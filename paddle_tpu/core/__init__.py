@@ -1,0 +1,26 @@
+from .dtype import convert_dtype, to_jax_dtype, is_floating, is_integer
+from .place import (
+    Place,
+    CPUPlace,
+    TPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
+    default_place,
+    is_compiled_with_tpu,
+    device_count,
+)
+
+__all__ = [
+    "convert_dtype",
+    "to_jax_dtype",
+    "is_floating",
+    "is_integer",
+    "Place",
+    "CPUPlace",
+    "TPUPlace",
+    "CUDAPlace",
+    "CUDAPinnedPlace",
+    "default_place",
+    "is_compiled_with_tpu",
+    "device_count",
+]

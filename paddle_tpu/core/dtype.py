@@ -1,0 +1,74 @@
+"""Dtype system.
+
+Parity with the reference's ``VarType.Type`` dtype enum
+(/root/reference/paddle/fluid/framework/framework.proto:104) but expressed as
+a thin mapping onto JAX/numpy dtypes.  bfloat16 is first-class (TPU native);
+float16 is kept for API parity.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical name -> jnp dtype
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+FLOATING = ("float16", "bfloat16", "float32", "float64")
+INTEGER = ("int8", "uint8", "int16", "int32", "int64")
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp dtype) to canonical name."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name not in _NAME_TO_DTYPE:
+            raise TypeError(f"unsupported dtype: {dtype!r}")
+        return name
+    # jnp.bfloat16 etc are types; np.dtype handles the rest
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = getattr(dtype, "__name__", str(dtype))
+    name = _ALIASES.get(name, name)
+    if name not in _NAME_TO_DTYPE:
+        raise TypeError(f"unsupported dtype: {dtype!r}")
+    return name
+
+
+def to_jax_dtype(dtype):
+    """Any dtype spec -> jnp dtype object."""
+    return _NAME_TO_DTYPE[convert_dtype(dtype)]
+
+
+def is_floating(dtype):
+    return convert_dtype(dtype) in FLOATING
+
+
+def is_integer(dtype):
+    return convert_dtype(dtype) in INTEGER

@@ -1,0 +1,10 @@
+"""Stock datasets.
+
+Parity: /root/reference/python/paddle/dataset/ (mnist, uci_housing, ...).
+No network egress is assumed: datasets are deterministic synthetic stand-ins
+with the same shapes/dtypes/reader API as the reference, sufficient for the
+book-style convergence tests (tests/book/) which only need learnable
+structure, not real data.
+"""
+
+from . import mnist, uci_housing  # noqa: F401

@@ -1,0 +1,39 @@
+"""MNIST-shaped synthetic dataset.
+
+Parity: /root/reference/python/paddle/dataset/mnist.py (train()/test()
+readers yielding (784-float image in [-1,1], int label)).  Images are
+class-conditional gaussian blobs so a LeNet/MLP can actually learn —
+mirrors the role of tests/book/test_recognize_digits.py fixtures.
+"""
+
+import numpy as np
+
+IMAGE_SIZE = 784
+NUM_CLASSES = 10
+
+
+def _make_split(n, seed):
+    rng = np.random.RandomState(seed)
+    # fixed per-class template patterns
+    templates = np.random.RandomState(7).uniform(-1, 1, (NUM_CLASSES, IMAGE_SIZE))
+    labels = rng.randint(0, NUM_CLASSES, size=n)
+    images = templates[labels] + rng.normal(0, 0.35, (n, IMAGE_SIZE))
+    images = np.clip(images, -1.0, 1.0).astype(np.float32)
+    return images, labels.astype(np.int64)
+
+
+def reader_creator(n, seed):
+    def reader():
+        images, labels = _make_split(n, seed)
+        for i in range(n):
+            yield images[i], labels[i]
+
+    return reader
+
+
+def train(n=2048):
+    return reader_creator(n, seed=1)
+
+
+def test(n=512):
+    return reader_creator(n, seed=2)

@@ -1,0 +1,31 @@
+"""UCI-housing-shaped synthetic regression dataset.
+
+Parity: /root/reference/python/paddle/dataset/uci_housing.py — 13 features,
+scalar target; linear ground truth + noise so fit-a-line converges
+(tests/book/test_fit_a_line.py parity).
+"""
+
+import numpy as np
+
+FEATURE_DIM = 13
+_W = np.random.RandomState(11).uniform(-1, 1, FEATURE_DIM).astype(np.float32)
+_B = 0.5
+
+
+def reader_creator(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        x = rng.uniform(-1, 1, (n, FEATURE_DIM)).astype(np.float32)
+        y = x @ _W + _B + rng.normal(0, 0.05, n).astype(np.float32)
+        for i in range(n):
+            yield x[i], y[i:i + 1].astype(np.float32)
+
+    return reader
+
+
+def train(n=512):
+    return reader_creator(n, seed=3)
+
+
+def test(n=128):
+    return reader_creator(n, seed=4)

@@ -1,0 +1,27 @@
+"""Distributed training.
+
+TPU-native mapping of the reference's distributed stack (SURVEY.md §2.3):
+NCCL rings -> mesh axes + XLA ICI collectives; gRPC PS runtime -> host
+sharded-embedding service (ps module); launch.py -> launch module;
+transpilers/ParallelExecutor -> sharded train steps.
+"""
+
+from . import collective
+from . import mesh
+from . import fleet
+from .collective import (
+    all_reduce, all_gather, reduce_scatter, broadcast, ppermute, all_to_all,
+    psum, pmean, pmax, pmin,
+)
+from .mesh import build_mesh, default_mesh, get_global_mesh, set_global_mesh
+from .env import ParallelEnv, init_parallel_env, get_rank, get_world_size
+from .data_parallel import DataParallel, DataParallelTrainStep, scale_loss
+
+__all__ = [
+    "collective", "mesh", "fleet",
+    "all_reduce", "all_gather", "reduce_scatter", "broadcast", "ppermute",
+    "all_to_all", "psum", "pmean", "pmax", "pmin",
+    "build_mesh", "default_mesh", "get_global_mesh", "set_global_mesh",
+    "ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
+    "DataParallel", "DataParallelTrainStep", "scale_loss",
+]

@@ -1,0 +1,118 @@
+"""Collective communication API.
+
+Parity: /root/reference/paddle/fluid/operators/collective/ (c_allreduce_sum
+c_allreduce_op.h:105, c_allgather, c_reducescatter, c_broadcast) and
+python/paddle/fluid/layers/collective.py:20-172.
+
+Two modes, mirroring the reference's graph-op vs eager duality:
+- inside shard_map/pjit: thin jax.lax wrappers keyed by mesh AXIS NAME
+  (the ring_id analogue);
+- eagerly on a mesh: the `eager_*` forms shard_map the collective for you.
+
+There is no gen_comm_id/comm_init — mesh axes are pre-wired by XLA
+(c_gen_nccl_id_op.cc's RPC rendezvous maps to jax.distributed.initialize,
+see paddle_tpu.distributed.env).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+__all__ = [
+    "all_reduce", "all_gather", "reduce_scatter", "broadcast", "ppermute",
+    "all_to_all", "psum", "pmean", "pmax", "pmin",
+    "eager_all_reduce", "eager_all_gather", "eager_broadcast",
+    "eager_reduce_scatter",
+]
+
+# --- in-spmd collectives (usable inside shard_map'ed functions) -----------
+
+def all_reduce(x, axis_name="dp", op="sum"):
+    """c_allreduce_{sum,max,min,prod} parity."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "prod":
+        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+    raise ValueError(f"unknown reduce op {op}")
+
+
+psum = partial(all_reduce, op="sum")
+pmean = partial(all_reduce, op="mean")
+pmax = partial(all_reduce, op="max")
+pmin = partial(all_reduce, op="min")
+
+
+def all_gather(x, axis_name="dp", axis=0, tiled=True):
+    """c_allgather parity."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name="dp", scatter_axis=0):
+    """c_reducescatter parity."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
+                            tiled=True)
+
+
+def broadcast(x, axis_name="dp", root=0):
+    """c_broadcast parity: every shard gets root's value."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis):
+    return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
+
+
+# --- eager collectives over a mesh ----------------------------------------
+
+def _eager(fn, mesh, in_spec, out_spec):
+    return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                     check_rep=False)
+
+
+def eager_all_reduce(x, mesh=None, axis_name="dp", op="sum"):
+    from .mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    spec = P(axis_name)
+    return _eager(lambda s: all_reduce(s, axis_name, op), mesh, (spec,),
+                  spec)(x)
+
+
+def eager_all_gather(x, mesh=None, axis_name="dp"):
+    from .mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    return _eager(lambda s: all_gather(s, axis_name), mesh, (P(axis_name),),
+                  P())(x)
+
+
+def eager_reduce_scatter(x, mesh=None, axis_name="dp"):
+    from .mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    return _eager(lambda s: reduce_scatter(s, axis_name), mesh,
+                  (P(axis_name),), P(axis_name))(x)
+
+
+def eager_broadcast(x, mesh=None, axis_name="dp", root=0):
+    from .mesh import default_mesh
+
+    mesh = mesh or default_mesh()
+    return _eager(lambda s: broadcast(s, axis_name, root), mesh,
+                  (P(axis_name),), P(axis_name))(x)

@@ -1,0 +1,99 @@
+"""Fleet — unified distributed-training facade.
+
+Parity: /root/reference/python/paddle/fluid/incubate/fleet/ —
+fleet.init (base/fleet_base.py:184), fleet.distributed_optimizer (:238),
+role makers (base/role_maker.py), DistributedStrategy
+(collective/__init__.py:134).
+"""
+
+import os
+
+from .env import ParallelEnv, init_parallel_env
+
+__all__ = ["init", "distributed_optimizer", "DistributedStrategy",
+           "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "worker_index",
+           "worker_num", "is_first_worker"]
+
+
+class DistributedStrategy:
+    """Parity: incubate/fleet/collective/__init__.py:134 — knobs for the
+    sharded step."""
+
+    def __init__(self):
+        self.nccl_comm_num = 1            # kept for API parity (unused)
+        self.use_local_sgd = False
+        self.local_sgd_steps = 1
+        self.use_dgc = False
+        self.dgc_sparsity = 0.999
+        self.recompute = False
+        self.recompute_checkpoints = []
+        self.amp = False
+        self.amp_loss_scale = 2.0 ** 15
+        # mesh degrees
+        self.dp_degree = None  # default: all devices
+        self.tp_degree = 1
+        self.pp_degree = 1
+        self.sp_degree = 1
+
+
+class PaddleCloudRoleMaker:
+    """Parity: role_maker.py PaddleCloudRoleMaker — ranks from env vars."""
+
+    def __init__(self, is_collective=True):
+        self._env = ParallelEnv()
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        return self._env.local_rank
+
+    def worker_num(self):
+        return max(self._env.nranks, 1)
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, current_id=0, workers=1, **kw):
+        super().__init__()
+        self._env._local_rank = current_id
+        self._env._nranks = workers
+
+
+_role_maker = None
+_strategy = None
+
+
+def init(role_maker=None):
+    global _role_maker
+    _role_maker = role_maker or PaddleCloudRoleMaker()
+    init_parallel_env()
+    return _role_maker
+
+
+def worker_index():
+    return _role_maker.worker_index() if _role_maker else 0
+
+
+def worker_num():
+    return _role_maker.worker_num() if _role_maker else 1
+
+
+def is_first_worker():
+    return worker_index() == 0 if _role_maker else True
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Wrap a dygraph optimizer for collective training (fleet_base.py:238).
+
+    Returns the optimizer augmented with the strategy; actual gradient
+    synchronization happens in DataParallelTrainStep / ShardedTrainStep
+    which consult the strategy's mesh degrees."""
+    global _strategy
+    _strategy = strategy or DistributedStrategy()
+    optimizer._fleet_strategy = _strategy
+    return optimizer
+
+
+def get_strategy():
+    return _strategy

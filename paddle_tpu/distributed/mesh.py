@@ -1,0 +1,63 @@
+"""Device mesh management.
+
+TPU-native replacement for the reference's communicator registry: NCCL
+rings keyed by ring_id (/root/reference/paddle/fluid/platform/
+collective_helper.h:62, c_comm_init ops) become named mesh axes on a
+jax.sharding.Mesh — "dp"/"tp"/"pp"/"sp"/"ep" axes replace ring ids, and
+XLA compiles the collectives onto ICI links; no comm-init ops exist.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_GLOBAL_MESH = None
+
+# canonical axis order
+AXES = ("pp", "dp", "sp", "tp")
+
+
+def build_mesh(dp=1, tp=1, pp=1, sp=1, devices=None):
+    """Create a Mesh with the requested parallelism degrees.
+
+    Axis semantics (scaling-book conventions):
+      dp — data parallel (gradient psum)
+      tp — tensor parallel (megatron-style sharded matmuls)
+      pp — pipeline stages
+      sp — sequence/context parallel (ring attention)
+    """
+    devices = devices if devices is not None else jax.devices()
+    need = dp * tp * pp * sp
+    if need > len(devices):
+        raise ValueError(
+            f"mesh needs {need} devices, only {len(devices)} available")
+    devs = np.array(devices[:need]).reshape(pp, dp, sp, tp)
+    return Mesh(devs, AXES)
+
+
+def set_global_mesh(mesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+    return mesh
+
+
+def get_global_mesh():
+    return _GLOBAL_MESH
+
+
+def default_mesh():
+    """All local devices on the dp axis."""
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        _GLOBAL_MESH = build_mesh(dp=len(jax.devices()))
+    return _GLOBAL_MESH
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh, batch_axes=("dp",)):
+    """Shard leading (batch) dim over the given mesh axes."""
+    return NamedSharding(mesh, P(batch_axes))

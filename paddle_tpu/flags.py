@@ -1,0 +1,105 @@
+"""Global flag registry.
+
+TPU-native analogue of the reference's gflags system
+(/root/reference/paddle/fluid/platform/flags.cc, exposed to Python via
+pybind.cc:1484 `init_gflags` and `fluid.set_flags`).  Flags are plain Python
+state: declared with `declare_flag`, overridable from the environment via
+``FLAGS_<name>`` at import time, and settable at runtime with
+:func:`set_flags` / readable with :func:`get_flags`.
+
+Unlike the reference there is no C++ side to mirror into -- XLA owns device
+memory and stream management -- so only behavior-relevant flags survive the
+translation (numeric checking, allocator hints forwarded to XLA, executor
+debug modes).
+"""
+
+import os
+
+_REGISTRY = {}
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "type", "help")
+
+    def __init__(self, name, default, help_str):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type(default)
+        self.help = help_str
+
+
+def _coerce(flag, value):
+    if flag.type is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return flag.type(value)
+
+
+def declare_flag(name, default, help_str=""):
+    """Declare a global flag. Env var ``FLAGS_<name>`` overrides the default."""
+    flag = _Flag(name, default, help_str)
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        flag.value = _coerce(flag, env)
+    _REGISTRY[name] = flag
+    return flag
+
+
+def set_flags(flags_dict):
+    """Set flags at runtime. Parity: ``fluid.set_flags``."""
+    for name, value in flags_dict.items():
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag: {name}")
+        flag = _REGISTRY[key]
+        flag.value = _coerce(flag, value)
+
+
+def get_flags(names):
+    """Read current flag values. Accepts a name or list of names."""
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for name in names:
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag: {name}")
+        out["FLAGS_" + key] = _REGISTRY[key].value
+    return out
+
+
+def flag(name):
+    """Fast internal accessor for a single flag value."""
+    return _REGISTRY[name].value
+
+
+def all_flags():
+    return {f.name: f.value for f in _REGISTRY.values()}
+
+
+# ---------------------------------------------------------------------------
+# Core flags (subset of platform/flags.cc with TPU-meaningful semantics)
+# ---------------------------------------------------------------------------
+
+# Numeric sanitizer: check every op output for NaN/Inf
+# (parity: FLAGS_check_nan_inf, platform/flags.cc:44 + operator.cc:1032).
+declare_flag("check_nan_inf", False, "Check every op output for NaN/Inf.")
+
+# Run programs op-by-op eagerly instead of jit-compiling the whole step.
+# Debug analogue of the reference's single-threaded Executor hot loop.
+declare_flag("eager_executor", False, "Interpret programs without jit (debug).")
+
+# Seed for parameter init when program/seed not set.
+declare_flag("global_seed", 0, "Fallback RNG seed for initializers.")
+
+# Print op types as they execute (VLOG-style tracing).
+declare_flag("executor_log_ops", False, "Log each op executed.")
+
+# AMP default dtype for TPU ("bfloat16" is the native choice; "float16"
+# for parity with the reference's fp16 AMP lists).
+declare_flag("amp_dtype", "bfloat16", "Low-precision dtype used by AMP.")
+
+# Benchmark / profiler output directory.
+declare_flag("profiler_dir", "/tmp/paddle_tpu_profile", "Profiler trace dir.")

@@ -1,0 +1,25 @@
+from .program import (
+    Program,
+    Block,
+    Variable,
+    Parameter,
+    Operator,
+    BackwardSection,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    name_scope,
+    data,
+)
+from .executor import Executor, Scope, global_scope, scope_guard
+from .backward import append_backward, gradients
+from .param_attr import ParamAttr
+from . import initializer, unique_name
+
+__all__ = [
+    "Program", "Block", "Variable", "Parameter", "Operator",
+    "BackwardSection", "default_main_program", "default_startup_program",
+    "program_guard", "name_scope", "data", "Executor", "Scope",
+    "global_scope", "scope_guard", "append_backward", "gradients",
+    "ParamAttr", "initializer", "unique_name",
+]

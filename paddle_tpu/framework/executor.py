@@ -1,0 +1,461 @@
+"""Executor + Scope.
+
+TPU-native replacement for the reference Executor stack:
+- `Executor::Run` hot loop (/root/reference/paddle/fluid/framework/executor.cc:449)
+- the Python feed/fetch façade (python/paddle/fluid/executor.py:676)
+- ParallelExecutor/graph passes (framework/parallel_executor.cc) — subsumed
+  by XLA: the whole program becomes ONE jitted function, so fusion, memory
+  planning and scheduling belong to the compiler, and the per-op dynamic
+  dispatch loop only exists at trace time.
+
+Execution model: a Program's op list is interpreted once while tracing; the
+traced function `step(state, feeds, rng) -> (new_state, fetches)` is jitted
+with state-buffer donation (the analogue of the reference's in-place
+variable mutation).  BackwardSection markers (see program.py) are realized
+with jax.value_and_grad over the preceding forward segment.
+
+Scope maps variable names to device arrays (parity: framework/scope.h:46,
+minus the parent-chain — programs here resolve names at trace time).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import flags
+from ..core.dtype import to_jax_dtype
+from ..core.place import default_place
+from ..ops.registry import get_op
+from .program import Variable, default_main_program
+
+
+class Scope:
+    """name -> array store for persistable variables."""
+
+    def __init__(self):
+        self.vars = {}
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def set_var(self, name, value):
+        self.vars[name] = value
+
+    def drop_kids(self):
+        self.vars.clear()
+
+    def local_var_names(self):
+        return list(self.vars)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+
+    return guard()
+
+
+class _RngBox:
+    """Mutable PRNG key holder threaded through op interpretation."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+    def next(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+def _resolve_slot(env, names):
+    vals = []
+    for n in names:
+        if n not in env:
+            raise KeyError(
+                f"variable '{n}' has no value: not fed, not initialized "
+                f"(did you run the startup program?)"
+            )
+        vals.append(env[n])
+    if len(vals) == 1:
+        return vals[0]
+    return vals
+
+
+# Ops whose outputs are trace-time constants (static attrs only). Their
+# concrete numpy values are tracked in a side const_env so that ops with
+# value-dependent output SHAPES (range, linspace) can still resolve under
+# jit — the analogue of the reference's compile-time shape inference for
+# fill_constant-fed shape ops.
+_CONST_EVAL = {
+    "fill_constant": lambda ins, attrs: {
+        "Out": np.full(tuple(attrs.get("shape", [])),
+                       float(attrs.get("value", 0.0)))},
+    "assign_value": lambda ins, attrs: {
+        "Out": np.array(
+            attrs.get("fp32_values") or attrs.get("int32_values")
+            or attrs.get("int64_values") or attrs.get("bool_values")
+        ).reshape(attrs.get("shape"))},
+}
+
+# Ops that need CONCRETE input values (output shape depends on them).
+_NEEDS_CONST_INPUTS = {"range", "linspace"}
+
+# Ops with data-dependent output shapes: impossible under jit by
+# construction (XLA static shapes); they work in the eager executor.
+_DYNAMIC_SHAPE_OPS = {"where_index", "masked_select", "unique"}
+
+
+def run_op(op, env, rng_box, const_env=None):
+    """Execute one recorded op against env (used at trace time)."""
+    opdef = get_op(op.type)
+    ins = {}
+    for slot, names in op.inputs.items():
+        if not names:
+            continue
+        ins[slot] = _resolve_slot(env, names)
+    attrs = op.attrs
+    if opdef.needs_rng:
+        attrs = dict(attrs)
+        attrs["_rng"] = rng_box.next()
+    if flags.flag("executor_log_ops"):
+        print(f"[paddle_tpu.executor] {op.type} {list(op.inputs)} -> {list(op.outputs)}")
+
+    if op.type in _NEEDS_CONST_INPUTS and const_env is not None:
+        const_ins = {}
+        ok = True
+        for slot, names in op.inputs.items():
+            if not names:
+                continue
+            if all(n in const_env for n in names):
+                vals = [const_env[n] for n in names]
+                const_ins[slot] = vals[0] if len(vals) == 1 else vals
+            else:
+                ok = False
+        if ok:
+            # keep as numpy: jnp.asarray would stage a tracer under jit
+            ins = {k: np.asarray(v) for k, v in const_ins.items()}
+        else:
+            raise NotImplementedError(
+                f"op '{op.type}' has a value-dependent output shape; its "
+                f"inputs must be compile-time constants under the jitted "
+                f"executor (or use FLAGS_eager_executor)")
+    elif op.type in _DYNAMIC_SHAPE_OPS:
+        import jax.core as _core
+
+        if any(isinstance(v, _core.Tracer)
+               for v in jax.tree.leaves(ins)):
+            raise NotImplementedError(
+                f"op '{op.type}' has a data-dependent output shape and "
+                f"cannot run under the jitted executor; set "
+                f"FLAGS_eager_executor=1 for this program")
+
+    outs = opdef.fn(ins, attrs)
+    for slot, names in op.outputs.items():
+        if slot not in outs:
+            continue
+        vals = outs[slot]
+        if len(names) == 1 and not isinstance(vals, (list, tuple)):
+            env[names[0]] = vals
+        else:
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            for n, v in zip(names, vals):
+                env[n] = v
+    if const_env is not None and op.type in _CONST_EVAL:
+        try:
+            c_outs = _CONST_EVAL[op.type](ins, attrs)
+            for slot, names in op.outputs.items():
+                if slot in c_outs:
+                    const_env[names[0]] = c_outs[slot]
+        except Exception:
+            pass
+
+
+def interpret(ops, env, rng_box, const_env=None):
+    for op in ops:
+        run_op(op, env, rng_box, const_env)
+
+
+def _checkpoint_chunks(seg, checkpoint_names):
+    """Split a forward segment at the ops producing each checkpoint var.
+    Returns [(ops, remat?)]: chunks between checkpoints are wrapped in
+    jax.checkpoint (recompute) — parity with the recompute_segments of
+    backward.py:639."""
+    if not checkpoint_names:
+        return [(seg, False)]
+    ckpts = set(checkpoint_names)
+    boundaries = []
+    for i, op in enumerate(seg):
+        if set(op.output_names()) & ckpts:
+            boundaries.append(i + 1)
+    if not boundaries:
+        return [(seg, False)]
+    chunks = []
+    start = 0
+    for b in boundaries:
+        if seg[start:b]:
+            chunks.append((seg[start:b], True))
+        start = b
+    if seg[start:]:
+        chunks.append((seg[start:], False))
+    return chunks
+
+
+class Executor:
+    """Parity: fluid.Executor (executor.py:437)."""
+
+    def __init__(self, place=None):
+        self.place = place or default_place()
+        self._cache = {}
+        seed = flags.flag("global_seed")
+        self._root_key = jax.random.PRNGKey(seed)
+
+    def close(self):
+        self._cache.clear()
+
+    # ------------------------------------------------------------------
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        scope=None,
+        return_numpy=True,
+        use_program_cache=True,
+    ):
+        program = program if program is not None else default_main_program()
+        # CompiledProgram / parallel wrapper support
+        if hasattr(program, "_get_executable_program"):
+            program = program._get_executable_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope if scope is not None else _global_scope
+
+        fetch_names = [
+            f.name if isinstance(f, Variable) else str(f) for f in fetch_list
+        ]
+
+        feed_arrays = {}
+        for name, value in feed.items():
+            v = program.global_block()._find_var_recursive(name)
+            dtype = to_jax_dtype(v.dtype) if v is not None and v.dtype else None
+            arr = jnp.asarray(np.asarray(value), dtype=dtype)
+            feed_arrays[name] = arr
+
+        self._root_key, run_key = jax.random.split(self._root_key)
+
+        if flags.flag("eager_executor") or flags.flag("check_nan_inf"):
+            return self._run_eager(program, feed_arrays, fetch_names, scope,
+                                   run_key, return_numpy)
+
+        persist_names = sorted(
+            v.name for v in program.list_vars() if v.persistable
+        )
+        state = {}
+        missing = []
+        for n in persist_names:
+            val = scope.find_var(n)
+            if val is None:
+                missing.append(n)
+            else:
+                state[n] = val
+        # Vars never written before and not produced by this program are an
+        # error only if some op reads them; let interpretation raise lazily.
+        produced = set()
+        for op in program.global_block().ops:
+            produced.update(op.output_names())
+        state_names = tuple(sorted(state))
+        for n in missing:
+            if n in produced:
+                continue
+            read = any(n in op.input_names() for op in program.global_block().ops)
+            if read:
+                raise RuntimeError(
+                    f"persistable variable '{n}' is uninitialized; run the "
+                    f"startup program first"
+                )
+
+        feed_sig = tuple(
+            (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
+            for n in sorted(feed_arrays)
+        )
+        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+               state_names)
+        # cache value holds the program so id() can't be recycled by a new
+        # Program allocated at the same address after GC
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None or entry[1] is not program:
+            compiled = self._build(program, fetch_names, tuple(persist_names))
+            if use_program_cache:
+                self._cache[key] = (compiled, program)
+        else:
+            compiled = entry[0]
+
+        new_state, fetches = compiled(state, feed_arrays, run_key)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _live_ops(program, fetch_names):
+        """Run-time dead-op elimination (the reference achieves this via
+        feed/fetch-targeted pruning in executor.py:236/274 + _prune): keep
+        ops that contribute to a fetch or to a persistable-variable update
+        (optimizer steps, batch-norm stats).  Programs with backward
+        sections run unpruned — everything feeds the update."""
+        ops = list(program.global_block().ops)
+        if program.backward_sections and not program._is_test:
+            return ops
+        persist = {v.name for v in program.list_vars() if v.persistable}
+        needed = set(fetch_names)
+        keep = [False] * len(ops)
+        for i in range(len(ops) - 1, -1, -1):
+            outs = set(ops[i].output_names())
+            if outs & needed or outs & persist:
+                keep[i] = True
+                needed |= set(ops[i].input_names())
+        return [op for i, op in enumerate(ops) if keep[i]]
+
+    def _build(self, program, fetch_names, persist_names):
+        ops = self._live_ops(program, fetch_names)
+        sections = [] if program._is_test else list(program.backward_sections)
+
+        def step(state, feeds, key):
+            env = {}
+            env.update(state)
+            env.update(feeds)
+            const_env = {}
+            rng_box = _RngBox(key)
+            pos = 0
+            for bs in sections:
+                seg = ops[pos:bs.pos]
+                train_params = {
+                    n: env[n] for n in bs.param_names if n in env
+                }
+                chunks = _checkpoint_chunks(seg, bs.checkpoint_names)
+
+                def fwd(ps, _env=dict(env), _chunks=chunks,
+                        _loss=bs.loss_name, _key=rng_box.key):
+                    e = dict(_env)
+                    e.update(ps)
+                    box_key = _key
+                    for chunk, remat in _chunks:
+                        if remat:
+                            # recompute segment (RecomputeOptimizer /
+                            # backward.py:623 parity) via jax.checkpoint
+                            def run_chunk(e_in, k, _c=chunk):
+                                e2 = dict(e_in)
+                                b = _RngBox(k)
+                                interpret(_c, e2, b, const_env)
+                                return e2, b.key
+
+                            e, box_key = jax.checkpoint(run_chunk)(e, box_key)
+                        else:
+                            b = _RngBox(box_key)
+                            interpret(chunk, e, b, const_env)
+                            box_key = b.key
+                    loss = e[_loss]
+                    return jnp.sum(loss), (e, box_key)
+
+                (loss_val, (env, new_key)), grads = jax.value_and_grad(
+                    fwd, has_aux=True
+                )(train_params)
+                rng_box = _RngBox(new_key)
+                for n, g in grads.items():
+                    env[n + "@GRAD"] = g
+                pos = bs.pos
+            interpret(ops[pos:], env, rng_box, const_env)
+            fetches = [env[n] for n in fetch_names]
+            new_state = {n: env[n] for n in persist_names if n in env}
+            return new_state, fetches
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    def _run_eager(self, program, feed_arrays, fetch_names, scope, key,
+                   return_numpy):
+        """Op-by-op interpretation without jit (FLAGS_eager_executor), with
+        per-op NaN/Inf checking when FLAGS_check_nan_inf is set (parity:
+        operator.cc:1032 + nan_inf_utils_detail.cc)."""
+        check = flags.flag("check_nan_inf")
+        env = {}
+        for n, v in scope.vars.items():
+            if v is not None:
+                env[n] = v
+        env.update(feed_arrays)
+        rng_box = _RngBox(key)
+        ops = self._live_ops(program, fetch_names)
+        sections = [] if program._is_test else list(program.backward_sections)
+        pos = 0
+        persist = {v.name for v in program.list_vars() if v.persistable}
+
+        def run_seg(seg):
+            for op in seg:
+                before = set(env)
+                run_op(op, env, rng_box)
+                if check:
+                    for slot, names in op.outputs.items():
+                        for n in names:
+                            if n in env and jnp.issubdtype(
+                                jnp.asarray(env[n]).dtype, jnp.floating
+                            ):
+                                if not bool(jnp.all(jnp.isfinite(env[n]))):
+                                    raise FloatingPointError(
+                                        f"op '{op.type}' output '{n}' "
+                                        f"contains NaN/Inf"
+                                    )
+
+        for bs in sections:
+            seg = ops[pos:bs.pos]
+            train_params = {n: env[n] for n in bs.param_names if n in env}
+
+            def fwd(ps, _env=dict(env), _seg=seg, _key=rng_box.key):
+                e = dict(_env)
+                e.update(ps)
+                box = _RngBox(_key)
+                interpret(_seg, e, box)
+                return jnp.sum(e[bs.loss_name]), (e, box.key)
+
+            (loss_val, (env, new_key)), grads = jax.value_and_grad(
+                fwd, has_aux=True
+            )(train_params)
+            rng_box = _RngBox(new_key)
+            if check:
+                for n, g in grads.items():
+                    if not bool(jnp.all(jnp.isfinite(g))):
+                        raise FloatingPointError(f"gradient of '{n}' has NaN/Inf")
+            for n, g in grads.items():
+                env[n + "@GRAD"] = g
+            pos = bs.pos
+        run_seg(ops[pos:])
+
+        for n in persist:
+            if n in env:
+                scope.set_var(n, env[n])
+        fetches = [env[n] for n in fetch_names]
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches

@@ -1,0 +1,37 @@
+"""Unique name generator.
+
+Parity: /root/reference/python/paddle/fluid/unique_name.py — per-prefix
+counters with guard support for reproducible naming.
+"""
+
+import contextlib
+
+
+class UniqueNameGenerator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, key):
+        if key not in self.ids:
+            self.ids[key] = 0
+        n = self.ids[key]
+        self.ids[key] += 1
+        return f"{key}_{n}"
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key):
+    return generator(key)
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    global generator
+    old = generator
+    generator = new_generator or UniqueNameGenerator()
+    try:
+        yield
+    finally:
+        generator = old

@@ -1,0 +1,117 @@
+"""Model IO: save/load variables and inference models.
+
+Parity: /root/reference/python/paddle/fluid/io.py — save_vars:208,
+save_params:336, save_persistables:556, load_vars:621, load_params:777,
+load_persistables:834, save_inference_model:1022, load_inference_model:1229.
+The reference serializes per-var protobuf tensors via save/load ops; here
+persistable state lives in the Scope as jax arrays and serializes to a
+single .npz (checkpoint-compatible with the dygraph state_dict path).
+"""
+
+import json
+import os
+
+import numpy as np
+
+from .framework.executor import global_scope
+from .framework.program import Program, default_main_program
+
+
+def _persistable_names(program):
+    return [v.name for v in program.list_vars() if v.persistable]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is not None:
+        names = [v.name if hasattr(v, "name") else v for v in vars]
+    else:
+        candidates = program.list_vars()
+        if predicate is not None:
+            candidates = [v for v in candidates if predicate(v)]
+        names = [v.name for v in candidates]
+    os.makedirs(dirname, exist_ok=True)
+    payload = {}
+    for n in names:
+        val = scope.find_var(n)
+        if val is None:
+            continue
+        payload[n] = np.asarray(val)
+    path = os.path.join(dirname, filename or "__params__.npz")
+    np.savez(path, **payload)
+    return path
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    return save_vars(executor, dirname, program,
+                     predicate=lambda v: v.is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    return save_vars(executor, dirname, program,
+                     predicate=lambda v: v.persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import jax.numpy as jnp
+
+    path = os.path.join(dirname, filename or "__params__.npz")
+    data = np.load(path)
+    scope = global_scope()
+    program = main_program or default_main_program()
+    if vars is not None:
+        wanted = {v.name if hasattr(v, "name") else v for v in vars}
+    else:
+        candidates = program.list_vars()
+        if predicate is not None:
+            candidates = [v for v in candidates if predicate(v)]
+        wanted = {v.name for v in candidates}
+    for n in data.files:
+        if n in wanted:
+            scope.set_var(n, jnp.asarray(data[n]))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    load_vars(executor, dirname, program,
+              predicate=lambda v: v.is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    load_vars(executor, dirname, program,
+              predicate=lambda v: v.persistable, filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """Prune program to the inference subgraph + freeze params
+    (parity: io.py:1022)."""
+    program = main_program or default_main_program()
+    target_names = [v.name if hasattr(v, "name") else v for v in target_vars]
+    pruned = program._prune(target_names)
+    os.makedirs(dirname, exist_ok=True)
+    model = {
+        "program": json.loads(pruned.to_json()),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": target_names,
+    }
+    with open(os.path.join(dirname, model_filename or "__model__.json"), "w") as f:
+        json.dump(model, f)
+    save_persistables(executor, dirname, pruned, filename=params_filename)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__.json")) as f:
+        model = json.load(f)
+    program = Program.from_json(json.dumps(model["program"]))
+    load_persistables(executor, dirname, program, filename=params_filename)
+    return program, model["feed_names"], model["fetch_names"]

@@ -1,0 +1,182 @@
+"""Dygraph→static bridge + compiled train steps.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/jit.py (TracedLayer
+:156) and dygraph_to_static/program_translator.py.  The reference traces
+ops into a ProgramDesc / rewrites Python AST; here the tracer IS jax.jit —
+`to_static` returns a compiled callable, `TracedLayer` additionally
+supports save_inference_model-style export via AOT lowering.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layers import (
+    buffer_dict,
+    functional_call,
+    functional_call_with_state,
+    param_dict,
+)
+
+__all__ = ["to_static", "TracedLayer", "TrainStep"]
+
+
+def to_static(layer_or_fn, static_argnums=()):
+    """Compile a Layer's forward (or a plain function) with jax.jit."""
+    from ..nn import Layer
+
+    if isinstance(layer_or_fn, Layer):
+        layer = layer_or_fn
+
+        @jax.jit
+        def apply(params, buffers, *args):
+            return functional_call_with_state(layer, params, buffers, *args)
+
+        def compiled(*args):
+            params = param_dict(layer)
+            buffers = buffer_dict(layer)
+            out, new_buffers = apply(params, buffers, *args)
+            for path, v in new_buffers.items():
+                layer._set_buffer_by_path(path, v)
+            return out
+
+        compiled.__wrapped__ = layer
+        return compiled
+    return jax.jit(layer_or_fn, static_argnums=static_argnums)
+
+
+class TracedLayer:
+    """Parity: dygraph/jit.py:156 TracedLayer.trace — captures a compiled
+    forward plus example-shaped signature for export."""
+
+    def __init__(self, layer, compiled, example_args):
+        self._layer = layer
+        self._compiled = compiled
+        self._example_args = example_args
+
+    @staticmethod
+    def trace(layer, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        compiled = to_static(layer)
+        out = compiled(*inputs)
+        return out, TracedLayer(layer, compiled, inputs)
+
+    def __call__(self, *args):
+        return self._compiled(*args)
+
+    def save_inference_model(self, dirname):
+        """AOT-lower and serialize StableHLO + params (the TPU-native
+        analogue of saving a frozen ProgramDesc)."""
+        import os
+        import pickle
+
+        import numpy as np
+
+        os.makedirs(dirname, exist_ok=True)
+        params = param_dict(self._layer)
+        buffers = buffer_dict(self._layer)
+
+        def fwd(params, buffers, *args):
+            out, _ = functional_call_with_state(self._layer, params, buffers,
+                                                *args)
+            return out
+
+        lowered = jax.jit(fwd).lower(params, buffers, *self._example_args)
+        with open(os.path.join(dirname, "model.stablehlo"), "w") as f:
+            f.write(lowered.as_text())
+        np.savez(os.path.join(dirname, "params.npz"),
+                 **{k: np.asarray(v) for k, v in params.items()})
+        with open(os.path.join(dirname, "meta.pkl"), "wb") as f:
+            pickle.dump({"buffers": {k: np.asarray(v)
+                                     for k, v in buffers.items()}}, f)
+        return dirname
+
+
+class TrainStep:
+    """Fully-jitted eager-mode training step.
+
+    Bundles model forward (+ buffer state), loss, grads, and an optax-backed
+    optimizer into one XLA computation with donated state — the eager
+    counterpart of the static Executor's compiled program, and the single-
+    chip base the distributed strategies shard.
+
+        step = TrainStep(model, optimizer, loss_fn)
+        loss = step(x, y)          # updates model params in place
+    """
+
+    def __init__(self, model, optimizer, loss_fn, donate=True):
+        self._model = model
+        self._optimizer = optimizer
+        self._loss_fn = loss_fn
+
+        def _step(params, buffers, opt_state, rng_key, *batch):
+            def loss_of(ps):
+                from ..nn.layers import _swap_params
+                from ..nn.parameter import default_rng
+
+                with _swap_params(model, ps), default_rng.key_context(rng_key):
+                    old = _swap_in_buffers(model, buffers)
+                    try:
+                        loss = loss_fn(model, *batch)
+                        new_buffers = {
+                            path: _get_buffer(model, path) for path in buffers
+                        }
+                    finally:
+                        _restore_buffers(model, old)
+                return loss, new_buffers
+
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            new_params, new_opt_state = optimizer.functional_update(
+                grads, opt_state, params)
+            return new_params, new_buffers, new_opt_state, loss
+
+        donate_args = (0, 1, 2) if donate else ()
+        self._jit_step = jax.jit(_step, donate_argnums=donate_args)
+        self._opt_state = None
+
+    def __call__(self, *batch):
+        from ..nn.parameter import default_rng
+
+        # structured-name params for functional grads
+        params = {n: p.value for n, p in self._model.named_parameters()
+                  if p.trainable}
+        buffers = buffer_dict(self._model)
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.init_state(params)
+        new_params, new_buffers, self._opt_state, loss = self._jit_step(
+            params, buffers, self._opt_state, default_rng.next_key(), *batch)
+        named = dict(self._model.named_parameters())
+        for n, v in new_params.items():
+            named[n].value = v
+        for path, v in new_buffers.items():
+            self._model._set_buffer_by_path(path, v)
+        return loss
+
+
+def _swap_in_buffers(model, buffers):
+    from ..nn.layers import _buffer_owner, _walk_sublayers
+
+    layers_by_prefix = {"": model}
+    for name, sub in _walk_sublayers(model, ""):
+        layers_by_prefix[name] = sub
+    old = {}
+    for path, v in buffers.items():
+        owner, leaf = _buffer_owner(layers_by_prefix, path)
+        old[path] = (owner, leaf, owner._buffers[leaf])
+        owner._buffers[leaf] = v
+    return old
+
+
+def _get_buffer(model, path):
+    from ..nn.layers import _buffer_owner, _walk_sublayers
+
+    layers_by_prefix = {"": model}
+    for name, sub in _walk_sublayers(model, ""):
+        layers_by_prefix[name] = sub
+    owner, leaf = _buffer_owner(layers_by_prefix, path)
+    return owner._buffers[leaf]
+
+
+def _restore_buffers(model, old):
+    for path, (owner, leaf, v) in old.items():
+        owner._buffers[leaf] = v

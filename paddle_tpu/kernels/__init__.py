@@ -1,0 +1,9 @@
+"""TPU kernels: Pallas implementations of the hot fused ops.
+
+The native-kernel tier of the framework — the analogue of the reference's
+hand-written CUDA fused ops (/root/reference/paddle/fluid/operators/fused/)
+and math library (operators/math/), rebuilt as Pallas/Mosaic kernels with
+XLA fallbacks.
+"""
+
+from . import attention  # noqa: F401

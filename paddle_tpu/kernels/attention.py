@@ -1,0 +1,69 @@
+"""Fused scaled-dot-product attention.
+
+Replaces the reference's fused transformer attention
+(/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu and
+math/bert_encoder_functor.cu) with a TPU-native path: a Pallas
+flash-attention kernel (added in kernels/flash_attention.py) for large
+sequence lengths, and an XLA-fused softmax(QK^T)V composition otherwise.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def _xla_attention(q, k, v, mask, scale, is_causal, dropout_p, training,
+                   rng_key):
+    # q,k,v: [B, H, S, D]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if is_causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(causal, logits, NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, NEG_INF)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        if rng_key is None:
+            from ..nn.parameter import default_rng
+
+            rng_key = default_rng.next_key()
+        keep = jax.random.bernoulli(rng_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
+                          scale=None, training=True, rng_key=None,
+                          use_flash=None):
+    """q/k/v: [batch, heads, seq, head_dim] -> [batch, heads, seq, head_dim]."""
+    head_dim = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(head_dim)
+
+    if use_flash is None:
+        # flash kernel needs TPU, no dropout inside kernel, seq multiple of
+        # its block size; mask support limited to causal
+        seq = q.shape[-2]
+        use_flash = (
+            jax.default_backend() == "tpu"
+            and dropout_p == 0.0
+            and mask is None
+            and seq >= 256
+            and seq % 128 == 0
+            and head_dim in (64, 128, 256)
+        )
+    if use_flash:
+        try:
+            from .flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=is_causal, sm_scale=scale)
+        except Exception:
+            pass
+    return _xla_attention(q, k, v, mask, scale, is_causal, dropout_p,
+                          training, rng_key)

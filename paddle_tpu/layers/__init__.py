@@ -1,0 +1,15 @@
+"""Layer function library (static graph builders).
+
+Parity target: /root/reference/python/paddle/fluid/layers/ — the ~150
+functions listed at layers/nn.py:38-188 plus tensor.py, loss.py,
+learning_rate_scheduler.py, metric_op.py.
+"""
+
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+from . import learning_rate_scheduler  # noqa: F401
+from ..framework.program import data  # noqa: F401
+
+from . import nn, tensor, loss, metric_op  # noqa: F401

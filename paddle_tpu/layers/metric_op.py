@@ -1,0 +1,26 @@
+"""Metric layer functions.
+
+Parity: /root/reference/python/paddle/fluid/layers/metric_op.py (accuracy,
+auc backed by operators/metrics/).
+"""
+
+from ..framework.layer_helper import LayerHelper
+
+__all__ = ["accuracy"]
+
+
+def accuracy(input, label, k=1, name=None):
+    """Top-k accuracy of predictions `input` vs int labels (metric_op.py)."""
+    helper = LayerHelper("accuracy", name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64")
+    helper.append_op("top_k", inputs={"X": input},
+                     outputs={"Out": values, "Indices": indices},
+                     attrs={"k": k})
+    acc = helper.create_variable_for_type_inference("float32")
+    correct = helper.create_variable_for_type_inference("int32")
+    total = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "accuracy", inputs={"Out": values, "Indices": indices, "Label": label},
+        outputs={"Accuracy": acc, "Correct": correct, "Total": total})
+    return acc

@@ -1,0 +1,394 @@
+"""Eager (dygraph) layers.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/nn.py (Conv2D, Pool2D,
+FC/Linear, BatchNorm, Embedding, LayerNorm, GRUUnit, ...) plus the
+transformer building blocks the flagship models need.
+"""
+
+import math
+
+import jax.numpy as jnp
+
+from . import functional as F
+from .functional import scaled_dot_product_attention
+from .layers import (
+    Layer,
+    functional_call,
+    param_dict,
+    load_param_dict,
+)
+from .parameter import EagerParameter, seed, default_rng
+from ..framework.initializer import (
+    ConstantInitializer,
+    NormalInitializer,
+    UniformInitializer,
+    XavierInitializer,
+)
+
+__all__ = [
+    "Layer", "EagerParameter", "functional_call", "param_dict",
+    "load_param_dict", "seed", "functional", "Linear", "Conv2D",
+    "Conv2DTranspose", "Pool2D", "MaxPool2D", "AvgPool2D", "BatchNorm",
+    "LayerNorm", "GroupNorm", "Embedding", "Dropout", "Sequential",
+    "LayerList", "ReLU", "GELU", "Sigmoid", "Tanh", "Softmax",
+    "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
+    "scaled_dot_product_attention",
+]
+
+functional = F
+
+
+class Linear(Layer):
+    """Parity: dygraph/nn.py Linear (mul + bias via core.ops)."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([input_dim, output_dim],
+                                            attr=param_attr)
+        if bias_attr is not False:
+            self.bias = self.create_parameter([output_dim], is_bias=True,
+                                              attr=bias_attr)
+        else:
+            self.bias = None
+        self._act = act
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        return _apply_act(out, self._act)
+
+
+class Conv2D(Layer):
+    """Parity: dygraph/nn.py Conv2D (NCHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + fs, attr=param_attr)
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_filters], is_bias=True,
+                                              attr=bias_attr)
+        else:
+            self.bias = None
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        self._act = act
+
+    def forward(self, x):
+        out = F.conv2d(x, self.weight, self.bias, self._stride,
+                       self._padding, self._dilation, self._groups)
+        return _apply_act(out, self._act)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups] + fs, attr=param_attr)
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_filters], is_bias=True,
+                                              attr=bias_attr)
+        else:
+            self.bias = None
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        self._act = act
+
+    def forward(self, x):
+        out = F.conv2d_transpose(x, self.weight, self.bias, self._stride,
+                                 self._padding, self._dilation, self._groups)
+        return _apply_act(out, self._act)
+
+
+class Pool2D(Layer):
+    """Parity: dygraph/nn.py Pool2D."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False):
+        super().__init__()
+        self._pool_size = pool_size
+        self._pool_type = pool_type
+        self._pool_stride = pool_stride
+        self._pool_padding = pool_padding
+        self._global = global_pooling
+
+    def forward(self, x):
+        if self._global:
+            axis = (2, 3)
+            if self._pool_type == "max":
+                return jnp.max(x, axis=axis, keepdims=True)
+            return jnp.mean(x, axis=axis, keepdims=True)
+        if self._pool_type == "max":
+            return F.max_pool2d(x, self._pool_size, self._pool_stride,
+                                self._pool_padding)
+        return F.avg_pool2d(x, self._pool_size, self._pool_stride,
+                            self._pool_padding)
+
+
+class MaxPool2D(Pool2D):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__(kernel_size, "max", stride or kernel_size, padding)
+
+
+class AvgPool2D(Pool2D):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__(kernel_size, "avg", stride or kernel_size, padding)
+
+
+class BatchNorm(Layer):
+    """Parity: dygraph/nn.py BatchNorm. Running stats are buffers; under a
+    functional train step use nn.layers.functional_call with
+    collect_buffers (see train utilities in paddle_tpu.jit)."""
+
+    def __init__(self, num_channels, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None,
+                 data_format="NCHW", dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [num_channels], attr=param_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], is_bias=True,
+                                          attr=bias_attr)
+        self.register_buffer("_mean", jnp.zeros(num_channels))
+        self.register_buffer("_variance", jnp.ones(num_channels))
+        self._momentum, self._epsilon = momentum, epsilon
+        self._data_format = data_format
+        self._act = act
+
+    def forward(self, x):
+        y, new_mean, new_var = F.batch_norm(
+            x, self._buffers["_mean"], self._buffers["_variance"],
+            self.weight, self.bias, training=self.training,
+            momentum=self._momentum, epsilon=self._epsilon,
+            data_format=self._data_format)
+        if self.training:
+            self._buffers["_mean"] = new_mean
+            self._buffers["_variance"] = new_var
+        return _apply_act(y, self._act)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        ns = ([normalized_shape] if isinstance(normalized_shape, int)
+              else list(normalized_shape))
+        self._normalized_shape = ns
+        self.weight = self.create_parameter(
+            ns, attr=param_attr, default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter(ns, is_bias=True, attr=bias_attr)
+        self._epsilon = epsilon
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [num_channels], default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], is_bias=True)
+        self._groups = num_groups
+        self._epsilon = epsilon
+
+    def forward(self, x):
+        from ..ops import nn_ops
+
+        return nn_ops.group_norm(
+            {"X": x, "Scale": self.weight.value, "Bias": self.bias.value},
+            {"groups": self._groups, "epsilon": self._epsilon})["Y"]
+
+
+class Embedding(Layer):
+    """Parity: dygraph/nn.py Embedding."""
+
+    def __init__(self, size, padding_idx=None, param_attr=None,
+                 dtype="float32", is_sparse=False):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            list(size), attr=param_attr,
+            default_initializer=XavierInitializer())
+        self._padding_idx = padding_idx
+
+    def forward(self, ids):
+        return F.embedding(ids, self.weight, self._padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train"):
+        super().__init__()
+        self._p = p
+        self._mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self._p, training=self.training, mode=self._mode)
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        for i, l in enumerate(layers):
+            if isinstance(l, tuple):
+                self.add_sublayer(l[0], l[1])
+            else:
+                self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for l in self._sub_layers.values():
+            x = l(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+
+class LayerList(Layer):
+    def __init__(self, layers=None):
+        super().__init__()
+        for i, l in enumerate(layers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+
+def _apply_act(x, act):
+    if act is None:
+        return x
+    return getattr(F, act)(x)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False):
+        super().__init__()
+        self._approx = approximate
+
+    def forward(self, x):
+        return F.gelu(x, self._approx)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return F.sigmoid(x)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return F.tanh(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+# ---------------------------------------------------------------------------
+# Transformer blocks (flagship path; fused attention kernels underneath)
+# ---------------------------------------------------------------------------
+
+class MultiHeadAttention(Layer):
+    """Self/cross attention with the fused SDPA kernel. Replaces the
+    reference's fused/multihead_matmul_op.cu transformer path."""
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        assert embed_dim % num_heads == 0
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.embed_dim = embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, bias_attr=bias_attr, dtype=dtype)
+        self.k_proj = Linear(embed_dim, embed_dim, bias_attr=bias_attr, dtype=dtype)
+        self.v_proj = Linear(embed_dim, embed_dim, bias_attr=bias_attr, dtype=dtype)
+        self.out_proj = Linear(embed_dim, embed_dim, bias_attr=bias_attr, dtype=dtype)
+        self._dropout = dropout
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                is_causal=False):
+        key = key if key is not None else query
+        value = value if value is not None else query
+        b, sq, _ = query.shape
+        sk = key.shape[1]
+        q = self.q_proj(query).reshape(b, sq, self.num_heads, self.head_dim)
+        k = self.k_proj(key).reshape(b, sk, self.num_heads, self.head_dim)
+        v = self.v_proj(value).reshape(b, sk, self.num_heads, self.head_dim)
+        q = jnp.transpose(q, (0, 2, 1, 3))
+        k = jnp.transpose(k, (0, 2, 1, 3))
+        v = jnp.transpose(v, (0, 2, 1, 3))
+        out = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self._dropout if self.training else 0.0,
+            is_causal=is_causal, training=self.training)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, self.embed_dim)
+        return self.out_proj(out)
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="gelu", normalize_before=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout,
+                                            dtype=dtype)
+        self.linear1 = Linear(d_model, dim_feedforward, dtype=dtype)
+        self.linear2 = Linear(dim_feedforward, d_model, dtype=dtype)
+        self.norm1 = LayerNorm(d_model, dtype=dtype)
+        self.norm2 = LayerNorm(d_model, dtype=dtype)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self._activation = activation
+        self._pre_norm = normalize_before
+
+    def forward(self, src, src_mask=None):
+        residual = src
+        if self._pre_norm:
+            src = self.norm1(src)
+        src = self.self_attn(src, attn_mask=src_mask)
+        src = residual + self.dropout1(src)
+        if not self._pre_norm:
+            src = self.norm1(src)
+        residual = src
+        if self._pre_norm:
+            src = self.norm2(src)
+        src = self.linear2(_apply_act(self.linear1(src), self._activation))
+        src = residual + self.dropout2(src)
+        if not self._pre_norm:
+            src = self.norm2(src)
+        return src
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer_fn, num_layers):
+        super().__init__()
+        self.layers = LayerList([encoder_layer_fn() for _ in range(num_layers)])
+
+    def forward(self, src, src_mask=None):
+        for layer in self.layers:
+            src = layer(src, src_mask)
+        return src

@@ -1,0 +1,277 @@
+"""Eager functional ops (`paddle_tpu.nn.functional`).
+
+The eager twin of the registered op kernels: pythonic signatures over jax
+arrays, sharing the kernel implementations in paddle_tpu/ops/ so static and
+dygraph modes have identical numerics (the reference achieves this by
+routing dygraph through the same OpKernel registry — tracer.cc:45).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import math_ops as _m
+from ..ops import nn_ops as _n
+from ..ops import tensor_ops as _t
+from .parameter import default_rng
+
+
+def _val(x):
+    from .parameter import EagerParameter
+
+    if isinstance(x, EagerParameter):
+        return x.value
+    return x
+
+
+# -- activations ------------------------------------------------------------
+
+def relu(x):
+    return jax.nn.relu(_val(x))
+
+
+def relu6(x):
+    return jnp.clip(_val(x), 0.0, 6.0)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(_val(x))
+
+
+def tanh(x):
+    return jnp.tanh(_val(x))
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(_val(x), approximate=approximate)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _n.leaky_relu({"X": _val(x)}, {"alpha": negative_slope})["Out"]
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(_val(x), alpha)
+
+
+def softplus(x):
+    return jax.nn.softplus(_val(x))
+
+
+def silu(x):
+    return jax.nn.silu(_val(x))
+
+
+def swish(x, beta=1.0):
+    return _n.swish({"X": _val(x)}, {"beta": beta})["Out"]
+
+
+def hard_swish(x):
+    return _n.hard_swish({"X": _val(x)}, {})["Out"]
+
+
+def hard_sigmoid(x):
+    return _n.hard_sigmoid({"X": _val(x)}, {})["Out"]
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(_val(x), axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(_val(x), axis=axis)
+
+
+# -- linear / conv / pool ---------------------------------------------------
+
+def linear(x, weight, bias=None):
+    out = _val(x) @ _val(weight)
+    if bias is not None:
+        out = out + _val(bias)
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    attrs = {
+        "strides": [stride, stride] if isinstance(stride, int) else list(stride),
+        "paddings": [padding, padding] if isinstance(padding, int) else list(padding),
+        "dilations": [dilation, dilation] if isinstance(dilation, int) else list(dilation),
+        "groups": groups,
+        "data_format": data_format,
+    }
+    out = _n.conv2d({"Input": _val(x), "Filter": _val(weight)}, attrs)["Output"]
+    if bias is not None:
+        b = _val(bias)
+        bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + b.reshape(bshape)
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     groups=1):
+    attrs = {
+        "strides": [stride, stride] if isinstance(stride, int) else list(stride),
+        "paddings": [padding, padding] if isinstance(padding, int) else list(padding),
+        "dilations": [dilation, dilation] if isinstance(dilation, int) else list(dilation),
+        "groups": groups,
+    }
+    out = _n.conv2d_transpose({"Input": _val(x), "Filter": _val(weight)},
+                              attrs)["Output"]
+    if bias is not None:
+        out = out + _val(bias).reshape(1, -1, 1, 1)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0):
+    stride = stride if stride is not None else kernel_size
+    return _n.pool2d({"X": _val(x)}, {
+        "ksize": [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size),
+        "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+        "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+        "pooling_type": "max"})["Out"]
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    stride = stride if stride is not None else kernel_size
+    return _n.pool2d({"X": _val(x)}, {
+        "ksize": [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size),
+        "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+        "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+        "pooling_type": "avg", "exclusive": exclusive})["Out"]
+
+
+def adaptive_avg_pool2d(x, output_size):
+    return _n.pool2d({"X": _val(x)}, {
+        "ksize": [output_size] * 2 if isinstance(output_size, int) else list(output_size),
+        "pooling_type": "avg", "adaptive": True})["Out"]
+
+
+def adaptive_max_pool2d(x, output_size):
+    return _n.pool2d({"X": _val(x)}, {
+        "ksize": [output_size] * 2 if isinstance(output_size, int) else list(output_size),
+        "pooling_type": "max", "adaptive": True})["Out"]
+
+
+# -- norm -------------------------------------------------------------------
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5):
+    x = _val(x)
+    if normalized_shape is None:
+        begin = x.ndim - 1
+    else:
+        ns = ([normalized_shape] if isinstance(normalized_shape, int)
+              else list(normalized_shape))
+        begin = x.ndim - len(ns)
+    ins = {"X": x}
+    if weight is not None:
+        ins["Scale"] = _val(weight).reshape(-1)
+    if bias is not None:
+        ins["Bias"] = _val(bias).reshape(-1)
+    return _n.layer_norm(ins, {"begin_norm_axis": begin,
+                               "epsilon": epsilon})["Y"]
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    out = _n.batch_norm(
+        {"X": _val(x), "Scale": _val(weight), "Bias": _val(bias),
+         "Mean": _val(running_mean), "Variance": _val(running_var)},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": not training,
+         "data_layout": data_format})
+    return out["Y"], out["MeanOut"], out["VarianceOut"]
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", rng_key=None):
+    key = rng_key if rng_key is not None else default_rng.next_key()
+    return _n.dropout({"X": _val(x)},
+                      {"dropout_prob": p, "is_test": not training,
+                       "dropout_implementation": mode, "_rng": key})["Out"]
+
+
+# -- losses -----------------------------------------------------------------
+
+def cross_entropy(input, label, soft_label=False, axis=-1, reduction="mean",
+                  ignore_index=-100):
+    """Logits-based CE (softmax fused), matching the reference's
+    softmax_with_cross_entropy kernel."""
+    out = _n.softmax_with_cross_entropy(
+        {"Logits": _val(input), "Label": _val(label)},
+        {"soft_label": soft_label, "axis": axis,
+         "ignore_index": ignore_index})["Loss"]
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def mse_loss(input, label, reduction="mean"):
+    out = jnp.square(_val(input) - _val(label))
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction="mean"):
+    out = _n.sigmoid_cross_entropy_with_logits(
+        {"X": _val(logit), "Label": _val(label)}, {})["Out"]
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def nll_loss(log_probs, label, reduction="mean"):
+    lp = _val(log_probs)
+    idx = _val(label).astype(jnp.int32)
+    picked = jnp.take_along_axis(lp, idx[..., None], axis=-1)[..., 0]
+    out = -picked
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+# -- embedding / misc -------------------------------------------------------
+
+def embedding(ids, weight, padding_idx=None):
+    return _n.lookup_table_v2(
+        {"Ids": _val(ids), "W": _val(weight)},
+        {"padding_idx": -1 if padding_idx is None else padding_idx})["Out"]
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(_val(x).astype(jnp.int32), num_classes)
+
+
+def pad(x, pad_width, mode="constant", value=0.0):
+    return jnp.pad(_val(x), pad_width, mode=mode,
+                   constant_values=value) if mode == "constant" else \
+        jnp.pad(_val(x), pad_width, mode=mode)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest"):
+    attrs = {"interp_method": mode}
+    if size is not None:
+        attrs["out_h"], attrs["out_w"] = int(size[0]), int(size[1])
+    if scale_factor is not None:
+        attrs["scale"] = float(scale_factor)
+    return _n.interpolate({"X": _val(x)}, attrs)["Out"]
+
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, scale=None, training=True):
+    """Fused attention entry point. Uses the Pallas flash-attention kernel
+    on TPU when shapes allow, else the XLA softmax(QK^T)V composition.
+
+    q/k/v: [batch, heads, seq, head_dim]."""
+    q, k, v = _val(q), _val(k), _val(v)
+    from ..kernels import attention as _attn
+
+    return _attn.dot_product_attention(
+        q, k, v, mask=attn_mask, dropout_p=dropout_p, is_causal=is_causal,
+        scale=scale, training=training)

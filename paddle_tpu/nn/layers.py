@@ -1,0 +1,305 @@
+"""nn.Layer — the eager module base class.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/layers.py (Layer:
+sublayers, parameters, add_parameter, state_dict, hooks, train/eval) with
+a functional extension for TPU: `functional_call(layer, params, *args)`
+runs forward with parameter values substituted from a flat dict, which is
+what lets a Layer be jitted/differentiated/sharded as a pure function
+(the analogue of the dygraph tracer capturing ops — imperative/tracer.cc:45
+— except here JAX is the tracer).
+"""
+
+import contextlib
+from collections import OrderedDict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import unique_name
+from .parameter import EagerParameter, default_rng
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        prefix = name_scope or type(self).__name__.lower()
+        self._full_name = unique_name.generate(prefix)
+        self._dtype = dtype
+        self._parameters = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self._buffers = OrderedDict()
+        self.training = True
+
+    # -- attribute plumbing ----------------------------------------------
+
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if isinstance(value, EagerParameter) and params is not None:
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer) and subs is not None:
+            subs[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        if "_parameters" in self.__dict__ and name in self._parameters:
+            return self._parameters[name]
+        if "_sub_layers" in self.__dict__ and name in self._sub_layers:
+            return self._sub_layers[name]
+        if "_buffers" in self.__dict__ and name in self._buffers:
+            return self._buffers[name]
+        raise AttributeError(
+            f"{type(self).__name__} has no attribute {name!r}")
+
+    # -- parameter management --------------------------------------------
+
+    def create_parameter(self, shape, dtype=None, is_bias=False,
+                         default_initializer=None, attr=None):
+        from ..framework.initializer import (
+            ConstantInitializer, XavierInitializer,
+        )
+        from ..framework.param_attr import ParamAttr
+
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype
+        init = (attr.initializer if attr and attr.initializer
+                else default_initializer)
+        name = (attr.name if attr and attr.name else
+                unique_name.generate(self._full_name + (".b" if is_bias else ".w")))
+        value = _materialize_init(init, shape, dtype, is_bias)
+        p = EagerParameter(value, name=name,
+                          trainable=attr.trainable if attr else True)
+        return p
+
+    def add_parameter(self, name, param):
+        self._parameters[name] = param
+        return param
+
+    def add_sublayer(self, name, layer):
+        self._sub_layers[name] = layer
+        return layer
+
+    def register_buffer(self, name, value):
+        self._buffers[name] = jnp.asarray(value)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers)]
+
+    def named_parameters(self, include_sublayers=True, prefix=""):
+        out = []
+        for n, p in self._parameters.items():
+            if p is not None:
+                out.append((f"{prefix}{n}" if prefix else n, p))
+        if include_sublayers:
+            for sn, sub in self._sub_layers.items():
+                sp = f"{prefix}{sn}." if prefix else f"{sn}."
+                out.extend(sub.named_parameters(True, sp))
+        return out
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        for sub in self._sub_layers.values():
+            out.append(sub)
+            out.extend(sub.sublayers())
+        return out
+
+    def named_buffers(self, prefix=""):
+        out = []
+        for n, b in self._buffers.items():
+            out.append((f"{prefix}{n}" if prefix else n, b))
+        for sn, sub in self._sub_layers.items():
+            sp = f"{prefix}{sn}." if prefix else f"{sn}."
+            out.extend(sub.named_buffers(sp))
+        return out
+
+    # -- modes ------------------------------------------------------------
+
+    def train(self):
+        self.training = True
+        for sub in self._sub_layers.values():
+            sub.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for sub in self._sub_layers.values():
+            sub.eval()
+        return self
+
+    # -- state dict (dygraph/checkpoint.py parity) ------------------------
+
+    def state_dict(self, include_sublayers=True):
+        out = OrderedDict()
+        for n, p in self.named_parameters(include_sublayers):
+            out[n] = np.asarray(p.value)
+        for n, b in self.named_buffers():
+            out[n] = np.asarray(b)
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        params = dict(self.named_parameters())
+        buffers = dict(self.named_buffers())
+        missing = []
+        for n, v in state_dict.items():
+            if n in params:
+                params[n].set_value(v)
+            elif n in buffers:
+                self._set_buffer_by_path(n, v)
+            else:
+                missing.append(n)
+        return missing
+
+    load_dict = set_state_dict
+
+    def _set_buffer_by_path(self, path, value):
+        parts = path.split(".")
+        layer = self
+        for p in parts[:-1]:
+            layer = layer._sub_layers[p]
+        layer._buffers[parts[-1]] = jnp.asarray(value)
+
+    # -- call -------------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+def _materialize_init(init, shape, dtype, is_bias):
+    """Run an initializer eagerly (no startup program in dygraph mode)."""
+    from ..core.dtype import to_jax_dtype
+    from ..framework import initializer as I
+
+    jdt = to_jax_dtype(dtype)
+    key = default_rng.next_key()
+    import jax
+
+    if init is None:
+        init = I.ConstantInitializer(0.0) if is_bias else I.XavierInitializer()
+    if isinstance(init, I.ConstantInitializer):
+        return jnp.full(shape, init.value, dtype=jdt)
+    if isinstance(init, I.UniformInitializer):
+        return jax.random.uniform(key, tuple(shape), minval=init.low,
+                                  maxval=init.high).astype(jdt)
+    if isinstance(init, I.NormalInitializer):
+        return (jax.random.normal(key, tuple(shape)) * init.scale
+                + init.loc).astype(jdt)
+    if isinstance(init, I.TruncatedNormalInitializer):
+        return (jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape))
+                * init.scale + init.loc).astype(jdt)
+    if isinstance(init, I.XavierInitializer):
+        fi, fo = I._fan_in_out(tuple(shape))
+        fi = init.fan_in or fi
+        fo = init.fan_out or fo
+        if init.uniform:
+            limit = float(np.sqrt(6.0 / (fi + fo)))
+            return jax.random.uniform(key, tuple(shape), minval=-limit,
+                                      maxval=limit).astype(jdt)
+        std = float(np.sqrt(2.0 / (fi + fo)))
+        return (jax.random.normal(key, tuple(shape)) * std).astype(jdt)
+    if isinstance(init, I.MSRAInitializer):
+        fi, _ = I._fan_in_out(tuple(shape))
+        fi = init.fan_in or fi
+        if init.uniform:
+            limit = float(np.sqrt(6.0 / fi))
+            return jax.random.uniform(key, tuple(shape), minval=-limit,
+                                      maxval=limit).astype(jdt)
+        std = float(np.sqrt(2.0 / fi))
+        return (jax.random.normal(key, tuple(shape)) * std).astype(jdt)
+    if isinstance(init, I.NumpyArrayInitializer):
+        return jnp.asarray(init.value, dtype=jdt)
+    raise TypeError(f"unsupported initializer {init!r}")
+
+
+# ---------------------------------------------------------------------------
+# Functional bridge: run a Layer as a pure function of a params dict
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _swap_params(layer, values):
+    named = dict(layer.named_parameters())
+    old = {}
+    for n, v in values.items():
+        if n in named:
+            old[n] = named[n].value
+            named[n].value = v
+    try:
+        yield
+    finally:
+        for n, v in old.items():
+            named[n].value = v
+
+
+def functional_call(layer, params, *args, **kwargs):
+    """Forward pass with parameter values taken from `params`
+    (dict name->array). Safe under jax tracing; the Layer's own values are
+    restored afterwards."""
+    with _swap_params(layer, params):
+        return layer(*args, **kwargs)
+
+
+def _walk_sublayers(layer, prefix):
+    for n, sub in layer._sub_layers.items():
+        path = f"{prefix}{n}" if not prefix else f"{prefix}.{n}"
+        yield path, sub
+        yield from _walk_sublayers(sub, path)
+
+
+def _buffer_owner(layers_by_prefix, path):
+    if "." in path:
+        owner_path, leaf = path.rsplit(".", 1)
+    else:
+        owner_path, leaf = "", path
+    return layers_by_prefix[owner_path], leaf
+
+
+def functional_call_with_state(layer, params, buffers, *args, **kwargs):
+    """Forward with params AND mutable buffers (batch-norm running stats)
+    substituted; returns (output, new_buffers).  This is how a stateful
+    Layer becomes a pure jittable function — the TPU answer to the
+    reference's in-place MeanOut/VarianceOut aliasing."""
+    layers_by_prefix = {"": layer}
+    for name, sub in _walk_sublayers(layer, ""):
+        layers_by_prefix[name] = sub
+    with _swap_params(layer, params):
+        old = {}
+        for path, v in buffers.items():
+            owner, leaf = _buffer_owner(layers_by_prefix, path)
+            old[path] = owner._buffers[leaf]
+            owner._buffers[leaf] = v
+        try:
+            out = layer(*args, **kwargs)
+            new_buffers = {}
+            for path in buffers:
+                owner, leaf = _buffer_owner(layers_by_prefix, path)
+                new_buffers[path] = owner._buffers[leaf]
+        finally:
+            for path, v in old.items():
+                owner, leaf = _buffer_owner(layers_by_prefix, path)
+                owner._buffers[leaf] = v
+    return out, new_buffers
+
+
+def buffer_dict(layer):
+    return {n: b for n, b in layer.named_buffers()}
+
+
+def param_dict(layer, trainable_only=False):
+    return {
+        n: p.value
+        for n, p in layer.named_parameters()
+        if (p.trainable or not trainable_only)
+    }
+
+
+def load_param_dict(layer, values):
+    named = dict(layer.named_parameters())
+    for n, v in values.items():
+        if n in named:
+            named[n].value = jnp.asarray(v)

@@ -1,0 +1,95 @@
+"""Eager Parameter + RNG state.
+
+Parity: the dygraph VarBase parameter half
+(/root/reference/paddle/fluid/imperative/layer.h:56) — an eager tensor with
+a name, trainable flag and in-place `set_value`, minus the grad slot (JAX
+autodiff is transform-based, not tape-based).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import flags
+
+
+class _EagerRng:
+    """Global PRNG stream for eager-mode stochastic ops (dropout, init).
+
+    Under jax tracing (jit train steps), a traced key must be threaded in
+    explicitly — use key_context so stochastic ops split from the traced
+    key instead of baking a constant into the compiled function."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # lazy: creating a key initializes the jax backend, which must not
+        # happen at import time
+        self._key = None
+        self._override = None
+
+    def seed(self, s):
+        with self._lock:
+            self._key = jax.random.PRNGKey(s)
+
+    def key_context(self, key):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            old = self._override
+            self._override = [key]
+            try:
+                yield
+            finally:
+                self._override = old
+
+        return ctx()
+
+    def next_key(self):
+        if self._override is not None:
+            self._override[0], sub = jax.random.split(self._override[0])
+            return sub
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.PRNGKey(flags.flag("global_seed"))
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+
+default_rng = _EagerRng()
+
+
+def seed(s):
+    """Parity: fluid.dygraph seed / paddle.seed."""
+    default_rng.seed(s)
+    return default_rng
+
+
+class EagerParameter:
+    """Named trainable array container used by nn.Layer."""
+
+    def __init__(self, value, name=None, trainable=True):
+        self.value = jnp.asarray(value)
+        self.name = name
+        self.trainable = trainable
+        self.stop_gradient = not trainable
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def set_value(self, v):
+        self.value = jnp.asarray(v, dtype=self.value.dtype)
+
+    def __repr__(self):
+        return (f"EagerParameter(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, trainable={self.trainable})")

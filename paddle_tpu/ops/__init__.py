@@ -1,0 +1,14 @@
+"""Op corpus: name-registered pure-jax kernels.
+
+Importing this package registers all built-in ops (the analogue of the
+reference's static REGISTER_OPERATOR initializers being linked in).
+"""
+
+from .registry import register_op, get_op, has_op, list_ops
+
+from . import math_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+
+__all__ = ["register_op", "get_op", "has_op", "list_ops"]

@@ -1,0 +1,302 @@
+"""Math op kernels.
+
+Covers the reference's elementwise family
+(/root/reference/paddle/fluid/operators/elementwise/), matmul/mul
+(operators/matmul_op.cc:521, mul_op.cc), reductions
+(operators/reduce_ops/), activations with simple math semantics
+(operators/activation_op.cc) and comparison/logical ops
+(operators/controlflow/compare_op.cc, logical_op.cc).
+
+Each kernel is a pure jax function; gradients are JAX-derived (the
+reference registers explicit *_grad ops per op — not needed here).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _bcast_to(x, y, axis):
+    """Reference elementwise broadcast: align y's dims to x starting at `axis`
+    (elementwise_op_function.h semantics). axis=-1 aligns trailing dims like
+    numpy."""
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    if axis is None or axis == -1:
+        return y  # numpy trailing-dim broadcast
+    # insert trailing singleton dims so y's first dim lines up with x[axis]
+    new_shape = y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def _elementwise(fn):
+    def kernel(ins, attrs):
+        x, y = ins["X"], ins["Y"]
+        y = _bcast_to(x, y, attrs.get("axis", -1))
+        return {"Out": fn(x, y)}
+
+    return kernel
+
+
+register_op("elementwise_add")(_elementwise(jnp.add))
+register_op("elementwise_sub")(_elementwise(jnp.subtract))
+register_op("elementwise_mul")(_elementwise(jnp.multiply))
+register_op("elementwise_div")(_elementwise(jnp.divide))
+register_op("elementwise_max")(_elementwise(jnp.maximum))
+register_op("elementwise_min")(_elementwise(jnp.minimum))
+register_op("elementwise_pow")(_elementwise(jnp.power))
+register_op("elementwise_mod")(_elementwise(jnp.mod))
+register_op("elementwise_floordiv")(_elementwise(jnp.floor_divide))
+
+
+@register_op("scale")
+def scale(ins, attrs):
+    """out = scale * (x + bias) or scale * x + bias (operators/scale_op.cc)."""
+    x = ins["X"]
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": x * s + b}
+    return {"Out": (x + b) * s}
+
+
+@register_op("pow")
+def pow_(ins, attrs):
+    return {"Out": jnp.power(ins["X"], attrs.get("factor", 1.0))}
+
+
+@register_op("matmul")
+def matmul(ins, attrs):
+    """operators/matmul_op.cc:521 — optional transpose + alpha, batched."""
+    x, y = ins["X"], ins["Y"]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("mul")
+def mul(ins, attrs):
+    """operators/mul_op.cc — flatten x to 2-D at x_num_col_dims, y likewise."""
+    x, y = ins["X"], ins["Y"]
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(jnp.prod(jnp.array(xs[:xnc]))), -1)) if x.ndim > 2 else x
+    y2 = y.reshape((-1, int(jnp.prod(jnp.array(ys[ync:]))))) if y.ndim > 2 else y
+    out = x2 @ y2
+    out_shape = xs[:xnc] + ys[ync:]
+    return {"Out": out.reshape(out_shape)}
+
+
+@register_op("sum")
+def sum_(ins, attrs):
+    """operators/sum_op.cc — add N tensors (duplicable input X)."""
+    xs = ins["X"]
+    if not isinstance(xs, (list, tuple)):
+        xs = [xs]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+def _reduce(fn):
+    def kernel(ins, attrs):
+        x = ins["X"]
+        if attrs.get("reduce_all", False):
+            dim = None
+        else:
+            dim = attrs.get("dim", [0])
+            dim = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+        keep = attrs.get("keep_dim", False)
+        return {"Out": fn(x, axis=dim, keepdims=keep)}
+
+    return kernel
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+register_op("reduce_all")(_reduce(jnp.all))
+register_op("reduce_any")(_reduce(jnp.any))
+
+
+@register_op("mean")
+def mean(ins, attrs):
+    return {"Out": jnp.mean(ins["X"])}
+
+
+def _unary(name, fn):
+    register_op(name)(lambda ins, attrs: {"Out": fn(ins["X"])})
+
+
+_unary("abs", jnp.abs)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("square", jnp.square)
+_unary("sign", jnp.sign)
+_unary("floor", jnp.floor)
+_unary("ceil", jnp.ceil)
+_unary("round", jnp.round)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("asin", jnp.arcsin)
+_unary("acos", jnp.arccos)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("logical_not", jnp.logical_not)
+
+
+@register_op("clip")
+def clip(ins, attrs):
+    return {"Out": jnp.clip(ins["X"], attrs.get("min"), attrs.get("max"))}
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ins, attrs):
+    x = ins["X"]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": x * scale}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ins, attrs):
+    return {"Out": jnp.sum(jnp.square(ins["X"])).reshape(())}
+
+
+@register_op("cumsum")
+def cumsum(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    reverse = attrs.get("reverse", False)
+    exclusive = attrs.get("exclusive", False)
+    work = jnp.flip(x, axis) if reverse else x
+    out = jnp.cumsum(work, axis=axis)
+    if exclusive:
+        # shift right by one along axis, zero-filled
+        pad = [(0, 0)] * work.ndim
+        pad[axis] = (1, 0)
+        idx = [slice(None)] * work.ndim
+        idx[axis] = slice(0, work.shape[axis])
+        out = jnp.pad(out, pad)[tuple(idx)]
+    if reverse:
+        out = jnp.flip(out, axis)
+    return {"Out": out}
+
+
+@register_op("arg_max")
+def arg_max(ins, attrs):
+    return {"Out": jnp.argmax(ins["X"], axis=attrs.get("axis", -1)).astype(jnp.int64)}
+
+
+@register_op("arg_min")
+def arg_min(ins, attrs):
+    return {"Out": jnp.argmin(ins["X"], axis=attrs.get("axis", -1)).astype(jnp.int64)}
+
+
+@register_op("argsort")
+def argsort(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    descending = attrs.get("descending", False)
+    key = -x if descending else x
+    idx = jnp.argsort(key, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("isfinite")
+def isfinite(ins, attrs):
+    return {"Out": jnp.all(jnp.isfinite(ins["X"]))}
+
+
+@register_op("isfinite_v2")
+def isfinite_v2(ins, attrs):
+    return {"Out": jnp.isfinite(ins["X"])}
+
+
+@register_op("isnan_v2")
+def isnan_v2(ins, attrs):
+    return {"Out": jnp.isnan(ins["X"])}
+
+
+@register_op("isinf_v2")
+def isinf_v2(ins, attrs):
+    return {"Out": jnp.isinf(ins["X"])}
+
+
+@register_op("increment")
+def increment(ins, attrs):
+    return {"Out": ins["X"] + attrs.get("step", 1.0)}
+
+
+def _compare(name, fn):
+    def kernel(ins, attrs):
+        x, y = ins["X"], ins["Y"]
+        return {"Out": fn(x, y)}
+
+    register_op(name)(kernel)
+
+
+_compare("less_than", jnp.less)
+_compare("less_equal", jnp.less_equal)
+_compare("greater_than", jnp.greater)
+_compare("greater_equal", jnp.greater_equal)
+_compare("equal", jnp.equal)
+_compare("not_equal", jnp.not_equal)
+_compare("logical_and", jnp.logical_and)
+_compare("logical_or", jnp.logical_or)
+_compare("logical_xor", jnp.logical_xor)
+
+
+@register_op("maximum")
+def maximum(ins, attrs):
+    return {"Out": jnp.maximum(ins["X"], ins["Y"])}
+
+
+@register_op("minimum")
+def minimum(ins, attrs):
+    return {"Out": jnp.minimum(ins["X"], ins["Y"])}
+
+
+@register_op("dot")
+def dot(ins, attrs):
+    x, y = ins["X"], ins["Y"]
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=x.ndim > 1)}
+
+
+@register_op("p_norm")
+def p_norm(ins, attrs):
+    x = ins["X"]
+    porder = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keepdim = attrs.get("keepdim", False)
+    out = jnp.sum(jnp.abs(x) ** porder, axis=axis, keepdims=keepdim) ** (1.0 / porder)
+    return {"Out": out}
+
+
+@register_op("kron")
+def kron(ins, attrs):
+    return {"Out": jnp.kron(ins["X"], ins["Y"])}

@@ -1,0 +1,612 @@
+"""Neural-net op kernels.
+
+Replaces the reference's conv/pool/norm/activation/loss kernels
+(/root/reference/paddle/fluid/operators/conv_op.cc, pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, activation_op.cc, softmax_op.cc,
+cross_entropy_op.cc, softmax_with_cross_entropy_op.cc, dropout_op.cc,
+lookup_table_op.cc, top_k_op.cc, one_hot_op.cc ...).  cuDNN kernel variants
+map to XLA: `lax.conv_general_dilated` and `lax.reduce_window` are the
+MXU-tiled equivalents.
+
+Layout convention follows the reference: NCHW for conv/pool (attr
+`data_format` honored where the reference supports NHWC).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# Activations (operators/activation_op.cc)
+# ---------------------------------------------------------------------------
+
+def _unary(name, fn):
+    register_op(name)(lambda ins, attrs: {"Out": fn(ins["X"])})
+
+
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("tanh", jnp.tanh)
+_unary("softplus", jax.nn.softplus)
+_unary("softsign", jax.nn.soft_sign)
+_unary("silu", jax.nn.silu)
+_unary("relu6", lambda x: jnp.clip(x, 0.0, 6.0))
+_unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+
+
+@register_op("gelu")
+def gelu(ins, attrs):
+    return {"Out": jax.nn.gelu(ins["X"], approximate=attrs.get("approximate", False))}
+
+
+@register_op("leaky_relu")
+def leaky_relu(ins, attrs):
+    alpha = attrs.get("alpha", 0.02)
+    x = ins["X"]
+    return {"Out": jnp.where(x >= 0, x, alpha * x)}
+
+
+@register_op("elu")
+def elu(ins, attrs):
+    return {"Out": jax.nn.elu(ins["X"], alpha=attrs.get("alpha", 1.0))}
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": jnp.clip(slope * ins["X"] + offset, 0.0, 1.0)}
+
+
+@register_op("hard_swish")
+def hard_swish(ins, attrs):
+    threshold = attrs.get("threshold", 6.0)
+    scale = attrs.get("scale", 6.0)
+    offset = attrs.get("offset", 3.0)
+    x = ins["X"]
+    return {"Out": x * jnp.clip(x + offset, 0.0, threshold) / scale}
+
+
+@register_op("swish")
+def swish(ins, attrs):
+    beta = attrs.get("beta", 1.0)
+    x = ins["X"]
+    return {"Out": x * jax.nn.sigmoid(beta * x)}
+
+
+@register_op("prelu")
+def prelu(ins, attrs):
+    x, alpha = ins["X"], ins["Alpha"]
+    mode = attrs.get("mode", "all")
+    if mode == "channel" and alpha.ndim == 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(x >= 0, x, alpha * x)}
+
+
+@register_op("softmax")
+def softmax(ins, attrs):
+    return {"Out": jax.nn.softmax(ins["X"], axis=attrs.get("axis", -1))}
+
+
+@register_op("log_softmax")
+def log_softmax(ins, attrs):
+    return {"Out": jax.nn.log_softmax(ins["X"], axis=attrs.get("axis", -1))}
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+@register_op("cross_entropy")
+def cross_entropy(ins, attrs):
+    """operators/cross_entropy_op.cc — X is probabilities (post-softmax)."""
+    x, label = ins["X"], ins["Label"]
+    if attrs.get("soft_label", False):
+        out = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), axis=-1, keepdims=True)
+    else:
+        idx = label.astype(jnp.int32)
+        if idx.ndim == x.ndim:
+            idx = jnp.squeeze(idx, axis=-1)
+        picked = jnp.take_along_axis(x, idx[..., None], axis=-1)
+        ignore = attrs.get("ignore_index", -100)
+        logp = -jnp.log(jnp.maximum(picked, 1e-20))
+        out = jnp.where(idx[..., None] == ignore, 0.0, logp)
+    return {"Y": out}
+
+
+@register_op("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(ins, attrs):
+    """operators/softmax_with_cross_entropy_op.cc — fused, numerically stable."""
+    logits, label = ins["Logits"], ins["Label"]
+    axis = attrs.get("axis", -1)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        idx = label.astype(jnp.int32)
+        if idx.ndim == logits.ndim:
+            idx = jnp.squeeze(idx, axis=axis)
+        picked = jnp.take_along_axis(logp, idx[..., None], axis=axis)
+        ignore = attrs.get("ignore_index", -100)
+        loss = jnp.where(idx[..., None] == ignore, 0.0, -picked)
+    return {"Softmax": jnp.exp(logp), "Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(ins, attrs):
+    x, label = ins["X"], ins["Label"]
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if attrs.get("normalize", False):
+        n = jnp.maximum(jnp.sum(label != ignore), 1)
+        loss = loss / n
+    return {"Out": loss}
+
+
+@register_op("square_error_cost")
+def square_error_cost(ins, attrs):
+    """operators/squared_l2_distance? layers.square_error_cost — (x-y)^2."""
+    return {"Out": jnp.square(ins["X"] - ins["Y"])}
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(ins, attrs):
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = ins["X"] - ins["Y"]
+    ad = jnp.abs(diff)
+    out = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    out = jnp.sum(out, axis=tuple(range(1, out.ndim)), keepdims=False)
+    return {"Out": out.reshape(-1, 1), "Diff": diff}
+
+
+@register_op("huber_loss")
+def huber_loss(ins, attrs):
+    delta = attrs.get("delta", 1.0)
+    r = ins["Y"] - ins["X"]
+    ar = jnp.abs(r)
+    out = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Out": out, "Residual": r}
+
+
+@register_op("bce_loss")
+def bce_loss(ins, attrs):
+    x, label = ins["X"], ins["Label"]
+    x = jnp.clip(x, 1e-12, 1.0 - 1e-12)
+    return {"Out": -(label * jnp.log(x) + (1 - label) * jnp.log(1 - x))}
+
+
+@register_op("log_loss")
+def log_loss(ins, attrs):
+    eps = attrs.get("epsilon", 1e-4)
+    p, label = ins["Predicted"], ins["Labels"]
+    return {
+        "Loss": -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    }
+
+
+@register_op("label_smooth")
+def label_smooth(ins, attrs):
+    eps = attrs.get("epsilon", 0.0)
+    x = ins["X"]
+    k = x.shape[-1]
+    if "PriorDist" in ins and ins["PriorDist"] is not None:
+        prior = ins["PriorDist"]
+        return {"Out": (1 - eps) * x + eps * prior}
+    return {"Out": (1 - eps) * x + eps / k}
+
+
+@register_op("kldiv_loss")
+def kldiv_loss(ins, attrs):
+    x, target = ins["X"], ins["Target"]
+    loss = jnp.where(target > 0, target * (jnp.log(target) - x), 0.0)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": loss}
+
+
+# ---------------------------------------------------------------------------
+# Conv / pool (operators/conv_op.cc, pool_op.cc) — cuDNN -> XLA conv HLO
+# ---------------------------------------------------------------------------
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _conv_pad(paddings, ksize, algo, n):
+    """Resolve reference padding attr (+ padding_algorithm SAME/VALID)."""
+    if algo == "VALID":
+        return [(0, 0)] * n
+    if algo == "SAME":
+        return "SAME"
+    p = _pair(paddings, n)
+    if len(p) == n:
+        return [(int(pi), int(pi)) for pi in p]
+    # [before0, after0, before1, after1] form
+    return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+
+
+@register_op("conv2d")
+def conv2d(ins, attrs):
+    x, w = ins["Input"], ins["Filter"]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    data_format = attrs.get("data_format", "NCHW")
+    pad = _conv_pad(attrs.get("paddings", [0, 0]), None, attrs.get("padding_algorithm", "EXPLICIT"), 2)
+    if data_format in ("NCHW", "AnyLayout"):
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    else:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NHWC", "OIHW", "NHWC"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(ins, attrs):
+    attrs = dict(attrs)
+    x = ins["Input"]
+    c = x.shape[1] if attrs.get("data_format", "NCHW") != "NHWC" else x.shape[-1]
+    attrs["groups"] = c
+    return conv2d(ins, attrs)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ins, attrs):
+    x, w = ins["Input"], ins["Filter"]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    pad = _conv_pad(attrs.get("paddings", [0, 0]), None, attrs.get("padding_algorithm", "EXPLICIT"), 2)
+    if pad == "SAME":
+        pad = [(0, 0), (0, 0)]
+    # filter layout for transpose conv in reference: (in, out//groups, kh, kw)
+    kh, kw = w.shape[2], w.shape[3]
+    pads = [
+        (dilations[i] * (k - 1) - pad[i][0], dilations[i] * (k - 1) - pad[i][1])
+        for i, k in enumerate((kh, kw))
+    ]
+    cin, cout_per_g = w.shape[0], w.shape[1]
+    if groups > 1:
+        # regroup (Cin, Cout/g, kh, kw) -> OIHW (Cout, Cin/g, kh, kw)
+        w_t = w.reshape(groups, cin // groups, cout_per_g, kh, kw)
+        w_t = jnp.swapaxes(w_t, 1, 2).reshape(
+            groups * cout_per_g, cin // groups, kh, kw)
+    else:
+        w_t = jnp.swapaxes(w, 0, 1)  # -> (out, in, kh, kw)
+    w_t = jnp.flip(w_t, axis=(2, 3))
+    dn = lax.conv_dimension_numbers(x.shape, w_t.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=pads,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("pool2d")
+def pool2d(ins, attrs):
+    x = ins["X"]
+    ptype = attrs.get("pooling_type", "max")
+    global_pool = attrs.get("global_pooling", False)
+    adaptive = attrs.get("adaptive", False)
+    data_format = attrs.get("data_format", "NCHW")
+    nchw = data_format in ("NCHW", "AnyLayout")
+    spatial = (2, 3) if nchw else (1, 2)
+
+    if global_pool or (adaptive and tuple(_pair(attrs.get("ksize", [1, 1]))) == (1, 1)):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": fn(x, axis=spatial, keepdims=True)}
+
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    if adaptive:
+        # adaptive pooling to output size ksize: use reduce_window with
+        # computed strides when divisible, else fall back to resize-style.
+        in_h, in_w = x.shape[spatial[0]], x.shape[spatial[1]]
+        oh, ow = ksize
+        sh, sw = in_h // oh, in_w // ow
+        kh, kw = in_h - (oh - 1) * sh, in_w - (ow - 1) * sw
+        strides, ksize, pads = (sh, sw), (kh, kw), [(0, 0), (0, 0)]
+    else:
+        strides = _pair(attrs.get("strides", [1, 1]))
+        pads = _conv_pad(attrs.get("paddings", [0, 0]),
+                         ksize, attrs.get("padding_algorithm", "EXPLICIT"), 2)
+
+    window = [1, 1, 1, 1]
+    wstrides = [1, 1, 1, 1]
+    window[spatial[0]], window[spatial[1]] = ksize
+    wstrides[spatial[0]], wstrides[spatial[1]] = strides
+    if pads == "SAME":
+        padding = "SAME"
+    else:
+        padding = [(0, 0)] * 4
+        padding[spatial[0]], padding[spatial[1]] = pads
+
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, wstrides, padding)
+        out = out.astype(x.dtype)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, wstrides, padding)
+        has_pad = padding == "SAME" or any(
+            p != (0, 0) for p in (padding if isinstance(padding, list) else []))
+        if attrs.get("exclusive", True) and has_pad:
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, wstrides, padding)
+            out = summed / counts
+        else:
+            out = summed / (window[spatial[0]] * window[spatial[1]])
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+@register_op("batch_norm", stateful=True)
+def batch_norm(ins, attrs):
+    """operators/batch_norm_op.cc — returns updated running stats as outputs
+    (MeanOut/VarianceOut alias Mean/Variance in the reference)."""
+    x = ins["X"]
+    scale, bias = ins["Scale"], ins["Bias"]
+    mean_in, var_in = ins["Mean"], ins["Variance"]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    use_global = attrs.get("use_global_stats", False) or is_test
+    data_layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if data_layout in ("NCHW", "AnyLayout") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if use_global:
+        mean, var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+        saved_mean = jnp.zeros_like(mean_in)
+        saved_var = jnp.zeros_like(var_in)
+    else:
+        mean = jnp.mean(x, axis=reduce_axes)
+        var = jnp.mean(jnp.square(x - mean.reshape(bshape)), axis=reduce_axes)
+        mean_out = mean_in * momentum + mean * (1 - momentum)
+        var_out = var_in * momentum + var * (1 - momentum)
+        saved_mean = mean
+        saved_var = 1.0 / jnp.sqrt(var + eps)
+
+    inv = 1.0 / jnp.sqrt(var + eps)
+    y = (x - mean.reshape(bshape)) * (inv * scale).reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": y,
+        "MeanOut": mean_out,
+        "VarianceOut": var_out,
+        "SavedMean": saved_mean,
+        "SavedVariance": saved_var,
+    }
+
+
+@register_op("layer_norm")
+def layer_norm(ins, attrs):
+    """operators/layer_norm_op.cc — normalize over dims >= begin_norm_axis."""
+    x = ins["X"]
+    eps = attrs.get("epsilon", 1e-5)
+    axis = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    inv = 1.0 / jnp.sqrt(var + eps)
+    y = (x - mean) * inv
+    scale = ins.get("Scale")
+    bias = ins.get("Bias")
+    norm_shape = x.shape[axis:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    return {
+        "Y": y,
+        "Mean": mean.reshape(x.shape[:axis]),
+        "Variance": var.reshape(x.shape[:axis]),
+    }
+
+
+@register_op("instance_norm")
+def instance_norm(ins, attrs):
+    x = ins["X"]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    scale, bias = ins.get("Scale"), ins.get("Bias")
+    c = x.shape[1]
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": y, "SavedMean": mean, "SavedVariance": var}
+
+
+@register_op("group_norm")
+def group_norm(ins, attrs):
+    x = ins["X"]
+    eps = attrs.get("epsilon", 1e-5)
+    groups = attrs.get("groups", 1)
+    n, c = x.shape[0], x.shape[1]
+    g = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    y = ((g - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    scale, bias = ins.get("Scale"), ins.get("Bias")
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return {"Y": y, "Mean": mean.reshape(n, groups), "Variance": var.reshape(n, groups)}
+
+
+@register_op("norm")
+def l2_normalize(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+# ---------------------------------------------------------------------------
+# Dropout (operators/dropout_op.cc) — consumes PRNG key
+# ---------------------------------------------------------------------------
+
+@register_op("dropout", needs_rng=True)
+def dropout(ins, attrs):
+    x = ins["X"]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
+        return {"Out": out, "Mask": jnp.ones_like(x)}
+    key = attrs["_rng"]
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / max(1.0 - p, 1e-12), 0.0)
+    else:
+        out = jnp.where(keep, x, 0.0)
+    return {"Out": out, "Mask": keep.astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Embedding (operators/lookup_table_op.cc) / one-hot / top-k
+# ---------------------------------------------------------------------------
+
+@register_op("lookup_table_v2")
+def lookup_table_v2(ins, attrs):
+    ids, w = ins["Ids"], ins["W"]
+    padding_idx = attrs.get("padding_idx", -1)
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return {"Out": out}
+
+
+@register_op("lookup_table")
+def lookup_table(ins, attrs):
+    # v1 keeps a trailing [,1] dim on ids
+    ids = ins["Ids"]
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = jnp.squeeze(ids, axis=-1)
+    return lookup_table_v2({"Ids": ids, "W": ins["W"]}, attrs)
+
+
+@register_op("one_hot_v2")
+def one_hot_v2(ins, attrs):
+    depth = attrs.get("depth")
+    return {"Out": jax.nn.one_hot(ins["X"].astype(jnp.int32), depth, dtype=jnp.float32)}
+
+
+@register_op("one_hot")
+def one_hot(ins, attrs):
+    x = ins["X"]
+    if x.ndim > 1 and x.shape[-1] == 1:
+        x = jnp.squeeze(x, axis=-1)
+    return one_hot_v2({"X": x}, attrs)
+
+
+@register_op("top_k")
+def top_k(ins, attrs):
+    x = ins["X"]
+    k = attrs.get("k", 1)
+    vals, idx = lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k_v2")
+def top_k_v2(ins, attrs):
+    x = ins["X"]
+    k = attrs.get("k", 1)
+    axis = attrs.get("axis", -1)
+    largest = attrs.get("largest", True)
+    x_m = jnp.moveaxis(x, axis, -1)
+    if not largest:
+        vals, idx = lax.top_k(-x_m, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(x_m, k)
+    return {
+        "Out": jnp.moveaxis(vals, -1, axis),
+        "Indices": jnp.moveaxis(idx, -1, axis).astype(jnp.int64),
+    }
+
+
+@register_op("accuracy")
+def accuracy(ins, attrs):
+    """operators/metrics/accuracy_op.cc — Out(top-k hit rate), given Indices."""
+    idx, label = ins["Indices"], ins["Label"]
+    if label.ndim < idx.ndim:
+        label = label[..., None]
+    correct = jnp.any(idx == label.astype(idx.dtype), axis=-1)
+    total = correct.shape[0]
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    return {
+        "Accuracy": (num_correct / total).astype(jnp.float32),
+        "Correct": num_correct.astype(jnp.int32),
+        "Total": jnp.asarray(total, jnp.int32),
+    }
+
+
+@register_op("pad")
+def pad(ins, attrs):
+    x = ins["X"]
+    p = attrs.get("paddings")
+    value = attrs.get("pad_value", 0.0)
+    pairs = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pairs, constant_values=value)}
+
+
+@register_op("pad2d")
+def pad2d(ins, attrs):
+    x = ins["X"]
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    mode = attrs.get("mode", "constant")
+    value = attrs.get("pad_value", 0.0)
+    pairs = [(0, 0), (0, 0), (int(p[0]), int(p[1])), (int(p[2]), int(p[3]))]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, pairs, constant_values=value)}
+    np_mode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, pairs, mode=np_mode)}
+
+
+@register_op("interpolate")
+def interpolate(ins, attrs):
+    x = ins["X"]  # NCHW
+    out_h = attrs.get("out_h", -1)
+    out_w = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    method = attrs.get("interp_method", "nearest")
+    if (out_h is None or out_h <= 0) and scale:
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    shape = (x.shape[0], x.shape[1], out_h, out_w)
+    jmethod = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[method]
+    return {"Out": jax.image.resize(x, shape, method=jmethod)}

@@ -1,0 +1,234 @@
+"""Optimizer update ops.
+
+Replaces /root/reference/paddle/fluid/operators/optimizers/ (sgd_op.cc,
+momentum_op.cc, adam_op.cc, adagrad_op.cc, rmsprop_op.cc, adamax_op.cc,
+adadelta_op.cc, lamb_op.cc, ftrl_op.cc, lars_momentum_op.cc,
+decayed_adagrad_op.cc, dpsgd_op.cc).  Each reference op mutates Param /
+moment buffers in place; here each kernel returns the new values ("ParamOut"
+etc.) and the functional executor rebinds the variables — XLA's buffer
+donation recovers the in-place update at runtime.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _lr(ins):
+    return ins["LearningRate"].reshape(())
+
+
+@register_op("sgd", stateful=True)
+def sgd(ins, attrs):
+    return {"ParamOut": ins["Param"] - _lr(ins) * ins["Grad"]}
+
+
+@register_op("momentum", stateful=True)
+def momentum(ins, attrs):
+    mu = attrs.get("mu", 0.9)
+    use_nesterov = attrs.get("use_nesterov", False)
+    p, g, v = ins["Param"], ins["Grad"], ins["Velocity"]
+    lr = _lr(ins)
+    v_out = mu * v + g
+    if use_nesterov:
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("lars_momentum", stateful=True)
+def lars_momentum(ins, attrs):
+    mu = attrs.get("mu", 0.9)
+    lars_coeff = attrs.get("lars_coeff", 0.001)
+    lars_weight_decay = attrs.get("lars_weight_decay", 0.0005)
+    p, g, v = ins["Param"], ins["Grad"], ins["Velocity"]
+    lr = _lr(ins)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + lars_weight_decay * p_norm + 1e-12),
+        lr,
+    )
+    v_out = mu * v + local_lr * (g + lars_weight_decay * p)
+    return {"ParamOut": p - v_out, "VelocityOut": v_out}
+
+
+@register_op("adam", stateful=True)
+def adam(ins, attrs):
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    p, g = ins["Param"], ins["Grad"]
+    m1, m2 = ins["Moment1"], ins["Moment2"]
+    b1pow = ins["Beta1Pow"].reshape(())
+    b2pow = ins["Beta2Pow"].reshape(())
+    lr = _lr(ins)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2pow) / (1 - b1pow)
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    return {
+        "ParamOut": p_out,
+        "Moment1Out": m1_out,
+        "Moment2Out": m2_out,
+        "Beta1PowOut": (b1pow * beta1).reshape(ins["Beta1Pow"].shape),
+        "Beta2PowOut": (b2pow * beta2).reshape(ins["Beta2Pow"].shape),
+    }
+
+
+@register_op("adamw", stateful=True)
+def adamw(ins, attrs):
+    coeff = attrs.get("coeff", 0.01)
+    out = adam(ins, attrs)
+    lr = _lr(ins)
+    out["ParamOut"] = out["ParamOut"] - lr * coeff * ins["Param"]
+    return out
+
+
+@register_op("adagrad", stateful=True)
+def adagrad(ins, attrs):
+    eps = attrs.get("epsilon", 1e-6)
+    p, g, m = ins["Param"], ins["Grad"], ins["Moment"]
+    lr = _lr(ins)
+    m_out = m + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("decayed_adagrad", stateful=True)
+def decayed_adagrad(ins, attrs):
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    p, g, m = ins["Param"], ins["Grad"], ins["Moment"]
+    lr = _lr(ins)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    return {"ParamOut": p - lr * g / (jnp.sqrt(m_out) + eps), "MomentOut": m_out}
+
+
+@register_op("adadelta", stateful=True)
+def adadelta(ins, attrs):
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    p, g = ins["Param"], ins["Grad"]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"], ins["AvgSquaredUpdate"]
+    g2 = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    return {
+        "ParamOut": p + update,
+        "AvgSquaredGradOut": g2,
+        "AvgSquaredUpdateOut": u2,
+    }
+
+
+@register_op("rmsprop", stateful=True)
+def rmsprop(ins, attrs):
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mom_coef = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    p, g = ins["Param"], ins["Grad"]
+    ms, mom = ins["MeanSquare"], ins["Moment"]
+    lr = _lr(ins)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    if centered:
+        mg = ins["MeanGrad"]
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - jnp.square(mg_out) + eps
+    else:
+        mg_out = None
+        denom = ms_out + eps
+    mom_out = mom_coef * mom + lr * g / jnp.sqrt(denom)
+    out = {"ParamOut": p - mom_out, "MeanSquareOut": ms_out, "MomentOut": mom_out}
+    if centered:
+        out["MeanGradOut"] = mg_out
+    return out
+
+
+@register_op("adamax", stateful=True)
+def adamax(ins, attrs):
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    p, g = ins["Param"], ins["Grad"]
+    m, inf_norm = ins["Moment"], ins["InfNorm"]
+    b1pow = ins["Beta1Pow"].reshape(())
+    lr = _lr(ins)
+    m_out = beta1 * m + (1 - beta1) * g
+    inf_out = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    p_out = p - (lr / (1 - b1pow)) * (m_out / (inf_out + eps))
+    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out}
+
+
+@register_op("ftrl", stateful=True)
+def ftrl(ins, attrs):
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    p, g = ins["Param"], ins["Grad"]
+    sq_accum, lin_accum = ins["SquaredAccumulator"], ins["LinearAccumulator"]
+    lr = _lr(ins)
+    new_accum = sq_accum + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_accum) - jnp.sqrt(sq_accum)) / lr
+    else:
+        sigma = (new_accum ** -lr_power - sq_accum ** -lr_power) / lr
+    lin_out = lin_accum + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_accum) / lr + 2 * l2
+    else:
+        denom = new_accum ** -lr_power / lr + 2 * l2
+    pre_shrink = (l1 * jnp.sign(lin_out) - lin_out) / denom
+    p_out = jnp.where(jnp.abs(lin_out) > l1, pre_shrink, 0.0)
+    return {
+        "ParamOut": p_out,
+        "SquaredAccumOut": new_accum,
+        "LinearAccumOut": lin_out,
+    }
+
+
+@register_op("dpsgd", stateful=True, needs_rng=True)
+def dpsgd(ins, attrs):
+    """Differentially-private SGD (optimizers/dpsgd_op.cc): clip + noise."""
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    p, g = ins["Param"], ins["Grad"]
+    lr = _lr(ins)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g / jnp.maximum(1.0, g_norm / clip)
+    noise = jax.random.normal(attrs["_rng"], g.shape) * sigma * clip
+    return {"ParamOut": p - lr * (g + noise / batch_size)}
+
+
+@register_op("lamb", stateful=True)
+def lamb(ins, attrs):
+    """LAMB large-batch optimizer (optimizers/lamb_op.cc; parity with
+    optimizer.py:2698)."""
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    p, g = ins["Param"], ins["Grad"]
+    m1, m2 = ins["Moment1"], ins["Moment2"]
+    b1pow = ins["Beta1Pow"].reshape(())
+    b2pow = ins["Beta2Pow"].reshape(())
+    lr = _lr(ins)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * jnp.square(g)
+    m1_hat = m1_out / (1 - b1pow)
+    m2_hat = m2_out / (1 - b2pow)
+    r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return {
+        "ParamOut": p - lr * trust * r,
+        "Moment1Out": m1_out,
+        "Moment2Out": m2_out,
+        "Beta1PowOut": (b1pow * beta1).reshape(ins["Beta1Pow"].shape),
+        "Beta2PowOut": (b2pow * beta2).reshape(ins["Beta2Pow"].shape),
+    }

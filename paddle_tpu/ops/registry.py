@@ -1,0 +1,61 @@
+"""Op registry.
+
+TPU-native replacement for the reference op registry
+(/root/reference/paddle/fluid/framework/op_registry.h:223 REGISTER_OPERATOR
+and the OpKernelType dispatch in framework/operator.cc:1044).  An op here is
+a single pure function over jax arrays:
+
+    fn(ins: dict[slot -> Array | list[Array]], attrs: dict) -> dict[slot -> ...]
+
+There is no kernel-type dispatch (place/layout/library): XLA compiles one
+kernel per backend, and data transform (operator.cc:1123) is jnp's implicit
+device placement.  There are also no registered grad ops -- gradients come
+from JAX tracing through the kernel; ops with bespoke gradients use
+jax.custom_vjp inside their kernel (the analogue of GradOpDescMaker).
+"""
+
+_OPS = {}
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "needs_rng", "stateful")
+
+    def __init__(self, name, fn, needs_rng=False, stateful=False):
+        self.name = name
+        self.fn = fn
+        # needs_rng: kernel consumes a PRNG key passed as attrs['_rng']
+        # (dropout, random init ops). The executor threads keys through.
+        self.needs_rng = needs_rng
+        # stateful: output aliases an input buffer logically (e.g. optimizer
+        # update ops writing ParamOut=Param). Purely informational; the
+        # functional interpreter always produces new values.
+        self.stateful = stateful
+
+
+def register_op(name, needs_rng=False, stateful=False):
+    """Decorator registering a kernel under an op type name."""
+
+    def deco(fn):
+        if name in _OPS:
+            raise ValueError(f"op '{name}' already registered")
+        _OPS[name] = OpDef(name, fn, needs_rng=needs_rng, stateful=stateful)
+        return fn
+
+    return deco
+
+
+def get_op(name):
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise NotImplementedError(
+            f"op '{name}' has no registered TPU kernel"
+        ) from None
+
+
+def has_op(name):
+    return name in _OPS
+
+
+def list_ops():
+    return sorted(_OPS)

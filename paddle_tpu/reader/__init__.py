@@ -1,0 +1,211 @@
+"""Data pipeline.
+
+Parity targets:
+- DataLoader.from_generator (/root/reference/python/paddle/fluid/reader.py:179)
+- reader decorators (python/paddle/reader/decorator.py: batch/shuffle/map/...)
+- the C++ double-buffered device feed (operators/reader/buffered_reader.cc)
+  becomes a background-thread prefetcher handing ready host batches to the
+  jitted step (device transfer overlaps with compute via jax async dispatch).
+"""
+
+import itertools
+import queue
+import random as _random
+import threading
+
+import numpy as np
+
+__all__ = ["DataLoader", "batch", "shuffle", "buffered", "map_readers",
+           "chain", "compose", "firstn", "cache"]
+
+
+# ---------------------------------------------------------------------------
+# reader decorators (python/paddle/reader/decorator.py parity)
+# ---------------------------------------------------------------------------
+
+def batch(reader, batch_size, drop_last=False):
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def shuffle(reader, buf_size, seed=None):
+    def shuffled():
+        rng = _random.Random(seed)
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+
+    return shuffled
+
+
+def buffered(reader, size):
+    """Background-thread prefetch (decorator.py buffered)."""
+
+    class _End:
+        pass
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+
+        def worker():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _End:
+                break
+            yield item
+
+    return buffered_reader
+
+
+def map_readers(func, *readers):
+    def reader():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+
+    return reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+
+    return reader
+
+
+def firstn(reader, n):
+    def reader_n():
+        yield from itertools.islice(reader(), n)
+
+    return reader_n
+
+
+def cache(reader):
+    all_items = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            for item in reader():
+                all_items.append(item)
+                yield item
+            filled[0] = True
+        else:
+            yield from all_items
+
+    return cached
+
+
+# ---------------------------------------------------------------------------
+# DataLoader
+# ---------------------------------------------------------------------------
+
+def _stack_samples(samples, feed_names):
+    """list of tuples -> dict of batched numpy arrays."""
+    cols = list(zip(*samples))
+    out = {}
+    for name, col in zip(feed_names, cols):
+        out[name] = np.stack([np.asarray(c) for c in col])
+    return out
+
+
+class DataLoader:
+    """Feeds dict batches to Executor.run (reader.py:179 parity).
+
+    Iterating yields dicts name->np.ndarray ready to pass as `feed`.
+    """
+
+    def __init__(self, feed_list=None, capacity=4, iterable=True):
+        self._feed_names = [
+            v.name if hasattr(v, "name") else v for v in (feed_list or [])
+        ]
+        self._capacity = capacity
+        self._batch_reader = None
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=4, iterable=True,
+                       return_list=False, use_double_buffer=True):
+        return DataLoader(feed_list, capacity, iterable)
+
+    def set_batch_generator(self, reader, places=None):
+        self._batch_reader = reader
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def batched():
+            for samples in reader():
+                yield _stack_samples(samples, self._feed_names)
+
+        self._batch_reader = batched
+        return self
+
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        return self.set_sample_list_generator(
+            batch(reader, batch_size, drop_last=drop_last), places)
+
+    def __iter__(self):
+        if self._batch_reader is None:
+            raise RuntimeError("no generator set on DataLoader")
+        prefetched = buffered(self._gen_feed_dicts, self._capacity)
+        return iter(prefetched())
+
+    def _gen_feed_dicts(self):
+        for item in self._batch_reader():
+            if isinstance(item, dict):
+                yield item
+            elif isinstance(item, (list, tuple)) and self._feed_names:
+                yield {n: np.asarray(v)
+                       for n, v in zip(self._feed_names, item)}
+            else:
+                yield item
+
+
+class DataFeeder:
+    """Parity: fluid.DataFeeder (data_feeder.py) — converts sample lists
+    to feed dicts."""
+
+    def __init__(self, feed_list, place=None):
+        self._feed_names = [v.name if hasattr(v, "name") else v
+                            for v in feed_list]
+
+    def feed(self, samples):
+        return _stack_samples(samples, self._feed_names)

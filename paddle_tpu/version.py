@@ -1,0 +1,6 @@
+"""Version info for paddle-tpu."""
+
+full_version = "0.1.0"
+major = 0
+minor = 1
+patch = 0

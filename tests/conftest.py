@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): CPUPlace is the
+simulator backend for all op logic, and a forced host-device count stands
+in for the multi-process localhost cluster of test_dist_base.py.
+
+Note: the environment's sitecustomize pins JAX_PLATFORMS=axon (real TPU),
+so we must override via jax.config, not env vars.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,191 @@
+"""Program/Executor/backward tests (parity model: test_executor_*,
+test_program.py, test_backward.py in the reference unittest suite)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _new_programs():
+    return fluid.Program(), fluid.Program()
+
+
+def test_feed_fetch_roundtrip():
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        out = fluid.layers.scale(x, scale=3.0, bias=1.0)
+    exe = fluid.Executor()
+    xb = np.random.rand(2, 4).astype(np.float32)
+    res = exe.run(main, feed={"x": xb}, fetch_list=[out])
+    np.testing.assert_allclose(res[0], 3 * xb + 1, rtol=1e-6)
+
+
+def test_startup_initializes_params():
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 3])
+        y = fluid.layers.fc(x, 5)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    params = main.all_parameters()
+    assert len(params) == 2  # w + b
+    for p in params:
+        assert scope.find_var(p.name) is not None
+
+
+def test_backward_grads_match_numeric():
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 3])
+        w = fluid.layers.create_parameter([3, 2], "float32", name="w_test")
+        out = fluid.layers.mul(x, w)
+        loss = fluid.layers.mean(out)
+        grads = fluid.append_backward(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xb = np.random.rand(4, 3).astype(np.float32)
+    (gname,) = [g.name for p, g in grads if p.name == "w_test"]
+    gw, = exe.run(main, feed={"x": xb}, fetch_list=[gname], scope=scope)
+    # d(mean(x@w))/dw[i,j] = mean over batch of x[:, i] / (4*2... )
+    expected = np.zeros((3, 2), np.float32)
+    for i in range(3):
+        expected[i, :] = xb[:, i].sum() / (4 * 2)
+    np.testing.assert_allclose(gw, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_sgd_training_converges():
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 13])
+        y = fluid.data("y", [None, 1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    W = rng.uniform(-1, 1, 13).astype(np.float32)
+    losses = []
+    for _ in range(150):
+        xb = rng.uniform(-1, 1, (64, 13)).astype(np.float32)
+        yb = (xb @ W + 0.3).reshape(-1, 1).astype(np.float32)
+        out = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss],
+                      scope=scope)
+        losses.append(float(out[0]))
+    assert losses[-1] < 0.05, losses[-1]
+    assert losses[-1] < losses[0]
+
+
+def test_clone_for_test_disables_dropout():
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 10])
+        out = fluid.layers.dropout(x, 0.5,
+                                   dropout_implementation="upscale_in_train")
+    test_prog = main.clone(for_test=True)
+    exe = fluid.Executor()
+    xb = np.ones((4, 10), np.float32)
+    res = exe.run(test_prog, feed={"x": xb}, fetch_list=[out])
+    np.testing.assert_allclose(res[0], xb)  # identity in test mode
+
+
+def test_program_serialization_roundtrip():
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        h = fluid.layers.fc(x, 8, act="relu")
+        out = fluid.layers.fc(h, 2)
+    text = main.to_json()
+    restored = fluid.Program.from_json(text)
+    assert restored.num_ops() == main.num_ops()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xb = np.random.rand(3, 4).astype(np.float32)
+    r1 = exe.run(main, feed={"x": xb}, fetch_list=[out.name], scope=scope)
+    r2 = exe.run(restored, feed={"x": xb}, fetch_list=[out.name], scope=scope)
+    np.testing.assert_allclose(r1[0], r2[0], rtol=1e-6)
+
+
+def test_persistable_state_roundtrips():
+    # optimizer state (momentum velocity) must persist across runs
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 2])
+        y = fluid.data("y", [None, 1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xb = np.ones((4, 2), np.float32)
+    yb = np.ones((4, 1), np.float32)
+    exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss], scope=scope)
+    vel_names = [n for n in scope.vars if "velocity" in n]
+    assert vel_names, "velocity accumulator missing"
+    v1 = np.asarray(scope.find_var(vel_names[0]))
+    exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss], scope=scope)
+    v2 = np.asarray(scope.find_var(vel_names[0]))
+    assert not np.allclose(v1, v2)
+
+
+def test_eager_executor_matches_jit():
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 5])
+        out = fluid.layers.fc(x, 3, act="tanh")
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xb = np.random.rand(2, 5).astype(np.float32)
+    r_jit = exe.run(main, feed={"x": xb}, fetch_list=[out], scope=scope)
+    fluid.set_flags({"FLAGS_eager_executor": True})
+    try:
+        r_eager = exe.run(main, feed={"x": xb}, fetch_list=[out], scope=scope)
+    finally:
+        fluid.set_flags({"FLAGS_eager_executor": False})
+    np.testing.assert_allclose(r_jit[0], r_eager[0], rtol=1e-5, atol=1e-6)
+
+
+def test_check_nan_inf_flag():
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 3])
+        out = fluid.layers.log(x)  # log of negative -> nan
+    exe = fluid.Executor()
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(FloatingPointError):
+            exe.run(main, feed={"x": -np.ones((2, 3), np.float32)},
+                    fetch_list=[out])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_lr_scheduler_decays():
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 2])
+        y = fluid.data("y", [None, 1])
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        lr = fluid.layers.learning_rate_scheduler.exponential_decay(
+            0.1, decay_steps=1, decay_rate=0.5)
+        fluid.optimizer.SGD(lr).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xb = np.ones((2, 2), np.float32)
+    yb = np.ones((2, 1), np.float32)
+    lrs = []
+    for _ in range(3):
+        out = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[lr],
+                      scope=scope)
+        lrs.append(float(out[0]))
+    np.testing.assert_allclose(lrs, [0.05, 0.025, 0.0125], rtol=1e-5)

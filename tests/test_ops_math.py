@@ -1,0 +1,155 @@
+"""Math op kernel tests (parity model: tests/unittests/test_elementwise_*,
+test_matmul_op.py, test_reduce_op.py, test_activation_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest, run_kernel
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def test_basic(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        self.check_output({"X": x, "Y": y}, {"Out": x + y})
+
+    def test_broadcast_axis(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(3).astype(np.float32)
+        self.attrs = {"axis": 1}
+        self.check_output({"X": x, "Y": y},
+                          {"Out": x + y.reshape(1, 3, 1)})
+        self.attrs = {}
+
+    def test_grad(self):
+        x = np.random.rand(3, 4)
+        y = np.random.rand(3, 4)
+        self.check_grad({"X": x, "Y": y}, ["X", "Y"])
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def test_basic(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        y = np.random.rand(5, 3).astype(np.float32)
+        self.check_output({"X": x, "Y": y}, {"Out": x @ y})
+
+    def test_transpose(self):
+        x = np.random.rand(5, 4).astype(np.float32)
+        y = np.random.rand(3, 5).astype(np.float32)
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.check_output({"X": x, "Y": y}, {"Out": x.T @ y.T})
+        self.attrs = {}
+
+    def test_batched(self):
+        x = np.random.rand(2, 4, 5).astype(np.float32)
+        y = np.random.rand(2, 5, 3).astype(np.float32)
+        self.check_output({"X": x, "Y": y}, {"Out": x @ y})
+
+    def test_grad(self):
+        x = np.random.rand(3, 4)
+        y = np.random.rand(4, 2)
+        self.check_grad({"X": x, "Y": y}, ["X", "Y"])
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def test_flatten(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(12, 5).astype(np.float32)
+        self.check_output({"X": x, "Y": y},
+                          {"Out": x.reshape(2, 12) @ y})
+
+
+class TestReduce(OpTest):
+    def test_sum(self):
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        out = run_kernel("reduce_sum", {"X": x}, {"dim": [1]})
+        np.testing.assert_allclose(out["Out"], x.sum(axis=1), rtol=1e-5)
+
+    def test_all_keepdim(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        out = run_kernel("reduce_mean", {"X": x},
+                         {"reduce_all": True, "keep_dim": True})
+        np.testing.assert_allclose(out["Out"], x.mean(keepdims=True).reshape(1, 1),
+                                   rtol=1e-5)
+
+    def test_max_min_prod(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            run_kernel("reduce_max", {"X": x}, {"dim": [0]})["Out"],
+            x.max(axis=0))
+        np.testing.assert_allclose(
+            run_kernel("reduce_min", {"X": x}, {"dim": [0]})["Out"],
+            x.min(axis=0))
+        np.testing.assert_allclose(
+            run_kernel("reduce_prod", {"X": x}, {"dim": [1]})["Out"],
+            x.prod(axis=1), rtol=1e-5)
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def test_bias_after(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        self.attrs = {"scale": 2.0, "bias": 1.0}
+        self.check_output({"X": x}, {"Out": 2 * x + 1})
+        self.attrs = {}
+
+    def test_bias_before(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        self.attrs = {"scale": 2.0, "bias": 1.0, "bias_after_scale": False}
+        self.check_output({"X": x}, {"Out": 2 * (x + 1)})
+        self.attrs = {}
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+    ("square", np.square), ("abs", np.abs), ("floor", np.floor),
+    ("ceil", np.ceil), ("sin", np.sin), ("cos", np.cos),
+    ("tanh", np.tanh),
+])
+def test_unary(op, fn):
+    x = (np.random.rand(3, 4) + 0.1).astype(np.float32)
+    out = run_kernel(op, {"X": x})
+    np.testing.assert_allclose(out["Out"], fn(x), rtol=1e-5, atol=1e-6)
+
+
+def test_sum_multi_input():
+    xs = [np.random.rand(3, 4).astype(np.float32) for _ in range(3)]
+    out = run_kernel("sum", {"X": xs})
+    np.testing.assert_allclose(out["Out"], sum(xs), rtol=1e-6)
+
+
+def test_compare_ops():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    y = np.array([2.0, 2.0, 2.0], np.float32)
+    assert (run_kernel("less_than", {"X": x, "Y": y})["Out"]
+            == (x < y)).all()
+    assert (run_kernel("equal", {"X": x, "Y": y})["Out"] == (x == y)).all()
+
+
+def test_clip_and_norm():
+    x = np.random.uniform(-2, 2, (4, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        run_kernel("clip", {"X": x}, {"min": -1.0, "max": 1.0})["Out"],
+        np.clip(x, -1, 1))
+    out = run_kernel("clip_by_norm", {"X": x}, {"max_norm": 1.0})["Out"]
+    assert np.linalg.norm(out) <= 1.0 + 1e-5
+
+
+def test_cumsum_argmax_topk():
+    x = np.random.rand(3, 6).astype(np.float32)
+    np.testing.assert_allclose(
+        run_kernel("cumsum", {"X": x}, {"axis": 1})["Out"],
+        np.cumsum(x, axis=1), rtol=1e-5)
+    np.testing.assert_array_equal(
+        run_kernel("arg_max", {"X": x}, {"axis": 1})["Out"],
+        np.argmax(x, axis=1))
+    out = run_kernel("top_k", {"X": x}, {"k": 2})
+    np.testing.assert_allclose(out["Out"], -np.sort(-x, axis=1)[:, :2],
+                               rtol=1e-6)

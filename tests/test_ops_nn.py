@@ -1,0 +1,207 @@
+"""NN op kernel tests (parity model: test_conv2d_op.py, test_pool2d_op.py,
+test_batch_norm_op.py, test_layer_norm_op.py, test_softmax_op.py,
+test_cross_entropy_op.py, test_dropout_op.py, test_lookup_table_op.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest, run_kernel
+
+
+def _ref_conv2d(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), dtype=np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+class TestConv2D(OpTest):
+    op_type = "conv2d"
+    atol = 1e-4
+    rtol = 1e-4
+
+    def test_basic(self):
+        x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+        w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1]}
+        self.check_output({"Input": x, "Filter": w},
+                          {"Output": _ref_conv2d(x, w, 1, 1)})
+        self.attrs = {}
+
+    def test_stride2(self):
+        x = np.random.rand(1, 2, 8, 8).astype(np.float32)
+        w = np.random.rand(3, 2, 3, 3).astype(np.float32)
+        self.attrs = {"strides": [2, 2], "paddings": [0, 0]}
+        self.check_output({"Input": x, "Filter": w},
+                          {"Output": _ref_conv2d(x, w, 2, 0)})
+        self.attrs = {}
+
+    def test_grad(self):
+        x = np.random.rand(1, 2, 5, 5)
+        w = np.random.rand(2, 2, 3, 3)
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1]}
+        self.check_grad({"Input": x, "Filter": w}, ["Input", "Filter"],
+                        out_slot="Output")
+        self.attrs = {}
+
+
+class TestPool2D(OpTest):
+    op_type = "pool2d"
+
+    def test_max(self):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2],
+                      "pooling_type": "max"}
+        expected = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        self.check_output({"X": x}, {"Out": expected})
+        self.attrs = {}
+
+    def test_avg(self):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2],
+                      "pooling_type": "avg"}
+        expected = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.check_output({"X": x}, {"Out": expected})
+        self.attrs = {}
+
+    def test_global(self):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        self.attrs = {"pooling_type": "avg", "global_pooling": True}
+        self.check_output({"X": x},
+                          {"Out": x.mean(axis=(2, 3), keepdims=True)})
+        self.attrs = {}
+
+
+def test_softmax():
+    x = np.random.rand(3, 5).astype(np.float32)
+    out = run_kernel("softmax", {"X": x})["Out"]
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    np.testing.assert_allclose(out, e / e.sum(axis=-1, keepdims=True),
+                               rtol=1e-5)
+    assert np.allclose(out.sum(axis=-1), 1.0)
+
+
+def test_softmax_with_cross_entropy():
+    logits = np.random.rand(4, 7).astype(np.float32)
+    label = np.random.randint(0, 7, (4, 1)).astype(np.int64)
+    out = run_kernel("softmax_with_cross_entropy",
+                     {"Logits": logits, "Label": label})
+    e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+    sm = e / e.sum(axis=-1, keepdims=True)
+    expected = -np.log(sm[np.arange(4), label[:, 0]]).reshape(4, 1)
+    np.testing.assert_allclose(out["Loss"], expected, rtol=1e-4)
+    np.testing.assert_allclose(out["Softmax"], sm, rtol=1e-5)
+
+
+def test_cross_entropy_probs():
+    x = np.random.rand(4, 5).astype(np.float32)
+    x = x / x.sum(axis=1, keepdims=True)
+    label = np.random.randint(0, 5, (4, 1)).astype(np.int64)
+    out = run_kernel("cross_entropy", {"X": x, "Label": label})["Y"]
+    expected = -np.log(x[np.arange(4), label[:, 0]]).reshape(4, 1)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+class TestBatchNorm(OpTest):
+    op_type = "batch_norm"
+    atol = 1e-4
+    rtol = 1e-4
+
+    def test_train(self):
+        x = np.random.rand(4, 3, 5, 5).astype(np.float32)
+        scale = np.random.rand(3).astype(np.float32)
+        bias = np.random.rand(3).astype(np.float32)
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        out = run_kernel("batch_norm",
+                         {"X": x, "Scale": scale, "Bias": bias,
+                          "Mean": mean, "Variance": var},
+                         {"epsilon": 1e-5, "momentum": 0.9})
+        mu = x.mean(axis=(0, 2, 3))
+        v = x.var(axis=(0, 2, 3))
+        expected = ((x - mu.reshape(1, 3, 1, 1))
+                    / np.sqrt(v.reshape(1, 3, 1, 1) + 1e-5)
+                    * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1))
+        np.testing.assert_allclose(out["Y"], expected, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(out["MeanOut"], 0.9 * mean + 0.1 * mu,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_inference(self):
+        x = np.random.rand(4, 3, 5, 5).astype(np.float32)
+        scale = np.ones(3, np.float32)
+        bias = np.zeros(3, np.float32)
+        mean = np.full(3, 0.5, np.float32)
+        var = np.full(3, 2.0, np.float32)
+        out = run_kernel("batch_norm",
+                         {"X": x, "Scale": scale, "Bias": bias,
+                          "Mean": mean, "Variance": var},
+                         {"epsilon": 1e-5, "is_test": True})
+        expected = (x - 0.5) / np.sqrt(2.0 + 1e-5)
+        np.testing.assert_allclose(out["Y"], expected, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm():
+    x = np.random.rand(4, 6).astype(np.float32)
+    scale = np.random.rand(6).astype(np.float32)
+    bias = np.random.rand(6).astype(np.float32)
+    out = run_kernel("layer_norm", {"X": x, "Scale": scale, "Bias": bias},
+                     {"begin_norm_axis": 1})["Y"]
+    mu = x.mean(axis=1, keepdims=True)
+    sd = np.sqrt(x.var(axis=1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, (x - mu) / sd * scale + bias,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dropout():
+    x = np.ones((100, 100), np.float32)
+    out = run_kernel("dropout", {"X": x},
+                     {"dropout_prob": 0.3,
+                      "dropout_implementation": "upscale_in_train"})
+    keep_rate = (out["Out"] != 0).mean()
+    assert abs(keep_rate - 0.7) < 0.05
+    # kept values upscaled
+    kept = out["Out"][out["Out"] != 0]
+    np.testing.assert_allclose(kept, 1.0 / 0.7, rtol=1e-5)
+    # test mode = identity under upscale_in_train
+    out_test = run_kernel("dropout", {"X": x},
+                          {"dropout_prob": 0.3, "is_test": True,
+                           "dropout_implementation": "upscale_in_train"})
+    np.testing.assert_allclose(out_test["Out"], x)
+
+
+def test_lookup_table():
+    w = np.random.rand(10, 4).astype(np.float32)
+    ids = np.array([[1, 2], [3, 0]], np.int64)
+    out = run_kernel("lookup_table_v2", {"Ids": ids, "W": w})["Out"]
+    np.testing.assert_allclose(out, w[ids])
+
+
+def test_one_hot_accuracy():
+    x = np.array([1, 3], np.int64)
+    out = run_kernel("one_hot_v2", {"X": x}, {"depth": 4})["Out"]
+    np.testing.assert_allclose(out, np.eye(4)[x])
+
+    # accuracy: top-1 indices vs label
+    idx = np.array([[1], [2], [3]], np.int64)
+    label = np.array([[1], [0], [3]], np.int64)
+    out = run_kernel("accuracy", {"Indices": idx, "Label": label,
+                                  "Out": idx.astype(np.float32)})
+    np.testing.assert_allclose(out["Accuracy"], 2.0 / 3.0, rtol=1e-6)
+
+
+@pytest.mark.parametrize("op", ["relu", "sigmoid", "gelu", "leaky_relu",
+                                "elu", "softplus", "relu6", "hard_sigmoid"])
+def test_activations_finite(op):
+    x = np.random.uniform(-3, 3, (4, 5)).astype(np.float32)
+    out = run_kernel(op, {"X": x})["Out"]
+    assert np.isfinite(out).all()
+    if op == "relu":
+        np.testing.assert_allclose(out, np.maximum(x, 0))

@@ -1,0 +1,139 @@
+"""Regression tests for review findings (round 1)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import run_kernel
+
+
+def test_range_under_jitted_executor():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        r = fluid.layers.range(0, 5, 1)
+    out = fluid.Executor().run(main, fetch_list=[r])
+    np.testing.assert_allclose(out[0], np.arange(0, 5, 1.0))
+
+
+def test_linspace_under_jitted_executor():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        r = fluid.layers.linspace(0.0, 1.0, 5)
+    out = fluid.Executor().run(main, fetch_list=[r])
+    np.testing.assert_allclose(out[0], np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_cumsum_reverse_exclusive():
+    out = run_kernel("cumsum", {"X": np.array([1.0, 2, 3, 4])},
+                     {"axis": 0, "reverse": True, "exclusive": True})
+    np.testing.assert_allclose(out["Out"], [9, 7, 4, 0])
+
+
+def test_conv2d_transpose_grouped():
+    out = run_kernel(
+        "conv2d_transpose",
+        {"Input": np.random.rand(1, 4, 5, 5).astype(np.float32),
+         "Filter": np.random.rand(4, 1, 3, 3).astype(np.float32)},
+        {"strides": [1, 1], "paddings": [1, 1], "groups": 2})
+    assert out["Output"].shape == (1, 2, 5, 5)
+
+
+def test_maximum_layer_dtype():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 3])
+        y = fluid.data("y", [None, 3])
+        m = fluid.layers.maximum(x, y)
+    assert m.dtype == "float32"
+
+
+def test_lookahead_slow_weights_start_as_copy():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 2])
+        yv = fluid.data("y", [None, 1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, yv))
+        opt = fluid.optimizer.LookaheadOptimizer(
+            fluid.optimizer.SGD(0.0), alpha=0.5, k=1)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    pname = main.all_parameters()[0].name
+    w0 = np.asarray(sc.find_var(pname)).copy()
+    exe.run(main, feed={"x": np.ones((2, 2), np.float32),
+                        "y": np.ones((2, 1), np.float32)},
+            fetch_list=[loss], scope=sc)
+    # lr=0 and slow==fast at init => params unchanged after sync step
+    np.testing.assert_allclose(w0, np.asarray(sc.find_var(pname)), atol=1e-6)
+
+
+def test_ema_bias_correction():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 2])
+        yv = fluid.data("y", [None, 1])
+        pred = fluid.layers.fc(x, 1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, yv))
+        fluid.optimizer.SGD(0.0).minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.999)
+        ema.update()
+    exe = fluid.Executor()
+    gsc = fluid.global_scope()
+    exe.run(startup)
+    pname = main.all_parameters()[0].name
+    exe.run(main, feed={"x": np.ones((2, 2), np.float32),
+                        "y": np.ones((2, 1), np.float32)},
+            fetch_list=[loss])
+    w = np.asarray(gsc.find_var(pname))
+    with ema.apply(exe):
+        w_ema = np.asarray(gsc.find_var(pname))
+    # with lr=0 the corrected EMA equals the (unchanged) parameter
+    np.testing.assert_allclose(w, w_ema, rtol=1e-4)
+
+
+def test_recompute_checkpoints_still_correct():
+    # numerics with checkpoints must match the plain path
+    def build(use_ckpt):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [None, 8])
+            yv = fluid.data("y", [None, 1])
+            h1 = fluid.layers.fc(x, 16, act="relu",
+                                 param_attr=fluid.ParamAttr(name="w1"),
+                                 bias_attr=fluid.ParamAttr(name="b1"))
+            h2 = fluid.layers.fc(h1, 16, act="relu",
+                                 param_attr=fluid.ParamAttr(name="w2"),
+                                 bias_attr=fluid.ParamAttr(name="b2"))
+            pred = fluid.layers.fc(h2, 1,
+                                   param_attr=fluid.ParamAttr(name="w3"),
+                                   bias_attr=fluid.ParamAttr(name="b3"))
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, yv))
+            sgd = fluid.optimizer.SGD(0.1)
+            if use_ckpt:
+                opt = fluid.optimizer.RecomputeOptimizer(sgd)
+                opt._set_checkpoints([h1, h2])
+                opt.minimize(loss)
+            else:
+                sgd.minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    xb = rng.rand(16, 8).astype(np.float32)
+    yb = rng.rand(16, 1).astype(np.float32)
+    results = []
+    for use_ckpt in (False, True):
+        with fluid.unique_name.guard():
+            main, startup, loss = build(use_ckpt)
+        exe = fluid.Executor()
+        sc = fluid.Scope()
+        fluid.flags.set_flags({"FLAGS_global_seed": 7})
+        exe._root_key = __import__("jax").random.PRNGKey(7)
+        exe.run(startup, scope=sc)
+        for _ in range(5):
+            out = exe.run(main, feed={"x": xb, "y": yb},
+                          fetch_list=[loss], scope=sc)
+        results.append(float(out[0]))
+    assert results[0] == pytest.approx(results[1], rel=1e-4)
