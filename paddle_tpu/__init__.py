@@ -63,6 +63,7 @@ from . import dygraph
 from . import distributed
 from . import amp
 from . import jit
+from . import models
 
 from .reader import DataLoader
 from .version import full_version as __version__
@@ -77,5 +78,6 @@ __all__ = [
     "scope_guard", "append_backward", "gradients", "ParamAttr",
     "initializer", "unique_name", "backward", "layers", "optimizer",
     "regularizer", "clip", "io", "reader", "dataset", "metrics",
-    "profiler", "nn", "dygraph", "distributed", "amp", "jit", "DataLoader",
+    "profiler", "nn", "dygraph", "distributed", "amp", "jit", "models",
+    "DataLoader",
 ]

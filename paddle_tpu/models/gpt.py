@@ -1,0 +1,77 @@
+"""GPT-style causal decoder.
+
+No direct reference counterpart (the reference's generative path is the
+seq2seq machine-translation book model); included because causal LM is the
+canonical long-context workload for the sequence-parallel / ring-attention
+path (SURVEY.md §5 "long-context" gap) and exercises the Pallas causal
+flash-attention kernel.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    dtype: str = "float32"
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__(dtype=cfg.dtype)
+        self.norm1 = nn.LayerNorm(cfg.hidden_size, dtype=cfg.dtype)
+        self.attn = nn.MultiHeadAttention(cfg.hidden_size, cfg.num_heads,
+                                          dropout=cfg.dropout,
+                                          dtype=cfg.dtype)
+        self.norm2 = nn.LayerNorm(cfg.hidden_size, dtype=cfg.dtype)
+        self.fc1 = nn.Linear(cfg.hidden_size, 4 * cfg.hidden_size,
+                             act="gelu", dtype=cfg.dtype)
+        self.fc2 = nn.Linear(4 * cfg.hidden_size, cfg.hidden_size,
+                             dtype=cfg.dtype)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x), is_causal=True)
+        x = x + self.drop(self.fc2(self.fc1(self.norm2(x))))
+        return x
+
+
+class GPT(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__(dtype=cfg.dtype)
+        self.cfg = cfg
+        self.wte = nn.Embedding([cfg.vocab_size, cfg.hidden_size],
+                                dtype=cfg.dtype)
+        self.wpe = nn.Embedding([cfg.max_seq_len, cfg.hidden_size],
+                                dtype=cfg.dtype)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.norm_f = nn.LayerNorm(cfg.hidden_size, dtype=cfg.dtype)
+
+    def forward(self, input_ids):
+        seq = input_ids.shape[1]
+        pos = jnp.arange(seq, dtype=jnp.int32)[None, :]
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm_f(x)
+        wte = self.wte.weight
+        wte = wte.value if hasattr(wte, "value") else wte
+        return jnp.einsum("bsh,vh->bsv", x, wte)
+
+    def loss(self, input_ids, labels):
+        logits = self.forward(input_ids)
+        logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return nll.mean()

@@ -1,0 +1,29 @@
+"""LeNet-5 for MNIST.
+
+Parity: the reference's recognize_digits book model
+(/root/reference/python/paddle/fluid/tests/book/test_recognize_digits.py
+`conv_net`) — conv/pool/conv/pool/fc stack. The public API keeps the
+reference's NCHW layout; XLA re-lays-out convs for the MXU internally.
+"""
+
+from .. import nn
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=10, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.conv1 = nn.Conv2D(1, 20, 5, dtype=dtype)
+        self.pool1 = nn.MaxPool2D(2, 2)
+        self.conv2 = nn.Conv2D(20, 50, 5, dtype=dtype)
+        self.pool2 = nn.MaxPool2D(2, 2)
+        self.fc1 = nn.Linear(4 * 4 * 50, 500, dtype=dtype)
+        self.fc2 = nn.Linear(500, num_classes, dtype=dtype)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        # x: [B, 1, 28, 28]
+        x = self.pool1(self.relu(self.conv1(x)))
+        x = self.pool2(self.relu(self.conv2(x)))
+        x = x.reshape(x.shape[0], -1)
+        x = self.relu(self.fc1(x))
+        return self.fc2(x)

@@ -1,0 +1,25 @@
+"""Simple MLP (the fit_a_line / recognize_digits `mlp` fixture).
+
+Parity: /root/reference/python/paddle/fluid/tests/book/test_fit_a_line.py
+and the `mlp` net in test_recognize_digits.py.
+"""
+
+from .. import nn
+
+
+class MLP(nn.Layer):
+    def __init__(self, input_dim, hidden_dims=(128, 64), num_classes=10,
+                 act="relu", dtype="float32"):
+        super().__init__(dtype=dtype)
+        dims = [input_dim] + list(hidden_dims)
+        self.hidden = nn.LayerList([
+            nn.Linear(dims[i], dims[i + 1], act=act, dtype=dtype)
+            for i in range(len(dims) - 1)
+        ])
+        self.out = nn.Linear(dims[-1], num_classes, dtype=dtype)
+
+    def forward(self, x):
+        x = x.reshape(x.shape[0], -1)
+        for fc in self.hidden:
+            x = fc(x)
+        return self.out(x)

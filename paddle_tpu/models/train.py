@@ -1,0 +1,129 @@
+"""Train-step factories: Layer + functional optimizer -> pure jitted step.
+
+The TPU answer to the reference's Executor hot loop + ParallelExecutor
+(SURVEY.md §3.1/§3.2): the whole (forward, backward, optimizer-update)
+iteration is ONE jitted function with donated state, so XLA owns fusion,
+scheduling, memory planning, and (under a mesh) collective insertion.
+
+TrainState is the explicit pytree of everything that mutates per step —
+the analogue of the reference's persistable variables in a Scope
+(framework/scope.h:46).
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layers import (
+    _swap_params, buffer_dict, functional_call_with_state, param_dict,
+)
+from ..nn.parameter import default_rng
+
+try:  # jax>=0.4.27
+    _register_dataclass = jax.tree_util.register_dataclass
+except AttributeError:  # pragma: no cover
+    _register_dataclass = None
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    buffers: Any
+    step: Any
+    rng: Any
+
+
+if _register_dataclass is not None:
+    _register_dataclass(
+        TrainState,
+        data_fields=["params", "opt_state", "buffers", "step", "rng"],
+        meta_fields=[],
+    )
+else:  # pragma: no cover
+    jax.tree_util.register_pytree_node(
+        TrainState,
+        lambda s: ((s.params, s.opt_state, s.buffers, s.step, s.rng), None),
+        lambda _, c: TrainState(*c),
+    )
+
+
+def init_train_state(model, optimizer, rng_seed=0):
+    params = param_dict(model, trainable_only=True)
+    return TrainState(
+        params=params,
+        opt_state=optimizer.init(params),
+        buffers=buffer_dict(model),
+        step=jnp.zeros((), jnp.int32),
+        rng=jax.random.PRNGKey(rng_seed),
+    )
+
+
+def _loss_with_buffers(model, params, buffers, rng, loss_fn, batch):
+    """Pure loss evaluation: params/buffers substituted, stochastic ops
+    (dropout) drawing from the traced rng key."""
+    with default_rng.key_context(rng):
+        if buffers:
+            return functional_call_with_state(model, params, buffers,
+                                              *batch, _method=loss_fn)
+        with _swap_params(model, params):
+            return loss_fn(model, *batch), buffers
+
+
+def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
+                    grad_psum_axis=None):
+    """Build `step(state, *batch) -> (state, loss)`.
+
+    loss_fn(model, *batch) -> scalar; defaults to model.loss.
+    grad_psum_axis: mesh axis name(s) to pmean grads over (for use inside
+    shard_map); plain pjit DP needs no explicit psum — XLA inserts it.
+    """
+    if loss_fn is None:
+        loss_fn = lambda m, *b: m.loss(*b)
+    model.train()
+
+    def step(state, *batch):
+        rng, new_rng = jax.random.split(state.rng)
+
+        def loss_of(params):
+            return _loss_with_buffers(model, params, state.buffers, rng,
+                                      loss_fn, batch)
+
+        (loss, new_buffers), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(state.params)
+        if grad_psum_axis:
+            grads = jax.lax.pmean(grads, grad_psum_axis)
+            loss = jax.lax.pmean(loss, grad_psum_axis)
+        params, opt_state = optimizer.update(state.params, grads,
+                                             state.opt_state)
+        new_state = TrainState(params=params, opt_state=opt_state,
+                               buffers=new_buffers, step=state.step + 1,
+                               rng=new_rng)
+        return new_state, loss
+
+    if jit:
+        step = jax.jit(step, donate_argnums=(0,) if donate else ())
+    return step
+
+
+def make_eval_step(model, forward_fn=None, jit=True):
+    if forward_fn is None:
+        forward_fn = lambda m, *b: m(*b)
+
+    def step(params, buffers, *batch):
+        was_training = model.training
+        model.eval()
+        try:
+            out, _ = _loss_with_buffers(model, params, buffers,
+                                        jax.random.PRNGKey(0), forward_fn,
+                                        batch)
+        finally:
+            if was_training:
+                model.train()
+        return out
+
+    if jit:
+        step = jax.jit(step)
+    return step

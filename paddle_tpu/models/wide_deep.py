@@ -1,0 +1,63 @@
+"""Wide&Deep CTR model (sparse workload).
+
+Parity: the reference's dist_ctr.py fixture and the Downpour/pslib sparse
+path (/root/reference/python/paddle/fluid/tests/unittests/dist_ctr.py;
+SURVEY.md §3.5). Sparse embedding lookups that the reference routes
+through the parameter server map to device-resident embedding tables here
+(host-sharded PS variant lives in distributed/ps.py).
+"""
+
+import jax.numpy as jnp
+
+from .. import nn
+
+
+class WideDeep(nn.Layer):
+    def __init__(self, sparse_field_count=26, sparse_vocab_size=100000,
+                 embedding_dim=16, dense_dim=13, hidden=(400, 400, 400),
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.sparse_field_count = sparse_field_count
+        # one shared hashed table (reference uses per-slot tables routed to
+        # the PS; a single table + field offset hashing is the dense-lookup
+        # equivalent and keeps one large MXU-friendly gather)
+        self.embedding = nn.Embedding(
+            [sparse_vocab_size, embedding_dim], dtype=dtype)
+        self.wide = nn.Embedding([sparse_vocab_size, 1], dtype=dtype)
+        dims = [dense_dim + sparse_field_count * embedding_dim] + list(hidden)
+        self.deep = nn.LayerList([
+            nn.Linear(dims[i], dims[i + 1], act="relu", dtype=dtype)
+            for i in range(len(dims) - 1)
+        ])
+        self.out = nn.Linear(dims[-1], 1, dtype=dtype)
+
+    def _hash_ids(self, sparse_ids):
+        # mix the field index into the id so the same raw id in different
+        # slots maps to different rows of the shared table (the reference
+        # keeps per-slot tables on the PS); also bounds out-of-vocab ids
+        f = sparse_ids.shape[1]
+        vocab = self.embedding.weight.shape[0]
+        field = jnp.arange(f, dtype=jnp.uint32)[None, :]
+        mixed = sparse_ids.astype(jnp.uint32) * jnp.uint32(2654435761)
+        mixed = mixed + field * jnp.uint32(0x9E3779B9)
+        return (mixed % jnp.uint32(vocab)).astype(jnp.int32)
+
+    def forward(self, sparse_ids, dense_features):
+        # sparse_ids: [B, F] int32, dense_features: [B, D]
+        sparse_ids = self._hash_ids(sparse_ids)
+        emb = self.embedding(sparse_ids)          # [B, F, E]
+        deep_in = jnp.concatenate(
+            [dense_features, emb.reshape(emb.shape[0], -1)], axis=-1)
+        x = deep_in
+        for fc in self.deep:
+            x = fc(x)
+        deep_logit = self.out(x)
+        wide_logit = self.wide(sparse_ids).sum(axis=1)  # [B, 1]
+        return deep_logit + wide_logit
+
+    def loss(self, sparse_ids, dense_features, labels):
+        from ..nn import functional as F
+
+        logit = self.forward(sparse_ids, dense_features)[:, 0]
+        return F.binary_cross_entropy_with_logits(
+            logit, labels.astype(logit.dtype))

@@ -1,0 +1,31 @@
+"""Word2Vec (CBOW) book model.
+
+Parity: /root/reference/python/paddle/fluid/tests/book/test_word2vec.py —
+N-gram context embeddings concatenated into an MLP softmax.
+"""
+
+import jax.numpy as jnp
+
+from .. import nn
+
+
+class Word2Vec(nn.Layer):
+    def __init__(self, vocab_size, embed_dim=32, context=4, hidden=256,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.embedding = nn.Embedding([vocab_size, embed_dim], dtype=dtype)
+        self.fc1 = nn.Linear(context * embed_dim, hidden, act="sigmoid",
+                             dtype=dtype)
+        self.fc2 = nn.Linear(hidden, vocab_size, dtype=dtype)
+
+    def forward(self, context_ids):
+        # context_ids: [B, C]
+        emb = self.embedding(context_ids)
+        flat = emb.reshape(emb.shape[0], -1)
+        return self.fc2(self.fc1(flat))
+
+    def loss(self, context_ids, target_ids):
+        from ..nn import functional as F
+
+        logits = self.forward(context_ids)
+        return F.cross_entropy(logits, target_ids)

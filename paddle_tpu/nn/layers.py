@@ -259,11 +259,17 @@ def _buffer_owner(layers_by_prefix, path):
     return layers_by_prefix[owner_path], leaf
 
 
-def functional_call_with_state(layer, params, buffers, *args, **kwargs):
+def functional_call_with_state(layer, params, buffers, *args, _method=None,
+                               **kwargs):
     """Forward with params AND mutable buffers (batch-norm running stats)
     substituted; returns (output, new_buffers).  This is how a stateful
     Layer becomes a pure jittable function — the TPU answer to the
-    reference's in-place MeanOut/VarianceOut aliasing."""
+    reference's in-place MeanOut/VarianceOut aliasing.
+
+    _method: optional fn(layer, *args, **kwargs) to call instead of
+    layer.__call__ (e.g. a loss method)."""
+    call = _method if _method is not None else (
+        lambda l, *a, **kw: l(*a, **kw))
     layers_by_prefix = {"": layer}
     for name, sub in _walk_sublayers(layer, ""):
         layers_by_prefix[name] = sub
@@ -274,7 +280,7 @@ def functional_call_with_state(layer, params, buffers, *args, **kwargs):
             old[path] = owner._buffers[leaf]
             owner._buffers[leaf] = v
         try:
-            out = layer(*args, **kwargs)
+            out = call(layer, *args, **kwargs)
             new_buffers = {}
             for path in buffers:
                 owner, leaf = _buffer_owner(layers_by_prefix, path)

@@ -90,6 +90,50 @@ class EagerParameter:
     def set_value(self, v):
         self.value = jnp.asarray(v, dtype=self.value.dtype)
 
+    def __jax_array__(self):
+        # lets jnp/jax ops consume a Parameter directly (the dygraph
+        # VarBase-is-a-tensor ergonomics, imperative/layer.h:56)
+        return self.value
+
+    def astype(self, dtype):
+        return self.value.astype(dtype)
+
+    def reshape(self, *shape):
+        return self.value.reshape(*shape)
+
+    def __add__(self, o):
+        return self.value + o
+
+    def __radd__(self, o):
+        return o + self.value
+
+    def __sub__(self, o):
+        return self.value - o
+
+    def __rsub__(self, o):
+        return o - self.value
+
+    def __mul__(self, o):
+        return self.value * o
+
+    def __rmul__(self, o):
+        return o * self.value
+
+    def __truediv__(self, o):
+        return self.value / o
+
+    def __rtruediv__(self, o):
+        return o / self.value
+
+    def __neg__(self):
+        return -self.value
+
+    def __matmul__(self, o):
+        return self.value @ o
+
+    def __getitem__(self, idx):
+        return self.value[idx]
+
     def __repr__(self):
         return (f"EagerParameter(name={self.name}, shape={self.shape}, "
                 f"dtype={self.dtype}, trainable={self.trainable})")

@@ -160,7 +160,10 @@ def adamax(ins, attrs):
     m_out = beta1 * m + (1 - beta1) * g
     inf_out = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
     p_out = p - (lr / (1 - b1pow)) * (m_out / (inf_out + eps))
-    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out}
+    # the reference advances beta1_pow via a separate scale op in
+    # Adamax._finish_update (optimizer.py:1986); folded into the kernel here
+    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out,
+            "Beta1PowOut": (b1pow * beta1).reshape(ins["Beta1Pow"].shape)}
 
 
 @register_op("ftrl", stateful=True)

@@ -252,7 +252,8 @@ class Adamax(Optimizer):
             "adamax",
             inputs={"Param": p, "Grad": g, "Moment": m, "InfNorm": inf,
                     "Beta1Pow": b1p, "LearningRate": self._param_lr(p)},
-            outputs={"ParamOut": p, "MomentOut": m, "InfNormOut": inf},
+            outputs={"ParamOut": p, "MomentOut": m, "InfNormOut": inf,
+                     "Beta1PowOut": b1p},
             attrs={"beta1": self._beta1, "beta2": self._beta2,
                    "epsilon": self._epsilon})
 

@@ -1,0 +1,221 @@
+"""Functional optimizers — pure (params, grads, state) -> (params, state).
+
+The eager/sharded counterpart to the static-graph optimizer zoo in
+optimizer/__init__.py.  Both call the SAME update kernels
+(paddle_tpu/ops/optimizer_ops.py, the rebuild of the reference's
+operators/optimizers/*), so static and functional training produce
+bit-identical updates.  The pure-transform shape is what lets a train step
+be jitted/pjit-sharded whole: optimizer state is an explicit pytree that
+rides through jax transformations (the reference instead mutates
+accumulator Variables in the scope — SURVEY.md §2.2 Optimizers).
+
+Usage:
+    opt = functional.Adam(1e-3)
+    state = opt.init(params)                       # params: dict name->array
+    params, state = opt.update(params, grads, state)
+"""
+
+import jax.numpy as jnp
+
+from ..ops import optimizer_ops as K
+
+__all__ = [
+    "FunctionalOptimizer", "SGD", "Momentum", "LarsMomentum", "Adam",
+    "AdamW", "Adagrad", "DecayedAdagrad", "Adadelta", "RMSProp", "Adamax",
+    "Ftrl", "Lamb",
+]
+
+
+class FunctionalOptimizer:
+    """Wraps one optimizer_ops kernel into an init/update transform.
+
+    Subclasses define:
+      op: the kernel function
+      slots: dict input-name -> fill value, per-param accumulators
+      scalar_slots: dict input-name -> init value, per-param scalar
+        accumulators (beta powers)
+      out_map: kernel output name -> input name rebind
+    """
+
+    op = None
+    slots = {}
+    scalar_slots = {}
+    out_map = {}  # kernel output name -> state slot, when != name minus "Out"
+
+    def __init__(self, learning_rate=0.001, grad_clip=None,
+                 weight_decay=None, **attrs):
+        self._lr = learning_rate
+        self._attrs = attrs
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+
+    def init(self, params):
+        state = {}
+        for name, p in params.items():
+            s = {k: jnp.full_like(p, v) for k, v in self.slots.items()}
+            s.update({k: jnp.asarray(v, dtype=jnp.float32)
+                      for k, v in self.scalar_slots.items()})
+            state[name] = s
+        state["__step__"] = jnp.zeros((), jnp.int32)
+        return state
+
+    def learning_rate(self, step):
+        lr = self._lr
+        if callable(lr):
+            lr = lr(step)
+        return jnp.asarray(lr, dtype=jnp.float32).reshape(1)
+
+    def update(self, params, grads, state):
+        if self._grad_clip is not None:
+            grads = self._grad_clip(grads)
+        step = state["__step__"]
+        lr = self.learning_rate(step)
+        new_params, new_state = {}, {"__step__": step + 1}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_state[name] = state[name]
+                continue
+            g = g.astype(p.dtype) if g.dtype != p.dtype else g
+            if self._weight_decay:
+                g = g + self._weight_decay * p
+            ins = {"Param": p, "Grad": g, "LearningRate": lr}
+            ins.update(state[name])
+            out = type(self).op(ins, dict(self._attrs))
+            new_params[name] = out.pop("ParamOut")
+            new_state[name] = {
+                self.out_map.get(k, k[: -len("Out")]): v
+                for k, v in out.items() if k.endswith("Out")
+            }
+        return new_params, new_state
+
+
+class SGD(FunctionalOptimizer):
+    op = staticmethod(K.sgd)
+
+
+class Momentum(FunctionalOptimizer):
+    op = staticmethod(K.momentum)
+    slots = {"Velocity": 0.0}
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 use_nesterov=False, **kw):
+        super().__init__(learning_rate, mu=momentum,
+                         use_nesterov=use_nesterov, **kw)
+
+
+class LarsMomentum(FunctionalOptimizer):
+    op = staticmethod(K.lars_momentum)
+    slots = {"Velocity": 0.0}
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, mu=momentum, lars_coeff=lars_coeff,
+                         lars_weight_decay=lars_weight_decay, **kw)
+
+
+class Adam(FunctionalOptimizer):
+    op = staticmethod(K.adam)
+    slots = {"Moment1": 0.0, "Moment2": 0.0}
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kw)
+        self.scalar_slots = {"Beta1Pow": beta1, "Beta2Pow": beta2}
+
+
+class AdamW(Adam):
+    op = staticmethod(K.adamw)
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, coeff=0.01, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self._attrs["coeff"] = coeff
+
+
+class Adagrad(FunctionalOptimizer):
+    op = staticmethod(K.adagrad)
+    slots = {"Moment": 0.0}
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, epsilon=epsilon, **kw)
+
+
+class DecayedAdagrad(FunctionalOptimizer):
+    op = staticmethod(K.decayed_adagrad)
+    slots = {"Moment": 0.0}
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, decay=decay, epsilon=epsilon, **kw)
+
+
+class Adadelta(FunctionalOptimizer):
+    op = staticmethod(K.adadelta)
+    slots = {"AvgSquaredGrad": 0.0, "AvgSquaredUpdate": 0.0}
+
+    def __init__(self, learning_rate=1.0, rho=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, rho=rho, epsilon=epsilon, **kw)
+
+
+class RMSProp(FunctionalOptimizer):
+    op = staticmethod(K.rmsprop)
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, **kw):
+        super().__init__(learning_rate, decay=rho, epsilon=epsilon,
+                         momentum=momentum, centered=centered, **kw)
+        self.slots = {"MeanSquare": 0.0, "Moment": 0.0}
+        if centered:
+            self.slots["MeanGrad"] = 0.0
+
+
+class Adamax(FunctionalOptimizer):
+    op = staticmethod(K.adamax)
+    slots = {"Moment": 0.0, "InfNorm": 0.0}
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kw)
+        self.scalar_slots = {"Beta1Pow": beta1}
+
+
+class Ftrl(FunctionalOptimizer):
+    op = staticmethod(K.ftrl)
+    slots = {"SquaredAccumulator": 0.0, "LinearAccumulator": 0.0}
+    out_map = {"SquaredAccumOut": "SquaredAccumulator",
+               "LinearAccumOut": "LinearAccumulator"}
+
+    def __init__(self, learning_rate=0.05, l1=0.0, l2=0.0, lr_power=-0.5,
+                 **kw):
+        super().__init__(learning_rate, l1=l1, l2=l2, lr_power=lr_power,
+                         **kw)
+
+
+class Lamb(FunctionalOptimizer):
+    op = staticmethod(K.lamb)
+    slots = {"Moment1": 0.0, "Moment2": 0.0}
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kw)
+        self._attrs["weight_decay"] = lamb_weight_decay
+        self.scalar_slots = {"Beta1Pow": beta1, "Beta2Pow": beta2}
+
+
+def global_norm_clip(clip_norm):
+    """Gradient clip-by-global-norm as a grads->grads transform (parity:
+    fluid.clip.GradientClipByGlobalNorm)."""
+
+    def clip(grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in grads.values() if g is not None)
+        gnorm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12))
+        return {k: (None if g is None else g * scale.astype(g.dtype))
+                for k, g in grads.items()}
+
+    return clip
