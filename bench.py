@@ -1,0 +1,109 @@
+"""Headline benchmark: BERT-base-scale causal-LM train step, one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric = model FLOPs utilization (MFU) of a full jitted
+(forward+backward+AdamW) step in bf16 — the north-star metric from
+BASELINE.md ("≥45% MFU"). vs_baseline = MFU / 0.45.
+FLOPs counted as 6 * n_params * n_tokens (standard transformer estimate;
+embedding table excluded from the param count).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+# peak bf16 FLOP/s per chip by TPU generation (public specs); fall back
+# conservatively if unknown
+PEAK_FLOPS = {
+    "v2": 22.5e12, "v3": 61.0e12, "v4": 137.5e12,  # wiki peak bf16 numbers
+    "v5e": 197e12, "v5p": 459e12, "v6e": 918e12, "v6": 918e12,
+}
+
+
+def _peak_flops(device):
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for k in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if k in kind:
+            return PEAK_FLOPS[k]
+    if device.platform == "cpu":
+        return 1e11  # nominal, so CPU smoke runs still emit sane JSON
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.models.train import init_train_state, make_train_step
+    from paddle_tpu.optimizer.functional import AdamW
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    # BERT-base geometry (12 x 768, causal-LM objective) on TPU;
+    # a small stand-in on CPU so the bench always completes
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=512, dtype="bfloat16")
+        batch, seq, iters = 16, 512, 20
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128, dtype="float32")
+        batch, seq, iters = 8, 128, 3
+
+    model = GPT(cfg)
+    opt = AdamW(1e-4)
+    state = init_train_state(model, opt)
+    step = make_train_step(model, opt, jit=False)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                    dtype=jnp.int32)
+    y = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                    dtype=jnp.int32)
+
+    # Scan `iters` steps inside ONE jit: a single device dispatch per
+    # measurement, so host<->device round trips don't pollute the number
+    # (and it is the idiomatic TPU train loop shape).
+    @jax.jit
+    def run_steps(state, x, y):
+        def body(st, _):
+            st, loss = step(st, x, y)
+            return st, loss
+        return jax.lax.scan(body, state, None, length=iters)
+
+    # NB: under the remote-tunnel backend block_until_ready alone does not
+    # guarantee execution finished — a host fetch (float()) is the only
+    # reliable sync, so every measurement boundary fetches a scalar.
+    state, losses = run_steps(state, x, y)  # compile + warmup
+    assert np.isfinite(float(losses[-1]))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state, losses = run_steps(state, x, y)
+        assert np.isfinite(float(losses[-1]))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    dt = best
+
+    n_params = sum(
+        int(np.prod(p.value.shape)) for n, p in model.named_parameters()
+        if "wte" not in n and "wpe" not in n)
+    tokens = batch * seq
+    model_flops = 6.0 * n_params * tokens
+    mfu = model_flops / dt / _peak_flops(dev)
+    print(json.dumps({
+        "metric": "bert_base_train_mfu" if on_tpu else "bert_small_cpu_mfu",
+        "value": round(mfu, 4),
+        "unit": "mfu_frac",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "tokens_per_sec": round(tokens / dt, 1),
+        "step_ms": round(dt * 1e3, 2),
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+    }))
+
+
+if __name__ == "__main__":
+    main()

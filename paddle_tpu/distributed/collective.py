@@ -82,7 +82,7 @@ def all_to_all(x, axis_name, split_axis, concat_axis):
 
 def _eager(fn, mesh, in_spec, out_spec):
     return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-                     check_rep=False)
+                     check_vma=False)
 
 
 def eager_all_reduce(x, mesh=None, axis_name="dp", op="sum"):

@@ -83,7 +83,7 @@ class DataParallelTrainStep:
                 in_specs=(replicated, replicated, replicated, replicated)
                 + tuple(batch_spec for _ in batch),
                 out_specs=(replicated, replicated, replicated, replicated),
-                check_rep=False,
+                check_vma=False,
             )(params, buffers, opt_state, rng_key, *batch)
 
         self._jit_step = jax.jit(_sharded, donate_argnums=(0, 1, 2))

@@ -1,0 +1,173 @@
+"""Tensor-parallel / fully-sharded training via partition rules.
+
+This is the capability the reference lacks (SURVEY.md §2.3: "Tensor
+parallel ... NO") built the TPU way: instead of rewriting the graph with
+collective ops (transpiler/collective.py in the reference does this for
+DP), we attach `jax.sharding.NamedSharding`s to the *arrays* of the train
+state according to regex partition rules, and `jax.jit` propagates the
+shardings through the whole train step — XLA inserts all-gathers /
+reduce-scatters / psums on ICI where the math demands them.
+
+Megatron-style rules for a transformer block (weights are [in, out]):
+  qkv / fc1 weights  -> shard OUT dim over "tp"  (column parallel)
+  out_proj / fc2     -> shard IN  dim over "tp"  (row parallel)
+  embeddings         -> shard vocab dim over "tp"
+  layernorm, biases of row-parallel layers -> replicated
+
+Optimizer moments inherit param shardings for free: FunctionalOptimizer
+.init builds them with zeros_like(param), which preserves sharding — so
+Adam/LAMB state is automatically sharded like the weights (ZeRO-style for
+the tp-sharded slices).
+"""
+
+import re
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "PartitionRules", "gpt_rules", "bert_rules", "mlp_rules",
+    "shard_params", "shard_train_state", "shard_batch",
+    "make_sharded_train_step",
+]
+
+
+class PartitionRules:
+    """Ordered (regex, PartitionSpec) table; first match wins.
+
+    The analogue of the reference's per-op placement decisions in
+    multi_devices_graph_pass.cc — but declarative and per-parameter.
+    """
+
+    def __init__(self, rules, default=P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.default = default
+
+    def spec(self, name, value=None):
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return spec
+        return self.default
+
+    def __add__(self, other):
+        out = PartitionRules([], default=self.default)
+        out.rules = self.rules + other.rules
+        return out
+
+
+def gpt_rules():
+    """Megatron TP sharding for models/gpt.py / models/bert.py naming."""
+    col = P(None, "tp")   # [in, out] -> out sharded
+    row = P("tp", None)   # [in, out] -> in sharded
+    return PartitionRules([
+        (r"(q_proj|k_proj|v_proj|fc1|linear1)\.weight$", col),
+        (r"(q_proj|k_proj|v_proj|fc1|linear1)\.bias$", P("tp")),
+        (r"(out_proj|fc2|linear2)\.weight$", row),
+        (r"(wte|wpe|word_emb|pos_emb|embedding)\.weight$", P("tp", None)),
+        (r".*", P()),
+    ])
+
+
+def bert_rules():
+    return gpt_rules()
+
+
+def mlp_rules():
+    return PartitionRules([
+        (r"\.weight$", P(None, "tp")),
+        (r".*", P()),
+    ])
+
+
+def _named(mesh, spec, value):
+    # drop axes that exceed rank; clamp spec to array rank
+    rank = np.ndim(value)
+    parts = list(spec) + [None] * max(0, rank - len(spec))
+    parts = parts[:rank]
+    # un-shard dims not divisible by the axis size (e.g. tiny test models)
+    def axsize(a):
+        if a is None:
+            return 1
+        names = (a,) if isinstance(a, str) else a
+        return int(np.prod([mesh.shape[n] for n in names]))
+    shape = np.shape(value)
+    parts = [a if shape[i] % axsize(a) == 0 else None
+             for i, a in enumerate(parts)]
+    while parts and parts[-1] is None:
+        parts.pop()
+    return NamedSharding(mesh, P(*parts))
+
+
+def shard_params(params, mesh, rules):
+    """device_put a {name: array} dict per the partition rules."""
+    return {
+        n: jax.device_put(v, _named(mesh, rules.spec(n, v), v))
+        for n, v in params.items()
+    }
+
+
+def shard_batch(mesh, *arrays, spec=None):
+    """Shard batch arrays: leading dim over dp, second (seq) over sp."""
+    out = []
+    for a in arrays:
+        s = spec
+        if s is None:
+            s = P("dp", "sp") if np.ndim(a) >= 2 else P("dp")
+        out.append(jax.device_put(a, _named(mesh, s, a)))
+    return tuple(out) if len(out) > 1 else out[0]
+
+
+def shard_train_state(state, mesh, rules):
+    """Shard a models.train.TrainState: params + matching opt moments per
+    rules, buffers/step/rng replicated."""
+    from ..models.train import TrainState
+
+    params = shard_params(state.params, mesh, rules)
+
+    def shard_opt(leaf_path, leaf):
+        # opt_state is a pytree whose dict keys mirror param names
+        for n, p in params.items():
+            if ("/" + n + "/" in leaf_path or leaf_path.endswith("/" + n)) \
+                    and np.shape(leaf) == np.shape(p):
+                return jax.device_put(leaf, _named(mesh, rules.spec(n), leaf))
+        return jax.device_put(leaf, NamedSharding(mesh, P()))
+
+    opt_state = _tree_map_with_path(shard_opt, state.opt_state)
+    rep = NamedSharding(mesh, P())
+    return TrainState(
+        params=params,
+        opt_state=opt_state,
+        buffers=jax.device_put(state.buffers, rep),
+        step=jax.device_put(state.step, rep),
+        rng=jax.device_put(state.rng, rep),
+    )
+
+
+def _tree_map_with_path(fn, tree, path=""):
+    if isinstance(tree, dict):
+        return {k: _tree_map_with_path(fn, v, path + "/" + str(k))
+                for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        t = [_tree_map_with_path(fn, v, path + f"/{i}")
+             for i, v in enumerate(tree)]
+        return type(tree)(t)
+    return fn(path, tree)
+
+
+def make_sharded_train_step(model, optimizer, mesh, rules=None,
+                            loss_fn=None, rng_seed=0):
+    """Build (step, sharded_state). step(state, *batch) -> (state, loss).
+
+    The step function is models.train.make_train_step's jitted step —
+    sharding is carried entirely by the arrays; XLA compiles the TP/DP/SP
+    collectives from the NamedShardings. Batch arrays should be placed
+    with shard_batch (dp×sp).
+    """
+    from ..models.train import init_train_state, make_train_step
+
+    rules = rules or gpt_rules()
+    state = init_train_state(model, optimizer, rng_seed=rng_seed)
+    state = shard_train_state(state, mesh, rules)
+    step = make_train_step(model, optimizer, loss_fn=loss_fn, jit=True)
+    return step, state

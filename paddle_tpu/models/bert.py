@@ -52,6 +52,7 @@ def bert_tiny_config(**kw):
 class BertEmbeddings(nn.Layer):
     def __init__(self, cfg):
         super().__init__(dtype=cfg.dtype)
+        self.cfg = cfg
         self.word_embeddings = nn.Embedding(
             [cfg.vocab_size, cfg.hidden_size], dtype=cfg.dtype)
         self.position_embeddings = nn.Embedding(
@@ -65,6 +66,10 @@ class BertEmbeddings(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None):
         seq = input_ids.shape[1]
+        if seq > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {seq} exceeds max_position_embeddings "
+                f"{self.cfg.max_position_embeddings}")
         pos = jnp.arange(seq, dtype=jnp.int32)[None, :]
         emb = self.word_embeddings(input_ids)
         emb = emb + self.position_embeddings(pos)
@@ -125,8 +130,7 @@ class BertForPretraining(nn.Layer):
                 seq_out, masked_positions[..., None], axis=1)
         h = self.transform_norm(self.transform(seq_out))
         # weight tying with the word embedding table (standard BERT)
-        emb = self.bert.embeddings.word_embeddings.weight
-        emb = emb.value if hasattr(emb, "value") else emb
+        emb = F._val(self.bert.embeddings.word_embeddings.weight)
         logits = jnp.einsum("bsh,vh->bsv", h, emb) + self.mlm_bias
         nsp_logits = self.nsp(pooled)
         return logits, nsp_logits

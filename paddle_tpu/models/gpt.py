@@ -61,14 +61,16 @@ class GPT(nn.Layer):
 
     def forward(self, input_ids):
         seq = input_ids.shape[1]
+        if seq > self.cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {seq} exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}")
         pos = jnp.arange(seq, dtype=jnp.int32)[None, :]
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
         for blk in self.blocks:
             x = blk(x)
         x = self.norm_f(x)
-        wte = self.wte.weight
-        wte = wte.value if hasattr(wte, "value") else wte
-        return jnp.einsum("bsh,vh->bsv", x, wte)
+        return jnp.einsum("bsh,vh->bsv", x, F._val(self.wte.weight))
 
     def loss(self, input_ids, labels):
         logits = self.forward(input_ids)

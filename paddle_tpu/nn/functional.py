@@ -182,7 +182,16 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
 
 
 def dropout(x, p=0.5, training=True, mode="upscale_in_train", rng_key=None):
-    key = rng_key if rng_key is not None else default_rng.next_key()
+    if p == 0.0 or (not training and mode == "upscale_in_train"):
+        return _val(x)
+    if rng_key is not None:
+        key = rng_key
+    elif not training:
+        # eval in downgrade_in_infer mode scales by (1-p) deterministically;
+        # the kernel ignores the key when is_test
+        key = jax.random.PRNGKey(0)
+    else:
+        key = default_rng.next_key()
     return _n.dropout({"X": _val(x)},
                       {"dropout_prob": p, "is_test": not training,
                        "dropout_implementation": mode, "_rng": key})["Out"]

@@ -91,8 +91,11 @@ class EagerParameter:
         self.value = jnp.asarray(v, dtype=self.value.dtype)
 
     def __jax_array__(self):
-        # lets jnp/jax ops consume a Parameter directly (the dygraph
-        # VarBase-is-a-tensor ergonomics, imperative/layer.h:56)
+        # lets elementwise jnp dunders and jnp.asarray consume a Parameter
+        # directly (the dygraph VarBase-is-a-tensor ergonomics,
+        # imperative/layer.h:56). Reductions (jnp.sum) and jit
+        # abstractification reject __jax_array__ on jax>=0.9 — use
+        # param.value there.
         return self.value
 
     def astype(self, dtype):

@@ -52,7 +52,11 @@ class FunctionalOptimizer:
     def init(self, params):
         state = {}
         for name, p in params.items():
-            s = {k: jnp.full_like(p, v) for k, v in self.slots.items()}
+            # accumulators always fp32 (bf16 moments destroy Adam
+            # stability); full_like keeps the param's sharding so moments
+            # of tp/dp-sharded params stay sharded
+            s = {k: jnp.full_like(p, v, dtype=jnp.float32)
+                 for k, v in self.slots.items()}
             s.update({k: jnp.asarray(v, dtype=jnp.float32)
                       for k, v in self.scalar_slots.items()})
             state[name] = s
@@ -77,13 +81,16 @@ class FunctionalOptimizer:
                 new_params[name] = p
                 new_state[name] = state[name]
                 continue
-            g = g.astype(p.dtype) if g.dtype != p.dtype else g
+            # update math in fp32 regardless of param dtype (bf16 training);
+            # the new param is cast back to the stored dtype
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
             if self._weight_decay:
-                g = g + self._weight_decay * p
-            ins = {"Param": p, "Grad": g, "LearningRate": lr}
+                g = g + self._weight_decay * p32
+            ins = {"Param": p32, "Grad": g, "LearningRate": lr}
             ins.update(state[name])
             out = type(self).op(ins, dict(self._attrs))
-            new_params[name] = out.pop("ParamOut")
+            new_params[name] = out.pop("ParamOut").astype(p.dtype)
             new_state[name] = {
                 self.out_map.get(k, k[: -len("Out")]): v
                 for k, v in out.items() if k.endswith("Out")
