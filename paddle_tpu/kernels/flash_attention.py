@@ -1,0 +1,370 @@
+"""Pallas TPU flash attention (forward + custom-VJP backward).
+
+The native-kernel tier of the attention stack: replaces the reference's
+hand-fused CUDA attention (/root/reference/paddle/fluid/operators/fused/
+multihead_matmul_op.cu, operators/math/bert_encoder_functor.cu) with an
+online-softmax tiled kernel that never materialises the [S, S] score
+matrix in HBM.
+
+Structure (canonical TPU pipelining shape): the grid is
+(batch*heads, q blocks, k blocks) with the k axis innermost and marked
+"arbitrary" so Mosaic double-buffers the k/v block DMAs against compute.
+Softmax statistics (running max m, running sum l) and the output
+accumulator live in VMEM scratch that persists across the k steps of one
+q block; the causal triangle prunes dead (qi, ki) tiles with pl.when.
+Matmuls run in the input dtype (bf16 → full-rate MXU) accumulating f32
+via preferred_element_type.
+
+Backward recomputes scores blockwise from the saved logsumexp (no S×S
+residual): one kernel for dq (grid k-innermost) and one for dk/dv (grid
+q-innermost) — the flash-attention-2 decomposition.
+
+On non-TPU backends the same kernels run in interpret mode, which is how
+tests/test_flash_attention.py checks numerics vs the XLA composition.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pieces; absent on CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+_LANES = 128
+NEG_INF = -1e30
+
+
+def _vmem_spec(*args):
+    if _VMEM is None:
+        return pl.BlockSpec(*args)
+    return pl.BlockSpec(*args, memory_space=_VMEM)
+
+
+def _scratch(shape, dtype=jnp.float32):
+    if pltpu is not None:
+        return pltpu.VMEM(shape, dtype)
+    raise RuntimeError("pallas TPU backend unavailable")  # pragma: no cover
+
+
+def _compiler_params():
+    if pltpu is None:  # pragma: no cover
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _causal_mask(s, qi, ki, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _tile(masked):
+        q = q_ref[0]                                      # [bq, d] native
+        k_blk = k_ref[0]                                  # [bk, d]
+        v_blk = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk] f32
+        if masked:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        m_prev = m_ref[:, 0]                              # [bq]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = l_prev * alpha + p.sum(axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_cur[:, None], l_ref.shape)
+
+    if causal:
+        # only tiles straddling the diagonal pay the iota/mask passes;
+        # tiles fully below it run the unmasked fast path
+        live = (qi + 1) * block_q > ki * block_k
+        full = qi * block_q >= (ki + 1) * block_k
+
+        @pl.when(live & full)
+        def _fast():
+            _tile(masked=False)
+
+        @pl.when(live & jnp.logical_not(full))
+        def _diag():
+            _tile(masked=True)
+    else:
+        _tile(masked=False)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(o_ref.dtype)
+        # stats get a trailing singleton axis: TPU block shapes need the
+        # last two dims (8,128)-aligned or equal to the array dims
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(l_safe))[:, None]
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    b, h, s, d = q.shape
+    grid = (b * h, s // block_q, s // block_k)
+    q3 = q.reshape(b * h, s, d)
+    k3 = k.reshape(b * h, s, d)
+    v3 = v.reshape(b * h, s, d)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            _vmem_spec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((block_q, d)),
+            _scratch((block_q, _LANES)),
+            _scratch((block_q, _LANES)),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return out.reshape(b, h, s, d), lse.reshape(b, h, s)
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc_ref, *, sm_scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    live = ((qi + 1) * block_q > ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]                                      # [bq, d]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0]                            # [bq]
+        delta = delta_ref[0][:, 0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        s = sm_scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])                     # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_acc_ref[:] = dq_acc_ref[:] + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                    *, sm_scale, causal, block_q, block_k):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    live = ((qi + 1) * block_q > ki * block_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        k_blk = k_ref[0]                                  # [bk, d]
+        v_blk = v_ref[0]
+        q = q_ref[0]                                      # [bq, d]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        s = sm_scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_acc_ref[:] = dk_acc_ref[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    b, h, s, d = q.shape
+    do = g
+    # delta = rowsum(dO * O), [b,h,s] — plain XLA, fuses into one pass
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+
+    q3 = q.reshape(b * h, s, d)
+    k3 = k.reshape(b * h, s, d)
+    v3 = v.reshape(b * h, s, d)
+    do3 = do.reshape(b * h, s, d)
+    lse3 = lse.reshape(b * h, s, 1)
+    delta3 = delta.reshape(b * h, s, 1)
+
+    grid_dq = (b * h, s // block_q, s // block_k)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=grid_dq,
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            _vmem_spec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            _vmem_spec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+            _vmem_spec((1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_specs=_vmem_spec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[_scratch((block_q, d))],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    grid_kv = (b * h, s // block_k, s // block_q)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=grid_kv,
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            _vmem_spec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q, 1), lambda bh, ki, qi: (bh, qi, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse3, delta3)
+
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h, s, d),
+            dv.reshape(b, h, s, d))
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None,
+                    block_q=None, block_k=None):
+    """Tiled attention over [batch, heads, seq, head_dim] inputs.
+
+    seq must be a multiple of the block sizes (default 512, clamped to
+    seq); head_dim should be an MXU-friendly 64/128/256. Returns the same
+    shape/dtype as q.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = q.shape[-2]
+
+    def _auto_block(default):
+        # largest power-of-two tile <= default that divides seq, so any
+        # 128-multiple seq (1920, 2176, ...) gets a valid tiling
+        for cand in (default, default // 2, default // 4, default // 8):
+            if cand <= s and s % cand == 0:
+                return cand
+        return s
+
+    block_q = block_q or _auto_block(DEFAULT_BLOCK_Q)
+    block_k = block_k or _auto_block(DEFAULT_BLOCK_K)
+    block_q, block_k = min(block_q, s), min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(
+            f"seq {s} must be divisible by block sizes ({block_q},{block_k})")
+    return _flash(q, k, v, float(sm_scale), bool(causal), block_q, block_k)
