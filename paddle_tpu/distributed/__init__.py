@@ -16,6 +16,12 @@ from .collective import (
 from .mesh import build_mesh, default_mesh, get_global_mesh, set_global_mesh
 from .env import ParallelEnv, init_parallel_env, get_rank, get_world_size
 from .data_parallel import DataParallel, DataParallelTrainStep, scale_loss
+from .sharded import (
+    PartitionRules, gpt_rules, bert_rules, mlp_rules, shard_params,
+    shard_batch, shard_train_state, make_sharded_train_step,
+)
+from .ring_attention import ring_attention, ring_attention_sharded
+from .pipeline import gpipe, build_gpt_pipeline
 
 __all__ = [
     "collective", "mesh", "fleet",
@@ -24,4 +30,9 @@ __all__ = [
     "build_mesh", "default_mesh", "get_global_mesh", "set_global_mesh",
     "ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
     "DataParallel", "DataParallelTrainStep", "scale_loss",
+    "PartitionRules", "gpt_rules", "bert_rules", "mlp_rules",
+    "shard_params", "shard_batch", "shard_train_state",
+    "make_sharded_train_step",
+    "ring_attention", "ring_attention_sharded",
+    "gpipe", "build_gpt_pipeline",
 ]

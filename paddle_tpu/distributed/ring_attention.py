@@ -1,0 +1,121 @@
+"""Ring attention: exact attention over sequence-sharded q/k/v.
+
+The long-context capability the reference lacks entirely (SURVEY.md §2.3:
+"Sequence/context parallelism ... NO"; its long-sequence story is LoD
+ragged batching + recompute). Here each device of the "sp" mesh axis
+holds a [B, H, S/n, D] shard; k/v shards rotate around the ring via
+jax.lax.ppermute (compiled to ICI neighbor exchanges) while the local
+q shard accumulates online-softmax partial results — so attention over
+the FULL sequence is computed without any device ever holding more than
+1/n of it, and the per-step block compute overlaps the next shard's
+transfer (XLA schedules the ppermute DMA against the einsums).
+
+Math: same numerically-stable streaming softmax as the flash kernel
+(kernels/flash_attention.py) — carry running max m, running sum l and an
+unnormalised accumulator; each incoming block contributes via
+exp-rescaling. Causal masking uses global positions derived from the
+ring step, so fully-future blocks contribute exp(-inf)=0 and vanish.
+
+grads: everything is jnp + ppermute (which has a transpose rule), so
+jax.grad differentiates straight through the ring; the per-block compute
+is wrapped in jax.checkpoint to keep backward memory at O(S/n).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def _block_attn(q, k, v, scale, causal, q_start, k_start):
+    """One q-shard x kv-shard block. Returns (unnormalised out, m, l).
+
+    q: [B,H,Sq,D], k/v: [B,H,Sk,D]; q_start/k_start are the global
+    offsets of the shards (traced scalars — the kv offset changes per
+    ring step).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_start + jnp.arange(q.shape[2])[:, None]
+        k_pos = k_start + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m = s.max(axis=-1)                                    # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
+    """Exact attention with q/k/v sequence-sharded over `axis_name`.
+
+    Must be called inside shard_map (or pmap) over a mesh with that axis;
+    q, k, v are the local [B, H, S_local, D] shards. Returns the local
+    output shard, same shape/dtype as q.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_loc = q.shape[2]
+    q_start = idx * s_loc
+
+    block = jax.checkpoint(
+        functools.partial(_block_attn, scale=sm_scale, causal=causal))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        k_cur, v_cur, kv_idx, m_acc, l_acc, o_acc = carry
+        o_i, m_i, l_i = block(q, k_cur, v_cur,
+                              q_start=q_start, k_start=kv_idx * s_loc)
+        m_new = jnp.maximum(m_acc, m_i)
+        # all-masked blocks have m_i = -inf -> beta = 0 -> no contribution
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_i - m_new)
+        l_new = l_acc * alpha + l_i * beta
+        o_new = o_acc * alpha[..., None] + o_i * beta[..., None]
+        # rotate kv shards one hop around the ring (ICI neighbor DMA)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        kv_next = (kv_idx - 1) % n
+        return (k_next, v_next, kv_next, m_new, l_new, o_new), None
+
+    b, h, sq, d = q.shape
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    carry0 = (k, v, idx, m0, l0, o0)
+    (kf, vf, _, m, l, o), _ = jax.lax.scan(step, carry0, None, length=n)
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (o / l_safe[..., None]).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_ring_fn(mesh, axis_name, causal, sm_scale):
+    spec = P(None, None, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          causal=causal, sm_scale=sm_scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return jax.jit(fn)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
+                           sm_scale=None):
+    """Global-array entry point: q/k/v are [B, H, S, D] jax Arrays; the
+    seq dim is (re)sharded over `axis_name` and the ring runs under jit.
+    The jitted fn is cached per (mesh, axis, causal, scale) so repeated
+    calls hit the compile cache."""
+    return _sharded_ring_fn(mesh, axis_name, bool(causal),
+                            sm_scale)(q, k, v)

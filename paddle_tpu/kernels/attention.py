@@ -46,28 +46,27 @@ def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
     head_dim = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(head_dim)
 
+    # the flash kernel supports neither arbitrary masks nor in-kernel
+    # dropout, and needs self-attention shapes with block-aligned seq;
+    # anything else must take the XLA path even if the caller forced
+    # use_flash=True (silent wrong numerics otherwise)
+    seq = q.shape[-2]
+    can_flash = (
+        (dropout_p == 0.0 or not training)
+        and mask is None
+        and q.shape[-2] == k.shape[-2]
+        and seq % 128 == 0
+        and head_dim in (64, 128, 256)
+    )
     if use_flash is None:
-        # flash kernel needs TPU, no dropout inside kernel, seq multiple of
-        # its block size; mask support limited to causal. Below ~1k tokens
-        # XLA's fused softmax(QK^T)V is faster on-chip (the S^2 matrix
-        # still fits cache-friendly tiles); flash wins once the S^2
-        # materialisation starts thrashing HBM (measured crossover on
-        # v5e: 512 -> XLA, 2048 -> flash by ~20%).
-        seq = q.shape[-2]
-        use_flash = (
-            jax.default_backend() == "tpu"
-            and dropout_p == 0.0
-            and mask is None
-            and seq >= 1024
-            and seq % 128 == 0
-            and head_dim in (64, 128, 256)
-        )
-    if use_flash:
-        try:
-            from .flash_attention import flash_attention
+        # Below ~1k tokens XLA's fused softmax(QK^T)V is faster on-chip
+        # (the S^2 matrix still fits cache-friendly tiles); flash wins
+        # once the S^2 materialisation starts thrashing HBM (measured
+        # crossover on v5e: 512 -> XLA, 2048 -> flash by ~20%).
+        use_flash = (jax.default_backend() == "tpu" and seq >= 1024)
+    if use_flash and can_flash:
+        from .flash_attention import flash_attention
 
-            return flash_attention(q, k, v, causal=is_causal, sm_scale=scale)
-        except Exception:
-            pass
+        return flash_attention(q, k, v, causal=is_causal, sm_scale=scale)
     return _xla_attention(q, k, v, mask, scale, is_causal, dropout_p,
                           training, rng_key)

@@ -345,7 +345,8 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
                     block_q=None, block_k=None):
     """Tiled attention over [batch, heads, seq, head_dim] inputs.
 
-    seq must be a multiple of the block sizes (default 512, clamped to
+    seq must be a multiple of the block sizes (default DEFAULT_BLOCK_Q/
+    DEFAULT_BLOCK_K = 1024, auto-shrunk to a power-of-two divisor of
     seq); head_dim should be an MXU-friendly 64/128/256. Returns the same
     shape/dtype as q.
     """

@@ -1,0 +1,90 @@
+"""GPipe pipeline numerics: pipelined loss/grads == single-device model.
+
+The reference's pipeline correctness story is dist-vs-local loss parity
+(test_dist_base.py); same assertion here: the pp-sharded schedule must
+reproduce the unsharded model's loss and gradients.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.pipeline import (
+    build_gpt_pipeline, gpipe, pipeline_dryrun)
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.nn.layers import param_dict, _swap_params
+
+
+def _model(layers=4):
+    return GPT(GPTConfig(vocab_size=128, hidden_size=32, num_layers=layers,
+                         num_heads=4, max_seq_len=16, dropout=0.0))
+
+
+def _batch(n=8, seq=16, seed=0):
+    r = np.random.default_rng(seed)
+    return (jnp.asarray(r.integers(0, 128, (n, seq)), jnp.int32),
+            jnp.asarray(r.integers(0, 128, (n, seq)), jnp.int32))
+
+
+def test_gpipe_identity_stage_schedule():
+    # trivial stage (h + w) checks the schedule routes every microbatch
+    # through every stage exactly once
+    mesh = build_mesh(dp=1, tp=1, pp=4, sp=1, devices=jax.devices()[:4])
+    w = jnp.arange(4, dtype=jnp.float32).reshape(4, 1) + 1.0  # [stages, 1]
+
+    fn = gpipe(lambda p, h: h + p[0], mesh, num_microbatches=2,
+               batch_axis=None)
+    x = jnp.ones((4, 3), jnp.float32)
+    out = jax.jit(fn)(w, x)
+    # every element passed all stages: + (1+2+3+4) = +10
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) + 10.0)
+
+
+@pytest.mark.parametrize("pp,dp", [(2, 1), (4, 1), (2, 2)])
+def test_pipeline_matches_single_device(pp, dp):
+    model = _model()
+    x, y = _batch()
+    mesh = build_mesh(dp=dp, tp=1, pp=pp, sp=1,
+                      devices=jax.devices()[:pp * dp])
+    apply_fn, params = build_gpt_pipeline(model, mesh, num_microbatches=2)
+
+    loss_pipe = jax.jit(apply_fn)(params, x, y)
+    with _swap_params(model, param_dict(model)):
+        loss_ref = model.loss(x, y)
+    np.testing.assert_allclose(float(loss_pipe), float(loss_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_grads_match_single_device():
+    model = _model()
+    x, y = _batch()
+    mesh = build_mesh(dp=1, tp=1, pp=2, sp=1, devices=jax.devices()[:2])
+    apply_fn, params = build_gpt_pipeline(model, mesh, num_microbatches=4)
+
+    grads = jax.jit(jax.grad(apply_fn))(params, x, y)
+
+    def ref_loss(flat):
+        with _swap_params(model, flat):
+            return model.loss(x, y)
+
+    ref_grads = jax.grad(ref_loss)(param_dict(model))
+
+    # block-stack grads: compare stage-stacked against per-block refs
+    g = grads["stages"]["attn.q_proj.weight"]          # [pp, per_stage, ...]
+    g = g.reshape(-1, *g.shape[2:])
+    for layer in range(4):
+        np.testing.assert_allclose(
+            np.asarray(g[layer]),
+            np.asarray(ref_grads[f"blocks.{layer}.attn.q_proj.weight"]),
+            rtol=2e-4, atol=1e-6, err_msg=f"layer {layer} dq_proj")
+    np.testing.assert_allclose(
+        np.asarray(grads["emb"]["wte.weight"]),
+        np.asarray(ref_grads["wte.weight"]), rtol=2e-4, atol=1e-6)
+
+
+def test_pipeline_dryrun_entrypoint():
+    loss = pipeline_dryrun(4, devices=jax.devices()[:4])
+    assert np.isfinite(loss)
