@@ -1,0 +1,219 @@
+// Host-resident sparse embedding shard — the native data plane of the
+// parameter-server subsystem (paddle_tpu/distributed/ps.py).
+//
+// TPU-native counterpart of the reference's C++ PS runtime
+// (/root/reference/paddle/fluid/operators/distributed/parameter_send.cc,
+// parameter_recv.cc and the pslib DownpourWorker pull/push path,
+// framework/fleet/fleet_wrapper.cc): rows live in host DRAM keyed by
+// feature id, materialise lazily on first touch, and update in place with
+// the optimizer folded into the push (sgd / adagrad), so the device only
+// ever sees the dense minibatch slice.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image). All bulk
+// ops take raw pointers into caller-owned numpy buffers; striped mutexes
+// give thread safety for concurrent pull/push from server threads.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kStripes = 64;
+
+enum OptType : int { kSGD = 0, kAdagrad = 1 };
+
+struct Shard {
+  int64_t dim;
+  float init_range;
+  uint64_t seed;
+  int opt_type;
+  float lr;
+  float adagrad_eps;
+  // row layout: [dim embedding][dim adagrad accumulators (if adagrad)]
+  int64_t row_width;
+  std::unordered_map<int64_t, std::vector<float>> rows[kStripes];
+  std::mutex locks[kStripes];
+
+  int stripe(int64_t id) const {
+    // splitmix-style scramble so sequential ids spread over stripes
+    uint64_t x = static_cast<uint64_t>(id) * 0x9E3779B97F4A7C15ull;
+    return static_cast<int>((x >> 32) % kStripes);
+  }
+
+  std::vector<float>& row(int64_t id, int s) {
+    auto it = rows[s].find(id);
+    if (it != rows[s].end()) return it->second;
+    // lazy init: uniform(-init_range, init_range), deterministic per id
+    std::vector<float> r(row_width, 0.0f);
+    std::mt19937_64 gen(seed ^ static_cast<uint64_t>(id));
+    std::uniform_real_distribution<float> dist(-init_range, init_range);
+    for (int64_t i = 0; i < dim; ++i) r[i] = dist(gen);
+    return rows[s].emplace(id, std::move(r)).first->second;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ps_create(int64_t dim, float init_range, uint64_t seed, int opt_type,
+                float lr, float adagrad_eps) {
+  auto* sh = new Shard();
+  sh->dim = dim;
+  sh->init_range = init_range;
+  sh->seed = seed;
+  sh->opt_type = opt_type;
+  sh->lr = lr;
+  sh->adagrad_eps = adagrad_eps;
+  sh->row_width = (opt_type == kAdagrad) ? 2 * dim : dim;
+  return sh;
+}
+
+void ps_destroy(void* h) { delete static_cast<Shard*>(h); }
+
+void ps_set_lr(void* h, float lr) { static_cast<Shard*>(h)->lr = lr; }
+
+// out: [n, dim] caller-allocated
+void ps_pull(void* h, const int64_t* ids, int64_t n, float* out) {
+  auto* sh = static_cast<Shard*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = sh->stripe(ids[i]);
+    std::lock_guard<std::mutex> g(sh->locks[s]);
+    const auto& r = sh->row(ids[i], s);
+    std::memcpy(out + i * sh->dim, r.data(), sh->dim * sizeof(float));
+  }
+}
+
+// grads: [n, dim]; duplicate ids accumulate naturally (sequential apply)
+void ps_push(void* h, const int64_t* ids, int64_t n, const float* grads) {
+  auto* sh = static_cast<Shard*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = sh->stripe(ids[i]);
+    std::lock_guard<std::mutex> g(sh->locks[s]);
+    auto& r = sh->row(ids[i], s);
+    const float* gr = grads + i * sh->dim;
+    if (sh->opt_type == kAdagrad) {
+      float* acc = r.data() + sh->dim;
+      for (int64_t d = 0; d < sh->dim; ++d) {
+        acc[d] += gr[d] * gr[d];
+        r[d] -= sh->lr * gr[d] / (std::sqrt(acc[d]) + sh->adagrad_eps);
+      }
+    } else {
+      for (int64_t d = 0; d < sh->dim; ++d) r[d] -= sh->lr * gr[d];
+    }
+  }
+}
+
+// raw row write (checkpoint restore / GEO delta apply)
+void ps_assign(void* h, const int64_t* ids, int64_t n, const float* vals) {
+  auto* sh = static_cast<Shard*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = sh->stripe(ids[i]);
+    std::lock_guard<std::mutex> g(sh->locks[s]);
+    auto& r = sh->row(ids[i], s);
+    std::memcpy(r.data(), vals + i * sh->dim, sh->dim * sizeof(float));
+  }
+}
+
+int64_t ps_size(void* h) {
+  auto* sh = static_cast<Shard*>(h);
+  int64_t total = 0;
+  for (int s = 0; s < kStripes; ++s) {
+    std::lock_guard<std::mutex> g(sh->locks[s]);
+    total += static_cast<int64_t>(sh->rows[s].size());
+  }
+  return total;
+}
+
+// export all (id, row) pairs; ids/vals caller-allocated with ps_size rows.
+// Returns number written (may be < capacity if table shrank concurrently).
+int64_t ps_export(void* h, int64_t* ids, float* vals, int64_t capacity) {
+  auto* sh = static_cast<Shard*>(h);
+  int64_t i = 0;
+  for (int s = 0; s < kStripes && i < capacity; ++s) {
+    std::lock_guard<std::mutex> g(sh->locks[s]);
+    for (const auto& kv : sh->rows[s]) {
+      if (i >= capacity) break;
+      ids[i] = kv.first;
+      std::memcpy(vals + i * sh->dim, kv.second.data(),
+                  sh->dim * sizeof(float));
+      ++i;
+    }
+  }
+  return i;
+}
+
+// ---------------------------------------------------------------------
+// MultiSlot text parser (reference: framework/data_feed.cc
+// MultiSlotDataFeed::ParseOneInstance) — line format per instance:
+//   <num_1> v v v <num_2> v v ...   (one group per slot, space-separated)
+// Dense floats and sparse int64 ids share the format; the caller passes
+// a slot-type mask. Parses a whole text buffer into flat value arrays
+// with per-(instance,slot) offsets, GIL-free.
+// ---------------------------------------------------------------------
+
+static inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+// returns #instances parsed, or -1 on malformed input.
+// counts: [max_groups] value count per slot-group, groups ordered
+//   (instance0 slot0..slotN-1, instance1 slot0.., ...); the caller
+//   rebuilds per-type offsets by walking groups with two cursors
+// int_vals / float_vals: capacity-bounded output buffers
+int64_t ps_parse_multislot(const char* buf, int64_t len, int num_slots,
+                           const uint8_t* slot_is_float,
+                           int64_t* counts, int64_t max_groups,
+                           int64_t* int_vals, int64_t int_cap,
+                           float* float_vals, int64_t float_cap) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t group = 0;
+  int64_t n_int = 0, n_float = 0;
+  int64_t instances = 0;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (!line_end) line_end = end;
+    if (line_end > p) {  // skip blank lines
+      for (int slot = 0; slot < num_slots; ++slot) {
+        p = skip_ws(p, line_end);
+        if (p >= line_end) return -1;
+        char* next = nullptr;
+        long cnt = strtol(p, &next, 10);
+        if (next == p || cnt < 0) return -1;
+        p = next;
+        if (group >= max_groups) return -1;
+        bool is_f = slot_is_float[slot] != 0;
+        for (long i = 0; i < cnt; ++i) {
+          p = skip_ws(p, line_end);
+          if (p >= line_end) return -1;
+          if (is_f) {
+            if (n_float >= float_cap) return -1;
+            float_vals[n_float++] = strtof(p, &next);
+          } else {
+            if (n_int >= int_cap) return -1;
+            int_vals[n_int++] = strtoll(p, &next, 10);
+          }
+          if (next == p) return -1;
+          p = next;
+        }
+        counts[group] = cnt;
+        ++group;
+      }
+      ++instances;
+    }
+    p = line_end + 1;
+  }
+  return instances;
+}
+
+}  // extern "C"

@@ -22,6 +22,9 @@ from .sharded import (
 )
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import gpipe, build_gpt_pipeline
+from .ps import (
+    SparseEmbedding, Communicator, PSServer, PSClient, HeartBeatMonitor,
+)
 
 __all__ = [
     "collective", "mesh", "fleet",
@@ -35,4 +38,6 @@ __all__ = [
     "make_sharded_train_step",
     "ring_attention", "ring_attention_sharded",
     "gpipe", "build_gpt_pipeline",
+    "SparseEmbedding", "Communicator", "PSServer", "PSClient",
+    "HeartBeatMonitor",
 ]

@@ -20,7 +20,6 @@ tensor parallelism composes by NamedSharding on the stacked weights'
 trailing dims as usual.
 """
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -108,6 +107,13 @@ def build_gpt_pipeline(model, mesh, num_microbatches, axis_name="pp"):
     """
     from ..nn.layers import functional_call, param_dict
 
+    if getattr(model.cfg, "dropout", 0.0):
+        # functional_call would bake a single trace-time dropout mask into
+        # the compiled scan — silently wrong training numerics
+        raise ValueError(
+            "build_gpt_pipeline requires dropout=0.0 (per-step RNG "
+            "threading through the pipeline schedule is not supported)")
+
     n_stages = mesh.shape[axis_name]
     blocks = list(model.blocks)
     assert len(blocks) % n_stages == 0, (
@@ -135,8 +141,7 @@ def build_gpt_pipeline(model, mesh, num_microbatches, axis_name="pp"):
         return h
 
     pipe = gpipe(stage_fn, mesh, num_microbatches, axis_name=axis_name)
-
-    cfg = model.cfg
+    max_seq = model.cfg.max_seq_len
 
     def apply_fn(params, input_ids, labels):
         from ..nn import functional as F
@@ -144,14 +149,14 @@ def build_gpt_pipeline(model, mesh, num_microbatches, axis_name="pp"):
         wte = params["emb"]["wte.weight"]
         wpe = params["emb"]["wpe.weight"]
         seq = input_ids.shape[1]
+        if seq > max_seq:
+            raise ValueError(
+                f"sequence length {seq} exceeds max_seq_len {max_seq}")
         pos = jnp.arange(seq, dtype=jnp.int32)[None, :]
         h = jnp.take(wte, input_ids, axis=0) + jnp.take(wpe, pos, axis=0)
         h = pipe(params["stages"], h)
-        g = params["head"]["norm_f.weight"]
-        b = params["head"]["norm_f.bias"]
-        mu = h.mean(-1, keepdims=True)
-        var = ((h - mu) ** 2).mean(-1, keepdims=True)
-        h = (h - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+        h = F.layer_norm(h, weight=params["head"]["norm_f.weight"],
+                         bias=params["head"]["norm_f.bias"])
         logits = jnp.einsum("bsh,vh->bsv", h, wte)
         logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]

@@ -1,0 +1,420 @@
+"""Parameter-server-style sparse embedding subsystem.
+
+TPU-native reshape of the reference's PS stack
+(/root/reference/paddle/fluid/operators/distributed/: RPCClient/RPCServer,
+Communicator modes, parameter_{send,recv,prefetch}.cc; plus the pslib
+DownpourWorker pull→compute→push loop, framework/device_worker.h:203):
+
+- Giant embedding tables are anti-XLA (dynamic shapes, sparse updates),
+  so they live HOST-side in native C++ shards (csrc/ps_shard.cpp via
+  paddle_tpu.native) with the optimizer folded into push. The device
+  program only ever sees the dense [batch, dim] slice — the same split
+  Downpour uses (pull_sparse → dense ops → push_sparse).
+- `Communicator` reproduces the reference's send modes
+  (operators/distributed/communicator.h:176): SYNC pushes inline,
+  ASYNC/HALF_ASYNC batch pushes on a background thread, GEO accumulates
+  locally and ships deltas every k steps.
+- `PSServer`/`PSClient` are the control-plane service (listen_and_serv
+  parity) as a length-prefixed TCP protocol for multi-host; in-process
+  tables skip the network entirely.
+"""
+
+import pickle
+import queue
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["SparseEmbedding", "Communicator", "PSServer", "PSClient",
+           "HeartBeatMonitor"]
+
+
+def _scramble(ids):
+    # same splitmix-style mix as the native shard so routing spreads
+    # sequential feature ids uniformly
+    x = ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    return (x >> np.uint64(32)).astype(np.int64)
+
+
+class _PyShard:
+    """Pure-python fallback with the NativeShard interface."""
+
+    def __init__(self, dim, init_range=0.05, seed=0, optimizer="adagrad",
+                 lr=0.05, adagrad_eps=1e-6):
+        self.dim = dim
+        self.init_range = init_range
+        self.seed = seed
+        self.opt = optimizer
+        self.lr = lr
+        self.eps = adagrad_eps
+        self.rows = {}
+        self.accs = {}
+
+    def _row(self, i):
+        r = self.rows.get(i)
+        if r is None:
+            rng = np.random.default_rng(self.seed ^ (i & 0x7FFFFFFF))
+            r = rng.uniform(-self.init_range, self.init_range,
+                            self.dim).astype(np.float32)
+            self.rows[i] = r
+            if self.opt == "adagrad":
+                self.accs[i] = np.zeros(self.dim, np.float32)
+        return r
+
+    def set_lr(self, lr):
+        self.lr = lr
+
+    def pull(self, ids):
+        return np.stack([self._row(int(i)) for i in ids]) if len(ids) \
+            else np.zeros((0, self.dim), np.float32)
+
+    def push(self, ids, grads):
+        for i, g in zip(ids, np.asarray(grads, np.float32)):
+            i = int(i)
+            r = self._row(i)
+            if self.opt == "adagrad":
+                acc = self.accs[i]
+                acc += g * g
+                r -= self.lr * g / (np.sqrt(acc) + self.eps)
+            else:
+                r -= self.lr * g
+
+    def assign(self, ids, vals):
+        for i, v in zip(ids, np.asarray(vals, np.float32)):
+            self._row(int(i))[:] = v
+
+    def __len__(self):
+        return len(self.rows)
+
+    def export(self):
+        ids = np.fromiter(self.rows.keys(), dtype=np.int64,
+                          count=len(self.rows))
+        vals = (np.stack([self.rows[int(i)] for i in ids])
+                if len(ids) else np.zeros((0, self.dim), np.float32))
+        return ids, vals
+
+
+def _make_shard(dim, **kw):
+    from .. import native
+
+    if native.available():
+        return native.NativeShard(dim, **kw)
+    return _PyShard(dim, **kw)
+
+
+class SparseEmbedding:
+    """N-way sharded host-resident embedding table.
+
+    Parity surface: distributed_lookup_table_op + parameter_prefetch.cc
+    (slice ids by shard, fetch, re-gather in input order).
+    """
+
+    def __init__(self, dim, num_shards=4, optimizer="adagrad", lr=0.05,
+                 init_range=0.05, seed=0, clients=None):
+        self.dim = dim
+        if clients is not None:          # remote mode: one client per shard
+            self.shards = clients
+        else:
+            self.shards = [
+                _make_shard(dim, init_range=init_range, seed=seed + i,
+                            optimizer=optimizer, lr=lr)
+                for i in range(num_shards)
+            ]
+        self.n = len(self.shards)
+
+    def _route(self, ids):
+        flat = np.ascontiguousarray(ids, dtype=np.int64).ravel()
+        shard_of = _scramble(flat) % self.n
+        return flat, shard_of
+
+    def pull(self, ids):
+        """ids: int array any shape -> [*shape, dim] float32."""
+        ids = np.asarray(ids)
+        flat, shard_of = self._route(ids)
+        out = np.empty((flat.size, self.dim), np.float32)
+        for s in range(self.n):
+            m = shard_of == s
+            if m.any():
+                out[m] = self.shards[s].pull(flat[m])
+        return out.reshape(*ids.shape, self.dim)
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids)
+        flat, shard_of = self._route(ids)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            flat.size, self.dim)
+        for s in range(self.n):
+            m = shard_of == s
+            if m.any():
+                self.shards[s].push(flat[m], grads[m])
+
+    def set_lr(self, lr):
+        for s in self.shards:
+            s.set_lr(lr)
+
+    def __len__(self):
+        return sum(len(s) for s in self.shards)
+
+    def state_dict(self):
+        ids, vals = [], []
+        for s in self.shards:
+            i, v = s.export()
+            ids.append(i)
+            vals.append(v)
+        return {"ids": np.concatenate(ids) if ids else np.zeros(0, np.int64),
+                "values": np.concatenate(vals) if vals
+                else np.zeros((0, self.dim), np.float32)}
+
+    def load_state_dict(self, state):
+        ids = np.asarray(state["ids"], np.int64)
+        vals = np.asarray(state["values"], np.float32)
+        flat, shard_of = self._route(ids)
+        for s in range(self.n):
+            m = shard_of == s
+            if m.any():
+                self.shards[s].assign(flat[m], vals[m])
+
+
+class Communicator:
+    """Batched gradient push with the reference's mode taxonomy
+    (communicator.h:176 AsyncCommunicator/HalfAsync/Sync/GeoSgd).
+
+    sync: push() forwards immediately.
+    async/half_async: pushes queue to a background thread; half_async's
+      barrier() drains the queue (the reference's batch-barrier).
+    geo: local delta accumulation, shipped every `geo_steps` steps
+      (GeoSgdCommunicator delta-sync).
+    """
+
+    def __init__(self, table, mode="async", geo_steps=10, max_merge=20):
+        assert mode in ("sync", "async", "half_async", "geo")
+        self.table = table
+        self.mode = mode
+        self.geo_steps = geo_steps
+        self.max_merge = max_merge
+        self._q = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = None
+        self._geo_acc = {}
+        self._step = 0
+        if mode in ("async", "half_async"):
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+
+    def _worker(self):
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                ids, grads = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            # merge a burst of pending pushes into one table update
+            batch = [(ids, grads)]
+            for _ in range(self.max_merge):
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            all_ids = np.concatenate([b[0] for b in batch])
+            all_grads = np.concatenate([b[1] for b in batch])
+            self.table.push(all_ids, all_grads)
+            for _ in batch:
+                self._q.task_done()
+
+    def push(self, ids, grads):
+        ids = np.ascontiguousarray(np.asarray(ids).ravel(), np.int64)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            ids.size, self.table.dim)
+        if self.mode == "sync":
+            self.table.push(ids, grads)
+        elif self.mode in ("async", "half_async"):
+            self._q.put((ids, grads))
+        else:  # geo: accumulate deltas locally
+            for i, g in zip(ids, grads):
+                i = int(i)
+                if i in self._geo_acc:
+                    self._geo_acc[i] = self._geo_acc[i] + g
+                else:
+                    self._geo_acc[i] = g.copy()
+            self._step += 1
+            if self._step % self.geo_steps == 0:
+                self._flush_geo()
+
+    def _flush_geo(self):
+        if not self._geo_acc:
+            return
+        ids = np.fromiter(self._geo_acc.keys(), np.int64,
+                          len(self._geo_acc))
+        grads = np.stack([self._geo_acc[int(i)] for i in ids])
+        self.table.push(ids, grads)
+        self._geo_acc.clear()
+
+    def barrier(self):
+        """Drain pending pushes (half-async batch barrier)."""
+        if self.mode == "geo":
+            self._flush_geo()
+        elif self._thread is not None:
+            self._q.join()
+
+    def stop(self):
+        if self._thread is not None:
+            self._q.join()
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# --------------------------------------------------------------------------
+# TCP control plane (listen_and_serv parity)
+# --------------------------------------------------------------------------
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class PSServer:
+    """One embedding shard behind a TCP endpoint (localhost clusters /
+    trusted DCN only — the wire format is pickle, same trust model as the
+    reference's in-cluster gRPC)."""
+
+    def __init__(self, dim, port=0, host="127.0.0.1", **shard_kw):
+        self.shard = _make_shard(dim, **shard_kw)
+        self.heartbeats = {}
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        msg = _recv_msg(self.request)
+                    except (ConnectionError, EOFError):
+                        return
+                    op = msg["op"]
+                    if op == "pull":
+                        _send_msg(self.request,
+                                  outer.shard.pull(msg["ids"]))
+                    elif op == "push":
+                        outer.shard.push(msg["ids"], msg["grads"])
+                        _send_msg(self.request, b"ok")
+                    elif op == "assign":
+                        outer.shard.assign(msg["ids"], msg["vals"])
+                        _send_msg(self.request, b"ok")
+                    elif op == "export":
+                        _send_msg(self.request, outer.shard.export())
+                    elif op == "set_lr":
+                        outer.shard.set_lr(msg["lr"])
+                        _send_msg(self.request, b"ok")
+                    elif op == "heartbeat":
+                        outer.heartbeats[msg["worker"]] = time.time()
+                        _send_msg(self.request, b"ok")
+                    elif op == "size":
+                        _send_msg(self.request, len(outer.shard))
+                    elif op == "shutdown":
+                        _send_msg(self.request, b"ok")
+                        threading.Thread(
+                            target=outer.server.shutdown).start()
+                        return
+                    else:
+                        _send_msg(self.request,
+                                  {"error": f"unknown op {op}"})
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Srv((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class PSClient:
+    """Shard-interface proxy over one PSServer connection."""
+
+    def __init__(self, host, port, dim):
+        self.dim = dim
+        self._sock = socket.create_connection((host, port))
+        self._lock = threading.Lock()
+
+    def _call(self, **msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            return _recv_msg(self._sock)
+
+    def pull(self, ids):
+        return self._call(op="pull", ids=np.asarray(ids, np.int64))
+
+    def push(self, ids, grads):
+        self._call(op="push", ids=np.asarray(ids, np.int64),
+                   grads=np.asarray(grads, np.float32))
+
+    def assign(self, ids, vals):
+        self._call(op="assign", ids=np.asarray(ids, np.int64),
+                   vals=np.asarray(vals, np.float32))
+
+    def export(self):
+        return self._call(op="export")
+
+    def set_lr(self, lr):
+        self._call(op="set_lr", lr=float(lr))
+
+    def heartbeat(self, worker_id):
+        self._call(op="heartbeat", worker=worker_id)
+
+    def __len__(self):
+        return int(self._call(op="size"))
+
+    def shutdown_server(self):
+        self._call(op="shutdown")
+
+    def close(self):
+        self._sock.close()
+
+
+class HeartBeatMonitor:
+    """Worker-liveness watchdog (heart_beat_monitor.h:70 parity): workers
+    ping; stale workers are reported dead after `timeout` seconds."""
+
+    def __init__(self, timeout=60.0):
+        self.timeout = timeout
+        self._beats = {}
+        self._lock = threading.Lock()
+
+    def beat(self, worker_id):
+        with self._lock:
+            self._beats[worker_id] = time.time()
+
+    def dead_workers(self, now=None):
+        now = now if now is not None else time.time()
+        with self._lock:
+            return [w for w, t in self._beats.items()
+                    if now - t > self.timeout]

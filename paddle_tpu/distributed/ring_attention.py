@@ -73,34 +73,45 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, _):
-        k_cur, v_cur, kv_idx, m_acc, l_acc, o_acc = carry
-        o_i, m_i, l_i = block(q, k_cur, v_cur,
-                              q_start=q_start, k_start=kv_idx * s_loc)
+    def combine(acc, o_i, m_i, l_i):
+        m_acc, l_acc, o_acc = acc
         m_new = jnp.maximum(m_acc, m_i)
         # all-masked blocks have m_i = -inf -> beta = 0 -> no contribution
         alpha = jnp.exp(m_acc - m_new)
         beta = jnp.exp(m_i - m_new)
         l_new = l_acc * alpha + l_i * beta
         o_new = o_acc * alpha[..., None] + o_i * beta[..., None]
-        # rotate kv shards one hop around the ring (ICI neighbor DMA)
-        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        kv_next = (kv_idx - 1) % n
-        return (k_next, v_next, kv_next, m_new, l_new, o_new), None
+        return m_new, l_new, o_new
 
-    b, h, sq, d = q.shape
-    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sq), jnp.float32)
-    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
-    carry0 = (k, v, idx, m0, l0, o0)
-    (kf, vf, _, m, l, o), _ = jax.lax.scan(step, carry0, None, length=n)
+    def step(carry, _):
+        k_cur, v_cur, kv_idx, acc = carry
+        # rotate kv shards one hop around the ring (ICI neighbor DMA),
+        # then fold in the newly-arrived block
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        kv_idx = (kv_idx - 1) % n
+        o_i, m_i, l_i = block(q, k_cur, v_cur,
+                              q_start=q_start, k_start=kv_idx * s_loc)
+        acc = combine(acc, o_i, m_i, l_i)
+        return (k_cur, v_cur, kv_idx, acc), None
+
+    # local block first, then n-1 rotate+combine steps (no wasted final hop)
+    acc0 = combine(
+        (jnp.full(q.shape[:3], NEG_INF, jnp.float32),
+         jnp.zeros(q.shape[:3], jnp.float32),
+         jnp.zeros(q.shape, jnp.float32)),
+        *block(q, k, v, q_start=q_start, k_start=idx * s_loc))
+    carry0 = (k, v, idx, acc0)
+    (_, _, _, (m, l, o)), _ = jax.lax.scan(step, carry0, None, length=n - 1)
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     return (o / l_safe[..., None]).astype(q.dtype)
 
 
-@functools.lru_cache(maxsize=64)
+# small bounded cache: each entry pins its Mesh + compiled executables
+# for the process lifetime, so cap it rather than let re-meshing
+# workloads accumulate closures
+@functools.lru_cache(maxsize=8)
 def _sharded_ring_fn(mesh, axis_name, causal, sm_scale):
     spec = P(None, None, axis_name, None)
     fn = jax.shard_map(

@@ -1,0 +1,162 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's host-side runtime is C++ (executors, PS, data feed); the
+TPU rebuild keeps the device path in XLA/Pallas and implements the
+host-side data plane natively here: sparse-embedding shards and the
+MultiSlot text parser live in csrc/ps_shard.cpp, compiled on first use
+(g++ -O3 -shared) and bound through ctypes — pybind11 is deliberately
+not a dependency.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "csrc", "ps_shard.cpp")
+_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "libps_shard.so")
+
+_lib = None
+_lock = threading.Lock()
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        c = ctypes
+        lib.ps_create.restype = c.c_void_p
+        lib.ps_create.argtypes = [c.c_int64, c.c_float, c.c_uint64,
+                                  c.c_int, c.c_float, c.c_float]
+        lib.ps_destroy.argtypes = [c.c_void_p]
+        lib.ps_set_lr.argtypes = [c.c_void_p, c.c_float]
+        lib.ps_pull.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                c.c_void_p]
+        lib.ps_push.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                c.c_void_p]
+        lib.ps_assign.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                  c.c_void_p]
+        lib.ps_size.restype = c.c_int64
+        lib.ps_size.argtypes = [c.c_void_p]
+        lib.ps_export.restype = c.c_int64
+        lib.ps_export.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                  c.c_int64]
+        lib.ps_parse_multislot.restype = c.c_int64
+        lib.ps_parse_multislot.argtypes = [
+            c.c_char_p, c.c_int64, c.c_int, c.c_void_p, c.c_void_p,
+            c.c_int64, c.c_void_p, c.c_int64, c.c_void_p, c.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available():
+    return load() is not None
+
+
+class NativeShard:
+    """ctypes wrapper over one C++ embedding shard."""
+
+    OPT = {"sgd": 0, "adagrad": 1}
+
+    def __init__(self, dim, init_range=0.05, seed=0, optimizer="adagrad",
+                 lr=0.05, adagrad_eps=1e-6):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native ps_shard library unavailable")
+        self._lib = lib
+        self.dim = int(dim)
+        self._h = lib.ps_create(self.dim, float(init_range), int(seed),
+                                self.OPT[optimizer], float(lr),
+                                float(adagrad_eps))
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.ps_destroy(h)
+            self._h = None
+
+    def set_lr(self, lr):
+        self._lib.ps_set_lr(self._h, float(lr))
+
+    def pull(self, ids):
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        out = np.empty((len(ids), self.dim), dtype=np.float32)
+        self._lib.ps_pull(self._h, ids.ctypes.data, len(ids),
+                          out.ctypes.data)
+        return out
+
+    def push(self, ids, grads):
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        assert grads.shape == (len(ids), self.dim)
+        self._lib.ps_push(self._h, ids.ctypes.data, len(ids),
+                          grads.ctypes.data)
+
+    def assign(self, ids, vals):
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        vals = np.ascontiguousarray(vals, dtype=np.float32)
+        self._lib.ps_assign(self._h, ids.ctypes.data, len(ids),
+                            vals.ctypes.data)
+
+    def __len__(self):
+        return int(self._lib.ps_size(self._h))
+
+    def export(self):
+        n = len(self)
+        ids = np.empty(n, dtype=np.int64)
+        vals = np.empty((n, self.dim), dtype=np.float32)
+        written = self._lib.ps_export(self._h, ids.ctypes.data,
+                                      vals.ctypes.data, n)
+        return ids[:written], vals[:written]
+
+
+def parse_multislot(text, slot_types, max_values_per_slot=1024):
+    """Parse MultiSlot lines (data_feed.cc format) with the native parser.
+
+    text: str/bytes of newline-separated instances; slot_types: sequence
+    of "float"/"int64" per slot. Returns (counts [n_inst, n_slots],
+    int_values flat, float_values flat).
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native ps_shard library unavailable")
+    if isinstance(text, str):
+        text = text.encode()
+    n_slots = len(slot_types)
+    is_float = np.array([1 if t == "float" else 0 for t in slot_types],
+                        dtype=np.uint8)
+    n_lines = max(1, text.count(b"\n") + 1)
+    max_groups = n_lines * n_slots
+    counts = np.zeros(max_groups, dtype=np.int64)
+    cap = n_lines * n_slots * max_values_per_slot
+    int_vals = np.empty(cap, dtype=np.int64)
+    float_vals = np.empty(cap, dtype=np.float32)
+    n = lib.ps_parse_multislot(
+        text, len(text), n_slots, is_float.ctypes.data, counts.ctypes.data,
+        max_groups, int_vals.ctypes.data, cap, float_vals.ctypes.data, cap)
+    if n < 0:
+        raise ValueError("malformed MultiSlot input")
+    counts = counts[: n * n_slots].reshape(n, n_slots)
+    n_int = int(counts[:, is_float == 0].sum()) if n else 0
+    n_float = int(counts[:, is_float == 1].sum()) if n else 0
+    return counts, int_vals[:n_int].copy(), float_vals[:n_float].copy()
