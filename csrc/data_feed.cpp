@@ -1,0 +1,260 @@
+// Native data-feed pipeline: N reader threads parse MultiSlot text files
+// into fixed-layout batches pushed through a bounded blocking queue.
+//
+// TPU-native counterpart of the reference's reader stack
+// (/root/reference/paddle/fluid/operators/reader/blocking_queue.h,
+// buffered_reader.cc and framework/data_feed.cc MultiSlotDataFeed):
+// parsing happens off the Python thread with the GIL released (ctypes
+// releases it around foreign calls), and the consumer pops ready numpy
+// batches — the host-side half of the input pipeline; device prefetch is
+// jax.device_put on the Python side.
+//
+// Batch layout (caller allocates):
+//   counts:    [batch, num_slots] int64 — real value count per group
+//   int_out:   [batch, total_int_width]   padded (width = sum of
+//              slot_max over int slots, per-slot segments in order)
+//   float_out: [batch, total_float_width] padded likewise
+// reader_next returns the number of instances in the batch, 0 at end of
+// data, -1 on parse error.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" int64_t ps_parse_multislot(const char* buf, int64_t len,
+                                      int num_slots,
+                                      const uint8_t* slot_is_float,
+                                      int64_t* counts, int64_t max_groups,
+                                      int64_t* int_vals, int64_t int_cap,
+                                      float* float_vals, int64_t float_cap);
+
+namespace {
+
+struct Batch {
+  int64_t n = 0;
+  std::vector<int64_t> counts;   // [n, num_slots]
+  std::vector<int64_t> ints;     // [n, int_width]
+  std::vector<float> floats;     // [n, float_width]
+};
+
+struct Reader {
+  std::vector<std::string> files;
+  std::vector<uint8_t> slot_is_float;
+  std::vector<int64_t> slot_max;
+  int num_slots;
+  int batch_size;
+  int queue_cap;
+  int64_t int_width = 0, float_width = 0;
+  bool error = false;
+
+  std::deque<Batch> queue;
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  std::atomic<size_t> next_file{0};
+  std::atomic<int> live_workers{0};
+  std::vector<std::thread> threads;
+  bool done = false;
+
+  void push_instance(Batch& b, const int64_t* counts,
+                     const int64_t* ints, const float* floats);
+  bool enqueue(Batch&& b);        // false if shutting down
+  void worker();
+  void finish_worker(Batch& partial);
+};
+
+void Reader::push_instance(Batch& dst, const int64_t* cnts,
+                           const int64_t* ints, const float* floats) {
+  // stored counts are clamped to the padded width so row[:count] never
+  // reads padding as data when a slot overflows slot_max
+  for (int s = 0; s < num_slots; ++s)
+    dst.counts.push_back(cnts[s] < slot_max[s] ? cnts[s] : slot_max[s]);
+  int64_t int_off = dst.ints.size();
+  int64_t float_off = dst.floats.size();
+  dst.ints.resize(int_off + int_width, 0);
+  dst.floats.resize(float_off + float_width, 0.0f);
+  const int64_t* ip = ints;
+  const float* fp = floats;
+  int64_t iw = 0, fw = 0;
+  for (int s = 0; s < num_slots; ++s) {
+    int64_t c = cnts[s];
+    if (slot_is_float[s]) {
+      int64_t take = c < slot_max[s] ? c : slot_max[s];
+      std::memcpy(dst.floats.data() + float_off + fw, fp,
+                  take * sizeof(float));
+      fp += c;
+      fw += slot_max[s];
+    } else {
+      int64_t take = c < slot_max[s] ? c : slot_max[s];
+      std::memcpy(dst.ints.data() + int_off + iw, ip,
+                  take * sizeof(int64_t));
+      ip += c;
+      iw += slot_max[s];
+    }
+  }
+  dst.n += 1;
+}
+
+void Reader::worker() {
+  std::vector<char> buf;
+  Batch local;
+  for (;;) {
+    size_t fi = next_file.fetch_add(1);
+    if (fi >= files.size()) break;
+    FILE* f = std::fopen(files[fi].c_str(), "rb");
+    if (!f) {
+      { std::lock_guard<std::mutex> g(mu); error = true; }
+      not_empty.notify_all();
+      break;
+    }
+    std::fseek(f, 0, SEEK_END);
+    long sz = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    // +1 for NUL terminator: strto* in the parser must not scan past
+    // the allocation on files without a trailing newline
+    buf.resize(sz + 1);
+    buf[sz] = '\0';
+    size_t rd = sz ? std::fread(buf.data(), 1, sz, f) : 0;
+    std::fclose(f);
+    if ((long)rd != sz) {
+      { std::lock_guard<std::mutex> g(mu); error = true; }
+      not_empty.notify_all();
+      break;
+    }
+
+    // parse whole file, then append instances to the shared partial batch
+    int64_t n_lines = 1;
+    for (char c : buf)
+      if (c == '\n') ++n_lines;
+    int64_t max_groups = n_lines * num_slots;
+    std::vector<int64_t> counts(max_groups);
+    // every parsed value consumes >= 2 bytes of input ("v "), so the
+    // file size bounds the value count — no per-slot guess needed
+    int64_t cap = sz / 2 + 16;
+    std::vector<int64_t> ivals(cap);
+    std::vector<float> fvals(cap);
+    int64_t n = ps_parse_multislot(buf.data(), sz, num_slots,
+                                   slot_is_float.data(), counts.data(),
+                                   max_groups, ivals.data(), cap,
+                                   fvals.data(), cap);
+    if (n < 0) {
+      { std::lock_guard<std::mutex> g(mu); error = true; }
+      not_empty.notify_all();
+      break;
+    }
+
+    const int64_t* ip = ivals.data();
+    const float* fp = fvals.data();
+    for (int64_t inst = 0; inst < n; ++inst) {
+      const int64_t* cnts = counts.data() + inst * num_slots;
+      push_instance(local, cnts, ip, fp);
+      for (int s = 0; s < num_slots; ++s) {
+        if (slot_is_float[s]) fp += cnts[s];
+        else ip += cnts[s];
+      }
+      if (local.n >= batch_size) {
+        if (!enqueue(std::move(local))) return;
+        local = Batch();
+      }
+    }
+  }
+  finish_worker(local);
+}
+
+// blocks while the queue is full; returns false if shutting down
+bool Reader::enqueue(Batch&& b) {
+  std::unique_lock<std::mutex> lk(mu);
+  not_full.wait(lk, [&] {
+    return (int)queue.size() < queue_cap || done;
+  });
+  if (done) return false;
+  queue.push_back(std::move(b));
+  not_empty.notify_one();
+  return true;
+}
+
+void Reader::finish_worker(Batch& partial) {
+  // each worker flushes its own tail batch (<= batch_size instances);
+  // the last worker out marks the stream done
+  if (partial.n > 0) enqueue(std::move(partial));
+  if (live_workers.fetch_sub(1) == 1) {
+    std::lock_guard<std::mutex> g(mu);
+    done = true;
+    not_empty.notify_all();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* reader_create(const char** files, int n_files, int num_slots,
+                    const uint8_t* slot_is_float, const int64_t* slot_max,
+                    int batch_size, int n_threads, int queue_cap) {
+  auto* r = new Reader();
+  for (int i = 0; i < n_files; ++i) r->files.emplace_back(files[i]);
+  r->slot_is_float.assign(slot_is_float, slot_is_float + num_slots);
+  r->slot_max.assign(slot_max, slot_max + num_slots);
+  r->num_slots = num_slots;
+  r->batch_size = batch_size;
+  r->queue_cap = queue_cap > 0 ? queue_cap : 8;
+  for (int s = 0; s < num_slots; ++s) {
+    if (slot_is_float[s]) r->float_width += slot_max[s];
+    else r->int_width += slot_max[s];
+  }
+  int nt = n_threads > 0 ? n_threads : 1;
+  r->live_workers = nt;
+  for (int t = 0; t < nt; ++t)
+    r->threads.emplace_back(&Reader::worker, r);
+  return r;
+}
+
+int64_t reader_int_width(void* h) {
+  return static_cast<Reader*>(h)->int_width;
+}
+int64_t reader_float_width(void* h) {
+  return static_cast<Reader*>(h)->float_width;
+}
+
+// blocks; returns batch size, 0 on end, -1 on error
+int64_t reader_next(void* h, int64_t* counts_out, int64_t* int_out,
+                    float* float_out) {
+  auto* r = static_cast<Reader*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  r->not_empty.wait(lk, [&] {
+    return !r->queue.empty() || r->done || r->error;
+  });
+  if (r->error) return -1;
+  if (r->queue.empty()) return 0;
+  Batch b = std::move(r->queue.front());
+  r->queue.pop_front();
+  r->not_full.notify_one();
+  lk.unlock();
+  std::memcpy(counts_out, b.counts.data(),
+              b.counts.size() * sizeof(int64_t));
+  if (!b.ints.empty())
+    std::memcpy(int_out, b.ints.data(), b.ints.size() * sizeof(int64_t));
+  if (!b.floats.empty())
+    std::memcpy(float_out, b.floats.data(), b.floats.size() * sizeof(float));
+  return b.n;
+}
+
+void reader_destroy(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  {
+    std::lock_guard<std::mutex> g(r->mu);
+    r->done = true;
+  }
+  r->not_full.notify_all();
+  r->not_empty.notify_all();
+  for (auto& t : r->threads) t.join();
+  delete r;
+}
+
+}  // extern "C"

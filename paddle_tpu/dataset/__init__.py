@@ -8,3 +8,4 @@ structure, not real data.
 """
 
 from . import mnist, uci_housing  # noqa: F401
+from .multislot import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401

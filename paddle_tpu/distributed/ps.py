@@ -199,6 +199,7 @@ class Communicator:
         self._q = queue.Queue()
         self._stop = threading.Event()
         self._thread = None
+        self._error = None
         self._geo_acc = {}
         self._step = 0
         if mode in ("async", "half_async"):
@@ -218,13 +219,24 @@ class Communicator:
                     batch.append(self._q.get_nowait())
                 except queue.Empty:
                     break
-            all_ids = np.concatenate([b[0] for b in batch])
-            all_grads = np.concatenate([b[1] for b in batch])
-            self.table.push(all_ids, all_grads)
-            for _ in batch:
-                self._q.task_done()
+            try:
+                all_ids = np.concatenate([b[0] for b in batch])
+                all_grads = np.concatenate([b[1] for b in batch])
+                self.table.push(all_ids, all_grads)
+            except Exception as e:  # surface at the next push/barrier;
+                self._error = e     # task_done must still run or join()
+                self._stop.set()    # deadlocks
+            finally:
+                for _ in batch:
+                    self._q.task_done()
+
+    def _check_error(self):
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise RuntimeError("communicator background push failed") from e
 
     def push(self, ids, grads):
+        self._check_error()
         ids = np.ascontiguousarray(np.asarray(ids).ravel(), np.int64)
         grads = np.ascontiguousarray(grads, np.float32).reshape(
             ids.size, self.table.dim)
@@ -258,6 +270,7 @@ class Communicator:
             self._flush_geo()
         elif self._thread is not None:
             self._q.join()
+        self._check_error()
 
     def stop(self):
         if self._thread is not None:
@@ -298,9 +311,10 @@ class PSServer:
     trusted DCN only — the wire format is pickle, same trust model as the
     reference's in-cluster gRPC)."""
 
-    def __init__(self, dim, port=0, host="127.0.0.1", **shard_kw):
+    def __init__(self, dim, port=0, host="127.0.0.1",
+                 heartbeat_timeout=60.0, **shard_kw):
         self.shard = _make_shard(dim, **shard_kw)
-        self.heartbeats = {}
+        self.monitor = HeartBeatMonitor(timeout=heartbeat_timeout)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -326,8 +340,10 @@ class PSServer:
                         outer.shard.set_lr(msg["lr"])
                         _send_msg(self.request, b"ok")
                     elif op == "heartbeat":
-                        outer.heartbeats[msg["worker"]] = time.time()
+                        outer.monitor.beat(msg["worker"])
                         _send_msg(self.request, b"ok")
+                    elif op == "dead_workers":
+                        _send_msg(self.request, outer.monitor.dead_workers())
                     elif op == "size":
                         _send_msg(self.request, len(outer.shard))
                     elif op == "shutdown":

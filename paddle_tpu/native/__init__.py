@@ -17,17 +17,18 @@ import numpy as np
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO, "csrc", "ps_shard.cpp")
+_SRCS = [os.path.join(_REPO, "csrc", f)
+         for f in ("ps_shard.cpp", "data_feed.cpp")]
 _SO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "libps_shard.so")
+                   "libpaddle_tpu_native.so")
 
 _lib = None
 _lock = threading.Lock()
 
 
 def _build():
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", _SO, _SRC]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           "-o", _SO] + _SRCS
     subprocess.run(cmd, check=True, capture_output=True)
 
 
@@ -39,7 +40,8 @@ def load():
             return _lib
         try:
             if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                    or os.path.getmtime(_SO) < max(os.path.getmtime(s)
+                                                   for s in _SRCS)):
                 _build()
             lib = ctypes.CDLL(_SO)
         except (OSError, subprocess.CalledProcessError):
@@ -65,6 +67,18 @@ def load():
         lib.ps_parse_multislot.argtypes = [
             c.c_char_p, c.c_int64, c.c_int, c.c_void_p, c.c_void_p,
             c.c_int64, c.c_void_p, c.c_int64, c.c_void_p, c.c_int64]
+        lib.reader_create.restype = c.c_void_p
+        lib.reader_create.argtypes = [
+            c.POINTER(c.c_char_p), c.c_int, c.c_int, c.c_void_p,
+            c.c_void_p, c.c_int, c.c_int, c.c_int]
+        lib.reader_int_width.restype = c.c_int64
+        lib.reader_int_width.argtypes = [c.c_void_p]
+        lib.reader_float_width.restype = c.c_int64
+        lib.reader_float_width.argtypes = [c.c_void_p]
+        lib.reader_next.restype = c.c_int64
+        lib.reader_next.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                    c.c_void_p]
+        lib.reader_destroy.argtypes = [c.c_void_p]
         _lib = lib
         return _lib
 
@@ -130,7 +144,7 @@ class NativeShard:
         return ids[:written], vals[:written]
 
 
-def parse_multislot(text, slot_types, max_values_per_slot=1024):
+def parse_multislot(text, slot_types):
     """Parse MultiSlot lines (data_feed.cc format) with the native parser.
 
     text: str/bytes of newline-separated instances; slot_types: sequence
@@ -148,7 +162,8 @@ def parse_multislot(text, slot_types, max_values_per_slot=1024):
     n_lines = max(1, text.count(b"\n") + 1)
     max_groups = n_lines * n_slots
     counts = np.zeros(max_groups, dtype=np.int64)
-    cap = n_lines * n_slots * max_values_per_slot
+    # every value consumes >= 2 input bytes, so len(text) bounds the count
+    cap = len(text) // 2 + 16
     int_vals = np.empty(cap, dtype=np.int64)
     float_vals = np.empty(cap, dtype=np.float32)
     n = lib.ps_parse_multislot(
@@ -160,3 +175,66 @@ def parse_multislot(text, slot_types, max_values_per_slot=1024):
     n_int = int(counts[:, is_float == 0].sum()) if n else 0
     n_float = int(counts[:, is_float == 1].sum()) if n else 0
     return counts, int_vals[:n_int].copy(), float_vals[:n_float].copy()
+
+
+class MultiSlotFileReader:
+    """Threaded native file reader: parses MultiSlot text files into
+    padded numpy batches off the Python thread (data_feed.cc +
+    blocking_queue.h parity).
+
+    slots: list of (name, "int64"|"float", max_values). Iterate to get
+    dicts {name: np.ndarray [batch, max_values]} plus "<name>:count".
+    """
+
+    def __init__(self, files, slots, batch_size, n_threads=2, queue_cap=8):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.slots = slots
+        self.batch_size = batch_size
+        n = len(slots)
+        is_float = np.array([1 if t == "float" else 0 for _, t, _ in slots],
+                            dtype=np.uint8)
+        smax = np.array([m for _, _, m in slots], dtype=np.int64)
+        arr = (ctypes.c_char_p * len(files))(
+            *[f.encode() for f in files])
+        self._h = lib.reader_create(
+            arr, len(files), n, is_float.ctypes.data, smax.ctypes.data,
+            batch_size, n_threads, queue_cap)
+        self._iw = lib.reader_int_width(self._h)
+        self._fw = lib.reader_float_width(self._h)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n_slots = len(self.slots)
+        counts = np.empty((self.batch_size, n_slots), np.int64)
+        ints = np.empty((self.batch_size, self._iw), np.int64)
+        floats = np.empty((self.batch_size, self._fw), np.float32)
+        n = self._lib.reader_next(self._h, counts.ctypes.data,
+                                  ints.ctypes.data, floats.ctypes.data)
+        if n < 0:
+            raise ValueError("malformed MultiSlot input file")
+        if n == 0:
+            raise StopIteration
+        out = {}
+        iw = fw = 0
+        for si, (name, typ, m) in enumerate(self.slots):
+            if typ == "float":
+                out[name] = floats[:n, fw:fw + m]
+                fw += m
+            else:
+                out[name] = ints[:n, iw:iw + m]
+                iw += m
+            out[name + ":count"] = counts[:n, si]
+        return out
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.reader_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
