@@ -132,6 +132,42 @@ int64_t ps_size(void* h) {
   return total;
 }
 
+int64_t ps_row_width(void* h) {
+  return static_cast<Shard*>(h)->row_width;
+}
+
+// full-row export/assign: vals are [n, row_width] including optimizer
+// accumulators, so checkpoint-resume keeps the adagrad state (the
+// reference's pserver table snapshot carries optimizer state too)
+int64_t ps_export_full(void* h, int64_t* ids, float* vals,
+                       int64_t capacity) {
+  auto* sh = static_cast<Shard*>(h);
+  int64_t i = 0;
+  for (int s = 0; s < kStripes && i < capacity; ++s) {
+    std::lock_guard<std::mutex> g(sh->locks[s]);
+    for (const auto& kv : sh->rows[s]) {
+      if (i >= capacity) break;
+      ids[i] = kv.first;
+      std::memcpy(vals + i * sh->row_width, kv.second.data(),
+                  sh->row_width * sizeof(float));
+      ++i;
+    }
+  }
+  return i;
+}
+
+void ps_assign_full(void* h, const int64_t* ids, int64_t n,
+                    const float* vals) {
+  auto* sh = static_cast<Shard*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = sh->stripe(ids[i]);
+    std::lock_guard<std::mutex> g(sh->locks[s]);
+    auto& r = sh->row(ids[i], s);
+    std::memcpy(r.data(), vals + i * sh->row_width,
+                sh->row_width * sizeof(float));
+  }
+}
+
 // export all (id, row) pairs; ids/vals caller-allocated with ps_size rows.
 // Returns number written (may be < capacity if table shrank concurrently).
 int64_t ps_export(void* h, int64_t* ids, float* vals, int64_t capacity) {

@@ -64,6 +64,7 @@ from . import distributed
 from . import amp
 from . import jit
 from . import models
+from . import checkpoint
 
 from .reader import DataLoader
 from .version import full_version as __version__

@@ -142,3 +142,122 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0**15,
     return OptimizerWithMixedPrecision(
         optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
         **kw)
+
+
+# -- dynamic loss scaling (functional, jit-compatible) -----------------------
+#
+# Parity: contrib/mixed_precision/fp16_utils.py:283 update_loss_scaling op
+# + the inf/nan-check ops decorator.py wires around it. Pure pytree state
+# so it lives inside a jitted/donated train step; the skip-update branch
+# is a lax.cond, not a host round trip.
+
+import jax as _jax
+import jax.numpy as _jnp
+
+
+def scaler_init(init_scale=2.0 ** 15, incr_every_n_steps=1000,
+                decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5):
+    return {
+        "scale": _jnp.asarray(init_scale, _jnp.float32),
+        "good_steps": _jnp.zeros((), _jnp.int32),
+        "bad_steps": _jnp.zeros((), _jnp.int32),
+        "incr_every": _jnp.asarray(incr_every_n_steps, _jnp.int32),
+        "decr_every": _jnp.asarray(decr_every_n_nan_or_inf, _jnp.int32),
+        "incr_ratio": _jnp.asarray(incr_ratio, _jnp.float32),
+        "decr_ratio": _jnp.asarray(decr_ratio, _jnp.float32),
+    }
+
+
+def scale_loss(scaler, loss):
+    return loss * scaler["scale"].astype(loss.dtype)
+
+
+def _all_finite(tree):
+    leaves = [_jnp.all(_jnp.isfinite(x)) for x in _jax.tree.leaves(tree)]
+    return _jnp.stack(leaves).all() if leaves else _jnp.asarray(True)
+
+
+def unscale_grads(scaler, grads):
+    inv = (1.0 / scaler["scale"])
+    return _jax.tree.map(lambda g: (g.astype(_jnp.float32) * inv), grads)
+
+
+def scaler_update(scaler, grads_finite):
+    """Advance the scale per the reference's counters: grow scale after
+    incr_every consecutive finite steps; shrink after decr_every
+    overflowing steps."""
+    def on_good(s):
+        good = s["good_steps"] + 1
+        grow = good >= s["incr_every"]
+        return {**s,
+                "scale": _jnp.where(grow, s["scale"] * s["incr_ratio"],
+                                    s["scale"]),
+                "good_steps": _jnp.where(grow, 0, good),
+                "bad_steps": _jnp.zeros((), _jnp.int32)}
+
+    def on_bad(s):
+        bad = s["bad_steps"] + 1
+        shrink = bad >= s["decr_every"]
+        return {**s,
+                "scale": _jnp.where(shrink,
+                                    _jnp.maximum(s["scale"] * s["decr_ratio"],
+                                                 1.0),
+                                    s["scale"]),
+                "bad_steps": _jnp.where(shrink, 0, bad),
+                "good_steps": _jnp.zeros((), _jnp.int32)}
+
+    return _jax.lax.cond(grads_finite, on_good, on_bad, scaler)
+
+
+def make_amp_train_step(model, optimizer, loss_fn=None, jit=True,
+                        donate=True, **scaler_kw):
+    """Train step with dynamic loss scaling and skip-on-overflow.
+
+    Returns (step, make_state): state = (TrainState, scaler_state);
+    step(state, *batch) -> (state, loss, grads_finite). Overflowing
+    steps leave params/opt-state untouched and shrink the scale —
+    OptimizerWithMixedPrecision semantics for jitted eager training.
+    """
+    from ..models.train import TrainState, init_train_state
+    from ..models.train import _loss_with_buffers
+    from ..nn.parameter import default_rng
+
+    if loss_fn is None:
+        loss_fn = lambda m, *b: m.loss(*b)
+    model.train()
+
+    def make_state(rng_seed=0):
+        return (init_train_state(model, optimizer, rng_seed=rng_seed),
+                scaler_init(**scaler_kw))
+
+    def step(state, *batch):
+        ts, sc = state
+        rng, new_rng = _jax.random.split(ts.rng)
+
+        def loss_of(params):
+            loss, bufs = _loss_with_buffers(model, params, ts.buffers, rng,
+                                            loss_fn, batch)
+            return scale_loss(sc, loss), (loss, bufs)
+
+        (_, (loss, new_buffers)), grads = _jax.value_and_grad(
+            loss_of, has_aux=True)(ts.params)
+        grads = unscale_grads(sc, grads)
+        finite = _all_finite(grads)
+        sc = scaler_update(sc, finite)
+
+        def do_update(_):
+            return optimizer.update(ts.params, grads, ts.opt_state)
+
+        def skip_update(_):
+            return ts.params, ts.opt_state
+
+        params, opt_state = _jax.lax.cond(finite, do_update, skip_update,
+                                          None)
+        new_ts = TrainState(params=params, opt_state=opt_state,
+                            buffers=new_buffers, step=ts.step + 1,
+                            rng=new_rng)
+        return (new_ts, sc), loss, finite
+
+    if jit:
+        step = _jax.jit(step, donate_argnums=(0,) if donate else ())
+    return step, make_state

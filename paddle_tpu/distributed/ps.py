@@ -97,6 +97,26 @@ class _PyShard:
                 if len(ids) else np.zeros((0, self.dim), np.float32))
         return ids, vals
 
+    @property
+    def row_width(self):
+        return 2 * self.dim if self.opt == "adagrad" else self.dim
+
+    def export_full(self):
+        ids, vals = self.export()
+        if self.opt != "adagrad":
+            return ids, vals
+        accs = (np.stack([self.accs[int(i)] for i in ids])
+                if len(ids) else np.zeros((0, self.dim), np.float32))
+        return ids, np.concatenate([vals, accs], axis=1)
+
+    def assign_full(self, ids, vals):
+        vals = np.asarray(vals, np.float32)
+        for i, v in zip(ids, vals):
+            i = int(i)
+            self._row(i)[:] = v[:self.dim]
+            if self.opt == "adagrad" and vals.shape[1] == 2 * self.dim:
+                self.accs[i][:] = v[self.dim:]
+
 
 def _make_shard(dim, **kw):
     from .. import native
@@ -160,14 +180,19 @@ class SparseEmbedding:
         return sum(len(s) for s in self.shards)
 
     def state_dict(self):
+        """Full rows INCLUDING optimizer accumulators (adagrad), so a
+        resumed run continues the uninterrupted trajectory — pserver
+        table snapshots carry optimizer state too."""
         ids, vals = [], []
+        full = all(hasattr(s, "export_full") for s in self.shards)
         for s in self.shards:
-            i, v = s.export()
+            i, v = (s.export_full() if full else s.export())
             ids.append(i)
             vals.append(v)
+        width = vals[0].shape[1] if vals and len(vals[0]) else self.dim
         return {"ids": np.concatenate(ids) if ids else np.zeros(0, np.int64),
                 "values": np.concatenate(vals) if vals
-                else np.zeros((0, self.dim), np.float32)}
+                else np.zeros((0, width), np.float32)}
 
     def load_state_dict(self, state):
         ids = np.asarray(state["ids"], np.int64)
@@ -175,8 +200,15 @@ class SparseEmbedding:
         flat, shard_of = self._route(ids)
         for s in range(self.n):
             m = shard_of == s
-            if m.any():
-                self.shards[s].assign(flat[m], vals[m])
+            if not m.any():
+                continue
+            shard = self.shards[s]
+            if (vals.shape[1] > self.dim
+                    and getattr(shard, "row_width", self.dim)
+                    == vals.shape[1]):
+                shard.assign_full(flat[m], vals[m])
+            else:
+                shard.assign(flat[m], vals[m][:, :self.dim])
 
 
 class Communicator:
@@ -336,6 +368,13 @@ class PSServer:
                         _send_msg(self.request, b"ok")
                     elif op == "export":
                         _send_msg(self.request, outer.shard.export())
+                    elif op == "export_full":
+                        _send_msg(self.request, outer.shard.export_full())
+                    elif op == "assign_full":
+                        outer.shard.assign_full(msg["ids"], msg["vals"])
+                        _send_msg(self.request, b"ok")
+                    elif op == "row_width":
+                        _send_msg(self.request, outer.shard.row_width)
                     elif op == "set_lr":
                         outer.shard.set_lr(msg["lr"])
                         _send_msg(self.request, b"ok")
@@ -399,6 +438,17 @@ class PSClient:
 
     def export(self):
         return self._call(op="export")
+
+    def export_full(self):
+        return self._call(op="export_full")
+
+    def assign_full(self, ids, vals):
+        self._call(op="assign_full", ids=np.asarray(ids, np.int64),
+                   vals=np.asarray(vals, np.float32))
+
+    @property
+    def row_width(self):
+        return int(self._call(op="row_width"))
 
     def set_lr(self, lr):
         self._call(op="set_lr", lr=float(lr))

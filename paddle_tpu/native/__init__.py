@@ -63,6 +63,13 @@ def load():
         lib.ps_export.restype = c.c_int64
         lib.ps_export.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
                                   c.c_int64]
+        lib.ps_row_width.restype = c.c_int64
+        lib.ps_row_width.argtypes = [c.c_void_p]
+        lib.ps_export_full.restype = c.c_int64
+        lib.ps_export_full.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                       c.c_int64]
+        lib.ps_assign_full.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                       c.c_void_p]
         lib.ps_parse_multislot.restype = c.c_int64
         lib.ps_parse_multislot.argtypes = [
             c.c_char_p, c.c_int64, c.c_int, c.c_void_p, c.c_void_p,
@@ -142,6 +149,27 @@ class NativeShard:
         written = self._lib.ps_export(self._h, ids.ctypes.data,
                                       vals.ctypes.data, n)
         return ids[:written], vals[:written]
+
+    @property
+    def row_width(self):
+        return int(self._lib.ps_row_width(self._h))
+
+    def export_full(self):
+        """(ids, [n, row_width]) including optimizer accumulators."""
+        n = len(self)
+        w = self.row_width
+        ids = np.empty(n, dtype=np.int64)
+        vals = np.empty((n, w), dtype=np.float32)
+        written = self._lib.ps_export_full(self._h, ids.ctypes.data,
+                                           vals.ctypes.data, n)
+        return ids[:written], vals[:written]
+
+    def assign_full(self, ids, vals):
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        vals = np.ascontiguousarray(vals, dtype=np.float32)
+        assert vals.shape == (len(ids), self.row_width)
+        self._lib.ps_assign_full(self._h, ids.ctypes.data, len(ids),
+                                 vals.ctypes.data)
 
 
 def parse_multislot(text, slot_types):

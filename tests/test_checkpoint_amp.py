@@ -1,0 +1,160 @@
+"""Checkpoint/resume (incl. sharded states + PS tables) and dynamic loss
+scaling semantics."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.amp import (
+    make_amp_train_step, scale_loss, scaler_init, scaler_update,
+    unscale_grads)
+from paddle_tpu.checkpoint import (
+    CheckpointManager, latest_step, load_checkpoint, save_checkpoint)
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.ps import SparseEmbedding
+from paddle_tpu.distributed.sharded import (
+    gpt_rules, make_sharded_train_step, shard_batch)
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.models.train import init_train_state, make_train_step
+from paddle_tpu.optimizer.functional import SGD, AdamW
+
+
+def _model(dtype="float32"):
+    return GPT(GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                         num_heads=4, max_seq_len=8, dtype=dtype))
+
+
+def _batch(seed=0):
+    r = np.random.default_rng(seed)
+    return (r.integers(0, 64, (4, 8)).astype(np.int32),
+            r.integers(0, 64, (4, 8)).astype(np.int32))
+
+
+def test_checkpoint_roundtrip_resume(tmp_path):
+    m = _model()
+    opt = AdamW(1e-3)
+    step = make_train_step(m, opt, donate=False)
+    state = init_train_state(m, opt)
+    x, y = _batch()
+    for _ in range(3):
+        state, _ = step(state, x, y)
+    save_checkpoint(tmp_path, state, step=3)
+    assert latest_step(tmp_path) == 3
+
+    # fresh model restores and continues identically
+    m2 = _model()
+    state2 = init_train_state(m2, opt)
+    restored, s = load_checkpoint(tmp_path, state2)
+    assert s == 3
+    np.testing.assert_array_equal(int(restored.step), int(state.step))
+    a, _ = step(state, x, y)
+    b, _ = make_train_step(m2, opt, donate=False)(restored, x, y)
+    np.testing.assert_allclose(
+        np.asarray(a.params["blocks.0.fc1.weight"]),
+        np.asarray(b.params["blocks.0.fc1.weight"]), rtol=1e-6)
+
+
+def test_checkpoint_restores_shardings(tmp_path):
+    mesh = build_mesh(dp=2, tp=2, sp=1, pp=1, devices=jax.devices()[:4])
+    m = _model()
+    step, state = make_sharded_train_step(m, AdamW(1e-3), mesh,
+                                          rules=gpt_rules())
+    x, y = _batch()
+    xs, ys = shard_batch(mesh, x, y, spec=None)
+    state, _ = step(state, xs, ys)
+    save_checkpoint(tmp_path, state, step=1)
+
+    m2 = _model()
+    _, template = make_sharded_train_step(m2, AdamW(1e-3), mesh,
+                                          rules=gpt_rules())
+    restored, _ = load_checkpoint(tmp_path, template)
+    w = restored.params["blocks.0.fc1.weight"]
+    assert w.sharding == template.params["blocks.0.fc1.weight"].sharding
+    np.testing.assert_allclose(
+        np.asarray(w), np.asarray(state.params["blocks.0.fc1.weight"]),
+        rtol=1e-6)
+
+
+def test_checkpoint_manager_keeps_last_n(tmp_path):
+    st = {"w": jnp.ones((2,))}
+    mgr = CheckpointManager(tmp_path, max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(st, s)
+    import os
+
+    kept = sorted(d for d in os.listdir(tmp_path) if d.startswith("step_"))
+    assert kept == ["step_3", "step_4"]
+
+
+def test_checkpoint_with_sparse_tables(tmp_path):
+    table = SparseEmbedding(dim=4, num_shards=2, optimizer="sgd", lr=1.0)
+    ids = np.arange(10, dtype=np.int64)
+    table.push(ids, np.ones((10, 4), np.float32))
+    save_checkpoint(tmp_path, {"w": jnp.zeros(1)}, 1,
+                    sparse_tables={"emb": table})
+    t2 = SparseEmbedding(dim=4, num_shards=3, optimizer="sgd", lr=1.0,
+                         seed=9)
+    load_checkpoint(tmp_path, {"w": jnp.zeros(1)},
+                    sparse_tables={"emb": t2})
+    np.testing.assert_allclose(t2.pull(ids), table.pull(ids), rtol=1e-6)
+
+
+def test_scaler_counters():
+    sc = scaler_init(init_scale=4.0, incr_every_n_steps=2,
+                     decr_every_n_nan_or_inf=1, incr_ratio=2.0,
+                     decr_ratio=0.5)
+    sc = scaler_update(sc, jnp.asarray(True))
+    assert float(sc["scale"]) == 4.0 and int(sc["good_steps"]) == 1
+    sc = scaler_update(sc, jnp.asarray(True))      # 2nd good -> grow
+    assert float(sc["scale"]) == 8.0 and int(sc["good_steps"]) == 0
+    sc = scaler_update(sc, jnp.asarray(False))     # overflow -> shrink
+    assert float(sc["scale"]) == 4.0
+
+
+def test_scale_unscale_roundtrip():
+    sc = scaler_init(init_scale=8.0)
+    loss = jnp.asarray(2.0)
+    assert float(scale_loss(sc, loss)) == 16.0
+    grads = {"a": jnp.asarray([8.0, 16.0])}
+    np.testing.assert_allclose(np.asarray(unscale_grads(sc, grads)["a"]),
+                               [1.0, 2.0])
+
+
+def test_amp_step_skips_update_on_overflow():
+    m = _model()
+    opt = SGD(0.1)
+    step, make_state = make_amp_train_step(m, opt, jit=True, donate=False,
+                                           init_scale=2.0 ** 15,
+                                           decr_every_n_nan_or_inf=1)
+    state = make_state()
+    x, y = _batch()
+    (ts1, sc1), loss, finite = step(state, x, y)
+    assert bool(finite)
+
+    # poison one param -> non-finite grads -> update must be skipped
+    bad = dict(ts1.params)
+    bad["blocks.0.fc1.weight"] = ts1.params["blocks.0.fc1.weight"] * np.nan
+    from paddle_tpu.models.train import TrainState
+
+    poisoned = TrainState(params=bad, opt_state=ts1.opt_state,
+                          buffers=ts1.buffers, step=ts1.step, rng=ts1.rng)
+    (ts2, sc2), loss2, finite2 = step((poisoned, sc1), x, y)
+    assert not bool(finite2)
+    assert float(sc2["scale"]) < float(sc1["scale"])       # shrunk
+    # params unchanged by the skipped update (still the poisoned values)
+    assert np.isnan(np.asarray(ts2.params["blocks.0.fc1.weight"])).all()
+
+
+def test_amp_step_trains():
+    m = _model()
+    step, make_state = make_amp_train_step(m, SGD(0.5), jit=True,
+                                           donate=False)
+    state = make_state()
+    x, _ = _batch()
+    losses = []
+    for _ in range(15):
+        state, loss, finite = step(state, x, x)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
