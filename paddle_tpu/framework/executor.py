@@ -126,8 +126,204 @@ _NEEDS_CONST_INPUTS = {"range", "linspace"}
 _DYNAMIC_SHAPE_OPS = {"where_index", "masked_select", "unique"}
 
 
+def _branch_env(env):
+    # lists are tensor-arrays with trace-time mutation semantics; both
+    # lax.cond branches get traced, so they must NOT share the outer list
+    return {k: (list(v) if isinstance(v, list) else v)
+            for k, v in env.items()}
+
+
+def _branch_fn(ops, env, key, out_names, const_env=None):
+    """Interpret a sub-block against a copy of the outer env, returning
+    the named results — the body of a lax.cond/while/scan closure. `key`
+    seeds a branch-local RngBox so rng draws inside the traced closure
+    never mutate the outer box with an inner-trace tracer."""
+    def fn(bound, key=key):
+        benv = _branch_env(env)
+        benv.update(bound)
+        box = _RngBox(key)
+        interpret(ops, benv, box, const_env)
+        return tuple(benv[n] for n in out_names)
+
+    return fn
+
+
+def _run_cond(op, env, rng_box, const_env=None):
+    """conditional_block pair -> lax.cond (layers/control_flow.py cond)."""
+    program = op.block.program
+    a = op.attrs
+    pred = env[op.inputs["Pred"][0]]
+    pred = jnp.asarray(pred).reshape(())
+    t_ops = program.blocks[a["true_block"]].ops
+    f_ops = program.blocks[a["false_block"]].ops
+    k = rng_box.next()  # outer-level split; branches fold a branch id in
+    outs = jax.lax.cond(
+        pred,
+        lambda _: _branch_fn(t_ops, env, jax.random.fold_in(k, 0),
+                             a["true_outs"], const_env)({}),
+        lambda _: _branch_fn(f_ops, env, jax.random.fold_in(k, 1),
+                             a["false_outs"], const_env)({}),
+        None)
+    for n, v in zip(op.outputs["Out"], outs):
+        env[n] = v
+
+
+def _run_switch(op, env, rng_box, const_env=None):
+    """Switch -> right-folded lax.cond chain (layers Switch parity:
+    first true case wins, else default, else values unchanged)."""
+    program = op.block.program
+    a = op.attrs
+    out_names = a["out_names"]
+    for n in out_names:
+        if n not in env:
+            raise KeyError(
+                f"Switch writes '{n}' but it has no value before the "
+                f"switch (cases only run conditionally)")
+    k = rng_box.next()
+    result = tuple(env[n] for n in out_names)
+    if a.get("default_block") is not None:
+        d_ops = program.blocks[a["default_block"]].ops
+        result = _branch_fn(d_ops, env, jax.random.fold_in(k, -1),
+                            out_names, const_env)({})
+    for i in range(len(a["case_blocks"]) - 1, -1, -1):
+        pred = jnp.asarray(env[a["case_preds"][i]]).reshape(())
+        c_ops = program.blocks[a["case_blocks"][i]].ops
+        taken = _branch_fn(c_ops, env, jax.random.fold_in(k, i),
+                           out_names, const_env)
+        result = jax.lax.cond(pred, lambda _, t=taken: t({}),
+                              lambda _, r=result: r, None)
+    for n, v in zip(op.outputs["Out"], result):
+        env[n] = v
+
+
+def _run_while(op, env, rng_box, const_env=None):
+    """while_op.cc -> lax.while_loop."""
+    program = op.block.program
+    a = op.attrs
+    loop_names = op.inputs["LoopVars"]
+    init_vars = tuple(jnp.asarray(env[n]) for n in loop_names)
+    c_ops = program.blocks[a["cond_block"]].ops
+    b_ops = program.blocks[a["body_block"]].ops
+    # rng key rides in the carry so each iteration draws fresh randomness
+    init = init_vars + (rng_box.next(),)
+
+    def cond_fn(carry):
+        (out,) = _branch_fn(c_ops, env, carry[-1], [a["cond_out"]],
+                            const_env)(dict(zip(a["cond_inner"],
+                                                carry[:-1])))
+        return jnp.asarray(out).reshape(())
+
+    def body_fn(carry):
+        key, sub = jax.random.split(carry[-1])
+        outs = _branch_fn(b_ops, env, sub, a["body_outs"], const_env)(
+            dict(zip(a["body_inner"], carry[:-1])))
+        return tuple(jnp.asarray(o, init_vars[i].dtype)
+                     for i, o in enumerate(outs)) + (key,)
+
+    max_iters = a.get("max_iters")
+    if max_iters:
+        # bounded lowering onto lax.scan so reverse-mode AD works (the
+        # while_grad parity path): iterate max_iters times, freezing the
+        # carry once the condition goes false
+        def scan_body(carry, _):
+            run = cond_fn(carry)
+            new = body_fn(carry)
+            frozen = tuple(jnp.where(run, n, c)
+                           for n, c in zip(new[:-1], carry[:-1]))
+            return frozen + (new[-1],), None
+
+        outs, _ = jax.lax.scan(scan_body, init, None, length=int(max_iters))
+    else:
+        outs = jax.lax.while_loop(cond_fn, body_fn, init)
+    for n, v in zip(op.outputs["Out"], outs[:-1]):
+        env[n] = v
+
+
+def _run_static_rnn(op, env, rng_box, const_env=None):
+    """StaticRNN -> lax.scan over the leading (time) axis."""
+    program = op.block.program
+    a = op.attrs
+    ops = program.blocks[a["block"]].ops
+    xs = tuple(jnp.asarray(env[n]) for n in op.inputs["StepInputs"])
+    init_mem = tuple(jnp.asarray(env[n]) for n in op.inputs["InitMemories"])
+    init = init_mem + (rng_box.next(),)
+
+    def body(carry, x_t):
+        key, sub = jax.random.split(carry[-1])
+        bound = dict(zip(a["memory_inner"], carry[:-1]))
+        bound.update(zip(a["input_inner"], x_t))
+        outs = _branch_fn(ops, env, sub,
+                          list(a["memory_update"]) + list(a["step_outs"]),
+                          const_env)(bound)
+        n_mem = len(a["memory_update"])
+        new_carry = tuple(jnp.asarray(o, init_mem[i].dtype)
+                          for i, o in enumerate(outs[:n_mem]))
+        return new_carry + (key,), tuple(outs[n_mem:])
+
+    _, stacked = jax.lax.scan(body, init, xs)
+    for n, v in zip(op.outputs["Out"], stacked):
+        env[n] = v
+
+
+def _array_index(name, env, const_env):
+    v = env.get(name)
+    try:
+        return int(np.asarray(v))
+    except Exception:
+        if const_env is not None and name in const_env:
+            return int(np.asarray(const_env[name]))
+        raise NotImplementedError(
+            "tensor-array indices must be compile-time constants under "
+            "the jitted executor (use while_loop/scan state for dynamic "
+            "indexing, or FLAGS_eager_executor)")
+
+
+def _run_array_op(op, env, rng_box, const_env=None):
+    """LoDTensorArray ops: trace-time python-list semantics. The index
+    must be trace-time static under jit (use while_loop/scan otherwise)."""
+    t = op.type
+    if t == "create_array":
+        env[op.outputs["Out"][0]] = []
+        return
+    if t == "array_write":
+        arr = env[op.inputs["Array"][0]]
+        i = _array_index(op.inputs["I"][0], env, const_env)
+        x = env[op.inputs["X"][0]]
+        if i == len(arr):
+            arr.append(x)
+        elif i < len(arr):
+            arr[i] = x
+        else:
+            raise IndexError(f"array_write index {i} > length {len(arr)}")
+        return
+    if t == "array_read":
+        arr = env[op.inputs["Array"][0]]
+        i = _array_index(op.inputs["I"][0], env, const_env)
+        env[op.outputs["Out"][0]] = arr[i]
+        return
+    if t == "array_length":
+        arr = env[op.inputs["Array"][0]]
+        env[op.outputs["Out"][0]] = jnp.asarray(len(arr), jnp.int64)
+        return
+
+
+_CONTROL_FLOW_OPS = {
+    "cond": _run_cond,
+    "switch": _run_switch,
+    "while_loop": _run_while,
+    "static_rnn": _run_static_rnn,
+    "create_array": _run_array_op,
+    "array_write": _run_array_op,
+    "array_read": _run_array_op,
+    "array_length": _run_array_op,
+}
+
+
 def run_op(op, env, rng_box, const_env=None):
     """Execute one recorded op against env (used at trace time)."""
+    if op.type in _CONTROL_FLOW_OPS:
+        _CONTROL_FLOW_OPS[op.type](op, env, rng_box, const_env)
+        return
     opdef = get_op(op.type)
     ins = {}
     for slot, names in op.inputs.items():

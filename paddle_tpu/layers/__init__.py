@@ -9,7 +9,9 @@ from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .sequence_ops import *  # noqa: F401,F403
 from . import learning_rate_scheduler  # noqa: F401
 from ..framework.program import data  # noqa: F401
 
-from . import nn, tensor, loss, metric_op  # noqa: F401
+from . import nn, tensor, loss, metric_op, control_flow, sequence_ops  # noqa: F401

@@ -1,0 +1,425 @@
+"""Static-graph control flow: cond / while_loop / StaticRNN / Switch.
+
+Parity surface: the reference's control-flow ops
+(/root/reference/paddle/fluid/operators/controlflow/conditional_block_op.cc,
+while_op.cc and python/paddle/fluid/layers/control_flow.py: While:1032,
+cond, Switch:2669, StaticRNN:420, increment:1308, array ops:1383-1566).
+
+Design: the reference runs sub-blocks with a nested Executor at runtime;
+here sub-blocks are recorded into child Blocks and the control-flow op is
+lowered AT TRACE TIME onto jax.lax.cond / lax.while_loop / lax.scan by
+interpreting the child block inside the branch/body closures (see
+framework/executor.py _run_cond/_run_while/_run_static_rnn). That keeps
+the whole program one compiled XLA computation — no data-dependent
+Python control flow survives into the jitted step, per TPU rules.
+"""
+
+import numpy as np
+
+from ..framework import program as prog_mod
+from ..framework.layer_helper import LayerHelper
+
+__all__ = ["cond", "while_loop", "StaticRNN", "Switch", "increment",
+           "less_than", "less_equal", "greater_than", "greater_equal",
+           "equal", "not_equal", "logical_and", "logical_or",
+    "logical_not", "array_write", "array_read", "array_length",
+    "create_array"]
+
+
+def _helper(name):
+    return LayerHelper(name)
+
+
+def _compare(op_type, x, y):
+    h = _helper(op_type)
+    out = h.create_variable_for_type_inference(dtype="bool")
+    h.append_op(op_type, inputs={"X": x, "Y": y}, outputs={"Out": out})
+    return out
+
+
+def less_than(x, y):
+    return _compare("less_than", x, y)
+
+
+def less_equal(x, y):
+    return _compare("less_equal", x, y)
+
+
+def greater_than(x, y):
+    return _compare("greater_than", x, y)
+
+
+def greater_equal(x, y):
+    return _compare("greater_equal", x, y)
+
+
+def equal(x, y):
+    return _compare("equal", x, y)
+
+
+def not_equal(x, y):
+    return _compare("not_equal", x, y)
+
+
+def logical_and(x, y):
+    return _compare("logical_and", x, y)
+
+
+def logical_or(x, y):
+    return _compare("logical_or", x, y)
+
+
+def logical_not(x):
+    h = _helper("logical_not")
+    out = h.create_variable_for_type_inference(dtype="bool")
+    h.append_op("logical_not", inputs={"X": x}, outputs={"Out": out})
+    return out
+
+
+from .tensor import increment  # noqa: F401 — single implementation
+
+
+def _captured_names(blocks, exclude=()):
+    """Outer variable names a sub-block reads (inputs not produced inside
+    the block and not bound loop/step vars). Recorded as an explicit
+    "Captured" input slot on the control-flow op so the executor's
+    dead-op pruning keeps their producers."""
+    exclude = set(exclude)
+    captured, produced = [], set()
+    for block in blocks:
+        for op in block.ops:
+            for n in op.input_names():
+                if (n not in produced and n not in exclude
+                        and n not in captured):
+                    captured.append(n)
+            produced |= set(op.output_names())
+    return captured
+
+
+def _record_sub_block(program, build_fn, inner_vars):
+    """Run build_fn with append_op redirected into a fresh child block.
+    Returns (block, result_of_build_fn)."""
+    block = program.create_block()
+    try:
+        result = build_fn(*inner_vars)
+    finally:
+        program.rollback()
+    return block, result
+
+
+def _clone_var_in(block, v, name=None):
+    return block.create_var(name=name, shape=v.shape, dtype=v.dtype)
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """lax.cond-backed conditional (parity: layers.cond / the
+    conditional_block op pair). true_fn/false_fn take no args and return
+    a Variable or (nested) list of Variables with matching shapes."""
+    program = pred.block.program
+    tb, t_out = _record_sub_block(program, lambda: true_fn(), ())
+    fb, f_out = _record_sub_block(program, lambda: false_fn(), ())
+
+    t_list = t_out if isinstance(t_out, (list, tuple)) else [t_out]
+    f_list = f_out if isinstance(f_out, (list, tuple)) else [f_out]
+    if len(t_list) != len(f_list):
+        raise ValueError("cond branches must return the same arity")
+
+    h = _helper("cond")
+    outs = [h.create_variable_for_type_inference(v.dtype) for v in t_list]
+    for o, v in zip(outs, t_list):
+        o.shape = v.shape
+    h.append_op(
+        "cond",
+        inputs={"Pred": pred,
+                "Captured": _captured_names([tb, fb])},
+        outputs={"Out": outs},
+        attrs={
+            "true_block": tb.idx,
+            "false_block": fb.idx,
+            "true_outs": [v.name for v in t_list],
+            "false_outs": [v.name for v in f_list],
+        })
+    return outs[0] if not isinstance(t_out, (list, tuple)) else outs
+
+
+def while_loop(cond_fn, body_fn, loop_vars, maximum_trip_count=None,
+               name=None):
+    """lax.while_loop-backed loop (parity: layers.while_loop / while_op.cc).
+
+    cond_fn(*vars) -> bool scalar Variable; body_fn(*vars) -> updated
+    vars (same arity/shapes — static shapes, per XLA).
+
+    maximum_trip_count: when set, the loop lowers to a bounded lax.scan
+    (iterating exactly that many times with a frozen-carry mask), which
+    is REQUIRED if gradients must flow through the loop — XLA cannot
+    reverse-differentiate an unbounded while (the reference's while_grad
+    replays the forward block; the scan lowering is the TPU equivalent).
+    """
+    loop_vars = list(loop_vars)
+    program = loop_vars[0].block.program
+
+    cb = program.create_block()
+    try:
+        c_inner = [_clone_var_in(cb, v) for v in loop_vars]
+        c_out = cond_fn(*c_inner)
+    finally:
+        program.rollback()
+
+    bb = program.create_block()
+    try:
+        b_inner = [_clone_var_in(bb, v) for v in loop_vars]
+        b_out = body_fn(*b_inner)
+    finally:
+        program.rollback()
+    b_out = b_out if isinstance(b_out, (list, tuple)) else [b_out]
+    if len(b_out) != len(loop_vars):
+        raise ValueError("body must return one value per loop var")
+
+    h = _helper("while_loop")
+    outs = [h.create_variable_for_type_inference(v.dtype)
+            for v in loop_vars]
+    for o, v in zip(outs, loop_vars):
+        o.shape = v.shape
+    captured = _captured_names(
+        [cb, bb], exclude=[v.name for v in c_inner + b_inner])
+    h.append_op(
+        "while_loop",
+        inputs={"LoopVars": loop_vars, "Captured": captured},
+        outputs={"Out": outs},
+        attrs={
+            "max_iters": (int(maximum_trip_count)
+                          if maximum_trip_count else None),
+            "cond_block": cb.idx,
+            "body_block": bb.idx,
+            "cond_inner": [v.name for v in c_inner],
+            "body_inner": [v.name for v in b_inner],
+            "cond_out": c_out.name,
+            "body_outs": [v.name for v in b_out],
+        })
+    return outs
+
+
+class Switch:
+    """Parity: control_flow.py:2669 — chained case()/default() blocks.
+
+    Used as a context manager; on exit it emits one "switch" op that the
+    executor lowers to a right-folded lax.cond chain (first true case
+    wins, else default, else the written variables keep their prior
+    values). Case bodies communicate by writing outer variables (the
+    reference pattern: layers.assign into a persistable var, e.g. the
+    learning-rate schedule in learning_rate_scheduler.py).
+    """
+
+    def __init__(self, name=None):
+        self._cases = []          # (pred, block)
+        self._default = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if exc[0] is not None:
+            return False
+        self._lower()
+        return False
+
+    class _CaseCtx:
+        def __init__(self, switch, pred):
+            self.switch = switch
+            self.pred = pred
+
+        def __enter__(self):
+            sw = self.switch
+            sw._program = prog_mod.default_main_program()
+            sw._block = sw._program.create_block()
+            return self
+
+        def __exit__(self, *exc):
+            self.switch._program.rollback()
+            entry = (self.pred, self.switch._block)
+            if self.pred is None:
+                self.switch._default = entry
+            else:
+                self.switch._cases.append(entry)
+            return False
+
+    def case(self, pred):
+        return Switch._CaseCtx(self, pred)
+
+    def default(self):
+        return Switch._CaseCtx(self, None)
+
+    def _lower(self):
+        blocks = [b for _, b in self._cases]
+        if self._default is not None:
+            blocks.append(self._default[1])
+        if not blocks:
+            return
+        # outer variables any case writes = the switch outputs
+        out_names = []
+        for b in blocks:
+            for op in b.ops:
+                for n in op.output_names():
+                    if n not in b.vars and n not in out_names:
+                        out_names.append(n)
+        if not out_names:
+            return
+        h = _helper("switch")
+        h.append_op(
+            "switch",
+            inputs={
+                "Preds": [p for p, _ in self._cases],
+                "Captured": _captured_names(
+                    blocks, exclude=out_names),
+            },
+            # outputs keep the SAME outer names: the switch result
+            # becomes the new value of each written variable
+            outputs={"Out": out_names},
+            attrs={
+                "case_preds": [p.name for p, _ in self._cases],
+                "case_blocks": [b.idx for _, b in self._cases],
+                "default_block": (self._default[1].idx
+                                  if self._default else None),
+                "out_names": out_names,
+            })
+
+
+class StaticRNN:
+    """lax.scan-backed RNN over a static sequence axis.
+
+    Parity: control_flow.py:420 StaticRNN (step_input / memory /
+    update_memory / step_output), with the time axis first:
+    step_input expects [T, ...] and the result of rnn() is [T, ...].
+    """
+
+    def __init__(self, name=None):
+        self._program = None
+        self._block = None
+        self._step_inputs = []    # (outer, inner)
+        self._memories = []       # [outer_init, inner, updated_name]
+        self._outputs = []        # inner step outputs
+        self._built = False
+
+    class _StepCtx:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            r = self.rnn
+            r._program = prog_mod.default_main_program()
+            r._block = r._program.create_block()
+            return self
+
+        def __exit__(self, *exc):
+            self.rnn._program.rollback()
+            if exc[0] is None:
+                self.rnn._finalize()
+            return False
+
+    def step(self):
+        return StaticRNN._StepCtx(self)
+
+    def _in_step(self):
+        if self._block is None:
+            raise RuntimeError("call inside `with rnn.step():`")
+
+    def step_input(self, x):
+        self._in_step()
+        inner = self._block.create_var(shape=(None,) + tuple(x.shape[1:]),
+                                       dtype=x.dtype)
+        inner.shape = tuple(x.shape[1:])
+        self._step_inputs.append((x, inner))
+        return inner
+
+    def memory(self, init):
+        self._in_step()
+        inner = self._block.create_var(shape=init.shape, dtype=init.dtype)
+        self._memories.append([init, inner, None])
+        return inner
+
+    def update_memory(self, mem, new_val):
+        self._in_step()
+        for m in self._memories:
+            if m[1] is mem:
+                m[2] = new_val.name
+                return
+        raise ValueError("update_memory: unknown memory variable")
+
+    def step_output(self, o):
+        self._in_step()
+        self._outputs.append(o)
+
+    def output(self, *outs):
+        for o in outs:
+            self.step_output(o)
+
+    def _finalize(self):
+        for m in self._memories:
+            if m[2] is None:
+                raise RuntimeError("memory was never update_memory'd")
+        if not self._outputs:
+            raise RuntimeError("StaticRNN needs at least one step_output")
+        self._built = True
+
+    def __call__(self):
+        if not self._built:
+            raise RuntimeError("StaticRNN used before its step block closed")
+        h = _helper("static_rnn")
+        outs = [h.create_variable_for_type_inference(o.dtype)
+                for o in self._outputs]
+        for o, (x, _) in zip(outs, self._step_inputs[:1]):
+            pass
+        exclude = ([i.name for _, i in self._step_inputs]
+                   + [m[1].name for m in self._memories])
+        h.append_op(
+            "static_rnn",
+            inputs={
+                "StepInputs": [x for x, _ in self._step_inputs],
+                "InitMemories": [m[0] for m in self._memories],
+                "Captured": _captured_names([self._block], exclude=exclude),
+            },
+            outputs={"Out": outs},
+            attrs={
+                "block": self._block.idx,
+                "input_inner": [i.name for _, i in self._step_inputs],
+                "memory_inner": [m[1].name for m in self._memories],
+                "memory_update": [m[2] for m in self._memories],
+                "step_outs": [o.name for o in self._outputs],
+            })
+        return outs[0] if len(outs) == 1 else outs
+
+
+# -- TensorArray (LoDTensorArray parity, trace-time list semantics) ---------
+
+def create_array(dtype="float32"):
+    """Parity: control_flow.py:1383 create_array. Arrays live in the env
+    as python lists at trace time; under jit their length must be
+    trace-time static (use while_loop/scan state for dynamic cases)."""
+    h = _helper("array")
+    out = h.create_variable_for_type_inference(dtype=dtype)
+    out.is_tensor_array = True
+    h.append_op("create_array", inputs={}, outputs={"Out": out}, attrs={})
+    return out
+
+
+def array_write(x, i, array):
+    h = _helper("array_write")
+    h.append_op("array_write", inputs={"X": x, "I": i, "Array": array},
+                outputs={"Out": array}, attrs={})
+    return array
+
+
+def array_read(array, i):
+    h = _helper("array_read")
+    out = h.create_variable_for_type_inference(array.dtype)
+    h.append_op("array_read", inputs={"Array": array, "I": i},
+                outputs={"Out": out}, attrs={})
+    return out
+
+
+def array_length(array):
+    h = _helper("array_length")
+    out = h.create_variable_for_type_inference("int64")
+    h.append_op("array_length", inputs={"Array": array},
+                outputs={"Out": out}, attrs={})
+    return out

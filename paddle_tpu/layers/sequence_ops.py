@@ -1,0 +1,96 @@
+"""Sequence (LoD) layers on padded-plus-length representation.
+
+The reference stores ragged batches as LoDTensors — flat values plus
+level-of-detail offsets (/root/reference/paddle/fluid/framework/
+lod_tensor.h:52,104) — and its sequence ops walk the offsets
+(operators/sequence_ops/, ~5.8k LoC). Offsets are anti-XLA (dynamic
+shapes), so the TPU-native representation is the standard static-shape
+dual: a padded dense tensor [B, T, ...] plus a length vector [B], with
+every op masking by length. sequence_pad/unpad convert between the
+ragged host format and the padded device format at the pipeline
+boundary, which is exactly where the reference's LoDTensor <-> numpy
+conversion happens.
+"""
+
+import numpy as np
+
+from ..framework.layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_mask", "sequence_pool", "sequence_softmax",
+    "sequence_reverse", "sequence_expand", "sequence_last_step",
+    "sequence_first_step", "pad_sequences", "unpad_sequences",
+]
+
+
+def _op(op_type, inputs, attrs=None, out_dtype="float32", n_outs=1):
+    h = LayerHelper(op_type)
+    outs = [h.create_variable_for_type_inference(out_dtype)
+            for _ in range(n_outs)]
+    h.append_op(op_type, inputs=inputs,
+                outputs={"Out": outs if n_outs > 1 else outs[0]},
+                attrs=attrs or {})
+    return outs if n_outs > 1 else outs[0]
+
+
+def sequence_mask(length, maxlen, dtype="float32"):
+    """[B] lengths -> [B, maxlen] 0/1 mask (parity: layers.sequence_mask
+    / sequence_mask_op.cc, with maxlen required to stay static-shape)."""
+    return _op("sequence_mask", {"X": length},
+               {"maxlen": int(maxlen), "out_dtype": dtype}, dtype)
+
+
+def sequence_pool(x, length, pool_type="average"):
+    """Masked pool over the time axis of [B, T, ...] (parity:
+    sequence_pool_op.cc sum/average/max/sqrt/last/first)."""
+    return _op("sequence_pool", {"X": x, "Length": length},
+               {"pooltype": pool_type.upper()}, x.dtype)
+
+
+def sequence_last_step(x, length):
+    return sequence_pool(x, length, "last")
+
+
+def sequence_first_step(x, length):
+    return sequence_pool(x, length, "first")
+
+
+def sequence_softmax(x, length):
+    """Per-sequence masked softmax over the time axis [B, T] (parity:
+    sequence_softmax_op.cc)."""
+    return _op("sequence_softmax", {"X": x, "Length": length}, {}, x.dtype)
+
+
+def sequence_reverse(x, length):
+    """Reverse each sequence's valid prefix, keeping padding in place
+    (parity: sequence_reverse_op.h)."""
+    return _op("sequence_reverse", {"X": x, "Length": length}, {}, x.dtype)
+
+
+def sequence_expand(x, length, ref_maxlen):
+    """Repeat each row x[b] over its sequence's valid steps -> [B, T, ...]
+    (parity: sequence_expand_op.cc with ref_level=0)."""
+    return _op("sequence_expand", {"X": x, "Length": length},
+               {"maxlen": int(ref_maxlen)}, x.dtype)
+
+
+# -- host-side ragged <-> padded conversion (LoDTensor boundary) ------------
+
+def pad_sequences(seqs, maxlen=None, dtype=np.float32, pad_value=0):
+    """list of [t_i, ...] arrays -> (padded [B, T, ...], length [B]).
+    The numpy-side analogue of to_lodtensor/sequence_pad."""
+    lens = np.array([len(s) for s in seqs], dtype=np.int64)
+    maxlen = int(maxlen or lens.max() if len(lens) else 0)
+    first = np.asarray(seqs[0])
+    trailing = first.shape[1:]
+    out = np.full((len(seqs), maxlen) + trailing, pad_value, dtype=dtype)
+    for i, s in enumerate(seqs):
+        t = min(len(s), maxlen)
+        out[i, :t] = np.asarray(s)[:t]
+    return out, np.minimum(lens, maxlen)
+
+
+def unpad_sequences(padded, length):
+    """(padded [B, T, ...], length [B]) -> list of [t_i, ...] arrays."""
+    padded = np.asarray(padded)
+    return [padded[i, : int(l)] for i, l in enumerate(np.asarray(length))]

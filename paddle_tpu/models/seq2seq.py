@@ -1,0 +1,213 @@
+"""Attention seq2seq (machine translation) with greedy + beam decode.
+
+Parity target: the reference's machine-translation book model
+(/root/reference/python/paddle/fluid/tests/book/test_machine_translation.py
+— encoder-decoder with attention built from dynamic RNN + the beam search
+ops operators/beam_search_op.cc / layers' beam-search decode). The TPU
+redesign replaces LoD-walking beam ops with a fixed-width beam carried
+through lax.scan: state is [B, beam, ...], every step expands
+beam*vocab, top-k's back down to beam, and gathers parent states —
+static shapes end to end.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+NEG_INF = -1e9
+
+
+@dataclasses.dataclass
+class Seq2SeqConfig:
+    src_vocab: int = 1000
+    tgt_vocab: int = 1000
+    hidden_size: int = 128
+    embed_dim: int = 64
+    bos_id: int = 0
+    eos_id: int = 1
+    dtype: str = "float32"
+
+
+class Encoder(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__(dtype=cfg.dtype)
+        self.embed = nn.Embedding([cfg.src_vocab, cfg.embed_dim],
+                                  dtype=cfg.dtype)
+        self.rnn = nn.RNN(nn.LSTMCell(cfg.embed_dim, cfg.hidden_size,
+                                      dtype=cfg.dtype))
+
+    def forward(self, src_ids, src_len=None):
+        x = self.embed(src_ids)                       # [B, T, E]
+        outs, (h, c) = self.rnn(x, length=src_len)
+        return outs, (h, c)
+
+
+class AttentionDecoderCell(nn.Layer):
+    """LSTM cell + Luong dot attention over encoder outputs."""
+
+    def __init__(self, cfg):
+        super().__init__(dtype=cfg.dtype)
+        self.cell = nn.LSTMCell(cfg.embed_dim + cfg.hidden_size,
+                                cfg.hidden_size, dtype=cfg.dtype)
+        self.attn_out = nn.Linear(2 * cfg.hidden_size, cfg.hidden_size,
+                                  act="tanh", dtype=cfg.dtype)
+
+    def forward(self, x_t, state, enc_outs, enc_mask):
+        h, c = state
+        inp = jnp.concatenate([x_t, h], axis=-1)
+        out, (h, c) = self.cell(inp, (h, c))
+        # dot attention: scores [B, T]
+        scores = jnp.einsum("bh,bth->bt", out, enc_outs)
+        scores = jnp.where(enc_mask > 0, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bt,bth->bh", probs, enc_outs)
+        attn_h = self.attn_out(jnp.concatenate([ctx, out], axis=-1))
+        return attn_h, (h, c)
+
+
+class Seq2Seq(nn.Layer):
+    def __init__(self, cfg: Seq2SeqConfig):
+        super().__init__(dtype=cfg.dtype)
+        self.cfg = cfg
+        self.encoder = Encoder(cfg)
+        self.tgt_embed = nn.Embedding([cfg.tgt_vocab, cfg.embed_dim],
+                                      dtype=cfg.dtype)
+        self.dec_cell = AttentionDecoderCell(cfg)
+        self.out_proj = nn.Linear(cfg.hidden_size, cfg.tgt_vocab,
+                                  dtype=cfg.dtype)
+
+    def _enc_mask(self, src_ids, src_len):
+        t = src_ids.shape[1]
+        if src_len is None:
+            return jnp.ones(src_ids.shape[:2], jnp.float32)
+        return (jnp.arange(t)[None, :] < src_len[:, None]).astype(
+            jnp.float32)
+
+    def forward(self, src_ids, tgt_in, src_len=None):
+        """Teacher-forced logits [B, T_tgt, V]."""
+        enc_outs, state = self.encoder(src_ids, src_len)
+        mask = self._enc_mask(src_ids, src_len)
+        x = self.tgt_embed(tgt_in)                    # [B, T, E]
+
+        def step(carry, x_t):
+            st = carry
+            attn_h, st = self.dec_cell(x_t, st, enc_outs, mask)
+            return st, attn_h
+
+        _, hs = jax.lax.scan(step, state, jnp.swapaxes(x, 0, 1))
+        hs = jnp.swapaxes(hs, 0, 1)                   # [B, T, H]
+        return self.out_proj(hs)
+
+    def loss(self, src_ids, tgt_in, tgt_out, src_len=None, tgt_len=None):
+        logits = self.forward(src_ids, tgt_in, src_len)
+        logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, tgt_out[..., None],
+                                   axis=-1)[..., 0]   # [B, T]
+        if tgt_len is not None:
+            m = (jnp.arange(nll.shape[1])[None, :]
+                 < tgt_len[:, None]).astype(nll.dtype)
+            return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+        return nll.mean()
+
+    # -- decoding ----------------------------------------------------------
+
+    def greedy_decode(self, src_ids, max_len, src_len=None):
+        """[B, max_len] token ids, argmax decoding under lax.scan."""
+        cfg = self.cfg
+        enc_outs, state = self.encoder(src_ids, src_len)
+        mask = self._enc_mask(src_ids, src_len)
+        b = src_ids.shape[0]
+        tok0 = jnp.full((b,), cfg.bos_id, jnp.int32)
+
+        def step(carry, _):
+            tok, st, done = carry
+            x_t = self.tgt_embed(tok)
+            attn_h, st = self.dec_cell(x_t, st, enc_outs, mask)
+            logits = self.out_proj(attn_h)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(done, cfg.eos_id, nxt)
+            done = done | (nxt == cfg.eos_id)
+            return (nxt, st, done), nxt
+
+        done0 = jnp.zeros((b,), bool)
+        _, toks = jax.lax.scan(step, (tok0, state, done0), None,
+                               length=max_len)
+        return jnp.swapaxes(toks, 0, 1)               # [B, max_len]
+
+    def beam_search_decode(self, src_ids, max_len, beam_size=4,
+                           src_len=None, length_penalty=0.0):
+        """Fixed-width beam search: returns (tokens [B, beam, max_len],
+        scores [B, beam]) sorted best-first.
+
+        Replaces the reference's LoD-shrinking beam_search_op with a
+        static [B, beam] lattice: finished beams are locked to EOS with
+        their score frozen; parent states gather by beam index each step.
+        """
+        cfg = self.cfg
+        b = src_ids.shape[0]
+        k = beam_size
+        enc_outs, (h, c) = self.encoder(src_ids, src_len)
+        mask = self._enc_mask(src_ids, src_len)
+
+        # tile batch -> [B*k, ...]
+        def tile(x):
+            return jnp.repeat(x, k, axis=0)
+
+        enc_outs_t, mask_t = tile(enc_outs), tile(mask)
+        state = (tile(h), tile(c))
+        tok = jnp.full((b * k,), cfg.bos_id, jnp.int32)
+        # only beam 0 is live initially (others -inf so the first top-k
+        # draws k distinct continuations of beam 0)
+        scores = jnp.tile(jnp.asarray([0.0] + [NEG_INF] * (k - 1),
+                                      jnp.float32), (b,))  # [B*k]
+        done = jnp.zeros((b * k,), bool)
+
+        def step(carry, _):
+            tok, state, scores, done = carry
+            x_t = self.tgt_embed(tok)
+            attn_h, new_state = self.dec_cell(x_t, state, enc_outs_t,
+                                              mask_t)
+            logp = jax.nn.log_softmax(
+                self.out_proj(attn_h).astype(jnp.float32), axis=-1)
+            v = logp.shape[-1]
+            # finished beams: only EOS continuation, at zero cost
+            eos_only = jnp.full((v,), NEG_INF).at[cfg.eos_id].set(0.0)
+            logp = jnp.where(done[:, None], eos_only[None, :], logp)
+            cand = scores[:, None] + logp             # [B*k, V]
+            cand = cand.reshape(b, k * v)
+            top_scores, top_idx = jax.lax.top_k(cand, k)   # [B, k]
+            parent = top_idx // v                     # beam index in [0,k)
+            token = (top_idx % v).astype(jnp.int32)
+            flat_parent = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+            new_state = jax.tree.map(lambda s: s[flat_parent], new_state)
+            tok = token.reshape(-1)
+            scores = top_scores.reshape(-1)
+            done = done[flat_parent] | (tok == cfg.eos_id)
+            return (tok, new_state, scores, done), (tok, flat_parent)
+
+        (tok, state, scores, done), (toks, parents) = jax.lax.scan(
+            step, (tok, state, scores, done), None, length=max_len)
+
+        # backtrack parent pointers to recover sequences [max_len, B*k]
+        def back(carry, t):
+            beam_idx = carry
+            tok_t = toks[t][beam_idx]
+            beam_idx = parents[t][beam_idx]
+            return beam_idx, tok_t
+
+        idx0 = jnp.arange(b * k)
+        _, rev = jax.lax.scan(back, idx0, jnp.arange(max_len - 1, -1, -1))
+        seqs = jnp.flip(rev, axis=0)                  # [max_len, B*k]
+        seqs = jnp.swapaxes(seqs, 0, 1).reshape(b, k, max_len)
+        scores = scores.reshape(b, k)
+        if length_penalty:
+            lens = (seqs != cfg.eos_id).sum(axis=-1).astype(jnp.float32)
+            scores = scores / ((5.0 + lens) / 6.0) ** length_penalty
+        order = jnp.argsort(-scores, axis=-1)
+        seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+        return seqs, scores

@@ -32,7 +32,7 @@ __all__ = [
     "LayerNorm", "GroupNorm", "Embedding", "Dropout", "Sequential",
     "LayerList", "ReLU", "GELU", "Sigmoid", "Tanh", "Softmax",
     "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
-    "scaled_dot_product_attention",
+    "scaled_dot_product_attention", "LSTMCell", "GRUCell", "RNN",
 ]
 
 functional = F
@@ -392,3 +392,108 @@ class TransformerEncoder(Layer):
         for layer in self.layers:
             src = layer(src, src_mask)
         return src
+
+
+class LSTMCell(Layer):
+    """Standard LSTM cell (parity: the reference's lstm/dynamic_lstm op
+    family, operators/lstm_op.h math with forget-bias folded in).
+
+    call(x [B,I], (h [B,H], c [B,H])) -> (h', (h', c'))
+    """
+
+    def __init__(self, input_size, hidden_size, forget_bias=0.0,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.forget_bias = forget_bias
+        self.weight_ih = self.create_parameter([input_size, 4 * hidden_size])
+        self.weight_hh = self.create_parameter([hidden_size, 4 * hidden_size])
+        self.bias = self.create_parameter([4 * hidden_size], is_bias=True)
+
+    def forward(self, x, state):
+        import jax.numpy as jnp
+
+        h, c = state
+        gates = (x @ F._val(self.weight_ih) + h @ F._val(self.weight_hh)
+                 + F._val(self.bias))
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = F.sigmoid(i)
+        f = F.sigmoid(f + self.forget_bias)
+        g = jnp.tanh(g)
+        o = F.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+    def zero_state(self, batch):
+        import jax.numpy as jnp
+
+        z = jnp.zeros((batch, self.hidden_size), self._dtype)
+        return (z, z)
+
+
+class GRUCell(Layer):
+    """GRU cell (parity: gru_op.h / dynamic_gru)."""
+
+    def __init__(self, input_size, hidden_size, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter([input_size, 3 * hidden_size])
+        self.weight_hh = self.create_parameter([hidden_size, 3 * hidden_size])
+        self.bias = self.create_parameter([3 * hidden_size], is_bias=True)
+
+    def forward(self, x, state):
+        import jax.numpy as jnp
+
+        h = state
+        xi = x @ F._val(self.weight_ih) + F._val(self.bias)
+        hi = h @ F._val(self.weight_hh)
+        xr, xz, xn = jnp.split(xi, 3, axis=-1)
+        hr, hz, hn = jnp.split(hi, 3, axis=-1)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+
+    def zero_state(self, batch):
+        import jax.numpy as jnp
+
+        return jnp.zeros((batch, self.hidden_size), self._dtype)
+
+
+class RNN(Layer):
+    """Run a cell over [B, T, I] with lax.scan; optional length masking
+    freezes state past each sequence's end (dynamic_rnn parity)."""
+
+    def __init__(self, cell, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.time_major = time_major
+
+    def forward(self, x, initial_state=None, length=None):
+        import jax
+        import jax.numpy as jnp
+
+        if not self.time_major:
+            x = jnp.swapaxes(x, 0, 1)          # [T, B, I]
+        batch = x.shape[1]
+        state = (initial_state if initial_state is not None
+                 else self.cell.zero_state(batch))
+
+        def step(carry, inp):
+            t, st = carry
+            out, new_st = self.cell(inp, st)
+            if length is not None:
+                alive = (t < length).reshape((batch,) + (1,))
+                new_st = jax.tree.map(
+                    lambda n, o: jnp.where(alive, n, o), new_st, st)
+                out = jnp.where(alive, out, 0.0)
+            return (t + 1, new_st), out
+
+        (_, final_state), outs = jax.lax.scan(step, (0, state), x)
+        if not self.time_major:
+            outs = jnp.swapaxes(outs, 0, 1)    # [B, T, H]
+        return outs, final_state

@@ -10,5 +10,6 @@ from . import math_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import tensor_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
 
 __all__ = ["register_op", "get_op", "has_op", "list_ops"]

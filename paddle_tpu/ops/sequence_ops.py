@@ -1,0 +1,107 @@
+"""Sequence op kernels over padded [B, T, ...] + length [B].
+
+Kernel-level parity with /root/reference/paddle/fluid/operators/
+sequence_ops/ (sequence_pool_op.h, sequence_softmax_op.h,
+sequence_reverse_op.h, sequence_expand_op.h, sequence_mask_op.h) with
+the ragged-offset walks replaced by masked dense math — identical
+results on the valid prefix, static shapes for XLA.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+NEG_INF = -1e30
+
+
+def _length(ins):
+    return jnp.asarray(ins["Length"]).reshape(-1)
+
+
+def _mask(length, maxlen, dtype=jnp.float32):
+    # [B, T] 1.0 where t < length[b]
+    t = jnp.arange(maxlen)[None, :]
+    return (t < length[:, None]).astype(dtype)
+
+
+@register_op("sequence_mask")
+def sequence_mask(ins, attrs):
+    length = jnp.asarray(ins["X"]).reshape(-1)
+    maxlen = int(attrs["maxlen"])
+    dt = attrs.get("out_dtype", "float32")
+    return {"Out": _mask(length, maxlen, jnp.dtype(dt))}
+
+
+@register_op("sequence_pool")
+def sequence_pool(ins, attrs):
+    x = jnp.asarray(ins["X"])                   # [B, T, ...]
+    length = _length(ins)
+    pool = attrs.get("pooltype", "AVERAGE").upper()
+    t = x.shape[1]
+    m = _mask(length, t, x.dtype)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    if pool == "SUM":
+        out = (x * m).sum(axis=1)
+    elif pool == "AVERAGE":
+        denom = jnp.maximum(length.astype(x.dtype), 1)
+        denom = denom.reshape((-1,) + (1,) * (x.ndim - 2))
+        out = (x * m).sum(axis=1) / denom
+    elif pool == "SQRT":
+        denom = jnp.sqrt(jnp.maximum(length.astype(x.dtype), 1))
+        denom = denom.reshape((-1,) + (1,) * (x.ndim - 2))
+        out = (x * m).sum(axis=1) / denom
+    elif pool == "MAX":
+        out = jnp.where(m > 0, x, NEG_INF).max(axis=1)
+        # all-pad rows: match the reference's 0 output for empty seqs
+        empty = (length == 0).reshape((-1,) + (1,) * (x.ndim - 2))
+        out = jnp.where(empty, 0.0, out).astype(x.dtype)
+    elif pool == "LAST":
+        idx = jnp.maximum(length - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+        empty = (length == 0).reshape((-1,) + (1,) * (x.ndim - 2))
+        out = jnp.where(empty, 0.0, out).astype(x.dtype)
+    elif pool == "FIRST":
+        empty = (length == 0).reshape((-1,) + (1,) * (x.ndim - 2))
+        out = jnp.where(empty, 0.0, x[:, 0]).astype(x.dtype)
+    else:
+        raise NotImplementedError(f"pooltype {pool}")
+    return {"Out": out}
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(ins, attrs):
+    x = jnp.asarray(ins["X"])                   # [B, T]
+    length = _length(ins)
+    m = _mask(length, x.shape[1], jnp.float32)
+    z = jnp.where(m > 0, x.astype(jnp.float32), NEG_INF)
+    p = jax.nn.softmax(z, axis=1) * m
+    # renormalise (softmax of all-masked row is garbage -> zeros;
+    # masked positions of p are already exactly 0)
+    denom = jnp.maximum(p.sum(axis=1, keepdims=True), 1e-12)
+    return {"Out": (p / denom).astype(x.dtype)}
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(ins, attrs):
+    x = jnp.asarray(ins["X"])                   # [B, T, ...]
+    length = _length(ins)
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]                # [1, T]
+    # index of source step: within valid prefix reverse, else identity
+    src = jnp.where(pos < length[:, None], length[:, None] - 1 - pos, pos)
+    src = src.reshape((x.shape[0], t) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.take_along_axis(x, src, axis=1)}
+
+
+@register_op("sequence_expand")
+def sequence_expand(ins, attrs):
+    x = jnp.asarray(ins["X"])                   # [B, ...]
+    length = _length(ins)
+    maxlen = int(attrs["maxlen"])
+    out = jnp.repeat(x[:, None], maxlen, axis=1)
+    m = _mask(length, maxlen, x.dtype)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 1))
+    return {"Out": out * m}

@@ -1,0 +1,190 @@
+"""Static-graph control flow lowered onto lax.cond/while_loop/scan.
+
+Parity spec: the reference's control-flow op tests
+(test_while_op.py, test_cond.py, test_switch.py semantics).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_cond_selects_branch():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        flag = fluid.data("flag", [1], dtype="float32")
+        pred = layers.greater_than(
+            layers.reduce_sum(flag), layers.fill_constant([1], "float32", 0.0))
+        out = layers.cond(pred,
+                          lambda: layers.scale(x, scale=2.0),
+                          lambda: layers.scale(x, scale=-1.0))
+    xv = np.ones((2, 4), np.float32)
+    (pos,) = _run(main, startup,
+                  {"x": xv, "flag": np.array([1.0], np.float32)}, [out])
+    np.testing.assert_allclose(np.asarray(pos), 2 * xv)
+    (neg,) = _run(main, startup,
+                  {"x": xv, "flag": np.array([-1.0], np.float32)}, [out])
+    np.testing.assert_allclose(np.asarray(neg), -xv)
+
+
+def test_cond_multiple_outputs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        pred = layers.greater_than(
+            layers.reduce_sum(x),
+            layers.fill_constant([1], "float32", 1e9))  # always false
+        a, b = layers.cond(
+            pred,
+            lambda: [layers.scale(x, scale=1.0), layers.scale(x, scale=2.0)],
+            lambda: [layers.scale(x, scale=3.0), layers.scale(x, scale=4.0)])
+    xv = np.ones((2, 4), np.float32)
+    ra, rb = _run(main, startup, {"x": xv}, [a, b])
+    np.testing.assert_allclose(np.asarray(ra), 3 * xv)
+    np.testing.assert_allclose(np.asarray(rb), 4 * xv)
+
+
+def test_while_loop_counts():
+    # sum 0..9 with a while loop
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "float32", 0.0)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        ten = layers.fill_constant([1], "float32", 10.0)
+
+        def cond_fn(i, acc):
+            return layers.less_than(i, ten)
+
+        def body_fn(i, acc):
+            return [i + 1.0, acc + i]
+
+        i_out, acc_out = layers.while_loop(cond_fn, body_fn, [i, acc])
+    res = _run(main, startup, {}, [acc_out, i_out])
+    assert float(np.asarray(res[0])) == 45.0
+    assert float(np.asarray(res[1])) == 10.0
+
+
+def test_while_loop_with_tensor_state():
+    # power iteration: x <- x @ W repeatedly, with tensor loop state
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 2])
+        i = layers.fill_constant([1], "float32", 0.0)
+        three = layers.fill_constant([1], "float32", 3.0)
+        io, xo = layers.while_loop(
+            lambda i, x: layers.less_than(i, three),
+            lambda i, x: [i + 1.0, layers.scale(x, scale=2.0)],
+            [i, x])
+    xv = np.ones((2, 2), np.float32)
+    (out,) = _run(main, startup, {"x": xv}, [xo])
+    np.testing.assert_allclose(np.asarray(out), 8 * xv)
+
+
+def test_static_rnn_matches_manual_scan():
+    seq, batch, dim = 5, 3, 4
+    r = np.random.default_rng(0)
+    xv = r.normal(size=(seq, batch, dim)).astype(np.float32)
+    h0v = np.zeros((batch, dim), np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [seq, batch, dim])
+        h0 = fluid.data("h0", [batch, dim])
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            prev = rnn.memory(init=h0)
+            h = layers.tanh(layers.elementwise_add(x_t, prev))
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    (res,) = _run(main, startup, {"x": xv, "h0": h0v}, [out])
+
+    ref = []
+    h = h0v
+    for t in range(seq):
+        h = np.tanh(xv[t] + h)
+        ref.append(h)
+    np.testing.assert_allclose(np.asarray(res), np.stack(ref), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_tensor_array_roundtrip():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        arr = layers.create_array("float32")
+        i0 = layers.fill_constant([1], "int64", 0)
+        i1 = layers.fill_constant([1], "int64", 1)
+        layers.array_write(x, i0, arr)
+        layers.array_write(layers.scale(x, scale=3.0), i1, arr)
+        n = layers.array_length(arr)
+        back = layers.array_read(arr, i1)
+    xv = np.ones((2, 4), np.float32)
+    nv, bv = _run(main, startup, {"x": xv}, [n, back])
+    assert int(np.asarray(nv)) == 2
+    np.testing.assert_allclose(np.asarray(bv), 3 * xv)
+
+
+def test_grad_through_while_loop():
+    # d/dw of (w doubled 3 times) -> 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_parameter([1], "float32", name="w",
+                                          default_initializer=
+                                          fluid.initializer.Constant(1.0))
+        i = layers.fill_constant([1], "float32", 0.0)
+        three = layers.fill_constant([1], "float32", 3.0)
+        _, wo = layers.while_loop(
+            lambda i, v: layers.less_than(i, three),
+            lambda i, v: [i + 1.0, layers.scale(v, scale=2.0)],
+            [i, w], maximum_trip_count=8)
+        loss = layers.reduce_sum(wo)
+        fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    (lv,) = exe.run(main, feed={}, fetch_list=[loss])
+    assert float(np.asarray(lv)) == 8.0
+    # after one SGD step with grad 8: w = 1 - 8 = -7
+    (lv2,) = exe.run(main, feed={}, fetch_list=[loss])
+    assert float(np.asarray(lv2)) == -56.0
+
+
+def test_switch_selects_case():
+    # the reference's LR-boundary pattern: assign into an outer var
+    def build(step_val):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            step = fluid.data("step", [1], dtype="float32")
+            lr = layers.fill_constant([1], "float32", 0.0)
+            b1 = layers.fill_constant([1], "float32", 10.0)
+            b2 = layers.fill_constant([1], "float32", 20.0)
+            with layers.Switch() as sw:
+                with sw.case(layers.less_than(step, b1)):
+                    layers.assign(layers.fill_constant([1], "float32", 1.0),
+                                  lr)
+                with sw.case(layers.less_than(step, b2)):
+                    layers.assign(layers.fill_constant([1], "float32", 0.1),
+                                  lr)
+                with sw.default():
+                    layers.assign(layers.fill_constant([1], "float32", 0.01),
+                                  lr)
+        exe = fluid.Executor()
+        exe.run(startup)
+        (out,) = exe.run(main,
+                         feed={"step": np.array([step_val], np.float32)},
+                         fetch_list=[lr])
+        return float(np.asarray(out))
+
+    assert build(5.0) == 1.0
+    assert build(15.0) == pytest.approx(0.1)
+    assert build(25.0) == pytest.approx(0.01)

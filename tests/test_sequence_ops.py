@@ -1,0 +1,80 @@
+"""Sequence op kernels vs numpy references on the valid prefix
+(OpTest-style spec of operators/sequence_ops/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(builder, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = builder()
+    exe = fluid.Executor()
+    exe.run(startup)
+    outs = exe.run(main, feed=feed, fetch_list=fetch)
+    return [np.asarray(o) for o in outs]
+
+
+X = np.array([[[1.0, 2], [3, 4], [5, 6]],
+              [[7, 8], [9, 10], [0, 0]]], np.float32)   # [2, 3, 2]
+LEN = np.array([3, 2], np.int64)
+
+
+def test_sequence_mask():
+    def build():
+        l = fluid.data("l", [None], dtype="int64")
+        return [layers.sequence_mask(l, maxlen=4)]
+    (m,) = _run(build, {"l": LEN})
+    np.testing.assert_array_equal(m, [[1, 1, 1, 0], [1, 1, 0, 0]])
+
+
+@pytest.mark.parametrize("pool,expect", [
+    ("sum", np.array([[9, 12], [16, 18]], np.float32)),
+    ("average", np.array([[3, 4], [8, 9]], np.float32)),
+    ("max", np.array([[5, 6], [9, 10]], np.float32)),
+    ("last", np.array([[5, 6], [9, 10]], np.float32)),
+    ("first", np.array([[1, 2], [7, 8]], np.float32)),
+])
+def test_sequence_pool(pool, expect):
+    def build():
+        x = fluid.data("x", [None, 3, 2])
+        l = fluid.data("l", [None], dtype="int64")
+        return [layers.sequence_pool(x, l, pool)]
+    (out,) = _run(build, {"x": X, "l": LEN})
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_sequence_softmax_masks_and_normalises():
+    def build():
+        x = fluid.data("x", [None, 3])
+        l = fluid.data("l", [None], dtype="int64")
+        return [layers.sequence_softmax(x, l)]
+    xv = np.array([[1.0, 2, 3], [1, 1, 99]], np.float32)
+    (out,) = _run(build, {"x": xv, "l": LEN})
+    np.testing.assert_allclose(out.sum(1), [1.0, 1.0], rtol=1e-5)
+    assert out[1, 2] == 0.0                  # masked step ignored (99)
+    np.testing.assert_allclose(out[1, :2], [0.5, 0.5], rtol=1e-5)
+
+
+def test_sequence_reverse_keeps_padding():
+    def build():
+        x = fluid.data("x", [None, 3, 2])
+        l = fluid.data("l", [None], dtype="int64")
+        return [layers.sequence_reverse(x, l)]
+    (out,) = _run(build, {"x": X, "l": LEN})
+    np.testing.assert_allclose(out[0], [[5, 6], [3, 4], [1, 2]])
+    np.testing.assert_allclose(out[1], [[9, 10], [7, 8], [0, 0]])
+
+
+def test_sequence_expand():
+    def build():
+        x = fluid.data("x", [None, 2])
+        l = fluid.data("l", [None], dtype="int64")
+        return [layers.sequence_expand(x, l, ref_maxlen=3)]
+    xv = np.array([[1.0, 2], [3, 4]], np.float32)
+    (out,) = _run(build, {"x": xv, "l": LEN})
+    np.testing.assert_allclose(out[0], [[1, 2], [1, 2], [1, 2]])
+    np.testing.assert_allclose(out[1], [[3, 4], [3, 4], [0, 0]])
