@@ -246,15 +246,19 @@ def make_amp_train_step(model, optimizer, loss_fn=None, jit=True,
         sc = scaler_update(sc, finite)
 
         def do_update(_):
-            return optimizer.update(ts.params, grads, ts.opt_state)
+            params, opt_state = optimizer.update(ts.params, grads,
+                                                 ts.opt_state)
+            return params, opt_state, new_buffers
 
         def skip_update(_):
-            return ts.params, ts.opt_state
+            # an overflow step commits NOTHING: buffers from the overflowed
+            # forward (e.g. batch-norm running stats) may carry NaN/Inf
+            return ts.params, ts.opt_state, ts.buffers
 
-        params, opt_state = _jax.lax.cond(finite, do_update, skip_update,
-                                          None)
+        params, opt_state, buffers = _jax.lax.cond(finite, do_update,
+                                                   skip_update, None)
         new_ts = TrainState(params=params, opt_state=opt_state,
-                            buffers=new_buffers, step=ts.step + 1,
+                            buffers=buffers, step=ts.step + 1,
                             rng=new_rng)
         return (new_ts, sc), loss, finite
 

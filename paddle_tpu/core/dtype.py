@@ -4,6 +4,13 @@ Parity with the reference's ``VarType.Type`` dtype enum
 (/root/reference/paddle/fluid/framework/framework.proto:104) but expressed as
 a thin mapping onto JAX/numpy dtypes.  bfloat16 is first-class (TPU native);
 float16 is kept for API parity.
+
+Integer policy (explicit contract): **int32 on device**. The reference uses
+int64 for ids/indices throughout; TPUs have no 64-bit scalar unit and JAX
+disables x64 by default, so any "int64"/"float64" request resolves to the
+32-bit device dtype here (one documented place) rather than being silently
+truncated per-op with warnings. Host-side numpy/C++ buffers (PS tables,
+native data feed) keep real int64 — only what lands on device narrows.
 """
 
 import numpy as np
@@ -61,9 +68,31 @@ def convert_dtype(dtype):
     return name
 
 
+# 64-bit -> 32-bit device canonicalization (see module docstring). Applied
+# only when JAX x64 is off (the default); flipping jax_enable_x64 restores
+# true 64-bit end to end.
+_DEVICE_NARROW = {
+    "int64": "int32",
+    "float64": "float32",
+    "complex128": "complex64",
+}
+
+
 def to_jax_dtype(dtype):
-    """Any dtype spec -> jnp dtype object."""
-    return _NAME_TO_DTYPE[convert_dtype(dtype)]
+    """Any dtype spec -> jnp dtype object (device canonical; see docstring)."""
+    name = convert_dtype(dtype)
+    from jax import config as _cfg
+    if not _cfg.jax_enable_x64:
+        name = _DEVICE_NARROW.get(name, name)
+    return _NAME_TO_DTYPE[name]
+
+
+def index_dtype():
+    """Dtype for emitted indices (argmax/top_k/size/...): the reference
+    emits int64; under the device contract this is int32 unless
+    jax_enable_x64 is on (then true int64, keeping the narrowing promise
+    in one place)."""
+    return to_jax_dtype("int64")
 
 
 def is_floating(dtype):

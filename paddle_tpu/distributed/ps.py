@@ -19,6 +19,7 @@ DownpourWorker pull→compute→push loop, framework/device_worker.h:203):
   tables skip the network entirely.
 """
 
+import io
 import pickle
 import queue
 import socket
@@ -316,6 +317,31 @@ class Communicator:
 # TCP control plane (listen_and_serv parity)
 # --------------------------------------------------------------------------
 
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Deserialization allow-list: the PS wire protocol only ever carries
+    builtins + numpy arrays/scalars. Anything else (os.system, functools,
+    arbitrary classes) is refused, so a peer that can reach the port cannot
+    get code execution through the pickle layer."""
+
+    _ALLOWED = {
+        ("builtins", "complex"), ("builtins", "frozenset"),
+        ("builtins", "set"), ("builtins", "slice"), ("builtins", "bytearray"),
+        ("numpy", "ndarray"), ("numpy", "dtype"),
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy.core.numeric", "_frombuffer"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy._core.numeric", "_frombuffer"),
+    }
+
+    def find_class(self, module, name):
+        if (module, name) in self._ALLOWED:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"PS wire format forbids {module}.{name}")
+
+
 def _send_msg(sock, obj):
     data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(struct.pack("<Q", len(data)) + data)
@@ -335,7 +361,7 @@ def _recv_msg(sock):
         if not chunk:
             raise ConnectionError("peer closed")
         buf += chunk
-    return pickle.loads(bytes(buf))
+    return _RestrictedUnpickler(io.BytesIO(bytes(buf))).load()
 
 
 class PSServer:

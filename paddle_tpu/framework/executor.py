@@ -183,7 +183,9 @@ def _run_switch(op, env, rng_box, const_env=None):
     result = tuple(env[n] for n in out_names)
     if a.get("default_block") is not None:
         d_ops = program.blocks[a["default_block"]].ops
-        result = _branch_fn(d_ops, env, jax.random.fold_in(k, -1),
+        # branch id past all case ids; fold_in rejects negative ints
+        result = _branch_fn(d_ops, env,
+                            jax.random.fold_in(k, len(a["case_blocks"])),
                             out_names, const_env)({})
     for i in range(len(a["case_blocks"]) - 1, -1, -1):
         pred = jnp.asarray(env[a["case_preds"][i]]).reshape(())
@@ -303,7 +305,7 @@ def _run_array_op(op, env, rng_box, const_env=None):
         return
     if t == "array_length":
         arr = env[op.inputs["Array"][0]]
-        env[op.outputs["Out"][0]] = jnp.asarray(len(arr), jnp.int64)
+        env[op.outputs["Out"][0]] = jnp.asarray(len(arr), jnp.int32)
         return
 
 

@@ -8,6 +8,7 @@ sequence lengths, and an XLA-fused softmax(QK^T)V composition otherwise.
 """
 
 import math
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -58,12 +59,20 @@ def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
         and seq % 128 == 0
         and head_dim in (64, 128, 256)
     )
+    forced_flash = use_flash is True
     if use_flash is None:
         # Below ~1k tokens XLA's fused softmax(QK^T)V is faster on-chip
         # (the S^2 matrix still fits cache-friendly tiles); flash wins
         # once the S^2 materialisation starts thrashing HBM (measured
         # crossover on v5e: 512 -> XLA, 2048 -> flash by ~20%).
         use_flash = (jax.default_backend() == "tpu" and seq >= 1024)
+    if forced_flash and not can_flash:
+        warnings.warn(
+            "use_flash=True requested but the flash kernel cannot serve this "
+            f"call (mask={mask is not None}, dropout={dropout_p}, seq={seq}, "
+            f"head_dim={head_dim}; needs no mask, no train-dropout, "
+            "self-attention, seq%128==0, head_dim in 64/128/256) — "
+            "falling back to the XLA path", stacklevel=2)
     if use_flash and can_flash:
         from .flash_attention import flash_attention
 

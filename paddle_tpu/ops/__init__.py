@@ -11,5 +11,9 @@ from . import nn_ops  # noqa: F401
 from . import tensor_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
+from . import loss_ops  # noqa: F401
+from . import metrics_ops  # noqa: F401
+from . import decode_ops  # noqa: F401
 
 __all__ = ["register_op", "get_op", "has_op", "list_ops"]

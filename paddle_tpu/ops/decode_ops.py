@@ -1,0 +1,89 @@
+"""Decode-time ops: beam_search, beam_search_decode, gather_tree.
+
+TPU-native replacements for /root/reference/paddle/fluid/operators/
+{beam_search,beam_search_decode,gather_tree}_op.cc and the dynamic_decode
+machinery in python/paddle/fluid/layers/rnn.py. The reference threads
+ragged LoD beams through per-step ops; here beams live in a dense
+[batch, beam_size] layout (static shapes for XLA) and the LoD bookkeeping
+becomes parent-pointer tensors consumed by gather_tree.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from .sequence_ops import NEG_INF
+
+
+@register_op("beam_search")
+def beam_search(ins, attrs):
+    """operators/beam_search_op.cc — one step of beam search. Dense form:
+    pre_ids [B, K], pre_scores [B, K], scores [B, K, V] (log-probs of the
+    candidate expansion). Selects the top beam_size of the K*V candidates
+    per source sequence; emits selected ids, scores, and parent beam
+    indices. Finished beams (pre_id == end_id) keep their score and only
+    propose the end token (rnn.py dynamic_decode parity)."""
+    pre_ids = jnp.asarray(ins["pre_ids"]).astype(jnp.int32)     # [B, K]
+    pre_scores = jnp.asarray(ins["pre_scores"])                 # [B, K]
+    scores = jnp.asarray(ins["scores"])                         # [B, K, V]
+    beam_size = int(attrs.get("beam_size", pre_ids.shape[1]))
+    end_id = int(attrs.get("end_id", 0))
+    b, k, v = scores.shape
+    finished = pre_ids == end_id
+    # finished beams: freeze — only the end token, carrying the old score
+    frozen = jnp.full((k, v), NEG_INF).at[:, end_id].set(0.0)
+    cand = jnp.where(finished[:, :, None], frozen[None],
+                     scores) + pre_scores[:, :, None]
+    flat = cand.reshape(b, k * v)
+    top_scores, top_idx = jax.lax.top_k(flat, beam_size)
+    parent = (top_idx // v).astype(jnp.int32)
+    ids = (top_idx % v).astype(jnp.int32)
+    return {"selected_ids": ids, "selected_scores": top_scores,
+            "parent_idx": parent}
+
+
+@register_op("gather_tree")
+def gather_tree(ins, attrs):
+    """operators/gather_tree_op.cc — back-track parent pointers to turn
+    per-step selected ids [T, B, K] + parents [T, B, K] into full
+    sequences."""
+    ids = jnp.asarray(ins["Ids"]).astype(jnp.int32)        # [T, B, K]
+    parents = jnp.asarray(ins["Parents"]).astype(jnp.int32)
+    t, b, k = ids.shape
+
+    def step(beam, inp):
+        # beam: [B, K] current beam slot per output column
+        step_ids, step_parents = inp
+        cur = jnp.take_along_axis(step_ids, beam, axis=1)
+        nxt = jnp.take_along_axis(step_parents, beam, axis=1)
+        return nxt, cur
+
+    init = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None], (b, k))
+    _, out = jax.lax.scan(step, init, (ids, parents), reverse=True)
+    return {"Out": out}
+
+
+@register_op("beam_search_decode")
+def beam_search_decode(ins, attrs):
+    """operators/beam_search_decode_op.cc — assemble final sequences from
+    the stacked per-step beams; dense form delegates the back-tracking to
+    the gather_tree recurrence and re-attaches scores."""
+    ids = jnp.asarray(ins["Ids"]).astype(jnp.int32)        # [T, B, K]
+    scores = jnp.asarray(ins["Scores"])                    # [T, B, K]
+    parents = jnp.asarray(ins["ParentIdx"]).astype(jnp.int32)
+    seqs = gather_tree({"Ids": ids, "Parents": parents}, {})["Out"]
+    end_id = int(attrs.get("end_id", 0))
+    # sentence score = score at the first end_id step (or last step)
+    t, b, k = ids.shape
+    is_end = seqs == end_id
+    first_end = jnp.argmax(is_end, axis=0)                 # 0 if none
+    has_end = is_end.any(axis=0)
+    last = jnp.full((b, k), t - 1, jnp.int32)
+    pick = jnp.where(has_end, first_end.astype(jnp.int32), last)
+    sent_scores = jnp.take_along_axis(
+        scores, pick[None], axis=0)[0]                     # [B, K]
+    # valid length per beam: first end position + 1 (or T)
+    lengths = jnp.where(has_end, first_end + 1, t).astype(jnp.int32)
+    return {"SentenceIds": jnp.moveaxis(seqs, 0, 1),       # [B, T, K]
+            "SentenceScores": sent_scores,
+            "SentenceLength": lengths}

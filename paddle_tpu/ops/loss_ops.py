@@ -1,0 +1,459 @@
+"""Ranking / classification loss kernels + CRF + CTC.
+
+TPU-native replacements for /root/reference/paddle/fluid/operators/
+{rank_loss,margin_rank_loss,hinge_loss,bpr_loss,modified_huber_loss,
+teacher_student_sigmoid_loss,center_loss,cos_sim,npair?,nce,
+hierarchical_sigmoid,sample_logits,linear_chain_crf,crf_decoding,
+warpctc,edit_distance,ctc_align}_op.cc. DP recursions (CRF forward,
+Viterbi, CTC alpha, Levenshtein) are lax.scan loops — one compiled
+XLA while-loop instead of the reference's per-sequence C++ walks.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from .sequence_ops import NEG_INF as _NEG, pack_to_front
+
+
+def _softplus_stable(x):
+    # log(1 + exp(-|x|)) + max(x, 0): the reference's stable BCE building
+    # block (teacher_student_sigmoid_loss_op.h:44-46)
+    return jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register_op("rank_loss")
+def rank_loss(ins, attrs):
+    """operators/rank_loss_op.cc — C = log(1+e^{l-r}) - label*(l-r)."""
+    o = jnp.asarray(ins["Left"]) - jnp.asarray(ins["Right"])
+    label = jnp.asarray(ins["Label"]).astype(o.dtype)
+    return {"Out": _softplus_stable(o) - label * o}
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(ins, attrs):
+    """operators/margin_rank_loss_op.cc — relu(-label*(x1-x2)+margin)."""
+    x1 = jnp.asarray(ins["X1"])
+    x2 = jnp.asarray(ins["X2"])
+    label = jnp.asarray(ins["Label"]).astype(x1.dtype)
+    margin = float(attrs.get("margin", 0.0))
+    act = -label * (x1 - x2) + margin
+    return {"Out": jax.nn.relu(act), "Activated": (act > 0).astype(x1.dtype)}
+
+
+@register_op("hinge_loss")
+def hinge_loss(ins, attrs):
+    """operators/hinge_loss_op.cc — relu(1 - (2*label-1) * pred)."""
+    pred = jnp.asarray(ins["Logits"])
+    label = jnp.asarray(ins["Labels"]).astype(pred.dtype)
+    return {"Loss": jax.nn.relu(1.0 - (2.0 * label - 1.0) * pred)}
+
+
+@register_op("bpr_loss")
+def bpr_loss(ins, attrs):
+    """operators/bpr_loss_op.h:62-77 — Bayesian personalized ranking:
+    loss_i = mean_{j != y_i} log(1 + exp(x_ij - x_iy))."""
+    x = jnp.asarray(ins["X"])                   # [N, C]
+    label = jnp.asarray(ins["Label"]).reshape(-1).astype(jnp.int32)
+    n, c = x.shape
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)     # [N, 1]
+    diff = x - pos
+    neg_ll = _softplus_stable(diff)              # log(1 + exp(diff))
+    mask = jnp.arange(c)[None, :] != label[:, None]
+    loss = jnp.where(mask, neg_ll, 0.0).sum(axis=1) / (c - 1)
+    return {"Y": loss[:, None]}
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(ins, attrs):
+    """operators/modified_huber_loss_op.cc — y=2l-1, z=pred*y:
+    (max(0,1-z))^2 if z >= -1 else -4z."""
+    pred = jnp.asarray(ins["X"])
+    label = jnp.asarray(ins["Y"]).astype(pred.dtype)
+    z = pred * (2.0 * label - 1.0)
+    sq = jnp.square(jax.nn.relu(1.0 - z))
+    out = jnp.where(z >= -1.0, sq, -4.0 * z)
+    return {"Out": out, "IntermediateVal": z}
+
+
+@register_op("teacher_student_sigmoid_loss")
+def teacher_student_sigmoid_loss(ins, attrs):
+    """operators/teacher_student_sigmoid_loss_op.h:43-63 — CTR distillation
+    loss with the label encoding {-2: z=0, -1: z=1, [0,1): q, [1,2]: 1+q}."""
+    x = jnp.asarray(ins["X"]).reshape(-1)
+    label = jnp.asarray(ins["Label"]).reshape(-1).astype(x.dtype)
+    sp = _softplus_stable(x)
+    case0 = sp                                   # label < -1: z=0
+    case1 = sp - x                               # label in [-1,0): z=1
+    case2 = sp + sp - x * label                  # label in [0,1): q only
+    case3 = (sp - x) + sp - x * (label - 1.0)    # label >= 1: z=1, q
+    y = jnp.where(label < -1.0, case0,
+                  jnp.where(label < 0.0, case1,
+                            jnp.where(label < 1.0, case2, case3)))
+    return {"Y": y.reshape(jnp.asarray(ins["X"]).shape)}
+
+
+@register_op("center_loss")
+def center_loss(ins, attrs):
+    """operators/center_loss_op.cc — 0.5*||x - center_y||^2 plus the
+    running-center SGD update CentersOut = Centers - alpha * dCenter."""
+    x = jnp.asarray(ins["X"])                    # [N, D]
+    label = jnp.asarray(ins["Label"]).reshape(-1).astype(jnp.int32)
+    centers = jnp.asarray(ins["Centers"])        # [C, D]
+    alpha = jnp.asarray(ins.get("CenterUpdateRate",
+                                attrs.get("alpha", 0.5))).reshape(())
+    sel = centers[label]                         # [N, D]
+    diff = x - sel
+    loss = 0.5 * jnp.square(diff).sum(axis=1, keepdims=True)
+    if bool(attrs.get("need_update", True)):
+        # center gradient: mean of (center - x) per class, count-normalized
+        counts = jnp.zeros((centers.shape[0],), x.dtype).at[label].add(1.0)
+        num = jnp.zeros_like(centers).at[label].add(-diff)
+        upd = num / (1.0 + counts)[:, None]
+        centers_out = centers - alpha * upd
+    else:
+        centers_out = centers
+    return {"Loss": loss, "SampleCenterDiff": diff, "CentersOut": centers_out}
+
+
+@register_op("cos_sim")
+def cos_sim(ins, attrs):
+    """operators/cos_sim_op.cc — row-wise cosine similarity with
+    broadcasting Y of batch 1."""
+    x = jnp.asarray(ins["X"])
+    y = jnp.asarray(ins["Y"])
+    xn = jnp.sqrt(jnp.square(x).sum(axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.square(y).sum(axis=-1, keepdims=True))
+    out = (x * y).sum(axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("npair_loss")
+def npair_loss(ins, attrs):
+    """layers/loss.py npair_loss parity — cross entropy over anchor·positive
+    similarities plus l2 regularization."""
+    anchor = jnp.asarray(ins["Anchor"])          # [N, D]
+    positive = jnp.asarray(ins["Positive"])      # [N, D]
+    labels = jnp.asarray(ins["Labels"]).reshape(-1)
+    l2_reg = float(attrs.get("l2_reg", 0.002))
+    sim = anchor @ positive.T                    # [N, N]
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    tgt = same / same.sum(axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -(tgt * logp).sum(axis=1).mean()
+    # Beta = 0.25 (layers/loss.py:1633-1644)
+    reg = l2_reg * (jnp.square(anchor).sum(axis=1).mean()
+                    + jnp.square(positive).sum(axis=1).mean()) * 0.25
+    return {"Out": ce + reg}
+
+
+@register_op("nce", needs_rng=True)
+def nce(ins, attrs):
+    """operators/nce_op.cc — noise-contrastive estimation with uniform
+    negative sampling (sampler=0 parity); the sampled-ids path is
+    deterministic when CustomDistProbs/SampleIds provided."""
+    x = jnp.asarray(ins["Input"])                # [N, D]
+    w = jnp.asarray(ins["Weight"])               # [C, D]
+    label = jnp.asarray(ins["Label"]).reshape(-1).astype(jnp.int32)
+    b = ins.get("Bias")
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    num_classes = int(attrs.get("num_total_classes", w.shape[0]))
+    n = x.shape[0]
+    if ins.get("SampleIds") is not None:
+        neg = jnp.asarray(ins["SampleIds"]).reshape(n, num_neg)
+    else:
+        key = attrs["_rng"]
+        neg = jax.random.randint(key, (n, num_neg), 0, num_classes)
+    ids = jnp.concatenate([label[:, None], neg], axis=1)     # [N, 1+S]
+    wv = w[ids]                                  # [N, 1+S, D]
+    logits = jnp.einsum("nd,nsd->ns", x, wv)
+    if b is not None:
+        logits = logits + jnp.asarray(b).reshape(-1)[ids]
+    # P(noise) uniform
+    log_pn = jnp.log(jnp.asarray(num_neg / num_classes, x.dtype))
+    adj = logits - log_pn
+    lbl = jnp.zeros_like(adj).at[:, 0].set(1.0)
+    loss = _softplus_stable(adj) - adj * lbl     # per-sample BCE w/ logits
+    return {"Cost": loss.sum(axis=1, keepdims=True),
+            "SampleLogits": logits, "SampleLabels": ids}
+
+
+@register_op("hierarchical_sigmoid")
+def hierarchical_sigmoid(ins, attrs):
+    """operators/hierarchical_sigmoid_op.cc — default complete-binary-tree
+    mode: num_classes-1 internal nodes; the path of class c follows the
+    bits of (c + num_classes) from the MSB side (math/matrix_bit_code.h)."""
+    x = jnp.asarray(ins["X"])                    # [N, D]
+    w = jnp.asarray(ins["W"])                    # [num_classes-1, D]
+    label = jnp.asarray(ins["Label"]).reshape(-1).astype(jnp.int32)
+    bias = ins.get("Bias")
+    num_classes = int(attrs["num_classes"])
+    code_len = max(1, int(jnp.ceil(jnp.log2(num_classes))))
+    # matrix_bit_code: code(c) = c + num_classes; walk bits below the MSB
+    code = label + num_classes
+    # number of significant bits minus 1 = path length per sample
+    nbits = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(jnp.int32)
+    losses = jnp.zeros((x.shape[0], 1), x.dtype)
+    for d in range(code_len):
+        # bit position from the top: index of internal node at depth d
+        depth_ok = d < nbits
+        shift = nbits - d
+        node = (code >> shift) - 1               # internal node index
+        bit = (code >> (shift - 1)) & 1          # next step: left/right
+        node = jnp.clip(node, 0, w.shape[0] - 1)
+        logit = (x * w[node]).sum(axis=1, keepdims=True)
+        if bias is not None:
+            logit = logit + jnp.asarray(bias).reshape(-1)[node][:, None]
+        t = bit.astype(x.dtype)[:, None]
+        step_loss = _softplus_stable(logit) - logit * t
+        losses = losses + jnp.where(depth_ok[:, None], step_loss, 0.0)
+    return {"Cost": losses, "PreOut": jnp.zeros((x.shape[0], code_len),
+                                                x.dtype)}
+
+
+@register_op("sample_logits")
+def sample_logits(ins, attrs):
+    """operators/sample_logits_op.cc — gather [true | sampled] logits for
+    sampled-softmax training; subtracts log-frequency when remove_accidental
+    hits are requested."""
+    logits = jnp.asarray(ins["Logits"])          # [N, C]
+    label = jnp.asarray(ins["Labels"]).astype(jnp.int32)  # [N, T]
+    samples = jnp.asarray(ins["CustomizedSamples"]).astype(jnp.int32)
+    ids = jnp.concatenate([label, samples], axis=1)
+    out = jnp.take_along_axis(logits, ids, axis=1)
+    nt = label.shape[1]
+    if bool(attrs.get("remove_accidental_hits", True)):
+        acc = (samples[:, None, :] == label[:, :, None]).any(axis=1)
+        out = out.at[:, nt:].add(jnp.where(acc, -1e20, 0.0))
+    new_label = jnp.concatenate(
+        [jnp.broadcast_to(jnp.arange(nt)[None], label.shape),
+         jnp.zeros_like(samples)], axis=1)
+    return {"SampledLogits": out, "Samples": ids,
+            "SampledLabels": new_label[:, :nt]}
+
+
+# --------------------------------------------------------------------------
+# CRF
+# --------------------------------------------------------------------------
+
+@register_op("linear_chain_crf")
+def linear_chain_crf(ins, attrs):
+    """operators/linear_chain_crf_op.cc — negative log-likelihood of a
+    linear-chain CRF. Transition [T+2, T]: row 0 = start weights, row 1 =
+    stop weights, rows 2.. = pairwise transitions. Emission [B, L, T] padded
+    + Length [B]."""
+    em = jnp.asarray(ins["Emission"])            # [B, L, T]
+    trans = jnp.asarray(ins["Transition"])       # [T+2, T]
+    label = jnp.asarray(ins["Label"]).astype(jnp.int32)  # [B, L]
+    length = jnp.asarray(ins["Length"]).reshape(-1)
+    b, l, t = em.shape
+    start, stop, pair = trans[0], trans[1], trans[2:]
+
+    # ---- partition function via forward algorithm (log space)
+    def fwd(carry, inp):
+        alpha, pos = carry
+        e, live = inp                             # [B,T], [B,1]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + pair[None], axis=1) + e
+        alpha = jnp.where(live > 0, nxt, alpha)
+        return (alpha, pos + 1), None
+
+    live = (jnp.arange(1, l)[None, :] < length[:, None]).astype(em.dtype)
+    a0 = start[None] + em[:, 0]
+    (alpha, _), _ = jax.lax.scan(
+        fwd, (a0, 1), (jnp.moveaxis(em[:, 1:], 1, 0),
+                       jnp.moveaxis(live[:, :, None], 1, 0)))
+    log_z = jax.nn.logsumexp(alpha + stop[None], axis=1)
+
+    # ---- score of the gold path
+    pos = jnp.arange(l)[None, :]
+    valid = pos < length[:, None]
+    em_score = jnp.where(
+        valid, jnp.take_along_axis(em, label[:, :, None], axis=2)[:, :, 0],
+        0.0).sum(axis=1)
+    prev, cur = label[:, :-1], label[:, 1:]
+    tr_valid = pos[:, 1:] < length[:, None]
+    tr_score = jnp.where(tr_valid, pair[prev, cur], 0.0).sum(axis=1)
+    first = label[:, 0]
+    last = jnp.take_along_axis(
+        label, jnp.maximum(length - 1, 0)[:, None], axis=1)[:, 0]
+    gold = em_score + tr_score + start[first] + stop[last]
+    ll = log_z - gold
+    return {"LogLikelihood": ll[:, None], "Alpha": alpha,
+            "EmissionExps": jnp.exp(em), "TransitionExps": jnp.exp(trans)}
+
+
+@register_op("crf_decoding")
+def crf_decoding(ins, attrs):
+    """operators/crf_decoding_op.cc — Viterbi decode over the same
+    transition layout as linear_chain_crf."""
+    em = jnp.asarray(ins["Emission"])            # [B, L, T]
+    trans = jnp.asarray(ins["Transition"])
+    length = jnp.asarray(ins["Length"]).reshape(-1)
+    b, l, t = em.shape
+    start, stop, pair = trans[0], trans[1], trans[2:]
+
+    def fwd(carry, inp):
+        score = carry
+        e, live = inp
+        cand = score[:, :, None] + pair[None]     # [B, T, T]
+        best = cand.max(axis=1) + e
+        arg = cand.argmax(axis=1).astype(jnp.int32)
+        new = jnp.where(live > 0, best, score)
+        return new, jnp.where(live > 0, arg, jnp.broadcast_to(
+            jnp.arange(t, dtype=jnp.int32)[None], (b, t)))
+
+    live = (jnp.arange(1, l)[None, :] < length[:, None]).astype(em.dtype)
+    s0 = start[None] + em[:, 0]
+    final, back = jax.lax.scan(
+        fwd, s0, (jnp.moveaxis(em[:, 1:], 1, 0),
+                  jnp.moveaxis(live[:, :, None], 1, 0)))
+    final = final + stop[None]
+    last = final.argmax(axis=1).astype(jnp.int32)
+
+    def trace(carry, bp):
+        cur = carry
+        prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0]
+        return prev, cur
+
+    if l > 1:
+        # reverse scan: ys[k] = tag at step k+1; final carry = tag at step 0
+        first, path = jax.lax.scan(trace, last, back, reverse=True)
+        full = jnp.concatenate(
+            [first[:, None], jnp.moveaxis(path, 0, 1)], axis=1)
+    else:
+        full = last[:, None]
+    # positions beyond length: 0 (reference writes only the valid prefix)
+    posm = jnp.arange(l)[None, :] < length[:, None]
+    path = jnp.where(posm, full, 0)
+    if ins.get("Label") is not None:
+        # correctness-mask mode (crf_decoding_op.h:63-76): emit 0/1
+        # per-position indicator path[j] == label[j] instead of tag ids
+        gold = jnp.asarray(ins["Label"]).astype(path.dtype).reshape(b, l)
+        path = jnp.where(posm, (path == gold).astype(path.dtype), 0)
+    return {"ViterbiPath": path}
+
+
+# --------------------------------------------------------------------------
+# CTC
+# --------------------------------------------------------------------------
+
+@register_op("warpctc")
+def warpctc(ins, attrs):
+    """operators/warpctc_op.cc — CTC loss. The reference binds Baidu's
+    warp-ctc CUDA library; here the standard alpha recursion in log space
+    runs as a lax.scan over time (blank-augmented target path)."""
+    logits = jnp.asarray(ins["Logits"])          # [B, T, C] raw acts
+    label = jnp.asarray(ins["Label"]).astype(jnp.int32)   # [B, U]
+    logit_len = jnp.asarray(ins["LogitsLength"]).reshape(-1)
+    label_len = jnp.asarray(ins["LabelLength"]).reshape(-1)
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = bool(attrs.get("norm_by_times", False))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    b, t, c = logp.shape
+    u = label.shape[1]
+    s = 2 * u + 1                                # blank-augmented length
+    # ext[k] = blank if k even else label[(k-1)/2]
+    ext = jnp.full((b, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    ext_valid = jnp.arange(s)[None, :] < (2 * label_len + 1)[:, None]
+    # allow skip from k-2 when ext[k] != blank and ext[k] != ext[k-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)))[:, :s]
+    can_skip = (ext != blank) & (ext != ext_m2)
+    a0 = jnp.full((b, s), _NEG)
+    a0 = a0.at[:, 0].set(logp[:, 0, blank])
+    first_lab = jnp.take_along_axis(logp[:, 0], label[:, :1], axis=1)[:, 0]
+    a0 = a0.at[:, 1].set(jnp.where(label_len > 0, first_lab, _NEG))
+
+    def step(alpha, inp):
+        lp, tpos = inp                            # [B, C], scalar
+        am1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                      constant_values=_NEG)[:, :s]
+        am2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                      constant_values=_NEG)[:, :s]
+        stay = jnp.logaddexp(alpha, am1)
+        tot = jnp.where(can_skip, jnp.logaddexp(stay, am2), stay)
+        emit = jnp.take_along_axis(lp, ext, axis=1)
+        nxt = tot + jnp.where(ext_valid, emit, _NEG)
+        live = (tpos < logit_len)[:, None]
+        return jnp.where(live, nxt, alpha), None
+
+    alpha, _ = jax.lax.scan(
+        step, a0, (jnp.moveaxis(logp[:, 1:], 1, 0), jnp.arange(1, t)))
+    # final: alpha[2*label_len] + alpha[2*label_len - 1]
+    endi = (2 * label_len).astype(jnp.int32)
+    a_end = jnp.take_along_axis(alpha, endi[:, None], axis=1)[:, 0]
+    a_end1 = jnp.take_along_axis(
+        alpha, jnp.maximum(endi - 1, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.where(label_len > 0, jnp.logaddexp(a_end, a_end1), a_end)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(logit_len.astype(loss.dtype), 1.0)
+    return {"Loss": loss[:, None].astype(logits.dtype),
+            "WarpCTCGrad": jnp.zeros_like(logits)}
+
+
+@register_op("ctc_align")
+def ctc_align(ins, attrs):
+    """operators/ctc_align_op.cc — greedy CTC decode post-process: merge
+    repeats, drop blanks; static-shape output packed to the front."""
+    x = jnp.asarray(ins["Input"]).astype(jnp.int32)       # [B, T] argmaxed
+    length = jnp.asarray(ins["Length"]).reshape(-1)
+    blank = int(attrs.get("blank", 0))
+    merge = bool(attrs.get("merge_repeated", True))
+    b, t = x.shape
+    pos = jnp.arange(t)[None, :]
+    valid = pos < length[:, None]
+    prev = jnp.pad(x, ((0, 0), (1, 0)), constant_values=-1)[:, :t]
+    keep = valid & (x != blank)
+    if merge:
+        keep = keep & (x != prev)
+    out, count = pack_to_front(x, keep)
+    return {"Output": out, "OutputLength": count.astype(length.dtype)}
+
+
+@register_op("edit_distance")
+def edit_distance(ins, attrs):
+    """operators/edit_distance_op.cc — Levenshtein distance between each
+    hyp/ref row pair; DP over the reference axis as a lax.scan."""
+    hyp = jnp.asarray(ins["Hyps"]).astype(jnp.int32)      # [B, M]
+    ref = jnp.asarray(ins["Refs"]).astype(jnp.int32)      # [B, N]
+    hyp_len = jnp.asarray(ins["HypsLength"]).reshape(-1)
+    ref_len = jnp.asarray(ins["RefsLength"]).reshape(-1)
+    normalized = bool(attrs.get("normalized", False))
+    b, m = hyp.shape
+    n = ref.shape[1]
+    # dp row over hyp positions 0..m
+    row0 = jnp.broadcast_to(jnp.arange(m + 1, dtype=jnp.float32)[None],
+                            (b, m + 1))
+    # clamp row index cost by hyp_len: positions past hyp_len don't matter
+    jpos = jnp.arange(1, m + 1)[None, :]
+
+    def step(carry, inp):
+        dp = carry                                # [B, M+1]
+        r_tok, i = inp                            # [B], scalar 1-based
+        live = (i <= ref_len)[:, None]
+        sub_cost = (hyp != r_tok[:, None]).astype(jnp.float32)
+        # new[0] = i
+        def inner(prev_new, k):
+            # prev_new: [B] value new[k-1]
+            cand = jnp.minimum(
+                jnp.minimum(dp[:, k] + 1.0,        # delete
+                            prev_new + 1.0),       # insert
+                dp[:, k - 1] + sub_cost[:, k - 1])  # substitute
+            return cand, cand
+
+        init = jnp.full((b,), i, jnp.float32)
+        _, cols = jax.lax.scan(inner, init, jnp.arange(1, m + 1))
+        new = jnp.concatenate([init[:, None], jnp.moveaxis(cols, 0, 1)],
+                              axis=1)
+        return jnp.where(live, new, dp), None
+
+    dp, _ = jax.lax.scan(step, row0,
+                         (jnp.moveaxis(ref, 1, 0).astype(jnp.int32),
+                          jnp.arange(1, n + 1)))
+    d = jnp.take_along_axis(dp, hyp_len[:, None].astype(jnp.int32),
+                            axis=1)[:, 0]
+    seq_num = jnp.asarray(b, jnp.int32)
+    if normalized:
+        d = d / jnp.maximum(ref_len.astype(d.dtype), 1.0)
+    return {"Out": d[:, None], "SequenceNum": seq_num}

@@ -14,6 +14,7 @@ reference registers explicit *_grad ops per op — not needed here).
 import jax
 import jax.numpy as jnp
 
+from ..core.dtype import index_dtype
 from .registry import register_op
 
 
@@ -208,12 +209,12 @@ def cumsum(ins, attrs):
 
 @register_op("arg_max")
 def arg_max(ins, attrs):
-    return {"Out": jnp.argmax(ins["X"], axis=attrs.get("axis", -1)).astype(jnp.int64)}
+    return {"Out": jnp.argmax(ins["X"], axis=attrs.get("axis", -1)).astype(index_dtype())}
 
 
 @register_op("arg_min")
 def arg_min(ins, attrs):
-    return {"Out": jnp.argmin(ins["X"], axis=attrs.get("axis", -1)).astype(jnp.int64)}
+    return {"Out": jnp.argmin(ins["X"], axis=attrs.get("axis", -1)).astype(index_dtype())}
 
 
 @register_op("argsort")
@@ -224,7 +225,7 @@ def argsort(ins, attrs):
     key = -x if descending else x
     idx = jnp.argsort(key, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+    return {"Out": out, "Indices": idx.astype(index_dtype())}
 
 
 @register_op("isfinite")

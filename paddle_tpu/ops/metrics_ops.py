@@ -1,0 +1,274 @@
+"""Metric op kernels: auc, precision_recall, chunk_eval,
+positive_negative_pair, mean_iou, average_accumulates.
+
+TPU-native replacements for /root/reference/paddle/fluid/operators/metrics/
+{auc,precision_recall}_op.h, operators/{chunk_eval,positive_negative_pair,
+mean_iou,average_accumulates}_op.cc. Stats are carried as explicit
+in/out tensors (the reference mutates persistable vars in place); all
+counting is vectorized masked math instead of per-sample loops.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("auc")
+def auc(ins, attrs):
+    """metrics/auc_op.h:30-122 — histogram-bucketed ROC AUC. StatPos/StatNeg
+    carry [num_thresholds+1] bucket counts (slide_steps=0 layout); outputs
+    updated stats + the trapezoid AUC over cumulative counts from the top
+    bucket down (auc_op.h:159-181)."""
+    predict = jnp.asarray(ins["Predict"])
+    label = jnp.asarray(ins["Label"]).reshape(-1)
+    num_thresholds = int(attrs.get("num_thresholds", 4095))
+    stat_pos = jnp.asarray(ins["StatPos"]).reshape(-1).astype(jnp.float32) \
+        if ins.get("StatPos") is not None \
+        else jnp.zeros(num_thresholds + 1, jnp.float32)
+    stat_neg = jnp.asarray(ins["StatNeg"]).reshape(-1).astype(jnp.float32) \
+        if ins.get("StatNeg") is not None \
+        else jnp.zeros(num_thresholds + 1, jnp.float32)
+    # last column is the positive-class probability (auc_op.h:94-96)
+    pos_prob = predict.reshape(predict.shape[0], -1)[:, -1]
+    bins = (pos_prob * num_thresholds).astype(jnp.int32)
+    bins = jnp.clip(bins, 0, num_thresholds)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    is_neg = (label == 0).astype(stat_neg.dtype)
+    stat_pos = stat_pos.at[bins].add(is_pos)
+    stat_neg = stat_neg.at[bins].add(is_neg)
+    # cumulative from top bucket down; trapezoid area in (neg, pos) space
+    pos_cum = jnp.cumsum(stat_pos[::-1])
+    neg_cum = jnp.cumsum(stat_neg[::-1])
+    pos_prev = jnp.concatenate([jnp.zeros(1, pos_cum.dtype), pos_cum[:-1]])
+    neg_prev = jnp.concatenate([jnp.zeros(1, neg_cum.dtype), neg_cum[:-1]])
+    area = jnp.sum(jnp.abs(neg_cum - neg_prev) * (pos_cum + pos_prev) / 2.0)
+    tot_pos, tot_neg = pos_cum[-1], neg_cum[-1]
+    auc_val = jnp.where((tot_pos > 0) & (tot_neg > 0),
+                        area / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
+    return {"AUC": auc_val, "StatPosOut": stat_pos, "StatNegOut": stat_neg}
+
+
+@register_op("precision_recall")
+def precision_recall(ins, attrs):
+    """metrics/precision_recall_op.h:30-160 — multiclass TP/FP/TN/FN
+    accumulation + (macro, micro) precision/recall/F1, batch and
+    accumulated."""
+    idx = jnp.asarray(ins["Indices"]).reshape(-1).astype(jnp.int32)
+    label = jnp.asarray(ins["Labels"]).reshape(-1).astype(jnp.int32)
+    cls_num = int(attrs["class_number"])
+    w = (jnp.asarray(ins["Weights"]).reshape(-1).astype(jnp.float32)
+         if ins.get("Weights") is not None
+         else jnp.ones(idx.shape, jnp.float32))
+    hit = idx == label
+    tp = jnp.zeros(cls_num, jnp.float32).at[idx].add(jnp.where(hit, w, 0.0))
+    fp = jnp.zeros(cls_num, jnp.float32).at[idx].add(jnp.where(hit, 0.0, w))
+    fn = jnp.zeros(cls_num, jnp.float32).at[label].add(
+        jnp.where(hit, 0.0, w))
+    # TN: every sample adds w to all classes except its idx (and label when
+    # mispredicted) — precision_recall_op.h:67-82
+    tn = jnp.full(cls_num, w.sum(), jnp.float32)
+    tn = tn - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)     # [C, 4]
+
+    def metrics(states):
+        tp_, fp_, tn_, fn_ = (states[:, 0], states[:, 1], states[:, 2],
+                              states[:, 3])
+        prec = jnp.where((tp_ > 0) | (fp_ > 0),
+                         tp_ / jnp.maximum(tp_ + fp_, 1e-30), 1.0)
+        rec = jnp.where((tp_ > 0) | (fn_ > 0),
+                        tp_ / jnp.maximum(tp_ + fn_, 1e-30), 1.0)
+        f1 = jnp.where((prec > 0) | (rec > 0),
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-30), 0.0)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        ttp, tfp, tfn = tp_.sum(), fp_.sum(), fn_.sum()
+        mprec = jnp.where((ttp > 0) | (tfp > 0),
+                          ttp / jnp.maximum(ttp + tfp, 1e-30), 1.0)
+        mrec = jnp.where((ttp > 0) | (tfn > 0),
+                         ttp / jnp.maximum(ttp + tfn, 1e-30), 1.0)
+        mf1 = jnp.where((mprec > 0) | (mrec > 0),
+                        2 * mprec * mrec / jnp.maximum(mprec + mrec, 1e-30),
+                        0.0)
+        return jnp.concatenate([macro, jnp.stack([mprec, mrec, mf1])])
+
+    batch_metrics = metrics(batch_states)
+    accum_states = batch_states
+    if ins.get("StatesInfo") is not None:
+        accum_states = accum_states + jnp.asarray(
+            ins["StatesInfo"]).reshape(cls_num, 4).astype(jnp.float32)
+    return {"BatchMetrics": batch_metrics,
+            "AccumMetrics": metrics(accum_states),
+            "AccumStatesInfo": accum_states}
+
+
+@register_op("positive_negative_pair")
+def positive_negative_pair(ins, attrs):
+    """operators/positive_negative_pair_op.h — for each same-query pair,
+    count concordant (pos), discordant (neg), tied (neutral) score/label
+    pairs; carries accumulated counts."""
+    score = jnp.asarray(ins["Score"]).reshape(-1)
+    label = jnp.asarray(ins["Label"]).reshape(-1)
+    qid = jnp.asarray(ins["QueryID"]).reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), k=1)          # each pair once
+    considered = same_q & (upper > 0) & (label[:, None] != label[None, :])
+    sd = score[:, None] - score[None, :]
+    ld = (label[:, None] - label[None, :]).astype(sd.dtype)
+    pos = (considered & (sd * ld > 0)).sum().astype(jnp.float32)
+    neg = (considered & (sd * ld < 0)).sum().astype(jnp.float32)
+    neu = (considered & (sd == 0)).sum().astype(jnp.float32)
+    if ins.get("AccumulatePositivePair") is not None:
+        pos = pos + jnp.asarray(ins["AccumulatePositivePair"]).reshape(())
+        neg = neg + jnp.asarray(ins["AccumulateNegativePair"]).reshape(())
+        neu = neu + jnp.asarray(ins["AccumulateNeutralPair"]).reshape(())
+    return {"PositivePair": pos, "NegativePair": neg, "NeutralPair": neu}
+
+
+@register_op("mean_iou")
+def mean_iou(ins, attrs):
+    """operators/mean_iou_op.h:30-113 — mean IoU with the reference's
+    accumulation protocol: OutWrong = sum(InWrongs) + per-mismatch
+    increments of BOTH wrong[label] and wrong[pred]; OutCorrect =
+    sum(InCorrects) + correct[pred] on match; OutMeanIou =
+    sum(InMeanIou) + mean(correct/(wrong+correct)) over present classes."""
+    pred = jnp.asarray(ins["Predictions"]).reshape(-1).astype(jnp.int32)
+    label = jnp.asarray(ins["Labels"]).reshape(-1).astype(jnp.int32)
+    n = int(attrs["num_classes"])
+    hit = (pred == label).astype(jnp.float32)
+    correct = jnp.zeros(n, jnp.float32).at[pred].add(hit)
+    wrong = jnp.zeros(n, jnp.float32).at[pred].add(1.0 - hit)
+    wrong = wrong.at[label].add(1.0 - hit)
+
+    def _sum_multi(slot):
+        vals = ins.get(slot)
+        if vals is None:
+            return 0.0
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        return sum(jnp.asarray(v).reshape(-1).astype(jnp.float32)
+                   for v in vals)
+
+    wrong = wrong + _sum_multi("InWrongs")
+    correct = correct + _sum_multi("InCorrects")
+    denom = wrong + correct
+    present = denom > 0
+    iou = jnp.where(present, correct / jnp.maximum(denom, 1.0), 0.0)
+    miou = iou.sum() / jnp.maximum(present.sum().astype(jnp.float32), 1.0)
+    miou = miou + jnp.sum(jnp.asarray(_sum_multi("InMeanIou")))
+    return {"OutMeanIou": miou, "OutWrong": wrong, "OutCorrect": correct}
+
+
+@register_op("chunk_eval")
+def chunk_eval(ins, attrs):
+    """operators/chunk_eval_op.cc — chunking precision/recall/F1 over a
+    tag scheme. Implements the IOB ("insert-begin") and `plain` schemes on
+    padded [B, T] + Length; labels encode (chunk_type, tag) as
+    label = chunk_type * num_tag_types + tag."""
+    inf = jnp.asarray(ins["Inference"]).astype(jnp.int32)
+    lab = jnp.asarray(ins["Label"]).astype(jnp.int32)
+    if inf.ndim > 2:
+        inf = inf.reshape(inf.shape[0], -1)
+        lab = lab.reshape(lab.shape[0], -1)
+    length = jnp.asarray(ins["Length"]).reshape(-1)
+    scheme = attrs.get("chunk_scheme", "IOB")
+    num_chunk_types = int(attrs["num_chunk_types"])
+    b, t = inf.shape
+    pos = jnp.arange(t)[None, :]
+    valid = pos < length[:, None]
+    excluded = jnp.asarray(
+        list(attrs.get("excluded_chunk_types", [])) or [-1], jnp.int32)
+
+    if scheme not in ("plain", "IOB"):
+        raise NotImplementedError(
+            f"chunk_eval: scheme {scheme!r} not implemented (supported: "
+            "plain, IOB; IOE/IOBES need their own tag layouts)")
+    if scheme == "plain":
+        n_tag = 1
+        def starts(seq, ok):
+            ctype = seq
+            prev = jnp.pad(ctype, ((0, 0), (1, 0)),
+                           constant_values=-1)[:, :t]
+            prev_ok = jnp.pad(ok, ((0, 0), (1, 0)))[:, :t]
+            return ok & (~prev_ok | (ctype != prev)), ctype
+    else:  # IOB: tag 0 = B, tag 1 = I
+        n_tag = 2
+        def starts(seq, ok):
+            ctype = seq // n_tag
+            tag = seq % n_tag
+            prev_t = jnp.pad(ctype, ((0, 0), (1, 0)),
+                             constant_values=-1)[:, :t]
+            prev_ok = jnp.pad(ok, ((0, 0), (1, 0)))[:, :t]
+            is_b = tag == 0
+            cont = (tag == 1) & prev_ok & (ctype == prev_t)
+            return ok & (is_b | ~cont), ctype
+
+    def chunks(seq):
+        ok = valid & ~jnp.isin(seq // (n_tag if scheme != "plain" else 1),
+                               excluded)
+        st, ctype = starts(seq, ok)
+        # end-of-chunk index for the chunk containing each position:
+        # position q terminates a chunk iff the next position starts one
+        # (or falls off the ok run); e[p] = suffix-min of terminator
+        # indices >= p
+        nxt_st = jnp.pad(st, ((0, 0), (0, 1)))[:, 1:]
+        nxt_ok = jnp.pad(ok, ((0, 0), (0, 1)))[:, 1:]
+        term = nxt_st | ~nxt_ok                           # [B, T]
+        idx = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+        term_idx = jnp.where(term, idx, t)
+        ends = jnp.flip(jax.lax.cummin(jnp.flip(term_idx, 1), axis=1), 1)
+        return st & ok, ends, ctype, ok
+
+    st_i, end_i, ct_i, ok_i = chunks(inf)
+    st_l, end_l, ct_l, ok_l = chunks(lab)
+    num_inf = st_i.sum()
+    num_lab = st_l.sum()
+    # exact signature match: chunks starting at the same position with the
+    # same type and the same end (chunk_eval_op.h Segment operator==)
+    matched = st_i & st_l & (ct_i == ct_l) & (end_i == end_l)
+    num_correct = matched.sum()
+    p = num_correct / jnp.maximum(num_inf, 1)
+    r = num_correct / jnp.maximum(num_lab, 1)
+    f1 = jnp.where((p + r) > 0, 2 * p * r / jnp.maximum(p + r, 1e-30), 0.0)
+    return {"Precision": p.astype(jnp.float32),
+            "Recall": r.astype(jnp.float32),
+            "F1-Score": f1.astype(jnp.float32),
+            "NumInferChunks": num_inf.astype(jnp.int32),
+            "NumLabelChunks": num_lab.astype(jnp.int32),
+            "NumCorrectChunks": num_correct.astype(jnp.int32)}
+
+
+@register_op("average_accumulates", stateful=True)
+def average_accumulates(ins, attrs):
+    """operators/average_accumulates_op.cc — the running accumulators
+    behind ModelAverage (optimizer.py:2861): sums of params over windows
+    (sum_1/sum_2/sum_3) with update/restore bookkeeping."""
+    param = jnp.asarray(ins["param"])
+    sum_1 = jnp.asarray(ins["in_sum_1"])
+    sum_2 = jnp.asarray(ins["in_sum_2"])
+    sum_3 = jnp.asarray(ins["in_sum_3"])
+    num_acc = jnp.asarray(ins["in_num_accumulates"]).reshape(()).astype(
+        jnp.int32)
+    old_num = jnp.asarray(ins["in_old_num_accumulates"]).reshape(()).astype(
+        jnp.int32)
+    num_upd = jnp.asarray(ins["in_num_updates"]).reshape(()).astype(
+        jnp.int32)
+    avg_window = float(attrs.get("average_window", 0))
+    max_avg = int(attrs.get("max_average_window", 10000))
+    min_avg = int(attrs.get("min_average_window", 10000))
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    sum_1 = sum_1 + param
+    # window rollover (average_accumulates_op.h): when the window is full,
+    # cascade sum_1 -> sum_2 -> sum_3
+    roll = (num_acc >= min_avg) & (
+        num_acc >= jnp.maximum(avg_window * num_upd.astype(jnp.float32),
+                               1.0).astype(jnp.int32)) | (num_acc >= max_avg)
+    sum_3_n = jnp.where(roll, sum_2 + sum_1, sum_3)
+    sum_2_n = jnp.where(roll, jnp.zeros_like(sum_2), sum_2 + sum_1)
+    sum_1_n = jnp.where(roll, jnp.zeros_like(sum_1), sum_1)
+    old_num_n = jnp.where(roll, num_acc, old_num)
+    num_acc_n = jnp.where(roll, 0, num_acc)
+    return {"out_sum_1": sum_1_n, "out_sum_2": sum_2_n,
+            "out_sum_3": sum_3_n, "out_num_accumulates": num_acc_n,
+            "out_old_num_accumulates": old_num_n,
+            "out_num_updates": num_upd}

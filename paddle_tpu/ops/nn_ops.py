@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.dtype import index_dtype
 from .registry import register_op
 
 
@@ -538,7 +539,7 @@ def top_k(ins, attrs):
     x = ins["X"]
     k = attrs.get("k", 1)
     vals, idx = lax.top_k(x, k)
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(index_dtype())}
 
 
 @register_op("top_k_v2")
@@ -555,7 +556,7 @@ def top_k_v2(ins, attrs):
         vals, idx = lax.top_k(x_m, k)
     return {
         "Out": jnp.moveaxis(vals, -1, axis),
-        "Indices": jnp.moveaxis(idx, -1, axis).astype(jnp.int64),
+        "Indices": jnp.moveaxis(idx, -1, axis).astype(index_dtype()),
     }
 
 

@@ -25,6 +25,29 @@ def _mask(length, maxlen, dtype=jnp.float32):
     return (t < length[:, None]).astype(dtype)
 
 
+def reverse_valid_prefix(x, length):
+    """Reverse each row's valid prefix of the time axis (axis 1), identity
+    past the length. Shared by sequence_reverse and the RNN is_reverse
+    paths."""
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    src = jnp.where(pos < length[:, None], length[:, None] - 1 - pos, pos)
+    src = src.reshape((x.shape[0], t) + (1,) * (x.ndim - 2)).astype(jnp.int32)
+    return jnp.take_along_axis(x, src, axis=1)
+
+
+def pack_to_front(x, keep, fill=0):
+    """Stable-pack kept entries of each row to the front; tail = fill.
+    Returns (packed, kept_count). Shared by sequence_erase / ctc_align."""
+    b, t = x.shape
+    dest = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    dest = jnp.where(keep, dest, t)               # dropped -> OOB, dropped
+    out = jnp.full_like(x, fill)
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    out = out.at[bidx, dest].set(jnp.where(keep, x, fill), mode="drop")
+    return out, keep.sum(axis=1)
+
+
 @register_op("sequence_mask")
 def sequence_mask(ins, attrs):
     length = jnp.asarray(ins["X"]).reshape(-1)
@@ -87,13 +110,7 @@ def sequence_softmax(ins, attrs):
 @register_op("sequence_reverse")
 def sequence_reverse(ins, attrs):
     x = jnp.asarray(ins["X"])                   # [B, T, ...]
-    length = _length(ins)
-    t = x.shape[1]
-    pos = jnp.arange(t)[None, :]                # [1, T]
-    # index of source step: within valid prefix reverse, else identity
-    src = jnp.where(pos < length[:, None], length[:, None] - 1 - pos, pos)
-    src = src.reshape((x.shape[0], t) + (1,) * (x.ndim - 2))
-    return {"Out": jnp.take_along_axis(x, src, axis=1)}
+    return {"Out": reverse_valid_prefix(x, _length(ins))}
 
 
 @register_op("sequence_expand")
@@ -105,3 +122,245 @@ def sequence_expand(ins, attrs):
     m = _mask(length, maxlen, x.dtype)
     m = m.reshape(m.shape + (1,) * (x.ndim - 1))
     return {"Out": out * m}
+
+
+# --------------------------------------------------------------------------
+# Round-2 completion of the sequence family. Same padded [B, T, ...] +
+# Length [B] representation. Kernel-parity targets cited per op; the
+# ragged-offset walks of the reference become masked dense math + static
+# shapes so XLA can tile everything onto the VPU/MXU.
+# --------------------------------------------------------------------------
+
+
+@register_op("sequence_concat")
+def sequence_concat(ins, attrs):
+    """sequence_ops/sequence_concat_op.cc — concat along time, packing each
+    row's valid prefixes contiguously. Inputs: X = list of [B, Ti, ...],
+    Length = list of [B]."""
+    xs = ins["X"] if isinstance(ins["X"], (list, tuple)) else [ins["X"]]
+    lens = ins["Length"]
+    if not isinstance(lens, (list, tuple)):
+        lens = [lens]
+    lens = [jnp.asarray(l).reshape(-1) for l in lens]
+    t_out = sum(x.shape[1] for x in xs)
+    b = xs[0].shape[0]
+    total = sum(lens)
+    # for output slot t of row b: which input tensor and which position
+    pos = jnp.arange(t_out)[None, :]                      # [1, Tout]
+    starts = []
+    acc = jnp.zeros((b,), lens[0].dtype)
+    for l in lens:
+        starts.append(acc)
+        acc = acc + l
+    out = jnp.zeros((b, t_out) + xs[0].shape[2:], xs[0].dtype)
+    for x, l, s in zip(xs, lens, starts):
+        ti = x.shape[1]
+        # scatter row-wise: out[b, s[b]+j] = x[b, j] for j < l[b]
+        j = jnp.arange(ti)[None, :]                       # [1, Ti]
+        dest = s[:, None] + j                             # [B, Ti]
+        valid = j < l[:, None]
+        dest = jnp.where(valid, dest, t_out)              # dump pad at OOB
+        bidx = jnp.broadcast_to(jnp.arange(b)[:, None], dest.shape)
+        out = out.at[bidx, dest.astype(jnp.int32)].set(
+            jnp.where(valid.reshape(valid.shape + (1,) * (x.ndim - 2)),
+                      x, 0), mode="drop")
+    return {"Out": out, "Length": total}
+
+
+@register_op("sequence_expand_as")
+def sequence_expand_as(ins, attrs):
+    """sequence_ops/sequence_expand_as_op.cc — row i of X repeated to the
+    length of sequence i in Y."""
+    x = jnp.asarray(ins["X"])                             # [B, ...]
+    length = _length(ins)                                 # target lengths
+    maxlen = int(attrs.get("maxlen", 0))
+    if not maxlen:
+        if ins.get("Y") is not None:
+            maxlen = jnp.asarray(ins["Y"]).shape[1]
+        else:
+            raise ValueError(
+                "sequence_expand_as needs a static time extent: pass the "
+                "maxlen attr or a padded Y reference tensor (Length is "
+                "traced, so it cannot size the output)")
+    out = jnp.repeat(x[:, None], maxlen, axis=1)
+    m = _mask(length, maxlen, x.dtype)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 1))
+    return {"Out": out * m}
+
+
+@register_op("sequence_pad")
+def sequence_pad(ins, attrs):
+    """sequence_ops/sequence_pad_op.cc — pad/truncate to padded_length,
+    fill invalid with pad_value; also emits Length."""
+    x = jnp.asarray(ins["X"])                             # [B, T, ...]
+    length = _length(ins)
+    pad_value = jnp.asarray(ins.get("PadValue", attrs.get("pad_value", 0.0)),
+                            x.dtype)
+    padded_len = int(attrs.get("padded_length", -1))
+    t = x.shape[1]
+    if padded_len < 0:
+        padded_len = t
+    if padded_len > t:
+        pad_width = [(0, 0), (0, padded_len - t)] + [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, pad_width)
+    else:
+        x = x[:, :padded_len]
+    length = jnp.minimum(length, padded_len)
+    m = _mask(length, padded_len, x.dtype)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    out = x * m + pad_value * (1 - m)
+    return {"Out": out, "Length": length}
+
+
+@register_op("sequence_unpad")
+def sequence_unpad(ins, attrs):
+    """sequence_ops/sequence_unpad_op.cc — inverse of sequence_pad: zero the
+    padding (our ragged rep), keep Length."""
+    x = jnp.asarray(ins["X"])
+    length = _length(ins)
+    m = _mask(length, x.shape[1], x.dtype)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return {"Out": x * m, "Length": length}
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ins, attrs):
+    """sequence_ops/sequence_reshape_op.cc — [len, D] -> [len*D/new_dim,
+    new_dim] per sequence. Tail-padding stays tail-padding under row-major
+    flatten, so this is a pure static reshape + length rescale."""
+    x = jnp.asarray(ins["X"])                             # [B, T, D]
+    length = _length(ins)
+    new_dim = int(attrs["new_dim"])
+    b, t, d = x.shape
+    assert (t * d) % new_dim == 0, "new_dim must divide T*D"
+    out = x.reshape(b, t * d // new_dim, new_dim)
+    return {"Out": out, "Length": length * d // new_dim}
+
+
+@register_op("sequence_slice")
+def sequence_slice(ins, attrs):
+    """sequence_ops/sequence_slice_op.cc — per-sequence [offset, offset+len)
+    window."""
+    x = jnp.asarray(ins["X"])                             # [B, T, ...]
+    offset = jnp.asarray(ins["Offset"]).reshape(-1)
+    slen = jnp.asarray(ins["SliceLength"]).reshape(-1)
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    src = jnp.clip(offset[:, None] + pos, 0, t - 1)
+    src = src.reshape((x.shape[0], t) + (1,) * (x.ndim - 2))
+    g = jnp.take_along_axis(x, src.astype(jnp.int32), axis=1)
+    m = _mask(slen, t, x.dtype)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return {"Out": g * m, "Length": slen}
+
+
+@register_op("sequence_enumerate")
+def sequence_enumerate(ins, attrs):
+    """sequence_ops/sequence_enumerate_op.h:49-70 — sliding win_size window
+    per position; positions past the sequence end filled with pad_value."""
+    x = jnp.asarray(ins["X"])                             # [B, T] int ids
+    length = _length(ins)
+    win = int(attrs["win_size"])
+    pad_value = attrs.get("pad_value", 0)
+    b, t = x.shape
+    pos = jnp.arange(t)[None, :, None]                    # [1, T, 1]
+    w = jnp.arange(win)[None, None, :]                    # [1, 1, W]
+    src = pos + w                                         # [1, T, W]
+    src_c = jnp.clip(src, 0, t - 1)
+    g = jnp.take_along_axis(x[:, :, None],
+                            jnp.broadcast_to(src_c, (b, t, win)), axis=1)
+    valid = src < length[:, None, None]
+    out = jnp.where(valid, g, pad_value)
+    # rows past the end of the sequence are all-pad in the reference too
+    return {"Out": out, "Length": length}
+
+
+@register_op("sequence_erase")
+def sequence_erase(ins, attrs):
+    """sequence_ops/sequence_erase_op.h:41-70 — drop listed tokens, pack
+    survivors to the front, shrink Length. Static-shape version: output
+    keeps T slots, tail zero-padded."""
+    x = jnp.asarray(ins["X"])                             # [B, T] int ids
+    length = _length(ins)
+    tokens = attrs.get("tokens", [])
+    b, t = x.shape
+    pos = jnp.arange(t)[None, :]
+    valid = pos < length[:, None]
+    keep = valid
+    for tok in tokens:
+        keep = keep & (x != tok)
+    out, count = pack_to_front(x, keep)
+    return {"Out": out, "Length": count.astype(length.dtype)}
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(ins, attrs):
+    """sequence_ops/sequence_scatter_op.cc — X[b, ids[b, j]] += updates[b, j]
+    for j < UpdateLength[b]."""
+    x = jnp.asarray(ins["X"])                             # [B, D]
+    ids = jnp.asarray(ins["Ids"])                         # [B, J]
+    upd = jnp.asarray(ins["Updates"])                     # [B, J]
+    ulen = jnp.asarray(ins["UpdateLength"]).reshape(-1)
+    b, j = ids.shape
+    valid = jnp.arange(j)[None, :] < ulen[:, None]
+    contrib = jnp.where(valid, upd, 0).astype(x.dtype)
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, j))
+    return {"Out": x.at[bidx, ids.astype(jnp.int32)].add(contrib)}
+
+
+@register_op("sequence_conv")
+def sequence_conv(ins, attrs):
+    """sequence_ops/sequence_conv_op.cc — context-window projection: for
+    each position, gather [t+start, t+start+ctx) (zero beyond the valid
+    prefix, like the reference's boundary padding) and project with the
+    filter [ctx*D, M]."""
+    x = jnp.asarray(ins["X"])                             # [B, T, D]
+    w = jnp.asarray(ins["Filter"])                        # [ctx*D, M]
+    length = _length(ins)
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", 0))  # op default is 0
+    # (sequence_conv_op.cc:145-146; the python layer passes -ctx//2 itself)
+    b, t, d = x.shape
+    m = _mask(length, t, x.dtype)[:, :, None]
+    xz = x * m                                            # zero invalid
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(xz, -off, axis=1)
+        pos = jnp.arange(t) + off
+        ok = ((pos >= 0) & (pos < t))[None, :, None]
+        cols.append(jnp.where(ok, shifted, 0))
+    col = jnp.concatenate(cols, axis=-1)                  # [B, T, ctx*D]
+    out = col.reshape(b * t, ctx_len * d) @ w
+    out = out.reshape(b, t, -1) * m
+    return {"Out": out}
+
+
+@register_op("sequence_topk_avg_pooling")
+def sequence_topk_avg_pooling(ins, attrs):
+    """sequence_ops/sequence_topk_avg_pooling_op.cc — per (row, channel),
+    average of the top-k valid values, for each k in `topks`; output
+    channels concatenated per k."""
+    x = jnp.asarray(ins["X"])                             # [B, T, C]
+    length = _length(ins)
+    topks = [int(k) for k in attrs.get("topks", [1])]
+    b, t, c = x.shape
+    m = _mask(length, t, x.dtype)[:, :, None]
+    z = jnp.where(m > 0, x, NEG_INF)
+    s = -jnp.sort(-z, axis=1)                             # desc along T
+    s = jnp.where(s <= NEG_INF / 2, 0.0, s)               # invalid -> 0
+    csum = jnp.cumsum(s, axis=1)                          # [B, T, C]
+    outs = []
+    for k in topks:
+        kk = jnp.minimum(jnp.maximum(length, 1), k)       # valid count
+        idx = (kk - 1).astype(jnp.int32)[:, None, None]
+        top_sum = jnp.take_along_axis(
+            csum, jnp.broadcast_to(idx, (b, 1, c)), axis=1)[:, 0]
+        # reference divides by k itself, not the valid count — short rows
+        # contribute zeros (sequence_topk_avg_pooling_op.h:147)
+        avg = top_sum / jnp.asarray(k, x.dtype)
+        avg = jnp.where((length == 0)[:, None], 0.0, avg)
+        outs.append(avg)
+    # channel-major, k innermost: out[..., j*k_num + k]
+    # (sequence_topk_avg_pooling_op.h:130-148)
+    return {"Out": jnp.stack(outs, axis=-1).reshape(b, c * len(topks))}

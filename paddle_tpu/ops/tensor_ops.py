@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.dtype import to_jax_dtype
+from ..core.dtype import index_dtype, to_jax_dtype
 from .registry import register_op
 
 # Reference VarType dtype enum values (framework.proto:107-125) so programs
@@ -406,7 +406,7 @@ def shape_(ins, attrs):
 
 @register_op("size")
 def size_(ins, attrs):
-    return {"Out": jnp.asarray(ins["Input"].size, dtype=jnp.int64)}
+    return {"Out": jnp.asarray(ins["Input"].size, dtype=index_dtype())}
 
 
 @register_op("assign")
@@ -439,7 +439,7 @@ def where_index(ins, attrs):
     import numpy as np
 
     cond = np.asarray(ins["Condition"])
-    return {"Out": jnp.asarray(np.stack(np.nonzero(cond), axis=1).astype(np.int64))}
+    return {"Out": jnp.asarray(np.stack(np.nonzero(cond), axis=1)).astype(index_dtype())}
 
 
 @register_op("masked_select")

@@ -1,0 +1,273 @@
+"""Loss / CRF / CTC op tests (parity model: tests/unittests/
+test_rank_loss_op.py, test_margin_rank_loss_op.py, test_hinge_loss_op.py,
+test_bpr_loss_op.py, test_modified_huber_loss_op.py, test_center_loss.py,
+test_linear_chain_crf_op.py, test_crf_decoding_op.py, test_warpctc_op.py,
+test_edit_distance_op.py, test_ctc_align_op.py)."""
+
+import itertools
+
+import numpy as np
+
+from op_test import OpTest, run_kernel
+
+
+class TestRankLoss(OpTest):
+    op_type = "rank_loss"
+
+    def test_forward(self):
+        l = np.random.rand(5, 1).astype(np.float64)
+        r = np.random.rand(5, 1).astype(np.float64)
+        lab = np.random.randint(0, 2, (5, 1)).astype(np.float64)
+        got = run_kernel("rank_loss", {"Left": l, "Right": r, "Label": lab})
+        o = l - r
+        np.testing.assert_allclose(got["Out"],
+                                   np.log(1 + np.exp(o)) - lab * o,
+                                   rtol=1e-6)
+
+    def test_grad(self):
+        self.check_grad({"Left": np.random.rand(4, 1),
+                         "Right": np.random.rand(4, 1),
+                         "Label": np.ones((4, 1))}, ["Left", "Right"])
+
+
+class TestMarginRankLoss(OpTest):
+    op_type = "margin_rank_loss"
+    attrs = {"margin": 0.5}
+
+    def test_forward(self):
+        x1 = np.random.rand(6, 1).astype(np.float64)
+        x2 = np.random.rand(6, 1).astype(np.float64)
+        lab = np.sign(np.random.rand(6, 1) - 0.5)
+        got = self.calc_output({"X1": x1, "X2": x2, "Label": lab})
+        np.testing.assert_allclose(
+            got["Out"], np.maximum(0, -lab * (x1 - x2) + 0.5), rtol=1e-6)
+
+
+class TestHingeLoss(OpTest):
+    op_type = "hinge_loss"
+
+    def test_forward(self):
+        pred = np.random.rand(5, 1).astype(np.float64)
+        lab = np.random.randint(0, 2, (5, 1)).astype(np.float64)
+        got = run_kernel("hinge_loss", {"Logits": pred, "Labels": lab})
+        np.testing.assert_allclose(
+            got["Loss"], np.maximum(0, 1 - (2 * lab - 1) * pred), rtol=1e-6)
+
+
+class TestBprLoss(OpTest):
+    op_type = "bpr_loss"
+
+    def test_forward(self):
+        np.random.seed(0)
+        x = np.random.rand(4, 5).astype(np.float64)
+        lab = np.random.randint(0, 5, (4, 1))
+        got = run_kernel("bpr_loss", {"X": x, "Label": lab})
+        exp = np.zeros(4)
+        for i in range(4):
+            y = lab[i, 0]
+            s = sum(np.log(1 + np.exp(x[i, j] - x[i, y]))
+                    for j in range(5) if j != y)
+            exp[i] = s / 4
+        np.testing.assert_allclose(got["Y"][:, 0], exp, rtol=1e-5)
+
+
+class TestModifiedHuber(OpTest):
+    op_type = "modified_huber_loss"
+
+    def test_forward(self):
+        pred = np.array([[2.0], [0.5], [-3.0]])
+        lab = np.array([[1.0], [0.0], [1.0]])
+        got = run_kernel("modified_huber_loss", {"X": pred, "Y": lab})
+        # z = [2, -0.5, -3] -> [0, 2.25, 12]
+        np.testing.assert_allclose(got["Out"][:, 0], [0.0, 2.25, 12.0],
+                                   rtol=1e-6)
+
+
+class TestTeacherStudent(OpTest):
+    op_type = "teacher_student_sigmoid_loss"
+
+    def test_cases(self):
+        x = np.array([[0.5], [0.5], [0.5], [0.5]], np.float64)
+        lab = np.array([[-2.0], [-1.0], [0.3], [1.3]], np.float64)
+        got = run_kernel("teacher_student_sigmoid_loss",
+                         {"X": x, "Label": lab})
+        sp = 0.5 + np.log(1 + np.exp(-0.5))
+        exp = [sp, sp - 0.5, sp + sp - 0.5 * 0.3,
+               (sp - 0.5) + sp - 0.5 * 0.3]
+        np.testing.assert_allclose(got["Y"][:, 0], exp, rtol=1e-6)
+
+
+class TestCenterLoss(OpTest):
+    op_type = "center_loss"
+
+    def test_forward(self):
+        np.random.seed(0)
+        x = np.random.rand(4, 3).astype(np.float64)
+        centers = np.random.rand(5, 3).astype(np.float64)
+        lab = np.array([1, 1, 2, 0])
+        got = run_kernel("center_loss",
+                         {"X": x, "Label": lab, "Centers": centers,
+                          "CenterUpdateRate": np.array(0.1)})
+        exp = 0.5 * ((x - centers[lab]) ** 2).sum(axis=1)
+        np.testing.assert_allclose(got["Loss"][:, 0], exp, rtol=1e-6)
+        assert got["CentersOut"].shape == centers.shape
+
+
+class TestCosSim(OpTest):
+    op_type = "cos_sim"
+
+    def test_forward(self):
+        x = np.random.rand(4, 5).astype(np.float64)
+        y = np.random.rand(4, 5).astype(np.float64)
+        got = run_kernel("cos_sim", {"X": x, "Y": y})
+        exp = (x * y).sum(1) / (np.linalg.norm(x, axis=1)
+                                * np.linalg.norm(y, axis=1))
+        np.testing.assert_allclose(got["Out"][:, 0], exp, rtol=1e-5)
+
+
+class TestNCE(OpTest):
+    def test_deterministic_samples(self):
+        np.random.seed(0)
+        x = np.random.rand(3, 4).astype(np.float64)
+        w = np.random.rand(10, 4).astype(np.float64)
+        lab = np.array([1, 3, 7])
+        samples = np.random.randint(0, 10, (3, 5))
+        got = run_kernel("nce", {"Input": x, "Weight": w, "Label": lab,
+                                 "SampleIds": samples},
+                         {"num_neg_samples": 5, "num_total_classes": 10})
+        assert got["Cost"].shape == (3, 1)
+        assert np.isfinite(got["Cost"]).all()
+
+
+class TestHSigmoid(OpTest):
+    def test_loss_positive_finite(self):
+        np.random.seed(0)
+        x = np.random.rand(4, 6).astype(np.float64)
+        w = np.random.rand(7, 6).astype(np.float64)
+        lab = np.array([0, 3, 5, 7])
+        got = run_kernel("hierarchical_sigmoid",
+                         {"X": x, "W": w, "Label": lab},
+                         {"num_classes": 8})
+        assert (got["Cost"] > 0).all() and np.isfinite(got["Cost"]).all()
+
+
+class TestLinearChainCRF(OpTest):
+    def test_against_bruteforce(self):
+        np.random.seed(0)
+        b, l, t = 2, 3, 3
+        em = np.random.rand(b, l, t).astype(np.float64)
+        trans = np.random.rand(t + 2, t).astype(np.float64)
+        lab = np.random.randint(0, t, (b, l))
+        lens = np.array([3, 2])
+        got = run_kernel("linear_chain_crf",
+                         {"Emission": em, "Transition": trans,
+                          "Label": lab, "Length": lens})
+        start, stop, pair = trans[0], trans[1], trans[2:]
+        for i in range(b):
+            n = lens[i]
+            scores = []
+            for path in itertools.product(range(t), repeat=n):
+                s = start[path[0]] + stop[path[-1]]
+                s += sum(em[i, k, path[k]] for k in range(n))
+                s += sum(pair[path[k], path[k + 1]] for k in range(n - 1))
+                scores.append(s)
+            log_z = np.log(np.sum(np.exp(scores)))
+            gold = (start[lab[i, 0]]
+                    + stop[lab[i, n - 1]]
+                    + sum(em[i, k, lab[i, k]] for k in range(n))
+                    + sum(pair[lab[i, k], lab[i, k + 1]]
+                          for k in range(n - 1)))
+            np.testing.assert_allclose(got["LogLikelihood"][i, 0],
+                                       log_z - gold, rtol=1e-5)
+
+
+class TestCRFDecoding(OpTest):
+    def test_against_bruteforce(self):
+        np.random.seed(1)
+        b, l, t = 2, 4, 3
+        em = np.random.rand(b, l, t).astype(np.float64)
+        trans = np.random.rand(t + 2, t).astype(np.float64)
+        lens = np.array([4, 2])
+        got = run_kernel("crf_decoding",
+                         {"Emission": em, "Transition": trans,
+                          "Length": lens})
+        start, stop, pair = trans[0], trans[1], trans[2:]
+        for i in range(b):
+            n = lens[i]
+            best, best_path = -1e30, None
+            for path in itertools.product(range(t), repeat=n):
+                s = start[path[0]] + stop[path[-1]]
+                s += sum(em[i, k, path[k]] for k in range(n))
+                s += sum(pair[path[k], path[k + 1]] for k in range(n - 1))
+                if s > best:
+                    best, best_path = s, path
+            np.testing.assert_array_equal(got["ViterbiPath"][i, :n],
+                                          best_path)
+
+
+class TestWarpCTC(OpTest):
+    def test_against_bruteforce(self):
+        # brute-force CTC likelihood: sum over all alignments
+        np.random.seed(0)
+        b, t, c = 1, 4, 3
+        logits = np.random.rand(b, t, c).astype(np.float64)
+        label = np.array([[1, 2]])
+        got = run_kernel("warpctc",
+                         {"Logits": logits, "Label": label,
+                          "LogitsLength": np.array([4]),
+                          "LabelLength": np.array([2])}, {"blank": 0})
+        p = np.exp(logits[0]) / np.exp(logits[0]).sum(-1, keepdims=True)
+
+        def collapse(path):
+            out = []
+            prev = -1
+            for s in path:
+                if s != prev and s != 0:
+                    out.append(s)
+                prev = s
+            return out
+
+        tot = 0.0
+        for path in itertools.product(range(c), repeat=t):
+            if collapse(path) == [1, 2]:
+                tot += np.prod([p[k, path[k]] for k in range(t)])
+        np.testing.assert_allclose(got["Loss"][0, 0], -np.log(tot),
+                                   rtol=1e-5)
+
+
+class TestCTCAlign(OpTest):
+    def test_basic(self):
+        x = np.array([[0, 1, 1, 0, 2, 2, 0], [3, 3, 0, 1, 0, 0, 0]])
+        lens = np.array([7, 4])
+        got = run_kernel("ctc_align", {"Input": x, "Length": lens},
+                         {"blank": 0, "merge_repeated": True})
+        np.testing.assert_array_equal(got["OutputLength"], [2, 2])
+        np.testing.assert_array_equal(got["Output"][0, :2], [1, 2])
+        np.testing.assert_array_equal(got["Output"][1, :2], [3, 1])
+
+
+class TestEditDistance(OpTest):
+    def test_against_reference_dp(self):
+        def lev(a, b):
+            m, n = len(a), len(b)
+            dp = np.zeros((n + 1, m + 1))
+            dp[0, :] = np.arange(m + 1)
+            dp[:, 0] = np.arange(n + 1)
+            for i in range(1, n + 1):
+                for j in range(1, m + 1):
+                    dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                                   dp[i - 1, j - 1]
+                                   + (a[j - 1] != b[i - 1]))
+            return dp[n, m]
+
+        np.random.seed(0)
+        hyp = np.random.randint(0, 5, (3, 6))
+        ref = np.random.randint(0, 5, (3, 5))
+        hl = np.array([6, 3, 0])
+        rl = np.array([5, 5, 2])
+        got = run_kernel("edit_distance",
+                         {"Hyps": hyp, "Refs": ref,
+                          "HypsLength": hl, "RefsLength": rl})
+        for i in range(3):
+            exp = lev(list(hyp[i, :hl[i]]), list(ref[i, :rl[i]]))
+            np.testing.assert_allclose(got["Out"][i, 0], exp)

@@ -1,0 +1,173 @@
+"""Metric + decode op tests (parity model: tests/unittests/test_auc_op.py,
+test_precision_recall_op.py, test_chunk_eval_op.py, test_mean_iou.py,
+test_positive_negative_pair_op.py, test_beam_search_op.py,
+test_gather_tree_op.py)."""
+
+import numpy as np
+
+
+from op_test import OpTest, run_kernel
+
+
+def roc_auc_ref(scores, labels):
+    """Exact pairwise AUC for the test reference."""
+    pos = scores[labels > 0]
+    neg = scores[labels == 0]
+    if len(pos) == 0 or len(neg) == 0:
+        return 0.0
+    wins = (pos[:, None] > neg[None, :]).sum()
+    ties = (pos[:, None] == neg[None, :]).sum()
+    return (wins + 0.5 * ties) / (len(pos) * len(neg))
+
+
+class TestAUC(OpTest):
+    def test_matches_pairwise(self):
+        np.random.seed(0)
+        n, nt = 200, 4095
+        scores = np.random.rand(n).astype(np.float64)
+        labels = np.random.randint(0, 2, n)
+        pred = np.stack([1 - scores, scores], axis=1)
+        got = run_kernel("auc", {"Predict": pred, "Label": labels},
+                         {"num_thresholds": nt})
+        # bucketed AUC approaches the exact pairwise value
+        np.testing.assert_allclose(float(got["AUC"]),
+                                   roc_auc_ref(scores, labels), atol=2e-3)
+
+    def test_accumulates(self):
+        pred = np.array([[0.2, 0.8], [0.9, 0.1]])
+        lab = np.array([1, 0])
+        g1 = run_kernel("auc", {"Predict": pred, "Label": lab},
+                        {"num_thresholds": 7})
+        g2 = run_kernel("auc", {"Predict": pred, "Label": lab,
+                                "StatPos": g1["StatPosOut"],
+                                "StatNeg": g1["StatNegOut"]},
+                        {"num_thresholds": 7})
+        assert g2["StatPosOut"].sum() == 2 * g1["StatPosOut"].sum()
+        assert float(g2["AUC"]) == float(g1["AUC"])  # same distribution
+
+
+class TestPrecisionRecall(OpTest):
+    def test_simple(self):
+        idx = np.array([0, 1, 1, 2])
+        lab = np.array([0, 1, 0, 2])
+        got = run_kernel("precision_recall",
+                         {"Indices": idx, "Labels": lab},
+                         {"class_number": 3})
+        # per class TP: [1,1,1]; FP: [0,1,0]; FN: [1,0,0] (sample 2:
+        # idx=1,label=0 -> FP[1], FN[0])
+        states = np.asarray(got["AccumStatesInfo"])
+        np.testing.assert_allclose(states[:, 0], [1, 1, 1])   # TP
+        np.testing.assert_allclose(states[:, 1], [0, 1, 0])   # FP
+        np.testing.assert_allclose(states[:, 3], [1, 0, 0])   # FN
+        # micro precision = 3/4
+        np.testing.assert_allclose(got["BatchMetrics"][3], 0.75)
+
+
+class TestMeanIou(OpTest):
+    def test_simple(self):
+        pred = np.array([0, 0, 1, 1])
+        lab = np.array([0, 1, 1, 1])
+        got = run_kernel("mean_iou", {"Predictions": pred, "Labels": lab},
+                         {"num_classes": 3})
+        # class0: inter 1, union 2 -> .5 ; class1: inter 2, union 3 -> 2/3
+        np.testing.assert_allclose(float(got["OutMeanIou"]),
+                                   (0.5 + 2 / 3) / 2, rtol=1e-6)
+
+
+class TestPositiveNegativePair(OpTest):
+    def test_counts(self):
+        score = np.array([0.9, 0.2, 0.5, 0.4])
+        label = np.array([1.0, 0.0, 1.0, 0.0])
+        qid = np.array([0, 0, 1, 1])
+        got = run_kernel("positive_negative_pair",
+                         {"Score": score, "Label": label, "QueryID": qid})
+        # q0: (0.9,1) vs (0.2,0): concordant; q1: (0.5,1) vs (0.4,0):
+        # concordant
+        assert float(got["PositivePair"]) == 2.0
+        assert float(got["NegativePair"]) == 0.0
+
+
+class TestChunkEvalIOB(OpTest):
+    def test_exact_match_and_miss(self):
+        # 1 chunk type, IOB: tags B=0, I=1 -> labels: B=0, I=1
+        # seq: B I I O B -> chunks: [0..2], [4..4]  (O encoded as a
+        # second, excluded chunk type: label 2)
+        lab = np.array([[0, 1, 1, 2, 0]])
+        inf = np.array([[0, 1, 1, 2, 0]])
+        got = run_kernel("chunk_eval",
+                         {"Inference": inf, "Label": lab,
+                          "Length": np.array([5])},
+                         {"num_chunk_types": 2, "chunk_scheme": "IOB",
+                          "excluded_chunk_types": [1]})
+        assert int(got["NumLabelChunks"]) == 2
+        assert int(got["NumCorrectChunks"]) == 2
+        np.testing.assert_allclose(float(got["F1-Score"]), 1.0)
+
+        # shorter predicted chunk -> boundary mismatch, no credit for
+        # chunk 1
+        inf2 = np.array([[0, 1, 0, 2, 0]])  # B I B O B: chunk [0..1] != [0..2]
+        got2 = run_kernel("chunk_eval",
+                          {"Inference": inf2, "Label": lab,
+                           "Length": np.array([5])},
+                          {"num_chunk_types": 2, "chunk_scheme": "IOB",
+                           "excluded_chunk_types": [1]})
+        assert int(got2["NumCorrectChunks"]) == 1  # only [4..4] matches
+
+
+class TestBeamSearch(OpTest):
+    def test_step(self):
+        # B=1, K=2, V=3
+        pre_ids = np.array([[1, 2]])
+        pre_scores = np.array([[-1.0, -2.0]])
+        scores = np.log(np.array([[[0.1, 0.6, 0.3],
+                                   [0.7, 0.2, 0.1]]]))
+        got = run_kernel("beam_search",
+                         {"pre_ids": pre_ids, "pre_scores": pre_scores,
+                          "scores": scores},
+                         {"beam_size": 2, "end_id": 0})
+        total = scores + pre_scores[:, :, None]
+        flat = total.reshape(-1)
+        order = np.argsort(-flat)[:2]
+        np.testing.assert_allclose(np.sort(got["selected_scores"][0]),
+                                   np.sort(flat[order]), rtol=1e-6)
+
+    def test_finished_beam_freezes(self):
+        pre_ids = np.array([[0, 2]])          # beam 0 already ended
+        pre_scores = np.array([[-0.5, -3.0]])
+        scores = np.log(np.full((1, 2, 3), 1 / 3))
+        got = run_kernel("beam_search",
+                         {"pre_ids": pre_ids, "pre_scores": pre_scores,
+                          "scores": scores},
+                         {"beam_size": 2, "end_id": 0})
+        # the finished beam proposes only end_id with unchanged score
+        best = np.argmax(got["selected_scores"][0])
+        assert got["selected_ids"][0][best] == 0
+        np.testing.assert_allclose(got["selected_scores"][0][best], -0.5)
+
+
+class TestGatherTree(OpTest):
+    def test_backtrack(self):
+        # T=3, B=1, K=2
+        ids = np.array([[[2, 3]], [[4, 5]], [[6, 7]]])
+        parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]])
+        got = run_kernel("gather_tree", {"Ids": ids, "Parents": parents})
+        # beam 0 at final step: id 6, parent 0 -> step1 beam0 id 4,
+        # parent of that is 1 -> step0 beam1 id 3
+        np.testing.assert_array_equal(got["Out"][:, 0, 0], [3, 4, 6])
+        # beam 1: id 7 <- parent 1 -> id 5, parent 0 -> id 2
+        np.testing.assert_array_equal(got["Out"][:, 0, 1], [2, 5, 7])
+
+
+class TestBeamSearchDecode(OpTest):
+    def test_shapes(self):
+        t, b, k = 4, 2, 3
+        np.random.seed(0)
+        ids = np.random.randint(1, 9, (t, b, k))
+        parents = np.random.randint(0, k, (t, b, k))
+        scores = -np.random.rand(t, b, k)
+        got = run_kernel("beam_search_decode",
+                         {"Ids": ids, "Scores": scores,
+                          "ParentIdx": parents}, {"end_id": 0})
+        assert got["SentenceIds"].shape == (b, t, k)
+        assert got["SentenceScores"].shape == (b, k)
+        assert (got["SentenceLength"] == t).all()  # no end tokens emitted

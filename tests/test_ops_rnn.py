@@ -1,0 +1,198 @@
+"""RNN op tests (parity model: tests/unittests/test_lstm_op.py,
+test_gru_op.py, test_lstm_unit_op.py, test_gru_unit_op.py — step-by-step
+numpy recurrence as the reference value)."""
+
+import numpy as np
+
+from op_test import OpTest, run_kernel
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm(xproj, w, lens):
+    b, t, four_h = xproj.shape
+    h = four_h // 4
+    hs = np.zeros((b, t, h), np.float64)
+    cs = np.zeros((b, t, h), np.float64)
+    for i in range(b):
+        hp = np.zeros(h)
+        cp = np.zeros(h)
+        for k in range(lens[i]):
+            g = xproj[i, k] + hp @ w
+            gc, gi, gf, go = np.split(g, 4)
+            ii, ff, oo = sigmoid(gi), sigmoid(gf), sigmoid(go)
+            c = np.tanh(gc) * ii + cp * ff
+            hh = oo * np.tanh(c)
+            hs[i, k], cs[i, k] = hh, c
+            hp, cp = hh, c
+    return hs, cs
+
+
+def np_gru(xproj, w, lens, origin=False):
+    b, t, three_h = xproj.shape
+    h = three_h // 3
+    hs = np.zeros((b, t, h), np.float64)
+    for i in range(b):
+        hp = np.zeros(h)
+        for k in range(lens[i]):
+            g = xproj[i, k].copy()
+            g[:2 * h] += hp @ w[:, :2 * h]
+            u, r = sigmoid(g[:h]), sigmoid(g[h:2 * h])
+            c = np.tanh(g[2 * h:] + (r * hp) @ w[:, 2 * h:])
+            hp = u * hp + (1 - u) * c if origin else (1 - u) * hp + u * c
+            hs[i, k] = hp
+    return hs
+
+
+class TestLSTM(OpTest):
+    op_type = "lstm"
+    atol = 1e-5
+
+    def test_forward(self):
+        np.random.seed(0)
+        b, t, h = 3, 5, 4
+        x = np.random.randn(b, t, 4 * h).astype(np.float64) * 0.5
+        w = np.random.randn(h, 4 * h).astype(np.float64) * 0.5
+        lens = np.array([5, 3, 0])
+        got = run_kernel("lstm", {"Input": x, "Weight": w, "Length": lens})
+        hs, cs = np_lstm(x, w, lens)
+        np.testing.assert_allclose(got["Hidden"], hs, atol=1e-5)
+        np.testing.assert_allclose(got["Cell"], cs, atol=1e-5)
+
+    def test_reverse_matches_flipped(self):
+        np.random.seed(1)
+        b, t, h = 2, 4, 3
+        x = np.random.randn(b, t, 4 * h) * 0.5
+        w = np.random.randn(h, 4 * h) * 0.5
+        lens = np.array([4, 2])
+        fwd_on_flipped = np_lstm(
+            np.stack([np.concatenate([x[i, :lens[i]][::-1],
+                                      x[i, lens[i]:]]) for i in range(b)]),
+            w, lens)[0]
+        got = run_kernel("lstm", {"Input": x, "Weight": w, "Length": lens},
+                         {"is_reverse": True})
+        for i in range(b):
+            np.testing.assert_allclose(got["Hidden"][i, :lens[i]],
+                                       fwd_on_flipped[i, :lens[i]][::-1],
+                                       atol=1e-5)
+
+    def test_grad(self):
+        np.random.seed(2)
+        x = np.random.randn(2, 3, 8) * 0.3
+        w = np.random.randn(2, 8) * 0.3
+        self.check_grad({"Input": x, "Weight": w,
+                         "Length": np.array([3, 2])}, ["Input", "Weight"],
+                        out_slot="Hidden")
+
+
+class TestGRU(OpTest):
+    op_type = "gru"
+
+    def test_forward(self):
+        np.random.seed(0)
+        b, t, h = 3, 4, 3
+        x = np.random.randn(b, t, 3 * h).astype(np.float64) * 0.5
+        w = np.random.randn(h, 3 * h).astype(np.float64) * 0.5
+        lens = np.array([4, 2, 1])
+        got = run_kernel("gru", {"Input": x, "Weight": w, "Length": lens})
+        np.testing.assert_allclose(got["Hidden"], np_gru(x, w, lens),
+                                   atol=1e-5)
+
+    def test_origin_mode(self):
+        np.random.seed(3)
+        x = np.random.randn(2, 3, 6) * 0.5
+        w = np.random.randn(2, 6) * 0.5
+        lens = np.array([3, 3])
+        got = run_kernel("gru", {"Input": x, "Weight": w, "Length": lens},
+                         {"origin_mode": True})
+        np.testing.assert_allclose(got["Hidden"],
+                                   np_gru(x, w, lens, origin=True),
+                                   atol=1e-5)
+
+    def test_grad(self):
+        x = np.random.randn(2, 3, 6) * 0.3
+        w = np.random.randn(2, 6) * 0.3
+        self.check_grad({"Input": x, "Weight": w,
+                         "Length": np.array([3, 2])}, ["Input", "Weight"],
+                        out_slot="Hidden")
+
+
+class TestLSTMUnit(OpTest):
+    op_type = "lstm_unit"
+
+    def test_forward(self):
+        np.random.seed(0)
+        x = np.random.randn(4, 12).astype(np.float64)
+        c_prev = np.random.randn(4, 3).astype(np.float64)
+        got = run_kernel("lstm_unit", {"X": x, "C_prev": c_prev},
+                         {"forget_bias": 1.0})
+        d = 3
+        i, f = sigmoid(x[:, :d]), sigmoid(x[:, d:2 * d] + 1.0)
+        o, g = sigmoid(x[:, 2 * d:3 * d]), np.tanh(x[:, 3 * d:])
+        c = f * c_prev + i * g
+        np.testing.assert_allclose(got["C"], c, atol=1e-6)
+        np.testing.assert_allclose(got["H"], o * np.tanh(c), atol=1e-6)
+
+    def test_grad(self):
+        x = np.random.randn(3, 8) * 0.5
+        c = np.random.randn(3, 2) * 0.5
+        self.check_grad({"X": x, "C_prev": c}, ["X", "C_prev"],
+                        out_slot="H")
+
+
+class TestGRUUnit(OpTest):
+    op_type = "gru_unit"
+
+    def test_forward(self):
+        np.random.seed(0)
+        h = 3
+        x = np.random.randn(4, 3 * h).astype(np.float64) * 0.5
+        hp = np.random.randn(4, h).astype(np.float64) * 0.5
+        w = np.random.randn(h, 3 * h).astype(np.float64) * 0.5
+        got = run_kernel("gru_unit",
+                         {"Input": x, "HiddenPrev": hp, "Weight": w})
+        g = x.copy()
+        g[:, :2 * h] += hp @ w[:, :2 * h]
+        u, r = sigmoid(g[:, :h]), sigmoid(g[:, h:2 * h])
+        c = np.tanh(g[:, 2 * h:] + (r * hp) @ w[:, 2 * h:])
+        np.testing.assert_allclose(got["Hidden"], (1 - u) * hp + u * c,
+                                   atol=1e-5)
+
+
+class TestLSTMP(OpTest):
+    op_type = "lstmp"
+
+    def test_projection_shape(self):
+        np.random.seed(0)
+        b, t, h, p = 2, 3, 4, 2
+        x = np.random.randn(b, t, 4 * h) * 0.5
+        w = np.random.randn(p, 4 * h) * 0.5
+        wp = np.random.randn(h, p) * 0.5
+        got = run_kernel("lstmp", {"Input": x, "Weight": w,
+                                   "ProjWeight": wp,
+                                   "Length": np.array([3, 2])})
+        assert got["Projection"].shape == (b, t, p)
+        assert got["Cell"].shape == (b, t, h)
+        assert np.isfinite(got["Projection"]).all()
+
+
+class TestRowConv(OpTest):
+    op_type = "row_conv"
+
+    def test_forward(self):
+        x = np.random.rand(2, 5, 3).astype(np.float64)
+        w = np.random.rand(2, 3).astype(np.float64)
+        got = run_kernel("row_conv", {"X": x, "Filter": w})
+        exp = np.zeros_like(x)
+        for t in range(5):
+            for k in range(2):
+                if t + k < 5:
+                    exp[:, t] += x[:, t + k] * w[k]
+        np.testing.assert_allclose(got["Out"], exp, atol=1e-6)
+
+    def test_grad(self):
+        x = np.random.rand(2, 4, 2)
+        w = np.random.rand(2, 2)
+        self.check_grad({"X": x, "Filter": w}, ["X", "Filter"])

@@ -1,0 +1,152 @@
+"""Round-2 sequence-family op tests (parity model:
+tests/unittests/test_sequence_{concat,pad,unpad,slice,enumerate,erase,
+scatter,conv,reshape,expand_as,topk_avg_pooling}.py — numpy references
+computed per-row over the ragged valid prefix)."""
+
+import numpy as np
+
+from op_test import OpTest, run_kernel
+
+
+def _rows(x, lens):
+    return [x[i, :lens[i]] for i in range(x.shape[0])]
+
+
+class TestSequenceConcat(OpTest):
+    op_type = "sequence_concat"
+
+    def test_basic(self):
+        x1 = np.random.rand(3, 4, 2).astype(np.float32)
+        l1 = np.array([2, 4, 1])
+        x2 = np.random.rand(3, 3, 2).astype(np.float32)
+        l2 = np.array([3, 0, 2])
+        got = run_kernel("sequence_concat", {"X": [x1, x2],
+                                             "Length": [l1, l2]})
+        for i in range(3):
+            packed = np.concatenate([x1[i, :l1[i]], x2[i, :l2[i]]], axis=0)
+            np.testing.assert_allclose(got["Out"][i, :l1[i] + l2[i]], packed,
+                                       rtol=1e-6)
+        np.testing.assert_array_equal(got["Length"], l1 + l2)
+
+
+class TestSequencePadUnpad(OpTest):
+    def test_pad(self):
+        x = np.random.rand(2, 3, 2).astype(np.float32)
+        lens = np.array([2, 3])
+        got = run_kernel("sequence_pad", {"X": x, "Length": lens},
+                         {"padded_length": 5, "pad_value": -1.0})
+        assert got["Out"].shape == (2, 5, 2)
+        np.testing.assert_allclose(got["Out"][0, :2], x[0, :2], rtol=1e-6)
+        assert (got["Out"][0, 2:] == -1.0).all()
+
+    def test_unpad(self):
+        x = np.random.rand(2, 4).astype(np.float32)
+        lens = np.array([1, 4])
+        got = run_kernel("sequence_unpad", {"X": x, "Length": lens})
+        assert (got["Out"][0, 1:] == 0).all()
+        np.testing.assert_allclose(got["Out"][1], x[1], rtol=1e-6)
+
+
+class TestSequenceSlice(OpTest):
+    def test_basic(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 6, 2)
+        got = run_kernel("sequence_slice",
+                         {"X": x, "Offset": np.array([1, 2]),
+                          "SliceLength": np.array([2, 3])})
+        np.testing.assert_allclose(got["Out"][0, :2], x[0, 1:3], rtol=1e-6)
+        np.testing.assert_allclose(got["Out"][1, :3], x[1, 2:5], rtol=1e-6)
+        assert (got["Out"][0, 2:] == 0).all()
+
+
+class TestSequenceEnumerate(OpTest):
+    def test_basic(self):
+        x = np.array([[1, 2, 3, 4, 0], [5, 6, 0, 0, 0]], np.int32)
+        lens = np.array([4, 2])
+        got = run_kernel("sequence_enumerate", {"X": x, "Length": lens},
+                         {"win_size": 2, "pad_value": 0})
+        # ref semantics: window [t, t+1] with pad past the end
+        np.testing.assert_array_equal(got["Out"][0, :4],
+                                      [[1, 2], [2, 3], [3, 4], [4, 0]])
+        np.testing.assert_array_equal(got["Out"][1, :2], [[5, 6], [6, 0]])
+
+
+class TestSequenceErase(OpTest):
+    def test_basic(self):
+        x = np.array([[1, 2, 1, 3, 0], [2, 2, 4, 0, 0]], np.int32)
+        lens = np.array([4, 3])
+        got = run_kernel("sequence_erase", {"X": x, "Length": lens},
+                         {"tokens": [1, 2]})
+        np.testing.assert_array_equal(got["Length"], [1, 1])
+        assert got["Out"][0, 0] == 3 and got["Out"][1, 0] == 4
+
+
+class TestSequenceScatter(OpTest):
+    def test_basic(self):
+        x = np.zeros((2, 6), np.float32)
+        ids = np.array([[0, 2, 2], [1, 3, 0]])
+        upd = np.ones((2, 3), np.float32)
+        got = run_kernel("sequence_scatter",
+                         {"X": x, "Ids": ids, "Updates": upd,
+                          "UpdateLength": np.array([3, 2])})
+        np.testing.assert_allclose(got["Out"][0], [1, 0, 2, 0, 0, 0])
+        np.testing.assert_allclose(got["Out"][1], [0, 1, 0, 1, 0, 0])
+
+
+class TestSequenceReshape(OpTest):
+    def test_basic(self):
+        x = np.random.rand(2, 4, 6).astype(np.float32)
+        lens = np.array([2, 4])
+        got = run_kernel("sequence_reshape", {"X": x, "Length": lens},
+                         {"new_dim": 3})
+        assert got["Out"].shape == (2, 8, 3)
+        np.testing.assert_array_equal(got["Length"], [4, 8])
+        np.testing.assert_allclose(got["Out"][0, :4].reshape(-1),
+                                   x[0, :2].reshape(-1), rtol=1e-6)
+
+
+class TestSequenceExpandAs(OpTest):
+    def test_basic(self):
+        x = np.random.rand(3, 2).astype(np.float32)
+        lens = np.array([2, 0, 3])
+        got = run_kernel("sequence_expand_as", {"X": x, "Length": lens},
+                         {"maxlen": 4})
+        np.testing.assert_allclose(got["Out"][0, :2], np.stack([x[0]] * 2),
+                                   rtol=1e-6)
+        assert (got["Out"][1] == 0).all()
+        np.testing.assert_allclose(got["Out"][2, :3], np.stack([x[2]] * 3),
+                                   rtol=1e-6)
+
+
+class TestSequenceConv(OpTest):
+    op_type = "sequence_conv"
+
+    def test_matches_manual(self):
+        np.random.seed(0)
+        x = np.random.rand(2, 5, 3).astype(np.float32)
+        lens = np.array([5, 3])
+        w = np.random.rand(9, 4).astype(np.float32)  # ctx=3 * D=3 -> 4
+        got = run_kernel("sequence_conv",
+                         {"X": x, "Filter": w, "Length": lens},
+                         {"contextLength": 3, "contextStart": -1})
+        # manual: row 1, pos 0 context = [0, x[0], x[1]]
+        ctx = np.concatenate([np.zeros(3), x[1][0], x[1][1]])
+        np.testing.assert_allclose(got["Out"][1, 0], ctx @ w, rtol=1e-5)
+        # invalid positions are zero
+        assert (got["Out"][1, 3:] == 0).all()
+
+    def test_grad(self):
+        x = np.random.rand(2, 4, 2)
+        w = np.random.rand(6, 3)
+        self.attrs = {"contextLength": 3, "contextStart": -1}
+        self.check_grad({"X": x, "Filter": w,
+                         "Length": np.array([4, 2])}, ["X", "Filter"])
+
+
+class TestSequenceTopkAvgPooling(OpTest):
+    def test_basic(self):
+        x = np.array([[[1.], [5.], [3.], [2.]]], np.float32)  # [1,4,1]
+        lens = np.array([3])
+        got = run_kernel("sequence_topk_avg_pooling",
+                         {"X": x, "Length": lens}, {"topks": [2, 5]})
+        # top-2 of [1,5,3] = 5,3 -> sum 8 / k=2 = 4; k=5: sum(5,3,1)/5 = 1.8
+        np.testing.assert_allclose(got["Out"][0], [4.0, 1.8], rtol=1e-6)
