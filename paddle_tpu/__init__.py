@@ -38,6 +38,9 @@ from .framework import (
     name_scope,
     data,
     Executor,
+    CompiledProgram,
+    BuildStrategy,
+    ExecutionStrategy,
     Scope,
     global_scope,
     scope_guard,
@@ -64,6 +67,7 @@ from . import distributed
 from . import amp
 from . import jit
 from . import models
+from . import slim
 from . import checkpoint
 
 from .reader import DataLoader

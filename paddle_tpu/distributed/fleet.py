@@ -86,9 +86,11 @@ def is_first_worker():
 def distributed_optimizer(optimizer, strategy=None):
     """Wrap a dygraph optimizer for collective training (fleet_base.py:238).
 
-    Returns the optimizer augmented with the strategy; actual gradient
-    synchronization happens in DataParallelTrainStep / ShardedTrainStep
-    which consult the strategy's mesh degrees."""
+    Returns the optimizer augmented with the strategy. The strategy's knobs
+    change behavior through `make_train_step` (or DataParallelTrainStep,
+    which consults the stored strategy): use_dgc -> DGCTrainStep,
+    use_local_sgd -> LocalSGDTrainStep, recompute -> jax.checkpoint around
+    the loss, amp -> bf16 auto_cast, mesh degrees -> build_mesh."""
     global _strategy
     _strategy = strategy or DistributedStrategy()
     optimizer._fleet_strategy = _strategy
@@ -97,3 +99,65 @@ def distributed_optimizer(optimizer, strategy=None):
 
 def get_strategy():
     return _strategy
+
+
+def make_train_step(model, optimizer, loss_fn, mesh=None, strategy=None):
+    """Build the train step the strategy asks for (CollectiveOptimizer
+    .minimize parity, incubate/fleet/collective/__init__.py:182 — but as a
+    step factory instead of a program transpile).
+
+    Consumes every DistributedStrategy knob:
+      use_dgc          -> DGC sparse-allreduce momentum step
+      use_local_sgd    -> per-replica steps + periodic averaging
+      recompute        -> jax.checkpoint around the loss (activation remat)
+      amp              -> bf16 auto_cast around the loss
+      dp/tp/pp/sp degrees -> mesh construction when no mesh is passed
+    """
+    import jax as _jax
+
+    from .data_parallel import DataParallelTrainStep
+    from .mesh import build_mesh, default_mesh
+    from .strategies import DGCTrainStep, LocalSGDTrainStep
+
+    strategy = (strategy or getattr(optimizer, "_fleet_strategy", None)
+                or DistributedStrategy())
+    if mesh is None:
+        if strategy.dp_degree or strategy.tp_degree > 1 \
+                or strategy.sp_degree > 1 or strategy.pp_degree > 1:
+            mesh = build_mesh(dp=strategy.dp_degree or 1,
+                              tp=strategy.tp_degree,
+                              pp=strategy.pp_degree,
+                              sp=strategy.sp_degree)
+        else:
+            mesh = default_mesh()
+
+    wrapped_loss = loss_fn
+    if strategy.amp:
+        from ..amp import auto_cast
+
+        def wrapped_loss(m, *batch, _inner=wrapped_loss):
+            with auto_cast(enable=True):
+                return _inner(m, *batch)
+    if strategy.recompute:
+        def wrapped_loss(m, *batch, _inner=wrapped_loss):
+            return _jax.checkpoint(
+                lambda *b: _inner(m, *b))(*batch)
+
+    if strategy.use_dgc:
+        hp = getattr(optimizer, "_hyperparams", None)
+        if hp is None or "learning_rate" in hp and callable(
+                hp["learning_rate"]):
+            raise ValueError(
+                "use_dgc needs an optimizer with recorded scalar "
+                "hyperparameters (paddle_tpu.dygraph SGD/Momentum); got "
+                f"{type(optimizer).__name__} without _hyperparams")
+        return DGCTrainStep(model, wrapped_loss, mesh,
+                            lr=float(hp["learning_rate"]),
+                            momentum=float(hp.get("momentum", 0.9)),
+                            sparsity=strategy.dgc_sparsity,
+                            rampup_begin_step=getattr(
+                                strategy, "dgc_rampup_begin_step", 0))
+    if strategy.use_local_sgd:
+        return LocalSGDTrainStep(model, optimizer, wrapped_loss, mesh,
+                                 local_sgd_steps=strategy.local_sgd_steps)
+    return DataParallelTrainStep(model, optimizer, wrapped_loss, mesh)

@@ -1,0 +1,281 @@
+"""Distributed training strategies: DGC and LocalSGD.
+
+TPU-native implementations of two reference capabilities that were dead
+knobs in round 1:
+
+- **DGC** (deep gradient compression): /root/reference/python/paddle/fluid/
+  optimizer.py:1041 DGCMomentumOptimizer + paddle/fluid/framework/details/
+  sparse_all_reduce_op_handle.cc. Local momentum correction (u = m*u + g),
+  error-feedback accumulation (v += u), per-parameter top-k selection on
+  |v|, and an all-reduce of only the selected entries; selected slots are
+  cleared from u and v. On TPU the "sparse all-reduce" is a psum of the
+  top-k-masked dense tensor: ICI collectives are compiled, not hand-rolled
+  NCCL, so the masked psum is the native expression of the same semantics
+  (and XLA fuses mask+psum into the backward).
+- **LocalSGD**: /root/reference/python/paddle/fluid/transpiler/
+  collective.py:270 — every worker takes `local_sgd_steps` independent
+  optimizer steps on its own replica, then replicas are averaged. Workers
+  = slots of the "dp" mesh axis; each device owns its replica as the
+  leading axis of a [ndev, ...] stacked param tree sharded over dp.
+
+Both run as ONE jitted SPMD program over the mesh (shard_map over "dp"),
+mirroring the repo-wide inversion of the reference's graph-rewriting
+transpilers.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn.layers import _swap_params, buffer_dict
+from ..nn.parameter import default_rng
+from .mesh import default_mesh
+
+__all__ = ["DGCTrainStep", "LocalSGDTrainStep", "dgc_topk_mask"]
+
+
+def dgc_topk_mask(v, sparsity):
+    """Top-k selection mask on |v|: keep the largest (1-sparsity) fraction.
+
+    The selection itself is the Pallas-friendly part of DGC; at these sizes
+    lax.top_k on the flattened tensor compiles to an efficient TPU sort.
+    """
+    flat = jnp.abs(v).reshape(-1)
+    k = max(1, int(round(flat.shape[0] * (1.0 - sparsity))))
+    kth = jax.lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(v) >= kth).astype(v.dtype)
+
+
+class DGCTrainStep:
+    """DGC momentum data-parallel train step.
+
+    step = DGCTrainStep(model, loss_fn, mesh, lr=..., momentum=...,
+                        sparsity=0.999, rampup_begin_step=0)
+    loss = step(x, y)
+
+    Before `rampup_begin_step` global steps the update is plain dense
+    momentum DP (reference DGCMomentumOptimizer behavior: dgc kicks in
+    after the rampup boundary, optimizer.py:1041).
+    """
+
+    def __init__(self, model, loss_fn, mesh=None, lr=0.01, momentum=0.9,
+                 sparsity=0.999, rampup_begin_step=0):
+        self._model = model
+        self._mesh = mesh or default_mesh()
+        self._lr = lr
+        self._m = momentum
+        self._sparsity = sparsity
+        self._rampup = int(rampup_begin_step)
+        self._state = None  # (u, v, velocity_dense, step_count)
+        self._loss_fn = loss_fn
+
+        def _local_grad(params, buffers, rng_key, *batch):
+            from ..jit import (_get_buffer, _restore_buffers,
+                               _swap_in_buffers)
+
+            def loss_of(ps):
+                with _swap_params(model, ps), \
+                        default_rng.key_context(rng_key):
+                    old = _swap_in_buffers(model, buffers)
+                    try:
+                        loss = loss_fn(model, *batch)
+                        new_buffers = {p: _get_buffer(model, p)
+                                       for p in buffers}
+                    finally:
+                        _restore_buffers(model, old)
+                return loss, new_buffers
+            return jax.value_and_grad(loss_of, has_aux=True)(params)
+
+        def _step(params, buffers, u, v, vel, count, rng_key, *batch):
+            (loss, new_buffers), grads = _local_grad(
+                params, buffers, rng_key, *batch)
+            loss = jax.lax.pmean(loss, "dp")
+            new_buffers = jax.tree.map(
+                lambda b: jax.lax.pmean(b, "dp") if jnp.issubdtype(
+                    jnp.asarray(b).dtype, jnp.floating) else b,
+                new_buffers)
+            use_dgc = count >= self._rampup
+
+            def dgc_branch(_):
+                def per_param(g, u_, v_):
+                    u_n = self._m * u_ + g          # momentum correction
+                    v_n = v_ + u_n                  # error feedback accum
+                    mask = dgc_topk_mask(v_n, self._sparsity)
+                    send = v_n * mask
+                    dense = jax.lax.pmean(send, "dp")
+                    return dense, u_n * (1 - mask), v_n * (1 - mask)
+                out = jax.tree.map(per_param, grads, u, v)
+                dense = jax.tree.map(lambda t: t[0], out,
+                                     is_leaf=lambda t: isinstance(t, tuple))
+                u_n = jax.tree.map(lambda t: t[1], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+                v_n = jax.tree.map(lambda t: t[2], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+                # selected velocity applied directly (u carries momentum)
+                p_n = jax.tree.map(lambda p, d: p - self._lr * d,
+                                   params, dense)
+                return p_n, u_n, v_n, vel
+
+            def dense_branch(_):
+                g = jax.tree.map(lambda g_: jax.lax.pmean(g_, "dp"), grads)
+                vel_n = jax.tree.map(lambda vl, g_: self._m * vl + g_,
+                                     vel, g)
+                p_n = jax.tree.map(lambda p, vl: p - self._lr * vl,
+                                   params, vel_n)
+                return p_n, u, v, vel_n
+
+            params, u, v, vel = jax.lax.cond(use_dgc, dgc_branch,
+                                             dense_branch, None)
+            return params, new_buffers, u, v, vel, count + 1, loss
+
+        rep = P()
+        bspec = P("dp")
+
+        def _sharded(params, buffers, u, v, vel, count, rng_key, *batch):
+            return shard_map(
+                _step, mesh=self._mesh,
+                in_specs=(rep, rep, rep, rep, rep, rep, rep)
+                + tuple(bspec for _ in batch),
+                out_specs=(rep, rep, rep, rep, rep, rep, rep),
+                check_vma=False,
+            )(params, buffers, u, v, vel, count, rng_key, *batch)
+
+        self._jit = jax.jit(_sharded, donate_argnums=(0, 1, 2, 3, 4))
+
+    def __call__(self, *batch):
+        from ..nn.layers import buffer_dict
+
+        params = {n: p.value for n, p in self._model.named_parameters()
+                  if p.trainable}
+        buffers = buffer_dict(self._model)
+        if self._state is None:
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            self._state = (zeros,
+                           jax.tree.map(jnp.zeros_like, params),
+                           jax.tree.map(jnp.zeros_like, params),
+                           jnp.zeros((), jnp.int32))
+        u, v, vel, count = self._state
+        batch = tuple(jnp.asarray(b) for b in batch)
+        params, buffers, u, v, vel, count, loss = self._jit(
+            params, buffers, u, v, vel, count, default_rng.next_key(),
+            *batch)
+        self._state = (u, v, vel, count)
+        named = dict(self._model.named_parameters())
+        for n, val in params.items():
+            named[n].value = val
+        for path, val in buffers.items():
+            self._model._set_buffer_by_path(path, val)
+        return loss
+
+
+class LocalSGDTrainStep:
+    """LocalSGD data-parallel train step (collective.py:270 parity).
+
+    Each dp slot owns an independent replica (leading [ndev] axis sharded
+    over "dp"); every `local_sgd_steps` global steps the replicas are
+    averaged with a pmean. local_sgd_steps=1 is exactly synchronous DP
+    for SGD-family optimizers.
+    """
+
+    def __init__(self, model, optimizer, loss_fn, mesh=None,
+                 local_sgd_steps=1):
+        self._model = model
+        self._optimizer = optimizer
+        self._mesh = mesh or default_mesh()
+        self._n = int(np.prod([self._mesh.shape[a]
+                               for a in ("dp",) if a in self._mesh.shape]))
+        self._k = int(local_sgd_steps)
+        self._state = None  # (params_stacked, opt_state_stacked, count)
+
+        def _step(params, buffers, opt_state, count, rng_key, *batch):
+            from ..jit import (_get_buffer, _restore_buffers,
+                               _swap_in_buffers)
+
+            # params: per-device block [1, ...] -> local replica
+            local = jax.tree.map(lambda p: p[0], params)
+            local_buf = jax.tree.map(lambda b: b[0], buffers)
+
+            def loss_of(ps):
+                with _swap_params(model, ps), \
+                        default_rng.key_context(rng_key):
+                    old = _swap_in_buffers(model, local_buf)
+                    try:
+                        loss = loss_fn(model, *batch)
+                        new_buf = {p: _get_buffer(model, p)
+                                   for p in local_buf}
+                    finally:
+                        _restore_buffers(model, old)
+                return loss, new_buf
+
+            (loss, new_buf), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(local)
+            loss = jax.lax.pmean(loss, "dp")
+            new_local, new_opt = optimizer.functional_update(
+                grads, jax.tree.map(lambda s: s[0], opt_state), local)
+            count = count + 1
+            sync = (count % self._k) == 0
+
+            def maybe_avg(p):
+                if not jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating):
+                    return p
+                return jax.lax.cond(
+                    sync, lambda q: jax.lax.pmean(q, "dp"), lambda q: q, p)
+
+            new_local = jax.tree.map(maybe_avg, new_local)
+            new_buf = jax.tree.map(maybe_avg, new_buf)
+            return (jax.tree.map(lambda p: p[None], new_local),
+                    jax.tree.map(lambda b: b[None], new_buf),
+                    jax.tree.map(lambda s: s[None], new_opt),
+                    count, loss)
+
+        rep = P()
+        stacked = P("dp")
+        bspec = P("dp")
+
+        def _sharded(params, buffers, opt_state, count, rng_key, *batch):
+            return shard_map(
+                _step, mesh=self._mesh,
+                in_specs=(stacked, stacked, stacked, rep, rep)
+                + tuple(bspec for _ in batch),
+                out_specs=(stacked, stacked, stacked, rep, rep),
+                check_vma=False,
+            )(params, buffers, opt_state, count, rng_key, *batch)
+
+        self._jit = jax.jit(_sharded, donate_argnums=(0, 1, 2))
+
+    def _stack(self, tree):
+        sharding = NamedSharding(self._mesh, P("dp"))
+        return jax.tree.map(
+            lambda p: jax.device_put(
+                jnp.broadcast_to(p[None], (self._n,) + p.shape), sharding),
+            tree)
+
+    def __call__(self, *batch):
+        from ..nn.layers import buffer_dict
+
+        if self._state is None:
+            params = {n: p.value for n, p in
+                      self._model.named_parameters() if p.trainable}
+            opt_state = self._optimizer.init_state(params)
+            self._state = (self._stack(params),
+                           self._stack(buffer_dict(self._model)),
+                           self._stack(opt_state),
+                           jnp.zeros((), jnp.int32))
+        params_st, buf_st, opt_st, count = self._state
+        batch = tuple(jnp.asarray(b) for b in batch)
+        params_st, buf_st, opt_st, count, loss = self._jit(
+            params_st, buf_st, opt_st, count, default_rng.next_key(),
+            *batch)
+        self._state = (params_st, buf_st, opt_st, count)
+        # reflect replica 0 into the model (replicas coincide after sync)
+        named = dict(self._model.named_parameters())
+        for n, val in jax.tree.map(lambda p: p[0],
+                                   dict(params_st)).items():
+            named[n].value = val
+        for path, val in jax.tree.map(lambda b: b[0],
+                                      dict(buf_st)).items():
+            self._model._set_buffer_by_path(path, val)
+        return loss

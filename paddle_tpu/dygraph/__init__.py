@@ -173,41 +173,53 @@ class DygraphOptimizer:
 
 
 def SGD(learning_rate=0.01, parameter_list=None, grad_clip=None):
-    return DygraphOptimizer(optax.sgd(learning_rate), parameter_list,
+    opt = DygraphOptimizer(optax.sgd(learning_rate), parameter_list,
                             grad_clip)
+    opt._hyperparams = {"learning_rate": learning_rate}
+    return opt
 
 
 def Momentum(learning_rate=0.01, momentum=0.9, parameter_list=None,
              use_nesterov=False, grad_clip=None):
-    return DygraphOptimizer(
+    opt = DygraphOptimizer(
         optax.sgd(learning_rate, momentum=momentum, nesterov=use_nesterov),
         parameter_list, grad_clip)
+    opt._hyperparams = {"learning_rate": learning_rate, "momentum": momentum}
+    return opt
 
 
 def Adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
          parameter_list=None, grad_clip=None):
-    return DygraphOptimizer(
+    opt = DygraphOptimizer(
         optax.adam(learning_rate, b1=beta1, b2=beta2, eps=epsilon),
         parameter_list, grad_clip)
+    opt._hyperparams = {"learning_rate": learning_rate}
+    return opt
 
 
 def AdamW(learning_rate=0.001, weight_decay=0.01, beta1=0.9, beta2=0.999,
           epsilon=1e-8, parameter_list=None, grad_clip=None):
-    return DygraphOptimizer(
+    opt = DygraphOptimizer(
         optax.adamw(learning_rate, b1=beta1, b2=beta2, eps=epsilon,
                     weight_decay=weight_decay), parameter_list, grad_clip)
+    opt._hyperparams = {"learning_rate": learning_rate}
+    return opt
 
 
 def Adagrad(learning_rate=0.01, parameter_list=None, grad_clip=None):
-    return DygraphOptimizer(optax.adagrad(learning_rate), parameter_list,
+    opt = DygraphOptimizer(optax.adagrad(learning_rate), parameter_list,
                             grad_clip)
+    opt._hyperparams = {"learning_rate": learning_rate}
+    return opt
 
 
 def RMSProp(learning_rate=0.01, rho=0.95, epsilon=1e-6, momentum=0.0,
             parameter_list=None, grad_clip=None):
-    return DygraphOptimizer(
+    opt = DygraphOptimizer(
         optax.rmsprop(learning_rate, decay=rho, eps=epsilon,
                       momentum=momentum), parameter_list, grad_clip)
+    opt._hyperparams = {"learning_rate": learning_rate, "momentum": momentum}
+    return opt
 
 
 def Adamax(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,

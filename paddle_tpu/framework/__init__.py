@@ -12,6 +12,7 @@ from .program import (
     data,
 )
 from .executor import Executor, Scope, global_scope, scope_guard
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from .backward import append_backward, gradients
 from .param_attr import ParamAttr
 from . import initializer, unique_name
@@ -20,6 +21,7 @@ __all__ = [
     "Program", "Block", "Variable", "Parameter", "Operator",
     "BackwardSection", "default_main_program", "default_startup_program",
     "program_guard", "name_scope", "data", "Executor", "Scope",
+    "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
     "global_scope", "scope_guard", "append_backward", "gradients",
     "ParamAttr", "initializer", "unique_name",
 ]

@@ -443,7 +443,10 @@ class Executor:
     ):
         program = program if program is not None else default_main_program()
         # CompiledProgram / parallel wrapper support
+        dp_mesh = None
         if hasattr(program, "_get_executable_program"):
+            if getattr(program, "_is_data_parallel", False):
+                dp_mesh = program._dp_mesh()
             program = program._get_executable_program()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -497,13 +500,23 @@ class Executor:
             (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
             for n in sorted(feed_arrays)
         )
+        if dp_mesh is not None:
+            ndev = dp_mesh.devices.size
+            for n, a in feed_arrays.items():
+                if a.ndim == 0 or a.shape[0] % ndev != 0:
+                    raise ValueError(
+                        f"data-parallel feed '{n}' needs a leading batch "
+                        f"dim divisible by {ndev} devices, got "
+                        f"{a.shape}")
+
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               state_names)
+               state_names, None if dp_mesh is None else dp_mesh.shape_tuple)
         # cache value holds the program so id() can't be recycled by a new
         # Program allocated at the same address after GC
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None or entry[1] is not program:
-            compiled = self._build(program, fetch_names, tuple(persist_names))
+            compiled = self._build(program, fetch_names, tuple(persist_names),
+                                   dp_mesh=dp_mesh)
             if use_program_cache:
                 self._cache[key] = (compiled, program)
         else:
@@ -515,6 +528,91 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    # ------------------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           sparse_config=None):
+        """Dataset-driven training loop — the industrial CTR path.
+
+        Parity: /root/reference/python/paddle/fluid/executor.py:1187
+        (train_from_dataset -> _run_from_dataset -> MultiTrainer /
+        HogwildWorker::TrainFiles, hogwild_worker.cc:237). The reference
+        spawns N DeviceWorker threads each draining a DataFeed; here the
+        native MultiSlot reader threads (csrc/data_feed.cpp) keep the
+        input queue full while ONE jitted program consumes batches — on
+        TPU the parallelism belongs inside the compiled step, not in
+        host worker threads.
+
+        sparse_config enables the Downpour/PS flow
+        (DistMultiTrainer + DownpourWorker::TrainFiles parity —
+        device_worker.h:203): {"table": SparseEmbedding-or-Communicator,
+        "ids_var": slot name with ids, "emb_var": data var fed with
+        pulled rows, "lr": optional} — pull before each step, push the
+        embedding gradient after (the program must mark emb_var in
+        append_backward's parameter_list so its @GRAD is addressable).
+
+        Returns the list of final-batch fetch values (or None, like the
+        reference, when fetch_list is empty).
+        """
+        program = program if program is not None else default_main_program()
+        real_prog = program
+        if hasattr(real_prog, "_get_executable_program"):
+            real_prog = real_prog._get_executable_program()
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+        fetch_info = list(fetch_info or fetch_names)
+        blk = real_prog.global_block()
+
+        sp = sparse_config or {}
+        table = sp.get("table")          # SparseEmbedding or Communicator
+        ids_var = sp.get("ids_var")
+        emb_var = sp.get("emb_var")
+        grad_name = (emb_var + "@GRAD") if emb_var else None
+        # Communicator wraps a table: pull reads through, push goes via
+        # the communicator's mode (sync/async/half_async/geo)
+        pull_src = getattr(table, "table", table)
+        push_dst = table
+
+        last = None
+        step_i = 0
+        for batch in dataset:
+            feed = {k: v for k, v in batch.items()
+                    if blk._find_var_recursive(k) is not None}
+            ids = None
+            if table is not None:
+                ids = np.asarray(batch[ids_var])
+                feed[emb_var] = pull_src.pull(ids)
+                fl = fetch_names + [grad_name]
+            else:
+                fl = fetch_names
+            out = self.run(program, feed=feed, fetch_list=fl, scope=scope)
+            if table is not None:
+                push_dst.push(ids, np.asarray(out[-1]))
+                out = out[:-1]
+            last = out
+            step_i += 1
+            if (debug or fetch_info) and fetch_names \
+                    and step_i % print_period == 0:
+                msg = ", ".join(
+                    f"{info}={np.asarray(v).mean():.6f}"
+                    for info, v in zip(fetch_info, out))
+                print(f"[train_from_dataset] step {step_i}: {msg}")
+        return last if fetch_names else None
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """executor.py:1130 parity — same drain loop, no sparse push; pass
+        a for_test clone of the program."""
+        return self.train_from_dataset(
+            program=program, dataset=dataset, scope=scope, thread=thread,
+            debug=debug, fetch_list=fetch_list, fetch_info=fetch_info,
+            print_period=print_period)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -537,9 +635,81 @@ class Executor:
                 needed |= set(ops[i].input_names())
         return [op for i, op in enumerate(ops) if keep[i]]
 
-    def _build(self, program, fetch_names, persist_names):
+    def _build(self, program, fetch_names, persist_names, dp_mesh=None):
         ops = self._live_ops(program, fetch_names)
         sections = [] if program._is_test else list(program.backward_sections)
+        return self._build_step(ops, sections, fetch_names, persist_names,
+                                dp_mesh)
+
+    def _build_step(self, ops, sections, fetch_names, persist_names,
+                    dp_mesh):
+        dp = dp_mesh is not None
+
+        def make_step(dp):
+            return self._make_step_fn(ops, sections, fetch_names,
+                                      persist_names, dp)
+        step = make_step(dp)
+
+        if not dp:
+            return jax.jit(step, donate_argnums=(0,))
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def dp_step(state, feeds, key):
+            # per-device rng diversity (dropout) while state stays in sync
+            key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+            return step(state, feeds, key)
+
+        plain_step = make_step(False)   # for shape-only evaluation
+        memo = {}
+
+        def compiled(state, feeds, key):
+            # rank-0 fetches are replicated (pmean'd reductions); rank>=1
+            # fetches concatenate over dp like ParallelExecutor's fetch
+            # merge (pybind fetch path). Ranks from a shape-only eval.
+            sig = tuple(sorted(
+                (n, a.shape, str(a.dtype)) for n, a in feeds.items()))
+            fn = memo.get(sig)
+            if fn is None:
+                ndev = dp_mesh.devices.size
+                local_feeds = {
+                    n: jax.ShapeDtypeStruct(
+                        (a.shape[0] // ndev,) + a.shape[1:], a.dtype)
+                    for n, a in feeds.items()
+                }
+                avals = jax.eval_shape(
+                    plain_step,
+                    {n: jax.ShapeDtypeStruct(np.shape(v),
+                                             jnp.asarray(v).dtype)
+                     for n, v in state.items()},
+                    local_feeds, jax.ShapeDtypeStruct((2,), np.uint32))
+                fetch_ranks = [len(f.shape) for f in avals[1]]
+
+                def dp_step_shaped(state, feeds, key):
+                    new_state, fetches = dp_step(state, feeds, key)
+                    fetches = [f if r >= 1 else jax.lax.pmean(f, "dp")
+                               for f, r in zip(fetches, fetch_ranks)]
+                    return new_state, fetches
+
+                out_fetch_specs = [
+                    P("dp") if r >= 1 else P() for r in fetch_ranks]
+                fn = jax.jit(shard_map(
+                    dp_step_shaped, mesh=dp_mesh,
+                    in_specs=(P(), P("dp"), P()),
+                    out_specs=(P(), out_fetch_specs),
+                    check_vma=False), donate_argnums=(0,))
+                memo[sig] = fn
+            return fn(state, feeds, key)
+
+        return compiled
+
+    def _make_step_fn(self, ops, sections, fetch_names, persist_names, dp):
+        # optimizer-updated params: identical across dp replicas by
+        # construction, so exempt from the SyncBN-style stats averaging
+        param_names = set()
+        for bs in sections:
+            param_names.update(bs.param_names)
 
         def step(state, feeds, key):
             env = {}
@@ -583,14 +753,30 @@ class Executor:
                 )(train_params)
                 rng_box = _RngBox(new_key)
                 for n, g in grads.items():
-                    env[n + "@GRAD"] = g
+                    # DP gradient sync — the one collective the reference
+                    # inserts as allreduce op-handles
+                    # (multi_devices_graph_pass.cc:446)
+                    env[n + "@GRAD"] = jax.lax.pmean(g, "dp") if dp else g
                 pos = bs.pos
             interpret(ops[pos:], env, rng_box, const_env)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in persist_names if n in env}
+            if dp:
+                # params were updated identically (grads pmean'd) and need
+                # no second collective; non-param float stats buffers
+                # (batch-norm running stats) diverge with the local shard
+                # -> average, SyncBN-style. Integer state (counters) is
+                # identical across devices and must NOT go through pmean
+                # (true division would float-ify it).
+                new_state = {
+                    n: (jax.lax.pmean(v, "dp")
+                        if n not in param_names and jnp.issubdtype(
+                            jnp.asarray(v).dtype, jnp.floating)
+                        else v)
+                    for n, v in new_state.items()}
             return new_state, fetches
 
-        return jax.jit(step, donate_argnums=(0,))
+        return step
 
     # ------------------------------------------------------------------
     def _run_eager(self, program, feed_arrays, fetch_names, scope, key,

@@ -15,5 +15,6 @@ from . import rnn_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import metrics_ops  # noqa: F401
 from . import decode_ops  # noqa: F401
+from . import quant_ops  # noqa: F401
 
 __all__ = ["register_op", "get_op", "has_op", "list_ops"]

@@ -225,11 +225,9 @@ def sample_logits(ins, attrs):
     if bool(attrs.get("remove_accidental_hits", True)):
         acc = (samples[:, None, :] == label[:, :, None]).any(axis=1)
         out = out.at[:, nt:].add(jnp.where(acc, -1e20, 0.0))
-    new_label = jnp.concatenate(
-        [jnp.broadcast_to(jnp.arange(nt)[None], label.shape),
-         jnp.zeros_like(samples)], axis=1)
     return {"SampledLogits": out, "Samples": ids,
-            "SampledLabels": new_label[:, :nt]}
+            "SampledLabels": jnp.broadcast_to(jnp.arange(nt)[None],
+                                              label.shape)}
 
 
 # --------------------------------------------------------------------------

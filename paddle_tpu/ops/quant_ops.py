@@ -1,0 +1,265 @@
+"""Quantization op kernels: fake quant/dequant (QAT) + int8 compute (PTQ).
+
+TPU-native replacements for /root/reference/paddle/fluid/operators/
+{fake_quantize,fake_dequantize,quantize,dequantize,requantize}_op.cc and
+the scale bookkeeping behind contrib/slim/quantization/quantization_pass.py.
+Fake-quant uses the straight-through estimator (custom_vjp identity) so QAT
+training flows gradients through the rounding; the real int8 path lowers to
+an XLA int8×int8→int32 dot that maps onto the MXU's integer mode.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _bin_cnt(bits):
+    return (1 << (bits - 1)) - 1          # 127 for 8 bits
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_bwd(_, g):
+    return (g,)                            # straight-through estimator
+
+
+_ste_round.defvjp(_ste_fwd, _ste_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fake_quant_dequant(x, scale, bits=8):
+    """round(x * bin/scale) clipped, then dequantized — the QAT trainer's
+    view of quantization error (fake_quantize_op.h ClipAndFakeQuantFunctor
+    followed by dequant). Gradient = identity on the WHOLE op (the
+    reference's FakeQuantDequantGradMaker passes dOut straight to dX),
+    including through the data-dependent scale."""
+    bin_cnt = _bin_cnt(bits)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * bin_cnt), -bin_cnt, bin_cnt)
+    return q * s / bin_cnt
+
+
+def _fqd_fwd(x, scale, bits):
+    return fake_quant_dequant(x, scale, bits), jnp.shape(scale)
+
+
+def _fqd_bwd(bits, scale_shape, g):
+    return g, jnp.zeros(scale_shape, g.dtype)
+
+
+fake_quant_dequant.defvjp(_fqd_fwd, _fqd_bwd)
+
+
+@register_op("fake_quantize_abs_max")
+def fake_quantize_abs_max(ins, attrs):
+    """fake_quantize_op.cc FakeQuantizeAbsMax — dynamic per-tensor scale."""
+    x = jnp.asarray(ins["X"])
+    bits = int(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    bin_cnt = _bin_cnt(bits)
+    s = jnp.maximum(scale, 1e-8)
+    out = jnp.clip(_ste_round(x / s * bin_cnt), -bin_cnt, bin_cnt)
+    return {"Out": out, "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def fake_quantize_dequantize_abs_max(ins, attrs):
+    x = jnp.asarray(ins["X"])
+    scale = jnp.max(jnp.abs(x))
+    out = fake_quant_dequant(x, scale, int(attrs.get("bit_length", 8)))
+    return {"Out": out, "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_quantize_abs_max")
+def fake_channel_wise_quantize_abs_max(ins, attrs):
+    """Per-output-channel scales (weights; channel = last dim for [in,out]
+    matmul weights, dim 0 for conv filters — quant_axis attr)."""
+    x = jnp.asarray(ins["X"])
+    bits = int(attrs.get("bit_length", 8))
+    axis = int(attrs.get("quant_axis", 0))
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red)
+    bin_cnt = _bin_cnt(bits)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    s = jnp.maximum(scale, 1e-8).reshape(shape)
+    out = jnp.clip(_ste_round(x / s * bin_cnt), -bin_cnt, bin_cnt)
+    return {"Out": out, "OutScale": scale}
+
+
+@register_op("fake_quantize_range_abs_max", stateful=True)
+def fake_quantize_range_abs_max(ins, attrs):
+    """Windowed-max scale tracking (fake_quantize_op.cc
+    FakeQuantizeRangeAbsMax): the last window_size batch maxima live in
+    the InScales/OutScales ring buffer (indexed by Iter) so the scale can
+    DECAY after an early outlier leaves the window. Without the ring
+    inputs it degrades to a running max."""
+    x = jnp.asarray(ins["X"])
+    bits = int(attrs.get("bit_length", 8))
+    window = int(attrs.get("window_size", 10000))
+    cur = jnp.max(jnp.abs(x))
+    if ins.get("InScales") is not None:
+        ring = jnp.asarray(ins["InScales"]).reshape(-1)
+        it = jnp.asarray(ins.get("Iter", 0)).reshape(()).astype(jnp.int32)
+        ring = ring.at[it % ring.shape[0]].set(cur)
+        scale = jnp.max(ring)
+        out = fake_quant_dequant(x, scale, bits)
+        return {"Out": out, "OutScale": scale.reshape(1),
+                "OutScales": ring, "OutIter": (it + 1).reshape(1)}
+    prev = (jnp.asarray(ins["InScale"]).reshape(())
+            if ins.get("InScale") is not None else cur)
+    scale = jnp.maximum(cur, prev)
+    out = fake_quant_dequant(x, scale, bits)
+    return {"Out": out, "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_quantize_moving_average_abs_max", stateful=True)
+def fake_quantize_moving_average_abs_max(ins, attrs):
+    """EMA scale tracking — the default QAT activation quantizer
+    (quantization_pass.py 'moving_average_abs_max')."""
+    x = jnp.asarray(ins["X"])
+    bits = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(x))
+    if ins.get("InScale") is not None:
+        prev = jnp.asarray(ins["InScale"]).reshape(())
+        state = jnp.asarray(ins.get("InState", 1.0)).reshape(())
+        accum = jnp.asarray(ins.get("InAccum", prev)).reshape(())
+        new_state = rate * state + 1.0
+        new_accum = rate * accum + cur
+        scale = new_accum / new_state
+    else:
+        new_state = jnp.asarray(1.0)
+        new_accum = cur
+        scale = cur
+    out = fake_quant_dequant(x, scale, bits)
+    return {"Out": out, "OutScale": scale.reshape(1),
+            "OutState": new_state.reshape(1),
+            "OutAccum": new_accum.reshape(1)}
+
+
+@register_op("moving_average_abs_max_scale", stateful=True)
+def moving_average_abs_max_scale(ins, attrs):
+    """Scale observer without quantization (quantization_pass.py inserts it
+    after ops whose outputs need calibrated scales)."""
+    x = jnp.asarray(ins["X"])
+    rate = float(attrs.get("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(x))
+    if ins.get("InScale") is not None:
+        prev = jnp.asarray(ins["InScale"]).reshape(())
+        scale = rate * prev + (1 - rate) * cur
+    else:
+        scale = cur
+    return {"Out": x, "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_dequantize_max_abs")
+def fake_dequantize_max_abs(ins, attrs):
+    x = jnp.asarray(ins["X"])
+    scale = jnp.asarray(ins["Scale"]).reshape(())
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": x.astype(jnp.float32) * scale / max_range}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs")
+def fake_channel_wise_dequantize_max_abs(ins, attrs):
+    x = jnp.asarray(ins["X"])
+    scales = ins["Scales"]
+    if isinstance(scales, (list, tuple)):
+        scales = scales[0]
+    scales = jnp.asarray(scales)
+    axis = int(attrs.get("quant_axis", 0))
+    max_range = float(attrs.get("max_range", 127.0))
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    return {"Out": x.astype(jnp.float32) * scales.reshape(shape)
+            / max_range}
+
+
+@register_op("quantize")
+def quantize(ins, attrs):
+    """operators/quantize_op.cc (mkldnn int8 path) — real int8 cast."""
+    x = jnp.asarray(ins["Input"])
+    scale = float(attrs.get("Scale", 1.0))
+    return {"Output": jnp.clip(jnp.round(x * scale), -128, 127)
+            .astype(jnp.int8)}
+
+
+@register_op("dequantize")
+def dequantize(ins, attrs):
+    x = jnp.asarray(ins["Input"])
+    scale = float(attrs.get("Scale", 1.0))
+    return {"Output": x.astype(jnp.float32) / scale}
+
+
+@register_op("requantize")
+def requantize(ins, attrs):
+    x = jnp.asarray(ins["Input"])
+    s_in = float(attrs.get("Scale_in", 1.0))
+    s_out = float(attrs.get("Scale_out", 1.0))
+    return {"Output": jnp.clip(
+        jnp.round(x.astype(jnp.float32) / s_in * s_out), -128, 127)
+        .astype(jnp.int8)}
+
+
+@register_op("dequantize_abs_max")
+def dequantize_abs_max(ins, attrs):
+    x = jnp.asarray(ins["X"])
+    scale = jnp.asarray(ins["Scale"]).reshape(())
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": x.astype(jnp.float32) * scale / max_range}
+
+
+@register_op("dequantize_log")
+def dequantize_log(ins, attrs):
+    """operators/dequantize_log_op.cc — 4-bit log-quantized weights: the
+    dict maps code -> value; sign bit in the high half."""
+    x = jnp.asarray(ins["X"]).astype(jnp.int32)
+    table = jnp.asarray(ins["Dict"])
+    code = x & 0x7F
+    val = table[jnp.clip(code, 0, table.shape[0] - 1)]
+    return {"Out": jnp.where(x >= 128, -val, val)}
+
+
+def int8_matmul(x_q, w_q, x_scale, w_scale, bits=8):
+    """Real int8×int8→int32 dot with fp32 rescale — the PTQ compute path.
+    preferred_element_type=int32 keeps the accumulation integer so XLA can
+    use the MXU's integer mode on TPU. w_scale may be per-output-channel."""
+    acc = jax.lax.dot_general(
+        x_q.astype(jnp.int8), w_q.astype(jnp.int8),
+        (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    bin_cnt = _bin_cnt(bits)
+    return acc.astype(jnp.float32) * (
+        x_scale * w_scale / (bin_cnt * bin_cnt))
+
+
+@register_op("quantized_matmul")
+def quantized_matmul(ins, attrs):
+    """PTQ matmul: fp32 activation dynamically quantized against the
+    calibrated XScale, int8 pre-quantized weight, integer accumulation,
+    fp32 rescale (the TPU analogue of the reference's mkldnn int8
+    fc/conv path carved out by quantization_pass.py)."""
+    x = jnp.asarray(ins["X"])
+    w_q = jnp.asarray(ins["Y"])                     # int8 [in, out]
+    xs = jnp.asarray(ins["XScale"]).reshape(())
+    ws = jnp.asarray(ins["YScale"]).reshape(-1)     # scalar or per-out-chan
+    bits = int(attrs.get("bit_length", 8))
+    bin_cnt = _bin_cnt(bits)
+    if x.ndim > 2:
+        x = x.reshape(-1, x.shape[-1]) if attrs.get("flatten", True) else x
+    x_q = jnp.clip(jnp.round(x / jnp.maximum(xs, 1e-8) * bin_cnt),
+                   -bin_cnt, bin_cnt).astype(jnp.int8)
+    out = int8_matmul(x_q, w_q, xs, ws[None, :] if ws.size > 1 else ws[0],
+                      bits)
+    return {"Out": out}

@@ -28,7 +28,7 @@ __all__ = [
     "DpsgdOptimizer", "DecayedAdagrad", "DecayedAdagradOptimizer",
     "Adadelta", "AdadeltaOptimizer", "RMSProp", "RMSPropOptimizer", "Ftrl",
     "FtrlOptimizer", "Lamb", "LambOptimizer", "RecomputeOptimizer",
-    "ExponentialMovingAverage", "LookaheadOptimizer",
+    "ExponentialMovingAverage", "LookaheadOptimizer", "ModelAverage",
 ]
 
 
@@ -493,6 +493,109 @@ class ExponentialMovingAverage:
                         scope.set_var(n, v)
 
         return guard()
+
+
+class ModelAverage:
+    """optimizer.py:2861 — windowed parameter averaging for eval.
+
+    Appends an average_accumulates op per trainable param (the reference's
+    _append_average_accumulate_op, optimizer.py:3003): sum_1/sum_2/sum_3
+    window accumulators cascade as windows roll over
+    (operators/average_accumulates_op.cc). apply() swaps the averaged
+    params in; restore() puts the trained ones back.
+    """
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._name = name or unique_name.generate("model_average")
+        self._params = []
+        program = default_main_program()
+        block = program.global_block()
+        sb = default_startup_program().global_block()
+        for p in program.all_parameters():
+            if not getattr(p, "trainable", True):
+                continue
+            self._params.append(p)
+            slots = {}
+            for s in ("sum_1", "sum_2", "sum_3"):
+                vname = f"{p.name}_{self._name}_{s}"
+                block.create_var(name=vname, shape=p.shape, dtype=p.dtype,
+                                 persistable=True, stop_gradient=True)
+                sv = sb.create_var(name=vname, shape=p.shape, dtype=p.dtype,
+                                   persistable=True, stop_gradient=True)
+                ConstantInitializer(0.0)(sv, sb)
+                slots[s] = vname
+            for s, dt in (("num_accumulates", "int32"),
+                          ("old_num_accumulates", "int32"),
+                          ("num_updates", "int32")):
+                vname = f"{p.name}_{self._name}_{s}"
+                block.create_var(name=vname, shape=[1], dtype=dt,
+                                 persistable=True, stop_gradient=True)
+                sv = sb.create_var(name=vname, shape=[1], dtype=dt,
+                                   persistable=True, stop_gradient=True)
+                ConstantInitializer(0.0)(sv, sb)
+                slots[s] = vname
+            block.append_op(
+                "average_accumulates",
+                inputs={"param": p.name,
+                        "in_sum_1": slots["sum_1"],
+                        "in_sum_2": slots["sum_2"],
+                        "in_sum_3": slots["sum_3"],
+                        "in_num_accumulates": slots["num_accumulates"],
+                        "in_old_num_accumulates":
+                            slots["old_num_accumulates"],
+                        "in_num_updates": slots["num_updates"]},
+                outputs={"out_sum_1": slots["sum_1"],
+                         "out_sum_2": slots["sum_2"],
+                         "out_sum_3": slots["sum_3"],
+                         "out_num_accumulates": slots["num_accumulates"],
+                         "out_old_num_accumulates":
+                             slots["old_num_accumulates"],
+                         "out_num_updates": slots["num_updates"]},
+                attrs={"average_window": float(average_window_rate),
+                       "min_average_window": int(min_average_window),
+                       "max_average_window": int(max_average_window)})
+
+    def _averaged(self, scope, p):
+        import numpy as np
+
+        pre = f"{p.name}_{self._name}_"
+        s1 = np.asarray(scope.find_var(pre + "sum_1"))
+        s2 = np.asarray(scope.find_var(pre + "sum_2"))
+        s3 = np.asarray(scope.find_var(pre + "sum_3"))
+        na = float(np.asarray(scope.find_var(pre + "num_accumulates")))
+        ona = float(np.asarray(
+            scope.find_var(pre + "old_num_accumulates")))
+        denom = max(na + ona, 1.0)
+        return (s1 + s2 + s3) / denom
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        from ..framework.executor import global_scope
+
+        scope = global_scope()
+
+        @contextlib.contextmanager
+        def guard():
+            backup = {}
+            for p in self._params:
+                backup[p.name] = scope.find_var(p.name)
+                scope.set_var(p.name, self._averaged(scope, p))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for n, v in backup.items():
+                        scope.set_var(n, v)
+
+        return guard()
+
+    def restore(self, executor=None):
+        """No-op when apply() was used as a context manager (parity)."""
 
 
 class LookaheadOptimizer:

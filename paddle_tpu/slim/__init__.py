@@ -1,0 +1,14 @@
+"""Model compression toolkit (slim).
+
+Parity surface: /root/reference/python/paddle/fluid/contrib/slim/ — the
+quantization passes (quantization_pass.py) and post-training quantization.
+Pruning/NAS/distillation from the reference's slim are higher-level recipes
+over the same primitives and are not yet ported.
+"""
+
+from .quantization import (QuantizationTransformPass,
+                           PostTrainingQuantization,
+                           quant_aware, convert)
+
+__all__ = ["QuantizationTransformPass", "PostTrainingQuantization",
+           "quant_aware", "convert"]

@@ -1,0 +1,173 @@
+"""Quantization passes over the Program IR.
+
+Parity: /root/reference/python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py — QuantizationTransformPass (QAT fake-quant insertion
+before quantizable ops, :143), and post_training_quantization.py (PTQ:
+calibrate activation scales on sample data, freeze int8 weights).
+
+The reference rewrites an IrGraph; here the pass splices ops directly into
+the Program's op list (the Program IS the graph — SURVEY §7 stage 2), and
+the PTQ result swaps mul/matmul ops for the `quantized_matmul` kernel whose
+int8×int8→int32 dot runs on the MXU's integer mode.
+"""
+
+import numpy as np
+
+from ..framework.program import Operator
+
+# op type -> (activation slot, weight slot)
+_QUANTIZABLE = {
+    "mul": ("X", "Y"),
+    "matmul": ("X", "Y"),
+    "conv2d": ("Input", "Filter"),
+    "depthwise_conv2d": ("Input", "Filter"),
+}
+
+
+class QuantizationTransformPass:
+    """QAT transform: insert fake quant-dequant on the inputs of every
+    quantizable op (quantization_pass.py:143 apply). Run it BEFORE
+    append_backward/minimize so the backward section sees the fake-quant
+    ops (the reference operates on the full graph and patches grad ops;
+    our autodiff differentiates through the fake-quant kernels' STE
+    automatically)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 skip_pattern="skip_quant"):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        assert activation_quantize_type in ("abs_max",
+                                            "moving_average_abs_max",
+                                            "range_abs_max")
+        assert weight_quantize_type in ("abs_max", "channel_wise_abs_max")
+        self._atype = activation_quantize_type
+        self._wtype = weight_quantize_type
+        self._skip = skip_pattern
+
+    def apply(self, program):
+        if program.backward_sections:
+            raise ValueError(
+                "apply QuantizationTransformPass before minimize()/"
+                "append_backward (the pass shifts op positions)")
+        block = program.global_block()
+        params = {p.name for p in program.all_parameters()}
+        new_ops = []
+        quantized = {}          # var name -> fake-quantized var name
+        for op in block.ops:
+            if op.type in _QUANTIZABLE \
+                    and not op.attrs.get(self._skip, False):
+                a_slot, w_slot = _QUANTIZABLE[op.type]
+                for slot in (a_slot, w_slot):
+                    names = op.inputs.get(slot, [])
+                    if not names:
+                        continue
+                    src = names[0]
+                    if src not in quantized:
+                        is_weight = src in params
+                        qname = src + ".quant_dequant"
+                        sv = block.var(src)
+                        block.create_var(name=qname, shape=sv.shape,
+                                         dtype=sv.dtype,
+                                         stop_gradient=False)
+                        # QAT emulation is per-tensor quant-dequant for
+                        # both weights and activations; the channel-wise
+                        # granularity shows up in PTQ's frozen weights
+                        qtype = "fake_quantize_dequantize_abs_max"
+                        attrs = {"bit_length":
+                                 self._wbits if is_weight else self._abits}
+                        new_ops.append(Operator(
+                            block, qtype, {"X": [src]},
+                            {"Out": [qname],
+                             "OutScale": [qname + ".scale"]}, attrs))
+                        quantized[src] = qname
+                    op.inputs[slot] = [quantized[src]]
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump()
+        return program
+
+
+def quant_aware(program, **kw):
+    """paddleslim-style one-call QAT wrapper."""
+    return QuantizationTransformPass(**kw).apply(program)
+
+
+class PostTrainingQuantization:
+    """PTQ (post_training_quantization.py parity): run calibration batches
+    through the eval program, collect per-op activation abs-max scales and
+    per-channel weight scales, then rewrite mul/matmul ops to
+    `quantized_matmul` with int8-frozen weights in the scope.
+
+        ptq = PostTrainingQuantization(exe, infer_program, feed_names,
+                                       calib_batches)
+        quant_program = ptq.quantize()
+    """
+
+    def __init__(self, executor, program, feed_names, calib_batches,
+                 weight_bits=8, activation_bits=8):
+        self._exe = executor
+        self._program = program
+        self._feeds = list(feed_names)
+        self._batches = calib_batches
+        self._wbits = weight_bits
+        self._abits = activation_bits
+
+    def quantize(self):
+        from ..framework.executor import global_scope
+
+        block = self._program.global_block()
+        params = {p.name for p in self._program.all_parameters()}
+        targets = [op for op in block.ops
+                   if op.type in ("mul", "matmul")
+                   and op.inputs.get("Y", [None])[0] in params]
+        act_names = sorted({op.inputs["X"][0] for op in targets})
+
+        # --- calibration: max |activation| over the sample batches
+        scales = {n: 0.0 for n in act_names}
+        for batch in self._batches:
+            feed = dict(zip(self._feeds, batch)) \
+                if not isinstance(batch, dict) else batch
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=act_names)
+            for n, v in zip(act_names, outs):
+                scales[n] = max(scales[n], float(np.max(np.abs(v))))
+
+        # --- freeze weights to int8 + rewrite ops
+        scope = global_scope()
+        bin_cnt = (1 << (self._wbits - 1)) - 1
+        for op in targets:
+            w_name = op.inputs["Y"][0]
+            x_name = op.inputs["X"][0]
+            w = np.asarray(scope.find_var(w_name))
+            w_scale = np.max(np.abs(w), axis=0)          # per out-channel
+            w_q = np.clip(np.round(w / np.maximum(w_scale, 1e-8)
+                                   * bin_cnt), -bin_cnt, bin_cnt
+                          ).astype(np.int8)
+            scope.set_var(w_name + ".int8", w_q)
+            scope.set_var(w_name + ".scale",
+                          w_scale.astype(np.float32))
+            scope.set_var(x_name + ".calib_scale",
+                          np.float32(scales[x_name]))
+            for nm, shape, dt in (
+                    (w_name + ".int8", list(w_q.shape), "int8"),
+                    (w_name + ".scale", [w_q.shape[-1]], "float32"),
+                    (x_name + ".calib_scale", [1], "float32")):
+                if nm not in block.vars:
+                    block.create_var(name=nm, shape=shape, dtype=dt,
+                                     persistable=True, stop_gradient=True)
+            op.type = "quantized_matmul"
+            op.inputs = {"X": [x_name], "Y": [w_name + ".int8"],
+                         "XScale": [x_name + ".calib_scale"],
+                         "YScale": [w_name + ".scale"]}
+            op.attrs = {"bit_length": self._wbits}
+        self._program._bump()
+        return self._program
+
+
+def convert(program, **kw):
+    """paddleslim-style alias: PTQ conversion of an eval program is done
+    through PostTrainingQuantization; QAT programs need no conversion for
+    inference here (fake-quant ops already emulate int8 numerics)."""
+    return program

@@ -1,0 +1,107 @@
+"""CompiledProgram.with_data_parallel tests.
+
+Parity model: tests/unittests/parallel_executor_test_base.py +
+test_parallel_executor_mnist.py — multi-device losses must match
+single-device losses (test_dist_base.py delta <= 1e-3), fetch merge
+concatenates over devices.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build_mnist_like(lr=0.05):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 64])
+        y = fluid.data("y", [None, 1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(lr).minimize(loss)
+    return main, startup, loss
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 64)).astype(np.float32)
+    y = rng.integers(0, 10, (n, 1)).astype(np.int64)
+    return x, y
+
+
+def test_dp_matches_single_device():
+    x, y = _data()
+
+    # single device
+    main1, startup1, loss1 = _build_mnist_like()
+    exe1 = fluid.Executor()
+    exe1.run(startup1)
+    # copy the initialized params for the dp run
+    params = {v.name: np.array(fluid.global_scope().find_var(v.name))
+              for v in main1.list_vars() if v.persistable
+              and fluid.global_scope().find_var(v.name) is not None}
+    single = [float(exe1.run(main1, feed={"x": x, "y": y},
+                             fetch_list=[loss1])[0]) for _ in range(5)]
+
+    # 8-device dp on the same init
+    with fluid.scope_guard(fluid.Scope()):
+        main2, startup2, loss2 = _build_mnist_like()
+        exe2 = fluid.Executor()
+        exe2.run(startup2)
+        name_map = dict(zip(
+            sorted(v.name for v in main2.list_vars() if v.persistable),
+            sorted(params)))
+        for n2, n1 in name_map.items():
+            if fluid.global_scope().find_var(n2) is not None:
+                fluid.global_scope().set_var(n2, params[n1])
+        compiled = fluid.CompiledProgram(main2).with_data_parallel(
+            loss_name=loss2.name)
+        dp = []
+        for _ in range(5):
+            out = exe2.run(compiled, feed={"x": x, "y": y},
+                           fetch_list=[loss2])
+            # fetch merge: [1]-shaped loss -> [ndev]; average like
+            # reference users do
+            dp.append(float(np.mean(out[0])))
+
+    for s, d in zip(single, dp):
+        assert abs(s - d) <= 1e-3, (single, dp)
+
+
+def test_dp_fetch_concatenates_per_sample_tensors():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        out = layers.reduce_sum(x, dim=1)       # [batch]
+    exe = fluid.Executor()
+    exe.run(startup)
+    compiled = fluid.CompiledProgram(main).with_data_parallel()
+    xb = np.arange(32, dtype=np.float32).reshape(8, 4)
+    (got,) = exe.run(compiled, feed={"x": xb}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), xb.sum(1), rtol=1e-6)
+
+
+def test_dp_rejects_indivisible_batch():
+    main, startup, loss = _build_mnist_like()
+    exe = fluid.Executor()
+    exe.run(startup)
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    x, y = _data(n=12)  # not divisible by 8
+    try:
+        exe.run(compiled, feed={"x": x, "y": y}, fetch_list=[loss])
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "divisible" in str(e)
+
+
+def test_compiled_program_without_dp_is_plain():
+    main, startup, loss = _build_mnist_like()
+    exe = fluid.Executor()
+    exe.run(startup)
+    x, y = _data()
+    compiled = fluid.CompiledProgram(main)
+    (a,) = exe.run(compiled, feed={"x": x, "y": y}, fetch_list=[loss])
+    assert np.isfinite(float(a))

@@ -1,0 +1,70 @@
+"""Launcher smoke tests (parity model: test_launch.sh — 2 workers on
+localhost see the trainer env contract and both exit clean)."""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+from paddle_tpu.distributed.launch import find_free_ports, start_procs
+
+_WORKER = """
+import json, os, sys
+print(json.dumps({
+    "rank": os.environ["PADDLE_TRAINER_ID"],
+    "endpoint": os.environ["PADDLE_CURRENT_ENDPOINT"],
+    "endpoints": os.environ["PADDLE_TRAINER_ENDPOINTS"],
+    "nranks": os.environ["PADDLE_TRAINERS_NUM"],
+}))
+"""
+
+_FAILER = """
+import os, sys, time
+if os.environ["PADDLE_TRAINER_ID"] == "1":
+    sys.exit(3)
+time.sleep(30)
+"""
+
+
+def test_two_workers_get_env_contract():
+    with tempfile.TemporaryDirectory() as tmp:
+        script = os.path.join(tmp, "worker.py")
+        with open(script, "w") as f:
+            f.write(_WORKER)
+        log_dir = os.path.join(tmp, "logs")
+        procs, logs = start_procs(
+            ["127.0.0.1"], "127.0.0.1", 2, script, log_dir=log_dir)
+        for p in procs:
+            assert p.wait(timeout=60) == 0
+        for f in logs:
+            f.close()
+        import json
+
+        seen = {}
+        for i in range(2):
+            with open(os.path.join(log_dir, f"workerlog.{i}")) as f:
+                rec = json.loads(f.read().strip().splitlines()[-1])
+            seen[rec["rank"]] = rec
+        assert set(seen) == {"0", "1"}
+        assert seen["0"]["nranks"] == "2"
+        eps = seen["0"]["endpoints"].split(",")
+        assert len(eps) == 2
+        assert seen["0"]["endpoint"] == eps[0]
+        assert seen["1"]["endpoint"] == eps[1]
+
+
+def test_worker_failure_terminates_pack():
+    with tempfile.TemporaryDirectory() as tmp:
+        script = os.path.join(tmp, "failer.py")
+        with open(script, "w") as f:
+            f.write(_FAILER)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", script],
+            cwd="/root/repo", timeout=120, capture_output=True)
+        assert r.returncode == 3, (r.returncode, r.stderr[-500:])
+
+
+def test_find_free_ports_distinct():
+    ports = find_free_ports(4)
+    assert len(set(ports)) == 4

@@ -1,0 +1,128 @@
+"""Quantization tests (parity model: tests in contrib/slim/tests —
+test_quantization_pass.py QAT graph rewrite, test_post_training_quantization
+int8 accuracy within tolerance of fp32)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.ops.registry import get_op
+from paddle_tpu.slim import PostTrainingQuantization, quant_aware
+
+import jax.numpy as jnp
+
+from op_test import run_kernel
+
+
+def test_fake_quant_dequant_roundtrip_error_bounded():
+    x = np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32)
+    got = run_kernel("fake_quantize_dequantize_abs_max", {"X": x},
+                     {"bit_length": 8})
+    err = np.abs(got["Out"] - x).max()
+    assert err <= np.abs(x).max() / 127 + 1e-6
+
+
+def test_fake_quant_ste_gradient_passes_through():
+    import jax
+
+    def f(x):
+        op = get_op("fake_quantize_dequantize_abs_max")
+        return op.fn({"X": x}, {"bit_length": 8})["Out"].sum()
+
+    x = jnp.asarray(np.random.rand(8).astype(np.float32))
+    g = jax.grad(f)(x)
+    # straight-through: gradient of sum is ~1 everywhere
+    np.testing.assert_allclose(np.asarray(g), np.ones(8), atol=1e-6)
+
+
+def test_channel_wise_quant_scales_per_channel():
+    x = np.stack([np.full(4, 1.0), np.full(4, 10.0)]).T.astype(np.float32)
+    got = run_kernel("fake_channel_wise_quantize_abs_max", {"X": x},
+                     {"bit_length": 8, "quant_axis": 1})
+    np.testing.assert_allclose(got["OutScale"], [1.0, 10.0])
+
+
+def test_int8_matmul_close_to_fp32():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 32)).astype(np.float32)
+    w = rng.normal(size=(32, 8)).astype(np.float32)
+    w_scale = np.abs(w).max(axis=0)
+    w_q = np.clip(np.round(w / w_scale * 127), -127, 127).astype(np.int8)
+    got = run_kernel("quantized_matmul",
+                     {"X": x, "Y": w_q,
+                      "XScale": np.float32(np.abs(x).max()),
+                      "YScale": w_scale.astype(np.float32)})
+    ref = x @ w
+    rel = np.abs(got["Out"] - ref).max() / np.abs(ref).max()
+    assert rel < 0.05, rel
+
+
+def _mlp_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 16])
+        h = fluid.layers.fc(x, 32, act="relu")
+        out = fluid.layers.fc(h, 4)
+    return main, startup, out
+
+
+def test_qat_pass_inserts_fake_quant_ops():
+    main, startup, out = _mlp_program()
+    n_before = len(main.global_block().ops)
+    quant_aware(main)
+    ops = main.global_block().ops
+    qops = [o for o in ops if o.type == "fake_quantize_dequantize_abs_max"]
+    assert len(qops) >= 2            # at least act+weight of the muls
+    assert len(ops) > n_before
+    # program still runs and trains
+    with fluid.program_guard(main, startup):
+        y = fluid.data("y", [None, 4])
+        loss = layers.mean(layers.square_error_cost(out, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    xb = rng.normal(size=(8, 16)).astype(np.float32)
+    yb = rng.normal(size=(8, 4)).astype(np.float32)
+    losses = [float(exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])[0]) for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+def test_ptq_int8_matches_fp32_within_tolerance():
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup, out = _mlp_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(1)
+        xb = rng.normal(size=(32, 16)).astype(np.float32)
+        (fp32_out,) = exe.run(main, feed={"x": xb}, fetch_list=[out])
+
+        infer = main.clone(for_test=True)
+        calib = [{"x": rng.normal(size=(32, 16)).astype(np.float32)}
+                 for _ in range(4)] + [{"x": xb}]
+        ptq = PostTrainingQuantization(exe, infer, ["x"], calib)
+        qprog = ptq.quantize()
+        assert any(op.type == "quantized_matmul"
+                   for op in qprog.global_block().ops)
+        (int8_out,) = exe.run(qprog, feed={"x": xb}, fetch_list=[out])
+        rel = (np.abs(np.asarray(int8_out) - np.asarray(fp32_out)).max()
+               / max(np.abs(np.asarray(fp32_out)).max(), 1e-6))
+        assert rel < 0.1, rel
+
+
+def test_range_abs_max_window_decays_after_outlier():
+    window = 4
+    ring = np.zeros(window, np.float32)
+    it = np.array(0)
+    xs = [np.full((4,), 80.0), *[np.full((4,), 4.0)] * 5]
+    scales = []
+    for x in xs:
+        got = run_kernel("fake_quantize_range_abs_max",
+                         {"X": x.astype(np.float32), "InScales": ring,
+                          "Iter": it},
+                         {"bit_length": 8, "window_size": window})
+        ring, it = got["OutScales"], got["OutIter"]
+        scales.append(float(got["OutScale"]))
+    assert scales[0] == 80.0
+    assert scales[-1] == 4.0      # the outlier left the window
