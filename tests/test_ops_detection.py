@@ -1,0 +1,316 @@
+"""Detection op tests (parity model: tests/unittests/test_iou_similarity_op
+.py, test_box_coder_op.py, test_bipartite_match_op.py, test_multiclass_nms
+_op.py, test_yolo_box_op.py, test_prior_box_op.py, test_roi_align_op.py,
+test_grid_sampler_op.py ...)."""
+
+import numpy as np
+
+from op_test import OpTest, run_kernel
+
+
+def np_iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    ar_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ar_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = ar_a[:, None] + ar_b[None] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-10), 0)
+
+
+class TestIouSimilarity(OpTest):
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        a = np.sort(rng.random((5, 4)), axis=-1).astype(np.float32)
+        b = np.sort(rng.random((7, 4)), axis=-1).astype(np.float32)
+        a = a[:, [0, 1, 2, 3]]
+        got = run_kernel("iou_similarity", {"X": a, "Y": b})
+        np.testing.assert_allclose(got["Out"], np_iou(a, b), atol=1e-5)
+
+
+class TestBoxCoder(OpTest):
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(0)
+        prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.3, 0.7, 0.9]],
+                         np.float32)
+        target = np.array([[0.15, 0.2, 0.45, 0.6]], np.float32)
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = run_kernel("box_coder",
+                         {"TargetBox": target, "PriorBox": prior},
+                         {"code_type": "encode_center_size",
+                          "variance": var})["OutputBox"]
+        dec = run_kernel("box_coder",
+                         {"TargetBox": enc, "PriorBox": prior},
+                         {"code_type": "decode_center_size",
+                          "variance": var, "axis": 0})["OutputBox"]
+        # decoding the encoding of target against prior j recovers target
+        for j in range(2):
+            np.testing.assert_allclose(dec[0, j], target[0], atol=1e-5)
+
+
+class TestBipartiteMatch(OpTest):
+    def test_greedy(self):
+        dist = np.array([[0.9, 0.1, 0.3],
+                         [0.8, 0.7, 0.2]], np.float32)
+        got = run_kernel("bipartite_match", {"DistMat": dist})
+        idx = got["ColToRowMatchIndices"][0]
+        # global max 0.9 -> gt0/col0; next best among remaining: 0.7 ->
+        # gt1/col1; col2 unmatched
+        np.testing.assert_array_equal(idx, [0, 1, -1])
+
+    def test_per_prediction_threshold(self):
+        dist = np.array([[0.9, 0.1, 0.6],
+                         [0.8, 0.7, 0.65]], np.float32)
+        got = run_kernel("bipartite_match", {"DistMat": dist},
+                         {"match_type": "per_prediction",
+                          "dist_threshold": 0.6})
+        idx = got["ColToRowMatchIndices"][0]
+        assert idx[2] == 1     # col2's best row (0.65 >= 0.6)
+
+
+class TestTargetAssign(OpTest):
+    def test_gather_and_fill(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        match = np.array([1, -1, 2, 0])
+        got = run_kernel("target_assign",
+                         {"X": x, "MatchIndices": match},
+                         {"mismatch_value": -9})
+        np.testing.assert_allclose(got["Out"][0], x[1])
+        assert (got["Out"][1] == -9).all()
+        np.testing.assert_allclose(got["OutWeight"].reshape(-1),
+                                   [1, 0, 1, 1])
+
+
+class TestMulticlassNMS(OpTest):
+    def test_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10],
+                          [0.5, 0.5, 10.5, 10.5],     # overlaps box 0
+                          [20, 20, 30, 30]], np.float32)
+        scores = np.array([[0.0, 0.0, 0.0],           # background class
+                           [0.9, 0.8, 0.7]], np.float32)
+        got = run_kernel("multiclass_nms",
+                         {"BBoxes": boxes, "Scores": scores},
+                         {"nms_threshold": 0.5, "keep_top_k": 10,
+                          "background_label": 0,
+                          "score_threshold": 0.01})
+        assert int(got["NumOut"]) == 2                # box 1 suppressed
+        kept_scores = sorted(got["Out"][:2, 1].tolist(), reverse=True)
+        np.testing.assert_allclose(kept_scores, [0.9, 0.7], atol=1e-6)
+
+
+class TestPriorBox(OpTest):
+    def test_shapes_and_range(self):
+        feat = np.zeros((1, 8, 4, 4), np.float32)
+        img = np.zeros((1, 3, 64, 64), np.float32)
+        got = run_kernel("prior_box", {"Input": feat, "Image": img},
+                         {"min_sizes": [16.0], "max_sizes": [32.0],
+                          "aspect_ratios": [2.0], "flip": True,
+                          "clip": True})
+        # ars = [1, 2, 0.5] -> 3 + 1 (sqrt(min*max)) = 4 priors per cell
+        assert got["Boxes"].shape == (4, 4, 4, 4)
+        assert (got["Boxes"] >= 0).all() and (got["Boxes"] <= 1).all()
+        assert got["Variances"].shape == got["Boxes"].shape
+
+    def test_center_alignment(self):
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        img = np.zeros((1, 3, 32, 32), np.float32)
+        got = run_kernel("prior_box", {"Input": feat, "Image": img},
+                         {"min_sizes": [8.0], "clip": False})
+        b = np.asarray(got["Boxes"])
+        # cell (0,0): center at (0.5*16)/32 = 0.25; square prior 8/32
+        np.testing.assert_allclose(b[0, 0, 0],
+                                   [0.25 - 0.125, 0.25 - 0.125,
+                                    0.25 + 0.125, 0.25 + 0.125], atol=1e-6)
+
+
+class TestAnchorGenerator(OpTest):
+    def test_count_and_center(self):
+        feat = np.zeros((1, 8, 3, 3), np.float32)
+        got = run_kernel("anchor_generator", {"Input": feat},
+                         {"anchor_sizes": [64.0],
+                          "aspect_ratios": [1.0],
+                          "stride": [16.0, 16.0]})
+        assert got["Anchors"].shape == (3, 3, 1, 4)
+        a = np.asarray(got["Anchors"][0, 0, 0])
+        cx = (a[0] + a[2]) / 2
+        cy = (a[1] + a[3]) / 2
+        np.testing.assert_allclose([cx, cy], [8.0, 8.0], atol=1e-4)
+        np.testing.assert_allclose(a[2] - a[0] + 1, 64.0, atol=1.0)
+
+
+class TestYoloBox(OpTest):
+    def test_decode_center_cell(self):
+        n, na, c, h, w = 1, 1, 2, 2, 2
+        x = np.zeros((n, na * (5 + c), h, w), np.float32)
+        x[0, 4] = 10.0                    # objectness ~1 everywhere
+        got = run_kernel("yolo_box",
+                         {"X": x, "ImgSize": np.array([[64, 64]])},
+                         {"anchors": [32, 32], "class_num": c,
+                          "conf_thresh": 0.005,
+                          "downsample_ratio": 32})
+        boxes = np.asarray(got["Boxes"]).reshape(h, w, 4)
+        # cell (0,0): sigmoid(0)=0.5 -> bx=(0.5+0)/2=0.25 of 64 = 16
+        # bw = exp(0)*32/64 = 0.5 -> 32 px
+        np.testing.assert_allclose(boxes[0, 0], [0, 0, 32, 32], atol=1e-3)
+
+
+class TestSigmoidFocalLoss(OpTest):
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 3)).astype(np.float64)
+        label = np.array([1, 0, 3, 2])
+        fg = np.array([2])
+        got = run_kernel("sigmoid_focal_loss",
+                         {"X": x, "Label": label, "FgNum": fg},
+                         {"gamma": 2.0, "alpha": 0.25})
+        p = 1 / (1 + np.exp(-x))
+        tgt = (label[:, None] == np.arange(1, 4)[None]).astype(np.float64)
+        ce = np.maximum(x, 0) - x * tgt + np.log1p(np.exp(-np.abs(x)))
+        pt = p * tgt + (1 - p) * (1 - tgt)
+        at = 0.25 * tgt + 0.75 * (1 - tgt)
+        exp = at * (1 - pt) ** 2 * ce / 2
+        np.testing.assert_allclose(got["Out"], exp, rtol=1e-5)
+
+
+class TestRoiAlign(OpTest):
+    def test_constant_image(self):
+        x = np.full((1, 2, 8, 8), 3.0, np.float32)
+        rois = np.array([[0, 0, 4, 4]], np.float32)
+        got = run_kernel("roi_align", {"X": x, "ROIs": rois},
+                         {"pooled_height": 2, "pooled_width": 2,
+                          "spatial_scale": 1.0, "sampling_ratio": 2})
+        np.testing.assert_allclose(got["Out"], np.full((1, 2, 2, 2), 3.0),
+                                   atol=1e-5)
+
+    def test_gradient_flows(self):
+        x = np.random.rand(1, 1, 6, 6)
+        rois = np.array([[1.0, 1.0, 4.0, 4.0]])
+        self.op_type = "roi_align"
+        self.attrs = {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0, "sampling_ratio": 2}
+        self.check_grad({"X": x, "ROIs": rois}, ["X"])
+
+
+class TestRoiPool(OpTest):
+    def test_max_of_bins(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        got = run_kernel("roi_pool", {"X": x, "ROIs": rois},
+                         {"pooled_height": 2, "pooled_width": 2,
+                          "spatial_scale": 1.0})
+        np.testing.assert_allclose(got["Out"][0, 0],
+                                   [[5, 7], [13, 15]])
+
+
+class TestGridSampler(OpTest):
+    def test_identity_grid(self):
+        x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                             indexing="ij")
+        grid = np.stack([xs, ys], axis=-1)[None].astype(np.float32)
+        got = run_kernel("grid_sampler", {"X": x, "Grid": grid})
+        np.testing.assert_allclose(got["Output"], x, atol=1e-5)
+
+
+class TestAffineChannel(OpTest):
+    def test_scale_bias(self):
+        x = np.random.rand(2, 3, 2, 2).astype(np.float32)
+        s = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([0.5, 0.0, -1.0], np.float32)
+        got = run_kernel("affine_channel", {"X": x, "Scale": s, "Bias": b})
+        np.testing.assert_allclose(
+            got["Out"], x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1),
+            rtol=1e-6)
+
+
+class TestAffineGridSampler(OpTest):
+    def test_identity_theta_roundtrip(self):
+        theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32),
+                        (1, 1, 1))
+        grid = run_kernel("affine_grid", {"Theta": theta},
+                          {"output_shape": [1, 1, 5, 5]})["Output"]
+        x = np.random.rand(1, 1, 5, 5).astype(np.float32)
+        out = run_kernel("grid_sampler", {"X": x, "Grid": grid})["Output"]
+        np.testing.assert_allclose(out, x, atol=1e-5)
+
+
+class TestGenerateProposals(OpTest):
+    def test_emits_valid_proposals(self):
+        rng = np.random.default_rng(0)
+        n, a, h, w = 1, 3, 4, 4
+        scores = rng.random((n, a, h, w)).astype(np.float32)
+        deltas = (rng.normal(size=(n, a * 4, h, w)) * 0.1).astype(
+            np.float32)
+        anchors = np.zeros((h, w, a, 4), np.float32)
+        for i in range(h):
+            for j in range(w):
+                for k in range(a):
+                    cx, cy = j * 16 + 8, i * 16 + 8
+                    s = 16 * (k + 1)
+                    anchors[i, j, k] = [cx - s / 2, cy - s / 2,
+                                       cx + s / 2, cy + s / 2]
+        var = np.full((h, w, a, 4), 1.0, np.float32)
+        got = run_kernel("generate_proposals",
+                         {"Scores": scores, "BboxDeltas": deltas,
+                          "ImInfo": np.array([[64.0, 64.0, 1.0]]),
+                          "Anchors": anchors, "Variances": var},
+                         {"pre_nms_topN": 12, "post_nms_topN": 5,
+                          "nms_thresh": 0.7, "min_size": 2.0})
+        assert got["RpnRois"].shape == (1, 5, 4)
+        nvalid = int(got["RpnRoisNum"][0])
+        assert 1 <= nvalid <= 5
+        b = got["RpnRois"][0, :nvalid]
+        assert (b[:, 2] >= b[:, 0]).all() and (b[:, 3] >= b[:, 1]).all()
+        assert (b >= 0).all() and (b <= 63).all()
+
+
+class TestYolov3Loss(OpTest):
+    def test_loss_positive_and_grad_flows(self):
+        rng = np.random.default_rng(0)
+        n, c, h, w = 1, 2, 4, 4
+        na = 2
+        x = rng.normal(size=(n, na * (5 + c), h, w)).astype(np.float64)
+        gt = np.array([[[0.4, 0.4, 0.3, 0.4], [0, 0, 0, 0]]])
+        lab = np.array([[1, 0]])
+        got = run_kernel("yolov3_loss",
+                         {"X": x, "GTBox": gt, "GTLabel": lab},
+                         {"anchors": [10, 13, 30, 35], "class_num": c,
+                          "anchor_mask": [0, 1], "ignore_thresh": 0.7,
+                          "downsample_ratio": 32})
+        assert float(got["Loss"][0]) > 0
+        assert int(got["GTMatchMask"][0, 0]) == 1   # real gt matched
+        assert int(got["GTMatchMask"][0, 1]) == 0   # padding ignored
+
+        self.op_type = "yolov3_loss"
+        self.attrs = {"anchors": [10, 13, 30, 35], "class_num": c,
+                      "anchor_mask": [0, 1], "ignore_thresh": 0.7,
+                      "downsample_ratio": 32}
+        self.check_grad({"X": x, "GTBox": gt, "GTLabel": lab}, ["X"],
+                        out_slot="Loss")
+
+
+class TestDistributeCollectFpn(OpTest):
+    def test_route_and_restore(self):
+        rois = np.array([[0, 0, 30, 30],        # small -> low level
+                         [0, 0, 300, 300],      # large -> high level
+                         [0, 0, 60, 60]], np.float32)
+        got = run_kernel("distribute_fpn_proposals", {"FpnRois": rois},
+                         {"min_level": 2, "max_level": 5,
+                          "refer_level": 4, "refer_scale": 224})
+        total = sum(int(got[f"MultiLevelRoIsNum@{i}"]) for i in range(4))
+        assert total == 3
+        restore = got["RestoreIndex"].reshape(-1)
+        assert sorted(restore.tolist()) == [0, 1, 2]
+
+    def test_collect_topk(self):
+        r1 = np.array([[0, 0, 10, 10], [1, 1, 5, 5]], np.float32)
+        r2 = np.array([[2, 2, 8, 8]], np.float32)
+        s1 = np.array([0.9, 0.1], np.float32)
+        s2 = np.array([0.5], np.float32)
+        got = run_kernel("collect_fpn_proposals",
+                         {"MultiLevelRois": [r1, r2],
+                          "MultiLevelScores": [s1, s2]},
+                         {"post_nms_topN": 2})
+        np.testing.assert_allclose(got["FpnRois"][0], r1[0])
+        np.testing.assert_allclose(got["FpnRois"][1], r2[0])
