@@ -1,0 +1,132 @@
+"""Collective ops as registered program-level ops.
+
+TPU-native replacements for /root/reference/paddle/fluid/operators/
+collective/{c_allreduce_sum,c_allreduce_max,c_allreduce_min,
+c_allreduce_prod,c_allgather,c_broadcast,c_reducescatter,c_comm_init,
+c_sync_calc_stream,c_sync_comm_stream}_op.cc and
+distributed_ops/{allreduce,broadcast}_op.cc.
+
+The reference dispatches these to NCCL on a ring identified by `ring_id`.
+Here each op lowers to the matching XLA collective (lax.psum /
+all_gather / psum_scatter / ppermute-broadcast) over a mesh axis: attr
+`axis_name` names the shard_map/pjit mesh axis (default "dp"), standing in
+for ring_id.  Outside any mesh axis the ops are identity on one device —
+the same degenerate behavior as a single-member NCCL ring.  Stream-sync
+ops are no-ops: XLA's dataflow ordering replaces stream semantics.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _in_axis(axis_name):
+    """True when tracing under a binding of `axis_name` (shard_map/pmap)."""
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def _allreduce(x, axis_name, red):
+    x = jnp.asarray(x)
+    if not _in_axis(axis_name):
+        return x
+    if red == "sum":
+        return lax.psum(x, axis_name)
+    if red == "max":
+        return lax.pmax(x, axis_name)
+    if red == "min":
+        return lax.pmin(x, axis_name)
+    if red == "prod":
+        # sign-safe product: gather all shards and reduce (exp/psum/log
+        # would NaN on negatives and kill gradients at zero)
+        return lax.all_gather(x, axis_name, axis=0).prod(axis=0)
+    raise ValueError(f"unknown reduction '{red}'")
+
+
+def _make_c_allreduce(name, red):
+    @register_op(name)
+    def op(ins, attrs, _red=red):
+        return {"Out": _allreduce(ins["X"], attrs.get("axis_name", "dp"),
+                                  _red)}
+    return op
+
+
+c_allreduce_sum = _make_c_allreduce("c_allreduce_sum", "sum")
+c_allreduce_max = _make_c_allreduce("c_allreduce_max", "max")
+c_allreduce_min = _make_c_allreduce("c_allreduce_min", "min")
+c_allreduce_prod = _make_c_allreduce("c_allreduce_prod", "prod")
+
+
+@register_op("allreduce")
+def allreduce(ins, attrs):
+    """distributed_ops/allreduce_op.cc — attr reduce_type: 0 sum, 1 prod,
+    2 max, 3 min (red_type enum in the reference)."""
+    red = {0: "sum", 1: "prod", 2: "max", 3: "min"}[
+        int(attrs.get("reduce_type", 0))]
+    return {"Out": _allreduce(ins["X"], attrs.get("axis_name", "dp"), red)}
+
+
+@register_op("c_allgather")
+def c_allgather(ins, attrs):
+    """collective/c_allgather_op.cc — concat shards along dim 0 (nranks
+    copies)."""
+    x = jnp.asarray(ins["X"])
+    axis_name = attrs.get("axis_name", "dp")
+    if not _in_axis(axis_name):
+        return {"Out": x}
+    return {"Out": lax.all_gather(x, axis_name, axis=0, tiled=True)}
+
+
+@register_op("c_reducescatter")
+def c_reducescatter(ins, attrs):
+    """collective/c_reducescatter_op.cc — sum across ranks, scatter dim 0."""
+    x = jnp.asarray(ins["X"])
+    axis_name = attrs.get("axis_name", "dp")
+    if not _in_axis(axis_name):
+        return {"Out": x}
+    return {"Out": lax.psum_scatter(x, axis_name, scatter_dimension=0,
+                                    tiled=True)}
+
+
+@register_op("c_broadcast")
+def c_broadcast(ins, attrs):
+    """collective/c_broadcast_op.cc — root's value to every rank."""
+    x = jnp.asarray(ins["X"])
+    axis_name = attrs.get("axis_name", "dp")
+    if not _in_axis(axis_name):
+        return {"Out": x}
+    root = int(attrs.get("root", 0))
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": lax.psum(masked, axis_name)}
+
+
+@register_op("broadcast")
+def broadcast(ins, attrs):
+    """distributed_ops/broadcast_op.cc — same as c_broadcast with attr
+    `root` (ring_id ignored: the mesh axis is the ring)."""
+    return c_broadcast(ins, attrs)
+
+
+@register_op("c_sync_calc_stream")
+def c_sync_calc_stream(ins, attrs):
+    """collective/c_sync_calc_stream_op.cc — no-op: XLA dataflow ordering
+    replaces CUDA stream synchronisation."""
+    return {"Out": jnp.asarray(ins["X"])}
+
+
+@register_op("c_sync_comm_stream")
+def c_sync_comm_stream(ins, attrs):
+    """collective/c_sync_comm_stream_op.cc — no-op (see above)."""
+    return {"Out": jnp.asarray(ins["X"])}
+
+
+@register_op("c_comm_init")
+def c_comm_init(ins, attrs):
+    """collective/c_comm_init_op.cc — no-op: mesh axes are declared at
+    shard_map/pjit entry, not imperatively initialised."""
+    return {}

@@ -1,0 +1,440 @@
+"""Extended-op batch tests (parity model: tests/unittests/test_multiplex_op
+.py, test_squared_l2_distance_op.py, test_reverse_op.py, test_fill_op.py,
+test_pad_constant_like.py, test_unique_with_counts.py, test_sync_batch_norm
+_op.py, test_conv3d_op.py, test_pool3d_op.py, test_deformable_conv_op.py,
+test_similarity_focus_op.py, collective *_op tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from op_test import OpTest, run_kernel
+
+
+class TestMultiplex(OpTest):
+    op_type = "multiplex"
+
+    def test_selects_rows(self):
+        rng = np.random.default_rng(0)
+        xs = [rng.standard_normal((4, 3)).astype(np.float32)
+              for _ in range(3)]
+        ids = np.array([[2], [0], [1], [2]], np.int32)
+        got = run_kernel("multiplex", {"X": xs, "Ids": ids})
+        exp = np.stack([xs[2][0], xs[0][1], xs[1][2], xs[2][3]])
+        np.testing.assert_allclose(got["Out"], exp)
+
+
+class TestSquaredL2Distance(OpTest):
+    op_type = "squared_l2_distance"
+
+    def test_output_and_grad(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 5)).astype(np.float64)
+        y = rng.standard_normal((4, 5)).astype(np.float64)
+        got = run_kernel("squared_l2_distance", {"X": x, "Y": y})
+        np.testing.assert_allclose(
+            got["Out"], np.square(x - y).sum(1, keepdims=True), rtol=1e-6)
+        self.check_grad({"X": x, "Y": y}, ["X", "Y"])
+
+    def test_broadcast_y(self):
+        x = np.ones((3, 4), np.float32) * 2
+        y = np.ones((1, 4), np.float32)
+        got = run_kernel("squared_l2_distance", {"X": x, "Y": y})
+        np.testing.assert_allclose(got["Out"], np.full((3, 1), 4.0))
+
+
+class TestReverse(OpTest):
+    def test_axis_list(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        got = run_kernel("reverse", {"X": x}, {"axis": [0, 2]})
+        np.testing.assert_allclose(got["Out"], x[::-1, :, ::-1])
+
+
+class TestFillAndDiag(OpTest):
+    def test_fill(self):
+        got = run_kernel("fill", {}, {"value": [1.0, 2.0, 3.0, 4.0],
+                                      "shape": [2, 2],
+                                      "dtype": "float32"})
+        np.testing.assert_allclose(got["Out"],
+                                   [[1.0, 2.0], [3.0, 4.0]])
+
+    def test_diag(self):
+        got = run_kernel("diag", {"Diagonal": np.array([1.0, 2.0, 3.0],
+                                                       np.float32)})
+        np.testing.assert_allclose(got["Out"], np.diag([1.0, 2.0, 3.0]))
+
+
+class TestPadConstantLike(OpTest):
+    def test_pads_to_x_shape(self):
+        x = np.zeros((4, 5), np.float32)
+        y = np.ones((2, 3), np.float32)
+        got = run_kernel("pad_constant_like", {"X": x, "Y": y},
+                         {"pad_value": 7.0})
+        assert got["Out"].shape == (4, 5)
+        np.testing.assert_allclose(got["Out"][:2, :3], y)
+        assert (got["Out"][2:] == 7.0).all() and (got["Out"][:, 3:] == 7.0).all()
+
+
+class TestUniqueWithCounts(OpTest):
+    def test_first_occurrence_order(self):
+        x = np.array([2, 3, 3, 1, 5, 3], np.int64)
+        got = run_kernel("unique_with_counts", {"X": x}, {"dtype": "int32"})
+        n = int(got["UniqueLen"])
+        assert n == 4
+        np.testing.assert_array_equal(got["Out"][:n], [2, 3, 1, 5])
+        np.testing.assert_array_equal(got["Count"][:n], [1, 3, 1, 1])
+        # Index maps each position back to its unique slot
+        np.testing.assert_array_equal(got["Index"], [0, 1, 1, 2, 3, 1])
+
+
+class TestBatchSizeLikeRandom(OpTest):
+    def test_uniform_shape_and_range(self):
+        x = np.zeros((7, 3), np.float32)
+        got = run_kernel("uniform_random_batch_size_like", {"Input": x},
+                         {"shape": [-1, 11], "min": 0.0, "max": 2.0})
+        assert got["Out"].shape == (7, 11)
+        assert (got["Out"] >= 0).all() and (got["Out"] < 2).all()
+
+    def test_gaussian_shape(self):
+        x = np.zeros((5, 2), np.float32)
+        got = run_kernel("gaussian_random_batch_size_like", {"Input": x},
+                         {"shape": [-1, 1000], "mean": 3.0, "std": 0.1})
+        assert got["Out"].shape == (5, 1000)
+        assert abs(got["Out"].mean() - 3.0) < 0.05
+
+
+class TestSimilarityFocus(OpTest):
+    def test_mask_marks_max_rows_cols(self):
+        rng = np.random.default_rng(0)
+        x = rng.random((2, 3, 4, 5)).astype(np.float32)
+        got = run_kernel("similarity_focus", {"X": x},
+                         {"axis": 1, "indexes": [0]})
+        out = got["Out"]
+        assert out.shape == x.shape
+        assert set(np.unique(out)).issubset({0.0, 1.0})
+        # every row and every column of the selected channel has a mark
+        m = out[0, 0]
+        assert (m.max(axis=1) == 1).all() and (m.max(axis=0) == 1).all()
+
+
+class TestSyncBatchNorm(OpTest):
+    def test_single_device_matches_batch_norm(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 3, 2, 2)).astype(np.float32)
+        ins = {"X": x, "Scale": np.ones(3, np.float32),
+               "Bias": np.zeros(3, np.float32),
+               "Mean": np.zeros(3, np.float32),
+               "Variance": np.ones(3, np.float32)}
+        got = run_kernel("sync_batch_norm", ins, {"epsilon": 1e-5})
+        ref = run_kernel("batch_norm", ins, {"epsilon": 1e-5,
+                                             "is_test": False})
+        np.testing.assert_allclose(got["Y"], ref["Y"], atol=1e-4)
+
+    def test_5d_ncdhw(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 2, 2, 2)).astype(np.float32)
+        got = run_kernel("sync_batch_norm",
+                         {"X": x, "Scale": np.ones(3, np.float32),
+                          "Bias": np.zeros(3, np.float32),
+                          "Mean": np.zeros(3, np.float32),
+                          "Variance": np.ones(3, np.float32)},
+                         {"epsilon": 1e-5})
+        assert got["Y"].shape == x.shape
+        mu = x.mean(axis=(0, 2, 3, 4))
+        np.testing.assert_allclose(got["SavedMean"], mu, atol=1e-5)
+
+    def test_cross_device_stats(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax import shard_map
+
+        devs = np.array(jax.devices()[:2])
+        if devs.size < 2:
+            pytest.skip("needs 2 devices")
+        mesh = Mesh(devs, ("dp",))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 3, 2, 2)).astype(np.float32)
+        scale = np.ones(3, np.float32)
+        bias = np.zeros(3, np.float32)
+        rmean = np.zeros(3, np.float32)
+        rvar = np.ones(3, np.float32)
+
+        from paddle_tpu.ops.registry import get_op
+        k = get_op("sync_batch_norm").fn
+
+        def local(xs):
+            return k({"X": xs, "Scale": scale, "Bias": bias,
+                      "Mean": rmean, "Variance": rvar},
+                     {"axis_name": "dp"})["Y"]
+
+        y = shard_map(local, mesh=mesh, in_specs=P("dp"),
+                      out_specs=P("dp"))(x)
+        # stats over the FULL batch -> identical to single-device batch_norm
+        ref = k({"X": x, "Scale": scale, "Bias": bias,
+                 "Mean": rmean, "Variance": rvar}, {})["Y"]
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-4)
+
+
+class TestConv3D(OpTest):
+    def test_matches_manual(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+        w = rng.standard_normal((3, 2, 2, 2, 2)).astype(np.float32)
+        got = run_kernel("conv3d", {"Input": x, "Filter": w},
+                         {"strides": [1, 1, 1], "paddings": [0, 0, 0]})
+        assert got["Output"].shape == (1, 3, 3, 3, 3)
+        # spot check one output element
+        exp = (x[0, :, :2, :2, :2] * w[1]).sum()
+        np.testing.assert_allclose(got["Output"][0, 1, 0, 0, 0], exp,
+                                   rtol=1e-4)
+
+    def test_transpose_inverts_shape(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((1, 4, 3, 3, 3)).astype(np.float32)
+        w = rng.standard_normal((4, 5, 2, 2, 2)).astype(np.float32)
+        got = run_kernel("conv3d_transpose", {"Input": x, "Filter": w},
+                         {"strides": [2, 2, 2], "paddings": [0, 0, 0]})
+        assert got["Output"].shape == (1, 5, 6, 6, 6)
+
+    def test_pool3d(self):
+        x = np.arange(64, dtype=np.float32).reshape(1, 1, 4, 4, 4)
+        got = run_kernel("pool3d", {"X": x},
+                         {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                          "pooling_type": "max"})
+        assert got["Out"].shape == (1, 1, 2, 2, 2)
+        assert got["Out"][0, 0, 0, 0, 0] == x[0, 0, :2, :2, :2].max()
+        gavg = run_kernel("pool3d", {"X": x},
+                          {"pooling_type": "avg", "global_pooling": True})
+        np.testing.assert_allclose(gavg["Out"].reshape(()), x.mean())
+
+
+class TestDeformableConv(OpTest):
+    def test_zero_offset_matches_conv2d(self):
+        """With zero offsets and unit mask, deformable conv == plain conv."""
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 6, 6)).astype(np.float32)
+        w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        ho = wo = 6  # stride 1, pad 1, k 3
+        off = np.zeros((2, 2 * 1 * 3 * 3, ho, wo), np.float32)
+        mask = np.ones((2, 1 * 3 * 3, ho, wo), np.float32)
+        got = run_kernel("deformable_conv",
+                         {"Input": x, "Offset": off, "Mask": mask,
+                          "Filter": w},
+                         {"strides": [1, 1], "paddings": [1, 1],
+                          "dilations": [1, 1], "groups": 1,
+                          "deformable_groups": 1})
+        ref = run_kernel("conv2d", {"Input": x, "Filter": w},
+                         {"strides": [1, 1], "paddings": [1, 1]})
+        np.testing.assert_allclose(got["Output"], ref["Output"], atol=1e-3,
+                                   rtol=1e-3)
+
+    def test_v1_no_mask(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1, 2, 5, 5)).astype(np.float32)
+        w = rng.standard_normal((2, 2, 3, 3)).astype(np.float32)
+        off = np.zeros((1, 2 * 9, 5, 5), np.float32)
+        got = run_kernel("deformable_conv_v1",
+                         {"Input": x, "Offset": off, "Filter": w},
+                         {"strides": [1, 1], "paddings": [1, 1],
+                          "dilations": [1, 1], "groups": 1,
+                          "deformable_groups": 1})
+        ref = run_kernel("conv2d", {"Input": x, "Filter": w},
+                         {"strides": [1, 1], "paddings": [1, 1]})
+        np.testing.assert_allclose(got["Output"], ref["Output"], atol=1e-3,
+                                   rtol=1e-3)
+
+
+class TestDistributedHelpers(OpTest):
+    def test_split_then_merge_roundtrip(self):
+        ids = np.array([4, 1, 7, 2, 9, 6], np.int64)
+        split = run_kernel("split_ids", {"Ids": ids}, {"num_shards": 2})
+        sizes = split["ShardSizes"]
+        assert sizes.sum() == 6
+        even = split["Out"][0][:int(sizes[0])]
+        odd = split["Out"][1][:int(sizes[1])]
+        assert all(i % 2 == 0 for i in even)
+        assert all(i % 2 == 1 for i in odd)
+        assert set(np.concatenate([even, odd])) == set(ids.tolist())
+
+    def test_merge_ids_restores_order(self):
+        # shard outputs in shard order; Rows give original positions
+        emb0 = np.array([[1.0], [3.0]], np.float32)   # rows 0, 2
+        emb1 = np.array([[2.0], [4.0]], np.float32)   # rows 1, 3
+        rows = [np.array([0, 2]), np.array([1, 3])]
+        ids = np.array([10, 11, 12, 13])
+        got = run_kernel("merge_ids", {"Ids": ids, "Rows": rows,
+                                       "X": [emb0, emb1]}, {})
+        np.testing.assert_allclose(got["Out"],
+                                   [[1.0], [2.0], [3.0], [4.0]])
+
+    def test_lookup_table_dequant(self):
+        # reference row layout (lookup_table_dequant_op.h:72-101):
+        # [min, max, float32 words packing 4 uint8 codes each];
+        # out = (max-min)/256 * code + min, width (Q-2)*4
+        rng = np.random.default_rng(0)
+        codes = rng.integers(0, 256, (2, 8), dtype=np.uint8)
+        packed = codes.reshape(2, 2, 4).copy().view(np.float32).reshape(2, 2)
+        minmax = np.array([[0.0, 256.0], [-1.0, 255.0]], np.float32)
+        w = np.concatenate([minmax, packed], axis=1)     # [2, 4]
+        ids = np.array([[1], [0]], np.int64)
+        got = run_kernel("lookup_table_dequant", {"W": w, "Ids": ids}, {})
+        exp = np.stack([
+            (minmax[1, 1] - minmax[1, 0]) / 256.0 * codes[1] + minmax[1, 0],
+            (minmax[0, 1] - minmax[0, 0]) / 256.0 * codes[0] + minmax[0, 0],
+        ]).astype(np.float32)
+        assert got["Out"].shape == (2, 8)
+        np.testing.assert_allclose(got["Out"], exp, rtol=1e-6)
+
+
+class TestAttentionLstm(OpTest):
+    def test_shapes_and_masking(self):
+        rng = np.random.default_rng(0)
+        b, t, d, h = 2, 5, 4, 3
+        x = rng.standard_normal((b, t, d)).astype(np.float32)
+        att_w = rng.standard_normal((d + h, 1)).astype(np.float32)
+        lstm_w = rng.standard_normal((d + h, 4 * h)).astype(np.float32)
+        lstm_b = np.zeros((4 * h,), np.float32)
+        got = run_kernel("attention_lstm",
+                         {"X": x, "AttentionWeight": att_w,
+                          "LSTMWeight": lstm_w, "LSTMBias": lstm_b,
+                          "Length": np.array([5, 3])}, {})
+        assert got["Hidden"].shape == (b, t, h)
+        assert got["Cell"].shape == (b, h)
+        assert np.isfinite(got["Hidden"]).all()
+
+
+class TestPyramidHash(OpTest):
+    def test_deterministic_embedding(self):
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((128, 1)).astype(np.float32)
+        x = np.array([[3, 7, 7, 2], [1, 1, 4, 9]], np.int32)
+        a = run_kernel("pyramid_hash", {"X": x, "W": w},
+                       {"num_emb": 8, "rand_len": 8, "space_len": 120,
+                        "pyramid_layer": 3})
+        b = run_kernel("pyramid_hash", {"X": x, "W": w},
+                       {"num_emb": 8, "rand_len": 8, "space_len": 120,
+                        "pyramid_layer": 3})
+        assert a["Out"].shape == (2, 8)
+        np.testing.assert_allclose(a["Out"], b["Out"])
+        assert np.abs(a["Out"]).sum() > 0
+
+
+class TestTreeConv(OpTest):
+    def test_single_node_patch_matches_eta_t(self):
+        """A leaf's patch is itself at depth 0: eta_t=1, eta_l=eta_r=0,
+        so its output row is f(leaf) @ Filter[:, 2] summed over depths."""
+        rng = np.random.default_rng(0)
+        nodes = rng.standard_normal((1, 4, 3)).astype(np.float32)
+        # tree: 1 -> 2, 1 -> 3 (node 4 isolated)
+        edges = np.zeros((1, 3, 2), np.int32)
+        edges[0, 0] = [1, 2]
+        edges[0, 1] = [1, 3]
+        filt = rng.standard_normal((3, 3, 2, 5)).astype(np.float32)
+        got = run_kernel("tree_conv", {"NodesVector": nodes,
+                                       "EdgeSet": edges, "Filter": filt},
+                         {"max_depth": 2})
+        assert got["Out"].shape == (1, 4, 2, 5)
+        # leaf node 2 (0-indexed 1): patch = {self}; only the t-slice fires
+        exp_leaf = np.einsum("f,fso->so", nodes[0, 1], filt[:, 2])
+        np.testing.assert_allclose(got["Out"][0, 1], exp_leaf, rtol=1e-4)
+        # root node 1 aggregates children at depth 1 with
+        # eta_t=1/2, child etas: temp = 0 and 1 -> check t-slice part
+        assert np.isfinite(got["Out"]).all()
+
+    def test_root_aggregates_children(self):
+        nodes = np.zeros((1, 3, 2), np.float32)
+        nodes[0, 0] = [1.0, 0.0]                 # root
+        nodes[0, 1] = [0.0, 1.0]                 # child A (index 1)
+        nodes[0, 2] = [0.0, 2.0]                 # child B (index 2)
+        edges = np.array([[[1, 2], [1, 3]]], np.int32)
+        filt = np.zeros((2, 3, 1, 1), np.float32)
+        filt[:, 2, 0, 0] = 1.0                   # only t-slice active
+        got = run_kernel("tree_conv", {"NodesVector": nodes,
+                                       "EdgeSet": edges, "Filter": filt},
+                         {"max_depth": 2})
+        # root: eta_t(d=0)=1 * (1+0) + eta_t(d=1)=0.5 * (0+1+2) = 2.5
+        np.testing.assert_allclose(got["Out"][0, 0, 0, 0], 2.5, rtol=1e-5)
+
+
+class TestFusionSingles(OpTest):
+    def test_fused_embedding_eltwise_layernorm(self):
+        rng = np.random.default_rng(0)
+        v, d = 11, 6
+        w0 = rng.standard_normal((v, d)).astype(np.float32)
+        w1 = rng.standard_normal((v, d)).astype(np.float32)
+        ids0 = rng.integers(0, v, (2, 3)).astype(np.int64)
+        ids1 = rng.integers(0, v, (2, 3)).astype(np.int64)
+        got = run_kernel("fused_embedding_eltwise_layernorm",
+                         {"Ids": [ids0, ids1], "Embs": [w0, w1],
+                          "Scale": np.ones(d, np.float32),
+                          "Bias": np.zeros(d, np.float32)},
+                         {"epsilon": 1e-5})
+        s = w0[ids0] + w1[ids1]
+        mu = s.mean(-1, keepdims=True)
+        sd = np.sqrt(s.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(got["Out"], (s - mu) / sd, atol=1e-4)
+
+    def test_fusion_transpose_flatten_concat(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        b = np.arange(24, 48, dtype=np.float32).reshape(2, 3, 4)
+        got = run_kernel("fusion_transpose_flatten_concat",
+                         {"X": [a, b]},
+                         {"trans_axis": (0, 2, 1), "flatten_axis": 1,
+                          "concat_axis": 1})
+        exp = np.concatenate([a.transpose(0, 2, 1).reshape(2, -1),
+                              b.transpose(0, 2, 1).reshape(2, -1)], axis=1)
+        np.testing.assert_allclose(got["Out"], exp)
+
+
+class TestCollectiveOps(OpTest):
+    def test_identity_outside_mesh(self):
+        x = np.array([1.0, 2.0], np.float32)
+        for op in ("c_allreduce_sum", "c_allreduce_max", "c_broadcast",
+                   "c_allgather", "c_reducescatter", "allreduce",
+                   "c_sync_calc_stream"):
+            got = run_kernel(op, {"X": x}, {})
+            np.testing.assert_allclose(got["Out"], x, err_msg=op)
+
+    def test_allreduce_in_mesh(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax import shard_map
+        from paddle_tpu.ops.registry import get_op
+
+        devs = np.array(jax.devices()[:4])
+        if devs.size < 4:
+            pytest.skip("needs 4 devices")
+        mesh = Mesh(devs, ("dp",))
+        x = np.arange(8, dtype=np.float32)
+
+        def local(xs):
+            return get_op("c_allreduce_sum").fn(
+                {"X": xs}, {"axis_name": "dp"})["Out"]
+
+        y = shard_map(local, mesh=mesh, in_specs=P("dp"),
+                      out_specs=P("dp"))(x)
+        # every shard holds the sum of its own 2 elements summed across
+        # ranks -> all equal to total sum of corresponding positions
+        y = np.asarray(y)
+        exp = x.reshape(4, 2).sum(0)
+        np.testing.assert_allclose(y.reshape(4, 2),
+                                   np.broadcast_to(exp, (4, 2)))
+
+    def test_broadcast_in_mesh(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax import shard_map
+        from paddle_tpu.ops.registry import get_op
+
+        devs = np.array(jax.devices()[:4])
+        if devs.size < 4:
+            pytest.skip("needs 4 devices")
+        mesh = Mesh(devs, ("dp",))
+        x = np.arange(4, dtype=np.float32)
+
+        def local(xs):
+            return get_op("c_broadcast").fn(
+                {"X": xs}, {"axis_name": "dp", "root": 2})["Out"]
+
+        y = np.asarray(shard_map(local, mesh=mesh, in_specs=P("dp"),
+                                 out_specs=P("dp"))(x))
+        np.testing.assert_allclose(y, np.full(4, 2.0))

@@ -123,6 +123,6 @@ def test_range_abs_max_window_decays_after_outlier():
                           "Iter": it},
                          {"bit_length": 8, "window_size": window})
         ring, it = got["OutScales"], got["OutIter"]
-        scales.append(float(got["OutScale"]))
+        scales.append(float(np.asarray(got["OutScale"]).reshape(())))
     assert scales[0] == 80.0
     assert scales[-1] == 4.0      # the outlier left the window
