@@ -35,12 +35,13 @@ def multiplex(ins, attrs):
 
 @register_op("similarity_focus")
 def similarity_focus(ins, attrs):
-    """operators/similarity_focus_op.cc — build a 0/1 focus mask over a
-    [B, C, A, B2] similarity tensor: for each selected channel (attr
-    `indexes` along attr `axis`), greedily mark the argmax row/column
-    pattern.  The reference's sequential greedy marking is re-expressed as
-    the union of per-row and per-column max indicators (the fixed point the
-    greedy pass converges to for distinct values)."""
+    """operators/similarity_focus_op.h:76-105 — for each batch and each
+    selected slice (attr `indexes` along attr `axis`), greedily walk cells
+    in descending value order, marking a cell only when neither its row
+    nor its column is already tagged, until min(H, W) cells are marked;
+    marks broadcast across the `axis` dimension and union across indexes.
+    The sequential greedy matching runs as a fori_loop of masked argmaxes
+    (min(H, W) iterations — the same count the reference stops at)."""
     x = jnp.asarray(ins["X"])                            # [B, C, H, W]
     axis = int(attrs.get("axis", 1))
     indexes = list(attrs.get("indexes", [0]))
@@ -48,9 +49,24 @@ def similarity_focus(ins, attrs):
         # reference supports axis in {1,2,3}; normalize to channel-select
         x = jnp.moveaxis(x, axis, 1)
     sel = x[:, jnp.asarray(indexes, jnp.int32)]          # [B, K, H, W]
-    row_max = sel == sel.max(axis=-1, keepdims=True)
-    col_max = sel == sel.max(axis=-2, keepdims=True)
-    mask = (row_max | col_max).any(axis=1)               # [B, H, W]
+    h, w = sel.shape[-2], sel.shape[-1]
+
+    def greedy(mat):                                     # [H, W] -> 0/1 mask
+        def body(_, st):
+            mask, avail = st
+            flat = jnp.where(avail, mat, -jnp.inf).reshape(-1)
+            pos = jnp.argmax(flat)
+            r, c = pos // w, pos % w
+            mask = mask.at[r, c].set(1.0)
+            avail = avail.at[r, :].set(False).at[:, c].set(False)
+            return mask, avail
+
+        mask0 = jnp.zeros((h, w), x.dtype)
+        avail0 = jnp.ones((h, w), bool)
+        mask, _ = lax.fori_loop(0, min(h, w), body, (mask0, avail0))
+        return mask
+
+    mask = jax.vmap(jax.vmap(greedy))(sel).max(axis=1)   # union over K
     out = jnp.broadcast_to(mask[:, None], x.shape).astype(x.dtype)
     if axis != 1:
         out = jnp.moveaxis(out, 1, axis)
@@ -253,15 +269,16 @@ def sync_batch_norm(ins, attrs):
     momentum = float(attrs.get("momentum", 0.9))
     if attrs.get("is_test"):
         return get_op("batch_norm").fn(ins, attrs)
-    # NCHW-family layouts of any rank: stats per channel (axis 1)
-    red = tuple(a for a in range(x.ndim) if a != 1)
+    # stats per channel: axis 1 for NCHW-family, last axis for NHWC
+    ch = x.ndim - 1 if attrs.get("data_layout", "NCHW") == "NHWC" else 1
+    red = tuple(a for a in range(x.ndim) if a != ch)
     mean = x.mean(axis=red)
     meansq = jnp.square(x).mean(axis=red)
     if axis_name:
         mean = lax.pmean(mean, axis_name)
         meansq = lax.pmean(meansq, axis_name)
     var = meansq - jnp.square(mean)
-    shape = tuple(-1 if a == 1 else 1 for a in range(x.ndim))
+    shape = tuple(-1 if a == ch else 1 for a in range(x.ndim))
     y = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
     y = y * jnp.asarray(ins["Scale"]).reshape(shape) \
         + jnp.asarray(ins["Bias"]).reshape(shape)
@@ -529,46 +546,76 @@ def tree_conv(ins, attrs):
 
 @register_op("attention_lstm")
 def attention_lstm(ins, attrs):
-    """operators/attention_lstm_op.cc — per step: score encoder states
-    against the previous hidden with a small MLP, softmax over time,
-    context = weighted sum, then one LSTM step on [context].  Padded-batch
-    form ([B, T, D] + Length) of the reference's LoD loop."""
-    x = jnp.asarray(ins["X"])                   # [B, T, D]
-    att_w = jnp.asarray(ins["AttentionWeight"])  # [D + D_h?, 1] per ref
-    lstm_w = jnp.asarray(ins["LSTMWeight"])     # [D + H, 4H]
-    lstm_b = jnp.asarray(ins["LSTMBias"]).reshape(-1)  # [4H]
-    b, t, d = x.shape
-    h_dim = lstm_w.shape[1] // 4
+    """operators/attention_lstm_op.cc:150-410 — per step:
+      score[t] = relu(x[t] @ att_w[:M] + att_bias + prev_cell @ att_w[M:])
+      (optional) score = relu(score * AttentionScalar + AttentionScalarBias)
+      alpha = softmax(score over valid steps); lstm_x = alpha @ x   [1, M]
+      gates = lstm_x @ W[D:] + prev_hidden @ W[:D] + bias            [4D]
+      gate order {forget, input, output, tilde} (:172-173): sigmoid on
+      the first 3D, tanh on tilde; cell = f*prev_cell + i*tanh(tilde);
+      hidden = o * tanh(cell).
+    Padded-batch form ([B, T, M] + Length) of the reference's LoD loop;
+    the carry freezes once a sample's length is exhausted, and Hidden/
+    Cell are per-step states (T x D in the reference), zero past length.
+    """
+    x = jnp.asarray(ins["X"])                   # [B, T, M]
+    att_w = jnp.asarray(ins["AttentionWeight"]).reshape(-1)  # [M + D]
+    lstm_w = jnp.asarray(ins["LSTMWeight"])     # [D + M, 4D]
+    lstm_b = jnp.asarray(ins["LSTMBias"]).reshape(-1)        # [4D]
+    b, t, m = x.shape
+    d = lstm_w.shape[1] // 4
     length = (jnp.asarray(ins["Length"]).reshape(-1)
               if ins.get("Length") is not None
               else jnp.full((b,), t, jnp.int32))
     tmask = jnp.arange(t)[None, :] < length[:, None]    # [B, T]
     c0 = (jnp.asarray(ins["C0"]) if ins.get("C0") is not None
-          else jnp.zeros((b, h_dim), x.dtype))
+          else jnp.zeros((b, d), x.dtype))
     h0 = (jnp.asarray(ins["H0"]) if ins.get("H0") is not None
-          else jnp.zeros((b, h_dim), x.dtype))
-    att_b = (jnp.asarray(ins.get("AttentionBias")).reshape(-1)
-             if ins.get("AttentionBias") is not None else None)
+          else jnp.zeros((b, d), x.dtype))
+    att_b_arr = (jnp.asarray(ins["AttentionBias"]).reshape(())
+                 if ins.get("AttentionBias") is not None else None)
+    att_scalar = (jnp.asarray(ins["AttentionScalar"]).reshape(())
+                  if ins.get("AttentionScalar") is not None else None)
+    att_scalar_b = (jnp.asarray(ins["AttentionScalarBias"]).reshape(())
+                    if ins.get("AttentionScalarBias") is not None else None)
+    # atted_x = x @ att_w[:M] (+ bias), precomputed once (:346-348)
+    atted_x = jnp.einsum("btm,m->bt", x, att_w[:m])
+    if att_b_arr is not None:
+        atted_x = atted_x + att_b_arr
 
-    def step(carry, _):
+    w_h, w_x = lstm_w[:d], lstm_w[d:]           # hidden rows first (:384)
+
+    def step(carry, step_idx):
         h, c = carry
-        # score each encoder position against h
-        feat = jnp.concatenate(
-            [x, jnp.broadcast_to(h[:, None], (b, t, h_dim))], axis=-1)
-        score = (feat @ att_w[:feat.shape[-1]]).squeeze(-1)   # [B, T]
-        if att_b is not None:
-            score = score + att_b[0]
-        score = jnp.where(tmask, score, -1e9)
+        cell_bias = jnp.einsum("bd,d->b", c, att_w[m:])      # :362
+        score = jax.nn.relu(atted_x + cell_bias[:, None])    # :364 bias_relu
+        if att_scalar is not None:
+            score = score * att_scalar
+            if att_scalar_b is not None:
+                score = jax.nn.relu(score + att_scalar_b)
+            else:
+                score = jax.nn.relu(score)
+        score = jnp.where(tmask, score, -jnp.inf)
         alpha = jax.nn.softmax(score, axis=-1)
-        ctx = jnp.einsum("bt,btd->bd", alpha, x)              # [B, D]
-        gates = jnp.concatenate([ctx, h], axis=-1) @ lstm_w + lstm_b
-        i, fg, g, o = jnp.split(gates, 4, axis=-1)
-        c_new = jax.nn.sigmoid(fg) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
-        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
-        return (h_new, c_new), h_new
+        lstm_x = jnp.einsum("bt,btm->bm", alpha, x)          # sum-pool :369
+        gates = lstm_x @ w_x + h @ w_h + lstm_b              # [B, 4D]
+        f = jax.nn.sigmoid(gates[:, :d])
+        i = jax.nn.sigmoid(gates[:, d:2 * d])
+        o = jax.nn.sigmoid(gates[:, 2 * d:3 * d])
+        tilde = jnp.tanh(gates[:, 3 * d:])
+        c_new = f * c + i * tilde
+        h_new = o * jnp.tanh(c_new)
+        live = (step_idx < length)[:, None]                  # freeze at len
+        c_keep = jnp.where(live, c_new, c)
+        h_keep = jnp.where(live, h_new, h)
+        zero = jnp.zeros_like(h_new)
+        return (h_keep, c_keep), (jnp.where(live, h_new, zero),
+                                  jnp.where(live, c_new, zero))
 
-    (h_f, c_f), hs = lax.scan(step, (h0, c0), None, length=t)
-    return {"Hidden": jnp.moveaxis(hs, 0, 1), "Cell": c_f,
+    (h_f, c_f), (hs, cs) = lax.scan(step, (h0, c0),
+                                    jnp.arange(t, dtype=jnp.int32))
+    return {"Hidden": jnp.moveaxis(hs, 0, 1),
+            "Cell": jnp.moveaxis(cs, 0, 1),
             "LSTMOUT": h_f}
 
 

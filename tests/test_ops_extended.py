@@ -105,18 +105,45 @@ class TestBatchSizeLikeRandom(OpTest):
         assert abs(got["Out"].mean() - 3.0) < 0.05
 
 
+def np_similarity_focus_greedy(sel):
+    """Reference greedy (similarity_focus_op.h:76-105) for one [H, W]."""
+    h, w = sel.shape
+    mask = np.zeros((h, w), np.float32)
+    order = np.argsort(-sel.reshape(-1), kind="stable")
+    tag_r, tag_c = set(), set()
+    for pos in order:
+        r, c = divmod(int(pos), w)
+        if r in tag_r or c in tag_c:
+            continue
+        mask[r, c] = 1.0
+        tag_r.add(r)
+        tag_c.add(c)
+        if len(tag_r) == min(h, w):
+            break
+    return mask
+
+
 class TestSimilarityFocus(OpTest):
-    def test_mask_marks_max_rows_cols(self):
+    def test_matches_reference_greedy(self):
         rng = np.random.default_rng(0)
         x = rng.random((2, 3, 4, 5)).astype(np.float32)
         got = run_kernel("similarity_focus", {"X": x},
                          {"axis": 1, "indexes": [0]})
         out = got["Out"]
         assert out.shape == x.shape
-        assert set(np.unique(out)).issubset({0.0, 1.0})
-        # every row and every column of the selected channel has a mark
-        m = out[0, 0]
-        assert (m.max(axis=1) == 1).all() and (m.max(axis=0) == 1).all()
+        for b in range(2):
+            exp = np_similarity_focus_greedy(x[b, 0])
+            for c in range(3):
+                np.testing.assert_array_equal(out[b, c], exp)
+
+    def test_greedy_case(self):
+        # [[4,3],[2,1]]: greedy marks (0,0) then (1,1) — the union-of-max
+        # shortcut would wrongly mark (0,1)/(1,0) instead of (1,1)
+        x = np.array([[[[4.0, 3.0], [2.0, 1.0]]]], np.float32)
+        got = run_kernel("similarity_focus", {"X": x},
+                         {"axis": 1, "indexes": [0]})
+        np.testing.assert_array_equal(got["Out"][0, 0],
+                                      [[1.0, 0.0], [0.0, 1.0]])
 
 
 class TestSyncBatchNorm(OpTest):
@@ -287,21 +314,59 @@ class TestDistributedHelpers(OpTest):
         np.testing.assert_allclose(got["Out"], exp, rtol=1e-6)
 
 
+def np_attention_lstm(x, att_w, lstm_w, lstm_b, lengths):
+    """Reference loop (attention_lstm_op.cc:340-410) in numpy."""
+    b, t, m = x.shape
+    d = lstm_w.shape[1] // 4
+    hs = np.zeros((b, t, d), np.float64)
+    cs = np.zeros((b, t, d), np.float64)
+    hf = np.zeros((b, d), np.float64)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    for i in range(b):
+        h = np.zeros(d)
+        c = np.zeros(d)
+        n = lengths[i]
+        atted = x[i, :n] @ att_w[:m]                 # [n]
+        for s in range(n):
+            score = np.maximum(atted + c @ att_w[m:], 0.0)   # bias_relu
+            e = np.exp(score - score.max())
+            alpha = e / e.sum()
+            lstm_x = alpha @ x[i, :n]                # [m]
+            gates = lstm_x @ lstm_w[d:] + h @ lstm_w[:d] + lstm_b
+            f = sig(gates[:d])
+            inp = sig(gates[d:2 * d])
+            o = sig(gates[2 * d:3 * d])
+            tilde = np.tanh(gates[3 * d:])
+            c = f * c + inp * tilde
+            h = o * np.tanh(c)
+            hs[i, s], cs[i, s] = h, c
+        hf[i] = h
+    return hs, cs, hf
+
+
 class TestAttentionLstm(OpTest):
-    def test_shapes_and_masking(self):
+    def test_matches_reference_loop(self):
         rng = np.random.default_rng(0)
-        b, t, d, h = 2, 5, 4, 3
-        x = rng.standard_normal((b, t, d)).astype(np.float32)
-        att_w = rng.standard_normal((d + h, 1)).astype(np.float32)
-        lstm_w = rng.standard_normal((d + h, 4 * h)).astype(np.float32)
-        lstm_b = np.zeros((4 * h,), np.float32)
+        b, t, m, d = 2, 5, 4, 3
+        x = rng.standard_normal((b, t, m)).astype(np.float32) * 0.5
+        att_w = rng.standard_normal((m + d, 1)).astype(np.float32)
+        lstm_w = rng.standard_normal((m + d, 4 * d)).astype(np.float32) * 0.5
+        lstm_b = rng.standard_normal((4 * d,)).astype(np.float32) * 0.1
+        lengths = np.array([5, 3])
         got = run_kernel("attention_lstm",
                          {"X": x, "AttentionWeight": att_w,
                           "LSTMWeight": lstm_w, "LSTMBias": lstm_b,
-                          "Length": np.array([5, 3])}, {})
-        assert got["Hidden"].shape == (b, t, h)
-        assert got["Cell"].shape == (b, h)
-        assert np.isfinite(got["Hidden"]).all()
+                          "Length": lengths}, {})
+        hs, cs, hf = np_attention_lstm(
+            x.astype(np.float64), att_w.reshape(-1).astype(np.float64),
+            lstm_w.astype(np.float64), lstm_b.astype(np.float64), lengths)
+        assert got["Hidden"].shape == (b, t, d)
+        assert got["Cell"].shape == (b, t, d)
+        np.testing.assert_allclose(got["Hidden"], hs, atol=1e-4)
+        np.testing.assert_allclose(got["Cell"], cs, atol=1e-4)
+        np.testing.assert_allclose(got["LSTMOUT"], hf, atol=1e-4)
+        # past-length steps are zero and the carry froze at length
+        assert (got["Hidden"][1, 3:] == 0).all()
 
 
 class TestPyramidHash(OpTest):
