@@ -1,0 +1,77 @@
+// C++ train demo — train a model from native code.
+//
+// Parity: /root/reference/paddle/fluid/train/demo/demo_trainer.cc, which
+// links libpaddle_fluid and drives Program/Executor from C++.  The
+// TPU-native runtime is the XLA/JAX process, so the native entry point
+// embeds the CPython interpreter and drives the same Program/Executor API
+// the Python front end uses — one runtime, one compiled step, a C++ host.
+//
+// Build:
+//   g++ -O2 csrc/train_demo.cpp $(python3-config --includes) \
+//       $(python3-config --embed --ldflags) -o train_demo
+// Run from the repo root (or with PYTHONPATH pointing at it):
+//   ./train_demo
+// Prints "loss <first> -> <last>" and exits 0 iff the loss dropped.
+
+#include <Python.h>
+
+#include <cstdio>
+
+static const char* kTrainScript = R"PY(
+import os, sys
+sys.path.insert(0, os.getcwd())
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+plat = os.environ.get("TRAIN_DEMO_PLATFORM")
+if plat:
+    # in-Python override: site hooks may pin JAX_PLATFORMS in the env
+    import jax
+    jax.config.update("jax_platforms", plat)
+import numpy as np
+import paddle_tpu as fluid
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.data("x", [None, 13])
+    y = fluid.data("y", [None, 1])
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.01).minimize(loss)
+
+exe = fluid.Executor()
+exe.run(startup)
+rng = np.random.default_rng(0)
+xb = rng.standard_normal((64, 13)).astype(np.float32)
+yb = (xb @ rng.standard_normal((13, 1)) + 0.5).astype(np.float32)
+first = last = None
+for i in range(50):
+    out = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    v = float(np.asarray(out[0]).reshape(()))
+    first = v if first is None else first
+    last = v
+print("loss %.6f -> %.6f" % (first, last))
+train_demo_ok = bool(last < first * 0.5)
+)PY";
+
+int main() {
+  Py_Initialize();
+  PyObject* globals = PyDict_New();
+  PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* result =
+      PyRun_String(kTrainScript, Py_file_input, globals, globals);
+  int ok = 0;
+  if (result == nullptr) {
+    PyErr_Print();
+  } else {
+    Py_DECREF(result);
+    PyObject* flag = PyDict_GetItemString(globals, "train_demo_ok");
+    ok = (flag != nullptr) && PyObject_IsTrue(flag);
+  }
+  Py_DECREF(globals);
+  if (Py_FinalizeEx() < 0) return 2;
+  if (!ok) {
+    std::fprintf(stderr, "train demo FAILED: loss did not converge\n");
+    return 1;
+  }
+  std::printf("train demo OK\n");
+  return 0;
+}

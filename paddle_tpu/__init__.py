@@ -69,6 +69,9 @@ from . import jit
 from . import models
 from . import slim
 from . import checkpoint
+from . import inference
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 
 from .reader import DataLoader
 from .version import full_version as __version__

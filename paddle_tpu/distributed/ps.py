@@ -510,3 +510,51 @@ class HeartBeatMonitor:
         with self._lock:
             return [w for w, t in self._beats.items()
                     if now - t > self.timeout]
+
+
+class ShardedPSClient:
+    """Route pulls/pushes across N PSServer endpoints by `id % N` — the
+    trainer-side counterpart of the reference's table sharding across
+    pservers (transpiler/distribute_transpiler.py slice_vars /
+    communicator send routing).  Connections are lazy so the client can
+    be constructed before the servers finish binding."""
+
+    def __init__(self, endpoints, dim):
+        self.endpoints = list(endpoints)
+        self.dim = dim
+        self._clients = [None] * len(self.endpoints)
+
+    def _client(self, shard):
+        if self._clients[shard] is None:
+            host, port = self.endpoints[shard].rsplit(":", 1)
+            self._clients[shard] = PSClient(host, int(port), self.dim)
+        return self._clients[shard]
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64)
+        flat = ids.reshape(-1)
+        n = len(self.endpoints)
+        out = np.zeros((flat.size, self.dim), np.float32)
+        for s in range(n):
+            m = (flat % n) == s
+            if m.any():
+                out[m] = self._client(s).pull(flat[m])
+        return out.reshape(ids.shape + (self.dim,))
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids, np.int64)
+        flat = ids.reshape(-1)
+        g = np.asarray(grads, np.float32).reshape(flat.size, self.dim)
+        n = len(self.endpoints)
+        for s in range(n):
+            m = (flat % n) == s
+            if m.any():
+                self._client(s).push(flat[m], g[m])
+
+    def close(self):
+        for c in self._clients:
+            if c is not None:
+                try:
+                    c._sock.close()
+                except OSError:
+                    pass

@@ -6,10 +6,14 @@ to_variable, no_grad, grad (base.py:255), checkpoint save/load
 DataParallel (parallel.py:223, provided by paddle_tpu.distributed).
 
 Autodiff note: the reference records a tape (imperative/tracer.cc) and
-`loss.backward()` walks it.  JAX autodiff is functional, so the dygraph
-training idiom here is `dygraph.grad(loss_fn)(model)` / TrainStep (see
-paddle_tpu.jit) — eager forward passes work identically, only the grad
-call site differs.
+`loss.backward()` walks it.  paddle_tpu.tape rebuilds that engine on
+jax.vjp: inside `dygraph.guard()` every Layer call / functional op records
+on a tape, `loss.backward()` runs the reverse sweep into Parameter.grad,
+and `optimizer.minimize(loss)` consumes those grads — reference dygraph
+training loops run unchanged.  The jit-friendly functional idiom
+(`dygraph.grad(loss_fn)(model)` / TrainStep in paddle_tpu.jit) remains the
+recommended TPU hot path: it compiles the whole step, while the tape path
+executes op-by-op exactly like the reference's tracer.
 """
 
 import contextlib
@@ -23,11 +27,13 @@ import optax
 from ..nn import Layer
 from ..nn.layers import functional_call, param_dict, load_param_dict
 from ..nn.parameter import EagerParameter, seed
+from ..tape import Tape, Variable, current_tape, pop_tape, push_tape
 
 __all__ = [
     "guard", "enabled", "to_variable", "no_grad", "grad", "value_and_grad",
     "save_dygraph", "load_dygraph", "seed", "SGD", "Momentum", "Adam",
     "AdamW", "Adagrad", "RMSProp", "Adamax", "Lamb", "DygraphOptimizer",
+    "Variable",
 ]
 
 _in_dygraph = True
@@ -35,8 +41,15 @@ _in_dygraph = True
 
 @contextlib.contextmanager
 def guard(place=None):
-    """Eager mode is the default; guard kept for API parity."""
-    yield
+    """Enter recorded eager mode: pushes a fresh autodiff tape so
+    `loss.backward()` works (parity: dygraph/base.py:190 guard enabling
+    the tracer).  Eager execution itself is always on."""
+    tape = push_tape(Tape())
+    try:
+        yield
+    finally:
+        tape.release()
+        pop_tape()
 
 
 def enabled():
@@ -44,14 +57,28 @@ def enabled():
 
 
 def to_variable(value, name=None):
-    return jnp.asarray(np.asarray(value))
+    """Wrap ndarray data as a leaf Variable (base.py to_variable);
+    stop_gradient defaults True like fed data in the reference."""
+    if isinstance(value, Variable):
+        return value
+    if isinstance(value, EagerParameter):
+        return value
+    return Variable(jnp.asarray(np.asarray(value)), name=name)
 
 
 @contextlib.contextmanager
 def no_grad():
-    """Inside jax, gradients only flow where a transform asks for them;
-    kept for parity. stop_gradient on results can be applied explicitly."""
-    yield
+    """Pause tape recording (dygraph/base.py no_grad): ops inside run
+    eagerly but contribute nothing to backward()."""
+    tape = current_tape()
+    if tape is None:
+        yield
+        return
+    tape.pause()
+    try:
+        yield
+    finally:
+        tape.resume()
 
 
 def value_and_grad(loss_fn, model):
@@ -118,7 +145,8 @@ class DygraphOptimizer:
         if grad_clip is not None:
             tx = optax.chain(grad_clip, tx)
         self.tx = tx
-        self._state = None
+        self._state = None              # whole-tree state (jitted path)
+        self._per_param_state = None    # per-param states (tape path)
 
     def _ensure_state(self, params):
         if self._state is None:
@@ -129,13 +157,26 @@ class DygraphOptimizer:
         return {p.name: p.value for p in self._params}
 
     def apply_gradients(self, grads):
-        """grads: dict name->grad array; updates parameters in place."""
-        params = self.current_params()
-        state = self._ensure_state(params)
-        updates, self._state = self.tx.update(grads, state, params)
-        new_params = optax.apply_updates(params, updates)
-        for p in self._params:
-            p.value = new_params[p.name]
+        """grads: dict name->grad array; updates parameters in place.
+
+        States are per-parameter (like the reference's per-param optimizer
+        ops): a parameter with no gradient this step is skipped entirely —
+        no moment decay, no weight decay — matching the reference rather
+        than a zero-grad optax update."""
+        by_name = {p.name: p for p in self._params}
+        if self._per_param_state is None:
+            self._per_param_state = {}
+        for n, g in grads.items():
+            p = by_name.get(n)
+            if p is None:
+                continue
+            sub_p = {n: p.value}
+            st = self._per_param_state.get(n)
+            if st is None:
+                st = self.tx.init(sub_p)
+            updates, self._per_param_state[n] = self.tx.update(
+                {n: g}, st, sub_p)
+            p.value = optax.apply_updates(sub_p, updates)[n]
 
     # functional API used by jitted train steps
     def init_state(self, params):
@@ -145,8 +186,24 @@ class DygraphOptimizer:
         updates, new_state = self.tx.update(grads, state, params)
         return optax.apply_updates(params, updates), new_state
 
-    def minimize(self, model, loss_fn, *args, **kwargs):
-        """Convenience: compute grads of loss_fn(model, *args) and step."""
+    def minimize(self, model, loss_fn=None, *args, **kwargs):
+        """Two call forms, both matching reference usage:
+
+        - minimize(loss) after loss.backward(): consume the gradients the
+          tape accumulated into Parameter.grad (optimizer.py dygraph path)
+        - minimize(model, loss_fn, *args): functional convenience — compute
+          grads of loss_fn(model, *args) and step.
+        """
+        if isinstance(model, Variable) or loss_fn is None:
+            loss = model
+            grads = {p.name: p.grad for p in self._params
+                     if p.grad is not None}
+            if not grads:
+                raise RuntimeError(
+                    "minimize(loss): no parameter gradients — call "
+                    "loss.backward() inside dygraph.guard() first")
+            self.apply_gradients(grads)
+            return loss
         vag = value_and_grad(loss_fn, model)
         loss, grads = vag(*args, **kwargs)
         # remap structured names to parameter names

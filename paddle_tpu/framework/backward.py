@@ -61,7 +61,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """Grads of targets w.r.t. inputs (parity: backward.py:1678).
+    """Grads of targets w.r.t. inputs (parity: backward.py:1678
+    calc_gradient): d(sum_i <targets[i], target_gradients[i] or 1>)/d(inputs).
 
     inputs must be variables live *before* the backward position (feed data
     or parameters) — intermediate activations inside the differentiated
@@ -69,11 +70,46 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """
     targets = targets if isinstance(targets, (list, tuple)) else [targets]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    if len(targets) != 1:
-        raise NotImplementedError("gradients(): exactly one target supported")
-    loss = targets[0]
-    program = loss.block.program
+    if target_gradients is not None and not isinstance(
+            target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    program = targets[0].block.program
     block = program.global_block()
+    if len(targets) > 1 or target_gradients is not None:
+        # reference calc_gradient semantics: d(sum_i <target_i, tg_i>)/d(x)
+        # with tg defaulting to ones.  Synthesize the weighted-sum scalar in
+        # the block so ONE BackwardSection covers all targets (XLA fuses the
+        # whole reverse sweep either way).
+        parts = []
+        for i, tgt in enumerate(targets):
+            term = tgt
+            tg = (target_gradients[i]
+                  if target_gradients and i < len(target_gradients) else None)
+            if tg is not None:
+                mul = block.create_var(
+                    name=f"{tgt.name}@weighted_{i}", shape=tgt.shape,
+                    dtype=tgt.dtype, stop_gradient=False)
+                block.append_op("elementwise_mul",
+                                inputs={"X": tgt, "Y": tg},
+                                outputs={"Out": mul}, attrs={"axis": -1})
+                term = mul
+            red = block.create_var(
+                name=f"{tgt.name}@grad_sum_{i}", shape=[1],
+                dtype=tgt.dtype, stop_gradient=False)
+            block.append_op("reduce_sum", inputs={"X": term},
+                            outputs={"Out": red},
+                            attrs={"reduce_all": True, "keep_dim": False})
+            parts.append(red)
+        if len(parts) > 1:
+            loss = block.create_var(
+                name=f"{targets[0].name}@combined_target", shape=[1],
+                dtype=targets[0].dtype, stop_gradient=False)
+            block.append_op("sum", inputs={"X": parts},
+                            outputs={"Out": loss})
+        else:
+            loss = parts[0]
+    else:
+        loss = targets[0]
     names = [v.name if hasattr(v, "name") else v for v in inputs]
     pos = len(block.ops)
     program.backward_sections.append(

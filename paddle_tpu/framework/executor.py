@@ -533,7 +533,7 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           sparse_config=None):
+                           sparse_config=None, _sparse_push=True):
         """Dataset-driven training loop — the industrial CTR path.
 
         Parity: /root/reference/python/paddle/fluid/executor.py:1187
@@ -568,32 +568,41 @@ class Executor:
         fetch_info = list(fetch_info or fetch_names)
         blk = real_prog.global_block()
 
-        sp = sparse_config or {}
-        table = sp.get("table")          # SparseEmbedding or Communicator
-        ids_var = sp.get("ids_var")
-        emb_var = sp.get("emb_var")
-        grad_name = (emb_var + "@GRAD") if emb_var else None
-        # Communicator wraps a table: pull reads through, push goes via
-        # the communicator's mode (sync/async/half_async/geo)
-        pull_src = getattr(table, "table", table)
-        push_dst = table
+        # sparse_config: one entry dict, a list of them, or (when None)
+        # whatever the DistributeTranspiler attached to the program
+        sp = sparse_config
+        if sp is None:
+            sp = getattr(program, "_ps_sparse_config", None) \
+                or getattr(real_prog, "_ps_sparse_config", None)
+        entries = list(sp) if isinstance(sp, (list, tuple)) \
+            else ([sp] if sp else [])
+        # tolerate partial/dense configs: no table -> dense path
+        entries = [e for e in entries if e and e.get("table") is not None]
+        for e in entries:
+            # Communicator wraps a table: pull reads through, push goes
+            # via the communicator's mode (sync/async/half_async/geo)
+            e["_pull"] = getattr(e["table"], "table", e["table"])
+            e["_grad"] = e["emb_var"] + "@GRAD"
 
         last = None
         step_i = 0
         for batch in dataset:
             feed = {k: v for k, v in batch.items()
                     if blk._find_var_recursive(k) is not None}
-            ids = None
-            if table is not None:
-                ids = np.asarray(batch[ids_var])
-                feed[emb_var] = pull_src.pull(ids)
-                fl = fetch_names + [grad_name]
-            else:
-                fl = fetch_names
+            fl = list(fetch_names)
+            batch_ids = {}
+            for e in entries:
+                ids = np.asarray(batch[e["ids_var"]])
+                batch_ids[e["emb_var"]] = ids
+                feed[e["emb_var"]] = e["_pull"].pull(ids)
+                if _sparse_push:
+                    fl.append(e["_grad"])
             out = self.run(program, feed=feed, fetch_list=fl, scope=scope)
-            if table is not None:
-                push_dst.push(ids, np.asarray(out[-1]))
-                out = out[:-1]
+            if entries and _sparse_push:
+                n = len(entries)
+                for e, g in zip(entries, out[-n:]):
+                    e["table"].push(batch_ids[e["emb_var"]], np.asarray(g))
+                out = out[:-n]
             last = out
             step_i += 1
             if (debug or fetch_info) and fetch_names \
@@ -607,12 +616,13 @@ class Executor:
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100):
-        """executor.py:1130 parity — same drain loop, no sparse push; pass
-        a for_test clone of the program."""
+        """executor.py:1130 parity — same drain loop but READ-ONLY on the
+        sparse tables: embedding rows are still pulled to feed the
+        program, gradients are neither fetched nor pushed."""
         return self.train_from_dataset(
             program=program, dataset=dataset, scope=scope, thread=thread,
             debug=debug, fetch_list=fetch_list, fetch_info=fetch_info,
-            print_period=print_period)
+            print_period=print_period, _sparse_push=False)
 
     # ------------------------------------------------------------------
     @staticmethod

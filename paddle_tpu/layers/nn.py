@@ -163,13 +163,16 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     return helper.append_activation(pre_bias, act)
 
 
-def embedding(input, size, is_sparse=False, padding_idx=None,
-              param_attr=None, dtype="float32", name=None):
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
     """Parity: layers/nn.py embedding / lookup_table_v2.
 
     is_sparse selected sparse SelectedRows grads in the reference; on TPU
-    XLA's gather/scatter fusion handles it, so the flag is accepted and
-    ignored (the PS sparse-table path is paddle_tpu.distributed.ps)."""
+    XLA's gather/scatter fusion handles local sparse grads, so the flag
+    alone changes nothing.  is_distributed (or is_sparse under the
+    DistributeTranspiler) routes the table to the parameter server: the
+    transpiler rewrites this op into a pull-fed variable
+    (paddle_tpu.transpiler; manual path: paddle_tpu.distributed.ps)."""
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
     out_shape = (tuple(input.shape) + (size[1],)
@@ -178,7 +181,9 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     helper.append_op(
         "lookup_table_v2", inputs={"Ids": input, "W": w},
         outputs={"Out": out},
-        attrs={"padding_idx": -1 if padding_idx is None else padding_idx})
+        attrs={"padding_idx": -1 if padding_idx is None else padding_idx,
+               "is_sparse": bool(is_sparse),
+               "is_distributed": bool(is_distributed)})
     return out
 
 

@@ -140,3 +140,175 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class ChunkEvaluator(MetricBase):
+    """Accumulate chunk_eval counters across batches -> precision/recall/F1
+    (parity: python/paddle/fluid/metrics.py:513; counters come from the
+    chunk_eval op, operators/metrics/ — supports IOB/IOE/IOBES/IO)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """Accumulate edit-distance op outputs (parity: metrics.py:611).
+    update takes the per-instance distances and the per-batch count of
+    sequence errors (instances with distance > 0)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None):
+        d = np.asarray(distances, np.float64).reshape(-1)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(seq_num) if seq_num is not None else d.size
+        self.instance_error += int((d > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError(
+                "There is no data in EditDistance Metric. Please check "
+                "layers.edit_distance output has been added to EditDistance.")
+        avg_distance = self.total_distance / self.seq_num
+        avg_instance_error = self.instance_error / float(self.seq_num)
+        return avg_distance, avg_instance_error
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision for detection (parity: metrics.py:805 +
+    operators/detection/detection_map_op.cc).  The reference evaluates
+    inside the graph with a LoD op; dynamic per-image box counts cannot
+    live in a static XLA program, so the evaluator runs host-side over
+    numpy batches — the same accumulate-then-eval contract.
+
+    update(detections, gt_labels, gt_boxes, gt_difficult=None):
+      detections: [M, 6] rows [label, score, xmin, ymin, xmax, ymax]
+      gt_labels:  [N] class ids;  gt_boxes: [N, 4];  gt_difficult: [N]
+      one call per image.
+    eval() -> mAP (float) over classes seen in ground truth.
+    """
+
+    def __init__(self, class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral", name=None):
+        super().__init__(name)
+        self.class_num = class_num
+        self.background_label = background_label
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        if ap_version not in ("integral", "11point"):
+            raise ValueError("ap_version must be 'integral' or '11point'")
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._scores = {}        # class -> list of (score, tp)
+        self._n_pos = {}         # class -> number of (counted) gt boxes
+
+    @staticmethod
+    def _iou(box, boxes):
+        lt = np.maximum(box[:2], boxes[:, :2])
+        rb = np.minimum(box[2:], boxes[:, 2:])
+        wh = np.maximum(rb - lt, 0)
+        inter = wh[:, 0] * wh[:, 1]
+        area = ((box[2] - box[0]) * (box[3] - box[1])
+                + (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+                - inter)
+        return np.where(area > 0, inter / np.maximum(area, 1e-10), 0.0)
+
+    def update(self, detections, gt_labels, gt_boxes, gt_difficult=None):
+        det = np.asarray(detections, np.float64).reshape(-1, 6)
+        gl = np.asarray(gt_labels).reshape(-1).astype(int)
+        gb = np.asarray(gt_boxes, np.float64).reshape(-1, 4)
+        gd = (np.asarray(gt_difficult).reshape(-1).astype(bool)
+              if gt_difficult is not None else np.zeros(gl.shape, bool))
+        for c in np.unique(gl):
+            if c == self.background_label:
+                continue
+            counted = gd[gl == c] == False if not self.evaluate_difficult \
+                else np.ones((gl == c).sum(), bool)
+            self._n_pos[c] = self._n_pos.get(c, 0) + int(counted.sum())
+        for c in np.unique(det[:, 0]).astype(int):
+            if c == self.background_label:
+                continue
+            dc = det[det[:, 0] == c]
+            order = np.argsort(-dc[:, 1], kind="stable")
+            gt_mask = gl == c
+            g_boxes = gb[gt_mask]
+            g_diff = gd[gt_mask]
+            matched = np.zeros(len(g_boxes), bool)
+            recs = self._scores.setdefault(c, [])
+            for i in order:
+                score = dc[i, 1]
+                if len(g_boxes) == 0:
+                    recs.append((score, 0))
+                    continue
+                ious = self._iou(dc[i, 2:], g_boxes)
+                j = int(np.argmax(ious))
+                if ious[j] >= self.overlap_threshold:
+                    if not self.evaluate_difficult and g_diff[j]:
+                        continue            # difficult: ignored entirely
+                    if not matched[j]:
+                        matched[j] = True
+                        recs.append((score, 1))
+                    else:
+                        recs.append((score, 0))
+                else:
+                    recs.append((score, 0))
+
+    def _ap(self, recs, n_pos):
+        if n_pos == 0 or not recs:
+            return None
+        recs = sorted(recs, key=lambda t: -t[0])
+        tps = np.cumsum([tp for _, tp in recs])
+        fps = np.cumsum([1 - tp for _, tp in recs])
+        recall = tps / n_pos
+        precision = tps / np.maximum(tps + fps, 1e-10)
+        if self.ap_version == "11point":
+            ap = 0.0
+            for t in np.linspace(0, 1, 11):
+                p = precision[recall >= t]
+                ap += (p.max() if p.size else 0.0) / 11.0
+            return ap
+        # integral: sum precision deltas over recall steps
+        ap = 0.0
+        prev_r = 0.0
+        for r, p in zip(recall, precision):
+            ap += p * (r - prev_r)
+            prev_r = r
+        return ap
+
+    def eval(self):
+        aps = []
+        for c, n_pos in self._n_pos.items():
+            ap = self._ap(self._scores.get(c, []), n_pos)
+            if ap is not None:
+                aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

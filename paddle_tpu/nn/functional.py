@@ -16,9 +16,10 @@ from .parameter import default_rng
 
 
 def _val(x):
+    from ..tape import Variable
     from .parameter import EagerParameter
 
-    if isinstance(x, EagerParameter):
+    if isinstance(x, (EagerParameter, Variable)):
         return x.value
     return x
 
@@ -284,3 +285,25 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     return _attn.dot_product_attention(
         q, k, v, mask=attn_mask, dropout_p=dropout_p, is_causal=is_causal,
         scale=scale, training=training)
+
+
+# -- dygraph tape integration ------------------------------------------------
+# Every public functional op records on the active dygraph tape when called
+# with Variables/Parameters (the analogue of the reference routing dygraph
+# ops through the tracer, imperative/tracer.cc:45).  With no tape active the
+# wrapper is a passthrough.
+
+def _wrap_module_for_tape():
+    import types
+
+    from ..tape import wrap_eager_fn
+
+    g = globals()
+    for name in list(g):
+        f = g[name]
+        if (not name.startswith("_") and isinstance(f, types.FunctionType)
+                and f.__module__ == __name__):
+            g[name] = wrap_eager_fn(f)
+
+
+_wrap_module_for_tape()

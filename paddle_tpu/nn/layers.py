@@ -165,7 +165,39 @@ class Layer:
     # -- call -------------------------------------------------------------
 
     def __call__(self, *args, **kwargs):
-        return self.forward(*args, **kwargs)
+        from ..tape import current_tape
+
+        tape = current_tape()
+        if tape is None:
+            return self.forward(*args, **kwargs)
+        return self._record_call(tape, args, kwargs)
+
+    def _record_call(self, tape, args, kwargs):
+        """Record this call on the dygraph tape: the forward runs as a
+        pure function of (params, inputs) under jax.vjp; buffer updates
+        (batch-norm stats) come back as explicit outputs and are
+        committed to the layer with their concrete values."""
+        # dict of EagerParameters: the tape wires each as a diff input
+        params = {n: p for n, p in self.named_parameters() if p.trainable}
+        buffers = buffer_dict(self)
+
+        def fn(ps, *xs, **kw):
+            out, new_buffers = functional_call_with_state(
+                self, ps, buffers, *xs, **kw)
+            return out, new_buffers
+
+        out, new_buffers = tape.record(fn, (params,) + args, kwargs)
+        for path, v in new_buffers.items():
+            # tape.record wraps array outputs as Variables; buffers stay
+            # plain arrays on the layer
+            self._set_buffer_by_path(
+                path, v.value if hasattr(v, "value") else v)
+        return out
+
+    def clear_gradients(self):
+        """Zero out parameter gradient slots (dygraph Layer API)."""
+        for _, p in self.named_parameters():
+            p.clear_gradient()
 
     def forward(self, *args, **kwargs):
         raise NotImplementedError

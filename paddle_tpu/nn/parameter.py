@@ -75,6 +75,9 @@ class EagerParameter:
         self.name = name
         self.trainable = trainable
         self.stop_gradient = not trainable
+        # gradient slot filled by the dygraph tape's backward sweep
+        # (imperative/layer.h grad_var_); None until a backward runs
+        self.grad = None
 
     @property
     def shape(self):
@@ -89,6 +92,13 @@ class EagerParameter:
 
     def set_value(self, v):
         self.value = jnp.asarray(v, dtype=self.value.dtype)
+
+    def gradient(self):
+        """Accumulated gradient as numpy, or None (VarBase.gradient())."""
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def clear_gradient(self):
+        self.grad = None
 
     def __jax_array__(self):
         # lets elementwise jnp dunders and jnp.asarray consume a Parameter

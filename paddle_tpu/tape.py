@@ -1,0 +1,420 @@
+"""Eager autodiff tape — the dygraph BasicEngine, TPU-native.
+
+Parity: /root/reference/paddle/fluid/imperative/{tracer.cc:45,
+engine.cc:137 BasicEngine, layer.h:56 VarBase}.  The reference's tracer
+records every traced op with its grad-op maker and `loss.backward()` walks
+the recorded graph.  Here each recorded call runs under `jax.vjp`: the
+primal executes eagerly (values immediately available, the dygraph
+contract) while jax builds the exact reverse function; the tape stores the
+vjp closures wired by data flow, and `Variable.backward()` replays them in
+reverse creation order, accumulating cotangents into `Parameter.grad` —
+the same engine design, with jax.vjp standing in for the C++ grad-op
+registry.
+
+Recording boundaries: nn.Layer.__call__, the public nn.functional surface,
+and Variable operator overloads.  Raw jnp math on unwrapped arrays is
+invisible to the tape (those segments contribute no gradient), exactly as
+numpy math was invisible to the reference's tracer.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_STATE = threading.local()
+
+
+def _stack():
+    if not hasattr(_STATE, "tapes"):
+        _STATE.tapes = []
+    return _STATE.tapes
+
+
+def _trace_state_clean():
+    try:
+        from jax._src import core as _core
+
+        return _core.trace_state_clean()
+    except Exception:  # pragma: no cover - fallback for other jax versions
+        return True
+
+
+def current_tape():
+    """Active, unpaused tape or None.  None while a record is executing
+    (inner Layer/functional calls run plain inside the vjp trace) and
+    under any jax transform trace (jit/grad/vmap): compiled train steps
+    inside dygraph.guard() must not leak tracers onto the eager tape."""
+    st = _stack()
+    if not st:
+        return None
+    t = st[-1]
+    if t._paused or t._recording or not _trace_state_clean():
+        return None
+    return t
+
+
+def push_tape(tape):
+    _stack().append(tape)
+    return tape
+
+
+def pop_tape():
+    return _stack().pop()
+
+
+class Variable:
+    """Eager tensor with tape provenance (VarBase analogue,
+    imperative/layer.h:56)."""
+
+    __slots__ = ("value", "_node", "_index", "stop_gradient", "_grad",
+                 "name")
+
+    def __init__(self, value, node=None, index=0, stop_gradient=None,
+                 name=None):
+        self.value = value if isinstance(value, jax.Array) \
+            else jnp.asarray(value)
+        self._node = node
+        self._index = index
+        # leaves default to stop_gradient=True (fed data), op results to
+        # False — the reference VarBase convention
+        self.stop_gradient = (node is None) if stop_gradient is None \
+            else stop_gradient
+        self._grad = None
+        self.name = name
+
+    # -- tensor surface ---------------------------------------------------
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def __jax_array__(self):
+        return self.value
+
+    def __repr__(self):
+        return f"Variable(shape={self.shape}, dtype={self.dtype})"
+
+    def __len__(self):
+        return self.value.shape[0]
+
+    def item(self):
+        return self.value.reshape(()).item()
+
+    def __float__(self):
+        return float(self.value)
+
+    def __int__(self):
+        return int(self.value)
+
+    def __bool__(self):
+        return bool(self.value)
+
+    # -- autodiff surface -------------------------------------------------
+
+    def backward(self, grad=None, retain_graph=False):
+        """Run the reverse sweep from this variable (engine.cc:137)."""
+        tape = self._node.tape if self._node is not None else None
+        if tape is None:
+            raise RuntimeError(
+                "backward() on a leaf/untaped Variable — run the forward "
+                "inside dygraph.guard() so the tape records it")
+        tape.run_backward(self, grad, retain_graph=retain_graph)
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self):
+        return Variable(self.value, stop_gradient=True, name=self.name)
+
+    # -- recorded methods / operators ------------------------------------
+
+    def astype(self, dtype):
+        return record_or_run(lambda a: a.astype(dtype), self)
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return record_or_run(lambda a: a.reshape(shape), self)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return record_or_run(lambda a: jnp.transpose(a, axes or None), self)
+
+    def sum(self, axis=None, keepdims=False):
+        return record_or_run(
+            lambda a: jnp.sum(a, axis=axis, keepdims=keepdims), self)
+
+    def mean(self, axis=None, keepdims=False):
+        return record_or_run(
+            lambda a: jnp.mean(a, axis=axis, keepdims=keepdims), self)
+
+    def __getitem__(self, idx):
+        return record_or_run(lambda a: a[idx], self)
+
+    def __neg__(self):
+        return record_or_run(lambda a: -a, self)
+
+    def __add__(self, o):
+        return record_or_run(lambda a, b: a + b, self, o)
+
+    def __radd__(self, o):
+        return record_or_run(lambda a, b: b + a, self, o)
+
+    def __sub__(self, o):
+        return record_or_run(lambda a, b: a - b, self, o)
+
+    def __rsub__(self, o):
+        return record_or_run(lambda a, b: b - a, self, o)
+
+    def __mul__(self, o):
+        return record_or_run(lambda a, b: a * b, self, o)
+
+    def __rmul__(self, o):
+        return record_or_run(lambda a, b: b * a, self, o)
+
+    def __truediv__(self, o):
+        return record_or_run(lambda a, b: a / b, self, o)
+
+    def __rtruediv__(self, o):
+        return record_or_run(lambda a, b: b / a, self, o)
+
+    def __pow__(self, o):
+        return record_or_run(lambda a, b: a ** b, self, o)
+
+    def __matmul__(self, o):
+        return record_or_run(lambda a, b: a @ b, self, o)
+
+    def __rmatmul__(self, o):
+        return record_or_run(lambda a, b: b @ a, self, o)
+
+    def __lt__(self, o):
+        return Variable(self.value < _raw(o), stop_gradient=True)
+
+    def __le__(self, o):
+        return Variable(self.value <= _raw(o), stop_gradient=True)
+
+    def __gt__(self, o):
+        return Variable(self.value > _raw(o), stop_gradient=True)
+
+    def __ge__(self, o):
+        return Variable(self.value >= _raw(o), stop_gradient=True)
+
+
+def _raw(x):
+    from .nn.parameter import EagerParameter
+
+    if isinstance(x, Variable):
+        return x.value
+    if isinstance(x, EagerParameter):
+        return x.value
+    return x
+
+
+class TapeNode:
+    __slots__ = ("tape", "seq", "vjp_fn", "parents", "out_treedef",
+                 "out_avals")
+
+    def __init__(self, tape, seq, vjp_fn, parents, out_treedef, out_avals):
+        self.tape = tape
+        self.seq = seq
+        self.vjp_fn = vjp_fn
+        self.parents = parents          # per diff-input: routing tuple
+        self.out_treedef = out_treedef
+        self.out_avals = out_avals      # per output leaf: (shape, dtype)
+
+
+class Tape:
+    """Recording context.  The graph itself is owned by the Variables:
+    each Variable strongly references its producing node, and nodes
+    strongly reference their parent nodes — so dropping the Variables of
+    an iteration frees its whole subgraph by refcount, exactly like the
+    reference's VarBase-owned grad graphs (forward-only eval loops inside
+    guard() don't leak).  backward() additionally drops the visited vjp
+    closures unless retain_graph."""
+
+    def __init__(self):
+        self._seq = 0
+        self._paused = False
+        self._recording = False
+
+    # -- recording --------------------------------------------------------
+
+    def record(self, fn, args, kwargs=None):
+        """Execute fn(*args, **kwargs) under jax.vjp, wiring Variables /
+        Parameters among the flattened args as differentiable inputs.
+        Returns outputs with every array leaf wrapped as a Variable."""
+        from .nn.parameter import EagerParameter
+
+        def is_leaf(x):
+            return isinstance(x, (Variable, EagerParameter))
+
+        flat, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs or {}), is_leaf=is_leaf)
+        diff_vals, parents, spec = [], [], []
+        for x in flat:
+            if isinstance(x, Variable):
+                if x.stop_gradient:
+                    spec.append(("c", x.value))
+                    continue
+                if x._node is not None and x._node.tape is self:
+                    parents.append(("node", x._node, x._index))
+                else:
+                    # leaf (or variable of a released/foreign tape): grads
+                    # accumulate on the Variable itself
+                    parents.append(("var", x))
+                diff_vals.append(x.value)
+                spec.append(("d", len(diff_vals) - 1))
+            elif isinstance(x, EagerParameter) and x.trainable:
+                parents.append(("param", x))
+                diff_vals.append(x.value)
+                spec.append(("d", len(diff_vals) - 1))
+            elif isinstance(x, EagerParameter):
+                spec.append(("c", x.value))
+            else:
+                spec.append(("c", x))
+
+        def pure(*dvals):
+            leaves = [dvals[s[1]] if s[0] == "d" else s[1] for s in spec]
+            a, kw = jax.tree_util.tree_unflatten(treedef, leaves)
+            return fn(*a, **kw)
+
+        self._recording = True
+        try:
+            out, vjp_fn = jax.vjp(pure, *diff_vals)
+        finally:
+            self._recording = False
+
+        out_flat, out_treedef = jax.tree_util.tree_flatten(out)
+        avals = [(tuple(o.shape), o.dtype) if isinstance(o, jax.Array)
+                 else None for o in out_flat]
+        node = TapeNode(self, self._seq, vjp_fn, parents, out_treedef,
+                        avals)
+        self._seq += 1
+        wrapped = [Variable(o, node, i) if isinstance(o, jax.Array) else o
+                   for i, o in enumerate(out_flat)]
+        return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+    # -- reverse sweep ----------------------------------------------------
+
+    def run_backward(self, var, grad=None, retain_graph=False):
+        if var._node is None:
+            return
+        # collect the reachable subgraph (graph ownership is on Variables;
+        # the tape keeps no node list), process in reverse creation order
+        reachable = {}
+        stack = [var._node]
+        while stack:
+            n = stack.pop()
+            if n.seq in reachable:
+                continue
+            reachable[n.seq] = n
+            for route in n.parents:
+                if route[0] == "node":
+                    stack.append(route[1])
+        order = sorted(reachable.values(), key=lambda n: -n.seq)
+
+        cots = {}                        # node seq -> {out_index: cotangent}
+
+        def add(node, idx, g):
+            d = cots.setdefault(node.seq, {})
+            d[idx] = d[idx] + g if idx in d else g
+
+        seed = (jnp.ones_like(var.value) if grad is None
+                else jnp.asarray(grad))
+        add(var._node, var._index, seed)
+
+        for node in order:
+            got = cots.pop(node.seq, None)
+            if got is None or node.vjp_fn is None:
+                continue
+            leaves = []
+            for i, aval in enumerate(node.out_avals):
+                if i in got:
+                    leaves.append(got[i])
+                elif aval is not None:
+                    leaves.append(jnp.zeros(aval[0], aval[1]))
+                else:
+                    leaves.append(None)
+            cot = jax.tree_util.tree_unflatten(node.out_treedef, leaves)
+            in_cots = node.vjp_fn(cot)
+            for route, g in zip(node.parents, in_cots):
+                kind = route[0]
+                if kind == "param":
+                    p = route[1]
+                    p.grad = g if p.grad is None else p.grad + g
+                elif kind == "node":
+                    add(route[1], route[2], g)
+                elif kind == "var":
+                    v = route[1]
+                    v._grad = g if v._grad is None else v._grad + g
+        if not retain_graph:
+            for n in order:
+                n.vjp_fn = None
+
+    def release(self):
+        """Kept for the guard() exit path; graph memory is owned by the
+        Variables, so there is nothing tape-side to free."""
+
+    # -- pause ------------------------------------------------------------
+
+    def pause(self):
+        self._paused = True
+
+    def resume(self):
+        self._paused = False
+
+
+def record_or_run(fn, *args, **kwargs):
+    """Record fn on the active tape, or run it plain (unwrapping
+    Variables/Parameters) when no tape is active."""
+    tape = current_tape()
+    if tape is not None:
+        return tape.record(fn, args, kwargs)
+    flat, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Variable))
+    flat = [x.value if isinstance(x, Variable) else x for x in flat]
+    a, kw = jax.tree_util.tree_unflatten(treedef, flat)
+    return fn(*a, **kw)
+
+
+def wrap_eager_fn(fn):
+    """Decorator: route a functional op through the active tape when any
+    argument carries provenance (Variable/trainable Parameter)."""
+    import functools
+
+    from .nn.parameter import EagerParameter
+
+    @functools.wraps(fn)
+    def run(*args, **kwargs):
+        tape = current_tape()
+        if tape is None:
+            return fn(*args, **kwargs)
+        flat = jax.tree_util.tree_leaves(
+            (args, kwargs),
+            is_leaf=lambda x: isinstance(x, (Variable, EagerParameter)))
+        if not any(isinstance(x, (Variable, EagerParameter)) for x in flat):
+            return fn(*args, **kwargs)
+        return tape.record(fn, args, kwargs)
+
+    return run

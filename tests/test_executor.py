@@ -189,3 +189,28 @@ def test_lr_scheduler_decays():
                       scope=scope)
         lrs.append(float(out[0]))
     np.testing.assert_allclose(lrs, [0.05, 0.025, 0.0125], rtol=1e-5)
+
+
+def test_gradients_multi_target_weighted():
+    """calc_gradient parity: multiple targets and target_gradients
+    (reference backward.py:1678)."""
+    from paddle_tpu.framework.backward import gradients
+    from paddle_tpu.framework.initializer import ConstantInitializer
+
+    main, startup = _new_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 3])
+        w = fluid.layers.create_parameter(
+            [3, 2], "float32", name="w_multi",
+            default_initializer=ConstantInitializer(1.0))
+        y1 = fluid.layers.matmul(x, w)                  # sum grad: x^T @ 1
+        y2 = fluid.layers.relu(fluid.layers.matmul(x, w))  # all positive
+        tg = fluid.layers.fill_constant([4, 2], "float32", 2.0)
+        gs = gradients([y1, y2], [w], target_gradients=[tg, None])
+    exe = fluid.Executor()
+    exe.run(startup)
+    xb = np.ones((4, 3), np.float32)
+    out = exe.run(main, feed={"x": xb}, fetch_list=[gs[0]])
+    # d(2*sum(y1) + sum(y2))/dw = 2*4 + 4 = 12 per entry (x all-ones)
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               np.full((3, 2), 12.0), rtol=1e-5)

@@ -1,0 +1,73 @@
+"""Evaluator tests (parity model: test_metrics.py + the reference's
+detection_map_op unittest fixtures)."""
+
+import numpy as np
+
+from paddle_tpu.metrics import ChunkEvaluator, DetectionMAP, EditDistance
+
+
+def test_chunk_evaluator_f1():
+    m = ChunkEvaluator()
+    m.update(10, 9, 8)
+    p, r, f1 = m.eval()
+    assert abs(p - 0.8) < 1e-9 and abs(r - 8 / 9) < 1e-9
+    assert abs(f1 - 2 * p * r / (p + r)) < 1e-9
+    m.update(3, 3, 3)
+    p, r, f1 = m.eval()
+    assert abs(p - 11 / 13) < 1e-9 and abs(r - 11 / 12) < 1e-9
+
+
+def test_edit_distance_accumulates():
+    m = EditDistance()
+    m.update([2.0, 0.0, 1.0])
+    m.update([0.0])
+    avg, err = m.eval()
+    assert abs(avg - 0.75) < 1e-9
+    assert abs(err - 0.5) < 1e-9
+
+
+def test_detection_map_perfect_predictions():
+    m = DetectionMAP(overlap_threshold=0.5)
+    gt = np.array([[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]])
+    det = np.array([
+        [1, 0.9, 0.1, 0.1, 0.4, 0.4],
+        [2, 0.8, 0.5, 0.5, 0.9, 0.9],
+    ])
+    m.update(det, [1, 2], gt)
+    assert abs(m.eval() - 1.0) < 1e-6
+
+
+def test_detection_map_penalizes_false_positive():
+    m = DetectionMAP(ap_version="11point")
+    gt = np.array([[0.1, 0.1, 0.4, 0.4]])
+    det = np.array([
+        [1, 0.9, 0.6, 0.6, 0.9, 0.9],     # FP, higher score
+        [1, 0.8, 0.1, 0.1, 0.4, 0.4],     # TP
+    ])
+    m.update(det, [1], gt)
+    v = m.eval()
+    assert 0.0 < v < 1.0
+
+
+def test_detection_map_duplicate_detection_is_fp():
+    m = DetectionMAP()
+    gt = np.array([[0.1, 0.1, 0.4, 0.4]])
+    det = np.array([
+        [1, 0.9, 0.1, 0.1, 0.4, 0.4],
+        [1, 0.8, 0.11, 0.11, 0.41, 0.41],  # duplicate match -> FP
+    ])
+    m.update(det, [1], gt)
+    # AP integral: TP at rank 1 gives full recall at precision 1
+    assert abs(m.eval() - 1.0) < 1e-6
+    m2 = DetectionMAP()
+    m2.update(det[[1, 0]][:, :], [1], gt)  # same rows, order irrelevant
+    assert abs(m2.eval() - 1.0) < 1e-6
+
+
+def test_detection_map_difficult_ignored():
+    m = DetectionMAP(evaluate_difficult=False)
+    gt = np.array([[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]])
+    det = np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4]])
+    m.update(det, [1, 1], gt, gt_difficult=[0, 1])
+    # the difficult gt is not counted as a positive -> perfect AP
+    assert abs(m.eval() - 1.0) < 1e-6
