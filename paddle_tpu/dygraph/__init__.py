@@ -142,16 +142,14 @@ class DygraphOptimizer:
         if parameter_list is None:
             raise ValueError("parameter_list is required in dygraph mode")
         self._params = [p for p in parameter_list if p.trainable]
-        if grad_clip is not None:
-            tx = optax.chain(grad_clip, tx)
-        self.tx = tx
-        self._state = None              # whole-tree state (jitted path)
+        # gradient clipping is a cross-parameter reduction (global norm),
+        # so on the tape path it applies over the WHOLE grad tree before
+        # the per-parameter base update; the jitted path uses the chained
+        # transform on the full tree and needs no split
+        self._clip = grad_clip
+        self._base = tx
+        self.tx = tx if grad_clip is None else optax.chain(grad_clip, tx)
         self._per_param_state = None    # per-param states (tape path)
-
-    def _ensure_state(self, params):
-        if self._state is None:
-            self._state = self.tx.init(params)
-        return self._state
 
     def current_params(self):
         return {p.name: p.value for p in self._params}
@@ -159,22 +157,28 @@ class DygraphOptimizer:
     def apply_gradients(self, grads):
         """grads: dict name->grad array; updates parameters in place.
 
-        States are per-parameter (like the reference's per-param optimizer
-        ops): a parameter with no gradient this step is skipped entirely —
-        no moment decay, no weight decay — matching the reference rather
-        than a zero-grad optax update."""
+        Clipping (if any) runs over the full grad tree first — global-norm
+        clipping must see every gradient together.  The base update is
+        then per-parameter with per-parameter states (like the reference's
+        per-param optimizer ops): a parameter with no gradient this step
+        is skipped entirely — no moment decay, no weight decay."""
         by_name = {p.name: p for p in self._params}
+        grads = {n: g for n, g in grads.items() if n in by_name}
+        if not grads:
+            return
+        if self._clip is not None:
+            vals = {n: by_name[n].value for n in grads}
+            clip_state = self._clip.init(vals)
+            grads, _ = self._clip.update(grads, clip_state, vals)
         if self._per_param_state is None:
             self._per_param_state = {}
         for n, g in grads.items():
-            p = by_name.get(n)
-            if p is None:
-                continue
+            p = by_name[n]
             sub_p = {n: p.value}
             st = self._per_param_state.get(n)
             if st is None:
-                st = self.tx.init(sub_p)
-            updates, self._per_param_state[n] = self.tx.update(
+                st = self._base.init(sub_p)
+            updates, self._per_param_state[n] = self._base.update(
                 {n: g}, st, sub_p)
             p.value = optax.apply_updates(sub_p, updates)[n]
 

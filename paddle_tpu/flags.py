@@ -103,3 +103,7 @@ declare_flag("amp_dtype", "bfloat16", "Low-precision dtype used by AMP.")
 
 # Benchmark / profiler output directory.
 declare_flag("profiler_dir", "/tmp/paddle_tpu_profile", "Profiler trace dir.")
+
+declare_flag("use_pallas_layer_norm", False,
+             "Route last-axis layer_norm through the Pallas fused kernel "
+             "on TPU (D % 128 == 0).")

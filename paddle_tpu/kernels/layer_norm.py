@@ -1,0 +1,156 @@
+"""Pallas TPU fused LayerNorm (forward + custom-VJP backward).
+
+Parity target: the reference's fused layer-norm CUDA kernels
+(/root/reference/paddle/fluid/operators/layer_norm_op.cu and the fused
+variants in operators/fused/fused_fc_elementwise_layernorm_op.cc) — one
+kernel that reads x once, computes mean/rstd in f32, and writes the
+normalized output, instead of the unfused mean/var/normalize chain.
+
+Kernel shape: grid over row blocks; each step loads a [block_rows, D]
+tile into VMEM, reduces mean and variance along D in f32 on the VPU, and
+writes y = (x - mean) * rstd * gamma + beta in the input dtype.  Mean and
+rstd are saved for the backward, which fuses the three reference grad
+terms (dx, dgamma partial, dbeta partial) into one data pass; the dgamma/
+dbeta row-partials are reduced with a plain XLA sum outside the kernel
+(a [rows, D] -> [D] reduction XLA already does at line rate).
+
+On non-TPU backends the kernels run in interpret mode (numerics tests);
+dispatch (ops/nn_ops.py layer_norm) only selects the Pallas path on TPU
+for last-axis norms with D % 128 == 0 under FLAGS_use_pallas_layer_norm.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pragma: no cover - TPU-specific
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_ROWS = 256
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)                  # [R, D]
+    mean = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = xc * rstd * g_ref[...].astype(jnp.float32)[None, :] \
+        + b_ref[...].astype(jnp.float32)[None, :]
+    y_ref[...] = y.astype(y_ref.dtype)
+    mean_ref[...] = mean[:, 0]
+    rstd_ref[...] = rstd[:, 0]
+
+
+def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
+                dx_ref, dg_part_ref, db_part_ref):
+    x = x_ref[...].astype(jnp.float32)                  # [R, D]
+    dy = dy_ref[...].astype(jnp.float32)
+    gamma = g_ref[...].astype(jnp.float32)[None, :]
+    mean = mean_ref[...][:, None]
+    rstd = rstd_ref[...][:, None]
+    xhat = (x - mean) * rstd
+    wdy = dy * gamma
+    # dx = rstd * (wdy - mean(wdy) - xhat * mean(wdy * xhat))
+    c1 = jnp.mean(wdy, axis=1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=1, keepdims=True)
+    dx_ref[...] = (rstd * (wdy - c1 - xhat * c2)).astype(dx_ref.dtype)
+    dg_part_ref[...] = jnp.sum(dy * xhat, axis=0)[None, :]
+    db_part_ref[...] = jnp.sum(dy, axis=0)[None, :]
+
+
+def _fwd(x, gamma, beta, eps, block_rows):
+    rows, d = x.shape
+    block = min(block_rows, rows)
+    grid = (pl.cdiv(rows, block),)
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, d), x.dtype),
+            jax.ShapeDtypeStruct((rows,), jnp.float32),
+            jax.ShapeDtypeStruct((rows,), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, gamma, beta)
+    return y, mean, rstd
+
+
+def _bwd(x, gamma, mean, rstd, dy, block_rows):
+    rows, d = x.shape
+    block = min(block_rows, rows)
+    nblocks = pl.cdiv(rows, block)
+    dx, dg_part, db_part = pl.pallas_call(
+        _bwd_kernel,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, d), x.dtype),
+            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
+            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, gamma, mean, rstd, dy)
+    return dx, dg_part.sum(axis=0), db_part.sum(axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_layer_norm(x, gamma, beta, eps=1e-5,
+                     block_rows=DEFAULT_BLOCK_ROWS):
+    """LayerNorm over the last axis of a 2-D [rows, D] input."""
+    y, _, _ = _fwd(x, gamma, beta, eps, block_rows)
+    return y
+
+
+def _fused_ln_fwd(x, gamma, beta, eps, block_rows):
+    y, mean, rstd = _fwd(x, gamma, beta, eps, block_rows)
+    return y, (x, gamma, mean, rstd)
+
+
+def _fused_ln_bwd(eps, block_rows, res, dy):
+    x, gamma, mean, rstd = res
+    dx, dgamma, dbeta = _bwd(x, gamma, mean, rstd, dy, block_rows)
+    return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
+
+
+fused_layer_norm.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+def layer_norm_pallas(x, gamma, beta, eps=1e-5):
+    """Any-rank wrapper: normalizes over the last axis."""
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    y = fused_layer_norm(x2, gamma, beta, eps)
+    return y.reshape(shape)

@@ -728,3 +728,139 @@ def fusion_transpose_flatten_concat(ins, attrs):
             lead *= s
         outs.append(t.reshape(lead, -1))
     return {"Out": jnp.concatenate(outs, axis=cat % 2)}
+
+
+# -- SelectedRows ops --------------------------------------------------------
+# The reference's sparse row-slice gradient machinery
+# (framework/selected_rows.h:41, operators/math/selected_rows_functor.cc,
+# operators/merge_selected_rows_op.cc,
+# operators/get_tensor_from_selected_rows_op.cc).  TPU contract: a
+# SelectedRows is the pair (rows [N] int32 with -1 padding, value [N, D]);
+# static capacity N = number of collected rows.
+
+@register_op("merge_selected_rows")
+def merge_selected_rows(ins, attrs):
+    """merge_selected_rows_op.cc — sum duplicate rows.  Output keeps the
+    same static capacity: first-occurrence slots hold the merged sums,
+    duplicate slots become empty (-1 rows, zero values).
+
+    Sort-based O(N log N): stable-argsort by row id groups duplicates
+    into runs; a cumulative max over run-head positions gives every
+    element its run head, whose ORIGINAL index (stable sort ⇒ smallest,
+    i.e. the first occurrence) is the scatter destination.  No N×N
+    pairwise comparisons — optimizer steps call this per batch."""
+    rows, value = ins["X"]
+    rows = jnp.asarray(rows, jnp.int32)
+    value = jnp.asarray(value)
+    n = rows.shape[0]
+    valid = rows >= 0
+    big = jnp.iinfo(jnp.int32).max
+    key = jnp.where(valid, rows, big)
+    order = jnp.argsort(key, stable=True)
+    sk = key[order]
+    is_run_head = jnp.concatenate(
+        [jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    # sorted position of each element's run head (cummax of head marks)
+    head_pos = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_run_head, jnp.arange(n), 0))
+    dest = order[head_pos]                 # original index of run head
+    merged = jnp.zeros_like(value).at[dest].add(
+        value[order] * valid[order][:, None].astype(value.dtype))
+    is_first = jnp.zeros((n,), bool).at[
+        jnp.where(valid[order], dest, n - 1)].max(valid[order])
+    out_rows = jnp.where(is_first & valid, rows, -1)
+    out_vals = jnp.where((is_first & valid)[:, None], merged, 0)
+    return {"Out": (out_rows, out_vals)}
+
+
+@register_op("get_tensor_from_selected_rows")
+def get_tensor_from_selected_rows(ins, attrs):
+    """get_tensor_from_selected_rows_op.cc — densify to [height, D]."""
+    rows, value = ins["X"]
+    rows = jnp.asarray(rows, jnp.int32)
+    value = jnp.asarray(value)
+    height = int(attrs["height"])
+    valid = rows >= 0
+    idx = jnp.where(valid, rows, 0)
+    dense = jnp.zeros((height,) + value.shape[1:], value.dtype)
+    return {"Out": dense.at[idx].add(
+        jnp.where(valid[:, None], value, 0))}
+
+
+@register_op("sgd_sparse", stateful=True)
+def sgd_sparse(ins, attrs):
+    """sgd_op.h SelectedRows branch — update ONLY the touched rows of the
+    parameter table: param[rows] -= lr * grad_rows.  Duplicate rows are
+    handled by scatter-add semantics (the reference merges first; the
+    additive scatter is equivalent for SGD)."""
+    p = jnp.asarray(ins["Param"])
+    rows, gval = ins["Grad"]
+    rows = jnp.asarray(rows, jnp.int32)
+    gval = jnp.asarray(gval).reshape(rows.shape[0], -1)
+    lr = jnp.asarray(ins["LearningRate"]).reshape(())
+    valid = rows >= 0
+    idx = jnp.where(valid, rows, 0)
+    upd = jnp.where(valid[:, None], lr * gval, 0).astype(p.dtype)
+    return {"ParamOut": p.at[idx].add(-upd)}
+
+
+@register_op("adagrad_sparse", stateful=True)
+def adagrad_sparse(ins, attrs):
+    """adagrad_op.cc SelectedRows branch — merge duplicate rows, then
+    moment[rows] += g^2; param[rows] -= lr * g / (sqrt(moment) + eps)."""
+    p = jnp.asarray(ins["Param"])
+    mom = jnp.asarray(ins["Moment"])
+    eps = float(attrs.get("epsilon", 1e-6))
+    lr = jnp.asarray(ins["LearningRate"]).reshape(())
+    merged = merge_selected_rows({"X": ins["Grad"]}, {})["Out"]
+    rows, gval = merged
+    valid = rows >= 0
+    idx = jnp.where(valid, rows, 0)
+    g = jnp.where(valid[:, None], gval, 0).astype(p.dtype)
+    new_mom = mom.at[idx].add(jnp.square(g))
+    scale = lr / (jnp.sqrt(new_mom[idx]) + eps)
+    return {"ParamOut": p.at[idx].add(-scale * g),
+            "MomentOut": new_mom}
+
+
+@register_op("var_conv_2d")
+def var_conv_2d(ins, attrs):
+    """operators/var_conv_2d_op.cc — per-sequence variable-size 2-D conv
+    (match-matrix models): each sample i has a [C, H_i, W_i] map; output
+    size per dim is (dim-1)//stride + 1 (SAME-style).  Ragged maps follow
+    the repo's padded+lengths contract (layers/sequence_ops.py): X is
+    [B, C, Hmax, Wmax] with RowLengths/ColLengths [B]; invalid input and
+    output cells are masked to zero, matching the reference's per-LoD
+    im2col over valid extents.  W is [OC, IC*KH*KW] exactly as the
+    reference stores it."""
+    x = jnp.asarray(ins["X"])                       # [B, C, Hm, Wm]
+    w = jnp.asarray(ins["W"])                       # [OC, IC*KH*KW]
+    b, c, hm, wm = x.shape
+    kh = int(attrs.get("KernelH", 1))
+    kw = int(attrs.get("KernelW", 1))
+    sh = int(attrs.get("StrideH", 1))
+    sw = int(attrs.get("StrideW", 1))
+    oc = int(attrs.get("OutputChannel", w.shape[0]))
+    rows = (jnp.asarray(ins["ROW"]).reshape(-1).astype(jnp.int32)
+            if ins.get("ROW") is not None else jnp.full((b,), hm, jnp.int32))
+    cols = (jnp.asarray(ins["COLUMN"]).reshape(-1).astype(jnp.int32)
+            if ins.get("COLUMN") is not None
+            else jnp.full((b,), wm, jnp.int32))
+    # zero out padded input cells so kernels straddling the boundary see 0
+    rmask = jnp.arange(hm)[None, :] < rows[:, None]          # [B, Hm]
+    cmask = jnp.arange(wm)[None, :] < cols[:, None]          # [B, Wm]
+    x = x * (rmask[:, None, :, None] & cmask[:, None, None, :])
+    filt = w.reshape(oc, c, kh, kw)
+    # reference pads so out = (in - 1)//stride + 1: total pad k-1, front
+    # half (k-1)//2 — lax's explicit padding expresses it exactly
+    pad = [((kh - 1) // 2, kh - 1 - (kh - 1) // 2),
+           ((kw - 1) // 2, kw - 1 - (kw - 1) // 2)]
+    out = lax.conv_general_dilated(
+        x, filt, window_strides=(sh, sw), padding=pad,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh, ow = out.shape[-2], out.shape[-1]
+    orow = (rows - 1) // sh + 1
+    ocol = (cols - 1) // sw + 1
+    omask = ((jnp.arange(oh)[None, :] < orow[:, None])[:, None, :, None]
+             & (jnp.arange(ow)[None, :] < ocol[:, None])[:, None, None, :])
+    return {"Out": out * omask, "Col": jnp.zeros((0,), x.dtype)}

@@ -404,10 +404,29 @@ def batch_norm(ins, attrs):
 
 @register_op("layer_norm")
 def layer_norm(ins, attrs):
-    """operators/layer_norm_op.cc — normalize over dims >= begin_norm_axis."""
+    """operators/layer_norm_op.cc — normalize over dims >= begin_norm_axis.
+
+    Under FLAGS_use_pallas_layer_norm, last-axis norms with lane-aligned
+    width route through the fused Pallas kernel (kernels/layer_norm.py —
+    one VMEM pass for mean/rstd/normalize, the layer_norm_op.cu fusion)."""
     x = ins["X"]
     eps = attrs.get("epsilon", 1e-5)
     axis = attrs.get("begin_norm_axis", 1)
+    from .. import flags as _flags
+
+    if (_flags.flag("use_pallas_layer_norm") and axis == x.ndim - 1
+            and x.shape[-1] % 128 == 0 and ins.get("Scale") is not None
+            and ins.get("Bias") is not None):
+        import jax as _jax
+
+        if _jax.default_backend() == "tpu":
+            from ..kernels.layer_norm import layer_norm_pallas
+
+            y = layer_norm_pallas(x, ins["Scale"].reshape(-1),
+                                  ins["Bias"].reshape(-1), eps)
+            mean = jnp.mean(x, axis=-1)
+            var = jnp.var(x, axis=-1)
+            return {"Y": y, "Mean": mean, "Variance": var}
     axes = tuple(range(axis, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)

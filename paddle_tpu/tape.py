@@ -101,6 +101,12 @@ class Variable:
     def numpy(self):
         return np.asarray(self.value)
 
+    def __array__(self, dtype=None, copy=None):
+        # without this, np.asarray falls into the sequence protocol and
+        # records one tape node per __getitem__ — quadratic blowup
+        a = np.asarray(self.value)
+        return a.astype(dtype) if dtype is not None else a
+
     def __jax_array__(self):
         return self.value
 

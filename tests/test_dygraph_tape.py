@@ -179,3 +179,36 @@ def test_adam_skips_params_without_grad():
         loss.backward()
         opt.minimize(loss)
         np.testing.assert_array_equal(b.weight.numpy(), w_b)
+
+
+def test_global_norm_clip_spans_parameters():
+    """clip_by_global_norm must scale ALL grads jointly — the combined
+    update norm equals the clip threshold, not sqrt(n_params)*threshold."""
+    import optax
+
+    with dg.guard():
+        a = nn.Linear(1, 4, bias_attr=False)
+        b = nn.Linear(1, 4, bias_attr=False)
+        opt = dg.SGD(learning_rate=1.0,
+                     parameter_list=a.parameters() + b.parameters(),
+                     grad_clip=optax.clip_by_global_norm(1.0))
+        wa0 = a.weight.numpy().copy()
+        wb0 = b.weight.numpy().copy()
+        x = dg.to_variable(np.full((1, 1), 100.0, np.float32))
+        loss = (a(x) + b(x)).sum()       # big grads, clip engages
+        loss.backward()
+        opt.minimize(loss)
+        da = a.weight.numpy() - wa0
+        db = b.weight.numpy() - wb0
+        total = np.sqrt((da ** 2).sum() + (db ** 2).sum())
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_np_asarray_on_variable_is_fast():
+    with dg.guard():
+        x = dg.to_variable(np.ones((50, 30), np.float32))
+        x.stop_gradient = False
+        y = x * 2.0
+        arr = np.asarray(y)              # must not walk the sequence proto
+        assert arr.shape == (50, 30)
+        np.testing.assert_allclose(arr, 2.0)

@@ -503,3 +503,35 @@ class TestCollectiveOps(OpTest):
         y = np.asarray(shard_map(local, mesh=mesh, in_specs=P("dp"),
                                  out_specs=P("dp"))(x))
         np.testing.assert_allclose(y, np.full(4, 2.0))
+
+
+class TestVarConv2D(OpTest):
+    def test_masking_and_shape(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 6, 6)).astype(np.float32)
+        w = rng.standard_normal((4, 27)).astype(np.float32)
+        got = run_kernel("var_conv_2d",
+                         {"X": x, "W": w, "ROW": np.array([6, 4]),
+                          "COLUMN": np.array([6, 3])},
+                         {"KernelH": 3, "KernelW": 3, "StrideH": 1,
+                          "StrideW": 1, "OutputChannel": 4,
+                          "InputChannel": 3})
+        out = got["Out"]
+        assert out.shape == (2, 4, 6, 6)
+        # sample 1 valid extent is 4x3: everything beyond is masked
+        assert (out[1, :, 4:, :] == 0).all()
+        assert (out[1, :, :, 3:] == 0).all()
+        assert np.abs(out[0]).sum() > 0
+
+    def test_full_extent_matches_conv2d_same(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1, 2, 5, 5)).astype(np.float32)
+        w = rng.standard_normal((3, 2 * 3 * 3)).astype(np.float32)
+        got = run_kernel("var_conv_2d", {"X": x, "W": w},
+                         {"KernelH": 3, "KernelW": 3, "StrideH": 1,
+                          "StrideW": 1, "OutputChannel": 3,
+                          "InputChannel": 2})
+        ref = run_kernel("conv2d",
+                         {"Input": x, "Filter": w.reshape(3, 2, 3, 3)},
+                         {"strides": [1, 1], "paddings": [1, 1]})
+        np.testing.assert_allclose(got["Out"], ref["Output"], atol=1e-4)

@@ -1,0 +1,69 @@
+"""Pallas fused LayerNorm tests — numerics vs the XLA composition, run in
+interpret mode on CPU (same strategy as test_flash_attention.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.layer_norm import fused_layer_norm, layer_norm_pallas
+
+
+def _ref(x, g, b, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = ((x - m) ** 2).mean(-1, keepdims=True)
+    return (x - m) / jnp.sqrt(v + eps) * g + b
+
+
+@pytest.mark.parametrize("rows,d", [(64, 128), (100, 256), (8, 512)])
+def test_forward_matches_xla(rows, d):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((rows, d)).astype(np.float32))
+    g = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal(d).astype(np.float32))
+    y = fused_layer_norm(x, g, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(_ref(x, g, b)),
+                               atol=2e-5)
+
+
+def test_gradients_match_xla():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((32, 128)).astype(np.float32))
+    g = jnp.asarray(rng.standard_normal(128).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal(128).astype(np.float32))
+    dy = jnp.asarray(rng.standard_normal((32, 128)).astype(np.float32))
+
+    def lp(x, g, b):
+        return (fused_layer_norm(x, g, b) * dy).sum()
+
+    def lr(x, g, b):
+        return (_ref(x, g, b) * dy).sum()
+
+    gp = jax.grad(lp, argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(x, g, b)
+    for a, c, name in zip(gp, gr, ["dx", "dgamma", "dbeta"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=2e-3, rtol=1e-4, err_msg=name)
+
+
+def test_any_rank_wrapper():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((4, 6, 128)).astype(np.float32))
+    g = jnp.ones((128,), jnp.float32)
+    b = jnp.zeros((128,), jnp.float32)
+    y = layer_norm_pallas(x, g, b)
+    assert y.shape == x.shape
+    np.testing.assert_allclose(np.asarray(y).mean(-1), 0.0, atol=1e-5)
+
+
+def test_bf16_io_f32_stats():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((16, 128)), jnp.bfloat16)
+    g = jnp.ones((128,), jnp.bfloat16)
+    b = jnp.zeros((128,), jnp.bfloat16)
+    y = fused_layer_norm(x, g, b)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32),
+        np.asarray(_ref(x.astype(jnp.float32), 1.0, 0.0)), atol=0.1)

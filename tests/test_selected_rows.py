@@ -1,0 +1,108 @@
+"""SelectedRows tests (parity model: test_selected_rows.py,
+test_merge_selectedrows_op.py, the SGD/Adagrad SelectedRows-branch
+unittests in the reference)."""
+
+import numpy as np
+
+from op_test import OpTest, run_kernel
+from paddle_tpu.selected_rows import (
+    SelectedRows, embedding_grad_selected_rows,
+)
+
+
+class TestMergeSelectedRows(OpTest):
+    def test_merges_duplicates(self):
+        rows = np.array([3, 1, 3, -1], np.int32)
+        vals = np.array([[1.0, 1.0], [2.0, 2.0], [10.0, 10.0],
+                         [99.0, 99.0]], np.float32)
+        out_rows, out_vals = run_kernel(
+            "merge_selected_rows", {"X": (rows, vals)})["Out"]
+        assert out_rows.tolist() == [3, 1, -1, -1]
+        np.testing.assert_allclose(out_vals[0], [11.0, 11.0])
+        np.testing.assert_allclose(out_vals[1], [2.0, 2.0])
+        np.testing.assert_allclose(out_vals[2:], 0.0)
+
+
+class TestGetTensorFromSelectedRows(OpTest):
+    def test_densify(self):
+        rows = np.array([2, 0, 2, -1], np.int32)
+        vals = np.array([[1.0], [5.0], [2.0], [88.0]], np.float32)
+        dense = run_kernel("get_tensor_from_selected_rows",
+                           {"X": (rows, vals)}, {"height": 4})["Out"]
+        np.testing.assert_allclose(dense, [[5.0], [0.0], [3.0], [0.0]])
+
+
+class TestSparseOptimizers(OpTest):
+    def test_sgd_sparse_touches_only_rows(self):
+        p = np.ones((5, 2), np.float32)
+        rows = np.array([1, 3, 1], np.int32)
+        g = np.ones((3, 2), np.float32)
+        out = run_kernel("sgd_sparse",
+                         {"Param": p, "Grad": (rows, g),
+                          "LearningRate": np.array([0.5], np.float32)})
+        exp = p.copy()
+        exp[1] -= 1.0            # two duplicate rows accumulate
+        exp[3] -= 0.5
+        np.testing.assert_allclose(out["ParamOut"], exp)
+
+    def test_adagrad_sparse_matches_dense_on_touched_rows(self):
+        rng = np.random.default_rng(0)
+        p = rng.standard_normal((6, 3)).astype(np.float32)
+        mom = np.zeros((6, 3), np.float32)
+        rows = np.array([4, 2], np.int32)
+        g = rng.standard_normal((2, 3)).astype(np.float32)
+        out = run_kernel("adagrad_sparse",
+                         {"Param": p, "Moment": mom, "Grad": (rows, g),
+                          "LearningRate": np.array([0.1], np.float32)},
+                         {"epsilon": 1e-6})
+        dense_g = np.zeros_like(p)
+        dense_g[rows] = g
+        ref = run_kernel("adagrad",
+                         {"Param": p, "Moment": mom, "Grad": dense_g,
+                          "LearningRate": np.array([0.1], np.float32)},
+                         {"epsilon": 1e-6})
+        np.testing.assert_allclose(out["ParamOut"][rows],
+                                   ref["ParamOut"][rows], atol=1e-6)
+        # untouched rows identical to the original param
+        mask = np.ones(6, bool)
+        mask[rows] = False
+        np.testing.assert_allclose(out["ParamOut"][mask], p[mask])
+
+
+def test_selected_rows_roundtrip_and_embedding_grad():
+    import jax
+    import jax.numpy as jnp
+
+    table = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((10, 4)).astype(np.float32))
+    ids = jnp.asarray(np.array([[1, 2], [2, 7]], np.int64))
+
+    def loss(t):
+        return (t[ids.reshape(-1)] ** 2).sum()
+
+    dense_grad = jax.grad(loss)(table)
+    out_grad = 2 * table[ids.reshape(-1)]       # d/d(gathered rows)
+    sr = embedding_grad_selected_rows(ids, out_grad, height=10).merge()
+    np.testing.assert_allclose(np.asarray(sr.to_dense()),
+                               np.asarray(dense_grad), atol=1e-5)
+
+
+class TestMergeSelectedRowsLarge(OpTest):
+    def test_large_batch_matches_numpy(self):
+        """Sort-based merge at a size where a pairwise N^2 matrix would
+        be 64M entries."""
+        rng = np.random.default_rng(0)
+        n = 8000
+        rows = rng.integers(0, 500, n).astype(np.int32)
+        rows[::7] = -1
+        vals = rng.standard_normal((n, 4)).astype(np.float32)
+        out_rows, out_vals = run_kernel(
+            "merge_selected_rows", {"X": (rows, vals)})["Out"]
+        dense = np.zeros((500, 4), np.float32)
+        np.add.at(dense, rows[rows >= 0], vals[rows >= 0])
+        got = np.zeros((500, 4), np.float32)
+        np.add.at(got, out_rows[out_rows >= 0], out_vals[out_rows >= 0])
+        np.testing.assert_allclose(got, dense, atol=1e-3)
+        # merged: every surviving row id unique
+        live = out_rows[out_rows >= 0]
+        assert len(np.unique(live)) == len(live)
