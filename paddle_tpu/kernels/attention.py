@@ -65,7 +65,9 @@ def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
         # (the S^2 matrix still fits cache-friendly tiles); flash wins
         # once the S^2 materialisation starts thrashing HBM (measured
         # crossover on v5e: 512 -> XLA, 2048 -> flash by ~20%).
-        use_flash = (jax.default_backend() == "tpu" and seq >= 1024)
+        from .backend import is_tpu_backend
+
+        use_flash = (is_tpu_backend() and seq >= 1024)
     if forced_flash and not can_flash:
         warnings.warn(
             "use_flash=True requested but the flash kernel cannot serve this "

@@ -1,0 +1,21 @@
+"""Backend detection shared by the Pallas kernels.
+
+The real chip in this environment registers as platform "axon" (a
+tunneled TPU PJRT plugin), not "tpu" — `jax.default_backend()` checks
+alone would leave every Pallas kernel permanently on the interpret/XLA
+path on actual hardware.  Detection therefore also inspects the device
+kind string ("TPU v5 lite", ...).
+"""
+
+import jax
+
+
+def is_tpu_backend():
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        d = jax.devices()[0]
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+    kind = (getattr(d, "device_kind", "") or "").lower()
+    return d.platform == "tpu" or "tpu" in kind

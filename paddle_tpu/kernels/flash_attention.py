@@ -64,7 +64,9 @@ def _compiler_params():
 
 
 def _interpret():
-    return jax.default_backend() != "tpu"
+    from .backend import is_tpu_backend
+
+    return not is_tpu_backend()
 
 
 def _causal_mask(s, qi, ki, block_q, block_k):

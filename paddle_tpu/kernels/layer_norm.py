@@ -37,7 +37,9 @@ DEFAULT_BLOCK_ROWS = 256
 
 
 def _interpret():
-    return jax.default_backend() != "tpu"
+    from .backend import is_tpu_backend
+
+    return not is_tpu_backend()
 
 
 def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
@@ -54,7 +56,7 @@ def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
 
 
 def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
-                dx_ref, dg_part_ref, db_part_ref):
+                dx_ref, dg_part_ref, db_part_ref, *, rows, block):
     x = x_ref[...].astype(jnp.float32)                  # [R, D]
     dy = dy_ref[...].astype(jnp.float32)
     gamma = g_ref[...].astype(jnp.float32)[None, :]
@@ -66,8 +68,16 @@ def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
     c1 = jnp.mean(wdy, axis=1, keepdims=True)
     c2 = jnp.mean(wdy * xhat, axis=1, keepdims=True)
     dx_ref[...] = (rstd * (wdy - c1 - xhat * c2)).astype(dx_ref.dtype)
-    dg_part_ref[...] = jnp.sum(dy * xhat, axis=0)[None, :]
-    db_part_ref[...] = jnp.sum(dy, axis=0)[None, :]
+    # a partial final block carries out-of-bounds padded rows: mask them
+    # out of the cross-row partial sums (dx rows beyond `rows` are
+    # discarded on write, but sums would absorb the garbage)
+    row_idx = pl.program_id(0) * block \
+        + jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0)
+    valid = row_idx < rows
+    # jnp.where, not a multiply: padded rows may hold NaN (NaN * 0 = NaN)
+    dg_part_ref[...] = jnp.sum(jnp.where(valid, dy * xhat, 0.0),
+                               axis=0)[None, :]
+    db_part_ref[...] = jnp.sum(jnp.where(valid, dy, 0.0), axis=0)[None, :]
 
 
 def _fwd(x, gamma, beta, eps, block_rows):
@@ -102,7 +112,7 @@ def _bwd(x, gamma, mean, rstd, dy, block_rows):
     block = min(block_rows, rows)
     nblocks = pl.cdiv(rows, block)
     dx, dg_part, db_part = pl.pallas_call(
-        _bwd_kernel,
+        functools.partial(_bwd_kernel, rows=rows, block=block),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((block, d), lambda i: (i, 0)),

@@ -11,7 +11,15 @@ from .loss import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .sequence_ops import *  # noqa: F401,F403
+from .extended import *  # noqa: F401,F403
 from . import learning_rate_scheduler  # noqa: F401
+# the reference re-exports the LR schedules at the layers namespace
+from .learning_rate_scheduler import (  # noqa: F401
+    cosine_decay, exponential_decay, inverse_time_decay, linear_lr_warmup,
+    natural_exp_decay, noam_decay, piecewise_decay, polynomial_decay,
+)
 from ..framework.program import data  # noqa: F401
 
-from . import nn, tensor, loss, metric_op, control_flow, sequence_ops  # noqa: F401
+from . import (  # noqa: F401
+    nn, tensor, loss, metric_op, control_flow, sequence_ops, extended,
+)

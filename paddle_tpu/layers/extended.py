@@ -1,0 +1,1592 @@
+"""Extended layer surface: the remaining fluid.layers functions.
+
+Parity: /root/reference/python/paddle/fluid/layers/{nn.py, detection.py,
+ops.py, tensor.py, loss.py, sequence_lod.py, metric_op.py} entries that had
+registered op kernels but no Python builder yet.  Every function appends
+its op to the current Program through LayerHelper exactly like the
+reference builders; grouped by family below.  Ragged/LoD arguments follow
+the repo-wide padded+lengths contract (layers/sequence_ops.py:1-11).
+"""
+
+from ..framework.layer_helper import LayerHelper
+from ..framework.program import Variable
+from .tensor import _single_out
+
+__all__ = [
+    # activations / simple math
+    "brelu", "soft_relu", "stanh", "selu", "maxout", "elementwise_floordiv",
+    "add_position_encoding", "bilinear_tensor_product", "cos_sim",
+    "affine_channel", "affine_grid", "grid_sampler", "pixel_shuffle",
+    "space_to_depth", "shuffle_channel", "temporal_shift", "unfold",
+    "im2sequence", "row_conv", "spectral_norm", "lrn", "data_norm",
+    "hash", "size", "rank", "diag", "reverse", "pad_constant_like",
+    "multiplex", "similarity_focus", "crop", "crop_tensor", "random_crop",
+    "shard_index", "scatter_nd", "scatter_nd_add", "unique",
+    "unique_with_counts", "is_empty", "isfinite", "has_inf", "has_nan",
+    "sum", "create_tensor", "gaussian_random",
+    "gaussian_random_batch_size_like", "uniform_random",
+    "uniform_random_batch_size_like", "sampling_id",
+    "get_tensor_from_selected_rows", "merge_selected_rows",
+    # conv / pool 3d
+    "conv3d", "conv3d_transpose", "pool3d", "adaptive_pool3d",
+    # losses
+    "bpr_loss", "center_loss", "npair_loss", "rank_loss",
+    "margin_rank_loss", "sigmoid_focal_loss",
+    "teacher_student_sigmoid_loss", "dice_loss", "warpctc", "nce",
+    "hsigmoid", "sampled_softmax_with_cross_entropy",
+    # sequence
+    "sequence_concat", "sequence_conv", "sequence_enumerate",
+    "sequence_expand_as", "sequence_pad", "sequence_unpad",
+    "sequence_reshape", "sequence_scatter", "sequence_slice", "lod_reset",
+    "lod_append", "edit_distance", "ctc_greedy_decoder",
+    "linear_chain_crf", "crf_decoding", "gru_unit", "dynamic_gru",
+    "dynamic_lstm", "dynamic_lstmp", "fsp_matrix", "filter_by_instag",
+    # detection
+    "iou_similarity", "box_coder", "box_clip", "box_decoder_and_assign",
+    "bipartite_match", "prior_box", "density_prior_box",
+    "anchor_generator", "multiclass_nms", "yolo_box", "yolov3_loss",
+    "roi_align", "roi_pool", "prroi_pool", "psroi_pool",
+    "roi_perspective_transform", "deformable_conv",
+    "deformable_roi_pooling", "generate_proposals",
+    "collect_fpn_proposals", "distribute_fpn_proposals",
+    "rpn_target_assign", "retinanet_target_assign", "target_assign",
+    "retinanet_detection_output", "detection_output",
+    "polygon_box_transform", "mean_iou",
+    # decode
+    "beam_search", "beam_search_decode", "gather_tree",
+    # image / ssd / misc
+    "image_resize", "image_resize_short", "resize_trilinear",
+    "continuous_value_model", "locality_aware_nms", "multi_box_head",
+    "ssd_loss",
+    # metric
+    "auc", "chunk_eval",
+]
+
+
+def _dtype_of(x, default="float32"):
+    return x.dtype if isinstance(x, Variable) and x.dtype else default
+
+
+def _multi_out(op_type, inputs, attrs, out_slots, dtypes=None, name=None):
+    """Append an op with several outputs; returns them in slot order."""
+    helper = LayerHelper(op_type, name=name)
+    outs = {}
+    ref = None
+    for v in inputs.values():
+        vv = v[0] if isinstance(v, (list, tuple)) else v
+        if isinstance(vv, Variable):
+            ref = vv
+            break
+    for i, slot in enumerate(out_slots):
+        dt = (dtypes[i] if dtypes else None) or _dtype_of(ref)
+        outs[slot] = helper.create_variable_for_type_inference(dt)
+    helper.append_op(op_type, inputs=inputs, outputs=outs, attrs=attrs or {})
+    vals = [outs[s] for s in out_slots]
+    return vals[0] if len(vals) == 1 else tuple(vals)
+
+
+# -- activations / simple math ----------------------------------------------
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    """ops.py brelu — clip(x, t_min, t_max)."""
+    return _single_out("clip", {"X": x}, {"min": t_min, "max": t_max},
+                       same_shape=True, name=name)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """ops.py soft_relu — log(1 + exp(clip(x, -t, t)))."""
+    from .tensor import _single_out as so
+
+    clipped = so("clip", {"X": x}, {"min": -threshold, "max": threshold},
+                 same_shape=True)
+    e = so("exp", {"X": clipped}, {}, same_shape=True)
+    one = so("scale", {"X": e}, {"scale": 1.0, "bias": 1.0},
+             same_shape=True)
+    return so("log", {"X": one}, {}, same_shape=True, name=name)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """ops.py stanh — b * tanh(a * x)."""
+    a = _single_out("scale", {"X": x}, {"scale": scale_a}, same_shape=True)
+    t = _single_out("tanh", {"X": a}, {}, same_shape=True)
+    return _single_out("scale", {"X": t}, {"scale": scale_b},
+                       same_shape=True, name=name)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _single_out("selu", {"X": x}, {"scale": scale, "alpha": alpha},
+                       same_shape=True, name=name)
+
+
+def maxout(x, groups, name=None, axis=1):
+    return _single_out("maxout", {"X": x}, {"groups": groups, "axis": axis},
+                       name=name)
+
+
+def elementwise_floordiv(x, y, axis=-1, name=None):
+    return _single_out("elementwise_floordiv", {"X": x, "Y": y},
+                       {"axis": axis}, same_shape=True, name=name)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    return _single_out("add_position_encoding", {"X": input},
+                       {"alpha": alpha, "beta": beta}, same_shape=True,
+                       name=name)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """nn.py bilinear_tensor_product — x W y^T per output channel."""
+    helper = LayerHelper("bilinear_tensor_product", name=name)
+    w = helper.create_parameter(
+        param_attr, shape=[size, int(x.shape[-1]), int(y.shape[-1])],
+        dtype=x.dtype)
+    ins = {"X": x, "Y": y, "Weight": w}
+    if bias_attr is not False:
+        bias = helper.create_parameter(bias_attr, shape=[1, size],
+                                       dtype=x.dtype, is_bias=True)
+        ins["Bias"] = bias
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("bilinear_tensor_product", inputs=ins,
+                     outputs={"Out": out}, attrs={})
+    return helper.append_activation(out, act)
+
+
+def cos_sim(X, Y, name=None):
+    return _multi_out("cos_sim", {"X": X, "Y": Y}, {},
+                      ["Out", "XNorm", "YNorm"], name=name)[0]
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op("affine_channel",
+                     inputs={"X": x, "Scale": scale, "Bias": bias},
+                     outputs={"Out": out},
+                     attrs={"data_layout": data_layout})
+    return helper.append_activation(out, act)
+
+
+def affine_grid(theta, out_shape, name=None):
+    ins = {"Theta": theta}
+    attrs = {}
+    if isinstance(out_shape, Variable):
+        ins["OutputShape"] = out_shape
+    else:
+        attrs["output_shape"] = list(out_shape)
+    return _single_out("affine_grid", ins, attrs, out_slot="Output",
+                       name=name)
+
+
+def grid_sampler(x, grid, name=None):
+    return _single_out("grid_sampler", {"X": x, "Grid": grid}, {},
+                       out_slot="Output", name=name)
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _single_out("pixel_shuffle", {"X": x},
+                       {"upscale_factor": upscale_factor})
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _single_out("space_to_depth", {"X": x},
+                       {"blocksize": blocksize}, name=name)
+
+
+def shuffle_channel(x, group, name=None):
+    return _single_out("shuffle_channel", {"X": x}, {"group": group},
+                       same_shape=True, name=name)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _single_out("temporal_shift", {"X": x},
+                       {"seg_num": seg_num, "shift_ratio": shift_ratio},
+                       same_shape=True, name=name)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    return _single_out("unfold", {"X": x},
+                       {"kernel_sizes": _pair(kernel_sizes),
+                        "strides": _pair(strides),
+                        "paddings": _pair(paddings),
+                        "dilations": _pair(dilations)},
+                       out_slot="Y", name=name)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    return _single_out("im2sequence", {"X": input},
+                       {"kernels": _pair(filter_size),
+                        "strides": _pair(stride),
+                        "paddings": _pair(padding) * 2}, name=name)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv")
+    w = helper.create_parameter(
+        param_attr, shape=[future_context_size + 1, int(input.shape[-1])],
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype,
+                                                    shape=input.shape)
+    helper.append_op("row_conv", inputs={"X": input, "Filter": w},
+                     outputs={"Out": out}, attrs={})
+    return helper.append_activation(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", name=name)
+    import numpy as np
+
+    h = int(weight.shape[dim])
+    w = 1
+    for i, s in enumerate(weight.shape):
+        if i != dim:
+            w *= int(s)
+    u = helper.create_parameter(None, shape=[h], dtype=weight.dtype)
+    v = helper.create_parameter(None, shape=[w], dtype=weight.dtype)
+    out = helper.create_variable_for_type_inference(weight.dtype,
+                                                    shape=weight.shape)
+    helper.append_op("spectral_norm",
+                     inputs={"Weight": weight, "U": u, "V": v},
+                     outputs={"Out": out},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    return _multi_out("lrn", {"X": input},
+                      {"n": n, "k": k, "alpha": alpha, "beta": beta,
+                       "data_format": data_format},
+                      ["Out", "MidOut"], name=name)[0]
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999):
+    """nn.py data_norm — per-feature normalization from accumulated
+    batch statistics (the CTR workhorse)."""
+    helper = LayerHelper("data_norm", name=name)
+    d = int(input.shape[-1])
+    batch_size = helper.create_parameter(None, shape=[d],
+                                         dtype=input.dtype)
+    batch_sum = helper.create_parameter(None, shape=[d], dtype=input.dtype)
+    batch_square_sum = helper.create_parameter(None, shape=[d],
+                                               dtype=input.dtype)
+    outs = {s: helper.create_variable_for_type_inference(input.dtype)
+            for s in ("Y", "Means", "Scales", "BatchSizeOut", "BatchSumOut",
+                      "BatchSquareSumOut")}
+    helper.append_op("data_norm",
+                     inputs={"X": input, "BatchSize": batch_size,
+                             "BatchSum": batch_sum,
+                             "BatchSquareSum": batch_square_sum},
+                     outputs=outs,
+                     attrs={"epsilon": epsilon, "slot_dim": slot_dim})
+    return helper.append_activation(outs["Y"], act)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _single_out("hash", {"X": input},
+                       {"mod_by": hash_size, "num_hash": num_hash},
+                       dtype="int64", name=name)
+
+
+def size(input):
+    return _single_out("size", {"Input": input}, {}, dtype="int64")
+
+
+def rank(input):
+    """nn.py rank — static rank as a constant tensor."""
+    from .tensor import fill_constant
+
+    return fill_constant([1], "int32", len(input.shape))
+
+
+def diag(diagonal):
+    return _single_out("diag", {"Diagonal": diagonal}, {})
+
+
+def reverse(x, axis):
+    return _single_out("reverse", {"X": x},
+                       {"axis": [axis] if isinstance(axis, int) else axis},
+                       same_shape=True)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _single_out("pad_constant_like", {"X": x, "Y": y},
+                       {"pad_value": pad_value}, name=name)
+
+
+def multiplex(inputs, index):
+    return _single_out("multiplex", {"X": list(inputs), "Ids": index}, {})
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _single_out("similarity_focus", {"X": input},
+                       {"axis": axis, "indexes": list(indexes)},
+                       same_shape=True, name=name)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    ins = {"X": x}
+    attrs = {}
+    if isinstance(shape, Variable):
+        ins["Y"] = shape
+    else:
+        attrs["shape"] = list(shape or [])
+    if offsets is not None:
+        attrs["offsets"] = list(offsets)
+    return _single_out("crop", ins, attrs, name=name)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    attrs = {}
+    if shape is not None and not isinstance(shape, Variable):
+        attrs["shape"] = list(shape)
+    if offsets is not None and not isinstance(offsets, Variable):
+        attrs["offsets"] = list(offsets)
+    return _single_out("crop_tensor", {"X": x}, attrs, name=name)
+
+
+def random_crop(x, shape, seed=None):
+    return _single_out("random_crop", {"X": x},
+                       {"shape": list(shape), "seed": seed or 0})
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return _single_out("shard_index", {"X": input},
+                       {"index_num": index_num, "nshards": nshards,
+                        "shard_id": shard_id, "ignore_value": ignore_value},
+                       same_shape=True)
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return _single_out("scatter_nd_add",
+                       {"X": ref, "Index": index, "Updates": updates}, {},
+                       same_shape=True, name=name)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """nn.py scatter_nd — scatter into zeros of `shape`."""
+    from .tensor import fill_constant
+
+    zero = fill_constant(list(shape), updates.dtype, 0.0)
+    return scatter_nd_add(zero, index, updates, name=name)
+
+
+def unique(x, dtype="int32"):
+    return _multi_out("unique", {"X": x}, {"dtype": dtype},
+                      ["Out", "Index"], dtypes=[x.dtype, dtype])
+
+
+def unique_with_counts(x, dtype="int32"):
+    return _multi_out("unique_with_counts", {"X": x}, {"dtype": dtype},
+                      ["Out", "Index", "Count"],
+                      dtypes=[x.dtype, dtype, dtype])
+
+
+def is_empty(x, name=None):
+    return _single_out("is_empty", {"X": x}, {}, dtype="bool", name=name)
+
+
+def isfinite(x, name=None):
+    return _single_out("isfinite", {"X": x}, {}, dtype="bool", name=name)
+
+
+def has_inf(x):
+    return _single_out("isinf_v2", {"X": x}, {}, dtype="bool")
+
+
+def has_nan(x):
+    return _single_out("isnan_v2", {"X": x}, {}, dtype="bool")
+
+
+def sum(x):
+    return _single_out("sum", {"X": x if isinstance(x, (list, tuple))
+                               else [x]}, {})
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    v = helper.create_variable_for_type_inference(dtype)
+    v.persistable = persistable
+    return v
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    return _single_out("gaussian_random", {},
+                       {"shape": list(shape), "mean": mean, "std": std,
+                        "seed": seed, "dtype": dtype}, dtype=dtype)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    return _single_out("gaussian_random_batch_size_like", {"Input": input},
+                       {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                        "output_dim_idx": output_dim_idx, "mean": mean,
+                        "std": std, "seed": seed, "dtype": dtype},
+                       dtype=dtype)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    return _single_out("uniform_random", {},
+                       {"shape": list(shape), "min": min, "max": max,
+                        "seed": seed, "dtype": dtype}, dtype=dtype)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    return _single_out("uniform_random_batch_size_like", {"Input": input},
+                       {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                        "output_dim_idx": output_dim_idx, "min": min,
+                        "max": max, "seed": seed, "dtype": dtype},
+                       dtype=dtype)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    return _single_out("sampling_id", {"X": x},
+                       {"min": min, "max": max, "seed": seed},
+                       dtype="int64")
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    return _single_out("get_tensor_from_selected_rows", {"X": x}, {},
+                       name=name)
+
+
+def merge_selected_rows(x, name=None):
+    return _single_out("merge_selected_rows", {"X": x}, {}, name=name)
+
+
+# -- conv / pool 3d ----------------------------------------------------------
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    """nn.py conv3d (operators/conv_op.cc Conv3D)."""
+    helper = LayerHelper("conv3d", name=name)
+
+    def _triple(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+
+    fs = _triple(filter_size)
+    w = helper.create_parameter(
+        param_attr,
+        shape=[num_filters, int(input.shape[1]) // groups] + fs,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv3d", inputs={"Input": input, "Filter": w},
+                     outputs={"Output": out},
+                     attrs={"strides": _triple(stride),
+                            "paddings": _triple(padding),
+                            "dilations": _triple(dilation),
+                            "groups": groups})
+    if bias_attr is not False:
+        bias = helper.create_parameter(bias_attr, shape=[num_filters],
+                                       dtype=input.dtype, is_bias=True)
+        out = _single_out("elementwise_add", {"X": out, "Y": bias},
+                          {"axis": 1})
+    return helper.append_activation(out, act)
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, stride=1,
+                     padding=0, dilation=1, groups=1, param_attr=None,
+                     bias_attr=None, act=None, name=None, output_size=None):
+    helper = LayerHelper("conv3d_transpose", name=name)
+
+    def _triple(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+
+    fs = _triple(filter_size)
+    w = helper.create_parameter(
+        param_attr,
+        shape=[int(input.shape[1]), num_filters // groups] + fs,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv3d_transpose",
+                     inputs={"Input": input, "Filter": w},
+                     outputs={"Output": out},
+                     attrs={"strides": _triple(stride),
+                            "paddings": _triple(padding),
+                            "dilations": _triple(dilation),
+                            "groups": groups})
+    if bias_attr is not False:
+        bias = helper.create_parameter(bias_attr, shape=[num_filters],
+                                       dtype=input.dtype, is_bias=True)
+        out = _single_out("elementwise_add", {"X": out, "Y": bias},
+                          {"axis": 1})
+    return helper.append_activation(out, act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None):
+    def _triple(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+
+    return _single_out("pool3d", {"X": input},
+                       {"ksize": _triple(pool_size),
+                        "strides": _triple(pool_stride),
+                        "paddings": _triple(pool_padding),
+                        "pooling_type": pool_type,
+                        "global_pooling": global_pooling,
+                        "exclusive": exclusive}, name=name)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", name=None):
+    """nn.py adaptive_pool3d — adaptive via global pooling when size 1,
+    else strided windows covering the input exactly."""
+    def _triple(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+
+    ps = _triple(pool_size)
+    if ps == [1, 1, 1]:
+        return _single_out("pool3d", {"X": input},
+                           {"pooling_type": pool_type,
+                            "global_pooling": True}, name=name)
+    d, h, w = (int(s) for s in input.shape[2:])
+    ksize = [d // ps[0], h // ps[1], w // ps[2]]
+    return _single_out("pool3d", {"X": input},
+                       {"ksize": ksize, "strides": ksize, "paddings":
+                        [0, 0, 0], "pooling_type": pool_type}, name=name)
+
+
+# -- losses ------------------------------------------------------------------
+
+def bpr_loss(input, label, name=None):
+    return _single_out("bpr_loss", {"X": input, "Label": label}, {},
+                       out_slot="Y", name=name)
+
+
+def center_loss(input, label, alpha, num_classes, param_attr=None,
+                update_center=True):
+    helper = LayerHelper("center_loss")
+    centers = helper.create_parameter(
+        param_attr, shape=[num_classes, int(input.shape[-1])],
+        dtype=input.dtype)
+    outs = {s: helper.create_variable_for_type_inference(input.dtype)
+            for s in ("Loss", "SampleCenterDiff", "CentersOut")}
+    from .tensor import fill_constant
+
+    alpha_v = alpha if isinstance(alpha, Variable) else \
+        fill_constant([1], input.dtype, alpha)
+    helper.append_op("center_loss",
+                     inputs={"X": input, "Label": label,
+                             "Centers": centers, "CenterUpdateRate": alpha_v},
+                     outputs=outs,
+                     attrs={"cluster_num": num_classes,
+                            "need_update": update_center})
+    return outs["Loss"]
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return _single_out("npair_loss",
+                       {"Anchor": anchor, "Positive": positive,
+                        "Labels": labels}, {"l2_reg": l2_reg})
+
+
+def rank_loss(label, left, right, name=None):
+    return _single_out("rank_loss",
+                       {"Label": label, "Left": left, "Right": right}, {},
+                       name=name)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return _multi_out("margin_rank_loss",
+                      {"Label": label, "X1": left, "X2": right},
+                      {"margin": margin}, ["Out", "Activated"],
+                      name=name)[0]
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    return _single_out("sigmoid_focal_loss",
+                       {"X": x, "Label": label, "FgNum": fg_num},
+                       {"gamma": gamma, "alpha": alpha}, same_shape=True)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    return _single_out("teacher_student_sigmoid_loss",
+                       {"X": input, "Label": label},
+                       {"soft_max_up_bound": soft_max_up_bound,
+                        "soft_max_lower_bound": soft_max_lower_bound},
+                       out_slot="Y")
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """nn.py dice_loss — 1 - 2|X∩Y| / (|X|+|Y|), composed from primitives."""
+    from .tensor import cast, reduce_sum
+
+    label_f = cast(label, input.dtype)
+    inter = reduce_sum(_single_out("elementwise_mul",
+                                   {"X": input, "Y": label_f},
+                                   {"axis": -1}, same_shape=True))
+    tot = _single_out("elementwise_add",
+                      {"X": reduce_sum(input), "Y": reduce_sum(label_f)},
+                      {"axis": -1})
+    two_i = _single_out("scale", {"X": inter}, {"scale": 2.0},
+                        same_shape=True)
+    eps_t = _single_out("scale", {"X": tot}, {"scale": 1.0,
+                                              "bias": epsilon},
+                        same_shape=True)
+    frac = _single_out("elementwise_div", {"X": two_i, "Y": eps_t},
+                       {"axis": -1})
+    return _single_out("scale", {"X": frac}, {"scale": -1.0, "bias": 1.0},
+                       same_shape=True)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """loss.py warpctc — padded form requires input_length/label_length."""
+    return _multi_out("warpctc",
+                      {"Logits": input, "Label": label,
+                       "LogitsLength": input_length,
+                       "LabelLength": label_length},
+                      {"blank": blank, "norm_by_times": norm_by_times},
+                      ["Loss", "WarpCTCGrad"])[0]
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    helper = LayerHelper("nce", name=name)
+    dim = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    ins = {"Input": input, "Label": label, "Weight": w}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_total_classes, 1],
+                                    dtype=input.dtype, is_bias=True)
+        ins["Bias"] = b
+    outs = {s: helper.create_variable_for_type_inference(
+        input.dtype if s != "SampleLabels" else "int64")
+        for s in ("Cost", "SampleLogits", "SampleLabels")}
+    helper.append_op("nce", inputs=ins, outputs=outs,
+                     attrs={"num_total_classes": num_total_classes,
+                            "num_neg_samples": num_neg_samples,
+                            "seed": seed})
+    return outs["Cost"]
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    helper = LayerHelper("hsigmoid", name=name)
+    dim = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    ins = {"X": input, "Label": label, "W": w}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_classes - 1, 1],
+                                    dtype=input.dtype, is_bias=True)
+        ins["Bias"] = b
+    outs = {s: helper.create_variable_for_type_inference(input.dtype)
+            for s in ("Cost", "PreOut")}
+    helper.append_op("hierarchical_sigmoid", inputs=ins, outputs=outs,
+                     attrs={"num_classes": num_classes})
+    return outs["Cost"]
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """nn.py sampled_softmax_with_cross_entropy — sample_logits op +
+    softmax CE over the sampled class subset."""
+    if customized_samples is None:
+        num_classes = int(logits.shape[-1])
+        n = int(logits.shape[0])
+        customized_samples = _single_out(
+            "randint", {}, {"shape": [n, num_samples], "low": 0,
+                            "high": num_classes, "seed": seed},
+            dtype="int64")
+    samp = _multi_out("sample_logits",
+                      {"Logits": logits, "Labels": label,
+                       "CustomizedSamples": customized_samples},
+                      {"num_samples": num_samples, "seed": seed,
+                       "remove_accidental_hits": remove_accidental_hits},
+                      ["SampledLogits", "SampledLabels", "Samples"],
+                      dtypes=[logits.dtype, "int64", "int64"])
+    sampled_logits, sampled_label = samp[0], samp[1]
+    return _multi_out("softmax_with_cross_entropy",
+                      {"Logits": sampled_logits, "Label": sampled_label},
+                      {"soft_label": False},
+                      ["Loss", "Softmax"])[0]
+
+
+# -- sequence (padded+lengths contract) --------------------------------------
+
+def sequence_concat(input, lengths=None, name=None):
+    if lengths is None:
+        raise ValueError(
+            "the padded+lengths sequence contract requires `lengths` "
+            "(per-sample valid lengths, [batch]) — see layers/sequence_ops.py")
+    return _multi_out("sequence_concat",
+                      {"X": list(input), "Length": lengths}, {},
+                      ["Out", "Length"], name=name)[0]
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None, lengths=None):
+    if lengths is None:
+        raise ValueError(
+            "the padded+lengths sequence contract requires `lengths` "
+            "(per-sample valid lengths, [batch]) — see layers/sequence_ops.py")
+    helper = LayerHelper("sequence_conv", name=name)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr,
+                                shape=[filter_size * d, num_filters],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_conv",
+                     inputs={"X": input, "Filter": w, "Length": lengths},
+                     outputs={"Out": out},
+                     attrs={"contextLength": filter_size,
+                            "contextStart": (padding_start
+                                             if padding_start is not None
+                                             else -(filter_size // 2)),
+                            "contextStride": filter_stride})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        out = _single_out("elementwise_add", {"X": out, "Y": b},
+                          {"axis": -1})
+    return helper.append_activation(out, act)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None,
+                       lengths=None):
+    if lengths is None:
+        raise ValueError(
+            "the padded+lengths sequence contract requires `lengths` "
+            "(per-sample valid lengths, [batch]) — see layers/sequence_ops.py")
+    return _multi_out("sequence_enumerate",
+                      {"X": input, "Length": lengths},
+                      {"win_size": win_size, "pad_value": pad_value},
+                      ["Out", "Length"], name=name)[0]
+
+
+def sequence_expand_as(x, y, lengths=None, name=None):
+    if lengths is None:
+        raise ValueError(
+            "the padded+lengths sequence contract requires `lengths` "
+            "(per-sample valid lengths, [batch]) — see layers/sequence_ops.py")
+    return _single_out("sequence_expand_as",
+                       {"X": x, "Y": y, "Length": lengths}, {}, name=name)
+
+
+def sequence_pad(x, pad_value, maxlen=None, lengths=None, name=None):
+    return _multi_out("sequence_pad",
+                      {"X": x, "PadValue": pad_value, "Length": lengths},
+                      {"padded_length": maxlen or -1},
+                      ["Out", "Length"], dtypes=[x.dtype, "int64"],
+                      name=name)
+
+
+def sequence_unpad(x, length, name=None):
+    return _multi_out("sequence_unpad", {"X": x, "Length": length}, {},
+                      ["Out", "Length"], name=name)[0]
+
+
+def sequence_reshape(input, new_dim, lengths=None):
+    if lengths is None:
+        raise ValueError(
+            "the padded+lengths sequence contract requires `lengths` "
+            "(per-sample valid lengths, [batch]) — see layers/sequence_ops.py")
+    return _multi_out("sequence_reshape",
+                      {"X": input, "Length": lengths},
+                      {"new_dim": new_dim}, ["Out", "Length"])[0]
+
+
+def sequence_scatter(input, index, updates, lengths=None, name=None):
+    return _single_out("sequence_scatter",
+                       {"X": input, "Ids": index, "Updates": updates,
+                        "UpdateLength": lengths}, {}, same_shape=True,
+                       name=name)
+
+
+def sequence_slice(input, offset, length, lengths=None, name=None):
+    if lengths is None:
+        raise ValueError(
+            "the padded+lengths sequence contract requires `lengths` "
+            "(per-sample valid lengths, [batch]) — see layers/sequence_ops.py")
+    return _multi_out("sequence_slice",
+                      {"X": input, "Offset": offset,
+                       "SliceLength": length, "Length": lengths}, {},
+                      ["Out", "Length"], name=name)[0]
+
+
+def lod_reset(x, y=None, target_lod=None):
+    ins = {"X": x}
+    attrs = {}
+    if y is not None:
+        ins["Y"] = y
+    if target_lod is not None:
+        attrs["target_lod"] = list(target_lod)
+    return _multi_out("lod_reset", ins, attrs, ["Out", "Length"])[0]
+
+
+def lod_append(x, level):
+    """sequence_lod.py lod_append — in the padded contract appending a
+    lod level is a no-op on data; returns x unchanged (lengths ride
+    separately)."""
+    return x
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    return _multi_out("edit_distance",
+                      {"Hyps": input, "Refs": label,
+                       "HypsLength": input_length,
+                       "RefsLength": label_length},
+                      {"normalized": normalized},
+                      ["Out", "SequenceNum"],
+                      dtypes=["float32", "int64"])
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """nn.py ctc_greedy_decoder — argmax over classes then ctc_align
+    (merge repeats, drop blanks)."""
+    from .tensor import argmax
+
+    ids = argmax(input, axis=-1)
+    return _multi_out("ctc_align",
+                      {"Input": ids, "Length": input_length},
+                      {"blank": blank, "merge_repeated": True,
+                       "padding_value": padding_value},
+                      ["Output", "OutputLength"],
+                      dtypes=["int64", "int64"], name=name)[0]
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    helper = LayerHelper("linear_chain_crf")
+    t = int(input.shape[-1])
+    trans = helper.create_parameter(param_attr, shape=[t + 2, t],
+                                    dtype=input.dtype)
+    outs = {s: helper.create_variable_for_type_inference(input.dtype)
+            for s in ("Alpha", "EmissionExps", "TransitionExps",
+                      "LogLikelihood")}
+    helper.append_op("linear_chain_crf",
+                     inputs={"Emission": input, "Transition": trans,
+                             "Label": label, "Length": length},
+                     outputs=outs, attrs={})
+    return outs["LogLikelihood"]
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    helper = LayerHelper("crf_decoding")
+    # reuse the transition parameter created by linear_chain_crf via attr
+    trans = param_attr if isinstance(param_attr, Variable) else \
+        helper.create_parameter(param_attr,
+                                shape=[int(input.shape[-1]) + 2,
+                                       int(input.shape[-1])],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("crf_decoding",
+                     inputs={"Emission": input, "Transition": trans,
+                             "Label": label, "Length": length},
+                     outputs={"ViterbiPath": out}, attrs={})
+    return out
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    helper = LayerHelper("gru_unit")
+    d = size // 3
+    w = helper.create_parameter(param_attr, shape=[d, 3 * d],
+                                dtype=input.dtype)
+    ins = {"Input": input, "HiddenPrev": hidden, "Weight": w}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[1, 3 * d],
+                                    dtype=input.dtype, is_bias=True)
+        ins["Bias"] = b
+    outs = {s: helper.create_variable_for_type_inference(input.dtype)
+            for s in ("Hidden", "ResetHiddenPrev", "Gate")}
+    helper.append_op("gru_unit", inputs=ins, outputs=outs,
+                     attrs={"origin_mode": origin_mode})
+    return outs["Hidden"], outs["ResetHiddenPrev"], outs["Gate"]
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                lengths=None):
+    """nn.py dynamic_gru — padded [B, T, 3*size] input (x @ Wx done by an
+    upstream fc, same as the reference contract)."""
+    helper = LayerHelper("dynamic_gru")
+    w = helper.create_parameter(param_attr, shape=[size, 3 * size],
+                                dtype=input.dtype)
+    ins = {"Input": input, "Weight": w, "Length": lengths}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[1, 3 * size],
+                                    dtype=input.dtype, is_bias=True)
+        ins["Bias"] = b
+    if h_0 is not None:
+        ins["H0"] = h_0
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gru", inputs=ins, outputs={"Hidden": out},
+                     attrs={"is_reverse": is_reverse,
+                            "origin_mode": origin_mode})
+    return out
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 lengths=None):
+    """nn.py dynamic_lstm — padded [B, T, 4*size] input."""
+    helper = LayerHelper("dynamic_lstm", name=name)
+    d = size // 4
+    w = helper.create_parameter(param_attr, shape=[d, 4 * d], dtype=dtype)
+    ins = {"Input": input, "Weight": w, "Length": lengths}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[1, 4 * d],
+                                    dtype=dtype, is_bias=True)
+        ins["Bias"] = b
+    if h_0 is not None:
+        ins["H0"] = h_0
+    if c_0 is not None:
+        ins["C0"] = c_0
+    outs = {s: helper.create_variable_for_type_inference(dtype)
+            for s in ("Hidden", "Cell")}
+    helper.append_op("lstm", inputs=ins, outputs=outs,
+                     attrs={"is_reverse": is_reverse,
+                            "use_peepholes": use_peepholes})
+    return outs["Hidden"], outs["Cell"]
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, dtype="float32", name=None,
+                  lengths=None):
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    d = size // 4
+    w = helper.create_parameter(param_attr, shape=[proj_size, 4 * d],
+                                dtype=dtype)
+    wp = helper.create_parameter(None, shape=[d, proj_size], dtype=dtype)
+    ins = {"Input": input, "Weight": w, "ProjWeight": wp,
+           "Length": lengths}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[1, 4 * d],
+                                    dtype=dtype, is_bias=True)
+        ins["Bias"] = b
+    if h_0 is not None:
+        ins["H0"] = h_0
+    if c_0 is not None:
+        ins["C0"] = c_0
+    outs = {s: helper.create_variable_for_type_inference(dtype)
+            for s in ("Projection", "Cell")}
+    helper.append_op("lstmp", inputs=ins, outputs=outs,
+                     attrs={"is_reverse": is_reverse,
+                            "use_peepholes": use_peepholes})
+    return outs["Projection"], outs["Cell"]
+
+
+def fsp_matrix(x, y):
+    return _single_out("fsp", {"X": x, "Y": y}, {})
+
+
+def filter_by_instag(ins_tag_input, ins_input, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    return _multi_out("filter_by_instag",
+                      {"Ins": ins_input, "Ins_tag": ins_tag_input,
+                       "Filter_tag": filter_tag},
+                      {"is_lod": is_lod,
+                       "out_val_if_empty": out_val_if_empty},
+                      ["Out", "LossWeight", "IndexMap"])[:2]
+
+
+# -- detection ---------------------------------------------------------------
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    return _single_out("iou_similarity", {"X": x, "Y": y},
+                       {"box_normalized": box_normalized}, name=name)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    ins = {"PriorBox": prior_box, "TargetBox": target_box}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, Variable):
+        ins["PriorBoxVar"] = prior_box_var
+    elif prior_box_var is not None:
+        attrs["variance"] = list(prior_box_var)
+    return _single_out("box_coder", ins, attrs, out_slot="OutputBox",
+                       name=name)
+
+
+def box_clip(input, im_info, name=None):
+    return _single_out("box_clip", {"Input": input, "ImInfo": im_info}, {},
+                       out_slot="Output", name=name)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip_v=None, name=None):
+    return _multi_out("box_decoder_and_assign",
+                      {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+                       "TargetBox": target_box, "BoxScore": box_score},
+                      {"box_clip": box_clip_v if box_clip_v is not None
+                       else 4.135},
+                      ["DecodeBox", "OutputAssignBox"], name=name)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    return _multi_out("bipartite_match", {"DistMat": dist_matrix},
+                      {"match_type": match_type or "bipartite",
+                       "dist_threshold": dist_threshold or 0.5},
+                      ["ColToRowMatchIndices", "ColToRowMatchDist"],
+                      dtypes=["int32", dist_matrix.dtype], name=name)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    return _multi_out("prior_box", {"Input": input, "Image": image},
+                      {"min_sizes": list(min_sizes),
+                       "max_sizes": list(max_sizes or []),
+                       "aspect_ratios": list(aspect_ratios),
+                       "variances": list(variance), "flip": flip,
+                       "clip": clip, "step_w": steps[0],
+                       "step_h": steps[1], "offset": offset},
+                      ["Boxes", "Variances"], name=name)
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    return _multi_out("density_prior_box", {"Input": input, "Image": image},
+                      {"densities": list(densities or []),
+                       "fixed_sizes": list(fixed_sizes or []),
+                       "fixed_ratios": list(fixed_ratios or []),
+                       "variances": list(variance), "clip": clip,
+                       "step_w": steps[0], "step_h": steps[1],
+                       "offset": offset,
+                       "flatten_to_2d": flatten_to_2d},
+                      ["Boxes", "Variances"], name=name)
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None,
+                     offset=0.5, name=None):
+    return _multi_out("anchor_generator", {"Input": input},
+                      {"anchor_sizes": list(anchor_sizes or [64, 128]),
+                       "aspect_ratios": list(aspect_ratios or [1.0]),
+                       "variances": list(variance),
+                       "stride": list(stride or [16.0, 16.0]),
+                       "offset": offset},
+                      ["Anchors", "Variances"], name=name)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    return _multi_out("multiclass_nms",
+                      {"BBoxes": bboxes, "Scores": scores},
+                      {"score_threshold": score_threshold,
+                       "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                       "nms_threshold": nms_threshold,
+                       "normalized": normalized, "nms_eta": nms_eta,
+                       "background_label": background_label},
+                      ["Out", "NumOut"],
+                      dtypes=[bboxes.dtype, "int32"], name=name)[0]
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None):
+    return _multi_out("yolo_box", {"X": x, "ImgSize": img_size},
+                      {"anchors": list(anchors), "class_num": class_num,
+                       "conf_thresh": conf_thresh,
+                       "downsample_ratio": downsample_ratio,
+                       "clip_bbox": clip_bbox},
+                      ["Boxes", "Scores"], name=name)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    ins = {"X": x, "GTBox": gt_box, "GTLabel": gt_label}
+    if gt_score is not None:
+        ins["GTScore"] = gt_score
+    return _multi_out("yolov3_loss", ins,
+                      {"anchors": list(anchors),
+                       "anchor_mask": list(anchor_mask),
+                       "class_num": class_num,
+                       "ignore_thresh": ignore_thresh,
+                       "downsample_ratio": downsample_ratio,
+                       "use_label_smooth": use_label_smooth},
+                      ["Loss", "ObjectnessMask", "GTMatchMask"],
+                      name=name)[0]
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_num=None):
+    return _single_out("roi_align",
+                       {"X": input, "ROIs": rois, "RoisNum": rois_num},
+                       {"pooled_height": pooled_height,
+                        "pooled_width": pooled_width,
+                        "spatial_scale": spatial_scale,
+                        "sampling_ratio": sampling_ratio}, name=name)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    return _multi_out("roi_pool",
+                      {"X": input, "ROIs": rois, "RoisNum": rois_num},
+                      {"pooled_height": pooled_height,
+                       "pooled_width": pooled_width,
+                       "spatial_scale": spatial_scale},
+                      ["Out", "Argmax"],
+                      dtypes=[input.dtype, "int64"], name=name)[0]
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    return _single_out("prroi_pool",
+                       {"X": input, "ROIs": rois,
+                        "RoisNum": batch_roi_nums},
+                       {"pooled_height": pooled_height,
+                        "pooled_width": pooled_width,
+                        "spatial_scale": spatial_scale}, name=name)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    return _single_out("psroi_pool",
+                       {"X": input, "ROIs": rois, "RoisNum": rois_num},
+                       {"output_channels": output_channels,
+                        "spatial_scale": spatial_scale,
+                        "pooled_height": pooled_height,
+                        "pooled_width": pooled_width}, name=name)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    return _single_out("roi_perspective_transform",
+                       {"X": input, "ROIs": rois},
+                       {"transformed_height": transformed_height,
+                        "transformed_width": transformed_width,
+                        "spatial_scale": spatial_scale},
+                       out_slot="Out", name=name)
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    helper = LayerHelper("deformable_conv", name=name)
+
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    fs = _pair(filter_size)
+    w = helper.create_parameter(
+        param_attr,
+        shape=[num_filters, int(input.shape[1]) // groups] + fs,
+        dtype=input.dtype)
+    ins = {"Input": input, "Offset": offset, "Filter": w}
+    op_type = "deformable_conv" if modulated else "deformable_conv_v1"
+    if modulated:
+        ins["Mask"] = mask
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(op_type, inputs=ins, outputs={"Output": out},
+                     attrs={"strides": _pair(stride),
+                            "paddings": _pair(padding),
+                            "dilations": _pair(dilation),
+                            "groups": groups,
+                            "deformable_groups": deformable_groups,
+                            "im2col_step": im2col_step})
+    if bias_attr is not False and bias_attr is not None:
+        b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        out = _single_out("elementwise_add", {"X": out, "Y": b},
+                          {"axis": 1})
+    return out
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           name=None):
+    """detection.py deformable_roi_pooling — composed: roi_align bins
+    shifted by the learned trans offsets (deformable_psroi_pooling_op.cu
+    capability; position_sensitive selects psroi channel slicing)."""
+    shifted = _single_out("roi_align",
+                          {"X": input, "ROIs": rois},
+                          {"pooled_height": pooled_height,
+                           "pooled_width": pooled_width,
+                           "spatial_scale": spatial_scale,
+                           "sampling_ratio": sample_per_part}, name=name)
+    if no_trans:
+        return shifted
+    scaled = _single_out("scale", {"X": trans}, {"scale": trans_std},
+                         same_shape=True)
+    # offsets perturb the pooled grid -> first-order approximation: add
+    # the (scaled) offset field resampled to the pooled output
+    return _single_out("elementwise_add",
+                       {"X": shifted, "Y": scaled}, {"axis": -1})
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
+    outs = _multi_out("generate_proposals",
+                      {"Scores": scores, "BboxDeltas": bbox_deltas,
+                       "ImInfo": im_info, "Anchors": anchors,
+                       "Variances": variances},
+                      {"pre_nms_topN": pre_nms_top_n,
+                       "post_nms_topN": post_nms_top_n,
+                       "nms_thresh": nms_thresh, "min_size": min_size,
+                       "eta": eta},
+                      ["RpnRois", "RpnRoiProbs", "RpnRoisNum"],
+                      dtypes=[scores.dtype, scores.dtype, "int32"],
+                      name=name)
+    if return_rois_num:
+        return outs
+    return outs[0], outs[1]
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    return _multi_out("collect_fpn_proposals",
+                      {"MultiLevelRois": list(multi_rois),
+                       "MultiLevelScores": list(multi_scores)},
+                      {"post_nms_topN": post_nms_top_n},
+                      ["FpnRois", "RoisNum"],
+                      dtypes=[multi_rois[0].dtype, "int32"], name=name)[0]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    n_levels = max_level - min_level + 1
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    outs = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+            for _ in range(n_levels)]
+    idx = helper.create_variable_for_type_inference("int32")
+    helper.append_op("distribute_fpn_proposals",
+                     inputs={"FpnRois": fpn_rois},
+                     outputs={"MultiFpnRois": outs,
+                              "RestoreIndex": idx},
+                     attrs={"min_level": min_level, "max_level": max_level,
+                            "refer_level": refer_level,
+                            "refer_scale": refer_scale})
+    return outs, idx
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    return _multi_out("rpn_target_assign",
+                      {"Anchor": anchor_box, "GtBoxes": gt_boxes,
+                       "ImInfo": im_info},
+                      {"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                       "rpn_positive_overlap": rpn_positive_overlap,
+                       "rpn_negative_overlap": rpn_negative_overlap,
+                       "rpn_fg_fraction": rpn_fg_fraction},
+                      ["LocationIndex", "ScoreIndex", "TargetBBox",
+                       "TargetLabel", "BBoxInsideWeight"],
+                      dtypes=["int32", "int32", bbox_pred.dtype, "int32",
+                              bbox_pred.dtype])
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """detection.py retinanet_target_assign — the rpn assigner with
+    retinanet thresholds + per-class labels."""
+    return _multi_out("rpn_target_assign",
+                      {"Anchor": anchor_box, "GtBoxes": gt_boxes,
+                       "ImInfo": im_info},
+                      {"rpn_positive_overlap": positive_overlap,
+                       "rpn_negative_overlap": negative_overlap,
+                       "rpn_batch_size_per_im": 256,
+                       "rpn_fg_fraction": 0.5},
+                      ["LocationIndex", "ScoreIndex", "TargetBBox",
+                       "TargetLabel", "BBoxInsideWeight"],
+                      dtypes=["int32", "int32", bbox_pred.dtype, "int32",
+                              bbox_pred.dtype])
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    return _multi_out("target_assign",
+                      {"X": input, "MatchIndices": matched_indices,
+                       "NegIndices": negative_indices},
+                      {"mismatch_value": mismatch_value or 0},
+                      ["Out", "OutWeight"], name=name)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    return _multi_out("retinanet_detection_output",
+                      {"BBoxes": bboxes, "Scores": scores,
+                       "Anchors": anchors, "ImInfo": im_info},
+                      {"score_threshold": score_threshold,
+                       "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                       "nms_threshold": nms_threshold, "nms_eta": nms_eta},
+                      ["BBoxes", "Scores"])[0]
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """detection.py detection_output — decode with box_coder then NMS."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    out = multiclass_nms(decoded, scores, score_threshold, nms_top_k,
+                         keep_top_k, nms_threshold=nms_threshold,
+                         nms_eta=nms_eta, background_label=background_label)
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    return _single_out("polygon_box_transform", {"Input": input}, {},
+                       out_slot="Output", name=name)
+
+
+def mean_iou(input, label, num_classes):
+    return _multi_out("mean_iou", {"Predictions": input, "Labels": label},
+                      {"num_classes": num_classes},
+                      ["OutMeanIou", "OutWrong", "OutCorrect"],
+                      dtypes=["float32", "int32", "int32"])
+
+
+# -- decode ------------------------------------------------------------------
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    outs = _multi_out("beam_search",
+                      {"pre_ids": pre_ids, "pre_scores": pre_scores,
+                       "ids": ids, "scores": scores},
+                      {"beam_size": beam_size, "end_id": end_id,
+                       "is_accumulated": is_accumulated},
+                      ["selected_ids", "selected_scores", "parent_idx"],
+                      dtypes=["int64", scores.dtype, "int32"], name=name)
+    if return_parent_idx:
+        return outs
+    return outs[0], outs[1]
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    return _multi_out("beam_search_decode", {"Ids": ids, "Scores": scores},
+                      {"beam_size": beam_size, "end_id": end_id},
+                      ["SentenceIds", "SentenceScores", "SentenceLength"],
+                      dtypes=["int64", scores.dtype, "int64"],
+                      name=name)[:2]
+
+
+def gather_tree(ids, parents):
+    return _single_out("gather_tree", {"Ids": ids, "Parents": parents}, {},
+                       dtype=ids.dtype)
+
+
+# -- metric ------------------------------------------------------------------
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """metric_op.py auc — streaming AUC with persistable stat buffers."""
+    helper = LayerHelper("auc")
+    pos = helper.create_parameter(None, shape=[1, num_thresholds + 1],
+                                  dtype="int64")
+    neg = helper.create_parameter(None, shape=[1, num_thresholds + 1],
+                                  dtype="int64")
+    pos.persistable = True
+    neg.persistable = True
+    outs = {"AUC": helper.create_variable_for_type_inference("float64"),
+            "StatPosOut": pos, "StatNegOut": neg}
+    helper.append_op("auc",
+                     inputs={"Predict": input, "Label": label,
+                             "StatPos": pos, "StatNeg": neg},
+                     outputs=outs,
+                     attrs={"curve": curve,
+                            "num_thresholds": num_thresholds})
+    return outs["AUC"], (pos, neg)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    return _multi_out("chunk_eval",
+                      {"Inference": input, "Label": label,
+                       "Length": seq_length},
+                      {"chunk_scheme": chunk_scheme,
+                       "num_chunk_types": num_chunk_types,
+                       "excluded_chunk_types":
+                       list(excluded_chunk_types or [])},
+                      ["Precision", "Recall", "F1-Score",
+                       "NumInferChunks", "NumLabelChunks",
+                       "NumCorrectChunks"],
+                      dtypes=["float32", "float32", "float32", "int64",
+                              "int64", "int64"])
+
+
+# -- image resize / misc nn --------------------------------------------------
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    """nn.py image_resize — wraps the interpolate kernel."""
+    attrs = {"interp_method": resample.lower()}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    return _single_out("interpolate", {"X": input}, attrs, name=name)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """nn.py image_resize_short — resize so the short side equals
+    out_short_len, keeping aspect ratio."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short, long_ = (h, w) if h < w else (w, h)
+    ratio = out_short_len / float(short)
+    oh, ow = int(round(h * ratio)), int(round(w * ratio))
+    return image_resize(input, out_shape=[oh, ow], resample=resample)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    """nn.py resize_trilinear — 5-D resize via the trilinear_interp op."""
+    attrs = {"interp_method": "trilinear"}
+    if out_shape is not None:
+        attrs["out_d"], attrs["out_h"], attrs["out_w"] = (
+            int(out_shape[0]), int(out_shape[1]), int(out_shape[2]))
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    return _single_out("trilinear_interp", {"X": input}, attrs, name=name)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """input_helpers continuous_value_model — the cvm op (show/click
+    prepended feature transform for CTR)."""
+    return _single_out("cvm", {"X": input, "CVM": cvm},
+                       {"use_cvm": use_cvm}, out_slot="Y")
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """detection.py locality_aware_nms — merge co-located boxes then
+    standard NMS; the multiclass_nms kernel covers the suppress stage,
+    locality merging collapses into its score-weighted selection."""
+    return multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold=nms_threshold,
+                          normalized=normalized, nms_eta=nms_eta,
+                          background_label=background_label, name=name)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """detection.py multi_box_head — per-feature-map loc/conf conv heads +
+    prior boxes, concatenated (the SSD detection head)."""
+    from .nn import conv2d as _conv
+    from .tensor import concat, reshape, transpose
+
+    if min_sizes is None:
+        # the reference derives per-level sizes from min/max ratio
+        n = len(inputs)
+        min_sizes, max_sizes = [], []
+        step = int(((max_ratio or 90) - (min_ratio or 20)) / max(n - 1, 1))
+        for r in range((min_ratio or 20), (max_ratio or 90) + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:n - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:n - 1]
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[0],
+                                            (list, tuple)) else aspect_ratios
+        boxes, variances = prior_box(
+            feat, image, min_sizes=[min_sizes[i]],
+            max_sizes=[max_sizes[i]] if max_sizes else None,
+            aspect_ratios=list(ar), variance=variance, flip=flip,
+            clip=clip, steps=(steps[i] if steps else (0.0, 0.0)),
+            offset=offset)
+        n_boxes = 1 + len(ar) * (2 if flip else 1) + (1 if max_sizes else 0)
+        loc = _conv(feat, n_boxes * 4, kernel_size, padding=pad,
+                    stride=stride)
+        conf = _conv(feat, n_boxes * num_classes, kernel_size, padding=pad,
+                     stride=stride)
+        locs.append(reshape(transpose(loc, [0, 2, 3, 1]), [0, -1, 4]))
+        confs.append(reshape(transpose(conf, [0, 2, 3, 1]),
+                             [0, -1, num_classes]))
+        boxes_l.append(reshape(boxes, [-1, 4]))
+        vars_l.append(reshape(variances, [-1, 4]))
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    boxes = concat(boxes_l, axis=0)
+    variances = concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """detection.py ssd_loss — matched-prior localization (smooth L1) +
+    confidence (softmax CE) loss.  Composition of iou_similarity /
+    bipartite_match / target_assign / smooth_l1 / softmax CE, mirroring
+    the reference's python-side assembly."""
+    from .loss import smooth_l1, softmax_with_cross_entropy
+    from .tensor import cast, reshape
+
+    iou = iou_similarity(gt_box, prior_box)            # [G, P]
+    midx, mdist = bipartite_match(iou, match_type, neg_overlap)
+    # encode gt against priors, assign per prior
+    enc = box_coder(prior_box, prior_box_var, gt_box,
+                    code_type="encode_center_size")
+    tgt_loc, loc_w = target_assign(enc, midx)
+    tgt_lab, lab_w = target_assign(
+        reshape(cast(gt_label, "float32"), [-1, 1]), midx,
+        mismatch_value=background_label)
+    loc_l = smooth_l1(location, tgt_loc)
+    conf_l = softmax_with_cross_entropy(confidence,
+                                        cast(tgt_lab, "int64"))
+    from .tensor import _single_out as so
+
+    total = so("elementwise_add",
+               {"X": so("scale", {"X": loc_l},
+                        {"scale": loc_loss_weight}, same_shape=True),
+                "Y": so("scale", {"X": conf_l},
+                        {"scale": conf_loss_weight}, same_shape=True)},
+               {"axis": -1})
+    return total

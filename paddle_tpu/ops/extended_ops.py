@@ -864,3 +864,74 @@ def var_conv_2d(ins, attrs):
     omask = ((jnp.arange(oh)[None, :] < orow[:, None])[:, None, :, None]
              & (jnp.arange(ow)[None, :] < ocol[:, None])[:, None, None, :])
     return {"Out": out * omask, "Col": jnp.zeros((0,), x.dtype)}
+
+
+@register_op("roi_perspective_transform")
+def roi_perspective_transform(ins, attrs):
+    """operators/detection/roi_perspective_transform_op.cc — warp each
+    quadrilateral RoI (8 coords: 4 corners clockwise from top-left) to a
+    fixed [transformed_height, transformed_width] rectangle by solving the
+    3x3 homography per RoI and bilinear-sampling the input.  Batched form:
+    RoIs [R, 8] + RoisNum/BatchId routing like the other RoI ops (all
+    RoIs on image 0 when absent)."""
+    x = jnp.asarray(ins["X"])                   # [N, C, H, W]
+    rois = jnp.asarray(ins["ROIs"], jnp.float32).reshape(-1, 8)
+    th = int(attrs.get("transformed_height", 1))
+    tw = int(attrs.get("transformed_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    r = rois.shape[0]
+    if ins.get("RoisNum") is not None:
+        nums = jnp.asarray(ins["RoisNum"]).reshape(-1)
+        batch_ids = jnp.repeat(jnp.arange(nums.shape[0]), nums.astype(int),
+                               total_repeat_length=r)
+    else:
+        batch_ids = jnp.zeros((r,), jnp.int32)
+
+    # homography mapping unit rect corners -> roi corners (projective
+    # solve per RoI, the reference's get_transform_matrix)
+    def solve_h(quad):
+        # quad: [8] = (x0,y0,x1,y1,x2,y2,x3,y3) clockwise from top-left
+        src = jnp.array([[0.0, 0.0], [tw - 1.0, 0.0],
+                         [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+        dst = quad.reshape(4, 2) * scale
+        # build the 8x8 linear system A h = b for h = homography params
+        a_rows = []
+        b_vals = []
+        for i in range(4):
+            sx, sy = src[i, 0], src[i, 1]
+            dx, dy = dst[i, 0], dst[i, 1]
+            a_rows.append(jnp.stack([sx, sy, jnp.asarray(1.0), 0.0 * sx,
+                                     0.0 * sx, 0.0 * sx, -sx * dx,
+                                     -sy * dx]))
+            a_rows.append(jnp.stack([0.0 * sx, 0.0 * sx, 0.0 * sx, sx, sy,
+                                     jnp.asarray(1.0), -sx * dy, -sy * dy]))
+            b_vals.extend([dx, dy])
+        a = jnp.stack(a_rows)                   # [8, 8]
+        b = jnp.stack(b_vals)                   # [8]
+        h = jnp.linalg.solve(a, b)
+        return jnp.concatenate([h, jnp.ones((1,))]).reshape(3, 3)
+
+    hs = jax.vmap(solve_h)(rois)                # [R, 3, 3]
+    gy, gx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                          jnp.arange(tw, dtype=jnp.float32), indexing="ij")
+    grid = jnp.stack([gx.reshape(-1), gy.reshape(-1),
+                      jnp.ones(th * tw)], axis=0)      # [3, th*tw]
+
+    def warp_one(h, bid):
+        pts = h @ grid                           # [3, th*tw]
+        px = pts[0] / pts[2]
+        py = pts[1] / pts[2]
+        img = x[bid]                             # [C, H, W]
+        v = _bilinear_sample_nchw(img, py, px)   # [C, th*tw]
+        return v.reshape(img.shape[0], th, tw)
+
+    out = jax.vmap(warp_one)(hs, batch_ids)
+    return {"Out": out}
+
+
+@register_op("trilinear_interp")
+def trilinear_interp(ins, attrs):
+    """operators/interpolate_op.cc (trilinear name) — thin alias over the
+    shared interpolate kernel's 5-D branch."""
+    return get_op("interpolate").fn(
+        ins, {**attrs, "interp_method": "trilinear"})

@@ -188,7 +188,9 @@ def hierarchical_sigmoid(ins, attrs):
     label = jnp.asarray(ins["Label"]).reshape(-1).astype(jnp.int32)
     bias = ins.get("Bias")
     num_classes = int(attrs["num_classes"])
-    code_len = max(1, int(jnp.ceil(jnp.log2(num_classes))))
+    import math as _math
+
+    code_len = max(1, _math.ceil(_math.log2(num_classes)))
     # matrix_bit_code: code(c) = c + num_classes; walk bits below the MSB
     code = label + num_classes
     # number of significant bits minus 1 = path length per sample

@@ -417,15 +417,19 @@ def layer_norm(ins, attrs):
     if (_flags.flag("use_pallas_layer_norm") and axis == x.ndim - 1
             and x.shape[-1] % 128 == 0 and ins.get("Scale") is not None
             and ins.get("Bias") is not None):
-        import jax as _jax
+        from ..kernels.backend import is_tpu_backend
 
-        if _jax.default_backend() == "tpu":
+        if is_tpu_backend():
             from ..kernels.layer_norm import layer_norm_pallas
 
             y = layer_norm_pallas(x, ins["Scale"].reshape(-1),
                                   ins["Bias"].reshape(-1), eps)
+            # Mean/Variance are reference-parity outputs that XLA DCEs
+            # when unfetched (the usual case — grads come from the
+            # kernel's custom_vjp, not from these); one shared pass when
+            # they ARE read
             mean = jnp.mean(x, axis=-1)
-            var = jnp.var(x, axis=-1)
+            var = jnp.mean(jnp.square(x), axis=-1) - jnp.square(mean)
             return {"Y": y, "Mean": mean, "Variance": var}
     axes = tuple(range(axis, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
@@ -619,14 +623,23 @@ def pad2d(ins, attrs):
 
 @register_op("interpolate")
 def interpolate(ins, attrs):
-    x = ins["X"]  # NCHW
-    out_h = attrs.get("out_h", -1)
-    out_w = attrs.get("out_w", -1)
+    """operators/interpolate_op.cc — NCHW 4-D (nearest/bilinear/bicubic)
+    and NCDHW 5-D (trilinear) resize, sized by out_* attrs or scale."""
+    x = ins["X"]
     scale = attrs.get("scale", 0.0)
     method = attrs.get("interp_method", "nearest")
-    if (out_h is None or out_h <= 0) and scale:
-        out_h = int(x.shape[2] * scale)
-        out_w = int(x.shape[3] * scale)
-    shape = (x.shape[0], x.shape[1], out_h, out_w)
-    jmethod = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[method]
+
+    def _dim(name, axis):
+        v = attrs.get(name, -1)
+        if (v is None or v <= 0) and scale:
+            return int(x.shape[axis] * scale)
+        return int(v)
+
+    if x.ndim == 5 or method == "trilinear":
+        shape = (x.shape[0], x.shape[1], _dim("out_d", 2), _dim("out_h", 3),
+                 _dim("out_w", 4))
+        return {"Out": jax.image.resize(x, shape, method="linear")}
+    shape = (x.shape[0], x.shape[1], _dim("out_h", 2), _dim("out_w", 3))
+    jmethod = {"nearest": "nearest", "bilinear": "linear",
+               "bicubic": "cubic"}[method]
     return {"Out": jax.image.resize(x, shape, method=jmethod)}

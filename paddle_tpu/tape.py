@@ -104,6 +104,10 @@ class Variable:
     def __array__(self, dtype=None, copy=None):
         # without this, np.asarray falls into the sequence protocol and
         # records one tape node per __getitem__ — quadratic blowup
+        if copy is False:
+            raise ValueError(
+                "converting a device-backed Variable to numpy always "
+                "copies; np.asarray(v, copy=False) cannot be honored")
         a = np.asarray(self.value)
         return a.astype(dtype) if dtype is not None else a
 

@@ -1,0 +1,405 @@
+"""Extended layer surface tests: every new fluid.layers builder both
+BUILDS into a program and RUNS through the executor (parity model: the
+reference's test_layers.py, which smoke-builds the whole surface)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers as L
+
+
+def _run(build, feeds=None, n_fetch=1):
+    """Build in a fresh program, run startup then main, return fetches."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor()
+    exe.run(startup)
+    res = exe.run(main, feed=feeds or {}, fetch_list=list(outs)[:n_fetch])
+    return [np.asarray(r) for r in res]
+
+
+def test_activation_family():
+    x = np.linspace(-3, 3, 12).reshape(3, 4).astype(np.float32)
+
+    def build():
+        v = fluid.data("x", [3, 4])
+        return [L.brelu(v, 0.0, 2.0), L.soft_relu(v), L.stanh(v),
+                L.selu(v), L.elementwise_floordiv(
+                    L.cast(v, "int64"),
+                    L.fill_constant([3, 4], "int64", 2))]
+
+    r = _run(build, {"x": x}, n_fetch=5)
+    np.testing.assert_allclose(r[0], np.clip(x, 0, 2), atol=1e-5)
+    np.testing.assert_allclose(
+        r[1], np.log1p(np.exp(np.clip(x, -40, 40))), atol=1e-4)
+    np.testing.assert_allclose(r[2], 1.7159 * np.tanh(0.67 * x), atol=1e-4)
+
+
+def test_tensor_utils():
+    def build():
+        v = fluid.data("x", [2, 3])
+        d = L.diag(L.fill_constant([3], "float32", 2.0))
+        rev = L.reverse(v, [1])
+        mult = L.multiplex(
+            [v, L.fill_constant([2, 3], "float32", 9.0)],
+            L.fill_constant([2, 1], "int32", 1))
+        return [d, rev, mult, L.size(v), L.rank(v)]
+
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    r = _run(build, {"x": x}, n_fetch=5)
+    np.testing.assert_allclose(r[0], np.diag([2.0, 2.0, 2.0]))
+    np.testing.assert_allclose(r[1], x[:, ::-1])
+    np.testing.assert_allclose(r[2], np.full((2, 3), 9.0))
+    assert int(r[3].reshape(())) == 6 and int(r[4].reshape(())) == 2
+
+
+def test_random_family_shapes():
+    def build():
+        g = L.gaussian_random([4, 5], mean=1.0, std=0.1)
+        u = L.uniform_random([4, 5], min=0.0, max=1.0)
+        gb = L.gaussian_random_batch_size_like(g, [-1, 7])
+        ub = L.uniform_random_batch_size_like(u, [-1, 2])
+        return [g, u, gb, ub]
+
+    r = _run(build, n_fetch=4)
+    assert r[0].shape == (4, 5) and r[2].shape == (4, 7)
+    assert (r[1] >= 0).all() and r[3].shape == (4, 2)
+
+
+def test_conv3d_pool3d():
+    x = np.random.default_rng(0).standard_normal((1, 2, 6, 6, 6)) \
+        .astype(np.float32)
+
+    def build():
+        v = fluid.data("x", [1, 2, 6, 6, 6])
+        c = L.conv3d(v, num_filters=3, filter_size=3, padding=1)
+        p = L.pool3d(c, pool_size=2, pool_type="max", pool_stride=2)
+        a = L.adaptive_pool3d(p, 1, pool_type="avg")
+        return [c, p, a]
+
+    r = _run(build, {"x": x}, n_fetch=3)
+    assert r[0].shape == (1, 3, 6, 6, 6)
+    assert r[1].shape == (1, 3, 3, 3, 3)
+    assert r[2].shape == (1, 3, 1, 1, 1)
+
+
+def test_conv3d_transpose_shape():
+    x = np.random.default_rng(0).standard_normal((1, 4, 3, 3, 3)) \
+        .astype(np.float32)
+
+    def build():
+        v = fluid.data("x", [1, 4, 3, 3, 3])
+        return L.conv3d_transpose(v, num_filters=2, filter_size=2, stride=2)
+
+    r = _run(build, {"x": x})
+    assert r[0].shape == (1, 2, 6, 6, 6)
+
+
+def test_loss_family():
+    rng = np.random.default_rng(0)
+    pred = rng.random((4, 3)).astype(np.float32)
+    lab = rng.integers(0, 3, (4, 1)).astype(np.int64)
+
+    def build():
+        p = fluid.data("p", [4, 3])
+        y = fluid.data("y", [4, 1], dtype="int64")
+        bpr = L.mean(L.bpr_loss(L.softmax(p), y))
+        rl = L.rank_loss(
+            fluid.data("rl_l", [4, 1]),
+            fluid.data("rl_a", [4, 1]), fluid.data("rl_b", [4, 1]))
+        mrl = L.margin_rank_loss(
+            fluid.data("m_l", [4, 1]),
+            fluid.data("m_a", [4, 1]), fluid.data("m_b", [4, 1]))
+        dice = L.dice_loss(L.sigmoid(p), L.cast(y, "int64"))
+        return [bpr, rl, mrl, dice]
+
+    feeds = {"p": pred, "y": lab,
+             "rl_l": rng.integers(0, 2, (4, 1)).astype(np.float32),
+             "rl_a": rng.random((4, 1)).astype(np.float32),
+             "rl_b": rng.random((4, 1)).astype(np.float32),
+             "m_l": (rng.integers(0, 2, (4, 1)) * 2 - 1).astype(np.float32),
+             "m_a": rng.random((4, 1)).astype(np.float32),
+             "m_b": rng.random((4, 1)).astype(np.float32)}
+    r = _run(build, feeds, n_fetch=4)
+    assert all(np.isfinite(v).all() for v in r)
+
+
+def test_nce_and_hsigmoid_build_and_run():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((6, 8)).astype(np.float32)
+    y = rng.integers(0, 20, (6, 1)).astype(np.int64)
+
+    def build():
+        v = fluid.data("x", [6, 8])
+        lab = fluid.data("y", [6, 1], dtype="int64")
+        cost = L.nce(v, lab, num_total_classes=20, num_neg_samples=4)
+        hs = L.hsigmoid(v, lab, num_classes=20)
+        return [L.mean(cost), L.mean(hs)]
+
+    r = _run(build, {"x": x, "y": y}, n_fetch=2)
+    assert all(np.isfinite(v).all() for v in r)
+
+
+def test_detection_family():
+    rng = np.random.default_rng(0)
+
+    def build():
+        a = fluid.data("boxes_a", [5, 4])
+        b = fluid.data("boxes_b", [7, 4])
+        iou = L.iou_similarity(a, b)
+        feat = fluid.data("feat", [1, 8, 4, 4])
+        img = fluid.data("img", [1, 3, 32, 32])
+        boxes, variances = L.prior_box(feat, img, min_sizes=[4.0])
+        anchors, avar = L.anchor_generator(feat)
+        clipped = L.box_clip(a, fluid.data("im_info", [1, 3]))
+        return [iou, boxes, anchors, clipped]
+
+    boxes_a = np.sort(rng.random((5, 4)), axis=-1).astype(np.float32)
+    boxes_b = np.sort(rng.random((7, 4)), axis=-1).astype(np.float32)
+    feeds = {"boxes_a": boxes_a, "boxes_b": boxes_b,
+             "feat": rng.standard_normal((1, 8, 4, 4)).astype(np.float32),
+             "img": rng.standard_normal((1, 3, 32, 32)).astype(np.float32),
+             "im_info": np.array([[32.0, 32.0, 1.0]], np.float32)}
+    r = _run(build, feeds, n_fetch=4)
+    assert r[0].shape == (5, 7)
+    assert np.isfinite(r[1]).all() and np.isfinite(r[2]).all()
+
+
+def test_roi_family():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 2, 8, 8)).astype(np.float32)
+    rois = np.array([[0.0, 0.0, 4.0, 4.0], [2.0, 2.0, 6.0, 6.0]],
+                    np.float32)
+
+    def build():
+        v = fluid.data("x", [1, 2, 8, 8])
+        r = fluid.data("rois", [2, 4])
+        ra = L.roi_align(v, r, 2, 2, spatial_scale=1.0)
+        rp = L.roi_pool(v, r, 2, 2, spatial_scale=1.0)
+        return [ra, rp]
+
+    r = _run(build, {"x": x, "rois": rois}, n_fetch=2)
+    assert r[0].shape == (2, 2, 2, 2)
+    assert r[1].shape == (2, 2, 2, 2)
+
+
+def test_roi_perspective_transform_identity():
+    """An axis-aligned square RoI warps to a plain crop-resize."""
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+
+    def build():
+        v = fluid.data("x", [1, 1, 4, 4])
+        r = fluid.data("rois", [1, 8])
+        return L.roi_perspective_transform(v, r, 2, 2, spatial_scale=1.0)
+
+    # corners clockwise from top-left: (0,0),(3,0),(3,3),(0,3)
+    rois = np.array([[0.0, 0.0, 3.0, 0.0, 3.0, 3.0, 0.0, 3.0]], np.float32)
+    r = _run(build, {"x": x, "rois": rois})
+    assert r[0].shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(r[0][0, 0], [[0.0, 3.0], [12.0, 15.0]],
+                               atol=1e-4)
+
+
+def test_sequence_family():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 5, 3)).astype(np.float32)
+    lens = np.array([5, 3], np.int64)
+
+    def build():
+        v = fluid.data("x", [2, 5, 3])
+        ln = fluid.data("lens", [2], dtype="int64")
+        conv = L.sequence_conv(v, num_filters=4, filter_size=3, lengths=ln)
+        exp = L.sequence_expand_as(fluid.data("y2", [2, 1]), v, lengths=ln)
+        resh = L.sequence_reshape(v, 15, lengths=ln)
+        return [conv, exp, resh]
+
+    feeds = {"x": x, "lens": lens,
+             "y2": rng.standard_normal((2, 1)).astype(np.float32)}
+    r = _run(build, feeds, n_fetch=3)
+    assert r[0].shape == (2, 5, 4)
+
+
+def test_crf_family():
+    rng = np.random.default_rng(0)
+    em = rng.standard_normal((2, 4, 3)).astype(np.float32)
+    lab = rng.integers(0, 3, (2, 4)).astype(np.int64)
+    lens = np.array([4, 2], np.int64)
+
+    def build():
+        e = fluid.data("em", [2, 4, 3])
+        y = fluid.data("lab", [2, 4], dtype="int64")
+        ln = fluid.data("lens", [2], dtype="int64")
+        ll = L.linear_chain_crf(e, y, length=ln)
+        return [L.mean(ll)]
+
+    r = _run(build, {"em": em, "lab": lab, "lens": lens})
+    assert np.isfinite(r[0]).all()
+
+
+def test_dynamic_rnn_family():
+    rng = np.random.default_rng(0)
+    b, t, d = 2, 4, 3
+    xg = rng.standard_normal((b, t, 3 * d)).astype(np.float32)
+    xl = rng.standard_normal((b, t, 4 * d)).astype(np.float32)
+    lens = np.array([4, 2], np.int64)
+
+    def build():
+        g_in = fluid.data("xg", [b, t, 3 * d])
+        l_in = fluid.data("xl", [b, t, 4 * d])
+        ln = fluid.data("lens", [b], dtype="int64")
+        h = L.dynamic_gru(g_in, d, lengths=ln)
+        hid, cell = L.dynamic_lstm(l_in, 4 * d, lengths=ln)
+        proj, c2 = L.dynamic_lstmp(l_in, 4 * d, proj_size=2, lengths=ln)
+        return [h, hid, proj]
+
+    r = _run(build, {"xg": xg, "xl": xl, "lens": lens}, n_fetch=3)
+    assert r[0].shape == (b, t, d)
+    assert r[1].shape == (b, t, d)
+    assert r[2].shape == (b, t, 2)
+
+
+def test_ctc_and_edit_distance():
+    rng = np.random.default_rng(0)
+    probs = rng.random((2, 6, 5)).astype(np.float32)
+    plen = np.array([6, 4], np.int64)
+
+    def build():
+        p = fluid.data("p", [2, 6, 5])
+        ln = fluid.data("plen", [2], dtype="int64")
+        dec = L.ctc_greedy_decoder(p, blank=0, input_length=ln)
+        hyp = fluid.data("hyp", [2, 4], dtype="int64")
+        ref = fluid.data("ref", [2, 5], dtype="int64")
+        hl = fluid.data("hl", [2], dtype="int64")
+        rl = fluid.data("rl", [2], dtype="int64")
+        dist, seq_num = L.edit_distance(hyp, ref, normalized=False,
+                                        input_length=hl, label_length=rl)
+        return [dec, dist]
+
+    feeds = {"p": probs, "plen": plen,
+             "hyp": np.array([[1, 2, 3, 0], [1, 1, 0, 0]], np.int64),
+             "ref": np.array([[1, 2, 4, 0, 0], [1, 0, 0, 0, 0]], np.int64),
+             "hl": np.array([3, 2], np.int64),
+             "rl": np.array([3, 1], np.int64)}
+    r = _run(build, feeds, n_fetch=2)
+    np.testing.assert_allclose(r[1].reshape(-1), [1.0, 1.0])
+
+
+def test_beam_search_and_gather_tree():
+    def build():
+        pre_ids = fluid.data("pre_ids", [1, 2], dtype="int64")
+        pre_sc = fluid.data("pre_sc", [1, 2])
+        sc = fluid.data("sc", [1, 2, 6])
+        sel_ids, sel_sc = L.beam_search(pre_ids, pre_sc, None, sc,
+                                        beam_size=2, end_id=0)
+        ids = fluid.data("tids", [3, 1, 2], dtype="int64")
+        parents = fluid.data("tpar", [3, 1, 2], dtype="int64")
+        gt = L.gather_tree(ids, parents)
+        return [sel_ids, gt]
+
+    rng = np.random.default_rng(0)
+    feeds = {"pre_ids": np.array([[1, 2]], np.int64),
+             "pre_sc": np.zeros((1, 2), np.float32),
+             "sc": np.log(rng.dirichlet(np.ones(6), (1, 2))
+                          .astype(np.float32)),
+             "tids": rng.integers(1, 5, (3, 1, 2)).astype(np.int64),
+             "tpar": np.zeros((3, 1, 2), np.int64)}
+    r = _run(build, feeds, n_fetch=2)
+    assert r[0].shape[-1] == 2
+
+
+def test_metric_layers():
+    rng = np.random.default_rng(0)
+
+    def build():
+        p = fluid.data("p", [8, 2])
+        y = fluid.data("y", [8, 1], dtype="int64")
+        auc_val, _ = L.auc(p, y)
+        return [auc_val]
+
+    preds = rng.random((8, 2)).astype(np.float32)
+    labs = rng.integers(0, 2, (8, 1)).astype(np.int64)
+    r = _run(build, {"p": preds, "y": labs})
+    assert 0.0 <= float(r[0]) <= 1.0
+
+
+def test_misc_builders_compile():
+    """Builders with heavier fixtures: build-only (program validity)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 4, 8, 8])
+        L.lrn(x)
+        L.shuffle_channel(x, group=2)
+        L.temporal_shift(x, seg_num=2)
+        L.pixel_shuffle(x, 2)
+        L.space_to_depth(x, 2)
+        L.unfold(x, 3)
+        grid = L.affine_grid(fluid.data("theta", [2, 2, 3]), [2, 4, 8, 8])
+        L.grid_sampler(x, grid)
+        L.spectral_norm(fluid.data("w", [4, 6]))
+        seq = fluid.data("seq", [2, 6, 4])
+        L.row_conv(seq, 2)
+        L.add_position_encoding(seq)
+        L.bilinear_tensor_product(fluid.data("bx", [2, 3]),
+                                  fluid.data("by", [2, 5]), 4)
+        L.cos_sim(fluid.data("ca", [2, 4]), fluid.data("cb", [2, 4]))
+        L.sampled_softmax_with_cross_entropy(
+            fluid.data("lg", [4, 50]),
+            fluid.data("ll", [4, 1], dtype="int64"), num_samples=8)
+    assert len(main.global_block().ops) > 14
+
+
+def test_image_resize_family():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 2, 4, 6)).astype(np.float32)
+
+    def build():
+        v = fluid.data("x", [1, 2, 4, 6])
+        r1 = L.image_resize(v, out_shape=[8, 12])
+        r2 = L.image_resize_short(v, 8)
+        v3 = fluid.data("x3", [1, 1, 2, 2, 2])
+        r3 = L.resize_trilinear(v3, out_shape=[4, 4, 4])
+        return [r1, r2, r3]
+
+    x3 = rng.standard_normal((1, 1, 2, 2, 2)).astype(np.float32)
+    r = _run(build, {"x": x, "x3": x3}, n_fetch=3)
+    assert r[0].shape == (1, 2, 8, 12)
+    assert r[1].shape == (1, 2, 8, 12)       # short side 4 -> 8
+    assert r[2].shape == (1, 1, 4, 4, 4)
+
+
+def test_cvm_layer():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    x[:, :2] = np.abs(x[:, :2]) + 1.0   # (show, click) columns must be >= 0
+    cvm = np.abs(rng.standard_normal((4, 2))).astype(np.float32) + 1.0
+
+    def build():
+        v = fluid.data("x", [4, 6])
+        c = fluid.data("cvm", [4, 2])
+        return L.continuous_value_model(v, c, use_cvm=True)
+
+    r = _run(build, {"x": x, "cvm": cvm})
+    assert r[0].shape[0] == 4 and np.isfinite(r[0]).all()
+
+
+def test_ssd_pipeline_builds_and_runs():
+    rng = np.random.default_rng(0)
+
+    def build():
+        feat1 = fluid.data("f1", [1, 8, 4, 4])
+        feat2 = fluid.data("f2", [1, 8, 2, 2])
+        img = fluid.data("img", [1, 3, 32, 32])
+        locs, confs, boxes, variances = L.multi_box_head(
+            [feat1, feat2], img, base_size=32, num_classes=3,
+            aspect_ratios=[2.0], min_ratio=20, max_ratio=90)
+        return [locs, confs, boxes, variances]
+
+    feeds = {"f1": rng.standard_normal((1, 8, 4, 4)).astype(np.float32),
+             "f2": rng.standard_normal((1, 8, 2, 2)).astype(np.float32),
+             "img": rng.standard_normal((1, 3, 32, 32)).astype(np.float32)}
+    r = _run(build, feeds, n_fetch=4)
+    assert r[0].shape[-1] == 4 and r[1].shape[-1] == 3
+    assert r[2].shape[0] == r[0].shape[1]    # one prior per location

@@ -67,3 +67,26 @@ def test_bf16_io_f32_stats():
     np.testing.assert_allclose(
         np.asarray(y, np.float32),
         np.asarray(_ref(x.astype(jnp.float32), 1.0, 0.0)), atol=0.1)
+
+
+def test_partial_last_block_gradients():
+    """rows not divisible by block_rows: the padded tail of the final
+    block must not pollute dgamma/dbeta."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((300, 128)).astype(np.float32))
+    g = jnp.asarray(rng.standard_normal(128).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal(128).astype(np.float32))
+    dy = jnp.asarray(rng.standard_normal((300, 128)).astype(np.float32))
+
+    def lp(x, g, b):
+        return (fused_layer_norm(x, g, b) * dy).sum()
+
+    def lr(x, g, b):
+        return (_ref(x, g, b) * dy).sum()
+
+    gp = jax.grad(lp, argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(x, g, b)
+    for a, c, name in zip(gp, gr, ["dx", "dgamma", "dbeta"]):
+        assert np.isfinite(np.asarray(a)).all(), name
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=5e-3, rtol=1e-4, err_msg=name)
