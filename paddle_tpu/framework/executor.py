@@ -309,10 +309,44 @@ def _run_array_op(op, env, rng_box, const_env=None):
         return
 
 
+def _run_while_block(op, env, rng_box, const_env=None):
+    """Block-style While (the reference's while_op used via
+    fluid.layers.While): loop state is every outer variable the body
+    block assigns, plus the condition variable; iteration stops when the
+    body's assign to the condition goes false."""
+    program = op.block.program
+    a = op.attrs
+    body = program.blocks[a["body_block"]]
+    cond_name = a["cond_name"]
+    written = set()
+    for o in body.ops:
+        written.update(o.output_names())
+    carry_names = sorted({cond_name} | {n for n in written if n in env})
+    cond_pos = carry_names.index(cond_name)
+    init = tuple(jnp.asarray(env[n]) for n in carry_names) \
+        + (rng_box.next(),)
+
+    def cond_fn(carry):
+        return jnp.asarray(carry[cond_pos]).reshape(()).astype(bool)
+
+    def body_fn(carry):
+        key, sub = jax.random.split(carry[-1])
+        local = _branch_env(env)
+        local.update(dict(zip(carry_names, carry[:-1])))
+        interpret(body.ops, local, _RngBox(sub), const_env)
+        return tuple(jnp.asarray(local[n], init[i].dtype)
+                     for i, n in enumerate(carry_names)) + (key,)
+
+    outs = jax.lax.while_loop(cond_fn, body_fn, init)
+    for n, v in zip(carry_names, outs[:-1]):
+        env[n] = v
+
+
 _CONTROL_FLOW_OPS = {
     "cond": _run_cond,
     "switch": _run_switch,
     "while_loop": _run_while,
+    "while_block": _run_while_block,
     "static_rnn": _run_static_rnn,
     "create_array": _run_array_op,
     "array_write": _run_array_op,

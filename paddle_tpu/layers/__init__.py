@@ -12,6 +12,7 @@ from .metric_op import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .sequence_ops import *  # noqa: F401,F403
 from .extended import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
 from . import learning_rate_scheduler  # noqa: F401
 # the reference re-exports the LR schedules at the layers namespace
 from .learning_rate_scheduler import (  # noqa: F401

@@ -20,6 +20,7 @@ from ..framework import program as prog_mod
 from ..framework.layer_helper import LayerHelper
 
 __all__ = ["cond", "while_loop", "StaticRNN", "Switch", "increment",
+           "case", "switch_case", "While", "IfElse", "DynamicRNN", "Print",
            "less_than", "less_equal", "greater_than", "greater_equal",
            "equal", "not_equal", "logical_and", "logical_or",
     "logical_not", "array_write", "array_read", "array_length",
@@ -423,3 +424,254 @@ def array_length(array):
     h.append_op("array_length", inputs={"Array": array},
                 outputs={"Out": out}, attrs={})
     return out
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """control_flow.py case (:3036) — first true predicate wins; lowers
+    to a chain of cond ops (nested lax.cond at run time)."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+
+    def build(i):
+        pred, fn = pairs[i]
+        if i == len(pairs) - 1 and default is None:
+            # reference: last fn is the fallback when nothing matched
+            return cond(pred, fn, fn, name=name)
+        fallback = (default if i == len(pairs) - 1
+                    else (lambda: build(i + 1)))
+        return cond(pred, fn, fallback, name=name)
+
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """control_flow.py switch_case (:3132) — integer dispatch over
+    branch functions; lowers to the Switch chain."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    from .tensor import fill_constant
+
+    pairs = []
+    for idx, fn in items:
+        pred = equal(branch_index,
+                     fill_constant([1], branch_index.dtype, idx))
+        pairs.append((pred, fn))
+    if default is None:
+        default = items[-1][1]
+    return case(pairs, default=default, name=name)
+
+
+class While:
+    """Block-style while (control_flow.py:1038 While) over the
+    while_loop machinery: the block body writes updated loop variables
+    in place via layers.assign, matching reference usage:
+
+        i = fluid.layers.fill_constant([1], 'int64', 0)
+        loop = fluid.layers.While(cond)
+        with loop.block():
+            ... assign(new_i, i); assign(new_cond, cond)
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self._cond = cond
+        self._name = name
+        self._program = cond.block.program
+
+    def block(self):
+        import contextlib
+
+        program = self._program
+        outer_block = program.current_block()
+
+        @contextlib.contextmanager
+        def guard():
+            blk = program.create_block()
+            try:
+                yield
+            finally:
+                program.rollback()
+            # outer variables the body assigns form the loop state; they
+            # are declared as op outputs so liveness analysis keeps the
+            # loop when any of them is fetched
+            written = []
+            for o in blk.ops:
+                for n in o.output_names():
+                    if (n not in written
+                            and outer_block._find_var_recursive(n)
+                            is not None):
+                        written.append(n)
+            h = _helper("while")
+            h.append_op(
+                "while_block",
+                inputs={"Cond": self._cond,
+                        "Captured": _captured_names([blk])},
+                outputs={"Out": written},
+                attrs={"body_block": blk.idx,
+                       "cond_name": self._cond.name})
+
+        return guard()
+
+
+class IfElse:
+    """Block-style conditional (control_flow.py:1525 IfElse): record
+    true/false branch blocks, merge outputs positionally.
+
+        ie = fluid.layers.IfElse(cond_bool)
+        with ie.true_block():
+            ie.output(x1)
+        with ie.false_block():
+            ie.output(x2)
+        out, = ie()
+    """
+
+    def __init__(self, cond, name=None):
+        self._cond = cond
+        self._program = cond.block.program
+        self._true = None          # (block, [outputs])
+        self._false = None
+        self._current = None
+
+    def _branch(self, which):
+        import contextlib
+
+        program = self._program
+
+        @contextlib.contextmanager
+        def guard():
+            blk = program.create_block()
+            outs = []
+            self._current = outs
+            try:
+                yield
+            finally:
+                program.rollback()
+                self._current = None
+            if which == "true":
+                self._true = (blk, outs)
+            else:
+                self._false = (blk, outs)
+
+        return guard()
+
+    def true_block(self):
+        return self._branch("true")
+
+    def false_block(self):
+        return self._branch("false")
+
+    def output(self, *outs):
+        if self._current is None:
+            raise RuntimeError("IfElse.output() outside a branch block")
+        self._current.extend(outs)
+
+    def input(self, x):
+        """The reference slices inputs by condition; under the dense
+        lax.cond lowering both branches see the full tensor."""
+        return x
+
+    def __call__(self):
+        if self._true is None or self._false is None:
+            raise RuntimeError("IfElse needs both true and false blocks")
+        tb, t_outs = self._true
+        fb, f_outs = self._false
+        if len(t_outs) != len(f_outs):
+            raise ValueError("IfElse branches must output the same arity")
+        h = _helper("ifelse")
+        outs = [h.create_variable_for_type_inference(v.dtype)
+                for v in t_outs]
+        for o, v in zip(outs, t_outs):
+            o.shape = v.shape
+        h.append_op(
+            "cond",
+            inputs={"Pred": self._cond,
+                    "Captured": _captured_names([tb, fb])},
+            outputs={"Out": outs},
+            attrs={"true_block": tb.idx, "false_block": fb.idx,
+                   "true_outs": [v.name for v in t_outs],
+                   "false_outs": [v.name for v in f_outs]})
+        return outs
+
+
+class DynamicRNN(StaticRNN):
+    """control_flow.py:1717 DynamicRNN — in the padded+lengths contract
+    ragged per-step slicing collapses into StaticRNN over the padded
+    time axis; consumers mask by lengths (the repo-wide sequence
+    design, layers/sequence_ops.py:1-11).
+
+    API adapters for reference usage: `block()` is the step context
+    (`with drnn.block():`), `step_input` accepts batch-major [B, T, ...]
+    (transposed to StaticRNN's time-major contract), and `memory`
+    supports the (shape=..., value=...) form."""
+
+    def block(self):
+        return self.step()
+
+    import contextlib as _contextlib
+
+    @_contextlib.contextmanager
+    def _outer_block(self):
+        """Build ops in the OUTER block while inside the step block
+        (step_input transposes and memory inits are outer-scope ops)."""
+        prog = self._program
+        saved = prog.current_block_idx
+        prog.current_block_idx = self._block.parent_idx
+        try:
+            yield
+        finally:
+            prog.current_block_idx = saved
+
+    def step_input(self, x, level=0):
+        from .tensor import transpose
+
+        with self._outer_block():
+            tm = transpose(x, [1, 0] + list(range(2, len(x.shape))))
+        return super().step_input(tm)
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32"):
+        if init is None:
+            if shape is None:
+                raise ValueError("memory() needs init or shape")
+            if not self._step_inputs:
+                raise RuntimeError(
+                    "memory(shape=...) must follow step_input (the batch "
+                    "dim comes from it)")
+            from .tensor import fill_constant_batch_size_like
+
+            outer_x = self._step_inputs[0][0]    # time-major [T, B, ...]
+            with self._outer_block():
+                init = fill_constant_batch_size_like(
+                    outer_x, [-1] + list(shape), dtype, value,
+                    input_dim_idx=1)
+        return super().memory(init)
+
+    def __call__(self):
+        """Reference drnn() yields batch-major outputs; StaticRNN's are
+        time-major — transpose back."""
+        from .tensor import transpose
+
+        outs = super().__call__()
+        outs_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        # outer result rank = per-step rank + time axis (outer shapes are
+        # inferred lazily, so derive the permutation from the step vars)
+        bm = []
+        for o, inner in zip(outs_list, self._outputs):
+            rank = len(inner.shape) + 1
+            bm.append(transpose(o, [1, 0] + list(range(2, rank))))
+        return bm[0] if len(bm) == 1 else bm
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """control_flow.py Print (:281) — runtime tensor peek via the print
+    op (jax.debug.print under jit)."""
+    from .tensor import _single_out
+
+    return _single_out("print", {"In": input},
+                       {"message": message or "", "first_n": first_n,
+                        "summarize": summarize}, same_shape=True)

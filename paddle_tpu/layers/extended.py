@@ -60,6 +60,10 @@ __all__ = [
     "ssd_loss",
     # metric
     "auc", "chunk_eval",
+    # io / plumbing
+    "autoincreased_step_counter", "load", "py_func",
+    "tensor_array_to_tensor", "reorder_lod_tensor_by_rank", "PyReader",
+    "py_reader", "create_py_reader_by_data", "read_file", "double_buffer",
 ]
 
 
@@ -1590,3 +1594,189 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                         {"scale": conf_loss_weight}, same_shape=True)},
                {"axis": -1})
     return total
+
+
+# -- io / misc plumbing ------------------------------------------------------
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """layers/tensor.py autoincreased_step_counter — the persistable
+    global step the LR schedules read (shared with
+    learning_rate_scheduler._global_step)."""
+    from .learning_rate_scheduler import _global_step
+
+    return _global_step()
+
+
+def load(out, file_path, load_as_fp16=False):
+    """layers/io.py load op — load one variable from a save_vars file at
+    build time (the runtime io path is fluid.io.load_vars)."""
+    import numpy as np
+
+    data = np.load(file_path, allow_pickle=False)
+    arr = data[out.name] if hasattr(data, "files") else data
+    from .tensor import assign
+
+    return assign(np.asarray(arr), out)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """nn.py py_func (operators/py_func_op.cc) — run arbitrary Python in
+    the graph via jax.pure_callback; backward_func supplies the custom
+    gradient like the reference's registered backward callable."""
+    from ..ops.registry import has_op, register_op
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    token = f"py_func_{id(func)}_{id(backward_func)}"
+    if not has_op(token):
+        def kernel(ins, attrs, _f=func, _bf=backward_func, _n=len(outs)):
+            arrs = ins["X"] if isinstance(ins["X"], (list, tuple)) \
+                else [ins["X"]]
+            arrs = [jnp.asarray(a) for a in arrs]
+            shapes = attrs["_out_shapes"]
+            dtypes = attrs["_out_dtypes"]
+            result_shape = tuple(
+                jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                for s, d in zip(shapes, dtypes))
+
+            def host_fwd(*vals):
+                r = _f(*[np.asarray(v) for v in vals])
+                r = r if isinstance(r, (list, tuple)) else [r]
+                return tuple(np.asarray(v, np.dtype(d))
+                             for v, d in zip(r, dtypes))
+
+            def call_fwd(*a):
+                return jax.pure_callback(host_fwd, result_shape, *a)
+
+            if _bf is None:
+                res = call_fwd(*arrs)
+            else:
+                # reference py_func_op.cc backward contract: the
+                # backward callable receives (inputs, outputs, output
+                # grads) and returns one grad per input
+                @jax.custom_vjp
+                def with_grad(*a):
+                    return call_fwd(*a)
+
+                def fwd_rule(*a):
+                    r = call_fwd(*a)
+                    return r, (a, r)
+
+                def bwd_rule(res_, cots):
+                    a, r = res_
+                    in_shapes = tuple(
+                        jax.ShapeDtypeStruct(v.shape, v.dtype) for v in a)
+
+                    def host_bwd(*vals):
+                        na = len(a)
+                        nr = len(r)
+                        ins_np = [np.asarray(v) for v in vals[:na]]
+                        outs_np = [np.asarray(v)
+                                   for v in vals[na:na + nr]]
+                        gouts = [np.asarray(v) for v in vals[na + nr:]]
+                        g = _bf(*ins_np, *outs_np, *gouts)
+                        g = g if isinstance(g, (list, tuple)) else [g]
+                        return tuple(
+                            np.asarray(v, np.asarray(iv).dtype)
+                            for v, iv in zip(g, ins_np))
+
+                    gins = jax.pure_callback(host_bwd, in_shapes, *a, *r,
+                                             *cots)
+                    return tuple(gins)
+
+                with_grad.defvjp(fwd_rule, bwd_rule)
+                res = with_grad(*arrs)
+            return {"Out": list(res) if _n > 1 else res[0]}
+        register_op(token)(kernel)
+    helper = LayerHelper("py_func")
+    helper.append_op(
+        token, inputs={"X": xs}, outputs={"Out": outs},
+        attrs={"_out_shapes": [list(o.shape) for o in outs],
+               "_out_dtypes": [o.dtype for o in outs]})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False):
+    """tensor.py tensor_array_to_tensor — concat/stack a tensor array."""
+    from .control_flow import array_length  # noqa: F401 (parity import)
+    from .tensor import _single_out
+
+    out = _single_out("tensor_array_to_tensor", {"X": input},
+                      {"axis": axis, "use_stack": use_stack})
+    return out, None
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """control_flow reorder_lod_tensor_by_rank — permute the batch by a
+    rank table; in the padded contract the table is simply the target
+    row order [B]."""
+    from .tensor import _single_out
+
+    return _single_out("reorder_by_rank", {"X": x, "RankTable": rank_table},
+                       {})
+
+
+class PyReader:
+    """fluid.io.PyReader / layers py_reader family shim — the decoupled
+    feeding the reference implements with a C++ blocking queue is
+    DataLoader territory here (reader/__init__.py); this object keeps the
+    decorate-batch-generator API so reference scripts run."""
+
+    def __init__(self, feed_list, capacity=64, iterable=True):
+        self.feed_list = list(feed_list)
+        self.capacity = capacity
+        self.iterable = iterable
+        self._gen = None
+
+    def decorate_batch_generator(self, generator, places=None):
+        self._gen = generator
+
+    decorate_sample_list_generator = decorate_batch_generator
+    decorate_tensor_provider = decorate_batch_generator
+
+    def __iter__(self):
+        if self._gen is None:
+            raise RuntimeError("decorate a generator first")
+        for batch in self._gen():
+            vals = batch if isinstance(batch, (list, tuple)) else [batch]
+            yield {v.name: b for v, b in zip(self.feed_list, vals)}
+
+    def start(self):
+        pass
+
+    def reset(self):
+        pass
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """layers/io.py py_reader — returns a PyReader over fresh data vars;
+    read_file unpacks them."""
+    from ..framework.program import data
+
+    feeds = [data(f"_py_reader_{name or 'r'}_{i}", list(s), dtype=d)
+             for i, (s, d) in enumerate(zip(shapes, dtypes))]
+    reader = PyReader(feeds, capacity)
+    reader._vars = feeds
+    return reader
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    return PyReader(feed_list, capacity)
+
+
+def read_file(reader):
+    """layers/io.py read_file — the data variables the reader feeds."""
+    return tuple(reader.feed_list) if len(reader.feed_list) > 1 \
+        else reader.feed_list[0]
+
+
+def double_buffer(reader, place=None, name=None):
+    """layers/io.py double_buffer — no-op: XLA pipelines host->device
+    copies and the native data_feed threads keep the queue full
+    (csrc/data_feed.cpp)."""
+    return reader

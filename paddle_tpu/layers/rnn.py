@@ -1,0 +1,507 @@
+"""RNN cells, the rnn() runner, and dynamic decoding.
+
+Parity: /root/reference/python/paddle/fluid/layers/rnn.py — RNNCell
+(:51), GRUCell (:160), LSTMCell (:232), rnn (:316), Decoder (:441),
+BeamSearchDecoder (:520), dynamic_decode (:920), DecodeHelper family
+(:1096-1352), BasicDecoder (:1364).
+
+TPU-native shape: the reference unrolls these through LoDTensorArray +
+While ops; here every loop is a `lax.scan` / `lax.while_loop` over the
+padded batch — one compiled program, static shapes, no per-step Python.
+Cells are nn.Layers (eager parameters) so the same objects serve dygraph
+code and jitted train steps; beam stepping and backtracking reuse the
+beam_search / gather_tree op kernels (ops/decode_ops.py).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.registry import get_op
+
+__all__ = [
+    "RNNCell", "GRUCell", "LSTMCell", "rnn", "birnn", "lstm",
+    "Decoder", "BeamSearchDecoder", "dynamic_decode",
+    "DecodeHelper", "TrainingHelper", "GreedyEmbeddingHelper",
+    "SampleEmbeddingHelper", "BasicDecoder",
+]
+
+
+def _val(x):
+    return F._val(x)
+
+
+class RNNCell(nn.Layer):
+    """Base cell: call(inputs, states, **kw) -> (outputs, new_states)
+    (rnn.py:51).  state_shape excludes the batch dimension."""
+
+    def call(self, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def forward(self, inputs, states, **kwargs):
+        return self.call(inputs, states, **kwargs)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        batch = _val(batch_ref).shape[batch_dim_idx]
+        shape = shape if shape is not None else self.state_shape
+        if isinstance(shape, (list, tuple)) and shape and \
+                isinstance(shape[0], (list, tuple)):
+            return [jnp.full((batch,) + tuple(s), init_value,
+                             F._val(batch_ref).dtype
+                             if dtype is None else dtype)
+                    for s in shape]
+        return jnp.full((batch,) + tuple(shape), init_value, dtype)
+
+
+class GRUCell(RNNCell):
+    """rnn.py:160 GRUCell (gate order matches operators/gru_unit_op)."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 dtype="float32", name=None):
+        super().__init__(dtype=dtype)
+        self.hidden_size = hidden_size
+        self._ih = nn.Linear(hidden_size, 3 * hidden_size,
+                             param_attr=param_attr, dtype=dtype)
+        self._hh = nn.Linear(hidden_size, 3 * hidden_size,
+                             param_attr=param_attr,
+                             bias_attr=bias_attr, dtype=dtype)
+
+    def call(self, inputs, states):
+        h = states
+        gi = self._ih(_val(inputs))
+        gh = self._hh(_val(h))
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        new_h = (1.0 - z) * n + z * _val(h)
+        return new_h, new_h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCell):
+    """rnn.py:232 LSTMCell — states are [h, c]."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 forget_bias=1.0, dtype="float32", name=None):
+        super().__init__(dtype=dtype)
+        self.hidden_size = hidden_size
+        self._forget_bias = forget_bias
+        self._ih = nn.Linear(hidden_size, 4 * hidden_size,
+                             param_attr=param_attr, dtype=dtype)
+        self._hh = nn.Linear(hidden_size, 4 * hidden_size,
+                             param_attr=param_attr, bias_attr=bias_attr,
+                             dtype=dtype)
+
+    def call(self, inputs, states):
+        h, c = states
+        gates = self._ih(_val(inputs)) + self._hh(_val(h))
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        f = jax.nn.sigmoid(f + self._forget_bias)
+        i = jax.nn.sigmoid(i)
+        o = jax.nn.sigmoid(o)
+        new_c = f * _val(c) + i * jnp.tanh(g)
+        new_h = o * jnp.tanh(new_c)
+        return new_h, [new_h, new_c]
+
+    @property
+    def state_shape(self):
+        return [(self.hidden_size,), (self.hidden_size,)]
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """rnn.py:316 — run `cell` over the time axis with lax.scan; the
+    carry freezes for steps past sequence_length (the reference's LoD
+    semantics on the padded batch)."""
+    x = _val(inputs)
+    if time_major:
+        x = jnp.moveaxis(x, 0, 1)                  # -> [B, T, ...]
+    b, t = x.shape[0], x.shape[1]
+    if initial_states is None:
+        initial_states = cell.get_initial_states(x, dtype=x.dtype)
+    if is_reverse:
+        x = jnp.flip(x, axis=1)
+    length = (jnp.asarray(_val(sequence_length)).reshape(-1)
+              if sequence_length is not None else None)
+
+    def step(carry, xt_i):
+        xt, i = xt_i
+        out, new_states = cell(xt, carry, **kwargs)
+        if length is not None:
+            if is_reverse:
+                # reversed scan: step i touches original position t-1-i,
+                # live when i >= t - len
+                live = (i >= (t - length))[:, None]
+            else:
+                live = (i < length)[:, None]
+            new_states = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(live, new, old),
+                new_states, carry)
+            out = jnp.where(live, out, jnp.zeros_like(out))
+        return new_states, out
+
+    idx = jnp.arange(t, dtype=jnp.int32)
+    final, outs = lax.scan(step, initial_states,
+                           (jnp.moveaxis(x, 0, 1), idx))
+    outs = jnp.moveaxis(outs, 0, 1)                # [B, T, H]
+    if is_reverse:
+        outs = jnp.flip(outs, axis=1)
+    if time_major:
+        outs = jnp.moveaxis(outs, 0, 1)
+    return outs, final
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    """Bidirectional runner (paddle.nn.layer.rnn birnn shape)."""
+    fw, fws = rnn(cell_fw, inputs, None if initial_states is None
+                  else initial_states[0], sequence_length,
+                  time_major=time_major, **kwargs)
+    bw, bws = rnn(cell_bw, inputs, None if initial_states is None
+                  else initial_states[1], sequence_length,
+                  time_major=time_major, is_reverse=True, **kwargs)
+    return jnp.concatenate([fw, bw], axis=-1), (fws, bws)
+
+
+_LSTM_CACHE = {}
+
+
+def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
+         num_layers=1, dropout_prob=0.0, is_bidirec=False, dtype="float32",
+         name=None, seed=-1, is_test=False, sequence_length=None,
+         cells=None):
+    """nn.py lstm (the cudnn_lstm layer, cudnn_lstm_op.cu.cc) — stacked
+    LSTM over the padded batch.  init_h/init_c: [num_layers*D, B, H].
+
+    Weights persist across calls: cells (and input projections) are
+    cached by (name, geometry) like the reference's named graph
+    parameters — pass `cells` explicitly (list of per-layer cells, each
+    a LSTMCell or (fw, bw) pair) to own the parameters, e.g. to register
+    them on a model for the optimizer; `lstm.get_cells(name, ...)`
+    returns the cached set."""
+    x = _val(input)
+    hidden_size = hidden_size or x.shape[-1]
+    h0 = _val(init_h)
+    c0 = _val(init_c)
+    if cells is None:
+        key = (name or "lstm", num_layers, hidden_size, is_bidirec,
+               dtype, int(x.shape[-1]))
+        cells = _LSTM_CACHE.get(key)
+        if cells is None:
+            cells = []
+            for _ in range(num_layers):
+                if is_bidirec:
+                    cells.append((LSTMCell(hidden_size, dtype=dtype),
+                                  LSTMCell(hidden_size, dtype=dtype)))
+                else:
+                    cells.append(LSTMCell(hidden_size, dtype=dtype))
+            proj = (nn.Linear(int(x.shape[-1]), hidden_size, dtype=dtype)
+                    if x.shape[-1] != hidden_size else None)
+            cells = (cells, proj)
+            _LSTM_CACHE[key] = cells
+    layer_cells, proj = cells
+    outs = x
+    last_h, last_c = [], []
+    for layer in range(num_layers):
+        if outs.shape[-1] != hidden_size:
+            if proj is None:
+                proj = nn.Linear(int(outs.shape[-1]), hidden_size,
+                                 dtype=dtype)
+            outs = proj(outs)
+        if is_bidirec:
+            cf, cb = layer_cells[layer]
+            fw_init = [h0[2 * layer], c0[2 * layer]]
+            bw_init = [h0[2 * layer + 1], c0[2 * layer + 1]]
+            o, ((hf, cf_state), (hb, cb_state)) = birnn(
+                cf, cb, outs, initial_states=(fw_init, bw_init),
+                sequence_length=sequence_length)
+            last_h.extend([hf, hb])
+            last_c.extend([cf_state, cb_state])
+            outs = o
+        else:
+            cell = layer_cells[layer]
+            o, (h, c) = rnn(cell, outs,
+                            [h0[layer], c0[layer]],
+                            sequence_length=sequence_length)
+            last_h.append(h)
+            last_c.append(c)
+            outs = o
+        if dropout_prob and not is_test and layer < num_layers - 1:
+            outs = F.dropout(outs, dropout_prob)
+    return outs, jnp.stack(last_h), jnp.stack(last_c)
+
+
+def _lstm_get_cells(name="lstm", num_layers=1, hidden_size=None,
+                    is_bidirec=False, dtype="float32", input_size=None):
+    """The cached (cells, projection) for a named lstm() call — collect
+    trainable parameters from here."""
+    key = (name, num_layers, hidden_size, is_bidirec, dtype, input_size)
+    return _LSTM_CACHE.get(key)
+
+
+lstm.get_cells = _lstm_get_cells
+
+
+# -- decoding ----------------------------------------------------------------
+
+class Decoder:
+    """rnn.py:441 — initialize() -> (inputs, states, finished);
+    step() -> (outputs, states, next_inputs, finished)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """rnn.py:520 — beam search over `cell` with embedding_fn/output_fn.
+
+    The per-step candidate selection delegates to the beam_search op
+    kernel and finalize() to gather_tree (ops/decode_ops.py), the same
+    kernels the program-level layers.beam_search builder uses.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (rnn.py:572)."""
+        x = _val(x)
+        return jnp.repeat(x, beam_size, axis=0)
+
+    def _merge(self, x):
+        x = _val(x)
+        return x.reshape((-1,) + x.shape[2:])
+
+    def _split(self, x):
+        x = _val(x)
+        return x.reshape((-1, self.beam_size) + x.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: self.tile_beam_merge_with_batch(s, self.beam_size),
+            initial_cell_states)
+        sample = jax.tree_util.tree_leaves(states)[0]
+        bk = sample.shape[0]                      # B * beam
+        b = bk // self.beam_size
+        ids = jnp.full((b, self.beam_size), self.start_token, jnp.int32)
+        # only beam 0 live initially (the reference's -inf trick)
+        scores = jnp.full((b, self.beam_size), -1e9, jnp.float32) \
+            .at[:, 0].set(0.0)
+        finished = jnp.zeros((b, self.beam_size), bool)
+        return (ids, scores), states, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        ids, scores = inputs
+        emb = self.embedding_fn(ids.reshape(-1)) if self.embedding_fn \
+            else ids.reshape(-1)
+        cell_out, new_states = self.cell(emb, states, **kwargs)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        b = ids.shape[0]
+        step_scores = self._split(logp)           # [B, K, V]
+        out = get_op("beam_search").fn(
+            {"pre_ids": ids, "pre_scores": scores, "scores": step_scores},
+            {"beam_size": self.beam_size, "end_id": self.end_token})
+        sel_ids = out["selected_ids"]             # [B, K]
+        sel_scores = out["selected_scores"]
+        parent = out["parent_idx"]
+        # reorder beam states by parent
+        gather = (jnp.arange(b)[:, None] * self.beam_size
+                  + parent).reshape(-1)
+        new_states = jax.tree_util.tree_map(lambda s: s[gather], new_states)
+        finished = sel_ids == self.end_token
+        outputs = {"ids": sel_ids, "scores": sel_scores, "parents": parent}
+        return outputs, new_states, (sel_ids, sel_scores), finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrack with gather_tree: outputs stacked [T, B, K]."""
+        out = get_op("gather_tree").fn(
+            {"Ids": outputs["ids"], "Parents": outputs["parents"]}, {})
+        return out["Out"], final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """rnn.py:920 — run decoder.step until every sequence finishes or
+    max_step_num; a lax.scan of max_step_num steps with finished masks
+    (the TPU-static form of the reference's While loop; early exit is a
+    mask, not a dynamic trip count)."""
+    if max_step_num is None:
+        raise ValueError("dynamic_decode on TPU needs max_step_num "
+                         "(static trip count)")
+    inputs, states, finished = decoder.initialize(inits)
+
+    def step(carry, time):
+        inputs, states, finished, seq_len = carry
+        outputs, new_states, next_inputs, next_finished = decoder.step(
+            time, inputs, states, **kwargs)
+        if decoder.tracks_own_finished:
+            # decoders that reorder beams align finished flags themselves;
+            # OR-ing the stale pre-reorder mask would tag wrong
+            # hypotheses, and lengths must follow the post-reorder slots
+            seq_len = seq_len + jnp.where(next_finished, 0, 1)
+        else:
+            next_finished = jnp.logical_or(next_finished, finished)
+            seq_len = seq_len + jnp.where(finished, 0, 1)
+        if impute_finished:
+            new_states = jax.tree_util.tree_map(
+                lambda new, old: _mask_state(new, old, finished),
+                new_states, states)
+        return (next_inputs, new_states, next_finished, seq_len), outputs
+
+    def _mask_state(new, old, fin):
+        f = fin.reshape((-1,) + (1,) * (new.ndim - 1))
+        return jnp.where(f, old, new)
+
+    seq_len0 = jax.tree_util.tree_map(
+        lambda f: jnp.zeros(f.shape, jnp.int32), finished)
+    (last_inputs, final_states, finished, seq_len), outs = lax.scan(
+        step, (inputs, states, finished, seq_len0),
+        jnp.arange(max_step_num, dtype=jnp.int32))
+    try:
+        outs, final_states = decoder.finalize(outs, final_states, seq_len)
+    except NotImplementedError:
+        pass
+    if not output_time_major:
+        outs = jax.tree_util.tree_map(
+            lambda o: jnp.moveaxis(o, 0, 1) if o.ndim >= 2 else o, outs)
+    if return_length:
+        return outs, final_states, seq_len
+    return outs, final_states
+
+
+# -- helpers (teacher forcing / sampling) ------------------------------------
+
+class DecodeHelper:
+    """rnn.py:1096 — initialize/sample/next_inputs triplet."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """rnn.py:1152 — teacher forcing from padded [B, T, ...] inputs."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.inputs = _val(inputs)
+        if time_major:
+            self.inputs = jnp.moveaxis(self.inputs, 0, 1)
+        self.sequence_length = jnp.asarray(_val(sequence_length)).reshape(-1)
+
+    def initialize(self):
+        first = self.inputs[:, 0]
+        finished = self.sequence_length <= 0
+        return first, finished
+
+    def sample(self, time, outputs, states):
+        return jnp.argmax(outputs, axis=-1).astype(jnp.int32)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        t = jnp.minimum(time + 1, self.inputs.shape[1] - 1)
+        nxt = lax.dynamic_index_in_dim(
+            jnp.moveaxis(self.inputs, 0, 1), t, keepdims=False)
+        finished = (time + 1) >= self.sequence_length
+        return finished, nxt, states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """rnn.py:1244 — feed back argmax through the embedding."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = jnp.asarray(_val(start_tokens)).reshape(-1) \
+            .astype(jnp.int32)
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        finished = jnp.zeros(self.start_tokens.shape, bool)
+        return self.embedding_fn(self.start_tokens), finished
+
+    def sample(self, time, outputs, states):
+        return jnp.argmax(outputs, axis=-1).astype(jnp.int32)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        finished = sample_ids == self.end_token
+        return finished, self.embedding_fn(sample_ids), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """rnn.py:1305 — multinomial sampling instead of argmax."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        self.seed = seed if seed is not None else 0
+
+    def sample(self, time, outputs, states):
+        logits = outputs if self.temperature is None \
+            else outputs / self.temperature
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), time)
+        return jax.random.categorical(key, logits, axis=-1) \
+            .astype(jnp.int32)
+
+
+class BasicDecoder(Decoder):
+    """rnn.py:1364 — cell + helper + optional output layer."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        inputs, finished = self.helper.initialize()
+        return inputs, initial_cell_states, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_outputs, cell_states = self.cell(inputs, states, **kwargs)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time, cell_outputs, cell_states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, cell_outputs, cell_states, sample_ids)
+        outputs = {"cell_outputs": cell_outputs, "sample_ids": sample_ids}
+        return outputs, next_states, next_inputs, finished

@@ -935,3 +935,26 @@ def trilinear_interp(ins, attrs):
     shared interpolate kernel's 5-D branch."""
     return get_op("interpolate").fn(
         ins, {**attrs, "interp_method": "trilinear"})
+
+
+@register_op("tensor_array_to_tensor")
+def tensor_array_to_tensor(ins, attrs):
+    """operators/tensor_array_to_tensor_op.cc — concat (or stack) the
+    entries of a tensor array along `axis`."""
+    arr = ins["X"]
+    arr = list(arr) if isinstance(arr, (list, tuple)) else [arr]
+    axis = int(attrs.get("axis", 1))
+    if attrs.get("use_stack"):
+        return {"Out": jnp.stack([jnp.asarray(a) for a in arr], axis=axis)}
+    return {"Out": jnp.concatenate([jnp.asarray(a) for a in arr],
+                                   axis=axis)}
+
+
+@register_op("reorder_by_rank")
+def reorder_by_rank(ins, attrs):
+    """operators/reorder_lod_tensor_by_rank_op.cc — permute batch rows by
+    the rank table order (padded contract: RankTable is the [B] index
+    order itself)."""
+    x = jnp.asarray(ins["X"])
+    order = jnp.asarray(ins["RankTable"]).reshape(-1).astype(jnp.int32)
+    return {"Out": x[order]}

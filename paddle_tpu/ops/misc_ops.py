@@ -669,3 +669,14 @@ def get_places(ins, attrs):
 
     n = int(attrs.get("device_count", 0)) or len(_j.devices())
     return {"Out": jnp.arange(n, dtype=jnp.int32)}
+
+
+@register_op("print")
+def print_op(ins, attrs):
+    """operators/print_op.cc — runtime tensor peek; under jit this is
+    jax.debug.print (host callback), identity on the data path."""
+    x = jnp.asarray(ins["In"])
+    msg = attrs.get("message") or "print"
+    jax.debug.print("[{m}] shape={s} value={v}", m=msg, s=str(x.shape),
+                    v=x)
+    return {"Out": x}

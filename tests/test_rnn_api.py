@@ -1,0 +1,313 @@
+"""RNN cell / rnn() / dynamic_decode tests (parity model: the reference's
+test_rnn_cell_api.py, test_rnn_decode_api.py) plus the block-style
+control-flow additions (While / IfElse / case / switch_case)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers as L
+from paddle_tpu.layers.rnn import (
+    BasicDecoder, BeamSearchDecoder, GreedyEmbeddingHelper, GRUCell,
+    LSTMCell, TrainingHelper, dynamic_decode, lstm, rnn,
+)
+
+
+def test_gru_cell_shapes_and_rnn_masking():
+    rng = np.random.default_rng(0)
+    cell = GRUCell(6)
+    x = jnp.asarray(rng.standard_normal((3, 5, 6)).astype(np.float32))
+    lens = jnp.asarray([5, 3, 1])
+    outs, final = rnn(cell, x, sequence_length=lens)
+    assert outs.shape == (3, 5, 6)
+    # steps past length are zero and the carry froze at the length
+    assert np.allclose(np.asarray(outs[1, 3:]), 0.0)
+    np.testing.assert_allclose(np.asarray(final[1]),
+                               np.asarray(outs[1, 2]), atol=1e-6)
+
+
+def test_lstm_cell_reverse():
+    rng = np.random.default_rng(1)
+    cell = LSTMCell(4)
+    x = jnp.asarray(rng.standard_normal((2, 6, 4)).astype(np.float32))
+    outs, (h, c) = rnn(cell, x, is_reverse=True)
+    assert outs.shape == (2, 6, 4)
+    assert h.shape == (2, 4) and c.shape == (2, 4)
+    assert np.isfinite(np.asarray(outs)).all()
+
+
+def test_stacked_lstm_layer():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 5, 8)).astype(np.float32))
+    h0 = jnp.zeros((2, 2, 8), jnp.float32)
+    c0 = jnp.zeros((2, 2, 8), jnp.float32)
+    outs, last_h, last_c = lstm(x, h0, c0, hidden_size=8, num_layers=2)
+    assert outs.shape == (2, 5, 8)
+    assert last_h.shape == (2, 2, 8)
+
+
+def test_basic_decoder_training_helper_teacher_forces():
+    rng = np.random.default_rng(3)
+    b, t, h, v = 2, 4, 8, 12
+    cell = GRUCell(h)
+    emb = jnp.asarray(rng.standard_normal((v, h)).astype(np.float32))
+    proj = jnp.asarray(rng.standard_normal((h, v)).astype(np.float32))
+    tgt = rng.integers(0, v, (b, t))
+    helper = TrainingHelper(emb[jnp.asarray(tgt)], np.array([4, 2]))
+    dec = BasicDecoder(cell, helper, output_fn=lambda o: o @ proj)
+    outs, final = dynamic_decode(
+        dec, inits=cell.get_initial_states(jnp.zeros((b, 1))),
+        max_step_num=t)
+    assert outs["cell_outputs"].shape == (b, t, v)
+    assert outs["sample_ids"].shape == (b, t)
+
+
+def test_greedy_embedding_helper_decodes():
+    rng = np.random.default_rng(4)
+    b, h, v = 2, 8, 10
+    cell = GRUCell(h)
+    emb_table = jnp.asarray(rng.standard_normal((v, h)).astype(np.float32))
+    proj = jnp.asarray(rng.standard_normal((h, v)).astype(np.float32))
+    helper = GreedyEmbeddingHelper(lambda ids: emb_table[ids],
+                                   start_tokens=np.zeros(b, np.int64),
+                                   end_token=1)
+    dec = BasicDecoder(cell, helper, output_fn=lambda o: o @ proj)
+    outs, final, lengths = dynamic_decode(
+        dec, inits=cell.get_initial_states(jnp.zeros((b, 1))),
+        max_step_num=6, return_length=True)
+    assert outs["sample_ids"].shape == (b, 6)
+    assert (np.asarray(lengths) <= 6).all()
+
+
+def test_beam_search_decoder_end_to_end():
+    """Beam search over a rigged output head: token (step+2) is forced at
+    each step so the best path is deterministic."""
+    b, v, k = 2, 9, 3
+    # transition chain: logits prefer 2 after 0, 3 after 2, 4 after 3,
+    # then the end token 1 (which then prefers itself)
+    chain = np.full((v, v), -10.0, np.float32)
+    chain[0, 2] = 10.0
+    chain[2, 3] = 10.0
+    chain[3, 4] = 10.0
+    chain[4, 1] = 10.0
+    chain[1, 1] = 10.0
+
+    class ChainCell(GRUCell):
+        def call(self, inputs, states):
+            # states carries the previous token one-hot in the first v dims
+            return inputs, inputs
+
+    # simpler: rig embedding_fn to one-hot and output_fn to chain lookup
+    def embedding_fn(ids):
+        return jax.nn.one_hot(ids, v)
+
+    def out_fn(o):
+        return o @ jnp.asarray(chain)
+
+    cell2 = ChainCell(v)
+    dec = BeamSearchDecoder(cell2, start_token=0, end_token=1,
+                            beam_size=k, embedding_fn=embedding_fn,
+                            output_fn=out_fn)
+    init = jnp.zeros((b, v), jnp.float32)
+    outs, final = dynamic_decode(dec, inits=init, max_step_num=5)
+    ids = np.asarray(outs)          # [B, T, K] after finalize+move
+    best = ids[:, :, 0]
+    np.testing.assert_array_equal(best[0, :4], [2, 3, 4, 1])
+    np.testing.assert_array_equal(best[1, :4], [2, 3, 4, 1])
+
+
+def test_while_block_style():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = L.fill_constant([1], "int64", 0)
+        ten = L.fill_constant([1], "int64", 10)
+        acc = L.fill_constant([1], "float32", 0.0)
+        cond_v = L.less_than(i, ten)
+        loop = L.While(cond_v)
+        with loop.block():
+            new_i = L.increment(i, value=1, in_place=False)
+            new_acc = L.elementwise_add(acc,
+                                        L.fill_constant([1], "float32", 2.0))
+            L.assign(new_i, i)
+            L.assign(new_acc, acc)
+            L.assign(L.less_than(new_i, ten), cond_v)
+    exe = fluid.Executor()
+    exe.run(startup)
+    out = exe.run(main, fetch_list=[acc, i])
+    assert float(np.asarray(out[0]).reshape(())) == 20.0
+    assert int(np.asarray(out[1]).reshape(())) == 10
+
+
+def test_ifelse_block_style():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [1])
+        five = L.fill_constant([1], "float32", 5.0)
+        cond_v = L.less_than(x, five)
+        ie = L.IfElse(cond_v)
+        with ie.true_block():
+            ie.output(L.scale(x, scale=10.0))
+        with ie.false_block():
+            ie.output(L.scale(x, scale=-1.0))
+        out = ie()[0]
+    exe = fluid.Executor()
+    exe.run(startup)
+    lo = exe.run(main, feed={"x": np.array([2.0], np.float32)},
+                 fetch_list=[out])
+    hi = exe.run(main, feed={"x": np.array([7.0], np.float32)},
+                 fetch_list=[out])
+    assert float(np.asarray(lo[0]).reshape(())) == 20.0
+    assert float(np.asarray(hi[0]).reshape(())) == -7.0
+
+
+def test_case_and_switch_case():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [1])
+        one = L.fill_constant([1], "float32", 1.0)
+        two = L.fill_constant([1], "float32", 2.0)
+        r = L.case([(L.less_than(x, one), lambda: L.scale(x, scale=100.0)),
+                    (L.less_than(x, two), lambda: L.scale(x, scale=10.0))],
+                   default=lambda: L.scale(x, scale=1.0))
+        idx = fluid.data("idx", [1], dtype="int32")
+        s = L.switch_case(idx,
+                          {0: lambda: L.fill_constant([1], "float32", 7.0),
+                           1: lambda: L.fill_constant([1], "float32", 8.0)})
+    exe = fluid.Executor()
+    exe.run(startup)
+    feeds = {"x": np.array([0.5], np.float32),
+             "idx": np.array([1], np.int32)}
+    out = exe.run(main, feed=feeds, fetch_list=[r, s])
+    assert float(np.asarray(out[0]).reshape(())) == 50.0
+    assert float(np.asarray(out[1]).reshape(())) == 8.0
+    feeds = {"x": np.array([1.5], np.float32),
+             "idx": np.array([0], np.int32)}
+    out = exe.run(main, feed=feeds, fetch_list=[r, s])
+    assert float(np.asarray(out[0]).reshape(())) == 15.0
+    assert float(np.asarray(out[1]).reshape(())) == 7.0
+
+
+def test_io_plumbing_layers():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4, 3])
+        order = fluid.data("order", [4], dtype="int32")
+        re = L.reorder_lod_tensor_by_rank(x, order)
+        arr = L.create_array("float32")
+        i0 = L.fill_constant([1], "int64", 0)
+        i1 = L.fill_constant([1], "int64", 1)
+        L.array_write(L.scale(x, scale=1.0), i0, arr)
+        L.array_write(L.scale(x, scale=2.0), i1, arr)
+        stacked, _ = L.tensor_array_to_tensor(arr, axis=0, use_stack=True)
+        step = L.autoincreased_step_counter()
+    exe = fluid.Executor()
+    exe.run(startup)
+    xb = np.arange(12, dtype=np.float32).reshape(4, 3)
+    out = exe.run(main, feed={"x": xb,
+                              "order": np.array([3, 2, 1, 0], np.int32)},
+                  fetch_list=[re, stacked])
+    np.testing.assert_allclose(out[0], xb[::-1])
+    assert np.asarray(out[1]).shape == (2, 4, 3)
+
+
+def test_py_func_layer():
+    def my_op(a):
+        return a * 3.0 + 1.0
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 2])
+        out = main.global_block().create_var(
+            name="pyfunc_out", shape=[2, 2], dtype="float32")
+        L.py_func(my_op, x, out)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xb = np.ones((2, 2), np.float32)
+    r = exe.run(main, feed={"x": xb}, fetch_list=[out])
+    np.testing.assert_allclose(r[0], xb * 3.0 + 1.0)
+
+
+def test_py_reader_shim():
+    reader = L.py_reader(capacity=8, shapes=[[2, 3]], dtypes=["float32"],
+                         name="test")
+    data_var = L.read_file(reader)
+
+    def gen():
+        for i in range(2):
+            yield [np.full((2, 3), float(i), np.float32)]
+
+    reader.decorate_batch_generator(gen)
+    batches = list(reader)
+    assert len(batches) == 2
+    assert batches[1][data_var.name][0, 0] == 1.0
+
+
+def test_py_func_backward():
+    def fwd(a):
+        return a * a
+
+    def bwd(a, out, dout):
+        return 2.0 * a * dout          # d(a^2)/da
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [3])
+        out = main.global_block().create_var(
+            name="sq_out", shape=[3], dtype="float32")
+        L.py_func(fwd, x, out, backward_func=bwd)
+        from paddle_tpu.framework.backward import gradients
+        gx = gradients([out], [x])[0]
+    exe = fluid.Executor()
+    exe.run(startup)
+    xb = np.array([1.0, 2.0, 3.0], np.float32)
+    r = exe.run(main, feed={"x": xb}, fetch_list=[out, gx])
+    np.testing.assert_allclose(r[0], xb ** 2)
+    np.testing.assert_allclose(r[1], 2 * xb)
+
+
+def test_lstm_weights_persist_across_calls():
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((2, 4, 8)).astype(np.float32))
+    h0 = jnp.zeros((1, 2, 8), jnp.float32)
+    c0 = jnp.zeros((1, 2, 8), jnp.float32)
+    o1, _, _ = lstm(x, h0, c0, hidden_size=8, name="persist_test")
+    o2, _, _ = lstm(x, h0, c0, hidden_size=8, name="persist_test")
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_bidirectional_lstm_state_shapes():
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.standard_normal((3, 5, 8)).astype(np.float32))
+    h0 = jnp.zeros((2, 3, 8), jnp.float32)      # num_layers*2 directions
+    c0 = jnp.zeros((2, 3, 8), jnp.float32)
+    outs, last_h, last_c = lstm(x, h0, c0, hidden_size=8, num_layers=1,
+                                is_bidirec=True, name="bi_test")
+    assert outs.shape == (3, 5, 16)
+    assert last_h.shape == (2, 3, 8)
+    assert last_c.shape == (2, 3, 8)
+    # cell state differs from hidden state (the old bug returned h rows)
+    assert not np.allclose(np.asarray(last_h), np.asarray(last_c))
+
+
+def test_dynamic_rnn_block_style():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2, 4, 3])           # batch-major
+        drnn = L.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x)
+            mem = drnn.memory(shape=[3], value=0.0)
+            new = L.elementwise_add(xt, mem)
+            drnn.update_memory(mem, new)
+            drnn.output(new)
+        out = drnn()
+    exe = fluid.Executor()
+    exe.run(startup)
+    xb = np.ones((2, 4, 3), np.float32)
+    r = exe.run(main, feed={"x": xb}, fetch_list=[out])
+    # running sum over time: final step = 4
+    np.testing.assert_allclose(np.asarray(r[0])[:, -1], 4.0)
+    assert np.asarray(r[0]).shape == (2, 4, 3)
