@@ -337,10 +337,26 @@ def _run_while_block(op, env, rng_box, const_env=None):
         return tuple(jnp.asarray(local[n], init[i].dtype)
                      for i, n in enumerate(carry_names)) + (key,)
 
-    outs = jax.lax.while_loop(cond_fn, body_fn, init)
+    max_iters = a.get("max_iters")
+    if max_iters:
+        # bounded lax.scan lowering so reverse-mode AD can flow through
+        # the loop (same contract as the functional while_loop op)
+        def scan_body(carry, _):
+            run = cond_fn(carry)
+            new = body_fn(carry)
+            frozen = tuple(jnp.where(run, n, c)
+                           for n, c in zip(new[:-1], carry[:-1]))
+            return frozen + (new[-1],), None
+
+        outs, _ = jax.lax.scan(scan_body, init, None,
+                               length=int(max_iters))
+    else:
+        outs = jax.lax.while_loop(cond_fn, body_fn, init)
     for n, v in zip(carry_names, outs[:-1]):
         env[n] = v
 
+
+_SIDE_EFFECT_OPS = {"print"}
 
 _CONTROL_FLOW_OPS = {
     "cond": _run_cond,
@@ -674,7 +690,10 @@ class Executor:
         keep = [False] * len(ops)
         for i in range(len(ops) - 1, -1, -1):
             outs = set(ops[i].output_names())
-            if outs & needed or outs & persist:
+            # side-effecting ops (runtime printing) survive regardless of
+            # consumers — their output IS the side effect
+            if outs & needed or outs & persist \
+                    or ops[i].type in _SIDE_EFFECT_OPS:
                 keep[i] = True
                 needed |= set(ops[i].input_names())
         return [op for i, op in enumerate(ops) if keep[i]]

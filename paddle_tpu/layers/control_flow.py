@@ -475,9 +475,14 @@ class While:
             ... assign(new_i, i); assign(new_cond, cond)
     """
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None, max_iters=None):
+        """max_iters: optional bound — when set, the loop lowers to a
+        lax.scan of exactly max_iters steps with a frozen-carry mask,
+        which is REQUIRED for gradients through the loop (XLA cannot
+        reverse-differentiate an unbounded while)."""
         self._cond = cond
         self._name = name
+        self._max_iters = max_iters
         self._program = cond.block.program
 
     def block(self):
@@ -510,7 +515,9 @@ class While:
                         "Captured": _captured_names([blk])},
                 outputs={"Out": written},
                 attrs={"body_block": blk.idx,
-                       "cond_name": self._cond.name})
+                       "cond_name": self._cond.name,
+                       "max_iters": (int(self._max_iters)
+                                     if self._max_iters else None)})
 
         return guard()
 

@@ -63,19 +63,33 @@ class RNNCell(nn.Layer):
 
 
 class GRUCell(RNNCell):
-    """rnn.py:160 GRUCell (gate order matches operators/gru_unit_op)."""
+    """rnn.py:160 GRUCell (gate order matches operators/gru_unit_op).
+    Input weights build lazily from the first input's width, like the
+    reference cells' build_once — embed_dim != hidden_size works."""
 
     def __init__(self, hidden_size, param_attr=None, bias_attr=None,
                  dtype="float32", name=None):
         super().__init__(dtype=dtype)
         self.hidden_size = hidden_size
-        self._ih = nn.Linear(hidden_size, 3 * hidden_size,
-                             param_attr=param_attr, dtype=dtype)
+        self._param_attr = param_attr
+        self._ih = None
         self._hh = nn.Linear(hidden_size, 3 * hidden_size,
                              param_attr=param_attr,
                              bias_attr=bias_attr, dtype=dtype)
 
+    def _build(self, inputs):
+        if self._ih is None:
+            # first call may happen inside a lax.scan trace: force the
+            # parameter init to evaluate eagerly (concrete arrays, no
+            # tracer leak out of the scan)
+            with jax.ensure_compile_time_eval():
+                self._ih = nn.Linear(int(_val(inputs).shape[-1]),
+                                     3 * self.hidden_size,
+                                     param_attr=self._param_attr,
+                                     dtype=self._dtype)
+
     def call(self, inputs, states):
+        self._build(inputs)
         h = states
         gi = self._ih(_val(inputs))
         gh = self._hh(_val(h))
@@ -93,20 +107,31 @@ class GRUCell(RNNCell):
 
 
 class LSTMCell(RNNCell):
-    """rnn.py:232 LSTMCell — states are [h, c]."""
+    """rnn.py:232 LSTMCell — states are [h, c]; input weights build
+    lazily from the first input's width (reference build_once)."""
 
     def __init__(self, hidden_size, param_attr=None, bias_attr=None,
                  forget_bias=1.0, dtype="float32", name=None):
         super().__init__(dtype=dtype)
         self.hidden_size = hidden_size
         self._forget_bias = forget_bias
-        self._ih = nn.Linear(hidden_size, 4 * hidden_size,
-                             param_attr=param_attr, dtype=dtype)
+        self._param_attr = param_attr
+        self._ih = None
         self._hh = nn.Linear(hidden_size, 4 * hidden_size,
                              param_attr=param_attr, bias_attr=bias_attr,
                              dtype=dtype)
 
+    def _build(self, inputs):
+        if self._ih is None:
+            # see GRUCell._build: eager init even under a scan trace
+            with jax.ensure_compile_time_eval():
+                self._ih = nn.Linear(int(_val(inputs).shape[-1]),
+                                     4 * self.hidden_size,
+                                     param_attr=self._param_attr,
+                                     dtype=self._dtype)
+
     def call(self, inputs, states):
+        self._build(inputs)
         h, c = states
         gates = self._ih(_val(inputs)) + self._hh(_val(h))
         i, f, g, o = jnp.split(gates, 4, axis=-1)
@@ -145,13 +170,18 @@ def rnn(cell, inputs, initial_states=None, sequence_length=None,
             if is_reverse:
                 # reversed scan: step i touches original position t-1-i,
                 # live when i >= t - len
-                live = (i >= (t - length))[:, None]
+                live = i >= (t - length)
             else:
-                live = (i < length)[:, None]
+                live = i < length
+
+            def bc(ref):
+                # broadcast [B] liveness against any-rank [B, ...] value
+                return live.reshape((-1,) + (1,) * (ref.ndim - 1))
+
             new_states = jax.tree_util.tree_map(
-                lambda new, old: jnp.where(live, new, old),
+                lambda new, old: jnp.where(bc(new), new, old),
                 new_states, carry)
-            out = jnp.where(live, out, jnp.zeros_like(out))
+            out = jnp.where(bc(out), out, jnp.zeros_like(out))
         return new_states, out
 
     idx = jnp.arange(t, dtype=jnp.int32)
@@ -187,12 +217,12 @@ def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
     """nn.py lstm (the cudnn_lstm layer, cudnn_lstm_op.cu.cc) — stacked
     LSTM over the padded batch.  init_h/init_c: [num_layers*D, B, H].
 
-    Weights persist across calls: cells (and input projections) are
-    cached by (name, geometry) like the reference's named graph
-    parameters — pass `cells` explicitly (list of per-layer cells, each
-    a LSTMCell or (fw, bw) pair) to own the parameters, e.g. to register
-    them on a model for the optimizer; `lstm.get_cells(name, ...)`
-    returns the cached set."""
+    Weights persist across calls: cells are cached by (name, geometry)
+    like the reference's named graph parameters — pass `cells`
+    explicitly (list of per-layer cells, each a LSTMCell or (fw, bw)
+    pair) to own the parameters, e.g. to register them on a model for
+    the optimizer; `lstm.get_cells(name, ...)` returns the cached
+    list."""
     x = _val(input)
     hidden_size = hidden_size or x.shape[-1]
     h0 = _val(init_h)
@@ -209,19 +239,14 @@ def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
                                   LSTMCell(hidden_size, dtype=dtype)))
                 else:
                     cells.append(LSTMCell(hidden_size, dtype=dtype))
-            proj = (nn.Linear(int(x.shape[-1]), hidden_size, dtype=dtype)
-                    if x.shape[-1] != hidden_size else None)
-            cells = (cells, proj)
             _LSTM_CACHE[key] = cells
-    layer_cells, proj = cells
+    layer_cells = cells
     outs = x
     last_h, last_c = [], []
     for layer in range(num_layers):
-        if outs.shape[-1] != hidden_size:
-            if proj is None:
-                proj = nn.Linear(int(outs.shape[-1]), hidden_size,
-                                 dtype=dtype)
-            outs = proj(outs)
+        # cells size their input weights lazily, so inter-layer width
+        # changes (input dim, 2H bidirectional outputs) need no extra
+        # projection
         if is_bidirec:
             cf, cb = layer_cells[layer]
             fw_init = [h0[2 * layer], c0[2 * layer]]
@@ -247,7 +272,7 @@ def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
 
 def _lstm_get_cells(name="lstm", num_layers=1, hidden_size=None,
                     is_bidirec=False, dtype="float32", input_size=None):
-    """The cached (cells, projection) for a named lstm() call — collect
+    """The cached per-layer cells for a named lstm() call — collect
     trainable parameters from here."""
     key = (name, num_layers, hidden_size, is_bidirec, dtype, input_size)
     return _LSTM_CACHE.get(key)

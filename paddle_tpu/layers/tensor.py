@@ -72,12 +72,22 @@ def sums(input, name=None):
 def assign(input, output=None):
     helper = LayerHelper("assign")
     if isinstance(input, np.ndarray):
-        # materialize as constant
+        # materialize as constant, dtype-faithfully: integers must not
+        # round-trip through float32 (values above 2^24 would corrupt)
         out = output or helper.create_variable_for_type_inference(str(input.dtype))
+        if np.issubdtype(input.dtype, np.integer):
+            slot = "int64_values"
+            vals = input.astype(np.int64).flatten().tolist()
+        elif input.dtype == np.bool_:
+            slot = "bool_values"
+            vals = input.flatten().tolist()
+        else:
+            slot = "fp32_values"
+            vals = input.astype(np.float32).flatten().tolist()
         helper.append_op(
             "assign_value", outputs={"Out": out},
             attrs={"shape": list(input.shape), "dtype": str(input.dtype),
-                   "fp32_values": input.astype(np.float32).flatten().tolist()})
+                   slot: vals})
         return out
     out = output or helper.create_variable_for_type_inference(input.dtype)
     helper.append_op("assign", inputs={"X": input}, outputs={"Out": out})

@@ -85,12 +85,16 @@ def matmul(ins, attrs):
 @register_op("mul")
 def mul(ins, attrs):
     """operators/mul_op.cc — flatten x to 2-D at x_num_col_dims, y likewise."""
+    import math as _math
+
     x, y = ins["X"], ins["Y"]
     xnc = attrs.get("x_num_col_dims", 1)
     ync = attrs.get("y_num_col_dims", 1)
     xs, ys = x.shape, y.shape
-    x2 = x.reshape((int(jnp.prod(jnp.array(xs[:xnc]))), -1)) if x.ndim > 2 else x
-    y2 = y.reshape((-1, int(jnp.prod(jnp.array(ys[ync:]))))) if y.ndim > 2 else y
+    # shapes are static python ints; math.prod keeps them that way (a
+    # jnp.prod here becomes a traced scalar under some transform stacks)
+    x2 = x.reshape((_math.prod(xs[:xnc]), -1)) if x.ndim > 2 else x
+    y2 = y.reshape((-1, _math.prod(ys[ync:]))) if y.ndim > 2 else y
     out = x2 @ y2
     out_shape = xs[:xnc] + ys[ync:]
     return {"Out": out.reshape(out_shape)}

@@ -311,3 +311,81 @@ def test_dynamic_rnn_block_style():
     # running sum over time: final step = 4
     np.testing.assert_allclose(np.asarray(r[0])[:, -1], 4.0)
     assert np.asarray(r[0]).shape == (2, 4, 3)
+
+
+def test_cells_accept_different_input_width():
+    """embed_dim != hidden_size (reference build_once behavior)."""
+    rng = np.random.default_rng(9)
+    cell = GRUCell(16)
+    x = jnp.asarray(rng.standard_normal((2, 5, 8)).astype(np.float32))
+    outs, final = rnn(cell, x)
+    assert outs.shape == (2, 5, 16)
+    cell2 = LSTMCell(12)
+    outs2, _ = rnn(cell2, x)
+    assert outs2.shape == (2, 5, 12)
+
+
+def test_stacked_bidirec_lstm_persists_and_projects():
+    rng = np.random.default_rng(10)
+    x = jnp.asarray(rng.standard_normal((2, 4, 6)).astype(np.float32))
+    h0 = jnp.zeros((4, 2, 8), jnp.float32)   # 2 layers * 2 dirs
+    c0 = jnp.zeros((4, 2, 8), jnp.float32)
+    o1, lh, lc = lstm(x, h0, c0, hidden_size=8, num_layers=2,
+                      is_bidirec=True, name="bi2_test")
+    o2, _, _ = lstm(x, h0, c0, hidden_size=8, num_layers=2,
+                    is_bidirec=True, name="bi2_test")
+    assert o1.shape == (2, 4, 16)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_while_block_with_grad_via_max_iters():
+    """Bounded While participates in backward (the scan lowering)."""
+    from paddle_tpu.framework.backward import gradients
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [1])
+        i = L.fill_constant([1], "int64", 0)
+        three = L.fill_constant([1], "int64", 3)
+        acc = L.scale(x, scale=1.0)
+        cond_v = L.less_than(i, three)
+        loop = L.While(cond_v, max_iters=5)
+        with loop.block():
+            L.assign(L.scale(acc, scale=2.0), acc)
+            new_i = L.increment(i, value=1, in_place=False)
+            L.assign(new_i, i)
+            L.assign(L.less_than(new_i, three), cond_v)
+        gx = gradients([L.mean(acc)], [x])[0]
+    exe = fluid.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"x": np.array([1.5], np.float32)},
+                  fetch_list=[acc, gx])
+    assert float(np.asarray(out[0]).reshape(())) == 12.0   # 1.5 * 2^3
+    np.testing.assert_allclose(np.asarray(out[1]), [8.0])  # d(acc)/dx
+
+
+def test_print_op_survives_pruning(capfd):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [2])
+        y = L.scale(x, scale=2.0)
+        L.Print(y, message="probe")       # return value discarded
+    exe = fluid.Executor()
+    exe.run(startup)
+    exe.run(main, feed={"x": np.array([1.0, 2.0], np.float32)},
+            fetch_list=[y])
+    out = capfd.readouterr()
+    assert "probe" in out.out or "probe" in out.err
+
+
+def test_assign_int64_fidelity():
+    # above float32's 2^24 exact-integer range (the corruption the fp32
+    # round-trip caused) but within the device int32 contract
+    big = np.array([2**30 + 7], np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = L.assign(big)
+    exe = fluid.Executor()
+    exe.run(startup)
+    out = exe.run(main, fetch_list=[v])
+    assert int(np.asarray(out[0]).reshape(())) == 2**30 + 7
