@@ -68,7 +68,7 @@ class GRUCell(RNNCell):
     reference cells' build_once — embed_dim != hidden_size works."""
 
     def __init__(self, hidden_size, param_attr=None, bias_attr=None,
-                 dtype="float32", name=None):
+                 dtype="float32", name=None, input_size=None):
         super().__init__(dtype=dtype)
         self.hidden_size = hidden_size
         self._param_attr = param_attr
@@ -76,6 +76,13 @@ class GRUCell(RNNCell):
         self._hh = nn.Linear(hidden_size, 3 * hidden_size,
                              param_attr=param_attr,
                              bias_attr=bias_attr, dtype=dtype)
+        if input_size is not None:
+            self._ih = nn.Linear(int(input_size), 3 * hidden_size,
+                                 param_attr=param_attr, dtype=dtype)
+
+    @property
+    def _lazy_unbuilt(self):
+        return self._ih is None
 
     def _build(self, inputs):
         if self._ih is None:
@@ -111,7 +118,8 @@ class LSTMCell(RNNCell):
     lazily from the first input's width (reference build_once)."""
 
     def __init__(self, hidden_size, param_attr=None, bias_attr=None,
-                 forget_bias=1.0, dtype="float32", name=None):
+                 forget_bias=1.0, dtype="float32", name=None,
+                 input_size=None):
         super().__init__(dtype=dtype)
         self.hidden_size = hidden_size
         self._forget_bias = forget_bias
@@ -120,6 +128,13 @@ class LSTMCell(RNNCell):
         self._hh = nn.Linear(hidden_size, 4 * hidden_size,
                              param_attr=param_attr, bias_attr=bias_attr,
                              dtype=dtype)
+        if input_size is not None:
+            self._ih = nn.Linear(int(input_size), 4 * hidden_size,
+                                 param_attr=param_attr, dtype=dtype)
+
+    @property
+    def _lazy_unbuilt(self):
+        return self._ih is None
 
     def _build(self, inputs):
         if self._ih is None:

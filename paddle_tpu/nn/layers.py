@@ -329,6 +329,15 @@ def buffer_dict(layer):
 
 
 def param_dict(layer, trainable_only=False):
+    unbuilt = [type(m).__name__ for m in layer.sublayers(include_self=True)
+               if getattr(m, "_lazy_unbuilt", False)]
+    if unbuilt:
+        import warnings
+        warnings.warn(
+            f"param_dict: {unbuilt} have lazily-built weights that do not "
+            f"exist yet — call the layer once (or pass input_size at "
+            f"construction) before collecting params, or those weights "
+            f"will be invisible to the optimizer", stacklevel=2)
     return {
         n: p.value
         for n, p in layer.named_parameters()

@@ -20,6 +20,7 @@ from . import detection_ops  # noqa: F401
 from . import roi_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import extended_ops  # noqa: F401
+from . import fused_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 
 __all__ = ["register_op", "get_op", "has_op", "list_ops"]

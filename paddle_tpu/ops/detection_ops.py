@@ -663,3 +663,340 @@ def yolov3_loss(ins, attrs):
     return {"Loss": loss,
             "ObjectnessMask": obj_t,
             "GTMatchMask": responsible.astype(jnp.int32)}
+
+
+# --------------------------------------------------------------------------
+# round-2 parity additions: NMS variants, mAP, R-CNN label sampling
+# --------------------------------------------------------------------------
+
+@register_op("multiclass_nms2")
+def multiclass_nms2(ins, attrs):
+    """detection/multiclass_nms_op.cc:480 (MultiClassNMS2Op) — same as
+    multiclass_nms plus an Index output mapping each kept row back to its
+    flattened input box index."""
+    boxes = jnp.asarray(ins["BBoxes"])
+    scores = jnp.asarray(ins["Scores"])
+    if boxes.ndim == 3 and boxes.shape[0] == 1:
+        boxes = boxes[0]
+    score_thresh = float(attrs.get("score_threshold", 0.0))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    background = int(attrs.get("background_label", 0))
+    normalized = bool(attrs.get("normalized", True))
+    c, m = scores.shape
+    all_scores, all_rows, all_idx = [], [], []
+    for cls in range(c):
+        if cls == background:
+            continue
+        keep = nms_mask(boxes, scores[cls], nms_thresh, nms_top_k,
+                        normalized, score_thresh)
+        all_scores.append(jnp.where(keep, scores[cls], BIG_NEG))
+        all_rows.append(jnp.concatenate([
+            jnp.full((m, 1), cls, boxes.dtype),
+            scores[cls][:, None], boxes], axis=1))
+        all_idx.append(jnp.arange(m, dtype=jnp.int32))
+    cat_scores = jnp.concatenate(all_scores)
+    cat_rows = jnp.concatenate(all_rows, axis=0)
+    cat_idx = jnp.concatenate(all_idx)
+    k = min(keep_top_k if keep_top_k > 0 else cat_scores.shape[0],
+            cat_scores.shape[0])
+    top_scores, top_idx = jax.lax.top_k(cat_scores, k)
+    valid = top_scores > BIG_NEG / 2
+    out = jnp.where(valid[:, None], cat_rows[top_idx], 0.0)
+    index = jnp.where(valid, cat_idx[top_idx], -1).astype(jnp.int32)
+    return {"Out": out, "Index": index[:, None],
+            "NumOut": valid.sum().astype(jnp.int32)}
+
+
+@register_op("locality_aware_nms")
+def locality_aware_nms(ins, attrs):
+    """detection/locality_aware_nms_op.cc — EAST-style NMS: boxes first
+    merge with overlapping neighbours by score-weighted average, then
+    standard per-class NMS. Fixed-shape: one merge sweep in score order
+    (the reference's sequential local merge), mask-packed output."""
+    boxes = jnp.asarray(ins["BBoxes"])          # [1, M, 4] or [M, 4]
+    scores = jnp.asarray(ins["Scores"])         # [1, C, M] or [C, M]
+    if boxes.ndim == 3:
+        boxes = boxes[0]
+    if scores.ndim == 3:
+        scores = scores[0]
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    score_thresh = float(attrs.get("score_threshold", 0.0))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    normalized = bool(attrs.get("normalized", True))
+    background = int(attrs.get("background_label", -1))
+    c, m = scores.shape
+    all_scores, all_rows = [], []
+    for cls in range(c):
+        if cls == background:
+            continue
+        s = scores[cls]
+        iou = iou_matrix(boxes, boxes, normalized)      # [M, M]
+        near = (iou > nms_thresh) & (s[None, :] > score_thresh)
+        w = jnp.where(near, s[None, :], 0.0)            # [M, M] weights
+        wsum = jnp.maximum(w.sum(axis=1, keepdims=True), 1e-10)
+        merged = (w @ boxes) / wsum                     # weighted average
+        merged_s = jnp.where(s > score_thresh,
+                             (w * s[None, :]).sum(axis=1)
+                             / wsum[:, 0], s)
+        keep = nms_mask(merged, merged_s, nms_thresh, -1, normalized,
+                        score_thresh)
+        all_scores.append(jnp.where(keep, merged_s, BIG_NEG))
+        all_rows.append(jnp.concatenate([
+            jnp.full((m, 1), cls, boxes.dtype),
+            merged_s[:, None], merged], axis=1))
+    cat_scores = jnp.concatenate(all_scores)
+    cat_rows = jnp.concatenate(all_rows, axis=0)
+    k = min(keep_top_k if keep_top_k > 0 else cat_scores.shape[0],
+            cat_scores.shape[0])
+    top_scores, top_idx = jax.lax.top_k(cat_scores, k)
+    valid = top_scores > BIG_NEG / 2
+    out = jnp.where(valid[:, None], cat_rows[top_idx], 0.0)
+    return {"Out": out, "NumOut": valid.sum().astype(jnp.int32)}
+
+
+@register_op("detection_map")
+def detection_map(ins, attrs):
+    """detection_map_op.cc — mAP over one batch of detections vs labels.
+    DetectRes rows: [label, score, x1, y1, x2, y2]; Label rows:
+    [label, x1, y1, x2, y2] (+optional difficult). Returns the 11-point or
+    integral AP averaged over classes present in labels, plus accumulator
+    passthroughs shaped for streaming use."""
+    det = jnp.asarray(ins["DetectRes"])         # [D, 6]
+    gt = jnp.asarray(ins["Label"])              # [G, 5] or [G, 6]
+    overlap_t = float(attrs.get("overlap_threshold", 0.5))
+    ap_type = attrs.get("ap_type", "integral")
+    class_num = int(attrs.get("class_num", 0))
+    if class_num <= 0:
+        if isinstance(gt, jax.core.Tracer):
+            raise ValueError(
+                "detection_map needs an explicit class_num attr when run "
+                "inside a compiled program (labels are traced values)")
+        class_num = max(1, 1 + int(jnp.max(gt[:, 0]))) if gt.shape[0] else 1
+    gt_label = gt[:, 0].astype(jnp.int32)
+    gt_boxes = gt[:, -4:]
+    d_label = det[:, 0].astype(jnp.int32)
+    d_score = det[:, 1]
+    d_boxes = det[:, 2:6]
+    iou = iou_matrix(d_boxes, gt_boxes, True)   # [D, G]
+    same = d_label[:, None] == gt_label[None, :]
+    iou = jnp.where(same, iou, 0.0)
+
+    order = jnp.argsort(-d_score)
+    aps = []
+    for cls in range(class_num):
+        npos = (gt_label == cls).sum()
+        matched = jnp.zeros((gt.shape[0],), bool)
+        tp = jnp.zeros((det.shape[0],))
+        fp = jnp.zeros((det.shape[0],))
+
+        def body(i, carry):
+            matched, tp, fp = carry
+            d = order[i]
+            is_cls = d_label[d] == cls
+            ious = jnp.where(matched, 0.0, iou[d])
+            j = jnp.argmax(ious)
+            hit = is_cls & (ious[j] >= overlap_t)
+            matched = matched.at[j].set(matched[j] | hit)
+            tp = tp.at[i].set(jnp.where(is_cls & hit, 1.0, 0.0))
+            fp = fp.at[i].set(jnp.where(is_cls & ~hit, 1.0, 0.0))
+            return matched, tp, fp
+
+        matched, tp, fp = jax.lax.fori_loop(
+            0, det.shape[0], body, (matched, tp, fp))
+        ctp = jnp.cumsum(tp)
+        cfp = jnp.cumsum(fp)
+        recall = ctp / jnp.maximum(npos, 1)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+        if ap_type == "11point":
+            pts = [jnp.where(recall >= t / 10.0, precision, 0.0).max()
+                   for t in range(11)]
+            ap = jnp.stack(pts).mean()
+        else:
+            dr = jnp.diff(recall, prepend=0.0)
+            ap = (precision * dr).sum()
+        aps.append(jnp.where(npos > 0, ap, jnp.nan))
+    aps = jnp.stack(aps)
+    have = ~jnp.isnan(aps)
+    m_ap = jnp.where(have, aps, 0.0).sum() / jnp.maximum(have.sum(), 1)
+    return {"MAP": m_ap.astype(jnp.float32),
+            "AccumPosCount": jnp.zeros((class_num,), jnp.int32),
+            "AccumTruePos": det[:, :2],
+            "AccumFalsePos": det[:, :2]}
+
+
+def _bbox_transform_targets(rois, gt, weights):
+    """Encode gt boxes against rois (Fast R-CNN deltas)."""
+    rw = rois[:, 2] - rois[:, 0] + 1.0
+    rh = rois[:, 3] - rois[:, 1] + 1.0
+    rx = rois[:, 0] + 0.5 * rw
+    ry = rois[:, 1] + 0.5 * rh
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gx = gt[:, 0] + 0.5 * gw
+    gy = gt[:, 1] + 0.5 * gh
+    wx, wy, ww, wh = weights
+    # reference bbox_util.h:77-81 BoxToDelta DIVIDES deltas by the weights
+    return jnp.stack([
+        (gx - rx) / rw / wx, (gy - ry) / rh / wy,
+        jnp.log(gw / rw) / ww, jnp.log(gh / rh) / wh], axis=1)
+
+
+@register_op("generate_proposal_labels", needs_rng=True)
+def generate_proposal_labels(ins, attrs):
+    """detection/generate_proposal_labels_op.cc — sample fg/bg RoIs for
+    Fast R-CNN training and emit classification + regression targets.
+    Fixed-shape variant: batch_size_per_im rows, mask-padded (the
+    reference emits ragged LoD rows)."""
+    rois = jnp.asarray(ins["RpnRois"]).reshape(-1, 4)
+    gt_classes = jnp.asarray(ins["GtClasses"]).reshape(-1).astype(jnp.int32)
+    gt_boxes = jnp.asarray(ins["GtBoxes"]).reshape(-1, 4)
+    is_crowd = (jnp.asarray(ins["IsCrowd"]).reshape(-1)
+                if ins.get("IsCrowd") is not None
+                else jnp.zeros((gt_boxes.shape[0],)))
+    batch_size = int(attrs.get("batch_size_per_im", 256))
+    fg_fraction = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    weights = attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    class_nums = int(attrs.get("class_nums", 81))
+    # candidate pool = proposals + gt boxes (reference appends gt)
+    cand = jnp.concatenate([rois, gt_boxes], axis=0)
+    iou = iou_matrix(gt_boxes, cand, normalized=False)   # [G, R]
+    iou = jnp.where(is_crowd[:, None] > 0, 0.0, iou)
+    best = iou.max(axis=0)
+    gt_of = iou.argmax(axis=0)
+    fg = best >= fg_thresh
+    bg = (best < bg_hi) & (best >= bg_lo)
+    n_fg = int(round(batch_size * fg_fraction))
+    n_bg = batch_size - n_fg
+    key = attrs.get("_rng")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    # randomized priority sample: noise in [0,1) breaks ties, invalid
+    # candidates sink to the bottom
+    fg_pri = fg.astype(jnp.float32) + jax.random.uniform(
+        k1, best.shape, minval=0.0, maxval=0.5)
+    bg_pri = bg.astype(jnp.float32) + jax.random.uniform(
+        k2, best.shape, minval=0.0, maxval=0.5)
+    _, fg_idx = jax.lax.top_k(fg_pri, n_fg)
+    _, bg_idx = jax.lax.top_k(bg_pri, n_bg)
+    fg_valid = fg[fg_idx]
+    bg_valid = bg[bg_idx]
+    sel = jnp.concatenate([fg_idx, bg_idx]).astype(jnp.int32)
+    valid = jnp.concatenate([fg_valid, bg_valid])
+    out_rois = jnp.where(valid[:, None], cand[sel], 0.0)
+    labels = jnp.where(
+        jnp.concatenate([fg_valid, jnp.zeros((n_bg,), bool)]),
+        gt_classes[gt_of[sel]], 0).astype(jnp.int32)
+    labels = jnp.where(valid, labels, -1)
+    tgt = _bbox_transform_targets(cand[sel], gt_boxes[gt_of[sel]],
+                                  weights)
+    is_fg = jnp.concatenate(
+        [fg_valid, jnp.zeros((n_bg,), bool)])[:, None]
+    # per-class regression layout [R, 4*class_nums]
+    bbox_targets = jnp.zeros((batch_size, 4 * class_nums), tgt.dtype)
+    col = labels.clip(0) * 4
+    rows = jnp.arange(batch_size)[:, None]
+    cols = col[:, None] + jnp.arange(4)[None, :]
+    bbox_targets = bbox_targets.at[rows, cols].set(
+        jnp.where(is_fg, tgt, 0.0))
+    inside_w = jnp.zeros_like(bbox_targets).at[rows, cols].set(
+        jnp.where(is_fg, 1.0, 0.0))
+    return {"Rois": out_rois,
+            "LabelsInt32": labels,
+            "BboxTargets": bbox_targets,
+            "BboxInsideWeights": inside_w,
+            "BboxOutsideWeights": inside_w,
+            "RoisNum": valid.sum().astype(jnp.int32)}
+
+
+@register_op("generate_mask_labels")
+def generate_mask_labels(ins, attrs):
+    """detection/generate_mask_labels_op.cc — Mask R-CNN mask targets.
+    Design deviation (documented): GtSegms is a dense binary mask stack
+    [G, H, W] rather than LoD polygon lists — polygon rasterization is
+    host-side data prep in this framework, not a device op. Each fg roi
+    crops + resizes its matched gt mask to resolution^2."""
+    im_info = jnp.asarray(ins["ImInfo"]).reshape(-1, 3)
+    gt_classes = jnp.asarray(ins["GtClasses"]).reshape(-1).astype(jnp.int32)
+    gt_segms = jnp.asarray(ins["GtSegms"])      # [G, H, W] binary
+    rois = jnp.asarray(ins["Rois"]).reshape(-1, 4)
+    labels = jnp.asarray(ins["LabelsInt32"]).reshape(-1).astype(jnp.int32)
+    num_classes = int(attrs.get("num_classes", 81))
+    res = int(attrs.get("resolution", 14))
+    g, hh, ww = gt_segms.shape
+    # match each roi to the gt mask with max overlap (via mask bbox)
+    ys = jnp.any(gt_segms > 0, axis=2)
+    xs = jnp.any(gt_segms > 0, axis=1)
+    xi = jnp.arange(ww)[None, :]
+    yi = jnp.arange(hh)[None, :]
+    x1 = jnp.where(xs, xi, ww).min(axis=1)
+    x2 = jnp.where(xs, xi, -1).max(axis=1)
+    y1 = jnp.where(ys, yi, hh).min(axis=1)
+    y2 = jnp.where(ys, yi, -1).max(axis=1)
+    gt_boxes = jnp.stack([x1, y1, x2, y2], axis=1).astype(rois.dtype)
+    iou = iou_matrix(rois, gt_boxes, normalized=False)   # [R, G]
+    gt_of = iou.argmax(axis=1)
+    fg = labels > 0
+
+    def crop_one(roi, gi):
+        mask = gt_segms[gi].astype(jnp.float32)[None, None]   # [1,1,H,W]
+        rx1, ry1, rx2, ry2 = roi
+        # sample a res x res grid inside the roi
+        gy = ry1 + (jnp.arange(res) + 0.5) / res * (ry2 - ry1)
+        gx = rx1 + (jnp.arange(res) + 0.5) / res * (rx2 - rx1)
+        iy = jnp.clip(gy, 0, hh - 1).astype(jnp.int32)
+        ix = jnp.clip(gx, 0, ww - 1).astype(jnp.int32)
+        return mask[0, 0][iy[:, None], ix[None, :]]
+
+    crops = jax.vmap(crop_one)(rois, gt_of)     # [R, res, res]
+    crops = (crops > 0.5).astype(jnp.int32)
+    crops = jnp.where(fg[:, None, None], crops, -1)
+    # per-class layout: [R, num_classes * res * res] one-hot by label
+    flat = crops.reshape(crops.shape[0], -1)
+    out = jnp.full((rois.shape[0], num_classes * res * res), -1,
+                   jnp.int32)
+    col0 = labels.clip(0) * res * res
+    cols = col0[:, None] + jnp.arange(res * res)[None, :]
+    out = out.at[jnp.arange(rois.shape[0])[:, None], cols].set(
+        jnp.where(fg[:, None], flat, -1))
+    return {"MaskRois": jnp.where(fg[:, None], rois, 0.0),
+            "RoiHasMaskInt32": fg.astype(jnp.int32),
+            "MaskInt32": out}
+
+
+@register_op("retinanet_target_assign")
+def retinanet_target_assign(ins, attrs):
+    """detection/rpn_target_assign_op.cc:587 (RetinanetTargetAssign) —
+    focal-loss anchor assignment: positive iff IoU >= positive_overlap
+    (or best anchor for a gt), negative iff max IoU < negative_overlap;
+    emits encoded regression targets and a fg count (the focal-loss
+    normalizer). Dense-mask variant of the reference's index lists."""
+    anchors = jnp.asarray(ins["Anchor"]).reshape(-1, 4)
+    gt = jnp.asarray(ins["GtBoxes"]).reshape(-1, 4)
+    gt_labels = jnp.asarray(ins["GtLabels"]).reshape(-1).astype(jnp.int32)
+    pos_t = float(attrs.get("positive_overlap", 0.5))
+    neg_t = float(attrs.get("negative_overlap", 0.4))
+    iou = iou_matrix(gt, anchors, normalized=False)      # [G, A]
+    best = iou.max(axis=0)
+    gt_of = iou.argmax(axis=0)
+    best_anchor = iou.argmax(axis=1)
+    is_best = jnp.zeros((anchors.shape[0],), bool).at[best_anchor].set(True)
+    pos = (best >= pos_t) | is_best
+    neg = (best < neg_t) & ~pos
+    labels = jnp.where(pos, gt_labels[gt_of],
+                       jnp.where(neg, 0, -1)).astype(jnp.int32)
+    tgt = _bbox_transform_targets(anchors, gt[gt_of],
+                                  [1.0, 1.0, 1.0, 1.0])
+    n = anchors.shape[0]
+    return {"LocationIndex": jnp.arange(n, dtype=jnp.int32),
+            "ScoreIndex": jnp.arange(n, dtype=jnp.int32),
+            "TargetLabel": labels,
+            "TargetBBox": jnp.where(pos[:, None], tgt, 0.0),
+            "BBoxInsideWeight": pos.astype(jnp.float32)[:, None]
+            * jnp.ones((1, 4)),
+            "ForegroundNumber": pos.sum().astype(jnp.int32)[None]}

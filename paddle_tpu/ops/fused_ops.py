@@ -243,3 +243,63 @@ def conv2d_fusion(ins, attrs):
         y = y + jnp.asarray(ins["ResidualData"])
     act = _ACT.get(attrs.get("activation", "relu"))
     return {"Output": act(y)}
+
+
+@register_op("fused_batch_norm_act")
+def fused_batch_norm_act(ins, attrs):
+    """fused/fused_bn_activation_op.cc registers the op name
+    fused_batch_norm_act — training-capable batch_norm + activation."""
+    bn = get_op("batch_norm")
+    out = bn.fn({"X": ins["X"], "Scale": ins["Scale"], "Bias": ins["Bias"],
+                 "Mean": ins["Mean"], "Variance": ins["Variance"]},
+                {"is_test": attrs.get("is_test", False),
+                 "momentum": attrs.get("momentum", 0.9),
+                 "epsilon": attrs.get("epsilon", 1e-5)})
+    act = _ACT.get(attrs.get("act_type", "relu"), jax.nn.relu)
+    out["Y"] = act(out["Y"])
+    return out
+
+
+@register_op("conv2d_inception_fusion")
+def conv2d_inception_fusion(ins, attrs):
+    """fused/fusion_conv_inception_op.cc — 4-branch inception block
+    (1x1 / 1x1+3x3 / 1x1+3x3+3x3 / pool+1x1 style), channel-concat of the
+    branch outputs. Inputs: Input + Filter (list of 4-branch filters) +
+    Bias list; this composition form runs each branch's convs and
+    concatenates, letting XLA fuse."""
+    conv = get_op("conv2d")
+    x = jnp.asarray(ins["Input"])
+    filters = ins["Filter"] if isinstance(ins["Filter"], (list, tuple)) \
+        else [ins["Filter"]]
+    biases = ins.get("Bias")
+    if biases is not None and not isinstance(biases, (list, tuple)):
+        biases = [biases]
+    outs = []
+    for i, w in enumerate(filters):
+        w = jnp.asarray(w)
+        kh = w.shape[2]
+        y = conv.fn({"Input": x, "Filter": w},
+                    {"strides": [1, 1], "paddings": [kh // 2, kh // 2],
+                     "dilations": [1, 1], "groups": 1})["Output"]
+        if biases is not None and i < len(biases) and biases[i] is not None:
+            y = y + jnp.asarray(biases[i]).reshape(1, -1, 1, 1)
+        outs.append(jax.nn.relu(y))
+    return {"Output": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("fused_embedding_fc_lstm")
+def fused_embedding_fc_lstm(ins, attrs):
+    """fused/fused_embedding_fc_lstm_op.cc — embedding lookup folded into
+    the lstm input projection: Embeddings is the pre-multiplied
+    [V, 4H] table (embed @ Wx already fused at weight-prep time), so the
+    recurrence consumes a gather instead of a matmul."""
+    ids = jnp.asarray(ins["Ids"]).astype(jnp.int32)
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    table = jnp.asarray(ins["Embeddings"])      # [V, 4H]
+    xproj = table[ids]                          # [B, T, 4H]
+    ins2 = {"Input": xproj, "Weight": ins["WeightH"],
+            "Bias": ins.get("Bias"), "H0": ins.get("H0"),
+            "C0": ins.get("C0"), "Length": ins.get("Length")}
+    out = get_op("lstm").fn(ins2, attrs)
+    return {"Hidden": out["Hidden"], "Cell": out["Cell"], "XX": xproj}

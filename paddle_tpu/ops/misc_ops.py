@@ -680,3 +680,38 @@ def print_op(ins, attrs):
     jax.debug.print("[{m}] shape={s} value={v}", m=msg, s=str(x.shape),
                     v=x)
     return {"Out": x}
+
+
+@register_op("max_pool3d_with_index")
+def max_pool3d_with_index(ins, attrs):
+    """pool_with_index_op.cc (3-D registration) — NCDHW max pool emitting
+    flat spatial argmax indices."""
+    x = jnp.asarray(ins["X"])
+    ksize = [int(k) for k in attrs["ksize"]]
+    strides = [int(s) for s in attrs.get("strides", ksize)]
+    n, c, d, h, w = x.shape
+    kd, kh, kw = ksize
+    sd, sh, sw = strides
+    od = (d - kd) // sd + 1
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches, idxs = [], []
+    for a in range(kd):
+        for i in range(kh):
+            for j in range(kw):
+                patches.append(x[:, :, a:a + sd * od:sd,
+                                 i:i + sh * oh:sh, j:j + sw * ow:sw])
+                ai = jnp.arange(od) * sd + a
+                ii = jnp.arange(oh) * sh + i
+                jj = jnp.arange(ow) * sw + j
+                idxs.append(ai[:, None, None] * h * w
+                            + ii[None, :, None] * w + jj[None, None, :])
+    stack = jnp.stack(patches, axis=-1)          # [N,C,od,oh,ow,k]
+    flat_idx = jnp.stack([jnp.broadcast_to(ix, (od, oh, ow))
+                          for ix in idxs], axis=-1)
+    arg = stack.argmax(axis=-1)
+    out = stack.max(axis=-1)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(flat_idx[None, None], stack.shape),
+        arg[..., None], axis=-1)[..., 0]
+    return {"Out": out, "Mask": mask.astype(jnp.int32)}

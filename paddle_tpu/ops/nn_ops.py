@@ -250,11 +250,13 @@ def conv2d(ins, attrs):
         dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
     else:
         dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NHWC", "OIHW", "NHWC"))
+    # NOTE: no preferred_element_type here — the MXU already accumulates
+    # bf16 convs in f32, and a f32 preferred type breaks the conv
+    # transpose rule under reverse-mode AD (mixed-dtype transpose_rhs)
     out = lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad,
         rhs_dilation=dilations, dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
     if out.dtype != x.dtype:
         out = out.astype(x.dtype)
@@ -378,21 +380,27 @@ def batch_norm(ins, attrs):
     bshape = [1] * x.ndim
     bshape[c_axis] = x.shape[c_axis]
 
+    # mixed-precision convention: stats accumulate in the running-stat
+    # dtype (f32), the normalized output returns in x's dtype (a bf16
+    # model keeps f32 running buffers without promoting activations)
+    xf = x.astype(jnp.promote_types(x.dtype, mean_in.dtype))
     if use_global:
         mean, var = mean_in, var_in
         mean_out, var_out = mean_in, var_in
         saved_mean = jnp.zeros_like(mean_in)
         saved_var = jnp.zeros_like(var_in)
     else:
-        mean = jnp.mean(x, axis=reduce_axes)
-        var = jnp.mean(jnp.square(x - mean.reshape(bshape)), axis=reduce_axes)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.mean(jnp.square(xf - mean.reshape(bshape)),
+                       axis=reduce_axes)
         mean_out = mean_in * momentum + mean * (1 - momentum)
         var_out = var_in * momentum + var * (1 - momentum)
         saved_mean = mean
         saved_var = 1.0 / jnp.sqrt(var + eps)
 
     inv = 1.0 / jnp.sqrt(var + eps)
-    y = (x - mean.reshape(bshape)) * (inv * scale).reshape(bshape) + bias.reshape(bshape)
+    y = ((xf - mean.reshape(bshape)) * (inv * scale).reshape(bshape)
+         + bias.reshape(bshape)).astype(x.dtype)
     return {
         "Y": y,
         "MeanOut": mean_out,
@@ -643,3 +651,42 @@ def interpolate(ins, attrs):
     jmethod = {"nearest": "nearest", "bilinear": "linear",
                "bicubic": "cubic"}[method]
     return {"Out": jax.image.resize(x, shape, method=jmethod)}
+
+
+@register_op("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose(ins, attrs):
+    """conv_transpose_op.cc (depthwise_conv2d_transpose name) —
+    conv2d_transpose with groups = input channels."""
+    attrs = dict(attrs)
+    attrs["groups"] = ins["Input"].shape[1]
+    return conv2d_transpose(ins, attrs)
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(ins, attrs):
+    """interpolate_op.cc (bilinear_interp registration) — legacy alias of
+    the shared interpolate kernel's bilinear branch."""
+    return interpolate(ins, {**attrs, "interp_method": "bilinear"})
+
+
+@register_op("nearest_interp")
+def nearest_interp(ins, attrs):
+    """interpolate_op.cc (nearest_interp registration)."""
+    return interpolate(ins, {**attrs, "interp_method": "nearest"})
+
+
+@register_op("cross_entropy2")
+def cross_entropy2(ins, attrs):
+    """cross_entropy_op.cc (CrossEntropyOp2) — hard-label CE over
+    probabilities with MatchX (the picked probability, reused by the
+    reference's grad kernel) and XShape passthrough outputs."""
+    x, label = ins["X"], ins["Label"]
+    idx = label.astype(jnp.int32)
+    if idx.ndim == x.ndim:
+        idx = jnp.squeeze(idx, axis=-1)
+    picked = jnp.take_along_axis(x, idx[..., None], axis=-1)
+    ignore = attrs.get("ignore_index", -100)
+    y = jnp.where(idx[..., None] == ignore, 0.0,
+                  -jnp.log(jnp.maximum(picked, 1e-20)))
+    return {"Y": y, "MatchX": picked,
+            "XShape": jnp.zeros((x.ndim + 1,), jnp.int32)}

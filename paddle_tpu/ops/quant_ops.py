@@ -263,3 +263,14 @@ def quantized_matmul(ins, attrs):
     out = int8_matmul(x_q, w_q, xs, ws[None, :] if ws.size > 1 else ws[0],
                       bits)
     return {"Out": out}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             stateful=True)
+def fake_quantize_dequantize_moving_average_abs_max(ins, attrs):
+    """fake_quantize_op.cc (FakeQuantizeDequantizeMovingAverageAbsMaxOp) —
+    identical compute to fake_quantize_moving_average_abs_max here (that
+    kernel already returns the dequantized value with a straight-through
+    gradient); registered separately for program parity with QAT graphs
+    that name this op."""
+    return fake_quantize_moving_average_abs_max(ins, attrs)

@@ -386,3 +386,101 @@ def grid_sampler(ins, attrs):
 
     out = jax.vmap(one)(x, gy, gx)              # [N, C, Ho, Wo]
     return {"Output": out}
+
+
+@register_op("deformable_psroi_pooling")
+def deformable_psroi_pooling(ins, attrs):
+    """deformable_psroi_pooling_op.cc — position-sensitive RoI pooling
+    whose per-bin sample grid is shifted by learned offsets (Trans input,
+    [R, 2*part_h*part_w] laid out [R, 2, ph, pw]). no_trans=True reduces
+    to plain psroi average pooling with bilinear sampling."""
+    x = jnp.asarray(ins["Input"])               # [N, C, H, W]
+    rois = jnp.asarray(ins["ROIs"]).reshape(-1, 4)
+    batch_ids = (jnp.asarray(ins["RoisNum"]).reshape(-1).astype(jnp.int32)
+                 if ins.get("RoisNum") is not None
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    trans = (jnp.asarray(ins["Trans"]) if ins.get("Trans") is not None
+             else None)
+    no_trans = bool(attrs.get("no_trans", trans is None))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    out_dim = int(attrs.get("output_dim"))
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    group = attrs.get("group_size", [1, 1])
+    gh, gw = (int(group[0]), int(group[1])) if hasattr(group, "__len__") \
+        else (int(group), int(group))
+    part = attrs.get("part_size", [ph, pw])
+    part_h, part_w = (int(part[0]), int(part[1])) \
+        if hasattr(part, "__len__") else (int(part), int(part))
+    sample = int(attrs.get("sample_per_part", 4))
+    trans_std = float(attrs.get("trans_std", 0.1))
+    n, c, h, w = x.shape
+
+    def one_roi(roi, tr, bid):
+        # reference: roi corners scaled, width/height floored at 0.1
+        x1 = jnp.round(roi[0]) * scale - 0.5
+        y1 = jnp.round(roi[1]) * scale - 0.5
+        x2 = (jnp.round(roi[2]) + 1.0) * scale - 0.5
+        y2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        sub_w = bin_w / sample
+        sub_h = bin_h / sample
+        iy = jnp.arange(ph)
+        ix = jnp.arange(pw)
+        if no_trans:
+            off_x = jnp.zeros((ph, pw))
+            off_y = jnp.zeros((ph, pw))
+        else:
+            tr2 = tr.reshape(2, part_h, part_w)
+            py = (iy * part_h // ph)[:, None] * jnp.ones((1, pw), jnp.int32)
+            px = jnp.ones((ph, 1), jnp.int32) * (ix * part_w // pw)[None, :]
+            off_x = tr2[0][py, px] * trans_std * rw
+            off_y = tr2[1][py, px] * trans_std * rh
+        sy = jnp.arange(sample) + 0.5
+        sx = jnp.arange(sample) + 0.5
+        # sample grid [ph, pw, s, s]
+        gy = (y1 + iy[:, None, None, None] * bin_h
+              + sy[None, None, :, None] * sub_h + off_y[:, :, None, None])
+        gx = (x1 + ix[None, :, None, None] * bin_w
+              + sx[None, None, None, :] * sub_w + off_x[:, :, None, None])
+        gy = jnp.clip(gy, 0.0, h - 1.0)
+        gx = jnp.clip(gx, 0.0, w - 1.0)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+        wy = gy - y0
+        wx = gx - x0
+        # position-sensitive channel selector
+        cg = jnp.arange(out_dim)
+        gy_id = (iy * gh // ph)
+        gx_id = (ix * gw // pw)
+        chan = (cg[:, None, None] * gh * gw
+                + gy_id[None, :, None] * gw + gx_id[None, None, :])
+        sel = x[bid][chan]                       # [C, ph, pw, H, W]
+        ci = jnp.arange(out_dim)[:, None, None, None, None]
+        bi = jnp.arange(ph)[None, :, None, None, None]
+        bj = jnp.arange(pw)[None, None, :, None, None]
+
+        def gather(yy, xx):
+            return sel[ci, bi, bj, yy[None], xx[None]]
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x1i)
+        v10 = gather(y1i, x0)
+        v11 = gather(y1i, x1i)
+        wy_ = wy[None]
+        wx_ = wx[None]
+        val = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+               + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        return val.mean(axis=(3, 4))             # [C, ph, pw]
+
+    if trans is None:
+        trans = jnp.zeros((rois.shape[0], 2 * part_h * part_w))
+    out = jax.vmap(one_roi)(rois, trans.reshape(rois.shape[0], -1),
+                            batch_ids)
+    return {"Output": out,
+            "TopCount": jnp.full(out.shape, sample * sample,
+                                 jnp.float32)}

@@ -480,3 +480,13 @@ def unique(ins, attrs):
     x = np.asarray(ins["X"])
     out, index = np.unique(x, return_inverse=True)
     return {"Out": jnp.asarray(out), "Index": jnp.asarray(index.astype(np.int32))}
+
+
+@register_op("fill_zeros_like2")
+def fill_zeros_like2(ins, attrs):
+    """fill_zeros_like_op.cc (FillZerosLike2Op) — fill_zeros_like with an
+    explicit dtype attr (used by backward passes on possibly-cast vars)."""
+    dtype = attrs.get("dtype", -1)
+    x = ins["X"]
+    dt = x.dtype if (dtype in (-1, None)) else resolve_dtype(dtype)
+    return {"Out": jnp.zeros(x.shape, dt)}

@@ -22,8 +22,9 @@ def run_kernel(op_type, inputs, attrs=None, rng_seed=0):
     attrs = dict(attrs or {})
     opdef = get_op(op_type)
     ins = {
-        k: ([jnp.asarray(x) for x in v] if isinstance(v, (list, tuple))
-            else jnp.asarray(v))
+        k: (None if v is None
+            else [jnp.asarray(x) for x in v]
+            if isinstance(v, (list, tuple)) else jnp.asarray(v))
         for k, v in inputs.items()
     }
     if opdef.needs_rng:

@@ -206,7 +206,9 @@ def test_machine_translation_greedy_decode():
     rng = np.random.default_rng(6)
     v, h, b, t = 16, 16, 8, 5
     emb = nn.Embedding([v, h])
-    cell = GRUCell(h)
+    # input_size builds the input projection eagerly so param_dict below
+    # (collected before the first forward) trains it too
+    cell = GRUCell(h, input_size=h)
     proj = nn.Linear(h, v)
     mods = [emb, cell, proj]
 

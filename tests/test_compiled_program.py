@@ -50,9 +50,14 @@ def test_dp_matches_single_device():
         main2, startup2, loss2 = _build_mnist_like()
         exe2 = fluid.Executor()
         exe2.run(startup2)
+        # map by creation order (both programs are built identically);
+        # sorting is wrong once unique suffixes straddle a digit boundary
+        # (fc_9 sorts after fc_10)
+        params1_order = [v.name for v in main1.list_vars()
+                         if v.persistable and v.name in params]
         name_map = dict(zip(
-            sorted(v.name for v in main2.list_vars() if v.persistable),
-            sorted(params)))
+            (v.name for v in main2.list_vars() if v.persistable),
+            params1_order))
         for n2, n1 in name_map.items():
             if fluid.global_scope().find_var(n2) is not None:
                 fluid.global_scope().set_var(n2, params[n1])

@@ -1,0 +1,339 @@
+"""Round-2 parity op tests: NMS variants, mAP, R-CNN label sampling,
+deformable psroi pooling, fused family, legacy interp aliases, pool3d
+with index (parity model: tests/unittests/test_multiclass_nms_op.py,
+test_detection_map_op.py, test_generate_proposal_labels_op.py,
+test_deformable_psroi_pooling.py, test_fused_*, test_bilinear_interp_op
+.py, test_pool_max_op.py)."""
+
+import numpy as np
+
+from op_test import OpTest, run_kernel
+
+
+def _boxes(n, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    xy = rng.random((n, 2)).astype(np.float32) * scale
+    wh = (rng.random((n, 2)).astype(np.float32) * 0.3 + 0.05) * scale
+    return np.concatenate([xy, xy + wh], axis=1)
+
+
+class TestMulticlassNms2(OpTest):
+    def test_index_points_at_kept_boxes(self):
+        boxes = _boxes(12)
+        rng = np.random.default_rng(1)
+        scores = rng.random((3, 12)).astype(np.float32)
+        out = run_kernel(
+            "multiclass_nms2", {"BBoxes": boxes, "Scores": scores},
+            {"keep_top_k": 6, "score_threshold": 0.05,
+             "nms_threshold": 0.5, "background_label": 0})
+        assert out["Out"].shape == (6, 6)
+        assert out["Index"].shape == (6, 1)
+        for row, idx in zip(out["Out"], out["Index"][:, 0]):
+            if idx < 0:
+                continue
+            np.testing.assert_allclose(row[2:], boxes[idx], atol=1e-5)
+            cls = int(row[0])
+            np.testing.assert_allclose(row[1], scores[cls, idx], atol=1e-5)
+
+    def test_matches_multiclass_nms(self):
+        boxes = _boxes(10, seed=3)
+        rng = np.random.default_rng(4)
+        scores = rng.random((2, 10)).astype(np.float32)
+        attrs = {"keep_top_k": 5, "score_threshold": 0.1,
+                 "nms_threshold": 0.4, "background_label": 0}
+        a = run_kernel("multiclass_nms",
+                       {"BBoxes": boxes, "Scores": scores}, attrs)
+        b = run_kernel("multiclass_nms2",
+                       {"BBoxes": boxes, "Scores": scores}, attrs)
+        np.testing.assert_allclose(a["Out"], b["Out"], atol=1e-6)
+        assert int(a["NumOut"]) == int(b["NumOut"])
+
+
+class TestLocalityAwareNms(OpTest):
+    def test_merges_overlapping_boxes(self):
+        # two nearly identical boxes -> one output at the weighted mean
+        boxes = np.array([[0.1, 0.1, 0.5, 0.5],
+                          [0.12, 0.12, 0.52, 0.52],
+                          [0.8, 0.8, 0.95, 0.95]], np.float32)
+        scores = np.array([[0.9, 0.6, 0.8]], np.float32)
+        out = run_kernel(
+            "locality_aware_nms", {"BBoxes": boxes, "Scores": scores},
+            {"keep_top_k": 3, "score_threshold": 0.1,
+             "nms_threshold": 0.3, "background_label": -1})
+        n = int(out["NumOut"])
+        assert n == 2
+        kept = out["Out"][:n]
+        # the cluster's kept row is a weighted mean of its two members
+        cluster = kept[kept[:, 2] < 0.6][0]
+        assert 0.1 <= cluster[2] <= 0.12
+        assert 0.5 <= cluster[4] <= 0.52
+        # merged score is a weighted blend strictly inside (0.6, 0.9)
+        assert 0.6 < cluster[1] < 0.9
+
+
+class TestDetectionMap(OpTest):
+    def test_perfect_detections_map_one(self):
+        det = np.array([[0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                        [1, 0.8, 0.5, 0.5, 0.9, 0.9]], np.float32)
+        gt = np.array([[0, 0.1, 0.1, 0.4, 0.4],
+                       [1, 0.5, 0.5, 0.9, 0.9]], np.float32)
+        out = run_kernel("detection_map", {"DetectRes": det, "Label": gt},
+                         {"class_num": 2})
+        np.testing.assert_allclose(out["MAP"], 1.0, atol=1e-6)
+
+    def test_missed_class_halves_map(self):
+        det = np.array([[0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                        [1, 0.8, 0.0, 0.0, 0.05, 0.05]], np.float32)
+        gt = np.array([[0, 0.1, 0.1, 0.4, 0.4],
+                       [1, 0.5, 0.5, 0.9, 0.9]], np.float32)
+        out = run_kernel("detection_map", {"DetectRes": det, "Label": gt},
+                         {"class_num": 2})
+        np.testing.assert_allclose(out["MAP"], 0.5, atol=1e-6)
+
+    def test_11point(self):
+        det = np.array([[0, 0.9, 0.1, 0.1, 0.4, 0.4]], np.float32)
+        gt = np.array([[0, 0.1, 0.1, 0.4, 0.4]], np.float32)
+        out = run_kernel("detection_map", {"DetectRes": det, "Label": gt},
+                         {"class_num": 1, "ap_type": "11point"})
+        np.testing.assert_allclose(out["MAP"], 1.0, atol=1e-6)
+
+
+class TestGenerateProposalLabels(OpTest):
+    def test_sampling_respects_quotas_and_targets(self):
+        rng = np.random.default_rng(0)
+        rois = _boxes(30, seed=1, scale=50.0)
+        gtb = np.array([[5., 5., 20., 20.], [30., 30., 45., 45.]],
+                       np.float32)
+        gtc = np.array([1, 2], np.int32)
+        out = run_kernel(
+            "generate_proposal_labels",
+            {"RpnRois": rois, "GtClasses": gtc, "GtBoxes": gtb,
+             "IsCrowd": None, "ImInfo": None},
+            {"batch_size_per_im": 16, "fg_fraction": 0.25,
+             "fg_thresh": 0.5, "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+             "class_nums": 4})
+        assert out["Rois"].shape == (16, 4)
+        assert out["BboxTargets"].shape == (16, 16)
+        labels = out["LabelsInt32"]
+        # gt boxes are appended to the candidate pool, so at least the two
+        # gts themselves are foreground with their own class
+        assert (labels > 0).sum() >= 2
+        assert set(labels[labels > 0]) <= {1, 2}
+        # fg rows have regression weights in their class column only
+        fg_rows = np.where(labels > 0)[0]
+        w = out["BboxInsideWeights"]
+        for r in fg_rows:
+            cols = labels[r] * 4 + np.arange(4)
+            assert w[r, cols].sum() == 4.0
+            assert w[r].sum() == 4.0
+
+
+class TestGenerateMaskLabels(OpTest):
+    def test_mask_crops_follow_labels(self):
+        segs = np.zeros((2, 32, 32), np.float32)
+        segs[0, 4:16, 4:16] = 1.0
+        segs[1, 18:30, 18:30] = 1.0
+        rois = np.array([[4., 4., 16., 16.], [18., 18., 30., 30.],
+                         [0., 0., 2., 2.]], np.float32)
+        labels = np.array([1, 2, -1], np.int32)
+        out = run_kernel(
+            "generate_mask_labels",
+            {"ImInfo": np.ones((1, 3), np.float32),
+             "GtClasses": np.array([1, 2], np.int32),
+             "GtSegms": segs, "Rois": rois, "LabelsInt32": labels},
+            {"num_classes": 3, "resolution": 4})
+        assert out["MaskInt32"].shape == (3, 3 * 16)
+        assert list(out["RoiHasMaskInt32"]) == [1, 1, 0]
+        # roi 0 fully inside its mask -> all ones in class-1 slice
+        m0 = out["MaskInt32"][0].reshape(3, 16)
+        assert m0[1].min() == 1
+        # background roi stays -1 everywhere
+        assert out["MaskInt32"][2].max() == -1
+
+
+class TestRetinanetTargetAssign(OpTest):
+    def test_assignment(self):
+        gtb = np.array([[5., 5., 20., 20.]], np.float32)
+        gtl = np.array([3], np.int32)
+        anchors = np.array([[5., 5., 20., 20.],      # IoU 1 -> pos
+                            [6., 6., 21., 21.],      # high IoU -> pos
+                            [40., 40., 60., 60.]],   # IoU 0 -> neg
+                           np.float32)
+        out = run_kernel(
+            "retinanet_target_assign",
+            {"Anchor": anchors, "GtBoxes": gtb, "GtLabels": gtl,
+             "ImInfo": np.ones((1, 3), np.float32)},
+            {"positive_overlap": 0.5, "negative_overlap": 0.4})
+        assert list(out["TargetLabel"]) == [3, 3, 0]
+        assert int(out["ForegroundNumber"][0]) == 2
+        # exact-match anchor encodes to zero deltas
+        np.testing.assert_allclose(out["TargetBBox"][0], 0.0, atol=1e-5)
+
+
+class TestDeformablePsroiPool(OpTest):
+    def test_no_trans_averages_bins(self):
+        x = np.random.default_rng(0).standard_normal(
+            (1, 8, 16, 16)).astype(np.float32)
+        rois = np.array([[2., 2., 9., 9.]], np.float32)
+        out = run_kernel(
+            "deformable_psroi_pooling",
+            {"Input": x, "ROIs": rois, "Trans": None},
+            {"no_trans": True, "spatial_scale": 1.0, "output_dim": 2,
+             "pooled_height": 2, "pooled_width": 2,
+             "group_size": [2, 2], "sample_per_part": 4})
+        assert out["Output"].shape == (1, 2, 2, 2)
+        assert np.isfinite(out["Output"]).all()
+
+    def test_trans_shifts_samples(self):
+        x = np.random.default_rng(1).standard_normal(
+            (1, 8, 16, 16)).astype(np.float32)
+        rois = np.array([[2., 2., 9., 9.]], np.float32)
+        base = run_kernel(
+            "deformable_psroi_pooling",
+            {"Input": x, "ROIs": rois, "Trans": None},
+            {"no_trans": True, "spatial_scale": 1.0, "output_dim": 2,
+             "pooled_height": 2, "pooled_width": 2, "group_size": [2, 2]})
+        tr = np.full((1, 8), 2.0, np.float32)
+        moved = run_kernel(
+            "deformable_psroi_pooling",
+            {"Input": x, "ROIs": rois, "Trans": tr},
+            {"no_trans": False, "spatial_scale": 1.0, "output_dim": 2,
+             "pooled_height": 2, "pooled_width": 2, "group_size": [2, 2],
+             "part_size": [2, 2], "trans_std": 0.1})
+        assert np.abs(moved["Output"] - base["Output"]).max() > 1e-6
+
+
+class TestFusedBatchNormAct(OpTest):
+    def test_training_updates_stats_and_clamps(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 3, 5, 5)).astype(np.float32)
+        out = run_kernel(
+            "fused_batch_norm_act",
+            {"X": x, "Scale": np.ones(3, np.float32),
+             "Bias": np.zeros(3, np.float32),
+             "Mean": np.zeros(3, np.float32),
+             "Variance": np.ones(3, np.float32)},
+            {"act_type": "relu", "momentum": 0.9})
+        assert out["Y"].min() >= 0.0
+        assert np.abs(out["MeanOut"]).max() > 0   # stats moved
+
+
+class TestConv2dInceptionFusion(OpTest):
+    def test_branches_concat(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 8, 6, 6)).astype(np.float32)
+        f1 = rng.standard_normal((4, 8, 1, 1)).astype(np.float32)
+        f3 = rng.standard_normal((5, 8, 3, 3)).astype(np.float32)
+        out = run_kernel("conv2d_inception_fusion",
+                         {"Input": x, "Filter": [f1, f3], "Bias": None},
+                         {})
+        assert out["Output"].shape == (2, 9, 6, 6)
+        assert out["Output"].min() >= 0.0  # relu'd branches
+
+
+class TestFusedEmbeddingFcLstm(OpTest):
+    def test_matches_manual_lstm_on_projected_input(self):
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 20, (2, 5)).astype(np.int32)
+        emb = (rng.standard_normal((20, 4 * 8)) * 0.1).astype(np.float32)
+        wh = (rng.standard_normal((8, 4 * 8)) * 0.1).astype(np.float32)
+        fused = run_kernel("fused_embedding_fc_lstm",
+                           {"Ids": ids, "Embeddings": emb,
+                            "WeightH": wh, "Bias": None}, {})
+        manual = run_kernel("lstm",
+                            {"Input": emb[ids], "Weight": wh,
+                             "Bias": None}, {})
+        np.testing.assert_allclose(fused["Hidden"], manual["Hidden"],
+                                   atol=1e-6)
+
+
+class TestMaxPool3dWithIndex(OpTest):
+    def test_out_and_mask(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+        out = run_kernel("max_pool3d_with_index", {"X": x},
+                         {"ksize": [2, 2, 2]})
+        assert out["Out"].shape == (1, 2, 2, 2, 2)
+        # mask flat index recovers the max value
+        flat = x.reshape(1, 2, -1)
+        for c in range(2):
+            got = np.take(flat[0, c], out["Mask"][0, c].reshape(-1))
+            np.testing.assert_allclose(got,
+                                       out["Out"][0, c].reshape(-1))
+
+
+class TestLegacyInterpAliases(OpTest):
+    def test_bilinear_matches_interpolate(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((1, 3, 4, 4)).astype(np.float32)
+        a = run_kernel("bilinear_interp", {"X": x},
+                       {"out_h": 8, "out_w": 8})
+        b = run_kernel("interpolate", {"X": x},
+                       {"out_h": 8, "out_w": 8,
+                        "interp_method": "bilinear"})
+        np.testing.assert_allclose(a["Out"], b["Out"])
+
+    def test_nearest_preserves_values(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = run_kernel("nearest_interp", {"X": x},
+                         {"out_h": 8, "out_w": 8})
+        assert set(np.unique(out["Out"])) <= set(np.unique(x))
+
+
+class TestCrossEntropy2(OpTest):
+    def test_matches_cross_entropy(self):
+        rng = np.random.default_rng(0)
+        logits = rng.standard_normal((4, 6)).astype(np.float32)
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        label = rng.integers(0, 6, (4, 1)).astype(np.int32)
+        a = run_kernel("cross_entropy2", {"X": probs, "Label": label}, {})
+        b = run_kernel("cross_entropy", {"X": probs, "Label": label}, {})
+        np.testing.assert_allclose(a["Y"], b["Y"], atol=1e-6)
+        picked = np.take_along_axis(probs, label.astype(np.int64), axis=1)
+        np.testing.assert_allclose(a["MatchX"], picked, atol=1e-6)
+
+
+class TestFillZerosLike2(OpTest):
+    def test_dtype_override(self):
+        x = np.ones((3, 2), np.float32)
+        out = run_kernel("fill_zeros_like2", {"X": x}, {"dtype": -1})
+        assert out["Out"].dtype == np.float32
+        assert out["Out"].sum() == 0
+
+
+class TestFakeQuantDequantMovingAverage(OpTest):
+    def test_round_trip_close_and_scale_tracked(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 8)).astype(np.float32)
+        out = run_kernel(
+            "fake_quantize_dequantize_moving_average_abs_max",
+            {"X": x, "InScale": np.array([1.0], np.float32),
+             "InState": np.array([1.0], np.float32),
+             "InAccum": np.array([1.0], np.float32)},
+            {"bit_length": 8, "moving_rate": 0.9})
+        assert out["Out"].shape == x.shape
+        # EMA scale: accum = rate*1 + max|x|, state = rate*1 + 1
+        expect_scale = (0.9 + np.abs(x).max()) / 1.9
+        np.testing.assert_allclose(out["OutScale"][0], expect_scale,
+                                   rtol=1e-6)
+        # 8-bit round-trip error bounded by scale/127 inside the scale;
+        # values beyond it clip (EMA lags the current max)
+        s = float(out["OutScale"][0])
+        err = np.abs(out["Out"] - x)
+        inside = np.abs(x) <= s
+        assert err[inside].max() <= s / 127 + 1e-6
+        assert np.abs(out["Out"]).max() <= s + 1e-6
+
+
+class TestDepthwiseConvTranspose(OpTest):
+    def test_matches_grouped_transpose(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((1, 4, 5, 5)).astype(np.float32)
+        w = rng.standard_normal((4, 1, 3, 3)).astype(np.float32)
+        a = run_kernel("depthwise_conv2d_transpose",
+                       {"Input": x, "Filter": w},
+                       {"strides": [2, 2], "paddings": [1, 1]})
+        b = run_kernel("conv2d_transpose", {"Input": x, "Filter": w},
+                       {"strides": [2, 2], "paddings": [1, 1],
+                        "groups": 4})
+        np.testing.assert_allclose(a["Output"], b["Output"], atol=1e-6)
