@@ -406,13 +406,9 @@ def nms_mask(boxes, scores, iou_threshold, top_k=-1, normalized=True,
     return unkeep
 
 
-@register_op("multiclass_nms")
-def multiclass_nms(ins, attrs):
-    """detection/multiclass_nms_op.cc — per-class NMS + global keep_top_k.
-    Dense output: [N_out, 6] rows (class, score, x1, y1, x2, y2) packed to
-    the front + NumOut (static shapes: N_out = keep_top_k)."""
-    boxes = jnp.asarray(ins["BBoxes"])          # [M, 4] or [C?, M, 4]
-    scores = jnp.asarray(ins["Scores"])         # [C, M]
+def _multiclass_nms_core(boxes, scores, attrs):
+    """Shared per-class NMS + global keep_top_k (multiclass_nms_op.cc).
+    Returns (rows [k, 6], input_box_index [k], valid mask [k])."""
     if boxes.ndim == 3 and boxes.shape[0] == 1:
         boxes = boxes[0]
     score_thresh = float(attrs.get("score_threshold", 0.0))
@@ -422,27 +418,37 @@ def multiclass_nms(ins, attrs):
     background = int(attrs.get("background_label", 0))
     normalized = bool(attrs.get("normalized", True))
     c, m = scores.shape
-    all_scores = []
-    all_rows = []
+    all_scores, all_rows, all_idx = [], [], []
     for cls in range(c):
         if cls == background:
             continue
         keep = nms_mask(boxes, scores[cls], nms_thresh, nms_top_k,
                         normalized, score_thresh)
-        s = jnp.where(keep, scores[cls], BIG_NEG)
-        all_scores.append(s)
+        all_scores.append(jnp.where(keep, scores[cls], BIG_NEG))
         all_rows.append(jnp.concatenate([
             jnp.full((m, 1), cls, boxes.dtype),
             scores[cls][:, None], boxes], axis=1))
+        all_idx.append(jnp.arange(m, dtype=jnp.int32))
     cat_scores = jnp.concatenate(all_scores)           # [(C-1)*M]
     cat_rows = jnp.concatenate(all_rows, axis=0)       # [(C-1)*M, 6]
+    cat_idx = jnp.concatenate(all_idx)
     k = min(keep_top_k if keep_top_k > 0 else cat_scores.shape[0],
             cat_scores.shape[0])
     top_scores, top_idx = jax.lax.top_k(cat_scores, k)
-    out = cat_rows[top_idx]
     valid = top_scores > BIG_NEG / 2
-    out = jnp.where(valid[:, None], out, 0.0)
-    return {"Out": out, "NumOut": valid.sum().astype(jnp.int32)}
+    rows = jnp.where(valid[:, None], cat_rows[top_idx], 0.0)
+    index = jnp.where(valid, cat_idx[top_idx], -1).astype(jnp.int32)
+    return rows, index, valid
+
+
+@register_op("multiclass_nms")
+def multiclass_nms(ins, attrs):
+    """detection/multiclass_nms_op.cc — per-class NMS + global keep_top_k.
+    Dense output: [N_out, 6] rows (class, score, x1, y1, x2, y2) packed to
+    the front + NumOut (static shapes: N_out = keep_top_k)."""
+    rows, _, valid = _multiclass_nms_core(
+        jnp.asarray(ins["BBoxes"]), jnp.asarray(ins["Scores"]), attrs)
+    return {"Out": rows, "NumOut": valid.sum().astype(jnp.int32)}
 
 
 # --------------------------------------------------------------------------
@@ -673,51 +679,28 @@ def yolov3_loss(ins, attrs):
 def multiclass_nms2(ins, attrs):
     """detection/multiclass_nms_op.cc:480 (MultiClassNMS2Op) — same as
     multiclass_nms plus an Index output mapping each kept row back to its
-    flattened input box index."""
-    boxes = jnp.asarray(ins["BBoxes"])
-    scores = jnp.asarray(ins["Scores"])
-    if boxes.ndim == 3 and boxes.shape[0] == 1:
-        boxes = boxes[0]
-    score_thresh = float(attrs.get("score_threshold", 0.0))
-    nms_thresh = float(attrs.get("nms_threshold", 0.3))
-    nms_top_k = int(attrs.get("nms_top_k", -1))
-    keep_top_k = int(attrs.get("keep_top_k", 100))
-    background = int(attrs.get("background_label", 0))
-    normalized = bool(attrs.get("normalized", True))
-    c, m = scores.shape
-    all_scores, all_rows, all_idx = [], [], []
-    for cls in range(c):
-        if cls == background:
-            continue
-        keep = nms_mask(boxes, scores[cls], nms_thresh, nms_top_k,
-                        normalized, score_thresh)
-        all_scores.append(jnp.where(keep, scores[cls], BIG_NEG))
-        all_rows.append(jnp.concatenate([
-            jnp.full((m, 1), cls, boxes.dtype),
-            scores[cls][:, None], boxes], axis=1))
-        all_idx.append(jnp.arange(m, dtype=jnp.int32))
-    cat_scores = jnp.concatenate(all_scores)
-    cat_rows = jnp.concatenate(all_rows, axis=0)
-    cat_idx = jnp.concatenate(all_idx)
-    k = min(keep_top_k if keep_top_k > 0 else cat_scores.shape[0],
-            cat_scores.shape[0])
-    top_scores, top_idx = jax.lax.top_k(cat_scores, k)
-    valid = top_scores > BIG_NEG / 2
-    out = jnp.where(valid[:, None], cat_rows[top_idx], 0.0)
-    index = jnp.where(valid, cat_idx[top_idx], -1).astype(jnp.int32)
-    return {"Out": out, "Index": index[:, None],
+    flattened input box index (thin wrapper over the shared core)."""
+    rows, index, valid = _multiclass_nms_core(
+        jnp.asarray(ins["BBoxes"]), jnp.asarray(ins["Scores"]), attrs)
+    return {"Out": rows, "Index": index[:, None],
             "NumOut": valid.sum().astype(jnp.int32)}
 
 
 @register_op("locality_aware_nms")
 def locality_aware_nms(ins, attrs):
     """detection/locality_aware_nms_op.cc — EAST-style NMS: boxes first
-    merge with overlapping neighbours by score-weighted average, then
-    standard per-class NMS. Fixed-shape: one merge sweep in score order
-    (the reference's sequential local merge), mask-packed output."""
+    merge with overlapping neighbours (coords score-weighted-averaged,
+    scores SUMMED over the cluster, :79-108 `scores[index] += scores[i]`),
+    then standard per-class NMS. Fixed-shape: one merge sweep in score
+    order (the reference's sequential local merge), mask-packed output."""
     boxes = jnp.asarray(ins["BBoxes"])          # [1, M, 4] or [M, 4]
     scores = jnp.asarray(ins["Scores"])         # [1, C, M] or [C, M]
     if boxes.ndim == 3:
+        if boxes.shape[0] != 1:
+            raise ValueError(
+                f"locality_aware_nms supports a single image per call "
+                f"(reference iterates the batch op-side); got batch "
+                f"{boxes.shape[0]}")
         boxes = boxes[0]
     if scores.ndim == 3:
         scores = scores[0]
@@ -737,9 +720,8 @@ def locality_aware_nms(ins, attrs):
         w = jnp.where(near, s[None, :], 0.0)            # [M, M] weights
         wsum = jnp.maximum(w.sum(axis=1, keepdims=True), 1e-10)
         merged = (w @ boxes) / wsum                     # weighted average
-        merged_s = jnp.where(s > score_thresh,
-                             (w * s[None, :]).sum(axis=1)
-                             / wsum[:, 0], s)
+        # reference accumulates the cluster score as a SUM (can exceed 1)
+        merged_s = jnp.where(s > score_thresh, w.sum(axis=1), s)
         keep = nms_mask(merged, merged_s, nms_thresh, -1, normalized,
                         score_thresh)
         all_scores.append(jnp.where(keep, merged_s, BIG_NEG))

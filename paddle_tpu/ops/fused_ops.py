@@ -254,7 +254,10 @@ def fused_batch_norm_act(ins, attrs):
                  "Mean": ins["Mean"], "Variance": ins["Variance"]},
                 {"is_test": attrs.get("is_test", False),
                  "momentum": attrs.get("momentum", 0.9),
-                 "epsilon": attrs.get("epsilon", 1e-5)})
+                 "epsilon": attrs.get("epsilon", 1e-5),
+                 # the reference op requires NHWC input
+                 # (fused_bn_activation_op.cc maker comment)
+                 "data_layout": attrs.get("data_layout", "NHWC")})
     act = _ACT.get(attrs.get("act_type", "relu"), jax.nn.relu)
     out["Y"] = act(out["Y"])
     return out
@@ -262,28 +265,63 @@ def fused_batch_norm_act(ins, attrs):
 
 @register_op("conv2d_inception_fusion")
 def conv2d_inception_fusion(ins, attrs):
-    """fused/fusion_conv_inception_op.cc — 4-branch inception block
-    (1x1 / 1x1+3x3 / 1x1+3x3+3x3 / pool+1x1 style), channel-concat of the
-    branch outputs. Inputs: Input + Filter (list of 4-branch filters) +
-    Bias list; this composition form runs each branch's convs and
-    concatenates, letting XLA fuse."""
+    """fused/fusion_conv_inception_op.{cc,cu} — the 4-conv inception
+    block the cudnn kernel evaluates via pointer-offset packing:
+
+      branch0: 3x3 pool(x)            -> 1x1 conv w0            -> oc0
+      branch1: x                      -> 1x1 conv w1; the first
+               oc1 = w1_oc - 2*w2_in channels ARE the branch output,
+               the tail channels are 1x1 projections feeding the 3x3s
+      branch2: tail(t1)               -> 3x3 conv w2; first
+               oc2 = w2_oc - w3_in channels kept
+      branch3: tail(t2)               -> 3x3 conv w3            -> oc3
+
+    Output = concat([b0, b1, b2, b3], channel) — channel arithmetic per
+    the reference InferShape (fusion_conv_inception_op.cc:40-49).
+    pooling_type (max/avg, exclusive) and activation attrs are honored.
+    Deviation (documented): the reference's cudnn kernel reads conv2's
+    input through a double-strided 2*w2_in-channel descriptor over
+    conv1's scratch tail; here conv2 consumes the tail channels
+    directly, sized by its own filter's in-channel dim."""
     conv = get_op("conv2d")
+    pool = get_op("pool2d")
     x = jnp.asarray(ins["Input"])
-    filters = ins["Filter"] if isinstance(ins["Filter"], (list, tuple)) \
-        else [ins["Filter"]]
+    filters = [jnp.asarray(w) for w in (
+        ins["Filter"] if isinstance(ins["Filter"], (list, tuple))
+        else [ins["Filter"]])]
     biases = ins.get("Bias")
     if biases is not None and not isinstance(biases, (list, tuple)):
         biases = [biases]
-    outs = []
-    for i, w in enumerate(filters):
-        w = jnp.asarray(w)
-        kh = w.shape[2]
-        y = conv.fn({"Input": x, "Filter": w},
-                    {"strides": [1, 1], "paddings": [kh // 2, kh // 2],
+    act = _ACT.get(attrs.get("activation", "relu"), jax.nn.relu)
+    pool_type = attrs.get("pooling_type", "max")
+
+    def run_conv(inp, w, i, pad):
+        y = conv.fn({"Input": inp, "Filter": w},
+                    {"strides": [1, 1], "paddings": [pad, pad],
                      "dilations": [1, 1], "groups": 1})["Output"]
-        if biases is not None and i < len(biases) and biases[i] is not None:
+        if biases is not None and i < len(biases) and \
+                biases[i] is not None:
             y = y + jnp.asarray(biases[i]).reshape(1, -1, 1, 1)
-        outs.append(jax.nn.relu(y))
+        return act(y)
+
+    if len(filters) == 4:
+        w0, w1, w2, w3 = filters
+        pooled = pool.fn({"X": x}, {
+            "pooling_type": pool_type, "ksize": [3, 3],
+            "strides": [1, 1], "paddings": [1, 1],
+            "exclusive": bool(attrs.get("exclusive", True))})["Out"]
+        b0 = run_conv(pooled, w0, 0, 0)                 # pool + 1x1
+        t1 = run_conv(x, w1, 1, 0)                      # shared 1x1
+        oc1 = t1.shape[1] - 2 * w2.shape[1]
+        b1 = t1[:, :oc1]
+        t2 = run_conv(t1[:, t1.shape[1] - w2.shape[1]:], w2, 2, 1)
+        oc2 = t2.shape[1] - w3.shape[1]
+        b2 = t2[:, :oc2]
+        b3 = run_conv(t2[:, oc2:], w3, 3, 1)
+        return {"Output": jnp.concatenate([b0, b1, b2, b3], axis=1)}
+    # degenerate form: independent same-padded branches off x
+    outs = [run_conv(x, w, i, w.shape[2] // 2)
+            for i, w in enumerate(filters)]
     return {"Output": jnp.concatenate(outs, axis=1)}
 
 

@@ -460,13 +460,14 @@ def deformable_psroi_pooling(ins, attrs):
         gx_id = (ix * gw // pw)
         chan = (cg[:, None, None] * gh * gw
                 + gy_id[None, :, None] * gw + gx_id[None, None, :])
-        sel = x[bid][chan]                       # [C, ph, pw, H, W]
-        ci = jnp.arange(out_dim)[:, None, None, None, None]
-        bi = jnp.arange(ph)[None, :, None, None, None]
-        bj = jnp.arange(pw)[None, None, :, None, None]
+        # gather only the bilinear sample points ([C, ph, pw, s, s]) —
+        # materializing x[bid][chan] ([C, ph, pw, H, W]) would cost
+        # R*C*ph*pw*H*W memory across the vmap
+        img = x[bid]                             # [Cin, H, W]
+        chan5 = chan[:, :, :, None, None]        # [C, ph, pw, 1, 1]
 
         def gather(yy, xx):
-            return sel[ci, bi, bj, yy[None], xx[None]]
+            return img[chan5, yy[None], xx[None]]
         v00 = gather(y0, x0)
         v01 = gather(y0, x1i)
         v10 = gather(y1i, x0)

@@ -52,12 +52,14 @@ def test_dp_matches_single_device():
         exe2.run(startup2)
         # map by creation order (both programs are built identically);
         # sorting is wrong once unique suffixes straddle a digit boundary
-        # (fc_9 sorts after fc_10)
+        # (fc_9 sorts after fc_10). Apply the same in-scope filter to both
+        # sides positionally so a skipped var can't shift the pairing.
         params1_order = [v.name for v in main1.list_vars()
                          if v.persistable and v.name in params]
-        name_map = dict(zip(
-            (v.name for v in main2.list_vars() if v.persistable),
-            params1_order))
+        params2_order = [v.name for v in main2.list_vars() if v.persistable]
+        assert len(params2_order) == len(params1_order), (
+            params1_order, params2_order)
+        name_map = dict(zip(params2_order, params1_order))
         for n2, n1 in name_map.items():
             if fluid.global_scope().find_var(n2) is not None:
                 fluid.global_scope().set_var(n2, params[n1])

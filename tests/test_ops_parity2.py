@@ -67,8 +67,9 @@ class TestLocalityAwareNms(OpTest):
         cluster = kept[kept[:, 2] < 0.6][0]
         assert 0.1 <= cluster[2] <= 0.12
         assert 0.5 <= cluster[4] <= 0.52
-        # merged score is a weighted blend strictly inside (0.6, 0.9)
-        assert 0.6 < cluster[1] < 0.9
+        # reference semantics: the cluster score is the SUM of member
+        # scores (locality_aware_nms_op.cc scores[index] += scores[i])
+        np.testing.assert_allclose(cluster[1], 1.5, atol=1e-5)
 
 
 class TestDetectionMap(OpTest):
@@ -206,7 +207,8 @@ class TestDeformablePsroiPool(OpTest):
 class TestFusedBatchNormAct(OpTest):
     def test_training_updates_stats_and_clamps(self):
         rng = np.random.default_rng(0)
-        x = rng.standard_normal((4, 3, 5, 5)).astype(np.float32)
+        # the reference op is NHWC-only: channels last
+        x = rng.standard_normal((4, 5, 5, 3)).astype(np.float32)
         out = run_kernel(
             "fused_batch_norm_act",
             {"X": x, "Scale": np.ones(3, np.float32),
@@ -216,10 +218,29 @@ class TestFusedBatchNormAct(OpTest):
             {"act_type": "relu", "momentum": 0.9})
         assert out["Y"].min() >= 0.0
         assert np.abs(out["MeanOut"]).max() > 0   # stats moved
+        # per-channel stats over N*H*W
+        np.testing.assert_allclose(
+            out["MeanOut"], 0.1 * x.mean(axis=(0, 1, 2)), atol=1e-5)
 
 
 class TestConv2dInceptionFusion(OpTest):
-    def test_branches_concat(self):
+    def test_four_filter_chained_block(self):
+        # channel arithmetic per fusion_conv_inception_op.cc InferShape:
+        # oc = w0_oc + (w1_oc - 2*w2_in) + (w2_oc - w3_in) + w3_oc
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 8, 6, 6)).astype(np.float32)
+        w0 = rng.standard_normal((4, 8, 1, 1)).astype(np.float32)
+        w1 = rng.standard_normal((11, 8, 1, 1)).astype(np.float32)  # oc1=5
+        w2 = rng.standard_normal((8, 3, 3, 3)).astype(np.float32)   # oc2=6
+        w3 = rng.standard_normal((7, 2, 3, 3)).astype(np.float32)   # oc3=7
+        out = run_kernel("conv2d_inception_fusion",
+                         {"Input": x, "Filter": [w0, w1, w2, w3],
+                          "Bias": None},
+                         {"pooling_type": "max", "activation": "relu"})
+        assert out["Output"].shape == (2, 4 + 5 + 6 + 7, 6, 6)
+        assert out["Output"].min() >= 0.0  # relu'd branches
+
+    def test_degenerate_independent_branches(self):
         rng = np.random.default_rng(0)
         x = rng.standard_normal((2, 8, 6, 6)).astype(np.float32)
         f1 = rng.standard_normal((4, 8, 1, 1)).astype(np.float32)
@@ -228,7 +249,7 @@ class TestConv2dInceptionFusion(OpTest):
                          {"Input": x, "Filter": [f1, f3], "Bias": None},
                          {})
         assert out["Output"].shape == (2, 9, 6, 6)
-        assert out["Output"].min() >= 0.0  # relu'd branches
+        assert out["Output"].min() >= 0.0
 
 
 class TestFusedEmbeddingFcLstm(OpTest):

@@ -1,0 +1,277 @@
+"""Table-driven per-op spec sweep (parity model: the reference's OpTest
+corpus, tests/unittests/op_test.py — "the behavioral spec of all ~600
+ops", SURVEY §4.1).
+
+Each SPEC row drives one registered kernel on small seeded inputs and
+checks it against a numpy reference (`ref`) or structural properties
+(`shape` / `finite`), and — for rows with `grad` slots — verifies the
+analytic jax gradient against central differences via OpTest.check_grad.
+Ops with their own dedicated test files are not repeated here; this file
+sweeps the long tail.
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest, run_kernel
+
+R = np.random.default_rng(7)
+
+
+def _f(*shape):
+    return R.standard_normal(shape).astype(np.float32)
+
+
+def _pos(*shape):
+    return (R.random(shape).astype(np.float32) * 0.9 + 0.05)
+
+
+def _i(hi, *shape):
+    return R.integers(0, hi, shape).astype(np.int32)
+
+
+# -- spec rows --------------------------------------------------------------
+# op, inputs, attrs, checks dict:
+#   ref: {slot: numpy expected}     exact value check (atol 1e-5)
+#   shape: {slot: tuple}            shape-only check
+#   grad: [input slots]             numeric-vs-analytic grad of out slot
+#   out: output slot for grad/default checks (default "Out")
+
+X34 = _f(3, 4)
+Y34 = _f(3, 4)
+P34 = _pos(3, 4)
+X245 = _f(2, 4, 5)
+
+SPECS = [
+    # ---- unary math ----
+    ("acos", {"X": P34 * 0.9}, {}, {"ref": {"Out": np.arccos(P34 * 0.9)}, "grad": ["X"]}),
+    ("asin", {"X": P34 * 0.9}, {}, {"ref": {"Out": np.arcsin(P34 * 0.9)}, "grad": ["X"]}),
+    ("atan", {"X": X34}, {}, {"ref": {"Out": np.arctan(X34)}, "grad": ["X"]}),
+    ("tan", {"X": X34 * 0.3}, {}, {"ref": {"Out": np.tan(X34 * 0.3)}, "grad": ["X"]}),
+    ("sinh", {"X": X34}, {}, {"ref": {"Out": np.sinh(X34)}, "grad": ["X"]}),
+    ("cosh", {"X": X34}, {}, {"ref": {"Out": np.cosh(X34)}, "grad": ["X"]}),
+    ("erf", {"X": X34}, {}, {"finite": ["Out"], "grad": ["X"]}),
+    ("log10", {"X": P34 + 1}, {}, {"ref": {"Out": np.log10(P34 + 1)}, "grad": ["X"]}),
+    ("log2", {"X": P34 + 1}, {}, {"ref": {"Out": np.log2(P34 + 1)}, "grad": ["X"]}),
+    ("log1p", {"X": P34}, {}, {"ref": {"Out": np.log1p(P34)}, "grad": ["X"]}),
+    ("rsqrt", {"X": P34 + 0.5}, {}, {"ref": {"Out": 1 / np.sqrt(P34 + 0.5)}, "grad": ["X"]}),
+    ("reciprocal", {"X": P34 + 0.5}, {}, {"ref": {"Out": 1 / (P34 + 0.5)}, "grad": ["X"]}),
+    ("round", {"X": X34 * 3}, {}, {"ref": {"Out": np.round(X34 * 3)}}),
+    ("sign", {"X": X34}, {}, {"ref": {"Out": np.sign(X34)}}),
+    ("pow", {"X": P34 + 0.5}, {"factor": 3.0}, {"ref": {"Out": (P34 + 0.5) ** 3}, "grad": ["X"]}),
+    ("silu", {"X": X34}, {}, {"ref": {"Out": X34 / (1 + np.exp(-X34))}, "grad": ["X"]}),
+    ("mish", {"X": X34}, {}, {"finite": ["Out"], "grad": ["X"]}),
+    ("softsign", {"X": X34}, {}, {"ref": {"Out": X34 / (1 + np.abs(X34))}, "grad": ["X"]}),
+    ("swish", {"X": X34}, {"beta": 1.0}, {"finite": ["Out"], "grad": ["X"]}),
+    ("hard_swish", {"X": X34}, {}, {"finite": ["Out"]}),
+    ("selu", {"X": X34}, {}, {"finite": ["Out"], "grad": ["X"]}),
+    ("square_error_cost", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": (X34 - Y34) ** 2}, "grad": ["X", "Y"]}),
+
+    # ---- binary elementwise ----
+    ("elementwise_sub", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": X34 - Y34}, "grad": ["X", "Y"]}),
+    ("elementwise_mul", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": X34 * Y34}, "grad": ["X", "Y"]}),
+    ("elementwise_div", {"X": X34, "Y": P34 + 0.5}, {}, {"ref": {"Out": X34 / (P34 + 0.5)}, "grad": ["X", "Y"]}),
+    ("elementwise_max", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": np.maximum(X34, Y34)}}),
+    ("elementwise_min", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": np.minimum(X34, Y34)}}),
+    ("elementwise_pow", {"X": P34 + 0.5, "Y": P34 * 2}, {}, {"ref": {"Out": (P34 + 0.5) ** (P34 * 2)}}),
+    ("elementwise_mod", {"X": _i(20, 3, 4), "Y": _i(5, 3, 4) + 1}, {}, {"finite": ["Out"]}),
+    ("elementwise_floordiv", {"X": _i(20, 3, 4), "Y": _i(5, 3, 4) + 1}, {}, {"finite": ["Out"]}),
+    ("maximum", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": np.maximum(X34, Y34)}}),
+    ("minimum", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": np.minimum(X34, Y34)}}),
+    ("minus", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": X34 - Y34}}),
+    ("dot", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": (X34 * Y34).sum(-1, keepdims=True)}, "grad": ["X", "Y"]}),
+    ("kron", {"X": _f(2, 2), "Y": _f(3, 3)}, {}, {"shape": {"Out": (6, 6)}}),
+
+    # ---- comparisons / logical ----
+    ("greater_than", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": X34 > Y34}}),
+    ("greater_equal", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": X34 >= Y34}}),
+    ("less_equal", {"X": X34, "Y": Y34}, {}, {"ref": {"Out": X34 <= Y34}}),
+    ("not_equal", {"X": X34, "Y": X34.copy()}, {}, {"ref": {"Out": np.zeros((3, 4), bool)}}),
+    ("logical_and", {"X": X34 > 0, "Y": Y34 > 0}, {}, {"ref": {"Out": (X34 > 0) & (Y34 > 0)}}),
+    ("logical_or", {"X": X34 > 0, "Y": Y34 > 0}, {}, {"ref": {"Out": (X34 > 0) | (Y34 > 0)}}),
+    ("logical_xor", {"X": X34 > 0, "Y": Y34 > 0}, {}, {"ref": {"Out": (X34 > 0) ^ (Y34 > 0)}}),
+    ("logical_not", {"X": X34 > 0}, {}, {"ref": {"Out": ~(X34 > 0)}}),
+    ("isfinite_v2", {"X": X34}, {}, {"ref": {"Out": np.isfinite(X34)}}),
+    ("isnan_v2", {"X": X34}, {}, {"ref": {"Out": np.isnan(X34)}}),
+    ("isinf_v2", {"X": X34}, {}, {"ref": {"Out": np.isinf(X34)}}),
+
+    # ---- shape / indexing ----
+    ("reshape2", {"X": X34}, {"shape": [4, 3]}, {"ref": {"Out": X34.reshape(4, 3)}, "grad": ["X"]}),
+    ("reshape", {"X": X34}, {"shape": [2, 6]}, {"ref": {"Out": X34.reshape(2, 6)}}),
+    ("transpose2", {"X": X245}, {"axis": [1, 0, 2]}, {"ref": {"Out": X245.transpose(1, 0, 2)}, "grad": ["X"]}),
+    ("transpose", {"X": X34}, {"axis": [1, 0]}, {"ref": {"Out": X34.T}}),
+    ("flatten", {"X": X245}, {"axis": 1}, {"ref": {"Out": X245.reshape(2, 20)}}),
+    ("flatten2", {"X": X245}, {"axis": 2}, {"ref": {"Out": X245.reshape(8, 5)}}),
+    ("flatten_contiguous_range", {"X": X245}, {"start_axis": 1, "stop_axis": 2}, {"ref": {"Out": X245.reshape(2, 20)}}),
+    ("squeeze", {"X": X34[:, None]}, {"axes": [1]}, {"ref": {"Out": X34}}),
+    ("squeeze2", {"X": X34[:, None]}, {"axes": [1]}, {"ref": {"Out": X34}}),
+    ("unsqueeze", {"X": X34}, {"axes": [1]}, {"ref": {"Out": X34[:, None]}}),
+    ("unsqueeze2", {"X": X34}, {"axes": [0]}, {"ref": {"Out": X34[None]}}),
+    ("stack", {"X": [X34, Y34]}, {"axis": 0}, {"ref": {"Y": np.stack([X34, Y34])}, "out": "Y"}),
+    ("unstack", {"X": X34}, {"axis": 0, "num": 3}, {"shape": None}),
+    ("unbind", {"X": X34}, {"axis": 0}, {"shape": None}),
+    ("concat", {"X": [X34, Y34]}, {"axis": 1}, {"ref": {"Out": np.concatenate([X34, Y34], 1)}}),
+    ("split", {"X": X34}, {"num": 2, "axis": 1}, {"shape": None}),
+    ("slice", {"Input": X34}, {"axes": [0], "starts": [1], "ends": [3]}, {"ref": {"Out": X34[1:3]}, "grad": ["Input"]}),
+    ("strided_slice", {"Input": X34}, {"axes": [1], "starts": [0], "ends": [4], "strides": [2]}, {"ref": {"Out": X34[:, 0:4:2]}}),
+    ("crop", {"X": X34}, {"offsets": [1, 1], "shape": [2, 2]}, {"ref": {"Out": X34[1:3, 1:3]}}),
+    ("crop_tensor", {"X": X34}, {"offsets": [0, 1], "shape": [2, 3]}, {"ref": {"Out": X34[0:2, 1:4]}}),
+    ("gather", {"X": X34, "Index": np.array([2, 0], np.int32)}, {}, {"ref": {"Out": X34[[2, 0]]}, "grad": ["X"]}),
+    ("gather_nd", {"X": X34, "Index": np.array([[1, 2], [0, 0]], np.int32)}, {}, {"ref": {"Out": X34[[1, 0], [2, 0]]}}),
+    ("scatter", {"X": X34.copy(), "Ids": np.array([1], np.int32), "Updates": _f(1, 4)}, {"overwrite": True}, {"finite": ["Out"]}),
+    ("scatter_nd_add", {"X": X34.copy(), "Index": np.array([[1]], np.int32), "Updates": _f(1, 4)}, {}, {"finite": ["Out"]}),
+    ("index_select", {"X": X34, "Index": np.array([0, 2], np.int32)}, {"dim": 0}, {"ref": {"Out": X34[[0, 2]]}}),
+    ("masked_select", {"X": np.arange(6, dtype=np.float32), "Mask": np.array([1, 0, 1, 0, 1, 0], bool)}, {}, {"finite": ["Y"], "out": "Y"}),
+    ("where", {"Condition": X34 > 0, "X": X34, "Y": Y34}, {}, {"ref": {"Out": np.where(X34 > 0, X34, Y34)}}),
+    ("where_index", {"Condition": np.array([0, 1, 1], bool)}, {}, {"finite": ["Out"]}),
+    ("roll", {"X": X34}, {"shifts": [1], "axis": [0]}, {"ref": {"Out": np.roll(X34, 1, 0)}}),
+    ("tile", {"X": X34}, {"repeat_times": [2, 1]}, {"ref": {"Out": np.tile(X34, (2, 1))}}),
+    ("expand", {"X": X34[:1]}, {"expand_times": [3, 1]}, {"ref": {"Out": np.tile(X34[:1], (3, 1))}}),
+    ("expand_v2", {"X": X34[:1]}, {"shape": [3, 4]}, {"ref": {"Out": np.broadcast_to(X34[:1], (3, 4))}}),
+    ("expand_as", {"X": X34[:1], "target_tensor": X34}, {}, {"shape": {"Out": (3, 4)}}),
+    ("tril_triu", {"X": X34}, {"diagonal": 0, "lower": True}, {"ref": {"Out": np.tril(X34)}}),
+    ("trace", {"Input": X34}, {}, {"ref": {"Out": np.float32(np.trace(X34))}}),
+    ("meshgrid", {"X": [np.arange(3, dtype=np.float32), np.arange(4, dtype=np.float32)]}, {}, {"shape": None}),
+    ("unique", {"X": np.array([3, 1, 3, 2], np.int32)}, {}, {"finite": []}),
+    ("shard_index", {"X": _i(20, 5, 1)}, {"index_num": 20, "nshards": 4, "shard_id": 1}, {"shape": {"Out": (5, 1)}}),
+    ("size", {"Input": X34}, {}, {"ref": {"Out": np.array(12)}}),
+    ("is_empty", {"X": X34}, {}, {"ref": {"Out": np.array(False)}}),
+    ("increment", {"X": np.array([3.0], np.float32)}, {"step": 2.0}, {"ref": {"Out": np.array([5.0], np.float32)}}),
+    ("space_to_depth", {"X": _f(1, 2, 4, 4)}, {"blocksize": 2}, {"shape": {"Out": (1, 8, 2, 2)}}),
+    ("pixel_shuffle", {"X": _f(1, 8, 2, 2)}, {"upscale_factor": 2}, {"shape": {"Out": (1, 2, 4, 4)}}),
+    ("shuffle_channel", {"X": _f(1, 8, 3, 3)}, {"group": 2}, {"shape": {"Out": (1, 8, 3, 3)}}),
+    ("temporal_shift", {"X": _f(4, 4, 3, 3)}, {"seg_num": 2, "shift_ratio": 0.25}, {"shape": {"Out": (4, 4, 3, 3)}}),
+    ("unfold", {"X": _f(1, 2, 4, 4)}, {"kernel_sizes": [2, 2], "strides": [2, 2], "paddings": [0, 0, 0, 0], "dilations": [1, 1]}, {"shape": {"Y": (1, 8, 4)}, "out": "Y"}),
+
+    # ---- fills / creation ----
+    ("fill_constant", {}, {"shape": [2, 3], "value": 2.5, "dtype": "float32"}, {"ref": {"Out": np.full((2, 3), 2.5, np.float32)}}),
+    ("fill_any_like", {"X": X34}, {"value": 1.5}, {"ref": {"Out": np.full((3, 4), 1.5, np.float32)}}),
+    ("fill_zeros_like", {"X": X34}, {}, {"ref": {"Out": np.zeros((3, 4), np.float32)}}),
+    ("fill_constant_batch_size_like", {"Input": X34}, {"shape": [-1, 2], "value": 3.0, "dtype": "float32"}, {"ref": {"Out": np.full((3, 2), 3.0, np.float32)}}),
+    ("eye", {}, {"num_rows": 3, "num_columns": 4, "dtype": "float32"}, {"ref": {"Out": np.eye(3, 4, dtype=np.float32)}}),
+    ("linspace", {"Start": np.array([0.0], np.float32), "Stop": np.array([1.0], np.float32), "Num": np.array([5], np.int32)}, {}, {"ref": {"Out": np.linspace(0, 1, 5, dtype=np.float32)}}),
+    ("range", {"Start": np.array([0.0], np.float32), "End": np.array([5.0], np.float32), "Step": np.array([1.0], np.float32)}, {}, {"ref": {"Out": np.arange(0, 5, 1, dtype=np.float32)}}),
+    ("diag_v2", {"X": np.array([1.0, 2.0], np.float32)}, {}, {"ref": {"Out": np.diag([1.0, 2.0]).astype(np.float32)}}),
+    ("assign", {"X": X34}, {}, {"ref": {"Out": X34}}),
+    ("assign_value", {}, {"shape": [2, 2], "dtype": "float32", "fp32_values": [1.0, 2.0, 3.0, 4.0]}, {"ref": {"Out": np.array([[1, 2], [3, 4]], np.float32)}}),
+    ("cast", {"X": X34}, {"out_dtype": "int32"}, {"ref": {"Out": X34.astype(np.int32)}}),
+    ("one_hot", {"X": _i(5, 4, 1)}, {"depth": 5}, {"shape": {"Out": (4, 5)}}),
+    ("sequence_mask", {"X": np.array([1, 3], np.int32)}, {"maxlen": 4}, {"ref": {"Out": np.array([[1, 0, 0, 0], [1, 1, 1, 0]], np.float32)}}),
+
+    # ---- random (shape/dtype contracts only) ----
+    ("gaussian_random", {}, {"shape": [3, 4], "dtype": "float32"}, {"shape": {"Out": (3, 4)}}),
+    ("uniform_random", {}, {"shape": [3, 4], "min": -1.0, "max": 1.0}, {"shape": {"Out": (3, 4)}}),
+    ("truncated_gaussian_random", {}, {"shape": [3, 4]}, {"shape": {"Out": (3, 4)}}),
+    ("randint", {}, {"shape": [3, 4], "low": 0, "high": 10}, {"shape": {"Out": (3, 4)}}),
+    ("randperm", {}, {"n": 8}, {"shape": {"Out": (8,)}}),
+    ("sampling_id", {"X": np.tile(np.array([[0.1, 0.9]], np.float32), (4, 1))}, {}, {"shape": {"Out": (4,)}}),
+    ("random_crop", {"X": _f(1, 3, 8, 8), "Seed": np.array([0], np.int32)}, {"shape": [3, 5, 5]}, {"shape": {"Out": (1, 3, 5, 5)}}),
+
+    # ---- reductions / norms ----
+    ("reduce_any", {"X": X34 > 1.5}, {"reduce_all": True}, {"ref": {"Out": np.array((X34 > 1.5).any())}}),
+    ("l1_norm", {"X": X34}, {}, {"ref": {"Out": np.abs(X34).sum()}, "grad": ["X"]}),
+    ("squared_l2_norm", {"X": X34}, {}, {"ref": {"Out": (X34 ** 2).sum()}, "grad": ["X"]}),
+    ("norm", {"X": X34}, {"axis": 1}, {"finite": ["Out"], "grad": ["X"]}),
+    ("p_norm", {"X": X34}, {"porder": 2.0, "axis": 1}, {"ref": {"Out": np.linalg.norm(X34, 2, 1)}, "grad": ["X"]}),
+    ("fsp", {"X": _f(2, 3, 4, 4), "Y": _f(2, 5, 4, 4)}, {}, {"shape": {"Out": (2, 3, 5)}}),
+
+    # ---- nn singles ----
+    ("fc", {"Input": X34, "W": _f(4, 5)}, {}, {"shape": {"Out": (3, 5)}, "grad": ["Input", "W"]}),
+    ("lookup_table", {"W": _f(10, 4), "Ids": _i(10, 3, 1)}, {}, {"shape": {"Out": (3, 4)}}),
+    ("group_norm", {"X": _f(2, 4, 3, 3), "Scale": np.ones(4, np.float32), "Bias": np.zeros(4, np.float32)}, {"groups": 2, "epsilon": 1e-5}, {"finite": ["Y"], "out": "Y"}),
+    ("instance_norm", {"X": _f(2, 4, 3, 3), "Scale": np.ones(4, np.float32), "Bias": np.zeros(4, np.float32)}, {"epsilon": 1e-5}, {"finite": ["Y"], "out": "Y"}),
+    ("data_norm", {"X": X34, "BatchSize": np.full(4, 10.0, np.float32), "BatchSum": np.zeros(4, np.float32), "BatchSquareSum": np.full(4, 10.0, np.float32)}, {}, {"finite": ["Y"], "out": "Y"}),
+    ("lrn", {"X": _f(1, 4, 3, 3)}, {"n": 2}, {"finite": ["Out"]}),
+    ("maxout", {"X": _f(1, 4, 3, 3)}, {"groups": 2}, {"shape": {"Out": (1, 2, 3, 3)}}),
+    ("prelu", {"X": X34, "Alpha": np.array([0.2], np.float32)}, {"mode": "all"}, {"ref": {"Out": np.where(X34 >= 0, X34, 0.2 * X34)}}),
+    ("log_softmax", {"X": X34}, {"axis": -1}, {"finite": ["Out"], "grad": ["X"]}),
+    ("max_pool2d_with_index", {"X": _f(1, 2, 4, 4)}, {"ksize": [2, 2]}, {"shape": {"Out": (1, 2, 2, 2)}}),
+    ("depthwise_conv2d", {"Input": _f(1, 4, 5, 5), "Filter": _f(4, 1, 3, 3)}, {"strides": [1, 1], "paddings": [1, 1]}, {"shape": {"Output": (1, 4, 5, 5)}, "out": "Output"}),
+    ("conv_shift", {"X": _f(2, 5), "Y": _f(2, 3)}, {}, {"shape": {"Out": (2, 5)}}),
+    ("pad", {"X": X34}, {"paddings": [1, 1, 0, 0], "pad_value": 0.0}, {"ref": {"Out": np.pad(X34, ((1, 1), (0, 0)))}}),
+    ("pad2d", {"X": _f(1, 1, 3, 3)}, {"paddings": [1, 1, 1, 1], "mode": "constant"}, {"shape": {"Out": (1, 1, 5, 5)}}),
+    ("bilinear_tensor_product", {"X": _f(3, 4), "Y": _f(3, 5), "Weight": _f(2, 4, 5)}, {}, {"shape": {"Out": (3, 2)}}),
+    ("spectral_norm", {"Weight": _f(4, 5), "U": _f(4), "V": _f(5)}, {"power_iters": 2}, {"shape": {"Out": (4, 5)}}),
+    ("add_position_encoding", {"X": _f(2, 6, 4)}, {"alpha": 1.0, "beta": 1.0}, {"shape": {"Out": (2, 6, 4)}}),
+    ("im2sequence", {"X": _f(1, 1, 4, 4)}, {"kernels": [2, 2], "strides": [2, 2], "paddings": [0, 0, 0, 0]}, {"shape": {"Out": (4, 4)}}),
+    ("spp", {"X": _f(1, 2, 4, 4)}, {"pyramid_height": 2}, {"finite": ["Out"]}),
+    ("unpool", {"X": np.ones((1, 1, 2, 2), np.float32), "Indices": np.array([[[[0, 3], [12, 15]]]], np.int32)}, {"unpooled_size": [4, 4]}, {"shape": {"Out": (1, 1, 4, 4)}}),
+
+    # ---- losses / metrics-ish ----
+    ("bce_loss", {"X": _pos(3, 4), "Label": (R.random((3, 4)) > 0.5).astype(np.float32)}, {}, {"finite": ["Out"], "grad": ["X"]}),
+    ("log_loss", {"Predicted": _pos(4, 1), "Labels": (R.random((4, 1)) > 0.5).astype(np.float32)}, {"epsilon": 1e-4}, {"finite": ["Loss"], "out": "Loss"}),
+    ("huber_loss", {"X": X34, "Y": Y34}, {"delta": 1.0}, {"finite": ["Out"], "grad": ["X"]}),
+    ("smooth_l1_loss", {"X": X34, "Y": Y34}, {"sigma": 1.0}, {"finite": ["Out"]}),
+    ("kldiv_loss", {"X": X34, "Target": _pos(3, 4)}, {"reduction": "mean"}, {"finite": ["Loss"], "out": "Loss"}),
+    ("label_smooth", {"X": np.eye(4, dtype=np.float32)}, {"epsilon": 0.1}, {"ref": {"Out": np.eye(4, dtype=np.float32) * 0.9 + 0.1 / 4}}),
+    ("sigmoid_cross_entropy_with_logits", {"X": X34, "Label": (R.random((3, 4)) > 0.5).astype(np.float32)}, {}, {"finite": ["Out"], "grad": ["X"]}),
+    ("npair_loss", {"Anchor": _f(4, 8), "Positive": _f(4, 8), "Labels": _i(3, 4).astype(np.float32)}, {"l2_reg": 0.002}, {"finite": ["Out"]}),
+
+    # ---- sequence (padded+Length design) ----
+    ("sequence_pool", {"X": _f(2, 4, 3), "Length": np.array([2, 4], np.int32)}, {"pooltype": "SUM"}, {"shape": {"Out": (2, 3)}}),
+    ("sequence_reverse", {"X": _f(2, 4, 3), "Length": np.array([2, 4], np.int32)}, {}, {"shape": {"Out": (2, 4, 3)}}),
+    ("sequence_softmax", {"X": _f(2, 4), "Length": np.array([2, 4], np.int32)}, {}, {"finite": ["Out"]}),
+    ("sequence_expand", {"X": _f(2, 3), "Length": np.array([2, 2], np.int32)}, {"maxlen": 3}, {"shape": {"Out": (2, 3, 3)}}),
+    ("lod_reset", {"X": _f(4, 3), "Y": np.array([2, 2], np.int32)}, {}, {"shape": {"Out": (4, 3), "Length": (2,)}}),
+
+    # ---- quant family ----
+    ("fake_quantize_abs_max", {"X": X34}, {"bit_length": 8}, {"finite": ["Out"]}),
+    ("fake_dequantize_max_abs", {"X": _i(127, 3, 4).astype(np.float32), "Scale": np.array([2.0], np.float32)}, {"max_range": 127.0}, {"finite": ["Out"]}),
+    ("quantize", {"Input": X34}, {"Scale": 16.0}, {"finite": ["Output"], "out": "Output"}),
+    ("dequantize", {"Input": (X34 * 16).astype(np.int32)}, {"Scale": 16.0}, {"finite": ["Output"], "out": "Output"}),
+    ("requantize", {"Input": (X34 * 16).astype(np.int32)}, {"Scale_in": 16.0, "Scale_out": 8.0}, {"finite": ["Output"], "out": "Output"}),
+    ("dequantize_abs_max", {"X": (X34 * 10).astype(np.int8), "Scale": np.array([0.5], np.float32)}, {"max_range": 127.0}, {"finite": ["Out"]}),
+    ("dequantize_log", {"X": np.abs(X34 * 10).astype(np.int8), "Dict": np.linspace(0.01, 1.0, 128).astype(np.float32)}, {}, {"finite": ["Out"]}),
+    ("moving_average_abs_max_scale", {"X": X34, "InState": np.ones(1, np.float32), "InAccum": np.ones(1, np.float32)}, {"moving_rate": 0.9}, {"finite": ["OutScale"], "out": "OutScale"}),
+
+    # ---- misc ----
+    ("hash", {"X": _i(100, 4, 1)}, {"num_hash": 2, "mod_by": 1000}, {"shape": {"Out": (4, 2)}}),
+    ("shuffle_batch", {"X": X34, "Seed": np.array([1], np.int32)}, {}, {"shape": {"Out": (3, 4)}}),
+    ("filter_by_instag", {"Ins": X34, "Ins_tag": np.array([1, 2, 1], np.int32), "Filter_tag": np.array([1], np.int32)}, {}, {"finite": []}),
+    ("sample_logits", {"Logits": _f(3, 10), "Labels": _i(10, 3, 1),
+                       "CustomizedSamples": _i(10, 3, 4)},
+     {"num_samples": 4}, {"finite": []}),
+]
+
+
+def _specs():
+    for row in SPECS:
+        yield pytest.param(row, id=row[0])
+
+
+@pytest.mark.parametrize("row", _specs())
+def test_op_spec(row):
+    name, ins, attrs, checks = row
+    out = run_kernel(name, ins, attrs)
+    out_slot = checks.get("out", "Out")
+    ref = checks.get("ref")
+    if ref:
+        for slot, exp in ref.items():
+            got = out[slot]
+            assert got.shape == np.asarray(exp).shape, (
+                f"{name}.{slot}: {got.shape} vs {np.asarray(exp).shape}")
+            np.testing.assert_allclose(
+                np.asarray(got, np.float64), np.asarray(exp, np.float64),
+                atol=2e-5, rtol=2e-5, err_msg=f"{name}.{slot}")
+    shapes = checks.get("shape")
+    if shapes:
+        for slot, shp in shapes.items():
+            assert tuple(out[slot].shape) == tuple(shp), (
+                f"{name}.{slot}: {out[slot].shape} vs {shp}")
+    for slot in checks.get("finite", []):
+        assert np.isfinite(np.asarray(out[slot], np.float64)).all(), (
+            f"{name}.{slot} not finite")
+
+    grad_slots = checks.get("grad")
+    if grad_slots:
+        t = OpTest()
+        t.op_type = name
+        t.attrs = attrs
+        t.grad_atol = getattr(t, "grad_atol", 1e-3)
+        t.grad_rtol = getattr(t, "grad_rtol", 1e-3)
+        t.check_grad(ins, grad_slots, out_slot=out_slot)
+
+
+def test_sweep_covers_new_ground():
+    """The sweep must keep covering >= 150 distinct ops."""
+    assert len({r[0] for r in SPECS}) >= 150
