@@ -1,0 +1,62 @@
+// C inference API for paddle_tpu exported models.
+//
+// Parity: /root/reference/paddle/fluid/inference/capi/paddle_c_api.h —
+// the reference wraps AnalysisPredictor behind a C ABI for C/Go
+// deployment (go/paddle/common.go:17-21 consumes it via cgo).  Here the
+// predictor wraps the same Program/Executor runtime the Python front end
+// uses (one runtime, one compiled function; XLA is the engine), hosted in
+// an embedded CPython when called from a plain C process, or the already
+// running interpreter when loaded into a Python process.
+//
+// Build (shared library):
+//   g++ -O2 -shared -fPIC csrc/predictor_capi.cpp \
+//       $(python3-config --includes) $(python3-config --embed --ldflags) \
+//       -o libpaddle_tpu_capi.so
+//
+// All functions return 0 on success, nonzero on failure, and are
+// GIL-correct from any thread.
+
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Predictor PD_Predictor;
+
+// Load an inference model saved by fluid.io.save_inference_model from
+// `model_dir`.  Returns NULL on failure (call PD_LastError for details).
+PD_Predictor* PD_NewPredictor(const char* model_dir);
+
+void PD_DeletePredictor(PD_Predictor* p);
+
+// Number of feed / fetch slots and their names (valid until the
+// predictor is deleted).
+int PD_FeedCount(PD_Predictor* p);
+int PD_FetchCount(PD_Predictor* p);
+const char* PD_FeedName(PD_Predictor* p, int i);
+
+// Bind float32 input data for feed slot `name`: `shape` has `ndim`
+// dims; data is copied.
+int PD_SetInput(PD_Predictor* p, const char* name, const float* data,
+                const int64_t* shape, int ndim);
+
+// Run the program on the bound inputs.
+int PD_Run(PD_Predictor* p);
+
+// Fetch output slot i as float32.  *data points at predictor-owned
+// memory valid until the next PD_Run/PD_Delete; shape/ndim likewise.
+int PD_GetOutput(PD_Predictor* p, int i, const float** data,
+                 const int64_t** shape, int* ndim);
+
+// Last error message (thread-shared, valid until next failing call).
+const char* PD_LastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // PADDLE_TPU_CAPI_H_

@@ -1,0 +1,277 @@
+// C inference API implementation — see paddle_tpu_capi.h.
+//
+// Parity: /root/reference/paddle/fluid/inference/capi/pd_predictor.cc
+// (PD_NewPredictor / PD_PredictorRun / PD_GetZeroCopyOutput).  The
+// reference binds a native AnalysisPredictor; the TPU-native runtime is
+// the XLA/JAX process, so this shim hosts the interpreter (embedding it
+// when the caller is a plain C process) and drives
+// fluid.io.load_inference_model + Executor.run — the exact code path the
+// Python serving flow uses, compiled once and cached by the Executor.
+
+#include "paddle_tpu_capi.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;  // guarded by the GIL
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      g_last_error = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// RAII: make the interpreter exist and hold the GIL for this scope.
+class GilScope {
+ public:
+  GilScope() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      owner_thread_state_ = PyEval_SaveThread();  // release after init
+    }
+    gil_ = PyGILState_Ensure();
+  }
+  ~GilScope() { PyGILState_Release(gil_); }
+
+ private:
+  PyGILState_STATE gil_;
+  PyThreadState* owner_thread_state_ = nullptr;
+};
+
+}  // namespace
+
+struct PD_Predictor {
+  PyObject* obj = nullptr;          // python-side predictor state (dict)
+  std::vector<std::string> feed_names;
+  // flat copies of the last outputs, owned here so pointers stay valid
+  std::vector<std::vector<float>> out_data;
+  std::vector<std::vector<int64_t>> out_shape;
+};
+
+static const char* kBootstrap = R"PY(
+import os, sys
+if os.getcwd() not in sys.path:
+    sys.path.insert(0, os.getcwd())
+repo = os.environ.get("PADDLE_TPU_ROOT")
+if repo and repo not in sys.path:
+    sys.path.insert(0, repo)
+plat = os.environ.get("PADDLE_TPU_CAPI_PLATFORM")
+if plat:
+    # jax.config override beats any site-pinned JAX_PLATFORMS (e.g. to
+    # serve on CPU while another process holds the accelerator)
+    import jax
+    jax.config.update("jax_platforms", plat)
+import numpy as np
+import paddle_tpu as fluid
+
+
+def _pd_new_predictor(model_dir):
+    exe = fluid.Executor()
+    program, feeds, fetches = fluid.io.load_inference_model(model_dir, exe)
+    return {"exe": exe, "program": program, "feeds": feeds,
+            "fetches": fetches, "inputs": {}, "outputs": []}
+
+
+def _pd_set_input(st, name, flat, shape):
+    st["inputs"][name] = np.asarray(flat, np.float32).reshape(shape)
+
+
+def _pd_run(st):
+    outs = st["exe"].run(st["program"], feed=st["inputs"],
+                         fetch_list=st["fetches"])
+    st["outputs"] = [np.ascontiguousarray(np.asarray(o, np.float32))
+                     for o in outs]
+)PY";
+
+static PyObject* g_module_dict = nullptr;  // bootstrap globals (GIL-guarded)
+
+static bool ensure_bootstrap() {
+  if (g_module_dict) return true;
+  g_module_dict = PyDict_New();
+  PyDict_SetItemString(g_module_dict, "__builtins__", PyEval_GetBuiltins());
+  PyObject* r =
+      PyRun_String(kBootstrap, Py_file_input, g_module_dict, g_module_dict);
+  if (!r) {
+    set_error_from_python();
+    Py_CLEAR(g_module_dict);
+    return false;
+  }
+  Py_DECREF(r);
+  return true;
+}
+
+extern "C" {
+
+PD_Predictor* PD_NewPredictor(const char* model_dir) {
+  GilScope gil;
+  if (!ensure_bootstrap()) return nullptr;
+  PyObject* fn = PyDict_GetItemString(g_module_dict, "_pd_new_predictor");
+  PyObject* st = PyObject_CallFunction(fn, "s", model_dir);
+  if (!st) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PD_Predictor* p = new PD_Predictor;
+  p->obj = st;
+  PyObject* feeds = PyDict_GetItemString(st, "feeds");
+  for (Py_ssize_t i = 0; i < PyList_Size(feeds); ++i) {
+    p->feed_names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(feeds, i)));
+  }
+  return p;
+}
+
+void PD_DeletePredictor(PD_Predictor* p) {
+  if (!p) return;
+  {
+    GilScope gil;
+    Py_XDECREF(p->obj);
+  }
+  delete p;
+}
+
+int PD_FeedCount(PD_Predictor* p) {
+  return static_cast<int>(p->feed_names.size());
+}
+
+const char* PD_FeedName(PD_Predictor* p, int i) {
+  if (i < 0 || i >= static_cast<int>(p->feed_names.size())) return nullptr;
+  return p->feed_names[i].c_str();
+}
+
+int PD_FetchCount(PD_Predictor* p) {
+  GilScope gil;
+  PyObject* fetches = PyDict_GetItemString(p->obj, "fetches");
+  return static_cast<int>(PyList_Size(fetches));
+}
+
+int PD_SetInput(PD_Predictor* p, const char* name, const float* data,
+                const int64_t* shape, int ndim) {
+  GilScope gil;
+  int64_t n = 1;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= shape[i];
+    PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* flat = PyList_New(n);
+  for (int64_t i = 0; i < n; ++i) {
+    PyList_SetItem(flat, i, PyFloat_FromDouble(data[i]));
+  }
+  PyObject* fn = PyDict_GetItemString(g_module_dict, "_pd_set_input");
+  PyObject* r = PyObject_CallFunction(fn, "OsOO", p->obj, name, flat, shp);
+  Py_DECREF(flat);
+  Py_DECREF(shp);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int PD_Run(PD_Predictor* p) {
+  GilScope gil;
+  PyObject* fn = PyDict_GetItemString(g_module_dict, "_pd_run");
+  PyObject* r = PyObject_CallFunction(fn, "O", p->obj);
+  if (!r) {
+    set_error_from_python();
+    return 1;
+  }
+  Py_DECREF(r);
+  // snapshot outputs into C-owned buffers
+  PyObject* outs = PyDict_GetItemString(p->obj, "outputs");
+  Py_ssize_t n = PyList_Size(outs);
+  p->out_data.assign(n, {});
+  p->out_shape.assign(n, {});
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* arr = PyList_GetItem(outs, i);  // np.float32, contiguous
+    PyObject* shape = PyObject_GetAttrString(arr, "shape");
+    for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d) {
+      p->out_shape[i].push_back(
+          PyLong_AsLongLong(PyTuple_GetItem(shape, d)));
+    }
+    Py_DECREF(shape);
+    PyObject* tb = PyObject_CallMethod(arr, "tobytes", nullptr);
+    if (!tb) {
+      set_error_from_python();
+      return 1;
+    }
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    PyBytes_AsStringAndSize(tb, &buf, &len);
+    p->out_data[i].resize(len / sizeof(float));
+    std::memcpy(p->out_data[i].data(), buf, len);
+    Py_DECREF(tb);
+  }
+  return 0;
+}
+
+int PD_GetOutput(PD_Predictor* p, int i, const float** data,
+                 const int64_t** shape, int* ndim) {
+  if (i < 0 || i >= static_cast<int>(p->out_data.size())) {
+    g_last_error = "output index out of range";
+    return 1;
+  }
+  *data = p->out_data[i].data();
+  *shape = p->out_shape[i].data();
+  *ndim = static_cast<int>(p->out_shape[i].size());
+  return 0;
+}
+
+const char* PD_LastError(void) { return g_last_error.c_str(); }
+
+}  // extern "C"
+
+#ifdef PD_CAPI_DEMO_MAIN
+// Standalone smoke main: PD_CAPI_DEMO_MAIN + model dir argv[1]; feeds
+// ones into every input of shape [1, K] given by PD_DEMO_FEED_DIM env.
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <model_dir>\n", argv[0]);
+    return 2;
+  }
+  PD_Predictor* p = PD_NewPredictor(argv[1]);
+  if (!p) {
+    std::fprintf(stderr, "load failed: %s\n", PD_LastError());
+    return 1;
+  }
+  const char* dim_s = std::getenv("PD_DEMO_FEED_DIM");
+  int64_t dim = dim_s ? std::atoll(dim_s) : 4;
+  std::vector<float> ones(static_cast<size_t>(dim), 1.0f);
+  int64_t shape[2] = {1, dim};
+  for (int i = 0; i < PD_FeedCount(p); ++i) {
+    if (PD_SetInput(p, PD_FeedName(p, i), ones.data(), shape, 2)) {
+      std::fprintf(stderr, "set input failed: %s\n", PD_LastError());
+      return 1;
+    }
+  }
+  if (PD_Run(p)) {
+    std::fprintf(stderr, "run failed: %s\n", PD_LastError());
+    return 1;
+  }
+  const float* out = nullptr;
+  const int64_t* oshape = nullptr;
+  int ondim = 0;
+  PD_GetOutput(p, 0, &out, &oshape, &ondim);
+  std::printf("out[0] dims=%d first=%f\n", ondim, out[0]);
+  PD_DeletePredictor(p);
+  return 0;
+}
+#endif

@@ -380,27 +380,31 @@ def batch_norm(ins, attrs):
     bshape = [1] * x.ndim
     bshape[c_axis] = x.shape[c_axis]
 
-    # mixed-precision convention: stats accumulate in the running-stat
-    # dtype (f32), the normalized output returns in x's dtype (a bf16
-    # model keeps f32 running buffers without promoting activations)
-    xf = x.astype(jnp.promote_types(x.dtype, mean_in.dtype))
+    # mixed-precision convention: stats accumulate in f32 (single-pass
+    # E[x^2]-E[x]^2 reductions — one read of x, the fused-kernel form),
+    # while the normalize itself is an x*a+b affine in x's OWN dtype so a
+    # bf16 model never materializes f32 activations and XLA can fuse the
+    # affine into the producing conv's epilogue
+    acc_t = jnp.promote_types(x.dtype, mean_in.dtype)
     if use_global:
         mean, var = mean_in, var_in
         mean_out, var_out = mean_in, var_in
         saved_mean = jnp.zeros_like(mean_in)
         saved_var = jnp.zeros_like(var_in)
     else:
-        mean = jnp.mean(xf, axis=reduce_axes)
-        var = jnp.mean(jnp.square(xf - mean.reshape(bshape)),
-                       axis=reduce_axes)
+        mean = jnp.mean(x, axis=reduce_axes, dtype=acc_t)
+        mean_sq = jnp.mean(jnp.square(x.astype(acc_t)), axis=reduce_axes)
+        var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
         mean_out = mean_in * momentum + mean * (1 - momentum)
         var_out = var_in * momentum + var * (1 - momentum)
         saved_mean = mean
         saved_var = 1.0 / jnp.sqrt(var + eps)
 
     inv = 1.0 / jnp.sqrt(var + eps)
-    y = ((xf - mean.reshape(bshape)) * (inv * scale).reshape(bshape)
-         + bias.reshape(bshape)).astype(x.dtype)
+    a = (inv * scale.astype(acc_t)).astype(x.dtype)
+    b = (bias.astype(acc_t) - mean * inv * scale.astype(acc_t)).astype(
+        x.dtype)
+    y = x * a.reshape(bshape) + b.reshape(bshape)
     return {
         "Y": y,
         "MeanOut": mean_out,
