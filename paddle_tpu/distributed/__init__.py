@@ -22,6 +22,7 @@ from .sharded import (
 )
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import gpipe, build_gpt_pipeline
+from .federated import FLClient, FLServer, run_fl_round
 from .ps import (
     SparseEmbedding, Communicator, PSServer, PSClient, HeartBeatMonitor,
 )
@@ -40,4 +41,5 @@ __all__ = [
     "gpipe", "build_gpt_pipeline",
     "SparseEmbedding", "Communicator", "PSServer", "PSClient",
     "HeartBeatMonitor",
+    "FLServer", "FLClient", "run_fl_round",
 ]
