@@ -23,6 +23,10 @@ from .sharded import (
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import gpipe, build_gpt_pipeline
 from .federated import FLClient, FLServer, run_fl_round
+from .moe import (
+    init_moe_params, moe_ffn, shard_moe_params, sharded_moe_ffn,
+    top_k_gating,
+)
 from .ps import (
     SparseEmbedding, Communicator, PSServer, PSClient, HeartBeatMonitor,
 )
@@ -42,4 +46,6 @@ __all__ = [
     "SparseEmbedding", "Communicator", "PSServer", "PSClient",
     "HeartBeatMonitor",
     "FLServer", "FLClient", "run_fl_round",
+    "init_moe_params", "moe_ffn", "sharded_moe_ffn", "shard_moe_params",
+    "top_k_gating",
 ]

@@ -15,10 +15,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _GLOBAL_MESH = None
 
 # canonical axis order
-AXES = ("pp", "dp", "sp", "tp")
+AXES = ("pp", "dp", "sp", "tp", "ep")
 
 
-def build_mesh(dp=1, tp=1, pp=1, sp=1, devices=None):
+def build_mesh(dp=1, tp=1, pp=1, sp=1, ep=1, devices=None):
     """Create a Mesh with the requested parallelism degrees.
 
     Axis semantics (scaling-book conventions):
@@ -26,13 +26,14 @@ def build_mesh(dp=1, tp=1, pp=1, sp=1, devices=None):
       tp — tensor parallel (megatron-style sharded matmuls)
       pp — pipeline stages
       sp — sequence/context parallel (ring attention)
+      ep — expert parallel (MoE all_to_all dispatch)
     """
     devices = devices if devices is not None else jax.devices()
-    need = dp * tp * pp * sp
+    need = dp * tp * pp * sp * ep
     if need > len(devices):
         raise ValueError(
             f"mesh needs {need} devices, only {len(devices)} available")
-    devs = np.array(devices[:need]).reshape(pp, dp, sp, tp)
+    devs = np.array(devices[:need]).reshape(pp, dp, sp, tp, ep)
     return Mesh(devs, AXES)
 
 

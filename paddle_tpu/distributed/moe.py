@@ -1,0 +1,132 @@
+"""Mixture-of-Experts with expert parallelism over a mesh axis.
+
+The reference has no MoE (SURVEY §2.3: expert parallel — NO); this module
+is capability the TPU rebuild adds, designed mesh-first the way the
+scaling-book prescribes: experts are a sharded leading dimension, tokens
+are dispatched to expert shards with one-hot einsums (GShard/Switch
+style, all static shapes for the MXU), and the `ep` mesh axis turns the
+dispatch/combine einsums into XLA all_to_all collectives over ICI —
+no hand-written communication.
+
+Forms:
+- `top_k_gating`: softmax router with top-k expert choice, capacity
+  clipping, and the Switch load-balance auxiliary loss.
+- `moe_ffn`: dense (single-device or auto-sharded under jit) MoE FFN.
+- `sharded_moe_ffn`: the same computation with explicit sharding
+  constraints so pjit lowers dispatch/combine to all_to_all over "ep".
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(key, num_experts, d_model, d_hidden, dtype=jnp.float32):
+    """Router + per-expert FFN weights: wg [D,E], w1 [E,D,H], w2 [E,H,D]."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = 1.0 / math.sqrt(d_model)
+    s2 = 1.0 / math.sqrt(d_hidden)
+    return {
+        "wg": (jax.random.normal(k1, (d_model, num_experts)) * s1
+               ).astype(dtype),
+        "w1": (jax.random.normal(k2, (num_experts, d_model, d_hidden))
+               * s1).astype(dtype),
+        "w2": (jax.random.normal(k3, (num_experts, d_hidden, d_model))
+               * s2).astype(dtype),
+    }
+
+
+def top_k_gating(x, wg, k=2, capacity_factor=1.25, min_capacity=4):
+    """Route tokens to top-k experts.
+
+    x: [N, D] tokens. Returns (dispatch [N, E, C] bool-ish float,
+    combine [N, E, C], aux_loss) with C = ceil(k*N/E * capacity_factor).
+    """
+    n, _ = x.shape
+    e = wg.shape[1]
+    cap = max(int(min_capacity),
+              int(math.ceil(k * n / e * capacity_factor)))
+    logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)    # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    dispatch = jnp.zeros((n, e, cap), jnp.float32)
+    combine = jnp.zeros((n, e, cap), jnp.float32)
+    masked = probs
+    # Switch load-balance loss on the FULL router distribution
+    me = probs.mean(axis=0)                                    # [E]
+    total_mask = jnp.zeros((n, e), jnp.float32)
+    counts = jnp.zeros((e,), jnp.float32)  # slots taken by earlier passes
+
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=1)                       # [N]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)     # [N, E]
+        # position inside the expert's capacity, offset past the slots
+        # already taken by previous choice passes (GShard position
+        # bookkeeping; without the offset 2nd-choice tokens double-book)
+        pos = ((jnp.cumsum(onehot, axis=0) - 1.0)
+               + counts[None, :]) * onehot                     # [N, E]
+        keep = (pos < cap) & (onehot > 0)
+        pos_c = jax.nn.one_hot(pos.sum(axis=1).astype(jnp.int32), cap,
+                               dtype=jnp.float32)              # [N, C]
+        slot = keep.astype(jnp.float32)[:, :, None] * pos_c[:, None, :]
+        gate = (probs * onehot).sum(axis=1, keepdims=True)     # [N, 1]
+        dispatch = dispatch + slot
+        combine = combine + slot * gate[:, :, None]
+        total_mask = total_mask + onehot
+        counts = counts + keep.astype(jnp.float32).sum(axis=0)
+        masked = masked * (1.0 - onehot)                       # next choice
+
+    ce = total_mask.mean(axis=0) / k                           # frac routed
+    aux_loss = e * jnp.sum(me * ce)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(params, x, k=2, capacity_factor=1.25, activation=jax.nn.gelu):
+    """MoE feed-forward over tokens x: [..., D] -> [..., D], plus the
+    load-balance aux loss. Static-shape einsum dispatch (MXU-friendly)."""
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    toks = x.reshape(-1, d)
+    dispatch, combine, aux = top_k_gating(
+        toks, params["wg"], k=k, capacity_factor=capacity_factor)
+    xin = jnp.einsum("nd,nec->ecd", toks.astype(jnp.float32), dispatch)
+    h = activation(jnp.einsum("ecd,edh->ech", xin,
+                              params["w1"].astype(jnp.float32)))
+    out = jnp.einsum("ech,ehd->ecd", h, params["w2"].astype(jnp.float32))
+    y = jnp.einsum("ecd,nec->nd", out, combine)
+    return y.reshape(*lead, d).astype(x.dtype), aux
+
+
+def shard_moe_params(params, mesh, axis="ep"):
+    """Place expert-major weights over the mesh's expert axis; the router
+    is replicated."""
+    put = lambda v, spec: jax.device_put(v, NamedSharding(mesh, spec))
+    return {
+        "wg": put(params["wg"], P()),
+        "w1": put(params["w1"], P(axis, None, None)),
+        "w2": put(params["w2"], P(axis, None, None)),
+    }
+
+
+def sharded_moe_ffn(params, x, mesh, axis="ep", k=2, capacity_factor=1.25,
+                    activation=jax.nn.gelu):
+    """Expert-parallel MoE forward: expert weights sharded over `axis`,
+    dispatch/combine einsums constrained so XLA lowers them to
+    all_to_all over that axis (tokens replicated or batch-sharded by the
+    caller's outer pjit)."""
+    cst = jax.lax.with_sharding_constraint
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    toks = x.reshape(-1, d)
+    dispatch, combine, aux = top_k_gating(
+        toks, params["wg"], k=k, capacity_factor=capacity_factor)
+    xin = jnp.einsum("nd,nec->ecd", toks.astype(jnp.float32), dispatch)
+    xin = cst(xin, NamedSharding(mesh, P(axis, None, None)))
+    h = activation(jnp.einsum("ecd,edh->ech", xin,
+                              params["w1"].astype(jnp.float32)))
+    out = jnp.einsum("ech,ehd->ecd", h, params["w2"].astype(jnp.float32))
+    out = cst(out, NamedSharding(mesh, P(axis, None, None)))
+    y = jnp.einsum("ecd,nec->nd", out, combine)
+    return y.reshape(*lead, d).astype(x.dtype), aux

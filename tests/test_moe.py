@@ -1,0 +1,96 @@
+"""Mixture-of-Experts / expert-parallel tests (capability absent in the
+reference — SURVEY §2.3 expert parallel: NO; this verifies the TPU-native
+addition): gating invariants, dense-vs-expert-parallel parity on the
+8-device CPU mesh, gradient flow, and load-balance loss behavior."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.moe import (
+    init_moe_params, moe_ffn, shard_moe_params, sharded_moe_ffn,
+    top_k_gating)
+
+
+def _params(e=4, d=8, h=16, seed=0):
+    return init_moe_params(jax.random.PRNGKey(seed), e, d, h)
+
+
+def test_gating_dispatch_invariants():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)
+    p = _params()
+    dispatch, combine, aux = top_k_gating(x, p["wg"], k=2,
+                                          capacity_factor=2.0)
+    n, e, c = dispatch.shape
+    assert e == 4
+    # each token lands in at most k distinct (expert, slot) cells
+    per_tok = dispatch.sum(axis=(1, 2))
+    assert float(per_tok.max()) <= 2.0 + 1e-6
+    # no slot is double-booked
+    per_slot = dispatch.sum(axis=0)
+    assert float(per_slot.max()) <= 1.0 + 1e-6
+    # combine weights live only where dispatch does and are probabilities
+    assert float(jnp.where(dispatch == 0, combine, 0.0).max()) == 0.0
+    assert float(combine.max()) <= 1.0 + 1e-6
+    assert float(aux) > 0.0
+
+
+def test_capacity_drops_overflow_tokens():
+    # all tokens prefer the same expert: tiny capacity drops the excess
+    x = jnp.ones((16, 8), jnp.float32)
+    wg = jnp.zeros((8, 4), jnp.float32).at[:, 1].set(5.0)
+    dispatch, _, _ = top_k_gating(x, wg, k=1, capacity_factor=0.25,
+                                  min_capacity=2)
+    routed = float(dispatch.sum())
+    assert routed <= 4.0 + 1e-6  # capped well below 16
+
+
+def test_moe_ffn_shapes_and_grad():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 16, 8)), jnp.float32)
+    p = _params()
+
+    def loss(p):
+        y, aux = moe_ffn(p, x, k=2)
+        return jnp.sum(y ** 2) + 0.01 * aux
+
+    g = jax.grad(loss)(p)
+    assert g["wg"].shape == p["wg"].shape
+    assert float(jnp.abs(g["w1"]).sum()) > 0
+    assert float(jnp.abs(g["wg"]).sum()) > 0  # router receives gradient
+
+
+def test_expert_parallel_matches_dense():
+    mesh = build_mesh(ep=8)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((4, 8, 16)), jnp.float32)
+    p = init_moe_params(jax.random.PRNGKey(3), 8, 16, 32)
+
+    y_dense, aux_dense = moe_ffn(p, x, k=2)
+
+    ps = shard_moe_params(p, mesh, axis="ep")
+
+    @jax.jit
+    def fwd(ps, x):
+        return sharded_moe_ffn(ps, x, mesh, axis="ep", k=2)
+
+    y_sh, aux_sh = fwd(ps, x)
+    np.testing.assert_allclose(np.asarray(y_sh), np.asarray(y_dense),
+                               atol=1e-5)
+    np.testing.assert_allclose(float(aux_sh), float(aux_dense), rtol=1e-5)
+    # expert weights really are sharded over the ep axis
+    assert ps["w1"].sharding.spec == jax.sharding.PartitionSpec(
+        "ep", None, None)
+
+
+def test_load_balance_loss_prefers_uniform_routing():
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((64, 8)),
+                    jnp.float32)
+    uniform_wg = jnp.zeros((8, 4), jnp.float32)
+    skew_wg = jnp.zeros((8, 4), jnp.float32).at[:, 0].set(4.0)
+    _, _, aux_u = top_k_gating(x, uniform_wg, k=1)
+    _, _, aux_s = top_k_gating(x, skew_wg, k=1)
+    assert float(aux_s) > float(aux_u)
