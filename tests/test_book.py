@@ -258,3 +258,44 @@ def test_machine_translation_greedy_decode():
     # predictions reproduce the learned shifted-copy target (zero-state
     # start makes a strict all-match too brittle)
     assert (sample[:, 0] == src[:, 1]).mean() >= 0.5
+
+
+def test_image_classification_vgg_style():
+    """book/test_image_classification.py — the 8th book model: a small
+    VGG-style conv-bn-relu stack on 3x32x32 inputs (CIFAR geometry),
+    trained on learnable synthetic class prototypes; loss must halve and
+    a for_test clone must run without labels."""
+    rng = np.random.default_rng(9)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.data("img", [None, 3, 32, 32])
+        label = fluid.data("label", [None, 1], dtype="int64")
+
+        def conv_block(x, ch):
+            c = L.conv2d(x, ch, 3, padding=1)
+            b = L.batch_norm(c, act="relu")
+            return L.pool2d(b, 2, "max", 2)
+
+        h = conv_block(img, 16)
+        h = conv_block(h, 32)
+        h = L.fc(L.flatten(h), 64, act="relu")
+        pred = L.fc(h, 10, act="softmax")
+        loss = L.mean(L.cross_entropy(pred, label))
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+
+    protos = rng.standard_normal((10, 3, 32, 32)).astype(np.float32)
+
+    def feeds():
+        lab = rng.integers(0, 10, (32, 1))
+        imgs = protos[lab[:, 0]] + \
+            0.3 * rng.standard_normal((32, 3, 32, 32)).astype(np.float32)
+        return {"img": imgs.astype(np.float32),
+                "label": lab.astype(np.int64)}
+
+    losses, exe = _train(main, startup, feeds, loss, epochs=40)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    test_prog = main.clone(for_test=True)
+    out = exe.run(test_prog, feed={"img": feeds()["img"]},
+                  fetch_list=[pred])
+    assert np.asarray(out[0]).shape == (32, 10)
