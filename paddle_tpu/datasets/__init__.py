@@ -1,0 +1,22 @@
+"""Stock dataset zoo — the `paddle.dataset.*` reader API surface.
+
+Parity: /root/reference/python/paddle/dataset/ (mnist.py, cifar.py,
+uci_housing.py, imdb.py, movielens.py, conll05.py, wmt14.py ...): each
+dataset exposes reader *creators* — zero-arg callables returning a
+generator of samples — that compose with paddle_tpu.reader decorators
+(shuffle/batch/map_readers).
+
+Design note (documented deviation): the reference downloads real corpora
+at import time; this environment is offline by design, so every dataset
+here synthesizes a deterministic, learnable surrogate with the exact
+sample STRUCTURE of the original (shapes, dtypes, vocab semantics,
+label ranges). Model code written against the reference API runs
+unchanged; numbers differ. Seeds are fixed so runs are reproducible.
+"""
+
+from . import cifar, conll05, imdb, mnist, movielens, uci_housing, wmt14
+
+__all__ = [
+    "mnist", "cifar", "uci_housing", "imdb", "movielens", "conll05",
+    "wmt14",
+]

@@ -1,0 +1,87 @@
+"""Stock dataset zoo tests (parity: python/paddle/dataset/ reader-creator
+API): structure of each sample, determinism, composition with the
+reader decorators, and end-to-end learnability of the surrogates."""
+
+import numpy as np
+
+import paddle_tpu.datasets as D
+from paddle_tpu import reader as R
+
+
+def test_mnist_shapes_and_determinism():
+    a = list(D.mnist.train()())[:5]
+    b = list(D.mnist.train()())[:5]
+    for (xa, ya), (xb, yb) in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)
+        assert ya == yb
+    x, y = a[0]
+    assert x.shape == (784,) and x.dtype == np.float32
+    assert 0 <= y < 10
+
+
+def test_cifar_variants():
+    x, y = next(D.cifar.train10()())
+    assert x.shape == (3072,) and 0 <= y < 10
+    x, y = next(D.cifar.train100()())
+    assert 0 <= y < 100
+
+
+def test_uci_housing_is_linear():
+    xs, ys = zip(*list(D.uci_housing.train()()))
+    X = np.stack(xs)
+    Y = np.stack(ys)[:, 0]
+    w, *_ = np.linalg.lstsq(X, Y, rcond=None)
+    resid = Y - X @ w
+    assert np.abs(resid).mean() < 0.2  # linear + small noise
+
+
+def test_imdb_vocab_and_signal():
+    wd = D.imdb.word_dict()
+    assert len(wd) == D.imdb.VOCAB
+    for words, label in list(D.imdb.train()())[:20]:
+        assert all(0 <= w < D.imdb.VOCAB for w in words)
+        marker = D.imdb._POS if label else D.imdb._NEG
+        assert marker in words  # the learnable sentiment signal
+
+
+def test_wmt14_shift_convention():
+    src, trg_in, trg_next = next(D.wmt14.train()())
+    assert trg_in[0] == D.wmt14.START
+    assert trg_next[-1] == D.wmt14.END
+    assert trg_in[1:] == trg_next[:-1]
+
+
+def test_movielens_rating_range():
+    for u, m, r in list(D.movielens.train()())[:10]:
+        assert 1 <= u[0] <= D.movielens.max_user_id()
+        assert 1 <= m[0] <= D.movielens.max_movie_id()
+        assert 0.5 <= float(r[0]) <= 5.0
+
+
+def test_conll05_parallel_sequences():
+    sample = next(D.conll05.test()())
+    words = sample[0]
+    assert len(sample) == 9
+    assert all(len(s) == len(words) for s in sample[1:])
+
+
+def test_composes_with_reader_decorators():
+    batched = R.batch(R.shuffle(D.mnist.train(), buf_size=64, seed=0),
+                      batch_size=16)
+    batch = next(batched())
+    assert len(batch) == 16
+    xs = np.stack([b[0] for b in batch])
+    assert xs.shape == (16, 784)
+
+
+def test_mnist_surrogate_is_learnable():
+    """A linear softmax fit on the synthetic mnist beats chance by a wide
+    margin (the class-prototype structure is the learnability contract)."""
+    data = list(D.mnist.train()())[:512]
+    X = np.stack([d[0] for d in data])
+    y = np.array([d[1] for d in data])
+    # one ridge regression per class on one-hot targets
+    T = np.eye(10)[y]
+    W = np.linalg.solve(X.T @ X + 1e-1 * np.eye(784), X.T @ T)
+    acc = (np.argmax(X @ W, 1) == y).mean()
+    assert acc > 0.8, acc
