@@ -53,6 +53,7 @@ from .framework import (
 from .framework import backward
 
 from . import layers
+from . import nets
 from . import optimizer
 from . import regularizer
 from . import clip

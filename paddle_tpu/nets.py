@@ -1,0 +1,113 @@
+"""Composite network builders — fluid.nets parity.
+
+Parity: /root/reference/python/paddle/fluid/nets.py:28
+(simple_img_conv_pool), :138 (img_conv_group), :251 (sequence_conv_pool),
+:319 (glu), :360 (scaled_dot_product_attention). Each helper composes
+this repo's layer builders; XLA fuses the pipeline (the reference's
+motivation for grouping them no longer needs hand care on TPU).
+"""
+
+from . import layers
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    """nets.py:28 — conv2d + pool2d."""
+    conv_out = layers.conv2d(
+        input, num_filters=num_filters, filter_size=filter_size,
+        stride=conv_stride, padding=conv_padding, dilation=conv_dilation,
+        groups=conv_groups, param_attr=param_attr, bias_attr=bias_attr,
+        act=act)
+    return layers.pool2d(
+        conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, pool_padding=pool_padding,
+        global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """nets.py:138 — serial conv(+bn)(+dropout) blocks then one pool (the
+    VGG block)."""
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def expand(v):
+        return list(v) if isinstance(v, (list, tuple)) \
+            else [v] * len(conv_num_filter)
+
+    conv_padding = expand(conv_padding)
+    conv_filter_size = expand(conv_filter_size)
+    param_attr = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * len(conv_num_filter)
+    conv_with_batchnorm = expand(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = expand(conv_batchnorm_drop_rate)
+
+    for i, nf in enumerate(conv_num_filter):
+        local_act = conv_act if not conv_with_batchnorm[i] else None
+        tmp = layers.conv2d(
+            tmp, num_filters=nf, filter_size=conv_filter_size[i],
+            padding=conv_padding[i], param_attr=param_attr[i],
+            act=local_act)
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            rate = conv_batchnorm_drop_rate[i]
+            if abs(rate) > 1e-5:
+                tmp = layers.dropout(tmp, dropout_prob=rate)
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, lengths=None,
+                       param_attr=None, act="sigmoid", pool_type="max",
+                       bias_attr=None):
+    """nets.py:251 — sequence_conv + sequence_pool. Under the padded+
+    lengths ragged design the sequence is [B, T, D] with a lengths
+    vector (pass `lengths`; defaults to full length)."""
+    conv_out = layers.sequence_conv(
+        input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, bias_attr=bias_attr, act=act,
+        lengths=lengths)
+    return layers.sequence_pool(conv_out, lengths, pool_type)
+
+
+def glu(input, dim=-1):
+    """nets.py:319 — gated linear unit: split -> sigmoid -> mul."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """nets.py:360 — multi-head scaled dot-product attention over
+    [B, T, D] q/k/v; returns [B, T_q, D_v]."""
+    if queries.shape[-1] != keys.shape[-1]:
+        raise ValueError("queries and keys need matching hidden size")
+    if num_heads < 1:
+        raise ValueError("num_heads must be >= 1")
+    d = queries.shape[-1]
+    if d % num_heads != 0:
+        raise ValueError("hidden size must divide num_heads")
+
+    def split_heads(x):
+        b = layers.reshape(x, [0, 0, num_heads, x.shape[-1] // num_heads])
+        return layers.transpose(b, [0, 2, 1, 3])
+
+    def combine_heads(x):
+        t = layers.transpose(x, [0, 2, 1, 3])
+        return layers.reshape(t, [0, 0, t.shape[2] * t.shape[3]])
+
+    q = split_heads(queries)
+    k = split_heads(keys)
+    v = split_heads(values)
+    scale = (d // num_heads) ** -0.5
+    logits = layers.matmul(q, k, transpose_y=True, alpha=scale)
+    weights = layers.softmax(logits)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    return combine_heads(layers.matmul(weights, v))

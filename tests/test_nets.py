@@ -1,0 +1,94 @@
+"""fluid.nets composite builder tests (parity: python/paddle/fluid/
+nets.py + the reference's test_layers.py nets cases)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import nets
+
+
+def _run(build):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feeds, out = build()
+    exe = fluid.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feeds(), fetch_list=[out])[0]
+
+
+def test_simple_img_conv_pool():
+    rng = np.random.default_rng(0)
+
+    def build():
+        img = fluid.data("img", [None, 1, 28, 28])
+        out = nets.simple_img_conv_pool(
+            img, num_filters=4, filter_size=5, pool_size=2,
+            pool_stride=2, act="relu")
+        return (lambda: {"img": rng.standard_normal(
+            (2, 1, 28, 28)).astype(np.float32)}), out
+
+    out = _run(build)
+    assert out.shape == (2, 4, 12, 12)
+    assert out.min() >= 0
+
+
+def test_img_conv_group_vgg_block():
+    rng = np.random.default_rng(1)
+
+    def build():
+        img = fluid.data("img", [None, 3, 16, 16])
+        out = nets.img_conv_group(
+            img, conv_num_filter=[8, 8], pool_size=2, pool_stride=2,
+            conv_act="relu", conv_with_batchnorm=True)
+        return (lambda: {"img": rng.standard_normal(
+            (2, 3, 16, 16)).astype(np.float32)}), out
+
+    out = _run(build)
+    assert out.shape == (2, 8, 8, 8)
+
+
+def test_sequence_conv_pool():
+    rng = np.random.default_rng(2)
+
+    def build():
+        x = fluid.data("x", [None, 6, 8])
+        lens = fluid.data("lens", [None], dtype="int64")
+        out = nets.sequence_conv_pool(x, num_filters=5, filter_size=3,
+                                      lengths=lens)
+        return (lambda: {
+            "x": rng.standard_normal((3, 6, 8)).astype(np.float32),
+            "lens": np.array([4, 6, 2], np.int64)}), out
+
+    out = _run(build)
+    assert out.shape[0] == 3 and out.shape[-1] == 5
+
+
+def test_glu_halves_and_gates():
+    rng = np.random.default_rng(3)
+    xv = rng.standard_normal((2, 6)).astype(np.float32)
+
+    def build():
+        x = fluid.data("x", [None, 6])
+        return (lambda: {"x": xv}), nets.glu(x, dim=-1)
+
+    out = _run(build)
+    a, b = xv[:, :3], xv[:, 3:]
+    np.testing.assert_allclose(out, a / (1 + np.exp(-b)), atol=1e-5)
+
+
+def test_scaled_dot_product_attention():
+    rng = np.random.default_rng(4)
+
+    def build():
+        q = fluid.data("q", [None, 5, 8])
+        k = fluid.data("k", [None, 7, 8])
+        v = fluid.data("v", [None, 7, 8])
+        out = nets.scaled_dot_product_attention(q, k, v, num_heads=2)
+        return (lambda: {
+            "q": rng.standard_normal((2, 5, 8)).astype(np.float32),
+            "k": rng.standard_normal((2, 7, 8)).astype(np.float32),
+            "v": rng.standard_normal((2, 7, 8)).astype(np.float32)}), out
+
+    out = _run(build)
+    assert out.shape == (2, 5, 8)
+    assert np.isfinite(out).all()
