@@ -54,6 +54,7 @@ from .framework import backward
 
 from . import layers
 from . import nets
+from . import debugger
 from . import optimizer
 from . import regularizer
 from . import clip
