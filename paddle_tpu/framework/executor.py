@@ -418,7 +418,19 @@ def run_op(op, env, rng_box, const_env=None):
                 f"cannot run under the jitted executor; set "
                 f"FLAGS_eager_executor=1 for this program")
 
-    outs = opdef.fn(ins, attrs)
+    try:
+        outs = opdef.fn(ins, attrs)
+    except Exception as e:
+        # decorate with the op identity + creation site (the reference
+        # attaches the Python stack to op errors, op_call_stack.cc)
+        where = getattr(op, "callsite", None)
+        note = (f"[operator '{op.type}' "
+                f"(inputs {list(op.inputs)}, outputs {list(op.outputs)})"
+                + (f", created at {where}" if where else "") + "]")
+        if hasattr(e, "add_note"):
+            e.add_note(note)
+            raise
+        raise type(e)(f"{e} {note}") from e
     for slot, names in op.outputs.items():
         if slot not in outs:
             continue

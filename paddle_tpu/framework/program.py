@@ -16,11 +16,25 @@ because that is the reference's programming model.
 import contextlib
 import copy
 import json
+import os
+import traceback
 
 import numpy as np
 
 from ..core.dtype import convert_dtype
 from . import unique_name
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _user_callsite():
+    """First stack frame outside the paddle_tpu package — where the user
+    built this op (reference op_call_stack.cc attaches the Python stack
+    to op errors)."""
+    for fr in reversed(traceback.extract_stack(limit=24)):
+        if not fr.filename.startswith(_PKG_DIR):
+            return f"{fr.filename}:{fr.lineno} ({fr.name})"
+    return None
 
 
 class Variable:
@@ -140,6 +154,9 @@ class Operator:
         for slot, vs in (outputs or {}).items():
             self.outputs[slot] = [v.name if isinstance(v, Variable) else v
                                   for v in _as_list(vs)]
+        # creation site for error decoration (op_call_stack.cc parity):
+        # first caller frame outside paddle_tpu
+        self.callsite = _user_callsite()
 
     def input_names(self):
         return [n for vs in self.inputs.values() for n in vs]

@@ -214,3 +214,29 @@ def test_gradients_multi_target_weighted():
     # d(2*sum(y1) + sum(y2))/dw = 2*4 + 4 = 12 per entry (x all-ones)
     np.testing.assert_allclose(np.asarray(out[0]),
                                np.full((3, 2), 12.0), rtol=1e-5)
+
+
+def test_op_errors_carry_callsite():
+    """Errors raised inside a kernel are decorated with the op type and
+    the user-code creation site (op_call_stack.cc parity)."""
+    import traceback
+
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        y = fluid.data("y", [None, 5])
+        z = fluid.layers.elementwise_add(x, y)   # shape mismatch at run
+    exe = fluid.Executor()
+    exe.run(startup)
+    try:
+        exe.run(main, feed={"x": np.zeros((2, 4), np.float32),
+                            "y": np.zeros((2, 5), np.float32)},
+                fetch_list=[z])
+        assert False, "expected a shape error"
+    except Exception:
+        tb = traceback.format_exc()
+        assert "operator 'elementwise_add'" in tb
+        assert "test_executor.py" in tb.split(
+            "operator 'elementwise_add'")[1][:200]
