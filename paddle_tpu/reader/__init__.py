@@ -209,3 +209,94 @@ class DataFeeder:
 
     def feed(self, samples):
         return _stack_samples(samples, self._feed_names)
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel-map a reader with a thread pool (parity:
+    python/paddle/reader/decorator.py:364 xmap_readers — the reference
+    uses threads too). order=True preserves sample order."""
+    import queue as _q
+    import threading as _t
+
+    def xreader():
+        in_q = _q.Queue(buffer_size)
+        out_q = _q.Queue(buffer_size)
+        END = object()
+
+        def feeder():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(END)
+
+        def worker():
+            while True:
+                item = in_q.get()
+                if item is END:
+                    out_q.put(END)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threads = [_t.Thread(target=feeder, daemon=True)]
+        threads += [_t.Thread(target=worker, daemon=True)
+                    for _ in range(process_num)]
+        for t in threads:
+            t.start()
+
+        finished = 0
+        if order:
+            import heapq
+            heap, want = [], 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is END:
+                    finished += 1
+                    continue
+                heapq.heappush(heap, item)
+                while heap and heap[0][0] == want:
+                    yield heapq.heappop(heap)[1]
+                    want += 1
+            while heap:
+                yield heapq.heappop(heap)[1]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is END:
+                    finished += 1
+                    continue
+                yield item[1]
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers, each drained on its own thread
+    (parity: decorator.py:457 — the reference forks processes; readers
+    here are python generators feeding a jit pipeline, so threads give
+    the same overlap without fork hazards under JAX)."""
+    import queue as _q
+    import threading as _t
+
+    def mreader():
+        out_q = _q.Queue(queue_size)
+        END = object()
+
+        def drain(r):
+            for sample in r():
+                out_q.put(sample)
+            out_q.put(END)
+
+        threads = [_t.Thread(target=drain, args=(r,), daemon=True)
+                   for r in readers]
+        for t in threads:
+            t.start()
+        finished = 0
+        while finished < len(readers):
+            item = out_q.get()
+            if item is END:
+                finished += 1
+                continue
+            yield item
+
+    return mreader

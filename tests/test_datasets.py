@@ -85,3 +85,20 @@ def test_mnist_surrogate_is_learnable():
     W = np.linalg.solve(X.T @ X + 1e-1 * np.eye(784), X.T @ T)
     acc = (np.argmax(X @ W, 1) == y).mean()
     assert acc > 0.8, acc
+
+
+def test_xmap_readers_parallel_map():
+    src = lambda: iter(range(20))
+    mapped = R.xmap_readers(lambda x: x * x, src, process_num=3,
+                            buffer_size=8, order=True)
+    assert list(mapped()) == [i * i for i in range(20)]
+    unordered = R.xmap_readers(lambda x: x * x, src, process_num=3,
+                               buffer_size=8, order=False)
+    assert sorted(unordered()) == [i * i for i in range(20)]
+
+
+def test_multiprocess_reader_interleaves():
+    r1 = lambda: iter([1, 2, 3])
+    r2 = lambda: iter([10, 20])
+    out = sorted(R.multiprocess_reader([r1, r2])())
+    assert out == [1, 2, 3, 10, 20]
