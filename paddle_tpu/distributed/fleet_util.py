@@ -1,0 +1,66 @@
+"""Fleet metric aggregation utilities.
+
+Parity: /root/reference/python/paddle/fluid/incubate/fleet/utils/
+fleet_util.py (global AUC / accuracy via allreduce across workers):
+each worker holds local accumulator state; the global metric is computed
+from the SUM of the accumulators, not the mean of local metrics. On TPU
+the allreduce is an XLA psum over a mesh axis (shard_map) — or a plain
+host-side sum when the caller already gathered per-worker states.
+"""
+
+import numpy as np
+
+__all__ = ["sum_accumulators", "global_auc", "global_accuracy",
+           "global_metric_over_mesh"]
+
+
+def sum_accumulators(states):
+    """Elementwise-sum a list of per-worker accumulator arrays (the
+    host-side form of the reference's allreduce)."""
+    out = None
+    for s in states:
+        a = np.asarray(s, np.float64)
+        out = a if out is None else out + a
+    return out
+
+
+def global_auc(stat_pos_list, stat_neg_list, num_thresholds=None):
+    """Global AUC from per-worker positive/negative histogram stats
+    (fleet_util.get_global_auc): sum the histograms, then integrate one
+    ROC curve — NOT the mean of local AUCs."""
+    pos = sum_accumulators(stat_pos_list)
+    neg = sum_accumulators(stat_neg_list)
+    # integrate from the highest threshold bucket down
+    tp = np.cumsum(pos[::-1])
+    fp = np.cumsum(neg[::-1])
+    tot_p = tp[-1]
+    tot_n = fp[-1]
+    if tot_p == 0 or tot_n == 0:
+        return 0.5
+    tpr = np.concatenate([[0.0], tp / tot_p])
+    fpr = np.concatenate([[0.0], fp / tot_n])
+    return float(np.trapezoid(tpr, fpr))
+
+
+def global_accuracy(correct_list, total_list):
+    """Global accuracy = sum(correct) / sum(total) across workers."""
+    c = float(sum_accumulators(correct_list))
+    t = float(sum_accumulators(total_list))
+    return c / max(t, 1.0)
+
+
+def global_metric_over_mesh(mesh, axis, local_state):
+    """psum `local_state` (an array or pytree of arrays) over a mesh
+    axis with shard_map — the in-graph form of the reference's
+    allreduce-based metric aggregation."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def agg(x):
+        return jax.tree.map(lambda v: jax.lax.psum(v, axis), x)
+
+    spec = jax.tree.map(lambda _: P(), local_state)
+    return jax.jit(shard_map(
+        agg, mesh=mesh, in_specs=(spec,), out_specs=spec,
+        check_vma=False))(local_state)

@@ -71,3 +71,54 @@ def test_detection_map_difficult_ignored():
     m.update(det, [1, 1], gt, gt_difficult=[0, 1])
     # the difficult gt is not counted as a positive -> perfect AP
     assert abs(m.eval() - 1.0) < 1e-6
+
+
+def test_fleet_util_global_auc_differs_from_mean_of_locals():
+    """Parity: fleet_util.get_global_auc — sum accumulators THEN compute,
+    which differs from averaging local AUCs on skewed shards."""
+    import numpy as np
+
+    from paddle_tpu.distributed import fleet_util
+    from paddle_tpu.metrics import Auc
+
+    rng = np.random.default_rng(0)
+    # shard 1 sees mostly positives, shard 2 mostly negatives
+    workers = []
+    for frac_pos, seed in ((0.9, 1), (0.1, 2)):
+        r = np.random.default_rng(seed)
+        n = 400
+        labels = (r.random(n) < frac_pos).astype(np.int64)
+        # overlapping score distributions -> imperfect AUC, and a
+        # per-shard bias so local curves differ from the global one
+        scores = np.clip(0.2 * labels + 0.6 * r.random(n)
+                         + 0.15 * frac_pos, 0, 1)
+        m = Auc(num_thresholds=512)
+        preds = np.stack([1 - scores, scores], axis=1)
+        m.update(preds, labels.reshape(-1, 1))
+        workers.append(m)
+    g = fleet_util.global_auc([w._stat_pos for w in workers],
+                              [w._stat_neg for w in workers])
+    local_aucs = [w.eval() for w in workers]
+    assert 0.5 < g <= 1.0
+    assert abs(g - np.mean(local_aucs)) > 1e-3   # genuinely different
+
+
+def test_fleet_util_global_accuracy():
+    from paddle_tpu.distributed import fleet_util
+
+    acc = fleet_util.global_accuracy([10, 30], [20, 40])
+    assert abs(acc - 40.0 / 60.0) < 1e-9
+
+
+def test_global_metric_over_mesh_psum():
+    import numpy as np
+
+    from paddle_tpu.distributed import fleet_util
+    from paddle_tpu.distributed.mesh import build_mesh
+
+    mesh = build_mesh(dp=8)
+    state = {"correct": np.float32(3.0), "total": np.float32(5.0)}
+    out = fleet_util.global_metric_over_mesh(mesh, "dp", state)
+    # replicated input -> psum multiplies by the axis size
+    assert float(out["correct"]) == 24.0
+    assert float(out["total"]) == 40.0
