@@ -28,6 +28,7 @@ from ..nn import Layer
 from ..nn.layers import functional_call, param_dict, load_param_dict
 from ..nn.parameter import EagerParameter, seed
 from ..tape import Tape, Variable, current_tape, pop_tape, push_tape
+from ..jit import ProgramTranslator, declarative  # noqa: F401
 
 __all__ = [
     "guard", "enabled", "to_variable", "no_grad", "grad", "value_and_grad",

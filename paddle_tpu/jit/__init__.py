@@ -180,3 +180,54 @@ def _get_buffer(model, path):
 def _restore_buffers(model, old):
     for path, (owner, leaf, v) in old.items():
         owner._buffers[leaf] = v
+
+
+class ProgramTranslator:
+    """Parity: dygraph_to_static/program_translator.py ProgramTranslator
+    — a singleton switch deciding whether `declarative` functions run
+    compiled (traced through jax.jit) or fall back to eager. The
+    reference converts Python AST; the TPU-native design converts by
+    TRACING (jax's native transform), so data-dependent Python control
+    flow must use layers.cond / lax primitives — a documented contract,
+    enforced with jax's own tracing errors."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._enabled = True
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        self._enabled = bool(enable_to_static)
+
+    def enabled(self):
+        return self._enabled
+
+    @staticmethod
+    def get_instance():
+        return ProgramTranslator()
+
+
+def declarative(fn=None, static_argnums=()):
+    """Parity: @fluid.dygraph.declarative (jit.py) — decorator form of
+    to_static, honoring the ProgramTranslator enable switch per call."""
+    import functools
+
+    def wrap(f):
+        compiled = to_static(f, static_argnums=static_argnums)
+
+        @functools.wraps(f)
+        def runner(*args, **kwargs):
+            if not ProgramTranslator().enabled():
+                return f(*args, **kwargs)
+            return compiled(*args, **kwargs)
+
+        runner.__wrapped__ = f
+        return runner
+
+    return wrap(fn) if fn is not None else wrap
+
+
+__all__ += ["ProgramTranslator", "declarative"]

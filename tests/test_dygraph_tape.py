@@ -212,3 +212,34 @@ def test_np_asarray_on_variable_is_fast():
         arr = np.asarray(y)              # must not walk the sequence proto
         assert arr.shape == (50, 30)
         np.testing.assert_allclose(arr, 2.0)
+
+
+def test_declarative_decorator_and_translator_switch():
+    """Parity: @declarative + ProgramTranslator.enable — compiled by
+    default, eager (python-visible) when disabled."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    import paddle_tpu.dygraph as dg
+
+    calls = {"python": 0}
+
+    @dg.declarative
+    def f(x):
+        calls["python"] += 1
+        return jnp.sin(x) * 2.0
+
+    x = jnp.asarray(np.array([0.5, 1.0], np.float32))
+    a = f(x)
+    a2 = f(x)
+    np.testing.assert_allclose(np.asarray(a), 2 * np.sin([0.5, 1.0]),
+                               atol=1e-6)
+    traced_calls = calls["python"]   # jit traces once (maybe twice)
+    dg.ProgramTranslator().enable(False)
+    try:
+        b = f(x)
+        assert calls["python"] == traced_calls + 1  # ran eagerly
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6)
+    finally:
+        dg.ProgramTranslator().enable(True)
