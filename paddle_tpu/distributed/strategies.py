@@ -40,9 +40,17 @@ __all__ = ["DGCTrainStep", "LocalSGDTrainStep", "dgc_topk_mask"]
 def dgc_topk_mask(v, sparsity):
     """Top-k selection mask on |v|: keep the largest (1-sparsity) fraction.
 
-    The selection itself is the Pallas-friendly part of DGC; at these sizes
-    lax.top_k on the flattened tensor compiles to an efficient TPU sort.
-    """
+    Default: exact kth value via lax.top_k (an efficient TPU sort).
+    Under FLAGS_use_pallas_dgc_topk the threshold instead comes from the
+    streaming Pallas histogram kernel (kernels/topk_threshold.py) — one
+    data pass, no sort, conservatively keeping >= k elements (the DGC
+    paper itself only estimates the threshold)."""
+    from .. import flags
+
+    if flags.flag("use_pallas_dgc_topk"):
+        from ..kernels.topk_threshold import dgc_topk_mask_pallas
+
+        return dgc_topk_mask_pallas(v, sparsity)
     flat = jnp.abs(v).reshape(-1)
     k = max(1, int(round(flat.shape[0] * (1.0 - sparsity))))
     kth = jax.lax.top_k(flat, k)[0][-1]

@@ -107,3 +107,8 @@ declare_flag("profiler_dir", "/tmp/paddle_tpu_profile", "Profiler trace dir.")
 declare_flag("use_pallas_layer_norm", False,
              "Route last-axis layer_norm through the Pallas fused kernel "
              "on TPU (D % 128 == 0).")
+
+declare_flag("use_pallas_dgc_topk", False,
+             "Route DGC top-k gradient selection through the streaming "
+             "Pallas histogram-threshold kernel instead of lax.top_k "
+             "(approximate: keeps >= k elements).")
