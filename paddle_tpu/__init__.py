@@ -55,6 +55,8 @@ from .framework import backward
 from . import layers
 from . import nets
 from . import debugger
+from .lod import (LoDTensor, create_lod_tensor,
+                  create_random_int_lodtensor)
 from . import optimizer
 from . import regularizer
 from . import clip

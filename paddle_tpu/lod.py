@@ -1,0 +1,89 @@
+"""LoD tensor user helpers — fluid.lod_tensor parity.
+
+Parity: /root/reference/python/paddle/fluid/lod_tensor.py:24
+(create_lod_tensor), :114 (create_random_int_lodtensor). The reference
+packs ragged sequences into one flat tensor + offset table (LoD); this
+framework's static-shape contract is padded [B, T, ...] data + a lengths
+vector (SURVEY §7 hard part (c): bucketing + masking design). These
+helpers accept the same ragged inputs the reference does (list of
+lists / flat data + recursive_seq_lens) and produce the padded+lengths
+pair every sequence op here consumes, with a LoDTensor facade exposing
+the reference's accessors.
+"""
+
+import numpy as np
+
+__all__ = ["LoDTensor", "create_lod_tensor",
+           "create_random_int_lodtensor"]
+
+
+class LoDTensor:
+    """Padded batch + per-row lengths, with the reference's accessors
+    (framework/lod_tensor.h:104 analogue at the Python surface)."""
+
+    def __init__(self, padded, lengths):
+        self.data = np.asarray(padded)
+        self.lengths = np.asarray(lengths, np.int64).reshape(-1)
+
+    def recursive_sequence_lengths(self):
+        return [list(map(int, self.lengths))]
+
+    def lod(self):
+        # offset form: [0, l0, l0+l1, ...]
+        return [list(map(int, np.concatenate(
+            [[0], np.cumsum(self.lengths)])))]
+
+    def shape(self):
+        return tuple(self.data.shape)
+
+    def __array__(self, dtype=None):
+        a = self.data
+        return a.astype(dtype) if dtype is not None else a
+
+    def rows(self):
+        """Iterate the unpadded sequences."""
+        for i, n in enumerate(self.lengths):
+            yield self.data[i, :int(n)]
+
+
+def create_lod_tensor(data, recursive_seq_lens=None, place=None):
+    """Build a LoDTensor from a list of per-sequence arrays, or from
+    flat data + recursive_seq_lens (the reference's two accepted forms,
+    lod_tensor.py:24). `place` is accepted for API parity; device
+    placement belongs to jit in this framework."""
+    if recursive_seq_lens is None:
+        seqs = [np.asarray(s) for s in data]
+    else:
+        lens = list(recursive_seq_lens[-1])
+        flat = np.asarray(data)
+        if flat.ndim == 1:
+            flat = flat.reshape(-1, 1)
+        seqs = []
+        off = 0
+        for n in lens:
+            seqs.append(flat[off:off + n])
+            off += n
+        if off != flat.shape[0]:
+            raise ValueError(
+                f"recursive_seq_lens sums to {off}, data has "
+                f"{flat.shape[0]} rows")
+    if not seqs:
+        raise ValueError("need at least one sequence")
+    lengths = np.array([len(s) for s in seqs], np.int64)
+    t = int(lengths.max())
+    tail = seqs[0].shape[1:]
+    out = np.zeros((len(seqs), t) + tail, seqs[0].dtype)
+    for i, s in enumerate(seqs):
+        out[i, :len(s)] = s
+    return LoDTensor(out, lengths)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    """lod_tensor.py:114 — random int sequences with the given ragged
+    lengths; each element has shape `base_shape`."""
+    lens = list(recursive_seq_lens[-1])
+    total = int(sum(lens))
+    flat = np.random.randint(low, high + 1,
+                             size=(total,) + tuple(base_shape))
+    return create_lod_tensor(flat, recursive_seq_lens, place)

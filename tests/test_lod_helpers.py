@@ -1,0 +1,62 @@
+"""fluid.lod_tensor helper tests (parity: lod_tensor.py:24,114 + its
+unittests): ragged input forms, accessor formats, and that the produced
+padded+lengths pair drives a sequence op."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_create_from_list_of_sequences():
+    t = fluid.create_lod_tensor(
+        [np.array([[1.0], [2.0]]), np.array([[3.0]])])
+    assert t.shape() == (2, 2, 1)
+    assert t.recursive_sequence_lengths() == [[2, 1]]
+    assert t.lod() == [[0, 2, 3]]
+    rows = list(t.rows())
+    np.testing.assert_allclose(rows[0], [[1.0], [2.0]])
+    np.testing.assert_allclose(rows[1], [[3.0]])
+    # padding is zero
+    assert t.data[1, 1, 0] == 0.0
+
+
+def test_create_from_flat_plus_lens():
+    flat = np.arange(6, dtype=np.float32).reshape(6, 1)
+    t = fluid.create_lod_tensor(flat, [[4, 2]])
+    assert t.shape() == (2, 4, 1)
+    np.testing.assert_allclose(t.data[0, :, 0], [0, 1, 2, 3])
+    np.testing.assert_allclose(t.data[1, :2, 0], [4, 5])
+
+
+def test_length_mismatch_raises():
+    import pytest
+
+    flat = np.zeros((5, 1), np.float32)
+    with pytest.raises(ValueError):
+        fluid.create_lod_tensor(flat, [[4, 2]])
+
+
+def test_random_int_lodtensor():
+    t = fluid.create_random_int_lodtensor([[3, 1]], base_shape=[1],
+                                          low=0, high=9)
+    assert t.shape() == (2, 3, 1)
+    assert (t.data >= 0).all() and (t.data <= 9).all()
+    assert list(t.lengths) == [3, 1]
+
+
+def test_feeds_sequence_op():
+    from paddle_tpu import layers as L
+
+    t = fluid.create_lod_tensor(
+        [np.ones((2, 3), np.float32), np.full((4, 3), 2.0, np.float32)])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4, 3])
+        lens = fluid.data("lens", [None], dtype="int64")
+        pooled = L.sequence_pool(x, lens, "sum")
+    exe = fluid.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"x": t.data, "lens": t.lengths},
+                  fetch_list=[pooled])[0]
+    np.testing.assert_allclose(out[0], [2, 2, 2])
+    np.testing.assert_allclose(out[1], [8, 8, 8])
