@@ -54,6 +54,11 @@ def create_lod_tensor(data, recursive_seq_lens=None, place=None):
     if recursive_seq_lens is None:
         seqs = [np.asarray(s) for s in data]
     else:
+        if len(recursive_seq_lens) != 1:
+            raise NotImplementedError(
+                "multi-level LoD is not supported by the padded+lengths "
+                "design; flatten the hierarchy to one level (got "
+                f"{len(recursive_seq_lens)} levels)")
         lens = list(recursive_seq_lens[-1])
         flat = np.asarray(data)
         if flat.ndim == 1:
@@ -69,13 +74,11 @@ def create_lod_tensor(data, recursive_seq_lens=None, place=None):
                 f"{flat.shape[0]} rows")
     if not seqs:
         raise ValueError("need at least one sequence")
-    lengths = np.array([len(s) for s in seqs], np.int64)
-    t = int(lengths.max())
-    tail = seqs[0].shape[1:]
-    out = np.zeros((len(seqs), t) + tail, seqs[0].dtype)
-    for i, s in enumerate(seqs):
-        out[i, :len(s)] = s
-    return LoDTensor(out, lengths)
+    from .layers.sequence_ops import pad_sequences
+
+    dtype = np.result_type(*[s.dtype for s in seqs])
+    padded, lengths = pad_sequences(seqs, dtype=dtype)
+    return LoDTensor(padded, lengths)
 
 
 def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,

@@ -60,3 +60,18 @@ def test_feeds_sequence_op():
                   fetch_list=[pooled])[0]
     np.testing.assert_allclose(out[0], [2, 2, 2])
     np.testing.assert_allclose(out[1], [8, 8, 8])
+
+
+def test_multi_level_lod_rejected():
+    import pytest
+
+    with pytest.raises(NotImplementedError):
+        fluid.create_lod_tensor(np.zeros((6, 1), np.float32),
+                                [[2, 1], [1, 2, 3]])
+
+
+def test_mixed_dtypes_promote():
+    t = fluid.create_lod_tensor(
+        [np.array([1, 2]), np.array([2.5, 3.5])])
+    assert t.data.dtype == np.float64
+    np.testing.assert_allclose(list(t.rows())[1], [2.5, 3.5])
