@@ -1,0 +1,62 @@
+"""Fleet FS utility tests (parity: incubate/fleet/utils/hdfs.py
+HDFSClient contract, exercised through LocalFS + split_files)."""
+
+import os
+
+import pytest
+
+from paddle_tpu.distributed.fs import HDFSClient, LocalFS, split_files
+
+
+def test_localfs_roundtrip(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.makedirs(d)
+    assert fs.is_dir(d)
+    f = os.path.join(d, "x.txt")
+    with open(f, "w") as fh:
+        fh.write("hello")
+    assert fs.is_file(f)
+    assert fs.cat(f) == "hello"
+    assert fs.ls(d) == ["x.txt"]
+    dst = os.path.join(d, "y.txt")
+    fs.rename(f, dst)
+    assert fs.is_file(dst) and not fs.is_exist(f)
+    cp = str(tmp_path / "copy.txt")
+    fs.download(dst, cp)
+    assert fs.cat(cp) == "hello"
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_rename_overwrite_guard(tmp_path):
+    fs = LocalFS()
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    for p in (a, b):
+        with open(p, "w") as fh:
+            fh.write(p)
+    with pytest.raises(FileExistsError):
+        fs.rename(a, b)
+    fs.rename(a, b, overwrite=True)
+    assert fs.cat(b).endswith("a")
+
+
+def test_hdfs_requires_hadoop():
+    import shutil
+
+    if shutil.which("hadoop"):
+        pytest.skip("hadoop present")
+    with pytest.raises(RuntimeError):
+        HDFSClient()
+
+
+def test_split_files_partitions_deterministically():
+    files = [f"part-{i}" for i in range(10)]
+    shards = [split_files(files, i, 3) for i in range(3)]
+    # disjoint cover
+    flat = sorted(sum(shards, []))
+    assert flat == sorted(files)
+    # every rank agrees regardless of input order
+    assert split_files(list(reversed(files)), 1, 3) == shards[1]
+    with pytest.raises(ValueError):
+        split_files(files, 3, 3)
