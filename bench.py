@@ -238,9 +238,27 @@ def bench_wide_deep(on_tpu, peak):
             "vs_baseline": None, "step_ms": round(dt * 1e3, 2)}
 
 
+def _probe_backend(timeout=180):
+    """The accelerator tunnel can wedge; probe it OUT of process so a
+    sick backend degrades the bench to CPU instead of hanging the
+    driver. Returns True if the default backend initializes."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     import jax
 
+    if not _probe_backend():
+        jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     peak = _peak_flops(dev)
