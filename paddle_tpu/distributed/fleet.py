@@ -161,3 +161,29 @@ def make_train_step(model, optimizer, loss_fn, mesh=None, strategy=None):
         return LocalSGDTrainStep(model, optimizer, wrapped_loss, mesh,
                                  local_sgd_steps=strategy.local_sgd_steps)
     return DataParallelTrainStep(model, optimizer, wrapped_loss, mesh)
+
+
+def save_persistables(executor, dirname, main_program=None):
+    """Fleet save facade (fleet_base.py save_persistables): rank 0 writes,
+    other ranks no-op — checkpoint state is replicated under pjit DP, so
+    one copy is the whole model (the reference pulls pserver slices;
+    the PS-table analogue here rides paddle_tpu.checkpoint)."""
+    if not is_first_worker():
+        return None
+    from .. import io
+
+    return io.save_persistables(executor, dirname,
+                                main_program=main_program)
+
+
+def save_inference_model(executor, dirname, feeded_var_names,
+                         target_vars, main_program=None):
+    """Fleet export facade (fleet_base.py save_inference_model): rank 0
+    writes the pruned serving program + params."""
+    if not is_first_worker():
+        return None
+    from .. import io
+
+    return io.save_inference_model(dirname, feeded_var_names,
+                                   target_vars, executor,
+                                   main_program=main_program)

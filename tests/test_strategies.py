@@ -218,3 +218,32 @@ def test_local_sgd_with_batchnorm_buffers_stay_clean():
     for _ in range(4):
         loss = float(ls(x, y))
     assert np.isfinite(loss)
+
+
+def test_fleet_save_facades(tmp_path):
+    """fleet.save_persistables / save_inference_model write from rank 0
+    and produce a loadable model (fleet_base.py parity)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed import fleet
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        h = fluid.layers.fc(x, 3)
+    exe = fluid.Executor()
+    exe.run(startup)
+    fleet.init()
+
+    d1 = str(tmp_path / "persist")
+    fleet.save_persistables(exe, d1, main_program=main)
+    import os
+    assert os.path.isdir(d1) and os.listdir(d1)
+
+    d2 = str(tmp_path / "infer")
+    fleet.save_inference_model(exe, d2, ["x"], [h], main_program=main)
+    prog, feeds, fetches = fluid.io.load_inference_model(d2, exe)
+    out = exe.run(prog, feed={feeds[0]: np.zeros((2, 4), np.float32)},
+                  fetch_list=fetches)
+    assert np.asarray(out[0]).shape == (2, 3)
