@@ -205,3 +205,70 @@ def test_activations_finite(op):
     assert np.isfinite(out).all()
     if op == "relu":
         np.testing.assert_allclose(out, np.maximum(x, 0))
+
+
+class TestNormOpGrads(OpTest):
+    """Numeric-vs-analytic grads for the normalization kernels (the
+    reference's per-op check_grad discipline, op_test.py:1261)."""
+
+    grad_atol = 5e-3
+    grad_rtol = 5e-3
+
+    def test_layer_norm_grad(self):
+        self.op_type = "layer_norm"
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 6)).astype(np.float64)
+        scale = rng.standard_normal(6).astype(np.float64)
+        bias = rng.standard_normal(6).astype(np.float64)
+        self.check_grad({"X": x, "Scale": scale, "Bias": bias},
+                        ["X", "Scale", "Bias"], out_slot="Y")
+
+    def test_batch_norm_grad_training(self):
+        self.op_type = "batch_norm"
+        self.attrs = {"is_test": False, "epsilon": 1e-5}
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 3, 2, 2)).astype(np.float64)
+        self.check_grad(
+            {"X": x, "Scale": np.ones(3), "Bias": np.zeros(3),
+             "Mean": np.zeros(3), "Variance": np.ones(3)},
+            ["X", "Scale", "Bias"], out_slot="Y")
+
+    def test_group_norm_grad(self):
+        self.op_type = "group_norm"
+        self.attrs = {"groups": 2, "epsilon": 1e-5}
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 4, 3, 3)).astype(np.float64)
+        self.check_grad({"X": x, "Scale": np.ones(4), "Bias": np.zeros(4)},
+                        ["X"], out_slot="Y")
+
+
+class TestPoolConvGrads(OpTest):
+    grad_atol = 5e-3
+    grad_rtol = 5e-3
+
+    def test_pool2d_avg_grad(self):
+        self.op_type = "pool2d"
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2]}
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((1, 2, 4, 4)).astype(np.float64)
+        self.check_grad({"X": x}, ["X"])
+
+    def test_conv2d_transpose_grad(self):
+        self.op_type = "conv2d_transpose"
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1]}
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((1, 2, 4, 4)).astype(np.float64)
+        w = rng.standard_normal((2, 3, 3, 3)).astype(np.float64)
+        self.check_grad({"Input": x, "Filter": w}, ["Input", "Filter"],
+                        out_slot="Output")
+
+    def test_softmax_with_cross_entropy_grad(self):
+        self.op_type = "softmax_with_cross_entropy"
+        self.attrs = {}
+        rng = np.random.default_rng(5)
+        logits = rng.standard_normal((4, 5)).astype(np.float64)
+        label = rng.integers(0, 5, (4, 1)).astype(np.int64)
+        self.check_grad({"Logits": logits, "Label": label}, ["Logits"],
+                        out_slot="Loss")
