@@ -55,6 +55,9 @@ from .framework import backward
 from . import layers
 from . import nets
 from . import debugger
+from . import average
+from . import install_check
+from . import model_stat
 from .lod import (LoDTensor, create_lod_tensor,
                   create_random_int_lodtensor)
 from . import optimizer

@@ -1,0 +1,33 @@
+"""fluid.average parity — WeightedAverage (average.py:40): streaming
+weighted mean used by training loops to smooth per-batch losses."""
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = 0.0
+        self.denominator = 0.0
+
+    def add(self, value, weight=1):
+        value = np.asarray(value, np.float64)
+        if value.size != 1:
+            # the reference accepts matrices and averages elementwise sum
+            weight = value.size * float(weight)
+            value = float(value.mean())
+        else:
+            value = float(value.reshape(()))
+            weight = float(weight)
+        self.numerator += value * weight
+        self.denominator += weight
+
+    def eval(self):
+        if self.denominator == 0:
+            raise ValueError(
+                "There is no data to be averaged in WeightedAverage.")
+        return self.numerator / self.denominator

