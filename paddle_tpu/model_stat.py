@@ -50,3 +50,48 @@ def summary(target, stream=None):
     text = "\n".join(lines)
     (stream.write(text + "\n") if stream is not None else print(text))
     return rows, total
+
+
+_DTYPE_BYTES = {
+    "float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+    "int8": 1, "uint8": 1, "int16": 2, "int32": 4, "int64": 8, "bool": 1,
+}
+
+
+def memory_usage(program, batch_size):
+    """contrib/memory_usage_calc.py:46 — rough per-step activation +
+    parameter memory of a program in MB: every var's element count
+    (batch dim -1 replaced by batch_size) times its dtype width. A lower
+    bound on TPU (XLA reuses buffers), matching the reference's estimate
+    semantics."""
+    total = 0
+    for v in program.list_vars():
+        shape = list(getattr(v, "shape", None) or ())
+        if not shape:
+            continue
+        dims = [batch_size if (isinstance(s, int) and s < 0) or s is None
+                else int(s) for s in shape]
+        if any(d <= 0 for d in dims):
+            continue
+        width = _DTYPE_BYTES.get(str(getattr(v, "dtype", "float32")), 4)
+        total += int(np.prod(dims)) * width
+    return total / (1024.0 ** 2)
+
+
+def op_freq_statistic(program):
+    """contrib/op_frequence.py:23 — (op_type -> count) over the whole
+    program, plus adjacent-pair counts (the reference's fusion-candidate
+    report)."""
+    from collections import OrderedDict
+
+    single = OrderedDict()
+    pairs = OrderedDict()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            single[op.type] = single.get(op.type, 0) + 1
+            if prev is not None:
+                key = f"{prev},{op.type}"
+                pairs[key] = pairs.get(key, 0) + 1
+            prev = op.type
+    return single, pairs

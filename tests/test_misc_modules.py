@@ -43,3 +43,31 @@ def test_model_stat_program_and_layer():
     layer = nn.Linear(4, 3)
     rows, total = summary(layer, stream=io.StringIO())
     assert total == 15
+
+
+def test_memory_usage_estimate():
+    from paddle_tpu.model_stat import memory_usage
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 256])
+        fluid.layers.fc(x, 128)
+    mb = memory_usage(main, batch_size=64)
+    # at least x (64*256*4) + w (256*128*4) + out (64*128*4) bytes
+    floor = (64 * 256 + 256 * 128 + 64 * 128) * 4 / 1024 ** 2
+    assert mb >= floor * 0.9
+    assert mb < 100
+
+
+def test_op_freq_statistic():
+    from paddle_tpu.model_stat import op_freq_statistic
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        h = fluid.layers.fc(x, 4, act="relu")
+        h = fluid.layers.fc(h, 4, act="relu")
+    single, pairs = op_freq_statistic(main)
+    assert single.get("relu", 0) == 2
+    assert sum(single.values()) == len(main.global_block().ops)
+    assert any("relu" in k for k in pairs)
