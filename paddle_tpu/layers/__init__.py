@@ -10,6 +10,7 @@ from .tensor import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
+from . import distributions  # noqa: F401
 from .sequence_ops import *  # noqa: F401,F403
 from .extended import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403

@@ -1,0 +1,163 @@
+"""Probability distributions — fluid.layers.distributions parity.
+
+Parity: /root/reference/python/paddle/fluid/layers/distributions.py:28
+(Distribution base), :113 (Uniform), :247 (Normal), :400 (Categorical),
+:503 (MultivariateNormalDiag). Methods mirror the reference surface
+(sample/entropy/log_prob/kl_divergence where defined); math runs as
+plain jnp, sampling draws from jax.random with a seed argument like the
+reference's `sample(shape, seed)`.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag"]
+
+
+def _arr(x):
+    return jnp.asarray(x, jnp.float32) if not hasattr(x, "dtype") \
+        else jnp.asarray(x)
+
+
+class Distribution:
+    """distributions.py:28 — abstract base."""
+
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """distributions.py:113 — U(low, high), broadcastable."""
+
+    def __init__(self, low, high):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape, seed=0):
+        key = jax.random.PRNGKey(seed)
+        base = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        u = jax.random.uniform(key, tuple(shape) + base)
+        return self.low + u * (self.high - self.low)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        dens = 1.0 / (self.high - self.low)
+        return jnp.log(jnp.where(inside, dens, 0.0) + 1e-30) \
+            * jnp.where(inside, 1.0, 1.0)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+
+class Normal(Distribution):
+    """distributions.py:247 — N(loc, scale), broadcastable."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape, seed=0):
+        key = jax.random.PRNGKey(seed)
+        base = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        z = jax.random.normal(key, tuple(shape) + base)
+        return self.loc + z * self.scale
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale * self.scale
+        return (-((v - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def kl_divergence(self, other):
+        # distributions.py:382 — KL(N0 || N1)
+        var0 = self.scale ** 2
+        var1 = other.scale ** 2
+        return (0.5 * (var0 + (self.loc - other.loc) ** 2) / var1
+                - 0.5 + jnp.log(other.scale / self.scale))
+
+
+class Categorical(Distribution):
+    """distributions.py:400 — categorical over unnormalized logits."""
+
+    def __init__(self, logits):
+        self.logits = _arr(logits)
+
+    def _probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape, seed=0):
+        key = jax.random.PRNGKey(seed)
+        return jax.random.categorical(key, self.logits,
+                                      shape=tuple(shape)
+                                      + self.logits.shape[:-1])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        idx = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(logp, idx[..., None], axis=-1)[..., 0]
+
+    def kl_divergence(self, other):
+        # distributions.py:459 — KL over the categorical simplex
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        logq = jax.nn.log_softmax(other.logits, axis=-1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """distributions.py:503 — mvn with diagonal covariance given as a
+    [D, D] diagonal `scale` matrix (reference contract)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)  # [D, D] diagonal
+
+    def _diag(self):
+        return jnp.diagonal(self.scale)
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = jnp.sum(jnp.log(self._diag() ** 2))
+        return 0.5 * (d * (1.0 + math.log(2 * math.pi)) + logdet)
+
+    def kl_divergence(self, other):
+        var0 = self._diag() ** 2
+        var1 = other._diag() ** 2
+        diff = other.loc - self.loc
+        return 0.5 * (jnp.sum(var0 / var1)
+                      + jnp.sum(diff * diff / var1)
+                      - self.loc.shape[-1]
+                      + jnp.sum(jnp.log(var1) - jnp.log(var0)))
+
+    def sample(self, shape, seed=0):
+        key = jax.random.PRNGKey(seed)
+        z = jax.random.normal(key, tuple(shape) + self.loc.shape)
+        return self.loc + z * self._diag()
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self._diag() ** 2
+        d = self.loc.shape[-1]
+        return (-0.5 * jnp.sum((v - self.loc) ** 2 / var, axis=-1)
+                - 0.5 * (d * math.log(2 * math.pi)
+                         + jnp.sum(jnp.log(var))))

@@ -1,0 +1,72 @@
+"""fluid.layers.distributions tests (parity: distributions.py:113-613 +
+test_distributions.py): closed-form entropy/log_prob/KL against scipy-
+style references, sampling moments."""
+
+import math
+
+import numpy as np
+
+from paddle_tpu.layers.distributions import (
+    Categorical, MultivariateNormalDiag, Normal, Uniform)
+
+
+def test_uniform():
+    u = Uniform(1.0, 3.0)
+    s = np.asarray(u.sample([2000], seed=0))
+    assert s.min() >= 1.0 and s.max() < 3.0
+    assert abs(s.mean() - 2.0) < 0.1
+    np.testing.assert_allclose(float(u.entropy()), math.log(2.0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(u.log_prob(2.0)), math.log(0.5),
+                               rtol=1e-5)
+
+
+def test_normal_entropy_logprob_kl():
+    n = Normal(0.0, 2.0)
+    np.testing.assert_allclose(
+        float(n.entropy()), 0.5 * math.log(2 * math.pi * math.e * 4.0),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        float(n.log_prob(1.0)),
+        -0.125 - math.log(2.0) - 0.5 * math.log(2 * math.pi), rtol=1e-5)
+    m = Normal(1.0, 1.0)
+    kl = float(n.kl_divergence(m))
+    expect = 0.5 * (4.0 + 1.0) / 1.0 - 0.5 + math.log(1.0 / 2.0)
+    np.testing.assert_allclose(kl, expect, rtol=1e-5)
+    assert float(n.kl_divergence(n)) < 1e-6
+    s = np.asarray(n.sample([4000], seed=1))
+    assert abs(s.std() - 2.0) < 0.1
+
+
+def test_categorical():
+    logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+    c = Categorical(logits)
+    expect_h = -(0.2 * math.log(0.2) + 0.3 * math.log(0.3)
+                 + 0.5 * math.log(0.5))
+    np.testing.assert_allclose(float(c.entropy()), expect_h, rtol=1e-5)
+    np.testing.assert_allclose(float(c.log_prob(np.array(2))),
+                               math.log(0.5), rtol=1e-5)
+    d = Categorical(np.zeros(3, np.float32))
+    kl = float(c.kl_divergence(d))
+    assert kl > 0
+    np.testing.assert_allclose(float(c.kl_divergence(c)), 0.0,
+                               atol=1e-7)
+    s = np.asarray(c.sample([5000], seed=2))
+    freq = np.bincount(s, minlength=3) / 5000
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+
+def test_mvn_diag():
+    loc = np.zeros(2, np.float32)
+    scale = np.diag([1.0, 2.0]).astype(np.float32)
+    m = MultivariateNormalDiag(loc, scale)
+    expect_h = 0.5 * (2 * (1 + math.log(2 * math.pi))
+                      + math.log(1.0) + math.log(4.0))
+    np.testing.assert_allclose(float(m.entropy()), expect_h, rtol=1e-5)
+    other = MultivariateNormalDiag(np.ones(2, np.float32),
+                                   np.eye(2, dtype=np.float32))
+    assert float(m.kl_divergence(other)) > 0
+    np.testing.assert_allclose(float(m.kl_divergence(m)), 0.0, atol=1e-6)
+    lp = float(m.log_prob(np.zeros(2, np.float32)))
+    expect_lp = -0.5 * (2 * math.log(2 * math.pi) + math.log(4.0))
+    np.testing.assert_allclose(lp, expect_lp, rtol=1e-5)
