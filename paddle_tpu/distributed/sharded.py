@@ -65,6 +65,10 @@ def gpt_rules():
         (r"(q_proj|k_proj|v_proj|fc1|linear1)\.bias$", P("tp")),
         (r"(out_proj|fc2|linear2)\.weight$", row),
         (r"(wte|wpe|word_emb|pos_emb|embedding)\.weight$", P("tp", None)),
+        # MoE expert-major weights shard over the expert-parallel axis;
+        # the router stays replicated
+        (r"moe\.(w1|w2)$", P("ep", None, None)),
+        (r"moe\.wg$", P()),
         (r".*", P()),
     ])
 

@@ -25,6 +25,40 @@ class GPTConfig:
     max_seq_len: int = 1024
     dropout: float = 0.0
     dtype: str = "float32"
+    # MoE (0 = dense FFN): experts shard over the mesh's "ep" axis via
+    # distributed.sharded.gpt_rules; router aux loss folds into .loss()
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+
+
+class MoEFFN(nn.Layer):
+    """Mixture-of-experts FFN block (capability beyond the reference —
+    SURVEY §2.3 expert parallel: NO). Wraps distributed.moe.moe_ffn with
+    layer-managed parameters; expert-major weights [E, ...] shard over
+    the "ep" mesh axis under the gpt_rules moe entries."""
+
+    def __init__(self, hidden, num_experts, top_k=2, capacity_factor=1.25,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.wg = self.create_parameter([hidden, num_experts])
+        self.w1 = self.create_parameter([num_experts, hidden, 4 * hidden])
+        self.w2 = self.create_parameter([num_experts, 4 * hidden, hidden])
+        self.last_aux_loss = 0.0
+
+    def forward(self, x):
+        from ..distributed.moe import moe_ffn
+
+        params = {"wg": F._val(self.wg), "w1": F._val(self.w1),
+                  "w2": F._val(self.w2)}
+        y, aux = moe_ffn(params, x, k=self.top_k,
+                         capacity_factor=self.capacity_factor)
+        # same-trace stash: .loss() reads it within one jit trace
+        self.last_aux_loss = aux
+        return y
 
 
 class GPTBlock(nn.Layer):
@@ -35,16 +69,24 @@ class GPTBlock(nn.Layer):
                                           dropout=cfg.dropout,
                                           dtype=cfg.dtype)
         self.norm2 = nn.LayerNorm(cfg.hidden_size, dtype=cfg.dtype)
-        self.fc1 = nn.Linear(cfg.hidden_size, 4 * cfg.hidden_size,
-                             act="gelu", dtype=cfg.dtype)
-        self.fc2 = nn.Linear(4 * cfg.hidden_size, cfg.hidden_size,
-                             dtype=cfg.dtype)
+        if cfg.num_experts > 0:
+            self.moe = MoEFFN(cfg.hidden_size, cfg.num_experts,
+                              top_k=cfg.moe_top_k,
+                              capacity_factor=cfg.moe_capacity_factor,
+                              dtype=cfg.dtype)
+        else:
+            self.fc1 = nn.Linear(cfg.hidden_size, 4 * cfg.hidden_size,
+                                 act="gelu", dtype=cfg.dtype)
+            self.fc2 = nn.Linear(4 * cfg.hidden_size, cfg.hidden_size,
+                                 dtype=cfg.dtype)
         self.drop = nn.Dropout(cfg.dropout)
+        self._moe = cfg.num_experts > 0
 
     def forward(self, x):
         x = x + self.attn(self.norm1(x), is_causal=True)
-        x = x + self.drop(self.fc2(self.fc1(self.norm2(x))))
-        return x
+        h = self.norm2(x)
+        ff = self.moe(h) if self._moe else self.fc2(self.fc1(h))
+        return x + self.drop(ff)
 
 
 class GPT(nn.Layer):
@@ -81,4 +123,9 @@ class GPT(nn.Layer):
         logits = self.forward(input_ids)
         lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
         lab = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-        return (lse - lab.astype(jnp.float32)).mean()
+        ce = (lse - lab.astype(jnp.float32)).mean()
+        if self.cfg.num_experts > 0:
+            # router load-balance loss from the SAME trace's forward
+            aux = sum(blk.moe.last_aux_loss for blk in self.blocks)
+            ce = ce + self.cfg.moe_aux_weight * aux
+        return ce
