@@ -29,7 +29,9 @@ void set_error_from_python() {
   if (value) {
     PyObject* s = PyObject_Str(value);
     if (s) {
-      g_last_error = PyUnicode_AsUTF8(s);
+      const char* msg = PyUnicode_AsUTF8(s);  // may return nullptr
+      if (msg) g_last_error = msg;
+      else PyErr_Clear();
       Py_DECREF(s);
     }
   }
@@ -89,8 +91,8 @@ def _pd_new_predictor(model_dir):
             "fetches": fetches, "inputs": {}, "outputs": []}
 
 
-def _pd_set_input(st, name, flat, shape):
-    st["inputs"][name] = np.asarray(flat, np.float32).reshape(shape)
+def _pd_set_input(st, name, buf, shape):
+    st["inputs"][name] = np.frombuffer(buf, np.float32).reshape(shape)
 
 
 def _pd_run(st):
@@ -132,7 +134,12 @@ PD_Predictor* PD_NewPredictor(const char* model_dir) {
   p->obj = st;
   PyObject* feeds = PyDict_GetItemString(st, "feeds");
   for (Py_ssize_t i = 0; i < PyList_Size(feeds); ++i) {
-    p->feed_names.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(feeds, i)));
+    const char* nm = PyUnicode_AsUTF8(PyList_GetItem(feeds, i));
+    if (!nm) {
+      PyErr_Clear();
+      nm = "<invalid-utf8-name>";
+    }
+    p->feed_names.emplace_back(nm);
   }
   return p;
 }
@@ -170,13 +177,14 @@ int PD_SetInput(PD_Predictor* p, const char* name, const float* data,
     n *= shape[i];
     PyTuple_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
   }
-  PyObject* flat = PyList_New(n);
-  for (int64_t i = 0; i < n; ++i) {
-    PyList_SetItem(flat, i, PyFloat_FromDouble(data[i]));
-  }
+  // one memcpy into a bytes object; np.frombuffer unpacks python-side
+  // (element-wise PyFloat boxing dominates latency at image sizes)
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(n * sizeof(float)));
   PyObject* fn = PyDict_GetItemString(g_module_dict, "_pd_set_input");
-  PyObject* r = PyObject_CallFunction(fn, "OsOO", p->obj, name, flat, shp);
-  Py_DECREF(flat);
+  PyObject* r = PyObject_CallFunction(fn, "OsOO", p->obj, name, buf, shp);
+  Py_DECREF(buf);
   Py_DECREF(shp);
   if (!r) {
     set_error_from_python();

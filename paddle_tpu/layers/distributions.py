@@ -56,8 +56,7 @@ class Uniform(Distribution):
         v = _arr(value)
         inside = (v >= self.low) & (v < self.high)
         dens = 1.0 / (self.high - self.low)
-        return jnp.log(jnp.where(inside, dens, 0.0) + 1e-30) \
-            * jnp.where(inside, 1.0, 1.0)
+        return jnp.log(jnp.where(inside, dens, 0.0) + 1e-30)
 
     def entropy(self):
         return jnp.log(self.high - self.low)

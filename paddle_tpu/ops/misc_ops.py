@@ -685,25 +685,39 @@ def print_op(ins, attrs):
 @register_op("max_pool3d_with_index")
 def max_pool3d_with_index(ins, attrs):
     """pool_with_index_op.cc (3-D registration) — NCDHW max pool emitting
-    flat spatial argmax indices."""
+    flat argmax indices into the UNPADDED input (paddings honored with
+    -inf borders that can never win the max; adaptive mode is
+    unsupported and raises)."""
+    if attrs.get("adaptive", False):
+        raise NotImplementedError(
+            "max_pool3d_with_index: adaptive pooling is not supported")
     x = jnp.asarray(ins["X"])
     ksize = [int(k) for k in attrs["ksize"]]
     strides = [int(s) for s in attrs.get("strides", ksize)]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0, 0])]
     n, c, d, h, w = x.shape
     kd, kh, kw = ksize
     sd, sh, sw = strides
-    od = (d - kd) // sd + 1
-    oh = (h - kh) // sh + 1
-    ow = (w - kw) // sw + 1
+    pd, ph, pw = (pads + [0, 0, 0])[:3]
+    neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(
+        x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, [(0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)],
+                 constant_values=neg)
+    dpad, hpad, wpad = d + 2 * pd, h + 2 * ph, w + 2 * pw
+    od = (dpad - kd) // sd + 1
+    oh = (hpad - kh) // sh + 1
+    ow = (wpad - kw) // sw + 1
     patches, idxs = [], []
     for a in range(kd):
         for i in range(kh):
             for j in range(kw):
-                patches.append(x[:, :, a:a + sd * od:sd,
-                                 i:i + sh * oh:sh, j:j + sw * ow:sw])
-                ai = jnp.arange(od) * sd + a
-                ii = jnp.arange(oh) * sh + i
-                jj = jnp.arange(ow) * sw + j
+                patches.append(xp[:, :, a:a + sd * od:sd,
+                                  i:i + sh * oh:sh, j:j + sw * ow:sw])
+                # index into the UNPADDED volume (padded cells lose the
+                # max, so their index is never selected)
+                ai = jnp.arange(od) * sd + a - pd
+                ii = jnp.arange(oh) * sh + i - ph
+                jj = jnp.arange(ow) * sw + j - pw
                 idxs.append(ai[:, None, None] * h * w
                             + ii[None, :, None] * w + jj[None, None, :])
     stack = jnp.stack(patches, axis=-1)          # [N,C,od,oh,ow,k]

@@ -380,11 +380,13 @@ def batch_norm(ins, attrs):
     bshape = [1] * x.ndim
     bshape[c_axis] = x.shape[c_axis]
 
-    # mixed-precision convention: stats accumulate in f32 (single-pass
-    # E[x^2]-E[x]^2 reductions — one read of x, the fused-kernel form),
-    # while the normalize itself is an x*a+b affine in x's OWN dtype so a
-    # bf16 model never materializes f32 activations and XLA can fuse the
-    # affine into the producing conv's epilogue
+    # mixed-precision convention: stats accumulate in f32 via the
+    # two-pass mean / centered-square reductions (the one-pass
+    # E[x^2]-E[x]^2 form catastrophically cancels in f32 for activations
+    # with large mean — variance collapses to 0), while the normalize
+    # itself is an x*a+b affine in x's OWN dtype so a bf16 model never
+    # materializes f32 activations and XLA can fuse the affine into the
+    # producing conv's epilogue
     acc_t = jnp.promote_types(x.dtype, mean_in.dtype)
     if use_global:
         mean, var = mean_in, var_in
@@ -393,8 +395,8 @@ def batch_norm(ins, attrs):
         saved_var = jnp.zeros_like(var_in)
     else:
         mean = jnp.mean(x, axis=reduce_axes, dtype=acc_t)
-        mean_sq = jnp.mean(jnp.square(x.astype(acc_t)), axis=reduce_axes)
-        var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+        centered = x.astype(acc_t) - mean.reshape(bshape)
+        var = jnp.mean(jnp.square(centered), axis=reduce_axes)
         mean_out = mean_in * momentum + mean * (1 - momentum)
         var_out = var_in * momentum + var * (1 - momentum)
         saved_mean = mean

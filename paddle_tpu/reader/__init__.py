@@ -223,20 +223,32 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         out_q = _q.Queue(buffer_size)
         END = object()
 
+        errors = []
+
         def feeder():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
-            for _ in range(process_num):
-                in_q.put(END)
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+            finally:
+                # guarantee every worker sees an END even if the source
+                # reader raised (missing sentinels deadlock the consumer)
+                for _ in range(process_num):
+                    in_q.put(END)
 
         def worker():
-            while True:
-                item = in_q.get()
-                if item is END:
-                    out_q.put(END)
-                    return
-                i, sample = item
-                out_q.put((i, mapper(sample)))
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is END:
+                        return
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                out_q.put(END)
 
         threads = [_t.Thread(target=feeder, daemon=True)]
         threads += [_t.Thread(target=worker, daemon=True)
@@ -257,7 +269,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 while heap and heap[0][0] == want:
                     yield heapq.heappop(heap)[1]
                     want += 1
-            while heap:
+            # on error some indices never arrive; drain what's complete
+            while heap and not errors:
                 yield heapq.heappop(heap)[1]
         else:
             while finished < process_num:
@@ -266,6 +279,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     finished += 1
                     continue
                 yield item[1]
+        if errors:
+            raise errors[0]
 
     return xreader
 
@@ -282,10 +297,16 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         out_q = _q.Queue(queue_size)
         END = object()
 
+        errors = []
+
         def drain(r):
-            for sample in r():
-                out_q.put(sample)
-            out_q.put(END)
+            try:
+                for sample in r():
+                    out_q.put(sample)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+            finally:
+                out_q.put(END)  # guaranteed sentinel, even on error
 
         threads = [_t.Thread(target=drain, args=(r,), daemon=True)
                    for r in readers]
@@ -298,5 +319,7 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
                 finished += 1
                 continue
             yield item
+        if errors:
+            raise errors[0]
 
     return mreader

@@ -137,3 +137,73 @@ def test_recompute_checkpoints_still_correct():
                           fetch_list=[loss], scope=sc)
         results.append(float(out[0]))
     assert results[0] == pytest.approx(results[1], rel=1e-4)
+
+
+def test_batch_norm_large_mean_no_cancellation():
+    """E[x^2]-E[x]^2 in f32 collapses variance for large-mean
+    activations; the two-pass centered form must not (review catch)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.registry import get_op
+
+    x = (np.random.default_rng(0).standard_normal((8, 4, 16, 16))
+         + 4096.0).astype(np.float32)
+    out = get_op("batch_norm").fn(
+        {"X": jnp.asarray(x), "Scale": jnp.ones(4), "Bias": jnp.zeros(4),
+         "Mean": jnp.zeros(4), "Variance": jnp.ones(4)},
+        {"is_test": False})
+    y = np.asarray(out["Y"])
+    np.testing.assert_allclose(y.std(axis=(0, 2, 3)), 1.0, atol=0.05)
+    np.testing.assert_allclose(np.asarray(out["VarianceOut"])[..., :],
+                               0.1 * x.var(axis=(0, 2, 3)) + 0.9,
+                               rtol=0.05)
+
+
+def test_xmap_readers_propagates_mapper_error():
+    """A raising mapper must surface the exception, not deadlock
+    (review catch: lost END sentinel)."""
+    from paddle_tpu import reader as R
+
+    def bad(x):
+        if x == 3:
+            raise ValueError("boom")
+        return x
+
+    mapped = R.xmap_readers(bad, lambda: iter(range(6)), process_num=2,
+                            buffer_size=4)
+    with pytest.raises(ValueError):
+        list(mapped())
+
+
+def test_multiprocess_reader_propagates_reader_error():
+    from paddle_tpu import reader as R
+
+    def flaky():
+        yield 1
+        raise RuntimeError("broken source")
+
+    merged = R.multiprocess_reader([lambda: iter([10, 20]), flaky])
+    with pytest.raises(RuntimeError):
+        list(merged())
+
+
+def test_max_pool3d_with_index_paddings():
+    """paddings shift output dims and never select border cells
+    (review catch: attr silently ignored)."""
+    from paddle_tpu.ops.registry import get_op
+
+    x = np.random.default_rng(1).standard_normal(
+        (1, 1, 4, 4, 4)).astype(np.float32)
+    out = get_op("max_pool3d_with_index").fn(
+        {"X": x}, {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                   "paddings": [1, 1, 1]})
+    assert np.asarray(out["Out"]).shape == (1, 1, 3, 3, 3)
+    mask = np.asarray(out["Mask"])
+    assert mask.min() >= 0 and mask.max() < 64
+    # every selected flat index holds the reported max
+    flat = x.reshape(-1)
+    np.testing.assert_allclose(flat[mask.reshape(-1)],
+                               np.asarray(out["Out"]).reshape(-1))
+    with pytest.raises(NotImplementedError):
+        get_op("max_pool3d_with_index").fn(
+            {"X": x}, {"ksize": [2, 2, 2], "adaptive": True})
