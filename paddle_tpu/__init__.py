@@ -58,6 +58,7 @@ from . import debugger
 from . import average
 from . import install_check
 from . import model_stat
+from . import sysconfig
 from .lod import (LoDTensor, create_lod_tensor,
                   create_random_int_lodtensor)
 from . import optimizer

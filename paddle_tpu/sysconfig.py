@@ -1,0 +1,22 @@
+"""paddle.sysconfig parity — get_include()/get_lib() paths for native
+extensions building against the framework (the C API header lives in
+csrc/)."""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def get_include():
+    """Directory containing paddle_tpu_capi.h."""
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib():
+    """Directory where built native artifacts live (the ctypes C ABI
+    .so from paddle_tpu.native is built on demand next to its module)."""
+    from . import native
+
+    return os.path.dirname(native._SO)

@@ -71,3 +71,13 @@ def test_op_freq_statistic():
     assert single.get("relu", 0) == 2
     assert sum(single.values()) == len(main.global_block().ops)
     assert any("relu" in k for k in pairs)
+
+
+def test_sysconfig_paths():
+    import os
+
+    from paddle_tpu import sysconfig
+
+    inc = sysconfig.get_include()
+    assert os.path.isfile(os.path.join(inc, "paddle_tpu_capi.h"))
+    assert os.path.isdir(sysconfig.get_lib())
