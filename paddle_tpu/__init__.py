@@ -59,6 +59,7 @@ from . import average
 from . import install_check
 from . import model_stat
 from . import sysconfig
+from . import utils
 from .lod import (LoDTensor, create_lod_tensor,
                   create_random_int_lodtensor)
 from . import optimizer

@@ -1,0 +1,39 @@
+"""Tensor interop utilities.
+
+Parity: /root/reference/paddle/fluid/framework/dlpack_tensor.cc (DLPack
+import/export on the Tensor stack) — jax arrays speak DLPack natively,
+so these are thin, documented entry points for zero-copy exchange with
+torch/numpy/cupy, plus the convenience converters user code expects.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["to_dlpack", "from_dlpack", "to_numpy", "to_tensor"]
+
+
+def to_dlpack(x):
+    """Export a device array as a DLPack capsule (dlpack_tensor.cc
+    parity). Consumers: torch.utils.dlpack.from_dlpack, cupy, numpy."""
+    arr = jnp.asarray(x)
+    # modern protocol: the array itself carries __dlpack__;
+    # jax.dlpack.to_dlpack is deprecated in recent jax
+    return arr.__dlpack__()
+
+
+def from_dlpack(capsule_or_array):
+    """Import a DLPack capsule or any __dlpack__-bearing tensor (e.g. a
+    torch.Tensor) as a jax array, zero-copy where the backend allows."""
+    return jnp.from_dlpack(capsule_or_array) if hasattr(
+        jnp, "from_dlpack") else jax.dlpack.from_dlpack(capsule_or_array)
+
+
+def to_numpy(x):
+    """Fetch to host as numpy (the reference's TensorToPyArray path)."""
+    return np.asarray(x)
+
+
+def to_tensor(x, dtype=None):
+    """Host data -> device array (the reference's PyArrayToTensor)."""
+    return jnp.asarray(x, dtype=dtype)

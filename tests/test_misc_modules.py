@@ -81,3 +81,24 @@ def test_sysconfig_paths():
     inc = sysconfig.get_include()
     assert os.path.isfile(os.path.join(inc, "paddle_tpu_capi.h"))
     assert os.path.isdir(sysconfig.get_lib())
+
+
+def test_dlpack_roundtrip_numpy_and_torch():
+    import numpy as np
+
+    from paddle_tpu import utils
+
+    a = utils.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    back = utils.from_dlpack(a)   # jax -> jax via __dlpack__
+    np.testing.assert_array_equal(utils.to_numpy(back),
+                                  utils.to_numpy(a))
+    try:
+        import torch
+    except ImportError:
+        return
+    t = torch.arange(4, dtype=torch.float32).reshape(2, 2)
+    j = utils.from_dlpack(t)
+    np.testing.assert_array_equal(utils.to_numpy(j),
+                                  t.numpy())
+    t2 = torch.utils.dlpack.from_dlpack(utils.to_dlpack(j))
+    np.testing.assert_array_equal(t2.numpy(), t.numpy())
