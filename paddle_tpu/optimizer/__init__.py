@@ -29,6 +29,7 @@ __all__ = [
     "Adadelta", "AdadeltaOptimizer", "RMSProp", "RMSPropOptimizer", "Ftrl",
     "FtrlOptimizer", "Lamb", "LambOptimizer", "RecomputeOptimizer",
     "ExponentialMovingAverage", "LookaheadOptimizer", "ModelAverage",
+    "PipelineOptimizer",
 ]
 
 
@@ -645,6 +646,68 @@ class LookaheadOptimizer:
             block.append_op("assign", inputs={"X": synced_fast},
                             outputs={"Out": p})
         return opt_ops, params_grads
+
+
+class PipelineOptimizer:
+    """Static-graph pipeline wrapper (optimizer.py:3413 parity).
+
+    The reference's v1 pipeline is ASYNC: microbatches flow through
+    program sections bound to places, and the optimizer updates per
+    microbatch (SectionWorker scope-queues, device_worker.h:325). On TPU
+    the section scheduling belongs to XLA (one compiled program) or the
+    eager gpipe engine (distributed/pipeline.py) for true multi-stage
+    model parallelism; this wrapper keeps the reference API — cut_list /
+    place_list / concurrency_list are accepted and recorded — and
+    provides the reference's execution semantics through
+    `run_pipeline`: the feed batch splits into microbatches, each
+    running the full (forward, backward, update) program, so parameter
+    updates happen per microbatch exactly like the async reference.
+    """
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30,
+                 start_cpu_core_id=0, sync_steps=1):
+        self._inner = optimizer
+        self.cut_list = cut_list or []
+        self.place_list = place_list or []
+        self.concurrency_list = concurrency_list or []
+        self.queue_size = queue_size
+        self.sync_steps = sync_steps
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        out = self._inner.minimize(loss, startup_program=startup_program,
+                                   parameter_list=parameter_list,
+                                   no_grad_set=no_grad_set)
+        loss.block.program._pipeline_cfg = {
+            "cut_list": self.cut_list,
+            "concurrency_list": self.concurrency_list,
+            "sync_steps": self.sync_steps,
+        }
+        return out
+
+    def run_pipeline(self, exe, program, feed, fetch_list,
+                     micro_batch_num=None):
+        """Run one macro-batch as `micro_batch_num` microbatches with a
+        parameter update per microbatch (the reference's async pipeline
+        semantics); returns the per-microbatch fetch lists."""
+        import numpy as np
+
+        m = micro_batch_num or max(
+            1, max(self.concurrency_list) if self.concurrency_list else 2)
+        names = list(feed)
+        batch = np.asarray(feed[names[0]]).shape[0]
+        if batch % m != 0:
+            raise ValueError(
+                f"macro batch {batch} not divisible into {m} microbatches")
+        step = batch // m
+        outs = []
+        for i in range(m):
+            micro = {n: np.asarray(feed[n])[i * step:(i + 1) * step]
+                     for n in names}
+            outs.append(exe.run(program, feed=micro,
+                                fetch_list=fetch_list))
+        return outs
 
 
 # Reference-compatible aliases
