@@ -14,23 +14,33 @@
 
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
 namespace {
 
-std::string g_last_error;  // guarded by the GIL
+// Guarded by its own mutex, NOT the GIL: PD_GetOutput / PD_LastError are
+// callable without the interpreter and may race a failing call on
+// another thread.
+std::mutex g_error_mu;
+std::string g_last_error;
+
+void set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lk(g_error_mu);
+  g_last_error = msg;
+}
 
 void set_error_from_python() {
   PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
   PyErr_Fetch(&type, &value, &tb);
   PyErr_NormalizeException(&type, &value, &tb);
-  g_last_error = "python error";
+  std::string msg = "python error";
   if (value) {
     PyObject* s = PyObject_Str(value);
     if (s) {
-      const char* msg = PyUnicode_AsUTF8(s);  // may return nullptr
-      if (msg) g_last_error = msg;
+      const char* m = PyUnicode_AsUTF8(s);  // may return nullptr
+      if (m) msg = m;
       else PyErr_Clear();
       Py_DECREF(s);
     }
@@ -38,6 +48,7 @@ void set_error_from_python() {
   Py_XDECREF(type);
   Py_XDECREF(value);
   Py_XDECREF(tb);
+  set_error(msg);
 }
 
 // RAII: make the interpreter exist and hold the GIL for this scope.
@@ -210,10 +221,22 @@ int PD_Run(PD_Predictor* p) {
   p->out_shape.assign(n, {});
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* arr = PyList_GetItem(outs, i);  // np.float32, contiguous
-    PyObject* shape = PyObject_GetAttrString(arr, "shape");
+    PyObject* shape =
+        arr ? PyObject_GetAttrString(arr, "shape") : nullptr;
+    if (!shape || !PyTuple_Check(shape)) {
+      Py_XDECREF(shape);
+      if (PyErr_Occurred()) set_error_from_python();
+      else set_error("output has no tuple .shape");
+      return 1;
+    }
     for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d) {
-      p->out_shape[i].push_back(
-          PyLong_AsLongLong(PyTuple_GetItem(shape, d)));
+      long long v = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
+      if (v == -1 && PyErr_Occurred()) {
+        Py_DECREF(shape);
+        set_error_from_python();
+        return 1;
+      }
+      p->out_shape[i].push_back(v);
     }
     Py_DECREF(shape);
     PyObject* tb = PyObject_CallMethod(arr, "tobytes", nullptr);
@@ -223,7 +246,11 @@ int PD_Run(PD_Predictor* p) {
     }
     char* buf = nullptr;
     Py_ssize_t len = 0;
-    PyBytes_AsStringAndSize(tb, &buf, &len);
+    if (PyBytes_AsStringAndSize(tb, &buf, &len) != 0) {
+      Py_DECREF(tb);
+      set_error_from_python();
+      return 1;
+    }
     p->out_data[i].resize(len / sizeof(float));
     std::memcpy(p->out_data[i].data(), buf, len);
     Py_DECREF(tb);
@@ -234,7 +261,7 @@ int PD_Run(PD_Predictor* p) {
 int PD_GetOutput(PD_Predictor* p, int i, const float** data,
                  const int64_t** shape, int* ndim) {
   if (i < 0 || i >= static_cast<int>(p->out_data.size())) {
-    g_last_error = "output index out of range";
+    set_error("output index out of range");
     return 1;
   }
   *data = p->out_data[i].data();
@@ -243,7 +270,14 @@ int PD_GetOutput(PD_Predictor* p, int i, const float** data,
   return 0;
 }
 
-const char* PD_LastError(void) { return g_last_error.c_str(); }
+const char* PD_LastError(void) {
+  // Copy under the mutex into a thread-local buffer so the returned
+  // pointer stays valid for the caller without racing a concurrent set.
+  static thread_local std::string local;
+  std::lock_guard<std::mutex> lk(g_error_mu);
+  local = g_last_error;
+  return local.c_str();
+}
 
 }  // extern "C"
 

@@ -1,11 +1,15 @@
 """Shared synthetic-data machinery for the offline dataset zoo."""
 
+import zlib
+
 import numpy as np
 
 
 def rng_for(name, split):
-    # stable, per-dataset/per-split seed
-    return np.random.default_rng(abs(hash((name, split))) % (2 ** 31))
+    # Stable per-dataset/per-split seed. Must be process-independent
+    # (builtin hash() is PYTHONHASHSEED-salted), so that train/eval in
+    # separate processes see the same samples.
+    return np.random.default_rng(zlib.crc32(f"{name}/{split}".encode()))
 
 
 def class_prototype_images(name, split, n, shape, num_classes,

@@ -78,12 +78,13 @@ class FLServer:
                     elif op == "wait_version":
                         want = msg["version"]
                         with outer._cond:
-                            outer._cond.wait_for(
+                            ok = outer._cond.wait_for(
                                 lambda: outer.version >= want,
                                 timeout=msg.get("timeout", 120.0))
                             _send_msg(self.request,
                                       {"version": outer.version,
-                                       "params": outer.params})
+                                       "params": outer.params,
+                                       "timed_out": not ok})
                     elif op == "shutdown":
                         _send_msg(self.request, b"ok")
                         threading.Thread(
@@ -136,6 +137,10 @@ class FLClient:
 
     def wait_version(self, version, timeout=120.0):
         r = self._call(op="wait_version", version=version, timeout=timeout)
+        if r.get("timed_out"):
+            raise TimeoutError(
+                f"wait_version({version}) timed out after {timeout}s; "
+                f"server is still at version {r['version']}")
         return r["version"], r["params"]
 
     def shutdown_server(self):

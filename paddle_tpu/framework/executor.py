@@ -430,7 +430,13 @@ def run_op(op, env, rng_box, const_env=None):
         if hasattr(e, "add_note"):
             e.add_note(note)
             raise
-        raise type(e)(f"{e} {note}") from e
+        try:
+            decorated = type(e)(f"{e} {note}")
+        except Exception:
+            # exception classes with non-str __init__ (UnicodeDecodeError
+            # etc.) can't be reconstructed from a message — re-raise as-is
+            raise e
+        raise decorated from e
     for slot, names in op.outputs.items():
         if slot not in outs:
             continue

@@ -17,7 +17,7 @@ import contextlib
 import copy
 import json
 import os
-import traceback
+import sys
 
 import numpy as np
 
@@ -30,10 +30,17 @@ _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _user_callsite():
     """First stack frame outside the paddle_tpu package — where the user
     built this op (reference op_call_stack.cc attaches the Python stack
-    to op errors)."""
-    for fr in reversed(traceback.extract_stack(limit=24)):
-        if not fr.filename.startswith(_PKG_DIR):
-            return f"{fr.filename}:{fr.lineno} ({fr.name})"
+    to op errors). Walks raw frames via sys._getframe — unlike
+    traceback.extract_stack this never touches source files, so per-op
+    graph-build overhead stays negligible even for large programs."""
+    fr = sys._getframe(1)
+    depth = 0
+    while fr is not None and depth < 24:
+        code = fr.f_code
+        if not code.co_filename.startswith(_PKG_DIR):
+            return f"{code.co_filename}:{fr.f_lineno} ({code.co_name})"
+        fr = fr.f_back
+        depth += 1
     return None
 
 
