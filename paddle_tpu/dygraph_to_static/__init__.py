@@ -1,0 +1,148 @@
+"""AST-based dygraph→static conversion.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:229 (ProgramTranslator.get_func — source rewrite
++ recompile) with ifelse_transformer.py / loop_transformer.py /
+break_continue_transformer.py.  The reference rewrites Python control
+flow into fluid cond/while ops so a ProgramDesc can capture it; here the
+rewrite targets `lax.cond` / `lax.while_loop` so data-dependent Python
+control flow survives `jax.jit` tracing with BOTH branches staged —
+plain jit tracing (paddle_tpu.jit.to_static) silently bakes in one
+branch, which is exactly the gap this module closes.
+
+    from paddle_tpu.jit import declarative
+
+    @declarative
+    def f(x):
+        if x.sum() > 0:       # tensor condition
+            y = x + 1
+        else:
+            y = x - 1
+        while (y < 40).all(): # tensor loop
+            y = y * 2
+        return y
+
+Both branches execute correctly for either sign of x.sum(), under jit.
+
+Unconverted (left as plain Python, documented contract): constructs
+containing `return`; `while`/`for` with `else`; break/continue other
+than direct `if c: break`; `for` over non-range iterables.  With Python
+values those behave exactly as written; with tensor conditions jax's
+tracer error surfaces as before.
+
+Autodiff contract: converted `if` (lax.cond) is reverse-differentiable;
+converted tensor-bound loops (lax.while_loop) are not (JAX cannot
+reverse an unbounded trip count) — jax's own error surfaces.  Loops
+with Python bounds unroll at trace time and differentiate normally.
+"""
+
+import ast
+import functools
+import inspect
+import linecache
+import textwrap
+
+from . import convert_ops
+from .convert_ops import ConversionError
+from .transformer import transform_function_def
+
+__all__ = ["convert_to_static", "ast_transform_source", "ConversionError"]
+
+_HELPERS = {
+    "__jst_ifelse__": convert_ops.convert_ifelse,
+    "__jst_while__": convert_ops.convert_while,
+    "__jst_and__": convert_ops.convert_logical_and,
+    "__jst_or__": convert_ops.convert_logical_or,
+    "__jst_not__": convert_ops.convert_logical_not,
+    "__jst_undef__": convert_ops._Undefined,
+    "__jst_range__": convert_ops.convert_range,
+    "__jst_range_cond__": convert_ops.convert_range_cond,
+}
+
+_CACHE_ATTR = "__jst_converted__"
+
+
+def ast_transform_source(fn):
+    """Return the transformed source text for `fn` (debugging aid,
+    parity with ProgramTranslator.get_code)."""
+    tree = _parse(fn)
+    tree = transform_function_def(tree)
+    return ast.unparse(tree)
+
+
+def _parse(fn):
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise ConversionError(f"cannot convert {fn!r}: not a plain def")
+    fdef.decorator_list = []  # avoid re-triggering @declarative
+    return tree
+
+
+def convert_to_static(fn):
+    """Rewrite `fn`'s control flow for staging and return the recompiled
+    function.  Falls back to `fn` unchanged when the source is
+    unavailable (builtins, lambdas, exec'd code)."""
+    cached = getattr(fn, _CACHE_ATTR, None)
+    if cached is not None:
+        return cached
+    try:
+        tree = _parse(fn)
+    except (OSError, TypeError, SyntaxError, ConversionError):
+        return fn
+    tree = transform_function_def(tree)
+    new_fn = _recompile(fn, tree)
+    try:
+        fn.__jst_converted__ = new_fn
+    except (AttributeError, TypeError):
+        pass
+    return new_fn
+
+
+def _recompile(fn, tree):
+    fdef = tree.body[0]
+    fname = fdef.name
+    freevars = fn.__code__.co_freevars
+    filename = (f"<dygraph_to_static "
+                f"{fn.__code__.co_filename}:{fn.__code__.co_firstlineno}>")
+
+    if freevars:
+        # rebuild the closure: wrap the def in a factory taking the free
+        # variables, call it with the live cell contents
+        factory = ast.FunctionDef(
+            name="__jst_factory__",
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=v, annotation=None) for v in freevars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[fdef, ast.Return(value=ast.Name(id=fname,
+                                                  ctx=ast.Load()))],
+            decorator_list=[], returns=None)
+        module = ast.Module(body=[factory], type_ignores=[])
+    else:
+        module = ast.Module(body=[fdef], type_ignores=[])
+    ast.fix_missing_locations(module)
+
+    # register the generated source so tracebacks show real lines
+    source = ast.unparse(module)
+    linecache.cache[filename] = (len(source), None,
+                                 [l + "\n" for l in source.splitlines()],
+                                 filename)
+
+    # Execute the def against the function's REAL module globals so late
+    # bindings and `global` writes keep working; the def itself lands in
+    # a scratch locals dict so the module's own name is not rebound.
+    # Only the mangled __jst_* helpers are injected into the module.
+    fn.__globals__.update(_HELPERS)
+    local_ns = {}
+    code = compile(ast.parse(source), filename, "exec")
+    exec(code, fn.__globals__, local_ns)
+    if freevars:
+        cells = [c.cell_contents for c in fn.__closure__]
+        new_fn = local_ns["__jst_factory__"](*cells)
+    else:
+        new_fn = local_ns[fname]
+    functools.update_wrapper(new_fn, fn)
+    return new_fn

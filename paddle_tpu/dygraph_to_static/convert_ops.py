@@ -1,0 +1,186 @@
+"""Runtime conversion helpers targeted by the AST transformer.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+convert_operators.py (convert_ifelse / convert_while_loop /
+convert_logical_*).  The reference dispatches on Variable vs Python
+value and builds fluid control-flow ops; the TPU-native dispatch is on
+jax.Array / tracer vs Python value and builds `lax.cond` /
+`lax.while_loop`, so the converted function stays fully jittable while
+plain-Python conditions keep exact Python semantics (including short
+circuit and one-branch execution).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class ConversionError(RuntimeError):
+    """A converted construct cannot be staged on a tensor condition."""
+
+
+class _Undefined:
+    """Placeholder for a name not bound on some path (the reference's
+    RETURN_NO_VALUE sentinel).  Any real use raises, so silently-wrong
+    values can never flow out of a converted branch."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name="<var>"):
+        self.name = name
+
+    def __repr__(self):
+        return f"<undefined variable {self.name!r}>"
+
+    def _raise(self, *a, **k):
+        raise ConversionError(
+            f"variable {self.name!r} is undefined on this control-flow "
+            f"path (define it before the if/while so both paths bind it)")
+
+    __bool__ = __call__ = __getattr__ = __getitem__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __lt__ = __le__ = __gt__ = __ge__ = _raise
+    __iter__ = __len__ = __neg__ = __matmul__ = __rmatmul__ = _raise
+
+
+def _is_tensor(x):
+    return isinstance(x, (jax.Array, jax.core.Tracer))
+
+
+def _as_pred(x, what):
+    x = jnp.asarray(x)
+    if x.size != 1:
+        raise ConversionError(
+            f"{what} must be a scalar, got shape {x.shape}; reduce it "
+            f"(e.g. .any()/.all()) first")
+    return x.reshape(()).astype(bool)
+
+
+def convert_ifelse(pred, true_fn, false_fn, init, names):
+    """`if pred:` with tensor pred -> lax.cond (both branches staged);
+    Python pred -> run exactly one branch.  `init` holds the incoming
+    values of the branch-assigned variables (branch closures take them
+    as parameters and return their final values)."""
+    if not _is_tensor(pred):
+        return true_fn(*init) if pred else false_fn(*init)
+    try:
+        # init rides the closures, not cond operands: an _Undefined
+        # placeholder only raises if the staged branch actually uses it
+        return jax.lax.cond(_as_pred(pred, "if condition"),
+                            lambda: true_fn(*init),
+                            lambda: false_fn(*init))
+    except ConversionError:
+        raise
+    except (TypeError, ValueError) as e:
+        missing = _diagnose_undef(names, init, true_fn, false_fn)
+        if missing:
+            raise ConversionError(
+                f"if-condition is a tensor, so both branches are staged "
+                f"with lax.cond and must bind the same variables with "
+                f"matching shape/dtype; {missing}") from e
+        raise ConversionError(
+            f"branches of a tensor `if` must return matching "
+            f"shapes/dtypes for {list(names)}: {e}") from e
+
+
+def _diagnose_undef(names, init, *fns):
+    # failure path only: run each branch once to find which names come
+    # back undefined (fn returns plain tuples, so no staging needed)
+    notes = []
+    for which, fn in zip(("true", "false"), fns):
+        try:
+            out = fn(*init)
+        except ConversionError as e:
+            notes.append(f"{which}-branch: {e}")
+            continue
+        except Exception:
+            continue
+        for name, v in zip(names, out):
+            if isinstance(v, _Undefined):
+                notes.append(f"{name!r} is not bound on the "
+                             f"{which}-branch")
+    return "; ".join(notes)
+
+
+def convert_while(cond_fn, body_fn, init, names):
+    """`while cond:` with tensor cond -> lax.while_loop over the
+    assigned-in-body variables as loop carry; Python cond -> plain
+    Python loop (body still runs through body_fn, semantics identical)."""
+    c = cond_fn(*init)
+    if not _is_tensor(c):
+        vals = tuple(init)
+        while c:
+            vals = tuple(body_fn(*vals))
+            c = cond_fn(*vals)
+        return vals
+
+    init = _concretize_undef_init(body_fn, init, names)
+    try:
+        return jax.lax.while_loop(
+            lambda vs: _as_pred(cond_fn(*vs), "while condition"),
+            lambda vs: tuple(body_fn(*vs)),
+            tuple(init))
+    except ConversionError:
+        raise
+    except (TypeError, ValueError) as e:
+        raise ConversionError(
+            f"while-condition is a tensor, so the loop is staged with "
+            f"lax.while_loop and the loop variables {list(names)} must "
+            f"keep fixed shape/dtype across iterations: {e}") from e
+
+
+def _concretize_undef_init(body_fn, init, names):
+    """Loop variables first *written* inside the body may be undefined at
+    loop entry.  One abstract trace of the body proves they are never
+    read before written (reading an _Undefined raises), and yields their
+    steady-state avals so they can enter the carry as zeros."""
+    if not any(isinstance(v, _Undefined) for v in init):
+        return init
+    try:
+        out = jax.eval_shape(lambda _: tuple(body_fn(*init)), 0)
+    except ConversionError as e:
+        raise ConversionError(
+            f"while-condition is a tensor but a loop variable is read "
+            f"before it is written and not defined before the loop: {e}"
+        ) from e
+    return tuple(
+        jnp.zeros(o.shape, o.dtype) if isinstance(v, _Undefined) else v
+        for v, o in zip(init, out))
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_tensor(lhs):
+        return jnp.logical_and(lhs, rhs_fn())
+    return lhs and rhs_fn()       # Python short-circuit preserved
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_tensor(lhs):
+        return jnp.logical_or(lhs, rhs_fn())
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_tensor(x):
+        return jnp.logical_not(x)
+    return not x
+
+
+def convert_range(*args):
+    """start/stop/step triple for a converted `for i in range(...)`;
+    any argument may be a tensor."""
+    if len(args) == 1:
+        return 0, args[0], 1
+    if len(args) == 2:
+        return args[0], args[1], 1
+    return args
+
+
+def convert_range_cond(i, stop, step):
+    """Python range termination: i < stop for step > 0, i > stop for
+    step < 0 — on tensors this stays a tensor predicate."""
+    if _is_tensor(i) or _is_tensor(stop) or _is_tensor(step):
+        return jnp.where(jnp.asarray(step) > 0, jnp.asarray(i) < stop,
+                         jnp.asarray(i) > stop)
+    return i < stop if step > 0 else i > stop

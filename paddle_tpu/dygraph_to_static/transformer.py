@@ -1,0 +1,435 @@
+"""AST transformers: Python control flow on tensors -> staged lax ops.
+
+Parity: /root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+ifelse_transformer.py, loop_transformer.py and
+break_continue_transformer.py.  Same rewrite shape as the reference —
+branch bodies hoisted into closures returning the assigned names, loops
+rewritten around a (cond_fn, body_fn, loop_vars) triple — but targeting
+the jax runtime helpers in convert_ops.py instead of fluid ops.
+
+Rewrites applied to a function body:
+
+    if T:  A            ->  def _t(): A;  return (x, ...)
+    else:  B                def _f(): B;  return (x, ...)
+                            (x, ...) = __jst_ifelse__(T, _t, _f, names)
+
+    while T: B          ->  def _c(x, ...): return T
+                            def _b(x, ...): B; return (x, ...)
+                            (x, ...) = __jst_while__(_c, _b, inits, names)
+
+    for i in range(e):  ->  counter `while` with the same body
+
+`break`/`continue` inside a `while` are eliminated first with flag
+variables (the reference's BreakContinueTransformer scheme), so the
+remaining tree is straight-line + if/while only.  Constructs containing
+`return` are left as plain Python: early return cannot be staged, and
+leaving them untouched keeps Python-value conditions working exactly as
+before (a tensor condition then surfaces jax's own tracer error).
+"""
+
+import ast
+
+
+def _assigned_names(stmts):
+    """Names bound by a statement list, excluding nested function/class
+    scopes (their locals do not escape)."""
+    names = []
+
+    def collect_target(t):
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect_target(e)
+        elif isinstance(t, ast.Starred):
+            collect_target(t.value)
+
+    def walk(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            # generated __jst_* closures are code, not loop-carried data
+            if hasattr(node, "name") and not node.name.startswith("__jst"):
+                names.append(node.name)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                collect_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            collect_target(node.target)
+        elif isinstance(node, ast.NamedExpr):
+            collect_target(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            collect_target(node.target)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    collect_target(item.optional_vars)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.append(alias.asname
+                             or alias.name.split(".")[0])
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for s in stmts:
+        walk(s)
+    seen, out = set(), []
+    for n in names:
+        # __jst_a_/__jst_i_ capture temps are written then immediately
+        # read within one statement block — never live across a branch
+        # or iteration, so they must not become out/loop vars
+        if n not in seen and not n.startswith(("__jst_a_", "__jst_i_")):
+            seen.add(n)
+            out.append(n)
+    return out
+
+
+def _contains(stmts, kinds, stop_at_loops=False):
+    """Does any statement (excluding nested function scopes, and
+    optionally nested loops) contain a node of the given kinds?"""
+    found = False
+
+    def walk(node):
+        nonlocal found
+        if found:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if stop_at_loops and isinstance(node, (ast.While, ast.For)):
+            return
+        if isinstance(node, kinds):
+            found = True
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for s in stmts:
+        walk(s)
+    return found
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+def _call(fn_name, *args):
+    return ast.Call(func=_name(fn_name), args=list(args), keywords=[])
+
+
+def _capture_or_undef(tmp, var):
+    """try: tmp = var\nexcept NameError: tmp = __jst_undef__(var_name)"""
+    return ast.Try(
+        body=[ast.Assign(targets=[_name(tmp, ast.Store())],
+                         value=_name(var))],
+        handlers=[ast.ExceptHandler(
+            type=_name("NameError"), name=None,
+            body=[ast.Assign(
+                targets=[_name(tmp, ast.Store())],
+                value=_call("__jst_undef__", _const(var)))])],
+        orelse=[], finalbody=[])
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names],
+                     ctx=ctx or ast.Load())
+
+
+class BreakContinueTransformer(ast.NodeTransformer):
+    """Eliminate `break`/`continue` from `while` bodies via flag
+    variables so the loop can be staged.  Only the directly-nested
+    `if X: break` / `if X: continue` pattern (arbitrary position, no
+    else) is rewritten; loops with other uses are marked to stay
+    Python (`_jst_skip`)."""
+
+    def __init__(self):
+        self._n = 0
+
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+        return node
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if not _contains(node.body, (ast.Break, ast.Continue),
+                         stop_at_loops=True):
+            return node
+        if node.orelse or not self._supported(node.body):
+            # while/else: `else` must be skipped when the loop breaks —
+            # flag elimination would always run it. Stay Python.
+            node._jst_skip = True
+            return node
+        self._n += 1
+        brk = f"__jst_brk_{self._n}"
+        cont = f"__jst_cont_{self._n}"
+        used_brk, used_cont, new_body = self._rewrite(node.body, brk, cont)
+        out = []
+        if used_cont:
+            new_body.insert(0, ast.Assign(
+                targets=[_name(cont, ast.Store())], value=_const(False)))
+        if used_brk:
+            out.append(ast.Assign(targets=[_name(brk, ast.Store())],
+                                  value=_const(False)))
+            node.test = _call(
+                "__jst_and__",
+                ast.Lambda(args=_no_args(), body=node.test),
+                ast.Lambda(args=_no_args(),
+                           body=_call("__jst_not__", _name(brk))))
+        node.body = new_body
+        out.append(node)
+        return out
+
+    def visit_For(self, node):
+        # `for` has no test to splice a break flag into; loops using
+        # break/continue stay Python (the range conversion skips them)
+        self.generic_visit(node)
+        if _contains(node.body, (ast.Break, ast.Continue),
+                     stop_at_loops=True):
+            node._jst_skip = True
+        return node
+
+    def _supported(self, body):
+        """break/continue must be the direct `if X: break` pattern at
+        the top level of the loop body, with no else."""
+        for s in body:
+            if (isinstance(s, ast.If) and len(s.body) == 1
+                    and not s.orelse
+                    and isinstance(s.body[0], (ast.Break, ast.Continue))):
+                continue
+            if isinstance(s, (ast.While, ast.For,
+                              ast.FunctionDef, ast.ClassDef)):
+                continue  # inner loops/scopes own their breaks
+            for sub in ast.walk(s):
+                if isinstance(sub, (ast.Break, ast.Continue)):
+                    return False
+        return True
+
+    def _rewrite(self, body, brk, cont):
+        used_brk = used_cont = False
+        new = []
+        guard = None  # accumulated active flags
+        for s in body:
+            if (isinstance(s, ast.If) and len(s.body) == 1
+                    and not s.orelse
+                    and isinstance(s.body[0], (ast.Break, ast.Continue))):
+                is_break = isinstance(s.body[0], ast.Break)
+                flag = brk if is_break else cont
+                used_brk |= is_break
+                used_cont |= not is_break
+                setter = ast.If(
+                    test=s.test,
+                    body=[ast.Assign(targets=[_name(flag, ast.Store())],
+                                     value=_const(True))],
+                    orelse=[])
+                new.append(self._guarded(setter, guard))
+                guard = (_call("__jst_and__",
+                               ast.Lambda(args=_no_args(), body=guard),
+                               ast.Lambda(args=_no_args(),
+                                          body=_skip_test(flag)))
+                         if guard is not None else _skip_test(flag))
+            else:
+                new.append(self._guarded(s, guard))
+        # collapse consecutive same-guard statements into one if
+        return used_brk, used_cont, _merge_guards(new)
+
+    def _guarded(self, stmt, guard):
+        if guard is None:
+            return stmt
+        import copy
+
+        g = ast.If(test=copy.deepcopy(guard), body=[stmt], orelse=[])
+        g._jst_guard = ast.dump(guard)
+        return g
+
+
+def _skip_test(flag):
+    return _call("__jst_not__", _name(flag))
+
+
+def _merge_guards(stmts):
+    out = []
+    for s in stmts:
+        tag = getattr(s, "_jst_guard", None)
+        if (tag is not None and out
+                and getattr(out[-1], "_jst_guard", None) == tag):
+            out[-1].body.extend(s.body)
+        else:
+            out.append(s)
+    return out
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """if/while/for(range) -> __jst_ifelse__/__jst_while__ call sites."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _next(self):
+        self._n += 1
+        return self._n
+
+    # -- tests: rewrite `and`/`or`/`not` so tensor operands never hit
+    # Python bool()
+    def _rewrite_test(self, node):
+        if isinstance(node, ast.BoolOp):
+            fn = ("__jst_and__" if isinstance(node.op, ast.And)
+                  else "__jst_or__")
+            expr = self._rewrite_test(node.values[-1])
+            for v in reversed(node.values[:-1]):
+                expr = _call(fn,
+                             ast.Lambda(args=_no_args(),
+                                        body=self._rewrite_test(v)),
+                             ast.Lambda(args=_no_args(), body=expr))
+            return expr
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            return _call("__jst_not__",
+                         self._rewrite_test(node.operand))
+        return node
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains(node.body + node.orelse, (ast.Return,)):
+            return node  # early return: keep Python (see module doc)
+        n = self._next()
+        out_vars = _assigned_names(node.body + node.orelse)
+        true_name, false_name = f"__jst_true_{n}", f"__jst_false_{n}"
+
+        # out_vars enter the branch closures as PARAMETERS: a branch
+        # assigning `y = y + 1` then reads its own bound local, and a
+        # branch not assigning `y` returns the incoming value unchanged
+        def branch(name, body):
+            args = ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=v, annotation=None) for v in out_vars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[])
+            return ast.FunctionDef(
+                name=name, args=args,
+                body=(body or [ast.Pass()])
+                + [ast.Return(value=_tuple_of(out_vars))],
+                decorator_list=[], returns=None)
+
+        inits = []
+        init_tmps = []
+        for i, v in enumerate(out_vars):
+            tmp = f"__jst_a_{n}_{i}"
+            init_tmps.append(tmp)
+            inits.append(_capture_or_undef(tmp, v))
+        call = _call("__jst_ifelse__", self._rewrite_test(node.test),
+                     _name(true_name), _name(false_name),
+                     _tuple_of(init_tmps),
+                     ast.Tuple(elts=[_const(v) for v in out_vars],
+                               ctx=ast.Load()))
+        if out_vars:
+            site = ast.Assign(
+                targets=[_tuple_of(out_vars, ast.Store())], value=call)
+        else:
+            site = ast.Expr(value=call)
+        return ([branch(true_name, node.body),
+                 branch(false_name, node.orelse)] + inits + [site])
+
+    def visit_While(self, node):
+        if getattr(node, "_jst_skip", False):
+            return node  # unsupported break/continue: stay Python
+        if node.orelse or _contains([node.test], (ast.NamedExpr,)):
+            # while/else stays Python; a walrus in the test binds a name
+            # the body reads — hoisting it into cond_fn would localize it
+            self.generic_visit(node)
+            return node
+        self.generic_visit(node)
+        if _contains(node.body, (ast.Return,)):
+            return node
+        n = self._next()
+        loop_vars = _assigned_names(node.body)
+        if not loop_vars:
+            return node  # nothing carried; cannot terminate on tensors
+        cond_name, body_name = f"__jst_cond_{n}", f"__jst_body_{n}"
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=v, annotation=None) for v in loop_vars],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cond_def = ast.FunctionDef(
+            name=cond_name, args=args,
+            body=[ast.Return(value=self._rewrite_test(node.test))],
+            decorator_list=[], returns=None)
+        body_def = ast.FunctionDef(
+            name=body_name, args=args,
+            body=node.body + [ast.Return(value=_tuple_of(loop_vars))],
+            decorator_list=[], returns=None)
+        inits = []
+        init_tmps = []
+        for i, v in enumerate(loop_vars):
+            tmp = f"__jst_i_{n}_{i}"
+            init_tmps.append(tmp)
+            inits.append(_capture_or_undef(tmp, v))
+        site = ast.Assign(
+            targets=[_tuple_of(loop_vars, ast.Store())],
+            value=_call("__jst_while__", _name(cond_name),
+                        _name(body_name), _tuple_of(init_tmps),
+                        ast.Tuple(elts=[_const(v) for v in loop_vars],
+                                  ctx=ast.Load())))
+        return [cond_def, body_def] + inits + [site]
+
+    def visit_For(self, node):
+        if getattr(node, "_jst_skip", False) or node.orelse:
+            return node
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords
+                and isinstance(node.target, ast.Name)):
+            self.generic_visit(node)
+            return node  # non-range iteration stays Python
+        if (_contains(node.body, (ast.Return,))
+                or _contains(node.body, (ast.Break, ast.Continue),
+                             stop_at_loops=True)):
+            self.generic_visit(node)
+            return node
+        n = self._next()
+        it, start, stop, step = (f"__jst_it_{n}", f"__jst_start_{n}",
+                                 f"__jst_stop_{n}", f"__jst_step_{n}")
+        header = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(start, ast.Store()),
+                                     _name(stop, ast.Store()),
+                                     _name(step, ast.Store())],
+                               ctx=ast.Store())],
+            value=_call("__jst_range__", *node.iter.args))
+        init = ast.Assign(targets=[_name(it, ast.Store())],
+                          value=_name(start))
+        # i = _it; body; _it = _it + step   (target reassignment inside
+        # the body does not perturb the iteration, matching `for`)
+        body = ([ast.Assign(targets=[ast.Name(id=node.target.id,
+                                              ctx=ast.Store())],
+                            value=_name(it))]
+                + node.body
+                + [ast.Assign(
+                    targets=[_name(it, ast.Store())],
+                    value=ast.BinOp(left=_name(it), op=ast.Add(),
+                                    right=_name(step)))])
+        loop = ast.While(
+            test=_call("__jst_range_cond__", _name(it), _name(stop),
+                       _name(step)),
+            body=body, orelse=[])
+        converted = self.visit_While(loop)
+        converted = (converted if isinstance(converted, list)
+                     else [converted])
+        return [header, init] + converted
+
+
+def transform_function_def(tree):
+    """Apply the full pipeline to a Module containing one FunctionDef."""
+    tree = BreakContinueTransformer().visit(tree)
+    tree = ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(tree)
+    return tree

@@ -21,15 +21,36 @@ __all__ = ["to_static", "TracedLayer", "TrainStep"]
 
 
 def to_static(layer_or_fn, static_argnums=()):
-    """Compile a Layer's forward (or a plain function) with jax.jit."""
+    """Compile a Layer's forward (or a plain function) with jax.jit,
+    after AST-converting tensor-dependent Python control flow into
+    lax.cond / lax.while_loop (dygraph_to_static package)."""
+    import types
+
+    from ..dygraph_to_static import convert_to_static
     from ..nn import Layer
 
     if isinstance(layer_or_fn, Layer):
         layer = layer_or_fn
+        fwd = type(layer).forward
+        converted = convert_to_static(fwd)
 
         @jax.jit
         def apply(params, buffers, *args):
-            return functional_call_with_state(layer, params, buffers, *args)
+            # swap the AST-converted forward in ONLY while tracing the
+            # compiled path; eager calls on the layer stay untouched
+            had = "forward" in layer.__dict__
+            prev = layer.__dict__.get("forward")
+            if converted is not fwd:
+                layer.forward = types.MethodType(converted, layer)
+            try:
+                return functional_call_with_state(
+                    layer, params, buffers, *args)
+            finally:
+                if converted is not fwd:
+                    if had:
+                        layer.forward = prev
+                    else:
+                        del layer.__dict__["forward"]
 
         def compiled(*args):
             params = param_dict(layer)
@@ -41,7 +62,8 @@ def to_static(layer_or_fn, static_argnums=()):
 
         compiled.__wrapped__ = layer
         return compiled
-    return jax.jit(layer_or_fn, static_argnums=static_argnums)
+    return jax.jit(convert_to_static(layer_or_fn),
+                   static_argnums=static_argnums)
 
 
 class TracedLayer:
@@ -185,11 +207,10 @@ def _restore_buffers(model, old):
 class ProgramTranslator:
     """Parity: dygraph_to_static/program_translator.py ProgramTranslator
     — a singleton switch deciding whether `declarative` functions run
-    compiled (traced through jax.jit) or fall back to eager. The
-    reference converts Python AST; the TPU-native design converts by
-    TRACING (jax's native transform), so data-dependent Python control
-    flow must use layers.cond / lax primitives — a documented contract,
-    enforced with jax's own tracing errors."""
+    compiled or fall back to eager. Like the reference, conversion is
+    AST-based (paddle_tpu.dygraph_to_static): tensor-dependent Python
+    if/while/for are rewritten into lax.cond / lax.while_loop before
+    jax.jit tracing, so both branches stage correctly."""
 
     _instance = None
 

@@ -1,0 +1,471 @@
+"""AST dygraph→static conversion: tensor-dependent Python control flow
+is rewritten into lax.cond / lax.while_loop so BOTH branches stage under
+jit (plain tracing silently bakes one branch in).
+
+Parity: reference tests under
+python/paddle/fluid/tests/unittests/dygraph_to_static/
+(test_ifelse.py, test_loop.py, test_break_continue.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.dygraph_to_static import (
+    ConversionError,
+    ast_transform_source,
+    convert_to_static,
+)
+from paddle_tpu.jit import ProgramTranslator, declarative
+
+
+def test_ifelse_tensor_both_branches():
+    @declarative
+    def f(x):
+        if x.sum() > 0:
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    xp = jnp.ones((3,))
+    xn = -jnp.ones((3,))
+    np.testing.assert_allclose(f(xp), np.full(3, 2.0))
+    np.testing.assert_allclose(f(xn), np.full(3, -2.0))  # the branch
+    # plain tracing would have baked in the first branch
+
+
+def test_ifelse_under_outer_jit():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x * 3.0
+        return y
+
+    g = jax.jit(convert_to_static(f))
+    np.testing.assert_allclose(g(jnp.ones(2)), np.full(2, 2.0))
+    np.testing.assert_allclose(g(-jnp.ones(2)), np.full(2, -3.0))
+
+
+def test_ifelse_python_cond_single_branch():
+    trace = []
+
+    def f(x, flag):
+        if flag:
+            trace.append("t")
+            y = x + 1
+        else:
+            trace.append("f")
+            y = x - 1
+        return y
+
+    g = convert_to_static(f)
+    assert float(g(jnp.float32(1.0), True)) == 2.0
+    assert trace == ["t"]  # python condition: only one branch ran
+
+
+def test_elif_chain():
+    @declarative
+    def f(x):
+        if x.sum() > 10.0:
+            y = x * 0.0
+        elif x.sum() > 0.0:
+            y = x * 1.0
+        else:
+            y = x * 2.0
+        return y
+
+    np.testing.assert_allclose(f(jnp.full((2,), 100.0)), np.zeros(2))
+    np.testing.assert_allclose(f(jnp.full((2,), 1.0)), np.full(2, 1.0))
+    np.testing.assert_allclose(f(jnp.full((2,), -1.0)), np.full(2, -2.0))
+
+
+def test_if_var_defined_outside_branch():
+    @declarative
+    def f(x):
+        y = x * 10.0
+        if x.sum() > 0:
+            y = y + 1.0
+        return y
+
+    np.testing.assert_allclose(f(jnp.ones(2)), np.full(2, 11.0))
+    np.testing.assert_allclose(f(-jnp.ones(2)), np.full(2, -10.0))
+
+
+def test_if_undefined_on_one_branch_errors_clearly():
+    def f(x):
+        if x.sum() > 0:
+            z = x + 1.0
+        else:
+            pass
+        return z
+
+    g = convert_to_static(f)
+    with pytest.raises(ConversionError, match="z"):
+        g(jnp.ones(2))
+
+
+def test_while_tensor_cond():
+    @declarative
+    def f(x):
+        while (x < 40.0).all():
+            x = x * 2.0
+        return x
+
+    np.testing.assert_allclose(f(jnp.float32(1.0)), 64.0)
+    np.testing.assert_allclose(f(jnp.float32(50.0)), 50.0)
+
+
+def test_while_python_cond_preserved():
+    def f(x, n):
+        i = 0
+        while i < n:
+            x = x + 1.0
+            i += 1
+        return x
+
+    g = convert_to_static(f)
+    assert float(g(jnp.float32(0.0), 3)) == 3.0
+    assert float(g(jnp.float32(0.0), 0)) == 0.0
+
+
+def test_while_write_first_temp():
+    @declarative
+    def f(x):
+        while x.sum() < 10.0:
+            t = x * 2.0  # written before read each iteration
+            x = t + 1.0
+        return x
+
+    out = f(jnp.float32(0.0))
+    assert float(out) >= 10.0
+
+
+def test_while_carried_var_must_be_initialized():
+    def f(x):
+        while x.sum() < 10.0:
+            x = x + acc  # acc read before ever written
+            acc = x
+        return x
+
+    g = convert_to_static(f)
+    with pytest.raises((ConversionError, NameError, UnboundLocalError)):
+        g(jnp.float32(0.0))
+
+
+def test_for_range_tensor_bound():
+    @declarative
+    def f(x, n):
+        for i in range(n):
+            x = x + i
+        return x
+
+    assert float(f(jnp.float32(0.0), jnp.int32(4))) == 6.0  # 0+1+2+3
+    assert float(f(jnp.float32(5.0), jnp.int32(0))) == 5.0
+
+
+def test_for_range_python_bound():
+    def f(x, n):
+        for _ in range(n):
+            x = x * 2.0
+        return x
+
+    g = convert_to_static(f)
+    assert float(g(jnp.float32(1.0), 3)) == 8.0
+
+
+def test_nested_if_in_while():
+    @declarative
+    def f(x):
+        s = jnp.float32(0.0)
+        while (x > 0.0).all():
+            if x.sum() > 5.0:
+                s = s + 2.0
+            else:
+                s = s + 1.0
+            x = x - 1.0
+        return s
+
+    # x=7: sums 7,6 -> +2 each; 5..1 -> +1 each => 2*2 + 5*1 = 9
+    assert float(f(jnp.float32(7.0))) == 9.0
+
+
+def test_break_pattern_tensor_loop():
+    @declarative
+    def f(x):
+        i = jnp.float32(0.0)
+        while i < 100.0:
+            if (x * i).sum() > 10.0:
+                break
+            i = i + 1.0
+        return i
+
+    assert float(f(jnp.float32(3.0))) == 4.0  # 3*4 = 12 > 10
+    assert float(f(jnp.float32(0.0))) == 100.0
+
+
+def test_continue_pattern_python_loop():
+    def f(n):
+        s = 0
+        i = 0
+        while i < n:
+            i = i + 1
+            if i % 2 == 0:
+                continue
+            s = s + i
+        return s
+
+    g = convert_to_static(f)
+    assert g(5) == 1 + 3 + 5
+
+
+def test_logical_ops_on_tensors():
+    @declarative
+    def f(x):
+        if (x.sum() > 0.0) and (x.sum() < 10.0):
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    np.testing.assert_allclose(f(jnp.ones(2)), np.full(2, 2.0))
+    np.testing.assert_allclose(f(jnp.full((2,), 100.0)),
+                               np.full(2, 99.0))
+    np.testing.assert_allclose(f(-jnp.ones(2)), np.full(2, -2.0))
+
+
+def test_logical_short_circuit_python():
+    calls = []
+
+    def rhs():
+        calls.append(1)
+        return True
+
+    def f(x, flag):
+        if flag and rhs():
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    g = convert_to_static(f)
+    assert float(g(jnp.float32(0.0), False)) == -1.0
+    assert calls == []  # short circuit preserved
+
+
+def test_closure_capture():
+    scale = 3.0
+
+    def f(x):
+        if x.sum() > 0:
+            y = x * scale
+        else:
+            y = x / scale
+        return y
+
+    g = convert_to_static(f)
+    np.testing.assert_allclose(g(jnp.ones(2)), np.full(2, 3.0))
+    np.testing.assert_allclose(
+        g(-jnp.ones(2)), np.full(2, -1 / 3.0), rtol=1e-6)
+
+
+def test_early_return_stays_python():
+    def f(x, flag):
+        if flag:
+            return x + 1.0
+        return x - 1.0
+
+    g = convert_to_static(f)
+    assert float(g(jnp.float32(0.0), True)) == 1.0
+    assert float(g(jnp.float32(0.0), False)) == -1.0
+
+
+def test_program_translator_switch():
+    ProgramTranslator().enable(False)
+    try:
+        @declarative
+        def f(x):
+            # under eager fallback, a python branch on a concrete
+            # tensor works via __bool__
+            if x.sum() > 0:
+                return x + 1.0
+            return x - 1.0
+
+        assert float(f(jnp.float32(1.0))) == 2.0
+    finally:
+        ProgramTranslator().enable(True)
+
+
+def test_grad_through_converted_if():
+    def f(x):
+        if x > 0:
+            y = x * x
+        else:
+            y = x * 3.0
+        return y
+
+    g = jax.grad(convert_to_static(f))
+    assert float(g(jnp.float32(2.0))) == 4.0
+    assert float(g(jnp.float32(-2.0))) == 3.0
+
+
+def test_python_counter_loop_grad():
+    # python-valued bound: the loop unrolls at trace time and stays
+    # reverse-differentiable
+    def f(x):
+        i = 0
+        while i < 3:
+            x = x * 2.0
+            i = i + 1
+        return x
+
+    g = jax.grad(convert_to_static(f))
+    assert float(g(jnp.float32(1.0))) == 8.0
+
+
+def test_tensor_loop_grad_raises_jax_error():
+    # tensor-valued bound: staged as lax.while_loop, which jax cannot
+    # reverse-differentiate (unbounded trip count) — the jax error
+    # surfaces rather than a silently wrong gradient
+    def f(x):
+        i = jnp.int32(0)
+        while i < 3:
+            x = x * 2.0
+            i = i + 1
+        return x
+
+    g = jax.grad(convert_to_static(f))
+    with pytest.raises(ValueError, match="while_loop"):
+        g(jnp.float32(1.0))
+
+
+def test_transform_source_debug_aid():
+    def f(x):
+        if x.sum() > 0:
+            y = x + 1
+        else:
+            y = x - 1
+        return y
+
+    src = ast_transform_source(f)
+    assert "__jst_ifelse__" in src
+    assert "__jst_true_" in src
+
+
+def test_while_else_with_break_stays_python():
+    def f(n):
+        i = 0
+        while i < n:
+            if i == 2:
+                break
+            i = i + 1
+        else:
+            i = -999
+        return i
+
+    g = convert_to_static(f)
+    assert g(10) == 2      # break taken: else must NOT run
+    assert g(1) == -999    # exhausted: else runs
+
+
+def test_late_bound_global_helper():
+    # _late_helper is defined AFTER conversion; the converted function
+    # must see the live module globals, not a snapshot
+    def f(x):
+        if x.sum() > 0:
+            y = _late_helper(x)
+        else:
+            y = x
+        return y
+
+    g = convert_to_static(f)
+    globals()["_late_helper"] = lambda v: v * 10.0
+    try:
+        np.testing.assert_allclose(g(jnp.ones(2)), np.full(2, 10.0))
+    finally:
+        del globals()["_late_helper"]
+
+
+def test_import_inside_branch():
+    def f(x, flag):
+        if flag:
+            import math
+            y = x * 2.0
+        else:
+            y = x
+        return y + math.pi if flag else y
+
+    g = convert_to_static(f)
+    assert float(g(jnp.float32(1.0), True)) == pytest.approx(
+        2.0 + np.pi)
+    assert float(g(jnp.float32(1.0), False)) == 1.0
+
+
+def test_walrus_in_while_test_stays_python():
+    def f(vals):
+        it = iter(vals)
+        total = 0.0
+        while (v := next(it, None)) is not None:
+            total += v
+        return total
+
+    g = convert_to_static(f)
+    assert g([1.0, 2.0, 3.0]) == 6.0
+
+
+def test_to_static_does_not_mutate_layer():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import to_static
+
+    calls = []
+
+    class Probe(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 2)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.sum() > 0:
+                calls.append("pos")
+                out = h * 2.0
+            else:
+                calls.append("neg")
+                out = h * 0.5
+            return out
+
+    layer = Probe()
+    to_static(layer)  # compile; must not patch the instance
+    assert "forward" not in layer.__dict__
+    calls.clear()
+    layer(jnp.ones((1, 2)))  # eager: exactly one branch's side effect
+    assert len(calls) == 1
+
+
+def test_layer_forward_conversion():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import to_static
+
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.sum() > 0:
+                out = h * 2.0
+            else:
+                out = h * 0.5
+            return out
+
+    layer = Gate()
+    compiled = to_static(layer)
+    x = jnp.ones((2, 4))
+    out = compiled(x)
+    h = layer.fc(x)
+    expect = np.asarray(h * 2.0 if float(h.sum()) > 0 else h * 0.5)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
