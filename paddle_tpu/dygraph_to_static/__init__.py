@@ -89,10 +89,13 @@ def convert_to_static(fn):
         return cached
     try:
         tree = _parse(fn)
-    except (OSError, TypeError, SyntaxError, ConversionError):
+        tree = transform_function_def(tree)
+        new_fn = _recompile(fn, tree)
+    except Exception:
+        # conversion must never break previously-working code: any
+        # transform/recompile failure falls back to the original
+        # function (reference ProgramTranslator logs and falls back too)
         return fn
-    tree = transform_function_def(tree)
-    new_fn = _recompile(fn, tree)
     try:
         fn.__jst_converted__ = new_fn
     except (AttributeError, TypeError):

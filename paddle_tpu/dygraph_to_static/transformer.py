@@ -78,7 +78,8 @@ def _assigned_names(stmts):
         # __jst_a_/__jst_i_ capture temps are written then immediately
         # read within one statement block — never live across a branch
         # or iteration, so they must not become out/loop vars
-        if n not in seen and not n.startswith(("__jst_a_", "__jst_i_")):
+        if n not in seen and not n.startswith(
+                ("__jst_a_", "__jst_i_", "__jst_t_")):
             seen.add(n)
             out.append(n)
     return out
@@ -174,11 +175,13 @@ class BreakContinueTransformer(ast.NodeTransformer):
         if used_brk:
             out.append(ast.Assign(targets=[_name(brk, ast.Store())],
                                   value=_const(False)))
+            # `not brk` first: after a break Python never re-evaluates
+            # the loop test, so ours must short-circuit before it too
             node.test = _call(
                 "__jst_and__",
-                ast.Lambda(args=_no_args(), body=node.test),
                 ast.Lambda(args=_no_args(),
-                           body=_call("__jst_not__", _name(brk))))
+                           body=_call("__jst_not__", _name(brk))),
+                ast.Lambda(args=_no_args(), body=node.test))
         node.body = new_body
         out.append(node)
         return out
@@ -298,8 +301,14 @@ class ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_If(self, node):
         self.generic_visit(node)
-        if _contains(node.body + node.orelse, (ast.Return,)):
-            return node  # early return: keep Python (see module doc)
+        if _contains(node.body + node.orelse,
+                     (ast.Return, ast.Global, ast.Nonlocal)):
+            return node  # early return / scope decls: keep Python
+        if _contains(node.body + node.orelse,
+                     (ast.Break, ast.Continue), stop_at_loops=True):
+            # break/continue belonging to an unconverted enclosing loop
+            # must stay syntactically inside that loop
+            return node
         n = self._next()
         out_vars = _assigned_names(node.body + node.orelse)
         true_name, false_name = f"__jst_true_{n}", f"__jst_false_{n}"
@@ -319,13 +328,19 @@ class ControlFlowTransformer(ast.NodeTransformer):
                 + [ast.Return(value=_tuple_of(out_vars))],
                 decorator_list=[], returns=None)
 
+        # hoist the test ahead of the captures: a walrus in the test
+        # (`if (y := f()) > 0:`) must bind y before y's value is
+        # captured for the branches
+        test_tmp = f"__jst_t_{n}"
+        hoist = ast.Assign(targets=[_name(test_tmp, ast.Store())],
+                           value=self._rewrite_test(node.test))
         inits = []
         init_tmps = []
         for i, v in enumerate(out_vars):
             tmp = f"__jst_a_{n}_{i}"
             init_tmps.append(tmp)
             inits.append(_capture_or_undef(tmp, v))
-        call = _call("__jst_ifelse__", self._rewrite_test(node.test),
+        call = _call("__jst_ifelse__", _name(test_tmp),
                      _name(true_name), _name(false_name),
                      _tuple_of(init_tmps),
                      ast.Tuple(elts=[_const(v) for v in out_vars],
@@ -336,18 +351,23 @@ class ControlFlowTransformer(ast.NodeTransformer):
         else:
             site = ast.Expr(value=call)
         return ([branch(true_name, node.body),
-                 branch(false_name, node.orelse)] + inits + [site])
+                 branch(false_name, node.orelse), hoist]
+                + inits + [site])
 
     def visit_While(self, node):
+        # always visit children first: even when this loop itself stays
+        # Python, convertible tensor control flow nested inside it must
+        # still be rewritten (visit_If keeps break/continue-bearing ifs
+        # intact, so an unconverted loop keeps its breaks)
+        self.generic_visit(node)
         if getattr(node, "_jst_skip", False):
             return node  # unsupported break/continue: stay Python
         if node.orelse or _contains([node.test], (ast.NamedExpr,)):
             # while/else stays Python; a walrus in the test binds a name
             # the body reads — hoisting it into cond_fn would localize it
-            self.generic_visit(node)
             return node
-        self.generic_visit(node)
-        if _contains(node.body, (ast.Return,)):
+        if _contains(node.body,
+                     (ast.Return, ast.Global, ast.Nonlocal)):
             return node
         n = self._next()
         loop_vars = _assigned_names(node.body)
@@ -382,18 +402,17 @@ class ControlFlowTransformer(ast.NodeTransformer):
         return [cond_def, body_def] + inits + [site]
 
     def visit_For(self, node):
-        if getattr(node, "_jst_skip", False) or node.orelse:
-            return node
-        if not (isinstance(node.iter, ast.Call)
-                and isinstance(node.iter.func, ast.Name)
-                and node.iter.func.id == "range"
-                and not node.iter.keywords
-                and isinstance(node.target, ast.Name)):
-            self.generic_visit(node)
-            return node  # non-range iteration stays Python
-        if (_contains(node.body, (ast.Return,))
+        if (getattr(node, "_jst_skip", False) or node.orelse
+                or not (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range"
+                        and not node.iter.keywords
+                        and isinstance(node.target, ast.Name))
+                or _contains(node.body,
+                             (ast.Return, ast.Global, ast.Nonlocal))
                 or _contains(node.body, (ast.Break, ast.Continue),
                              stop_at_loops=True)):
+            # loop stays Python, but nested constructs still convert
             self.generic_visit(node)
             return node
         n = self._next()

@@ -1,14 +1,32 @@
 """Model compression toolkit (slim).
 
-Parity surface: /root/reference/python/paddle/fluid/contrib/slim/ — the
-quantization passes (quantization_pass.py) and post-training quantization.
-Pruning/NAS/distillation from the reference's slim are higher-level recipes
-over the same primitives and are not yet ported.
+Parity surface: /root/reference/python/paddle/fluid/contrib/slim/ —
+quantization (QAT pass + post-training), pruning (prune/pruner.py,
+prune_strategy.py), and distillation (distillation/distiller.py,
+distillation_strategy.py).
+
+Documented drop — NAS + searcher (slim/nas/light_nas_strategy.py,
+slim/searcher/controller_server.py): the reference's LightNAS is a
+simulated-annealing architecture search driven by a socket
+controller-server measuring latency on target phones/GPUs.  Neither the
+client/server search harness nor the latency tables transfer to a TPU
+pod; architecture search on TPU is a fleet-orchestration concern (spawn
+trials as separate XLA programs), not an in-framework graph mutation.
+The pruning `sensitivity` analysis covers the in-framework part of the
+search loop (scoring candidate sub-networks).
 """
 
+from .distill import (DistillationStrategy, FSPDistiller, L2Distiller,
+                      SoftLabelDistiller, merge)
+from .prune import (MagnitudePruner, Pruner, StructurePruner,
+                    apply_masks, sensitivity, sparsity, uniform_prune)
 from .quantization import (QuantizationTransformPass,
                            PostTrainingQuantization,
                            quant_aware, convert)
 
 __all__ = ["QuantizationTransformPass", "PostTrainingQuantization",
-           "quant_aware", "convert"]
+           "quant_aware", "convert",
+           "Pruner", "StructurePruner", "MagnitudePruner",
+           "uniform_prune", "apply_masks", "sensitivity", "sparsity",
+           "merge", "L2Distiller", "SoftLabelDistiller", "FSPDistiller",
+           "DistillationStrategy"]

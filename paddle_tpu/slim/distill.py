@@ -1,0 +1,173 @@
+"""Knowledge-distillation passes (slim).
+
+Parity: /root/reference/python/paddle/fluid/contrib/slim/distillation/
+distiller.py (L2Distiller :26, FSPDistiller :103, SoftLabelDistiller
+:199) and distillation_strategy.py (DistillationStrategy — merge the
+teacher graph into the student graph, attach distiller losses, swap the
+training loss).
+
+The reference merges two GraphWrappers; here `merge` splices the
+teacher Program's ops/vars IN PLACE into the student Program with a
+name prefix, sharing the data vars, and marks every teacher var
+stop-gradient so backward never enters the teacher.  Clone the student
+first (`student.clone()`) if the un-distilled program is still needed.  The distillers
+then build their losses with ordinary layer calls under program_guard —
+the combined program stays one XLA computation, so teacher forward,
+student forward, and both losses fuse into a single compiled step.
+"""
+
+import numpy as np
+
+from .. import layers
+from ..framework.executor import global_scope
+from ..framework.program import Parameter, program_guard
+
+__all__ = ["merge", "L2Distiller", "SoftLabelDistiller", "FSPDistiller",
+           "DistillationStrategy"]
+
+TEACHER_PREFIX = "teacher_"
+
+
+def merge(teacher_program, student_program, data_vars, scope=None,
+          prefix=TEACHER_PREFIX, teacher_scope=None):
+    """Splice the teacher graph into the student program (parity:
+    distillation_strategy.py _create_distillation_graph / GraphWrapper
+    merge).
+
+    data_vars: names fed to BOTH networks (stay unprefixed, shared).
+    Teacher parameter values currently in `teacher_scope` (default: the
+    global scope) are copied to their prefixed names so the merged
+    program can run immediately.  Returns the merged program.
+    """
+    scope = scope or global_scope()
+    teacher_scope = teacher_scope or scope
+    data = set(data_vars)
+
+    def ren(name):
+        return name if name in data else prefix + name
+
+    block = student_program.global_block()
+    tblock = teacher_program.global_block()
+    for var in tblock.vars.values():
+        if var.name in data:
+            continue
+        new_name = ren(var.name)
+        if isinstance(var, Parameter):
+            nv = block.create_parameter(
+                name=new_name, shape=var.shape, dtype=var.dtype,
+                trainable=False)
+        else:
+            nv = block.create_var(
+                name=new_name, shape=var.shape, dtype=var.dtype,
+                persistable=var.persistable)
+        nv.stop_gradient = True
+        val = teacher_scope.find_var(var.name)
+        if val is not None:
+            scope.set_var(new_name, np.asarray(val))
+    for op in tblock.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        block.append_op(
+            op.type,
+            inputs={slot: [ren(n) for n in names]
+                    for slot, names in op.inputs.items()},
+            outputs={slot: [ren(n) for n in names]
+                     for slot, names in op.outputs.items()},
+            attrs=dict(op.attrs))
+    return student_program
+
+
+class L2Distiller:
+    """L2 loss between a student and a teacher feature map
+    (distiller.py:26)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        block = program.global_block()
+        with program_guard(program):
+            s = block.var(self.student_feature_map)
+            t = block.var(self.teacher_feature_map)
+            l2 = layers.mean(layers.square_error_cost(s, t))
+            return l2 * self.distillation_loss_weight
+
+
+class SoftLabelDistiller:
+    """Soft-target cross entropy between temperature-softened logits
+    (distiller.py:199)."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        block = program.global_block()
+        with program_guard(program):
+            s = block.var(self.student_feature_map)
+            t = block.var(self.teacher_feature_map)
+            soft_t = layers.softmax(t * (1.0 / self.teacher_temperature))
+            soft_t.stop_gradient = True
+            ce = layers.softmax_with_cross_entropy(
+                s * (1.0 / self.student_temperature), soft_t,
+                soft_label=True)
+            return layers.mean(ce) * self.distillation_loss_weight
+
+
+class FSPDistiller:
+    """Flow-of-solution-procedure loss over (start, end) feature-map
+    pairs (distiller.py:103); fsp_matrix is the repo's `fsp` kernel."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1.0):
+        self.student_pairs = student_pairs
+        self.teacher_pairs = teacher_pairs
+        self.distillation_loss_weight = distillation_loss_weight
+
+    def distiller_loss(self, program):
+        block = program.global_block()
+        with program_guard(program):
+            losses = []
+            for (s0, s1), (t0, t1) in zip(self.student_pairs,
+                                          self.teacher_pairs):
+                s_fsp = layers.fsp_matrix(block.var(s0), block.var(s1))
+                t_fsp = layers.fsp_matrix(block.var(t0), block.var(t1))
+                losses.append(
+                    layers.mean(layers.square_error_cost(s_fsp, t_fsp)))
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            return total * self.distillation_loss_weight
+
+
+class DistillationStrategy:
+    """Combine distiller losses with the student loss
+    (distillation_strategy.py:30).  Usage:
+
+        merged = distill.merge(teacher_prog, student_prog, ["x"])
+        strategy = DistillationStrategy(distillers=[...])
+        total = strategy.build(merged, student_loss_var)
+        optimizer.minimize(total)   # teacher frozen via stop_gradient
+    """
+
+    def __init__(self, distillers=()):
+        self.distillers = list(distillers)
+
+    def build(self, program, student_loss=None):
+        total = None
+        with program_guard(program):
+            for d in self.distillers:
+                loss = d.distiller_loss(program)
+                total = loss if total is None else total + loss
+            if student_loss is not None:
+                total = (student_loss if total is None
+                         else total + student_loss)
+        return total

@@ -1,0 +1,164 @@
+"""Pruning passes (slim).
+
+Parity: /root/reference/python/paddle/fluid/contrib/slim/prune/
+pruner.py:22 (Pruner/StructurePruner: cal_pruned_idx l1_norm ranking,
+prune_tensor lazy/hard) and prune_strategy.py:36 (PruneStrategy /
+UniformPruneStrategy / SensitivePruneStrategy).
+
+TPU-native design note: the reference's "hard" mode physically shrinks
+tensors and ripples new shapes through the graph — on XLA that would
+force a recompile per pruning event and fight the static-shape model.
+The training-time form here is therefore the reference's *lazy* mode
+(masking: pruned slots pinned to zero), which XLA folds into the matmul
+efficiently and which keeps one compiled program alive across pruning
+steps.  `prune_tensor(..., lazy=False)` still provides the hard shrink
+at the numpy level for export-time surgery.
+"""
+
+import re
+
+import numpy as np
+
+from ..framework.executor import global_scope
+
+__all__ = [
+    "Pruner", "StructurePruner", "MagnitudePruner",
+    "uniform_prune", "apply_masks", "sensitivity", "sparsity",
+]
+
+
+class Pruner:
+    """Base class of all pruners (pruner.py:22)."""
+
+    def prune(self, param):
+        raise NotImplementedError
+
+
+class StructurePruner(Pruner):
+    """Group (filter/column) pruning by per-group norm (pruner.py:33).
+
+    pruning_axis / criterions are dicts keyed by param name, with '*'
+    as the fallback key, exactly like the reference.
+    """
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        criterion = self.criterions.get(name, self.criterions.get("*"))
+        if axis is None:
+            axis = self.pruning_axis.get(name, self.pruning_axis.get("*"))
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
+        if criterion == "l1_norm":
+            scores = np.sum(np.abs(param), axis=reduce_dims)
+        elif criterion == "l2_norm":
+            scores = np.sqrt(np.sum(np.square(param), axis=reduce_dims))
+        else:
+            raise ValueError(f"unsupported criterion {criterion!r}")
+        return scores.argsort()[:prune_num]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=False):
+        mask = np.zeros(tensor.shape[pruned_axis], dtype=bool)
+        mask[np.asarray(pruned_idx, dtype=np.int64)] = True
+        if lazy:
+            out = np.array(tensor)
+            index = [slice(None)] * tensor.ndim
+            index[pruned_axis] = mask
+            out[tuple(index)] = 0
+            return out
+        index = [slice(None)] * tensor.ndim
+        index[pruned_axis] = ~mask
+        return np.array(tensor[tuple(index)])
+
+    def mask_for(self, name, param, ratio, axis=None):
+        """Keep-mask (1.0 = kept) broadcastable to the param shape."""
+        if axis is None:
+            axis = self.pruning_axis.get(name, self.pruning_axis.get("*"))
+        idx = self.cal_pruned_idx(name, param, ratio, axis=axis)
+        keep = np.ones(param.shape[axis], dtype=param.dtype)
+        keep[idx] = 0
+        shape = [1] * param.ndim
+        shape[axis] = param.shape[axis]
+        return np.broadcast_to(keep.reshape(shape), param.shape).copy()
+
+
+class MagnitudePruner(Pruner):
+    """Unstructured magnitude pruning: zero the smallest-|w| fraction."""
+
+    def mask_for(self, name, param, ratio, axis=None):
+        k = int(round(param.size * ratio))
+        keep = np.ones(param.size, dtype=param.dtype)
+        if k > 0:
+            idx = np.abs(param).ravel().argsort()[:k]
+            keep[idx] = 0
+        return keep.reshape(param.shape)
+
+
+def _match_params(program, pattern):
+    rx = re.compile(pattern)
+    return [p for p in program.global_block().all_parameters()
+            if rx.match(p.name) and p.trainable]
+
+
+def uniform_prune(program, ratio, pruned_params=".*", pruner=None,
+                  scope=None):
+    """UniformPruneStrategy equivalent (prune_strategy.py:36): prune
+    every matching parameter at the same ratio.  Zeroes the pruned
+    slots in the scope and returns {name: keep_mask}; re-pin with
+    `apply_masks` after optimizer updates to maintain sparsity."""
+    pruner = pruner or MagnitudePruner()
+    scope = scope or global_scope()
+    masks = {}
+    for p in _match_params(program, pruned_params):
+        value = scope.find_var(p.name)
+        if value is None:
+            raise ValueError(
+                f"parameter '{p.name}' has no value in scope — run the "
+                f"startup program (or load a checkpoint) before pruning")
+        value = np.asarray(value)
+        mask = pruner.mask_for(p.name, value, ratio)
+        masks[p.name] = mask
+        scope.set_var(p.name, value * mask)
+    return masks
+
+
+def apply_masks(masks, scope=None):
+    """Re-apply keep-masks after training updates (the lazy-mode
+    maintenance the reference does inside its optimize loop)."""
+    scope = scope or global_scope()
+    for name, mask in masks.items():
+        v = scope.find_var(name)
+        if v is not None:
+            scope.set_var(name, np.asarray(v) * mask)
+
+
+def sparsity(masks):
+    total = sum(m.size for m in masks.values())
+    zeros = sum(int((m == 0).sum()) for m in masks.values())
+    return zeros / max(total, 1)
+
+
+def sensitivity(program, param_names, ratios, eval_fn, pruner=None,
+                scope=None):
+    """SensitivePruneStrategy's analysis phase (prune_strategy.py:437):
+    for each parameter, prune at each ratio, evaluate, restore.
+    Returns {param_name: {ratio: metric}}."""
+    pruner = pruner or MagnitudePruner()
+    scope = scope or global_scope()
+    result = {}
+    for name in param_names:
+        value = scope.find_var(name)
+        if value is None:
+            raise ValueError(
+                f"parameter '{name}' has no value in scope — run the "
+                f"startup program (or load a checkpoint) first")
+        backup = np.array(value)
+        result[name] = {}
+        for ratio in ratios:
+            mask = pruner.mask_for(name, backup, ratio)
+            scope.set_var(name, backup * mask)
+            result[name][ratio] = float(eval_fn())
+        scope.set_var(name, backup)
+    return result

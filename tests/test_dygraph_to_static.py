@@ -416,6 +416,70 @@ def test_walrus_in_while_test_stays_python():
     assert g([1.0, 2.0, 3.0]) == 6.0
 
 
+def test_break_skips_test_reevaluation():
+    # after `break` Python never re-evaluates the loop test; the flag
+    # rewrite must short-circuit before the original test (here the
+    # test would IndexError once i == len(data))
+    def f(data):
+        i = 0
+        while data[i] > 0:
+            i = i + 1
+            if i == len(data):
+                break
+        return i
+
+    g = convert_to_static(f)
+    assert g([5, 4]) == 2
+
+
+_GLOBAL_COUNTER = 0
+
+
+def test_global_in_branch_falls_back():
+    def f(x, flag):
+        global _GLOBAL_COUNTER
+        if flag:
+            _GLOBAL_COUNTER = _GLOBAL_COUNTER + 1
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    g = convert_to_static(f)
+    before = _GLOBAL_COUNTER
+    assert float(g(jnp.float32(0.0), True)) == 1.0
+    assert _GLOBAL_COUNTER == before + 1
+
+
+def test_tensor_if_inside_python_for_with_break():
+    # the for stays Python (break), but the tensor if inside it must
+    # still convert
+    @declarative
+    def f(x):
+        for i in range(4):
+            if i == 3:
+                break
+            if x.sum() > 0:
+                x = x + 1.0
+            else:
+                x = x - 1.0
+        return x
+
+    assert float(f(jnp.float32(1.0))) == 4.0
+    assert float(f(jnp.float32(-10.0))) == -13.0
+
+
+def test_walrus_in_if_test():
+    def f(x):
+        if (y := float(x) * 2.0) > 3.0:
+            y = y + 1.0
+        return y
+
+    g = convert_to_static(f)
+    assert g(np.float32(2.0)) == 5.0
+    assert g(np.float32(1.0)) == 2.0
+
+
 def test_to_static_does_not_mutate_layer():
     import paddle_tpu.nn as nn
     from paddle_tpu.jit import to_static
