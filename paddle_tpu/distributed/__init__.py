@@ -21,7 +21,8 @@ from .sharded import (
     shard_batch, shard_train_state, make_sharded_train_step,
 )
 from .ring_attention import ring_attention, ring_attention_sharded
-from .pipeline import gpipe, build_gpt_pipeline
+from .pipeline import (gpipe, build_gpt_pipeline,
+                       build_gpt_pipeline_3d)
 from .federated import FLClient, FLServer, run_fl_round
 from .moe import (
     init_moe_params, moe_ffn, shard_moe_params, sharded_moe_ffn,
@@ -42,7 +43,7 @@ __all__ = [
     "shard_params", "shard_batch", "shard_train_state",
     "make_sharded_train_step",
     "ring_attention", "ring_attention_sharded",
-    "gpipe", "build_gpt_pipeline",
+    "gpipe", "build_gpt_pipeline", "build_gpt_pipeline_3d",
     "SparseEmbedding", "Communicator", "PSServer", "PSClient",
     "HeartBeatMonitor",
     "FLServer", "FLClient", "run_fl_round",

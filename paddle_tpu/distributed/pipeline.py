@@ -30,11 +30,18 @@ __all__ = ["gpipe", "stack_block_params", "build_gpt_pipeline",
 
 
 def gpipe(stage_fn, mesh, num_microbatches, axis_name="pp",
-          batch_axis="dp", remat=True):
-    """Build fn(stacked_params, x) -> y running the GPipe schedule.
+          batch_axis="dp", remat=True, needs_rng=False,
+          param_specs=None):
+    """Build fn(stacked_params, x[, rng_key]) -> y running the GPipe
+    schedule.
 
     stage_fn(stage_params, h) -> h': one pipeline stage; h' must have
-    h's shape/dtype (transformer-block shape preservation).
+    h's shape/dtype (transformer-block shape preservation).  With
+    needs_rng=True, stage_fn(stage_params, h, key) -> h' instead: each
+    schedule tick derives key = fold_in(fold_in(base, tick), stage), so
+    every (microbatch, stage) pair sees an independent stream — the
+    per-tick threading dropout needs.  Under jax.grad/remat the same
+    fold happens in the recompute, so forward and backward masks agree.
     stacked_params: pytree whose leaves have a leading n_stages dim.
     x: [B, ...] activations; B must divide into num_microbatches.
     """
@@ -42,7 +49,9 @@ def gpipe(stage_fn, mesh, num_microbatches, axis_name="pp",
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
 
-    def body(params_loc, x_loc):
+    has_dp = batch_axis and batch_axis in mesh.shape
+
+    def body(params_loc, x_loc, key):
         my = jax.tree.map(lambda l: l[0], params_loc)     # this stage's slice
         i = jax.lax.axis_index(axis_name)
         m = num_microbatches
@@ -59,7 +68,18 @@ def gpipe(stage_fn, mesh, num_microbatches, axis_name="pp",
             x_t = jax.lax.dynamic_index_in_dim(
                 xs, jnp.clip(t, 0, m - 1), 0, keepdims=False)
             h_in = jnp.where(is_first, x_t, h_recv)
-            h_out = stage_fn(my, h_in)
+            if needs_rng:
+                tick_key = jax.random.fold_in(
+                    jax.random.fold_in(key, t), i)
+                if has_dp:
+                    # each dp replica holds different data and must draw
+                    # its own masks — replicated keys would correlate
+                    # dropout noise across the batch shards
+                    tick_key = jax.random.fold_in(
+                        tick_key, jax.lax.axis_index(batch_axis))
+                h_out = stage_fn(my, h_in, tick_key)
+            else:
+                h_out = stage_fn(my, h_in)
             slot = t - (n_stages - 1)
             valid = (slot >= 0) & (slot < m) & is_last
             cl = jnp.clip(slot, 0, m - 1)
@@ -78,14 +98,21 @@ def gpipe(stage_fn, mesh, num_microbatches, axis_name="pp",
         out_buf = jax.lax.psum(out_buf, axis_name)
         return out_buf.reshape(x_loc.shape)
 
-    has_dp = batch_axis and batch_axis in mesh.shape
     x_spec = P(batch_axis) if has_dp else P()
+    # param_specs: per-leaf PartitionSpecs for the stacked weights (all
+    # leading with the pp axis); lets tensor parallelism ride the same
+    # shard_map — each device then holds its (stage, tp) weight tile
+    p_spec = P(axis_name) if param_specs is None else param_specs
     fn = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis_name), x_spec),
+        in_specs=(p_spec, x_spec, P()),
         out_specs=x_spec,
         check_vma=False)
-    return fn
+    if needs_rng:
+        return fn
+    # keep the historical two-arg signature when no rng is threaded
+    return lambda params, x: fn(params, x,
+                                jax.random.PRNGKey(0))
 
 
 def stack_block_params(block_param_dicts):
@@ -107,13 +134,7 @@ def build_gpt_pipeline(model, mesh, num_microbatches, axis_name="pp"):
     """
     from ..nn.layers import functional_call, param_dict
 
-    if getattr(model.cfg, "dropout", 0.0):
-        # functional_call would bake a single trace-time dropout mask into
-        # the compiled scan — silently wrong training numerics
-        raise ValueError(
-            "build_gpt_pipeline requires dropout=0.0 (per-step RNG "
-            "threading through the pipeline schedule is not supported)")
-
+    dropout_p = float(getattr(model.cfg, "dropout", 0.0) or 0.0)
     n_stages = mesh.shape[axis_name]
     blocks = list(model.blocks)
     assert len(blocks) % n_stages == 0, (
@@ -132,20 +153,50 @@ def build_gpt_pipeline(model, mesh, num_microbatches, axis_name="pp"):
     head = {n: v for n, v in all_params.items()
             if n.startswith("norm_f.")}
 
-    def stage_fn(stage_params, h):
-        # scan this stage's blocks (leaves [per_stage, ...])
-        def one_block(h, blk_params):
-            return functional_call(block0, blk_params, h), None
+    if dropout_p:
+        from ..nn.parameter import default_rng
 
-        h, _ = jax.lax.scan(one_block, h, stage_params)
-        return h
+        def stage_fn(stage_params, h, key):
+            # scan this stage's blocks (leaves [per_stage, ...]); each
+            # block folds its index so masks differ across blocks, and
+            # key_context routes the per-(tick, stage, block) stream
+            # into the blocks' Dropout layers
+            def one_block(h, xs):
+                blk_params, idx = xs
+                blk_key = jax.random.fold_in(key, idx)
+                with default_rng.key_context(blk_key):
+                    return functional_call(block0, blk_params, h), None
 
-    pipe = gpipe(stage_fn, mesh, num_microbatches, axis_name=axis_name)
+            per = jax.tree.leaves(stage_params)[0].shape[0]
+            h, _ = jax.lax.scan(
+                one_block, h,
+                (stage_params, jnp.arange(per, dtype=jnp.int32)))
+            return h
+    else:
+        def stage_fn(stage_params, h):
+            # scan this stage's blocks (leaves [per_stage, ...])
+            def one_block(h, blk_params):
+                return functional_call(block0, blk_params, h), None
+
+            h, _ = jax.lax.scan(one_block, h, stage_params)
+            return h
+
+    pipe = gpipe(stage_fn, mesh, num_microbatches, axis_name=axis_name,
+                 needs_rng=bool(dropout_p))
+    return _lm_apply_fn(model, pipe, dropout_p), \
+        {"emb": emb, "stages": stages, "head": head}
+
+
+def _lm_apply_fn(model, pipe, dropout_p):
+    """Shared pre/post-pipeline LM wrapper: embedding lookup (+dropout),
+    pipelined block stack, final layer norm, tied-head logits, fused CE
+    (one wrapper so the dp x pp and dp x tp x pp builders cannot
+    diverge)."""
+    from ..nn import functional as F
+
     max_seq = model.cfg.max_seq_len
 
-    def apply_fn(params, input_ids, labels):
-        from ..nn import functional as F
-
+    def apply_fn(params, input_ids, labels, rng_key=None):
         wte = params["emb"]["wte.weight"]
         wpe = params["emb"]["wpe.weight"]
         seq = input_ids.shape[1]
@@ -154,44 +205,164 @@ def build_gpt_pipeline(model, mesh, num_microbatches, axis_name="pp"):
                 f"sequence length {seq} exceeds max_seq_len {max_seq}")
         pos = jnp.arange(seq, dtype=jnp.int32)[None, :]
         h = jnp.take(wte, input_ids, axis=0) + jnp.take(wpe, pos, axis=0)
-        h = pipe(params["stages"], h)
+        if dropout_p:
+            if rng_key is None:
+                raise ValueError(
+                    "this pipeline was built with dropout>0: pass a "
+                    "fresh rng_key to every apply_fn call (a fixed key "
+                    "would reuse the same dropout masks each step)")
+            # embedding dropout (model.drop) lives outside the pipeline;
+            # fold a constant far above any tick index for its stream
+            h = F.dropout(h, p=dropout_p,
+                          rng_key=jax.random.fold_in(rng_key, 1 << 30))
+            h = pipe(params["stages"], h, rng_key)
+        else:
+            h = pipe(params["stages"], h)
         h = F.layer_norm(h, weight=params["head"]["norm_f.weight"],
                          bias=params["head"]["norm_f.bias"])
         logits = jnp.einsum("bsh,vh->bsv", h, wte)
-        logp = F.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return nll.mean()
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        lab = jnp.take_along_axis(logits, labels[..., None],
+                                  axis=-1)[..., 0]
+        return (lse - lab.astype(jnp.float32)).mean()
 
-    params = {"emb": emb, "stages": stages, "head": head}
-    return apply_fn, params
+    return apply_fn
 
 
-def pipeline_dryrun(n_devices, devices=None, num_microbatches=4):
-    """Driver hook: one pipelined fwd+bwd+sgd step on a pp x dp mesh."""
+def build_gpt_pipeline_3d(model, mesh, num_microbatches, axis_pp="pp",
+                          axis_tp="tp", batch_axis="dp"):
+    """dp x tp x pp composed in ONE mesh: megatron tensor parallelism
+    inside each pipeline stage.
+
+    The stacked block weights shard over BOTH the pp axis (leading
+    stage dim) and the tp axis (megatron column/row dims): q/k/v and
+    fc1 split their output dim (attention heads divide across tp),
+    out_proj and fc2 split their input dim with a psum(tp) completing
+    the row-parallel matmul — two tp collectives per block, the
+    standard megatron count.  The batch additionally shards over dp via
+    the gpipe x_spec.  Math mirrors models.gpt.GPTBlock exactly (same
+    SDPA kernel, gelu, layer_norm), so the pipelined+tp loss matches
+    the single-device model.
+
+    Requires dropout == 0 (the dp x pp builder handles dropout; see
+    build_gpt_pipeline).  Returns (apply_fn, params) like
+    build_gpt_pipeline.
+    """
+    from ..nn import functional as F
+    from ..nn.layers import param_dict
+
+    if float(getattr(model.cfg, "dropout", 0.0) or 0.0):
+        raise ValueError("build_gpt_pipeline_3d requires dropout=0.0")
+
+    n_stages = mesh.shape[axis_pp]
+    tp = mesh.shape[axis_tp]
+    heads = model.cfg.num_heads
+    hidden = model.cfg.hidden_size
+    assert heads % tp == 0, f"{heads} heads not divisible by tp={tp}"
+    blocks = list(model.blocks)
+    assert len(blocks) % n_stages == 0
+    per_stage = len(blocks) // n_stages
+    head_dim = hidden // heads
+
+    stacked = stack_block_params([param_dict(b) for b in blocks])
+    stages = {n: v.reshape(n_stages, per_stage, *v.shape[1:])
+              for n, v in stacked.items()}
+
+    # megatron sharding per stacked leaf [pp, per_stage, ...]:
+    #   column parallel (split output dim): q/k/v, fc1 -> last dim tp
+    #   row parallel (split input dim): out_proj, fc2 -> dim 2 tp,
+    #     bias replicated (added once, after the psum)
+    def leaf_spec(name):
+        if name.endswith(".weight") and any(
+                k in name for k in ("q_proj", "k_proj", "v_proj", "fc1")):
+            return P(axis_pp, None, None, axis_tp)
+        if name.endswith(".bias") and any(
+                k in name for k in ("q_proj", "k_proj", "v_proj", "fc1")):
+            return P(axis_pp, None, axis_tp)
+        if name.endswith(".weight") and any(
+                k in name for k in ("out_proj", "fc2")):
+            return P(axis_pp, None, axis_tp, None)
+        return P(axis_pp)           # norms + row-parallel biases
+
+    param_specs = {n: leaf_spec(n) for n in stages}
+    eps = blocks[0].norm1._epsilon
+
+    def stage_fn(p, h):
+        # p: this stage's local tile {name: [per_stage, ...local...]}
+        def one_block(h, bp):
+            x = h
+            hn = F.layer_norm(x, [hidden], bp["norm1.weight"],
+                              bp["norm1.bias"], eps)
+            b, s, _ = hn.shape
+            loc = heads // tp
+
+            def proj(w, bias):
+                return (hn @ w + bias).reshape(b, s, loc, head_dim)
+
+            q = proj(bp["attn.q_proj.weight"], bp["attn.q_proj.bias"])
+            k = proj(bp["attn.k_proj.weight"], bp["attn.k_proj.bias"])
+            v = proj(bp["attn.v_proj.weight"], bp["attn.v_proj.bias"])
+            q, k, v = (jnp.transpose(t, (0, 2, 1, 3)) for t in (q, k, v))
+            o = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                               training=False)
+            o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, s, -1)
+            attn_out = jax.lax.psum(o @ bp["attn.out_proj.weight"],
+                                    axis_tp) + bp["attn.out_proj.bias"]
+            x = x + attn_out
+            hn = F.layer_norm(x, [hidden], bp["norm2.weight"],
+                              bp["norm2.bias"], eps)
+            ff = F.gelu(hn @ bp["fc1.weight"] + bp["fc1.bias"])
+            ff = jax.lax.psum(ff @ bp["fc2.weight"],
+                              axis_tp) + bp["fc2.bias"]
+            return x + ff, None
+
+        h, _ = jax.lax.scan(one_block, h, p)
+        return h
+
+    pipe = gpipe(stage_fn, mesh, num_microbatches, axis_name=axis_pp,
+                 batch_axis=batch_axis, param_specs=param_specs)
+    all_params = param_dict(model)
+    emb = {n: v for n, v in all_params.items()
+           if n.startswith(("wte.", "wpe."))}
+    head = {n: v for n, v in all_params.items()
+            if n.startswith("norm_f.")}
+    return _lm_apply_fn(model, pipe, 0.0), \
+        {"emb": emb, "stages": stages, "head": head}
+
+
+def pipeline_dryrun(n_devices, devices=None, num_microbatches=4, pp=2,
+                    dropout=0.0):
+    """Driver hook: one pipelined fwd+bwd+sgd step on a pp x dp mesh
+    (pp is configurable so deeper pipelines get exercised; dropout>0
+    threads per-tick PRNG keys through the schedule)."""
     import numpy as np
 
     from ..models.gpt import GPT, GPTConfig
     from .mesh import build_mesh
 
-    pp = 2
     dp = n_devices // pp
     mesh = build_mesh(dp=dp, tp=1, pp=pp, sp=1, devices=devices)
-    model = GPT(GPTConfig(vocab_size=256, hidden_size=32, num_layers=4,
-                          num_heads=4, max_seq_len=16, dropout=0.0))
+    model = GPT(GPTConfig(vocab_size=256, hidden_size=32, num_layers=pp * 2,
+                          num_heads=4, max_seq_len=16, dropout=dropout))
     apply_fn, params = build_gpt_pipeline(model, mesh, num_microbatches)
 
     r = np.random.default_rng(0)
-    batch = 2 * dp * num_microbatches
+    batch = max(2 * dp, 1) * num_microbatches
     x = jnp.asarray(r.integers(0, 256, (batch, 16)), jnp.int32)
     y = jnp.asarray(r.integers(0, 256, (batch, 16)), jnp.int32)
 
     @jax.jit
-    def step(params, x, y):
-        loss, grads = jax.value_and_grad(apply_fn)(params, x, y)
+    def step(params, x, y, key):
+        def loss_fn(params):
+            if dropout:
+                return apply_fn(params, x, y, rng_key=key)
+            return apply_fn(params, x, y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
         params = jax.tree.map(lambda p, g: p - 1e-3 * g, params, grads)
         return params, loss
 
-    params, loss = step(params, x, y)
+    params, loss = step(params, x, y, jax.random.PRNGKey(0))
     loss.block_until_ready()
     assert jnp.isfinite(loss), "pipeline dryrun loss not finite"
     return float(loss)

@@ -19,8 +19,6 @@ DownpourWorker pull→compute→push loop, framework/device_worker.h:203):
   tables skip the network entirely.
 """
 
-import io
-import pickle
 import queue
 import socket
 import socketserver
@@ -317,37 +315,150 @@ class Communicator:
 # TCP control plane (listen_and_serv parity)
 # --------------------------------------------------------------------------
 
-class _RestrictedUnpickler(pickle.Unpickler):
-    """Deserialization allow-list: the PS wire protocol only ever carries
-    builtins + numpy arrays/scalars. Anything else (os.system, functools,
-    arbitrary classes) is refused, so a peer that can reach the port cannot
-    get code execution through the pickle layer."""
+# -- wire codec ------------------------------------------------------------
+# Fixed binary format, parity with the reference's proto wire schema
+# (operators/distributed/send_recv.proto.in VariableMessage: name, type,
+# dims, serialized tensor bytes).  A tagged value tree — scalars, strings,
+# ndarrays (dtype + dims + raw buffer), lists, dicts — with NO embedded
+# code paths: decoding can only ever produce data, unlike pickle, so a
+# peer that reaches the port cannot gain execution.
 
-    _ALLOWED = {
-        ("builtins", "complex"), ("builtins", "frozenset"),
-        ("builtins", "set"), ("builtins", "slice"), ("builtins", "bytearray"),
-        ("numpy", "ndarray"), ("numpy", "dtype"),
-        ("numpy.core.multiarray", "_reconstruct"),
-        ("numpy.core.multiarray", "scalar"),
-        ("numpy.core.numeric", "_frombuffer"),
-        ("numpy._core.multiarray", "_reconstruct"),
-        ("numpy._core.multiarray", "scalar"),
-        ("numpy._core.numeric", "_frombuffer"),
-    }
+_WIRE_MAGIC = b"PT"
+_WIRE_VERSION = 1
+(_T_NONE, _T_BOOL, _T_INT, _T_FLOAT, _T_STR, _T_BYTES, _T_NDARRAY,
+ _T_LIST, _T_TUPLE, _T_DICT) = range(10)
 
-    def find_class(self, module, name):
-        if (module, name) in self._ALLOWED:
-            return super().find_class(module, name)
-        raise pickle.UnpicklingError(
-            f"PS wire format forbids {module}.{name}")
+_WIRE_DTYPES = {"bool", "int8", "int16", "int32", "int64", "uint8",
+                "uint16", "uint32", "uint64", "float16", "float32",
+                "float64"}
+
+
+def _enc(obj, out):
+    if obj is None:
+        out.append(struct.pack("<B", _T_NONE))
+    elif isinstance(obj, bool) or isinstance(obj, np.bool_):
+        out.append(struct.pack("<BB", _T_BOOL, bool(obj)))
+    elif isinstance(obj, (int, np.integer)):
+        out.append(struct.pack("<Bq", _T_INT, int(obj)))
+    elif isinstance(obj, (float, np.floating)):
+        out.append(struct.pack("<Bd", _T_FLOAT, float(obj)))
+    elif isinstance(obj, str):
+        b = obj.encode()
+        out.append(struct.pack("<BI", _T_STR, len(b)))
+        out.append(b)
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(struct.pack("<BI", _T_BYTES, len(obj)))
+        out.append(bytes(obj))
+    elif isinstance(obj, np.ndarray):
+        a = np.ascontiguousarray(obj)
+        dt = str(a.dtype)
+        if dt not in _WIRE_DTYPES:
+            raise TypeError(f"dtype {dt} not wire-encodable")
+        dtb = dt.encode()
+        out.append(struct.pack("<BB", _T_NDARRAY, len(dtb)))
+        out.append(dtb)
+        out.append(struct.pack("<B", a.ndim))
+        out.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        out.append(struct.pack("<Q", a.nbytes))
+        out.append(a.tobytes())
+    elif isinstance(obj, (list, tuple)):
+        tag = _T_TUPLE if isinstance(obj, tuple) else _T_LIST
+        out.append(struct.pack("<BI", tag, len(obj)))
+        for v in obj:
+            _enc(v, out)
+    elif isinstance(obj, dict):
+        out.append(struct.pack("<BI", _T_DICT, len(obj)))
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise TypeError("wire dict keys must be str")
+            _enc(k, out)
+            _enc(v, out)
+    else:
+        raise TypeError(f"{type(obj).__name__} not wire-encodable")
+
+
+def _dec(buf, off):
+    (tag,) = struct.unpack_from("<B", buf, off)
+    off += 1
+    if tag == _T_NONE:
+        return None, off
+    if tag == _T_BOOL:
+        (v,) = struct.unpack_from("<B", buf, off)
+        return bool(v), off + 1
+    if tag == _T_INT:
+        (v,) = struct.unpack_from("<q", buf, off)
+        return v, off + 8
+    if tag == _T_FLOAT:
+        (v,) = struct.unpack_from("<d", buf, off)
+        return v, off + 8
+    if tag in (_T_STR, _T_BYTES):
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        raw = bytes(buf[off:off + n])
+        if len(raw) != n:
+            raise ValueError("truncated wire string")
+        return (raw.decode() if tag == _T_STR else raw), off + n
+    if tag == _T_NDARRAY:
+        (dtl,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        dt = bytes(buf[off:off + dtl]).decode("ascii")
+        off += dtl
+        if dt not in _WIRE_DTYPES:
+            raise ValueError(f"wire format forbids dtype {dt!r}")
+        (ndim,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        shape = struct.unpack_from(f"<{ndim}q", buf, off)
+        off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        expect = int(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
+        if nbytes != expect or off + nbytes > len(buf):
+            raise ValueError("wire ndarray length mismatch")
+        a = np.frombuffer(bytes(buf[off:off + nbytes]), dtype=dt)
+        return a.reshape(shape), off + nbytes
+    if tag in (_T_LIST, _T_TUPLE):
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        items = []
+        for _ in range(n):
+            v, off = _dec(buf, off)
+            items.append(v)
+        return (tuple(items) if tag == _T_TUPLE else items), off
+    if tag == _T_DICT:
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        d = {}
+        for _ in range(n):
+            k, off = _dec(buf, off)
+            v, off = _dec(buf, off)
+            d[k] = v
+        return d, off
+    raise ValueError(f"unknown wire tag {tag}")
+
+
+def wire_dumps(obj):
+    out = [_WIRE_MAGIC, struct.pack("<B", _WIRE_VERSION)]
+    _enc(obj, out)
+    return b"".join(out)
+
+
+def wire_loads(data):
+    if len(data) < 3 or data[:2] != _WIRE_MAGIC:
+        raise ValueError("bad wire magic (not a paddle_tpu PS frame)")
+    if data[2] != _WIRE_VERSION:
+        raise ValueError(f"unsupported wire version {data[2]}")
+    obj, off = _dec(data, 3)
+    if off != len(data):
+        raise ValueError("trailing bytes in wire frame")
+    return obj
 
 
 def _send_msg(sock, obj):
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    data = wire_dumps(obj)
     sock.sendall(struct.pack("<Q", len(data)) + data)
 
 
-def _recv_msg(sock):
+def _recv_msg(sock, max_frame=1 << 34):
     hdr = b""
     while len(hdr) < 8:
         chunk = sock.recv(8 - len(hdr))
@@ -355,19 +466,21 @@ def _recv_msg(sock):
             raise ConnectionError("peer closed")
         hdr += chunk
     (n,) = struct.unpack("<Q", hdr)
+    if n > max_frame:
+        raise ValueError(f"wire frame of {n} bytes exceeds limit")
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             raise ConnectionError("peer closed")
         buf += chunk
-    return _RestrictedUnpickler(io.BytesIO(bytes(buf))).load()
+    return wire_loads(bytes(buf))
 
 
 class PSServer:
-    """One embedding shard behind a TCP endpoint (localhost clusters /
-    trusted DCN only — the wire format is pickle, same trust model as the
-    reference's in-cluster gRPC)."""
+    """One embedding shard behind a TCP endpoint.  The wire format is
+    the fixed binary codec above (send_recv.proto.in parity) — pure
+    data, no deserialization code paths."""
 
     def __init__(self, dim, port=0, host="127.0.0.1",
                  heartbeat_timeout=60.0, **shard_kw):

@@ -89,12 +89,23 @@ def convert_to_static(fn):
         return cached
     try:
         tree = _parse(fn)
+    except (OSError, TypeError, SyntaxError, ConversionError):
+        return fn  # no source (lambda, builtin, exec'd): silently eager
+    try:
         tree = transform_function_def(tree)
         new_fn = _recompile(fn, tree)
-    except Exception:
+    except Exception as e:
         # conversion must never break previously-working code: any
         # transform/recompile failure falls back to the original
-        # function (reference ProgramTranslator logs and falls back too)
+        # function — but audibly, like the reference ProgramTranslator's
+        # log-and-fallback
+        import warnings
+
+        warnings.warn(
+            f"dygraph_to_static conversion of "
+            f"{getattr(fn, '__qualname__', fn)!r} failed "
+            f"({type(e).__name__}: {e}); running unconverted",
+            stacklevel=3)
         return fn
     try:
         fn.__jst_converted__ = new_fn

@@ -601,7 +601,8 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           sparse_config=None, _sparse_push=True):
+                           sparse_config=None, _sparse_push=True,
+                           prefetch=None):
         """Dataset-driven training loop — the industrial CTR path.
 
         Parity: /root/reference/python/paddle/fluid/executor.py:1187
@@ -620,6 +621,15 @@ class Executor:
         pulled rows, "lr": optional} — pull before each step, push the
         embedding gradient after (the program must mark emb_var in
         append_backward's parameter_list so its @GRAD is addressable).
+
+        prefetch: overlap batch N+1's host work (dataset iteration +
+        sparse embedding pull over TCP) with batch N's device step on a
+        producer thread — the reference's buffered_reader double-buffer
+        (operators/reader/buffered_reader.cc) + Communicator send-overlap.
+        Default (None) enables it for dense programs and for tables
+        behind async/half_async/geo Communicators, where one-step-stale
+        pulls are already the semantics; plain sync tables keep the
+        strict pull->step->push order.
 
         Returns the list of final-batch fetch values (or None, like the
         reference, when fetch_list is empty).
@@ -652,9 +662,19 @@ class Executor:
             e["_pull"] = getattr(e["table"], "table", e["table"])
             e["_grad"] = e["emb_var"] + "@GRAD"
 
-        last = None
-        step_i = 0
-        for batch in dataset:
+        if prefetch is None:
+            # auto: overlap unless a table has strict sync semantics
+            # (a plain SparseEmbedding, or a SYNC-mode Communicator).
+            # Read-only draining (infer_from_dataset) never pushes, so
+            # it has no ordering constraint at all.
+            def _is_async(e):
+                mode = getattr(e["table"], "mode", None)
+                return mode in ("async", "half_async", "geo")
+
+            prefetch = (not _sparse_push
+                        or all(_is_async(e) for e in entries))
+
+        def prepare(batch):
             feed = {k: v for k, v in batch.items()
                     if blk._find_var_recursive(k) is not None}
             fl = list(fetch_names)
@@ -665,6 +685,60 @@ class Executor:
                 feed[e["emb_var"]] = e["_pull"].pull(ids)
                 if _sparse_push:
                     fl.append(e["_grad"])
+            return feed, fl, batch_ids
+
+        if prefetch:
+            # producer thread keeps one prepared batch in flight: batch
+            # N+1's iteration + embedding pull overlap batch N's step
+            import queue as _queue
+            import threading as _threading
+
+            q = _queue.Queue(maxsize=2)
+            stop = _threading.Event()
+            _END, _ERR = object(), object()
+
+            def _offer(item):
+                # bounded put that gives up when the consumer is gone,
+                # so a raising train loop can't strand this thread
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        return True
+                    except _queue.Full:
+                        continue
+                return False
+
+            def produce():
+                try:
+                    for b in dataset:
+                        if not _offer(prepare(b)):
+                            return
+                    _offer(_END)
+                except BaseException as exc:   # propagate to consumer
+                    _offer((_ERR, exc))
+
+            t = _threading.Thread(target=produce, daemon=True)
+            t.start()
+
+            def prepared_batches():
+                try:
+                    while True:
+                        item = q.get()
+                        if item is _END:
+                            return
+                        if isinstance(item, tuple) and item[0] is _ERR:
+                            raise item[1]
+                        yield item
+                finally:
+                    stop.set()        # unblock + retire the producer
+        else:
+            def prepared_batches():
+                for b in dataset:
+                    yield prepare(b)
+
+        last = None
+        step_i = 0
+        for feed, fl, batch_ids in prepared_batches():
             out = self.run(program, feed=feed, fetch_list=fl, scope=scope)
             if entries and _sparse_push:
                 n = len(entries)
@@ -683,14 +757,17 @@ class Executor:
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           prefetch=None):
         """executor.py:1130 parity — same drain loop but READ-ONLY on the
         sparse tables: embedding rows are still pulled to feed the
-        program, gradients are neither fetched nor pushed."""
+        program, gradients are neither fetched nor pushed (so prefetch
+        auto-enables: there is no pull/push ordering constraint)."""
         return self.train_from_dataset(
             program=program, dataset=dataset, scope=scope, thread=thread,
             debug=debug, fetch_list=fetch_list, fetch_info=fetch_info,
-            print_period=print_period, _sparse_push=False)
+            print_period=print_period, _sparse_push=False,
+            prefetch=prefetch)
 
     # ------------------------------------------------------------------
     @staticmethod

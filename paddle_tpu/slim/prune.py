@@ -156,9 +156,12 @@ def sensitivity(program, param_names, ratios, eval_fn, pruner=None,
                 f"startup program (or load a checkpoint) first")
         backup = np.array(value)
         result[name] = {}
-        for ratio in ratios:
-            mask = pruner.mask_for(name, backup, ratio)
-            scope.set_var(name, backup * mask)
-            result[name][ratio] = float(eval_fn())
-        scope.set_var(name, backup)
+        try:
+            for ratio in ratios:
+                mask = pruner.mask_for(name, backup, ratio)
+                scope.set_var(name, backup * mask)
+                result[name][ratio] = float(eval_fn())
+        finally:
+            # a raising eval_fn must not leave the model pruned
+            scope.set_var(name, backup)
     return result

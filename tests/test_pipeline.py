@@ -13,7 +13,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.distributed.mesh import build_mesh
 from paddle_tpu.distributed.pipeline import (
-    build_gpt_pipeline, gpipe, pipeline_dryrun)
+    build_gpt_pipeline, build_gpt_pipeline_3d, gpipe, pipeline_dryrun)
 from paddle_tpu.models.gpt import GPT, GPTConfig
 from paddle_tpu.nn.layers import param_dict, _swap_params
 
@@ -85,6 +85,127 @@ def test_pipeline_grads_match_single_device():
         np.asarray(ref_grads["wte.weight"]), rtol=2e-4, atol=1e-6)
 
 
+def test_3d_composed_mesh_loss_and_grads_match():
+    # dp x tp x pp ACTIVE in ONE mesh: megatron tp inside each pipeline
+    # stage, batch sharded over dp — loss AND grads match single-device
+    model = _model(layers=2)
+    x, y = _batch()
+    mesh = build_mesh(dp=2, tp=2, pp=2, sp=1, devices=jax.devices()[:8])
+    apply_fn, params = build_gpt_pipeline_3d(model, mesh,
+                                             num_microbatches=2)
+    loss3d = jax.jit(apply_fn)(params, x, y)
+    with _swap_params(model, param_dict(model)):
+        ref = model.loss(x, y)
+    np.testing.assert_allclose(float(loss3d), float(ref), rtol=1e-5,
+                               atol=1e-6)
+
+    grads = jax.jit(jax.grad(apply_fn))(params, x, y)
+
+    def ref_loss(flat):
+        with _swap_params(model, flat):
+            return model.loss(x, y)
+
+    ref_grads = jax.grad(ref_loss)(param_dict(model))
+    g = grads["stages"]["attn.q_proj.weight"]      # [pp, per, H, H]
+    g = g.reshape(-1, *g.shape[2:])
+    for layer in range(2):
+        np.testing.assert_allclose(
+            np.asarray(g[layer]),
+            np.asarray(ref_grads[f"blocks.{layer}.attn.q_proj.weight"]),
+            rtol=2e-4, atol=1e-6, err_msg=f"layer {layer}")
+
+
+def test_3d_composed_mesh_tp4():
+    # tp > 2 (the round-2 dryrun capped tp at 2)
+    model = _model(layers=2)
+    x, y = _batch()
+    mesh = build_mesh(dp=1, tp=4, pp=2, sp=1, devices=jax.devices()[:8])
+    apply_fn, params = build_gpt_pipeline_3d(model, mesh,
+                                             num_microbatches=2)
+    loss3d = jax.jit(apply_fn)(params, x, y)
+    with _swap_params(model, param_dict(model)):
+        ref = model.loss(x, y)
+    np.testing.assert_allclose(float(loss3d), float(ref), rtol=1e-5,
+                               atol=1e-6)
+
+
 def test_pipeline_dryrun_entrypoint():
     loss = pipeline_dryrun(4, devices=jax.devices()[:4])
     assert np.isfinite(loss)
+
+
+def test_pipeline_dryrun_pp4_with_dropout():
+    loss = pipeline_dryrun(8, devices=jax.devices()[:8], pp=4,
+                           dropout=0.1)
+    assert np.isfinite(loss)
+
+
+def test_pipeline_dropout_masks_vary_and_average_out():
+    # dropout>0: per-(tick, stage, block) PRNG streams -> two keys give
+    # different losses; many-key average approaches the no-dropout loss
+    # (upscale_in_train keeps expectation equal)
+    model = GPT(GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                          num_heads=4, max_seq_len=16, dropout=0.3))
+    x, y = _batch()
+    mesh = build_mesh(dp=1, tp=1, pp=2, sp=1, devices=jax.devices()[:2])
+    apply_fn, params = build_gpt_pipeline(model, mesh, num_microbatches=2)
+    step = jax.jit(lambda k: apply_fn(params, x, y, rng_key=k))
+
+    l0 = float(step(jax.random.PRNGKey(0)))
+    l1 = float(step(jax.random.PRNGKey(1)))
+    assert l0 != l1                       # different masks
+
+    # deterministic for a fixed key
+    assert float(step(jax.random.PRNGKey(0))) == l0
+
+    ref_model = GPT(GPTConfig(vocab_size=128, hidden_size=32,
+                              num_layers=2, num_heads=4, max_seq_len=16,
+                              dropout=0.0))
+    ref_apply, _ = build_gpt_pipeline(ref_model, mesh,
+                                      num_microbatches=2)
+    ref_loss = float(jax.jit(ref_apply)(params, x, y))
+    mean_loss = np.mean([float(step(jax.random.PRNGKey(k)))
+                         for k in range(8)])
+    assert abs(mean_loss - ref_loss) / ref_loss < 0.25, \
+        (mean_loss, ref_loss)
+
+
+def test_pipeline_dropout_requires_key():
+    model = GPT(GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=4, max_seq_len=8, dropout=0.1))
+    mesh = build_mesh(dp=1, tp=1, pp=2, sp=1, devices=jax.devices()[:2])
+    apply_fn, params = build_gpt_pipeline(model, mesh, num_microbatches=2)
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.integers(0, 64, (4, 8)), jnp.int32)
+    y = jnp.asarray(r.integers(0, 64, (4, 8)), jnp.int32)
+    with pytest.raises(ValueError, match="rng_key"):
+        apply_fn(params, x, y)     # silent mask reuse must be an error
+
+
+def test_pipeline_dropout_trains():
+    # pipelined GPT WITH dropout trains end to end (the reference's
+    # PipelineTrainer trains dropout-bearing models;
+    # framework/pipeline_trainer.cc)
+    model = GPT(GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=4, max_seq_len=8, dropout=0.1))
+    mesh = build_mesh(dp=1, tp=1, pp=2, sp=1, devices=jax.devices()[:2])
+    apply_fn, params = build_gpt_pipeline(model, mesh, num_microbatches=2)
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.integers(0, 64, (4, 8)), jnp.int32)
+    y = jnp.asarray(r.integers(0, 64, (4, 8)), jnp.int32)
+
+    @jax.jit
+    def train_step(params, key):
+        loss, grads = jax.value_and_grad(
+            lambda p: apply_fn(p, x, y, rng_key=key))(params)
+        return jax.tree.map(lambda p, g: p - 0.1 * g, params,
+                            grads), loss
+
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for i in range(30):
+        params, loss = train_step(params, jax.random.fold_in(key, i))
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8, \
+        (losses[:3], losses[-3:])

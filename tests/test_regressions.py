@@ -124,18 +124,22 @@ def test_recompute_checkpoints_still_correct():
     xb = rng.rand(16, 8).astype(np.float32)
     yb = rng.rand(16, 1).astype(np.float32)
     results = []
-    for use_ckpt in (False, True):
-        with fluid.unique_name.guard():
-            main, startup, loss = build(use_ckpt)
-        exe = fluid.Executor()
-        sc = fluid.Scope()
-        fluid.flags.set_flags({"FLAGS_global_seed": 7})
-        exe._root_key = __import__("jax").random.PRNGKey(7)
-        exe.run(startup, scope=sc)
-        for _ in range(5):
-            out = exe.run(main, feed={"x": xb, "y": yb},
-                          fetch_list=[loss], scope=sc)
-        results.append(float(out[0]))
+    old_seed = fluid.flags.flag("global_seed")
+    try:
+        for use_ckpt in (False, True):
+            with fluid.unique_name.guard():
+                main, startup, loss = build(use_ckpt)
+            exe = fluid.Executor()
+            sc = fluid.Scope()
+            fluid.flags.set_flags({"FLAGS_global_seed": 7})
+            exe._root_key = __import__("jax").random.PRNGKey(7)
+            exe.run(startup, scope=sc)
+            for _ in range(5):
+                out = exe.run(main, feed={"x": xb, "y": yb},
+                              fetch_list=[loss], scope=sc)
+            results.append(float(out[0]))
+    finally:
+        fluid.flags.set_flags({"FLAGS_global_seed": old_seed})
     assert results[0] == pytest.approx(results[1], rel=1e-4)
 
 

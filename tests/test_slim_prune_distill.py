@@ -35,6 +35,8 @@ def _make_data(n=512, din=16, classes=4, seed=0):
 
 
 def _classifier_program(din=16, classes=4, hidden=32):
+    # pin init determinism regardless of flags left by earlier tests
+    fluid.flags.set_flags({"FLAGS_global_seed": 0})
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = fluid.data("x", [None, din])
