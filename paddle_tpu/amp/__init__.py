@@ -17,36 +17,80 @@ from .. import flags
 __all__ = ["AutoMixedPrecisionLists", "decorate", "auto_cast",
            "amp_dtype", "CustomOpLists"]
 
-# fp16_lists.py parity
+# fp16_lists.py parity (full reference sets + TPU-relevant additions).
+# WHITE: MXU ops — always worth running in the compute dtype.
 WHITE_LIST = {
     "conv2d", "matmul", "mul", "fc",
+    # TPU additions: the other MXU-bound kernels in this op corpus
+    "conv2d_transpose", "depthwise_conv2d", "conv3d", "matmul_v2",
+    "fused_multihead_matmul",
 }
+# BLACK: numerically fragile reductions/transcendentals — keep fp32.
 BLACK_LIST = {
     "exp", "square", "log", "mean", "sum", "cos_sim",
-    "softmax", "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "softmax", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
     "cross_entropy", "cross_entropy2",
+    # TPU additions in the same fragility class
+    "reduce_mean", "reduce_sum", "log_softmax", "logsumexp",
+    "layer_norm_grad",
 }
+# GRAY: follow their inputs (reference fp16_lists.py gray_list, full)
 GRAY_LIST = {
-    "elementwise_add", "elementwise_sub", "elementwise_mul", "elementwise_div",
-    "elementwise_max", "elementwise_min", "elementwise_pow", "elementwise_mod",
-    "relu", "sigmoid", "tanh", "pool2d", "batch_norm", "layer_norm",
-    "dropout", "reshape2", "transpose2", "concat", "split", "scale", "cast",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "elementwise_mod", "elementwise_floordiv",
+    "batch_norm", "layer_norm", "tanh", "sigmoid", "lookup_table",
+    "top_k", "pool2d", "pool3d", "dropout", "relu", "relu6",
+    "leaky_relu", "soft_relu", "flatten2", "stack", "unstack",
+    "uniform_random_batch_size_like", "gaussian_random",
+    "gaussian_random_batch_size_like", "slice", "rank", "scale",
+    "transpose2", "reshape2", "gather", "fill_constant",
+    "get_tensor_from_selected_rows", "sign", "cast", "concat", "split",
+}
+# ops with no meaningful fp16 kernel (reference unsupported_fp16_list):
+# control flow, IO/distributed transport, integer comparisons, CRF/RNN
+# fusions — never cast, whatever the lists say
+UNSUPPORTED_FP16_LIST = {
+    "send", "send_barrier", "recv", "fetch_barrier", "create_py_reader",
+    "create_double_buffer_reader", "read", "load",
+    "increment", "less_than", "less_equal", "greater_than",
+    "greater_equal", "equal", "not_equal", "read_from_array",
+    "shrink_rnn_memory", "lod_array_length", "logical_and", "logical_or",
+    "logical_xor", "logical_not", "print", "conditional_block", "while",
+    "ifelse", "is_empty",
+    "lstm", "cudnn_lstm", "lstmp", "gru", "gru_unit",
+    "linear_chain_crf", "crf_decoding", "bpr_loss",
 }
 
 
 class AutoMixedPrecisionLists:
-    """Parity: fp16_lists.py AutoMixedPrecisionLists."""
+    """Parity: fp16_lists.py AutoMixedPrecisionLists — custom entries
+    move ops between lists with the reference's precedence (a custom
+    white op leaves black/gray; overlap between the custom lists is an
+    error)."""
 
-    def __init__(self, custom_white_list=None, custom_black_list=None):
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
         self.white_list = set(WHITE_LIST)
         self.black_list = set(BLACK_LIST)
         self.gray_list = set(GRAY_LIST)
-        if custom_white_list:
-            self.white_list |= set(custom_white_list)
-            self.black_list -= set(custom_white_list)
-        if custom_black_list:
-            self.black_list |= set(custom_black_list)
-            self.white_list -= set(custom_black_list)
+        self.unsupported_list = set(UNSUPPORTED_FP16_LIST)
+        self.black_varnames = set(custom_black_varnames or ())
+        overlap = set(custom_white_list or ()) & set(
+            custom_black_list or ())
+        if overlap:
+            raise ValueError(
+                f"Custom white list overlaps custom black list: "
+                f"{sorted(overlap)}")
+        for op in custom_white_list or ():
+            self.black_list.discard(op)
+            self.gray_list.discard(op)
+            self.white_list.add(op)
+        for op in custom_black_list or ():
+            self.white_list.discard(op)
+            self.gray_list.discard(op)
+            self.black_list.add(op)
 
 
 CustomOpLists = AutoMixedPrecisionLists
@@ -87,12 +131,109 @@ def autocast_enabled():
 
 
 def maybe_cast_to_compute(x):
-    """Called by white-list functional ops on their inputs."""
+    """Cast one fp32 value to the AMP compute dtype when autocast is on."""
     if not _autocast_state["enabled"]:
         return x
     if hasattr(x, "dtype") and x.dtype == jnp.float32:
         return x.astype(amp_dtype())
     return x
+
+
+def cast_for_op(op_type, *xs):
+    """List-aware autocast dispatch, called by the eager functional ops:
+    white ops cast fp32 inputs down to the compute dtype, black ops cast
+    low-precision inputs UP to fp32, gray/unsupported pass through.
+    Honors auto_cast(custom_white_list=..., custom_black_list=...)."""
+    st = _autocast_state
+    if not st["enabled"]:
+        return xs if len(xs) > 1 else xs[0]
+    lists = st["lists"] or AutoMixedPrecisionLists()
+    lo = amp_dtype()
+
+    def down(x):
+        if hasattr(x, "dtype") and x.dtype == jnp.float32:
+            return x.astype(lo)
+        return x
+
+    def up(x):
+        if hasattr(x, "dtype") and x.dtype in (jnp.float16, jnp.bfloat16):
+            return x.astype(jnp.float32)
+        return x
+
+    if op_type in lists.white_list:
+        out = tuple(down(x) for x in xs)
+    elif op_type in lists.black_list:
+        out = tuple(up(x) for x in xs)
+    else:
+        out = xs
+    return out if len(out) > 1 else out[0]
+
+
+# -- static-graph rewrite (fp16_utils.py:51 rewrite_program parity) ----------
+
+def rewrite_program(program, amp_lists=None, dest_dtype=None):
+    """Insert cast ops so white-list ops compute in the AMP dtype and
+    black-list ops stay fp32 — the reference's rewrite_program
+    (fp16_utils.py:51/156) on this Program IR.  Parameters feeding
+    white ops are cast at use (fp32 master weights stay in scope).
+    Apply BEFORE minimize()/append_backward, like the quantization
+    pass; autodiff then differentiates through the casts."""
+    from ..framework.program import Operator
+
+    lists = amp_lists or AutoMixedPrecisionLists()
+    dest = dest_dtype or ("bfloat16" if flags.flag("amp_dtype") ==
+                          "bfloat16" else "float16")
+    if program.backward_sections:
+        raise ValueError(
+            "apply amp.rewrite_program before minimize()/append_backward")
+    block = program.global_block()
+    new_ops = []
+    casted = {}       # (var, dtype) -> cast-output name
+    n = [0]
+
+    def cast_in(name, to):
+        key = (name, to)
+        if key not in casted:
+            n[0] += 1
+            out = f"{name}.cast_{to}_{n[0]}"
+            block.create_var(name=out, dtype=to)
+            new_ops.append(Operator(
+                block, "cast", {"X": [name]}, {"Out": [out]},
+                {"in_dtype": None, "out_dtype": to}))
+            casted[key] = out
+        return casted[key]
+
+    for op in block.ops:
+        if op.type in lists.white_list:
+            to = dest
+        elif op.type in lists.black_list:
+            to = "float32"
+        else:
+            new_ops.append(op)
+            continue
+        ins = {}
+        for slot, names in op.inputs.items():
+            out_names = []
+            for vn in names:
+                v = block._find_var_recursive(vn)
+                is_float = v is not None and str(
+                    getattr(v, "dtype", "")).endswith(
+                        ("float32", "float16", "bfloat16"))
+                if is_float and vn not in lists.black_varnames:
+                    out_names.append(cast_in(vn, to))
+                else:
+                    out_names.append(vn)
+            ins[slot] = out_names
+        new_ops.append(Operator(block, op.type, None, None, op.attrs))
+        new_ops[-1].inputs = ins
+        new_ops[-1].outputs = op.outputs
+        # downstream consumers see the op's declared output dtype; the
+        # interpreter propagates actual array dtypes, so no output cast
+        # is needed until a black op pins fp32 again
+    block.ops[:] = new_ops
+    program.amp_enabled = True
+    program._bump()
+    return program
 
 
 # -- static-graph decorate ---------------------------------------------------

@@ -1,11 +1,25 @@
-"""Stock datasets.
+"""`paddle.dataset` compatibility surface.
 
-Parity: /root/reference/python/paddle/dataset/ (mnist, uci_housing, ...).
-No network egress is assumed: datasets are deterministic synthetic stand-ins
-with the same shapes/dtypes/reader API as the reference, sufficient for the
-book-style convergence tests (tests/book/) which only need learnable
-structure, not real data.
+The stock dataset zoo lives in `paddle_tpu.datasets` (ONE
+implementation — this module aliases it so both reference import paths,
+`paddle.dataset.mnist`-style and the plural `datasets` package, resolve
+to the same modules).  The industrial tabular feeds (DatasetFactory /
+InMemoryDataset / QueueDataset, parity fluid/dataset.py:22) live in
+`datasets.multislot` and are re-exported here.
 """
 
-from . import mnist, uci_housing  # noqa: F401
-from .multislot import DatasetFactory, InMemoryDataset, QueueDataset  # noqa: F401
+import sys as _sys
+
+from ..datasets import (cifar, conll05, imdb, mnist, movielens,  # noqa: F401
+                        multislot, uci_housing, wmt14)
+from ..datasets.multislot import (DatasetFactory, InMemoryDataset,  # noqa: F401
+                                  QueueDataset)
+
+# make `import paddle_tpu.dataset.mnist`-style submodule imports resolve
+for _name in ("mnist", "cifar", "uci_housing", "imdb", "movielens",
+              "conll05", "wmt14", "multislot"):
+    _sys.modules[__name__ + "." + _name] = globals()[_name]
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "movielens",
+           "conll05", "wmt14", "multislot", "DatasetFactory",
+           "InMemoryDataset", "QueueDataset"]

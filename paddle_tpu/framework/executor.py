@@ -663,13 +663,16 @@ class Executor:
             e["_grad"] = e["emb_var"] + "@GRAD"
 
         if prefetch is None:
-            # auto: overlap unless a table has strict sync semantics
-            # (a plain SparseEmbedding, or a SYNC-mode Communicator).
+            # auto: overlap only where concurrent pull/push is already
+            # the table's contract — async/half_async Communicators push
+            # from their own background thread (locked shards). geo
+            # flushes on the CALLING thread, and plain SparseEmbedding
+            # is strictly synchronous: both stay un-overlapped.
             # Read-only draining (infer_from_dataset) never pushes, so
             # it has no ordering constraint at all.
             def _is_async(e):
                 mode = getattr(e["table"], "mode", None)
-                return mode in ("async", "half_async", "geo")
+                return mode in ("async", "half_async")
 
             prefetch = (not _sparse_push
                         or all(_is_async(e) for e in entries))

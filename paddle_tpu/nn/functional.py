@@ -75,19 +75,32 @@ def hard_sigmoid(x):
 
 
 def softmax(x, axis=-1):
-    return jax.nn.softmax(_val(x), axis=axis)
+    x = _amp_cast("softmax", _val(x))
+    return jax.nn.softmax(x, axis=axis)
 
 
 def log_softmax(x, axis=-1):
-    return jax.nn.log_softmax(_val(x), axis=axis)
+    x = _amp_cast("log_softmax", _val(x))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def _amp_cast(op_type, *xs):
+    """Autocast hook: list-aware dispatch under amp.auto_cast (white ops
+    run in the compute dtype, black ops are protected back to fp32)."""
+    from ..amp import autocast_enabled, cast_for_op
+
+    if not autocast_enabled():
+        return xs if len(xs) > 1 else xs[0]
+    return cast_for_op(op_type, *xs)
 
 
 # -- linear / conv / pool ---------------------------------------------------
 
 def linear(x, weight, bias=None):
-    out = _val(x) @ _val(weight)
+    xv, wv = _amp_cast("matmul", _val(x), _val(weight))
+    out = xv @ wv
     if bias is not None:
-        out = out + _val(bias)
+        out = out + _val(bias).astype(out.dtype)
     return out
 
 
@@ -100,9 +113,10 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         "groups": groups,
         "data_format": data_format,
     }
-    out = _n.conv2d({"Input": _val(x), "Filter": _val(weight)}, attrs)["Output"]
+    xv, wv = _amp_cast("conv2d", _val(x), _val(weight))
+    out = _n.conv2d({"Input": xv, "Filter": wv}, attrs)["Output"]
     if bias is not None:
-        b = _val(bias)
+        b = _val(bias).astype(out.dtype)
         bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
         out = out + b.reshape(bshape)
     return out
@@ -204,8 +218,9 @@ def cross_entropy(input, label, soft_label=False, axis=-1, reduction="mean",
                   ignore_index=-100):
     """Logits-based CE (softmax fused), matching the reference's
     softmax_with_cross_entropy kernel."""
+    logits = _amp_cast("softmax_with_cross_entropy", _val(input))
     out = _n.softmax_with_cross_entropy(
-        {"Logits": _val(input), "Label": _val(label)},
+        {"Logits": logits, "Label": _val(label)},
         {"soft_label": soft_label, "axis": axis,
          "ignore_index": ignore_index})["Loss"]
     if reduction == "mean":

@@ -153,17 +153,27 @@ class DataLoader:
     Iterating yields dicts name->np.ndarray ready to pass as `feed`.
     """
 
-    def __init__(self, feed_list=None, capacity=4, iterable=True):
+    def __init__(self, feed_list=None, capacity=4, iterable=True,
+                 use_multiprocess=False, num_workers=2):
         self._feed_names = [
             v.name if hasattr(v, "name") else v for v in (feed_list or [])
         ]
         self._capacity = capacity
         self._batch_reader = None
+        self._use_multiprocess = use_multiprocess
+        self._num_workers = num_workers
 
     @staticmethod
     def from_generator(feed_list=None, capacity=4, iterable=True,
-                       return_list=False, use_double_buffer=True):
-        return DataLoader(feed_list, capacity, iterable)
+                       return_list=False, use_double_buffer=True,
+                       use_multiprocess=False, num_workers=2):
+        """use_multiprocess=True engages worker processes + shared-memory
+        transport (reader.py:469 DygraphGeneratorLoader parity) instead
+        of the background thread — the GIL-free path for CPU-bound
+        python readers."""
+        return DataLoader(feed_list, capacity, iterable,
+                          use_multiprocess=use_multiprocess,
+                          num_workers=num_workers)
 
     def set_batch_generator(self, reader, places=None):
         self._batch_reader = reader
@@ -185,11 +195,38 @@ class DataLoader:
     def __iter__(self):
         if self._batch_reader is None:
             raise RuntimeError("no generator set on DataLoader")
+        if self._use_multiprocess:
+            from .shm import ShmBatchLoader
+
+            def sharded(worker_id, num_workers):
+                return self._gen_feed_dicts(worker_id, num_workers)
+
+            return iter(ShmBatchLoader(sharded,
+                                       num_workers=self._num_workers,
+                                       capacity=self._capacity))
         prefetched = buffered(self._gen_feed_dicts, self._capacity)
         return iter(prefetched())
 
-    def _gen_feed_dicts(self):
-        for item in self._batch_reader():
+    def _gen_feed_dicts(self, worker_id=None, num_workers=None):
+        import inspect
+        import itertools
+
+        reader = self._batch_reader
+        if worker_id is None:
+            items = reader()
+        else:
+            # multiprocess path: pass the shard through when the user's
+            # reader is shard-aware, else round-robin islice (order
+            # preserved; see ShmBatchLoader doc for the cost model)
+            try:
+                shard_aware = len(
+                    inspect.signature(reader).parameters) >= 2
+            except (TypeError, ValueError):
+                shard_aware = False
+            items = (reader(worker_id, num_workers) if shard_aware
+                     else itertools.islice(reader(), worker_id, None,
+                                           num_workers))
+        for item in items:
             if isinstance(item, dict):
                 yield item
             elif isinstance(item, (list, tuple)) and self._feed_names:

@@ -1,0 +1,225 @@
+"""Multiprocess shared-memory data loading.
+
+Parity: /root/reference/python/paddle/fluid/reader.py:469
+DygraphGeneratorLoader (use_multiprocess=True) over
+memory/allocation/mmap_allocator.cc — worker PROCESSES prepare batches
+and hand them to the trainer through shared memory, sidestepping both
+the GIL (thread loaders serialize CPU-bound python readers) and pickle
+(arrays move as raw bytes in a SharedMemory segment).
+
+Design: worker i round-robins the batch stream (batches i, i+N,
+i+2N, ...), writes each batch's arrays back-to-back into one
+SharedMemory segment, and queues (segment name, per-array metadata).
+The consumer reads queues round-robin so batch ORDER MATCHES the serial
+reader, copies the arrays out (one memcpy — the same cost the
+reference's LoDTensor shared-mem copy pays), and unlinks the segment
+immediately, so segment lifetime is one batch.
+
+Cleanup mirrors the reference's signal-handler story
+(reader.py:469 _set_process_signal_handler): workers install
+terminate-on-SIGTERM handlers, the parent tracks live segment names and
+unlinks them on iterator close/GC/atexit, and python's own
+resource_tracker backstops anything that leaks.
+"""
+
+import atexit
+import itertools
+import multiprocessing as mp
+import signal
+import traceback
+from multiprocessing import shared_memory
+
+import numpy as np
+
+__all__ = ["ShmBatchLoader"]
+
+_END = "__end__"
+_ERR = "__err__"
+
+# segment names handed to the parent but not yet unlinked; one process-
+# wide registry + atexit hook (per-instance hooks would pin loaders)
+_LIVE_SEGMENTS = set()
+
+
+def _cleanup_segments():
+    for name in list(_LIVE_SEGMENTS):
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+        _LIVE_SEGMENTS.discard(name)
+
+
+atexit.register(_cleanup_segments)
+
+
+def _worker_main(batch_reader, worker_id, num_workers, sharded, q,
+                 capacity_sem):
+    signal.signal(signal.SIGTERM, lambda *a: exit(0))
+    try:
+        if sharded:
+            # shard-aware reader: each worker generates ONLY its batches
+            it = batch_reader(worker_id, num_workers)
+        else:
+            # plain generator: islice re-evaluates skipped batches, so
+            # >1 worker on an expensive plain reader does duplicate
+            # work — callers wanting real parallel speedup pass a
+            # (worker_id, num_workers) factory (see ShmBatchLoader doc)
+            it = itertools.islice(batch_reader(), worker_id, None,
+                                  num_workers)
+        for batch in it:
+            arrays = _normalize(batch)
+            total = sum(a.nbytes for _, a in arrays)
+            capacity_sem.acquire()      # bound in-flight shared memory
+            seg = shared_memory.SharedMemory(create=True,
+                                             size=max(total, 1))
+            meta = []
+            off = 0
+            for name, a in arrays:
+                seg.buf[off:off + a.nbytes] = a.tobytes()
+                meta.append((name, str(a.dtype), a.shape, off))
+                off += a.nbytes
+            q.put((seg.name, meta))
+            seg.close()                 # parent unlinks after copying
+            try:
+                # ownership moves to the parent: stop this process's
+                # resource tracker from warning about (or double-
+                # unlinking) the segment at exit
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister("/" + seg.name,
+                                            "shared_memory")
+            except Exception:
+                pass
+        q.put((_END, worker_id))
+    except BaseException:
+        q.put((_ERR, traceback.format_exc()))
+
+
+def _normalize(batch):
+    if isinstance(batch, dict):
+        return [(k, np.ascontiguousarray(v)) for k, v in batch.items()]
+    if isinstance(batch, (list, tuple)):
+        return [(str(i), np.ascontiguousarray(v))
+                for i, v in enumerate(batch)]
+    return [("0", np.ascontiguousarray(batch))]
+
+
+class ShmBatchLoader:
+    """Iterate a batch reader with `num_workers` worker processes and
+    shared-memory transport.  Yields whatever shape the reader yields
+    (dict -> dict, tuple/list -> list), batches in serial order.
+
+    Two reader forms:
+      reader()                      -> plain generator.  One worker
+        decouples reader CPU time from the train loop (the reference's
+        DygraphGeneratorLoader shape); more workers preserve order via
+        round-robin islice but re-run the generator per worker, so they
+        only help when per-batch cost is in the YIELDED work.
+      reader(worker_id, num_workers) -> shard-aware factory.  Each
+        worker generates only batches worker_id, worker_id+N, ... —
+        N-way parallel CPU speedup with order still guaranteed.
+    """
+
+    def __init__(self, batch_reader, num_workers=2, capacity=4,
+                 mp_context=None):
+        import inspect
+
+        assert num_workers >= 1
+        self._reader = batch_reader
+        try:
+            n_params = len(inspect.signature(batch_reader).parameters)
+        except (TypeError, ValueError):
+            n_params = 0
+        self._sharded = n_params >= 2
+        self._num_workers = num_workers
+        self._capacity = capacity
+        # fork: generators/closures pass to children for free (the
+        # reference's loader forks too); children only touch numpy
+        self._ctx = mp.get_context(mp_context or "fork")
+        # module-level registry + one atexit hook: per-instance
+        # registration would pin every epoch's loader alive forever
+        self._live_segments = _LIVE_SEGMENTS
+
+    def _cleanup_segments(self):
+        _cleanup_segments()
+
+    def __iter__(self):
+        n = self._num_workers
+        queues = [self._ctx.Queue() for _ in range(n)]
+        sems = [self._ctx.Semaphore(max(1, self._capacity // n))
+                for _ in range(n)]
+        procs = [
+            self._ctx.Process(
+                target=_worker_main,
+                args=(self._reader, i, n, self._sharded, queues[i],
+                      sems[i]),
+                daemon=True)
+            for i in range(n)
+        ]
+        for p in procs:
+            p.start()
+        try:
+            # round-robin keeps serial order for round-robin-sharded
+            # streams; a finished worker leaves the rotation so uneven
+            # shard-aware readers (e.g. sharded by file) still drain
+            # every batch instead of truncating at the first END
+            active = list(range(n))
+            pos = 0
+            while active:
+                i = active[pos % len(active)]
+                item = queues[i].get()
+                if item[0] == _END:
+                    active.remove(i)
+                    continue
+                if item[0] == _ERR:
+                    raise RuntimeError(
+                        f"multiprocess DataLoader worker failed:\n"
+                        f"{item[1]}")
+                seg_name, meta = item
+                self._live_segments.add(seg_name)
+                yield self._materialize(seg_name, meta)
+                sems[i].release()
+                pos += 1
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+            # drain queues so no segment leaks
+            for q in queues:
+                try:
+                    while True:
+                        item = q.get_nowait()
+                        if item and item[0] not in (_END, _ERR):
+                            self._live_segments.add(item[0])
+                except Exception:
+                    pass
+            self._cleanup_segments()
+
+    def _materialize(self, seg_name, meta):
+        seg = shared_memory.SharedMemory(name=seg_name)
+        try:
+            out = {}
+            for name, dtype, shape, off in meta:
+                nbytes = int(np.prod(shape, dtype=np.int64)) \
+                    * np.dtype(dtype).itemsize
+                # bytes() copies without exporting a live view of the
+                # segment buffer (a frombuffer view would pin it open)
+                raw = bytes(seg.buf[off:off + nbytes])
+                out[name] = np.frombuffer(raw,
+                                          dtype=dtype).reshape(shape)
+            keys = list(out)
+            if keys == [str(i) for i in range(len(keys))]:
+                return [out[k] for k in keys]   # tuple/list reader
+            return out
+        finally:
+            seg.close()
+            try:
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+            self._live_segments.discard(seg_name)

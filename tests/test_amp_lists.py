@@ -1,0 +1,89 @@
+"""AMP list wiring: the white/black lists must actually steer dtypes.
+
+Parity: contrib/mixed_precision/fp16_lists.py (list semantics) and
+fp16_utils.py rewrite_program (static cast insertion).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import amp, layers
+from paddle_tpu.amp import AutoMixedPrecisionLists, auto_cast, rewrite_program
+from paddle_tpu.nn import functional as F
+
+
+def test_custom_list_overlap_rejected():
+    with pytest.raises(ValueError, match="overlap"):
+        AutoMixedPrecisionLists(custom_white_list=["softmax"],
+                                custom_black_list=["softmax"])
+
+
+def test_custom_lists_move_ops():
+    lists = AutoMixedPrecisionLists(custom_white_list=["softmax"],
+                                    custom_black_list=["matmul"])
+    assert "softmax" in lists.white_list
+    assert "softmax" not in lists.black_list
+    assert "matmul" in lists.black_list
+    assert "matmul" not in lists.white_list
+
+
+def test_eager_autocast_white_op_computes_low_precision():
+    x = jnp.ones((4, 8), jnp.float32)
+    w = jnp.ones((8, 2), jnp.float32)
+    assert F.linear(x, w).dtype == jnp.float32     # no context
+    with auto_cast(enable=True):
+        assert F.linear(x, w).dtype == amp.amp_dtype()
+
+
+def test_eager_autocast_black_op_stays_fp32():
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    with auto_cast(enable=True):
+        out = F.softmax(x)
+    assert out.dtype == jnp.float32                # protected upcast
+
+
+def test_eager_custom_black_list_disables_cast():
+    x = jnp.ones((4, 8), jnp.float32)
+    w = jnp.ones((8, 2), jnp.float32)
+    with auto_cast(enable=True, custom_black_list=["matmul"]):
+        assert F.linear(x, w).dtype == jnp.float32
+
+
+def test_static_rewrite_program_inserts_casts_and_trains():
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [None, 16])
+            y = fluid.data("y", [None, 1], dtype="int64")
+            h = fluid.layers.fc(x, 32, act="relu")
+            logits = fluid.layers.fc(h, 4)
+            rewrite_program(main)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        casts = [op for op in main.global_block().ops
+                 if op.type == "cast"]
+        assert casts, "rewrite_program inserted no cast ops"
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        xb = rng.normal(size=(32, 16)).astype(np.float32)
+        yb = rng.integers(0, 4, (32, 1)).astype(np.int64)
+        losses = [float(exe.run(main, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])[0])
+                  for _ in range(20)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_static_rewrite_rejects_built_backward():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        loss = layers.mean(fluid.layers.fc(x, 1))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    with pytest.raises(ValueError, match="before minimize"):
+        rewrite_program(main)

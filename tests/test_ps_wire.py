@@ -168,17 +168,21 @@ def test_prefetch_dense_matches_unprefetched():
     import paddle_tpu as fluid
 
     results = {}
-    for pf in (False, True):
-        with fluid.scope_guard(fluid.Scope()):
-            fluid.flags.set_flags({"FLAGS_global_seed": 0})
-            with fluid.unique_name.guard():
-                main, startup, loss = _linreg_program()
-            exe = fluid.Executor()
-            exe.run(startup)
-            out = exe.train_from_dataset(
-                main, _slow_dataset(6, 0.0), fetch_list=[loss],
-                print_period=1000, prefetch=pf)
-            results[pf] = float(out[0])
+    old_seed = fluid.flags.flag("global_seed")
+    try:
+        for pf in (False, True):
+            with fluid.scope_guard(fluid.Scope()):
+                fluid.flags.set_flags({"FLAGS_global_seed": 0})
+                with fluid.unique_name.guard():
+                    main, startup, loss = _linreg_program()
+                exe = fluid.Executor()
+                exe.run(startup)
+                out = exe.train_from_dataset(
+                    main, _slow_dataset(6, 0.0), fetch_list=[loss],
+                    print_period=1000, prefetch=pf)
+                results[pf] = float(out[0])
+    finally:
+        fluid.flags.set_flags({"FLAGS_global_seed": old_seed})
     assert results[False] == pytest.approx(results[True], rel=1e-6)
 
 

@@ -1,0 +1,191 @@
+"""Multiprocess shared-memory DataLoader tests.
+
+Parity target: fluid/reader.py:469 DygraphGeneratorLoader
+(use_multiprocess=True) — worker processes + shared-memory queue.
+Key assertions: batch ORDER matches the serial reader, worker crashes
+propagate, no shared-memory segments leak, and >1 worker beats the
+threaded loader on a CPU-bound (GIL-bound) reader.
+"""
+
+import glob
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.reader import DataLoader
+from paddle_tpu.reader.shm import ShmBatchLoader
+
+
+def _batches(n=8, size=256, seed=1):
+    def reader():
+        rng = np.random.default_rng(seed)
+        for i in range(n):
+            yield {"x": rng.normal(size=(size,)).astype(np.float32),
+                   "i": np.array([i], np.int64)}
+
+    return reader
+
+
+def test_order_and_values_match_serial():
+    reader = _batches()
+    serial = list(reader())
+    for workers in (1, 2, 3):
+        got = list(ShmBatchLoader(reader, num_workers=workers))
+        assert len(got) == len(serial)
+        for a, b in zip(got, serial):
+            assert int(a["i"][0]) == int(b["i"][0])   # order preserved
+            np.testing.assert_array_equal(a["x"], b["x"])
+
+
+def test_tuple_batches_roundtrip():
+    def reader():
+        for i in range(4):
+            yield (np.full((3,), i, np.float32), np.array([i]))
+
+    got = list(ShmBatchLoader(reader, num_workers=2))
+    assert len(got) == 4
+    for i, item in enumerate(got):
+        assert isinstance(item, list)
+        np.testing.assert_array_equal(item[0], np.full((3,), i,
+                                                       np.float32))
+
+
+def test_worker_error_propagates():
+    def reader():
+        yield {"x": np.zeros(4, np.float32)}
+        raise ValueError("reader blew up in worker")
+
+    with pytest.raises(RuntimeError, match="reader blew up"):
+        list(ShmBatchLoader(reader, num_workers=2))
+
+
+def test_no_segment_leak():
+    from paddle_tpu.reader import shm as shm_mod
+
+    loader = ShmBatchLoader(_batches(n=6), num_workers=2)
+    for _ in range(2):
+        list(loader)
+    assert not shm_mod._LIVE_SEGMENTS
+    # early consumer exit must also clean up
+    it = iter(ShmBatchLoader(_batches(n=6), num_workers=2))
+    next(it)
+    it.close()
+    time.sleep(0.2)
+    assert not shm_mod._LIVE_SEGMENTS
+
+
+def test_uneven_shard_aware_reader_drains_fully():
+    # worker 0: 2 batches, worker 1: 5 batches — nothing may be dropped
+    def reader(worker_id, num_workers):
+        counts = [2, 5]
+        for j in range(counts[worker_id]):
+            yield {"w": np.array([worker_id], np.int64),
+                   "j": np.array([j], np.int64)}
+
+    got = [(int(b["w"][0]), int(b["j"][0]))
+           for b in ShmBatchLoader(reader, num_workers=2)]
+    assert sorted(got) == sorted(
+        [(0, j) for j in range(2)] + [(1, j) for j in range(5)])
+
+
+def test_dataloader_multiprocess_integration():
+    x_data = np.arange(32, dtype=np.float32).reshape(8, 4)
+
+    def reader():
+        for i in range(8):
+            yield {"x": x_data[i:i + 1]}
+
+    loader = DataLoader.from_generator(use_multiprocess=True,
+                                       num_workers=2)
+    loader.set_batch_generator(reader)
+    got = np.concatenate([b["x"] for b in loader])
+    np.testing.assert_array_equal(got, x_data)
+
+
+def _cpu_batch(i, iters):
+    # pure-python loop: holds the GIL, so thread loaders cannot
+    # parallelize it (~50ms/batch)
+    acc = 0.0
+    for j in range(iters):
+        acc += (j * 2654435761 % 97) * 1e-9
+    return {"x": np.full((4,), np.float32(acc + i))}
+
+
+def _cpu_bound_reader(n=9, iters=600000):
+    def reader():
+        for i in range(n):
+            yield _cpu_batch(i, iters)
+
+    return reader
+
+
+def _cpu_bound_sharded(n=9, iters=600000):
+    # shard-aware form: worker w generates only batches w, w+N, ...
+    def reader(worker_id, num_workers):
+        for i in range(worker_id, n, num_workers):
+            yield _cpu_batch(i, iters)
+
+    return reader
+
+
+def test_multiprocess_beats_threaded_on_cpu_bound_reader():
+    # threaded loader: background thread + GIL -> serialized with the
+    # consumer, so wall time ~= total reader time
+    t0 = time.perf_counter()
+    threaded = DataLoader.from_generator(capacity=4)
+    threaded.set_batch_generator(_cpu_bound_reader())
+    serial = list(threaded)
+    t_threaded = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    shm = DataLoader.from_generator(use_multiprocess=True, num_workers=3,
+                                    capacity=6)
+    shm.set_batch_generator(_cpu_bound_sharded())
+    got = list(shm)
+    t_shm = time.perf_counter() - t0
+
+    assert len(serial) == len(got)
+    for a, b in zip(serial, got):       # same order, same values
+        np.testing.assert_array_equal(a["x"], b["x"])
+    import os
+
+    if len(os.sched_getaffinity(0)) >= 2:
+        # 3 worker processes on GIL-bound work: require a real speedup
+        # (conservative 1.3x; typically ~2.5x). On a single-core box
+        # parallel speedup is physically impossible — only assert the
+        # process path does not collapse.
+        assert t_shm * 1.3 < t_threaded, (t_shm, t_threaded)
+    else:
+        assert t_shm < t_threaded * 1.5, (t_shm, t_threaded)
+
+
+def test_feeds_static_training():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [None, 4])
+            y = fluid.data("y", [None, 1])
+            loss = layers.mean(layers.square_error_cost(
+                fluid.layers.fc(x, 1), y))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        def reader():
+            rng = np.random.default_rng(0)
+            w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+            for _ in range(20):
+                xb = rng.normal(size=(16, 4)).astype(np.float32)
+                yield {"x": xb, "y": xb @ w}
+
+        loader = DataLoader.from_generator(use_multiprocess=True,
+                                           num_workers=2)
+        loader.set_batch_generator(reader)
+        losses = [float(exe.run(main, feed=b, fetch_list=[loss])[0])
+                  for b in loader]
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])

@@ -34,9 +34,17 @@ def _make_data(n=512, din=16, classes=4, seed=0):
     return x.astype(np.float32), y.astype(np.int64).reshape(-1, 1)
 
 
-def _classifier_program(din=16, classes=4, hidden=32):
-    # pin init determinism regardless of flags left by earlier tests
+@pytest.fixture(autouse=True)
+def _pinned_seed():
+    # pin init determinism regardless of flags left by earlier tests,
+    # and restore afterwards so this module leaks nothing either
+    old = fluid.flags.flag("global_seed")
     fluid.flags.set_flags({"FLAGS_global_seed": 0})
+    yield
+    fluid.flags.set_flags({"FLAGS_global_seed": old})
+
+
+def _classifier_program(din=16, classes=4, hidden=32):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = fluid.data("x", [None, din])
