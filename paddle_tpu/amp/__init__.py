@@ -84,6 +84,10 @@ class AutoMixedPrecisionLists:
                 f"Custom white list overlaps custom black list: "
                 f"{sorted(overlap)}")
         for op in custom_white_list or ():
+            if op in self.unsupported_list:
+                raise ValueError(
+                    f"op {op!r} has no fp16 kernel (unsupported list) "
+                    f"and cannot be white-listed")
             self.black_list.discard(op)
             self.gray_list.discard(op)
             self.white_list.add(op)
@@ -160,7 +164,9 @@ def cast_for_op(op_type, *xs):
             return x.astype(jnp.float32)
         return x
 
-    if op_type in lists.white_list:
+    if op_type in lists.unsupported_list:
+        out = xs                       # never cast, whatever the lists say
+    elif op_type in lists.white_list:
         out = tuple(down(x) for x in xs)
     elif op_type in lists.black_list:
         out = tuple(up(x) for x in xs)
@@ -204,6 +210,9 @@ def rewrite_program(program, amp_lists=None, dest_dtype=None):
         return casted[key]
 
     for op in block.ops:
+        if op.type in lists.unsupported_list:
+            new_ops.append(op)         # never cast these
+            continue
         if op.type in lists.white_list:
             to = dest
         elif op.type in lists.black_list:

@@ -38,10 +38,31 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-DEFAULT_BLOCK_Q = 1024
-DEFAULT_BLOCK_K = 1024
+# 512x512 tiles: the f32 score block is 1 MB (vs 4 MB at 1024^2),
+# leaving VMEM for double-buffered k/v DMA at head_dim 64-256, and a
+# seq-2048 call gets a 4-step k loop for DMA/compute overlap instead of
+# 2. Override per-call via flash_attention(block_q=..., block_k=...) or
+# globally via PADDLE_TPU_FLASH_BLOCK=<q>x<k> for on-chip A/B runs.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 _LANES = 128
 NEG_INF = -1e30
+
+
+def _env_blocks():
+    import os
+
+    v = os.environ.get("PADDLE_TPU_FLASH_BLOCK")
+    if not v:
+        return None
+    try:
+        bq, _, bk = v.partition("x")
+        return int(bq), int(bk or bq)
+    except ValueError:
+        raise ValueError(
+            f"PADDLE_TPU_FLASH_BLOCK={v!r} is malformed; expected "
+            f"'<block_q>x<block_k>' (e.g. 512x512) or a single size"
+        ) from None
 
 
 def _vmem_spec(*args):
@@ -348,7 +369,7 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
     """Tiled attention over [batch, heads, seq, head_dim] inputs.
 
     seq must be a multiple of the block sizes (default DEFAULT_BLOCK_Q/
-    DEFAULT_BLOCK_K = 1024, auto-shrunk to a power-of-two divisor of
+    DEFAULT_BLOCK_K = 512, auto-shrunk to a power-of-two divisor of
     seq); head_dim should be an MXU-friendly 64/128/256. Returns the same
     shape/dtype as q.
     """
@@ -364,8 +385,10 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
                 return cand
         return s
 
-    block_q = block_q or _auto_block(DEFAULT_BLOCK_Q)
-    block_k = block_k or _auto_block(DEFAULT_BLOCK_K)
+    env = _env_blocks()
+    dq, dk = env if env else (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    block_q = block_q or _auto_block(dq)
+    block_k = block_k or _auto_block(dk)
     block_q, block_k = min(block_q, s), min(block_k, s)
     if s % block_q or s % block_k:
         raise ValueError(

@@ -16,12 +16,14 @@ from .. import nn
 
 class ConvBNLayer(nn.Layer):
     def __init__(self, in_ch, out_ch, filter_size, stride=1, groups=1,
-                 act=None, dtype="float32"):
+                 act=None, data_format="NCHW", dtype="float32"):
         super().__init__(dtype=dtype)
         self.conv = nn.Conv2D(in_ch, out_ch, filter_size, stride=stride,
                               padding=(filter_size - 1) // 2, groups=groups,
-                              bias_attr=False, dtype=dtype)
-        self.bn = nn.BatchNorm(out_ch, act=act, dtype=dtype)
+                              bias_attr=False, data_format=data_format,
+                              dtype=dtype)
+        self.bn = nn.BatchNorm(out_ch, act=act, data_format=data_format,
+                               dtype=dtype)
 
     def forward(self, x):
         return self.bn(self.conv(x))
@@ -30,13 +32,16 @@ class ConvBNLayer(nn.Layer):
 class BasicBlock(nn.Layer):
     expansion = 1
 
-    def __init__(self, in_ch, ch, stride=1, dtype="float32"):
+    def __init__(self, in_ch, ch, stride=1, data_format="NCHW",
+                 dtype="float32"):
         super().__init__(dtype=dtype)
+        df = data_format
         self.conv0 = ConvBNLayer(in_ch, ch, 3, stride=stride, act="relu",
-                                 dtype=dtype)
-        self.conv1 = ConvBNLayer(ch, ch, 3, dtype=dtype)
+                                 data_format=df, dtype=dtype)
+        self.conv1 = ConvBNLayer(ch, ch, 3, data_format=df, dtype=dtype)
         self.short = (None if stride == 1 and in_ch == ch else
-                      ConvBNLayer(in_ch, ch, 1, stride=stride, dtype=dtype))
+                      ConvBNLayer(in_ch, ch, 1, stride=stride,
+                                  data_format=df, dtype=dtype))
         self.relu = nn.ReLU()
 
     def forward(self, x):
@@ -48,15 +53,18 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, in_ch, ch, stride=1, dtype="float32"):
+    def __init__(self, in_ch, ch, stride=1, data_format="NCHW",
+                 dtype="float32"):
         super().__init__(dtype=dtype)
-        self.conv0 = ConvBNLayer(in_ch, ch, 1, act="relu", dtype=dtype)
-        self.conv1 = ConvBNLayer(ch, ch, 3, stride=stride, act="relu",
+        df = data_format
+        self.conv0 = ConvBNLayer(in_ch, ch, 1, act="relu", data_format=df,
                                  dtype=dtype)
-        self.conv2 = ConvBNLayer(ch, ch * 4, 1, dtype=dtype)
+        self.conv1 = ConvBNLayer(ch, ch, 3, stride=stride, act="relu",
+                                 data_format=df, dtype=dtype)
+        self.conv2 = ConvBNLayer(ch, ch * 4, 1, data_format=df, dtype=dtype)
         self.short = (None if stride == 1 and in_ch == ch * 4 else
                       ConvBNLayer(in_ch, ch * 4, 1, stride=stride,
-                                  dtype=dtype))
+                                  data_format=df, dtype=dtype))
         self.relu = nn.ReLU()
 
     def forward(self, x):
@@ -66,25 +74,35 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
+    """data_format="NHWC" runs the whole conv stack channels-last (the
+    MXU-preferred layout — no XLA relayout transposes); the input API
+    stays NCHW with ONE transpose at the stem."""
+
     def __init__(self, block, depths, num_classes=1000, in_ch=3,
-                 dtype="float32"):
+                 data_format="NCHW", dtype="float32"):
         super().__init__(dtype=dtype)
+        self._data_format = data_format
         self.stem = ConvBNLayer(in_ch, 64, 7, stride=2, act="relu",
-                                dtype=dtype)
-        self.pool = nn.MaxPool2D(3, 2, padding=1)
+                                data_format=data_format, dtype=dtype)
+        self.pool = nn.MaxPool2D(3, 2, padding=1,
+                                 data_format=data_format)
         chans = [64, 128, 256, 512]
         blocks = []
         prev = 64
         for stage, (ch, depth) in enumerate(zip(chans, depths)):
             for i in range(depth):
                 stride = 2 if i == 0 and stage > 0 else 1
-                blocks.append(block(prev, ch, stride=stride, dtype=dtype))
+                blocks.append(block(prev, ch, stride=stride,
+                                    data_format=data_format, dtype=dtype))
                 prev = ch * block.expansion
         self.blocks = nn.LayerList(blocks)
-        self.global_pool = nn.Pool2D(pool_type="avg", global_pooling=True)
+        self.global_pool = nn.Pool2D(pool_type="avg", global_pooling=True,
+                                     data_format=data_format)
         self.fc = nn.Linear(prev, num_classes, dtype=dtype)
 
     def forward(self, x):
+        if self._data_format == "NHWC":
+            x = jnp.transpose(x, (0, 2, 3, 1))   # NCHW API -> NHWC core
         x = self.pool(self.stem(x))
         for b in self.blocks:
             x = b(x)
@@ -92,16 +110,19 @@ class ResNet(nn.Layer):
         return self.fc(x.reshape(x.shape[0], -1))
 
 
-def resnet18(num_classes=1000, dtype="float32"):
-    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, dtype=dtype)
+def resnet18(num_classes=1000, data_format="NCHW", dtype="float32"):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes,
+                  data_format=data_format, dtype=dtype)
 
 
-def resnet34(num_classes=1000, dtype="float32"):
-    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, dtype=dtype)
+def resnet34(num_classes=1000, data_format="NCHW", dtype="float32"):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes,
+                  data_format=data_format, dtype=dtype)
 
 
-def resnet50(num_classes=1000, dtype="float32"):
-    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes, dtype=dtype)
+def resnet50(num_classes=1000, data_format="NCHW", dtype="float32"):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes,
+                  data_format=data_format, dtype=dtype)
 
 
 class SEBlock(nn.Layer):
@@ -160,6 +181,8 @@ class SEResNeXt(nn.Layer):
         self.fc = nn.Linear(prev, num_classes, dtype=dtype)
 
     def forward(self, x):
+        # NCHW only (the SE gate's reshape assumes channel-first);
+        # NHWC support lives on the ResNet family
         x = self.pool(self.stem(x))
         for b in self.blocks:
             x = b(x)

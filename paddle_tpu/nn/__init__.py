@@ -63,7 +63,8 @@ class Conv2D(Layer):
 
     def __init__(self, num_channels, num_filters, filter_size, stride=1,
                  padding=0, dilation=1, groups=1, param_attr=None,
-                 bias_attr=None, act=None, dtype="float32"):
+                 bias_attr=None, act=None, data_format="NCHW",
+                 dtype="float32"):
         super().__init__(dtype=dtype)
         fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
         self.weight = self.create_parameter(
@@ -76,10 +77,12 @@ class Conv2D(Layer):
         self._stride, self._padding = stride, padding
         self._dilation, self._groups = dilation, groups
         self._act = act
+        self._data_format = data_format
 
     def forward(self, x):
         out = F.conv2d(x, self.weight, self.bias, self._stride,
-                       self._padding, self._dilation, self._groups)
+                       self._padding, self._dilation, self._groups,
+                       data_format=self._data_format)
         return _apply_act(out, self._act)
 
 
@@ -110,35 +113,43 @@ class Pool2D(Layer):
     """Parity: dygraph/nn.py Pool2D."""
 
     def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
-                 pool_padding=0, global_pooling=False):
+                 pool_padding=0, global_pooling=False,
+                 data_format="NCHW"):
         super().__init__()
         self._pool_size = pool_size
         self._pool_type = pool_type
         self._pool_stride = pool_stride
         self._pool_padding = pool_padding
         self._global = global_pooling
+        self._data_format = data_format
 
     def forward(self, x):
         if self._global:
-            axis = (2, 3)
+            axis = (2, 3) if self._data_format == "NCHW" else (1, 2)
             if self._pool_type == "max":
                 return jnp.max(x, axis=axis, keepdims=True)
             return jnp.mean(x, axis=axis, keepdims=True)
         if self._pool_type == "max":
             return F.max_pool2d(x, self._pool_size, self._pool_stride,
-                                self._pool_padding)
+                                self._pool_padding,
+                                data_format=self._data_format)
         return F.avg_pool2d(x, self._pool_size, self._pool_stride,
-                            self._pool_padding)
+                            self._pool_padding,
+                            data_format=self._data_format)
 
 
 class MaxPool2D(Pool2D):
-    def __init__(self, kernel_size, stride=None, padding=0):
-        super().__init__(kernel_size, "max", stride or kernel_size, padding)
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW"):
+        super().__init__(kernel_size, "max", stride or kernel_size,
+                         padding, data_format=data_format)
 
 
 class AvgPool2D(Pool2D):
-    def __init__(self, kernel_size, stride=None, padding=0):
-        super().__init__(kernel_size, "avg", stride or kernel_size, padding)
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW"):
+        super().__init__(kernel_size, "avg", stride or kernel_size,
+                         padding, data_format=data_format)
 
 
 class BatchNorm(Layer):

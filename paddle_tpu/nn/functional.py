@@ -137,22 +137,25 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, dilation=1,
     return out
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0):
+def max_pool2d(x, kernel_size, stride=None, padding=0,
+               data_format="NCHW"):
     stride = stride if stride is not None else kernel_size
     return _n.pool2d({"X": _val(x)}, {
         "ksize": [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size),
         "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
         "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
-        "pooling_type": "max"})["Out"]
+        "pooling_type": "max", "data_format": data_format})["Out"]
 
 
-def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True):
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               data_format="NCHW"):
     stride = stride if stride is not None else kernel_size
     return _n.pool2d({"X": _val(x)}, {
         "ksize": [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size),
         "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
         "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
-        "pooling_type": "avg", "exclusive": exclusive})["Out"]
+        "pooling_type": "avg", "exclusive": exclusive,
+        "data_format": data_format})["Out"]
 
 
 def adaptive_avg_pool2d(x, output_size):

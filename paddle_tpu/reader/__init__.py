@@ -208,7 +208,6 @@ class DataLoader:
         return iter(prefetched())
 
     def _gen_feed_dicts(self, worker_id=None, num_workers=None):
-        import inspect
         import itertools
 
         reader = self._batch_reader
@@ -218,12 +217,10 @@ class DataLoader:
             # multiprocess path: pass the shard through when the user's
             # reader is shard-aware, else round-robin islice (order
             # preserved; see ShmBatchLoader doc for the cost model)
-            try:
-                shard_aware = len(
-                    inspect.signature(reader).parameters) >= 2
-            except (TypeError, ValueError):
-                shard_aware = False
-            items = (reader(worker_id, num_workers) if shard_aware
+            from .shm import is_shard_aware
+
+            items = (reader(worker_id, num_workers)
+                     if is_shard_aware(reader)
                      else itertools.islice(reader(), worker_id, None,
                                            num_workers))
         for item in items:

@@ -55,6 +55,24 @@ def _cleanup_segments():
 atexit.register(_cleanup_segments)
 
 
+def is_shard_aware(reader):
+    """A reader opts into N-way sharding by taking exactly the two
+    REQUIRED positional parameters (worker_id, num_workers); readers
+    with defaulted/keyword parameters stay plain generators (calling
+    them with worker indices would silently misbind)."""
+    import inspect
+
+    try:
+        params = list(inspect.signature(reader).parameters.values())
+    except (TypeError, ValueError):
+        return False
+    required = [p for p in params
+                if p.default is inspect.Parameter.empty
+                and p.kind in (p.POSITIONAL_ONLY,
+                               p.POSITIONAL_OR_KEYWORD)]
+    return len(required) == 2 and len(params) == 2
+
+
 def _worker_main(batch_reader, worker_id, num_workers, sharded, q,
                  capacity_sem):
     signal.signal(signal.SIGTERM, lambda *a: exit(0))
@@ -125,15 +143,9 @@ class ShmBatchLoader:
 
     def __init__(self, batch_reader, num_workers=2, capacity=4,
                  mp_context=None):
-        import inspect
-
         assert num_workers >= 1
         self._reader = batch_reader
-        try:
-            n_params = len(inspect.signature(batch_reader).parameters)
-        except (TypeError, ValueError):
-            n_params = 0
-        self._sharded = n_params >= 2
+        self._sharded = is_shard_aware(batch_reader)
         self._num_workers = num_workers
         self._capacity = capacity
         # fork: generators/closures pass to children for free (the
@@ -170,7 +182,19 @@ class ShmBatchLoader:
             pos = 0
             while active:
                 i = active[pos % len(active)]
-                item = queues[i].get()
+                while True:
+                    try:
+                        item = queues[i].get(timeout=5.0)
+                        break
+                    except Exception:
+                        # worker killed without a sentinel (OOM killer,
+                        # segfault): surface it instead of hanging
+                        p = procs[i]
+                        if not p.is_alive():
+                            raise RuntimeError(
+                                f"multiprocess DataLoader worker {i} "
+                                f"died (exitcode {p.exitcode}) without "
+                                f"reporting — likely killed (OOM?)")
                 if item[0] == _END:
                     active.remove(i)
                     continue
@@ -207,11 +231,13 @@ class ShmBatchLoader:
             for name, dtype, shape, off in meta:
                 nbytes = int(np.prod(shape, dtype=np.int64)) \
                     * np.dtype(dtype).itemsize
-                # bytes() copies without exporting a live view of the
-                # segment buffer (a frombuffer view would pin it open)
+                # bytes() copies without exporting a live view that
+                # would pin the segment open at close(); .copy() makes
+                # the final array WRITABLE (frombuffer views over bytes
+                # are read-only, unlike the threaded loader's output)
                 raw = bytes(seg.buf[off:off + nbytes])
-                out[name] = np.frombuffer(raw,
-                                          dtype=dtype).reshape(shape)
+                out[name] = np.frombuffer(
+                    raw, dtype=dtype).reshape(shape).copy()
             keys = list(out)
             if keys == [str(i) for i in range(len(keys))]:
                 return [out[k] for k in keys]   # tuple/list reader

@@ -93,6 +93,24 @@ def test_resnet18_bn_buffers_update():
     assert float(np.abs(np.asarray(state.buffers[mean_keys[0]])).sum()) > 0
 
 
+def test_resnet_nhwc_matches_nchw():
+    # channels-last core (MXU-preferred layout) must be numerically
+    # identical to the NCHW path; the input API stays NCHW either way
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 3, 64, 64).astype("float32"))
+    m1 = models.resnet18(num_classes=10)
+    m2 = models.resnet18(num_classes=10, data_format="NHWC")
+    m1.eval()
+    m2.eval()
+    named2 = dict(m2.named_parameters())
+    for n, p in m1.named_parameters():
+        named2[n].value = p.value
+    np.testing.assert_allclose(np.asarray(m1(x)), np.asarray(m2(x)),
+                               atol=2e-4)
+
+
 def test_word2vec_converges():
     rng = np.random.RandomState(0)
     ctx = rng.randint(0, 100, (16, 4))

@@ -151,13 +151,14 @@ def test_multiprocess_beats_threaded_on_cpu_bound_reader():
         np.testing.assert_array_equal(a["x"], b["x"])
     import os
 
-    if len(os.sched_getaffinity(0)) >= 2:
+    cores = len(os.sched_getaffinity(0))
+    if cores >= 4:
         # 3 worker processes on GIL-bound work: require a real speedup
-        # (conservative 1.3x; typically ~2.5x). On a single-core box
-        # parallel speedup is physically impossible — only assert the
-        # process path does not collapse.
-        assert t_shm * 1.3 < t_threaded, (t_shm, t_threaded)
+        # (conservative 1.2x; typically ~2.5x on idle hosts)
+        assert t_shm * 1.2 < t_threaded, (t_shm, t_threaded)
     else:
+        # few/loaded cores: parallel speedup is not guaranteed — only
+        # assert the process path does not collapse
         assert t_shm < t_threaded * 1.5, (t_shm, t_threaded)
 
 
