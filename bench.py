@@ -170,7 +170,8 @@ def bench_resnet50(on_tpu, peak):
         # NHWC keeps the conv stack in the MXU-preferred layout (no XLA
         # relayout transposes); PADDLE_TPU_BENCH_NCHW=1 measures the
         # NCHW path for comparison
-        fmt = "NCHW" if os.environ.get("PADDLE_TPU_BENCH_NCHW") else "NHWC"
+        fmt = ("NCHW" if os.environ.get("PADDLE_TPU_BENCH_NCHW", "")
+               .lower() in ("1", "true", "yes") else "NHWC")
         model = resnet50(dtype="bfloat16", data_format=fmt)
         # batch 128 is the measured MFU knee on one v5e chip (64 -> 0.11,
         # 128 -> 0.13+, 256 only marginally better at 2x memory)

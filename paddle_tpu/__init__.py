@@ -58,6 +58,7 @@ from . import debugger
 from . import average
 from . import install_check
 from . import model_stat
+from . import contrib
 from . import sysconfig
 from . import utils
 from .lod import (LoDTensor, create_lod_tensor,
@@ -96,5 +97,6 @@ __all__ = [
     "initializer", "unique_name", "backward", "layers", "optimizer",
     "regularizer", "clip", "io", "reader", "dataset", "metrics",
     "profiler", "nn", "dygraph", "distributed", "amp", "jit", "models",
+    "contrib",
     "DataLoader",
 ]

@@ -1,0 +1,40 @@
+"""`fluid.contrib` surface.
+
+Parity: /root/reference/python/paddle/fluid/contrib/ — every contrib
+subpackage maps to a first-class implementation here:
+
+- layers            -> contrib.layers (builders over the op corpus)
+- trainer/inferencer-> contrib.trainer / contrib.inferencer
+- extend_optimizer  -> contrib.extend_optimizer (decoupled weight decay)
+- decoder           -> contrib.decoder (one decoding engine, layers.rnn)
+- mixed_precision   -> paddle_tpu.amp (aliased)
+- slim / quantize   -> paddle_tpu.slim (aliased)
+- memory_usage_calc / model_stat / op_frequence -> model_stat module
+- reader            -> paddle_tpu.reader decorators
+- utils             -> fleet fs/lookup utilities (distributed package)
+"""
+
+from .. import amp as mixed_precision  # noqa: F401
+from .. import slim  # noqa: F401
+from ..model_stat import memory_usage, op_freq_statistic  # noqa: F401
+from . import decoder, extend_optimizer, layers  # noqa: F401
+from .extend_optimizer import (  # noqa: F401
+    DecoupledWeightDecay,
+    extend_with_decoupled_weight_decay,
+)
+from .inferencer import Inferencer  # noqa: F401
+from .trainer import (  # noqa: F401
+    BeginEpochEvent,
+    BeginStepEvent,
+    CheckpointConfig,
+    EndEpochEvent,
+    EndStepEvent,
+    Trainer,
+)
+
+__all__ = ["layers", "decoder", "extend_optimizer", "mixed_precision",
+           "slim", "Trainer", "Inferencer", "CheckpointConfig",
+           "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "DecoupledWeightDecay",
+           "extend_with_decoupled_weight_decay", "memory_usage",
+           "op_freq_statistic"]

@@ -1,0 +1,18 @@
+"""contrib beam-search decoder surface.
+
+Parity: /root/reference/python/paddle/fluid/contrib/decoder/
+beam_search_decoder.py (TrainingDecoder/BeamSearchDecoder state-machine
+API, :842 LoC).  That contrib API was the experimental precursor of the
+layers.rnn decode stack the reference later mainlined; this repo
+implements the mainlined form once (layers/rnn.py: BeamSearchDecoder
+:319, dynamic_decode :398 — scan-based, jittable) and exposes it here
+under the contrib import path.  The contrib-only StateCell/
+TrainingDecoder incremental-construction classes collapse into writing
+the cell directly against layers.rnn.RNNCell — same capability, one
+decoding engine.
+"""
+
+from ..layers.rnn import (BeamSearchDecoder, Decoder,  # noqa: F401
+                          dynamic_decode)
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]

@@ -1,0 +1,94 @@
+"""Decoupled weight decay for any static-graph optimizer.
+
+Parity: /root/reference/python/paddle/fluid/contrib/extend_optimizer/
+extend_optimizer_with_weight_decay.py — DecoupledWeightDecay (:20)
+mixin + extend_with_decoupled_weight_decay (:102) class factory:
+`param -= coeff * param` applied from the PRE-update parameter value,
+independent of the gradient path (AdamW-style decoupling).
+"""
+
+from ..framework.program import Variable
+
+__all__ = ["DecoupledWeightDecay", "extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay:
+    """Mixin over an Optimizer subclass (use via the factory below)."""
+
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None, **kwargs):
+        if not isinstance(coeff, (float, int, Variable)):
+            raise TypeError("coeff should be float or Variable.")
+        self._coeff = coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._params_name = set()
+        super().__init__(**kwargs)
+
+    def _scale_parameters(self, params_grads):
+        from ..layers import tensor as T
+
+        if isinstance(self._coeff, (float, int)) and self._coeff == 0.0:
+            return []
+        scaled = []
+        for param, grad in params_grads:
+            if grad is None:
+                continue
+            if (self._apply_decay_param_fun is not None
+                    and not self._apply_decay_param_fun(param.name)):
+                continue
+            assert param.name not in self._params_name, \
+                f"duplicate decay for {param.name}"
+            # capture coeff * param BEFORE the optimizer update runs
+            scaled.append((param, T.scale(param, scale=self._coeff)
+                           if isinstance(self._coeff, (float, int))
+                           else T.elementwise_mul(param, self._coeff)))
+            self._params_name.add(param.name)
+        return scaled
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..layers import tensor as T
+
+        params_grads = self.backward(loss,
+                                     startup_program=startup_program,
+                                     parameter_list=parameter_list,
+                                     no_grad_set=no_grad_set)
+        scaled_params = self._scale_parameters(params_grads)
+        opt_ops = self.apply_gradients(params_grads)
+        # decay uses the pre-update value captured above; the assign
+        # lands after the optimizer ops, mirroring the reference's
+        # elementwise_sub + assign pair
+        for param, scaled in scaled_params:
+            updated = T.elementwise_sub(param, scaled)
+            T.assign(updated, output=param)
+        return opt_ops, params_grads
+
+    def __str__(self):
+        return "Weight Decay, params: " + ",".join(self._params_name)
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Class factory: returns `base_optimizer` with decoupled weight
+    decay prepended (extend_optimizer_with_weight_decay.py:102).
+
+        AdamW = extend_with_decoupled_weight_decay(fluid.optimizer.Adam)
+        AdamW(weight_decay=0.01, learning_rate=1e-3).minimize(loss)
+    """
+    from ..optimizer import Optimizer
+
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, Optimizer)):
+        raise TypeError(
+            "extend_with_decoupled_weight_decay needs an Optimizer "
+            "subclass")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay, apply_decay_param_fun=None,
+                     **kwargs):
+            super().__init__(coeff=weight_decay,
+                             apply_decay_param_fun=apply_decay_param_fun,
+                             **kwargs)
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        base_optimizer.__name__ + "WithDecoupledWeightDecay")
+    return OptimizerWithDecoupledWeightDecay

@@ -1,0 +1,42 @@
+"""High-level Inferencer.
+
+Parity: /root/reference/python/paddle/fluid/contrib/inferencer.py:28 —
+rebuild the network via `infer_func`, load trained parameters from
+`param_path`, serve `.infer(feed)` calls on a private scope.
+"""
+
+import numpy as np
+
+from .. import io as _io
+from ..framework.executor import Executor, Scope, scope_guard
+from ..framework.program import Program, program_guard
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer:
+    def __init__(self, infer_func, param_path, place=None,
+                 parallel=False):
+        self.scope = Scope()
+        self.inference_program = Program()
+        startup = Program()
+        from ..framework import unique_name
+
+        with program_guard(self.inference_program, startup), \
+                unique_name.guard():
+            self.predict_var = infer_func()
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            _io.load_params(self.exe, param_path,
+                            main_program=self.inference_program)
+        self.inference_program = self.inference_program.clone(
+            for_test=True)
+
+    def infer(self, inputs, return_numpy=True):
+        """inputs: {var_name: ndarray} -> [predict values]."""
+        with scope_guard(self.scope):
+            out = self.exe.run(self.inference_program, feed=inputs,
+                               fetch_list=[self.predict_var],
+                               return_numpy=return_numpy)
+        return out

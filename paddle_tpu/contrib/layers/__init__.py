@@ -1,0 +1,383 @@
+"""contrib layer builders.
+
+Parity: /root/reference/python/paddle/fluid/contrib/layers/
+(nn.py:39-924, metric_op.py:30, rnn_impl.py:164/405).  Every kernel
+these builders target already lives in the op corpus (ops/fused_ops.py,
+misc_ops.py, extended_ops.py, sequence_ops.py, detection_ops.py) — this
+module supplies the `fluid.contrib.layers.*` Program-building surface
+over them.  Ragged inputs follow the repo-wide padded+lengths contract
+instead of LoD (layers/sequence_ops.py).
+"""
+
+from ...framework.layer_helper import LayerHelper
+from ...layers.tensor import _single_out
+from ...layers import rnn as _rnn_api
+
+__all__ = [
+    "fused_elemwise_activation", "var_conv_2d", "match_matrix_tensor",
+    "sequence_topk_avg_pooling", "tree_conv", "fused_embedding_seq_pool",
+    "multiclass_nms2", "search_pyramid_hash", "shuffle_batch",
+    "partial_concat", "partial_sum", "ctr_metric_bundle",
+    "basic_gru", "basic_lstm",
+]
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True, name=None):
+    """contrib/layers/nn.py:39 — unary(binary(x, y)) fusion."""
+    helper = LayerHelper("fused_elemwise_activation", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mid = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "fused_elemwise_activation",
+        inputs={"X": x, "Y": y},
+        outputs={"Out": out, "IntermediateOut": mid},
+        attrs={"functor_list": list(functor_list), "axis": axis,
+               "scale": scale})
+    return (out, mid) if save_intermediate_out else out
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel,
+                filter_size, stride=1, param_attr=None, act=None,
+                dtype="float32", name=None):
+    """contrib/layers/nn.py:103 — per-sequence variable-size conv.
+    input: [B, C, Hmax, Wmax] padded maps; row/col: [B] valid extents
+    (the padded+lengths form of the reference's two LoD inputs)."""
+    helper = LayerHelper("var_conv_2d", name=name)
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    st = stride if isinstance(stride, (list, tuple)) else [stride, stride]
+    w = helper.create_parameter(
+        param_attr, shape=[output_channel, input_channel * fs[0] * fs[1]],
+        dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "var_conv_2d",
+        inputs={"X": input, "ROW": row, "COLUMN": col, "W": w},
+        outputs={"Out": out},
+        attrs={"InputChannel": input_channel,
+               "OutputChannel": output_channel,
+               "KernelH": fs[0], "KernelW": fs[1],
+               "StrideH": st[0], "StrideW": st[1]})
+    return helper.append_activation(out, act)
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None):
+    """contrib/layers/nn.py:219 — x @ W_t @ y text-match tensor.
+    x: [B, Lx, D], y: [B, Ly, D] (padded); returns ([B, T, Lx, Ly], tmp)."""
+    helper = LayerHelper("match_matrix_tensor", name=name)
+    d = x.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[d, channel_num, d],
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    tmp = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("match_matrix_tensor",
+                     inputs={"X": x, "Y": y, "W": w},
+                     outputs={"Out": out, "Tmp": tmp},
+                     attrs={"dim_t": channel_num})
+    return helper.append_activation(out, act), tmp
+
+
+def sequence_topk_avg_pooling(input, length, topks, channel_num=None,
+                              name=None):
+    """contrib/layers/nn.py:302 — per-channel top-k average pooling over
+    valid timesteps.  input: [B, T, C] padded, length: [B]."""
+    return _single_out("sequence_topk_avg_pooling",
+                       {"X": input, "Length": length},
+                       {"topks": [int(k) for k in topks],
+                        "channel_num": channel_num}, name=name)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              dtype="float32", name=None):
+    """contrib/layers/nn.py:370 — TBCNN tree convolution.
+    nodes_vector: [B, M, F], edge_set: [B, E, 2]."""
+    helper = LayerHelper("tree_conv", name=name)
+    f = nodes_vector.shape[-1]
+    w = helper.create_parameter(
+        param_attr, shape=[f, 3, output_size, num_filters], dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("tree_conv",
+                     inputs={"NodesVector": nodes_vector,
+                             "EdgeSet": edge_set, "Filter": w},
+                     outputs={"Out": out},
+                     attrs={"max_depth": max_depth})
+    if bias_attr:
+        b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                    dtype=dtype, is_bias=True)
+        out = _single_out("elementwise_add", {"X": out, "Y": b},
+                          {"axis": -1})
+    return helper.append_activation(out, act)
+
+
+def fused_embedding_seq_pool(input, size, length=None, is_sparse=False,
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32", name=None):
+    """contrib/layers/nn.py:435 — embedding lookup + sum pool.
+    input: [B, T] padded ids; length: [B] valid counts (LoD analogue)."""
+    helper = LayerHelper("fused_embedding_seq_pool", name=name)
+    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    ins = {"W": w, "Ids": input}
+    if length is not None:
+        ins["Length"] = length
+    helper.append_op("fused_embedding_seq_pool", inputs=ins,
+                     outputs={"Out": out},
+                     attrs={"combiner": combiner,
+                            "is_sparse": is_sparse,
+                            "padding_idx": padding_idx})
+    return out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k,
+                    keep_top_k, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=0, return_index=False,
+                    name=None):
+    """contrib/layers/nn.py:501 — NMS with kept-row input indices."""
+    helper = LayerHelper("multiclass_nms2", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    index = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "multiclass_nms2",
+        inputs={"BBoxes": bboxes, "Scores": scores},
+        outputs={"Out": out, "Index": index},
+        attrs={"score_threshold": score_threshold,
+               "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+               "nms_threshold": nms_threshold, "normalized": normalized,
+               "nms_eta": nms_eta, "background_label": background_label})
+    return (out, index) if return_index else out
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer,
+                        rand_len, drop_out_percent=0.0, is_training=True,
+                        use_filter=False, white_list_len=0,
+                        black_list_len=0, seed=0, lr=1.0,
+                        param_attr=None, dtype="float32", name=None):
+    """contrib/layers/nn.py:631 — multi-scale n-gram hash embedding.
+    input: [B, T] padded token ids.  The white/black-list n-gram filter
+    is not ported (loudly rejected, not silently dropped); `lr` rides
+    the parameter's learning-rate multiplier like the reference."""
+    if use_filter or white_list_len or black_list_len:
+        raise NotImplementedError(
+            "search_pyramid_hash white/black-list filtering is not "
+            "ported; pass use_filter=False with zero list lengths")
+    helper = LayerHelper("pyramid_hash", name=name)
+    w = helper.create_parameter(param_attr, shape=[space_len + rand_len, 1],
+                                dtype=dtype)
+    if lr != 1.0:
+        w.optimize_attr = {**getattr(w, "optimize_attr", {}),
+                           "learning_rate": float(lr)}
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("pyramid_hash",
+                     inputs={"X": input, "W": w},
+                     outputs={"Out": out},
+                     attrs={"num_emb": num_emb, "space_len": space_len,
+                            "pyramid_layer": pyramid_layer,
+                            "rand_len": rand_len,
+                            "drop_out_percent": drop_out_percent,
+                            "is_training": is_training, "seed": seed})
+    return out
+
+
+def shuffle_batch(x, seed=None, name=None):
+    """contrib/layers/nn.py:747 — random row permutation (one shared
+    permutation per batch)."""
+    helper = LayerHelper("shuffle_batch", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    idx = helper.create_variable_for_type_inference("int32")
+    helper.append_op("shuffle_batch", inputs={"X": x},
+                     outputs={"Out": out, "ShuffleIdx": idx},
+                     attrs={"startup_seed": seed or 0})
+    return out
+
+
+def partial_concat(input, start_index=0, length=-1, name=None):
+    """contrib/layers/nn.py:811 — concat of column slices."""
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    return _single_out("partial_concat", {"X": list(xs)},
+                       {"start_index": start_index, "length": length},
+                       name=name)
+
+
+def partial_sum(input, start_index=0, length=-1, name=None):
+    """contrib/layers/nn.py:873 — sum of column slices."""
+    xs = input if isinstance(input, (list, tuple)) else [input]
+    return _single_out("partial_sum", {"X": list(xs)},
+                       {"start_index": start_index, "length": length},
+                       name=name)
+
+
+def ctr_metric_bundle(input, label, name=None):
+    """contrib/layers/metric_op.py:30 — CTR eval bundle: returns
+    (local_sqrerr, local_abserr, local_prob, local_q), the same four
+    statistics the reference accumulates for distributed CTR eval."""
+    diff = _single_out("elementwise_sub", {"X": input, "Y": label}, {})
+    sqrerr = _single_out("reduce_sum",
+                         {"X": _single_out("square", {"X": diff}, {})},
+                         {"reduce_all": True})
+    abserr = _single_out("reduce_sum",
+                         {"X": _single_out("abs", {"X": diff}, {})},
+                         {"reduce_all": True})
+    prob = _single_out("reduce_sum", {"X": input}, {"reduce_all": True})
+    q = _single_out("reduce_sum", {"X": label}, {"reduce_all": True})
+    return sqrerr, abserr, prob, q
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0,
+              bidirectional=False, batch_first=True, param_attr=None,
+              bias_attr=None, gate_activation=None, activation=None,
+              dtype="float32", name="basic_gru"):
+    """contrib/layers/rnn_impl.py:164 — multi-layer (optionally
+    bidirectional) GRU from the fc + `gru` op pair per layer/direction
+    (padded+lengths ragged form).  init_hidden: None or
+    [num_layers * num_directions, B, H].  Returns (rnn_out,
+    last_hidden); rnn_out concatenates directions on the feature axis,
+    last_hidden stacks [L * D, B, H] like the reference."""
+    outs, last_h, _ = _stacked_rnn(
+        "gru", input, init_hidden, None, hidden_size, num_layers,
+        sequence_length, dropout_prob, bidirectional, batch_first,
+        param_attr, bias_attr, gate_activation, activation, 0.0, dtype)
+    return outs, last_h
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0,
+               bidirectional=False, batch_first=True, param_attr=None,
+               bias_attr=None, gate_activation=None, activation=None,
+               forget_bias=1.0, dtype="float32", name="basic_lstm"):
+    """contrib/layers/rnn_impl.py:405 — multi-layer (optionally
+    bidirectional) LSTM; forget_bias is added to the forget-gate bias
+    slice exactly as the reference's BasicLSTMUnit does.  Returns
+    (rnn_out, last_hidden, last_cell)."""
+    outs, last_h, last_c = _stacked_rnn(
+        "lstm", input, init_hidden, init_cell, hidden_size, num_layers,
+        sequence_length, dropout_prob, bidirectional, batch_first,
+        param_attr, bias_attr, gate_activation, activation, forget_bias,
+        dtype)
+    return outs, last_h, last_c
+
+
+def _layer_init(init, layer, num_layers, dirs, d):
+    """Per-(layer, direction) slice of a stacked [L*D, B, H] initial
+    state (rnn_impl.py seeds each layer from its own slice)."""
+    if init is None:
+        return None
+    if num_layers * dirs == 1 and len(init.shape) == 2:
+        return init
+    idx = layer * dirs + d
+    return _single_out("slice", {"Input": init},
+                       {"axes": [0], "starts": [idx], "ends": [idx + 1],
+                        "decrease_axis": [0]})
+
+
+def _stacked_rnn(kind, input, init_hidden, init_cell, hidden_size,
+                 num_layers, sequence_length, dropout_prob,
+                 bidirectional, batch_first, param_attr, bias_attr,
+                 gate_activation, activation, forget_bias, dtype):
+    import numpy as np
+
+    from ...layers import nn as N
+    from ...layers import sequence_ops as S
+    from ...layers import tensor as T
+
+    gates = 3 if kind == "gru" else 4
+    dirs = 2 if bidirectional else 1
+    x = input if batch_first else _transpose_bt(input)
+    lasts_h, lasts_c = [], []
+    for layer in range(num_layers):
+        if layer > 0 and dropout_prob:
+            # inter-layer dropout, rnn_impl.py placement
+            x = N.dropout(x, dropout_prob)
+        dir_outs = []
+        for d, rev in enumerate([False, True][:dirs]):
+            proj = N.fc(x, gates * hidden_size, num_flatten_dims=2,
+                        param_attr=param_attr, bias_attr=False)
+            helper = LayerHelper(f"basic_{kind}")
+            w = helper.create_parameter(
+                param_attr, shape=[hidden_size, gates * hidden_size],
+                dtype=dtype)
+            ins = {"Input": proj, "Weight": w,
+                   "Length": sequence_length}
+            if bias_attr is not False:
+                b = helper.create_parameter(
+                    bias_attr, shape=[1, gates * hidden_size],
+                    dtype=dtype, is_bias=True)
+                if kind == "lstm" and forget_bias:
+                    # forget gate = third slice of (c, i, f, o)
+                    fb = np.zeros((1, 4 * hidden_size), np.float32)
+                    fb[0, 2 * hidden_size:3 * hidden_size] = forget_bias
+                    b = T.elementwise_add(b, T.assign(fb))
+                ins["Bias"] = b
+            h0 = _layer_init(init_hidden, layer, num_layers, dirs, d)
+            if h0 is not None:
+                ins["H0"] = h0
+            attrs = {"is_reverse": rev}
+            if gate_activation:
+                attrs["gate_activation"] = gate_activation
+            if kind == "gru":
+                if activation:
+                    attrs["activation"] = activation
+                out = helper.create_variable_for_type_inference(dtype)
+                helper.append_op("gru", inputs=ins,
+                                 outputs={"Hidden": out}, attrs=attrs)
+                hidden, cell = out, None
+            else:
+                if activation:
+                    attrs["candidate_activation"] = activation
+                    attrs["cell_activation"] = activation
+                c0 = _layer_init(init_cell, layer, num_layers, dirs, d)
+                if c0 is not None:
+                    ins["C0"] = c0
+                attrs["use_peepholes"] = False
+                hidden = helper.create_variable_for_type_inference(dtype)
+                cell = helper.create_variable_for_type_inference(dtype)
+                helper.append_op("lstm", inputs=ins,
+                                 outputs={"Hidden": hidden,
+                                          "Cell": cell}, attrs=attrs)
+            dir_outs.append(hidden)
+            lasts_h.append(_last_step(hidden, sequence_length, rev))
+            if cell is not None:
+                lasts_c.append(_last_step(cell, sequence_length, rev))
+        x = (dir_outs[0] if dirs == 1
+             else T.concat(dir_outs, axis=2))
+        # created vars carry no inferred shape; the next layer's fc
+        # needs the feature dim
+        x.shape = [None, None, dirs * hidden_size]
+    out = x if batch_first else _transpose_bt(x)
+    # reference shape: last states stacked [num_layers * dirs, B, H]
+    from ...layers import nn as _N
+
+    last_h = _stack_states(lasts_h)
+    last_c = _stack_states(lasts_c) if lasts_c else None
+    return out, last_h, last_c
+
+
+def _stack_states(states):
+    from ...layers import tensor as T
+
+    if len(states) == 1:
+        return _single_out("unsqueeze2", {"X": states[0]}, {"axes": [0]})
+    return T.stack(states, axis=0)
+
+
+def _last_step(x, sequence_length, rev):
+    """Final valid state: last valid step forward; step 0 for a
+    reversed direction (its output is re-reversed by the kernel)."""
+    from ...layers import sequence_ops as S
+
+    if rev:
+        return _single_out("slice", {"Input": x},
+                           {"axes": [1], "starts": [0], "ends": [1],
+                            "decrease_axis": [1]})
+    if sequence_length is not None:
+        return S.sequence_last_step(x, sequence_length)
+    return _single_out("slice", {"Input": x},
+                       {"axes": [1], "starts": [-1],
+                        "ends": [2 ** 31 - 1], "decrease_axis": [1]})
+
+
+def _transpose_bt(x):
+    return _single_out("transpose2", {"X": x}, {"axis": [1, 0, 2]})

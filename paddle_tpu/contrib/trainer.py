@@ -1,0 +1,204 @@
+"""High-level Trainer API.
+
+Parity: /root/reference/python/paddle/fluid/contrib/trainer.py —
+Trainer (:169) with the event-handler protocol (BeginEpochEvent :40,
+EndEpochEvent :52, BeginStepEvent :64, EndStepEvent :83),
+CheckpointConfig (:100), and the save_params / save_inference_model /
+stop surface.  The reference's incremental-checkpoint plumbing
+(:663-1171) collapses onto paddle_tpu.checkpoint (orbax, crash-safe
+markers, keep-N GC).
+"""
+
+import os
+
+import numpy as np
+
+from .. import io as _io
+from ..framework.executor import Executor, Scope, scope_guard
+from ..framework.program import Program, program_guard
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "CheckpointConfig", "Trainer"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        # parity: trainer.py:73 fetch_metrics switch
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """trainer.py:100 — periodic checkpointing knobs."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or "checkpoints"
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+
+
+class Trainer:
+    """trainer.py:169 — builds the train program from `train_func`
+    (returns the loss variable, optionally [loss, *metrics]), applies
+    `optimizer_func()`, and drives epochs with the event protocol:
+
+        def train_func():
+            x = fluid.data("x", [None, 13]); y = fluid.data("y", [None, 1])
+            return fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(x, 1), y))
+
+        trainer = Trainer(train_func, lambda: fluid.optimizer.SGD(0.01))
+        trainer.train(num_epochs=5, event_handler=handler,
+                      reader=batch_reader, feed_order=["x", "y"])
+    """
+
+    def __init__(self, train_func, optimizer_func, place=None,
+                 parallel=False, checkpoint_config=None):
+        self.scope = Scope()
+        self.train_program = Program()
+        self.startup_program = Program()
+        self._checkpoint_cfg = checkpoint_config
+        self.stop_ = False
+        from ..framework import unique_name
+
+        # fresh name scope: an Inferencer rebuilding the same net in the
+        # same process must produce identical parameter names
+        with program_guard(self.train_program, self.startup_program), \
+                unique_name.guard():
+            out = train_func()
+            if isinstance(out, (list, tuple)):
+                self.loss, self.metrics = out[0], list(out[1:])
+            else:
+                self.loss, self.metrics = out, []
+            optimizer = optimizer_func()
+            optimizer.minimize(self.loss)
+        self.test_program = self.train_program.clone(for_test=True)
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            if self._checkpoint_cfg:
+                self._maybe_resume()
+
+    # -- events ---------------------------------------------------------
+    def stop(self):
+        """trainer.py: user calls from the event handler to end
+        training after the current step."""
+        self.stop_ = True
+
+    def _feed(self, data, feed_order):
+        if isinstance(data, dict):
+            return data
+        return {name: np.asarray(col)
+                for name, col in zip(feed_order, zip(*data))}
+
+    def train(self, num_epochs, event_handler=None, reader=None,
+              feed_order=None):
+        event_handler = event_handler or (lambda e: None)
+        fetch = [self.loss] + self.metrics
+        step_global = 0
+        with scope_guard(self.scope):
+            for epoch in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch))
+                for step, data in enumerate(reader()):
+                    begin = BeginStepEvent(epoch, step)
+                    event_handler(begin)
+                    out = self.exe.run(
+                        self.train_program,
+                        feed=self._feed(data, feed_order),
+                        fetch_list=fetch if begin.fetch_metrics else [])
+                    event_handler(EndStepEvent(epoch, step, out))
+                    step_global += 1
+                    if (self._checkpoint_cfg and step_global
+                            % self._checkpoint_cfg.step_interval == 0):
+                        self._save_checkpoint(step_global)
+                    if self.stop_:
+                        break
+                event_handler(EndEpochEvent(epoch))
+                if self.stop_:
+                    break
+
+    def test(self, reader, feed_order=None):
+        """Average loss+metrics over the test reader on the pruned test
+        program (trainer.py Trainer.test)."""
+        fetch = [self.loss] + self.metrics
+        totals = None
+        n = 0
+        with scope_guard(self.scope):
+            for data in reader():
+                out = self.exe.run(self.test_program,
+                                   feed=self._feed(data, feed_order),
+                                   fetch_list=fetch)
+                vals = [float(np.asarray(v).mean()) for v in out]
+                totals = (vals if totals is None
+                          else [a + b for a, b in zip(totals, vals)])
+                n += 1
+        return [t / max(n, 1) for t in (totals or [0.0] * len(fetch))]
+
+    def save_params(self, param_path):
+        with scope_guard(self.scope):
+            _io.save_params(self.exe, param_path,
+                            main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        targets = [([self.loss] + self.metrics)[i]
+                   for i in target_var_indexes]
+        with scope_guard(self.scope):
+            _io.save_inference_model(param_path, feeded_var_names,
+                                     targets, self.exe,
+                                     main_program=self.train_program)
+
+    # -- checkpointing --------------------------------------------------
+    def _persistable_state(self):
+        state = {}
+        for v in self.train_program.list_vars():
+            if getattr(v, "persistable", False):
+                val = self.scope.find_var(v.name)
+                if val is not None:
+                    state[v.name] = np.asarray(val)
+        return state
+
+    def _save_checkpoint(self, step):
+        from .. import checkpoint as ckpt
+
+        cfg = self._checkpoint_cfg
+        ckpt.save_checkpoint(cfg.checkpoint_dir,
+                             self._persistable_state(), step)
+        # keep-N GC, trainer.py CheckpointConfig.max_num_checkpoints
+        steps = ckpt._list_steps(cfg.checkpoint_dir)
+        for old in steps[:-cfg.max_num_checkpoints]:
+            import shutil
+
+            shutil.rmtree(ckpt._step_path(cfg.checkpoint_dir, old),
+                          ignore_errors=True)
+
+    def _maybe_resume(self):
+        from .. import checkpoint as ckpt
+
+        cfg = self._checkpoint_cfg
+        if ckpt.latest_step(cfg.checkpoint_dir) is None:
+            return
+        template = self._persistable_state()
+        state, _ = ckpt.load_checkpoint(cfg.checkpoint_dir, template)
+        for name, value in state.items():
+            self.scope.set_var(name, np.asarray(value))

@@ -56,10 +56,12 @@ atexit.register(_cleanup_segments)
 
 
 def is_shard_aware(reader):
-    """A reader opts into N-way sharding by taking exactly the two
-    REQUIRED positional parameters (worker_id, num_workers); readers
-    with defaulted/keyword parameters stay plain generators (calling
-    them with worker indices would silently misbind)."""
+    """A reader opts into N-way sharding by REQUIRING at least two
+    positional parameters — (worker_id, num_workers) — with any further
+    parameters defaulted.  Zero required params = plain generator
+    (defaulted params like `def r(batch_size=32)` must NOT receive
+    worker indices).  Exactly one required param is ambiguous and
+    rejected loudly rather than silently mis-called."""
     import inspect
 
     try:
@@ -70,7 +72,14 @@ def is_shard_aware(reader):
                 if p.default is inspect.Parameter.empty
                 and p.kind in (p.POSITIONAL_ONLY,
                                p.POSITIONAL_OR_KEYWORD)]
-    return len(required) == 2 and len(params) == 2
+    if len(required) >= 2:
+        return True
+    if len(required) == 1:
+        raise TypeError(
+            f"reader {reader!r} takes one required parameter — a "
+            f"multiprocess reader must take either zero (plain "
+            f"generator) or (worker_id, num_workers)")
+    return False
 
 
 def _worker_main(batch_reader, worker_id, num_workers, sharded, q,
