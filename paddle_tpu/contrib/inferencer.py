@@ -5,8 +5,6 @@ rebuild the network via `infer_func`, load trained parameters from
 `param_path`, serve `.infer(feed)` calls on a private scope.
 """
 
-import numpy as np
-
 from .. import io as _io
 from ..framework.executor import Executor, Scope, scope_guard
 from ..framework.program import Program, program_guard

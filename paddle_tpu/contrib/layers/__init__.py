@@ -11,7 +11,6 @@ instead of LoD (layers/sequence_ops.py).
 
 from ...framework.layer_helper import LayerHelper
 from ...layers.tensor import _single_out
-from ...layers import rnn as _rnn_api
 
 __all__ = [
     "fused_elemwise_activation", "var_conv_2d", "match_matrix_tensor",
@@ -273,6 +272,23 @@ def _layer_init(init, layer, num_layers, dirs, d):
                         "decrease_axis": [0]})
 
 
+def _per_use_attr(attr, suffix):
+    """A NAMED ParamAttr shared across layers/directions/uses would
+    alias differently-shaped parameters; suffix it per use (the
+    reference rnn_impl suffixes names per layer the same way)."""
+    from ...framework.param_attr import ParamAttr
+
+    if attr is None or attr is False or not getattr(attr, "name", None):
+        return attr
+    a = ParamAttr(name=f"{attr.name}_{suffix}",
+                  initializer=attr.initializer,
+                  learning_rate=attr.learning_rate,
+                  regularizer=attr.regularizer,
+                  trainable=attr.trainable,
+                  do_model_average=attr.do_model_average)
+    return a
+
+
 def _stacked_rnn(kind, input, init_hidden, init_cell, hidden_size,
                  num_layers, sequence_length, dropout_prob,
                  bidirectional, batch_first, param_attr, bias_attr,
@@ -280,7 +296,6 @@ def _stacked_rnn(kind, input, init_hidden, init_cell, hidden_size,
     import numpy as np
 
     from ...layers import nn as N
-    from ...layers import sequence_ops as S
     from ...layers import tensor as T
 
     gates = 3 if kind == "gru" else 4
@@ -293,17 +308,21 @@ def _stacked_rnn(kind, input, init_hidden, init_cell, hidden_size,
             x = N.dropout(x, dropout_prob)
         dir_outs = []
         for d, rev in enumerate([False, True][:dirs]):
+            tag = f"l{layer}_d{d}"
             proj = N.fc(x, gates * hidden_size, num_flatten_dims=2,
-                        param_attr=param_attr, bias_attr=False)
+                        param_attr=_per_use_attr(param_attr,
+                                                 f"{tag}_in"),
+                        bias_attr=False)
             helper = LayerHelper(f"basic_{kind}")
             w = helper.create_parameter(
-                param_attr, shape=[hidden_size, gates * hidden_size],
-                dtype=dtype)
+                _per_use_attr(param_attr, f"{tag}_rec"),
+                shape=[hidden_size, gates * hidden_size], dtype=dtype)
             ins = {"Input": proj, "Weight": w,
                    "Length": sequence_length}
             if bias_attr is not False:
                 b = helper.create_parameter(
-                    bias_attr, shape=[1, gates * hidden_size],
+                    _per_use_attr(bias_attr, tag),
+                    shape=[1, gates * hidden_size],
                     dtype=dtype, is_bias=True)
                 if kind == "lstm" and forget_bias:
                     # forget gate = third slice of (c, i, f, o)
@@ -348,8 +367,6 @@ def _stacked_rnn(kind, input, init_hidden, init_cell, hidden_size,
         x.shape = [None, None, dirs * hidden_size]
     out = x if batch_first else _transpose_bt(x)
     # reference shape: last states stacked [num_layers * dirs, B, H]
-    from ...layers import nn as _N
-
     last_h = _stack_states(lasts_h)
     last_c = _stack_states(lasts_c) if lasts_c else None
     return out, last_h, last_c
@@ -380,4 +397,8 @@ def _last_step(x, sequence_length, rev):
 
 
 def _transpose_bt(x):
-    return _single_out("transpose2", {"X": x}, {"axis": [1, 0, 2]})
+    out = _single_out("transpose2", {"X": x}, {"axis": [1, 0, 2]})
+    if getattr(x, "shape", None) is not None and len(x.shape) >= 2:
+        # downstream fc needs feature dims; swap the leading two
+        out.shape = [x.shape[1], x.shape[0], *x.shape[2:]]
+    return out

@@ -134,6 +134,9 @@ class Trainer:
                     if self.stop_:
                         break
                 event_handler(EndEpochEvent(epoch))
+                if (self._checkpoint_cfg and (epoch + 1)
+                        % self._checkpoint_cfg.epoch_interval == 0):
+                    self._save_checkpoint(step_global)
                 if self.stop_:
                     break
 

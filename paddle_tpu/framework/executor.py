@@ -123,7 +123,8 @@ _NEEDS_CONST_INPUTS = {"range", "linspace"}
 
 # Ops with data-dependent output shapes: impossible under jit by
 # construction (XLA static shapes); they work in the eager executor.
-_DYNAMIC_SHAPE_OPS = {"where_index", "masked_select", "unique"}
+_DYNAMIC_SHAPE_OPS = {"where_index", "masked_select", "unique",
+                      "shrink_memory"}
 
 
 def _branch_env(env):
@@ -307,6 +308,38 @@ def _run_array_op(op, env, rng_box, const_env=None):
         arr = env[op.inputs["Array"][0]]
         env[op.outputs["Out"][0]] = jnp.asarray(len(arr), jnp.int32)
         return
+    if t == "lod_tensor_to_array":
+        # control_flow.py:1132 parity: split [B, T, ...] into
+        # per-timestep slices over the rank-table's still-active prefix.
+        # Row counts are value-dependent -> concrete lengths only
+        # (FLAGS_eager_executor), like the reference's LoD machinery.
+        x = np.asarray(env[op.inputs["X"][0]])
+        table = np.asarray(env[op.inputs["RankTable"][0]])
+        order, lengths = table[:, 0].astype(int), table[:, 1]
+        max_len = int(lengths[0]) if len(lengths) else 0
+        out = []
+        for t_step in range(max_len):
+            active = int((lengths > t_step).sum())
+            out.append(jnp.asarray(x[order[:active], t_step]))
+        env[op.outputs["Out"][0]] = out
+        return
+    if t == "array_to_lod_tensor":
+        # control_flow.py:1174 parity: inverse of the split above,
+        # restoring original row order and right-padding short rows
+        arr = env[op.inputs["X"][0]]
+        table = np.asarray(env[op.inputs["RankTable"][0]])
+        order, lengths = table[:, 0].astype(int), table[:, 1]
+        b = len(order)
+        max_len = len(arr)
+        feat = np.asarray(arr[0]).shape[1:] if arr else ()
+        dtype = np.asarray(arr[0]).dtype if arr else np.float32
+        out = np.zeros((b, max_len) + tuple(feat), dtype)
+        for t_step, step_rows in enumerate(arr):
+            step_rows = np.asarray(step_rows)
+            active = step_rows.shape[0]
+            out[order[:active], t_step] = step_rows
+        env[op.outputs["Out"][0]] = jnp.asarray(out)
+        return
 
 
 def _run_while_block(op, env, rng_box, const_env=None):
@@ -368,6 +401,8 @@ _CONTROL_FLOW_OPS = {
     "array_write": _run_array_op,
     "array_read": _run_array_op,
     "array_length": _run_array_op,
+    "lod_tensor_to_array": _run_array_op,
+    "array_to_lod_tensor": _run_array_op,
 }
 
 

@@ -24,7 +24,8 @@ __all__ = ["cond", "while_loop", "StaticRNN", "Switch", "increment",
            "less_than", "less_equal", "greater_than", "greater_equal",
            "equal", "not_equal", "logical_and", "logical_or",
     "logical_not", "array_write", "array_read", "array_length",
-    "create_array"]
+    "create_array", "lod_rank_table", "max_sequence_len",
+    "lod_tensor_to_array", "array_to_lod_tensor", "shrink_memory"]
 
 
 def _helper(name):
@@ -422,6 +423,70 @@ def array_length(array):
     h = _helper("array_length")
     out = h.create_variable_for_type_inference("int64")
     h.append_op("array_length", inputs={"Array": array},
+                outputs={"Out": out}, attrs={})
+    return out
+
+
+def lod_rank_table(x, level=0, lengths=None):
+    """Parity: control_flow.py:1046 — sort sequences by length (desc,
+    stable) for length-bucketed dynamic-RNN batching.  The reference
+    reads lengths from x's LoD level; the padded+lengths contract passes
+    them explicitly (`lengths` [B] — required; `level` is accepted for
+    signature parity but the nested hierarchy is already flattened to
+    the lengths vector by lod.create_lod_tensor)."""
+    if lengths is None:
+        raise ValueError(
+            "lod_rank_table needs the lengths vector (padded+lengths "
+            "contract; see paddle_tpu.lod.create_lod_tensor)")
+    h = _helper("lod_rank_table")
+    out = h.create_variable_for_type_inference("int64")
+    h.append_op("lod_rank_table", inputs={"X": lengths},
+                outputs={"Out": out}, attrs={"level": level})
+    out.is_rank_table = True
+    return out
+
+
+def max_sequence_len(rank_table):
+    """Parity: control_flow.py:1125 — the longest length in the table."""
+    h = _helper("max_sequence_len")
+    out = h.create_variable_for_type_inference("int64")
+    h.append_op("max_sequence_len", inputs={"RankTable": rank_table},
+                outputs={"Out": out}, attrs={})
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    """Parity: control_flow.py:1132 — split padded [B, T, ...] into a
+    tensor array of per-timestep slices covering only the sequences
+    still active at each step, in rank-table order.  Row counts are
+    value-dependent: runs under FLAGS_eager_executor (the reference's
+    LoD machinery is likewise interpreter-only)."""
+    h = _helper("lod_tensor_to_array")
+    out = h.create_variable_for_type_inference(x.dtype)
+    out.is_tensor_array = True
+    h.append_op("lod_tensor_to_array", inputs={"X": x, "RankTable": table},
+                outputs={"Out": out}, attrs={})
+    return out
+
+
+def array_to_lod_tensor(x, table):
+    """Parity: control_flow.py:1174 — inverse of lod_tensor_to_array:
+    reassemble the padded batch in the original row order."""
+    h = _helper("array_to_lod_tensor")
+    out = h.create_variable_for_type_inference(x.dtype)
+    h.append_op("array_to_lod_tensor", inputs={"X": x, "RankTable": table},
+                outputs={"Out": out}, attrs={})
+    return out
+
+
+def shrink_memory(x, i, table):
+    """Parity: control_flow.py:1660 — drop the memory rows of sequences
+    that already finished at step i (rows in rank-table order, so the
+    active ones are a prefix)."""
+    h = _helper("shrink_memory")
+    out = h.create_variable_for_type_inference(x.dtype)
+    h.append_op("shrink_memory",
+                inputs={"X": x, "I": i, "RankTable": table},
                 outputs={"Out": out}, attrs={})
     return out
 

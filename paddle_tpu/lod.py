@@ -19,19 +19,36 @@ __all__ = ["LoDTensor", "create_lod_tensor",
 
 class LoDTensor:
     """Padded batch + per-row lengths, with the reference's accessors
-    (framework/lod_tensor.h:104 analogue at the Python surface)."""
+    (framework/lod_tensor.h:104 analogue at the Python surface).
 
-    def __init__(self, padded, lengths):
+    Multi-level (nested) LoD — lod_tensor.h:52 `LoD =
+    vector<Vector<size_t>>` — keeps the ORIGINAL recursive_seq_lens and
+    flattens the hierarchy to bottom-level sequences for the padded
+    data: data is [num_bottom_seqs, T_max, ...] with `lengths` the
+    bottom-level lengths, and the upper levels describe how those
+    bottom sequences group (exactly the information the reference's
+    upper offset vectors carry)."""
+
+    def __init__(self, padded, lengths, recursive_seq_lens=None):
         self.data = np.asarray(padded)
         self.lengths = np.asarray(lengths, np.int64).reshape(-1)
+        self._recursive = (
+            [[int(v) for v in level] for level in recursive_seq_lens]
+            if recursive_seq_lens is not None
+            else [list(map(int, self.lengths))])
+
+    @property
+    def lod_level(self):
+        return len(self._recursive)
 
     def recursive_sequence_lengths(self):
-        return [list(map(int, self.lengths))]
+        return [list(level) for level in self._recursive]
 
     def lod(self):
-        # offset form: [0, l0, l0+l1, ...]
+        # offset form per level: [0, l0, l0+l1, ...]
         return [list(map(int, np.concatenate(
-            [[0], np.cumsum(self.lengths)])))]
+            [[0], np.cumsum(level)])))
+            for level in self._recursive]
 
     def shape(self):
         return tuple(self.data.shape)
@@ -41,9 +58,30 @@ class LoDTensor:
         return a.astype(dtype) if dtype is not None else a
 
     def rows(self):
-        """Iterate the unpadded sequences."""
+        """Iterate the unpadded bottom-level sequences."""
         for i, n in enumerate(self.lengths):
             yield self.data[i, :int(n)]
+
+    def top_level_groups(self):
+        """Iterate lists of bottom-sequence indices per top-level
+        sequence (the grouping the upper LoD levels encode)."""
+        counts = self._recursive[0]
+        if self.lod_level == 1:
+            yield from ([i] for i in range(len(counts)))
+            return
+        # fold intermediate levels: how many bottom seqs per top seq
+        per = list(self._recursive[0])
+        for level in self._recursive[1:-1]:
+            folded = []
+            off = 0
+            for c in per:
+                folded.append(int(sum(level[off:off + c])))
+                off += c
+            per = folded
+        off = 0
+        for c in per:
+            yield list(range(off, off + c))
+            off += c
 
 
 def create_lod_tensor(data, recursive_seq_lens=None, place=None):
@@ -53,13 +91,10 @@ def create_lod_tensor(data, recursive_seq_lens=None, place=None):
     placement belongs to jit in this framework."""
     if recursive_seq_lens is None:
         seqs = [np.asarray(s) for s in data]
+        recursive = None
     else:
-        if len(recursive_seq_lens) != 1:
-            raise NotImplementedError(
-                "multi-level LoD is not supported by the padded+lengths "
-                "design; flatten the hierarchy to one level (got "
-                f"{len(recursive_seq_lens)} levels)")
-        lens = list(recursive_seq_lens[-1])
+        _validate_nested_lod(recursive_seq_lens)
+        lens = list(recursive_seq_lens[-1])     # bottom level: data rows
         flat = np.asarray(data)
         if flat.ndim == 1:
             flat = flat.reshape(-1, 1)
@@ -72,13 +107,24 @@ def create_lod_tensor(data, recursive_seq_lens=None, place=None):
             raise ValueError(
                 f"recursive_seq_lens sums to {off}, data has "
                 f"{flat.shape[0]} rows")
+        recursive = recursive_seq_lens
     if not seqs:
         raise ValueError("need at least one sequence")
     from .layers.sequence_ops import pad_sequences
 
     dtype = np.result_type(*[s.dtype for s in seqs])
     padded, lengths = pad_sequences(seqs, dtype=dtype)
-    return LoDTensor(padded, lengths)
+    return LoDTensor(padded, lengths, recursive_seq_lens=recursive)
+
+
+def _validate_nested_lod(recursive_seq_lens):
+    """Each level's entry count must equal the sum of the level above
+    (lod_tensor.h CheckLoD semantics on the lengths form)."""
+    for upper, lower in zip(recursive_seq_lens, recursive_seq_lens[1:]):
+        if sum(upper) != len(lower):
+            raise ValueError(
+                f"invalid nested LoD: level with sum {sum(upper)} must "
+                f"partition the {len(lower)} entries below it")
 
 
 def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,

@@ -50,8 +50,9 @@ def fused_elemwise_activation(ins, attrs):
 
 @register_op("fused_embedding_seq_pool")
 def fused_embedding_seq_pool(ins, attrs):
-    """fused/fused_embedding_seq_pool_op.cc — lookup + sum-pool over each
-    row's valid ids."""
+    """fused/fused_embedding_seq_pool_op.cc — lookup + pool over each
+    row's valid ids; padding_idx contributes zero and combiner supports
+    sum/mean (lookup_table padding semantics + sequence_pool types)."""
     w = jnp.asarray(ins["W"])                   # [V, D]
     ids = jnp.asarray(ins["Ids"]).astype(jnp.int32)     # [B, T]
     length = (jnp.asarray(ins["Length"]).reshape(-1)
@@ -60,7 +61,17 @@ def fused_embedding_seq_pool(ins, attrs):
     emb = w[ids]                                 # [B, T, D]
     mask = (jnp.arange(ids.shape[1])[None, :]
             < length[:, None]).astype(emb.dtype)
-    return {"Out": (emb * mask[..., None]).sum(axis=1)}
+    padding_idx = attrs.get("padding_idx")
+    if padding_idx is not None:
+        mask = mask * (ids != int(padding_idx)).astype(emb.dtype)
+    combiner = attrs.get("combiner", "sum")
+    pooled = (emb * mask[..., None]).sum(axis=1)
+    if combiner == "mean":
+        denom = jnp.maximum(mask.sum(axis=1), 1.0)
+        pooled = pooled / denom[:, None]
+    elif combiner != "sum":
+        raise ValueError(f"unsupported combiner {combiner!r}")
+    return {"Out": pooled}
 
 
 @register_op("fusion_seqpool_concat")

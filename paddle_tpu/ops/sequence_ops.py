@@ -9,6 +9,7 @@ results on the valid prefix, static shapes for XLA.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .registry import register_op
 
@@ -364,3 +365,39 @@ def sequence_topk_avg_pooling(ins, attrs):
     # channel-major, k innermost: out[..., j*k_num + k]
     # (sequence_topk_avg_pooling_op.h:130-148)
     return {"Out": jnp.stack(outs, axis=-1).reshape(b, c * len(topks))}
+
+
+# -- LoD rank-table machinery ------------------------------------------------
+# Parity: operators/lod_rank_table_op.cc + layers/control_flow.py:1046
+# (lod_rank_table), :1125 (max_sequence_len), :1660 (shrink_memory).
+# The reference sorts a LoD level's sequences by length to run
+# length-bucketed dynamic RNNs; on the padded+lengths contract the table
+# is [B, 2] int64 rows (original_index, length) sorted desc — a fixed
+# shape, so building it stays jittable. shrink_memory's OUTPUT row count
+# is value-dependent (eager executor only, like the to/from-array pair).
+
+@register_op("lod_rank_table")
+def lod_rank_table(ins, attrs):
+    # int32: jax truncates int64 without x64 mode anyway (and warns)
+    length = jnp.asarray(ins["X"]).reshape(-1).astype(jnp.int32)
+    # stable desc sort by length (reference sorts desc, ties keep order)
+    order = jnp.argsort(-length, stable=True)
+    return {"Out": jnp.stack([order.astype(jnp.int32), length[order]],
+                             axis=1)}
+
+
+@register_op("max_sequence_len")
+def max_sequence_len(ins, attrs):
+    table = jnp.asarray(ins["RankTable"])
+    return {"Out": table[0, 1].astype(jnp.int32)}
+
+
+@register_op("shrink_memory")
+def shrink_memory(ins, attrs):
+    """Keep only the memory rows of sequences still active at step I
+    (rows are in rank-table order, so active rows are a prefix)."""
+    x = np.asarray(ins["X"])
+    i = int(np.asarray(ins["I"]).reshape(()))
+    table = np.asarray(ins["RankTable"])
+    active = int((table[:, 1] > i).sum())
+    return {"Out": jnp.asarray(x[:max(active, 0)])}

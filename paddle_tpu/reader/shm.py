@@ -72,13 +72,15 @@ def is_shard_aware(reader):
                 if p.default is inspect.Parameter.empty
                 and p.kind in (p.POSITIONAL_ONLY,
                                p.POSITIONAL_OR_KEYWORD)]
-    if len(required) >= 2:
+    if len(required) == 2:
         return True
-    if len(required) == 1:
+    if len(required) in (1,) or len(required) > 2:
         raise TypeError(
-            f"reader {reader!r} takes one required parameter — a "
-            f"multiprocess reader must take either zero (plain "
-            f"generator) or (worker_id, num_workers)")
+            f"reader {reader!r} requires {len(required)} positional "
+            f"parameters — a multiprocess reader must require either "
+            f"zero (plain generator) or exactly two "
+            f"(worker_id, num_workers); further parameters must be "
+            f"defaulted")
     return False
 
 

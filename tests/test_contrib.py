@@ -366,6 +366,79 @@ def test_contrib_ctr_metric_bundle():
     np.testing.assert_allclose(float(np.asarray(q)), 1.0, rtol=1e-5)
 
 
+def test_contrib_rnn_batch_first_false():
+    def build():
+        g = fluid.data("g", [6, 2, 4])   # [T, B, F]
+        out, h = contrib_layers.basic_gru(g, None, 8,
+                                          batch_first=False)
+        return out, h
+
+    rng = np.random.default_rng(0)
+    out, h = _run_program(
+        build, {"g": rng.normal(size=(6, 2, 4)).astype(np.float32)})
+    assert np.asarray(out).shape == (6, 2, 8)    # back to [T, B, H]
+    assert np.asarray(h).shape == (1, 2, 8)
+
+
+def test_contrib_named_param_attr_no_aliasing():
+    def build():
+        g = fluid.data("g", [None, 4, 4])
+        out, h = contrib_layers.basic_gru(
+            g, None, 8, num_layers=2,
+            param_attr=fluid.ParamAttr(name="shared_w"))
+        return out
+
+    (out,) = _run_program(build, {
+        "g": np.zeros((2, 4, 4), np.float32)})
+    assert np.asarray(out).shape == (2, 4, 8)
+
+
+def test_embedding_seq_pool_padding_and_mean():
+    w = np.arange(12, dtype=np.float32).reshape(6, 2)
+    ids = np.array([[1, 0, 2]], np.int64)
+
+    def build(combiner, padding_idx):
+        def b():
+            iv = fluid.data("ids", [None, 3], dtype="int64")
+            return contrib_layers.fused_embedding_seq_pool(
+                iv, [6, 2], combiner=combiner, padding_idx=padding_idx,
+                param_attr=fluid.ParamAttr(
+                    initializer=fluid.initializer.NumpyArrayInitializer(
+                        w)))
+
+        return b
+
+    (summed,) = _run_program(build("sum", 0), {"ids": ids})
+    (meaned,) = _run_program(build("mean", 0), {"ids": ids})
+    # padding_idx=0 excluded: rows 1 and 2 only
+    np.testing.assert_allclose(np.asarray(summed).ravel(),
+                               w[1] + w[2], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(meaned).ravel(),
+                               (w[1] + w[2]) / 2, rtol=1e-6)
+
+
+def test_trainer_epoch_interval_checkpoints():
+    d = tempfile.mkdtemp()
+    cfg = CheckpointConfig(checkpoint_dir=d, step_interval=10 ** 9,
+                           epoch_interval=1, max_num_checkpoints=5)
+    with fluid.unique_name.guard():
+        t = Trainer(_train_func, lambda: fluid.optimizer.SGD(0.1),
+                    checkpoint_config=cfg)
+        t.train(num_epochs=2, event_handler=None, reader=_reader(n=2),
+                feed_order=["x", "y"])
+    assert len(os.listdir(d)) >= 2   # one per epoch
+
+
+def test_shard_aware_three_required_rejected():
+    from paddle_tpu.reader.shm import is_shard_aware
+
+    def r3(a, b, c):
+        yield {}
+
+    with pytest.raises(TypeError, match="exactly two"):
+        is_shard_aware(r3)
+
+
 def test_contrib_decoder_alias():
     from paddle_tpu.contrib import decoder
 

@@ -62,12 +62,13 @@ def test_feeds_sequence_op():
     np.testing.assert_allclose(out[1], [8, 8, 8])
 
 
-def test_multi_level_lod_rejected():
-    import pytest
-
-    with pytest.raises(NotImplementedError):
-        fluid.create_lod_tensor(np.zeros((6, 1), np.float32),
+def test_multi_level_lod_supported():
+    # round-3: nested LoD is first-class (see test_lod_rank_table.py
+    # for the full machinery)
+    t = fluid.create_lod_tensor(np.zeros((6, 1), np.float32),
                                 [[2, 1], [1, 2, 3]])
+    assert t.lod_level == 2
+    assert t.recursive_sequence_lengths() == [[2, 1], [1, 2, 3]]
 
 
 def test_mixed_dtypes_promote():

@@ -1,0 +1,132 @@
+"""Multi-level LoD + lod_rank_table machinery.
+
+Parity: framework/lod_tensor.h:52 nested LoD, layers/control_flow.py
+lod_rank_table (:1046), max_sequence_len (:1125), lod_tensor_to_array
+(:1132), array_to_lod_tensor (:1174), shrink_memory (:1660) — the
+length-sorted dynamic-RNN batching machinery, on the padded+lengths
+representation (value-dependent row counts run on the eager executor,
+mirroring the reference's interpreter-only LoD ops).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.layers.control_flow import (
+    array_to_lod_tensor,
+    lod_rank_table,
+    lod_tensor_to_array,
+    max_sequence_len,
+    shrink_memory,
+)
+from paddle_tpu.lod import LoDTensor, create_lod_tensor
+
+
+def test_multi_level_lod_roundtrip():
+    # 2 top sequences: first has 2 sub-seqs (len 3, 2), second 1 (len 4)
+    flat = np.arange(9, dtype=np.float32).reshape(9, 1)
+    t = create_lod_tensor(flat, [[2, 1], [3, 2, 4]])
+    assert t.lod_level == 2
+    assert t.recursive_sequence_lengths() == [[2, 1], [3, 2, 4]]
+    assert t.lod() == [[0, 2, 3], [0, 3, 5, 9]]
+    assert t.data.shape == (3, 4, 1)     # 3 bottom seqs padded to 4
+    np.testing.assert_array_equal(t.lengths, [3, 2, 4])
+    rows = list(t.rows())
+    np.testing.assert_array_equal(rows[0].ravel(), [0, 1, 2])
+    np.testing.assert_array_equal(rows[1].ravel(), [3, 4])
+    np.testing.assert_array_equal(rows[2].ravel(), [5, 6, 7, 8])
+    assert list(t.top_level_groups()) == [[0, 1], [2]]
+
+
+def test_three_level_lod():
+    flat = np.arange(6, dtype=np.float32).reshape(6, 1)
+    t = create_lod_tensor(flat, [[1, 1], [1, 2], [2, 1, 3]])
+    assert t.lod_level == 3
+    assert t.lod() == [[0, 1, 2], [0, 1, 3], [0, 2, 3, 6]]
+    assert list(t.top_level_groups()) == [[0], [1, 2]]
+
+
+def test_invalid_nested_lod_rejected():
+    with pytest.raises(ValueError, match="partition"):
+        create_lod_tensor(np.zeros((5, 1), np.float32),
+                          [[2, 1], [3, 2]])  # 3 != len([3,2])
+
+
+def _with_eager():
+    fluid.set_flags({"FLAGS_eager_executor": True})
+
+
+def _without_eager():
+    fluid.set_flags({"FLAGS_eager_executor": False})
+
+
+def test_rank_table_sort_and_max_len():
+    with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            lens = fluid.data("lens", [None], dtype="int64")
+            table = lod_rank_table(None, lengths=lens)
+            mx = max_sequence_len(table)
+        exe = fluid.Executor()
+        exe.run(startup)
+        tab, m = exe.run(main, feed={"lens": np.array([2, 4, 1, 4],
+                                                      np.int64)},
+                         fetch_list=[table, mx])
+        tab = np.asarray(tab)
+        # stable desc: lengths [4,4,2,1], indices [1,3,0,2]
+        np.testing.assert_array_equal(tab[:, 1], [4, 4, 2, 1])
+        np.testing.assert_array_equal(tab[:, 0], [1, 3, 0, 2])
+        assert int(np.asarray(m)) == 4
+
+
+def test_lod_tensor_to_array_roundtrip():
+    _with_eager()
+    try:
+        with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [None, 4, 2])
+                lens = fluid.data("lens", [None], dtype="int64")
+                table = lod_rank_table(None, lengths=lens)
+                arr = lod_tensor_to_array(x, table)
+                back = array_to_lod_tensor(arr, table)
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.default_rng(0)
+            xv = rng.normal(size=(3, 4, 2)).astype(np.float32)
+            lv = np.array([2, 4, 1], np.int64)
+            # zero the padding so the roundtrip comparison is exact
+            for i, n in enumerate(lv):
+                xv[i, int(n):] = 0.0
+            (out,) = exe.run(main, feed={"x": xv, "lens": lv},
+                             fetch_list=[back])
+            np.testing.assert_allclose(np.asarray(out), xv)
+    finally:
+        _without_eager()
+
+
+def test_shrink_memory_prefix():
+    _with_eager()
+    try:
+        with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                mem = fluid.data("mem", [None, 3])
+                step = fluid.data("i", [1], dtype="int64")
+                lens = fluid.data("lens", [None], dtype="int64")
+                table = lod_rank_table(None, lengths=lens)
+                out = shrink_memory(mem, step, table)
+            exe = fluid.Executor()
+            exe.run(startup)
+            mv = np.arange(12, dtype=np.float32).reshape(4, 3)
+            lv = np.array([2, 4, 1, 3], np.int64)   # sorted: 4,3,2,1
+            for i, expect in [(0, 4), (1, 3), (2, 2), (3, 1)]:
+                (o,) = exe.run(main,
+                               feed={"mem": mv,
+                                     "i": np.array([i], np.int64),
+                                     "lens": lv},
+                               fetch_list=[out])
+                assert np.asarray(o).shape == (expect, 3), (i, expect)
+    finally:
+        _without_eager()
