@@ -278,6 +278,8 @@ def _per_use_attr(attr, suffix):
     reference rnn_impl suffixes names per layer the same way)."""
     from ...framework.param_attr import ParamAttr
 
+    if isinstance(attr, str):           # string form names the param too
+        return ParamAttr(name=f"{attr}_{suffix}")
     if attr is None or attr is False or not getattr(attr, "name", None):
         return attr
     a = ParamAttr(name=f"{attr.name}_{suffix}",

@@ -308,6 +308,18 @@ def _run_array_op(op, env, rng_box, const_env=None):
         arr = env[op.inputs["Array"][0]]
         env[op.outputs["Out"][0]] = jnp.asarray(len(arr), jnp.int32)
         return
+    if t in ("lod_tensor_to_array", "array_to_lod_tensor"):
+        # row counts are value-dependent -> concrete values only, same
+        # contract as _DYNAMIC_SHAPE_OPS but routed via the array table
+        import jax.core as _core
+
+        probe = [env.get(n) for names in op.inputs.values()
+                 for n in names]
+        if any(isinstance(v, _core.Tracer) for v in probe):
+            raise NotImplementedError(
+                f"op '{t}' has data-dependent output shapes and cannot "
+                f"run under the jitted executor; set "
+                f"FLAGS_eager_executor=1 for this program")
     if t == "lod_tensor_to_array":
         # control_flow.py:1132 parity: split [B, T, ...] into
         # per-timestep slices over the rank-table's still-active prefix.

@@ -1,0 +1,142 @@
+"""Per-op microbenchmark harness.
+
+Parity: /root/reference/paddle/fluid/operators/benchmark/op_tester.cc
+(+ op_tester_config.cc): run one registered op repeatedly from a config
+describing input shapes/dtypes/attrs, report per-iteration latency.
+The reference builds a one-op ProgramDesc and loops Executor::Run; here
+the kernel jits once (trace + compile excluded from the timing loop,
+the analogue of the reference's warm-up run) and the timed region is
+device-side execution only.
+
+Usage (python -m paddle_tpu.ops.benchmark):
+
+    python -m paddle_tpu.ops.benchmark --op matmul \
+        --input "X:float32:64x256" --input "Y:float32:256x256" \
+        --repeat 100
+    python -m paddle_tpu.ops.benchmark --config bench_ops.json
+
+Config file: a JSON list of {"op": ..., "inputs": {slot: {"shape": [...],
+"dtype": ...}}, "attrs": {...}, "repeat": N} entries (the reference's
+op_tester_config text format, in JSON).
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+__all__ = ["OpBenchConfig", "run_op_benchmark", "main"]
+
+
+class OpBenchConfig:
+    """One benchmark case (op_tester_config.h OpTesterConfig)."""
+
+    def __init__(self, op, inputs, attrs=None, repeat=100, warmup=3):
+        self.op = op
+        self.inputs = inputs            # {slot: {"shape":[...], "dtype":..}}
+        self.attrs = attrs or {}
+        self.repeat = repeat
+        self.warmup = warmup
+
+    @staticmethod
+    def from_dict(d):
+        return OpBenchConfig(d["op"], d["inputs"], d.get("attrs"),
+                             d.get("repeat", 100), d.get("warmup", 3))
+
+
+def _materialize(spec, rng):
+    shape = tuple(spec.get("shape", ()))
+    dtype = np.dtype(spec.get("dtype", "float32"))
+    if np.issubdtype(dtype, np.integer):
+        hi = int(spec.get("high", 100))
+        return rng.integers(0, hi, shape).astype(dtype)
+    if dtype == np.bool_:
+        return rng.integers(0, 2, shape).astype(bool)
+    return rng.standard_normal(shape).astype(dtype)
+
+
+def run_op_benchmark(config, seed=0):
+    """Time one op kernel; returns a dict with per-iteration stats.
+
+    Timed region = jitted kernel execution with host sync, after
+    warm-up compiles — op_tester.cc's RunImpl loop with the build
+    excluded.
+    """
+    import jax
+
+    from .registry import get_op
+
+    opdef = get_op(config.op)
+    rng = np.random.default_rng(seed)
+    ins = {slot: _materialize(spec, rng)
+           for slot, spec in config.inputs.items()}
+    attrs = dict(config.attrs)
+    if getattr(opdef, "needs_rng", False):
+        attrs["_rng"] = jax.random.PRNGKey(seed)
+
+    fn = jax.jit(lambda ins: opdef.fn(ins, attrs))
+    out = fn(ins)
+    jax.block_until_ready(out)              # compile outside the timing
+    for _ in range(config.warmup):
+        jax.block_until_ready(fn(ins))
+
+    times = []
+    for _ in range(config.repeat):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(ins))
+        times.append(time.perf_counter() - t0)
+    times = np.asarray(times)
+    return {
+        "op": config.op,
+        "repeat": config.repeat,
+        "latency_us_mean": float(times.mean() * 1e6),
+        "latency_us_min": float(times.min() * 1e6),
+        "latency_us_p50": float(np.percentile(times, 50) * 1e6),
+        "latency_us_p99": float(np.percentile(times, 99) * 1e6),
+        "device": str(jax.devices()[0].platform),
+    }
+
+
+def _parse_input(text):
+    """CLI form slot:dtype:AxBxC."""
+    slot, dtype, shape = text.split(":")
+    return slot, {"dtype": dtype,
+                  "shape": [int(s) for s in shape.split("x") if s]}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-op latency microbenchmark (op_tester.cc parity)")
+    ap.add_argument("--op")
+    ap.add_argument("--input", action="append", default=[],
+                    help="slot:dtype:AxBxC (repeatable)")
+    ap.add_argument("--attrs", default="{}", help="JSON attrs")
+    ap.add_argument("--repeat", type=int, default=100)
+    ap.add_argument("--config", help="JSON file with a list of cases")
+    ap.add_argument("--platform",
+                    help="force a jax platform (e.g. cpu) before backend "
+                         "init — overrides a site-pinned JAX_PLATFORMS")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    cases = []
+    if args.config:
+        with open(args.config) as f:
+            cases = [OpBenchConfig.from_dict(d) for d in json.load(f)]
+    if args.op:
+        cases.append(OpBenchConfig(
+            args.op, dict(_parse_input(i) for i in args.input),
+            json.loads(args.attrs), args.repeat))
+    if not cases:
+        ap.error("need --op or --config")
+    for case in cases:
+        print(json.dumps(run_op_benchmark(case)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -74,7 +74,7 @@ def is_shard_aware(reader):
                                p.POSITIONAL_OR_KEYWORD)]
     if len(required) == 2:
         return True
-    if len(required) in (1,) or len(required) > 2:
+    if required:
         raise TypeError(
             f"reader {reader!r} requires {len(required)} positional "
             f"parameters — a multiprocess reader must require either "

@@ -1,0 +1,50 @@
+"""Per-op microbenchmark harness (op_tester.cc parity)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+from paddle_tpu.ops.benchmark import OpBenchConfig, run_op_benchmark
+
+
+def test_matmul_benchmark_reports_latency():
+    cfg = OpBenchConfig("matmul",
+                        {"X": {"shape": [32, 64], "dtype": "float32"},
+                         "Y": {"shape": [64, 16], "dtype": "float32"}},
+                        repeat=5, warmup=1)
+    r = run_op_benchmark(cfg)
+    assert r["op"] == "matmul"
+    assert r["latency_us_min"] > 0
+    assert r["latency_us_min"] <= r["latency_us_mean"]
+    assert r["latency_us_p50"] <= r["latency_us_p99"] + 1e-9
+
+
+def test_rng_op_benchmark():
+    cfg = OpBenchConfig("dropout",
+                        {"X": {"shape": [64, 64], "dtype": "float32"}},
+                        attrs={"dropout_prob": 0.3}, repeat=3, warmup=1)
+    r = run_op_benchmark(cfg)
+    assert r["latency_us_mean"] > 0
+
+
+def test_int_input_spec():
+    cfg = OpBenchConfig(
+        "lookup_table",
+        {"W": {"shape": [100, 8], "dtype": "float32"},
+         "Ids": {"shape": [16, 1], "dtype": "int64", "high": 100}},
+        repeat=2, warmup=1)
+    r = run_op_benchmark(cfg)
+    assert r["latency_us_mean"] > 0
+
+
+def test_cli_entrypoint():
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.ops.benchmark",
+         "--op", "relu", "--input", "X:float32:16x16", "--repeat", "3",
+         "--platform", "cpu"],
+        capture_output=True, text=True, timeout=300, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-500:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["op"] == "relu" and rec["latency_us_mean"] > 0

@@ -72,6 +72,21 @@ def test_downpour_ctr_loss_falls():
     """The full Downpour loop through the public API: pull sparse rows ->
     jitted program step (emb var in parameter_list) -> push grads.
     Loss must fall over epochs (dist_fleet_ctr parity)."""
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        # isolate from suite-order state: scope, names, init seed
+        stack.enter_context(fluid.scope_guard(fluid.Scope()))
+        stack.enter_context(fluid.unique_name.guard())
+        old_seed = fluid.flags.flag("global_seed")
+        fluid.flags.set_flags({"FLAGS_global_seed": 0})
+        stack.callback(
+            lambda: fluid.flags.set_flags(
+                {"FLAGS_global_seed": old_seed}))
+        _downpour_ctr_body()
+
+
+def _downpour_ctr_body():
     dim = 8
     table = SparseEmbedding(dim=dim, num_shards=2, optimizer="adagrad",
                             lr=0.2, seed=0)
