@@ -245,7 +245,7 @@ def bench_wide_deep(on_tpu, peak):
             "vs_baseline": None, "step_ms": round(dt * 1e3, 2)}
 
 
-def _probe_backend(timeouts=(240, 360, 480), pause=30):
+def _probe_backend(timeouts=(180, 240, 300), pause=20):
     """The accelerator tunnel can wedge; probe it OUT of process so a
     sick backend degrades the bench to CPU instead of hanging the
     driver.  A single failed probe does NOT surrender: cold tunnels have

@@ -59,6 +59,11 @@ from . import average
 from . import install_check
 from . import model_stat
 from . import contrib
+from . import (communicator, compiler, data_feeder, evaluator,  # noqa: F401
+               executor, input, lod_tensor, log_helper, param_attr,
+               parallel_executor)
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from .param_attr import WeightNormParamAttr  # noqa: F401
 from . import sysconfig
 from . import utils
 from .lod import (LoDTensor, create_lod_tensor,

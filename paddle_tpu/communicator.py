@@ -1,0 +1,9 @@
+"""`fluid.communicator` import-path compatibility.
+
+Parity: python/paddle/fluid/communicator.py — the PS Communicator lives
+in distributed/ps.py (sync/async/half_async/geo modes).
+"""
+
+from .distributed.ps import Communicator  # noqa: F401
+
+__all__ = ["Communicator"]

@@ -1,0 +1,9 @@
+"""`fluid.compiler` import-path compatibility.
+
+Parity: python/paddle/fluid/compiler.py — implementation in
+framework/compiler.py.
+"""
+
+from .framework.compiler import CompiledProgram  # noqa: F401
+
+__all__ = ["CompiledProgram"]

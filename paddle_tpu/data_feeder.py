@@ -1,0 +1,9 @@
+"""`fluid.data_feeder` import-path compatibility.
+
+Parity: python/paddle/fluid/data_feeder.py — implementation in
+reader/__init__.py.
+"""
+
+from .reader import DataFeeder  # noqa: F401
+
+__all__ = ["DataFeeder"]

@@ -1,0 +1,11 @@
+"""`fluid.evaluator` import-path compatibility.
+
+Parity: python/paddle/fluid/evaluator.py — the deprecated Evaluator
+classes forwarded to their fluid.metrics successors (exactly what the
+reference deprecation notes instruct).
+"""
+
+from .metrics import (ChunkEvaluator, DetectionMAP,  # noqa: F401
+                      EditDistance)
+
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP"]

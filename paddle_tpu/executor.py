@@ -1,0 +1,11 @@
+"""`fluid.executor` import-path compatibility.
+
+Parity: python/paddle/fluid/executor.py — the implementation lives in
+framework/executor.py; this module preserves the reference import path
+(`from paddle.fluid.executor import Executor, global_scope`).
+"""
+
+from .framework.executor import (Executor, Scope, global_scope,  # noqa: F401
+                                 scope_guard)
+
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]

@@ -313,8 +313,8 @@ def _run_array_op(op, env, rng_box, const_env=None):
         # contract as _DYNAMIC_SHAPE_OPS but routed via the array table
         import jax.core as _core
 
-        probe = [env.get(n) for names in op.inputs.values()
-                 for n in names]
+        probe = jax.tree.leaves(
+            [env.get(n) for names in op.inputs.values() for n in names])
         if any(isinstance(v, _core.Tracer) for v in probe):
             raise NotImplementedError(
                 f"op '{t}' has data-dependent output shapes and cannot "

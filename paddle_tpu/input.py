@@ -1,0 +1,10 @@
+"""`fluid.input` import-path compatibility.
+
+Parity: python/paddle/fluid/input.py (one_hot :25, embedding :152) —
+both implemented in the layers package.
+"""
+
+from .layers.nn import embedding  # noqa: F401
+from .layers import one_hot  # noqa: F401
+
+__all__ = ["one_hot", "embedding"]

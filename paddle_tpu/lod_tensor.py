@@ -1,0 +1,11 @@
+"""`fluid.lod_tensor` import-path compatibility.
+
+Parity: python/paddle/fluid/lod_tensor.py — implementation in lod.py
+(incl. multi-level LoD).
+"""
+
+from .lod import (LoDTensor, create_lod_tensor,  # noqa: F401
+                  create_random_int_lodtensor)
+
+__all__ = ["LoDTensor", "create_lod_tensor",
+           "create_random_int_lodtensor"]

@@ -76,6 +76,10 @@ def run_op_benchmark(config, seed=0):
         attrs["_rng"] = jax.random.PRNGKey(seed)
 
     fn = jax.jit(lambda ins: opdef.fn(ins, attrs))
+    # device-resident inputs: the timed region must not include the
+    # per-call host-to-device upload
+    ins = jax.device_put(ins)
+    jax.block_until_ready(ins)
     out = fn(ins)
     jax.block_until_ready(out)              # compile outside the timing
     for _ in range(config.warmup):
@@ -113,6 +117,7 @@ def main(argv=None):
                     help="slot:dtype:AxBxC (repeatable)")
     ap.add_argument("--attrs", default="{}", help="JSON attrs")
     ap.add_argument("--repeat", type=int, default=100)
+    ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--config", help="JSON file with a list of cases")
     ap.add_argument("--platform",
                     help="force a jax platform (e.g. cpu) before backend "
@@ -131,7 +136,7 @@ def main(argv=None):
     if args.op:
         cases.append(OpBenchConfig(
             args.op, dict(_parse_input(i) for i in args.input),
-            json.loads(args.attrs), args.repeat))
+            json.loads(args.attrs), args.repeat, args.warmup))
     if not cases:
         ap.error("need --op or --config")
     for case in cases:

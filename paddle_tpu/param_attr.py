@@ -1,0 +1,19 @@
+"""`fluid.param_attr` import-path compatibility.
+
+Parity: python/paddle/fluid/param_attr.py (ParamAttr :27,
+WeightNormParamAttr :187 — the weight-norm reparameterization attr; the
+`dim` knob is carried for API parity, the normalization itself rides
+the initializer/regularizer hooks).
+"""
+
+from .framework.param_attr import ParamAttr  # noqa: F401
+
+__all__ = ["ParamAttr", "WeightNormParamAttr"]
+
+
+class WeightNormParamAttr(ParamAttr):
+    """param_attr.py:187 — ParamAttr carrying the weight-norm dim."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim

@@ -1,0 +1,61 @@
+"""Import-path compatibility modules (fluid.executor, fluid.compiler,
+fluid.param_attr, ... and the ParallelExecutor facade).
+
+Parity: the reference's top-level fluid module layout — 1.x user
+scripts import from these paths directly.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_import_paths_resolve():
+    from paddle_tpu.communicator import Communicator
+    from paddle_tpu.compiler import CompiledProgram
+    from paddle_tpu.data_feeder import DataFeeder
+    from paddle_tpu.evaluator import ChunkEvaluator
+    from paddle_tpu.executor import Executor, global_scope
+    from paddle_tpu.input import embedding, one_hot
+    from paddle_tpu.lod_tensor import create_lod_tensor
+    from paddle_tpu.log_helper import get_logger
+    from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
+
+    assert Executor is fluid.Executor
+    assert CompiledProgram is fluid.CompiledProgram
+    assert ParamAttr is fluid.ParamAttr
+    attr = WeightNormParamAttr(dim=0, name="wn")
+    assert attr.dim == 0 and attr.name == "wn"
+    import logging
+
+    lg = get_logger("compat_test", logging.INFO, fmt="%(message)s")
+    assert lg.level == logging.INFO
+
+
+def test_parallel_executor_facade_trains():
+    with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [None, 4])
+            y = fluid.data("y", [None, 1])
+            loss = layers.mean(layers.square_error_cost(
+                fluid.layers.fc(x, 1), y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        fluid.Executor().run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                    main_program=main)
+        rng = np.random.default_rng(0)
+        w = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+        losses = []
+        for _ in range(15):
+            xb = rng.normal(size=(32, 4)).astype(np.float32)
+            out = pe.run(fetch_list=[loss],
+                         feed={"x": xb, "y": xb @ w})
+            losses.append(float(np.asarray(out[0]).mean()))
+        assert losses[-1] < losses[0] * 0.5
+        # deprecated feed_dict alias still works
+        out = pe.run(fetch_list=[loss],
+                     feed_dict={"x": np.zeros((8, 4), np.float32),
+                                "y": np.zeros((8, 1), np.float32)})
+        assert np.isfinite(float(np.asarray(out[0]).mean()))
