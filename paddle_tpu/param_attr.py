@@ -1,9 +1,10 @@
 """`fluid.param_attr` import-path compatibility.
 
 Parity: python/paddle/fluid/param_attr.py (ParamAttr :27,
-WeightNormParamAttr :187 — the weight-norm reparameterization attr; the
-`dim` knob is carried for API parity, the normalization itself rides
-the initializer/regularizer hooks).
+WeightNormParamAttr :187).  A WeightNormParamAttr on a layer weight
+triggers the real reparameterization in LayerHelper.create_parameter:
+w = g * v / ||v|| with the norm over every axis except `dim`, g/v the
+trainable parameters (layer_helper_base.py parity).
 """
 
 from .framework.param_attr import ParamAttr  # noqa: F401

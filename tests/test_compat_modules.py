@@ -33,6 +33,66 @@ def test_import_paths_resolve():
     assert lg.level == logging.INFO
 
 
+def test_weight_norm_param_attr_reparameterizes():
+    from paddle_tpu.param_attr import WeightNormParamAttr
+
+    with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [None, 4])
+            y = fluid.data("y", [None, 1])
+            pred = fluid.layers.fc(
+                x, 3, param_attr=WeightNormParamAttr(dim=1, name="wn"),
+                bias_attr=False)
+            loss = layers.mean(layers.square_error_cost(
+                fluid.layers.fc(pred, 1), y))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        params = {p.name for p in main.global_block().all_parameters()}
+        assert "wn.v" in params and "wn.g" in params   # reparameterized
+        assert "wn" not in params
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        w = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+        losses = []
+        for _ in range(25):
+            xb = rng.normal(size=(16, 4)).astype(np.float32)
+            out = exe.run(main, feed={"x": xb, "y": xb @ w},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(out[0])))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        # g directly scales each output column's weight norm
+        scope = fluid.global_scope()
+        v = np.asarray(scope.find_var("wn.v"))
+        g = np.asarray(scope.find_var("wn.g"))
+        assert v.shape == (4, 3) and g.shape == (3,)
+
+
+def test_weight_norm_step0_equals_v():
+    # reference layer_helper_base initializes g = ||v||, so the
+    # effective weight at step 0 IS v; dim=-1 must normalize like dim=1
+    from paddle_tpu.param_attr import WeightNormParamAttr
+
+    for dim in (1, -1):
+        with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [None, 4])
+                pred = fluid.layers.fc(
+                    x, 3, bias_attr=False,
+                    param_attr=WeightNormParamAttr(dim=dim, name="wn"))
+            exe = fluid.Executor()
+            exe.run(startup)
+            scope = fluid.global_scope()
+            v = np.asarray(scope.find_var("wn.v"))
+            g = np.asarray(scope.find_var("wn.g"))
+            np.testing.assert_allclose(
+                g, np.linalg.norm(v, axis=0), rtol=1e-6)
+            xb = np.eye(4, dtype=np.float32)
+            (out,) = exe.run(main, feed={"x": xb}, fetch_list=[pred])
+            np.testing.assert_allclose(np.asarray(out), v, rtol=1e-5)
+
+
 def test_parallel_executor_facade_trains():
     with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
         main, startup = fluid.Program(), fluid.Program()
