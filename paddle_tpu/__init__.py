@@ -63,6 +63,9 @@ from . import (communicator, compiler, data_feeder, evaluator,  # noqa: F401
                executor, input, lod_tensor, log_helper, param_attr,
                parallel_executor)
 from .parallel_executor import ParallelExecutor  # noqa: F401
+from . import compat  # noqa: F401
+from . import incubate  # noqa: F401
+from .reader import batch  # noqa: F401
 from .param_attr import WeightNormParamAttr  # noqa: F401
 from . import sysconfig
 from . import utils

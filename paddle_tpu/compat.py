@@ -1,0 +1,59 @@
+"""`paddle.compat` parity (python/paddle/compat.py) — py2/py3 string
+shims that 1.x scripts import; on py3 they reduce to the obvious
+conversions (the reference's own py3 branches)."""
+
+import math
+
+__all__ = ["long_type", "to_text", "to_bytes", "round",
+           "floor_division", "get_exception_message"]
+
+long_type = int
+
+
+def _convert(obj, conv, inplace):
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_convert(i, conv, False) for i in obj]
+            return obj
+        return [_convert(i, conv, False) for i in obj]
+    if isinstance(obj, set):
+        if inplace:
+            vals = [_convert(i, conv, False) for i in obj]
+            obj.clear()
+            obj.update(vals)
+            return obj
+        return {_convert(i, conv, False) for i in obj}
+    return conv(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    # reference semantics: only bytes decode; str passes through and
+    # every other type (None, bool, float, ...) is returned UNCHANGED
+    return _convert(
+        obj, lambda o: o.decode(encoding)
+        if isinstance(o, (bytes, bytearray)) else o, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    return _convert(
+        obj, lambda o: o.encode(encoding) if isinstance(o, str) else o,
+        inplace)
+
+
+def round(x, d=0):
+    """py2-style half-away-from-zero rounding (compat.py round)."""
+    if x > 0.0:
+        p = 10 ** d
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0.0:
+        p = 10 ** d
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return math.copysign(0.0, x)
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

@@ -42,7 +42,11 @@ class LayerHelper:
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None
-        if hasattr(attr, "dim") and not is_bias:
+        from .param_attr import WeightNormParamAttr
+
+        if isinstance(attr, WeightNormParamAttr):
+            # ANY parameter with this attr reparameterizes, bias
+            # included (layer_helper_base.py:327)
             return self._create_weight_norm_parameter(
                 attr, shape, dtype, default_initializer)
         suffix = "b" if is_bias else "w"
@@ -78,17 +82,21 @@ class LayerHelper:
         from .param_attr import ParamAttr as _PA
 
         base = attr.name or unique_name.generate(f"{self.name}.w")
-        inner = _PA(name=None, initializer=attr.initializer,
+        inner = _PA(name=base + "_v", initializer=attr.initializer,
                     learning_rate=attr.learning_rate,
                     regularizer=attr.regularizer, trainable=attr.trainable)
-        inner.name = base + ".v"
         v = self.create_parameter(inner, shape, dtype=dtype,
                                   default_initializer=default_initializer)
         dim = attr.dim
         if dim is not None:
             dim = dim % len(shape)          # negative dims normalize
-        g_shape = [shape[dim]] if dim is not None else [1]
-        g_attr = _PA(name=base + ".g", learning_rate=attr.learning_rate,
+        # g keeps the weight's rank: shape[dim] on dim, 1 elsewhere
+        # (layer_helper_base.py:232-234) — checkpoints match by shape
+        g_shape = [1] * len(shape)
+        if dim is not None:
+            g_shape[dim] = shape[dim]
+        g_attr = _PA(name=base + "_g", learning_rate=attr.learning_rate,
+                     regularizer=attr.regularizer,
                      trainable=attr.trainable,
                      initializer=ConstantInitializer(1.0))
         g = self.create_parameter(g_attr, g_shape, dtype=dtype)
@@ -111,11 +119,12 @@ class LayerHelper:
         sb = self.startup_program.global_block()
         raw = unique_name.generate(base + ".wn_g0")
         sb.create_var(name=raw, dtype=dtype)
-        norm_ops(sb, v.name, raw, keep_dim=False)
+        norm_ops(sb, v.name, raw, keep_dim=True)
         sb.append_op("reshape2", {"X": [raw]}, {"Out": [g.name]},
                      {"shape": list(g_shape)})
 
-        # main program: w = g * v / ||v|| recomputed per step
+        # main program: w = g * v / ||v|| recomputed per step; g is
+        # rank-preserved so plain -1 broadcasting applies throughout
         norm = self.create_variable_for_type_inference(dtype)
         norm_ops(self.main_program.global_block(), v.name, norm.name,
                  keep_dim=True)
@@ -124,8 +133,7 @@ class LayerHelper:
                        {"Out": unit}, {"axis": -1})
         w = self.create_variable_for_type_inference(dtype)
         self.append_op("elementwise_mul", {"X": unit, "Y": g},
-                       {"Out": w}, {"axis": dim if dim is not None
-                                    else -1})
+                       {"Out": w}, {"axis": -1})
         w.shape = list(shape)
         return w
 

@@ -30,3 +30,15 @@ class ParamAttr:
         if isinstance(arg, Initializer):
             return ParamAttr(initializer=arg)
         raise TypeError(f"cannot convert {arg!r} to ParamAttr")
+
+
+class WeightNormParamAttr(ParamAttr):
+    """param_attr.py:187 — triggers the w = g * v / ||v||
+    reparameterization in LayerHelper.create_parameter
+    (layer_helper_base.py:87 parity): parameters become name_v / name_g
+    (g rank-preserved, size shape[dim] on `dim`, singletons elsewhere),
+    with g initialized to ||v|| so the step-0 weight equals v."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim

@@ -1,0 +1,12 @@
+"""`fluid.incubate` surface.
+
+Parity: python/paddle/fluid/incubate/ — fleet lives in
+paddle_tpu.distributed.fleet (aliased here); data_generator is the ETL
+helper emitting MultiSlot text consumed by QueueDataset/
+InMemoryDataset (csrc/data_feed.cpp).
+"""
+
+from ..distributed import fleet  # noqa: F401
+from . import data_generator  # noqa: F401
+
+__all__ = ["fleet", "data_generator"]

@@ -7,14 +7,7 @@ w = g * v / ||v|| with the norm over every axis except `dim`, g/v the
 trainable parameters (layer_helper_base.py parity).
 """
 
-from .framework.param_attr import ParamAttr  # noqa: F401
+from .framework.param_attr import (ParamAttr,  # noqa: F401
+                                   WeightNormParamAttr)
 
 __all__ = ["ParamAttr", "WeightNormParamAttr"]
-
-
-class WeightNormParamAttr(ParamAttr):
-    """param_attr.py:187 — ParamAttr carrying the weight-norm dim."""
-
-    def __init__(self, dim=None, **kwargs):
-        super().__init__(**kwargs)
-        self.dim = dim

@@ -23,6 +23,8 @@ def test_import_paths_resolve():
     from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr
 
     assert Executor is fluid.Executor
+    assert fluid.compat.to_text(None) is None       # passthrough
+    assert fluid.compat.to_text(1.5) == 1.5
     assert CompiledProgram is fluid.CompiledProgram
     assert ParamAttr is fluid.ParamAttr
     attr = WeightNormParamAttr(dim=0, name="wn")
@@ -48,7 +50,7 @@ def test_weight_norm_param_attr_reparameterizes():
                 fluid.layers.fc(pred, 1), y))
             fluid.optimizer.SGD(0.05).minimize(loss)
         params = {p.name for p in main.global_block().all_parameters()}
-        assert "wn.v" in params and "wn.g" in params   # reparameterized
+        assert "wn_v" in params and "wn_g" in params   # reparameterized
         assert "wn" not in params
         exe = fluid.Executor()
         exe.run(startup)
@@ -63,9 +65,9 @@ def test_weight_norm_param_attr_reparameterizes():
         assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
         # g directly scales each output column's weight norm
         scope = fluid.global_scope()
-        v = np.asarray(scope.find_var("wn.v"))
-        g = np.asarray(scope.find_var("wn.g"))
-        assert v.shape == (4, 3) and g.shape == (3,)
+        v = np.asarray(scope.find_var("wn_v"))
+        g = np.asarray(scope.find_var("wn_g"))
+        assert v.shape == (4, 3) and g.shape == (1, 3)
 
 
 def test_weight_norm_step0_equals_v():
@@ -84,10 +86,10 @@ def test_weight_norm_step0_equals_v():
             exe = fluid.Executor()
             exe.run(startup)
             scope = fluid.global_scope()
-            v = np.asarray(scope.find_var("wn.v"))
-            g = np.asarray(scope.find_var("wn.g"))
+            v = np.asarray(scope.find_var("wn_v"))
+            g = np.asarray(scope.find_var("wn_g"))
             np.testing.assert_allclose(
-                g, np.linalg.norm(v, axis=0), rtol=1e-6)
+                g.ravel(), np.linalg.norm(v, axis=0), rtol=1e-6)
             xb = np.eye(4, dtype=np.float32)
             (out,) = exe.run(main, feed={"x": xb}, fetch_list=[pred])
             np.testing.assert_allclose(np.asarray(out), v, rtol=1e-5)

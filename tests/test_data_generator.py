@@ -1,0 +1,194 @@
+"""incubate.data_generator tests: the ETL surface that writes MultiSlot
+text consumed by the native data feed.
+
+Parity: incubate/data_generator/__init__.py + its test_data_generator.py
+— and the integration contract: generator output files feed
+QueueDataset -> train_from_dataset unchanged.
+"""
+
+import io
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.datasets.multislot import QueueDataset
+from paddle_tpu.incubate.data_generator import (
+    DataGenerator,
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+)
+
+
+class _WordsLabel(MultiSlotDataGenerator):
+    def generate_sample(self, line):
+        def it():
+            if line is None:
+                for i in range(4):
+                    yield [("ids", [i, i + 1]), ("label", [i % 2])]
+            else:
+                vals = [int(x) for x in line.split()]
+                yield [("ids", vals[:-1]), ("label", [vals[-1]])]
+
+        return it
+
+
+def test_multislot_text_format():
+    g = _WordsLabel()
+    out = io.StringIO()
+    g.run_from_memory(out=out)
+    lines = out.getvalue().splitlines()
+    assert lines[0] == "2 0 1 1 0"
+    assert len(lines) == 4
+    assert g._proto_info == [("ids", "uint64"), ("label", "uint64")]
+
+
+def test_stdin_driver():
+    g = _WordsLabel()
+    import sys
+
+    out = io.StringIO()
+    old = sys.stdin
+    sys.stdin = io.StringIO("7 8 1\n4 5 0\n")
+    try:
+        g.run_from_stdin(out=out)
+    finally:
+        sys.stdin = old
+    assert out.getvalue() == "2 7 8 1 1\n2 4 5 1 0\n"
+
+
+def test_string_generator_and_float_promotion():
+    class S(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("a", ["x", "y"])]
+
+            return it
+
+    s = S()
+    out = io.StringIO()
+    s.run_from_memory(out=out)
+    assert out.getvalue() == "2 x y\n"
+
+    class F(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("v", [1])]
+                yield [("v", [1.5])]
+
+            return it
+
+    f = F()
+    out = io.StringIO()
+    f.run_from_memory(out=out)
+    assert f._proto_info == [("v", "float")]
+
+
+def test_slot_count_change_rejected():
+    class Bad(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("a", [1])]
+                yield [("a", [1]), ("b", [2])]
+
+            return it
+
+    with pytest.raises(ValueError, match="field set changed"):
+        Bad().run_from_memory(out=io.StringIO())
+
+
+def test_slot_name_change_rejected():
+    class Swapped(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("ids", [1]), ("label", [0])]
+                yield [("label", [0]), ("ids", [1])]   # column swap!
+
+            return it
+
+    with pytest.raises(ValueError, match="not match"):
+        Swapped().run_from_memory(out=io.StringIO())
+
+
+def test_generate_batch_hook():
+    class Batched(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                for i in range(4):
+                    yield [("v", [i])]
+
+            return it
+
+        def generate_batch(self, samples):
+            def it():
+                # batch-level transform: emit batch-max alongside
+                mx = max(s[0][1][0] for s in samples)
+                for s in samples:
+                    yield [("v", s[0][1]), ("mx", [mx])]
+
+            return it
+
+    g = Batched()
+    g.set_batch(2)
+    out = io.StringIO()
+    g.run_from_memory(out=out)
+    assert out.getvalue() == ("1 0 1 1\n1 1 1 1\n"
+                              "1 2 1 3\n1 3 1 3\n")
+
+
+def test_generator_files_feed_train_from_dataset():
+    # full loop: generator writes part files -> native MultiSlot feed
+    # -> static training step
+    class CTR(MultiSlotDataGenerator):
+        def __init__(self, seed):
+            super().__init__()
+            self._rng = np.random.default_rng(seed)
+
+        def generate_sample(self, line):
+            def it():
+                for _ in range(64):
+                    ids = self._rng.integers(0, 20, 2)
+                    yield [("ids", [int(i) for i in ids]),
+                           ("label", [float(int(ids.sum()) % 2)])]
+
+            return it
+
+    with tempfile.TemporaryDirectory() as tmp:
+        files = []
+        for i in range(2):
+            path = os.path.join(tmp, f"part-{i}")
+            with open(path, "w") as f:
+                CTR(seed=i).run_from_memory(out=f)
+            files.append(path)
+
+        ds = QueueDataset()
+        ds.set_filelist(files)
+        ds.set_batch_size(16)
+        ds.set_thread(2)
+        ds.set_use_var([("ids", "int64", 2), ("label", "float", 1)])
+
+        with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                ids = fluid.data("ids", [None, 2], dtype="int64")
+                label = fluid.data("label", [None, 1])
+                oh = layers.cast(layers.one_hot(
+                    layers.reshape(ids, [-1, 2, 1]), 20), "float32")
+                logit = fluid.layers.fc(
+                    layers.reshape(oh, [-1, 40]), 1)
+                loss = layers.mean(
+                    layers.sigmoid_cross_entropy_with_logits(
+                        logit, label))
+                fluid.optimizer.Adam(0.05).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            losses = []
+            for _ in range(6):
+                out = exe.train_from_dataset(main, ds,
+                                             fetch_list=[loss],
+                                             print_period=10 ** 6)
+                losses.append(float(np.asarray(out[0])))
+        assert losses[-1] < losses[0], losses
