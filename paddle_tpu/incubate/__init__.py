@@ -6,7 +6,7 @@ helper emitting MultiSlot text consumed by QueueDataset/
 InMemoryDataset (csrc/data_feed.cpp).
 """
 
-from ..distributed import fleet  # noqa: F401
 from . import data_generator  # noqa: F401
+from . import fleet  # noqa: F401
 
 __all__ = ["fleet", "data_generator"]

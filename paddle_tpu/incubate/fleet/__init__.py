@@ -1,0 +1,18 @@
+"""`fluid.incubate.fleet` import-path surface over
+paddle_tpu.distributed.fleet (role makers, DistributedStrategy wired to
+real features, distributed_optimizer, rank-0 save facades) plus the
+base/collective/parameter_server/utils subpackages."""
+
+import sys as _sys
+
+from paddle_tpu.distributed import fleet as _impl
+
+_self = _sys.modules[__name__]
+for _n in dir(_impl):
+    if not _n.startswith("_"):
+        setattr(_self, _n, getattr(_impl, _n))
+
+from . import base, collective, parameter_server, utils  # noqa: F401,E402
+
+__all__ = ([n for n in dir(_impl) if not n.startswith("_")]
+           + ["base", "collective", "parameter_server", "utils"])

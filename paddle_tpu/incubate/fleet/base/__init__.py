@@ -1,0 +1,3 @@
+"""`fluid.incubate.fleet.base` — role makers + fleet facade."""
+
+from . import role_maker  # noqa: F401

@@ -1,0 +1,10 @@
+"""Parity: incubate/fleet/base/role_maker.py — PaddleCloudRoleMaker
+(:PADDLE_TRAINER_ID env discovery) and UserDefinedRoleMaker; the
+implementations live in paddle_tpu.distributed.fleet."""
+
+from paddle_tpu.distributed.fleet import (  # noqa: F401
+    PaddleCloudRoleMaker,
+    UserDefinedRoleMaker,
+)
+
+__all__ = ["PaddleCloudRoleMaker", "UserDefinedRoleMaker"]

@@ -1,0 +1,16 @@
+"""Parity: incubate/fleet/collective/__init__.py — the collective
+(allreduce) fleet mode: `from ...collective import fleet` then
+fleet.init / fleet.distributed_optimizer(strategy).minimize.  The
+DistributedStrategy knobs route to real features
+(distributed/fleet.py)."""
+
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed.fleet import (  # noqa: F401
+    DistributedStrategy,
+    distributed_optimizer,
+)
+
+CollectiveOptimizer = distributed_optimizer
+
+__all__ = ["fleet", "DistributedStrategy", "CollectiveOptimizer",
+           "distributed_optimizer"]

@@ -1,0 +1,5 @@
+"""Parity: incubate/fleet/parameter_server/ — PS fleet modes; the
+transpiled-PS runtime lives in paddle_tpu.transpiler +
+distributed/ps.py."""
+
+from . import distribute_transpiler, pslib  # noqa: F401

@@ -1,0 +1,10 @@
+"""Parity: incubate/fleet/parameter_server/distribute_transpiler —
+fleet over DistributeTranspiler artifacts: the transpiler itself is
+paddle_tpu.transpiler.DistributeTranspiler; fleet.init and the worker
+helpers come from the shared fleet facade (distributed/fleet.py)."""
+
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.transpiler import (DistributeTranspiler,  # noqa: F401
+                                   DistributeTranspilerConfig)
+
+__all__ = ["fleet", "DistributeTranspiler", "DistributeTranspilerConfig"]

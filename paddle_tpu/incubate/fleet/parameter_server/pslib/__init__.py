@@ -1,0 +1,10 @@
+"""Parity: incubate/fleet/parameter_server/pslib — the Downpour
+sparse-PS mode: host-side sharded tables with the optimizer folded into
+push (distributed/ps.py SparseEmbedding/Communicator + csrc/
+ps_shard.cpp), driven by Executor.train_from_dataset sparse_config."""
+
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed.ps import (Communicator,  # noqa: F401
+                                       SparseEmbedding)
+
+__all__ = ["fleet", "SparseEmbedding", "Communicator"]

@@ -1,0 +1,7 @@
+"""Parity: incubate/fleet/utils — fleet_util + fs live in
+paddle_tpu.distributed."""
+
+from paddle_tpu.distributed import fleet_util  # noqa: F401
+from paddle_tpu.distributed.fs import HDFSClient, LocalFS  # noqa: F401
+
+__all__ = ["fleet_util", "LocalFS", "HDFSClient"]

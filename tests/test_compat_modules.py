@@ -35,6 +35,29 @@ def test_import_paths_resolve():
     assert lg.level == logging.INFO
 
 
+def test_incubate_fleet_import_paths():
+    # the 1.x distributed-script surface
+    from paddle_tpu.incubate.fleet.base import role_maker
+    from paddle_tpu.incubate.fleet.collective import (
+        CollectiveOptimizer,
+        DistributedStrategy,
+        fleet,
+    )
+    from paddle_tpu.incubate.fleet.parameter_server.distribute_transpiler \
+        import DistributeTranspiler
+    from paddle_tpu.incubate.fleet.parameter_server.pslib import (
+        SparseEmbedding,
+    )
+    from paddle_tpu.incubate.fleet.utils import LocalFS
+
+    rm = role_maker.UserDefinedRoleMaker(current_id=0, workers=1)
+    fleet.init(rm)
+    assert fleet.worker_index() == 0 and fleet.worker_num() == 1
+    assert fleet.is_first_worker()
+    s = DistributedStrategy()
+    assert hasattr(s, "__dict__")
+
+
 def test_weight_norm_param_attr_reparameterizes():
     from paddle_tpu.param_attr import WeightNormParamAttr
 
