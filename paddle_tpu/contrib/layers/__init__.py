@@ -127,6 +127,7 @@ def fused_embedding_seq_pool(input, size, length=None, is_sparse=False,
                      attrs={"combiner": combiner,
                             "is_sparse": is_sparse,
                             "padding_idx": padding_idx})
+    out.shape = [None, list(size)[1]]
     return out
 
 
@@ -177,6 +178,7 @@ def search_pyramid_hash(input, num_emb, space_len, pyramid_layer,
                             "rand_len": rand_len,
                             "drop_out_percent": drop_out_percent,
                             "is_training": is_training, "seed": seed})
+    out.shape = [None, num_emb]
     return out
 
 

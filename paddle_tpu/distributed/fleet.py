@@ -12,7 +12,9 @@ from .env import ParallelEnv, init_parallel_env
 
 __all__ = ["init", "distributed_optimizer", "DistributedStrategy",
            "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "worker_index",
-           "worker_num", "is_first_worker"]
+           "worker_num", "is_first_worker", "get_strategy",
+           "make_train_step", "save_persistables",
+           "save_inference_model"]
 
 
 class DistributedStrategy:

@@ -8,11 +8,10 @@ import sys as _sys
 from paddle_tpu.distributed import fleet as _impl
 
 _self = _sys.modules[__name__]
-for _n in dir(_impl):
-    if not _n.startswith("_"):
-        setattr(_self, _n, getattr(_impl, _n))
+for _n in _impl.__all__:
+    setattr(_self, _n, getattr(_impl, _n))
 
 from . import base, collective, parameter_server, utils  # noqa: F401,E402
 
-__all__ = ([n for n in dir(_impl) if not n.startswith("_")]
+__all__ = (list(_impl.__all__)
            + ["base", "collective", "parameter_server", "utils"])

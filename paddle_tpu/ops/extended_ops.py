@@ -632,7 +632,7 @@ def _mix_hash(ids, seed):
     return h ^ (h >> 16)
 
 
-@register_op("pyramid_hash")
+@register_op("pyramid_hash", needs_rng=True)
 def pyramid_hash(ins, attrs):
     """operators/pyramid_hash_op.cc — multi-scale n-gram hash embedding:
     for each pyramid level l in [2, pyramid_layer], hash every l-gram of
@@ -646,24 +646,47 @@ def pyramid_hash(ins, attrs):
     rand_len = int(attrs.get("rand_len", 16))
     space_len = int(attrs.get("space_len", w.shape[0] - rand_len))
     layers = int(attrs.get("pyramid_layer", 2))
+    drop_p = float(attrs.get("drop_out_percent", 0.0) or 0.0)
+    training = bool(attrs.get("is_training", True))
     b, t = x.shape
     n_slice = max(num_emb // rand_len, 1)
     out = jnp.zeros((b, num_emb), w.dtype)
     wf = w.reshape(-1)
+    dropped = jnp.zeros((b,), jnp.int32)
     for lvl in range(2, layers + 1):
         if lvl > t:
             break
         # l-gram window sums as the gram signature
         gram = sum(x[:, i:t - lvl + 1 + i] * (31 ** i) for i in range(lvl))
+        keep = None
+        if training and drop_p > 0.0:
+            # training-time n-gram dropout (pyramid_hash_op.cc:318 —
+            # rand_r per OCCURRENCE): an independent draw per (row,
+            # position, level) each step, keyed off the op RNG folded
+            # with the user seed so different grams drop across steps
+            import jax as _jax
+
+            key = _jax.random.fold_in(
+                _jax.random.fold_in(attrs["_rng"],
+                                    int(attrs.get("seed", 0) or 0)),
+                lvl)
+            keep = _jax.random.uniform(key, gram.shape) >= drop_p
+            dropped = dropped + (~keep).sum(axis=1).astype(jnp.int32)
         for s in range(n_slice):
             hidx = (_mix_hash(gram, seed=lvl * 131 + s)
                     % jnp.uint32(space_len)).astype(jnp.int32)  # [B, G]
             # each hash addresses rand_len consecutive table entries
             offs = jnp.arange(rand_len, dtype=jnp.int32)
             rows = wf[(hidx[..., None] + offs[None, None]) % wf.shape[0]]
+            if keep is not None:
+                rows = rows * keep[..., None].astype(rows.dtype)
             out = out.at[:, s * rand_len:(s + 1) * rand_len].add(
                 rows.sum(axis=1))
-    return {"Out": out, "DropPos": jnp.zeros((b, 1), jnp.int32),
+    if not training and drop_p > 0.0:
+        # eval scales by drop_out_percent (pyramid_hash_op.cc:386
+        # avx_axpy_noadd) — downgrade-in-infer semantics
+        out = out * jnp.asarray(drop_p, out.dtype)
+    return {"Out": out, "DropPos": dropped[:, None],
             "X_Temp_Out": x}
 
 

@@ -439,6 +439,36 @@ def test_shard_aware_three_required_rejected():
         is_shard_aware(r3)
 
 
+def test_pyramid_hash_dropout_knob():
+    # drop_out_percent must act in training and be a no-op at eval
+    ids = np.random.default_rng(0).integers(0, 50, (4, 6))
+
+    def build(p, training):
+        def b():
+            iv = fluid.data("ids", [None, 6], dtype="int64")
+            return contrib_layers.search_pyramid_hash(
+                iv, num_emb=16, space_len=1000, pyramid_layer=3,
+                rand_len=16, drop_out_percent=p, is_training=training,
+                param_attr=fluid.ParamAttr(
+                    initializer=fluid.initializer.NormalInitializer()))
+
+        return b
+
+    old_seed = fluid.flags.flag("global_seed")
+    fluid.flags.set_flags({"FLAGS_global_seed": 0})
+    try:
+        (o0,) = _run_program(build(0.0, True), {"ids": ids})
+        (o5,) = _run_program(build(0.5, True), {"ids": ids})
+        (oe,) = _run_program(build(0.5, False), {"ids": ids})
+    finally:
+        fluid.flags.set_flags({"FLAGS_global_seed": old_seed})
+    assert not np.allclose(np.asarray(o0), np.asarray(o5))
+    # eval scales by drop_out_percent (pyramid_hash_op.cc:386): the
+    # p=0.5 eval output is half the no-dropout sum
+    np.testing.assert_allclose(np.asarray(oe), np.asarray(o0) * 0.5,
+                               rtol=1e-6)
+
+
 def test_contrib_decoder_alias():
     from paddle_tpu.contrib import decoder
 
