@@ -27,6 +27,7 @@ null for pure-throughput metrics with no reference number (BASELINE.md
 records that the reference publishes none in-tree).
 """
 
+import functools
 import json
 import time
 
@@ -54,10 +55,14 @@ def _peak_flops(device):
 def _time_steps(step, state, batch, iters, reps=3):
     """Best per-step seconds over `reps` timed scans of `iters` steps,
     each scan one device dispatch (host fetch as the only reliable sync
-    under the remote-tunnel backend)."""
+    under the remote-tunnel backend).  CONSUMES `state` (the carried
+    train state is donated so XLA reuses the parameter buffers instead
+    of copying them each scan) — don't reuse it after this returns."""
     import jax
 
-    @jax.jit
+    # donating the carried state lets XLA reuse the parameter buffers
+    # across scan invocations instead of copying them
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def run(state, *batch):
         def body(st, _):
             st, loss = step(st, *batch)
@@ -69,7 +74,7 @@ def _time_steps(step, state, batch, iters, reps=3):
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        st, losses = run(state, *batch)
+        st, losses = run(st, *batch)
         float(losses[-1])
         best = min(best, (time.perf_counter() - t0) / iters)
     return best

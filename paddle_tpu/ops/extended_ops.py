@@ -664,13 +664,11 @@ def pyramid_hash(ins, attrs):
             # rand_r per OCCURRENCE): an independent draw per (row,
             # position, level) each step, keyed off the op RNG folded
             # with the user seed so different grams drop across steps
-            import jax as _jax
-
-            key = _jax.random.fold_in(
-                _jax.random.fold_in(attrs["_rng"],
-                                    int(attrs.get("seed", 0) or 0)),
+            key = jax.random.fold_in(
+                jax.random.fold_in(attrs["_rng"],
+                                   int(attrs.get("seed", 0) or 0)),
                 lvl)
-            keep = _jax.random.uniform(key, gram.shape) >= drop_p
+            keep = jax.random.uniform(key, gram.shape) >= drop_p
             dropped = dropped + (~keep).sum(axis=1).astype(jnp.int32)
         for s in range(n_slice):
             hidx = (_mix_hash(gram, seed=lvl * 131 + s)

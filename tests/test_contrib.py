@@ -460,12 +460,16 @@ def test_pyramid_hash_dropout_knob():
         (o0,) = _run_program(build(0.0, True), {"ids": ids})
         (o5,) = _run_program(build(0.5, True), {"ids": ids})
         (oe,) = _run_program(build(0.5, False), {"ids": ids})
+        # p=0.25 pins the exact eval factor (at 0.5, p == 1-p could
+        # mask an inverted implementation)
+        (oq,) = _run_program(build(0.25, False), {"ids": ids})
     finally:
         fluid.flags.set_flags({"FLAGS_global_seed": old_seed})
     assert not np.allclose(np.asarray(o0), np.asarray(o5))
-    # eval scales by drop_out_percent (pyramid_hash_op.cc:386): the
-    # p=0.5 eval output is half the no-dropout sum
+    # eval scales by drop_out_percent (pyramid_hash_op.cc:386)
     np.testing.assert_allclose(np.asarray(oe), np.asarray(o0) * 0.5,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(oq), np.asarray(o0) * 0.25,
                                rtol=1e-6)
 
 

@@ -208,8 +208,12 @@ def test_prefetch_overlaps_slow_reader():
             times[pf] = time.perf_counter() - t0
     # reader sleep alone is n*delay; with overlap the step cost hides
     # inside it, so prefetch must not be slower and should approach the
-    # reader-bound floor
-    assert times[True] <= times[False] * 1.1, times
+    # reader-bound floor. Under heavy suite load on a single core the
+    # absolute wall-clock is noisy — keep a loose bound there.
+    import os
+
+    slack = 1.1 if len(os.sched_getaffinity(0)) >= 2 else 1.6
+    assert times[True] <= times[False] * slack, times
 
 
 def test_prefetch_propagates_reader_errors():
