@@ -66,6 +66,7 @@ from .parallel_executor import ParallelExecutor  # noqa: F401
 from . import compat  # noqa: F401
 from . import incubate  # noqa: F401
 from .reader import batch  # noqa: F401
+from . import dygraph_grad_clip  # noqa: F401
 from .param_attr import WeightNormParamAttr  # noqa: F401
 from . import sysconfig
 from . import utils

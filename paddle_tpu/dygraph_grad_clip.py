@@ -1,0 +1,44 @@
+"""`fluid.dygraph_grad_clip` import-path compatibility.
+
+Parity: python/paddle/fluid/dygraph_grad_clip.py — the dygraph-era
+GradClipBy* classes over the one clip implementation (clip.py).  NOTE
+the argument-order difference between the two reference surfaces:
+dygraph_grad_clip.GradClipByValue takes (min_value, max_value) (:92)
+while clip.GradientClipByValue takes (max, min=None) — this shim
+preserves each surface's own order rather than aliasing them.
+"""
+
+from .clip import GradientClipBase as GradClipBase  # noqa: F401
+from .clip import GradientClipByGlobalNorm, GradientClipByNorm
+from .clip import GradientClipByValue as _ByValueImpl
+
+__all__ = ["GradClipBase", "GradClipByValue", "GradClipByNorm",
+           "GradClipByGlobalNorm"]
+
+
+class GradClipByValue(_ByValueImpl):
+    """dygraph_grad_clip.py:92 — (min_value, max_value); min_value=None
+    means -max_value (max_value must then be positive)."""
+
+    def __init__(self, min_value, max_value=None):
+        if min_value is None:
+            assert max_value is not None and max_value > 0.0, \
+                "max_value must be positive when min_value is None"
+            min_value = -max_value
+        if max_value is None:
+            # single-arg form: the given value is the magnitude bound
+            max_value = abs(float(min_value))
+            min_value = -max_value
+        super().__init__(max=max_value, min=min_value)
+
+
+class GradClipByNorm(GradientClipByNorm):
+    """dygraph_grad_clip.py:171 — same (clip_norm) signature."""
+
+
+class GradClipByGlobalNorm(GradientClipByGlobalNorm):
+    """dygraph_grad_clip.py:250 — (max_global_norm); the dtype arg is
+    accepted and ignored (jax promotes as needed)."""
+
+    def __init__(self, max_global_norm, dtype="float32"):
+        super().__init__(max_global_norm)

@@ -35,6 +35,23 @@ def test_import_paths_resolve():
     assert lg.level == logging.INFO
 
 
+def test_dygraph_grad_clip_alias():
+    from paddle_tpu.dygraph_grad_clip import (
+        GradClipByGlobalNorm,
+        GradClipByNorm,
+        GradClipByValue,
+    )
+
+    # dygraph surface order is (min_value, max_value) — the bounds must
+    # land the right way around, not alias clip.py's (max, min)
+    c = GradClipByValue(-0.25, 1.5)
+    assert c.min == -0.25 and c.max == 1.5
+    c2 = GradClipByValue(None, 2.0)          # min defaults to -max
+    assert c2.min == -2.0 and c2.max == 2.0
+    assert GradClipByNorm(1.0).clip_norm == 1.0
+    assert GradClipByGlobalNorm(5.0, dtype="float32").clip_norm == 5.0
+
+
 def test_incubate_fleet_import_paths():
     # the 1.x distributed-script surface
     from paddle_tpu.incubate.fleet.base import role_maker
