@@ -1,0 +1,109 @@
+"""Opportunistic TPU bench capture daemon (VERDICT r3 next-round #1).
+
+The axon tunnel to the single TPU chip goes down for hours at a time,
+and `jax.devices()` HANGS rather than erroring when it is — so TPU
+measurement must never be a once-per-round inline lottery.  This daemon
+runs for the whole round:
+
+  loop until --max-hours:
+    probe the tunnel OUT of process with a timeout
+    if up:   flock the chip and run bench.py (which writes
+             BENCH_TPU.json row-by-row as each config completes on
+             chip, so a mid-suite tunnel death keeps what finished)
+    sleep (short when down, long after a good capture)
+
+bench.py then merges the last-good BENCH_TPU.json rows into its output
+whenever it has to fall back to CPU, so a tunnel outage at
+driver-bench time degrades the evidence instead of erasing it.
+
+Chip exclusivity: everything that touches the TPU takes a blocking
+flock on LOCK_PATH; interactive experiments should do the same
+(`flock /tmp/paddle_tpu_chip.lock -c "python ..."`).
+
+Measurement-infrastructure parity with the reference's
+paddle/fluid/platform/profiler.h:206 and tools/timeline.py:137 roles.
+"""
+
+import argparse
+import fcntl
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCK_PATH = "/tmp/paddle_tpu_chip.lock"
+LOG_PATH = os.path.join(REPO, "tpu_capture.log")
+
+
+def log(msg):
+    line = "%s %s" % (time.strftime("%H:%M:%S"), msg)
+    print(line, flush=True)
+    with open(LOG_PATH, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout):
+    """True if the default backend comes up as TPU within `timeout`."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform == 'tpu'"],
+            timeout=timeout, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_bench(timeout):
+    """Run bench.py holding the chip lock; returns (rc, n_tpu_rows)."""
+    with open(LOCK_PATH, "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            with open(LOG_PATH, "a") as out:
+                r = subprocess.run(
+                    [sys.executable, os.path.join(REPO, "bench.py")],
+                    timeout=timeout, stdout=out, stderr=out, cwd=REPO)
+            rc = r.returncode
+        except subprocess.TimeoutExpired:
+            rc = -1
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+    rows = 0
+    try:
+        with open(os.path.join(REPO, "BENCH_TPU.json")) as f:
+            rows = len(json.load(f).get("rows", {}))
+    except Exception:
+        pass
+    return rc, rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--probe-timeout", type=int, default=120)
+    ap.add_argument("--bench-timeout", type=int, default=3600)
+    ap.add_argument("--down-sleep", type=int, default=900)
+    ap.add_argument("--captured-sleep", type=int, default=5400)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    log("capture daemon up; deadline in %.1fh" % args.max_hours)
+    while time.time() < deadline:
+        if probe(args.probe_timeout):
+            log("tunnel UP — running bench.py on chip")
+            rc, rows = run_bench(args.bench_timeout)
+            log("bench rc=%s BENCH_TPU.json rows=%d" % (rc, rows))
+            sleep = args.captured_sleep if rows else args.down_sleep
+        else:
+            log("tunnel down (probe timeout %ds)" % args.probe_timeout)
+            sleep = args.down_sleep
+        if time.time() + sleep > deadline:
+            break
+        time.sleep(sleep)
+    log("capture daemon done")
+
+
+if __name__ == "__main__":
+    main()
