@@ -29,6 +29,28 @@ from ..nn.layers import functional_call, param_dict, load_param_dict
 from ..nn.parameter import EagerParameter, seed
 from ..tape import Tape, Variable, current_tape, pop_tape, push_tape
 from ..jit import ProgramTranslator, declarative  # noqa: F401
+from .container import LayerList, ParameterList, Sequential  # noqa: F401
+from .nn import (  # noqa: F401
+    BatchNorm,
+    BilinearTensorProduct,
+    Conv2D,
+    Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
+    Dropout,
+    Embedding,
+    GroupNorm,
+    GRUUnit,
+    LayerNorm,
+    Linear,
+    NCE,
+    Pool2D,
+    PRelu,
+    RowConv,
+    SequenceConv,
+    SpectralNorm,
+    TreeConv,
+)
 
 __all__ = [
     "guard", "enabled", "to_variable", "no_grad", "grad", "value_and_grad",
@@ -36,6 +58,11 @@ __all__ = [
     "AdamW", "Adagrad", "RMSProp", "Adamax", "Lamb", "DygraphOptimizer",
     "Variable",
 ]
+# star-import parity: reference fluid/dygraph/__init__.py extends
+# __all__ with nn.__all__ and container.__all__
+from . import container as _container, nn as _nn  # noqa: E402
+
+__all__ += _nn.__all__ + _container.__all__
 
 _in_dygraph = True
 

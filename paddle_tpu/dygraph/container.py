@@ -1,0 +1,7 @@
+"""fluid.dygraph.container import-path parity (Sequential, ParameterList,
+LayerList — reference python/paddle/fluid/dygraph/container.py).  The
+implementations live in paddle_tpu.nn."""
+
+from ..nn import LayerList, ParameterList, Sequential  # noqa: F401
+
+__all__ = ["Sequential", "ParameterList", "LayerList"]

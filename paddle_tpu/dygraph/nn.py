@@ -1,0 +1,37 @@
+"""fluid.dygraph.nn import-path parity: the dygraph Layer-class zoo.
+
+One implementation lives in paddle_tpu.nn (see that module for the
+per-class reference citations into
+/root/reference/python/paddle/fluid/dygraph/nn.py); this module mirrors
+the reference path so 1.x scripts importing fluid.dygraph.nn run
+unchanged.
+"""
+
+from ..nn import (  # noqa: F401
+    BatchNorm,
+    BilinearTensorProduct,
+    Conv2D,
+    Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
+    Dropout,
+    Embedding,
+    GroupNorm,
+    GRUUnit,
+    LayerNorm,
+    Linear,
+    NCE,
+    Pool2D,
+    PRelu,
+    RowConv,
+    SequenceConv,
+    SpectralNorm,
+    TreeConv,
+)
+
+__all__ = [
+    "Conv2D", "Conv3D", "Pool2D", "Linear", "BatchNorm", "Dropout",
+    "Embedding", "GRUUnit", "LayerNorm", "NCE", "PRelu",
+    "BilinearTensorProduct", "Conv2DTranspose", "Conv3DTranspose",
+    "GroupNorm", "SpectralNorm", "TreeConv", "SequenceConv", "RowConv",
+]

@@ -30,9 +30,12 @@ __all__ = [
     "load_param_dict", "seed", "functional", "Linear", "Conv2D",
     "Conv2DTranspose", "Pool2D", "MaxPool2D", "AvgPool2D", "BatchNorm",
     "LayerNorm", "GroupNorm", "Embedding", "Dropout", "Sequential",
-    "LayerList", "ReLU", "GELU", "Sigmoid", "Tanh", "Softmax",
-    "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
-    "scaled_dot_product_attention", "LSTMCell", "GRUCell", "RNN",
+    "LayerList", "ParameterList", "ReLU", "GELU", "Sigmoid", "Tanh",
+    "Softmax", "MultiHeadAttention", "TransformerEncoderLayer",
+    "TransformerEncoder", "scaled_dot_product_attention", "LSTMCell",
+    "GRUCell", "RNN", "Conv3D", "Conv3DTranspose", "GRUUnit", "NCE",
+    "PRelu", "BilinearTensorProduct", "SequenceConv", "RowConv",
+    "SpectralNorm", "TreeConv",
 ]
 
 functional = F
@@ -473,6 +476,409 @@ class GRUCell(Layer):
         import jax.numpy as jnp
 
         return jnp.zeros((batch, self.hidden_size), self._dtype)
+
+
+class ParameterList(Layer):
+    """Indexed parameter container (parity: dygraph/container.py
+    ParameterList:91)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __setitem__(self, idx, param):
+        self._parameters[str(idx)] = param
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+
+class Conv3D(Layer):
+    """NCDHW 3-D convolution (parity: dygraph/nn.py Conv3D:272)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        groups = groups or 1
+        fs = ([filter_size] * 3 if isinstance(filter_size, int)
+              else list(filter_size))
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + fs, attr=param_attr)
+        self.bias = (self.create_parameter([num_filters], is_bias=True,
+                                           attr=bias_attr)
+                     if bias_attr is not False else None)
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        self._act = act
+
+    def forward(self, x):
+        from ..ops import extended_ops
+
+        out = extended_ops.conv3d(
+            {"Input": x, "Filter": self.weight.value},
+            {"strides": self._stride, "paddings": self._padding,
+             "dilations": self._dilation, "groups": self._groups})["Output"]
+        if self.bias is not None:
+            out = out + self.bias.value.reshape(1, -1, 1, 1, 1)
+        return _apply_act(out, self._act)
+
+
+class Conv3DTranspose(Layer):
+    """NCDHW transposed 3-D convolution (parity: dygraph/nn.py
+    Conv3DTranspose:474)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, padding=0,
+                 stride=1, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        groups = groups or 1
+        fs = ([filter_size] * 3 if isinstance(filter_size, int)
+              else list(filter_size))
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups] + fs, attr=param_attr)
+        self.bias = (self.create_parameter([num_filters], is_bias=True,
+                                           attr=bias_attr)
+                     if bias_attr is not False else None)
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        self._act = act
+
+    def forward(self, x):
+        from ..ops import extended_ops
+
+        out = extended_ops.conv3d_transpose(
+            {"Input": x, "Filter": self.weight.value},
+            {"strides": self._stride, "paddings": self._padding,
+             "dilations": self._dilation, "groups": self._groups})["Output"]
+        if self.bias is not None:
+            out = out + self.bias.value.reshape(1, -1, 1, 1, 1)
+        return _apply_act(out, self._act)
+
+
+class GRUUnit(Layer):
+    """Single GRU step over pre-projected input (parity: dygraph/nn.py
+    GRUUnit:1505; op semantics operators/gru_unit_op.h).
+
+    `size` is 3*H as in the reference; call(input [B, 3H], hidden [B, H])
+    -> (hidden', reset_hidden_prev, gate)."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        h = size // 3
+        self.weight = self.create_parameter([h, 3 * h], attr=param_attr)
+        self.bias = (self.create_parameter([1, 3 * h], is_bias=True,
+                                           attr=bias_attr)
+                     if bias_attr is not False else None)
+        self._activation = activation
+        self._gate_activation = gate_activation
+        self._origin_mode = origin_mode
+
+    def forward(self, input, hidden):
+        from ..ops import rnn_ops
+
+        ins = {"Input": input, "HiddenPrev": hidden,
+               "Weight": self.weight.value}
+        if self.bias is not None:
+            ins["Bias"] = self.bias.value
+        outs = rnn_ops.gru_unit(
+            ins, {"activation": self._activation,
+                  "gate_activation": self._gate_activation,
+                  "origin_mode": self._origin_mode})
+        return outs["Hidden"], outs["ResetHiddenPrev"], outs["Gate"]
+
+
+class NCE(Layer):
+    """Noise-contrastive estimation loss head (parity: dygraph/nn.py
+    NCE:1683; op operators/nce_op.cc).  call(input [N, D], label [N, 1])
+    -> cost [N, 1], scaled per-example by `sample_weight` [N] when given
+    (at construction or per call).  Negatives are drawn fresh each call:
+    uniform / log-uniform / custom_dist samplers; the loss's
+    noise-probability correction uses the uniform form (documented
+    approximation for the non-uniform samplers)."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=None,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([num_total_classes, dim],
+                                            attr=param_attr)
+        self.bias = (self.create_parameter([num_total_classes, 1],
+                                           is_bias=True, attr=bias_attr)
+                     if bias_attr is not False else None)
+        self._num_total_classes = num_total_classes
+        self._num_neg = int(num_neg_samples or 10)
+        self._sample_weight = sample_weight   # [N] per-example cost scale
+        if sampler not in ("uniform", "log_uniform", "custom_dist"):
+            raise ValueError(f"unknown NCE sampler {sampler!r}")
+        if sampler == "custom_dist" and custom_dist is None:
+            raise ValueError("custom_dist sampler needs custom_dist probs")
+        self._sampler = sampler
+        self._custom_dist = custom_dist
+
+    def _sample_ids(self, n):
+        import jax
+
+        key = default_rng.next_key()
+        c, s = self._num_total_classes, self._num_neg
+        if self._sampler == "uniform":
+            return jax.random.randint(key, (n, s), 0, c)
+        if self._sampler == "log_uniform":
+            # inverse-CDF of P(k) ~ log((k+2)/(k+1)) / log(C+1)
+            u = jax.random.uniform(key, (n, s))
+            return (jnp.exp(u * math.log(c + 1.0)) - 1.0).astype(jnp.int32)
+        probs = jnp.asarray(self._custom_dist)
+        return jax.random.choice(key, c, (n, s), p=probs / probs.sum())
+
+    def forward(self, input, label, sample_weight=None):
+        from ..ops import loss_ops
+
+        ins = {"Input": input, "Label": label,
+               "Weight": self.weight.value,
+               "SampleIds": self._sample_ids(input.shape[0])}
+        if self.bias is not None:
+            ins["Bias"] = self.bias.value
+        cost = loss_ops.nce(
+            ins, {"num_total_classes": self._num_total_classes,
+                  "num_neg_samples": self._num_neg})["Cost"]
+        sw = sample_weight if sample_weight is not None \
+            else self._sample_weight
+        if sw is not None:
+            cost = cost * jnp.reshape(
+                sw.value if hasattr(sw, "value") else jnp.asarray(sw),
+                (-1, 1))
+        return cost
+
+
+class PRelu(Layer):
+    """Learnable leaky-ReLU (parity: dygraph/nn.py PRelu:1917).  mode
+    'all' (one alpha), 'channel' (per-channel), 'element' (per-element,
+    needs input_shape)."""
+
+    def __init__(self, mode, channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            if channel is None:
+                raise ValueError("PRelu mode 'channel' needs `channel`")
+            shape = [1, channel, 1, 1]
+        elif mode == "element":
+            if input_shape is None:
+                raise ValueError("PRelu mode 'element' needs `input_shape`")
+            # batch dim is NOT part of the parameter (ref nn.py:1999)
+            shape = [1] + list(input_shape)[1:]
+        else:
+            raise ValueError(f"unknown PRelu mode {mode!r}")
+        self._mode = mode
+        # Constant(1.0) = identity at init, matching the dygraph class
+        # (ref nn.py:2007); the static fluid.layers.prelu builder keeps
+        # the op default 0.25
+        self.weight = self.create_parameter(
+            shape, attr=param_attr,
+            default_initializer=ConstantInitializer(1.0))
+
+    def forward(self, x):
+        from ..ops import nn_ops
+
+        return nn_ops.prelu({"X": x, "Alpha": self.weight.value},
+                            {"mode": self._mode})["Out"]
+
+
+class BilinearTensorProduct(Layer):
+    """out_t = x W_t y^T + b (parity: dygraph/nn.py
+    BilinearTensorProduct:2020)."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=param_attr)
+        self.bias = (self.create_parameter([1, output_dim], is_bias=True,
+                                           attr=bias_attr)
+                     if bias_attr is not False else None)
+        self._act = act
+
+    def forward(self, x, y):
+        from ..ops import misc_ops
+
+        ins = {"X": x, "Y": y, "Weight": self.weight.value}
+        if self.bias is not None:
+            ins["Bias"] = self.bias.value
+        return _apply_act(
+            misc_ops.bilinear_tensor_product(ins, {})["Out"], self._act)
+
+
+class SequenceConv(Layer):
+    """Context-window projection over padded sequences (parity:
+    dygraph/nn.py SequenceConv:2356 — which the reference REFUSES to run
+    in dygraph mode; this one works).  Weights are built lazily from the
+    input feature dim on first call; call(x [B, T, D], lengths [B])."""
+
+    def __init__(self, name_scope=None, num_filters=None, filter_size=3,
+                 filter_stride=1, padding=None, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope=name_scope, dtype=dtype)
+        if num_filters is None:
+            raise ValueError("SequenceConv needs num_filters")
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._filter_stride = filter_stride
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self.weight = None
+        self.bias = None
+
+    @property
+    def _lazy_unbuilt(self):
+        return "weight" not in self._parameters
+
+    def _build(self, x):
+        if self._lazy_unbuilt:
+            d = int(x.shape[-1])
+            self.weight = self.create_parameter(
+                [self._filter_size * d, self._num_filters],
+                attr=self._param_attr)
+            if self._bias_attr is not False:
+                self.bias = self.create_parameter(
+                    [self._num_filters], is_bias=True, attr=self._bias_attr)
+
+    def __call__(self, *args, **kwargs):
+        # build BEFORE the tape snapshots the parameter list, so the
+        # first recorded call already differentiates through the weights
+        self._build(args[0])
+        return super().__call__(*args, **kwargs)
+
+    def forward(self, x, lengths=None):
+        from ..ops import sequence_ops
+
+        if lengths is None:
+            lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+        out = sequence_ops.sequence_conv(
+            {"X": x, "Filter": self.weight.value, "Length": lengths},
+            {"contextLength": self._filter_size,
+             "contextStart": -(self._filter_size // 2),
+             "contextStride": self._filter_stride})["Out"]
+        if "bias" in self._parameters and self.bias is not None:
+            out = out + self.bias.value.reshape(1, 1, -1)
+        return _apply_act(out, self._act)
+
+
+class RowConv(Layer):
+    """Lookahead (row) convolution, DeepSpeech2-style (parity:
+    dygraph/nn.py RowConv:2450 — reference refuses dygraph mode; this
+    one works).  Filter [future_context_size+1, D] built lazily."""
+
+    def __init__(self, name_scope=None, future_context_size=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope=name_scope, dtype=dtype)
+        if future_context_size is None:
+            raise ValueError("RowConv needs future_context_size")
+        self._future_context_size = future_context_size
+        self._param_attr = param_attr
+        self._act = act
+        self.weight = None
+
+    @property
+    def _lazy_unbuilt(self):
+        return "weight" not in self._parameters
+
+    def _build(self, x):
+        if self._lazy_unbuilt:
+            self.weight = self.create_parameter(
+                [self._future_context_size + 1, int(x.shape[-1])],
+                attr=self._param_attr)
+
+    def __call__(self, *args, **kwargs):
+        self._build(args[0])
+        return super().__call__(*args, **kwargs)
+
+    def forward(self, x, lengths=None):
+        from ..ops import rnn_ops
+
+        ins = {"X": x, "Filter": self.weight.value}
+        if lengths is not None:
+            ins["Length"] = lengths
+        return _apply_act(rnn_ops.row_conv(ins, {})["Out"], self._act)
+
+
+class SpectralNorm(Layer):
+    """Spectral weight normalization via power iteration (parity:
+    dygraph/nn.py SpectralNorm:2629; op operators/spectral_norm_op.h).
+    call(weight) -> weight / sigma_max; u/v are persistent non-trainable
+    power-iteration vectors."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= int(s)
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=NormalInitializer(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=NormalInitializer(0.0, 1.0))
+        self.weight_u.trainable = False
+        self.weight_v.trainable = False
+        self._dim, self._power_iters, self._eps = dim, power_iters, eps
+
+    def forward(self, weight):
+        from ..ops import misc_ops
+
+        return misc_ops.spectral_norm(
+            {"Weight": weight, "U": self.weight_u.value,
+             "V": self.weight_v.value},
+            {"dim": self._dim, "power_iters": self._power_iters,
+             "eps": self._eps})["Out"]
+
+
+class TreeConv(Layer):
+    """Tree-based convolution (TBCNN) over (nodes, edges) (parity:
+    dygraph/nn.py TreeConv:2734; op operators/tree_conv_op.cc).
+    call(nodes_vector [B, M, F], edge_set [B, E, 2]) ->
+    [B, M, output_size, num_filters]."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters], attr=param_attr)
+        self.bias = (self.create_parameter([num_filters], is_bias=True,
+                                           attr=bias_attr)
+                     if bias_attr is not False else None)
+        self._max_depth = max_depth
+        self._act = act
+
+    def forward(self, nodes_vector, edge_set):
+        from ..ops import extended_ops
+
+        out = extended_ops.tree_conv(
+            {"NodesVector": nodes_vector, "EdgeSet": edge_set,
+             "Filter": self.weight.value},
+            {"max_depth": self._max_depth})["Out"]
+        if self.bias is not None:
+            out = out + self.bias.value.reshape(1, 1, 1, -1)
+        return _apply_act(out, self._act)
 
 
 class RNN(Layer):
