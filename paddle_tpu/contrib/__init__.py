@@ -18,6 +18,8 @@ from .. import amp as mixed_precision  # noqa: F401
 from .. import slim  # noqa: F401
 from ..model_stat import memory_usage, op_freq_statistic  # noqa: F401
 from . import decoder, extend_optimizer, layers  # noqa: F401
+from . import quantize, reader  # noqa: F401
+from .quantize import QuantizeTranspiler  # noqa: F401
 from .extend_optimizer import (  # noqa: F401
     DecoupledWeightDecay,
     extend_with_decoupled_weight_decay,
@@ -33,7 +35,8 @@ from .trainer import (  # noqa: F401
 )
 
 __all__ = ["layers", "decoder", "extend_optimizer", "mixed_precision",
-           "slim", "Trainer", "Inferencer", "CheckpointConfig",
+           "slim", "quantize", "reader", "QuantizeTranspiler",
+           "Trainer", "Inferencer", "CheckpointConfig",
            "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
            "EndStepEvent", "DecoupledWeightDecay",
            "extend_with_decoupled_weight_decay", "memory_usage",

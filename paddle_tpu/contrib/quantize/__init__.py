@@ -1,0 +1,6 @@
+"""fluid.contrib.quantize import-path parity (reference
+contrib/quantize/__init__.py)."""
+
+from .quantize_transpiler import QuantizeTranspiler  # noqa: F401
+
+__all__ = ["QuantizeTranspiler"]

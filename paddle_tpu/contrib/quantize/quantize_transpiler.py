@@ -1,0 +1,51 @@
+"""fluid.contrib.quantize.quantize_transpiler parity.
+
+The reference QuantizeTranspiler (contrib/quantize/
+quantize_transpiler.py:80) rewrites a Program with fake quant/dequant
+ops for QAT and freezes it for int8 inference; the one implementation
+of that rewrite here is slim/quantization.py (QuantizationTransformPass
+and friends).  This module keeps the 1.x class name and method surface
+on top of it.
+"""
+
+from ...slim.quantization import QuantizationTransformPass, convert
+
+__all__ = ["QuantizeTranspiler"]
+
+
+class QuantizeTranspiler:
+    """Reference ctor signature (quantize_transpiler.py:81): weight/
+    activation bit widths + quantize types; `window_size`/`moving_rate`
+    are accepted for signature parity (they parameterize the
+    range_abs_max/moving_average estimators, which the jnp kernels
+    compute exactly rather than via windowed state)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max",
+                 window_size=10000, moving_rate=0.9):
+        self._pass = QuantizationTransformPass(
+            weight_bits=weight_bits, activation_bits=activation_bits,
+            activation_quantize_type=activation_quantize_type,
+            weight_quantize_type=weight_quantize_type)
+
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake quant/dequant ops for QAT (ref :146).  Must run
+        before minimize(), exactly like the reference (which patches the
+        forward graph and relies on grad re-generation)."""
+        from ...framework.program import default_main_program
+
+        program = program or default_main_program()
+        return self._pass.apply(program)
+
+    def freeze_program(self, program, place=None, fuse_bn=False,
+                       scope=None):
+        """Freeze a QAT program for inference (ref :223); the fake-quant
+        kernels already emulate int8 numerics at inference here, so this
+        is the identity conversion from slim."""
+        return convert(program)
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """ref :349 — storage conversion is an XLA-side concern (bf16/
+        int8 layouts are chosen by the compiler); returns the program."""
+        return convert(program)

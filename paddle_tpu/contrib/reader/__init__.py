@@ -1,0 +1,6 @@
+"""fluid.contrib.reader import-path parity (reference
+contrib/reader/__init__.py)."""
+
+from .distributed_reader import distributed_batch_reader  # noqa: F401
+
+__all__ = ["distributed_batch_reader"]

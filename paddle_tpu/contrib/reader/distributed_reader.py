@@ -1,0 +1,21 @@
+"""fluid.contrib.reader.distributed_reader parity (reference
+contrib/reader/distributed_reader.py:21): shard a batch reader across
+trainers so each consumes its 1/Nth slice, driven by the same
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM env contract the launcher sets
+(distributed/launch.py)."""
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def decorated():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers == trainer_id:
+                yield batch
+
+    return decorated

@@ -10,16 +10,19 @@ InMemoryDataset / QueueDataset, parity fluid/dataset.py:22) live in
 
 import sys as _sys
 
-from ..datasets import (cifar, conll05, imdb, mnist, movielens,  # noqa: F401
-                        multislot, uci_housing, wmt14)
+from ..datasets import (cifar, conll05, flowers, imdb, imikolov,  # noqa: F401
+                        mnist, movielens, multislot, sentiment,
+                        uci_housing, voc2012, wmt14, wmt16)
 from ..datasets.multislot import (DatasetFactory, InMemoryDataset,  # noqa: F401
                                   QueueDataset)
 
 # make `import paddle_tpu.dataset.mnist`-style submodule imports resolve
 for _name in ("mnist", "cifar", "uci_housing", "imdb", "movielens",
-              "conll05", "wmt14", "multislot"):
+              "conll05", "wmt14", "multislot", "flowers", "imikolov",
+              "sentiment", "wmt16", "voc2012"):
     _sys.modules[__name__ + "." + _name] = globals()[_name]
 
 __all__ = ["mnist", "cifar", "uci_housing", "imdb", "movielens",
-           "conll05", "wmt14", "multislot", "DatasetFactory",
+           "conll05", "wmt14", "multislot", "flowers", "imikolov",
+           "sentiment", "wmt16", "voc2012", "DatasetFactory",
            "InMemoryDataset", "QueueDataset"]

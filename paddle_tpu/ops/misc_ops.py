@@ -592,6 +592,49 @@ def dgc_clip_by_norm(ins, attrs):
     return {"Out": jnp.where(step < rampup, x, clipped)}
 
 
+@register_op("dgc", stateful=True)
+def dgc(ins, attrs):
+    """operators/dgc_op.h — DGC sparsification with momentum correction
+    and error feedback (arXiv:1712.01887).  Before `rampup_begin_step`
+    the grad passes through untouched; after it, U accumulates momentum,
+    V accumulates error feedback, and only the top-(1-s) fraction of |V|
+    ships (GradOut), the rest staying in U/V.  The sparsity s walks the
+    `sparsity` array over `rampup_step` steps (optimizer.py:1069-1075).
+
+    The reference encodes the sparse selection for an NCCL sparse
+    allreduce (EncodeGrad/GatherBuff); under SPMD the masked dense
+    GradOut IS the collective operand, so no encode buffer exists."""
+    u = jnp.asarray(ins["U"])
+    v = jnp.asarray(ins["V"])
+    g = jnp.asarray(ins["Grad"])
+    step = jnp.asarray(ins.get("current_step", 0)).reshape(())
+    mu = float(attrs.get("m", attrs.get("mu", 0.9)))
+    rampup_begin = float(attrs.get("rampup_begin_step", 0.0))
+    rampup_step = max(float(attrs.get("rampup_step", 1.0)), 1.0)
+    sparsity = jnp.asarray(
+        [float(s) for s in attrs.get("sparsity", [0.999])], jnp.float32)
+    nlev = sparsity.shape[0]
+    # warmup: index into the sparsity array by progress through rampup
+    prog = jnp.clip((step - rampup_begin) / rampup_step, 0.0, 1.0)
+    idx = jnp.clip((prog * nlev).astype(jnp.int32), 0, nlev - 1)
+    s = sparsity[idx]
+
+    u_n = mu * u + g                               # momentum correction
+    v_n = v + u_n                                  # error feedback
+    flat = jnp.abs(v_n).reshape(-1)
+    n = flat.shape[0]
+    # k is data-dependent (warmup sparsity is a traced value), so take
+    # the k-th largest via a full sort + dynamic index instead of
+    # lax.top_k's static k
+    k = jnp.clip(jnp.round(n * (1.0 - s)).astype(jnp.int32), 1, n)
+    kth = jnp.sort(flat)[n - k]
+    mask = (jnp.abs(v_n) >= kth).astype(g.dtype)
+    before = step < rampup_begin
+    return {"GradOut": jnp.where(before, g, v_n * mask),
+            "UOut": jnp.where(before, u, u_n * (1.0 - mask)),
+            "VOut": jnp.where(before, v, v_n * (1.0 - mask))}
+
+
 @register_op("dgc_momentum", stateful=True)
 def dgc_momentum(ins, attrs):
     """operators/optimizers/dgc_momentum_op.h — momentum before the

@@ -23,6 +23,7 @@ from .. import clip as clip_mod
 
 __all__ = [
     "Optimizer", "SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+    "DGCMomentumOptimizer",
     "LarsMomentum", "LarsMomentumOptimizer", "Adagrad", "AdagradOptimizer",
     "Adam", "AdamOptimizer", "AdamW", "Adamax", "AdamaxOptimizer", "Dpsgd",
     "DpsgdOptimizer", "DecayedAdagrad", "DecayedAdagradOptimizer",
@@ -149,6 +150,115 @@ class Momentum(Optimizer):
                     "LearningRate": self._param_lr(p)},
             outputs={"ParamOut": p, "VelocityOut": v},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """optimizer.py:1041 — DGC momentum with the reference constructor
+    (momentum, rampup_begin_step, rampup_step, sparsity warmup list).
+
+    Emits per-param: [optional dgc_clip_by_norm] -> dgc (U/V momentum
+    correction + error feedback + top-k sparsify, ops/misc_ops.py) ->
+    dgc_momentum (momentum before the rampup boundary, direct sparse
+    update after), plus one shared step counter incremented per
+    apply_gradients.  Under the DP CompiledProgram path the masked dense
+    GradOut is the allreduce operand — the SPMD form of the reference's
+    sparse NCCL allreduce (operators/dgc_op.h encode path)."""
+
+    _u_velocity_acc_str = "_dgc_u_"
+    _v_velocity_acc_str = "_dgc_v_"
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=None, parameter_list=None,
+                 use_nesterov=False, local_grad_clip_norm=None,
+                 num_trainers=None, regularization=None, grad_clip=None,
+                 name=None):
+        assert rampup_begin_step >= 0, "rampup_begin_step must >= 0"
+        super().__init__(learning_rate, regularization=regularization,
+                         grad_clip=grad_clip, name=name)
+        self.type = "dgc_momentum"
+        self._momentum = momentum
+        self._use_nesterov = bool(use_nesterov)
+        self._rampup_begin_step = rampup_begin_step
+        self._rampup_step = rampup_step
+        self._sparsity = list(sparsity) if sparsity is not None else [0.999]
+        self._parameter_list = parameter_list
+        if local_grad_clip_norm is not None:
+            # reference optimizer.py:1153-1156: clip norm is scaled by
+            # num_trainers**-0.5 and num_trainers must be a positive int
+            assert isinstance(num_trainers, int) and num_trainers > 0, \
+                "local_grad_clip_norm needs a positive int num_trainers"
+            self._clip_norm = local_grad_clip_norm * (num_trainers ** -0.5)
+        else:
+            self._clip_norm = None
+        self._num_trainers = num_trainers
+        self._global_step_var = None
+
+    def _get_global_step_var(self):
+        if self._global_step_var is None:
+            self._global_step_var = T.create_global_var(
+                [1], 0.0, "float32", persistable=True,
+                name=unique_name.generate(self._name + "_global_step"))
+        return self._global_step_var
+
+    def apply_gradients(self, params_grads):
+        ops = super().apply_gradients(params_grads)
+        T.increment(self._get_global_step_var(), 1.0, in_place=True)
+        return ops
+
+    def _is_use_dgc(self, param):
+        """optimizer.py:1169 — small (<16384 elements) or non-fp32
+        params skip sparsification and stay on dense momentum."""
+        numel = 1
+        for s in param.shape:
+            numel *= int(s)
+        return numel >= 16384 and str(param.dtype) in ("float32",
+                                                       "FP32")
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        vel = self._add_accumulator("velocity", p)
+        if not self._is_use_dgc(p):
+            return block.append_op(
+                "momentum",
+                inputs={"Param": p, "Grad": g, "Velocity": vel,
+                        "LearningRate": self._param_lr(p)},
+                outputs={"ParamOut": p, "VelocityOut": vel},
+                attrs={"mu": self._momentum,
+                       "use_nesterov": self._use_nesterov})
+        u = self._add_accumulator(self._u_velocity_acc_str, p)
+        v = self._add_accumulator(self._v_velocity_acc_str, p)
+        step = self._get_global_step_var()
+        if self._clip_norm is not None:
+            clipped = block.create_var(
+                name=unique_name.generate(p.name + "_dgc_clip"),
+                shape=list(p.shape), dtype=p.dtype)
+            block.append_op(
+                "dgc_clip_by_norm",
+                inputs={"X": g, "current_step": step},
+                outputs={"Out": clipped},
+                attrs={"max_norm": float(self._clip_norm),
+                       "rampup_begin_step": float(self._rampup_begin_step)})
+            g = clipped
+        sparse_g = block.create_var(
+            name=unique_name.generate(p.name + "_dgc_grad"),
+            shape=list(p.shape), dtype=p.dtype)
+        block.append_op(
+            "dgc",
+            inputs={"U": u, "V": v, "Grad": g, "current_step": step},
+            outputs={"UOut": u, "VOut": v, "GradOut": sparse_g},
+            attrs={"m": self._momentum,
+                   "rampup_begin_step": float(self._rampup_begin_step),
+                   "rampup_step": float(self._rampup_step),
+                   "sparsity": self._sparsity})
+        return block.append_op(
+            "dgc_momentum",
+            inputs={"Param": p, "Grad": sparse_g, "Velocity": vel,
+                    "LearningRate": self._param_lr(p),
+                    "current_step": step},
+            outputs={"ParamOut": p, "VelocityOut": vel},
+            attrs={"mu": self._momentum,
+                   "use_nesterov": self._use_nesterov,
+                   "rampup_begin_step": float(self._rampup_begin_step)})
 
 
 class LarsMomentum(Optimizer):

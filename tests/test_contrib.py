@@ -478,3 +478,65 @@ def test_contrib_decoder_alias():
 
     assert decoder.BeamSearchDecoder is not None
     assert decoder.dynamic_decode is not None
+
+
+def test_contrib_quantize_transpiler_path():
+    """VERDICT r3 #8: contrib.quantize import path (ref contrib/
+    quantize/quantize_transpiler.py:80) — QAT transpile then train."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib.quantize import QuantizeTranspiler
+    from paddle_tpu.contrib.quantize.quantize_transpiler import (  # noqa: F401
+        QuantizeTranspiler as _SamePath,
+    )
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 8])
+        y = fluid.data("y", [None, 1])
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(x, 1), y))
+        t = QuantizeTranspiler(weight_bits=8, activation_bits=8,
+                               window_size=100)
+        t.training_transpile(main, startup)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    assert any("fake_quant" in op.type for op in main.global_block().ops)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(32, 8)).astype(np.float32)
+    ys = (xs[:, :1] * 0.5).astype(np.float32)
+    losses = [float(exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss])[0]) for _ in range(20)]
+    assert losses[-1] < losses[0]
+    frozen = t.freeze_program(main)
+    assert frozen is main
+
+
+def test_contrib_distributed_batch_reader_shards():
+    """ref contrib/reader/distributed_reader.py:21 — each trainer sees
+    its 1/Nth batch slice."""
+    import os
+
+    from paddle_tpu.contrib.reader import distributed_batch_reader
+
+    def batches():
+        for i in range(10):
+            yield i
+
+    old = {k: os.environ.get(k)
+           for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM")}
+    try:
+        os.environ["PADDLE_TRAINERS_NUM"] = "3"
+        seen = {}
+        for tid in range(3):
+            os.environ["PADDLE_TRAINER_ID"] = str(tid)
+            seen[tid] = list(distributed_batch_reader(batches)())
+        assert seen[0] == [0, 3, 6, 9]
+        assert seen[1] == [1, 4, 7]
+        assert seen[2] == [2, 5, 8]
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)

@@ -102,3 +102,36 @@ def test_multiprocess_reader_interleaves():
     r2 = lambda: iter([10, 20])
     out = sorted(R.multiprocess_reader([r1, r2])())
     assert out == [1, 2, 3, 10, 20]
+
+
+def test_new_surrogate_datasets_shapes():
+    """VERDICT r3 #8: flowers/imikolov/sentiment/wmt16/voc2012 surrogate
+    zoo (ref python/paddle/dataset/)."""
+    import numpy as np
+
+    from paddle_tpu.dataset import (flowers, imikolov, sentiment,
+                                    voc2012, wmt16)
+
+    img, lab = next(flowers.train()())
+    assert img.shape == (3, 224, 224) and 0 <= lab < 102
+
+    gram = next(imikolov.train(imikolov.build_dict(), 5)())
+    assert len(gram) == 5 and all(isinstance(w, int) for w in gram)
+    src, trg = next(imikolov.train(None, 5, imikolov.DataType.SEQ)())
+    assert len(src) == len(trg)
+
+    words, label = next(sentiment.train()())
+    assert label in (0, 1) and max(words) < len(sentiment.get_word_dict())
+
+    s, t, tn = next(wmt16.train(5000, 5000)())
+    assert t[0] == wmt16.START and tn[-1] == wmt16.END
+    d = wmt16.get_dict("en", 100)
+    rd = wmt16.get_dict("en", 100, reverse=True)
+    assert d["<s>"] == 0 and rd[0] == "<s>"
+
+    img, lab = next(voc2012.train()())
+    assert img.shape == (3, 128, 128) and lab.shape == (128, 128)
+    assert lab.max() < 21
+    # deterministic across calls (process-independent seeding)
+    img2, _ = next(voc2012.train()())
+    np.testing.assert_array_equal(img, img2)

@@ -290,3 +290,84 @@ def test_pipeline_optimizer_microbatched_updates(tmp_path):
     with pytest.raises(ValueError):
         popt.run_pipeline(exe, main, {"x": xb[:30], "y": yb[:30]},
                           [loss], micro_batch_num=4)
+
+
+def test_dgc_momentum_optimizer_facade_converges():
+    """VERDICT r3 #6: the reference's user-facing DGCMomentumOptimizer
+    class (optimizer.py:1041) — static-graph minimize converges on the
+    book LR model with sparsity active past the rampup boundary."""
+    import paddle_tpu as fluid
+
+    rng = np.random.default_rng(0)
+    # 128x128 first layer = 16384 elements: exactly at the reference's
+    # _is_use_dgc threshold, so sparsification engages for it while the
+    # small head stays dense (optimizer.py:1169)
+    true_w = rng.normal(size=(128, 1)).astype(np.float32)
+    xs = rng.normal(size=(64, 128)).astype(np.float32)
+    ys = (xs @ true_w).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 128])
+        y = fluid.data("y", [None, 1])
+        h = fluid.layers.fc(x, 128, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.02, momentum=0.9, rampup_begin_step=3,
+            rampup_step=4, sparsity=[0.5, 0.75],
+            local_grad_clip_norm=10.0, num_trainers=1)
+        opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses = [float(exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss])[0]) for _ in range(40)]
+    assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+
+    # sparsity is ACTIVE: the error-feedback accumulator V is nonzero
+    # once past rampup (it holds the unsent residual), and the step
+    # counter advanced
+    scope = fluid.global_scope()
+    v_names = [n for n in main.global_block().vars if "_dgc_v_" in n]
+    assert v_names
+    v_val = np.asarray(scope.find_var(v_names[0]))
+    assert np.abs(v_val).max() > 0, "V residual empty - dgc never engaged"
+    step_names = [n for n in main.global_block().vars
+                  if "_global_step" in n]
+    assert float(np.asarray(scope.find_var(step_names[0]))[0]) == 40.0
+
+
+def test_dgc_momentum_optimizer_before_rampup_is_dense_momentum():
+    """Before rampup_begin_step the facade must match plain Momentum
+    exactly (dgc_momentum_op.h pre-boundary branch)."""
+    import paddle_tpu as fluid
+
+    rng = np.random.default_rng(1)
+    xs = rng.normal(size=(16, 128)).astype(np.float32)
+    ys = rng.normal(size=(16, 1)).astype(np.float32)
+
+    def run(opt_factory, steps=3):
+        np.random.seed(7)
+        fluid.nn.seed(7)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            # 128x128 weight >= 16384 so the DGC path (not the small-
+            # param dense fallback) is what must match Momentum
+            x = fluid.data("x", [None, 128])
+            y = fluid.data("y", [None, 1])
+            h = fluid.layers.fc(x, 128, name="fc_cmp", act="relu")
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(h, 1, name="fc_head"), y))
+            opt_factory().minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        out = [float(exe.run(main, feed={"x": xs, "y": ys},
+                             fetch_list=[loss])[0]) for _ in range(steps)]
+        return out
+
+    dgc_losses = run(lambda: fluid.optimizer.DGCMomentumOptimizer(
+        learning_rate=0.05, momentum=0.9, rampup_begin_step=1000))
+    mom_losses = run(lambda: fluid.optimizer.Momentum(
+        learning_rate=0.05, momentum=0.9))
+    np.testing.assert_allclose(dgc_losses, mom_losses, rtol=1e-5)
