@@ -58,10 +58,17 @@ def init_parallel_env():
     env = ParallelEnv()
     if env.nranks > 1 and env.trainer_endpoints:
         coordinator = env.trainer_endpoints[0]
+        kwargs = {}
+        # bounded rendezvous (reference launch.py aborts the pack when a
+        # worker dies; an unbounded initialize would hang instead)
+        timeout = os.environ.get("PADDLE_RENDEZVOUS_TIMEOUT")
+        if timeout:
+            kwargs["initialization_timeout"] = int(timeout)
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=env.nranks,
             process_id=env.local_rank,
+            **kwargs,
         )
     _initialized = True
     return env

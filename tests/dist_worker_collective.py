@@ -1,0 +1,109 @@
+"""2-process collective worker (companion script, reference-style
+dist_*.py — see test_dist_collective.py for the parent).
+
+Run by distributed.launch.start_procs with the PADDLE_* env contract;
+exercises the REAL multi-process wiring: init_parallel_env ->
+jax.distributed.initialize over the launcher's endpoint list (the
+gen-nccl-id rendezvous analogue, distributed/env.py), then
+psum/broadcast numerics (parity test_collective_base.py:34,123) and a
+2-trainer data-parallel training run whose losses the parent compares
+against single-process training (parity test_dist_base.py:935).
+"""
+
+import json
+import os
+import sys
+
+# exactly one CPU device per process so the 2-process world is 2 devices
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from paddle_tpu.distributed.collective import all_reduce  # noqa: E402
+from paddle_tpu.distributed.collective import (  # noqa: E402
+    eager_all_gather,
+    eager_all_reduce,
+)
+from paddle_tpu.distributed.env import (  # noqa: E402
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+)
+from paddle_tpu.distributed.mesh import build_mesh  # noqa: E402
+
+
+def main():
+    out_path = sys.argv[1]
+    init_parallel_env()                      # the wiring under test
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.device_count()
+    assert jax.local_device_count() == 1
+    rank, world = get_rank(), get_world_size()
+    assert world == 2
+    assert rank == int(os.environ["PADDLE_TRAINER_ID"])
+
+    mesh = build_mesh(dp=2)                  # global 2-device mesh
+    dp_sharding = NamedSharding(mesh, P("dp"))
+
+    # --- collective numerics (test_collective_base.py parity) ----------
+    local = np.full((1, 4), float(rank + 1), np.float32)
+    g = jax.make_array_from_process_local_data(dp_sharding, local)
+    summed = eager_all_reduce(g, mesh)       # 1 + 2 = 3 on every shard
+    my_sum = np.asarray(summed.addressable_shards[0].data)
+    assert np.allclose(my_sum, 3.0), my_sum
+    gathered = eager_all_gather(g, mesh)     # replicated [2, 4]
+    mine = np.asarray(gathered.addressable_data(0))
+    assert mine.shape == (2, 4)
+    assert np.allclose(mine[0], 1.0) and np.allclose(mine[1], 2.0), mine
+
+    # --- 2-trainer DP training vs the parent's local run ---------------
+    rng = np.random.default_rng(0)
+    true_w = rng.normal(size=(8, 1)).astype(np.float32)
+    X = rng.normal(size=(32, 8)).astype(np.float32)
+    Y = (X @ true_w).astype(np.float32)
+    prng = np.random.default_rng(1)
+    w0 = (prng.normal(size=(8, 1)) * 0.1).astype(np.float32)
+    b0 = np.zeros((1,), np.float32)
+
+    half = 32 // world
+    xg = jax.make_array_from_process_local_data(
+        dp_sharding, X[rank * half:(rank + 1) * half])
+    yg = jax.make_array_from_process_local_data(
+        dp_sharding, Y[rank * half:(rank + 1) * half])
+    rep = NamedSharding(mesh, P())
+    wg = jax.make_array_from_callback(w0.shape, rep, lambda idx: w0[idx])
+    bg = jax.make_array_from_callback(b0.shape, rep, lambda idx: b0[idx])
+
+    def spmd_step(w, b, x, y):
+        def local_loss(w, b):
+            pred = x @ w + b
+            return ((pred - y) ** 2).mean()
+
+        loss, (gw, gb) = jax.value_and_grad(local_loss, (0, 1))(w, b)
+        # grad averaging through the framework collective API
+        loss = all_reduce(loss, "dp", op="mean")
+        gw = all_reduce(gw, "dp", op="mean")
+        gb = all_reduce(gb, "dp", op="mean")
+        return w - 0.1 * gw, b - 0.1 * gb, loss
+
+    step = jax.jit(jax.shard_map(
+        spmd_step, mesh=mesh, in_specs=(P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+    losses = []
+    for _ in range(5):
+        wg, bg, loss = step(wg, bg, xg, yg)
+        losses.append(float(np.asarray(loss.addressable_data(0))))
+
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump({"losses": losses, "world": world}, f)
+
+
+if __name__ == "__main__":
+    main()
