@@ -15,6 +15,8 @@ from .tensor import _single_out
 __all__ = [
     # activations / simple math
     "brelu", "soft_relu", "stanh", "selu", "maxout", "elementwise_floordiv",
+    "hard_shrink", "softshrink", "logsigmoid", "tanh_shrink",
+    "thresholded_relu",
     "add_position_encoding", "bilinear_tensor_product", "cos_sim",
     "affine_channel", "affine_grid", "grid_sampler", "pixel_shuffle",
     "space_to_depth", "shuffle_channel", "temporal_shift", "unfold",
@@ -115,6 +117,38 @@ def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
     t = _single_out("tanh", {"X": a}, {}, same_shape=True)
     return _single_out("scale", {"X": t}, {"scale": scale_b},
                        same_shape=True, name=name)
+
+
+def hard_shrink(x, threshold=0.5, name=None):
+    """ops.py hard_shrink — zero inside [-threshold, threshold]."""
+    return _single_out("hard_shrink", {"X": x}, {"threshold": threshold},
+                       same_shape=True, name=name)
+
+
+def softshrink(x, alpha=0.5, name=None):
+    """ops.py softshrink (the python arg is `alpha`, the op attr
+    `lambda` — nn.py:9864)."""
+    return _single_out("softshrink", {"X": x}, {"lambda": alpha},
+                       same_shape=True, name=name)
+
+
+def logsigmoid(x, name=None):
+    """ops.py logsigmoid — log(1 / (1 + exp(-x)))."""
+    return _single_out("logsigmoid", {"X": x}, {}, same_shape=True,
+                       name=name)
+
+
+def tanh_shrink(x, name=None):
+    """ops.py tanh_shrink — x - tanh(x)."""
+    return _single_out("tanh_shrink", {"X": x}, {}, same_shape=True,
+                       name=name)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    """ops.py thresholded_relu."""
+    return _single_out("thresholded_relu", {"X": x},
+                       {"threshold": threshold}, same_shape=True,
+                       name=name)
 
 
 def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):

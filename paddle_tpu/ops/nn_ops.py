@@ -78,6 +78,44 @@ def swish(ins, attrs):
     return {"Out": x * jax.nn.sigmoid(beta * x)}
 
 
+@register_op("hard_shrink")
+def hard_shrink(ins, attrs):
+    """activation_op.h HardShrinkFunctor — zero inside [-t, t]."""
+    t = attrs.get("threshold", 0.5)
+    x = ins["X"]
+    return {"Out": jnp.where(jnp.abs(x) > t, x, 0.0)}
+
+
+@register_op("softshrink")
+def softshrink(ins, attrs):
+    """activation_op.h SoftShrinkFunctor — shrink toward 0 by lambda."""
+    lam = attrs.get("lambda", 0.5)
+    x = ins["X"]
+    return {"Out": jnp.where(x > lam, x - lam,
+                             jnp.where(x < -lam, x + lam, 0.0))}
+
+
+@register_op("logsigmoid")
+def logsigmoid(ins, attrs):
+    """activation_op.h LogSigmoidFunctor = -softplus(-x), stable form."""
+    return {"Out": jax.nn.log_sigmoid(ins["X"])}
+
+
+@register_op("tanh_shrink")
+def tanh_shrink(ins, attrs):
+    """activation_op.h TanhShrinkFunctor — x - tanh(x)."""
+    x = ins["X"]
+    return {"Out": x - jnp.tanh(x)}
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(ins, attrs):
+    """activation_op.h ThresholdedReluFunctor."""
+    t = attrs.get("threshold", 1.0)
+    x = ins["X"]
+    return {"Out": jnp.where(x > t, x, 0.0)}
+
+
 @register_op("prelu")
 def prelu(ins, attrs):
     x, alpha = ins["X"], ins["Alpha"]

@@ -1,0 +1,199 @@
+"""Generate OPS_DIFF.md — the op-corpus reconciliation audit
+(VERDICT r3 #5).
+
+Every base (non-grad) operator name registered by the reference
+(REGISTER_OPERATOR / REGISTER_OP_WITHOUT_GRADIENT plus the elementwise/
+compare/reduce/activation macro families, extracted from
+/root/reference/paddle/fluid/operators into tools/ref_ops_v17.txt) is
+classified into exactly one of:
+
+  kernel      — same name in the live ops registry
+  renamed     — registry kernel under a different name
+  layer       — materialized at the fluid.layers level (python-side
+                structure, no dedicated kernel needed)
+  autodiff    — reference grad machinery; jax.grad/vjp owns it
+  <collapse>  — subsumed by a named subsystem (executor, reader, io,
+                XLA, jax.distributed, PS runtime, ...) with the repo
+                file that owns the capability
+
+The script FAILS (exit 1) if any reference op is unexplained, so the
+audit cannot silently rot; tests/test_ops_diff.py runs the same
+classification in the suite.  Grad ops (184 *_grad / *_grad2 sites) are
+covered in aggregate by the autodiff row of the summary.
+
+Usage: python tools/gen_ops_diff.py [--check]
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_LIST = os.path.join(REPO, "tools", "ref_ops_v17.txt")
+OUT = os.path.join(REPO, "OPS_DIFF.md")
+
+# reference name -> registry name
+RENAMED = {
+    "reorder_lod_tensor_by_rank": "reorder_by_rank",
+}
+
+# reference ops materialized at the fluid.layers level (verified
+# user-callable surface), not as registry kernels
+LAYER_LEVEL = {
+    "while": "layers.While / layers.while_loop (lax.while_loop)",
+    "conditional_block": "layers.cond / layers.Switch / layers.IfElse "
+                         "(lax.cond)",
+    "conditional_block_infer": "same lowering as conditional_block",
+    "recurrent": "layers.StaticRNN (lax.scan)",
+    "select_input": "layers.case/switch_case lowering",
+    "select_output": "layers.case/switch_case lowering",
+    "write_to_array": "layers.array_write (python TensorArray)",
+    "read_from_array": "layers.array_read",
+    "lod_array_length": "layers.array_length",
+    "array_to_lod_tensor": "layers.array_to_lod_tensor (padded+lengths "
+                           "contract, paddle_tpu/lod.py)",
+    "lod_tensor_to_array": "layers.lod_tensor_to_array",
+    "split_lod_tensor": "layers.IfElse true/false branch routing",
+    "merge_lod_tensor": "layers.IfElse merge",
+    "merge_lod_tensor_infer": "layers.IfElse merge (infer variant)",
+    "rnn_memory_helper": "StaticRNN memory plumbing (lax.scan carry)",
+    "shrink_rnn_memory": "DynamicRNN length masking (lax.scan + masks)",
+    "py_func": "layers.py_func (host callback)",
+    "brelu": "layers.brelu (clip composition)",
+    "soft_relu": "layers.soft_relu (clip/exp/log composition)",
+    "stanh": "layers.stanh (scale/tanh composition)",
+}
+
+# subsumed by a subsystem; {ref op: (owner, why)}
+COLLAPSED = {
+    # executor / io runtime (framework/executor.py, io.py, checkpoint.py)
+    "feed": ("framework/executor.py", "feed map is native executor state"),
+    "fetch": ("framework/executor.py", "fetch list is native executor "
+              "state"),
+    "delete_var": ("framework/executor.py", "XLA/jax own buffer "
+                   "lifetime; scope vars are GC'd"),
+    "fake_init": ("framework/executor.py", "PS-side lazy init; "
+                  "startup program covers it"),
+    "load": ("io.py", "python-native load_persistables"),
+    "save": ("io.py", "python-native save_persistables"),
+    "load_combine": ("io.py", "single-file load path"),
+    "save_combine": ("io.py", "single-file save path"),
+    "recv_save": ("checkpoint.py", "PS-side checkpoint riders"),
+    "checkpoint_notify": ("checkpoint.py", "PS checkpoint riders over "
+                          "the wire codec"),
+    # reader stack (reader/, csrc/data_feed.cpp)
+    "read": ("reader/", "python+native reader pipeline, no graph op"),
+    "create_custom_reader": ("reader/", "decorator-composed readers"),
+    # distributed rendezvous / collective init (distributed/env.py, mesh.py)
+    "c_comm_init_all": ("distributed/env.py", "jax.distributed."
+                        "initialize + mesh axes replace comm groups"),
+    "c_gen_nccl_id": ("distributed/env.py", "rendezvous is "
+                      "jax.distributed.initialize"),
+    "gen_nccl_id": ("distributed/env.py", "same"),
+    "nccl": ("distributed/collective.py", "XLA collectives over ICI/DCN "
+             "replace the NCCL op wrappers"),
+    # PS/RPC runtime (distributed/ps.py + csrc/ps_shard.cpp + transpiler)
+    "send": ("distributed/ps.py", "binary wire codec send path"),
+    "recv": ("distributed/ps.py", "wire codec recv path"),
+    "send_barrier": ("distributed/ps.py", "communicator barriers"),
+    "fetch_barrier": ("distributed/ps.py", "communicator barriers"),
+    "prefetch": ("distributed/ps.py", "sparse table prefetch in client"),
+    "listen_and_serv": ("distributed/ps.py", "TCP PSServer"),
+    "fl_listen_and_serv": ("distributed/federated.py", "FedAvg server "
+                           "(exceeds the reference stub)"),
+    "distributed_lookup_table": ("transpiler.py", "transpiled to PS "
+                                 "client lookups"),
+    "lookup_sparse_table": ("distributed/ps.py", "sparse shard lookup"),
+    "split_byref": ("transpiler.py", "param slicing at transpile time"),
+    "split_selected_rows": ("selected_rows.py", "row-shard split is a "
+                            "python-level helper"),
+    "ref_by_trainer_id": ("transpiler.py", "trainer-indexed param "
+                          "selection at transpile time"),
+    # engine / backend bridges: XLA owns codegen+fusion (SURVEY §7)
+    "cudnn_lstm": ("XLA", "lax.scan LSTM fuses on TPU; cuDNN is "
+                   "CUDA-only"),
+    "fusion_group": ("XLA", "XLA fusion replaces hand-grouped kernels"),
+    "coalesce_tensor": ("XLA", "buffer coalescing is an XLA allocator "
+                        "concern"),
+    "lite_engine": ("XLA", "Paddle-Lite bridge, documented drop"),
+    "ngraph_engine": ("XLA", "nGraph bridge, documented drop"),
+    "tensorrt_engine": ("XLA", "TensorRT bridge, documented drop"),
+    # Baidu-internal services
+    "pull_box_sparse": ("documented drop", "BoxPS is a Baidu-internal "
+                        "service with no public counterpart"),
+    "push_box_sparse": ("documented drop", "same"),
+}
+
+
+def classify(ref_ops, registry):
+    rows, unexplained = [], []
+    for name in ref_ops:
+        if name in registry:
+            fn = registry[name].fn
+            rows.append((name, "kernel", f"`{fn.__module__}`"))
+        elif name in RENAMED and RENAMED[name] in registry:
+            rows.append((name, "renamed",
+                         f"registry kernel `{RENAMED[name]}`"))
+        elif name in LAYER_LEVEL:
+            rows.append((name, "layer", LAYER_LEVEL[name]))
+        elif name in COLLAPSED:
+            owner, why = COLLAPSED[name]
+            rows.append((name, "collapsed", f"`{owner}` — {why}"))
+        else:
+            unexplained.append(name)
+    return rows, unexplained
+
+
+def main(check_only=False):
+    ref_ops = [l.strip() for l in open(REF_LIST) if l.strip()]
+    if REPO not in sys.path:        # runnable from any cwd
+        sys.path.insert(0, REPO)
+    from paddle_tpu.ops.registry import _OPS
+    import paddle_tpu.ops  # noqa: F401 — registers every family
+
+    rows, unexplained = classify(ref_ops, _OPS)
+    if unexplained:
+        print("UNEXPLAINED reference ops:", unexplained)
+        return 1
+    if check_only:
+        print(f"ops-diff clean: {len(rows)} reference ops explained")
+        return 0
+
+    extras = sorted(set(_OPS) - set(ref_ops) - set(RENAMED.values()))
+    counts = {}
+    for _, kind, _ in rows:
+        counts[kind] = counts.get(kind, 0) + 1
+    with open(OUT, "w") as f:
+        f.write(
+            "# OPS_DIFF — reference operator corpus reconciliation\n\n"
+            "Generated by `tools/gen_ops_diff.py` (re-run after adding "
+            "ops; `--check` mode runs in the test suite).  Source list: "
+            "`tools/ref_ops_v17.txt` — every base (non-grad) operator "
+            "name the reference registers via REGISTER_OPERATOR / "
+            "REGISTER_OP_WITHOUT_GRADIENT and the elementwise / compare "
+            "/ reduce / activation macro families under "
+            "`paddle/fluid/operators` (registry matched: "
+            "`framework/op_registry.h:223`).\n\n"
+            f"**{len(rows)} reference base ops, 0 unexplained**: "
+            f"{counts.get('kernel', 0)} same-name kernels, "
+            f"{counts.get('renamed', 0)} renamed, "
+            f"{counts.get('layer', 0)} materialized at the layers "
+            f"level, {counts.get('collapsed', 0)} collapsed into named "
+            "subsystems.  The reference's 184 `*_grad` registrations "
+            "are owned wholesale by jax.grad/vjp (autodiff; "
+            "`framework/backward.py`, `tape.py`).\n\n"
+            "| reference op | status | implemented as / why |\n"
+            "|---|---|---|\n")
+        for name, kind, detail in rows:
+            f.write(f"| {name} | {kind} | {detail} |\n")
+        f.write(
+            f"\n## Registry ops beyond the reference list ({len(extras)})"
+            "\n\nCapability exceeding the reference corpus (2.x-style "
+            "`*_v2` names, TPU-native fused/collective kernels, "
+            "optimizer variants), kept for API breadth:\n\n"
+            + ", ".join(f"`{e}`" for e in extras) + "\n")
+    print(f"wrote {OUT}: {len(rows)} rows, {len(extras)} extras")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(check_only="--check" in sys.argv))
