@@ -25,8 +25,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["gpipe", "stack_block_params", "build_gpt_pipeline",
-           "pipeline_dryrun"]
+__all__ = ["gpipe", "interleaved_gpipe", "bubble_fraction",
+           "stack_block_params", "interleave_stack_params",
+           "build_gpt_pipeline", "pipeline_dryrun"]
 
 
 def gpipe(stage_fn, mesh, num_microbatches, axis_name="pp",
@@ -115,6 +116,137 @@ def gpipe(stage_fn, mesh, num_microbatches, axis_name="pp",
                                 jax.random.PRNGKey(0))
 
 
+def bubble_fraction(n_stages, num_microbatches, num_virtual=1):
+    """Idle fraction of the schedule (per device, forward or its
+    transpose): GPipe = (S-1)/(m+S-1); with V interleaved virtual
+    chunks per device the fill shrinks V-fold to (S-1)/(mV+S-1)
+    (Megatron-LM interleaved schedule, arXiv:2104.04473 §2.2)."""
+    s, m, v = n_stages, num_microbatches, num_virtual
+    return (s - 1) / (m * v + s - 1)
+
+
+def interleaved_gpipe(stage_fn, mesh, num_microbatches, num_virtual,
+                      axis_name="pp", batch_axis="dp", remat=True,
+                      param_specs=None):
+    """Interleaved virtual-stage pipeline (Megatron-LM 2104.04473 §2.2)
+    as ONE SPMD program — the perf schedule the reference's async
+    pipeline trainer (optimizer.py:3413, pipeline_trainer.cc) never
+    had.
+
+    Each device owns `num_virtual` (V) NON-contiguous chunks of the
+    layer stack: chunk c lives on device c mod S, so a microbatch rides
+    the ppermute ring V full laps.  Per tick every device computes one
+    (microbatch, chunk) unit and ppermutes the activation to its ring
+    neighbor — the SAME dataflow as gpipe, only the tick->unit indexing
+    changes:
+
+        tp = t - d; q, r = divmod(tp, S); v = q % V; w = q // V
+        unit = (microbatch w*S + r, chunk v*S + d)
+
+    which makes every dependency arrive exactly one tick earlier on the
+    ring neighbor (incl. the lap boundary S-1 -> 0).  Total schedule:
+    m*V + S - 1 chunk-ticks where a chunk-tick is 1/V of a gpipe stage
+    -> wall m + (S-1)/V stage-times vs gpipe's m + S - 1: the fill
+    bubble shrinks V-fold (`bubble_fraction`).  jax.grad transposes the
+    whole schedule for the backward, so the backward bubble shrinks
+    identically.
+
+    stacked_params: leaves [S*V, ...] in INTERLEAVED device order (row
+    d*V + v = chunk v*S + d) — see interleave_stack_params.  Requires
+    num_microbatches % S == 0 (wave injection).
+    """
+    n_stages = mesh.shape[axis_name]
+    v_chunks = int(num_virtual)
+    m = num_microbatches
+    if m % n_stages != 0:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({m}) "
+            f"divisible by n_stages ({n_stages}) — wave injection")
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+    has_dp = batch_axis and batch_axis in mesh.shape
+
+    def body(params_loc, x_loc):
+        # local leaves [V, ...]: this device's chunks, level-major
+        my = params_loc
+        for leaf in jax.tree.leaves(my):
+            if leaf.shape[0] != v_chunks:
+                # without this, dynamic_index_in_dim would CLAMP an
+                # out-of-range level to row 0 and silently reuse chunk
+                # 0's weights (e.g. gpipe-style [S, ...] stacks)
+                raise ValueError(
+                    f"interleaved params must have local leading dim "
+                    f"num_virtual={v_chunks} (global S*V in interleaved "
+                    f"order, see interleave_stack_params); got "
+                    f"{leaf.shape[0]}")
+        d = jax.lax.axis_index(axis_name)
+        mb = x_loc.shape[0] // m
+        xs = x_loc.reshape(m, mb, *x_loc.shape[1:])
+        out_buf = jnp.zeros_like(xs)
+        h0 = jnp.zeros_like(xs[0])
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        total_ticks = m * v_chunks + n_stages - 1
+
+        def tick(carry, t):
+            h_recv, out_buf = carry
+            tp = t - d                         # device-local phase
+            valid = (tp >= 0) & (tp < m * v_chunks)
+            q = jnp.clip(tp, 0, m * v_chunks - 1) // n_stages
+            r = jnp.clip(tp, 0, m * v_chunks - 1) % n_stages
+            v = q % v_chunks                   # virtual chunk level
+            w = q // v_chunks                  # microbatch wave
+            j = w * n_stages + r               # microbatch index
+            x_t = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(j, 0, m - 1), 0, keepdims=False)
+            inject = (d == 0) & (v == 0)       # chunk 0 loads the data
+            h_in = jnp.where(inject, x_t, h_recv)
+            chunk_p = jax.tree.map(
+                lambda l: jax.lax.dynamic_index_in_dim(
+                    l, v, 0, keepdims=False), my)
+            h_out = stage_fn(chunk_p, h_in)
+            emit = valid & (d == n_stages - 1) & (v == v_chunks - 1)
+            cl = jnp.clip(j, 0, m - 1)
+            cur = jax.lax.dynamic_index_in_dim(out_buf, cl, 0,
+                                               keepdims=False)
+            out_buf = jax.lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(emit, h_out, cur), cl, 0)
+            h_recv = jax.lax.ppermute(h_out, axis_name, perm)
+            return (h_recv, out_buf), None
+
+        (_, out_buf), _ = jax.lax.scan(tick, (h0, out_buf),
+                                       jnp.arange(total_ticks))
+        out_buf = jnp.where(d == n_stages - 1, out_buf, 0.0)
+        out_buf = jax.lax.psum(out_buf, axis_name)
+        return out_buf.reshape(x_loc.shape)
+
+    x_spec = P(batch_axis) if has_dp else P()
+    p_spec = P(axis_name) if param_specs is None else param_specs
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(p_spec, x_spec), out_specs=x_spec,
+        check_vma=False)
+
+
+def interleave_stack_params(block_param_dicts, n_stages, num_virtual):
+    """Blocks -> {name: [S*V, per_chunk, ...]} in interleaved device
+    order: global row d*V + v holds chunk c = v*S + d, so sharding the
+    leading dim over "pp" gives device d its V chunk levels
+    contiguously (level-major)."""
+    L = len(block_param_dicts)
+    chunks = n_stages * num_virtual
+    if L % chunks != 0:
+        raise ValueError(
+            f"{L} blocks not divisible into {chunks} chunks")
+    per = L // chunks
+    stacked = stack_block_params(block_param_dicts)
+    out = {}
+    for n, varr in stacked.items():
+        byc = varr.reshape(chunks, per, *varr.shape[1:])
+        rows = [byc[v * n_stages + d]
+                for d in range(n_stages) for v in range(num_virtual)]
+        out[n] = jnp.stack(rows)        # [S*V, per, ...]
+    return out
+
+
 def stack_block_params(block_param_dicts):
     """[{name: arr}, ...] per block -> {name: arr[L, ...]} stacked."""
     names = block_param_dicts[0].keys()
@@ -122,7 +254,8 @@ def stack_block_params(block_param_dicts):
             for n in names}
 
 
-def build_gpt_pipeline(model, mesh, num_microbatches, axis_name="pp"):
+def build_gpt_pipeline(model, mesh, num_microbatches, axis_name="pp",
+                       interleave=1):
     """Split a models.gpt.GPT into a pp-sharded pipelined middle.
 
     Returns (apply_fn, params) where params = {"emb": {...}, "stages":
@@ -131,58 +264,77 @@ def build_gpt_pipeline(model, mesh, num_microbatches, axis_name="pp"):
     pipeline (they are dp/tp-sharded as usual); the block stack runs
     through the GPipe schedule, scanning blocks-per-stage inside each
     stage.
+
+    interleave=V > 1 switches to the interleaved virtual-stage schedule
+    (interleaved_gpipe): each device holds V non-contiguous chunks and
+    the fill bubble shrinks V-fold.  Requires dropout == 0 (the per-tick
+    rng threading is wired for the GPipe schedule only) and
+    num_microbatches % n_stages == 0.
     """
     from ..nn.layers import functional_call, param_dict
 
     dropout_p = float(getattr(model.cfg, "dropout", 0.0) or 0.0)
     n_stages = mesh.shape[axis_name]
     blocks = list(model.blocks)
-    assert len(blocks) % n_stages == 0, (
-        f"{len(blocks)} blocks not divisible into {n_stages} stages")
-    per_stage = len(blocks) // n_stages
-
     block0 = blocks[0]
-    stacked = stack_block_params([param_dict(b) for b in blocks])
-    # [L, ...] -> [n_stages, per_stage, ...]
-    stages = {n: v.reshape(n_stages, per_stage, *v.shape[1:])
-              for n, v in stacked.items()}
+
+    def plain_stage_fn(stage_params, h):
+        # scan this stage's blocks (leaves [per_stage, ...])
+        def one_block(h, blk_params):
+            return functional_call(block0, blk_params, h), None
+
+        h, _ = jax.lax.scan(one_block, h, stage_params)
+        return h
+
+    if interleave > 1:
+        if dropout_p:
+            raise ValueError(
+                "interleave > 1 requires dropout=0.0 (per-tick rng "
+                "threading is GPipe-schedule only)")
+        stages = interleave_stack_params(
+            [param_dict(b) for b in blocks], n_stages, interleave)
+        pipe = interleaved_gpipe(plain_stage_fn, mesh, num_microbatches,
+                                 interleave, axis_name=axis_name)
+    else:
+        assert len(blocks) % n_stages == 0, (
+            f"{len(blocks)} blocks not divisible into {n_stages} stages")
+        per_stage = len(blocks) // n_stages
+        stacked = stack_block_params([param_dict(b) for b in blocks])
+        # [L, ...] -> [n_stages, per_stage, ...]
+        stages = {n: v.reshape(n_stages, per_stage, *v.shape[1:])
+                  for n, v in stacked.items()}
+
+        if dropout_p:
+            from ..nn.parameter import default_rng
+
+            def stage_fn(stage_params, h, key):
+                # scan this stage's blocks (leaves [per_stage, ...]);
+                # each block folds its index so masks differ across
+                # blocks, and key_context routes the per-(tick, stage,
+                # block) stream into the blocks' Dropout layers
+                def one_block(h, xs):
+                    blk_params, idx = xs
+                    blk_key = jax.random.fold_in(key, idx)
+                    with default_rng.key_context(blk_key):
+                        return functional_call(block0, blk_params, h), \
+                            None
+
+                per = jax.tree.leaves(stage_params)[0].shape[0]
+                h, _ = jax.lax.scan(
+                    one_block, h,
+                    (stage_params, jnp.arange(per, dtype=jnp.int32)))
+                return h
+        else:
+            stage_fn = plain_stage_fn
+
+        pipe = gpipe(stage_fn, mesh, num_microbatches,
+                     axis_name=axis_name, needs_rng=bool(dropout_p))
 
     all_params = param_dict(model)
     emb = {n: v for n, v in all_params.items()
            if n.startswith(("wte.", "wpe."))}
     head = {n: v for n, v in all_params.items()
             if n.startswith("norm_f.")}
-
-    if dropout_p:
-        from ..nn.parameter import default_rng
-
-        def stage_fn(stage_params, h, key):
-            # scan this stage's blocks (leaves [per_stage, ...]); each
-            # block folds its index so masks differ across blocks, and
-            # key_context routes the per-(tick, stage, block) stream
-            # into the blocks' Dropout layers
-            def one_block(h, xs):
-                blk_params, idx = xs
-                blk_key = jax.random.fold_in(key, idx)
-                with default_rng.key_context(blk_key):
-                    return functional_call(block0, blk_params, h), None
-
-            per = jax.tree.leaves(stage_params)[0].shape[0]
-            h, _ = jax.lax.scan(
-                one_block, h,
-                (stage_params, jnp.arange(per, dtype=jnp.int32)))
-            return h
-    else:
-        def stage_fn(stage_params, h):
-            # scan this stage's blocks (leaves [per_stage, ...])
-            def one_block(h, blk_params):
-                return functional_call(block0, blk_params, h), None
-
-            h, _ = jax.lax.scan(one_block, h, stage_params)
-            return h
-
-    pipe = gpipe(stage_fn, mesh, num_microbatches, axis_name=axis_name,
-                 needs_rng=bool(dropout_p))
     return _lm_apply_fn(model, pipe, dropout_p), \
         {"emb": emb, "stages": stages, "head": head}
 

@@ -209,3 +209,114 @@ def test_pipeline_dropout_trains():
     assert np.isfinite(losses).all()
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.8, \
         (losses[:3], losses[-3:])
+
+
+def test_interleaved_identity_stage_schedule():
+    """Every microbatch passes all S*V chunks exactly once, in order,
+    with the V-lap ring routing."""
+    from paddle_tpu.distributed.pipeline import interleaved_gpipe
+
+    mesh = build_mesh(dp=1, tp=1, pp=2, sp=1, devices=jax.devices()[:2])
+    # 4 chunks (S=2, V=2), chunk c adds 10**c: order-sensitive sum
+    # interleaved rows: row d*V+v = chunk v*2+d -> rows [c0,c2,c1,c3]
+    w = jnp.asarray([[1.0], [100.0], [10.0], [1000.0]])
+
+    fn = interleaved_gpipe(lambda p, h: h + p[0], mesh,
+                           num_microbatches=4, num_virtual=2,
+                           batch_axis=None)
+    x = jnp.zeros((8, 3), jnp.float32)
+    out = jax.jit(fn)(w, x)
+    np.testing.assert_allclose(np.asarray(out), 1111.0)
+
+
+def test_interleaved_order_sensitivity():
+    """Chunks must run in chunk order (0,1,2,3), not device order —
+    a non-commutative stage catches any routing mixup."""
+    from paddle_tpu.distributed.pipeline import interleaved_gpipe
+
+    mesh = build_mesh(dp=1, tp=1, pp=2, sp=1, devices=jax.devices()[:2])
+    # stage: h -> h * 2 + c  (non-commutative across order)
+    # chunk ids in interleaved row order [0, 2, 1, 3]
+    cs = jnp.asarray([[0.0], [2.0], [1.0], [3.0]])
+    fn = interleaved_gpipe(lambda p, h: h * 2.0 + p[0], mesh,
+                           num_microbatches=2, num_virtual=2,
+                           batch_axis=None)
+    x = jnp.zeros((2, 1), jnp.float32)
+    out = jax.jit(fn)(cs, x)
+    # ((((0*2+0)*2+1)*2+2)*2+3) = 11; any other chunk order differs
+    np.testing.assert_allclose(np.asarray(out), 11.0)
+
+
+@pytest.mark.parametrize("pp,v,dp", [(2, 2, 1), (2, 4, 1), (4, 2, 1),
+                                     (2, 2, 2)])
+def test_interleaved_pipeline_matches_single_device(pp, v, dp):
+    layers = pp * v          # one block per chunk
+    model = _model(layers=layers)
+    x, y = _batch()
+    mesh = build_mesh(dp=dp, tp=1, pp=pp, sp=1,
+                      devices=jax.devices()[:pp * dp])
+    apply_fn, params = build_gpt_pipeline(
+        model, mesh, num_microbatches=pp, interleave=v)
+    loss_pipe = jax.jit(apply_fn)(params, x, y)
+    with _swap_params(model, param_dict(model)):
+        loss_ref = model.loss(x, y)
+    np.testing.assert_allclose(float(loss_pipe), float(loss_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_interleaved_grads_match_single_device():
+    model = _model(layers=8)     # S=2, V=2 -> 4 chunks of 2 blocks
+    x, y = _batch()
+    mesh = build_mesh(dp=1, tp=1, pp=2, sp=1, devices=jax.devices()[:2])
+    apply_fn, params = build_gpt_pipeline(model, mesh,
+                                          num_microbatches=4,
+                                          interleave=2)
+    grads = jax.jit(jax.grad(apply_fn))(params, x, y)
+
+    def ref_loss(flat):
+        with _swap_params(model, flat):
+            return model.loss(x, y)
+
+    ref_grads = jax.grad(ref_loss)(param_dict(model))
+
+    # undo the interleaved row order: row d*V+v = chunk v*S+d, chunk c
+    # holds blocks [c*per, (c+1)*per)
+    g = grads["stages"]["attn.q_proj.weight"]   # [S*V, per, ...]
+    S, V, per = 2, 2, 2
+    for d in range(S):
+        for vv in range(V):
+            c = vv * S + d
+            for k in range(per):
+                layer = c * per + k
+                np.testing.assert_allclose(
+                    np.asarray(g[d * V + vv, k]),
+                    np.asarray(
+                        ref_grads[f"blocks.{layer}.attn.q_proj.weight"]),
+                    rtol=2e-4, atol=1e-6, err_msg=f"layer {layer}")
+    np.testing.assert_allclose(
+        np.asarray(grads["emb"]["wte.weight"]),
+        np.asarray(ref_grads["wte.weight"]), rtol=2e-4, atol=1e-6)
+
+
+def test_bubble_fraction_shrinks_v_fold():
+    from paddle_tpu.distributed.pipeline import bubble_fraction
+
+    # GPipe: (S-1)/(m+S-1); V=4 interleaved: (S-1)/(mV+S-1)
+    assert bubble_fraction(4, 8) == pytest.approx(3 / 11)
+    assert bubble_fraction(4, 8, 4) == pytest.approx(3 / 35)
+    # monotone improvement in V
+    for v in (2, 3, 4):
+        assert bubble_fraction(4, 8, v) < bubble_fraction(4, 8, v - 1)
+
+
+def test_interleaved_rejects_bad_configs():
+    from paddle_tpu.distributed.pipeline import interleaved_gpipe
+
+    mesh = build_mesh(dp=1, tp=1, pp=2, sp=1, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="divisible"):
+        interleaved_gpipe(lambda p, h: h, mesh, num_microbatches=3,
+                          num_virtual=2)
+    model = GPT(GPTConfig(vocab_size=64, hidden_size=16, num_layers=4,
+                          num_heads=2, max_seq_len=8, dropout=0.1))
+    with pytest.raises(ValueError, match="dropout"):
+        build_gpt_pipeline(model, mesh, num_microbatches=2, interleave=2)
