@@ -56,27 +56,28 @@ def probe(timeout):
         return False
 
 
-def run_bench(timeout):
-    """Run bench.py holding the chip lock; returns (rc, n_tpu_rows)."""
+def run_locked(script, timeout):
+    """Run a repo script holding the chip flock; returns its rc."""
     with open(LOCK_PATH, "w") as lockf:
         fcntl.flock(lockf, fcntl.LOCK_EX)
         try:
             with open(LOG_PATH, "a") as out:
                 r = subprocess.run(
-                    [sys.executable, os.path.join(REPO, "bench.py")],
+                    [sys.executable, os.path.join(REPO, script)],
                     timeout=timeout, stdout=out, stderr=out, cwd=REPO)
-            rc = r.returncode
+            return r.returncode
         except subprocess.TimeoutExpired:
-            rc = -1
+            return -1
         finally:
             fcntl.flock(lockf, fcntl.LOCK_UN)
-    rows = 0
+
+
+def tpu_rows():
     try:
         with open(os.path.join(REPO, "BENCH_TPU.json")) as f:
-            rows = len(json.load(f).get("rows", {}))
+            return len(json.load(f).get("rows", {}))
     except Exception:
-        pass
-    return rc, rows
+        return 0
 
 
 def main():
@@ -93,9 +94,23 @@ def main():
     while time.time() < deadline:
         if probe(args.probe_timeout):
             log("tunnel UP — running bench.py on chip")
-            rc, rows = run_bench(args.bench_timeout)
-            log("bench rc=%s BENCH_TPU.json rows=%d" % (rc, rows))
-            sleep = args.captured_sleep if rows else args.down_sleep
+            before = tpu_rows()
+            rc = run_locked("bench.py", args.bench_timeout)
+            rows = tpu_rows()
+            log("bench rc=%s BENCH_TPU.json rows=%d (+%d this run)"
+                % (rc, rows, rows - before))
+            # gate on THIS run succeeding, not on rows persisted by
+            # past captures — a tunnel death right after the probe
+            # must not trigger an hour of sweep against a dead chip
+            good = rc == 0 and rows > 0
+            if good:
+                # chip window is precious: also run the resnet50 tuning
+                # sweep (writes rows["resnet50_sweep"] itself)
+                log("running resnet50 tuning sweep")
+                rc2 = run_locked("tools/resnet50_tpu_tune.py",
+                                 args.bench_timeout)
+                log("sweep rc=%s" % rc2)
+            sleep = args.captured_sleep if good else args.down_sleep
         else:
             log("tunnel down (probe timeout %ds)" % args.probe_timeout)
             sleep = args.down_sleep
