@@ -176,34 +176,76 @@ def bench_lenet(on_tpu, peak):
             "vs_baseline": None, "step_ms": round(dt * 1e3, 2)}
 
 
+def resnet50_time_config(peak, batch=128, remat=False, iters=10,
+                         data_format="NHWC"):
+    """ONE parameterized ResNet-50 bf16 train-step measurement — shared
+    by the headline bench row and tools/resnet50_tpu_tune.py's sweep so
+    the MFU basis cannot drift between them."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.resnet import resnet50
+    from paddle_tpu.models.train import init_train_state, make_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer.functional import Momentum
+
+    model = resnet50(dtype="bfloat16", data_format=data_format)
+    opt = Momentum(0.1, 0.9)
+    state = init_train_state(model, opt)
+
+    if remat:
+        # checkpoint INSIDE the loss (before value_and_grad): the conv
+        # stack recomputes in the backward instead of storing
+        # activations
+        def loss_fn(m, x, y):
+            return jax.checkpoint(
+                lambda xx: F.cross_entropy(m(xx), y).mean())(x)
+    else:
+        def loss_fn(m, x, y):
+            return F.cross_entropy(m(x), y).mean()
+
+    step = make_train_step(model, opt, loss_fn=loss_fn, jit=False)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 3, 224, 224)),
+                    jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+    dt = _time_steps(step, state, (x, y), iters)
+    mfu = 3.0 * RESNET50_FWD_FLOPS_224 * batch / dt / peak
+    return {"batch": batch, "remat": remat,
+            "step_ms": round(dt * 1e3, 2),
+            "samples_per_sec": round(batch / dt, 1),
+            "mfu": round(mfu, 4)}
+
+
 def bench_resnet50(on_tpu, peak):
     """BASELINE config 2: ResNet-50 train step, data-parallel path (one
     chip here; the DP program is the same jitted step the sharded test
     runs over the CPU mesh)."""
     import jax.numpy as jnp
 
-    from paddle_tpu.models.resnet import resnet18, resnet50
+    from paddle_tpu.models.resnet import resnet18
     from paddle_tpu.models.train import init_train_state, make_train_step
     from paddle_tpu.nn import functional as F
     from paddle_tpu.optimizer.functional import Momentum
 
     if on_tpu:
-        import os
-
         # NHWC keeps the conv stack in the MXU-preferred layout (no XLA
         # relayout transposes); PADDLE_TPU_BENCH_NCHW=1 measures the
-        # NCHW path for comparison
+        # NCHW path for comparison.  batch 128 is the measured MFU knee
+        # on one v5e chip (64 -> 0.11, 128 -> 0.13+, 256 only
+        # marginally better at 2x memory)
         fmt = ("NCHW" if os.environ.get("PADDLE_TPU_BENCH_NCHW", "")
                .lower() in ("1", "true", "yes") else "NHWC")
-        model = resnet50(dtype="bfloat16", data_format=fmt)
-        # batch 128 is the measured MFU knee on one v5e chip (64 -> 0.11,
-        # 128 -> 0.13+, 256 only marginally better at 2x memory)
-        batch, size, iters, fwd_flops = 128, 224, 10, RESNET50_FWD_FLOPS_224
-        name = "resnet50_train_mfu"
-    else:
-        model = resnet18(num_classes=10, dtype="float32")
-        batch, size, iters, fwd_flops = 8, 32, 2, 2 * 0.037e9
-        name = "resnet18_cpu_mfu"
+        r = resnet50_time_config(peak, batch=128, data_format=fmt)
+        mfu = r["mfu"]
+        return {"metric": "resnet50_train_mfu", "value": mfu,
+                "unit": "mfu_frac",
+                "vs_baseline": round(mfu / MFU_TARGET, 4),
+                "samples_per_sec": r["samples_per_sec"],
+                "step_ms": r["step_ms"]}
+
+    model = resnet18(num_classes=10, dtype="float32")
+    batch, size, iters, fwd_flops = 8, 32, 2, 2 * 0.037e9
     opt = Momentum(0.1, 0.9)
     state = init_train_state(model, opt)
 
@@ -213,12 +255,12 @@ def bench_resnet50(on_tpu, peak):
     step = make_train_step(model, opt, loss_fn=loss_fn, jit=False)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((batch, 3, size, size)),
-                    jnp.bfloat16 if on_tpu else jnp.float32)
-    y = jnp.asarray(rng.integers(0, 1000 if on_tpu else 10, (batch,)),
-                    jnp.int32)
+                    jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (batch,)), jnp.int32)
     dt = _time_steps(step, state, (x, y), iters)
     mfu = 3.0 * fwd_flops * batch / dt / peak
-    return {"metric": name, "value": round(mfu, 4), "unit": "mfu_frac",
+    return {"metric": "resnet18_cpu_mfu", "value": round(mfu, 4),
+            "unit": "mfu_frac",
             "vs_baseline": round(mfu / MFU_TARGET, 4),
             "samples_per_sec": round(batch / dt, 1),
             "step_ms": round(dt * 1e3, 2)}

@@ -1,0 +1,250 @@
+"""Autoregressive decoding engine with KV cache for the GPT family.
+
+Capability beyond the reference (its generative path is beam-search
+seq2seq, layers/rnn.py + the machine-translation book model — see
+models/seq2seq.py for that parity); this is the TPU-first incremental
+decoder for causal LMs:
+
+- STATIC shapes end to end: the cache is a fixed [L, B, H, max_len, D]
+  buffer updated with dynamic_update_slice, and generation is ONE
+  lax.scan over max_new_tokens — the whole generate() compiles to a
+  single XLA program, no per-token retrace/dispatch.
+- Prefill processes the whole prompt as one batched causal pass (MXU-
+  sized matmuls) and fills the cache; decode steps then attend over the
+  cache prefix with a position mask.
+- Sampling: greedy, temperature, top-k, nucleus (top-p), all inside
+  the scan via jax.random.categorical on masked logits.
+
+Math mirrors models/gpt.py GPT.forward exactly (same param names from
+nn.layers.param_dict, same SDPA scale 1/sqrt(head_dim), fp32 softmax)
+— tested token-exact against the cache-free model.  Dense-FFN configs
+only (MoE decode dispatch is a training-scale feature).
+"""
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layers import param_dict
+
+__all__ = ["DecodeParams", "build_decode_params", "prefill",
+           "decode_step", "generate", "init_cache"]
+
+
+class DecCfg(NamedTuple):
+    """Hashable static geometry (jit static arg; GPTConfig itself is an
+    unhashable dataclass and must not ride the pytree)."""
+    hidden_size: int
+    num_heads: int
+    num_layers: int
+    max_seq_len: int
+    dtype: str
+
+    @classmethod
+    def from_model_cfg(cls, cfg):
+        return cls(cfg.hidden_size, cfg.num_heads, cfg.num_layers,
+                   cfg.max_seq_len, cfg.dtype)
+
+
+class DecodeParams(NamedTuple):
+    """Stacked decode-ready parameters: emb/head plain dicts, blocks
+    stacked [L, ...] for lax.scan over layers; cfg is a static DecCfg
+    (kept out of jit traces via static args)."""
+    emb: dict
+    blocks: dict
+    head: dict
+    cfg: DecCfg
+
+
+def build_decode_params(model):
+    """GPT -> DecodeParams (concrete arrays; reusable across calls)."""
+    if model.cfg.num_experts > 0:
+        raise NotImplementedError(
+            "KV-cache decode supports dense-FFN GPT configs only")
+    from ..distributed.pipeline import stack_block_params
+
+    flat = param_dict(model)
+    emb = {n: v for n, v in flat.items()
+           if n.startswith(("wte.", "wpe."))}
+    head = {n: v for n, v in flat.items() if n.startswith("norm_f.")}
+    blocks = stack_block_params([param_dict(b) for b in model.blocks])
+    return DecodeParams(emb, blocks, head,
+                        DecCfg.from_model_cfg(model.cfg))
+
+
+def init_cache(cfg, batch, max_len, dtype=None):
+    """Fixed-size KV buffer [L, B, H, max_len, D] (+ f32-safe dtype)."""
+    dtype = dtype or cfg.dtype
+    head_dim = cfg.hidden_size // cfg.num_heads
+    shape = (cfg.num_layers, batch, cfg.num_heads, max_len, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _split_heads(x, num_heads):
+    b, s, e = x.shape
+    return jnp.transpose(x.reshape(b, s, num_heads, e // num_heads),
+                         (0, 2, 1, 3))
+
+
+def _block_tail(x, attn_out, bp):
+    """Residual + MLP shared by prefill and decode (GPTBlock.forward
+    with dropout off)."""
+    x = x + attn_out @ bp["attn.out_proj.weight"] \
+        + bp["attn.out_proj.bias"]
+    h = F.layer_norm(x, [x.shape[-1]], bp["norm2.weight"],
+                     bp["norm2.bias"])
+    ff = F.gelu(h @ bp["fc1.weight"] + bp["fc1.bias"])
+    return x + ff @ bp["fc2.weight"] + bp["fc2.bias"]
+
+
+def _qkv(hn, bp, num_heads):
+    q = _split_heads(hn @ bp["attn.q_proj.weight"]
+                     + bp["attn.q_proj.bias"], num_heads)
+    k = _split_heads(hn @ bp["attn.k_proj.weight"]
+                     + bp["attn.k_proj.bias"], num_heads)
+    v = _split_heads(hn @ bp["attn.v_proj.weight"]
+                     + bp["attn.v_proj.bias"], num_heads)
+    return q, k, v
+
+
+def _merge_heads(o):
+    b, h, s, d = o.shape
+    return jnp.transpose(o, (0, 2, 1, 3)).reshape(b, s, h * d)
+
+
+def prefill(params: DecodeParams, input_ids, cache, cfg=None):
+    """Full-prompt causal pass; returns (last-position logits [B, V],
+    cache filled at [..., :S, :])."""
+    cfg = cfg or params.cfg
+    seq = input_ids.shape[1]
+    pos = jnp.arange(seq, dtype=jnp.int32)[None, :]
+    x = jnp.take(params.emb["wte.weight"], input_ids, axis=0) \
+        + jnp.take(params.emb["wpe.weight"], pos, axis=0)
+
+    def layer(x, bp):
+        hn = F.layer_norm(x, [cfg.hidden_size], bp["norm1.weight"],
+                          bp["norm1.bias"])
+        q, k, v = _qkv(hn, bp, cfg.num_heads)
+        o = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                           training=False)
+        return _block_tail(x, _merge_heads(o), bp), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(layer, x, params.blocks)
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)),
+    }
+    x = F.layer_norm(x, [cfg.hidden_size], params.head["norm_f.weight"],
+                     params.head["norm_f.bias"])
+    logits = jnp.einsum("bh,vh->bv", x[:, -1], params.emb["wte.weight"])
+    return logits, cache
+
+
+def decode_step(params: DecodeParams, token, cache, pos, cfg=None):
+    """One incremental step: token [B] at position pos (scalar) ->
+    (logits [B, V], updated cache)."""
+    cfg = cfg or params.cfg
+    scale = 1.0 / (cfg.hidden_size // cfg.num_heads) ** 0.5
+    x = jnp.take(params.emb["wte.weight"], token[:, None], axis=0) \
+        + params.emb["wpe.weight"][pos][None, None, :]
+    max_len = cache["k"].shape[3]
+    live = (jnp.arange(max_len) <= pos)[None, None, None, :]
+
+    def layer(x, xs):
+        bp, k_cache, v_cache = xs
+        hn = F.layer_norm(x, [cfg.hidden_size], bp["norm1.weight"],
+                          bp["norm1.bias"])
+        q, k, v = _qkv(hn, bp, cfg.num_heads)      # [B, H, 1, D]
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q,
+                       k_cache.astype(q.dtype)) * scale
+        s = jnp.where(live, s.astype(jnp.float32), -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v_cache.astype(x.dtype))
+        return _block_tail(x, _merge_heads(o), bp), (k_cache, v_cache)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer, x, (params.blocks, cache["k"], cache["v"]))
+    cache = {"k": ks, "v": vs}
+    x = F.layer_norm(x, [cfg.hidden_size], params.head["norm_f.weight"],
+                     params.head["norm_f.bias"])
+    logits = jnp.einsum("bh,vh->bv", x[:, -1], params.emb["wte.weight"])
+    return logits, cache
+
+
+def _sample(logits, key, temperature, top_k, top_p):
+    """Masked categorical draw; temperature<=0 means greedy."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        # clamp: top_k > vocab would crash lax.top_k deep in the trace
+        kth = jax.lax.top_k(
+            logits, min(int(top_k), logits.shape[-1]))[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p is not None:
+        sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest prefix with mass >= top_p stays; find its cutoff logit
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "max_new_tokens", "temperature", "top_k", "top_p"))
+def _generate_jit(trees, cfg, prompt_ids, max_new_tokens, temperature,
+                  top_k, top_p, key):
+    params = DecodeParams(*trees, cfg)
+    batch, prompt_len = prompt_ids.shape
+    cache = init_cache(cfg, batch, prompt_len + max_new_tokens)
+    logits, cache = prefill(params, prompt_ids, cache, cfg)
+    first = _sample(logits, key, temperature, top_k, top_p)
+
+    def step(carry, i):
+        token, cache, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = decode_step(params, token, cache,
+                                    prompt_len + i, cfg)
+        nxt = _sample(logits, sub, temperature, top_k, top_p)
+        return (nxt, cache, key), nxt
+
+    (_, _, _), rest = jax.lax.scan(
+        step, (first, cache, key), jnp.arange(max_new_tokens - 1))
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def generate(model_or_params, prompt_ids, max_new_tokens,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None, rng_key=None):
+    """Generate [B, max_new_tokens] continuations of prompt_ids [B, S].
+
+    One compiled program per (shape, sampling-config); defaults to
+    greedy.  temperature > 0 enables sampling (pass rng_key for
+    reproducibility)."""
+    params = (model_or_params
+              if isinstance(model_or_params, DecodeParams)
+              else build_decode_params(model_or_params))
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    total = prompt_ids.shape[1] + max_new_tokens
+    if total > params.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt+new = {total} exceeds max_seq_len "
+            f"{params.cfg.max_seq_len}")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
+    return _generate_jit((params.emb, params.blocks, params.head),
+                         params.cfg, prompt_ids, max_new_tokens,
+                         float(temperature), top_k, top_p, key)

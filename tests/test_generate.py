@@ -1,0 +1,127 @@
+"""KV-cache decoding engine: token-exact parity with the cache-free
+model (models/generate.py).  The cache-free oracle recomputes the full
+forward per emitted token."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.generate import (build_decode_params, decode_step,
+                                        generate, init_cache, prefill)
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.nn.layers import _swap_params, param_dict
+
+
+def _model(**kw):
+    cfg = dict(vocab_size=97, hidden_size=48, num_layers=3, num_heads=4,
+               max_seq_len=32, dropout=0.0)
+    cfg.update(kw)
+    return GPT(GPTConfig(**cfg))
+
+
+def _greedy_nocache(model, prompt, n):
+    """Oracle: full forward over the growing sequence each step."""
+    ids = jnp.asarray(prompt, jnp.int32)
+    with _swap_params(model, param_dict(model)):
+        for _ in range(n):
+            logits = model(ids)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    return np.asarray(ids[:, prompt.shape[1]:])
+
+
+def test_prefill_logits_match_model():
+    model = _model()
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 97, (2, 7)), jnp.int32)
+    params = build_decode_params(model)
+    cache = init_cache(params.cfg, 2, 16)
+    logits, cache = prefill(params, prompt, cache)
+    with _swap_params(model, param_dict(model)):
+        ref = model(prompt)[:, -1]
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=2e-4, atol=1e-5)
+    # cache holds the prompt's k/v: a decode step at pos=7 must match
+    # the model run on prompt+token
+    tok = jnp.asarray([5, 9], jnp.int32)
+    step_logits, _ = decode_step(params, tok, cache, 7)
+    ext = jnp.concatenate([prompt, tok[:, None]], axis=1)
+    with _swap_params(model, param_dict(model)):
+        ref2 = model(ext)[:, -1]
+    np.testing.assert_allclose(np.asarray(step_logits),
+                               np.asarray(ref2), rtol=2e-4, atol=1e-5)
+
+
+def test_greedy_generate_token_exact_vs_nocache():
+    model = _model()
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(0, 97, (3, 5)), jnp.int32)
+    out = generate(model, prompt, max_new_tokens=10)
+    assert out.shape == (3, 10)
+    ref = _greedy_nocache(model, prompt, 10)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_single_token_generation():
+    model = _model()
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    out = generate(model, prompt, max_new_tokens=1)
+    assert out.shape == (1, 1)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  _greedy_nocache(model, prompt, 1))
+
+
+def test_topk1_sampling_equals_greedy():
+    model = _model()
+    prompt = jnp.asarray([[4, 8, 15, 16]], jnp.int32)
+    greedy = generate(model, prompt, max_new_tokens=6)
+    top1 = generate(model, prompt, max_new_tokens=6, temperature=0.7,
+                    top_k=1, rng_key=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(top1))
+
+
+def test_sampling_reproducible_and_varies():
+    model = _model()
+    prompt = jnp.asarray([[4, 8, 15, 16]], jnp.int32)
+    a = generate(model, prompt, max_new_tokens=8, temperature=1.0,
+                 top_k=20, rng_key=jax.random.PRNGKey(7))
+    b = generate(model, prompt, max_new_tokens=8, temperature=1.0,
+                 top_k=20, rng_key=jax.random.PRNGKey(7))
+    c = generate(model, prompt, max_new_tokens=8, temperature=1.0,
+                 top_k=20, rng_key=jax.random.PRNGKey(8))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert (np.asarray(a) < 97).all() and (np.asarray(a) >= 0).all()
+
+
+def test_top_p_masks_tail():
+    """With a peaked distribution, top_p=0.5 must only ever emit the
+    argmax token."""
+    from paddle_tpu.models.generate import _sample
+
+    logits = jnp.asarray([[10.0, 0.0, -1.0, -2.0]] * 4)
+    for seed in range(5):
+        tok = _sample(logits, jax.random.PRNGKey(seed), 1.0, None, 0.5)
+        assert (np.asarray(tok) == 0).all()
+
+
+def test_generate_guards():
+    model = _model()
+    prompt = jnp.zeros((1, 30), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(model, prompt, max_new_tokens=10)   # 40 > 32
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(model, jnp.zeros((1, 4), jnp.int32), max_new_tokens=0)
+    moe = GPT(GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_seq_len=16, num_experts=4))
+    with pytest.raises(NotImplementedError):
+        build_decode_params(moe)
+
+
+def test_bf16_generate_runs():
+    model = _model(dtype="bfloat16")
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = generate(model, prompt, max_new_tokens=5)
+    assert out.shape == (1, 5) and out.dtype == jnp.int32

@@ -24,60 +24,14 @@ if REPO not in sys.path:
 import numpy as np  # noqa: E402
 
 
-def time_config(batch, remat, iters=10, reps=3):
+def time_config(batch, remat, iters=10):
     import jax
-    import jax.numpy as jnp
 
-    from bench import RESNET50_FWD_FLOPS_224, _peak_flops
-    from paddle_tpu.models.resnet import resnet50
-    from paddle_tpu.models.train import init_train_state, make_train_step
-    from paddle_tpu.nn import functional as F
-    from paddle_tpu.optimizer.functional import Momentum
+    from bench import _peak_flops, resnet50_time_config
 
-    model = resnet50(dtype="bfloat16", data_format="NHWC")
-    opt = Momentum(0.1, 0.9)
-    state = init_train_state(model, opt)
-
-    if remat:
-        # checkpoint INSIDE the loss (before value_and_grad): the whole
-        # conv stack recomputes in the backward instead of storing
-        # activations — wrapping the finished train step would be a
-        # primal no-op
-        def loss_fn(m, x, y):
-            return jax.checkpoint(
-                lambda xx: F.cross_entropy(m(xx), y).mean())(x)
-    else:
-        def loss_fn(m, x, y):
-            return F.cross_entropy(m(x), y).mean()
-
-    step = make_train_step(model, opt, loss_fn=loss_fn, jit=False)
-
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((batch, 3, 224, 224)),
-                    jnp.bfloat16)
-    y = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
-
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def run(state, x, y):
-        def body(st, _):
-            st, loss = step(st, x, y)
-            return st, loss
-        return jax.lax.scan(body, state, None, length=iters)
-
-    st, losses = run(state, x, y)
-    assert np.isfinite(float(losses[-1]))
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        st, losses = run(st, x, y)
-        float(losses[-1])
-        best = min(best, (time.perf_counter() - t0) / iters)
     peak = _peak_flops(jax.devices()[0])
-    mfu = 3.0 * RESNET50_FWD_FLOPS_224 * batch / best / peak
-    return {"batch": batch, "remat": remat,
-            "step_ms": round(best * 1e3, 2),
-            "samples_per_sec": round(batch / best, 1),
-            "mfu": round(mfu, 4)}
+    return resnet50_time_config(peak, batch=batch, remat=remat,
+                                iters=iters)
 
 
 def main():
@@ -95,7 +49,28 @@ def main():
     if dev.platform != "tpu":
         print(json.dumps({"skipped": f"not on TPU ({dev.platform})"}))
         return 1
-    results = []
+    from bench import _git_sha, _load_bench_tpu, _save_bench_tpu
+
+    def persist(results):
+        # save after EVERY timed config (the tunnel can die mid-sweep
+        # and a timeout kill must not discard measured rows), and never
+        # clobber a previous good sweep with an all-error one
+        timed = [r for r in results if "mfu" in r]
+        if not timed:
+            return None
+        best = max(timed, key=lambda r: r["mfu"])
+        row = {"metric": "resnet50_sweep", "configs": results,
+               "best": best,
+               "device": str(getattr(dev, "device_kind", dev.platform)),
+               "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()),
+               "git_sha": _git_sha()}
+        doc = _load_bench_tpu() or {"rows": {}}
+        doc["rows"]["resnet50_sweep"] = row
+        _save_bench_tpu(doc)
+        return best
+
+    results, best = [], None
     for batch in (64, 128, 256):
         for remat in (False, True):
             try:
@@ -105,18 +80,7 @@ def main():
                      "error": f"{type(e).__name__}: {e}"[:160]}
             results.append(r)
             print(json.dumps(r), flush=True)
-    timed = [r for r in results if "mfu" in r]
-    best = max(timed, key=lambda r: r["mfu"]) if timed else None
-    row = {"metric": "resnet50_sweep", "configs": results, "best": best,
-           "device": str(getattr(dev, "device_kind", dev.platform)),
-           "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                        time.gmtime())}
-    from bench import _git_sha, _load_bench_tpu, _save_bench_tpu
-
-    row["git_sha"] = _git_sha()
-    doc = _load_bench_tpu() or {"rows": {}}
-    doc["rows"]["resnet50_sweep"] = row
-    _save_bench_tpu(doc)
+            best = persist(results) or best
     print(json.dumps({"sweep_best": best}), flush=True)
     return 0
 

@@ -80,6 +80,17 @@ def tpu_rows():
         return 0
 
 
+def bench_tpu_mtime():
+    """This-run signal: bench.py only (re)writes BENCH_TPU.json when it
+    actually captured rows ON CHIP, so an mtime advance means THIS run
+    measured something — unlike the row count, which persists from past
+    captures."""
+    try:
+        return os.path.getmtime(os.path.join(REPO, "BENCH_TPU.json"))
+    except OSError:
+        return 0.0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-hours", type=float, default=11.0)
@@ -94,15 +105,16 @@ def main():
     while time.time() < deadline:
         if probe(args.probe_timeout):
             log("tunnel UP — running bench.py on chip")
-            before = tpu_rows()
+            mtime_before = bench_tpu_mtime()
             rc = run_locked("bench.py", args.bench_timeout)
             rows = tpu_rows()
-            log("bench rc=%s BENCH_TPU.json rows=%d (+%d this run)"
-                % (rc, rows, rows - before))
-            # gate on THIS run succeeding, not on rows persisted by
-            # past captures — a tunnel death right after the probe
+            # gate on THIS run writing on-chip rows (mtime advance),
+            # not on rows persisted by past captures — a tunnel death
+            # right after the probe (bench falls back to CPU, exits 0)
             # must not trigger an hour of sweep against a dead chip
-            good = rc == 0 and rows > 0
+            good = rc == 0 and bench_tpu_mtime() > mtime_before
+            log("bench rc=%s rows=%d captured_this_run=%s"
+                % (rc, rows, good))
             if good:
                 # chip window is precious: also run the resnet50 tuning
                 # sweep (writes rows["resnet50_sweep"] itself)
