@@ -54,15 +54,110 @@ def _block_attn(q, k, v, scale, causal, q_start, k_start):
     return o, m, l
 
 
-def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
+def _use_flash_blocks(impl, s_loc):
+    if impl not in ("auto", "flash", "xla"):
+        raise ValueError(
+            f"ring_attention impl must be 'auto', 'flash' or 'xla'; "
+            f"got {impl!r}")
+    if impl == "xla":
+        return False
+    if impl == "flash":
+        return True
+    # auto: the Pallas kernel path needs the TPU backend (interpret mode
+    # on CPU is correctness-only) and a lane-aligned local shard
+    from ..kernels.backend import is_tpu_backend
+
+    return is_tpu_backend() and s_loc % 128 == 0
+
+
+def _flash_ring_block(q, k, v, scale, rel):
+    """One q-shard x kv-shard block through the Pallas flash kernel,
+    returning (normalized out f32, lse f32).
+
+    rel classifies the kv shard against the q shard on the causal ring:
+    0 = past (full attention), 1 = diagonal (causal triangle), 2 =
+    future (contributes nothing: lse = -inf weights it out of the
+    combine).  No offset mask is ever needed — the three cases are
+    exactly the kernel's causal=False / causal=True / skip."""
+    from ..kernels.flash_attention import flash_attention_with_lse
+
+    def past(_):
+        o, lse = flash_attention_with_lse(q, k, v, causal=False,
+                                          sm_scale=scale)
+        return o.astype(jnp.float32), lse
+
+    def diag(_):
+        o, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                          sm_scale=scale)
+        return o.astype(jnp.float32), lse
+
+    def future(_):
+        return (jnp.zeros(q.shape, jnp.float32),
+                jnp.full(q.shape[:3], NEG_INF, jnp.float32))
+
+    return jax.lax.switch(rel, [past, diag, future], None)
+
+
+def _combine_lse(acc, o_i, lse_i):
+    """Merge (normalized out, lse) partials: softmax-weighted average.
+    An empty partial (lse = -inf) gets weight exp(-inf) = 0."""
+    o_acc, lse_acc = acc
+    lse_new = jnp.logaddexp(lse_acc, lse_i)
+    safe = jnp.where(lse_new <= NEG_INF, 0.0, lse_new)
+    a = jnp.exp(lse_acc - safe)[..., None]
+    b = jnp.exp(lse_i - safe)[..., None]
+    return o_acc * a + o_i * b, lse_new
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal, sm_scale):
+    """Ring loop with the Pallas flash kernel computing each block —
+    the per-block [S/n, S/n] score tile never touches HBM, and the
+    (out, lse) partials merge exactly (same identity the flash kernel
+    uses across k tiles, applied across ring hops)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def rel_of(kv_idx):
+        if not causal:
+            return jnp.int32(0)                    # every shard: full
+        return jnp.where(kv_idx == idx, 1,
+                         jnp.where(kv_idx < idx, 0, 2)).astype(jnp.int32)
+
+    acc = _combine_lse(
+        (jnp.zeros(q.shape, jnp.float32),
+         jnp.full(q.shape[:3], NEG_INF, jnp.float32)),
+        *_flash_ring_block(q, k, v, sm_scale, rel_of(idx)))
+
+    def step(carry, _):
+        k_cur, v_cur, kv_idx, acc = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        kv_idx = (kv_idx - 1) % n
+        o_i, lse_i = _flash_ring_block(q, k_cur, v_cur, sm_scale,
+                                       rel_of(kv_idx))
+        return (k_cur, v_cur, kv_idx, _combine_lse(acc, o_i, lse_i)), None
+
+    (_, _, _, (o, _)), _ = jax.lax.scan(
+        step, (k, v, idx, acc), None, length=n - 1)
+    return o.astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None,
+                   impl="auto"):
     """Exact attention with q/k/v sequence-sharded over `axis_name`.
 
     Must be called inside shard_map (or pmap) over a mesh with that axis;
     q, k, v are the local [B, H, S_local, D] shards. Returns the local
     output shard, same shape/dtype as q.
+
+    impl: "auto" (Pallas flash blocks on TPU, XLA composition
+    elsewhere), "flash", or "xla".
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if _use_flash_blocks(impl, q.shape[2]):
+        return _ring_attention_flash(q, k, v, axis_name, causal, sm_scale)
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_loc = q.shape[2]
@@ -112,21 +207,21 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, sm_scale=None):
 # for the process lifetime, so cap it rather than let re-meshing
 # workloads accumulate closures
 @functools.lru_cache(maxsize=8)
-def _sharded_ring_fn(mesh, axis_name, causal, sm_scale):
+def _sharded_ring_fn(mesh, axis_name, causal, sm_scale, impl):
     spec = P(None, None, axis_name, None)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=axis_name,
-                          causal=causal, sm_scale=sm_scale),
+                          causal=causal, sm_scale=sm_scale, impl=impl),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return jax.jit(fn)
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
-                           sm_scale=None):
+                           sm_scale=None, impl="auto"):
     """Global-array entry point: q/k/v are [B, H, S, D] jax Arrays; the
     seq dim is (re)sharded over `axis_name` and the ring runs under jit.
-    The jitted fn is cached per (mesh, axis, causal, scale) so repeated
-    calls hit the compile cache."""
+    The jitted fn is cached per (mesh, axis, causal, scale, impl) so
+    repeated calls hit the compile cache."""
     return _sharded_ring_fn(mesh, axis_name, bool(causal),
-                            sm_scale)(q, k, v)
+                            sm_scale, impl)(q, k, v)

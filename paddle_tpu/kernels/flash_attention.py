@@ -283,12 +283,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd(sm_scale, causal, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    b, h, s, d = q.shape
     do = g
     # delta = rowsum(dO * O), [b,h,s] — plain XLA, fuses into one pass
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
+    return _bwd_core(sm_scale, causal, block_q, block_k, q, k, v, do,
+                     lse, delta)
 
+
+def _bwd_core(sm_scale, causal, block_q, block_k, q, k, v, do, lse,
+              delta):
+    """Shared FA-2 backward given a precomputed delta row vector.
+
+    The (out, lse)-output variant folds its lse cotangent in here:
+    ds = p*(dp - delta + dlse) = p*(dp - (delta - dlse)), so the caller
+    just passes delta - dlse and the kernels stay byte-identical."""
+    b, h, s, d = q.shape
     q3 = q.reshape(b * h, s, d)
     k3 = k.reshape(b * h, s, d)
     v3 = v.reshape(b * h, s, d)
@@ -364,15 +374,31 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
 _flash.defvjp(_flash_fwd, _bwd)
 
 
-def flash_attention(q, k, v, causal=False, sm_scale=None,
-                    block_q=None, block_k=None):
-    """Tiled attention over [batch, heads, seq, head_dim] inputs.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, sm_scale, causal, block_q, block_k):
+    return _fwd(q, k, v, sm_scale, causal, block_q, block_k)
 
-    seq must be a multiple of the block sizes (default DEFAULT_BLOCK_Q/
-    DEFAULT_BLOCK_K = 512, auto-shrunk to a power-of-two divisor of
-    seq); head_dim should be an MXU-friendly 64/128/256. Returns the same
-    shape/dtype as q.
-    """
+
+def _flash_lse_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(sm_scale, causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    do, dlse = g
+    # dlse rides the same kernels: ds gains +p*dlse, i.e. delta -> delta
+    # - dlse (see _bwd_core)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1) - dlse.astype(jnp.float32)
+    return _bwd_core(sm_scale, causal, block_q, block_k, q, k, v, do,
+                     lse, delta)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _resolve(q, sm_scale, block_q, block_k):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     s = q.shape[-2]
@@ -393,4 +419,29 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
     if s % block_q or s % block_k:
         raise ValueError(
             f"seq {s} must be divisible by block sizes ({block_q},{block_k})")
-    return _flash(q, k, v, float(sm_scale), bool(causal), block_q, block_k)
+    return float(sm_scale), block_q, block_k
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None,
+                    block_q=None, block_k=None):
+    """Tiled attention over [batch, heads, seq, head_dim] inputs.
+
+    seq must be a multiple of the block sizes (default DEFAULT_BLOCK_Q/
+    DEFAULT_BLOCK_K = 512, auto-shrunk to a power-of-two divisor of
+    seq); head_dim should be an MXU-friendly 64/128/256. Returns the same
+    shape/dtype as q.
+    """
+    sm_scale, block_q, block_k = _resolve(q, sm_scale, block_q, block_k)
+    return _flash(q, k, v, sm_scale, bool(causal), block_q, block_k)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, sm_scale=None,
+                             block_q=None, block_k=None):
+    """flash_attention that ALSO returns the per-row logsumexp
+    [batch, heads, seq] (f32), fully differentiable through both
+    outputs — the building block for ring attention's (out, lse) block
+    combine (distributed/ring_attention.py): partial attentions over kv
+    shards merge exactly via softmax-weighted averaging of normalized
+    outputs."""
+    sm_scale, block_q, block_k = _resolve(q, sm_scale, block_q, block_k)
+    return _flash_lse(q, k, v, sm_scale, bool(causal), block_q, block_k)

@@ -186,9 +186,11 @@ def _sample(logits, key, temperature, top_k, top_p):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None:
-        # clamp: top_k > vocab would crash lax.top_k deep in the trace
+        # clamp to [1, vocab]: either end would crash lax.top_k /
+        # broadcasting deep in the trace
         kth = jax.lax.top_k(
-            logits, min(int(top_k), logits.shape[-1]))[0][..., -1:]
+            logits,
+            max(1, min(int(top_k), logits.shape[-1])))[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
     if top_p is not None:
         sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]

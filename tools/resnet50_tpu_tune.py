@@ -11,7 +11,6 @@ successful bench capture); safe to run standalone:
   flock /tmp/paddle_tpu_chip.lock -c "python tools/resnet50_tpu_tune.py"
 """
 
-import functools
 import json
 import os
 import sys
@@ -21,7 +20,6 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-import numpy as np  # noqa: E402
 
 
 def time_config(batch, remat, iters=10):
