@@ -310,6 +310,24 @@ def bench_wide_deep(on_tpu, peak):
             "vs_baseline": None, "step_ms": round(dt * 1e3, 2)}
 
 
+def bench_bert_chunked_ce(on_tpu, peak):
+    """On-chip A/B for the streaming vocab-chunked CE (models/gpt.py
+    streaming_softmax_ce): same BERT-geometry config as the headline
+    but with ce_vocab_chunk=8192, so BENCH_TPU.json records whether
+    keeping the [B,S,32k] logits out of the backward beats the fused
+    full-logits CE.  TPU-only (the CPU fallback shape is too small for
+    the difference to mean anything)."""
+    from paddle_tpu.models.gpt import GPTConfig
+
+    if not on_tpu:
+        return {"metric": "bert_chunked_ce",
+                "skipped": "tpu-only A/B"}
+    cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=512, dtype="bfloat16",
+                    ce_vocab_chunk=8192)
+    return _bench_gpt_mfu(cfg, 16, 512, 20, "bert_chunked_ce_mfu", peak)
+
+
 def bench_flash_tiles(on_tpu, peak):
     """Flash-attention tile A/B (VERDICT r3 #10): time the Pallas kernel
     fwd+bwd at seq 2048 and 4096 with 512x512 vs 256x256 tiles and
@@ -480,7 +498,8 @@ def main():
     benches = [("lenet", bench_lenet), ("resnet", bench_resnet50),
                ("transformer_flash", bench_transformer_flash),
                ("wide_deep", bench_wide_deep),
-               ("flash_tile_ab", bench_flash_tiles)]
+               ("flash_tile_ab", bench_flash_tiles),
+               ("bert_chunked_ce", bench_bert_chunked_ce)]
     for key, fn in benches:
         try:
             r = record(key, fn(on_tpu, peak))

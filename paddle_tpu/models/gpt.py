@@ -25,6 +25,12 @@ class GPTConfig:
     max_seq_len: int = 1024
     dropout: float = 0.0
     dtype: str = "float32"
+    # > 0: stream the CE over vocab chunks of this size (must divide
+    # vocab_size) so the full [B, S, V] logits never persist to the
+    # backward — the chunk recomputes under jax.checkpoint. Trades
+    # one extra logits matmul pass for ~2x less logits HBM traffic;
+    # worthwhile at 32k+ vocabs on HBM-bound configs.
+    ce_vocab_chunk: int = 0
     # MoE (0 = dense FFN): experts shard over the mesh's "ep" axis via
     # distributed.sharded.gpt_rules; router aux loss folds into .loss()
     num_experts: int = 0
@@ -103,6 +109,33 @@ class GPT(nn.Layer):
         self.norm_f = nn.LayerNorm(cfg.hidden_size, dtype=cfg.dtype)
 
     def forward(self, input_ids):
+        x = self._final_hidden(input_ids)
+        return jnp.einsum("bsh,vh->bsv", x, F._val(self.wte.weight))
+
+    def loss(self, input_ids, labels):
+        # fused CE: per-token logsumexp minus the gathered label logit.
+        # Materialising log_softmax over [B, S, V] in fp32 costs ~4x the
+        # logits' HBM footprint; the reduction form lets XLA fuse the fp32
+        # upcast into the logsumexp and touch the full logits once.
+        if self.cfg.ce_vocab_chunk > 0:
+            h = self._final_hidden(input_ids)
+            ce = streaming_softmax_ce(h, F._val(self.wte.weight), labels,
+                                      self.cfg.ce_vocab_chunk)
+        else:
+            logits = self.forward(input_ids)
+            lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+            lab = jnp.take_along_axis(logits, labels[..., None],
+                                      axis=-1)[..., 0]
+            ce = (lse - lab.astype(jnp.float32)).mean()
+        if self.cfg.num_experts > 0:
+            # router load-balance loss from the SAME trace's forward
+            aux = sum(blk.moe.last_aux_loss for blk in self.blocks)
+            ce = ce + self.cfg.moe_aux_weight * aux
+        return ce
+
+    def _final_hidden(self, input_ids):
+        """forward() up to (and including) the final layer norm, without
+        the head matmul."""
         seq = input_ids.shape[1]
         if seq > self.cfg.max_seq_len:
             raise ValueError(
@@ -112,20 +145,44 @@ class GPT(nn.Layer):
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
         for blk in self.blocks:
             x = blk(x)
-        x = self.norm_f(x)
-        return jnp.einsum("bsh,vh->bsv", x, F._val(self.wte.weight))
+        return self.norm_f(x)
 
-    def loss(self, input_ids, labels):
-        # fused CE: per-token logsumexp minus the gathered label logit.
-        # Materialising log_softmax over [B, S, V] in fp32 costs ~4x the
-        # logits' HBM footprint; the reduction form lets XLA fuse the fp32
-        # upcast into the logsumexp and touch the full logits once.
-        logits = self.forward(input_ids)
-        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
-        lab = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-        ce = (lse - lab.astype(jnp.float32)).mean()
-        if self.cfg.num_experts > 0:
-            # router load-balance loss from the SAME trace's forward
-            aux = sum(blk.moe.last_aux_loss for blk in self.blocks)
-            ce = ce + self.cfg.moe_aux_weight * aux
-        return ce
+
+def streaming_softmax_ce(h, wte, labels, chunk):
+    """Fused CE streamed over vocab chunks: mean(lse - z_label) where
+    z = h @ wte^T, computed chunk-by-chunk with an online logsumexp so
+    the [N, V] logits never exist at once — and jax.checkpoint on the
+    chunk body keeps them out of the BACKWARD's residuals too (each
+    chunk's logits recompute from h and its wte rows).
+
+    h: [B, S, H] (or [N, H]); wte: [V, H]; labels int [B, S] / [N]."""
+    v, hidden = wte.shape
+    if v % chunk != 0:
+        raise ValueError(f"ce_vocab_chunk {chunk} must divide vocab {v}")
+    n_chunks = v // chunk
+    hs = h.reshape(-1, hidden)
+    lab = labels.reshape(-1)
+    n = hs.shape[0]
+    wcs = wte.reshape(n_chunks, chunk, hidden)
+    bases = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
+
+    @jax.checkpoint
+    def body(carry, xs):
+        m, s, zlab = carry
+        wc, base = xs
+        z = jnp.einsum("nh,ch->nc", hs, wc,
+                       preferred_element_type=jnp.float32)
+        m_new = jnp.maximum(m, z.max(axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            z - m_new[:, None]).sum(axis=-1)
+        in_c = (lab >= base) & (lab < base + chunk)
+        zl = jnp.take_along_axis(
+            z, jnp.clip(lab - base, 0, chunk - 1)[:, None], axis=1)[:, 0]
+        zlab = jnp.where(in_c, zl, zlab)
+        return (m_new, s, zlab), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, zlab), _ = jax.lax.scan(body, init, (wcs, bases))
+    return (m + jnp.log(s) - zlab).mean()

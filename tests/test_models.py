@@ -153,3 +153,54 @@ def test_grad_clip_global_norm():
     g = {"a": np.full((4,), 10.0, np.float32)}
     out = clip(g)
     assert np.linalg.norm(np.asarray(out["a"])) <= 1.0 + 1e-5
+
+
+def test_streaming_ce_matches_full_loss_and_grads():
+    """GPTConfig.ce_vocab_chunk: the streamed CE must equal the fused
+    full-logits CE in value AND parameter gradients (it is the same
+    math, chunked with an online logsumexp + per-chunk remat)."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.gpt import GPT, GPTConfig, streaming_softmax_ce
+    from paddle_tpu.nn.layers import _swap_params, param_dict
+
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.integers(0, 96, (2, 8)), jnp.int32)
+    y = jnp.asarray(r.integers(0, 96, (2, 8)), jnp.int32)
+
+    base = dict(vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+                max_seq_len=16)
+    m_full = GPT(GPTConfig(**base))
+    m_chunk = GPT(GPTConfig(**base, ce_vocab_chunk=32))
+    params = param_dict(m_full)
+
+    def loss_of(model, p):
+        with _swap_params(model, p):
+            return model.loss(x, y)
+
+    l_full, g_full = jax.value_and_grad(
+        lambda p: loss_of(m_full, p))(params)
+    l_chunk, g_chunk = jax.value_and_grad(
+        lambda p: loss_of(m_chunk, p))(params)
+    np.testing.assert_allclose(float(l_full), float(l_chunk), rtol=1e-6)
+    for n in g_full:
+        np.testing.assert_allclose(
+            np.asarray(g_full[n]), np.asarray(g_chunk[n]),
+            rtol=2e-4, atol=1e-6, err_msg=n)
+
+    # direct helper checks: label in first/last chunk, bad chunk size
+    h = jnp.asarray(r.normal(size=(3, 4, 32)), jnp.float32)
+    wte = jnp.asarray(r.normal(size=(96, 32)), jnp.float32)
+    lab = jnp.asarray([[0, 95, 31, 32]] * 3, jnp.int32)
+    ref_logits = jnp.einsum("bsh,vh->bsv", h, wte)
+    ref = (jax.nn.logsumexp(ref_logits, axis=-1)
+           - jnp.take_along_axis(ref_logits, lab[..., None],
+                                 axis=-1)[..., 0]).mean()
+    got = streaming_softmax_ce(h, wte, lab, 32)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    import pytest
+
+    with pytest.raises(ValueError, match="divide"):
+        streaming_softmax_ce(h, wte, lab, 7)
