@@ -107,22 +107,12 @@ class DataParallelTrainStep:
 
 
 class DataParallel:
-    """Parity shim for dygraph.DataParallel (parallel.py:223): wraps a
-    Layer; forward just delegates (replication is handled by the train
-    step), scale_loss/apply_collective_grads kept as no-ops for scripts
-    written against the reference API."""
+    """Alias for THE dygraph DataParallel implementation
+    (paddle_tpu.dygraph.parallel.DataParallel — reference
+    parallel.py:223): one semantics for both import paths.  Lazy so
+    this module never imports the dygraph package at import time."""
 
-    def __init__(self, layer, strategy=None):
-        self._layer = layer
+    def __new__(cls, layer, strategy=None):
+        from ..dygraph.parallel import DataParallel as _Impl
 
-    def __call__(self, *args, **kwargs):
-        return self._layer(*args, **kwargs)
-
-    def __getattr__(self, name):
-        return getattr(self.__dict__["_layer"], name)
-
-    def scale_loss(self, loss):
-        return loss
-
-    def apply_collective_grads(self):
-        pass
+        return _Impl(layer, strategy)

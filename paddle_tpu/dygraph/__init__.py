@@ -2,8 +2,10 @@
 
 Parity: /root/reference/python/paddle/fluid/dygraph/ — guard (base.py:190),
 to_variable, no_grad, grad (base.py:255), checkpoint save/load
-(checkpoint.py:33,96), optimizers usable with parameter lists, and
-DataParallel (parallel.py:223, provided by paddle_tpu.distributed).
+(checkpoint.py:33,96), optimizers usable with parameter lists, the Layer
+class zoo (.nn/.container), and DataParallel + prepare_context +
+ParallelEnv (.parallel; paddle_tpu.distributed.DataParallel aliases the
+same implementation).
 
 Autodiff note: the reference records a tape (imperative/tracer.cc) and
 `loss.backward()` walks it.  paddle_tpu.tape rebuilds that engine on
@@ -59,10 +61,18 @@ __all__ = [
     "Variable",
 ]
 # star-import parity: reference fluid/dygraph/__init__.py extends
-# __all__ with nn.__all__ and container.__all__
+# __all__ with nn.__all__, container.__all__ and parallel.__all__
 from . import container as _container, nn as _nn  # noqa: E402
+from . import parallel  # noqa: E402, F401
+from .parallel import (  # noqa: E402, F401
+    DataParallel,
+    ParallelEnv,
+    ParallelStrategy,
+    prepare_context,
+)
 
-__all__ += _nn.__all__ + _container.__all__
+__all__ += _nn.__all__ + _container.__all__ + parallel.__all__ + [
+    "parallel"]
 
 _in_dygraph = True
 

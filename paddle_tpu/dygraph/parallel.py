@@ -1,0 +1,160 @@
+"""fluid.dygraph.parallel parity: ParallelEnv / prepare_context /
+DataParallel (reference python/paddle/fluid/dygraph/parallel.py:30,54,
+223).  This is THE dygraph DataParallel implementation —
+paddle_tpu.distributed.DataParallel aliases it.
+
+The reference wraps a dygraph Layer so each process runs its own
+forward/backward and grads NCCL-allreduce across trainers.  Here the
+single-program SPMD path (distributed.DataParallelTrainStep) is the
+native design; this class keeps the 1.x multi-PROCESS script shape
+working with the reference's exact semantics: scale_loss divides the
+loss by nranks and apply_collective_grads SUM-reduces each parameter's
+tape gradient across processes (sum of 1/n-scaled grads = cross-rank
+mean), the rendezvous being distributed/env.py's
+jax.distributed.initialize.  In a single-process world both are exact
+no-ops, as in the reference.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import Layer
+
+__all__ = ["prepare_context", "ParallelEnv", "ParallelStrategy",
+           "DataParallel"]
+
+
+def __getattr__(name):            # lazy: avoid distributed<->dygraph cycle
+    if name == "ParallelEnv":
+        from ..distributed.env import ParallelEnv
+
+        return ParallelEnv
+    raise AttributeError(name)
+
+
+class ParallelStrategy:
+    """Reference parallel.py ParallelStrategy (pybind'd struct there):
+    nranks / local_rank / trainer_endpoints / current_endpoint."""
+
+    def __init__(self):
+        self.nranks = 1
+        self.local_rank = 0
+        self.trainer_endpoints = []
+        self.current_endpoint = ""
+
+
+def prepare_context(strategy=None):
+    """Build the parallel context from the PADDLE_* env contract and
+    perform the DCN rendezvous (reference parallel.py:30 +
+    imperative/nccl_context.cc).  With an explicit multi-rank strategy
+    the rendezvous still runs (env-driven and idempotent) — the
+    reference likewise initializes the communicator for any
+    nranks >= 2."""
+    from ..distributed.env import init_parallel_env
+
+    if strategy is None:
+        strategy = ParallelStrategy()
+        env = init_parallel_env()
+        strategy.nranks = env.nranks
+        strategy.local_rank = env.local_rank
+        strategy.trainer_endpoints = env.trainer_endpoints
+        strategy.current_endpoint = env.current_endpoint
+    elif int(strategy.nranks) > 1:
+        init_parallel_env()
+    return strategy
+
+
+@functools.lru_cache(maxsize=4)
+def _cross_process_sum(mesh):
+    """Jitted leading-axis sum, cached per mesh so repeated
+    apply_collective_grads calls hit the compile cache."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.jit(lambda s: jnp.sum(s, axis=0),
+                   out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.lru_cache(maxsize=4)
+def _process_mesh(n):
+    """1-device-per-process mesh (processes may own several chips; the
+    grad sum only needs one lane per process)."""
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devs = [per_proc[i] for i in range(n)]
+    return jax.sharding.Mesh(np.array(devs), ("dp",))
+
+
+class DataParallel(Layer):
+    """Reference parallel.py:223 — wrap a dygraph Layer for
+    multi-process data parallelism.
+
+    loss = model.scale_loss(loss); loss.backward();
+    model.apply_collective_grads(); opt.minimize(loss)
+    """
+
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy or prepare_context()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @property
+    def _nranks(self):
+        return max(int(self._strategy.nranks), 1)
+
+    def scale_loss(self, loss):
+        """Divide by trainer count so the summed allreduce averages
+        (reference :290; no-op for nranks == 1)."""
+        if self._nranks == 1:
+            return loss
+        return loss / float(self._nranks)
+
+    def apply_collective_grads(self):
+        """SUM-allreduce every parameter gradient across processes
+        (reference :382 coalesced NCCL allreduce; with scale_loss's 1/n
+        the synced grad is the cross-rank mean).  Grads live on the
+        tape's EagerParameter.grad slots."""
+        if self._nranks == 1:
+            return
+        if jax.process_count() != self._nranks:
+            raise RuntimeError(
+                f"apply_collective_grads: strategy says nranks="
+                f"{self._nranks} but jax.process_count()="
+                f"{jax.process_count()} — the rendezvous did not run "
+                f"(prepare_context needs the PADDLE_* env contract)")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = _process_mesh(self._nranks)
+        sh = NamedSharding(mesh, P("dp"))
+        summed = _cross_process_sum(mesh)
+
+        for _, p in self._layers.named_parameters():
+            if p.trainable and p.grad is not None:
+                local = np.asarray(p.grad)[None]      # [1, ...] this rank
+                stacked = jax.make_array_from_process_local_data(
+                    sh, local)
+                p.grad = jnp.asarray(summed(stacked).addressable_data(0))
+
+    # checkpoint surface delegates to the wrapped layers with UNwrapped
+    # names (reference :459 strips the _layers prefix)
+    def state_dict(self, include_sublayers=True):
+        return self._layers.state_dict(include_sublayers)
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        return self._layers.set_state_dict(state_dict,
+                                           use_structured_name)
+
+    load_dict = set_state_dict
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, include_sublayers=True, prefix=""):
+        return self._layers.named_parameters(include_sublayers, prefix)

@@ -100,6 +100,34 @@ def main():
         wg, bg, loss = step(wg, bg, xg, yg)
         losses.append(float(np.asarray(loss.addressable_data(0))))
 
+    # --- dygraph DataParallel grad sync (fluid.dygraph.parallel) -------
+    import paddle_tpu.dygraph as dg
+    import paddle_tpu.nn as nn
+
+    strategy = dg.prepare_context()
+    assert strategy.nranks == 2, strategy.nranks
+    with dg.guard():
+        nn.seed(42)                       # identical init on both ranks
+        model = nn.Linear(4, 1)
+        dp = dg.DataParallel(model, strategy)
+        w0 = np.asarray(model.weight.value).copy()
+        b0 = float(np.asarray(model.bias.value)[0])
+        xb = np.full((2, 4), float(rank + 1), np.float32)
+        out = dp(dg.to_variable(xb))
+        loss = dp.scale_loss((out ** 2).mean())
+        loss.backward()
+        dp.apply_collective_grads()
+        g_sync = model.weight.gradient()
+        # closed form: rows identical -> pred_r = (r+1)*sum(w)+b;
+        # scale_loss makes each local grad pred_r*(r+1)/2 and the SUM
+        # allreduce yields the cross-rank MEAN of unscaled grads
+        # (reference semantics: sum of 1/n-scaled grads)
+        preds = [c * w0.sum() + b0 for c in (1.0, 2.0)]
+        expect = preds[0] * 1.0 + preds[1] * 2.0
+        assert np.allclose(g_sync, expect, rtol=1e-5), (g_sync, expect)
+        # state_dict carries UNwrapped names
+        assert set(dp.state_dict()) == set(model.state_dict())
+
     if rank == 0:
         with open(out_path, "w") as f:
             json.dump({"losses": losses, "world": world}, f)
