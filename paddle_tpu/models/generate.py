@@ -31,7 +31,7 @@ from ..nn import functional as F
 from ..nn.layers import param_dict
 
 __all__ = ["DecodeParams", "build_decode_params", "prefill",
-           "decode_step", "generate", "init_cache"]
+           "decode_step", "generate", "beam_search", "init_cache"]
 
 
 class DecCfg(NamedTuple):
@@ -227,14 +227,116 @@ def _generate_jit(trees, cfg, prompt_ids, max_new_tokens, temperature,
     return jnp.concatenate([first[:, None], rest.T], axis=1)
 
 
-def generate(model_or_params, prompt_ids, max_new_tokens,
-             temperature: float = 0.0, top_k: Optional[int] = None,
-             top_p: Optional[float] = None, rng_key=None):
-    """Generate [B, max_new_tokens] continuations of prompt_ids [B, S].
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "beam_size", "max_new_tokens", "eos_id"))
+def _beam_search_jit(trees, cfg, prompt_ids, beam_size, max_new_tokens,
+                     eos_id, length_penalty):
+    params = DecodeParams(*trees, cfg)
+    batch, prompt_len = prompt_ids.shape
+    K, V = beam_size, params.emb["wte.weight"].shape[0]
+    neg = jnp.float32(-1e30)
 
-    One compiled program per (shape, sampling-config); defaults to
-    greedy.  temperature > 0 enables sampling (pass rng_key for
-    reproducibility)."""
+    cache = init_cache(cfg, batch, prompt_len + max_new_tokens)
+    logits0, cache = prefill(params, prompt_ids, cache)
+    # beams live flattened [B*K] row-major; tile the prompt cache
+    cache = {k: jnp.repeat(v, K, axis=1) for k, v in cache.items()}
+
+    def beam_update(logp, finished, logits_bkv):
+        """One beam step: extend each live beam by every token, keep
+        the global top-K per batch.  Finished beams may only extend
+        with eos at zero added score (standard freeze)."""
+        logp_tok = jax.nn.log_softmax(
+            logits_bkv.astype(jnp.float32), axis=-1)
+        if eos_id is not None:
+            frozen = jnp.full((V,), neg).at[eos_id].set(0.0)
+            logp_tok = jnp.where(finished[..., None], frozen, logp_tok)
+        total = logp[..., None] + logp_tok           # [B, K, V]
+        top, idx = jax.lax.top_k(total.reshape(batch, K * V), K)
+        parent = idx // V                            # [B, K]
+        token = (idx % V).astype(jnp.int32)
+        fin_new = jnp.take_along_axis(finished, parent, axis=1)
+        if eos_id is not None:
+            fin_new = fin_new | (token == eos_id)
+        return top, parent, token, fin_new
+
+    # first expansion: only beam 0 is live so the top-K are K DISTINCT
+    # first tokens of the single prompt continuation
+    logp0 = jnp.full((batch, K), neg).at[:, 0].set(0.0)
+    fin0 = jnp.zeros((batch, K), bool)
+    logits_bkv = jnp.broadcast_to(logits0[:, None, :], (batch, K, V))
+    logp, parent, token, finished = beam_update(logp0, fin0, logits_bkv)
+
+    seqs = jnp.full((batch, K, max_new_tokens),
+                    eos_id if eos_id is not None else 0, jnp.int32)
+    seqs = seqs.at[:, :, 0].set(token)
+    lens = jnp.ones((batch, K), jnp.float32)
+    boffs = (jnp.arange(batch) * K)[:, None]
+
+    def reorder(cache, parent):
+        flat = (boffs + parent).reshape(-1)          # [B*K] global rows
+        return {k: v[:, flat] for k, v in cache.items()}
+
+    cache = reorder(cache, parent)
+
+    def step(carry, i):
+        token, cache, logp, finished, seqs, lens = carry
+        logits, cache = decode_step(params, token.reshape(-1), cache,
+                                    prompt_len + i)
+        logp, parent, tok_new, fin_new = beam_update(
+            logp, finished, logits.reshape(batch, K, V))
+        cache = reorder(cache, parent)
+        seqs = jnp.take_along_axis(seqs, parent[..., None], axis=1)
+        seqs = seqs.at[:, :, i + 1].set(tok_new)
+        was_fin = jnp.take_along_axis(finished, parent, axis=1)
+        lens = jnp.take_along_axis(lens, parent, axis=1) \
+            + (~was_fin).astype(jnp.float32)
+        return (tok_new, cache, logp, fin_new, seqs, lens), None
+
+    (token, cache, logp, finished, seqs, lens), _ = jax.lax.scan(
+        step, (token, cache, logp, finished, seqs, lens),
+        jnp.arange(max_new_tokens - 1))
+
+    # GNMT-style normalization at final ranking; length_penalty is a
+    # TRACED float (0.0 -> exponent 0 -> divisor 1), so sweeping it
+    # reuses one compiled program
+    scores = logp / (((5.0 + lens) / 6.0) ** length_penalty)
+    order = jnp.argsort(-scores, axis=1)
+    return (jnp.take_along_axis(seqs, order[..., None], axis=1),
+            jnp.take_along_axis(scores, order, axis=1))
+
+
+def beam_search(model_or_params, prompt_ids, beam_size, max_new_tokens,
+                eos_id: Optional[int] = None,
+                length_penalty: float = 0.0):
+    """KV-cached beam search: (sequences [B, beam, T], scores [B, beam])
+    sorted best-first.  The generative identity of the reference
+    (layers.beam_search / dynamic_decode BeamSearchDecoder,
+    layers/rnn.py) rebuilt on the static-shape cache decoder: beams ride
+    flattened into the batch dim, the cache reorders by parent beam via
+    one gather per step, and the whole search is a single lax.scan.
+
+    Scores are summed token log-probs; `length_penalty` > 0 applies the
+    GNMT normalization at final ranking.  With `eos_id`, finished beams
+    freeze (eos-padded, score unchanged)."""
+    params, prompt_ids = _resolve_and_check(model_or_params, prompt_ids,
+                                            max_new_tokens)
+    if beam_size < 1:
+        raise ValueError("beam_size must be >= 1")
+    vocab = params.emb["wte.weight"].shape[0]
+    if beam_size > vocab:
+        # the first expansion has only `vocab` live candidates; wider
+        # beams would fill from dead -inf rows and return garbage
+        raise ValueError(
+            f"beam_size {beam_size} exceeds vocab_size {vocab}")
+    return _beam_search_jit(
+        (params.emb, params.blocks, params.head), params.cfg,
+        prompt_ids, int(beam_size), int(max_new_tokens),
+        None if eos_id is None else int(eos_id), float(length_penalty))
+
+
+def _resolve_and_check(model_or_params, prompt_ids, max_new_tokens):
+    """Shared generate/beam_search preamble: params resolution + the
+    sequence-budget guards."""
     params = (model_or_params
               if isinstance(model_or_params, DecodeParams)
               else build_decode_params(model_or_params))
@@ -246,6 +348,19 @@ def generate(model_or_params, prompt_ids, max_new_tokens,
             f"{params.cfg.max_seq_len}")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    return params, prompt_ids
+
+
+def generate(model_or_params, prompt_ids, max_new_tokens,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None, rng_key=None):
+    """Generate [B, max_new_tokens] continuations of prompt_ids [B, S].
+
+    One compiled program per (shape, sampling-config); defaults to
+    greedy.  temperature > 0 enables sampling (pass rng_key for
+    reproducibility)."""
+    params, prompt_ids = _resolve_and_check(model_or_params, prompt_ids,
+                                            max_new_tokens)
     key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
     return _generate_jit((params.emb, params.blocks, params.head),
                          params.cfg, prompt_ids, max_new_tokens,

@@ -125,3 +125,88 @@ def test_bf16_generate_runs():
     prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
     out = generate(model, prompt, max_new_tokens=5)
     assert out.shape == (1, 5) and out.dtype == jnp.int32
+
+
+def _teacher_forced_score(model, prompt, seq):
+    """Independent oracle: sum of log softmax(logits)[token] over the
+    generated positions, via the cache-free model."""
+    full = jnp.concatenate([prompt, seq[None]], axis=1)
+    with _swap_params(model, param_dict(model)):
+        logits = model(full)
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    n = prompt.shape[1]
+    score = 0.0
+    for i in range(seq.shape[0]):
+        score += float(lp[0, n - 1 + i, int(seq[i])])
+    return score
+
+
+def test_beam_search_scores_are_true_log_probs():
+    from paddle_tpu.models.generate import beam_search
+
+    model = _model()
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(0, 97, (1, 4)), jnp.int32)
+    seqs, scores = beam_search(model, prompt, beam_size=3,
+                               max_new_tokens=5)
+    assert seqs.shape == (1, 3, 5) and scores.shape == (1, 3)
+    # sorted best-first, and every score equals the independent
+    # teacher-forced log-prob of its sequence
+    s = np.asarray(scores)[0]
+    assert (np.diff(s) <= 1e-6).all()
+    for b in range(3):
+        ref = _teacher_forced_score(model, prompt,
+                                    jnp.asarray(seqs[0, b]))
+        np.testing.assert_allclose(s[b], ref, rtol=1e-4, atol=1e-4)
+    # beams are distinct
+    assert len({tuple(np.asarray(seqs[0, b])) for b in range(3)}) == 3
+
+
+def test_beam1_matches_greedy():
+    from paddle_tpu.models.generate import beam_search
+
+    model = _model()
+    prompt = jnp.asarray([[7, 3, 11]], jnp.int32)
+    greedy = generate(model, prompt, max_new_tokens=6)
+    seqs, _ = beam_search(model, prompt, beam_size=1, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(seqs[:, 0]),
+                                  np.asarray(greedy))
+
+
+def test_beam_search_guards_and_penalty_reuses_compile():
+    from paddle_tpu.models.generate import beam_search
+
+    model = _model()
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="vocab"):
+        beam_search(model, prompt, beam_size=200, max_new_tokens=2)
+    with pytest.raises(ValueError, match="beam_size"):
+        beam_search(model, prompt, beam_size=0, max_new_tokens=2)
+    # length_penalty is traced: sweeping it must not change sequences
+    # of a no-eos search (all lengths equal), only the score scale
+    s0, sc0 = beam_search(model, prompt, beam_size=3, max_new_tokens=4)
+    s1, sc1 = beam_search(model, prompt, beam_size=3, max_new_tokens=4,
+                          length_penalty=0.6)
+    np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))
+    assert not np.allclose(np.asarray(sc0), np.asarray(sc1))
+
+
+def test_beam_search_eos_freezes():
+    from paddle_tpu.models.generate import beam_search
+
+    model = _model()
+    rng = np.random.default_rng(5)
+    prompt = jnp.asarray(rng.integers(0, 97, (2, 3)), jnp.int32)
+    eos = 1
+    seqs, scores = beam_search(model, prompt, beam_size=3,
+                               max_new_tokens=8, eos_id=eos,
+                               length_penalty=0.6)
+    arr = np.asarray(seqs)
+    # after the first eos, the tail is all eos (frozen padding)
+    for b in range(arr.shape[0]):
+        for k in range(arr.shape[1]):
+            row = arr[b, k]
+            hits = np.where(row == eos)[0]
+            if hits.size:
+                assert (row[hits[0]:] == eos).all(), row
+    assert np.isfinite(np.asarray(scores)).all()
