@@ -17,8 +17,10 @@ decoder for causal LMs:
 
 Math mirrors models/gpt.py GPT.forward exactly (same param names from
 nn.layers.param_dict, same SDPA scale 1/sqrt(head_dim), fp32 softmax)
-— tested token-exact against the cache-free model.  Dense-FFN configs
-only (MoE decode dispatch is a training-scale feature).
+— tested token-exact against the cache-free model, for dense-FFN and
+MoE configs alike (decode steps use drop-free expert capacity; parity
+with a full-forward recompute holds when the recompute's capacity does
+not bind either — see _block_tail).
 """
 
 import functools
@@ -42,11 +44,14 @@ class DecCfg(NamedTuple):
     num_layers: int
     max_seq_len: int
     dtype: str
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def from_model_cfg(cls, cfg):
         return cls(cfg.hidden_size, cfg.num_heads, cfg.num_layers,
-                   cfg.max_seq_len, cfg.dtype)
+                   cfg.max_seq_len, cfg.dtype, cfg.moe_top_k,
+                   cfg.moe_capacity_factor)
 
 
 class DecodeParams(NamedTuple):
@@ -60,10 +65,13 @@ class DecodeParams(NamedTuple):
 
 
 def build_decode_params(model):
-    """GPT -> DecodeParams (concrete arrays; reusable across calls)."""
-    if model.cfg.num_experts > 0:
-        raise NotImplementedError(
-            "KV-cache decode supports dense-FFN GPT configs only")
+    """GPT -> DecodeParams (concrete arrays; reusable across calls).
+
+    MoE configs decode too: top-k expert CHOICE is per-token, but the
+    capacity-drop mask is cohort-dependent, so decode steps route with
+    drop-free capacity (cap = cohort size; see _block_tail) — cached
+    decode then matches a full-forward recompute exactly whenever that
+    recompute's own capacity does not bind."""
     from ..distributed.pipeline import stack_block_params
 
     flat = param_dict(model)
@@ -89,15 +97,34 @@ def _split_heads(x, num_heads):
                          (0, 2, 1, 3))
 
 
-def _block_tail(x, attn_out, bp):
-    """Residual + MLP shared by prefill and decode (GPTBlock.forward
-    with dropout off)."""
+def _block_tail(x, attn_out, bp, cfg, decode=False):
+    """Residual + MLP/MoE shared by prefill and decode (GPTBlock.forward
+    with dropout off).
+
+    MoE capacity: prefill keeps cfg.moe_capacity_factor so the prompt
+    pass matches the training forward bit-for-bit; decode steps raise
+    the factor to E/k (cap = cohort size) so NO token is ever
+    capacity-dropped — small per-step cohorts have high load-fraction
+    variance and would otherwise drop more often than training cohorts,
+    silently degrading generation."""
     x = x + attn_out @ bp["attn.out_proj.weight"] \
         + bp["attn.out_proj.bias"]
     h = F.layer_norm(x, [x.shape[-1]], bp["norm2.weight"],
                      bp["norm2.bias"])
-    ff = F.gelu(h @ bp["fc1.weight"] + bp["fc1.bias"])
-    return x + ff @ bp["fc2.weight"] + bp["fc2.bias"]
+    if "moe.wg" in bp:
+        from ..distributed.moe import moe_ffn
+
+        factor = cfg.moe_capacity_factor
+        if decode:
+            n_experts = bp["moe.wg"].shape[-1]
+            factor = max(factor, n_experts / cfg.moe_top_k)
+        ff, _ = moe_ffn({"wg": bp["moe.wg"], "w1": bp["moe.w1"],
+                         "w2": bp["moe.w2"]}, h, k=cfg.moe_top_k,
+                        capacity_factor=factor)
+    else:
+        ff = F.gelu(h @ bp["fc1.weight"] + bp["fc1.bias"]) \
+            @ bp["fc2.weight"] + bp["fc2.bias"]
+    return x + ff
 
 
 def _qkv(hn, bp, num_heads):
@@ -130,7 +157,7 @@ def prefill(params: DecodeParams, input_ids, cache, cfg=None):
         q, k, v = _qkv(hn, bp, cfg.num_heads)
         o = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                            training=False)
-        return _block_tail(x, _merge_heads(o), bp), (k, v)
+        return _block_tail(x, _merge_heads(o), bp, cfg), (k, v)
 
     x, (ks, vs) = jax.lax.scan(layer, x, params.blocks)
     cache = {
@@ -169,7 +196,8 @@ def decode_step(params: DecodeParams, token, cache, pos, cfg=None):
         s = jnp.where(live, s.astype(jnp.float32), -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
         o = jnp.einsum("bhqk,bhkd->bhqd", p, v_cache.astype(x.dtype))
-        return _block_tail(x, _merge_heads(o), bp), (k_cache, v_cache)
+        return _block_tail(x, _merge_heads(o), bp, cfg,
+                           decode=True), (k_cache, v_cache)
 
     x, (ks, vs) = jax.lax.scan(
         layer, x, (params.blocks, cache["k"], cache["v"]))

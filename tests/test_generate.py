@@ -114,10 +114,25 @@ def test_generate_guards():
         generate(model, prompt, max_new_tokens=10)   # 40 > 32
     with pytest.raises(ValueError, match="max_new_tokens"):
         generate(model, jnp.zeros((1, 4), jnp.int32), max_new_tokens=0)
-    moe = GPT(GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
-                        num_heads=4, max_seq_len=16, num_experts=4))
-    with pytest.raises(NotImplementedError):
-        build_decode_params(moe)
+
+
+def test_moe_greedy_generate_matches_nocache():
+    """MoE decode: per-token routing is cohort-independent, so with
+    non-binding capacity the cached decode is token-exact vs the full
+    forward."""
+    model = GPT(GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=4, max_seq_len=24, num_experts=4,
+                          moe_top_k=2, moe_capacity_factor=8.0))
+    # decisive router: scale up the gate so expert choices sit far from
+    # ulp-level attention differences (a per-layer argmax would
+    # otherwise amplify 1e-5 hidden-state noise into token flips)
+    for blk in model.blocks:
+        blk.moe.wg.set_value(np.asarray(blk.moe.wg.value) * 10.0)
+    rng = np.random.default_rng(9)
+    prompt = jnp.asarray(rng.integers(0, 64, (2, 5)), jnp.int32)
+    out = generate(model, prompt, max_new_tokens=6)
+    ref = _greedy_nocache(model, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(out), ref)
 
 
 def test_bf16_generate_runs():
