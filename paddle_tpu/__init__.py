@@ -47,10 +47,13 @@ from .framework import (
     append_backward,
     gradients,
     ParamAttr,
-    initializer,
-    unique_name,
 )
-from .framework import backward
+
+# top-level fluid module paths (richer than the framework internals:
+# initializer adds init_on_cpu, unique_name adds switch)
+from . import initializer
+from . import unique_name
+from . import backward
 
 from . import layers
 from . import nets

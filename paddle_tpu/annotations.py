@@ -1,0 +1,31 @@
+"""`fluid.annotations` import-path compatibility.
+
+Parity: python/paddle/fluid/annotations.py (deprecated :22): wraps a
+function so each call emits a deprecation warning naming the
+replacement, without changing behavior.
+"""
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(since, instead, extra_message=""):
+    def decorator(func):
+        msg = ("API %s is deprecated since %s. Please use %s instead."
+               % (func.__name__, since, instead))
+        if extra_message:
+            full = msg + "\n" + extra_message
+        else:
+            full = msg
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(full, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__doc__ = (full + "\n\n") + (func.__doc__ or "")
+        return wrapper
+
+    return decorator

@@ -1,0 +1,9 @@
+"""`fluid.backward` import-path compatibility.
+
+Parity: python/paddle/fluid/backward.py (append_backward :1145,
+gradients :1678) — implementation in framework/backward.py.
+"""
+
+from .framework.backward import append_backward, gradients  # noqa: F401
+
+__all__ = ["append_backward", "gradients"]

@@ -1,0 +1,18 @@
+"""`fluid.data` import-path compatibility.
+
+Parity: python/paddle/fluid/data.py — `fluid.data` must be BOTH a
+callable (`fluid.data("x", [None, 784])`) and an importable module
+path (`from paddle.fluid.data import data`).  The reference gets the
+callable binding from `from .data import *` in fluid/__init__.py and
+would lose it if the submodule were imported afterwards; here the
+sys.modules entry is replaced by the function itself (carrying a
+`.data` self-reference for the from-import form), so both spellings
+stay correct in any import order.
+"""
+
+import sys
+
+from .framework.program import data
+
+data.data = data
+sys.modules[__name__] = data

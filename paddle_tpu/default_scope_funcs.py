@@ -1,0 +1,69 @@
+"""`fluid.default_scope_funcs` import-path compatibility.
+
+Parity: python/paddle/fluid/default_scope_funcs.py (get_cur_scope :46,
+enter/leave_local_scope :59,:68, var :76, find_var :83,
+scoped_function :90): a thread-local stack of Scopes over the
+framework Scope store, so nested helper code can allocate into a
+local scope that is dropped on exit.
+"""
+
+import threading
+
+from .framework.executor import Scope, global_scope
+
+__all__ = [
+    "get_cur_scope", "enter_local_scope", "leave_local_scope", "var",
+    "find_var", "scoped_function",
+]
+
+_local = threading.local()
+
+
+def _stack():
+    if not hasattr(_local, "stack"):
+        _local.stack = [global_scope()]
+    return _local.stack
+
+
+def get_cur_scope():
+    return _stack()[-1]
+
+
+def enter_local_scope():
+    cur = get_cur_scope()
+    new = Scope()
+    new._parent = cur
+    _stack().append(new)
+    return new
+
+
+def leave_local_scope():
+    stack = _stack()
+    if len(stack) == 1:
+        raise RuntimeError("cannot leave the global scope")
+    stack.pop().drop_kids()
+
+
+def var(name):
+    return get_cur_scope().var(name)
+
+
+def find_var(name):
+    """Parent-chain lookup (Scope::FindVar semantics, scope.h:46).
+    Stops at the first scope CONTAINING the name — a created-but-unset
+    local var (value None) shadows any parent entry, as in the
+    reference."""
+    scope = get_cur_scope()
+    while scope is not None:
+        if name in scope.local_var_names():
+            return scope.find_var(name)
+        scope = getattr(scope, "_parent", None)
+    return None
+
+
+def scoped_function(func):
+    enter_local_scope()
+    try:
+        return func()
+    finally:
+        leave_local_scope()

@@ -1,0 +1,52 @@
+"""`fluid.dygraph_utils` import-path compatibility.
+
+Parity: python/paddle/fluid/dygraph_utils.py
+(_append_activation_in_dygraph :20, _append_bias_in_dygraph :48):
+helpers the reference's generated `core.ops.*` fast path uses to
+tack an activation / bias onto an eager op result.  The cudnn/mkldnn
+toggles have no TPU meaning and are accepted and ignored.
+"""
+
+from . import nn
+
+__all__ = []
+
+_ACTS = {
+    "relu": nn.functional.relu,
+    "relu6": nn.functional.relu6,
+    "sigmoid": nn.functional.sigmoid,
+    "tanh": nn.functional.tanh,
+    "softmax": nn.functional.softmax,
+    "leaky_relu": nn.functional.leaky_relu,
+    "elu": nn.functional.elu,
+    "gelu": nn.functional.gelu,
+    "softplus": nn.functional.softplus,
+    "swish": nn.functional.swish,
+    "hard_sigmoid": nn.functional.hard_sigmoid,
+    "hard_swish": nn.functional.hard_swish,
+}
+
+
+def _append_activation_in_dygraph(input, act=None, use_cudnn=None,
+                                  use_mkldnn=None):
+    if act is None:
+        return input
+    if act not in _ACTS:
+        raise ValueError("unsupported activation %r" % act)
+    return _ACTS[act](input)
+
+
+def _append_bias_in_dygraph(input, bias=None, axis=1):
+    if bias is None:
+        return input
+    # elementwise_add(axis) semantics: align bias dims starting at
+    # `axis`; axis=-1 means trailing alignment (rank(x) - rank(bias))
+    ndim = len(input.shape)
+    bshape = list(bias.shape)
+    if axis == -1:
+        axis = ndim - len(bshape)
+    if not 0 <= axis <= ndim - len(bshape):
+        raise ValueError("bias of rank %d cannot align at axis %d of a "
+                         "rank-%d input" % (len(bshape), axis, ndim))
+    new_shape = [1] * axis + bshape + [1] * (ndim - axis - len(bshape))
+    return input + bias.reshape(*new_shape)

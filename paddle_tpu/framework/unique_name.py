@@ -26,12 +26,25 @@ def generate(key):
     return generator(key)
 
 
-@contextlib.contextmanager
-def guard(new_generator=None):
+def generate_with_ignorable_key(key):
+    """unique_name.py:123 parity — same counter space; the reference's
+    "ignorable" prefix only matters to its dygraph name checker."""
+    return generator(key)
+
+
+def switch(new_generator=None):
+    """unique_name.py:131 parity — swap the global generator, returning
+    the previous one so callers can restore it."""
     global generator
     old = generator
     generator = new_generator or UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
     try:
         yield
     finally:
-        generator = old
+        switch(old)

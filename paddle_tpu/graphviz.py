@@ -1,0 +1,163 @@
+"""`fluid.graphviz` import-path compatibility.
+
+Parity: python/paddle/fluid/graphviz.py (Graph :51, Node :141,
+Edge :161, GraphPreviewGenerator :184): a small dot-text builder used
+by net_drawer/debugger; `show` renders via the `dot` binary when
+present and otherwise just writes the .dot file.
+"""
+
+import subprocess
+
+__all__ = ["Graph", "Node", "Edge", "GraphPreviewGenerator"]
+
+
+def crepr(v):
+    return '"%s"' % v if isinstance(v, str) else str(v)
+
+
+class Rank:
+    def __init__(self, kind, name, priority):
+        assert kind in ("source", "sink", "same", "min", "max")
+        self.kind = kind
+        self.name = name
+        self.priority = priority
+        self.nodes = []
+
+    def __str__(self):
+        if not self.nodes:
+            return ""
+        return "{rank=%s; %s}" % (
+            self.kind, ",".join(n.name for n in self.nodes))
+
+
+class Node:
+    counter = 1
+
+    def __init__(self, label, prefix, description="", **attrs):
+        self.label = label
+        self.name = "%s_%d" % (prefix, Node.counter)
+        Node.counter += 1
+        self.description = description
+        self.attrs = attrs
+
+    def __str__(self):
+        attrs = ", ".join("%s=%s" % (k, crepr(v))
+                          for k, v in sorted(self.attrs.items()))
+        return "%s [label=%s %s];" % (self.name, crepr(self.label), attrs)
+
+
+class Edge:
+    def __init__(self, source, target, **attrs):
+        self.source = source
+        self.target = target
+        self.attrs = attrs
+
+    def __str__(self):
+        attrs = ", ".join("%s=%s" % (k, crepr(v))
+                          for k, v in sorted(self.attrs.items()))
+        return "%s -> %s [%s]" % (self.source.name, self.target.name, attrs)
+
+
+class Graph:
+    rank_counter = 0
+
+    def __init__(self, title, **attrs):
+        self.title = title
+        self.attrs = attrs
+        self.nodes = []
+        self.edges = []
+        self.rank_groups = {}
+
+    def code(self):
+        return self.__str__()
+
+    def rank_group(self, kind, priority):
+        name = "rankgroup-%d" % Graph.rank_counter
+        Graph.rank_counter += 1
+        self.rank_groups[name] = Rank(kind, name, priority)
+        return name
+
+    def node(self, label, prefix, description="", **attrs):
+        node = Node(label, prefix, description, **attrs)
+        if "rank" in attrs:
+            group = self.rank_groups[attrs.pop("rank")]
+            node.attrs.pop("rank")
+            group.nodes.append(node)
+        self.nodes.append(node)
+        return node
+
+    def edge(self, source, target, **attrs):
+        edge = Edge(source, target, **attrs)
+        self.edges.append(edge)
+        return edge
+
+    def compile(self, dot_path):
+        """Write the .dot and try `dot -Tpdf`; returns the image path
+        (which exists only if the dot binary is installed)."""
+        with open(dot_path, "w") as f:
+            f.write(self.__str__())
+        image_path = dot_path[:-4] + ".pdf" \
+            if dot_path.endswith(".dot") else dot_path + ".pdf"
+        try:
+            subprocess.run(["dot", "-Tpdf", dot_path, "-o", image_path],
+                           check=False, capture_output=True)
+        except FileNotFoundError:
+            pass
+        return image_path
+
+    def show(self, dot_path):
+        return self.compile(dot_path)
+
+    def _rank_repr(self):
+        ranks = sorted(self.rank_groups.values(),
+                       key=lambda x: x.priority)
+        return "\n".join(str(g) for g in ranks)
+
+    def __str__(self):
+        reprs = ["digraph G {"]
+        reprs += ["%s=%s;" % (k, crepr(v))
+                  for k, v in sorted(self.attrs.items())]
+        reprs.append(self._rank_repr())
+        reprs += [str(n) for n in self.nodes]
+        reprs += [str(e) for e in self.edges]
+        reprs.append("} // end G")
+        return "\n".join(r for r in reprs if r)
+
+
+class GraphPreviewGenerator:
+    """graphviz.py:184 parity — the param/op/arg styling the debugger
+    uses for program visualization."""
+
+    def __init__(self, title):
+        self.graph = Graph(title)
+
+    def __call__(self, path="temp.dot", show=False):
+        if show:
+            return self.graph.show(path)
+        return self.graph.compile(path)
+
+    def add_param(self, name, data_type, highlight=False):
+        label = "\\n".join(["param", name, str(data_type)])
+        return self.graph.node(
+            label, prefix="param", description=name, shape="none",
+            style="rounded,filled,bold",
+            color="#148b97" if not highlight else "orange",
+            fontcolor="#ffffff", fontname="Arial")
+
+    def add_op(self, opType, **kwargs):
+        highlight = kwargs.pop("highlight", False)
+        return self.graph.node(
+            "<<B>%s</B>>" % opType, prefix="op", description=opType,
+            shape="box", style="rounded, filled, bold",
+            color="#303A3A" if not highlight else "orange",
+            fontname="Arial", fontcolor="#ffffff")
+
+    def add_arg(self, name, highlight=False):
+        return self.graph.node(
+            name, prefix="arg", description=name, shape="box",
+            style="rounded,filled,bold", fontname="Arial",
+            fontcolor="#999999",
+            color="#dddddd" if not highlight else "orange")
+
+    def add_edge(self, source, target, **kwargs):
+        return self.graph.edge(source, target, **kwargs)

@@ -1,0 +1,8 @@
+"""`fluid.inferencer` import-path compatibility.
+
+Parity: python/paddle/fluid/inferencer.py:16 — the reference's module
+is an empty placeholder noting the move into fluid.contrib; the
+working Inferencer lives in contrib/inferencer.py here too.
+"""
+
+__all__ = []

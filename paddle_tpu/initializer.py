@@ -1,0 +1,43 @@
+"""`fluid.initializer` import-path compatibility.
+
+Parity: python/paddle/fluid/initializer.py (Constant :86, Uniform
+:161, Normal :268, TruncatedNormal :351, Xavier :432, MSRA :564,
+NumpyArray :822) — implementation in framework/initializer.py.
+
+`init_on_cpu`/`force_init_on_cpu` are placement hints in the
+reference; under XLA, initializer placement is the compiler's
+decision, so the context is an honest no-op kept for script parity.
+"""
+
+import contextlib
+
+from .framework.initializer import (  # noqa: F401
+    Constant, ConstantInitializer, Initializer, MSRA, MSRAInitializer,
+    Normal, NormalInitializer, NumpyArrayInitializer, TruncatedNormal,
+    TruncatedNormalInitializer, Uniform, UniformInitializer, Xavier,
+    XavierInitializer)
+
+__all__ = [
+    "Constant", "Uniform", "Normal", "TruncatedNormal", "Xavier",
+    "MSRA", "NumpyArrayInitializer", "force_init_on_cpu", "init_on_cpu",
+]
+
+_force_init_on_cpu = False
+
+
+def force_init_on_cpu():
+    """initializer.py parity — reads the flag set by init_on_cpu()."""
+    return _force_init_on_cpu
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    """initializer.py parity — placement hint; XLA decides placement,
+    so only the flag round-trip is kept."""
+    global _force_init_on_cpu
+    prev = _force_init_on_cpu
+    _force_init_on_cpu = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu = prev

@@ -1,0 +1,10 @@
+"""`fluid.layer_helper` import-path compatibility.
+
+Parity: python/paddle/fluid/layer_helper.py — implementation in
+framework/layer_helper.py.  Custom-layer authors import LayerHelper
+from this path in 1.x scripts.
+"""
+
+from .framework.layer_helper import LayerHelper  # noqa: F401
+
+__all__ = ["LayerHelper"]

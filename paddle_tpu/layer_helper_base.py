@@ -1,0 +1,11 @@
+"""`fluid.layer_helper_base` import-path compatibility.
+
+Parity: python/paddle/fluid/layer_helper_base.py (LayerHelperBase).
+The rebuild keeps one helper class: LayerHelper serves both the
+static builders and the dygraph Layer zoo, so the base alias points
+at the same implementation.
+"""
+
+from .framework.layer_helper import LayerHelper as LayerHelperBase  # noqa: F401
+
+__all__ = ["LayerHelperBase"]

@@ -1,0 +1,74 @@
+"""`fluid.net_drawer` import-path compatibility.
+
+Parity: python/paddle/fluid/net_drawer.py (draw_node :62,
+draw_edge :69, parse_graph :77, draw_graph :103): renders a Program's
+op/var graph to dot text over the JSON-IR Program instead of the
+protobuf desc.
+"""
+
+import argparse
+import itertools
+import logging
+
+from .graphviz import Graph
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["draw_graph"]
+
+OP_STYLE = {"shape": "oval", "color": "#0F9D58", "style": "filled",
+            "fontcolor": "#FFFFFF"}
+VAR_STYLE = {"shape": "box"}
+
+_id_counter = itertools.count(0)
+
+
+def unique_id():
+    return next(_id_counter)
+
+
+def draw_node(graph, op):
+    return graph.node(op.type, prefix="op", **OP_STYLE)
+
+
+def draw_var_node(graph, name, var_nodes):
+    if name not in var_nodes:
+        var_nodes[name] = graph.node(name, prefix="var", **VAR_STYLE)
+    return var_nodes[name]
+
+
+def parse_graph(program, graph, var_dict=None):
+    var_nodes = {}
+    for block in program.blocks:
+        for op in block.ops:
+            op_node = draw_node(graph, op)
+            for name in op.input_names():
+                graph.edge(draw_var_node(graph, name, var_nodes), op_node)
+            for name in op.output_names():
+                graph.edge(op_node, draw_var_node(graph, name, var_nodes))
+    return var_nodes
+
+
+def draw_graph(startup_program, main_program, **kwargs):
+    filename = kwargs.get("filename") or "graph.dot"
+    graph = Graph(kwargs.get("graph_attr", {}).get("name", "net"))
+    parse_graph(startup_program, graph)
+    parse_graph(main_program, graph)
+    graph.compile(filename)
+    return graph
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="draw a paddle_tpu Program saved as JSON IR")
+    parser.add_argument("program", help="program JSON file")
+    parser.add_argument("--output", default="graph.dot")
+    args = parser.parse_args()
+    from .framework.program import Program
+    with open(args.program) as f:
+        program = Program.from_json(f.read())
+    draw_graph(program, program, filename=args.output)
+
+
+if __name__ == "__main__":
+    main()

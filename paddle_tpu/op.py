@@ -1,0 +1,61 @@
+"""`fluid.op` import-path compatibility.
+
+Parity: python/paddle/fluid/op.py (get_all_op_protos :24,
+OpDescCreationMethod :41, OperatorFactory :178): the pre-layers way
+of creating raw operators by name.  Here the "proto" registry is
+ops/registry.py and the created object is a framework Operator
+appended nowhere — callers add it to a Block or run it eagerly
+through the registry kernel.
+"""
+
+from .framework.program import Operator
+from .ops import registry
+
+__all__ = ["get_all_op_protos", "Operator", "OperatorFactory"]
+
+
+def get_all_op_protos():
+    """List of registered op defs (the OpProto analogue)."""
+    return [registry.get_op(name) for name in registry.list_ops()]
+
+
+def is_str(s):
+    return isinstance(s, str)
+
+
+class OperatorFactory:
+    """op.py:178 parity — `create_op(type, inputs..., outputs..., attrs...)`.
+    Slot routing follows the fluid naming convention the reference
+    encodes in its op protos: variable slots are Capitalized (X, Y,
+    W, Ids, Out...), attrs are lower_snake_case — so a Capitalized
+    key with string value(s) is a slot, everything else an attr.
+    Output slots are the Out* family (Y is an INPUT for mul/
+    elementwise ops)."""
+
+    _OUTPUT_SLOTS = ("Out", "Output", "Outs", "OutScale", "ParamOut",
+                     "MeanOut", "VarianceOut", "Y@GRAD")
+
+    def create(self, op_type, **kwargs):
+        if not registry.has_op(op_type):
+            raise ValueError("unknown op type %r" % op_type)
+        inputs, outputs, attrs = {}, {}, {}
+        for key, val in kwargs.items():
+            is_names = is_str(val) or (
+                isinstance(val, (list, tuple)) and val
+                and all(is_str(v) for v in val))
+            if is_names and key[:1].isupper():
+                target = (outputs if key in self._OUTPUT_SLOTS
+                          or key.endswith("Out") else inputs)
+                target[key] = [val] if is_str(val) else list(val)
+            else:
+                attrs[key] = val
+        return Operator(block=None, type=op_type, inputs=inputs,
+                        outputs=outputs, attrs=attrs)
+
+    def __call__(self, *args, **kwargs):
+        if "type" in kwargs:
+            op_type = kwargs.pop("type")
+        else:
+            assert len(args) == 1
+            op_type = args[0]
+        return self.create(op_type, **kwargs)

@@ -1,0 +1,11 @@
+"""`fluid.unique_name` import-path compatibility.
+
+Parity: python/paddle/fluid/unique_name.py (generate :84, switch :131,
+guard :185) — implementation in framework/unique_name.py.
+"""
+
+from .framework.unique_name import (  # noqa: F401
+    UniqueNameGenerator, generate, generate_with_ignorable_key, guard,
+    switch)
+
+__all__ = ["generate", "switch", "guard"]

@@ -1,0 +1,23 @@
+"""`fluid.wrapped_decorator` import-path compatibility.
+
+Parity: python/paddle/fluid/wrapped_decorator.py (wrap_decorator :21,
+signature_safe_contextmanager :31).  The reference leans on the
+third-party `decorator` package to preserve signatures; functools in
+the stdlib is enough here.
+"""
+
+import contextlib
+import functools
+
+__all__ = ["wrap_decorator", "signature_safe_contextmanager"]
+
+
+def wrap_decorator(decorator_func):
+    def __impl__(func):
+        wrapped = decorator_func(func)
+        return functools.wraps(func)(wrapped)
+
+    return __impl__
+
+
+signature_safe_contextmanager = wrap_decorator(contextlib.contextmanager)

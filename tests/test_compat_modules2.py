@@ -1,0 +1,307 @@
+"""Round-4 top-level fluid module-path parity.
+
+Reference paths covered: python/paddle/fluid/{backward, initializer,
+unique_name, layer_helper, layer_helper_base, wrapped_decorator,
+annotations, default_scope_funcs, inferencer, distribute_lookup_table,
+dygraph_utils, data, trainer_desc, device_worker, trainer_factory,
+data_feed_desc, graphviz, net_drawer, op}.py — each must be importable
+at the same dotted path AND behave.
+"""
+
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.layers as layers
+
+
+def test_backward_module_path():
+    from paddle_tpu.backward import append_backward, gradients
+    assert append_backward is fluid.framework.backward.append_backward
+    assert gradients is fluid.framework.backward.gradients
+
+
+def test_initializer_module_and_init_on_cpu():
+    from paddle_tpu import initializer
+    assert initializer.Xavier is initializer.XavierInitializer
+    assert not initializer.force_init_on_cpu()
+    with initializer.init_on_cpu():
+        assert initializer.force_init_on_cpu()
+    assert not initializer.force_init_on_cpu()
+
+
+def test_unique_name_switch_roundtrip():
+    from paddle_tpu import unique_name
+    gen = unique_name.UniqueNameGenerator()
+    old = unique_name.switch(gen)
+    try:
+        a = unique_name.generate("fc")
+        b = unique_name.generate_with_ignorable_key("fc")
+        assert (a, b) == ("fc_0", "fc_1")
+    finally:
+        restored = unique_name.switch(old)
+    # switch returns the generator being replaced
+    assert restored is gen
+
+
+def test_layer_helper_paths():
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.layer_helper_base import LayerHelperBase
+    assert issubclass(LayerHelper, LayerHelperBase)
+
+
+def test_wrapped_decorator_preserves_signature():
+    from paddle_tpu.wrapped_decorator import signature_safe_contextmanager
+
+    @signature_safe_contextmanager
+    def ctx(tag):
+        yield tag + 1
+
+    assert ctx.__name__ == "ctx"
+    with ctx(41) as v:
+        assert v == 42
+
+
+def test_annotations_deprecated_warns():
+    from paddle_tpu.annotations import deprecated
+
+    @deprecated(since="1.0", instead="new_fn")
+    def old_fn(x):
+        return x * 2
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert old_fn(3) == 6
+    assert any("new_fn" in str(w.message) for w in caught)
+
+
+def test_default_scope_funcs_local_scope():
+    from paddle_tpu import default_scope_funcs as dsf
+    base = dsf.get_cur_scope()
+    base.set_var("w", np.float32(7.0))
+    local = dsf.enter_local_scope()
+    try:
+        assert dsf.get_cur_scope() is local
+        # parent-chain lookup (Scope::FindVar semantics)
+        assert dsf.find_var("w") == np.float32(7.0)
+        dsf.get_cur_scope().set_var("tmp", 1)
+        assert dsf.find_var("tmp") == 1
+        # a created-but-unset local var shadows the parent's entry
+        dsf.var("w")
+        assert dsf.find_var("w") is None
+    finally:
+        dsf.leave_local_scope()
+    assert dsf.get_cur_scope() is base
+    assert dsf.find_var("tmp") is None
+    got = dsf.scoped_function(lambda: dsf.find_var("w"))
+    assert got == np.float32(7.0)
+    with pytest.raises(RuntimeError):
+        # never allowed to pop the global scope
+        dsf.leave_local_scope()
+
+
+def test_inferencer_is_contrib_pointer():
+    import paddle_tpu.inferencer as inf
+    assert inf.__all__ == []
+    from paddle_tpu.contrib.inferencer import Inferencer  # noqa: F401
+
+
+def test_find_distributed_lookup_table():
+    from paddle_tpu.distribute_lookup_table import (
+        find_distributed_lookup_table,
+        find_distributed_lookup_table_inputs,
+        find_distributed_lookup_table_outputs)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [None, 1], dtype="int64")
+        emb = layers.embedding(ids, size=(100, 8), is_distributed=True)
+        layers.embedding(ids, size=(50, 8))  # local table: ignored
+    table = find_distributed_lookup_table(main)
+    assert table is not None
+    assert find_distributed_lookup_table_inputs(main, table) == ["ids"]
+    assert find_distributed_lookup_table_outputs(main, table) == [emb.name]
+
+
+def test_find_distributed_lookup_table_none_and_multi():
+    from paddle_tpu.distribute_lookup_table import (
+        find_distributed_lookup_table)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [None, 1], dtype="int64")
+        layers.embedding(ids, size=(10, 4))
+    assert find_distributed_lookup_table(main) is None
+    with fluid.program_guard(main, startup):
+        layers.embedding(ids, size=(10, 4), is_distributed=True)
+        layers.embedding(ids, size=(20, 4), is_distributed=True)
+    with pytest.raises(ValueError):
+        find_distributed_lookup_table(main)
+
+
+def test_dygraph_utils_helpers():
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu import dygraph_utils
+    with dg.guard():
+        x = dg.to_variable(np.array([[-1.0, 2.0]], np.float32))
+        y = dygraph_utils._append_activation_in_dygraph(x, "relu")
+        np.testing.assert_allclose(np.asarray(y.numpy()), [[0.0, 2.0]])
+        assert dygraph_utils._append_activation_in_dygraph(x) is x
+        b = dg.to_variable(np.array([1.0, 1.0], np.float32))
+        z = dygraph_utils._append_bias_in_dygraph(x, b, axis=1)
+        np.testing.assert_allclose(np.asarray(z.numpy()), [[0.0, 3.0]])
+        # axis=-1 (the elementwise_add default) aligns trailing dims
+        z2 = dygraph_utils._append_bias_in_dygraph(x, b, axis=-1)
+        np.testing.assert_allclose(np.asarray(z2.numpy()), [[0.0, 3.0]])
+        assert tuple(z2.shape) == (1, 2)
+        with pytest.raises(ValueError):
+            dygraph_utils._append_bias_in_dygraph(x, b, axis=2)
+        with pytest.raises(ValueError):
+            dygraph_utils._append_activation_in_dygraph(x, "nope")
+
+
+def test_data_module_path_stays_callable():
+    import paddle_tpu.data  # noqa: F401  (module-path import form)
+    from paddle_tpu.data import data as data_fn
+    assert callable(fluid.data)
+    assert data_fn is fluid.data
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+    assert x.shape[-1] == 4
+
+
+def test_trainer_factory_default_and_opt_info():
+    from paddle_tpu.trainer_factory import TrainerFactory
+    from paddle_tpu.trainer_desc import MultiTrainer, DistMultiTrainer
+    from paddle_tpu.device_worker import Hogwild, DownpourSGD
+    t = TrainerFactory()._create_trainer(None)
+    assert isinstance(t, MultiTrainer)
+    assert isinstance(t._device_worker, Hogwild)
+    t._set_fetch_var_and_info([], [], print_period=10)
+    t._gen_trainer_desc()
+    assert t.proto_desc.class_name == "MultiTrainer"
+    assert t.proto_desc.device_worker_name == "HogwildWorker"
+
+    t2 = TrainerFactory()._create_trainer({
+        "trainer": "DistMultiTrainer", "device_worker": "DownpourSGD",
+        "dump_slot": True, "mpi_rank": 3})
+    assert isinstance(t2, DistMultiTrainer)
+    assert isinstance(t2._device_worker, DownpourSGD)
+    t2._gen_trainer_desc()
+    assert t2.proto_desc.device_worker_name == "DownpourWorker"
+    assert t2.proto_desc.mpi_rank == 3
+    assert t2._desc()["class_name"] == "DistMultiTrainer"
+
+
+def test_fetch_handler_monitor_polls():
+    from paddle_tpu.trainer_factory import FetchHandler, FetchHandlerMonitor
+    scope = fluid.Scope()
+    scope.set_var("loss_0", np.float32(0.5))
+    seen = []
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.data("loss_0", [1])
+
+    class H(FetchHandler):
+        def handler(self, fetch_dict):
+            seen.append(dict(fetch_dict))
+
+    mon = FetchHandlerMonitor(scope, H(var_dict={"loss": v},
+                                       period_secs=0.2))
+    mon.start()
+    deadline = time.time() + 5
+    while not seen and time.time() < deadline:
+        time.sleep(0.05)
+    mon.stop()
+    # handler sees USER keys (the var_dict keys), not var names
+    assert seen and seen[0]["loss"] == np.float32(0.5)
+
+
+def test_data_feed_desc_parse_mutate_reserialize(tmp_path):
+    proto = tmp_path / "data.proto"
+    proto.write_text(
+        'name: "MultiSlotDataFeed"\n'
+        "batch_size: 2\n"
+        "multi_slot_desc {\n"
+        "  slots {\n"
+        '    name: "words"\n'
+        '    type: "uint64"\n'
+        "    is_dense: false\n"
+        "    is_used: false\n"
+        "  }\n"
+        "  slots {\n"
+        '    name: "label"\n'
+        '    type: "uint64"\n'
+        "    is_dense: false\n"
+        "    is_used: false\n"
+        "  }\n"
+        "}\n")
+    from paddle_tpu.data_feed_desc import DataFeedDesc
+    d = DataFeedDesc(str(proto))
+    assert d.proto_desc["batch_size"] == 2
+    d.set_batch_size(128)
+    d.set_dense_slots(["words"])
+    d.set_use_slots(["words", "label"])
+    slots = d.proto_desc["multi_slot_desc"]["slots"]
+    assert slots[0]["is_dense"] and slots[0]["is_used"] and slots[1]["is_used"]
+    assert not slots[1]["is_dense"]
+    # round-trips through its own serializer
+    text = d.desc()
+    reparsed = tmp_path / "reparsed.proto"
+    reparsed.write_text(text)
+    d2 = DataFeedDesc(str(reparsed))
+    assert d2.proto_desc == d.proto_desc
+
+
+def test_graphviz_and_net_drawer(tmp_path):
+    from paddle_tpu.graphviz import Graph, GraphPreviewGenerator
+    g = Graph("t", rankdir="TB")
+    a = g.node("A", prefix="op", shape="box")
+    b = g.node("B", prefix="var")
+    g.edge(a, b, color="black")
+    dot = str(g)
+    assert "digraph G" in dot and "A" in dot and "->" in dot
+    gen = GraphPreviewGenerator("params")
+    p = gen.add_param("w", "float32")
+    o = gen.add_op("matmul")
+    gen.add_edge(p, o)
+    assert "matmul" in str(gen.graph)
+
+    from paddle_tpu.net_drawer import draw_graph
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        layers.fc(x, 2)
+    out = tmp_path / "net.dot"
+    graph = draw_graph(startup, main, filename=str(out))
+    text = out.read_text()
+    assert "digraph" in text
+    # the fc layer lowers to mul/matmul + add ops in the drawn graph
+    assert any(op in text for op in ("fc", "mul", "matmul"))
+    assert str(graph) == text.rstrip("\n") or len(text) > 0
+
+
+def test_op_factory_creates_operator():
+    from paddle_tpu.op import OperatorFactory, get_all_op_protos
+    protos = get_all_op_protos()
+    assert len(protos) > 300
+    fac = OperatorFactory()
+    op = fac("relu", X="x0", Out="y0")
+    assert op.type == "relu"
+    assert op.inputs == {"X": ["x0"]}
+    assert op.outputs == {"Out": ["y0"]}
+    op2 = fac.create("scale", X=["x"], Out=["y"], scale=3.0)
+    assert op2.attrs["scale"] == 3.0
+    # Y is an INPUT slot (mul/elementwise), not an output
+    op3 = fac.create("elementwise_add", X="a", Y="b", Out="c")
+    assert op3.inputs == {"X": ["a"], "Y": ["b"]}
+    assert op3.outputs == {"Out": ["c"]}
+    # lower_snake string kwargs are attrs, not input slots
+    op4 = fac.create("pool2d", X="x", Out="y", pooling_type="max")
+    assert op4.attrs["pooling_type"] == "max"
+    assert "pooling_type" not in op4.inputs
+    with pytest.raises(ValueError):
+        fac.create("definitely_not_an_op", X="x")
