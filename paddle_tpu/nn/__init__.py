@@ -162,7 +162,7 @@ class BatchNorm(Layer):
 
     def __init__(self, num_channels, momentum=0.9, epsilon=1e-5,
                  param_attr=None, bias_attr=None, act=None,
-                 data_format="NCHW", dtype="float32"):
+                 data_format="NCHW", dtype="float32", stats_sample=0):
         super().__init__(dtype=dtype)
         self.weight = self.create_parameter(
             [num_channels], attr=param_attr,
@@ -174,13 +174,17 @@ class BatchNorm(Layer):
         self._momentum, self._epsilon = momentum, epsilon
         self._data_format = data_format
         self._act = act
+        # ghost-batch stats subsample (0 = full batch); see the
+        # batch_norm kernel for the measured on-chip rationale
+        self._stats_sample = stats_sample
 
     def forward(self, x):
         y, new_mean, new_var = F.batch_norm(
             x, self._buffers["_mean"], self._buffers["_variance"],
             self.weight, self.bias, training=self.training,
             momentum=self._momentum, epsilon=self._epsilon,
-            data_format=self._data_format)
+            data_format=self._data_format,
+            stats_sample=self._stats_sample)
         if self.training:
             self._buffers["_mean"] = new_mean
             self._buffers["_variance"] = new_var

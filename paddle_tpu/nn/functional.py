@@ -190,12 +190,13 @@ def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5):
 
 
 def batch_norm(x, running_mean, running_var, weight, bias, training=False,
-               momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+               momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               stats_sample=0):
     out = _n.batch_norm(
         {"X": _val(x), "Scale": _val(weight), "Bias": _val(bias),
          "Mean": _val(running_mean), "Variance": _val(running_var)},
         {"momentum": momentum, "epsilon": epsilon, "is_test": not training,
-         "data_layout": data_format})
+         "data_layout": data_format, "stats_sample": stats_sample})
     return out["Y"], out["MeanOut"], out["VarianceOut"]
 
 

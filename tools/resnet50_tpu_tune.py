@@ -22,14 +22,14 @@ if REPO not in sys.path:
 
 
 
-def time_config(batch, remat, iters=10):
+def time_config(batch, remat, iters=10, stats_sample=0):
     import jax
 
     from bench import _peak_flops, resnet50_time_config
 
     peak = _peak_flops(jax.devices()[0])
     return resnet50_time_config(peak, batch=batch, remat=remat,
-                                iters=iters)
+                                iters=iters, bn_stats_sample=stats_sample)
 
 
 def main():
@@ -69,16 +69,17 @@ def main():
         return best
 
     results, best = [], None
-    for batch in (64, 128, 256):
-        for remat in (False, True):
-            try:
-                r = time_config(batch, remat)
-            except Exception as e:
-                r = {"batch": batch, "remat": remat,
-                     "error": f"{type(e).__name__}: {e}"[:160]}
-            results.append(r)
-            print(json.dumps(r), flush=True)
-            best = persist(results) or best
+    for batch, remat, ss in ((128, False, 0), (128, False, 16),
+                             (128, False, 32), (256, False, 32),
+                             (128, True, 16), (256, True, 32)):
+        try:
+            r = time_config(batch, remat, stats_sample=ss)
+        except Exception as e:
+            r = {"batch": batch, "remat": remat, "stats_sample": ss,
+                 "error": f"{type(e).__name__}: {e}"[:160]}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+        best = persist(results) or best
     print(json.dumps({"sweep_best": best}), flush=True)
     return 0
 
