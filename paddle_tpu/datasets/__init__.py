@@ -14,9 +14,12 @@ label ranges). Model code written against the reference API runs
 unchanged; numbers differ. Seeds are fixed so runs are reproducible.
 """
 
-from . import cifar, conll05, imdb, mnist, movielens, uci_housing, wmt14
+from . import (cifar, common, conll05, flowers, image, imdb, imikolov,
+               mnist, movielens, mq2007, sentiment, uci_housing, voc2012,
+               wmt14, wmt16)
 
 __all__ = [
     "mnist", "cifar", "uci_housing", "imdb", "movielens", "conll05",
-    "wmt14",
+    "wmt14", "wmt16", "imikolov", "sentiment", "flowers", "voc2012",
+    "mq2007", "common", "image",
 ]

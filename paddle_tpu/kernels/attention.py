@@ -65,9 +65,17 @@ def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
         # (the S^2 matrix still fits cache-friendly tiles); flash wins
         # once the S^2 materialisation starts thrashing HBM (measured
         # crossover on v5e: 512 -> XLA, 2048 -> flash by ~20%).
+        # PADDLE_TPU_FORCE_FLASH=0/1 overrides the heuristic for
+        # on-chip A/B runs (same role as PADDLE_TPU_FLASH_BLOCK).
+        import os
+
         from .backend import is_tpu_backend
 
-        use_flash = (is_tpu_backend() and seq >= 1024)
+        env = os.environ.get("PADDLE_TPU_FORCE_FLASH", "")
+        if env:
+            use_flash = env.lower() in ("1", "true", "yes")
+        else:
+            use_flash = (is_tpu_backend() and seq >= 1024)
     if forced_flash and not can_flash:
         warnings.warn(
             "use_flash=True requested but the flash kernel cannot serve this "

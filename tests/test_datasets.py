@@ -3,6 +3,7 @@ API): structure of each sample, determinism, composition with the
 reader decorators, and end-to-end learnability of the surrogates."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu.datasets as D
 from paddle_tpu import reader as R
@@ -135,3 +136,103 @@ def test_new_surrogate_datasets_shapes():
     # deterministic across calls (process-independent seeding)
     img2, _ = next(voc2012.train()())
     np.testing.assert_array_equal(img, img2)
+
+
+def test_mq2007_formats():
+    from paddle_tpu.datasets import mq2007
+
+    # pointwise: (relevance int, 46-dim features)
+    rel, feat = next(iter(mq2007.train(format="pointwise")))
+    assert feat.shape == (mq2007.FEATURE_DIM,)
+    assert rel in (0, 1, 2)
+
+    # pairwise: label 1, better-then-worse ordering by construction
+    label, hi, lo = next(iter(mq2007.train(format="pairwise")))
+    assert label == 1 and hi.shape == lo.shape == (mq2007.FEATURE_DIM,)
+    # the synthetic scorer must rank the "better" doc higher on average
+    import numpy as np
+    wins = 0
+    for i, (l, a, b) in enumerate(mq2007.train(format="pairwise")):
+        wins += float(a @ mq2007._SCORER) > float(b @ mq2007._SCORER)
+        if i >= 199:
+            break
+    assert wins / 200 > 0.8
+
+    # listwise: normalized relevances sum to 1, matrix row per doc
+    rels, feats = next(iter(mq2007.test(format="listwise")))
+    assert feats.shape == (len(rels), mq2007.FEATURE_DIM)
+    assert abs(sum(rels) - 1.0) < 1e-5
+
+    # LETOR line parsing round-trip
+    q = mq2007.Query()._parse_("2 qid:10 1:0.5 2:-1.25 # doc = x")
+    assert (q.relevance_score, q.query_id) == (2, 10)
+    assert q.feature_vector == [0.5, -1.25] and q.description == "doc = x"
+
+
+def test_dataset_common_split_and_cluster_reader(tmp_path):
+    from paddle_tpu.datasets import common
+
+    def reader():
+        for i in range(10):
+            yield i * i
+
+    suffix = str(tmp_path / "part-%05d.pickle")
+    paths = common.split(reader, 4, suffix=suffix)
+    assert len(paths) == 3  # 4 + 4 + 2
+    got = sorted(
+        x for tid in range(2)
+        for x in common.cluster_files_reader(
+            str(tmp_path / "part-*.pickle"), 2, tid)())
+    assert got == sorted(i * i for i in range(10))
+
+    # md5 + cache-hit download path
+    f = tmp_path / "blob.bin"
+    f.write_bytes(b"hello")
+    md5 = common.md5file(str(f))
+    cache_dir = tmp_path / "home" / "mod"
+    cache_dir.mkdir(parents=True)
+    (cache_dir / "blob.bin").write_bytes(b"hello")
+    old_home = common.DATA_HOME
+    common.DATA_HOME = str(tmp_path / "home")
+    try:
+        assert common.download("http://x/blob.bin", "mod", md5).endswith(
+            "blob.bin")
+        with pytest.raises(RuntimeError, match="offline"):
+            common.download("http://x/missing.bin", "mod", "0" * 32)
+    finally:
+        common.DATA_HOME = old_home
+
+
+def test_dataset_image_transforms(tmp_path):
+    from paddle_tpu.datasets import image
+
+    # bilinear resize on a linear ramp stays a linear ramp
+    ramp = np.tile(np.arange(16, dtype=np.float32)[None, :], (8, 1))
+    out = image._resize_bilinear(ramp, 8, 8)
+    diffs = np.diff(out[0])
+    assert np.allclose(diffs, diffs[0], atol=1e-4)
+
+    im = np.arange(20 * 30 * 3, dtype=np.uint8).reshape(20, 30, 3)
+    r = image.resize_short(im, 10)
+    assert min(r.shape[:2]) == 10 and r.shape[1] == 15
+    c = image.center_crop(r, 8)
+    assert c.shape[:2] == (8, 8)
+    rc = image.random_crop(r, 8)
+    assert rc.shape[:2] == (8, 8)
+    fl = image.left_right_flip(im)
+    np.testing.assert_array_equal(fl[:, 0], im[:, -1])
+    chw = image.to_chw(im)
+    assert chw.shape == (3, 20, 30)
+
+    t = image.simple_transform(im, 16, 12, is_train=False,
+                               mean=[1.0, 2.0, 3.0])
+    assert t.shape == (3, 12, 12) and t.dtype == np.float32
+
+    # PPM decode + load_and_transform via .npy
+    ppm = b"P6\n# comment\n4 2\n255\n" + bytes(range(24))
+    dec = image.load_image_bytes(ppm)
+    assert dec.shape == (2, 4, 3) and dec[0, 0, 0] == 0
+    npy = tmp_path / "im.npy"
+    np.save(npy, im)
+    lt = image.load_and_transform(str(npy), 16, 12, is_train=True)
+    assert lt.shape == (3, 12, 12)
