@@ -42,7 +42,7 @@ def _interpret():
     return not is_tpu_backend()
 
 
-def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, *, eps):
     x = x_ref[...].astype(jnp.float32)                  # [R, D]
     mean = jnp.mean(x, axis=1, keepdims=True)
     xc = x - mean
@@ -51,18 +51,24 @@ def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
     y = xc * rstd * g_ref[...].astype(jnp.float32)[None, :] \
         + b_ref[...].astype(jnp.float32)[None, :]
     y_ref[...] = y.astype(y_ref.dtype)
-    mean_ref[...] = mean[:, 0]
-    rstd_ref[...] = rstd[:, 0]
+    # mean/rstd are NOT materialized: 1-D f32 outputs tile at T(1024)
+    # and clash with row blocks (Mosaic layout-verify failure on chip);
+    # the backward recomputes them from the x block it already holds
+    # in VMEM — identical numerics, and the forward writes less HBM.
 
 
-def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
-                dx_ref, dg_part_ref, db_part_ref, *, rows, block):
+def _bwd_kernel(x_ref, g_ref, dy_ref,
+                dx_ref, dg_acc_ref, db_acc_ref, *, rows, block, groups,
+                eps):
     x = x_ref[...].astype(jnp.float32)                  # [R, D]
     dy = dy_ref[...].astype(jnp.float32)
     gamma = g_ref[...].astype(jnp.float32)[None, :]
-    mean = mean_ref[...][:, None]
-    rstd = rstd_ref[...][:, None]
-    xhat = (x - mean) * rstd
+    # recompute row stats from the block already in VMEM (see fwd)
+    mean = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
     wdy = dy * gamma
     # dx = rstd * (wdy - mean(wdy) - xhat * mean(wdy * xhat))
     c1 = jnp.mean(wdy, axis=1, keepdims=True)
@@ -74,17 +80,32 @@ def _bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
     row_idx = pl.program_id(0) * block \
         + jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0)
     valid = row_idx < rows
+    d = x.shape[1]
+    # dgamma/dbeta partials: reduce the block's rows down to `groups`
+    # rows (8 keeps the accumulator TPU-tileable — a (1, D) block
+    # violates the (8, 128) minimum) and ACCUMULATE into one
+    # VMEM-resident [groups, D] output shared by every grid step; the
+    # final [groups, D] -> [D] sum happens outside in XLA.
     # jnp.where, not a multiply: padded rows may hold NaN (NaN * 0 = NaN)
-    dg_part_ref[...] = jnp.sum(jnp.where(valid, dy * xhat, 0.0),
-                               axis=0)[None, :]
-    db_part_ref[...] = jnp.sum(jnp.where(valid, dy, 0.0), axis=0)[None, :]
+    dgp = jnp.sum(jnp.where(valid, dy * xhat, 0.0)
+                  .reshape(groups, -1, d), axis=1)
+    dbp = jnp.sum(jnp.where(valid, dy, 0.0)
+                  .reshape(groups, -1, d), axis=1)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_acc_ref[...] = jnp.zeros_like(dg_acc_ref)
+        db_acc_ref[...] = jnp.zeros_like(db_acc_ref)
+
+    dg_acc_ref[...] += dgp
+    db_acc_ref[...] += dbp
 
 
 def _fwd(x, gamma, beta, eps, block_rows):
     rows, d = x.shape
     block = min(block_rows, rows)
     grid = (pl.cdiv(rows, block),)
-    y, mean, rstd = pl.pallas_call(
+    y = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
         grid=grid,
         in_specs=[
@@ -92,66 +113,58 @@ def _fwd(x, gamma, beta, eps, block_rows):
             pl.BlockSpec((d,), lambda i: (0,)),
             pl.BlockSpec((d,), lambda i: (0,)),
         ],
-        out_specs=[
-            pl.BlockSpec((block, d), lambda i: (i, 0)),
-            pl.BlockSpec((block,), lambda i: (i,)),
-            pl.BlockSpec((block,), lambda i: (i,)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((rows, d), x.dtype),
-            jax.ShapeDtypeStruct((rows,), jnp.float32),
-            jax.ShapeDtypeStruct((rows,), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
         interpret=_interpret(),
     )(x, gamma, beta)
-    return y, mean, rstd
+    return y
 
 
-def _bwd(x, gamma, mean, rstd, dy, block_rows):
+def _bwd(x, gamma, dy, eps, block_rows):
     rows, d = x.shape
     block = min(block_rows, rows)
     nblocks = pl.cdiv(rows, block)
-    dx, dg_part, db_part = pl.pallas_call(
-        functools.partial(_bwd_kernel, rows=rows, block=block),
+    groups = 8 if block % 8 == 0 else 1
+    dx, dg_acc, db_acc = pl.pallas_call(
+        functools.partial(_bwd_kernel, rows=rows, block=block,
+                          groups=groups, eps=eps),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((block, d), lambda i: (i, 0)),
             pl.BlockSpec((d,), lambda i: (0,)),
-            pl.BlockSpec((block,), lambda i: (i,)),
-            pl.BlockSpec((block,), lambda i: (i,)),
             pl.BlockSpec((block, d), lambda i: (i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((block, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, d), lambda i: (i, 0)),
+            # every grid step maps the SAME full-array block: the
+            # accumulator stays VMEM-resident across the whole grid
+            pl.BlockSpec((groups, d), lambda i: (0, 0)),
+            pl.BlockSpec((groups, d), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, d), x.dtype),
-            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
-            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
+            jax.ShapeDtypeStruct((groups, d), jnp.float32),
+            jax.ShapeDtypeStruct((groups, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(x, gamma, mean, rstd, dy)
-    return dx, dg_part.sum(axis=0), db_part.sum(axis=0)
+    )(x, gamma, dy)
+    return dx, dg_acc.sum(axis=0), db_acc.sum(axis=0)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def fused_layer_norm(x, gamma, beta, eps=1e-5,
                      block_rows=DEFAULT_BLOCK_ROWS):
     """LayerNorm over the last axis of a 2-D [rows, D] input."""
-    y, _, _ = _fwd(x, gamma, beta, eps, block_rows)
-    return y
+    return _fwd(x, gamma, beta, eps, block_rows)
 
 
 def _fused_ln_fwd(x, gamma, beta, eps, block_rows):
-    y, mean, rstd = _fwd(x, gamma, beta, eps, block_rows)
-    return y, (x, gamma, mean, rstd)
+    return _fwd(x, gamma, beta, eps, block_rows), (x, gamma)
 
 
 def _fused_ln_bwd(eps, block_rows, res, dy):
-    x, gamma, mean, rstd = res
-    dx, dgamma, dbeta = _bwd(x, gamma, mean, rstd, dy, block_rows)
+    x, gamma = res
+    dx, dgamma, dbeta = _bwd(x, gamma, dy, eps, block_rows)
     return dx, dgamma.astype(gamma.dtype), dbeta.astype(gamma.dtype)
 
 
