@@ -394,6 +394,29 @@ def bench_flash_tiles(on_tpu, peak):
            "times_ms": results, "winners": winners}
     if not timed:
         out["error"] = "all block configs failed"
+
+    # on-chip numerics parity vs the XLA path, re-validated every
+    # capture (the kernel was interpret-only-verified until r4; real
+    # lowering bugs surface as O(0.1+) error, while ~5e-3 rel is the
+    # bf16-MXU accumulation floor measured on v5e)
+    try:
+        from paddle_tpu.kernels.attention import _xla_attention
+
+        rng = np.random.default_rng(1)
+        shp = (2, 4, 1024, 64)
+        q, k, v = (jnp.asarray(rng.standard_normal(shp) * 0.5,
+                               jnp.float32) for _ in range(3))
+        sc = 1.0 / np.sqrt(shp[-1])
+        y1 = jax.jit(lambda q, k, v: fa.flash_attention(
+            q, k, v, causal=True, sm_scale=sc))(q, k, v)
+        y2 = jax.jit(lambda q, k, v: _xla_attention(
+            q, k, v, None, sc, True, 0.0, False, None))(q, k, v)
+        err = float(jnp.max(jnp.abs(y1 - y2)))
+        out["causal_fwd_max_err_vs_xla"] = round(err, 6)
+        out["numerics_ok"] = err < 0.02
+    except Exception as e:  # record, never kill the capture
+        out["numerics_ok"] = False
+        out["numerics_error"] = f"{type(e).__name__}: {e}"[:120]
     return out
 
 
