@@ -1,0 +1,17 @@
+"""`fluid.contrib.memory_usage_calc` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/memory_usage_calc.py
+(memory_usage :46).  The underlying estimator lives in
+paddle_tpu/model_stat.py and returns one lower-bound MB figure; this
+path keeps the reference's (lower, upper, unit) contract, where upper
+is the reference's x1.7 allocator-overhead envelope.
+"""
+
+from ..model_stat import memory_usage as _estimate_mb
+
+__all__ = ["memory_usage"]
+
+
+def memory_usage(program, batch_size):
+    mb = _estimate_mb(program, batch_size)
+    return mb, mb * 1.7, "MB"

@@ -1,0 +1,13 @@
+"""`fluid.contrib.mixed_precision` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/mixed_precision/ (decorator.py
+decorate :218, fp16_lists.py AutoMixedPrecisionLists) — the working
+implementation is paddle_tpu/amp (bf16-first autocast + dynamic loss
+scaling).
+"""
+
+from ...amp import (  # noqa: F401
+    AutoMixedPrecisionLists, CustomOpLists, OptimizerWithMixedPrecision,
+    decorate)
+
+__all__ = ["decorate", "AutoMixedPrecisionLists", "CustomOpLists"]

@@ -1,0 +1,9 @@
+"""`fluid.contrib.model_stat` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/model_stat.py (summary) —
+implementation in paddle_tpu/model_stat.py.
+"""
+
+from ..model_stat import summary  # noqa: F401
+
+__all__ = ["summary"]

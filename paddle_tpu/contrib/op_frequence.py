@@ -1,0 +1,9 @@
+"""`fluid.contrib.op_frequence` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/op_frequence.py
+(op_freq_statistic :23) — implementation in paddle_tpu/model_stat.py.
+"""
+
+from ..model_stat import op_freq_statistic  # noqa: F401
+
+__all__ = ["op_freq_statistic"]

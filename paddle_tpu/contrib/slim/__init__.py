@@ -1,0 +1,14 @@
+"""`fluid.contrib.slim` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/slim/ — implementation in
+paddle_tpu/slim (quantization/prune/distill).  The reference's
+nas/searcher subpackages are a documented drop (SURVEY §7 stage 9);
+its core.Compressor config-driven loop maps onto using the
+quantization/prune/distillation passes directly.
+"""
+
+from ...slim import *  # noqa: F401,F403
+from ...slim import __all__ as _slim_all
+from . import quantization, prune, distillation  # noqa: F401
+
+__all__ = list(_slim_all) + ["quantization", "prune", "distillation"]

@@ -1,0 +1,57 @@
+"""`fluid.contrib.utils.hdfs_utils` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/utils/hdfs_utils.py (HDFSClient,
+multi_download :487, multi_upload :558) — the client implementation
+lives in distributed/fs.py (same `hadoop fs` subprocess surface the
+reference drives); the multi_* helpers shard a directory listing
+across trainers and walk it with a local thread pool.
+"""
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+from ...distributed.fs import HDFSClient  # noqa: F401
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """Download this trainer's shard (round-robin by index) of the
+    files under hdfs_path."""
+    # HDFSClient.ls returns full URIs, LocalFS.ls bare names — join
+    # through basename so both work
+    files = sorted(os.path.join(hdfs_path, os.path.basename(f))
+                   for f in client.ls(hdfs_path))
+    mine = [f for i, f in enumerate(files) if i % trainers == trainer_id]
+    os.makedirs(local_path, exist_ok=True)
+
+    def fetch(remote):
+        dst = os.path.join(local_path, os.path.basename(remote))
+        client.download(remote, dst)
+        return dst
+
+    with ThreadPoolExecutor(max_workers=multi_processes) as pool:
+        return list(pool.map(fetch, mine))
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    """Upload every file under local_path with a local thread pool."""
+    todo = []
+    for root, _, names in os.walk(local_path):
+        for name in names:
+            src = os.path.join(root, name)
+            rel = os.path.relpath(src, local_path)
+            todo.append((src, os.path.join(hdfs_path, rel)))
+
+    def push(pair):
+        src, dst = pair
+        client.makedirs(os.path.dirname(dst))
+        if overwrite:
+            client.delete(dst)
+        client.upload(dst, src)   # FS.upload signature is (dest, local)
+        return dst
+
+    with ThreadPoolExecutor(max_workers=multi_processes) as pool:
+        return list(pool.map(push, todo))

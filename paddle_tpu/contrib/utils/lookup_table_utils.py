@@ -1,0 +1,75 @@
+"""`fluid.contrib.utils.lookup_table_utils` parity.
+
+Reference: python/paddle/fluid/contrib/utils/lookup_table_utils.py —
+helpers for resuming / serving models whose embedding table lived on
+parameter servers: rewrite the distributed program back to a local
+sparse one, and load checkpointed persistables where the table is
+stored separately (possibly sharded by pserver).
+"""
+
+import os
+
+import numpy as np
+
+from ... import io
+from ...distribute_lookup_table import _distributed_lookup_ops
+from ...framework.executor import global_scope
+
+__all__ = ["convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+
+def convert_dist_to_sparse_program(program):
+    """Clone `program` with every distributed lookup rewritten to a
+    LOCAL sparse lookup (is_distributed=False, is_sparse=True) so
+    single-process increment training can run it."""
+    converted = program.clone()
+    for op, _ in list(_distributed_lookup_ops(converted)):
+        op.attrs["is_distributed"] = False
+        op.attrs["is_sparse"] = True
+    return converted
+
+
+def _load_table_rows(path):
+    """Table rows from one .npy file or a directory of pserver-shard
+    .npy files (concatenated in shard order)."""
+    if os.path.isdir(path):
+        shards = sorted(f for f in os.listdir(path) if f.endswith(".npy"))
+        if not shards:
+            raise IOError("no .npy table shards under %s" % path)
+        return np.concatenate(
+            [np.load(os.path.join(path, f)) for f in shards], axis=0)
+    return np.load(path)
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var, lookup_table_var_path):
+    """Load persistables for CONTINUED TRAINING: everything except the
+    table from `dirname`, the table itself from its own (possibly
+    sharded) path."""
+    table_name = (lookup_table_var if isinstance(lookup_table_var, str)
+                  else lookup_table_var.name)
+    io.load_vars(executor, dirname, program,
+                 predicate=lambda v: v.persistable and v.name != table_name)
+    global_scope().set_var(table_name, _load_table_rows(
+        lookup_table_var_path))
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name):
+    """Load persistables for SERVING: everything from `dirname`; the
+    table may sit beside the dense vars or under a subdirectory named
+    after it (the layout the reference's distributed save produces)."""
+    io.load_vars(executor, dirname, program,
+                 predicate=lambda v: v.persistable
+                 and v.name != lookup_table_var_name)
+    table_dir = os.path.join(dirname, lookup_table_var_name)
+    if os.path.isdir(table_dir):
+        rows = _load_table_rows(table_dir)
+    elif os.path.exists(table_dir + ".npy"):
+        rows = np.load(table_dir + ".npy")
+    else:
+        raise IOError("lookup table %r not found under %s"
+                      % (lookup_table_var_name, dirname))
+    global_scope().set_var(lookup_table_var_name, rows)

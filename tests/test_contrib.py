@@ -540,3 +540,104 @@ def test_contrib_distributed_batch_reader_shards():
         for k, v in old.items():
             os.environ.pop(k, None) if v is None else \
                 os.environ.__setitem__(k, v)
+
+
+def test_contrib_module_paths_round4():
+    """Round-4 contrib import-path parity: every reference
+    fluid.contrib.<mod> dotted path resolves."""
+    import importlib
+
+    for mod in ("memory_usage_calc", "op_frequence", "model_stat",
+                "mixed_precision", "slim", "slim.quantization",
+                "slim.prune", "slim.distillation", "utils",
+                "utils.hdfs_utils", "utils.lookup_table_utils"):
+        importlib.import_module("paddle_tpu.contrib." + mod)
+    from paddle_tpu.contrib.memory_usage_calc import memory_usage
+    from paddle_tpu.contrib.op_frequence import op_freq_statistic
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 4])
+        fluid.layers.fc(fluid.layers.fc(x, 8), 2)
+    lo, hi, unit = memory_usage(main, batch_size=32)
+    assert 0 < lo <= hi and unit == "MB"
+    uni, adj = op_freq_statistic(main)
+    assert sum(uni.values()) == main.num_ops() and len(adj) >= 1
+
+
+def test_lookup_table_utils(tmp_path):
+    import numpy as np
+
+    from paddle_tpu.contrib.utils.lookup_table_utils import (
+        convert_dist_to_sparse_program, load_persistables_for_increment,
+        load_persistables_for_inference)
+    from paddle_tpu import io
+
+    with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.data("ids", [None, 1], dtype="int64")
+            emb = fluid.layers.embedding(ids, size=(50, 8),
+                                         is_distributed=True)
+            fluid.layers.fc(emb, 2)
+
+        # dist -> local sparse rewrite
+        conv = convert_dist_to_sparse_program(main)
+        ops = [op for op in conv.global_block().ops
+               if op.type.startswith("lookup_table")]
+        assert ops and all(not o.attrs["is_distributed"] and
+                           o.attrs["is_sparse"] for o in ops)
+        # original untouched
+        assert any(o.attrs.get("is_distributed")
+                   for o in main.global_block().ops
+                   if o.type.startswith("lookup_table"))
+
+        exe = fluid.Executor()
+        exe.run(startup)
+        table_name = [o.inputs["W"][0] for o in main.global_block().ops
+                      if o.type.startswith("lookup_table")][0]
+        io.save_persistables(exe, str(tmp_path), main)
+
+        # table shards in their own directory
+        rows = np.arange(50 * 8, dtype=np.float32).reshape(50, 8)
+        shard_dir = tmp_path / "table_shards"
+        shard_dir.mkdir()
+        np.save(shard_dir / "shard0.npy", rows[:25])
+        np.save(shard_dir / "shard1.npy", rows[25:])
+
+        load_persistables_for_increment(str(tmp_path), exe, main,
+                                        table_name, str(shard_dir))
+        got = np.asarray(fluid.global_scope().find_var(table_name))
+        np.testing.assert_array_equal(got, rows)
+
+        # inference layout: table dir named after the var inside dirname
+        table_dir = tmp_path / table_name
+        table_dir.mkdir()
+        np.save(table_dir / "shard0.npy", rows)
+        load_persistables_for_inference(str(tmp_path), exe, main,
+                                        table_name)
+        got = np.asarray(fluid.global_scope().find_var(table_name))
+        np.testing.assert_array_equal(got, rows)
+
+
+def test_hdfs_utils_multi_helpers(tmp_path):
+    from paddle_tpu.contrib.utils import hdfs_utils
+    from paddle_tpu.distributed.fs import LocalFS
+
+    src = tmp_path / "remote"
+    src.mkdir()
+    for i in range(5):
+        (src / f"part-{i}").write_text(str(i))
+    client = LocalFS()
+
+    out0 = tmp_path / "t0"
+    got0 = hdfs_utils.multi_download(client, str(src), str(out0),
+                                     trainer_id=0, trainers=2)
+    out1 = tmp_path / "t1"
+    got1 = hdfs_utils.multi_download(client, str(src), str(out1),
+                                     trainer_id=1, trainers=2)
+    names = sorted(os.path.basename(p) for p in got0 + got1)
+    assert names == [f"part-{i}" for i in range(5)]
+
+    up = tmp_path / "up"
+    hdfs_utils.multi_upload(client, str(up), str(src))
+    assert sorted(p.name for p in up.iterdir()) == names
