@@ -1,0 +1,50 @@
+"""Tensor interop utilities.
+
+Parity: /root/reference/paddle/fluid/framework/dlpack_tensor.cc (DLPack
+import/export on the Tensor stack) — jax arrays speak DLPack natively,
+so these are thin, documented entry points for zero-copy exchange with
+torch/numpy/cupy, plus the convenience converters user code expects.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["to_dlpack", "from_dlpack", "to_numpy", "to_tensor"]
+
+
+def to_dlpack(x):
+    """Export a device array for DLPack exchange (dlpack_tensor.cc
+    parity).  Modern protocol: returns the array itself, which carries
+    __dlpack__/__dlpack_device__ — exactly what torch.from_dlpack,
+    cupy.from_dlpack, np.from_dlpack, and our from_dlpack consume.
+    (A raw capsule would NOT round-trip: jnp.from_dlpack rejects bare
+    capsules in recent jax.)"""
+    return jnp.asarray(x)
+
+
+def from_dlpack(capsule_or_array):
+    """Import any __dlpack__-bearing tensor (e.g. a torch.Tensor) —
+    or a legacy raw capsule — as a jax array, zero-copy where the
+    backend allows."""
+    if hasattr(capsule_or_array, "__dlpack__"):
+        return jnp.from_dlpack(capsule_or_array) if hasattr(
+            jnp, "from_dlpack") else jax.dlpack.from_dlpack(
+                capsule_or_array)
+    # legacy PyCapsule path
+    return jax.dlpack.from_dlpack(capsule_or_array)
+
+
+def to_numpy(x):
+    """Fetch to host as numpy (the reference's TensorToPyArray path)."""
+    return np.asarray(x)
+
+
+def to_tensor(x, dtype=None):
+    """Host data -> device array (the reference's PyArrayToTensor)."""
+    return jnp.asarray(x, dtype=dtype)
+
+
+from . import plot  # noqa: E402,F401
+
+__all__ = __all__ + ["plot"]

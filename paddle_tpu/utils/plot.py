@@ -1,0 +1,66 @@
+"""`paddle.utils.plot` parity — the Ploter the book tutorials use.
+
+Reference: python/paddle/utils/plot.py (PlotData, Ploter): collects
+(step, value) series per title and renders them with matplotlib; in a
+headless/non-interactive session `show` falls through to `save`-style
+behavior without erroring.
+"""
+
+__all__ = ["Ploter"]
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {title: PlotData() for title in args}
+        self.__disable_plot__ = False
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")  # headless-safe
+            import matplotlib.pyplot as plt
+
+            self.plt = plt
+        except Exception:
+            self.plt = None
+            self.__disable_plot__ = True
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__
+
+    def append(self, title, step, value):
+        assert title in self.__plot_data__, (
+            "title %s not found in %s" % (title, list(self.__plot_data__)))
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path=None):
+        if self.__plot_is_disabled__():
+            return
+        self.plt.clf()
+        titles = []
+        for title in self.__args__:
+            data = self.__plot_data__[title]
+            if len(data.step) > 0:
+                self.plt.plot(data.step, data.value)
+                titles.append(title)
+        self.plt.legend(titles, loc="upper left")
+        if path is not None:
+            self.plt.savefig(path)
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()

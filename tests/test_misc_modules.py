@@ -102,3 +102,31 @@ def test_dlpack_roundtrip_numpy_and_torch():
                                   t.numpy())
     t2 = torch.utils.dlpack.from_dlpack(utils.to_dlpack(j))
     np.testing.assert_array_equal(t2.numpy(), t.numpy())
+
+
+def test_utils_plot_ploter(tmp_path):
+    """paddle.utils.plot parity (the book tutorials' Ploter)."""
+    from paddle_tpu.utils.plot import Ploter
+
+    p = Ploter("train cost", "test cost")
+    for i in range(5):
+        p.append("train cost", i, 1.0 / (i + 1))
+    p.append("test cost", 0, 0.7)
+    out = tmp_path / "curve.png"
+    p.plot(str(out))
+    assert out.exists() and out.stat().st_size > 0
+    with pytest.raises(AssertionError):
+        p.append("nope", 0, 1.0)
+    p.reset()
+    assert all(not d.step for d in p.__plot_data__.values())
+
+
+def test_utils_still_exports_dlpack_surface():
+    import paddle_tpu.utils as u
+
+    ref = np.arange(6, dtype=np.float32).reshape(2, 3)
+    x = u.to_tensor(ref)
+    np.testing.assert_array_equal(u.to_numpy(x), ref)
+    cap = u.to_dlpack(x)
+    y = u.from_dlpack(cap)
+    np.testing.assert_array_equal(u.to_numpy(y), ref)
