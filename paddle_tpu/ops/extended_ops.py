@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from jax.ad_checkpoint import checkpoint_name
+
 from ..core.dtype import to_jax_dtype
 from .registry import get_op, register_op
 
@@ -310,7 +312,7 @@ def conv3d(ins, attrs):
         x, w, window_strides=strides, padding=[(p, p) for p in pads],
         rhs_dilation=dil, feature_group_count=groups,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
-    return {"Output": out}
+    return {"Output": checkpoint_name(out, "conv_out")}
 
 
 @register_op("conv3d_transpose")
@@ -338,13 +340,14 @@ def conv3d_transpose(ins, attrs):
                 window_strides=(1, 1, 1), padding=pad_cfg,
                 lhs_dilation=strides, rhs_dilation=dil,
                 dimension_numbers=("NCDHW", "OIDHW", "NCDHW")))
-        return {"Output": jnp.concatenate(outs, axis=1)}
+        return {"Output": checkpoint_name(
+            jnp.concatenate(outs, axis=1), "conv_out")}
     w_flip = jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1)  # -> [C_out, C_in, ...]
     out = lax.conv_general_dilated(
         x, w_flip, window_strides=(1, 1, 1), padding=pad_cfg,
         lhs_dilation=strides, rhs_dilation=dil,
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
-    return {"Output": out}
+    return {"Output": checkpoint_name(out, "conv_out")}
 
 
 @register_op("pool3d")

@@ -15,6 +15,7 @@ Layout convention follows the reference: NCHW for conv/pool (attr
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from ..core.dtype import index_dtype
 from .registry import register_op
@@ -298,6 +299,11 @@ def conv2d(ins, attrs):
     )
     if out.dtype != x.dtype:
         out = out.astype(x.dtype)
+    # named so the selective-remat policy (make_train_step
+    # remat="conv_outs") can save exactly the conv outputs and
+    # recompute the cheap elementwise tail (BN affine / relu / add) in
+    # the backward pass; a no-op outside jax.checkpoint contexts
+    out = checkpoint_name(out, "conv_out")
     return {"Output": out}
 
 
@@ -340,6 +346,7 @@ def conv2d_transpose(ins, attrs):
         lhs_dilation=strides, rhs_dilation=dilations,
         dimension_numbers=dn, feature_group_count=groups,
     )
+    out = checkpoint_name(out, "conv_out")
     return {"Output": out}
 
 

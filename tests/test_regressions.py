@@ -234,16 +234,40 @@ def test_make_train_step_remat_matches_plain():
     model = resnet18(num_classes=10)
     opt = Momentum(0.1, 0.9)
     outs = {}
-    for remat in (False, True):
+    for remat in (False, True, "conv_outs"):
         state = init_train_state(model, opt, rng_seed=0)
         step = make_train_step(model, opt, loss_fn=loss_fn, remat=remat,
                                donate=False)
         new_state, loss = step(state, x, y)
         outs[remat] = (float(loss), new_state)
-    # recompute reassociates float reductions (BN), so relative not exact
-    rel = abs(outs[False][0] - outs[True][0]) / abs(outs[False][0])
-    assert rel < 1e-3
-    pa = jax.tree_util.tree_leaves(outs[False][1].params)
-    pb = jax.tree_util.tree_leaves(outs[True][1].params)
-    deltas = [float(jnp.max(jnp.abs(a - b))) for a, b in zip(pa, pb)]
-    assert max(deltas) < 5e-3
+    # recompute reassociates float reductions (BN), so relative not exact.
+    # At batch 2 / random init this net's grads reach |g|~5e3 (BN-stat
+    # backward is ill-conditioned), so the conv_outs partial-recompute
+    # policy — whose fusions genuinely reorder — is compared by
+    # update-vector cosine + scale-relative magnitude (verified exact to
+    # ~2e-11 relative under x64; the f32 spread is pure reassociation).
+    for mode in (True, "conv_outs"):
+        rel = abs(outs[False][0] - outs[mode][0]) / abs(outs[False][0])
+        assert rel < 1e-3, mode
+        pa = jax.tree_util.tree_leaves(outs[False][1].params)
+        pb = jax.tree_util.tree_leaves(outs[mode][1].params)
+        if mode is True:
+            deltas = [float(jnp.max(jnp.abs(a - b))) for a, b in zip(pa, pb)]
+            assert max(deltas) < 5e-3, mode
+        else:
+            p0 = jax.tree_util.tree_leaves(state.params)
+            ua = jnp.concatenate([(a - o).reshape(-1)
+                                  for a, o in zip(pa, p0)])
+            ub = jnp.concatenate([(b - o).reshape(-1)
+                                  for b, o in zip(pb, p0)])
+            cos = float(jnp.vdot(ua, ub)
+                        / (jnp.linalg.norm(ua) * jnp.linalg.norm(ub)))
+            assert cos > 0.99, (mode, cos)
+            assert float(jnp.linalg.norm(ua - ub)
+                         / jnp.linalg.norm(ua)) < 0.15, mode
+    import pytest
+
+    with pytest.raises(ValueError):
+        make_train_step(model, opt, loss_fn=loss_fn,
+                        remat="conv_out")(
+            init_train_state(model, opt, rng_seed=0), x, y)

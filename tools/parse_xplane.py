@@ -1,0 +1,70 @@
+"""Parse a jax.profiler xplane.pb into a per-op time table.
+
+The tensorboard_plugin_profile converter in this image is broken against
+the installed TF (missing xspace_to_tools_data symbol), so this walks the
+XSpace proto directly: TPU device plane -> XLA-op lines -> aggregate
+duration by HLO op name / category.
+
+Usage: python tools/parse_xplane.py <xplane.pb> [top_n]
+"""
+import collections
+import sys
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+
+def load(path):
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+def device_plane(xs):
+    for p in xs.planes:
+        if p.name.startswith("/device:TPU"):
+            return p
+    raise SystemExit(f"no TPU plane in {[p.name for p in xs.planes]}")
+
+
+def agg(plane):
+    """Return {line_name: {event_name: (total_ps, count)}} plus the
+    event-metadata stat 'hlo_category' when present."""
+    md = {m.id: m for m in plane.event_metadata.values()}
+    smd = {m.id: m.name for m in plane.stat_metadata.values()}
+    out = {}
+    for line in plane.lines:
+        table = collections.defaultdict(lambda: [0, 0, ""])
+        for ev in line.events:
+            m = md.get(ev.metadata_id)
+            name = m.name if m else str(ev.metadata_id)
+            row = table[name]
+            row[0] += ev.duration_ps
+            row[1] += 1
+            if not row[2] and m:
+                for st in m.stats:
+                    if smd.get(st.metadata_id) == "hlo_category":
+                        row[2] = st.str_value
+        out[line.name] = table
+    return out
+
+
+def main():
+    path = sys.argv[1]
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    xs = load(path)
+    plane = device_plane(xs)
+    tables = agg(plane)
+    for lname, table in tables.items():
+        total = sum(v[0] for v in table.values())
+        if total == 0:
+            continue
+        print(f"== line {lname!r}: total {total/1e9:.3f} ms over "
+              f"{sum(v[1] for v in table.values())} events")
+        rows = sorted(table.items(), key=lambda kv: -kv[1][0])[:top_n]
+        for name, (ps, n, cat) in rows:
+            print(f"  {ps/1e9:9.3f} ms  x{n:<5d} {cat:12s} {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

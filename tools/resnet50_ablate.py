@@ -1,0 +1,129 @@
+"""On-chip ResNet-50 ablation: where does the non-MXU time go?
+
+Times the batch-128 NHWC bf16 train step under component ablations so the
+HBM-bound hypothesis (see bench.py bench_resnet50 notes) can be split into
+BN-stats traffic vs backward-activation traffic vs optimizer/update cost.
+
+All timing goes through bench._time_steps (chained lax.scan, donated
+carry) — independent repeated dispatches of identical args are served
+from a cache by the remote-tunnel backend and time as ~0ms.
+
+Run on the TPU (python tools/resnet50_ablate.py); prints one JSON line
+per variant.  Read-only: no bench.py behavior depends on this file.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from bench import RESNET50_FWD_FLOPS_224, _time_steps
+from paddle_tpu import nn
+from paddle_tpu.models.resnet import resnet50
+from paddle_tpu.models.train import (
+    _loss_with_buffers, init_train_state, make_train_step)
+from paddle_tpu.nn import functional as F
+from paddle_tpu.optimizer.functional import Momentum
+
+PEAK = 197e12  # v5e bf16
+
+
+def build(batch=128, ss=0, bn_global=False, remat=False):
+    model = resnet50(dtype="bfloat16", data_format="NHWC",
+                     bn_stats_sample=ss)
+    if bn_global:
+        # affine-only BN: running stats, no batch-stats reductions
+        def fwd(self, x):
+            y, _, _ = F.batch_norm(
+                x, self._buffers["_mean"], self._buffers["_variance"],
+                self.weight, self.bias, training=False,
+                momentum=self._momentum, epsilon=self._epsilon,
+                data_format=self._data_format)
+            from paddle_tpu.nn import _apply_act
+            return _apply_act(y, self._act)
+
+        for lyr in model.sublayers(include_self=True):
+            if isinstance(lyr, nn.BatchNorm):
+                lyr.forward = fwd.__get__(lyr)
+    opt = Momentum(0.001, 0.9)  # timing-only: tiny lr so warmup can't NaN
+    state = init_train_state(model, opt)
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y).mean()
+
+    step = make_train_step(model, opt, loss_fn=loss_fn, jit=False,
+                           remat=remat)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 3, 224, 224)), jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+    return model, state, step, loss_fn, (x, y)
+
+
+def time_fwd_only(model, state, loss_fn, batch, iters=10, reps=3):
+    """Forward-only scan: the carry (prev loss) is folded into the input
+    by a numerically-invisible but un-DCE-able add so the scan body
+    can't be collapsed or cached."""
+    params, buffers = state.params, state.buffers
+
+    @jax.jit
+    def run(acc, x, y):
+        def body(acc, _):
+            xx = x + (acc * 1e-30).astype(x.dtype)
+            loss, _ = _loss_with_buffers(model, params, buffers,
+                                         jax.random.PRNGKey(0), loss_fn,
+                                         (xx, y))
+            return loss.astype(jnp.float32), loss
+        return jax.lax.scan(body, acc, None, length=iters)
+
+    x, y = batch
+    acc = jnp.zeros((), jnp.float32)
+    acc2, losses = run(acc, x, y)
+    float(losses[-1])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _, losses = run(acc, x, y)
+        float(losses[-1])
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def report(name, dt, batch, fwd_only=False, extra=None):
+    factor = 1.0 if fwd_only else 3.0
+    mfu = factor * RESNET50_FWD_FLOPS_224 * batch / dt / PEAK
+    row = {"variant": name, "step_ms": round(dt * 1e3, 2),
+           "samples_per_sec": round(batch / dt, 1), "mfu": round(mfu, 4)}
+    if extra:
+        row.update(extra)
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main():
+    print(json.dumps({"device": str(jax.devices()[0])}), flush=True)
+
+    for name, kw, fwdonly in [
+        ("train_ss16", dict(ss=16), False),
+        ("train_fullbn", dict(ss=0), False),
+        ("train_bnglobal", dict(bn_global=True), False),
+        ("fwd_fullbn", dict(ss=0), True),
+        ("fwd_bnglobal", dict(bn_global=True), True),
+        ("train_ss16_b256", dict(ss=16), False),
+    ]:
+        b = 256 if name.endswith("b256") else 128
+        model, state, step, loss_fn, batch = build(batch=b, **kw)
+        if fwdonly:
+            dt = time_fwd_only(model, state, loss_fn, batch)
+        else:
+            dt = _time_steps(step, state, batch, iters=10)
+        report(name, dt, b, fwd_only=fwdonly)
+        del model, state, step, batch
+
+
+if __name__ == "__main__":
+    main()
