@@ -23,16 +23,24 @@ def to_dlpack(x):
     return jnp.asarray(x)
 
 
-def from_dlpack(capsule_or_array):
-    """Import any __dlpack__-bearing tensor (e.g. a torch.Tensor) —
-    or a legacy raw capsule — as a jax array, zero-copy where the
-    backend allows."""
-    if hasattr(capsule_or_array, "__dlpack__"):
-        return jnp.from_dlpack(capsule_or_array) if hasattr(
-            jnp, "from_dlpack") else jax.dlpack.from_dlpack(
-                capsule_or_array)
-    # legacy PyCapsule path
-    return jax.dlpack.from_dlpack(capsule_or_array)
+def from_dlpack(tensor):
+    """Import any __dlpack__-bearing tensor (e.g. a torch.Tensor) as a
+    jax array, zero-copy where the backend allows.
+
+    Raw PyCapsules (the pre-2021 protocol) are rejected with a clear
+    error: the installed jax consumes only the modern
+    __dlpack__/__dlpack_device__ protocol, so pass the tensor object
+    itself (e.g. the torch.Tensor, NOT torch.utils.dlpack.to_dlpack(t))."""
+    if hasattr(tensor, "__dlpack__"):
+        return jnp.from_dlpack(tensor) if hasattr(
+            jnp, "from_dlpack") else jax.dlpack.from_dlpack(tensor)
+    if type(tensor).__name__ == "PyCapsule":
+        raise TypeError(
+            "from_dlpack no longer accepts raw DLPack capsules; pass the "
+            "source tensor itself (it must implement __dlpack__), e.g. "
+            "from_dlpack(torch_tensor) instead of "
+            "from_dlpack(torch.utils.dlpack.to_dlpack(torch_tensor))")
+    return jax.dlpack.from_dlpack(tensor)
 
 
 def to_numpy(x):

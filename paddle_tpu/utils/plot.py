@@ -1,10 +1,13 @@
 """`paddle.utils.plot` parity — the Ploter the book tutorials use.
 
 Reference: python/paddle/utils/plot.py (PlotData, Ploter): collects
-(step, value) series per title and renders them with matplotlib; in a
-headless/non-interactive session `show` falls through to `save`-style
-behavior without erroring.
+(step, value) series per title and renders them with matplotlib.  Like
+the reference, display is gated on an attached display (DISPLAY env):
+headless sessions fall back to the Agg backend and `plot()` shows the
+figure only when a display exists; pass `path` to always write a file.
 """
+
+import os
 
 __all__ = ["Ploter"]
 
@@ -28,10 +31,14 @@ class Ploter:
         self.__args__ = args
         self.__plot_data__ = {title: PlotData() for title in args}
         self.__disable_plot__ = False
+        self._interactive = bool(os.environ.get("DISPLAY"))
         try:
             import matplotlib
 
-            matplotlib.use("Agg")  # headless-safe
+            if not self._interactive:
+                # headless: only force Agg when no display is attached,
+                # never clobber an interactive backend the session set up
+                matplotlib.use("Agg")
             import matplotlib.pyplot as plt
 
             self.plt = plt
@@ -60,6 +67,14 @@ class Ploter:
         self.plt.legend(titles, loc="upper left")
         if path is not None:
             self.plt.savefig(path)
+        elif self._interactive:
+            # reference behavior: display when a session can show it
+            self.plt.show()
+        else:
+            # headless with no path: draw so the figure is inspectable
+            # via plt.gcf() (tutorials sometimes call plot() bare); a
+            # silent no-op here would discard the render entirely
+            self.plt.draw()
 
     def reset(self):
         for data in self.__plot_data__.values():
