@@ -177,10 +177,12 @@ def bench_lenet(on_tpu, peak):
 
 
 def resnet50_time_config(peak, batch=128, remat=False, iters=10,
-                         data_format="NHWC", bn_stats_sample=0):
+                         data_format="NHWC", bn_stats_sample=0,
+                         fused=False):
     """ONE parameterized ResNet-50 bf16 train-step measurement — shared
     by the headline bench row and tools/resnet50_tpu_tune.py's sweep so
-    the MFU basis cannot drift between them."""
+    the MFU basis cannot drift between them.  fused=True engages the
+    Pallas fused-bottleneck kernel on the 12 identity blocks."""
     import jax
     import jax.numpy as jnp
 
@@ -190,7 +192,7 @@ def resnet50_time_config(peak, batch=128, remat=False, iters=10,
     from paddle_tpu.optimizer.functional import Momentum
 
     model = resnet50(dtype="bfloat16", data_format=data_format,
-                     bn_stats_sample=bn_stats_sample)
+                     bn_stats_sample=bn_stats_sample, fused=fused)
     opt = Momentum(0.1, 0.9)
     state = init_train_state(model, opt)
 
@@ -213,6 +215,8 @@ def resnet50_time_config(peak, batch=128, remat=False, iters=10,
          "mfu": round(mfu, 4)}
     if bn_stats_sample:
         r["bn_stats_sample"] = bn_stats_sample
+    if fused:
+        r["fused"] = True
     return r
 
 
@@ -274,6 +278,26 @@ def bench_resnet50(on_tpu, peak):
             "vs_baseline": round(mfu / MFU_TARGET, 4),
             "samples_per_sec": round(batch / dt, 1),
             "step_ms": round(dt * 1e3, 2)}
+
+
+def bench_resnet50_fused(on_tpu, peak):
+    """ResNet-50 with the Pallas fused-bottleneck kernel on the 12
+    identity blocks (kernels/fused_bottleneck.py) — the traffic-removal
+    answer to the roofline finding that the unfused step runs at ~100%
+    of HBM bandwidth.  Separate config (and LAST in the suite) so a
+    Mosaic regression can never cost the known-good rows."""
+    if not on_tpu:
+        return {"metric": "resnet50_fused_mfu",
+                "skipped": "TPU-only config (interpret-mode numerics "
+                           "are covered by tests/test_fused_bottleneck.py)"}
+    r = resnet50_time_config(peak, batch=128, data_format="NHWC",
+                             bn_stats_sample=16, fused=True)
+    mfu = r["mfu"]
+    return {"metric": "resnet50_fused_mfu", "value": mfu,
+            "unit": "mfu_frac", "vs_baseline": round(mfu / MFU_TARGET, 4),
+            "samples_per_sec": r["samples_per_sec"],
+            "step_ms": r["step_ms"], "bn_stats_sample": 16,
+            "fused": True}
 
 
 def bench_transformer_flash(on_tpu, peak):
@@ -532,7 +556,8 @@ def main():
                ("transformer_flash", bench_transformer_flash),
                ("wide_deep", bench_wide_deep),
                ("flash_tile_ab", bench_flash_tiles),
-               ("bert_chunked_ce", bench_bert_chunked_ce)]
+               ("bert_chunked_ce", bench_bert_chunked_ce),
+               ("resnet_fused", bench_resnet50_fused)]
     for key, fn in benches:
         try:
             r = record(key, fn(on_tpu, peak))

@@ -54,7 +54,7 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, in_ch, ch, stride=1, data_format="NCHW",
-                 dtype="float32"):
+                 dtype="float32", fused=False):
         super().__init__(dtype=dtype)
         df = data_format
         self.conv0 = ConvBNLayer(in_ch, ch, 1, act="relu", data_format=df,
@@ -66,8 +66,78 @@ class BottleneckBlock(nn.Layer):
                       ConvBNLayer(in_ch, ch * 4, 1, stride=stride,
                                   data_format=df, dtype=dtype))
         self.relu = nn.ReLU()
+        # the fused Pallas path covers exactly the identity-shortcut
+        # stride-1 NHWC shape (12 of ResNet-50's 16 blocks — the bulk
+        # of the HBM traffic the kernel exists to remove)
+        self._fused = (fused and self.short is None and stride == 1
+                       and df == "NHWC")
+
+    def _bn_affine(self, bn, conv_out):
+        """Resolve one BatchNorm to a per-channel (a, b) affine, exactly
+        the batch_norm kernel's semantics (two-pass f32 stats; ghost
+        subsample via _stats_sample; running stats updated in train)."""
+        import jax.numpy as jnp_
+
+        eps, mom = bn._epsilon, bn._momentum
+        if self.training:
+            ss = bn._stats_sample
+            xs = conv_out if not (0 < ss < conv_out.shape[0]) \
+                else conv_out[:ss]
+            axes = tuple(range(xs.ndim - 1))            # NHWC: reduce NHW
+            mean = jnp_.mean(xs, axis=axes, dtype=jnp_.float32)
+            centered = xs.astype(jnp_.float32) - mean
+            var = jnp_.mean(jnp_.square(centered), axis=axes)
+            bn._buffers["_mean"] = bn._buffers["_mean"] * mom \
+                + mean * (1 - mom)
+            bn._buffers["_variance"] = bn._buffers["_variance"] * mom \
+                + var * (1 - mom)
+        else:
+            mean = bn._buffers["_mean"]
+            var = bn._buffers["_variance"]
+        inv = 1.0 / jnp_.sqrt(var + eps)
+        a = inv * bn.weight.value.astype(jnp_.float32)
+        b = bn.bias.value.astype(jnp_.float32) - mean * a
+        return a, b
+
+    def _forward_fused(self, x):
+        """One-HBM-round-trip block: ghost-batch BN stats resolved on a
+        small slice OUTSIDE the kernel (the slice convs re-run on ss/N
+        of the batch; grads through the stats compose via autodiff),
+        then the whole block runs as one Pallas kernel."""
+        from ..kernels.fused_bottleneck import fused_bottleneck
+
+        w1 = self.conv0.conv.weight.value[:, :, 0, 0].T   # [Cin, Cm]
+        w2 = jnp.transpose(self.conv1.conv.weight.value, (2, 3, 1, 0))
+        w3 = self.conv2.conv.weight.value[:, :, 0, 0].T   # [Cm, Cout]
+
+        if self.training:
+            ss = self.conv0.bn._stats_sample
+            xs = x if not (0 < ss < x.shape[0]) else x[:ss]
+            c0s = self.conv0.conv(xs)
+            a1, b1 = self._bn_affine(self.conv0.bn, c0s)
+            h0s = jnp.maximum(c0s * a1.astype(c0s.dtype)
+                              + b1.astype(c0s.dtype), 0)
+            c1s = self.conv1.conv(h0s)
+            a2, b2 = self._bn_affine(self.conv1.bn, c1s)
+            h1s = jnp.maximum(c1s * a2.astype(c1s.dtype)
+                              + b2.astype(c1s.dtype), 0)
+            c2s = self.conv2.conv(h1s)
+            a3, b3 = self._bn_affine(self.conv2.bn, c2s)
+        else:
+            a1, b1 = self._bn_affine(self.conv0.bn, None)
+            a2, b2 = self._bn_affine(self.conv1.bn, None)
+            a3, b3 = self._bn_affine(self.conv2.bn, None)
+        return fused_bottleneck(x, w1, w2, w3, a1, b1, a2, b2, a3, b3)
 
     def forward(self, x):
+        # training with full-batch stats (ss=0) would run every conv
+        # TWICE (full-batch stats chain outside the kernel + the kernel
+        # itself) — strictly slower than unfused, so route it to the
+        # per-conv path; the fused win requires ghost stats (ss>0) or
+        # eval mode
+        ss = self.conv0.bn._stats_sample
+        if self._fused and (not self.training or 0 < ss < x.shape[0]):
+            return self._forward_fused(x)
         y = self.conv2(self.conv1(self.conv0(x)))
         s = x if self.short is None else self.short(x)
         return self.relu(y + s)
@@ -79,7 +149,7 @@ class ResNet(nn.Layer):
     stays NCHW with ONE transpose at the stem."""
 
     def __init__(self, block, depths, num_classes=1000, in_ch=3,
-                 data_format="NCHW", dtype="float32"):
+                 data_format="NCHW", dtype="float32", fused=False):
         super().__init__(dtype=dtype)
         self._data_format = data_format
         self.stem = ConvBNLayer(in_ch, 64, 7, stride=2, act="relu",
@@ -92,8 +162,10 @@ class ResNet(nn.Layer):
         for stage, (ch, depth) in enumerate(zip(chans, depths)):
             for i in range(depth):
                 stride = 2 if i == 0 and stage > 0 else 1
+                kw = {"fused": True} if fused else {}
                 blocks.append(block(prev, ch, stride=stride,
-                                    data_format=data_format, dtype=dtype))
+                                    data_format=data_format, dtype=dtype,
+                                    **kw))
                 prev = ch * block.expansion
         self.blocks = nn.LayerList(blocks)
         self.global_pool = nn.Pool2D(pool_type="avg", global_pooling=True,
@@ -136,10 +208,14 @@ def resnet34(num_classes=1000, data_format="NCHW", dtype="float32",
 
 
 def resnet50(num_classes=1000, data_format="NCHW", dtype="float32",
-             bn_stats_sample=0):
+             bn_stats_sample=0, fused=False):
+    """fused=True routes the 12 identity bottleneck blocks through the
+    Pallas fused-block kernel (kernels/fused_bottleneck.py) — NHWC
+    only; requires bn_stats_sample>0 (or eval mode) to be a perf win."""
     return set_bn_stats_sample(
         ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes,
-               data_format=data_format, dtype=dtype), bn_stats_sample)
+               data_format=data_format, dtype=dtype, fused=fused),
+        bn_stats_sample)
 
 
 class SEBlock(nn.Layer):

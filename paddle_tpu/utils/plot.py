@@ -1,10 +1,12 @@
 """`paddle.utils.plot` parity — the Ploter the book tutorials use.
 
 Reference: python/paddle/utils/plot.py (PlotData, Ploter): collects
-(step, value) series per title and renders them with matplotlib.  Like
-the reference, display is gated on an attached display (DISPLAY env):
-headless sessions fall back to the Agg backend and `plot()` shows the
-figure only when a display exists; pass `path` to always write a file.
+(step, value) series per title and renders them with matplotlib.
+Display policy: headless sessions (no DISPLAY) fall back to the Agg
+backend and `plot()` draws without showing; with a display attached,
+`plot()` shows NON-blocking (the reference's IPython display-update
+analogue — a blocking show would freeze the training loop calling
+plot() each epoch).  Pass `path` to always write a file.
 """
 
 import os
@@ -68,8 +70,9 @@ class Ploter:
         if path is not None:
             self.plt.savefig(path)
         elif self._interactive:
-            # reference behavior: display when a session can show it
-            self.plt.show()
+            # non-blocking: a tutorial loop calls plot() every epoch
+            self.plt.show(block=False)
+            self.plt.pause(0.001)
         else:
             # headless with no path: draw so the figure is inspectable
             # via plt.gcf() (tutorials sometimes call plot() bare); a

@@ -1,0 +1,204 @@
+"""Fused Pallas bottleneck block: kernel numerics + model integration.
+
+Checks the one-HBM-round-trip block kernel (kernels/fused_bottleneck.py,
+interpret mode on CPU) against the unfused ConvBN composition — forward,
+full gradient set, ghost-stats training semantics, and eval mode.
+Parity role: the reference's fused-conv op tests
+(/root/reference/python/paddle/fluid/tests/unittests/test_conv2d_fusion_op.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu import nn
+from paddle_tpu.kernels.fused_bottleneck import (
+    default_batch_tile, fused_bottleneck)
+from paddle_tpu.models.resnet import BottleneckBlock, resnet50
+
+
+def _ref_block(x, w1, w2, w3, a1, b1, a2, b2, a3, b3):
+    cm = w1.shape[1]
+    c0 = jnp.einsum("nhwc,cd->nhwd", x, w1,
+                    preferred_element_type=jnp.float32)
+    h0 = jnp.maximum(c0 * a1 + b1, 0).astype(x.dtype)
+    dn = lax.conv_dimension_numbers(h0.shape, (cm, cm, 3, 3),
+                                    ("NHWC", "OIHW", "NHWC"))
+    w2_oihw = jnp.transpose(w2, (3, 2, 0, 1))
+    c1 = lax.conv_general_dilated(
+        h0, w2_oihw, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=dn).astype(jnp.float32)
+    h1 = jnp.maximum(c1 * a2 + b2, 0).astype(x.dtype)
+    c2 = jnp.einsum("nhwc,cd->nhwd", h1, w3,
+                    preferred_element_type=jnp.float32)
+    pre = c2 * a3 + b3 + x.astype(jnp.float32)
+    return jnp.maximum(pre, 0).astype(x.dtype)
+
+
+def _mk_args(seed=0, n=8, h=8, w=8, c=32, cm=8):
+    rng = np.random.default_rng(seed)
+    f32 = jnp.float32
+    return (jnp.asarray(rng.standard_normal((n, h, w, c)) * 0.5, f32),
+            jnp.asarray(rng.standard_normal((c, cm)) * 0.2, f32),
+            jnp.asarray(rng.standard_normal((3, 3, cm, cm)) * 0.2, f32),
+            jnp.asarray(rng.standard_normal((cm, c)) * 0.2, f32),
+            jnp.asarray(rng.standard_normal(cm) * 0.3 + 1, f32),
+            jnp.asarray(rng.standard_normal(cm) * 0.1, f32),
+            jnp.asarray(rng.standard_normal(cm) * 0.3 + 1, f32),
+            jnp.asarray(rng.standard_normal(cm) * 0.1, f32),
+            jnp.asarray(rng.standard_normal(c) * 0.3 + 1, f32),
+            jnp.asarray(rng.standard_normal(c) * 0.1, f32))
+
+
+def test_kernel_forward_matches_composition():
+    args = _mk_args()
+    np.testing.assert_allclose(np.asarray(fused_bottleneck(*args)),
+                               np.asarray(_ref_block(*args)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_kernel_grads_match_composition():
+    args = _mk_args()
+    g_ref = jax.grad(lambda *a: jnp.sum(_ref_block(*a) ** 2),
+                     argnums=tuple(range(10)))(*args)
+    g_fus = jax.grad(lambda *a: jnp.sum(fused_bottleneck(*a) ** 2),
+                     argnums=tuple(range(10)))(*args)
+    for name, a, b in zip(
+            "dx dw1 dw2 dw3 da1 db1 da2 db2 da3 db3".split(),
+            g_ref, g_fus):
+        scale = max(float(jnp.max(jnp.abs(a))), 1.0)
+        np.testing.assert_allclose(np.asarray(b) / scale,
+                                   np.asarray(a) / scale,
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_kernel_multi_tile_grid():
+    # force >1 grid step so the weight-grad accumulator pattern and the
+    # per-tile dx blocks are exercised
+    args = _mk_args(n=8)
+    y_one = fused_bottleneck(*args, batch_tile=8)
+    y_tiled = fused_bottleneck(*args, batch_tile=2)
+    np.testing.assert_allclose(np.asarray(y_tiled), np.asarray(y_one),
+                               rtol=1e-5, atol=1e-5)
+    g_one = jax.grad(lambda *a: jnp.sum(
+        fused_bottleneck(*a, batch_tile=8) ** 2),
+        argnums=(1, 2, 3))(*args)
+    g_tiled = jax.grad(lambda *a: jnp.sum(
+        fused_bottleneck(*a, batch_tile=2) ** 2),
+        argnums=(1, 2, 3))(*args)
+    for a, b in zip(g_one, g_tiled):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_default_batch_tile_divides():
+    assert default_batch_tile(128, 56, 56, 256) * 56 * 56 <= 12544
+    for n in (128, 96, 8, 7):
+        assert n % default_batch_tile(n, 14, 14, 1024) == 0
+
+
+def _fresh_block(ss=4):
+    blk = BottleneckBlock(32, 8, stride=1, data_format="NHWC",
+                          dtype="float32", fused=True)
+    for lyr in blk.sublayers(include_self=True):
+        if isinstance(lyr, nn.BatchNorm):
+            lyr._stats_sample = ss
+    return blk
+
+
+def test_block_fused_matches_unfused_training():
+    blk = _fresh_block()
+    blk.train()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 8, 8, 32)) * 0.5, jnp.float32)
+    y_fused = blk._forward_fused(x)
+    for lyr in blk.sublayers(include_self=True):
+        if isinstance(lyr, nn.BatchNorm):
+            lyr._buffers["_mean"] = jnp.zeros_like(lyr._buffers["_mean"])
+            lyr._buffers["_variance"] = jnp.ones_like(
+                lyr._buffers["_variance"])
+    blk._fused = False
+    y_ref = blk.forward(x)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_block_fused_grads_match_unfused():
+    from paddle_tpu.models.train import _loss_with_buffers, init_train_state
+    from paddle_tpu.optimizer.functional import Momentum
+
+    blk = _fresh_block()
+    blk.train()
+    opt = Momentum(0.1, 0.9)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 8, 8, 32)) * 0.5, jnp.float32)
+    lf = lambda m, a: jnp.sum(m(a) ** 2)
+
+    def grads(fused):
+        blk._fused = fused
+        state = init_train_state(blk, opt)
+        def loss_of(params):
+            return _loss_with_buffers(blk, params, state.buffers,
+                                      jax.random.PRNGKey(0), lf, ((x,)))
+        return jax.grad(loss_of, has_aux=True)(state.params)[0]
+
+    g1, g0 = grads(True), grads(False)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        scale = max(float(jnp.max(jnp.abs(a))), 1.0)
+        np.testing.assert_allclose(np.asarray(b) / scale,
+                                   np.asarray(a) / scale,
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_block_fused_updates_running_stats():
+    blk = _fresh_block()
+    blk.train()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 8, 8, 32)) * 0.5, jnp.float32)
+    m0 = np.asarray(blk.conv0.bn._buffers["_mean"]).copy()
+    blk._forward_fused(x)
+    m1 = np.asarray(blk.conv0.bn._buffers["_mean"])
+    assert not np.allclose(m0, m1)
+
+
+def test_block_fused_eval_uses_running_stats():
+    blk = _fresh_block()
+    blk.train()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 8, 8, 32)) * 0.5, jnp.float32)
+    for _ in range(3):
+        blk._forward_fused(x)
+    blk.eval()
+    y_fused = blk._forward_fused(x)
+    blk._fused = False
+    y_ref = blk.forward(x)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet50_fused_train_step_runs():
+    from paddle_tpu.models.train import init_train_state, make_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer.functional import Momentum
+
+    model = resnet50(num_classes=10, data_format="NHWC",
+                     bn_stats_sample=2, fused=True)
+    fused_blocks = [b for b in model.blocks if getattr(b, "_fused", False)]
+    assert len(fused_blocks) == 12  # identity blocks of [3, 4, 6, 3]
+    opt = Momentum(0.01, 0.9)
+    state = init_train_state(model, opt)
+    step = make_train_step(
+        model, opt,
+        loss_fn=lambda m, a, b: F.cross_entropy(m(a), b).mean())
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 3, 64, 64)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (4,)), jnp.int32)
+    losses = []
+    for _ in range(3):
+        state, loss = step(state, x, y)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
