@@ -393,14 +393,41 @@ def bench_flash_tiles(on_tpu, peak):
                     block_q=_blk[0], block_k=_blk[1]).astype(
                         jnp.float32).sum()
 
-            grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            grad = jax.grad(loss, argnums=(0, 1, 2))
+            # iterations CHAIN (each step's q/k/v fold in the previous
+            # grads at ~1e-30, numerically invisible but un-DCE-able):
+            # independent repeats of an identical dispatch are served
+            # from a cache by the remote-tunnel backend and time as
+            # pure RPC latency (r4 catch: the r3-style per-call loop
+            # reported 74ms for a 0.6ms-ideal shape at every tile size)
+            iters = 10
+
+            @jax.jit
+            def run(q, k, v, _grad=grad):
+                def body(c, _):
+                    qq, kk, vv = c
+                    dq, dk, dv = _grad(qq, kk, vv)
+                    eps = jnp.asarray(1e-30, qq.dtype)
+                    return ((qq + dq * eps, kk + dk * eps,
+                             vv + dv * eps), dq[0, 0, 0, 0])
+                return jax.lax.scan(body, (q, k, v), None, length=iters)
+
             try:
-                jax.block_until_ready(grad(q, k, v))
-                reps, best = 5, float("inf")
+                qr = q
+                (_, outs) = run(qr, k, v)
+                float(outs[-1])
+                reps, best = 3, float("inf")
                 for _ in range(reps):
+                    # chain ACROSS reps too: perturb q by the last
+                    # scan output so no rep repeats a byte-identical
+                    # dispatch (the warmed cache would serve it)
+                    qr = qr * (1.0 + jnp.asarray(outs[-1], qr.dtype)
+                               * 1e-30)
                     t0 = time.perf_counter()
-                    jax.block_until_ready(grad(q, k, v))
-                    best = min(best, time.perf_counter() - t0)
+                    _, outs = run(qr, k, v)
+                    float(outs[-1])
+                    best = min(best,
+                               (time.perf_counter() - t0) / iters)
                 results[f"seq{seq}_blk{blk[0]}"] = round(best * 1e3, 3)
             except Exception as e:
                 results[f"seq{seq}_blk{blk[0]}"] = \

@@ -4,12 +4,14 @@ The conv-net analogue of flash attention: the on-chip roofline of the
 ResNet-50 train step (tools/resnet50_ablate.py, r4) showed the step
 running at ~100% of v5e HBM bandwidth — 46.7GB of traffic, dominated by
 the per-conv materialisation of every intermediate activation of every
-bottleneck block.  This kernel computes a whole identity bottleneck
+bottleneck block.  This kernel computes a whole stride-1 bottleneck
 block
 
-    y = relu(a3 * conv1x1(h1, w3) + b3 + x)
+    y = relu(a3 * conv1x1(h1, w3) + b3 + shortcut)
     h1 = relu(a2 * conv3x3(h0, w2) + b2)
     h0 = relu(a1 * conv1x1(x, w1) + b1)
+    shortcut = x                      (identity variant)
+             | a4 * conv1x1(x, w4) + b4   (projection variant)
 
 in one VMEM residency per batch tile: HBM sees one read of x and one
 write of y in the forward, and one read of (x, dy) and one write of dx
@@ -44,7 +46,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-
 from jax.experimental.pallas import tpu as pltpu
 
 _VMEM = pltpu.VMEM
@@ -79,49 +80,51 @@ def default_batch_tile(n, h, w, c, rows_target=12544):
     return t
 
 
-def _conv3x3(h0_pad, w2, t, h, wid, cm, stride=1):
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _conv3x3(h0_pad, w2, t, h, wid, cm):
     """Nine shifted matmuls over a padded [T, H+2, W+2, Cm] tile -> f32
-    [T*Ho*Wo, Cmo]."""
-    ho, wo = (h + stride - 1) // stride, (wid + stride - 1) // stride
-    acc = jnp.zeros((t * ho * wo, w2.shape[-1]), jnp.float32)
+    [T*H*W, Cmo]."""
+    acc = jnp.zeros((t * h * wid, w2.shape[-1]), jnp.float32)
     for dy in range(3):
         for dx in range(3):
-            sl = h0_pad[:, dy:dy + h:stride, dx:dx + wid:stride, :]
-            acc += jax.lax.dot_general(
-                sl.reshape(t * ho * wo, cm), w2[dy, dx],
-                (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            sl = h0_pad[:, dy:dy + h, dx:dx + wid, :]
+            acc += _dot(sl.reshape(t * h * wid, cm), w2[dy, dx],
+                        ((1,), (0,)))
     return acc
 
 
-def _fwd_kernel(x_ref, w1_ref, w2_ref, w3_ref, aff_ref, o_ref, h0p_ref,
-                *, t, h, w, cin, cm):
+def _fwd_kernel(x_ref, w1_ref, w2_ref, w3_ref, w4_ref, aff_ref, o_ref,
+                h0p_ref, *, t, h, w, cin, cm, cout, proj):
     dt = x_ref.dtype
     x = x_ref[...]                                       # [T,H,W,Cin]
     xm = x.reshape(t * h * w, cin)
-    a1 = aff_ref[0, :cm]
-    b1 = aff_ref[1, :cm]
-    a2 = aff_ref[2, :cm]
-    b2 = aff_ref[3, :cm]
-    a3 = aff_ref[4, :]
-    b3 = aff_ref[5, :]
+    a1, b1 = aff_ref[0, :cm], aff_ref[1, :cm]
+    a2, b2 = aff_ref[2, :cm], aff_ref[3, :cm]
+    a3, b3 = aff_ref[4, :cout], aff_ref[5, :cout]
 
-    c0 = jax.lax.dot_general(xm, w1_ref[...], (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
+    c0 = _dot(xm, w1_ref[...], ((1,), (0,)))
     h0 = jnp.maximum(c0 * a1 + b1, 0.0).astype(dt)       # [R, Cm]
     h0p_ref[...] = jnp.zeros(h0p_ref.shape, h0p_ref.dtype)
     h0p_ref[:, 1:h + 1, 1:w + 1, :] = h0.reshape(t, h, w, cm)
     c1 = _conv3x3(h0p_ref[...], w2_ref[...], t, h, w, cm)
     h1 = jnp.maximum(c1 * a2 + b2, 0.0).astype(dt)
-    c2 = jax.lax.dot_general(h1, w3_ref[...], (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    pre = c2 * a3 + b3 + xm.astype(jnp.float32)
-    o_ref[...] = jnp.maximum(pre, 0.0).astype(dt).reshape(t, h, w, cin)
+    c2 = _dot(h1, w3_ref[...], ((1,), (0,)))
+    if proj:
+        a4, b4 = aff_ref[6, :cout], aff_ref[7, :cout]
+        s = _dot(xm, w4_ref[...], ((1,), (0,))) * a4 + b4
+    else:
+        s = xm.astype(jnp.float32)
+    pre = c2 * a3 + b3 + s
+    o_ref[...] = jnp.maximum(pre, 0.0).astype(dt).reshape(t, h, w, cout)
 
 
-def _bwd_kernel(x_ref, dy_ref, w1_ref, w2_ref, w3_ref, aff_ref,
-                dx_ref, dw1_ref, dw2_ref, dw3_ref, daff_ref, h0p_ref,
-                dc1p_ref, *, t, h, w, cin, cm):
+def _bwd_kernel(x_ref, dy_ref, w1_ref, w2_ref, w3_ref, w4_ref, aff_ref,
+                dx_ref, dw1_ref, dw2_ref, dw3_ref, dw4_ref, daff_ref,
+                h0p_ref, dc1p_ref, *, t, h, w, cin, cm, cout, proj):
     dt = x_ref.dtype
     i = pl.program_id(0)
 
@@ -130,23 +133,18 @@ def _bwd_kernel(x_ref, dy_ref, w1_ref, w2_ref, w3_ref, aff_ref,
         dw1_ref[...] = jnp.zeros_like(dw1_ref)
         dw2_ref[...] = jnp.zeros_like(dw2_ref)
         dw3_ref[...] = jnp.zeros_like(dw3_ref)
+        dw4_ref[...] = jnp.zeros_like(dw4_ref)
         daff_ref[...] = jnp.zeros_like(daff_ref)
 
     x = x_ref[...]
     xm = x.reshape(t * h * w, cin)
-    a1 = aff_ref[0, :cm]
-    b1 = aff_ref[1, :cm]
-    a2 = aff_ref[2, :cm]
-    b2 = aff_ref[3, :cm]
-    a3 = aff_ref[4, :]
-    b3 = aff_ref[5, :]
-    w1 = w1_ref[...]
-    w2 = w2_ref[...]
-    w3 = w3_ref[...]
+    a1, b1 = aff_ref[0, :cm], aff_ref[1, :cm]
+    a2, b2 = aff_ref[2, :cm], aff_ref[3, :cm]
+    a3, b3 = aff_ref[4, :cout], aff_ref[5, :cout]
+    w1, w2, w3 = w1_ref[...], w2_ref[...], w3_ref[...]
 
     # ---- recompute forward (flash-style; nothing saved in HBM) ----
-    c0 = jax.lax.dot_general(xm, w1, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
+    c0 = _dot(xm, w1, ((1,), (0,)))
     u0 = c0 * a1 + b1
     h0 = jnp.maximum(u0, 0.0).astype(dt)
     c0 = c0.astype(dt)                    # residency: f32 copy freed
@@ -156,22 +154,26 @@ def _bwd_kernel(x_ref, dy_ref, w1_ref, w2_ref, w3_ref, aff_ref,
     u1 = c1 * a2 + b2
     h1 = jnp.maximum(u1, 0.0).astype(dt)
     c1 = c1.astype(dt)
-    c2 = jax.lax.dot_general(h1, w3, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    pre = c2 * a3 + b3 + xm.astype(jnp.float32)
+    c2 = _dot(h1, w3, ((1,), (0,)))
+    if proj:
+        a4, b4 = aff_ref[6, :cout], aff_ref[7, :cout]
+        w4 = w4_ref[...]
+        c4 = _dot(xm, w4, ((1,), (0,)))
+        s = c4 * a4 + b4
+        c4 = c4.astype(dt)
+    else:
+        s = xm.astype(jnp.float32)
+    pre = c2 * a3 + b3 + s
     c2 = c2.astype(dt)
 
     # ---- backward chain ----
-    dy = dy_ref[...].reshape(t * h * w, cin).astype(jnp.float32)
-    dz3 = jnp.where(pre > 0.0, dy, 0.0)                   # f32 [R,Cin]
-    daff_ref[4, :] += jnp.sum(dz3 * c2.astype(jnp.float32), axis=0)
-    daff_ref[5, :] += jnp.sum(dz3, axis=0)
+    dy = dy_ref[...].reshape(t * h * w, cout).astype(jnp.float32)
+    dz3 = jnp.where(pre > 0.0, dy, 0.0)                  # f32 [R,Cout]
+    daff_ref[4, :cout] += jnp.sum(dz3 * c2.astype(jnp.float32), axis=0)
+    daff_ref[5, :cout] += jnp.sum(dz3, axis=0)
     dc2 = (dz3 * a3).astype(dt)
-    dw3_ref[...] += jax.lax.dot_general(
-        h1, dc2, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dh1 = jax.lax.dot_general(dc2, w3, (((1,), (1,)), ((), ())),
-                              preferred_element_type=jnp.float32)
+    dw3_ref[...] += _dot(h1, dc2, ((0,), (0,)))
+    dh1 = _dot(dc2, w3, ((1,), (1,)))
     du1 = jnp.where(u1 > 0.0, dh1, 0.0)
     daff_ref[2, :cm] += jnp.sum(du1 * c1.astype(jnp.float32), axis=0)
     daff_ref[3, :cm] += jnp.sum(du1, axis=0)
@@ -184,106 +186,110 @@ def _bwd_kernel(x_ref, dy_ref, w1_ref, w2_ref, w3_ref, aff_ref,
     for dy_ in range(3):
         for dx_ in range(3):
             tap = h0p_ref[:, dy_:dy_ + h, dx_:dx_ + w, :]
-            dw2_ref[dy_, dx_] += jax.lax.dot_general(
-                tap.reshape(t * h * w, cm), dc1,
-                (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            dw2_ref[dy_, dx_] += _dot(tap.reshape(t * h * w, cm), dc1,
+                                      ((0,), (0,)))
             # transposed conv: dh0 gathers dc1 at the opposite shift
             rtap = dc1p_ref[:, 2 - dy_:2 - dy_ + h, 2 - dx_:2 - dx_ + w, :]
-            dh0 += jax.lax.dot_general(
-                rtap.reshape(t * h * w, cm), w2[dy_, dx_],
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            dh0 += _dot(rtap.reshape(t * h * w, cm), w2[dy_, dx_],
+                        ((1,), (1,)))
     du0 = jnp.where(u0 > 0.0, dh0, 0.0)
     daff_ref[0, :cm] += jnp.sum(du0 * c0.astype(jnp.float32), axis=0)
     daff_ref[1, :cm] += jnp.sum(du0, axis=0)
     dc0 = (du0 * a1).astype(dt)
-    dw1_ref[...] += jax.lax.dot_general(
-        xm, dc0, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dx_main = jax.lax.dot_general(dc0, w1, (((1,), (1,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-    dx_ref[...] = (dx_main + dz3).astype(dt).reshape(t, h, w, cin)
+    dw1_ref[...] += _dot(xm, dc0, ((0,), (0,)))
+    dx_main = _dot(dc0, w1, ((1,), (1,)))
+    if proj:
+        daff_ref[6, :cout] += jnp.sum(dz3 * c4.astype(jnp.float32),
+                                      axis=0)
+        daff_ref[7, :cout] += jnp.sum(dz3, axis=0)
+        dc4 = (dz3 * a4).astype(dt)
+        dw4_ref[...] += _dot(xm, dc4, ((0,), (0,)))
+        dx_res = _dot(dc4, w4, ((1,), (1,)))
+    else:
+        dx_res = dz3
+    dx_ref[...] = (dx_main + dx_res).astype(dt).reshape(t, h, w, cin)
 
 
-def _pack_affines(a1, b1, a2, b2, a3, b3, cin):
-    """[6, Cin] f32 row-packed affine table (rows 0-3 Cm-wide, padded)."""
-    cm = a1.shape[0]
-    pad = cin - cm
-    rows = [jnp.pad(v.astype(jnp.float32), (0, pad)) if pad else
-            v.astype(jnp.float32)
-            for v in (a1, b1, a2, b2)] + [a3.astype(jnp.float32),
-                                          b3.astype(jnp.float32)]
+def _pack_affines(affs, width):
+    """[8, width] f32 row-packed affine table (rows padded to width;
+    rows 6-7 are the projection-shortcut affine, zero for identity)."""
+    rows = []
+    for v in affs:
+        v = v.astype(jnp.float32)
+        rows.append(jnp.pad(v, (0, width - v.shape[0]))
+                    if v.shape[0] < width else v)
+    while len(rows) < 8:
+        rows.append(jnp.zeros(width, jnp.float32))
     return jnp.stack(rows)
 
 
-def _fwd(x, w1, w2, w3, aff, batch_tile):
+def _specs(x, dy_shape, w1, w2, w3, w4, aff, t, h, w):
+    tile = lambda shape: _vmem_spec(shape, lambda i: (i, 0, 0, 0))
+    return ([tile((t, h, w, x.shape[-1]))]
+            + ([tile((t, h, w, dy_shape[-1]))] if dy_shape else [])
+            + [_full_spec(w1.shape), _full_spec(w2.shape),
+               _full_spec(w3.shape), _full_spec(w4.shape),
+               _full_spec(aff.shape)])
+
+
+def _fwd(x, w1, w2, w3, w4, aff, batch_tile, proj):
     n, h, w, cin = x.shape
-    cm = w1.shape[1]
-    t = batch_tile or default_batch_tile(n, h, w, cin)
+    cm, cout = w1.shape[1], w3.shape[1]
+    t = batch_tile or default_batch_tile(n, h, w, max(cin, cout))
     if n % t:
         raise ValueError(f"batch_tile={t} does not divide batch {n}")
-    grid = (n // t,)
-    kernel = functools.partial(_fwd_kernel, t=t, h=h, w=w, cin=cin, cm=cm)
+    kernel = functools.partial(_fwd_kernel, t=t, h=h, w=w, cin=cin,
+                               cm=cm, cout=cout, proj=proj)
     return pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            _vmem_spec((t, h, w, cin), lambda i: (i, 0, 0, 0)),
-            _full_spec(w1.shape),
-            _full_spec(w2.shape),
-            _full_spec(w3.shape),
-            _full_spec(aff.shape),
-        ],
-        out_specs=_vmem_spec((t, h, w, cin), lambda i: (i, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(n // t,),
+        in_specs=_specs(x, None, w1, w2, w3, w4, aff, t, h, w),
+        out_specs=_vmem_spec((t, h, w, cout), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h, w, cout), x.dtype),
         scratch_shapes=[pltpu.VMEM((t, h + 2, w + 2, cm), x.dtype)],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(x, w1, w2, w3, aff)
+    )(x, w1, w2, w3, w4, aff)
 
 
-def _bwd(x, dy, w1, w2, w3, aff, batch_tile):
+def _bwd(x, dy, w1, w2, w3, w4, aff, batch_tile, proj):
     n, h, w, cin = x.shape
-    cm = w1.shape[1]
+    cm, cout = w1.shape[1], w3.shape[1]
     # backward holds ~2x the forward's f32 residents; halve the row
     # budget relative to the forward tile
-    t = batch_tile or default_batch_tile(n, h, w, cin, rows_target=6272)
+    t = batch_tile or default_batch_tile(n, h, w, max(cin, cout),
+                                         rows_target=6272)
     if n % t:
         raise ValueError(f"batch_tile={t} does not divide batch {n}")
-    grid = (n // t,)
-    kernel = functools.partial(_bwd_kernel, t=t, h=h, w=w, cin=cin, cm=cm)
+    kernel = functools.partial(_bwd_kernel, t=t, h=h, w=w, cin=cin,
+                               cm=cm, cout=cout, proj=proj)
     scratch = [pltpu.VMEM((t, h + 2, w + 2, cm), x.dtype),
                pltpu.VMEM((t, h + 2, w + 2, cm), x.dtype)]
+    tile = lambda c: _vmem_spec((t, h, w, c), lambda i: (i, 0, 0, 0))
     return pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            _vmem_spec((t, h, w, cin), lambda i: (i, 0, 0, 0)),
-            _vmem_spec((t, h, w, cin), lambda i: (i, 0, 0, 0)),
-            _full_spec(w1.shape),
-            _full_spec(w2.shape),
-            _full_spec(w3.shape),
-            _full_spec(aff.shape),
-        ],
-        out_specs=[
-            _vmem_spec((t, h, w, cin), lambda i: (i, 0, 0, 0)),
-            _full_spec(w1.shape),
-            _full_spec(w2.shape),
-            _full_spec(w3.shape),
-            _full_spec(aff.shape),
-        ],
+        grid=(n // t,),
+        in_specs=_specs(x, dy.shape, w1, w2, w3, w4, aff, t, h, w),
+        out_specs=[tile(cin), _full_spec(w1.shape), _full_spec(w2.shape),
+                   _full_spec(w3.shape), _full_spec(w4.shape),
+                   _full_spec(aff.shape)],
         out_shape=[
             jax.ShapeDtypeStruct(x.shape, x.dtype),
             jax.ShapeDtypeStruct(w1.shape, jnp.float32),
             jax.ShapeDtypeStruct(w2.shape, jnp.float32),
             jax.ShapeDtypeStruct(w3.shape, jnp.float32),
+            jax.ShapeDtypeStruct(w4.shape, jnp.float32),
             jax.ShapeDtypeStruct(aff.shape, jnp.float32),
         ],
         scratch_shapes=scratch,
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(x, dy, w1, w2, w3, aff)
+    )(x, dy, w1, w2, w3, w4, aff)
+
+
+def _dummy_w4(x):
+    # identity variant: w4 is never read; minimal aligned placeholder
+    return jnp.zeros((8, 128), x.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(10,))
@@ -295,26 +301,58 @@ def fused_bottleneck(x, w1, w2, w3, a1, b1, a2, b2, a3, b3,
     w3: [Cm, Cin]; a*/b*: per-channel affines (batch-norm resolved to
     scale/shift by the caller — see models/resnet.py ghost-stats path).
     """
-    aff = _pack_affines(a1, b1, a2, b2, a3, b3, x.shape[-1])
-    return _fwd(x, w1, w2, w3, aff, batch_tile)
+    aff = _pack_affines((a1, b1, a2, b2, a3, b3), x.shape[-1])
+    return _fwd(x, w1, w2, w3, _dummy_w4(x), aff, batch_tile, False)
 
 
 def _vjp_fwd(x, w1, w2, w3, a1, b1, a2, b2, a3, b3, batch_tile):
-    aff = _pack_affines(a1, b1, a2, b2, a3, b3, x.shape[-1])
-    y = _fwd(x, w1, w2, w3, aff, batch_tile)
+    aff = _pack_affines((a1, b1, a2, b2, a3, b3), x.shape[-1])
+    y = _fwd(x, w1, w2, w3, _dummy_w4(x), aff, batch_tile, False)
     return y, (x, w1, w2, w3, aff)
 
 
 def _vjp_bwd(batch_tile, res, dy):
     x, w1, w2, w3, aff = res
     cm = w1.shape[1]
-    dx, dw1, dw2, dw3, daff = _bwd(x, dy, w1, w2, w3, aff, batch_tile)
-    da1, db1 = daff[0, :cm], daff[1, :cm]
-    da2, db2 = daff[2, :cm], daff[3, :cm]
-    da3, db3 = daff[4], daff[5]
+    dx, dw1, dw2, dw3, _, daff = _bwd(x, dy, w1, w2, w3, _dummy_w4(x),
+                                      aff, batch_tile, False)
     cast = lambda g, ref: g.astype(ref.dtype)
     return (dx, cast(dw1, w1), cast(dw2, w2), cast(dw3, w3),
-            da1, db1, da2, db2, da3, db3)
+            daff[0, :cm], daff[1, :cm], daff[2, :cm], daff[3, :cm],
+            daff[4], daff[5])
 
 
 fused_bottleneck.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(13,))
+def fused_bottleneck_proj(x, w1, w2, w3, w4, a1, b1, a2, b2, a3, b3,
+                          a4, b4, batch_tile=None):
+    """Projection-shortcut stride-1 bottleneck block (e.g. ResNet-50
+    stage-1 block 0: Cin 64 -> Cout 256 at 56x56, the single most
+    traffic-heavy block).  shortcut = a4 * conv1x1(x, w4) + b4."""
+    cout = w3.shape[1]
+    aff = _pack_affines((a1, b1, a2, b2, a3, b3, a4, b4), cout)
+    return _fwd(x, w1, w2, w3, w4, aff, batch_tile, True)
+
+
+def _vjp_fwd_proj(x, w1, w2, w3, w4, a1, b1, a2, b2, a3, b3, a4, b4,
+                  batch_tile):
+    cout = w3.shape[1]
+    aff = _pack_affines((a1, b1, a2, b2, a3, b3, a4, b4), cout)
+    y = _fwd(x, w1, w2, w3, w4, aff, batch_tile, True)
+    return y, (x, w1, w2, w3, w4, aff)
+
+
+def _vjp_bwd_proj(batch_tile, res, dy):
+    x, w1, w2, w3, w4, aff = res
+    cm = w1.shape[1]
+    dx, dw1, dw2, dw3, dw4, daff = _bwd(x, dy, w1, w2, w3, w4, aff,
+                                        batch_tile, True)
+    cast = lambda g, ref: g.astype(ref.dtype)
+    return (dx, cast(dw1, w1), cast(dw2, w2), cast(dw3, w3),
+            cast(dw4, w4), daff[0, :cm], daff[1, :cm], daff[2, :cm],
+            daff[3, :cm], daff[4], daff[5], daff[6], daff[7])
+
+
+fused_bottleneck_proj.defvjp(_vjp_fwd_proj, _vjp_bwd_proj)

@@ -66,45 +66,53 @@ class BottleneckBlock(nn.Layer):
                       ConvBNLayer(in_ch, ch * 4, 1, stride=stride,
                                   data_format=df, dtype=dtype))
         self.relu = nn.ReLU()
-        # the fused Pallas path covers exactly the identity-shortcut
-        # stride-1 NHWC shape (12 of ResNet-50's 16 blocks — the bulk
-        # of the HBM traffic the kernel exists to remove)
-        self._fused = (fused and self.short is None and stride == 1
-                       and df == "NHWC")
+        # the fused Pallas path covers the stride-1 NHWC shapes:
+        # identity shortcut (12 of ResNet-50's 16 blocks) and the
+        # projection shortcut of stage-1 block 0 (the most
+        # traffic-heavy single block); only the 3 stride-2 transition
+        # blocks stay on the per-conv path
+        self._fused = fused and stride == 1 and df == "NHWC"
 
     def _bn_affine(self, bn, conv_out):
-        """Resolve one BatchNorm to a per-channel (a, b) affine, exactly
-        the batch_norm kernel's semantics (two-pass f32 stats; ghost
-        subsample via _stats_sample; running stats updated in train)."""
+        """Resolve one BatchNorm to a per-channel (a, b) affine by
+        running the REGISTERED batch_norm kernel on the (already
+        ghost-sliced) conv output — one implementation of the stats
+        semantics (two-pass f32, momentum running-stat update), shared
+        with the unfused path; the kernel's Y output is dead code that
+        XLA DCEs.  Returned (a, b) are cast to the activation dtype so
+        the fused block applies bit-compatible affines to the unfused
+        ConvBN path."""
         import jax.numpy as jnp_
 
-        eps, mom = bn._epsilon, bn._momentum
+        from ..ops import nn_ops
+
+        eps = bn._epsilon
         if self.training:
-            ss = bn._stats_sample
-            xs = conv_out if not (0 < ss < conv_out.shape[0]) \
-                else conv_out[:ss]
-            axes = tuple(range(xs.ndim - 1))            # NHWC: reduce NHW
-            mean = jnp_.mean(xs, axis=axes, dtype=jnp_.float32)
-            centered = xs.astype(jnp_.float32) - mean
-            var = jnp_.mean(jnp_.square(centered), axis=axes)
-            bn._buffers["_mean"] = bn._buffers["_mean"] * mom \
-                + mean * (1 - mom)
-            bn._buffers["_variance"] = bn._buffers["_variance"] * mom \
-                + var * (1 - mom)
+            out = nn_ops.batch_norm(
+                {"X": conv_out, "Scale": bn.weight.value,
+                 "Bias": bn.bias.value, "Mean": bn._buffers["_mean"],
+                 "Variance": bn._buffers["_variance"]},
+                {"momentum": bn._momentum, "epsilon": eps,
+                 "is_test": False, "data_layout": "NHWC"})
+            bn._buffers["_mean"] = out["MeanOut"]
+            bn._buffers["_variance"] = out["VarianceOut"]
+            mean, inv = out["SavedMean"], out["SavedVariance"]
         else:
             mean = bn._buffers["_mean"]
-            var = bn._buffers["_variance"]
-        inv = 1.0 / jnp_.sqrt(var + eps)
+            inv = 1.0 / jnp_.sqrt(bn._buffers["_variance"] + eps)
         a = inv * bn.weight.value.astype(jnp_.float32)
         b = bn.bias.value.astype(jnp_.float32) - mean * a
-        return a, b
+        dt = (conv_out.dtype if conv_out is not None
+              else bn.weight.value.dtype)
+        return a.astype(dt), b.astype(dt)
 
     def _forward_fused(self, x):
         """One-HBM-round-trip block: ghost-batch BN stats resolved on a
         small slice OUTSIDE the kernel (the slice convs re-run on ss/N
         of the batch; grads through the stats compose via autodiff),
         then the whole block runs as one Pallas kernel."""
-        from ..kernels.fused_bottleneck import fused_bottleneck
+        from ..kernels.fused_bottleneck import (
+            fused_bottleneck, fused_bottleneck_proj)
 
         w1 = self.conv0.conv.weight.value[:, :, 0, 0].T   # [Cin, Cm]
         w2 = jnp.transpose(self.conv1.conv.weight.value, (2, 3, 1, 0))
@@ -123,11 +131,21 @@ class BottleneckBlock(nn.Layer):
                               + b2.astype(c1s.dtype), 0)
             c2s = self.conv2.conv(h1s)
             a3, b3 = self._bn_affine(self.conv2.bn, c2s)
+            if self.short is not None:
+                c4s = self.short.conv(xs)
+                a4, b4 = self._bn_affine(self.short.bn, c4s)
         else:
             a1, b1 = self._bn_affine(self.conv0.bn, None)
             a2, b2 = self._bn_affine(self.conv1.bn, None)
             a3, b3 = self._bn_affine(self.conv2.bn, None)
-        return fused_bottleneck(x, w1, w2, w3, a1, b1, a2, b2, a3, b3)
+            if self.short is not None:
+                a4, b4 = self._bn_affine(self.short.bn, None)
+        if self.short is None:
+            return fused_bottleneck(x, w1, w2, w3, a1, b1, a2, b2,
+                                    a3, b3)
+        w4 = self.short.conv.weight.value[:, :, 0, 0].T   # [Cin, Cout]
+        return fused_bottleneck_proj(x, w1, w2, w3, w4, a1, b1, a2, b2,
+                                     a3, b3, a4, b4)
 
     def forward(self, x):
         # training with full-batch stats (ss=0) would run every conv

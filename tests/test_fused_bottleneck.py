@@ -94,6 +94,86 @@ def test_kernel_multi_tile_grid():
                                    rtol=1e-4, atol=1e-4)
 
 
+def _ref_block_proj(x, w1, w2, w3, w4, a1, b1, a2, b2, a3, b3, a4, b4):
+    cm = w1.shape[1]
+    c0 = jnp.einsum("nhwc,cd->nhwd", x, w1,
+                    preferred_element_type=jnp.float32)
+    h0 = jnp.maximum(c0 * a1 + b1, 0).astype(x.dtype)
+    dn = lax.conv_dimension_numbers(h0.shape, (cm, cm, 3, 3),
+                                    ("NHWC", "OIHW", "NHWC"))
+    w2_oihw = jnp.transpose(w2, (3, 2, 0, 1))
+    c1 = lax.conv_general_dilated(
+        h0, w2_oihw, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=dn).astype(jnp.float32)
+    h1 = jnp.maximum(c1 * a2 + b2, 0).astype(x.dtype)
+    c2 = jnp.einsum("nhwc,cd->nhwd", h1, w3,
+                    preferred_element_type=jnp.float32)
+    s = jnp.einsum("nhwc,cd->nhwd", x, w4,
+                   preferred_element_type=jnp.float32) * a4 + b4
+    return jnp.maximum(c2 * a3 + b3 + s, 0).astype(x.dtype)
+
+
+def _mk_args_proj(seed=0, n=8, h=8, w=8, cin=16, cm=8, cout=32):
+    rng = np.random.default_rng(seed)
+    f32 = jnp.float32
+    g = rng.standard_normal
+    return (jnp.asarray(g((n, h, w, cin)) * 0.5, f32),
+            jnp.asarray(g((cin, cm)) * 0.2, f32),
+            jnp.asarray(g((3, 3, cm, cm)) * 0.2, f32),
+            jnp.asarray(g((cm, cout)) * 0.2, f32),
+            jnp.asarray(g((cin, cout)) * 0.2, f32),
+            jnp.asarray(g(cm) * 0.3 + 1, f32),
+            jnp.asarray(g(cm) * 0.1, f32),
+            jnp.asarray(g(cm) * 0.3 + 1, f32),
+            jnp.asarray(g(cm) * 0.1, f32),
+            jnp.asarray(g(cout) * 0.3 + 1, f32),
+            jnp.asarray(g(cout) * 0.1, f32),
+            jnp.asarray(g(cout) * 0.3 + 1, f32),
+            jnp.asarray(g(cout) * 0.1, f32))
+
+
+def test_proj_kernel_forward_and_grads_match_composition():
+    from paddle_tpu.kernels.fused_bottleneck import fused_bottleneck_proj
+
+    args = _mk_args_proj()
+    np.testing.assert_allclose(
+        np.asarray(fused_bottleneck_proj(*args)),
+        np.asarray(_ref_block_proj(*args)), rtol=1e-5, atol=1e-5)
+    g_ref = jax.grad(lambda *a: jnp.sum(_ref_block_proj(*a) ** 2),
+                     argnums=tuple(range(13)))(*args)
+    g_fus = jax.grad(lambda *a: jnp.sum(fused_bottleneck_proj(*a) ** 2),
+                     argnums=tuple(range(13)))(*args)
+    for name, a, b in zip(
+            "dx dw1 dw2 dw3 dw4 da1 db1 da2 db2 da3 db3 da4 db4".split(),
+            g_ref, g_fus):
+        scale = max(float(jnp.max(jnp.abs(a))), 1.0)
+        np.testing.assert_allclose(np.asarray(b) / scale,
+                                   np.asarray(a) / scale,
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_proj_block_matches_unfused():
+    blk = BottleneckBlock(16, 8, stride=1, data_format="NHWC",
+                          dtype="float32", fused=True)
+    assert blk.short is not None and blk._fused
+    for lyr in blk.sublayers(include_self=True):
+        if isinstance(lyr, nn.BatchNorm):
+            lyr._stats_sample = 4
+    blk.train()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 8, 8, 16)) * 0.5, jnp.float32)
+    y_fused = blk._forward_fused(x)
+    for lyr in blk.sublayers(include_self=True):
+        if isinstance(lyr, nn.BatchNorm):
+            lyr._buffers["_mean"] = jnp.zeros_like(lyr._buffers["_mean"])
+            lyr._buffers["_variance"] = jnp.ones_like(
+                lyr._buffers["_variance"])
+    blk._fused = False
+    y_ref = blk.forward(x)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_default_batch_tile_divides():
     assert default_batch_tile(128, 56, 56, 256) * 56 * 56 <= 12544
     for n in (128, 96, 8, 7):
@@ -188,17 +268,50 @@ def test_resnet50_fused_train_step_runs():
     model = resnet50(num_classes=10, data_format="NHWC",
                      bn_stats_sample=2, fused=True)
     fused_blocks = [b for b in model.blocks if getattr(b, "_fused", False)]
-    assert len(fused_blocks) == 12  # identity blocks of [3, 4, 6, 3]
+    # 12 identity blocks + stage-1 block 0 (projection, stride 1); only
+    # the 3 stride-2 transitions stay unfused
+    assert len(fused_blocks) == 13
     opt = Momentum(0.01, 0.9)
     state = init_train_state(model, opt)
     step = make_train_step(
         model, opt,
         loss_fn=lambda m, a, b: F.cross_entropy(m(a), b).mean())
     rng = np.random.default_rng(0)
+    # 64x64 keeps stage-4 maps at 2x2: with ghost stats ss=2 a 32x32
+    # input leaves 1x1 maps whose 2-point BN variance is degenerate and
+    # the forward explodes IDENTICALLY on the unfused path (verified
+    # per-block: fused-vs-unfused diff stays ~1e-6 while magnitudes
+    # blow up) — a BN-statistics pathology, not a kernel property
     x = jnp.asarray(rng.standard_normal((4, 3, 64, 64)), jnp.float32)
     y = jnp.asarray(rng.integers(0, 10, (4,)), jnp.int32)
     losses = []
-    for _ in range(3):
+    for _ in range(2):
         state, loss = step(state, x, y)
         losses.append(float(loss))
     assert all(np.isfinite(losses))
+
+
+def test_block_fused_matches_unfused_bf16():
+    """The affine convention matters in bf16: (a, b) are resolved by the
+    shared batch_norm kernel and cast to the activation dtype, so fused
+    and unfused outputs agree to bf16 noise, not just f32 noise."""
+    blk = BottleneckBlock(32, 8, stride=1, data_format="NHWC",
+                          dtype="bfloat16", fused=True)
+    for lyr in blk.sublayers(include_self=True):
+        if isinstance(lyr, nn.BatchNorm):
+            lyr._stats_sample = 4
+    blk.train()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 8, 8, 32)) * 0.5,
+                    jnp.bfloat16)
+    y_fused = blk._forward_fused(x)
+    for lyr in blk.sublayers(include_self=True):
+        if isinstance(lyr, nn.BatchNorm):
+            lyr._buffers["_mean"] = jnp.zeros_like(lyr._buffers["_mean"])
+            lyr._buffers["_variance"] = jnp.ones_like(
+                lyr._buffers["_variance"])
+    blk._fused = False
+    y_ref = blk.forward(x)
+    np.testing.assert_allclose(
+        np.asarray(y_fused, np.float32), np.asarray(y_ref, np.float32),
+        rtol=0.05, atol=0.05)

@@ -22,14 +22,15 @@ if REPO not in sys.path:
 
 
 
-def time_config(batch, remat, iters=10, stats_sample=0):
+def time_config(batch, remat, iters=10, stats_sample=0, fused=False):
     import jax
 
     from bench import _peak_flops, resnet50_time_config
 
     peak = _peak_flops(jax.devices()[0])
     return resnet50_time_config(peak, batch=batch, remat=remat,
-                                iters=iters, bn_stats_sample=stats_sample)
+                                iters=iters, bn_stats_sample=stats_sample,
+                                fused=fused)
 
 
 def main():
@@ -69,13 +70,17 @@ def main():
         return best
 
     results, best = [], None
-    for batch, remat, ss in ((128, False, 0), (128, False, 16),
-                             (128, False, 32), (256, False, 32),
-                             (128, True, 16), (256, True, 32)):
+    # (batch, remat, stats_sample, fused); fused rows time the Pallas
+    # fused-bottleneck path (r4) against the per-conv XLA path
+    for batch, remat, ss, fused in (
+            (128, False, 16, False), (128, False, 32, False),
+            (128, False, 16, True), (128, False, 32, True),
+            (256, False, 32, True), (128, True, 16, False)):
         try:
-            r = time_config(batch, remat, stats_sample=ss)
+            r = time_config(batch, remat, stats_sample=ss, fused=fused)
         except Exception as e:
             r = {"batch": batch, "remat": remat, "stats_sample": ss,
+                 "fused": fused,
                  "error": f"{type(e).__name__}: {e}"[:160]}
         results.append(r)
         print(json.dumps(r), flush=True)
