@@ -182,7 +182,7 @@ def resnet50_time_config(peak, batch=128, remat=False, iters=10,
     """ONE parameterized ResNet-50 bf16 train-step measurement — shared
     by the headline bench row and tools/resnet50_tpu_tune.py's sweep so
     the MFU basis cannot drift between them.  fused=True engages the
-    Pallas fused-bottleneck kernel on the 12 identity blocks."""
+    Pallas fused-bottleneck kernels on all 16 blocks."""
     import jax
     import jax.numpy as jnp
 
@@ -281,11 +281,11 @@ def bench_resnet50(on_tpu, peak):
 
 
 def bench_resnet50_fused(on_tpu, peak):
-    """ResNet-50 with the Pallas fused-bottleneck kernel on the 12
-    identity blocks (kernels/fused_bottleneck.py) — the traffic-removal
-    answer to the roofline finding that the unfused step runs at ~100%
-    of HBM bandwidth.  Separate config (and LAST in the suite) so a
-    Mosaic regression can never cost the known-good rows."""
+    """ResNet-50 with the Pallas fused-bottleneck kernels on all 16
+    blocks (kernels/fused_bottleneck.py) — the traffic-removal answer
+    to the roofline finding that the unfused step runs at ~100% of HBM
+    bandwidth.  Separate config (and LAST in the suite) so a Mosaic
+    regression can never cost the known-good rows."""
     if not on_tpu:
         return {"metric": "resnet50_fused_mfu",
                 "skipped": "TPU-only config (interpret-mode numerics "

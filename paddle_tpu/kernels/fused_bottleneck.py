@@ -308,15 +308,19 @@ def fused_bottleneck(x, w1, w2, w3, a1, b1, a2, b2, a3, b3,
 def _vjp_fwd(x, w1, w2, w3, a1, b1, a2, b2, a3, b3, batch_tile):
     aff = _pack_affines((a1, b1, a2, b2, a3, b3), x.shape[-1])
     y = _fwd(x, w1, w2, w3, _dummy_w4(x), aff, batch_tile, False)
-    return y, (x, w1, w2, w3, aff)
+    return y, (x, w1, w2, w3, aff, jnp.zeros((0,), a1.dtype))
 
 
 def _vjp_bwd(batch_tile, res, dy):
-    x, w1, w2, w3, aff = res
+    x, w1, w2, w3, aff, atok = res
     cm = w1.shape[1]
     dx, dw1, dw2, dw3, _, daff = _bwd(x, dy, w1, w2, w3, _dummy_w4(x),
                                       aff, batch_tile, False)
     cast = lambda g, ref: g.astype(ref.dtype)
+    # daff rows must come back in the primal affine dtype (bf16 models
+    # pass bf16 affines; JAX only tolerates the f32 mismatch via a
+    # deprecated exception)
+    daff = daff.astype(atok.dtype)
     return (dx, cast(dw1, w1), cast(dw2, w2), cast(dw3, w3),
             daff[0, :cm], daff[1, :cm], daff[2, :cm], daff[3, :cm],
             daff[4], daff[5])
@@ -341,18 +345,283 @@ def _vjp_fwd_proj(x, w1, w2, w3, w4, a1, b1, a2, b2, a3, b3, a4, b4,
     cout = w3.shape[1]
     aff = _pack_affines((a1, b1, a2, b2, a3, b3, a4, b4), cout)
     y = _fwd(x, w1, w2, w3, w4, aff, batch_tile, True)
-    return y, (x, w1, w2, w3, w4, aff)
+    return y, (x, w1, w2, w3, w4, aff, jnp.zeros((0,), a1.dtype))
 
 
 def _vjp_bwd_proj(batch_tile, res, dy):
-    x, w1, w2, w3, w4, aff = res
+    x, w1, w2, w3, w4, aff, atok = res
     cm = w1.shape[1]
     dx, dw1, dw2, dw3, dw4, daff = _bwd(x, dy, w1, w2, w3, w4, aff,
                                         batch_tile, True)
     cast = lambda g, ref: g.astype(ref.dtype)
+    daff = daff.astype(atok.dtype)
     return (dx, cast(dw1, w1), cast(dw2, w2), cast(dw3, w3),
             cast(dw4, w4), daff[0, :cm], daff[1, :cm], daff[2, :cm],
             daff[3, :cm], daff[4], daff[5], daff[6], daff[7])
 
 
 fused_bottleneck_proj.defvjp(_vjp_fwd_proj, _vjp_bwd_proj)
+
+
+# ---------------------------------------------------------------------------
+# stride-2 transition block (projection shortcut + downsampling conv1)
+# ---------------------------------------------------------------------------
+#
+# All stride-2 access is expressed as parity decomposition — reshape
+# [.., 2k, ..] -> [.., k, 2, ..] then static index — so the kernel needs
+# no strided memory ops: tap (dy, dx) of the stride-2 3x3 conv reads
+# rows dy, dy+2, ... which is parity (dy % 2) offset (dy // 2) of the
+# padded plane, and the transposed conv scatters by stacking the four
+# output phases and collapsing [Ho, 2] -> H in a plain reshape.
+
+
+def _tap2(h0p6, dy, dx, ho, wo):
+    """Stride-2 tap: h0_pad[:, dy:dy+2*ho:2, dx:dx+2*wo:2, :] via the
+    parity-reshaped [T, (H+2)/2, 2, (W+2)/2, 2, Cm] view."""
+    ro, pr = divmod(dy, 2)
+    co, pc = divmod(dx, 2)
+    return h0p6[:, ro:ro + ho, pr, co:co + wo, pc, :]
+
+
+def _conv3x3_s2(h0p6, w2, t, ho, wo, cm):
+    acc = jnp.zeros((t * ho * wo, w2.shape[-1]), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            sl = _tap2(h0p6, dy, dx, ho, wo)
+            acc += _dot(sl.reshape(t * ho * wo, cm), w2[dy, dx],
+                        ((1,), (0,)))
+    return acc
+
+
+def _fwd_kernel_down(x_ref, w1_ref, w2_ref, w3_ref, w4_ref, aff_ref,
+                     o_ref, h0p_ref, *, t, h, w, cin, cm, cout):
+    dt = x_ref.dtype
+    ho, wo = h // 2, w // 2
+    x = x_ref[...]
+    xm = x.reshape(t * h * w, cin)
+    a1, b1 = aff_ref[0, :cm], aff_ref[1, :cm]
+    a2, b2 = aff_ref[2, :cm], aff_ref[3, :cm]
+    a3, b3 = aff_ref[4, :cout], aff_ref[5, :cout]
+    a4, b4 = aff_ref[6, :cout], aff_ref[7, :cout]
+
+    c0 = _dot(xm, w1_ref[...], ((1,), (0,)))
+    h0 = jnp.maximum(c0 * a1 + b1, 0.0).astype(dt)
+    h0p_ref[...] = jnp.zeros(h0p_ref.shape, h0p_ref.dtype)
+    h0p_ref[:, 1:h + 1, 1:w + 1, :] = h0.reshape(t, h, w, cm)
+    h0p6 = h0p_ref[...].reshape(t, (h + 2) // 2, 2, (w + 2) // 2, 2, cm)
+    c1 = _conv3x3_s2(h0p6, w2_ref[...], t, ho, wo, cm)
+    h1 = jnp.maximum(c1 * a2 + b2, 0.0).astype(dt)
+    c2 = _dot(h1, w3_ref[...], ((1,), (0,)))
+    # 1x1 stride-2 shortcut reads phase (0, 0) of x
+    x6 = x.reshape(t, ho, 2, wo, 2, cin)
+    xs2 = x6[:, :, 0, :, 0, :].reshape(t * ho * wo, cin)
+    s = _dot(xs2, w4_ref[...], ((1,), (0,))) * a4 + b4
+    pre = c2 * a3 + b3 + s
+    o_ref[...] = jnp.maximum(pre, 0.0).astype(dt).reshape(t, ho, wo, cout)
+
+
+def _bwd_kernel_down(x_ref, dy_ref, w1_ref, w2_ref, w3_ref, w4_ref,
+                     aff_ref, dx_ref, dw1_ref, dw2_ref, dw3_ref, dw4_ref,
+                     daff_ref, h0p_ref, dc1p_ref, *, t, h, w, cin, cm,
+                     cout):
+    dt = x_ref.dtype
+    ho, wo = h // 2, w // 2
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw1_ref[...] = jnp.zeros_like(dw1_ref)
+        dw2_ref[...] = jnp.zeros_like(dw2_ref)
+        dw3_ref[...] = jnp.zeros_like(dw3_ref)
+        dw4_ref[...] = jnp.zeros_like(dw4_ref)
+        daff_ref[...] = jnp.zeros_like(daff_ref)
+
+    x = x_ref[...]
+    xm = x.reshape(t * h * w, cin)
+    a1, b1 = aff_ref[0, :cm], aff_ref[1, :cm]
+    a2, b2 = aff_ref[2, :cm], aff_ref[3, :cm]
+    a3, b3 = aff_ref[4, :cout], aff_ref[5, :cout]
+    a4, b4 = aff_ref[6, :cout], aff_ref[7, :cout]
+    w1, w2, w3, w4 = w1_ref[...], w2_ref[...], w3_ref[...], w4_ref[...]
+
+    # ---- recompute ----
+    c0 = _dot(xm, w1, ((1,), (0,)))
+    u0 = c0 * a1 + b1
+    h0 = jnp.maximum(u0, 0.0).astype(dt)
+    c0 = c0.astype(dt)
+    h0p_ref[...] = jnp.zeros(h0p_ref.shape, h0p_ref.dtype)
+    h0p_ref[:, 1:h + 1, 1:w + 1, :] = h0.reshape(t, h, w, cm)
+    h0p6 = h0p_ref[...].reshape(t, (h + 2) // 2, 2, (w + 2) // 2, 2, cm)
+    c1 = _conv3x3_s2(h0p6, w2, t, ho, wo, cm)
+    u1 = c1 * a2 + b2
+    h1 = jnp.maximum(u1, 0.0).astype(dt)
+    c1 = c1.astype(dt)
+    c2 = _dot(h1, w3, ((1,), (0,)))
+    x6 = x.reshape(t, ho, 2, wo, 2, cin)
+    xs2 = x6[:, :, 0, :, 0, :].reshape(t * ho * wo, cin)
+    c4 = _dot(xs2, w4, ((1,), (0,)))
+    pre = c2 * a3 + b3 + (c4 * a4 + b4)
+    c2 = c2.astype(dt)
+    c4 = c4.astype(dt)
+
+    # ---- backward ----
+    dy = dy_ref[...].reshape(t * ho * wo, cout).astype(jnp.float32)
+    dz3 = jnp.where(pre > 0.0, dy, 0.0)
+    daff_ref[4, :cout] += jnp.sum(dz3 * c2.astype(jnp.float32), axis=0)
+    daff_ref[5, :cout] += jnp.sum(dz3, axis=0)
+    daff_ref[6, :cout] += jnp.sum(dz3 * c4.astype(jnp.float32), axis=0)
+    daff_ref[7, :cout] += jnp.sum(dz3, axis=0)
+    dc2 = (dz3 * a3).astype(dt)
+    dw3_ref[...] += _dot(h1, dc2, ((0,), (0,)))
+    dh1 = _dot(dc2, w3, ((1,), (1,)))
+    du1 = jnp.where(u1 > 0.0, dh1, 0.0)
+    daff_ref[2, :cm] += jnp.sum(du1 * c1.astype(jnp.float32), axis=0)
+    daff_ref[3, :cm] += jnp.sum(du1, axis=0)
+    dc1 = (du1 * a2).astype(dt)
+
+    # shortcut grads; dx phase-(0,0) scatter built by phase stacking
+    dc4 = (dz3 * a4).astype(dt)
+    dw4_ref[...] += _dot(xs2, dc4, ((0,), (0,)))
+    dxs = _dot(dc4, w4, ((1,), (1,))).reshape(t, ho, wo, cin)
+    zero = jnp.zeros_like(dxs)
+    dx_short = jnp.stack(
+        [jnp.stack([dxs, zero], axis=3),
+         jnp.stack([zero, zero], axis=3)],
+        axis=2).reshape(t * h * w, cin)
+
+    # dW2 taps + transposed stride-2 conv via output phases
+    dc1p_ref[...] = jnp.zeros(dc1p_ref.shape, dc1p_ref.dtype)
+    dc1p_ref[:, 1:ho + 1, 1:wo + 1, :] = dc1.reshape(t, ho, wo, cm)
+    for dy_ in range(3):
+        for dx_ in range(3):
+            tap = _tap2(h0p6, dy_, dx_, ho, wo)
+            dw2_ref[dy_, dx_] += _dot(tap.reshape(t * ho * wo, cm), dc1,
+                                      ((0,), (0,)))
+    # dh0 phase (pr, pc): a tap (dy, dx) contributes to rows of parity
+    # pr iff (2i + pr + 1 - dy) is even, i.e. dy ≡ pr+1 (mod 2); row
+    # offset in the padded dc1 = 1 + (pr + 1 - dy)//2 (zero-padding
+    # absorbs the out-of-range boundary rows)
+    phases = []
+    for pr in (0, 1):
+        rows = []
+        for pc in (0, 1):
+            acc = jnp.zeros((t * ho * wo, cm), jnp.float32)
+            for dy_ in range(3):
+                if (dy_ % 2) != (pr + 1) % 2:
+                    continue
+                for dx_ in range(3):
+                    if (dx_ % 2) != (pc + 1) % 2:
+                        continue
+                    ro = 1 + (pr + 1 - dy_) // 2
+                    co = 1 + (pc + 1 - dx_) // 2
+                    sl = dc1p_ref[:, ro:ro + ho, co:co + wo, :]
+                    acc += _dot(sl.reshape(t * ho * wo, cm),
+                                w2[dy_, dx_], ((1,), (1,)))
+            rows.append(acc.reshape(t, ho, wo, cm))
+        phases.append(rows)
+    dh0 = jnp.stack(
+        [jnp.stack([phases[0][0], phases[0][1]], axis=3),
+         jnp.stack([phases[1][0], phases[1][1]], axis=3)],
+        axis=2).reshape(t * h * w, cm)
+
+    du0 = jnp.where(u0 > 0.0, dh0, 0.0)
+    daff_ref[0, :cm] += jnp.sum(du0 * c0.astype(jnp.float32), axis=0)
+    daff_ref[1, :cm] += jnp.sum(du0, axis=0)
+    dc0 = (du0 * a1).astype(dt)
+    dw1_ref[...] += _dot(xm, dc0, ((0,), (0,)))
+    dx_main = _dot(dc0, w1, ((1,), (1,)))
+    dx_ref[...] = (dx_main + dx_short).astype(dt).reshape(t, h, w, cin)
+
+
+def _fwd_down(x, w1, w2, w3, w4, aff, batch_tile):
+    n, h, w, cin = x.shape
+    cm, cout = w1.shape[1], w3.shape[1]
+    t = batch_tile or default_batch_tile(n, h, w, max(cin, cout))
+    if n % t:
+        raise ValueError(f"batch_tile={t} does not divide batch {n}")
+    kernel = functools.partial(_fwd_kernel_down, t=t, h=h, w=w, cin=cin,
+                               cm=cm, cout=cout)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // t,),
+        in_specs=_specs(x, None, w1, w2, w3, w4, aff, t, h, w),
+        out_specs=_vmem_spec((t, h // 2, w // 2, cout),
+                             lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h // 2, w // 2, cout),
+                                       x.dtype),
+        scratch_shapes=[pltpu.VMEM((t, h + 2, w + 2, cm), x.dtype)],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(x, w1, w2, w3, w4, aff)
+
+
+def _bwd_down(x, dy, w1, w2, w3, w4, aff, batch_tile):
+    n, h, w, cin = x.shape
+    cm, cout = w1.shape[1], w3.shape[1]
+    t = batch_tile or default_batch_tile(n, h, w, max(cin, cout),
+                                         rows_target=6272)
+    if n % t:
+        raise ValueError(f"batch_tile={t} does not divide batch {n}")
+    kernel = functools.partial(_bwd_kernel_down, t=t, h=h, w=w, cin=cin,
+                               cm=cm, cout=cout)
+    scratch = [pltpu.VMEM((t, h + 2, w + 2, cm), x.dtype),
+               pltpu.VMEM((t, h // 2 + 2, w // 2 + 2, cm), x.dtype)]
+    tile = lambda hh, ww, c: _vmem_spec((t, hh, ww, c),
+                                        lambda i: (i, 0, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(n // t,),
+        in_specs=[tile(h, w, cin), tile(h // 2, w // 2, cout),
+                  _full_spec(w1.shape), _full_spec(w2.shape),
+                  _full_spec(w3.shape), _full_spec(w4.shape),
+                  _full_spec(aff.shape)],
+        out_specs=[tile(h, w, cin), _full_spec(w1.shape),
+                   _full_spec(w2.shape), _full_spec(w3.shape),
+                   _full_spec(w4.shape), _full_spec(aff.shape)],
+        out_shape=[
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            jax.ShapeDtypeStruct(w1.shape, jnp.float32),
+            jax.ShapeDtypeStruct(w2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(w3.shape, jnp.float32),
+            jax.ShapeDtypeStruct(w4.shape, jnp.float32),
+            jax.ShapeDtypeStruct(aff.shape, jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(x, dy, w1, w2, w3, w4, aff)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(13,))
+def fused_bottleneck_down(x, w1, w2, w3, w4, a1, b1, a2, b2, a3, b3,
+                          a4, b4, batch_tile=None):
+    """Stride-2 transition bottleneck block (conv1 3x3 stride 2 +
+    projection shortcut 1x1 stride 2): [N, H, W, Cin] ->
+    [N, H/2, W/2, Cout], H and W even.  Completes fused coverage of
+    all 16 ResNet-50 blocks."""
+    cout = w3.shape[1]
+    aff = _pack_affines((a1, b1, a2, b2, a3, b3, a4, b4), cout)
+    return _fwd_down(x, w1, w2, w3, w4, aff, batch_tile)
+
+
+def _vjp_fwd_down(x, w1, w2, w3, w4, a1, b1, a2, b2, a3, b3, a4, b4,
+                  batch_tile):
+    cout = w3.shape[1]
+    aff = _pack_affines((a1, b1, a2, b2, a3, b3, a4, b4), cout)
+    y = _fwd_down(x, w1, w2, w3, w4, aff, batch_tile)
+    return y, (x, w1, w2, w3, w4, aff, jnp.zeros((0,), a1.dtype))
+
+
+def _vjp_bwd_down(batch_tile, res, dy):
+    x, w1, w2, w3, w4, aff, atok = res
+    cm = w1.shape[1]
+    dx, dw1, dw2, dw3, dw4, daff = _bwd_down(x, dy, w1, w2, w3, w4, aff,
+                                             batch_tile)
+    cast = lambda g, ref: g.astype(ref.dtype)
+    daff = daff.astype(atok.dtype)
+    return (dx, cast(dw1, w1), cast(dw2, w2), cast(dw3, w3),
+            cast(dw4, w4), daff[0, :cm], daff[1, :cm], daff[2, :cm],
+            daff[3, :cm], daff[4], daff[5], daff[6], daff[7])
+
+
+fused_bottleneck_down.defvjp(_vjp_fwd_down, _vjp_bwd_down)

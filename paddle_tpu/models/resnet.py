@@ -66,12 +66,14 @@ class BottleneckBlock(nn.Layer):
                       ConvBNLayer(in_ch, ch * 4, 1, stride=stride,
                                   data_format=df, dtype=dtype))
         self.relu = nn.ReLU()
-        # the fused Pallas path covers the stride-1 NHWC shapes:
-        # identity shortcut (12 of ResNet-50's 16 blocks) and the
-        # projection shortcut of stage-1 block 0 (the most
-        # traffic-heavy single block); only the 3 stride-2 transition
-        # blocks stay on the per-conv path
-        self._fused = fused and stride == 1 and df == "NHWC"
+        # the fused Pallas path covers ALL of ResNet-50's block shapes
+        # in NHWC: identity shortcut (12 blocks), the stride-1
+        # projection block (stage-1 block 0), and the stride-2
+        # transitions (fused_bottleneck_down)
+        self._stride = stride
+        self._fused = (fused and df == "NHWC"
+                       and (stride == 1
+                            or (stride == 2 and self.short is not None)))
 
     def _bn_affine(self, bn, conv_out):
         """Resolve one BatchNorm to a per-channel (a, b) affine by
@@ -112,7 +114,7 @@ class BottleneckBlock(nn.Layer):
         of the batch; grads through the stats compose via autodiff),
         then the whole block runs as one Pallas kernel."""
         from ..kernels.fused_bottleneck import (
-            fused_bottleneck, fused_bottleneck_proj)
+            fused_bottleneck, fused_bottleneck_down, fused_bottleneck_proj)
 
         w1 = self.conv0.conv.weight.value[:, :, 0, 0].T   # [Cin, Cm]
         w2 = jnp.transpose(self.conv1.conv.weight.value, (2, 3, 1, 0))
@@ -144,6 +146,9 @@ class BottleneckBlock(nn.Layer):
             return fused_bottleneck(x, w1, w2, w3, a1, b1, a2, b2,
                                     a3, b3)
         w4 = self.short.conv.weight.value[:, :, 0, 0].T   # [Cin, Cout]
+        if self._stride == 2:
+            return fused_bottleneck_down(x, w1, w2, w3, w4, a1, b1,
+                                         a2, b2, a3, b3, a4, b4)
         return fused_bottleneck_proj(x, w1, w2, w3, w4, a1, b1, a2, b2,
                                      a3, b3, a4, b4)
 
@@ -154,7 +159,9 @@ class BottleneckBlock(nn.Layer):
         # per-conv path; the fused win requires ghost stats (ss>0) or
         # eval mode
         ss = self.conv0.bn._stats_sample
-        if self._fused and (not self.training or 0 < ss < x.shape[0]):
+        if (self._fused and (not self.training or 0 < ss < x.shape[0])
+                and (self._stride == 1
+                     or (x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0))):
             return self._forward_fused(x)
         y = self.conv2(self.conv1(self.conv0(x)))
         s = x if self.short is None else self.short(x)
@@ -227,9 +234,10 @@ def resnet34(num_classes=1000, data_format="NCHW", dtype="float32",
 
 def resnet50(num_classes=1000, data_format="NCHW", dtype="float32",
              bn_stats_sample=0, fused=False):
-    """fused=True routes the 12 identity bottleneck blocks through the
-    Pallas fused-block kernel (kernels/fused_bottleneck.py) — NHWC
-    only; requires bn_stats_sample>0 (or eval mode) to be a perf win."""
+    """fused=True routes all 16 bottleneck blocks through the Pallas
+    fused-block kernels (kernels/fused_bottleneck.py: identity,
+    projection, stride-2 transition variants) — NHWC only; requires
+    bn_stats_sample>0 (or eval mode) to be a perf win."""
     return set_bn_stats_sample(
         ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes,
                data_format=data_format, dtype=dtype, fused=fused),

@@ -174,6 +174,83 @@ def test_proj_block_matches_unfused():
                                rtol=1e-5, atol=1e-5)
 
 
+def _ref_block_down(x, w1, w2, w3, w4, a1, b1, a2, b2, a3, b3, a4, b4):
+    cm = w1.shape[1]
+    c0 = jnp.einsum("nhwc,cd->nhwd", x, w1,
+                    preferred_element_type=jnp.float32)
+    h0 = jnp.maximum(c0 * a1 + b1, 0).astype(x.dtype)
+    dn = lax.conv_dimension_numbers(h0.shape, (cm, cm, 3, 3),
+                                    ("NHWC", "OIHW", "NHWC"))
+    w2_oihw = jnp.transpose(w2, (3, 2, 0, 1))
+    c1 = lax.conv_general_dilated(
+        h0, w2_oihw, (2, 2), [(1, 1), (1, 1)],
+        dimension_numbers=dn).astype(jnp.float32)
+    h1 = jnp.maximum(c1 * a2 + b2, 0).astype(x.dtype)
+    c2 = jnp.einsum("nhwc,cd->nhwd", h1, w3,
+                    preferred_element_type=jnp.float32)
+    s = jnp.einsum("nhwc,cd->nhwd", x[:, ::2, ::2, :], w4,
+                   preferred_element_type=jnp.float32) * a4 + b4
+    return jnp.maximum(c2 * a3 + b3 + s, 0).astype(x.dtype)
+
+
+def test_down_kernel_forward_and_grads_match_composition():
+    from paddle_tpu.kernels.fused_bottleneck import fused_bottleneck_down
+
+    args = _mk_args_proj()      # H, W even; stride-2 output is H/2, W/2
+    np.testing.assert_allclose(
+        np.asarray(fused_bottleneck_down(*args)),
+        np.asarray(_ref_block_down(*args)), rtol=1e-5, atol=1e-5)
+    g_ref = jax.grad(lambda *a: jnp.sum(_ref_block_down(*a) ** 2),
+                     argnums=tuple(range(13)))(*args)
+    g_fus = jax.grad(lambda *a: jnp.sum(fused_bottleneck_down(*a) ** 2),
+                     argnums=tuple(range(13)))(*args)
+    for name, a, b in zip(
+            "dx dw1 dw2 dw3 dw4 da1 db1 da2 db2 da3 db3 da4 db4".split(),
+            g_ref, g_fus):
+        scale = max(float(jnp.max(jnp.abs(a))), 1.0)
+        np.testing.assert_allclose(np.asarray(b) / scale,
+                                   np.asarray(a) / scale,
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_down_block_matches_unfused():
+    blk = BottleneckBlock(16, 8, stride=2, data_format="NHWC",
+                          dtype="float32", fused=True)
+    assert blk._fused and blk._stride == 2
+    for lyr in blk.sublayers(include_self=True):
+        if isinstance(lyr, nn.BatchNorm):
+            lyr._stats_sample = 4
+    blk.train()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 8, 8, 16)) * 0.5, jnp.float32)
+    y_fused = blk._forward_fused(x)
+    assert y_fused.shape == (8, 4, 4, 32)
+    for lyr in blk.sublayers(include_self=True):
+        if isinstance(lyr, nn.BatchNorm):
+            lyr._buffers["_mean"] = jnp.zeros_like(lyr._buffers["_mean"])
+            lyr._buffers["_variance"] = jnp.ones_like(
+                lyr._buffers["_variance"])
+    blk._fused = False
+    y_ref = blk.forward(x)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_down_block_odd_spatial_falls_back():
+    # odd H/W cannot phase-decompose; forward() must route to the
+    # per-conv path instead of crashing
+    blk = BottleneckBlock(16, 8, stride=2, data_format="NHWC",
+                          dtype="float32", fused=True)
+    for lyr in blk.sublayers(include_self=True):
+        if isinstance(lyr, nn.BatchNorm):
+            lyr._stats_sample = 4
+    blk.train()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((8, 7, 7, 16)), jnp.float32)
+    y = blk.forward(x)
+    assert y.shape == (8, 4, 4, 32)
+
+
 def test_default_batch_tile_divides():
     assert default_batch_tile(128, 56, 56, 256) * 56 * 56 <= 12544
     for n in (128, 96, 8, 7):
@@ -268,9 +345,9 @@ def test_resnet50_fused_train_step_runs():
     model = resnet50(num_classes=10, data_format="NHWC",
                      bn_stats_sample=2, fused=True)
     fused_blocks = [b for b in model.blocks if getattr(b, "_fused", False)]
-    # 12 identity blocks + stage-1 block 0 (projection, stride 1); only
-    # the 3 stride-2 transitions stay unfused
-    assert len(fused_blocks) == 13
+    # all 16: 12 identity + the stride-1 projection block + the 3
+    # stride-2 transitions (fused_bottleneck_down)
+    assert len(fused_blocks) == 16
     opt = Momentum(0.01, 0.9)
     state = init_train_state(model, opt)
     step = make_train_step(
