@@ -70,7 +70,12 @@ def _time_steps(step, state, batch, iters, reps=3):
     each scan one device dispatch (host fetch as the only reliable sync
     under the remote-tunnel backend).  CONSUMES `state` (the carried
     train state is donated so XLA reuses the parameter buffers instead
-    of copying them each scan) — don't reuse it after this returns."""
+    of copying them each scan) — don't reuse it after this returns.
+
+    iters also sets the dispatch-floor dilution: one tunnel round-trip
+    costs tens of ms (r4: resnet step 53.1ms wall at iters=10 vs 45.8ms
+    device-profiled, i.e. ~73ms floor / iters), so TPU configs use
+    iters large enough that floor/iters is ~1ms."""
     import jax
 
     # donating the carried state lets XLA reuse the parameter buffers
@@ -137,7 +142,7 @@ def bench_bert(on_tpu, peak):
     if on_tpu:
         cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=512, dtype="bfloat16")
-        batch, seq, iters = 16, 512, 20
+        batch, seq, iters = 16, 512, 60
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=128, dtype="float32")
@@ -158,7 +163,7 @@ def bench_lenet(on_tpu, peak):
     from paddle_tpu.nn import functional as F
     from paddle_tpu.optimizer.functional import Adam
 
-    batch, iters = (2048, 20) if on_tpu else (128, 3)
+    batch, iters = (2048, 100) if on_tpu else (128, 3)
     model = LeNet()
     opt = Adam(1e-3)
     state = init_train_state(model, opt)
@@ -176,7 +181,7 @@ def bench_lenet(on_tpu, peak):
             "vs_baseline": None, "step_ms": round(dt * 1e3, 2)}
 
 
-def resnet50_time_config(peak, batch=128, remat=False, iters=10,
+def resnet50_time_config(peak, batch=128, remat=False, iters=40,
                          data_format="NHWC", bn_stats_sample=0,
                          fused=False):
     """ONE parameterized ResNet-50 bf16 train-step measurement — shared
@@ -309,7 +314,7 @@ def bench_transformer_flash(on_tpu, peak):
     if on_tpu:
         cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=6,
                         num_heads=16, max_seq_len=2048, dtype="bfloat16")
-        batch, seq, iters = 8, 2048, 10
+        batch, seq, iters = 8, 2048, 30
     else:
         cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
                         num_heads=2, max_seq_len=256, dtype="float32")
@@ -329,7 +334,7 @@ def bench_wide_deep(on_tpu, peak):
     from paddle_tpu.models.wide_deep import WideDeep
     from paddle_tpu.optimizer.functional import Adagrad
 
-    batch, iters = (8192, 20) if on_tpu else (256, 3)
+    batch, iters = (8192, 100) if on_tpu else (256, 3)
     model = WideDeep(sparse_vocab_size=1000000 if on_tpu else 10000)
     opt = Adagrad(0.01)
     state = init_train_state(model, opt)
@@ -359,7 +364,7 @@ def bench_bert_chunked_ce(on_tpu, peak):
     cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
                     num_heads=12, max_seq_len=512, dtype="bfloat16",
                     ce_vocab_chunk=8192)
-    return _bench_gpt_mfu(cfg, 16, 512, 20, "bert_chunked_ce_mfu", peak)
+    return _bench_gpt_mfu(cfg, 16, 512, 60, "bert_chunked_ce_mfu", peak)
 
 
 def bench_flash_tiles(on_tpu, peak):

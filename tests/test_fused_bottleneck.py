@@ -400,7 +400,7 @@ def test_fused_block_under_shard_map_dp():
     matches the unsharded kernel and weight grads psum correctly."""
     import functools
 
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     rng = np.random.default_rng(0)
@@ -420,7 +420,7 @@ def test_fused_block_under_shard_map_dp():
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P("dp"),) + (P(),) * 9, out_specs=P("dp"),
-        check_rep=False)
+        check_vma=False)
     def sharded(x, w1, w2, w3, *affs):
         return fused_bottleneck(x, w1, w2, w3, *affs)
 

@@ -37,6 +37,67 @@ def probe(timeout=120):
 
 
 EXPERIMENTS = {
+    "fused_kernel_smoke": """
+# compile+run each fused-bottleneck kernel variant at every ResNet-50
+# stage geometry individually, so a Mosaic lowering rejection names the
+# exact kernel instead of one aggregated train-step error
+import jax, jax.numpy as jnp, numpy as np, json
+from paddle_tpu.kernels.fused_bottleneck import (
+    fused_bottleneck, fused_bottleneck_down, fused_bottleneck_proj)
+rng = np.random.default_rng(0)
+bf = jnp.bfloat16
+def mk(shape, scale=0.2):
+    return jnp.asarray(rng.standard_normal(shape) * scale, bf)
+results = {}
+GEOMS = [("s1", 56, 64, 256), ("s2", 28, 128, 512),
+         ("s3", 14, 256, 1024), ("s4", 7, 512, 2048)]
+for name, hw, cm, cout in GEOMS:
+    n = 8
+    x = mk((n, hw, hw, cout))
+    args = (x, mk((cout, cm)), mk((3, 3, cm, cm)), mk((cm, cout)),
+            mk((cm,), 1), mk((cm,), 0.1), mk((cm,), 1), mk((cm,), 0.1),
+            mk((cout,), 1), mk((cout,), 0.1))
+    for kind, fn in (("fwd", lambda *a: fused_bottleneck(*a)),
+                     ("bwd", jax.grad(lambda *a: jnp.sum(
+                         fused_bottleneck(*a).astype(jnp.float32)),
+                         argnums=(0, 1)))):
+        key = "id_%s_%s" % (name, kind)
+        try:
+            out = jax.jit(fn)(*args)
+            jax.block_until_ready(out)
+            results[key] = "ok"
+        except Exception as e:
+            results[key] = ("%s: %s" % (type(e).__name__, e))[:300]
+        print("PART " + json.dumps({key: results[key]}), flush=True)
+# proj (stage-1 block 0) and down (stage-2 transition) geometries
+xp = mk((8, 56, 56, 64))
+pargs = (xp, mk((64, 64)), mk((3, 3, 64, 64)), mk((64, 256)),
+         mk((64, 256)), mk((64,), 1), mk((64,), 0.1), mk((64,), 1),
+         mk((64,), 0.1), mk((256,), 1), mk((256,), 0.1), mk((256,), 1),
+         mk((256,), 0.1))
+xd = mk((8, 56, 56, 256))
+dargs = (xd, mk((256, 128)), mk((3, 3, 128, 128)), mk((128, 512)),
+         mk((256, 512)), mk((128,), 1), mk((128,), 0.1), mk((128,), 1),
+         mk((128,), 0.1), mk((512,), 1), mk((512,), 0.1), mk((512,), 1),
+         mk((512,), 0.1))
+for key, fn, a in (
+        ("proj_fwd", lambda *a: fused_bottleneck_proj(*a), pargs),
+        ("proj_bwd", jax.grad(lambda *a: jnp.sum(
+            fused_bottleneck_proj(*a).astype(jnp.float32)),
+            argnums=(0, 1)), pargs),
+        ("down_fwd", lambda *a: fused_bottleneck_down(*a), dargs),
+        ("down_bwd", jax.grad(lambda *a: jnp.sum(
+            fused_bottleneck_down(*a).astype(jnp.float32)),
+            argnums=(0, 1)), dargs)):
+    try:
+        out = jax.jit(fn)(*a)
+        jax.block_until_ready(out)
+        results[key] = "ok"
+    except Exception as e:
+        results[key] = ("%s: %s" % (type(e).__name__, e))[:300]
+    print("PART " + json.dumps({key: results[key]}), flush=True)
+print("RESULT " + json.dumps(results), flush=True)
+""",
     "rpc_floor": """
 # dispatch round-trip floor of the tunnel: how much does one host-sync
 # cost?  Informs the iters choice in bench._time_steps (measured step
@@ -120,7 +181,7 @@ print("RESULT " + json.dumps({
 from bench import resnet50_time_config, _peak_flops
 import jax, json
 peak = _peak_flops(jax.devices()[0])
-r = resnet50_time_config(peak, batch=128, iters=10, bn_stats_sample=16,
+r = resnet50_time_config(peak, batch=128, iters=40, bn_stats_sample=16,
                          fused=True)
 print("RESULT " + json.dumps(r), flush=True)
 """,
@@ -132,7 +193,7 @@ peak = _peak_flops(jax.devices()[0])
 cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=6,
                 num_heads=16, max_seq_len=2048, dtype="bfloat16")
 for batch in (8, 12, 16):
-    r = _bench_gpt_mfu(cfg, batch, 2048, 10, "transformer_flash_b%d" % batch,
+    r = _bench_gpt_mfu(cfg, batch, 2048, 30, "transformer_flash_b%d" % batch,
                        peak)
     print("RESULT " + json.dumps(r), flush=True)
 """,
@@ -148,10 +209,20 @@ def run_experiment(name, code, timeout):
         for line in r.stdout.splitlines():
             if line.startswith("RESULT "):
                 log({"experiment": name, "result": json.loads(line[7:])})
+            elif line.startswith("PART "):
+                log({"experiment": name, "part": json.loads(line[5:])})
         if r.returncode != 0:
             log({"experiment": name, "rc": r.returncode,
                  "stderr": r.stderr[-1500:]})
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # keep the PART lines already printed — for a hung Mosaic
+        # compile they say exactly which kernels survived
+        out = (e.stdout or b"")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        for line in out.splitlines():
+            if line.startswith("PART "):
+                log({"experiment": name, "part": json.loads(line[5:])})
         log({"experiment": name, "error": "timeout %ds" % timeout})
 
 
@@ -163,6 +234,8 @@ def main():
         if probe():
             log({"tunnel": "up"})
             run_experiment("rpc_floor", EXPERIMENTS["rpc_floor"], 600)
+            run_experiment("fused_kernel_smoke",
+                           EXPERIMENTS["fused_kernel_smoke"], 1800)
             run_experiment("resnet_fused",
                            EXPERIMENTS["resnet_fused"], 1800)
             run_experiment("transformer_profile",

@@ -22,7 +22,7 @@ if REPO not in sys.path:
 
 
 
-def time_config(batch, remat, iters=10, stats_sample=0, fused=False):
+def time_config(batch, remat, iters=40, stats_sample=0, fused=False):
     import jax
 
     from bench import _peak_flops, resnet50_time_config
