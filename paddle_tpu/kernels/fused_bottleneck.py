@@ -625,3 +625,174 @@ def _vjp_bwd_down(batch_tile, res, dy):
 
 
 fused_bottleneck_down.defvjp(_vjp_fwd_down, _vjp_bwd_down)
+
+
+# ---------------------------------------------------------------------------
+# stem tail: BN affine + relu + 3x3 stride-2 maxpool (pad 1)
+# ---------------------------------------------------------------------------
+#
+# The ResNet stem's elementwise tail is pure HBM traffic on the XLA
+# path (BN-affine fusion + pool fwd + a select-and-scatter backward,
+# ~2ms of the on-chip step): this kernel does relu(c*a+b) and the
+# stride-2 maxpool in one VMEM residency, and the backward recomputes
+# on-tile and routes pool gradients by VALUE EQUALITY against the
+# pooled max.  Equality routing differs from select-and-scatter only
+# on exact ties: ties at 0 (the common case — relu floors) are killed
+# by the relu mask in the same backward, and positive float ties are
+# measure-zero for real activations (each tied element receives the
+# full window gradient rather than first-wins).
+
+
+def _pool_taps(hp6, ho, wo):
+    acc = None
+    for dy in range(3):
+        for dx in range(3):
+            sl = _tap2(hp6, dy, dx, ho, wo)
+            acc = sl if acc is None else jnp.maximum(acc, sl)
+    return acc
+
+
+def _stem_fwd_kernel(c_ref, aff_ref, o_ref, hp_ref, *, t, h, w, cm):
+    dt = c_ref.dtype
+    ho, wo = h // 2, w // 2
+    a, b = aff_ref[0], aff_ref[1]
+    c = c_ref[...].reshape(t * h * w, cm)
+    hh = jnp.maximum(c.astype(jnp.float32) * a + b, 0.0).astype(dt)
+    # h >= 0 so 0-padding can never win a max over a window that
+    # contains at least one real element (every window does)
+    hp_ref[...] = jnp.zeros(hp_ref.shape, hp_ref.dtype)
+    hp_ref[:, 1:h + 1, 1:w + 1, :] = hh.reshape(t, h, w, cm)
+    hp6 = hp_ref[...].reshape(t, (h + 2) // 2, 2, (w + 2) // 2, 2, cm)
+    o_ref[...] = _pool_taps(hp6, ho, wo)
+
+
+def _stem_bwd_kernel(c_ref, dy_ref, aff_ref, dc_ref, daff_ref, hp_ref,
+                     yp_ref, dyp_ref, *, t, h, w, cm):
+    dt = c_ref.dtype
+    ho, wo = h // 2, w // 2
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        daff_ref[...] = jnp.zeros_like(daff_ref)
+
+    a, b = aff_ref[0], aff_ref[1]
+    c = c_ref[...].reshape(t * h * w, cm)
+    cf = c.astype(jnp.float32)
+    u = cf * a + b
+    hh = jnp.maximum(u, 0.0).astype(dt)
+    hp_ref[...] = jnp.zeros(hp_ref.shape, hp_ref.dtype)
+    hp_ref[:, 1:h + 1, 1:w + 1, :] = hh.reshape(t, h, w, cm)
+    hp6 = hp_ref[...].reshape(t, (h + 2) // 2, 2, (w + 2) // 2, 2, cm)
+    y = _pool_taps(hp6, ho, wo)                          # [T,Ho,Wo,Cm]
+
+    # padded y and dy: a window out of range contributes dy = 0, so the
+    # pad value of yp is irrelevant
+    yp_ref[...] = jnp.zeros(yp_ref.shape, yp_ref.dtype)
+    yp_ref[:, 1:ho + 1, 1:wo + 1, :] = y
+    dyp_ref[...] = jnp.zeros(dyp_ref.shape, dyp_ref.dtype)
+    dyp_ref[:, 1:ho + 1, 1:wo + 1, :] = dy_ref[...]
+
+    # dh phase (pr, pc): windows (dy, dx) with dy ≡ pr+1, dx ≡ pc+1
+    # (mod 2) cover that phase; padded-window offset 1 + (pr+1-dy)//2
+    h6 = hh.reshape(t, ho, 2, wo, 2, cm)
+    phases = []
+    for pr in (0, 1):
+        row = []
+        for pc in (0, 1):
+            h_ph = h6[:, :, pr, :, pc, :]
+            acc = jnp.zeros((t, ho, wo, cm), jnp.float32)
+            for dy_ in range(3):
+                if (dy_ % 2) != (pr + 1) % 2:
+                    continue
+                for dx_ in range(3):
+                    if (dx_ % 2) != (pc + 1) % 2:
+                        continue
+                    ro = 1 + (pr + 1 - dy_) // 2
+                    co = 1 + (pc + 1 - dx_) // 2
+                    ysl = yp_ref[:, ro:ro + ho, co:co + wo, :]
+                    dsl = dyp_ref[:, ro:ro + ho, co:co + wo, :]
+                    acc = acc + jnp.where(h_ph == ysl,
+                                          dsl.astype(jnp.float32), 0.0)
+            row.append(acc)
+        phases.append(row)
+    dh = jnp.stack(
+        [jnp.stack([phases[0][0], phases[0][1]], axis=3),
+         jnp.stack([phases[1][0], phases[1][1]], axis=3)],
+        axis=2).reshape(t * h * w, cm)
+    du = jnp.where(u > 0.0, dh, 0.0)
+    daff_ref[0] += jnp.sum(du * cf, axis=0)
+    daff_ref[1] += jnp.sum(du, axis=0)
+    dc_ref[...] = (du * a).astype(dt).reshape(t, h, w, cm)
+
+
+def _stem_fwd(c, aff, batch_tile):
+    n, h, w, cm = c.shape
+    t = batch_tile or default_batch_tile(n, h, w, cm)
+    if n % t:
+        raise ValueError(f"batch_tile={t} does not divide batch {n}")
+    kernel = functools.partial(_stem_fwd_kernel, t=t, h=h, w=w, cm=cm)
+    tile = _vmem_spec((t, h, w, cm), lambda i: (i, 0, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(n // t,),
+        in_specs=[tile, _full_spec(aff.shape)],
+        out_specs=_vmem_spec((t, h // 2, w // 2, cm),
+                             lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h // 2, w // 2, cm), c.dtype),
+        scratch_shapes=[pltpu.VMEM((t, h + 2, w + 2, cm), c.dtype)],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(c, aff)
+
+
+def _stem_bwd(c, dy, aff, batch_tile):
+    n, h, w, cm = c.shape
+    t = batch_tile or default_batch_tile(n, h, w, cm, rows_target=6272)
+    if n % t:
+        raise ValueError(f"batch_tile={t} does not divide batch {n}")
+    kernel = functools.partial(_stem_bwd_kernel, t=t, h=h, w=w, cm=cm)
+    tile = _vmem_spec((t, h, w, cm), lambda i: (i, 0, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(n // t,),
+        in_specs=[tile,
+                  _vmem_spec((t, h // 2, w // 2, cm),
+                             lambda i: (i, 0, 0, 0)),
+                  _full_spec(aff.shape)],
+        out_specs=[tile, _full_spec(aff.shape)],
+        out_shape=[jax.ShapeDtypeStruct(c.shape, c.dtype),
+                   jax.ShapeDtypeStruct(aff.shape, jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((t, h + 2, w + 2, cm), c.dtype),
+            pltpu.VMEM((t, h // 2 + 2, w // 2 + 2, cm), c.dtype),
+            pltpu.VMEM((t, h // 2 + 2, w // 2 + 2, cm), dy.dtype),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(c, dy, aff)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_stem_tail(c, a, b, batch_tile=None):
+    """relu(c*a + b) -> 3x3 stride-2 maxpool (pad 1): the BN-affine +
+    relu + pool tail of the ResNet stem in one HBM round-trip.
+    c: [N, H, W, Cm] conv output (H, W even); a/b: per-channel affine."""
+    aff = jnp.stack([a.astype(jnp.float32), b.astype(jnp.float32)])
+    return _stem_fwd(c, aff, batch_tile)
+
+
+def _stem_vjp_fwd(c, a, b, batch_tile):
+    aff = jnp.stack([a.astype(jnp.float32), b.astype(jnp.float32)])
+    y = _stem_fwd(c, aff, batch_tile)
+    return y, (c, aff, jnp.zeros((0,), a.dtype))
+
+
+def _stem_vjp_bwd(batch_tile, res, dy):
+    c, aff, atok = res
+    dc, daff = _stem_bwd(c, dy, aff, batch_tile)
+    daff = daff.astype(atok.dtype)
+    return dc, daff[0], daff[1]
+
+
+fused_stem_tail.defvjp(_stem_vjp_fwd, _stem_vjp_bwd)

@@ -50,6 +50,37 @@ class BasicBlock(nn.Layer):
         return self.relu(y + s)
 
 
+def _bn_affine(bn, conv_out, training):
+    """Resolve one BatchNorm to a per-channel (a, b) affine by running
+    the REGISTERED batch_norm kernel on the (already ghost-sliced) conv
+    output — one implementation of the stats semantics (two-pass f32,
+    momentum running-stat update), shared with the unfused path; the
+    kernel's Y output is dead code that XLA DCEs.  Returned (a, b) are
+    cast to the activation dtype so the fused kernels apply
+    bit-compatible affines to the unfused ConvBN path."""
+    from ..ops import nn_ops
+
+    eps = bn._epsilon
+    if training:
+        out = nn_ops.batch_norm(
+            {"X": conv_out, "Scale": bn.weight.value,
+             "Bias": bn.bias.value, "Mean": bn._buffers["_mean"],
+             "Variance": bn._buffers["_variance"]},
+            {"momentum": bn._momentum, "epsilon": eps,
+             "is_test": False, "data_layout": "NHWC"})
+        bn._buffers["_mean"] = out["MeanOut"]
+        bn._buffers["_variance"] = out["VarianceOut"]
+        mean, inv = out["SavedMean"], out["SavedVariance"]
+    else:
+        mean = bn._buffers["_mean"]
+        inv = 1.0 / jnp.sqrt(bn._buffers["_variance"] + eps)
+    a = inv * bn.weight.value.astype(jnp.float32)
+    b = bn.bias.value.astype(jnp.float32) - mean * a
+    dt = (conv_out.dtype if conv_out is not None
+          else bn.weight.value.dtype)
+    return a.astype(dt), b.astype(dt)
+
+
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
@@ -76,37 +107,7 @@ class BottleneckBlock(nn.Layer):
                             or (stride == 2 and self.short is not None)))
 
     def _bn_affine(self, bn, conv_out):
-        """Resolve one BatchNorm to a per-channel (a, b) affine by
-        running the REGISTERED batch_norm kernel on the (already
-        ghost-sliced) conv output — one implementation of the stats
-        semantics (two-pass f32, momentum running-stat update), shared
-        with the unfused path; the kernel's Y output is dead code that
-        XLA DCEs.  Returned (a, b) are cast to the activation dtype so
-        the fused block applies bit-compatible affines to the unfused
-        ConvBN path."""
-        import jax.numpy as jnp_
-
-        from ..ops import nn_ops
-
-        eps = bn._epsilon
-        if self.training:
-            out = nn_ops.batch_norm(
-                {"X": conv_out, "Scale": bn.weight.value,
-                 "Bias": bn.bias.value, "Mean": bn._buffers["_mean"],
-                 "Variance": bn._buffers["_variance"]},
-                {"momentum": bn._momentum, "epsilon": eps,
-                 "is_test": False, "data_layout": "NHWC"})
-            bn._buffers["_mean"] = out["MeanOut"]
-            bn._buffers["_variance"] = out["VarianceOut"]
-            mean, inv = out["SavedMean"], out["SavedVariance"]
-        else:
-            mean = bn._buffers["_mean"]
-            inv = 1.0 / jnp_.sqrt(bn._buffers["_variance"] + eps)
-        a = inv * bn.weight.value.astype(jnp_.float32)
-        b = bn.bias.value.astype(jnp_.float32) - mean * a
-        dt = (conv_out.dtype if conv_out is not None
-              else bn.weight.value.dtype)
-        return a.astype(dt), b.astype(dt)
+        return _bn_affine(bn, conv_out, self.training)
 
     def _forward_fused(self, x):
         """One-HBM-round-trip block: ghost-batch BN stats resolved on a
@@ -196,11 +197,30 @@ class ResNet(nn.Layer):
         self.global_pool = nn.Pool2D(pool_type="avg", global_pooling=True,
                                      data_format=data_format)
         self.fc = nn.Linear(prev, num_classes, dtype=dtype)
+        # fused stem tail (BN affine + relu + s2 maxpool as one Pallas
+        # kernel); the 7x7 conv itself stays on XLA — its K=3-channel
+        # matmul shape is XLA's to tile, the tail is pure traffic
+        self._fused_stem = fused and data_format == "NHWC"
+
+    def _stem_pool(self, x):
+        ss = self.stem.bn._stats_sample
+        c = self.stem.conv(x)
+        if (self._fused_stem
+                and (not self.training or 0 < ss < x.shape[0])
+                and c.shape[1] % 2 == 0 and c.shape[2] % 2 == 0):
+            from ..kernels.fused_bottleneck import fused_stem_tail
+
+            cs = (c if not (self.training and 0 < ss < c.shape[0])
+                  else c[:ss])
+            a, b = _bn_affine(self.stem.bn, cs if self.training else None,
+                              self.training)
+            return fused_stem_tail(c, a, b)
+        return self.pool(self.stem.bn(c))
 
     def forward(self, x):
         if self._data_format == "NHWC":
             x = jnp.transpose(x, (0, 2, 3, 1))   # NCHW API -> NHWC core
-        x = self.pool(self.stem(x))
+        x = self._stem_pool(x)
         for b in self.blocks:
             x = b(x)
         x = self.global_pool(x)

@@ -440,3 +440,49 @@ def test_fused_block_under_shard_map_dp():
         np.testing.assert_allclose(np.asarray(a) / scale,
                                    np.asarray(b) / scale,
                                    rtol=1e-3, atol=1e-4)
+
+
+def test_stem_tail_matches_composition():
+    from paddle_tpu.kernels.fused_bottleneck import fused_stem_tail
+
+    rng = np.random.default_rng(0)
+    c = jnp.asarray(rng.standard_normal((4, 8, 8, 16)), jnp.float32)
+    a = jnp.asarray(rng.standard_normal(16) * 0.3 + 1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(16) * 0.1, jnp.float32)
+
+    def ref(c, a, b):
+        h = jnp.maximum(c.astype(jnp.float32) * a + b, 0).astype(c.dtype)
+        return lax.reduce_window(
+            h, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            [(0, 0), (1, 1), (1, 1), (0, 0)]).astype(c.dtype)
+
+    np.testing.assert_allclose(np.asarray(fused_stem_tail(c, a, b)),
+                               np.asarray(ref(c, a, b)),
+                               rtol=1e-6, atol=1e-6)
+    g_ref = jax.grad(lambda *x: jnp.sum(ref(*x) ** 2),
+                     argnums=(0, 1, 2))(c, a, b)
+    g_fus = jax.grad(lambda *x: jnp.sum(fused_stem_tail(*x) ** 2),
+                     argnums=(0, 1, 2))(c, a, b)
+    for name, x, y in zip(("dc", "da", "db"), g_ref, g_fus):
+        scale = max(float(jnp.max(jnp.abs(x))), 1.0)
+        np.testing.assert_allclose(np.asarray(y) / scale,
+                                   np.asarray(x) / scale,
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_stem_pool_fused_matches_unfused_in_model():
+    m = resnet50(num_classes=4, data_format="NHWC", bn_stats_sample=4,
+                 fused=True)
+    m.train()
+    rng = np.random.default_rng(0)
+    xx = jnp.asarray(rng.standard_normal((8, 64, 64, 3)), jnp.float32)
+    y_fused = m._stem_pool(xx)
+    for lyr in m.stem.sublayers(include_self=True):
+        if isinstance(lyr, nn.BatchNorm):
+            lyr._buffers["_mean"] = jnp.zeros_like(lyr._buffers["_mean"])
+            lyr._buffers["_variance"] = jnp.ones_like(
+                lyr._buffers["_variance"])
+    m._fused_stem = False
+    y_ref = m._stem_pool(xx)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)

@@ -96,6 +96,21 @@ for key, fn, a in (
     except Exception as e:
         results[key] = ("%s: %s" % (type(e).__name__, e))[:300]
     print("PART " + json.dumps({key: results[key]}), flush=True)
+# stem tail at the real stem geometry
+from paddle_tpu.kernels.fused_bottleneck import fused_stem_tail
+cs = mk((8, 112, 112, 64))
+sa = (cs, mk((64,), 1), mk((64,), 0.1))
+for key, fn in (("stem_fwd", lambda *a: fused_stem_tail(*a)),
+                ("stem_bwd", jax.grad(lambda *a: jnp.sum(
+                    fused_stem_tail(*a).astype(jnp.float32)),
+                    argnums=(0, 1, 2)))):
+    try:
+        out = jax.jit(fn)(*sa)
+        jax.block_until_ready(out)
+        results[key] = "ok"
+    except Exception as e:
+        results[key] = ("%s: %s" % (type(e).__name__, e))[:300]
+    print("PART " + json.dumps({key: results[key]}), flush=True)
 print("RESULT " + json.dumps(results), flush=True)
 """,
     "rpc_floor": """
