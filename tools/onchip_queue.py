@@ -200,6 +200,17 @@ r = resnet50_time_config(peak, batch=128, iters=40, bn_stats_sample=16,
                          fused=True)
 print("RESULT " + json.dumps(r), flush=True)
 """,
+    "bert_batch_sweep": """
+from bench import _bench_gpt_mfu, _peak_flops
+from paddle_tpu.models.gpt import GPTConfig
+import jax, json
+peak = _peak_flops(jax.devices()[0])
+cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                num_heads=12, max_seq_len=512, dtype="bfloat16")
+for batch in (24, 32, 48):
+    r = _bench_gpt_mfu(cfg, batch, 512, 60, "bert_b%d" % batch, peak)
+    print("RESULT " + json.dumps(r), flush=True)
+""",
     "transformer_batch_sweep": """
 from bench import _bench_gpt_mfu, _peak_flops
 from paddle_tpu.models.gpt import GPTConfig
@@ -257,6 +268,8 @@ def main():
                            EXPERIMENTS["transformer_profile"], 1200)
             run_experiment("transformer_batch_sweep",
                            EXPERIMENTS["transformer_batch_sweep"], 1500)
+            run_experiment("bert_batch_sweep",
+                           EXPERIMENTS["bert_batch_sweep"], 1500)
             run_experiment("flash_chained",
                            EXPERIMENTS["flash_chained"], 1200)
             log({"queue": "done"})
