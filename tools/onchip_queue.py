@@ -211,6 +211,19 @@ for batch in (24, 32, 48):
     r = _bench_gpt_mfu(cfg, batch, 512, 60, "bert_b%d" % batch, peak)
     print("RESULT " + json.dumps(r), flush=True)
 """,
+    "bert_pallas_ln": """
+# A/B: Pallas fused LayerNorm vs XLA LN on the headline BERT config
+from bench import _bench_gpt_mfu, _peak_flops
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu import flags
+import jax, json
+peak = _peak_flops(jax.devices()[0])
+cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                num_heads=12, max_seq_len=512, dtype="bfloat16")
+flags.set_flags({"FLAGS_use_pallas_layer_norm": True})
+r = _bench_gpt_mfu(cfg, 16, 512, 60, "bert_pallas_ln", peak)
+print("RESULT " + json.dumps(r), flush=True)
+""",
     "transformer_batch_sweep": """
 from bench import _bench_gpt_mfu, _peak_flops
 from paddle_tpu.models.gpt import GPTConfig
@@ -270,6 +283,8 @@ def main():
                            EXPERIMENTS["transformer_batch_sweep"], 1500)
             run_experiment("bert_batch_sweep",
                            EXPERIMENTS["bert_batch_sweep"], 1500)
+            run_experiment("bert_pallas_ln",
+                           EXPERIMENTS["bert_pallas_ln"], 900)
             run_experiment("flash_chained",
                            EXPERIMENTS["flash_chained"], 1200)
             log({"queue": "done"})
