@@ -234,37 +234,43 @@ def test_make_train_step_remat_matches_plain():
     model = resnet18(num_classes=10)
     opt = Momentum(0.1, 0.9)
     outs = {}
-    for remat in (False, True, "conv_outs"):
+    for remat in (False, True):
         state = init_train_state(model, opt, rng_seed=0)
         step = make_train_step(model, opt, loss_fn=loss_fn, remat=remat,
                                donate=False)
         new_state, loss = step(state, x, y)
         outs[remat] = (float(loss), new_state)
-    # recompute reassociates float reductions (BN), so relative not exact.
-    # At batch 2 / random init this net's grads reach |g|~5e3 (BN-stat
-    # backward is ill-conditioned), so the conv_outs partial-recompute
-    # policy — whose fusions genuinely reorder — is compared by
-    # update-vector cosine + scale-relative magnitude (verified exact to
-    # ~2e-11 relative under x64; the f32 spread is pure reassociation).
-    for mode in (True, "conv_outs"):
-        rel = abs(outs[False][0] - outs[mode][0]) / abs(outs[False][0])
-        assert rel < 1e-3, mode
-        pa = jax.tree_util.tree_leaves(outs[False][1].params)
-        pb = jax.tree_util.tree_leaves(outs[mode][1].params)
-        if mode is True:
-            deltas = [float(jnp.max(jnp.abs(a - b))) for a, b in zip(pa, pb)]
-            assert max(deltas) < 5e-3, mode
-        else:
-            p0 = jax.tree_util.tree_leaves(state.params)
-            ua = jnp.concatenate([(a - o).reshape(-1)
-                                  for a, o in zip(pa, p0)])
-            ub = jnp.concatenate([(b - o).reshape(-1)
-                                  for b, o in zip(pb, p0)])
-            cos = float(jnp.vdot(ua, ub)
-                        / (jnp.linalg.norm(ua) * jnp.linalg.norm(ub)))
-            assert cos > 0.99, (mode, cos)
-            assert float(jnp.linalg.norm(ua - ub)
-                         / jnp.linalg.norm(ua)) < 0.15, mode
+    # recompute reassociates float reductions (BN), so relative not exact
+    rel = abs(outs[False][0] - outs[True][0]) / abs(outs[False][0])
+    assert rel < 1e-3
+    pa = jax.tree_util.tree_leaves(outs[False][1].params)
+    pb = jax.tree_util.tree_leaves(outs[True][1].params)
+    deltas = [float(jnp.max(jnp.abs(a - b))) for a, b in zip(pa, pb)]
+    assert max(deltas) < 5e-3
+
+    # conv_outs: the partial-recompute policy's fusions genuinely
+    # reorder f32 reductions, and this net's batch-2 BN backward is so
+    # ill-conditioned (|g|~5e3 at random init) that an f32 comparison
+    # is chaotic and suite-order dependent.  Compare gradients under
+    # x64, where the policy is exact to ~1e-11 relative.
+    with jax.enable_x64():
+        model64 = resnet18(num_classes=10, dtype='float64')
+        x64 = jnp.asarray(np.asarray(x), jnp.float64)
+        stepped = {}
+        for mode in (False, "conv_outs"):
+            st = init_train_state(model64, opt, rng_seed=0)
+            step64 = make_train_step(model64, opt, loss_fn=loss_fn,
+                                     remat=mode, donate=False)
+            stepped[mode], _ = step64(st, x64, y)
+        for a, b in zip(
+                jax.tree_util.tree_leaves(stepped[False].params),
+                jax.tree_util.tree_leaves(stepped["conv_outs"].params)):
+            scale = max(float(jnp.max(jnp.abs(a))), 1.0)
+            # f64 reassociation noise on |g|~5e3 grads lands ~1e-8 in
+            # the updated params; anything structural is >1e-3
+            np.testing.assert_allclose(np.asarray(b) / scale,
+                                       np.asarray(a) / scale,
+                                       rtol=1e-6, atol=1e-6)
     import pytest
 
     with pytest.raises(ValueError):
