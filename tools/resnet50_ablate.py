@@ -33,9 +33,9 @@ from paddle_tpu.optimizer.functional import Momentum
 PEAK = 197e12  # v5e bf16
 
 
-def build(batch=128, ss=0, bn_global=False, remat=False):
+def build(batch=128, ss=0, bn_global=False, remat=False, fused=False):
     model = resnet50(dtype="bfloat16", data_format="NHWC",
-                     bn_stats_sample=ss)
+                     bn_stats_sample=ss, fused=fused)
     if bn_global:
         # affine-only BN: running stats, no batch-stats reductions
         def fwd(self, x):
@@ -109,11 +109,11 @@ def main():
 
     for name, kw, fwdonly in [
         ("train_ss16", dict(ss=16), False),
-        ("train_fullbn", dict(ss=0), False),
+        ("train_ss16_fused", dict(ss=16, fused=True), False),
+        ("fwd_ss16_fused", dict(ss=16, fused=True), True),
         ("train_bnglobal", dict(bn_global=True), False),
         ("fwd_fullbn", dict(ss=0), True),
         ("fwd_bnglobal", dict(bn_global=True), True),
-        ("train_ss16_b256", dict(ss=16), False),
     ]:
         b = 256 if name.endswith("b256") else 128
         model, state, step, loss_fn, batch = build(batch=b, **kw)
