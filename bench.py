@@ -253,14 +253,36 @@ def bench_resnet50(on_tpu, peak):
               .lower() in ("1", "true", "yes") else 16)
         r = resnet50_time_config(peak, batch=128, data_format=fmt,
                                  bn_stats_sample=ss)
-        mfu = r["mfu"]
+        # once a capture has PROVEN the fused kernels on chip (the
+        # resnet_fused side config, which runs last, wrote a clean row),
+        # later headline captures measure both paths and report the
+        # faster one — without ever risking the headline on an unproven
+        # Mosaic compile
+        best, fused_note = r, None
+        doc = _load_bench_tpu() or {}
+        prior = (doc.get("rows", {}).get("resnet_fused") or {})
+        if fmt == "NHWC" and ss and prior.get("value"):
+            try:
+                rf = resnet50_time_config(peak, batch=128,
+                                          data_format=fmt,
+                                          bn_stats_sample=ss, fused=True)
+                if rf["mfu"] > best["mfu"]:
+                    best, fused_note = rf, round(r["mfu"], 4)
+            except Exception as e:  # noqa: BLE001
+                fused_note = f"fused attempt failed: {e}"[:120]
+        mfu = best["mfu"]
         out = {"metric": "resnet50_train_mfu", "value": mfu,
                "unit": "mfu_frac",
                "vs_baseline": round(mfu / MFU_TARGET, 4),
-               "samples_per_sec": r["samples_per_sec"],
-               "step_ms": r["step_ms"]}
+               "samples_per_sec": best["samples_per_sec"],
+               "step_ms": best["step_ms"]}
         if ss:
             out["bn_stats_sample"] = ss
+        if best.get("fused"):
+            out["fused"] = True
+            out["unfused_mfu"] = fused_note
+        elif isinstance(fused_note, str):
+            out["fused_note"] = fused_note
         return out
 
     model = resnet18(num_classes=10, dtype="float32")
