@@ -612,12 +612,37 @@ def main():
                ("flash_tile_ab", bench_flash_tiles),
                ("bert_chunked_ce", bench_bert_chunked_ce),
                ("resnet_fused", bench_resnet50_fused)]
+    import signal
+
+    class _ConfigTimeout(Exception):
+        pass
+
+    def _alarm(signum, frame):
+        raise _ConfigTimeout()
+
     for key, fn in benches:
+        # per-config watchdog: a hung first-time Mosaic compile (or a
+        # tunnel death mid-config) must convert to an error row so the
+        # suite still completes and the HEADLINE line still prints —
+        # the driver records the LAST printed line
+        budget = 1500 if on_tpu else 0
+        old = None
         try:
+            if budget:
+                old = signal.signal(signal.SIGALRM, _alarm)
+                signal.alarm(budget)
             r = record(key, fn(on_tpu, peak))
+        except _ConfigTimeout:
+            r = {"metric": key, "error": f"config timeout {budget}s",
+                 "device": device}
         except Exception as e:  # a failed side config must not kill the
             r = {"metric": key, "error": f"{type(e).__name__}: {e}"[:200],
                  "device": device}
+        finally:
+            if budget:
+                signal.alarm(0)
+                if old is not None:
+                    signal.signal(signal.SIGALRM, old)
         suite[key] = r
         print(json.dumps(r), flush=True)
 
