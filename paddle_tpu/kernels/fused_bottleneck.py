@@ -62,7 +62,13 @@ def _vmem_spec(*args):
 
 
 def _compiler_params():
-    return pltpu.CompilerParams(dimension_semantics=("arbitrary",))
+    # vmem_limit_bytes raises Mosaic's default 16MB scoped-VMEM budget:
+    # at the ResNet-50 stage geometries the kernels' live f32
+    # intermediates measure 16.0-28.3MB of scoped allocation (v5e,
+    # jax 0.9 — see FUSED_PROBE.log), well under the chip's 128MB VMEM
+    # but over the default compiler cap.
+    return pltpu.CompilerParams(dimension_semantics=("arbitrary",),
+                                vmem_limit_bytes=100 * 1024 * 1024)
 
 
 def _full_spec(shape):
@@ -773,11 +779,49 @@ def _stem_bwd(c, dy, aff, batch_tile):
     )(c, dy, aff)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+# Longest spatial side the stem kernel is PROVEN to compile at on v5e
+# (jax 0.9): 112 (the ResNet-50 stem geometry), once _compiler_params
+# raises Mosaic's default 16MB scoped-VMEM cap — under the default cap
+# the phase-deinterleave reshape's scratch overflows (FUSED_PROBE.log).
+# The scoped cost scales with the LANE-PADDED plane, so the guard keys
+# on max(h, w); anything beyond the proven side dispatches to the XLA
+# composition rather than gambling on an unproven Mosaic compile.
+_STEM_SIDE_LIMIT = 112
+
+
+def _stem_tail_xla(c, a, b):
+    """XLA fallback with kernel-identical semantics: relu(c*a+b) ->
+    3x3 stride-2 maxpool, pad 1."""
+    hh = jnp.maximum(c.astype(jnp.float32) * a + b, 0.0).astype(c.dtype)
+    return jax.lax.reduce_window(
+        hh, jnp.asarray(-jnp.inf, hh.dtype), jax.lax.max,
+        (1, 3, 3, 1), (1, 2, 2, 1),
+        ((0, 0), (1, 1), (1, 1), (0, 0)))
+
+
 def fused_stem_tail(c, a, b, batch_tile=None):
     """relu(c*a + b) -> 3x3 stride-2 maxpool (pad 1): the BN-affine +
     relu + pool tail of the ResNet stem in one HBM round-trip.
-    c: [N, H, W, Cm] conv output (H, W even); a/b: per-channel affine."""
+    c: [N, H, W, Cm] conv output (H, W even); a/b: per-channel affine.
+
+    Above _STEM_SIDE_LIMIT the Pallas kernel is unproven (Mosaic
+    scoped-vmem cost scales with the plane) and this dispatches to
+    the XLA composition — the stem tail is ~1% of the ResNet-50 step's
+    HBM traffic, so the fused win there was never material; the guard
+    keeps the API total while the bottleneck kernels carry the perf.
+    The dispatch lives OUTSIDE the custom_vjp: a guard inside the
+    primal would be bypassed by the custom VJP rules under grad, and
+    the XLA branch wants native autodiff anyway."""
+    # keyed on the longer spatial side, not the h*w product: the OOM
+    # scales with the lane-padded plane, so a tall-narrow [112, 28]
+    # plane is as bad as [112, 112] (review catch)
+    if max(c.shape[1], c.shape[2]) > _STEM_SIDE_LIMIT:
+        return _stem_tail_xla(c, a, b)
+    return _stem_tail_pallas(c, a, b, batch_tile)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _stem_tail_pallas(c, a, b, batch_tile=None):
     aff = jnp.stack([a.astype(jnp.float32), b.astype(jnp.float32)])
     return _stem_fwd(c, aff, batch_tile)
 
@@ -795,4 +839,4 @@ def _stem_vjp_bwd(batch_tile, res, dy):
     return dc, daff[0], daff[1]
 
 
-fused_stem_tail.defvjp(_stem_vjp_fwd, _stem_vjp_bwd)
+_stem_tail_pallas.defvjp(_stem_vjp_fwd, _stem_vjp_bwd)

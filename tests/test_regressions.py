@@ -240,37 +240,39 @@ def test_make_train_step_remat_matches_plain():
                                donate=False)
         new_state, loss = step(state, x, y)
         outs[remat] = (float(loss), new_state)
-    # recompute reassociates float reductions (BN), so relative not exact
+    # f32 on this net is near-chaotic (batch-2 BN backward, |g|~5e3 at
+    # random init): recompute's reduction reassociation alone has been
+    # measured pushing the loss delta past 1e-3 rel depending on host /
+    # suite order.  Keep only a coarse sanity bound here; the REAL
+    # remat-matches-plain check runs under x64 below, where the
+    # recompute is exact to ~1e-11 relative.
     rel = abs(outs[False][0] - outs[True][0]) / abs(outs[False][0])
-    assert rel < 1e-3
+    assert rel < 3e-2
     pa = jax.tree_util.tree_leaves(outs[False][1].params)
     pb = jax.tree_util.tree_leaves(outs[True][1].params)
     deltas = [float(jnp.max(jnp.abs(a - b))) for a, b in zip(pa, pb)]
-    assert max(deltas) < 5e-3
+    assert max(deltas) < 5e-2
 
-    # conv_outs: the partial-recompute policy's fusions genuinely
-    # reorder f32 reductions, and this net's batch-2 BN backward is so
-    # ill-conditioned (|g|~5e3 at random init) that an f32 comparison
-    # is chaotic and suite-order dependent.  Compare gradients under
-    # x64, where the policy is exact to ~1e-11 relative.
+    # Deterministic comparison for BOTH remat modes under x64, where
+    # reduction reassociation lands ~1e-8 in the updated params and
+    # anything structural is >1e-3.
     with jax.enable_x64():
         model64 = resnet18(num_classes=10, dtype='float64')
         x64 = jnp.asarray(np.asarray(x), jnp.float64)
         stepped = {}
-        for mode in (False, "conv_outs"):
+        for mode in (False, True, "conv_outs"):
             st = init_train_state(model64, opt, rng_seed=0)
             step64 = make_train_step(model64, opt, loss_fn=loss_fn,
                                      remat=mode, donate=False)
             stepped[mode], _ = step64(st, x64, y)
-        for a, b in zip(
-                jax.tree_util.tree_leaves(stepped[False].params),
-                jax.tree_util.tree_leaves(stepped["conv_outs"].params)):
-            scale = max(float(jnp.max(jnp.abs(a))), 1.0)
-            # f64 reassociation noise on |g|~5e3 grads lands ~1e-8 in
-            # the updated params; anything structural is >1e-3
-            np.testing.assert_allclose(np.asarray(b) / scale,
-                                       np.asarray(a) / scale,
-                                       rtol=1e-6, atol=1e-6)
+        for mode in (True, "conv_outs"):
+            for a, b in zip(
+                    jax.tree_util.tree_leaves(stepped[False].params),
+                    jax.tree_util.tree_leaves(stepped[mode].params)):
+                scale = max(float(jnp.max(jnp.abs(a))), 1.0)
+                np.testing.assert_allclose(np.asarray(b) / scale,
+                                           np.asarray(a) / scale,
+                                           rtol=1e-6, atol=1e-6)
     import pytest
 
     with pytest.raises(ValueError):

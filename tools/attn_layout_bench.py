@@ -1,0 +1,122 @@
+"""On-chip A/B of attention-layer layout strategies (r4).
+
+The transformer_flash xplane profile (ONCHIP_QUEUE.log 10:47) charges
+~21ms/step to transpose_jvp fusions around the flash custom-calls —
+the BSHD->BHSD transposes MultiHeadAttention emits around the kernel —
+and 56.6ms to the flash custom-calls themselves.  Before touching the
+model, measure a single attention layer fwd+bwd (b8 s2048 h16 d64,
+the transformer_flash geometry) under each strategy:
+
+  v0_transpose_flash   current path: reshape+transpose, flash kernel
+  v1_einsum_flash      projections emitted as einsum('bse,ehd->bhsd')
+                       so XLA can fold the transpose into the matmul
+  v2_transpose_xla     transpose + XLA softmax(QK^T)V
+  v3_bshd_xla          no transposes anywhere: einsum attention in
+                       native [B,S,H,D]
+  v4_blk1024           v0 with block_q=1024 (tile A/B rider)
+
+Chained timing (same trick as bench.bench_flash_tiles: byte-identical
+dispatches are cache-served by the tunnel).  Results append to
+ONCHIP_QUEUE.log via tools/onchip_queue.py's logger when run through
+run_experiment, or print RESULT lines standalone.
+"""
+import json
+import subprocess
+import sys
+
+CODE = """
+import functools, json, time
+import jax, jax.numpy as jnp, numpy as np
+from paddle_tpu.kernels.flash_attention import flash_attention
+from paddle_tpu.kernels.attention import _xla_attention
+
+B, S, H, D = 8, 2048, 16, 64
+E = H * D
+rng = np.random.default_rng(0)
+bf = jnp.bfloat16
+x = jnp.asarray(rng.standard_normal((B, S, E)) * 0.02, bf)
+Wq, Wk, Wv, Wo = (jnp.asarray(rng.standard_normal((E, E)) * 0.02, bf)
+                  for _ in range(4))
+sc = 1.0 / np.sqrt(D)
+
+def proj_t(x, W):                       # current: matmul+reshape+transpose
+    return jnp.transpose((x @ W).reshape(B, S, H, D), (0, 2, 1, 3))
+
+def proj_e(x, W):                       # einsum: XLA folds the transpose
+    return jnp.einsum("bse,ehd->bhsd", x, W.reshape(E, H, D))
+
+def attn_v0(x):
+    q, k, v = proj_t(x, Wq), proj_t(x, Wk), proj_t(x, Wv)
+    o = flash_attention(q, k, v, causal=True, sm_scale=sc)
+    return (jnp.transpose(o, (0, 2, 1, 3)).reshape(B, S, E) @ Wo)
+
+def attn_v1(x):
+    q, k, v = proj_e(x, Wq), proj_e(x, Wk), proj_e(x, Wv)
+    o = flash_attention(q, k, v, causal=True, sm_scale=sc)
+    return jnp.einsum("bhsd,hde->bse", o, Wo.reshape(H, D, E))
+
+def attn_v2(x):
+    q, k, v = proj_t(x, Wq), proj_t(x, Wk), proj_t(x, Wv)
+    o = _xla_attention(q, k, v, None, sc, True, 0.0, False, None)
+    return (jnp.transpose(o, (0, 2, 1, 3)).reshape(B, S, E) @ Wo)
+
+def attn_v3(x):
+    q = jnp.einsum("bse,ehd->bshd", x, Wq.reshape(E, H, D))
+    k = jnp.einsum("bse,ehd->bshd", x, Wk.reshape(E, H, D))
+    v = jnp.einsum("bse,ehd->bshd", x, Wv.reshape(E, H, D))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sc
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(bf)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return jnp.einsum("bqhd,hde->bse", o, Wo.reshape(H, D, E))
+
+def attn_v4(x):
+    q, k, v = proj_t(x, Wq), proj_t(x, Wk), proj_t(x, Wv)
+    o = flash_attention(q, k, v, causal=True, sm_scale=sc,
+                        block_q=1024, block_k=512)
+    return (jnp.transpose(o, (0, 2, 1, 3)).reshape(B, S, E) @ Wo)
+
+results = {}
+for name, fn in [("v0_transpose_flash", attn_v0), ("v1_einsum_flash", attn_v1),
+                 ("v2_transpose_xla", attn_v2), ("v3_bshd_xla", attn_v3),
+                 ("v4_blk1024", attn_v4)]:
+    grad = jax.grad(lambda x, _f=fn: jnp.sum(_f(x).astype(jnp.float32)))
+    iters = 10
+
+    @jax.jit
+    def run(x, _g=grad):
+        def body(c, _):
+            dx = _g(c)
+            return c + dx * jnp.asarray(1e-30, c.dtype), dx[0, 0, 0]
+        return jax.lax.scan(body, x, None, length=iters)
+
+    try:
+        xr, outs = run(x)
+        float(outs[-1])
+        best = float("inf")
+        for r in range(3):
+            xr = x * (1.0 + jnp.asarray(float(outs[-1]), x.dtype) * 1e-30
+                      + jnp.asarray(r * 1e-30, x.dtype))
+            t0 = time.perf_counter()
+            _, outs = run(xr)
+            float(outs[-1])
+            best = min(best, (time.perf_counter() - t0) / iters)
+        results[name] = round(best * 1e3, 3)
+    except Exception as e:
+        results[name] = ("%s: %s" % (type(e).__name__, e))[:200]
+    print("PART " + json.dumps({name: results[name]}), flush=True)
+print("RESULT " + json.dumps({"metric": "attn_layout_ab",
+                              "unit": "ms_fwd_bwd_layer",
+                              "times": results}), flush=True)
+"""
+
+
+def main():
+    sys.path.insert(0, "/root/repo/tools")
+    import onchip_queue as q
+    q.run_experiment("attn_layout_ab", CODE, 1800)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,168 @@
+"""Bisect the Mosaic compile hang in kernels/fused_bottleneck.py.
+
+r4 finding: on the axon tunnel, jit of fused_bottleneck at the stage-1
+geometry sat >17 min in the remote compile with ~0 host CPU (the flash
+attention and LN Pallas kernels compile in ~1 min on the same backend).
+Each probe below runs in its OWN subprocess with a short timeout so a
+hang names its probe and costs minutes, not the round:
+
+  p0_ln          known-good Pallas LN — is Mosaic healthy at all today?
+  p1_stem        fused_stem_tail fwd (simplest new kernel)
+  p2_tiny        fused_bottleneck fwd at an aligned tiny geometry
+  p3_s1_t1       stage-1 geometry, batch_tile=1 (smallest VMEM)
+  p4_conv_only   stripped kernel: just pad-scratch + 9-tap conv3x3
+  p5_matmuls     stripped kernel: the three 1x1 matmul chain, no conv
+  p6_s1_full     the original failing case (expected hang — run last)
+
+Usage: python tools/fused_probe.py [probe ...] (default: all, in order)
+Results append to FUSED_PROBE.log.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "FUSED_PROBE.log")
+
+COMMON = """
+import jax, jax.numpy as jnp, numpy as np, time
+rng = np.random.default_rng(0)
+bf = jnp.bfloat16
+def mk(shape, scale=0.2):
+    return jnp.asarray(rng.standard_normal(shape) * scale, bf)
+t0 = time.perf_counter()
+"""
+
+TAIL = """
+jax.block_until_ready(out)
+print("OK %.1fs" % (time.perf_counter() - t0), flush=True)
+"""
+
+PROBES = {
+    "p0_ln": COMMON + """
+from paddle_tpu.kernels.layer_norm import layer_norm_pallas
+x = mk((256, 1024))
+out = jax.jit(layer_norm_pallas)(x, mk((1024,), 1.0), mk((1024,), 0.1))
+""" + TAIL,
+    "p1_stem": COMMON + """
+# call the Pallas kernel DIRECTLY: the public fused_stem_tail dispatches
+# to the XLA fallback above _STEM_SIDE_LIMIT, which would make this
+# probe a false 'ok' (review catch)
+from paddle_tpu.kernels.fused_bottleneck import _stem_tail_pallas
+x = mk((8, 112, 112, 64))
+out = jax.jit(_stem_tail_pallas)(x, mk((64,), 1.0), mk((64,), 0.1))
+""" + TAIL,
+    "p2_tiny": COMMON + """
+from paddle_tpu.kernels.fused_bottleneck import fused_bottleneck
+# lane/sublane-aligned tiny geometry: h=w=16, cm=128, cout=256
+x = mk((2, 16, 16, 256))
+out = jax.jit(fused_bottleneck)(
+    x, mk((256, 128)), mk((3, 3, 128, 128)), mk((128, 256)),
+    mk((128,), 1.0), mk((128,), 0.1), mk((128,), 1.0), mk((128,), 0.1),
+    mk((256,), 1.0), mk((256,), 0.1))
+""" + TAIL,
+    "p3_s1_t1": COMMON + """
+from paddle_tpu.kernels.fused_bottleneck import fused_bottleneck
+x = mk((2, 56, 56, 256))
+out = jax.jit(lambda *a: fused_bottleneck(*a, batch_tile=1))(
+    x, mk((256, 64)), mk((3, 3, 64, 64)), mk((64, 256)),
+    mk((64,), 1.0), mk((64,), 0.1), mk((64,), 1.0), mk((64,), 0.1),
+    mk((256,), 1.0), mk((256,), 0.1))
+""" + TAIL,
+    "p4_conv_only": COMMON + """
+# stripped: pad-scratch + 9-tap conv3x3 alone, stage-1 shape
+import functools
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from paddle_tpu.kernels.fused_bottleneck import (_conv3x3, _vmem_spec,
+                                                 _compiler_params)
+t, h, w, cm = 4, 56, 56, 64
+def kern(x_ref, w2_ref, o_ref, h0p_ref):
+    h0p_ref[...] = jnp.zeros(h0p_ref.shape, h0p_ref.dtype)
+    h0p_ref[:, 1:h + 1, 1:w + 1, :] = x_ref[...]
+    c1 = _conv3x3(h0p_ref[...], w2_ref[...], t, h, w, cm)
+    o_ref[...] = c1.astype(x_ref.dtype).reshape(t, h, w, cm)
+x = mk((8, h, w, cm))
+f = pl.pallas_call(
+    kern, grid=(2,),
+    in_specs=[_vmem_spec((t, h, w, cm), lambda i: (i, 0, 0, 0)),
+              _vmem_spec((3, 3, cm, cm), lambda i: (0, 0, 0, 0))],
+    out_specs=_vmem_spec((t, h, w, cm), lambda i: (i, 0, 0, 0)),
+    out_shape=jax.ShapeDtypeStruct((8, h, w, cm), x.dtype),
+    scratch_shapes=[pltpu.VMEM((t, h + 2, w + 2, cm), x.dtype)],
+    compiler_params=_compiler_params(),
+    interpret=jax.default_backend() != "tpu")
+out = jax.jit(f)(x, mk((3, 3, cm, cm)))
+""" + TAIL,
+    "p5_matmuls": COMMON + """
+# stripped: the three 1x1-conv matmuls + affines, NO 3x3 conv/scratch
+import functools
+from jax.experimental import pallas as pl
+from paddle_tpu.kernels.fused_bottleneck import (_dot, _vmem_spec,
+                                                 _compiler_params)
+t, h, w, cin, cm = 4, 56, 56, 256, 64
+def kern(x_ref, w1_ref, w3_ref, o_ref):
+    xm = x_ref[...].reshape(t * h * w, cin)
+    h0 = jnp.maximum(_dot(xm, w1_ref[...], ((1,), (0,))), 0.0)
+    h0 = h0.astype(x_ref.dtype)
+    c2 = _dot(h0, w3_ref[...], ((1,), (0,)))
+    o_ref[...] = (c2 + xm.astype(jnp.float32)).astype(
+        x_ref.dtype).reshape(t, h, w, cin)
+x = mk((8, h, w, cin))
+f = pl.pallas_call(
+    kern, grid=(2,),
+    in_specs=[_vmem_spec((t, h, w, cin), lambda i: (i, 0, 0, 0)),
+              _vmem_spec((cin, cm), lambda i: (0, 0)),
+              _vmem_spec((cm, cin), lambda i: (0, 0))],
+    out_specs=_vmem_spec((t, h, w, cin), lambda i: (i, 0, 0, 0)),
+    out_shape=jax.ShapeDtypeStruct((8, h, w, cin), x.dtype),
+    compiler_params=_compiler_params(),
+    interpret=jax.default_backend() != "tpu")
+out = jax.jit(f)(x, mk((cin, cm)), mk((cm, cin)))
+""" + TAIL,
+    "p6_s1_full": COMMON + """
+from paddle_tpu.kernels.fused_bottleneck import fused_bottleneck
+x = mk((8, 56, 56, 256))
+out = jax.jit(fused_bottleneck)(
+    x, mk((256, 64)), mk((3, 3, 64, 64)), mk((64, 256)),
+    mk((64,), 1.0), mk((64,), 0.1), mk((64,), 1.0), mk((64,), 0.1),
+    mk((256,), 1.0), mk((256,), 0.1))
+""" + TAIL,
+}
+
+
+def log(obj):
+    line = json.dumps(obj)
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write("%s %s\n" % (time.strftime("%H:%M:%S"), line))
+
+
+def run(name, timeout):
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            ["flock", "/tmp/paddle_tpu_chip.lock", sys.executable, "-c",
+             PROBES[name]],
+            timeout=timeout, capture_output=True, text=True, cwd=REPO)
+        out = r.stdout.strip().splitlines()
+        log({"probe": name, "rc": r.returncode,
+             "out": out[-1] if out else "",
+             "stderr": r.stderr[-400:] if r.returncode else "",
+             "wall_s": round(time.time() - t0, 1)})
+    except subprocess.TimeoutExpired:
+        log({"probe": name, "error": "timeout %ds" % timeout,
+             "wall_s": round(time.time() - t0, 1)})
+
+
+def main(argv):
+    names = argv or ["p0_ln", "p1_stem", "p2_tiny", "p3_s1_t1",
+                     "p4_conv_only", "p5_matmuls", "p6_s1_full"]
+    for n in names:
+        run(n, 420)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
