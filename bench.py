@@ -494,7 +494,7 @@ def bench_flash_tiles(on_tpu, peak):
         q, k, v = (jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
                    for _ in range(3))
 
-        for blk in ((512, 512), (256, 256)):
+        for blk in ((1024, 1024), (512, 512)):
             # per-call block args (fresh jit per block so each pair gets
             # its own traced kernel; an env-var flip would be invisible
             # to a cached executable)

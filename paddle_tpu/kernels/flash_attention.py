@@ -38,13 +38,16 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-# 512x512 tiles: the f32 score block is 1 MB (vs 4 MB at 1024^2),
-# leaving VMEM for double-buffered k/v DMA at head_dim 64-256, and a
-# seq-2048 call gets a 4-step k loop for DMA/compute overlap instead of
-# 2. Override per-call via flash_attention(block_q=..., block_k=...) or
-# globally via PADDLE_TPU_FLASH_BLOCK=<q>x<k> for on-chip A/B runs.
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# 1024x1024 tiles: measured fastest on v5e (r4 flash_block_ab2,
+# b8 h16 s2048 d64 fwd+bwd chained): 512x512 17.48ms, 1024x512 16.62,
+# 2048x512 17.07, 1024x1024 14.80 (2048x1024 fails to compile).  The
+# f32 score block is 4 MB — fits Mosaic's default 16MB scoped budget
+# (this file sets no vmem_limit_bytes, unlike fused_bottleneck); shorter
+# k loops beat the extra DMA overlap the 512 tiling bought.  Override
+# per-call via flash_attention(block_q=..., block_k=...) or globally
+# via PADDLE_TPU_FLASH_BLOCK=<q>x<k> for on-chip A/B runs.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 _LANES = 128
 NEG_INF = -1e30
 
@@ -427,7 +430,7 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
     """Tiled attention over [batch, heads, seq, head_dim] inputs.
 
     seq must be a multiple of the block sizes (default DEFAULT_BLOCK_Q/
-    DEFAULT_BLOCK_K = 512, auto-shrunk to a power-of-two divisor of
+    DEFAULT_BLOCK_K = 1024, auto-shrunk to a power-of-two divisor of
     seq); head_dim should be an MXU-friendly 64/128/256. Returns the same
     shape/dtype as q.
     """

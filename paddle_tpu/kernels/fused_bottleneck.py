@@ -86,6 +86,22 @@ def default_batch_tile(n, h, w, c, rows_target=12544):
     return t
 
 
+# Mosaic's scoped-VMEM demand is ~(live f32 intermediates) = rows x
+# max-channel x 4B x live-count, so a fixed row target that fits
+# stage 1 (c=256) wedges the compiler at stage 2+ (c=512..2048): the
+# on-chip bisect (FUSED_PROBE.log / ONCHIP_QUEUE.log r4) measured
+# s1 compiling in ~20s at rows x c = 12544*256 (fwd) / 6272*256 (bwd)
+# while s2's bwd at 6272*512 searched >420s.  Budget row-units
+# instead: rows_target = UNITS / max(cin, cout), anchored at the
+# proven stage-1 points.
+_FWD_ROW_UNITS = 12544 * 256
+_BWD_ROW_UNITS = 6272 * 256
+
+
+def _rows_for(cin, cout, units):
+    return max(256, units // max(cin, cout, 1))
+
+
 def _dot(a, b, dims):
     return jax.lax.dot_general(a, b, (dims, ((), ())),
                                preferred_element_type=jnp.float32)
@@ -241,7 +257,9 @@ def _specs(x, dy_shape, w1, w2, w3, w4, aff, t, h, w):
 def _fwd(x, w1, w2, w3, w4, aff, batch_tile, proj):
     n, h, w, cin = x.shape
     cm, cout = w1.shape[1], w3.shape[1]
-    t = batch_tile or default_batch_tile(n, h, w, max(cin, cout))
+    t = batch_tile or default_batch_tile(
+        n, h, w, max(cin, cout),
+        rows_target=_rows_for(cin, cout, _FWD_ROW_UNITS))
     if n % t:
         raise ValueError(f"batch_tile={t} does not divide batch {n}")
     kernel = functools.partial(_fwd_kernel, t=t, h=h, w=w, cin=cin,
@@ -263,8 +281,9 @@ def _bwd(x, dy, w1, w2, w3, w4, aff, batch_tile, proj):
     cm, cout = w1.shape[1], w3.shape[1]
     # backward holds ~2x the forward's f32 residents; halve the row
     # budget relative to the forward tile
-    t = batch_tile or default_batch_tile(n, h, w, max(cin, cout),
-                                         rows_target=6272)
+    t = batch_tile or default_batch_tile(
+        n, h, w, max(cin, cout),
+        rows_target=_rows_for(cin, cout, _BWD_ROW_UNITS))
     if n % t:
         raise ValueError(f"batch_tile={t} does not divide batch {n}")
     kernel = functools.partial(_bwd_kernel, t=t, h=h, w=w, cin=cin,
@@ -542,7 +561,9 @@ def _bwd_kernel_down(x_ref, dy_ref, w1_ref, w2_ref, w3_ref, w4_ref,
 def _fwd_down(x, w1, w2, w3, w4, aff, batch_tile):
     n, h, w, cin = x.shape
     cm, cout = w1.shape[1], w3.shape[1]
-    t = batch_tile or default_batch_tile(n, h, w, max(cin, cout))
+    t = batch_tile or default_batch_tile(
+        n, h, w, max(cin, cout),
+        rows_target=_rows_for(cin, cout, _FWD_ROW_UNITS))
     if n % t:
         raise ValueError(f"batch_tile={t} does not divide batch {n}")
     kernel = functools.partial(_fwd_kernel_down, t=t, h=h, w=w, cin=cin,
@@ -564,8 +585,9 @@ def _fwd_down(x, w1, w2, w3, w4, aff, batch_tile):
 def _bwd_down(x, dy, w1, w2, w3, w4, aff, batch_tile):
     n, h, w, cin = x.shape
     cm, cout = w1.shape[1], w3.shape[1]
-    t = batch_tile or default_batch_tile(n, h, w, max(cin, cout),
-                                         rows_target=6272)
+    t = batch_tile or default_batch_tile(
+        n, h, w, max(cin, cout),
+        rows_target=_rows_for(cin, cout, _BWD_ROW_UNITS))
     if n % t:
         raise ValueError(f"batch_tile={t} does not divide batch {n}")
     kernel = functools.partial(_bwd_kernel_down, t=t, h=h, w=w, cin=cin,

@@ -113,7 +113,9 @@ print("RESULT " + json.dumps({"metric": "attn_layout_ab",
 
 
 def main():
-    sys.path.insert(0, "/root/repo/tools")
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import onchip_queue as q
     q.run_experiment("attn_layout_ab", CODE, 1800)
 

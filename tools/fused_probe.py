@@ -141,11 +141,18 @@ def log(obj):
 
 
 def run(name, timeout):
+    import fcntl
+
+    # acquire the chip lock in-process BEFORE starting the timeout
+    # clock — with a `flock` wrapper the timeout includes lock-wait and
+    # a starved probe logs a false 'hang' (same fix as
+    # onchip_queue.run_experiment)
+    lockf = open("/tmp/paddle_tpu_chip.lock", "w")
+    fcntl.flock(lockf, fcntl.LOCK_EX)
     t0 = time.time()
     try:
         r = subprocess.run(
-            ["flock", "/tmp/paddle_tpu_chip.lock", sys.executable, "-c",
-             PROBES[name]],
+            [sys.executable, "-c", PROBES[name]],
             timeout=timeout, capture_output=True, text=True, cwd=REPO)
         out = r.stdout.strip().splitlines()
         log({"probe": name, "rc": r.returncode,
@@ -155,6 +162,8 @@ def run(name, timeout):
     except subprocess.TimeoutExpired:
         log({"probe": name, "error": "timeout %ds" % timeout,
              "wall_s": round(time.time() - t0, 1)})
+    finally:
+        lockf.close()
 
 
 def main(argv):

@@ -240,29 +240,48 @@ for batch in (8, 12, 16):
 
 
 def run_experiment(name, code, timeout):
+    import fcntl
+
+    # hold the chip lock in THIS process while the child runs: with the
+    # old `flock <lock> python -c` wrapper the timeout clock started at
+    # spawn and could be entirely consumed waiting for another
+    # experiment's lock (r4: a 900s probe got 150s of real run time).
+    # Acquiring here means `timeout` measures actual chip time.
+    lockf = open("/tmp/paddle_tpu_chip.lock", "w")
+    fcntl.flock(lockf, fcntl.LOCK_EX)
+    # own session so a timeout can killpg the WHOLE tree: killing just
+    # the wrapper leaves a wedged grandchild alive holding the chip —
+    # every later experiment would then deadlock (r4 incident)
+    p = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, start_new_session=True)
     try:
-        r = subprocess.run(
-            ["flock", "/tmp/paddle_tpu_chip.lock", sys.executable, "-c",
-             code],
-            timeout=timeout, capture_output=True, text=True, cwd=REPO)
-        for line in r.stdout.splitlines():
+        out, err = p.communicate(timeout=timeout)
+        for line in out.splitlines():
             if line.startswith("RESULT "):
                 log({"experiment": name, "result": json.loads(line[7:])})
             elif line.startswith("PART "):
                 log({"experiment": name, "part": json.loads(line[5:])})
-        if r.returncode != 0:
-            log({"experiment": name, "rc": r.returncode,
-                 "stderr": r.stderr[-1500:]})
-    except subprocess.TimeoutExpired as e:
+        if p.returncode != 0:
+            log({"experiment": name, "rc": p.returncode,
+                 "stderr": err[-1500:]})
+    except subprocess.TimeoutExpired:
+        import signal as _signal
+
+        try:
+            os.killpg(os.getpgid(p.pid), _signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        out, _ = p.communicate()
         # keep the PART lines already printed — for a hung Mosaic
         # compile they say exactly which kernels survived
-        out = (e.stdout or b"")
-        if isinstance(out, bytes):
-            out = out.decode(errors="replace")
-        for line in out.splitlines():
+        for line in (out or "").splitlines():
             if line.startswith("PART "):
                 log({"experiment": name, "part": json.loads(line[5:])})
         log({"experiment": name, "error": "timeout %ds" % timeout})
+    finally:
+        lockf.close()
 
 
 def main():
