@@ -408,10 +408,16 @@ def _resolve(q, sm_scale, block_q, block_k):
 
     def _auto_block(default):
         # largest power-of-two tile <= default that divides seq, so any
-        # 128-multiple seq (1920, 2176, ...) gets a valid tiling
-        for cand in (default, default // 2, default // 4, default // 8):
+        # 128-multiple seq (1920, 2176, ...) gets a valid tiling; the
+        # ladder always descends to 64 regardless of where the default
+        # starts (raising the default to 1024 must not lift the floor —
+        # a seq divisible by 64 but not 128 would otherwise fall back
+        # to one full-seq tile and blow the score block's VMEM)
+        cand = default
+        while cand >= 64:
             if cand <= s and s % cand == 0:
                 return cand
+            cand //= 2
         return s
 
     env = _env_blocks()

@@ -257,6 +257,24 @@ def test_default_batch_tile_divides():
         assert n % default_batch_tile(n, 14, 14, 1024) == 0
 
 
+def test_row_units_bounded_across_stages():
+    """Mosaic's scoped-VMEM demand ~ rows x max-channel: the r4 on-chip
+    bisect showed a fixed row target compiling stage 1 but wedging the
+    compiler at stage 2+ (ONCHIP_QUEUE.log).  The channel-aware budget
+    must keep rows x channels at or below the proven stage-1 anchor for
+    every ResNet-50 stage, fwd and bwd."""
+    from paddle_tpu.kernels.fused_bottleneck import (_BWD_ROW_UNITS,
+                                                     _FWD_ROW_UNITS,
+                                                     _rows_for)
+
+    for hw, cout in ((56, 256), (28, 512), (14, 1024), (7, 2048)):
+        for units in (_FWD_ROW_UNITS, _BWD_ROW_UNITS):
+            rows = default_batch_tile(
+                128, hw, hw, cout,
+                rows_target=_rows_for(cout, cout, units)) * hw * hw
+            assert rows * cout <= units, (hw, cout, units, rows)
+
+
 def _fresh_block(ss=4):
     blk = BottleneckBlock(32, 8, stride=1, data_format="NHWC",
                           dtype="float32", fused=True)

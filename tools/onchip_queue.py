@@ -249,20 +249,33 @@ def run_experiment(name, code, timeout):
     # Acquiring here means `timeout` measures actual chip time.
     lockf = open("/tmp/paddle_tpu_chip.lock", "w")
     fcntl.flock(lockf, fcntl.LOCK_EX)
+    # persistent compilation cache shared with bench.py (see
+    # jax_cache_env.py): Mosaic kernel compiles on the remote backend
+    # run 2-5 MINUTES each and are lost when the experiment subprocess
+    # exits — with the cache, later experiments reuse them
+    sys.path.insert(0, REPO)
+    import jax_cache_env
+
+    env = jax_cache_env.set_cache_env(dict(os.environ))
     # own session so a timeout can killpg the WHOLE tree: killing just
     # the wrapper leaves a wedged grandchild alive holding the chip —
     # every later experiment would then deadlock (r4 incident)
     p = subprocess.Popen(
         [sys.executable, "-c", code],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        cwd=REPO, start_new_session=True)
+        cwd=REPO, start_new_session=True, env=env)
     try:
         out, err = p.communicate(timeout=timeout)
         for line in out.splitlines():
-            if line.startswith("RESULT "):
-                log({"experiment": name, "result": json.loads(line[7:])})
-            elif line.startswith("PART "):
-                log({"experiment": name, "part": json.loads(line[5:])})
+            # tolerate non-JSON payloads (e.g. "RESULT done") — a
+            # malformed status line must not kill the driver mid-queue
+            try:
+                if line.startswith("RESULT "):
+                    log({"experiment": name, "result": json.loads(line[7:])})
+                elif line.startswith("PART "):
+                    log({"experiment": name, "part": json.loads(line[5:])})
+            except ValueError:
+                log({"experiment": name, "raw": line[:300]})
         if p.returncode != 0:
             log({"experiment": name, "rc": p.returncode,
                  "stderr": err[-1500:]})
@@ -275,10 +288,14 @@ def run_experiment(name, code, timeout):
             pass
         out, _ = p.communicate()
         # keep the PART lines already printed — for a hung Mosaic
-        # compile they say exactly which kernels survived
+        # compile they say exactly which kernels survived.  SIGKILL
+        # can truncate a line mid-write, so parse defensively here too
         for line in (out or "").splitlines():
             if line.startswith("PART "):
-                log({"experiment": name, "part": json.loads(line[5:])})
+                try:
+                    log({"experiment": name, "part": json.loads(line[5:])})
+                except ValueError:
+                    log({"experiment": name, "raw": line[:300]})
         log({"experiment": name, "error": "timeout %ds" % timeout})
     finally:
         lockf.close()
