@@ -364,11 +364,11 @@ def bench_transformer_h128(on_tpu, peak):
     MXU utilisation (contraction/output dim = 64 of 128 lanes); this
     config shows the framework's ceiling when the model geometry is
     MXU-shaped.  Same hidden size, layers, and FLOP accounting."""
-    from paddle_tpu.models.gpt import GPTConfig
-
     if not on_tpu:
         return {"metric": "transformer_h128_train_mfu",
                 "skipped": "tpu-only side config"}
+    from paddle_tpu.models.gpt import GPTConfig
+
     cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=6,
                     num_heads=8, max_seq_len=2048, dtype="bfloat16")
     return _bench_gpt_mfu(cfg, 8, 2048, 30, "transformer_h128_train_mfu",

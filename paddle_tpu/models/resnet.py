@@ -50,6 +50,19 @@ class BasicBlock(nn.Layer):
         return self.relu(y + s)
 
 
+def _fused_subset():
+    """PADDLE_TPU_FUSED_SUBSET=id restricts the fused Pallas path to
+    the 12 identity bottleneck blocks (no proj/down/stem kernels): the
+    axon remote-compile service routes programs with many Mosaic
+    custom calls to an AOT helper with a broken TPU_WORKER_HOSTNAMES
+    env (r4, ONCHIP_QUEUE.log 12:39) — the subset keeps the train-step
+    program under that threshold while still removing most of the
+    HBM traffic."""
+    import os
+
+    return os.environ.get("PADDLE_TPU_FUSED_SUBSET", "")
+
+
 def _bn_affine(bn, conv_out, training):
     """Resolve one BatchNorm to a per-channel (a, b) affine by running
     the REGISTERED batch_norm kernel on the (already ghost-sliced) conv
@@ -100,11 +113,15 @@ class BottleneckBlock(nn.Layer):
         # the fused Pallas path covers ALL of ResNet-50's block shapes
         # in NHWC: identity shortcut (12 blocks), the stride-1
         # projection block (stage-1 block 0), and the stride-2
-        # transitions (fused_bottleneck_down)
+        # transitions (fused_bottleneck_down); _fused_subset() can
+        # restrict it to the identity blocks.
+        id_only = _fused_subset() == "id"
         self._stride = stride
         self._fused = (fused and df == "NHWC"
                        and (stride == 1
-                            or (stride == 2 and self.short is not None)))
+                            or (stride == 2 and self.short is not None))
+                       and not (id_only
+                                and (self.short is not None or stride != 1)))
 
     def _bn_affine(self, bn, conv_out):
         return _bn_affine(bn, conv_out, self.training)
@@ -200,7 +217,8 @@ class ResNet(nn.Layer):
         # fused stem tail (BN affine + relu + s2 maxpool as one Pallas
         # kernel); the 7x7 conv itself stays on XLA — its K=3-channel
         # matmul shape is XLA's to tile, the tail is pure traffic
-        self._fused_stem = fused and data_format == "NHWC"
+        self._fused_stem = (fused and data_format == "NHWC"
+                            and _fused_subset() != "id")
 
     def _stem_pool(self, x):
         ss = self.stem.bn._stats_sample

@@ -36,12 +36,12 @@ def _write_multislot_files(tmp, n_files=2, lines_per_file=64, seed=0):
     return files
 
 
-def _make_dataset(tmp, batch=16):
+def _make_dataset(tmp, batch=16, threads=2):
     files = _write_multislot_files(tmp)
     ds = QueueDataset()
     ds.set_filelist(files)
     ds.set_batch_size(batch)
-    ds.set_thread(2)
+    ds.set_thread(threads)
     ds.set_use_var([("ids", "int64", 2), ("label", "float", 1),
                     ("feat", "float", 3)])
     return ds
@@ -109,7 +109,10 @@ def _downpour_ctr_body():
     exe.run(startup)
 
     with tempfile.TemporaryDirectory() as tmp:
-        ds = _make_dataset(tmp)
+        # single reader thread: with 2 threads the batch ORDER is
+        # thread-interleaving-dependent and the fetched per-epoch loss
+        # rides on it — the assertion below flaked by suite order
+        ds = _make_dataset(tmp, threads=1)
         epoch_losses = []
         for _ in range(10):
             out = exe.train_from_dataset(
@@ -118,7 +121,9 @@ def _downpour_ctr_body():
                                "emb_var": "emb"})
             epoch_losses.append(float(np.asarray(out[0])))
     assert len(table) > 0
-    assert epoch_losses[-1] < epoch_losses[0], epoch_losses
+    # windowed comparison: late-epoch mean under early-epoch mean
+    assert (np.mean(epoch_losses[-3:]) < np.mean(epoch_losses[:3])), \
+        epoch_losses
 
 
 def test_downpour_through_communicator():

@@ -17,6 +17,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "ONCHIP_QUEUE.log")
 
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+import jax_cache_env  # noqa: E402  (needs REPO on sys.path)
+
 
 def log(obj):
     line = json.dumps(obj)
@@ -239,6 +243,20 @@ for batch in (8, 12, 16):
 }
 
 
+def _log_lines(name, out):
+    """Log RESULT/PART status lines from experiment stdout.  Parses
+    defensively: a malformed or SIGKILL-truncated line must not kill
+    the driver mid-queue."""
+    for line in (out or "").splitlines():
+        try:
+            if line.startswith("RESULT "):
+                log({"experiment": name, "result": json.loads(line[7:])})
+            elif line.startswith("PART "):
+                log({"experiment": name, "part": json.loads(line[5:])})
+        except ValueError:
+            log({"experiment": name, "raw": line[:300]})
+
+
 def run_experiment(name, code, timeout):
     import fcntl
 
@@ -253,9 +271,6 @@ def run_experiment(name, code, timeout):
     # jax_cache_env.py): Mosaic kernel compiles on the remote backend
     # run 2-5 MINUTES each and are lost when the experiment subprocess
     # exits — with the cache, later experiments reuse them
-    sys.path.insert(0, REPO)
-    import jax_cache_env
-
     env = jax_cache_env.set_cache_env(dict(os.environ))
     # own session so a timeout can killpg the WHOLE tree: killing just
     # the wrapper leaves a wedged grandchild alive holding the chip —
@@ -266,16 +281,7 @@ def run_experiment(name, code, timeout):
         cwd=REPO, start_new_session=True, env=env)
     try:
         out, err = p.communicate(timeout=timeout)
-        for line in out.splitlines():
-            # tolerate non-JSON payloads (e.g. "RESULT done") — a
-            # malformed status line must not kill the driver mid-queue
-            try:
-                if line.startswith("RESULT "):
-                    log({"experiment": name, "result": json.loads(line[7:])})
-                elif line.startswith("PART "):
-                    log({"experiment": name, "part": json.loads(line[5:])})
-            except ValueError:
-                log({"experiment": name, "raw": line[:300]})
+        _log_lines(name, out)
         if p.returncode != 0:
             log({"experiment": name, "rc": p.returncode,
                  "stderr": err[-1500:]})
@@ -287,15 +293,9 @@ def run_experiment(name, code, timeout):
         except ProcessLookupError:
             pass
         out, _ = p.communicate()
-        # keep the PART lines already printed — for a hung Mosaic
-        # compile they say exactly which kernels survived.  SIGKILL
-        # can truncate a line mid-write, so parse defensively here too
-        for line in (out or "").splitlines():
-            if line.startswith("PART "):
-                try:
-                    log({"experiment": name, "part": json.loads(line[5:])})
-                except ValueError:
-                    log({"experiment": name, "raw": line[:300]})
+        # keep the PART/RESULT lines already printed — for a hung
+        # Mosaic compile they say exactly which kernels survived
+        _log_lines(name, out)
         log({"experiment": name, "error": "timeout %ds" % timeout})
     finally:
         lockf.close()
