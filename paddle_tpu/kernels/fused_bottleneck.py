@@ -107,9 +107,35 @@ def _dot(a, b, dims):
                                preferred_element_type=jnp.float32)
 
 
+# The 3x3 conv inside the kernels has two formulations:
+#   taps:   nine shifted [R, Cm] x [Cm, Cmo] matmuls — each contracts
+#           only Cm (64-512) of the MXU's 128-deep systolic array, so
+#           stage-1/2 run the MXU at <=50% depth
+#   im2col: ONE [R, 9*Cm] x [9*Cm, Cmo] matmul over a lane-concatenated
+#           patch matrix built in VMEM — full MXU depth at every stage,
+#           at the cost of a 9x wider VMEM intermediate
+# PADDLE_TPU_FUSED_CONV=taps restores the original formulation for
+# on-chip A/Bs.
+def _conv_mode():
+    import os
+
+    return os.environ.get("PADDLE_TPU_FUSED_CONV", "im2col")
+
+
+def _im2col(h0_pad, t, h, wid, cm):
+    """Lane-concatenated 3x3 patches of a padded [T, H+2, W+2, Cm]
+    tile -> [T*H*W, 9*Cm]."""
+    taps = [h0_pad[:, dy:dy + h, dx:dx + wid, :].reshape(t * h * wid, cm)
+            for dy in range(3) for dx in range(3)]
+    return jnp.concatenate(taps, axis=1)
+
+
 def _conv3x3(h0_pad, w2, t, h, wid, cm):
-    """Nine shifted matmuls over a padded [T, H+2, W+2, Cm] tile -> f32
+    """3x3 conv over a padded [T, H+2, W+2, Cm] tile -> f32
     [T*H*W, Cmo]."""
+    if _conv_mode() == "im2col":
+        p = _im2col(h0_pad, t, h, wid, cm)
+        return _dot(p, w2.reshape(9 * cm, w2.shape[-1]), ((1,), (0,)))
     acc = jnp.zeros((t * h * wid, w2.shape[-1]), jnp.float32)
     for dy in range(3):
         for dx in range(3):
@@ -172,7 +198,15 @@ def _bwd_kernel(x_ref, dy_ref, w1_ref, w2_ref, w3_ref, w4_ref, aff_ref,
     c0 = c0.astype(dt)                    # residency: f32 copy freed
     h0p_ref[...] = jnp.zeros(h0p_ref.shape, h0p_ref.dtype)
     h0p_ref[:, 1:h + 1, 1:w + 1, :] = h0.reshape(t, h, w, cm)
-    c1 = _conv3x3(h0p_ref[...], w2, t, h, w, cm)
+    im2col = _conv_mode() == "im2col"
+    if im2col:
+        # build the patch matrix ONCE: the recompute's conv and the
+        # dW2 matmul below both consume it (review catch — Mosaic is
+        # not guaranteed to CSE it across separate ref reads)
+        p = _im2col(h0p_ref[...], t, h, w, cm)
+        c1 = _dot(p, w2.reshape(9 * cm, cm), ((1,), (0,)))
+    else:
+        c1 = _conv3x3(h0p_ref[...], w2, t, h, w, cm)
     u1 = c1 * a2 + b2
     h1 = jnp.maximum(u1, 0.0).astype(dt)
     c1 = c1.astype(dt)
@@ -204,16 +238,28 @@ def _bwd_kernel(x_ref, dy_ref, w1_ref, w2_ref, w3_ref, w4_ref, aff_ref,
     # dW2[dy,dx] += shift(h0_pad)^T @ dc1 ; dh0 via transposed taps
     dc1p_ref[...] = jnp.zeros(dc1p_ref.shape, dc1p_ref.dtype)
     dc1p_ref[:, 1:h + 1, 1:w + 1, :] = dc1.reshape(t, h, w, cm)
-    dh0 = jnp.zeros((t * h * w, cm), jnp.float32)
-    for dy_ in range(3):
-        for dx_ in range(3):
-            tap = h0p_ref[:, dy_:dy_ + h, dx_:dx_ + w, :]
-            dw2_ref[dy_, dx_] += _dot(tap.reshape(t * h * w, cm), dc1,
-                                      ((0,), (0,)))
-            # transposed conv: dh0 gathers dc1 at the opposite shift
-            rtap = dc1p_ref[:, 2 - dy_:2 - dy_ + h, 2 - dx_:2 - dx_ + w, :]
-            dh0 += _dot(rtap.reshape(t * h * w, cm), w2[dy_, dx_],
-                        ((1,), (1,)))
+    if im2col:
+        # dW2 = P^T @ dc1 as ONE [9Cm, R] x [R, Cm] matmul (full MXU
+        # depth over the big R contraction), reusing the recompute's
+        # patch matrix; dh0 is the transposed conv = im2col(dc1p)
+        # against the spatially FLIPPED transposed weights
+        dw2_ref[...] += _dot(p, dc1, ((0,), (0,))).reshape(dw2_ref.shape)
+        pr = _im2col(dc1p_ref[...], t, h, w, cm)
+        w2t = jnp.transpose(w2[::-1, ::-1], (0, 1, 3, 2)).reshape(
+            9 * cm, cm)
+        dh0 = _dot(pr, w2t, ((1,), (0,)))
+    else:
+        dh0 = jnp.zeros((t * h * w, cm), jnp.float32)
+        for dy_ in range(3):
+            for dx_ in range(3):
+                tap = h0p_ref[:, dy_:dy_ + h, dx_:dx_ + w, :]
+                dw2_ref[dy_, dx_] += _dot(tap.reshape(t * h * w, cm), dc1,
+                                          ((0,), (0,)))
+                # transposed conv: dh0 gathers dc1 at the opposite shift
+                rtap = dc1p_ref[:, 2 - dy_:2 - dy_ + h,
+                                2 - dx_:2 - dx_ + w, :]
+                dh0 += _dot(rtap.reshape(t * h * w, cm), w2[dy_, dx_],
+                            ((1,), (1,)))
     du0 = jnp.where(u0 > 0.0, dh0, 0.0)
     daff_ref[0, :cm] += jnp.sum(du0 * c0.astype(jnp.float32), axis=0)
     daff_ref[1, :cm] += jnp.sum(du0, axis=0)
