@@ -115,7 +115,10 @@ def _dot(a, b, dims):
 #           patch matrix built in VMEM — full MXU depth at every stage,
 #           at the cost of a 9x wider VMEM intermediate
 # PADDLE_TPU_FUSED_CONV=taps restores the original formulation for
-# on-chip A/Bs.
+# on-chip A/Bs.  The env var is read at TRACE time and is not part of
+# any jit cache key, so it is process-start-only: flipping it after a
+# shape has compiled keeps serving the cached executable (A/B drivers
+# run each mode in its own process).
 def _conv_mode():
     import os
 

@@ -256,6 +256,47 @@ def _generate_jit(trees, cfg, prompt_ids, max_new_tokens, temperature,
 
 
 @functools.partial(jax.jit, static_argnames=(
+    "cfg", "max_new_tokens", "temperature", "top_k", "top_p", "eos_id"))
+def _generate_eos_jit(trees, cfg, prompt_ids, max_new_tokens, temperature,
+                      top_k, top_p, key, eos_id):
+    """Greedy/sampled decode with EOS early exit: a lax.while_loop that
+    stops as soon as EVERY row has emitted eos_id, so a batch whose
+    sequences finish early doesn't pay the full max_new_tokens of
+    decode steps (serving latency; the fixed-length scan above stays
+    the jit-friendliest shape for benchmarking/throughput).  Finished
+    rows keep emitting eos_id (the reference decoder's
+    end-of-sentence semantics)."""
+    params = DecodeParams(*trees, cfg)
+    batch, prompt_len = prompt_ids.shape
+    cache = init_cache(cfg, batch, prompt_len + max_new_tokens)
+    logits, cache = prefill(params, prompt_ids, cache, cfg)
+    first = _sample(logits, key, temperature, top_k, top_p)
+    out = jnp.full((batch, max_new_tokens), eos_id, jnp.int32)
+    out = out.at[:, 0].set(first)
+    done = first == eos_id
+
+    def cond(carry):
+        i, _, _, _, done, _ = carry
+        return jnp.logical_and(i < max_new_tokens,
+                               jnp.logical_not(done.all()))
+
+    def body(carry):
+        i, token, cache, key, done, out = carry
+        key, sub = jax.random.split(key)
+        logits, cache = decode_step(params, token, cache,
+                                    prompt_len + i - 1, cfg)
+        nxt = _sample(logits, sub, temperature, top_k, top_p)
+        nxt = jnp.where(done, eos_id, nxt)
+        out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+        return (i + 1, nxt, cache, key,
+                jnp.logical_or(done, nxt == eos_id), out)
+
+    _, _, _, _, _, out = jax.lax.while_loop(
+        cond, body, (jnp.int32(1), first, cache, key, done, out))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=(
     "cfg", "beam_size", "max_new_tokens", "eos_id"))
 def _beam_search_jit(trees, cfg, prompt_ids, beam_size, max_new_tokens,
                      eos_id, length_penalty):
@@ -381,15 +422,21 @@ def _resolve_and_check(model_or_params, prompt_ids, max_new_tokens):
 
 def generate(model_or_params, prompt_ids, max_new_tokens,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             top_p: Optional[float] = None, rng_key=None):
+             top_p: Optional[float] = None, rng_key=None, eos_id=None):
     """Generate [B, max_new_tokens] continuations of prompt_ids [B, S].
 
     One compiled program per (shape, sampling-config); defaults to
     greedy.  temperature > 0 enables sampling (pass rng_key for
-    reproducibility)."""
+    reproducibility).  eos_id engages early exit: decode stops the
+    moment every row has emitted eos_id (a lax.while_loop instead of
+    the fixed-length scan), and finished rows pad with eos_id."""
     params, prompt_ids = _resolve_and_check(model_or_params, prompt_ids,
                                             max_new_tokens)
     key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
-    return _generate_jit((params.emb, params.blocks, params.head),
-                         params.cfg, prompt_ids, max_new_tokens,
+    trees = (params.emb, params.blocks, params.head)
+    if eos_id is not None:
+        return _generate_eos_jit(trees, params.cfg, prompt_ids,
+                                 max_new_tokens, float(temperature),
+                                 top_k, top_p, key, int(eos_id))
+    return _generate_jit(trees, params.cfg, prompt_ids, max_new_tokens,
                          float(temperature), top_k, top_p, key)

@@ -225,3 +225,36 @@ def test_beam_search_eos_freezes():
             if hits.size:
                 assert (row[hits[0]:] == eos).all(), row
     assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_generate_eos_early_exit_matches_scan():
+    """eos_id engages the while_loop path: rows must match the
+    fixed-length scan output up to (and including) each row's first
+    eos, pad eos after it, and produce identical output when eos never
+    fires."""
+    from paddle_tpu.models.generate import generate
+
+    model = _model()
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(0, 97, (2, 5)), jnp.int32)
+    base = np.asarray(generate(model, prompt, 10))
+
+    # pick the token row 0 emits at step 3 as eos: row 0 must stop there
+    eos = int(base[0, 3])
+    out = np.asarray(generate(model, prompt, 10, eos_id=eos))
+    for r in range(2):
+        hits = np.where(base[r] == eos)[0]
+        if hits.size:
+            cut = int(hits[0])
+            np.testing.assert_array_equal(out[r, :cut + 1],
+                                          base[r, :cut + 1])
+            # after its first eos the row pads with eos
+            assert (out[r, cut:] == eos).all()
+        else:
+            # a row that never emits eos must match the scan end-to-end
+            np.testing.assert_array_equal(out[r], base[r])
+
+    # an eos OUTSIDE the vocab can never fire: the while_loop must run
+    # to max_new_tokens and reproduce the scan output exactly
+    out2 = np.asarray(generate(model, prompt, 10, eos_id=97))
+    np.testing.assert_array_equal(out2, base)
