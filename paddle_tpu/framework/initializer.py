@@ -134,10 +134,43 @@ class NumpyArrayInitializer(Initializer):
         )
 
 
+class BilinearInitializer(Initializer):
+    """Bilinear-upsample kernel init for transposed conv weights
+    (parity: reference initializer.py BilinearInitializer :766-775) —
+    a Conv2DTranspose with this weight, stride s, kernel 2s-s%2 and
+    groups=C performs bilinear interpolation.  Weight shape must be
+    [C_in, f_out, H, W] with H == W."""
+
+    def __call__(self, param, block):
+        shape = list(param.shape)
+        if len(shape) != 4:
+            raise ValueError(
+                f"BilinearInitializer needs a 4-D weight, got {shape}")
+        if shape[2] != shape[3]:
+            raise ValueError(
+                f"BilinearInitializer needs a square kernel, got {shape}")
+        k = shape[3]
+        # exactly the reference's formula: f = ceil(k/2),
+        # c = (2f - 1 - f%2) / (2f); the center's half-pixel shift keys
+        # on the parity of f, NOT of k (review catch — they differ for
+        # k % 4 in {2, 3})
+        f = (k + 1) // 2
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:k, :k]
+        filt = ((1 - np.abs(og[0] / f - c))
+                * (1 - np.abs(og[1] / f - c)))
+        # each (in-channel, out-filter) slot gets the same bilinear
+        # kernel; emission delegates to NumpyArrayInitializer so the
+        # assign_value encoding lives once
+        weight = np.broadcast_to(filt, shape).astype(np.float32)
+        NumpyArrayInitializer(weight)(param, block)
+
+
 # Reference-compatible aliases
 Constant = ConstantInitializer
 Uniform = UniformInitializer
 Normal = NormalInitializer
 TruncatedNormal = TruncatedNormalInitializer
 Xavier = XavierInitializer
+Bilinear = BilinearInitializer
 MSRA = MSRAInitializer

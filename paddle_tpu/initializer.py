@@ -12,14 +12,15 @@ decision, so the context is an honest no-op kept for script parity.
 import contextlib
 
 from .framework.initializer import (  # noqa: F401
-    Constant, ConstantInitializer, Initializer, MSRA, MSRAInitializer,
-    Normal, NormalInitializer, NumpyArrayInitializer, TruncatedNormal,
-    TruncatedNormalInitializer, Uniform, UniformInitializer, Xavier,
-    XavierInitializer)
+    Bilinear, BilinearInitializer, Constant, ConstantInitializer,
+    Initializer, MSRA, MSRAInitializer, Normal, NormalInitializer,
+    NumpyArrayInitializer, TruncatedNormal, TruncatedNormalInitializer,
+    Uniform, UniformInitializer, Xavier, XavierInitializer)
 
 __all__ = [
     "Constant", "Uniform", "Normal", "TruncatedNormal", "Xavier",
-    "MSRA", "NumpyArrayInitializer", "force_init_on_cpu", "init_on_cpu",
+    "Bilinear", "MSRA", "NumpyArrayInitializer", "force_init_on_cpu",
+    "init_on_cpu",
 ]
 
 _force_init_on_cpu = False
