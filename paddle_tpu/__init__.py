@@ -47,6 +47,14 @@ from .framework import (
     append_backward,
     gradients,
     ParamAttr,
+    cpu_places,
+    cuda_places,
+    cuda_pinned_places,
+    in_dygraph_mode,
+    is_compiled_with_cuda,
+    load_op_library,
+    require_version,
+    device_guard,
 )
 
 # top-level fluid module paths (richer than the framework internals:

@@ -4,6 +4,7 @@ Parity: python/paddle/fluid/compiler.py — implementation in
 framework/compiler.py.
 """
 
-from .framework.compiler import CompiledProgram  # noqa: F401
+from .framework.compiler import (BuildStrategy, CompiledProgram,
+                                 ExecutionStrategy)  # noqa: F401
 
-__all__ = ["CompiledProgram"]
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]

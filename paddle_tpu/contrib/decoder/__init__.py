@@ -12,7 +12,7 @@ the cell directly against layers.rnn.RNNCell — same capability, one
 decoding engine.
 """
 
-from ..layers.rnn import (BeamSearchDecoder, Decoder,  # noqa: F401
+from ...layers.rnn import (BeamSearchDecoder, Decoder,  # noqa: F401
                           dynamic_decode)
 
 __all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]

@@ -1,0 +1,137 @@
+"""`fluid.contrib.decoder.beam_search_decoder` import-path parity.
+
+The reference's contrib decoding state machine (InitState/StateCell/
+TrainingDecoder/BeamSearchDecoder, beam_search_decoder.py:842 LoC) was
+the experimental precursor of the mainlined layers.rnn decode stack.
+Here the TRAINING-time state machine is implemented over StaticRNN
+(same scan-based engine as the rest of the RNN stack) so 1.x scripts
+using the incremental-construction API run; the beam-search side is
+the one mainlined engine (layers/rnn.py BeamSearchDecoder).
+"""
+
+from ...layers.control_flow import StaticRNN
+from ...layers.rnn import BeamSearchDecoder  # noqa: F401
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """Initial decoder state (reference :InitState): either a concrete
+    init tensor or a zero-filled shape spec."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        self._init = init if init is not None else init_boot
+        self._shape = shape
+        self._value = value
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        if self._init is None:
+            raise ValueError("InitState needs `init` (shape-only init "
+                             "requires a boot tensor under scan)")
+        return self._init
+
+
+class StateCell:
+    """Named-state container with a user-registered updater
+    (reference :StateCell).  States live as StaticRNN memories while a
+    TrainingDecoder block is active."""
+
+    def __init__(self, inputs=None, states=None, out_state=None,
+                 name=None):
+        self._state_specs = dict(states or {})
+        self._inputs = dict(inputs or {})
+        self._out_state = out_state or (next(iter(states))
+                                        if states else None)
+        self._updater = None
+        self._cur_states = {}
+        self._cur_inputs = {}
+        self._rnn = None
+
+    def state_updater(self, fn):
+        self._updater = fn
+        return fn
+
+    def get_state(self, name):
+        return self._cur_states[name]
+
+    def set_state(self, name, value):
+        self._cur_states[name] = value
+
+    def get_input(self, name):
+        return self._cur_inputs[name]
+
+    def compute_state(self, inputs):
+        self._cur_inputs = dict(inputs)
+        if self._updater is None:
+            raise ValueError("register an updater via @state_updater")
+        self._updater(self)
+
+    def update_states(self):
+        for name, mem in list(self._mems.items()):
+            self._rnn.update_memory(mem, self._cur_states[name])
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+    def _begin(self, rnn):
+        self._rnn = rnn
+        self._mems = {}
+        for name, spec in self._state_specs.items():
+            init = spec.value if isinstance(spec, InitState) else spec
+            mem = rnn.memory(init=init)
+            self._mems[name] = mem
+            self._cur_states[name] = mem
+
+
+class TrainingDecoder:
+    """Teacher-forced decoding loop (reference :TrainingDecoder): a
+    with-block defines one step; calling the decoder returns the
+    stacked step outputs [T, ...]."""
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, name=None):
+        self.state_cell = state_cell
+        self._rnn = StaticRNN(name=name)
+        self._outputs = []
+        self.status = self.BEFORE_DECODER
+
+    def block(self):
+        decoder = self
+
+        class _Ctx:
+            def __enter__(self):
+                decoder.status = decoder.IN_DECODER
+                decoder._step_ctx = decoder._rnn.step()
+                decoder._step_ctx.__enter__()
+                decoder.state_cell._begin(decoder._rnn)
+                return self
+
+            def __exit__(self, *exc):
+                r = decoder._step_ctx.__exit__(*exc)
+                decoder.status = decoder.AFTER_DECODER
+                return r
+
+        return _Ctx()
+
+    def step_input(self, x):
+        return self._rnn.step_input(x)
+
+    def static_input(self, x):
+        return self._rnn.step_input(x)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self._rnn.step_output(o)
+            self._outputs.append(o)
+
+    def __call__(self):
+        out = self._rnn()
+        return out if not isinstance(out, (list, tuple)) or len(out) > 1 \
+            else out[0]

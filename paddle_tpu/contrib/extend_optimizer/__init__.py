@@ -1,0 +1,13 @@
+"""`fluid.contrib.extend_optimizer` import-path compatibility package.
+
+Implementation in ._impl (DecoupledWeightDecay mixin +
+extend_with_decoupled_weight_decay factory); the reference's
+extend_optimizer_with_weight_decay submodule path re-exports it.
+"""
+
+from ._impl import (  # noqa: F401
+    DecoupledWeightDecay,
+    extend_with_decoupled_weight_decay,
+)
+
+__all__ = ["DecoupledWeightDecay", "extend_with_decoupled_weight_decay"]

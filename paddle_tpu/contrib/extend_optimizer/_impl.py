@@ -7,7 +7,7 @@ mixin + extend_with_decoupled_weight_decay (:102) class factory:
 independent of the gradient path (AdamW-style decoupling).
 """
 
-from ..framework.program import Variable
+from ...framework.program import Variable
 
 __all__ = ["DecoupledWeightDecay", "extend_with_decoupled_weight_decay"]
 

@@ -1,0 +1,9 @@
+"""`fluid.contrib.extend_optimizer.extend_optimizer_with_weight_decay`
+import-path compatibility — honest re-export of the implementation."""
+
+from ._impl import (  # noqa: F401
+    DecoupledWeightDecay,
+    extend_with_decoupled_weight_decay,
+)
+
+__all__ = ["DecoupledWeightDecay", "extend_with_decoupled_weight_decay"]

@@ -1,0 +1,11 @@
+"""`fluid.contrib.layers.metric_op` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/layers/metric_op.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.contrib.layers import (  # noqa: F401
+    ctr_metric_bundle,
+)
+
+__all__ = ['ctr_metric_bundle']

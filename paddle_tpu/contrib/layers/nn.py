@@ -1,0 +1,21 @@
+"""`fluid.contrib.layers.nn` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/layers/nn.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.contrib.layers import (  # noqa: F401
+    fused_elemwise_activation,
+    fused_embedding_seq_pool,
+    match_matrix_tensor,
+    multiclass_nms2,
+    partial_concat,
+    partial_sum,
+    search_pyramid_hash,
+    sequence_topk_avg_pooling,
+    shuffle_batch,
+    tree_conv,
+    var_conv_2d,
+)
+
+__all__ = ['fused_elemwise_activation', 'fused_embedding_seq_pool', 'match_matrix_tensor', 'multiclass_nms2', 'partial_concat', 'partial_sum', 'search_pyramid_hash', 'sequence_topk_avg_pooling', 'shuffle_batch', 'tree_conv', 'var_conv_2d']

@@ -1,0 +1,36 @@
+"""`fluid.contrib.layers.rnn_impl` import-path compatibility.
+
+Parity: contrib/layers/rnn_impl.py — basic_gru/basic_lstm builders live
+in the contrib.layers aggregate; BasicGRUUnit/BasicLSTMUnit (the
+reference's dygraph cell Layers behind those builders) map onto the one
+cell implementation in paddle_tpu.nn (GRUCell/LSTMCell semantics).
+"""
+
+from ...nn import GRUCell as _GRUCell, LSTMCell as _LSTMCell
+from . import basic_gru, basic_lstm  # noqa: F401
+
+
+class BasicGRUUnit(_GRUCell):
+    """Reference rnn_impl.BasicGRUUnit(name_scope, hidden_size, ...) —
+    a dygraph Layer computing one GRU step."""
+
+    def __init__(self, name_scope=None, hidden_size=None, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        if hidden_size is None and isinstance(name_scope, int):
+            # reference calls it (name_scope, hidden_size); tolerate
+            # positional hidden_size-only use
+            name_scope, hidden_size = None, name_scope
+        super().__init__(hidden_size, hidden_size, dtype=dtype)
+
+
+class BasicLSTMUnit(_LSTMCell):
+    def __init__(self, name_scope=None, hidden_size=None, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        if hidden_size is None and isinstance(name_scope, int):
+            name_scope, hidden_size = None, name_scope
+        super().__init__(hidden_size, hidden_size, dtype=dtype)
+
+
+__all__ = ["BasicGRUUnit", "BasicLSTMUnit", "basic_gru", "basic_lstm"]

@@ -1,0 +1,11 @@
+"""`fluid.contrib.mixed_precision.decorator` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/mixed_precision/decorator.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.contrib.mixed_precision import (  # noqa: F401
+    decorate,
+)
+
+__all__ = ['decorate']

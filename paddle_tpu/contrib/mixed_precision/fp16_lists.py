@@ -1,0 +1,11 @@
+"""`fluid.contrib.mixed_precision.fp16_lists` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/mixed_precision/fp16_lists.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.contrib.mixed_precision import (  # noqa: F401
+    AutoMixedPrecisionLists,
+)
+
+__all__ = ['AutoMixedPrecisionLists']

@@ -1,0 +1,8 @@
+"""`fluid.contrib.slim.core` import-path compatibility: Compressor
+lives in paddle_tpu/slim/compressor.py (reference core/compressor.py);
+the config-YAML loader is subsumed by Compressor.config(strategies=...)
+in code."""
+
+from ....slim.compressor import Compressor  # noqa: F401
+
+__all__ = ["Compressor"]

@@ -1,0 +1,10 @@
+"""`fluid.contrib.slim.core.compressor` parity — Compressor in
+paddle_tpu/slim/compressor.py; Context is the Compressor itself (it
+carries epoch_id/train_program/eval_program, the fields strategy hooks
+read)."""
+
+from ....slim.compressor import Compressor  # noqa: F401
+
+Context = Compressor
+
+__all__ = ["Context", "Compressor"]

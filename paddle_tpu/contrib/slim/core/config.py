@@ -1,0 +1,28 @@
+"""`fluid.contrib.slim.core.config` parity: the reference's YAML
+config factory instantiating strategies by class name; here strategies
+are constructed in code, and the factory resolves names against the
+slim namespace for config-driven scripts."""
+
+__all__ = ["ConfigFactory"]
+
+
+class ConfigFactory:
+    def __init__(self, config=None):
+        """config: dict {strategy_name: {class: ..., kwargs...}} (the
+        YAML file's parsed form)."""
+        self._config = dict(config or {})
+        self.compressor = {}
+
+    def instance(self, name):
+        import importlib
+
+        spec = dict(self._config.get(name) or {})
+        cls_name = spec.pop("class", name)
+        for modname in ("paddle_tpu.slim.quantization",
+                        "paddle_tpu.contrib.slim.prune.prune_strategy",
+                        "paddle_tpu.contrib.slim.nas.light_nas_strategy",
+                        "paddle_tpu.slim"):
+            mod = importlib.import_module(modname)
+            if hasattr(mod, cls_name):
+                return getattr(mod, cls_name)(**spec)
+        raise KeyError(f"unknown strategy class {cls_name!r}")

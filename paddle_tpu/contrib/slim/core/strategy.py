@@ -1,0 +1,24 @@
+"""`fluid.contrib.slim.core.strategy` parity: the hook protocol base
+class (on_compression_begin/on_epoch_begin/on_epoch_end/
+on_compression_end), all default no-ops."""
+
+
+class Strategy:
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+
+__all__ = ["Strategy"]

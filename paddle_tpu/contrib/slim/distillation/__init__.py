@@ -1,5 +1,5 @@
 """`fluid.contrib.slim.distillation` import-path compatibility —
 implementation in paddle_tpu/slim/distill.py."""
 
-from ...slim.distill import *  # noqa: F401,F403
-from ...slim.distill import __all__  # noqa: F401
+from ....slim.distill import *  # noqa: F401,F403
+from ....slim.distill import __all__  # noqa: F401

@@ -1,0 +1,11 @@
+"""`fluid.contrib.slim.distillation.distillation_strategy` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/slim/distillation/distillation_strategy.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.contrib.slim.distillation import (  # noqa: F401
+    DistillationStrategy,
+)
+
+__all__ = ['DistillationStrategy']

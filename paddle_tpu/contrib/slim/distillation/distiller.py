@@ -1,0 +1,13 @@
+"""`fluid.contrib.slim.distillation.distiller` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/slim/distillation/distiller.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.contrib.slim.distillation import (  # noqa: F401
+    FSPDistiller,
+    L2Distiller,
+    SoftLabelDistiller,
+)
+
+__all__ = ['FSPDistiller', 'L2Distiller', 'SoftLabelDistiller']

@@ -1,0 +1,6 @@
+"""`fluid.contrib.slim.graph` import-path compatibility package."""
+
+from .executor import SlimGraphExecutor  # noqa: F401
+from .graph_wrapper import GraphWrapper, OpWrapper, VarWrapper  # noqa: F401
+
+__all__ = ["GraphWrapper", "VarWrapper", "OpWrapper", "SlimGraphExecutor"]

@@ -1,0 +1,17 @@
+"""Parity: contrib/slim/graph/executor.py — runs a GraphWrapper's
+program through the one Executor."""
+
+from ....framework.executor import Executor
+
+__all__ = ["SlimGraphExecutor"]
+
+
+class SlimGraphExecutor:
+    def __init__(self, place=None):
+        self.exe = Executor(place)
+
+    def run(self, graph, scope=None, data=None):
+        feed = data if isinstance(data, dict) else None
+        fetch = list(graph.out_nodes.values())
+        return self.exe.run(graph.program, feed=feed, fetch_list=fetch,
+                            scope=scope)

@@ -1,0 +1,83 @@
+"""Slim graph wrappers.
+
+Parity: contrib/slim/graph/graph_wrapper.py — uniform views over a
+Program for the compression strategies (iterate ops, look up vars,
+trace producers/consumers).  Wraps the JSON-IR Program directly; the
+reference's IrGraph round-trip is unnecessary since the Program IS the
+graph here.
+"""
+
+__all__ = ["GraphWrapper", "VarWrapper", "OpWrapper"]
+
+
+class VarWrapper:
+    def __init__(self, var, graph):
+        self._var = var
+        self._graph = graph
+
+    def name(self):
+        return self._var.name
+
+    def shape(self):
+        return list(self._var.shape or [])
+
+    def outputs(self):
+        """Ops consuming this var."""
+        return [op for op in self._graph.ops()
+                if self.name() in {n for ns in op._op.inputs.values()
+                                   for n in ns}]
+
+    def inputs(self):
+        """Ops producing this var."""
+        return [op for op in self._graph.ops()
+                if self.name() in {n for ns in op._op.outputs.values()
+                                   for n in ns}]
+
+
+class OpWrapper:
+    def __init__(self, op, graph):
+        self._op = op
+        self._graph = graph
+
+    def type(self):
+        return self._op.type
+
+    def attr(self, name):
+        return self._op.attrs.get(name)
+
+    def set_attr(self, name, value):
+        self._op.attrs[name] = value
+
+    def inputs(self, slot=None):
+        names = (self._op.inputs.get(slot, []) if slot else
+                 [n for ns in self._op.inputs.values() for n in ns])
+        return [self._graph.var(n) for n in names]
+
+    def outputs(self, slot=None):
+        names = (self._op.outputs.get(slot, []) if slot else
+                 [n for ns in self._op.outputs.values() for n in ns])
+        return [self._graph.var(n) for n in names]
+
+
+class GraphWrapper:
+    def __init__(self, program, in_nodes=None, out_nodes=None):
+        self.program = program
+        self.in_nodes = dict(in_nodes or {})
+        self.out_nodes = dict(out_nodes or {})
+
+    def ops(self):
+        return [OpWrapper(op, self)
+                for op in self.program.global_block().ops]
+
+    def vars(self):
+        return [VarWrapper(v, self) for v in self.program.list_vars()]
+
+    def var(self, name):
+        return VarWrapper(self.program.global_block().var(name), self)
+
+    def all_parameters(self):
+        return [VarWrapper(p, self) for p in self.program.all_parameters()]
+
+    def clone(self, for_test=False):
+        return GraphWrapper(self.program.clone(for_test=for_test),
+                            self.in_nodes, self.out_nodes)

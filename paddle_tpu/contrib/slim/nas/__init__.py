@@ -1,0 +1,18 @@
+"""`fluid.contrib.slim.nas` import-path compatibility package.
+
+The reference's LightNAS drives a simulated-annealing controller over
+a socket (controller server on the trainer-0 host, search agents on
+workers) scoring candidates by phone/GPU latency tables.  The
+controller, server, agent, and strategy shell are implemented here
+in-process over localhost TCP (the same control-plane style as
+distributed/ps.py); only the device-latency tables are a documented
+drop — score_fn is the user's to supply (slim/__init__.py rationale).
+"""
+
+from .controller_server import ControllerServer  # noqa: F401
+from .light_nas_strategy import LightNASStrategy  # noqa: F401
+from .search_agent import SearchAgent  # noqa: F401
+from .search_space import SearchSpace  # noqa: F401
+
+__all__ = ["ControllerServer", "SearchAgent", "LightNASStrategy",
+           "SearchSpace"]

@@ -1,0 +1,59 @@
+"""Parity: contrib/slim/nas/controller_server.py — a line-protocol TCP
+server wrapping a controller: agents send "tokens_csv reward", the
+server updates the controller and answers with the next tokens to try.
+"""
+
+import socket
+import threading
+
+from ..searcher.controller import SAController
+
+__all__ = ["ControllerServer"]
+
+
+class ControllerServer:
+    def __init__(self, controller=None, address=("127.0.0.1", 0),
+                 max_client_num=100, search_steps=None, key=None):
+        self._controller = controller or SAController()
+        self._address = address
+        self._search_steps = search_steps
+        self._closed = False
+        self._sock = None
+        self._thread = None
+
+    def start(self):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(self._address)
+        self._sock.listen(16)
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def ip(self):
+        return self._sock.getsockname()[0]
+
+    def port(self):
+        return self._sock.getsockname()[1]
+
+    def _serve(self):
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            with conn:
+                data = conn.recv(65536).decode().strip()
+                if not data:
+                    continue
+                tokens_s, _, reward_s = data.rpartition(" ")
+                tokens = [int(t) for t in tokens_s.split(",") if t]
+                if tokens:
+                    self._controller.update(tokens, float(reward_s))
+                nxt = self._controller.next_tokens()
+                conn.sendall(",".join(map(str, nxt)).encode())
+
+    def close(self):
+        self._closed = True
+        if self._sock is not None:
+            self._sock.close()

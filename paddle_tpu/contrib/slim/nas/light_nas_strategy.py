@@ -1,0 +1,47 @@
+"""Parity: contrib/slim/nas/light_nas_strategy.py — the search loop
+shell: rank 0 runs the ControllerServer, every worker proposes/scores
+candidate token lists through a SearchAgent.  The device-latency
+lookup of the reference is the user-supplied score_fn (documented
+drop: phone latency tables)."""
+
+from ..searcher.controller import SAController
+from .controller_server import ControllerServer
+from .search_agent import SearchAgent
+
+__all__ = ["LightNASStrategy"]
+
+
+class LightNASStrategy:
+    def __init__(self, controller=None, end_epoch=10, target_flops=None,
+                 retrain_epoch=1, metric_name="acc_top1",
+                 server_ip="127.0.0.1", server_port=0,
+                 is_server=True, search_steps=100):
+        self._controller = controller or SAController()
+        self.search_steps = search_steps
+        self._server = None
+        self._agent = None
+        self._is_server = is_server
+        self._addr = (server_ip, server_port)
+
+    def search(self, search_space, score_fn, steps=None):
+        """Run the annealing loop in-process: propose tokens, build via
+        search_space.create_net is the caller's concern inside score_fn;
+        returns (best_tokens, best_reward)."""
+        tokens = self._controller.reset(search_space.range_table(),
+                                        search_space.init_tokens())
+        for _ in range(steps or self.search_steps):
+            reward = float(score_fn(tokens))
+            self._controller.update(tokens, reward)
+            tokens = self._controller.next_tokens()
+        return self._controller.best_tokens, self._controller.max_reward
+
+    def on_compression_begin(self, context):
+        if self._is_server:
+            self._server = ControllerServer(
+                self._controller, self._addr).start()
+            self._agent = SearchAgent(self._server.ip(),
+                                      self._server.port())
+
+    def on_compression_end(self, context):
+        if self._server is not None:
+            self._server.close()

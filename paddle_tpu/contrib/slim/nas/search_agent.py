@@ -1,0 +1,21 @@
+"""Parity: contrib/slim/nas/search_agent.py — the worker-side client
+of ControllerServer."""
+
+import socket
+
+__all__ = ["SearchAgent"]
+
+
+class SearchAgent:
+    def __init__(self, server_ip, server_port, key=None):
+        self._addr = (server_ip, int(server_port))
+
+    def update(self, tokens, reward):
+        """Report (tokens, reward); returns the next tokens to try."""
+        with socket.create_connection(self._addr, timeout=30) as s:
+            msg = ",".join(map(str, tokens)) + " " + str(float(reward))
+            s.sendall(msg.encode())
+            return [int(t) for t in s.recv(65536).decode().split(",")]
+
+    def next_tokens(self):
+        return self.update([], -1e30)

@@ -1,0 +1,18 @@
+"""Parity: contrib/slim/nas/search_space.py — the user-subclassed
+space: token ranges, token->arch materialisation."""
+
+__all__ = ["SearchSpace"]
+
+
+class SearchSpace:
+    def init_tokens(self):
+        """Initial token list."""
+        raise NotImplementedError
+
+    def range_table(self):
+        """Per-token cardinality list."""
+        raise NotImplementedError
+
+    def create_net(self, tokens=None):
+        """Materialise (train_program, eval_program, ...) for tokens."""
+        raise NotImplementedError

@@ -1,5 +1,5 @@
 """`fluid.contrib.slim.prune` import-path compatibility —
 implementation in paddle_tpu/slim/prune.py."""
 
-from ...slim.prune import *  # noqa: F401,F403
-from ...slim.prune import __all__  # noqa: F401
+from ....slim.prune import *  # noqa: F401,F403
+from ....slim.prune import __all__  # noqa: F401

@@ -1,0 +1,21 @@
+"""`fluid.contrib.slim.prune.auto_prune_strategy` parity: annealing
+search over per-layer prune ratios, driven by the in-process
+SAController (the reference's socket-distributed variant collapses to
+the same controller run locally)."""
+
+from ..searcher.controller import SAController
+from .prune_strategy import PruneStrategy
+
+__all__ = ["AutoPruneStrategy"]
+
+
+class AutoPruneStrategy(PruneStrategy):
+    def __init__(self, pruner=None, controller=None, start_epoch=0,
+                 end_epoch=0, min_ratio=0.2, max_ratio=0.8,
+                 metric_name=None, pruned_params=None, retrain_epoch=0):
+        super().__init__(pruner, start_epoch, end_epoch,
+                         (min_ratio + max_ratio) / 2, metric_name,
+                         pruned_params)
+        self.controller = controller or SAController()
+        self.min_ratio = min_ratio
+        self.max_ratio = max_ratio

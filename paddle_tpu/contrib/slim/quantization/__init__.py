@@ -1,7 +1,7 @@
 """`fluid.contrib.slim.quantization` import-path compatibility —
 implementation in paddle_tpu/slim/quantization.py."""
 
-from ...slim import quantization as _q
-from ...slim.quantization import *  # noqa: F401,F403
+from ....slim import quantization as _q
+from ....slim.quantization import *  # noqa: F401,F403
 
 __all__ = [n for n in dir(_q) if not n.startswith("_")]

@@ -1,0 +1,15 @@
+"""`fluid.contrib.slim.quantization.mkldnn_post_training_strategy`
+parity: MKLDNN is an x86 deployment backend with no TPU meaning
+(documented drop); the class exists so imports resolve and its hooks
+are no-ops."""
+
+
+class MKLDNNPostTrainingQuantStrategy:
+    def __init__(self, *a, **kw):
+        pass
+
+    def on_compression_begin(self, context):
+        return None
+
+
+__all__ = ["MKLDNNPostTrainingQuantStrategy"]

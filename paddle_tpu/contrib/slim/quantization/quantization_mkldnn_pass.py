@@ -1,0 +1,20 @@
+"""`fluid.contrib.slim.quantization.quantization_mkldnn_pass` parity.
+
+MKLDNN is an x86 inference backend with no TPU meaning (documented
+drop, SURVEY §7 stage 9); the pass classes exist so imports resolve,
+and apply() is an honest no-op returning the program unchanged."""
+
+
+class QatInt8MkldnnPass:
+    def __init__(self, *a, **kw):
+        pass
+
+    def apply(self, graph):
+        return graph
+
+
+class Qat2Int8MkldnnPass(QatInt8MkldnnPass):
+    pass
+
+
+__all__ = ["QatInt8MkldnnPass", "Qat2Int8MkldnnPass"]

@@ -1,0 +1,17 @@
+"""`fluid.contrib.slim.quantization.quantization_pass` import-path
+compatibility — implementation in paddle_tpu/slim/quantization.py."""
+
+from ....slim.quantization import (  # noqa: F401
+    AddQuantDequantPass,
+    ConvertToInt8Pass,
+    QuantizationFreezePass,
+    QuantizationTransformPass,
+    ScaleForInferencePass,
+    ScaleForTrainingPass,
+    TransformForMobilePass,
+)
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "ConvertToInt8Pass", "TransformForMobilePass",
+           "ScaleForTrainingPass", "ScaleForInferencePass",
+           "AddQuantDequantPass"]

@@ -1,0 +1,6 @@
+"""`fluid.contrib.slim.quantization.quantization_strategy` parity —
+implementation in paddle_tpu/slim/quantization.py."""
+
+from ....slim.quantization import QuantizationStrategy  # noqa: F401
+
+__all__ = ["QuantizationStrategy"]

@@ -1,0 +1,9 @@
+"""`fluid.contrib.slim.searcher` import-path compatibility package.
+
+The in-process controllers live in .controller; the socket
+controller-SERVER + phone-latency tables of the reference's LightNAS
+remain a documented drop (see paddle_tpu/slim/__init__.py)."""
+
+from .controller import EvolutionaryController, SAController  # noqa: F401
+
+__all__ = ["EvolutionaryController", "SAController"]

@@ -1,0 +1,90 @@
+"""In-process search controllers.
+
+Parity: contrib/slim/searcher/controller.py — EvolutionaryController
+(base protocol) and SAController (simulated annealing over integer
+token lists).  These are pure-Python and fully functional; only the
+reference's socket server distribution layer is dropped (slim NAS
+rationale in paddle_tpu/slim/__init__.py).
+"""
+
+import copy
+import math
+import random
+
+__all__ = ["EvolutionaryController", "SAController"]
+
+
+class EvolutionaryController:
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+    def reset(self, range_table, constrain_func=None):
+        raise NotImplementedError
+
+    def next_tokens(self):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated annealing: perturb one token per step; accept worse
+    rewards with prob exp((reward - best) / T), T decaying by
+    reduce_rate each iteration."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=None):
+        self._range_table = list(range_table or [])
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._reward = -math.inf
+        self._tokens = None
+        self._max_reward = -math.inf
+        self._best_tokens = None
+        self._iter = 0
+        self._constrain_func = None
+        self._rng = random.Random(seed)
+
+    def reset(self, range_table, init_tokens=None, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = (list(init_tokens) if init_tokens is not None
+                        else [self._rng.randrange(r)
+                              for r in self._range_table])
+        self._iter = 0
+        return self._tokens
+
+    def update(self, tokens, reward):
+        """Accept/reject `tokens` given its measured reward; returns
+        True if accepted as the current state."""
+        self._iter += 1
+        temperature = (self._init_temperature
+                       * self._reduce_rate ** self._iter)
+        accept = (reward > self._reward
+                  or self._rng.random() < math.exp(
+                      (reward - self._reward) / max(temperature, 1e-9)))
+        if accept:
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+        return accept
+
+    def next_tokens(self, control_token=None):
+        tokens = copy.deepcopy(control_token if control_token is not None
+                               else self._tokens)
+        for _ in range(1000):
+            cand = list(tokens)
+            i = self._rng.randrange(len(cand))
+            cand[i] = self._rng.randrange(self._range_table[i])
+            if self._constrain_func is None or self._constrain_func(cand):
+                return cand
+        return tokens
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward

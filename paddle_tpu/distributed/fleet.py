@@ -189,3 +189,25 @@ def save_inference_model(executor, dirname, feeded_var_names,
     return io.save_inference_model(dirname, feeded_var_names,
                                    target_vars, executor,
                                    main_program=main_program)
+
+
+class Fleet:
+    """Base-class parity (reference fleet_base.py Fleet ABC): the
+    module-level functions (init/worker_index/distributed_optimizer/...)
+    are the one implementation; this class offers them as methods for
+    scripts subclassing or type-checking against Fleet."""
+
+    def init(self, role_maker=None):
+        return init(role_maker)
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)

@@ -75,6 +75,11 @@ __all__ += _nn.__all__ + _container.__all__ + parallel.__all__ + [
     "parallel"]
 
 _in_dygraph = True
+# guard nesting depth: framework.in_dygraph_mode() reports True only
+# inside dygraph.guard(), matching the reference's tracer-active
+# semantics (static-graph scripts branch on it), while enabled() keeps
+# this design's eager-always answer.
+_guard_depth = 0
 
 
 @contextlib.contextmanager
@@ -82,10 +87,13 @@ def guard(place=None):
     """Enter recorded eager mode: pushes a fresh autodiff tape so
     `loss.backward()` works (parity: dygraph/base.py:190 guard enabling
     the tracer).  Eager execution itself is always on."""
+    global _guard_depth
     tape = push_tape(Tape())
+    _guard_depth += 1
     try:
         yield
     finally:
+        _guard_depth -= 1
         tape.release()
         pop_tape()
 

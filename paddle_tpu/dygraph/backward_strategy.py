@@ -1,0 +1,16 @@
+"""`fluid.dygraph.backward_strategy` parity.
+
+The reference's BackwardStrategy controls gradient-sum ordering in the
+C++ imperative engine (sort_sum_gradient).  Under jax.vjp the gradient
+accumulation order is the compiler's, deterministic per program; the
+class is kept so 1.x scripts constructing it (and passing it to
+loss.backward()) run unchanged.
+"""
+
+
+class BackwardStrategy:
+    def __init__(self):
+        self.sort_sum_gradient = False
+
+
+__all__ = ["BackwardStrategy"]

@@ -1,0 +1,36 @@
+"""`fluid.dygraph.base` import-path compatibility.
+
+Parity: python/paddle/fluid/dygraph/base.py — guard/no_grad/grad/
+to_variable/enabled live on the dygraph package; enable_dygraph /
+disable_dygraph hold a module-level guard so scripts using the global
+toggle (instead of the context manager) work.
+"""
+
+from . import grad, guard, no_grad, to_variable  # noqa: F401
+from . import enabled as _enabled
+
+_global_guard = None
+
+
+def enabled():
+    return _enabled()
+
+
+def enable_dygraph(place=None):
+    """Enter a process-global dygraph guard (reference base.py
+    enable_dygraph)."""
+    global _global_guard
+    if _global_guard is None:
+        _global_guard = guard(place)
+        _global_guard.__enter__()
+
+
+def disable_dygraph():
+    global _global_guard
+    if _global_guard is not None:
+        _global_guard.__exit__(None, None, None)
+        _global_guard = None
+
+
+__all__ = ["no_grad", "grad", "guard", "enable_dygraph",
+           "disable_dygraph", "enabled", "to_variable"]

@@ -1,0 +1,12 @@
+"""`fluid.dygraph.checkpoint` import-path compatibility.
+
+Parity: python/paddle/fluid/dygraph/checkpoint.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.dygraph import (  # noqa: F401
+    load_dygraph,
+    save_dygraph,
+)
+
+__all__ = ['load_dygraph', 'save_dygraph']

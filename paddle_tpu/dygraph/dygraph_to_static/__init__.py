@@ -1,0 +1,7 @@
+"""`fluid.dygraph.dygraph_to_static` import-path compatibility package.
+
+The AST-based conversion lives in paddle_tpu/dygraph_to_static/ (one
+implementation); these submodules map the reference's internal class
+names onto it."""
+
+from ...dygraph_to_static import *  # noqa: F401,F403

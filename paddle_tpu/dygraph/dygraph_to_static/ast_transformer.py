@@ -1,0 +1,10 @@
+"""Reference ast_transformer.py parity: DygraphToStaticAst is the
+root AST pass; here the root pass is transformer.ControlFlowTransformer
+plus the convert-call rewriter (dygraph_to_static/transformer.py)."""
+
+from ...dygraph_to_static.transformer import (  # noqa: F401
+    ControlFlowTransformer as DygraphToStaticAst,
+)
+from ...dygraph_to_static import convert_to_static  # noqa: F401
+
+__all__ = ["DygraphToStaticAst", "convert_to_static"]

@@ -1,0 +1,8 @@
+"""Reference break_continue_transformer.py parity — implementation in
+dygraph_to_static/transformer.py."""
+
+from ...dygraph_to_static.transformer import (  # noqa: F401
+    BreakContinueTransformer,
+)
+
+__all__ = ["BreakContinueTransformer"]

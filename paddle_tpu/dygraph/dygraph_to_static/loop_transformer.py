@@ -1,0 +1,30 @@
+"""Reference loop_transformer.py parity: while/for -> lax loop staging
+is part of ControlFlowTransformer here (one pass handles if/while/for);
+NameVisitor's role (loop-carried name discovery) is the module-level
+_assigned_names helper."""
+
+import ast as _ast
+
+from ...dygraph_to_static.transformer import (  # noqa: F401
+    ControlFlowTransformer as LoopTransformer,
+    _assigned_names,
+)
+
+
+class NameVisitor(_ast.NodeVisitor):
+    """Collect names assigned anywhere under a node (the reference
+    visitor's loop-var discovery role)."""
+
+    def __init__(self, root=None):
+        self.names = set()
+        if root is not None:
+            self.visit(root)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (_ast.Store, _ast.AugStore if hasattr(
+                _ast, "AugStore") else _ast.Store)):
+            self.names.add(node.id)
+        self.generic_visit(node)
+
+
+__all__ = ["LoopTransformer", "NameVisitor"]

@@ -1,0 +1,23 @@
+"""Reference program_translator.py parity — ProgramTranslator lives in
+paddle_tpu.jit; convert_function_with_cache is the cached AST
+conversion entry."""
+
+import functools
+
+from ...jit import ProgramTranslator  # noqa: F401
+from ...dygraph_to_static import convert_to_static
+
+
+@functools.lru_cache(maxsize=None)
+def _cached(fn):
+    return convert_to_static(fn)
+
+
+def convert_function_with_cache(fn):
+    try:
+        return _cached(fn)
+    except TypeError:          # unhashable callables convert uncached
+        return convert_to_static(fn)
+
+
+__all__ = ["ProgramTranslator", "convert_function_with_cache"]

@@ -1,0 +1,59 @@
+"""Reference static_analysis.py parity.
+
+The reference's NodeVarType lattice + AstNodeWrapper drive type
+inference for declarative conversion; the trace-based conversion here
+gets real types from tracing, so these are minimal functional stand-ins
+for scripts introspecting the machinery."""
+
+import ast as _ast
+
+
+class NodeVarType:
+    UNKNOWN = 0
+    STATEMENT = 1
+    PADDLE_DYGRAPH_API = 2
+    PADDLE_CONTROL_FLOW = 3
+    TENSOR = 100
+    NUMPY_NDARRAY = 101
+    INT = 200
+    FLOAT = 201
+    BOOLEAN = 202
+    STRING = 203
+    NONE = 204
+
+
+class AstNodeWrapper:
+    def __init__(self, node, parent=None):
+        self.node = node
+        self.parent = parent
+        self.children = []
+        self.node_var_type = {NodeVarType.UNKNOWN}
+
+
+class StaticAnalysisVisitor:
+    """Build the wrapper tree (the reference's traversal skeleton)."""
+
+    def __init__(self, ast_root=None):
+        self.node_wrapper_root = None
+        self._map = {}
+        if ast_root is not None:
+            self.run(ast_root)
+
+    def run(self, ast_root):
+        def build(node, parent):
+            w = AstNodeWrapper(node, parent)
+            self._map[id(node)] = w
+            for child in _ast.iter_child_nodes(node):
+                w.children.append(build(child, w))
+            return w
+        self.node_wrapper_root = build(ast_root, None)
+        return self.node_wrapper_root
+
+    def get_node_wrapper_root(self):
+        return self.node_wrapper_root
+
+    def get_node_to_wrapper_map(self):
+        return self._map
+
+
+__all__ = ["AstNodeWrapper", "NodeVarType", "StaticAnalysisVisitor"]

@@ -1,0 +1,22 @@
+"""Reference variable_trans_func.py parity: gast-node factories used by
+the reference's codegen; here conversion emits Python AST directly, so
+these return the equivalent ast nodes."""
+
+import ast as _ast
+
+
+def to_static_variable_gast_node(name):
+    """AST for `name = paddle_tpu.dygraph.to_variable(name)`."""
+    return _ast.parse(
+        f"{name} = paddle_tpu.dygraph.to_variable({name})").body[0]
+
+
+def create_static_variable_gast_node(name):
+    """AST for declaring a data variable placeholder."""
+    return _ast.parse(
+        f"{name} = paddle_tpu.data(name={name!r}, shape=[-1], "
+        f"dtype='float32')").body[0]
+
+
+__all__ = ["to_static_variable_gast_node",
+           "create_static_variable_gast_node"]

@@ -1,0 +1,28 @@
+"""`fluid.dygraph.jit` import-path compatibility.
+
+Parity: python/paddle/fluid/dygraph/jit.py — TracedLayer and the
+dygraph_to_static_* tracers live in paddle_tpu.jit (the one
+trace/convert implementation); the reference's four decorators all map
+onto `to_static`, whose traced Program provides code/program/output
+views.
+"""
+
+from ..jit import TracedLayer, declarative, to_static  # noqa: F401
+
+dygraph_to_static_func = to_static
+dygraph_to_static_program = to_static
+dygraph_to_static_output = to_static
+
+
+def dygraph_to_static_code(fn):
+    """Reference returns the transformed source; here conversion is
+    trace-based, so the honest answer is the original source (the
+    traced Program is the artifact — use to_static(fn) for it)."""
+    import inspect
+
+    return inspect.getsource(fn)
+
+
+__all__ = ["TracedLayer", "declarative", "dygraph_to_static_code",
+           "dygraph_to_static_func", "dygraph_to_static_output",
+           "dygraph_to_static_program"]

@@ -1,0 +1,11 @@
+"""`fluid.dygraph.layers` import-path compatibility.
+
+Parity: python/paddle/fluid/dygraph/layers.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.dygraph import (  # noqa: F401
+    Layer,
+)
+
+__all__ = ['Layer']

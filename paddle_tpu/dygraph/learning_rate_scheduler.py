@@ -1,0 +1,133 @@
+"""Dygraph learning-rate decay classes.
+
+Parity: python/paddle/fluid/dygraph/learning_rate_scheduler.py —
+NoamDecay (:NoamDecay), PiecewiseDecay, NaturalExpDecay,
+ExponentialDecay, InverseTimeDecay, PolynomialDecay, CosineDecay.
+
+TPU-first design: every decay is a PURE function of the step count, so
+an instance is directly usable as an optax schedule (the dygraph
+optimizer factories pass `learning_rate` straight into optax, which
+calls schedules with the traced update count) — no mutable LR variable
+needs to live in the compiled step.  The reference's stateful protocol
+(`.step()` advancing an internal counter, instance called with no
+arguments) is kept for script parity.
+"""
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = ["LearningRateDecay", "PiecewiseDecay", "NaturalExpDecay",
+           "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+           "CosineDecay", "NoamDecay"]
+
+
+class LearningRateDecay:
+    def __init__(self, begin=0, step=1, dtype="float32"):
+        self.step_num = begin
+        self.step_size = step
+        self.dtype = dtype
+
+    def step(self):
+        """Advance the internal counter (reference protocol)."""
+        self.step_num += self.step_size
+
+    def value(self, step_num):
+        raise NotImplementedError
+
+    def __call__(self, step_num=None):
+        if step_num is None:
+            step_num = self.step_num
+        return jnp.asarray(self.value(step_num), jnp.float32)
+
+
+class PiecewiseDecay(LearningRateDecay):
+    def __init__(self, boundaries, values, begin=0, step=1,
+                 dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+
+    def value(self, n):
+        lr = self.values[-1]
+        bs = jnp.asarray(self.boundaries)
+        idx = jnp.searchsorted(bs, jnp.asarray(n), side="right")
+        return jnp.asarray(self.values)[idx] if len(
+            self.values) == len(self.boundaries) + 1 else lr
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.lr, self.ds, self.dr = learning_rate, decay_steps, decay_rate
+        self.staircase = staircase
+
+    def value(self, n):
+        p = jnp.asarray(n, jnp.float32) / self.ds
+        if self.staircase:
+            p = jnp.floor(p)
+        return self.lr * jnp.exp(-self.dr * p)
+
+
+class ExponentialDecay(NaturalExpDecay):
+    def value(self, n):
+        p = jnp.asarray(n, jnp.float32) / self.ds
+        if self.staircase:
+            p = jnp.floor(p)
+        return self.lr * self.dr ** p
+
+
+class InverseTimeDecay(NaturalExpDecay):
+    def value(self, n):
+        p = jnp.asarray(n, jnp.float32) / self.ds
+        if self.staircase:
+            p = jnp.floor(p)
+        return self.lr / (1.0 + self.dr * p)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=1e-4,
+                 power=1.0, cycle=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.lr, self.ds = learning_rate, decay_steps
+        self.end_lr, self.power, self.cycle = end_learning_rate, power, cycle
+
+    def value(self, n):
+        n = jnp.asarray(n, jnp.float32)
+        ds = jnp.asarray(self.ds, jnp.float32)
+        if self.cycle:
+            mult = jnp.ceil(jnp.maximum(n, 1.0) / ds)
+            ds = ds * mult
+        else:
+            n = jnp.minimum(n, ds)
+        return ((self.lr - self.end_lr)
+                * (1 - n / ds) ** self.power + self.end_lr)
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.lr = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def value(self, n):
+        epoch = jnp.floor(jnp.asarray(n, jnp.float32)
+                          / self.step_each_epoch)
+        return (self.lr * 0.5
+                * (jnp.cos(epoch * math.pi / self.epochs) + 1))
+
+
+class NoamDecay(LearningRateDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 dtype="float32", learning_rate=1.0):
+        super().__init__(begin, step, dtype)
+        self.d_model, self.warmup, self.lr = d_model, warmup_steps, \
+            learning_rate
+
+    def value(self, n):
+        n = jnp.maximum(jnp.asarray(n, jnp.float32), 1.0)
+        return (self.lr * self.d_model ** -0.5
+                * jnp.minimum(n ** -0.5, n * self.warmup ** -1.5))

@@ -1,0 +1,19 @@
+"""`fluid.dygraph.profiler` import-path compatibility.
+
+Parity: python/paddle/fluid/dygraph/profiler.py — gperf hooks have no
+TPU meaning; they map onto the one profiler implementation's start/stop
+so scripts bracketing training with them still collect spans.
+"""
+
+from ..profiler import start_profiler as _start, stop_profiler as _stop
+
+
+def start_gperf_profiler():
+    _start()
+
+
+def stop_gperf_profiler():
+    _stop()
+
+
+__all__ = ["start_gperf_profiler", "stop_gperf_profiler"]

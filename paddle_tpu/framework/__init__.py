@@ -25,3 +25,115 @@ __all__ = [
     "global_scope", "scope_guard", "append_backward", "gradients",
     "ParamAttr", "initializer", "unique_name",
 ]
+
+
+# ---- device/place helpers + version/dygraph introspection ----------------
+# (reference framework.py: cuda_places :318, cpu_places :368,
+#  cuda_pinned_places :399, in_dygraph_mode :222, is_compiled_with_cuda
+#  :342, load_op_library :..., require_version :129, device_guard :5461)
+
+def cpu_places(device_count=None):
+    """List of CPUPlace; count defaults to CPU_NUM (reference) or 1."""
+    import os
+
+    from ..core.place import CPUPlace
+
+    if device_count is None:
+        device_count = int(os.environ.get("CPU_NUM", "1"))
+    return [CPUPlace() for _ in range(device_count)]
+
+
+def cuda_places(device_ids=None):
+    """Reference lists CUDA devices; here the accelerator set is the
+    jax device list (TPU chips), exposed as TPUPlace — a 1.x script's
+    `places=fluid.cuda_places()` keeps meaning "all accelerators"."""
+    import jax
+
+    from ..core.place import TPUPlace
+
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if device_ids is not None:
+        return [TPUPlace(i) for i in device_ids]
+    if not devs:
+        return cpu_places()
+    return [TPUPlace(d.id) for d in devs]
+
+
+def cuda_pinned_places(device_count=None):
+    """Pinned host memory has no XLA-level control; returns CPU places
+    (honest shim, same count semantics as the reference)."""
+    return cpu_places(device_count)
+
+
+def in_dygraph_mode():
+    """True inside dygraph.guard() (reference: tracer active)."""
+    from .. import dygraph
+
+    return dygraph._guard_depth > 0
+
+
+def is_compiled_with_cuda():
+    """Always False: this build targets TPU via XLA, never CUDA."""
+    return False
+
+
+def load_op_library(lib_path):
+    """The reference dlopens a custom-op .so and re-generates layer
+    wrappers.  Custom native ops here are Pallas kernels registered via
+    ops.registry; there is no compatible binary ABI to load, so this
+    raises with the migration pointer instead of silently ignoring."""
+    raise NotImplementedError(
+        f"load_op_library({lib_path!r}): CUDA/C++ custom-op libraries "
+        "have no TPU ABI; register a JAX/Pallas kernel via "
+        "paddle_tpu.ops.registry.register_op instead")
+
+
+def require_version(min_version, max_version=None):
+    """Version gate (reference framework.py:129): validates THIS
+    package's version against [min_version, max_version]."""
+    from ..version import full_version
+
+    def parse(v):
+        parts = []
+        for p in str(v).split("."):
+            parts.append(int(p) if p.isdigit() else 0)
+        return (parts + [0, 0, 0, 0])[:4]
+
+    if not isinstance(min_version, str) or (
+            max_version is not None and not isinstance(max_version, str)):
+        raise TypeError("require_version: version args must be str")
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {full_version} < required min "
+            f"{min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {full_version} > allowed max "
+            f"{max_version}")
+
+
+class _DeviceGuard:
+    def __init__(self, device):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def device_guard(device=None):
+    """Reference pins ops in the block to a device (framework.py:5461).
+    Under XLA, placement inside one program is the compiler's decision,
+    so the context is an honest no-op kept for script parity."""
+    if device not in (None, "cpu", "gpu", "tpu") and not str(
+            device).startswith(("gpu:", "tpu:")):
+        raise ValueError(f"device_guard: unknown device {device!r}")
+    return _DeviceGuard(device)
+
+
+__all__ += ["cpu_places", "cuda_places", "cuda_pinned_places",
+            "in_dygraph_mode", "is_compiled_with_cuda",
+            "load_op_library", "require_version", "device_guard"]

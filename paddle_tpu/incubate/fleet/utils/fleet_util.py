@@ -1,0 +1,45 @@
+"""`incubate.fleet.utils.fleet_util` import-path compatibility.
+
+Parity: the reference's FleetUtil bundles rank-0 logging and global
+metric computation; the metric math lives in
+distributed/fleet_util.py (psum-form global AUC/accuracy).
+"""
+
+import sys
+
+from ....distributed import fleet as _fleet
+from ....distributed.fleet_util import (  # noqa: F401
+    global_accuracy,
+    global_auc,
+    sum_accumulators,
+)
+
+
+class FleetUtil:
+    def rank0_print(self, s):
+        if _fleet.worker_index() == 0:
+            print(s, file=sys.stderr, flush=True)
+
+    rank0_info = rank0_print
+    rank0_error = rank0_print
+
+    def print_global_auc(self, scope=None, stat_pos="_generated_var_2",
+                         stat_neg="_generated_var_3",
+                         print_prefix=""):
+        auc = self.get_global_auc(scope, stat_pos, stat_neg)
+        self.rank0_print(f"{print_prefix} global auc = {auc}")
+        return auc
+
+    def get_global_auc(self, scope=None, stat_pos="_generated_var_2",
+                       stat_neg="_generated_var_3"):
+        from ....framework.executor import global_scope
+
+        scope = scope or global_scope()
+        pos = scope.find_var(stat_pos)
+        neg = scope.find_var(stat_neg)
+        if pos is None or neg is None:
+            return None
+        return global_auc([pos], [neg])
+
+
+__all__ = ["FleetUtil"]

@@ -1,0 +1,11 @@
+"""`fluid.incubate.fleet.utils.hdfs` import-path compatibility.
+
+Parity: python/paddle/fluid/incubate/fleet/utils/hdfs.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.incubate.fleet.utils import (  # noqa: F401
+    HDFSClient,
+)
+
+__all__ = ['HDFSClient']

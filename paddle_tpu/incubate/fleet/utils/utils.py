@@ -1,0 +1,85 @@
+"""`incubate.fleet.utils.utils` import-path compatibility.
+
+Parity: reference fleet/utils/utils.py program io/inspection helpers,
+mapped onto the one Program JSON serialization (framework/program.py)
+and io.py: load_program/save_program round-trip the IR;
+program_type_trans converts between the text and binary spellings
+(both JSON here); check_saved_vars_try_dump inspects a saved model dir.
+"""
+
+import os
+
+from ....framework.program import Program
+
+__all__ = ["load_program", "save_program", "program_type_trans",
+           "check_saved_vars_try_dump", "check_not_expected_ops",
+           "parse_program", "check_pruned_program_vars", "graphviz"]
+
+
+def save_program(program, model_filename):
+    with open(model_filename, "w") as f:
+        f.write(program.to_json())
+    return model_filename
+
+
+def load_program(model_filename, is_text=True):
+    with open(model_filename) as f:
+        return Program.from_json(f.read())
+
+
+def program_type_trans(prog_dir, prog_fn, is_text):
+    """Reference converts text<->binary ProgramDesc; the IR here has a
+    single JSON spelling, so the 'converted' file is a copy with the
+    conventional suffix."""
+    src = os.path.join(prog_dir, prog_fn)
+    dst = prog_fn + (".bin" if is_text else ".pbtxt")
+    with open(src) as f, open(os.path.join(prog_dir, dst), "w") as g:
+        g.write(f.read())
+    return dst
+
+
+def check_not_expected_ops(program, not_expected_op_types=()):
+    present = {op.type for b in [program.global_block()] for op in b.ops}
+    return sorted(present & set(not_expected_op_types))
+
+
+def check_saved_vars_try_dump(dump_dir, dump_prog_fn, is_text_dump_program,
+                              feed_config=None, fetch_config=None,
+                              batch_size=1, save_filename=None):
+    prog = load_program(os.path.join(dump_dir, dump_prog_fn),
+                        is_text_dump_program)
+    return [v.name for v in prog.list_vars()
+            if getattr(v, "persistable", False)]
+
+
+def parse_program(program, output_dir=None):
+    """Pretty-dump a program's ops/vars (reference parse_program): the
+    JSON IR is already the readable form; returns the summary dict."""
+    ops = [op.type for op in program.global_block().ops]
+    out = {"op_count": len(ops), "ops": ops,
+           "vars": [v.name for v in program.list_vars()]}
+    if output_dir:
+        import json as _json
+        import os as _os
+
+        with open(_os.path.join(output_dir, "program.json"), "w") as f:
+            f.write(_json.dumps(out, indent=1))
+    return out
+
+
+def check_pruned_program_vars(train_prog, pruned_prog):
+    """Vars present in the train program but missing after pruning
+    (reference check_pruned_program_vars)."""
+    train_vars = {v.name for v in train_prog.list_vars()}
+    pruned_vars = {v.name for v in pruned_prog.list_vars()}
+    return sorted(train_vars - pruned_vars)
+
+
+def graphviz(block, output_dir="", filename="program.dot"):
+    """DOT render via the one debugger implementation."""
+    import os as _os
+
+    from ....debugger import draw_block_graphviz
+
+    path = _os.path.join(output_dir, filename) if output_dir else filename
+    return draw_block_graphviz(block, path=path)

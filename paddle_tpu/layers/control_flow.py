@@ -747,3 +747,24 @@ def Print(input, first_n=-1, message=None, summarize=20,
     return _single_out("print", {"In": input},
                        {"message": message or "", "first_n": first_n,
                         "summarize": summarize}, same_shape=True)
+
+
+# --- reference fluid/layers/control_flow.py __all__ parity -----------------------
+# These names are implemented in sibling modules of this package; a
+# PEP 562 module __getattr__ resolves them through the aggregate
+# namespace so 1.x submodule imports (`from paddle.fluid.layers.control_flow
+# import reorder_lod_tensor_by_rank`) work without circular imports.
+_REF_PARITY_NAMES = ['is_empty', 'reorder_lod_tensor_by_rank']
+
+
+def __getattr__(name):
+    if name in _REF_PARITY_NAMES:
+        from paddle_tpu import layers as _agg
+
+        return getattr(_agg, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_REF_PARITY_NAMES))

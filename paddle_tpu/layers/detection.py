@@ -1,0 +1,22 @@
+"""`fluid.layers.detection` import-path compatibility.
+
+Parity: python/paddle/fluid/layers/detection.py — the detection builder
+corpus is implemented in ops/detection_ops.py and exposed on the
+aggregate layers namespace; this module resolves the reference's
+submodule path onto it lazily (PEP 562) to avoid circular imports.
+"""
+
+_REF_PARITY_NAMES = ['anchor_generator', 'bipartite_match', 'box_clip', 'box_coder', 'box_decoder_and_assign', 'collect_fpn_proposals', 'density_prior_box', 'detection_output', 'distribute_fpn_proposals', 'generate_mask_labels', 'generate_proposal_labels', 'generate_proposals', 'iou_similarity', 'locality_aware_nms', 'multi_box_head', 'multiclass_nms', 'polygon_box_transform', 'prior_box', 'retinanet_detection_output', 'retinanet_target_assign', 'roi_perspective_transform', 'rpn_target_assign', 'sigmoid_focal_loss', 'ssd_loss', 'target_assign', 'yolo_box', 'yolov3_loss']
+
+
+def __getattr__(name):
+    if name in _REF_PARITY_NAMES:
+        from paddle_tpu import layers as _agg
+
+        return getattr(_agg, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_REF_PARITY_NAMES))

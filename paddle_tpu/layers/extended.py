@@ -50,6 +50,7 @@ __all__ = [
     "roi_align", "roi_pool", "prroi_pool", "psroi_pool",
     "roi_perspective_transform", "deformable_conv",
     "deformable_roi_pooling", "generate_proposals",
+    "generate_proposal_labels", "generate_mask_labels",
     "collect_fpn_proposals", "distribute_fpn_proposals",
     "rpn_target_assign", "retinanet_target_assign", "target_assign",
     "retinanet_detection_output", "detection_output",
@@ -1287,6 +1288,50 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
     # the (scaled) offset field resampled to the pooled output
     return _single_out("elementwise_add",
                        {"X": shifted, "Y": scaled}, {"axis": -1})
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """Sample fg/bg RoIs + regression targets for the RCNN head
+    (reference layers/detection.py generate_proposal_labels over
+    detection/generate_proposal_labels_op.cc; kernel in
+    ops/detection_ops.py)."""
+    outs = _multi_out(
+        "generate_proposal_labels",
+        {"RpnRois": rpn_rois, "GtClasses": gt_classes,
+         "IsCrowd": is_crowd, "GtBoxes": gt_boxes, "ImInfo": im_info},
+        {"batch_size_per_im": batch_size_per_im,
+         "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+         "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+         "bbox_reg_weights": list(bbox_reg_weights),
+         "class_nums": class_nums or 81, "use_random": use_random,
+         "is_cls_agnostic": is_cls_agnostic,
+         "is_cascade_rcnn": is_cascade_rcnn},
+        ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+         "BboxOutsideWeights"],
+        dtypes=[rpn_rois.dtype, "int32", rpn_rois.dtype, rpn_rois.dtype,
+                rpn_rois.dtype])
+    return tuple(outs)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """Mask R-CNN mask targets (reference layers/detection.py
+    generate_mask_labels over detection/generate_mask_labels_op.cc;
+    kernel in ops/detection_ops.py)."""
+    outs = _multi_out(
+        "generate_mask_labels",
+        {"ImInfo": im_info, "GtClasses": gt_classes, "IsCrowd": is_crowd,
+         "GtSegms": gt_segms, "Rois": rois, "LabelsInt32": labels_int32},
+        {"num_classes": num_classes, "resolution": resolution},
+        ["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+        dtypes=[rois.dtype, "int32", "int32"])
+    return tuple(outs)
 
 
 def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,

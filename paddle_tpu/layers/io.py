@@ -1,0 +1,16 @@
+"""`fluid.layers.io` import-path compatibility.
+
+Parity: python/paddle/fluid/layers/io.py — honest re-export of
+the reference __all__ onto the single implementation.
+"""
+
+from paddle_tpu.layers import (  # noqa: F401
+    create_py_reader_by_data,
+    data,
+    double_buffer,
+    load,
+    py_reader,
+    read_file,
+)
+
+__all__ = ['create_py_reader_by_data', 'data', 'double_buffer', 'load', 'py_reader', 'read_file']

@@ -1,0 +1,70 @@
+"""`fluid.layers.layer_function_generator` import-path compatibility.
+
+Parity: the reference generates Python layer wrappers from OpProto
+metadata (generate_layer_fn/generate_activation_fn) plus doc helpers.
+The op corpus here is the ops.registry; the generators synthesize an
+equivalent builder over a registered kernel, so downstream code that
+manufactures layers from op names keeps working.
+"""
+
+import functools
+import warnings
+
+__all__ = ["deprecated", "generate_layer_fn", "generate_activation_fn",
+           "autodoc", "templatedoc"]
+
+
+def generate_layer_fn(op_type):
+    """Builder over a registered kernel: single-input single-output
+    convention (X -> Out), attrs passed through."""
+    from .extended import _single_out
+
+    def layer(x=None, name=None, **attrs):
+        ins = {"X": x} if x is not None else {}
+        return _single_out(op_type, ins, attrs)
+
+    layer.__name__ = op_type
+    layer.__doc__ = f"Generated layer for the registered op {op_type!r}."
+    return layer
+
+
+def generate_activation_fn(op_type):
+    """Activation builder (X -> Out, no attrs)."""
+    fn = generate_layer_fn(op_type)
+
+    def act(x, name=None):
+        return fn(x, name=name)
+
+    act.__name__ = op_type
+    return act
+
+
+def deprecated(func_or_class):
+    """Mark an API deprecated (reference emits a docstring note)."""
+
+    @functools.wraps(func_or_class)
+    def wrapper(*args, **kwargs):
+        warnings.warn(
+            f"{func_or_class.__name__} is deprecated", DeprecationWarning,
+            stacklevel=2)
+        return func_or_class(*args, **kwargs)
+
+    return wrapper
+
+
+def autodoc(comment=""):
+    def wrapper(func):
+        func.__doc__ = (func.__doc__ or "") + comment
+        return func
+
+    return wrapper
+
+
+def templatedoc(op_type=None):
+    """The reference splices OpProto comments into docstrings; kernels
+    here carry their own docstrings, so this is identity."""
+
+    def wrapper(func):
+        return func
+
+    return wrapper

@@ -109,3 +109,24 @@ def bce_loss(input, label, name=None):
     helper.append_op("bce_loss", inputs={"X": input, "Label": label},
                      outputs={"Out": out})
     return out
+
+
+# --- reference fluid/layers/loss.py __all__ parity -----------------------
+# These names are implemented in sibling modules of this package; a
+# PEP 562 module __getattr__ resolves them through the aggregate
+# namespace so 1.x submodule imports (`from paddle.fluid.layers.loss
+# import center_loss`) work without circular imports.
+_REF_PARITY_NAMES = ['bpr_loss', 'center_loss', 'edit_distance', 'hsigmoid', 'margin_rank_loss', 'nce', 'npair_loss', 'rank_loss', 'sampled_softmax_with_cross_entropy', 'teacher_student_sigmoid_loss', 'warpctc']
+
+
+def __getattr__(name):
+    if name in _REF_PARITY_NAMES:
+        from paddle_tpu import layers as _agg
+
+        return getattr(_agg, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_REF_PARITY_NAMES))

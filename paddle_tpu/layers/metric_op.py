@@ -24,3 +24,24 @@ def accuracy(input, label, k=1, name=None):
         "accuracy", inputs={"Out": values, "Indices": indices, "Label": label},
         outputs={"Accuracy": acc, "Correct": correct, "Total": total})
     return acc
+
+
+# --- reference fluid/layers/metric_op.py __all__ parity -----------------------
+# These names are implemented in sibling modules of this package; a
+# PEP 562 module __getattr__ resolves them through the aggregate
+# namespace so 1.x submodule imports (`from paddle.fluid.layers.metric_op
+# import auc`) work without circular imports.
+_REF_PARITY_NAMES = ['auc']
+
+
+def __getattr__(name):
+    if name in _REF_PARITY_NAMES:
+        from paddle_tpu import layers as _agg
+
+        return getattr(_agg, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_REF_PARITY_NAMES))

@@ -578,3 +578,24 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
     new_cell = sigmoid(f_b) * cell_t_prev + sigmoid(i) * tanh(c_hat)
     new_hidden = sigmoid(o) * tanh(new_cell)
     return new_hidden, new_cell
+
+
+# --- reference fluid/layers/nn.py __all__ parity -----------------------
+# These names are implemented in sibling modules of this package; a
+# PEP 562 module __getattr__ resolves them through the aggregate
+# namespace so 1.x submodule imports (`from paddle.fluid.layers.nn
+# import linear_chain_crf`) work without circular imports.
+_REF_PARITY_NAMES = ['adaptive_pool3d', 'add_position_encoding', 'affine_channel', 'affine_grid', 'autoincreased_step_counter', 'bilinear_tensor_product', 'brelu', 'chunk_eval', 'continuous_value_model', 'conv3d', 'conv3d_transpose', 'cos_sim', 'crf_decoding', 'crop', 'crop_tensor', 'ctc_greedy_decoder', 'data_norm', 'deformable_conv', 'deformable_roi_pooling', 'dice_loss', 'elementwise_add', 'elementwise_div', 'elementwise_floordiv', 'elementwise_max', 'elementwise_min', 'elementwise_mod', 'elementwise_mul', 'elementwise_pow', 'elementwise_sub', 'expand', 'expand_as', 'filter_by_instag', 'flatten', 'fsp_matrix', 'gather', 'gather_nd', 'gather_tree', 'gaussian_random', 'gaussian_random_batch_size_like', 'get_tensor_from_selected_rows', 'grid_sampler', 'hash', 'im2sequence', 'image_resize', 'image_resize_short', 'linear_chain_crf', 'lod_append', 'lod_reset', 'log', 'log_loss', 'logical_and', 'logical_not', 'logical_or', 'logical_xor', 'lrn', 'maxout', 'mean', 'mean_iou', 'merge_selected_rows', 'multiplex', 'pad_constant_like', 'pixel_shuffle', 'pool3d', 'pow', 'prroi_pool', 'psroi_pool', 'py_func', 'random_crop', 'rank', 'reduce_all', 'reduce_any', 'reduce_max', 'reduce_mean', 'reduce_min', 'reduce_prod', 'reduce_sum', 'reshape', 'resize_trilinear', 'roi_align', 'roi_pool', 'row_conv', 'sampling_id', 'scale', 'scatter', 'scatter_nd', 'scatter_nd_add', 'selu', 'shape', 'shard_index', 'shuffle_channel', 'sign', 'similarity_focus', 'size', 'slice', 'smooth_l1', 'soft_relu', 'space_to_depth', 'spectral_norm', 'split', 'squeeze', 'stack', 'stanh', 'strided_slice', 'sum', 'temporal_shift', 'transpose', 'unfold', 'uniform_random', 'uniform_random_batch_size_like', 'unique', 'unique_with_counts', 'unsqueeze', 'unstack', 'where']
+
+
+def __getattr__(name):
+    if name in _REF_PARITY_NAMES:
+        from paddle_tpu import layers as _agg
+
+        return getattr(_agg, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_REF_PARITY_NAMES))

@@ -545,3 +545,24 @@ class BasicDecoder(Decoder):
             time, cell_outputs, cell_states, sample_ids)
         outputs = {"cell_outputs": cell_outputs, "sample_ids": sample_ids}
         return outputs, next_states, next_inputs, finished
+
+
+# --- reference fluid/layers/rnn.py __all__ parity -----------------------
+# These names are implemented in sibling modules of this package; a
+# PEP 562 module __getattr__ resolves them through the aggregate
+# namespace so 1.x submodule imports (`from paddle.fluid.layers.rnn
+# import dynamic_lstm`) work without circular imports.
+_REF_PARITY_NAMES = ['beam_search', 'beam_search_decode', 'dynamic_gru', 'dynamic_lstm', 'dynamic_lstmp', 'gru_unit', 'lstm_unit']
+
+
+def __getattr__(name):
+    if name in _REF_PARITY_NAMES:
+        from paddle_tpu import layers as _agg
+
+        return getattr(_agg, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_REF_PARITY_NAMES))

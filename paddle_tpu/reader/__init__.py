@@ -15,7 +15,7 @@ import threading
 
 import numpy as np
 
-__all__ = ["DataLoader", "batch", "shuffle", "buffered", "map_readers",
+__all__ = ["DataLoader", "PyReader", "batch", "shuffle", "buffered", "map_readers",
            "chain", "compose", "firstn", "cache"]
 
 
@@ -357,3 +357,33 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             raise errors[0]
 
     return mreader
+
+
+class PyReader(DataLoader):
+    """`fluid.io.PyReader` parity (reference reader.py:441): the 1.x
+    name for the generator-fed loader.  decorate_* methods map onto the
+    DataLoader setters; start()/reset() exist for the non-iterable
+    protocol (iteration here is always the iterable protocol, so they
+    are no-ops kept for script parity)."""
+
+    def __init__(self, feed_list=None, capacity=4, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list=feed_list, capacity=capacity,
+                         iterable=iterable)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(sample_generator, batch_size,
+                                         drop_last=drop_last, places=places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places=places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places=places)
+
+    def start(self):
+        return None
+
+    def reset(self):
+        return None

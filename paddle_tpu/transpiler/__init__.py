@@ -29,7 +29,7 @@ get_pserver_program(endpoint) returns the serving handle for that
 endpoint (the ListenAndServ analogue).
 """
 
-from .distributed.ps import PSServer, ShardedPSClient, SparseEmbedding
+from ..distributed.ps import PSServer, ShardedPSClient, SparseEmbedding
 
 
 class DistributeTranspilerConfig:
@@ -114,7 +114,7 @@ class DistributeTranspiler:
 
     def transpile(self, trainer_id, program=None, pservers="", trainers=1,
                   sync_mode=None, startup_program=None):
-        from .framework.program import default_main_program, \
+        from ..framework.program import default_main_program, \
             default_startup_program
 
         program = program if program is not None else default_main_program()
@@ -285,3 +285,51 @@ class DistributeTranspiler:
     def client(self):
         """The shared ShardedPSClient in TCP mode (None in-process)."""
         return getattr(self, "_client", None)
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """Reference transpiler/memory_optimization_transpiler.py — a
+    legacy inplace/memory-reuse pass, deprecated in the reference and
+    superseded here by XLA buffer assignment (SURVEY §7: XLA owns
+    memory planning).  Honest no-op kept for 1.x script parity."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """See memory_optimize: XLA owns buffer lifetime; no-op parity."""
+    return None
+
+
+class HashName:
+    """PS endpoint dispatch policy (reference ps_dispatcher.py:60):
+    hash(var name) % #pservers."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+
+    def dispatch(self, varlist):
+        return [self._eps[hash(v.name if hasattr(v, "name") else v)
+                          % len(self._eps)] for v in varlist]
+
+    def reset(self):
+        pass
+
+
+class RoundRobin:
+    """PS endpoint dispatch policy (reference ps_dispatcher.py:93):
+    cycling assignment."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self._eps[self._i])
+            self._i = (self._i + 1) % len(self._eps)
+        return out
+
+    def reset(self):
+        self._i = 0

@@ -1,0 +1,48 @@
+"""`fluid.transpiler.collective` import-path compatibility.
+
+Parity: python/paddle/fluid/transpiler/collective.py — the reference's
+GradAllReduce/LocalSGD are program-rewriting transpilers inserting
+c_allreduce/broadcast ops.  Under SPMD, gradient allreduce is XLA's
+psum inserted by sharding (distributed/data_parallel.py) and LocalSGD
+is a step-wrapper (distributed/strategies.py LocalSGDTrainStep); these
+classes keep the reference's transpile() entry so 1.x collective
+scripts run — transpile() records the config and the executor's
+sharded path applies the semantics.
+"""
+
+from ..distributed.strategies import LocalSGDTrainStep  # noqa: F401
+
+
+class Collective:
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+        self.nranks = 1
+        self.rank = 0
+
+    def transpile(self, startup_program=None, main_program=None, rank=0,
+                  endpoints="127.0.0.1:6174", current_endpoint=None,
+                  wait_port=True):
+        eps = (endpoints.split(",") if isinstance(endpoints, str)
+               else list(endpoints))
+        self.nranks = len(eps)
+        self.rank = rank
+        self.startup_program = startup_program
+        self.main_program = main_program
+        return self
+
+
+class GradAllReduce(Collective):
+    """DP gradient allreduce: under pjit/shard_map the psum is inserted
+    by XLA from the sharding annotations — nothing to rewrite."""
+
+
+class LocalSGD(Collective):
+    """Periodic parameter averaging; the executing implementation is
+    LocalSGDTrainStep."""
+
+    def __init__(self, nrings=1, k_steps=1):
+        super().__init__(nrings)
+        self.k_steps = k_steps
+
+
+__all__ = ["GradAllReduce", "LocalSGD", "Collective"]
