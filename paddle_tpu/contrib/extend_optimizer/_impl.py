@@ -24,7 +24,7 @@ class DecoupledWeightDecay:
         super().__init__(**kwargs)
 
     def _scale_parameters(self, params_grads):
-        from ..layers import tensor as T
+        from ...layers import tensor as T
 
         if isinstance(self._coeff, (float, int)) and self._coeff == 0.0:
             return []
@@ -46,7 +46,7 @@ class DecoupledWeightDecay:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        from ..layers import tensor as T
+        from ...layers import tensor as T
 
         params_grads = self.backward(loss,
                                      startup_program=startup_program,
@@ -73,7 +73,7 @@ def extend_with_decoupled_weight_decay(base_optimizer):
         AdamW = extend_with_decoupled_weight_decay(fluid.optimizer.Adam)
         AdamW(weight_decay=0.01, learning_rate=1e-3).minimize(loss)
     """
-    from ..optimizer import Optimizer
+    from ...optimizer import Optimizer
 
     if not (isinstance(base_optimizer, type)
             and issubclass(base_optimizer, Optimizer)):

@@ -80,6 +80,33 @@ def tpu_rows():
         return 0
 
 
+def stale_row_keys(head, ignore=()):
+    """Row keys whose captured sha trails `head` (bench.py merges over
+    prior captures, so a partially-failed run leaves old-sha rows
+    behind — every row must carry HEAD for the evidence to be fresh).
+    Rows with a null sha (bench's _git_sha timed out) are unknowable,
+    not stale: treating them as stale would re-arm the daemon forever
+    and starve the chip.  `ignore` lists keys a previous good capture
+    failed to refresh (persistently-failing or retired configs) —
+    equally capable of pinning the fast re-arm loop for the round."""
+    if not head:
+        return set()
+    try:
+        with open(os.path.join(REPO, "BENCH_TPU.json")) as f:
+            rows = json.load(f).get("rows", {})
+        return {k for k, r in rows.items()
+                if k not in ignore and isinstance(r, dict)
+                and r.get("git_sha") and r.get("git_sha") != head}
+    except Exception:
+        return set()
+
+
+def head_sha():
+    sys.path.insert(0, REPO)
+    from bench import _git_sha
+    return _git_sha() or ""
+
+
 def bench_tpu_mtime():
     """This-run signal: bench.py only (re)writes BENCH_TPU.json when it
     actually captured rows ON CHIP, so an mtime advance means THIS run
@@ -101,10 +128,12 @@ def main():
     args = ap.parse_args()
 
     deadline = time.time() + args.max_hours * 3600
+    unrefreshable = set()
     log("capture daemon up; deadline in %.1fh" % args.max_hours)
     while time.time() < deadline:
         if probe(args.probe_timeout):
             log("tunnel UP — running bench.py on chip")
+            head_at_start = head_sha()
             mtime_before = bench_tpu_mtime()
             rc = run_locked("bench.py", args.bench_timeout)
             rows = tpu_rows()
@@ -122,7 +151,26 @@ def main():
                 rc2 = run_locked("tools/resnet50_tpu_tune.py",
                                  args.bench_timeout)
                 log("sweep rc=%s" % rc2)
-            sleep = args.captured_sleep if good else args.down_sleep
+            # re-arm fast while any captured row trails HEAD — the
+            # round's evidence must carry the end-of-round sha
+            # (VERDICT r4 next-round #2), so a capture of stale code
+            # does not buy a long sleep.  A row still stale after a
+            # good full capture can never be refreshed (its config
+            # fails persistently or was retired) — stop chasing it,
+            # or it pins the fast loop and starves the chip.
+            # unrefreshable = rows a good capture failed to bring to
+            # the sha it STARTED at (commits landing mid-capture must
+            # not condemn every row); stale = rows trailing current
+            # HEAD, which a post-capture commit legitimately recreates
+            if good:
+                unrefreshable |= stale_row_keys(head_at_start,
+                                                ignore=unrefreshable)
+            stale = stale_row_keys(head_sha(), ignore=unrefreshable)
+            sleep = (args.down_sleep if (not good or stale)
+                     else args.captured_sleep)
+            if good and stale:
+                log("stale rows %s trail HEAD — re-arming soon"
+                    % sorted(stale))
         else:
             log("tunnel down (probe timeout %ds)" % args.probe_timeout)
             sleep = args.down_sleep
