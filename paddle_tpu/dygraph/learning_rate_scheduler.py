@@ -47,13 +47,16 @@ class PiecewiseDecay(LearningRateDecay):
         super().__init__(begin, step, dtype)
         self.boundaries = list(boundaries)
         self.values = list(values)
+        if len(self.values) != len(self.boundaries) + 1:
+            raise ValueError(
+                "PiecewiseDecay needs len(values) == len(boundaries)+1, "
+                f"got {len(self.values)} values for "
+                f"{len(self.boundaries)} boundaries")
 
     def value(self, n):
-        lr = self.values[-1]
         bs = jnp.asarray(self.boundaries)
         idx = jnp.searchsorted(bs, jnp.asarray(n), side="right")
-        return jnp.asarray(self.values)[idx] if len(
-            self.values) == len(self.boundaries) + 1 else lr
+        return jnp.asarray(self.values)[idx]
 
 
 class NaturalExpDecay(LearningRateDecay):

@@ -325,8 +325,13 @@ class AddQuantDequantPass:
                 for slot, names in list(op.inputs.items()):
                     if not names:
                         continue
-                    src = names[0]
-                    if src not in quantized:
+                    # quantize EVERY name in the slot — rewriting only
+                    # names[0] would silently drop the rest of a
+                    # multi-name input (ADVICE r4; latent while the
+                    # target ops' slots are single-name)
+                    for src in names:
+                        if src in quantized:
+                            continue
                         sv = block.var(src)
                         qname = src + ".quant_dequant"
                         block.create_var(name=qname, shape=sv.shape,
@@ -339,7 +344,7 @@ class AddQuantDequantPass:
                              "OutScale": [qname + ".scale"]},
                             {"bit_length": self._bits}))
                         quantized[src] = qname
-                    op.inputs[slot] = [quantized[src]]
+                    op.inputs[slot] = [quantized[s] for s in names]
             new_ops.append(op)
         block.ops = new_ops
         program._bump()
