@@ -13,8 +13,8 @@ import sys as _sys
 from ..datasets import (cifar, conll05, flowers, imdb, imikolov,  # noqa: F401
                         mnist, movielens, multislot, sentiment,
                         uci_housing, voc2012, wmt14, wmt16)
-from ..datasets.multislot import (DatasetFactory, InMemoryDataset,  # noqa: F401
-                                  QueueDataset)
+from ..datasets.multislot import (BoxPSDataset, DatasetFactory,  # noqa: F401
+                                  InMemoryDataset, QueueDataset)
 
 # make `import paddle_tpu.dataset.mnist`-style submodule imports resolve
 for _name in ("mnist", "cifar", "uci_housing", "imdb", "movielens",
@@ -25,4 +25,4 @@ for _name in ("mnist", "cifar", "uci_housing", "imdb", "movielens",
 __all__ = ["mnist", "cifar", "uci_housing", "imdb", "movielens",
            "conll05", "wmt14", "multislot", "flowers", "imikolov",
            "sentiment", "wmt16", "voc2012", "DatasetFactory",
-           "InMemoryDataset", "QueueDataset"]
+           "InMemoryDataset", "QueueDataset", "BoxPSDataset"]

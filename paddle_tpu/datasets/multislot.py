@@ -9,7 +9,8 @@ Executor.run feeds or jitted train steps.
 
 import numpy as np
 
-__all__ = ["QueueDataset", "InMemoryDataset", "DatasetFactory"]
+__all__ = ["QueueDataset", "InMemoryDataset", "BoxPSDataset",
+           "DatasetFactory"]
 
 
 class _DatasetBase:
@@ -111,6 +112,35 @@ class InMemoryDataset(_DatasetBase):
                    for k in chunk[0]}
 
 
+class BoxPSDataset(InMemoryDataset):
+    """dataset.py:793 BoxPSDataset surface parity.
+
+    In the reference this extends InMemoryDataset with hooks into the
+    BoxPS ads-serving hardware wrapper
+    (framework/fleet/box_wrapper.h:123): begin_pass/end_pass bracket a
+    pass of data through that external system.  There is no BoxPS
+    hardware on TPU, so the DATA surface (load_into_memory, shuffles,
+    iteration) is the real InMemoryDataset implementation and the
+    pass hooks are explicit no-ops — scripts written against the
+    BoxPSDataset API run unchanged, feeding the ordinary PS/collective
+    paths instead of BoxPS.  See README "Documented drops" for the
+    BoxWrapper rationale."""
+
+    def begin_pass(self):
+        return None
+
+    def end_pass(self, need_save_delta=False):  # noqa: ARG002 (parity sig)
+        return None
+
+    def wait_preload_done(self):
+        return None
+
+    def preload_into_memory(self):
+        # reference overlaps load with training via boxps threads; the
+        # truthful TPU equivalent is a synchronous load
+        return self.load_into_memory()
+
+
 class DatasetFactory:
     """dataset.py:22 DatasetFactory parity."""
 
@@ -119,4 +149,6 @@ class DatasetFactory:
             return QueueDataset()
         if name == "InMemoryDataset":
             return InMemoryDataset()
+        if name == "BoxPSDataset":
+            return BoxPSDataset()
         raise ValueError(f"unknown dataset type {name}")

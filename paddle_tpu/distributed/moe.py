@@ -130,3 +130,48 @@ def sharded_moe_ffn(params, x, mesh, axis="ep", k=2, capacity_factor=1.25,
     out = cst(out, NamedSharding(mesh, P(axis, None, None)))
     y = jnp.einsum("ecd,nec->nd", out, combine)
     return y.reshape(*lead, d).astype(x.dtype), aux
+
+
+def moe_ffn_shardmap(params, x, axis="ep", k=2, capacity_factor=1.25,
+                     activation=jax.nn.gelu):
+    """Expert-parallel MoE for use INSIDE a `jax.shard_map` body.
+
+    `sharded_moe_ffn` above is the pjit-style path (sharding
+    constraints, XLA inserts the all_to_alls); this is its shard_map
+    twin for composition with the pipeline schedules in
+    distributed/pipeline.py, whose gpipe/interleaved_gpipe bodies are
+    per-device code where sharding constraints don't exist — the GShard
+    dispatch/combine all_to_alls over `axis` are written explicitly
+    (the role NCCL all-to-all plays in MoE ports of the reference's
+    collective ops, operators/collective/).
+
+    params' expert-major leaves are the LOCAL slices ([E_loc, ...]
+    with E_loc = E / axis_size); the router `wg` is replicated [D, E].
+    x is this device's token shard.  Tokens are gated locally, slots
+    exchange expert-major over `axis`, local experts run, and the
+    reverse exchange returns each token's expert outputs for the
+    combine.  With enough capacity (no drops) the result is
+    numerically the dense moe_ffn of the same tokens.
+    """
+    ep = jax.lax.axis_size(axis)
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    toks = x.reshape(-1, d)
+    e_loc = params["w1"].shape[0]
+    dispatch, combine, aux = top_k_gating(
+        toks, params["wg"], k=k, capacity_factor=capacity_factor)
+    cap = dispatch.shape[-1]
+    xin = jnp.einsum("nd,nec->ecd", toks.astype(jnp.float32), dispatch)
+    # [E, C, D] -> [ep, E_loc, C, D] -> exchange: leading dim becomes
+    # the SOURCE peer whose tokens fill those slots
+    xin = xin.reshape(ep, e_loc, cap, d)
+    xin = jax.lax.all_to_all(xin, axis, split_axis=0, concat_axis=0)
+    h = activation(jnp.einsum("secd,edh->sech", xin,
+                              params["w1"].astype(jnp.float32)))
+    out = jnp.einsum("sech,ehd->secd", h,
+                     params["w2"].astype(jnp.float32))
+    # reverse exchange: slots travel back to their token owners
+    out = jax.lax.all_to_all(out, axis, split_axis=0, concat_axis=0)
+    y = jnp.einsum("ecd,nec->nd", out.reshape(ep * e_loc, cap, d),
+                   combine)
+    return y.reshape(*lead, d).astype(x.dtype), aux

@@ -1,10 +1,15 @@
 """`incubate.fleet.parameter_server.pslib.optimizer_factory` parity.
 
-The reference's DistributedAdam splits a program's sparse/dense params
-into pslib table configs.  The sparse data plane here is
+The reference's DistributedAdam
+(python/paddle/fluid/incubate/fleet/parameter_server/pslib/
+optimizer_factory.py:437 DownpourOptimizer path) walks the program,
+assigns each `is_sparse` embedding its own pslib sparse table and the
+remaining trainable params one dense table, and emits those table
+configs for the Downpour server/worker.  Here the sparse data plane is
 transpiler.SparseEmbedding (adagrad/sgd-in-push, csrc/ps_shard.cpp);
-this factory records the split so pslib-style scripts can introspect
-it.
+this factory performs the SAME split over the captured Program and
+records it in `sparse_table_configs` / `dense_table_configs` so
+pslib-style scripts can introspect which params ride which table.
 """
 
 
@@ -16,16 +21,58 @@ class DistributedOptimizerImplBase:
 class DistributedAdam(DistributedOptimizerImplBase):
     def __init__(self, optimizer=None):
         super().__init__(optimizer)
-        self.supported_embedding_types = ["lookup_table", "pull_sparse"]
+        self.supported_embedding_types = ["lookup_table", "lookup_table_v2"]
+        # populated by minimize(): the reference's server/worker table
+        # split (sparse table per embedding W, one dense table)
+        self.sparse_table_configs = []
+        self.dense_table_configs = []
+
+    def _split_tables(self, program, params_grads):
+        """Reference semantics: every `is_sparse`/`is_distributed`
+        lookup_table W gets its own sparse table id (0..k-1); all other
+        trainable params share one dense table (id k)."""
+        block = program.global_block()
+        sparse = []
+        seen = set()
+        for op in block.ops:
+            if (op.type in self.supported_embedding_types
+                    and (op.attrs.get("is_sparse")
+                         or op.attrs.get("is_distributed"))):
+                w = op.inputs["W"][0]
+                if w in seen:
+                    continue
+                seen.add(w)
+                w_var = block.var(w)
+                sparse.append({
+                    "table_id": len(sparse),
+                    "param": w,
+                    "emb_dim": int(w_var.shape[-1]),
+                    "ids_var": op.inputs["Ids"][0],
+                    # the push-side optimizer csrc/ps_shard.cpp applies
+                    "accessor": "sparse_adagrad_in_push",
+                })
+        pairs = [(p.name, g.name) for p, g in params_grads
+                 if g is not None and p.name not in seen]
+        dense = [{
+            "table_id": len(sparse),
+            "params": [pn for pn, _ in pairs],
+            "grads": [gn for _, gn in pairs],
+            "accessor": "dense_adam",
+        }] if pairs else []
+        return sparse, dense
 
     def minimize(self, losses, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         import paddle_tpu as fluid
 
         loss = losses[0] if isinstance(losses, (list, tuple)) else losses
-        return (self._optimizer or fluid.optimizer.Adam()).minimize(
+        opt = self._optimizer or fluid.optimizer.Adam()
+        opt_ops, params_grads = opt.minimize(
             loss, startup_program=startup_program,
             parameter_list=parameter_list, no_grad_set=no_grad_set)
+        self.sparse_table_configs, self.dense_table_configs = (
+            self._split_tables(loss.block.program, params_grads))
+        return opt_ops, params_grads
 
 
 __all__ = ["DistributedAdam"]

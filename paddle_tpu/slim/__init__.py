@@ -5,15 +5,17 @@ quantization (QAT pass + post-training), pruning (prune/pruner.py,
 prune_strategy.py), and distillation (distillation/distiller.py,
 distillation_strategy.py).
 
-Documented drop — NAS + searcher (slim/nas/light_nas_strategy.py,
-slim/searcher/controller_server.py): the reference's LightNAS is a
-simulated-annealing architecture search driven by a socket
-controller-server measuring latency on target phones/GPUs.  Neither the
-client/server search harness nor the latency tables transfer to a TPU
-pod; architecture search on TPU is a fleet-orchestration concern (spawn
-trials as separate XLA programs), not an in-framework graph mutation.
-The pruning `sensitivity` analysis covers the in-framework part of the
-search loop (scoring candidate sub-networks).
+NAS + searcher ARE implemented (r4/r5): the simulated-annealing
+controller (contrib/slim/searcher/controller.py SAController), the
+line-protocol socket ControllerServer, the worker-side SearchAgent,
+and LightNASStrategy's search loop all live under
+`paddle_tpu.contrib.slim` with an end-to-end test
+(tests/test_slim_nas.py) driving a toy annealing search through the
+real server/agent protocol.  The ONE dropped piece is the reference's
+phone/GPU latency lookup tables that LightNAS used as its reward
+(light_nas_strategy.py's hardware-latency eval): on TPU the reward is
+the caller's `score_fn` (a compiled-trial measurement or the pruning
+`sensitivity` analysis below).
 """
 
 from .distill import (DistillationStrategy, FSPDistiller, L2Distiller,

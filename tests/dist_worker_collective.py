@@ -1,5 +1,6 @@
-"""2-process collective worker (companion script, reference-style
-dist_*.py — see test_dist_collective.py for the parent).
+"""N-process collective worker (companion script, reference-style
+dist_*.py — see test_dist_collective.py for the parent; world size
+comes from the launcher's PADDLE_TRAINERS_NUM).
 
 Run by distributed.launch.start_procs with the PADDLE_* env contract;
 exercises the REAL multi-process wiring: init_parallel_env ->
@@ -14,7 +15,7 @@ import json
 import os
 import sys
 
-# exactly one CPU device per process so the 2-process world is 2 devices
+# exactly one CPU device per process so the N-process world is N devices
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
 
 import jax  # noqa: E402
@@ -39,27 +40,30 @@ from paddle_tpu.distributed.mesh import build_mesh  # noqa: E402
 
 def main():
     out_path = sys.argv[1]
+    expected = int(os.environ["PADDLE_TRAINERS_NUM"])
     init_parallel_env()                      # the wiring under test
-    assert jax.process_count() == 2, jax.process_count()
-    assert jax.device_count() == 2, jax.device_count()
+    assert jax.process_count() == expected, jax.process_count()
+    assert jax.device_count() == expected, jax.device_count()
     assert jax.local_device_count() == 1
     rank, world = get_rank(), get_world_size()
-    assert world == 2
+    assert world == expected
     assert rank == int(os.environ["PADDLE_TRAINER_ID"])
 
-    mesh = build_mesh(dp=2)                  # global 2-device mesh
+    mesh = build_mesh(dp=world)              # global N-device mesh
     dp_sharding = NamedSharding(mesh, P("dp"))
 
     # --- collective numerics (test_collective_base.py parity) ----------
     local = np.full((1, 4), float(rank + 1), np.float32)
     g = jax.make_array_from_process_local_data(dp_sharding, local)
-    summed = eager_all_reduce(g, mesh)       # 1 + 2 = 3 on every shard
+    total = world * (world + 1) / 2.0        # sum of (r+1) over ranks
+    summed = eager_all_reduce(g, mesh)
     my_sum = np.asarray(summed.addressable_shards[0].data)
-    assert np.allclose(my_sum, 3.0), my_sum
-    gathered = eager_all_gather(g, mesh)     # replicated [2, 4]
+    assert np.allclose(my_sum, total), (my_sum, total)
+    gathered = eager_all_gather(g, mesh)     # replicated [world, 4]
     mine = np.asarray(gathered.addressable_data(0))
-    assert mine.shape == (2, 4)
-    assert np.allclose(mine[0], 1.0) and np.allclose(mine[1], 2.0), mine
+    assert mine.shape == (world, 4)
+    for r in range(world):
+        assert np.allclose(mine[r], r + 1.0), (r, mine[r])
 
     # --- 2-trainer DP training vs the parent's local run ---------------
     rng = np.random.default_rng(0)
@@ -105,7 +109,7 @@ def main():
     import paddle_tpu.nn as nn
 
     strategy = dg.prepare_context()
-    assert strategy.nranks == 2, strategy.nranks
+    assert strategy.nranks == world, strategy.nranks
     with dg.guard():
         nn.seed(42)                       # identical init on both ranks
         model = nn.Linear(4, 1)
@@ -122,8 +126,9 @@ def main():
         # scale_loss makes each local grad pred_r*(r+1)/2 and the SUM
         # allreduce yields the cross-rank MEAN of unscaled grads
         # (reference semantics: sum of 1/n-scaled grads)
-        preds = [c * w0.sum() + b0 for c in (1.0, 2.0)]
-        expect = preds[0] * 1.0 + preds[1] * 2.0
+        preds = [(r + 1.0) * w0.sum() + b0 for r in range(world)]
+        expect = sum(2.0 * preds[r] * (r + 1.0)
+                     for r in range(world)) / world
         assert np.allclose(g_sync, expect, rtol=1e-5), (g_sync, expect)
         # state_dict carries UNwrapped names
         assert set(dp.state_dict()) == set(model.state_dict())

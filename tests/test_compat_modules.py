@@ -161,3 +161,42 @@ def test_parallel_executor_facade_trains():
                      feed_dict={"x": np.zeros((8, 4), np.float32),
                                 "y": np.zeros((8, 1), np.float32)})
         assert np.isfinite(float(np.asarray(out[0]).mean()))
+
+
+def test_pslib_distributed_adam_table_split():
+    # reference optimizer_factory.py DownpourOptimizer semantics: each
+    # is_sparse embedding W -> its own sparse table; everything else
+    # trainable -> one dense table
+    from paddle_tpu.incubate.fleet.parameter_server.pslib.optimizer_factory \
+        import DistributedAdam
+
+    with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.data("ids", [None, 1], dtype="int64")
+            ids2 = fluid.data("ids2", [None, 1], dtype="int64")
+            e1 = fluid.layers.embedding(ids, size=[100, 8], is_sparse=True)
+            e2 = fluid.layers.embedding(ids2, size=[50, 8],
+                                        is_distributed=True)
+            dense_in = fluid.layers.concat(
+                [fluid.layers.reshape(e1, [-1, 8]),
+                 fluid.layers.reshape(e2, [-1, 8])], axis=1)
+            y = fluid.data("y", [None, 1])
+            pred = fluid.layers.fc(dense_in, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            opt = DistributedAdam(fluid.optimizer.Adam(0.01))
+            opt_ops, params_grads = opt.minimize(loss)
+
+    sparse = opt.sparse_table_configs
+    dense = opt.dense_table_configs
+    assert len(sparse) == 2
+    sparse_params = {t["param"] for t in sparse}
+    assert len(sparse_params) == 2
+    assert all(t["emb_dim"] == 8 for t in sparse)
+    assert all(t["accessor"] == "sparse_adagrad_in_push" for t in sparse)
+    assert [t["table_id"] for t in sparse] == [0, 1]
+    assert len(dense) == 1 and dense[0]["table_id"] == 2
+    # fc weight + bias ride the dense table; embedding Ws do not
+    assert len(dense[0]["params"]) >= 2
+    assert not (set(dense[0]["params"]) & sparse_params)

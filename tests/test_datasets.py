@@ -236,3 +236,28 @@ def test_dataset_image_transforms(tmp_path):
     np.save(npy, im)
     lt = image.load_and_transform(str(npy), 16, 12, is_train=True)
     assert lt.shape == (3, 12, 12)
+
+
+def test_boxps_dataset_surface(tmp_path):
+    # BoxPSDataset: real InMemoryDataset data path + no-op pass hooks
+    # (fluid/dataset.py:793 surface; box_wrapper.h drop documented)
+    import numpy as np
+
+    from paddle_tpu.dataset import BoxPSDataset, DatasetFactory
+
+    f = tmp_path / "part-0"
+    f.write_text("1 7 2 0.5 0.25\n1 3 2 1.0 0.75\n")
+    ds = DatasetFactory().create_dataset("BoxPSDataset")
+    assert isinstance(ds, BoxPSDataset)
+    ds.set_filelist([str(f)])
+    ds.set_use_var([("ids", "int64", 1), ("vals", "float", 2)])
+    ds.set_batch_size(2)
+    ds.begin_pass()
+    ds.preload_into_memory()
+    ds.wait_preload_done()
+    assert len(ds) == 2
+    batches = list(ds)
+    assert batches and batches[0]["ids"].shape[0] == 2
+    assert np.allclose(sorted(batches[0]["vals"][:, 0]), [0.5, 1.0])
+    ds.end_pass()
+    ds.release_memory()

@@ -1,6 +1,6 @@
-"""Real 2-process collective test (VERDICT r3 #3).
+"""Real multi-process collective test (VERDICT r3 #3; 4-proc r4 #9).
 
-Spawns a 2-worker localhost cluster through distributed.launch
+Spawns an N-worker localhost cluster through distributed.launch
 .start_procs (the PADDLE_* env contract), whose workers run
 jax.distributed.initialize via distributed/env.py — the path no
 in-process mesh test can cover.  Numerics parity:
@@ -27,7 +27,9 @@ WORKER = os.path.join(os.path.dirname(__file__),
 
 
 def _local_reference_losses(steps=5):
-    """Single-process full-batch run of the worker's training problem."""
+    """Single-process full-batch run of the worker's training problem
+    (equal shards make the mean-of-shard-means equal the full-batch
+    gradient, so ONE reference serves every world size)."""
     rng = np.random.default_rng(0)
     true_w = rng.normal(size=(8, 1)).astype(np.float32)
     X = rng.normal(size=(32, 8)).astype(np.float32)
@@ -47,12 +49,14 @@ def _local_reference_losses(steps=5):
     return losses
 
 
-def test_two_process_cluster_collectives_and_dist_vs_local(tmp_path):
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_cluster_collectives_and_dist_vs_local(nproc, tmp_path):
     out = tmp_path / "rank0.json"
     log_dir = tmp_path / "logs"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs, logs = start_procs(
-        node_ips=["127.0.0.1"], node_ip="127.0.0.1", nproc_per_node=2,
+        node_ips=["127.0.0.1"], node_ip="127.0.0.1",
+        nproc_per_node=nproc,
         training_script=WORKER, script_args=(str(out),),
         log_dir=str(log_dir),
         # prepend (not replace) so the axon sitecustomize dir survives;
@@ -83,7 +87,7 @@ def test_two_process_cluster_collectives_and_dist_vs_local(tmp_path):
     if rc != 0:
         raise AssertionError(f"worker failed rc={rc}\n{_dump()}")
     result = json.loads(out.read_text())
-    assert result["world"] == 2
+    assert result["world"] == nproc
     dist_losses = result["losses"]
     local_losses = _local_reference_losses(len(dist_losses))
     # test_dist_base.py:935 delta contract

@@ -1,0 +1,70 @@
+"""End-to-end slim NAS search: ControllerServer + SearchAgent + SA.
+
+Reference path: contrib/slim/searcher/controller_server.py (line-proto
+TCP server over an annealing controller) driven by
+contrib/slim/nas/search_agent.py workers inside
+light_nas_strategy.py's loop.  The toy objective stands in for the
+reference's latency-table score; the protocol, threading, and
+annealing dynamics are the real ones.
+"""
+
+from paddle_tpu.contrib.slim.nas import (
+    ControllerServer, LightNASStrategy, SearchAgent, SearchSpace)
+from paddle_tpu.contrib.slim.searcher.controller import SAController
+
+TARGET = [3, 5, 2, 7]
+
+
+def _reward(tokens):
+    # max 0 at TARGET; strictly decreasing in L1 distance
+    return -float(sum(abs(t - g) for t, g in zip(tokens, TARGET)))
+
+
+class ToySpace(SearchSpace):
+    def init_tokens(self):
+        return [0, 0, 0, 0]
+
+    def range_table(self):
+        return [8, 8, 8, 8]
+
+
+def test_controller_server_agent_round_trip():
+    ctrl = SAController(seed=0)
+    init = ctrl.reset([8, 8, 8, 8], [0, 0, 0, 0])
+    server = ControllerServer(ctrl).start()
+    try:
+        agent = SearchAgent(server.ip(), server.port())
+        tokens = init
+        for _ in range(120):
+            tokens = agent.update(tokens, _reward(tokens))
+            assert len(tokens) == 4
+            assert all(0 <= t < 8 for t in tokens)
+        # annealing over the socket protocol must beat the all-zeros
+        # start (reward -17) decisively
+        assert ctrl.max_reward >= -4, (
+            f"SA via server stuck at {ctrl.max_reward} "
+            f"(best {ctrl.best_tokens})")
+    finally:
+        server.close()
+
+
+def test_light_nas_strategy_in_process_search():
+    strat = LightNASStrategy(controller=SAController(seed=1),
+                             search_steps=150)
+    best_tokens, best_reward = strat.search(ToySpace(), _reward)
+    assert best_reward >= -4
+    assert len(best_tokens) == 4
+
+
+def test_light_nas_strategy_server_lifecycle():
+    # rank-0 path: on_compression_begin starts a live server an agent
+    # can talk to; on_compression_end shuts it down
+    strat = LightNASStrategy(controller=SAController(seed=2))
+    strat._controller.reset([4, 4], [0, 0])
+    strat.on_compression_begin(None)
+    try:
+        agent = strat._agent
+        nxt = agent.update([0, 0], -1.0)
+        assert len(nxt) == 2
+    finally:
+        strat.on_compression_end(None)
