@@ -39,6 +39,35 @@ than cuDNN fusion enums.
 On non-TPU backends the kernels run in interpret mode;
 tests/test_fused_bottleneck.py checks fwd+grad numerics against the
 unfused composition.
+
+MEASURED STATUS (honest, r4->r5).  Every kernel variant passes on-chip
+fwd+bwd smoke at every ResNet-50 geometry (ONCHIP_QUEUE.log
+fused_kernel_smoke3), but the path has NOT yet beaten XLA end-to-end:
+
+- the only full-model fused config measured on chip, the 12-block
+  identity subset, was SLOWER than unfused (0.1133 vs 0.1493 MFU at
+  b128 ss16, r4 13:04) — hypothesis: the recompute backward trades
+  ~2x conv FLOPs for traffic, a good trade only where the block is
+  deep in the bandwidth-bound regime (large-spatial stages 1-2), while
+  the tiny-spatial stage-3/4 tiles (7^2/14^2 x 1-2k channels) have the
+  least im2col reuse and likely pay more compute than they save; the
+  r5 `id_early` subset + onchip_queue `resnet_fused_subset_ab`
+  experiment tests exactly this split;
+- the FULL 16-block program cannot currently be measured at all: the
+  axon remote-compile service routes programs with many Mosaic custom
+  calls to an AOT helper that dies server-side on a broken
+  TPU_WORKER_HOSTNAMES env (three r4 captures lost) — an
+  infrastructure ceiling, not a kernel property;
+- a full-fused FORWARD compiled in 382.6s (r4 12:55), so compile cost
+  alone makes the full path impractical behind the tunnel until the
+  persistent cache is warm.
+
+Until a measured config BEATS unfused, the headline bench reports the
+XLA path and the fused path stays opt-in (PADDLE_TPU_FUSED_SUBSET,
+bench resnet_fused side row).  If id_early also loses, the honest
+conclusion is that XLA's conv stack + ghost-BN is already within the
+roofline's reach and these kernels are a capability demonstration, not
+a perf win.
 """
 
 import functools

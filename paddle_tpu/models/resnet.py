@@ -57,7 +57,13 @@ def _fused_subset():
     custom calls to an AOT helper with a broken TPU_WORKER_HOSTNAMES
     env (r4, ONCHIP_QUEUE.log 12:39) — the subset keeps the train-step
     program under that threshold while still removing most of the
-    HBM traffic."""
+    HBM traffic.
+
+    =id_early further restricts to the LARGE-SPATIAL identity blocks
+    (stages 1-2, mid-channels <= 128): stage-3/4 tiles are tiny-spatial
+    x huge-channel, where the im2col formulation has the least reuse —
+    the r5 hypothesis for why the full id-subset measured slower than
+    unfused (0.1133 vs 0.1493, ONCHIP_QUEUE.log r4 13:04)."""
     import os
 
     return os.environ.get("PADDLE_TPU_FUSED_SUBSET", "")
@@ -115,13 +121,16 @@ class BottleneckBlock(nn.Layer):
         # projection block (stage-1 block 0), and the stride-2
         # transitions (fused_bottleneck_down); _fused_subset() can
         # restrict it to the identity blocks.
-        id_only = _fused_subset() == "id"
+        subset = _fused_subset()
+        id_only = subset in ("id", "id_early")
+        early_only = subset == "id_early"
         self._stride = stride
         self._fused = (fused and df == "NHWC"
                        and (stride == 1
                             or (stride == 2 and self.short is not None))
                        and not (id_only
-                                and (self.short is not None or stride != 1)))
+                                and (self.short is not None or stride != 1))
+                       and not (early_only and ch > 128))
 
     def _bn_affine(self, bn, conv_out):
         return _bn_affine(bn, conv_out, self.training)
@@ -218,7 +227,7 @@ class ResNet(nn.Layer):
         # kernel); the 7x7 conv itself stays on XLA — its K=3-channel
         # matmul shape is XLA's to tile, the tail is pure traffic
         self._fused_stem = (fused and data_format == "NHWC"
-                            and _fused_subset() != "id")
+                            and _fused_subset() not in ("id", "id_early"))
 
     def _stem_pool(self, x):
         ss = self.stem.bn._stats_sample

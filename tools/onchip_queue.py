@@ -204,6 +204,27 @@ r = resnet50_time_config(peak, batch=128, iters=40, bn_stats_sample=16,
                          fused=True)
 print("RESULT " + json.dumps(r), flush=True)
 """,
+    "resnet_fused_subset_ab": """
+# r5: WHERE does the fused path lose?  A/B the identity-block subsets:
+# id (all 12; r4 measured 0.1133 < unfused 0.1493) vs id_early (the 5
+# large-spatial stage-1/2 identities only) vs unfused — if id_early
+# wins while id loses, the tiny-spatial stage-3/4 kernels are the
+# regression and the subset default should change.
+import os, jax, json
+from bench import resnet50_time_config, _peak_flops
+peak = _peak_flops(jax.devices()[0])
+for subset, fused in (("", False), ("id_early", True), ("id", True)):
+    os.environ["PADDLE_TPU_FUSED_SUBSET"] = subset
+    try:
+        r = resnet50_time_config(peak, batch=128, iters=40,
+                                 bn_stats_sample=16, fused=fused)
+        r["fused_subset"] = subset
+    except Exception as e:
+        r = {"fused_subset": subset,
+             "error": ("%s: %s" % (type(e).__name__, e))[:200]}
+    print("PART " + json.dumps(r), flush=True)
+print("RESULT " + json.dumps({"ab": "done"}), flush=True)
+""",
     "bert_batch_sweep": """
 from bench import _bench_gpt_mfu, _peak_flops
 from paddle_tpu.models.gpt import GPTConfig

@@ -1,0 +1,273 @@
+"""Round-5 ResNet-50 traffic probe: split the 46.7GB/step by component.
+
+The r4 roofline said the b128 NHWC bf16 ss16 train step is HBM-bound
+(~100% of v5e bandwidth) but the per-op evidence didn't survive the
+round.  This probe compiles a family of step variants and reads XLA's
+own `compiled.cost_analysis()` bytes/flops for each, so the traffic
+splits by component WITHOUT timing noise:
+
+  base_b128      full step (the headline config)
+  fwd_b128       forward+loss only      -> backward+update traffic delta
+  bnaffine_b128  affine-only BN         -> BN-stats traffic delta
+  nopool_b128    maxpool -> s2 slice    -> select_and_scatter bwd delta
+  sgd_b128       SGD (no velocity)      -> optimizer traffic delta
+  base_b256      batch scaling          -> fixed-cost amortization
+
+Each variant is also timed (the scan program is already compiled, so
+timing is ~2s more), and the base variant gets an xplane capture whose
+per-op table is PERSISTED to R5_RESNET_PROFILE.json — the r4 mistake
+(profile informed a decision, then evaporated) not repeated.
+
+Run on chip via tools/onchip_queue.run_experiment (holds the chip lock).
+Prints PART lines per variant and one RESULT line; read-only for the
+rest of the repo.
+"""
+import collections
+import functools
+import glob
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from bench import RESNET50_FWD_FLOPS_224
+from paddle_tpu import nn
+from paddle_tpu.models.resnet import resnet50
+from paddle_tpu.models.train import (
+    _loss_with_buffers, init_train_state, make_train_step)
+from paddle_tpu.nn import functional as F
+from paddle_tpu.optimizer.functional import Momentum, SGD
+
+PEAK = 197e12  # v5e bf16
+ITERS = 10
+
+# PADDLE_R5_PROBE_SMOKE=1: tiny shapes on CPU to validate the script
+# end-to-end (including the xplane parse) without a chip
+import os  # noqa: E402
+
+SMOKE = os.environ.get("PADDLE_R5_PROBE_SMOKE", "") == "1"
+if SMOKE:
+    ITERS = 2
+
+
+def part(obj):
+    print("PART " + json.dumps(obj), flush=True)
+
+
+def build(batch=128, ss=16, bn_global=False, opt=None, nopool=False):
+    model = resnet50(dtype="bfloat16", data_format="NHWC",
+                     bn_stats_sample=ss)
+    if bn_global:
+        def fwd(self, x):
+            y, _, _ = F.batch_norm(
+                x, self._buffers["_mean"], self._buffers["_variance"],
+                self.weight, self.bias, training=False,
+                momentum=self._momentum, epsilon=self._epsilon,
+                data_format=self._data_format)
+            from paddle_tpu.nn import _apply_act
+            return _apply_act(y, self._act)
+
+        for lyr in model.sublayers(include_self=True):
+            if isinstance(lyr, nn.BatchNorm):
+                lyr.forward = fwd.__get__(lyr)
+    if nopool:
+        # stride-2 subsample stands in for the 3x3/s2 maxpool (same
+        # 112->56 shape): the timing/traffic delta isolates the
+        # reduce_window fwd + select_and_scatter bwd cost
+        model.pool.forward = lambda x: x[:, 1::2, 1::2, :]
+    opt = opt or Momentum(0.001, 0.9)
+    state = init_train_state(model, opt)
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y).mean()
+
+    step = make_train_step(model, opt, loss_fn=loss_fn, jit=False)
+    rng = np.random.default_rng(0)
+    size = 64 if SMOKE else 224
+    x = jnp.asarray(rng.standard_normal((batch, 3, size, size)), jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+    return model, state, step, loss_fn, (x, y)
+
+
+def cost_keys(comp):
+    """The analytical totals XLA reports for the whole scan program."""
+    try:
+        ca = comp.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        # only the totals: the per-operand breakdown keys
+        # ("bytes accessed0{}", ...) are noise at this granularity
+        return {k: ca[k] for k in ("flops", "bytes accessed") if k in ca}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)[:200]}
+
+
+def probe_train(name, batch=128, profile=False, **kw):
+    model, state, step, loss_fn, batch_xy = build(batch=batch, **kw)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(state, *b):
+        def body(st, _):
+            st, loss = step(st, *b)
+            return st, loss
+        return jax.lax.scan(body, state, None, length=ITERS)
+
+    t0 = time.perf_counter()
+    comp = run.lower(state, *batch_xy).compile()
+    compile_s = round(time.perf_counter() - t0, 1)
+    costs = cost_keys(comp)
+    # per-step normalization of the scan totals
+    row = {"variant": name, "batch": batch, "compile_s": compile_s}
+    for k, v in costs.items():
+        if isinstance(v, (int, float)):
+            row[k.replace(" ", "_") + "_per_step_gb"] = round(
+                v / ITERS / 1e9, 2)
+        else:
+            row[k] = v
+    # call the AOT-compiled object, NOT run(...): the .lower().compile()
+    # above does not populate jit's own cache, so run(...) would compile
+    # the whole program a second time (2x every chip compile)
+    run = comp
+    st, losses = run(state, *batch_xy)
+    jax.tree_util.tree_map(
+        lambda a: a.delete() if hasattr(a, "delete") else None, state)
+    assert np.isfinite(float(losses[-1])), "non-finite loss " + name
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        st, losses = run(st, *batch_xy)
+        float(losses[-1])
+        best = min(best, (time.perf_counter() - t0) / ITERS)
+    row["step_ms"] = round(best * 1e3, 2)
+    row["mfu"] = round(3.0 * RESNET50_FWD_FLOPS_224 * batch / best / PEAK, 4)
+    if profile:
+        with jax.profiler.trace("/root/repo/.prof_r5_resnet"):
+            st, losses = run(st, *batch_xy)
+            float(losses[-1])
+    part(row)
+    del model, st, step, batch_xy
+    return row
+
+
+def probe_fwd(name, batch=128, **kw):
+    model, state, step, loss_fn, (x, y) = build(batch=batch, **kw)
+    params, buffers = state.params, state.buffers
+
+    @jax.jit
+    def run(acc, x, y):
+        def body(acc, _):
+            xx = x + (acc * 1e-30).astype(x.dtype)
+            loss, _ = _loss_with_buffers(model, params, buffers,
+                                         jax.random.PRNGKey(0), loss_fn,
+                                         (xx, y))
+            return loss.astype(jnp.float32), loss
+        return jax.lax.scan(body, acc, None, length=ITERS)
+
+    acc = jnp.zeros((), jnp.float32)
+    t0 = time.perf_counter()
+    comp = run.lower(acc, x, y).compile()
+    compile_s = round(time.perf_counter() - t0, 1)
+    costs = cost_keys(comp)
+    row = {"variant": name, "batch": batch, "compile_s": compile_s}
+    for k, v in costs.items():
+        if isinstance(v, (int, float)):
+            row[k.replace(" ", "_") + "_per_step_gb"] = round(
+                v / ITERS / 1e9, 2)
+        else:
+            row[k] = v
+    run = comp                     # see probe_train: avoid a 2nd compile
+    _, losses = run(acc, x, y)
+    float(losses[-1])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _, losses = run(acc, x, y)
+        float(losses[-1])
+        best = min(best, (time.perf_counter() - t0) / ITERS)
+    row["step_ms"] = round(best * 1e3, 2)
+    row["mfu_fwd_basis"] = round(
+        RESNET50_FWD_FLOPS_224 * batch / best / PEAK, 4)
+    part(row)
+    del model, state
+    return row
+
+
+def parse_profile():
+    """Per-op/per-category ms from the newest xplane capture."""
+    from tools.parse_xplane import device_plane, load
+
+    files = sorted(glob.glob(
+        "/root/repo/.prof_r5_resnet/**/*.xplane.pb", recursive=True))
+    if not files:
+        return {"error": "no xplane capture found"}
+    try:
+        plane = device_plane(load(files[-1]))
+    except BaseException as e:  # device_plane raises SystemExit on CPU
+        return {"error": str(e)[:200]}
+    md = {m.id: m for m in plane.event_metadata.values()}
+    smd = {m.id: m.name for m in plane.stat_metadata.values()}
+    cats = collections.defaultdict(float)
+    tops = collections.defaultdict(float)
+    for line in plane.lines:
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            m = md.get(ev.metadata_id)
+            if m is None or m.name.startswith("%while"):
+                continue
+            cat = ""
+            for stt in m.stats:
+                if smd.get(stt.metadata_id) == "hlo_category":
+                    cat = stt.str_value
+            cats[cat] += ev.duration_ps / 1e9 / ITERS
+            tops[m.name[:90]] += ev.duration_ps / 1e9 / ITERS
+    return {
+        "per_step_ms_by_category": {
+            k: round(v, 2) for k, v in
+            sorted(cats.items(), key=lambda kv: -kv[1]) if v > 0.05},
+        "top_ops_ms": {k: round(v, 2) for k, v in
+                       sorted(tops.items(), key=lambda kv: -kv[1])[:25]},
+    }
+
+
+def main():
+    part({"device": str(jax.devices()[0])})
+    base_b = 4 if SMOKE else 128
+    rows = []
+    rows.append(probe_train("base_b128", batch=base_b, profile=True))
+    for name, kw in [
+        ("bnaffine_b128", dict(bn_global=True)),
+        ("nopool_b128", dict(nopool=True)),
+        ("sgd_b128", dict(opt=SGD(0.001))),
+    ]:
+        try:
+            rows.append(probe_train(name, batch=base_b, **kw))
+        except Exception as e:  # noqa: BLE001
+            part({"variant": name, "error": str(e)[:300]})
+    try:
+        rows.append(probe_fwd("fwd_b128", batch=base_b))
+    except Exception as e:  # noqa: BLE001
+        part({"variant": "fwd_b128", "error": str(e)[:300]})
+    try:
+        rows.append(probe_train("base_b256", batch=8 if SMOKE else 256))
+    except Exception as e:  # noqa: BLE001
+        part({"variant": "base_b256", "error": str(e)[:300]})
+    prof = parse_profile()
+    out = {"rows": rows, "profile": prof,
+           "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    with open("/root/repo/R5_RESNET_PROFILE.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print("RESULT " + json.dumps(
+        {"n_rows": len(rows),
+         "profile_categories": prof.get("per_step_ms_by_category", {})}),
+        flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Round-5 first-window orchestrator: probe > bench priority.
+
+Waits for the tunnel, runs the r5 ResNet traffic probe as the FIRST
+thing in the chip window (its results decide the round's perf work),
+then re-arms the tpu_capture daemon for the round's ongoing captures.
+One-shot: exits after the probe so the operator is notified.
+"""
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.onchip_queue import (  # noqa: E402
+    EXPERIMENTS, log, probe, run_experiment)
+
+
+def main():
+    deadline = time.time() + 11 * 3600
+    log({"r5_watch": "up"})
+    while time.time() < deadline:
+        if probe():
+            log({"r5_watch": "tunnel up — running resnet probe"})
+            code = open(os.path.join(REPO, "tools/r5_resnet_probe.py")).read()
+            run_experiment("r5_resnet_probe", code, 3600)
+            log({"r5_watch": "probe done — fused subset A/B"})
+            run_experiment("resnet_fused_subset_ab",
+                           EXPERIMENTS["resnet_fused_subset_ab"], 2400)
+            log({"r5_watch": "re-arming capture daemon"})
+            subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "tools/tpu_capture.py"),
+                 "--max-hours", "11", "--probe-timeout", "120",
+                 "--bench-timeout", "5400", "--down-sleep", "300",
+                 "--captured-sleep", "5400"],
+                cwd=REPO, start_new_session=True,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            return 0
+        time.sleep(240)
+    log({"r5_watch": "expired"})
+    return 1
+
+
+if __name__ == "__main__":
+    main()

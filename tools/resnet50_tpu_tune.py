@@ -69,13 +69,19 @@ def main():
         _save_bench_tpu(doc)
         return best
 
+    # fused rows ride the id-subset by default: the full-fused program
+    # exceeds the remote AOT helper's custom-call ceiling and dies
+    # server-side (TPU_WORKER_HOSTNAMES, r4) — an unset env must
+    # measure, not crash
+    os.environ.setdefault("PADDLE_TPU_FUSED_SUBSET", "id")
+
     results, best = [], None
     # (batch, remat, stats_sample, fused); fused rows time the Pallas
     # fused-bottleneck path (r4) against the per-conv XLA path
     for batch, remat, ss, fused in (
             (128, False, 16, False), (128, False, 32, False),
-            (128, False, 16, True), (128, False, 32, True),
-            (256, False, 32, True), (128, True, 16, False)):
+            (192, False, 16, False), (256, False, 32, False),
+            (128, False, 16, True), (128, True, 16, False)):
         try:
             r = time_config(batch, remat, stats_sample=ss, fused=fused)
         except Exception as e:
