@@ -425,7 +425,7 @@ def bench_bert_chunked_ce(on_tpu, peak):
     from paddle_tpu.models.gpt import GPTConfig
 
     if not on_tpu:
-        return {"metric": "bert_chunked_ce",
+        return {"metric": "bert_chunked_ce_mfu",
                 "skipped": "tpu-only A/B"}
     cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
                     num_heads=12, max_seq_len=512, dtype="bfloat16",

@@ -552,3 +552,101 @@ def test_reorder_by_rank():
     rank = np.array([3, 1, 0, 2], np.int32)
     out = run_kernel("reorder_by_rank", {"X": x, "RankTable": rank}, {})
     assert out["Out"].shape == x.shape
+
+
+# -- r5: the last 9 never-directly-tested registered kernels ---------------
+
+def test_shrink_activations_values_and_grads():
+    from op_test import OpTest, run_kernel
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((3, 4)).astype(np.float32) * 2
+
+    out = run_kernel("hard_shrink", {"X": x}, {"threshold": 0.5})["Out"]
+    np.testing.assert_allclose(out, np.where(np.abs(x) > 0.5, x, 0.0))
+
+    out = run_kernel("softshrink", {"X": x}, {"lambda": 0.5})["Out"]
+    np.testing.assert_allclose(
+        out, np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0.0)),
+        rtol=1e-6)
+
+    out = run_kernel("tanh_shrink", {"X": x})["Out"]
+    np.testing.assert_allclose(out, x - np.tanh(x), rtol=1e-5, atol=1e-6)
+
+    out = run_kernel("thresholded_relu", {"X": x}, {"threshold": 1.0})["Out"]
+    np.testing.assert_allclose(out, np.where(x > 1.0, x, 0.0))
+
+    out = run_kernel("logsigmoid", {"X": x})["Out"]
+    np.testing.assert_allclose(out, -np.log1p(np.exp(-x)), rtol=1e-5,
+                               atol=1e-6)
+
+    # numeric-vs-analytic grads away from the kink points
+    xg = rng.standard_normal((2, 3)).astype(np.float32) * 2
+    xg = np.where(np.abs(np.abs(xg) - 0.5) < 0.1, xg + 0.25, xg)
+
+    class T(OpTest):
+        op_type = "logsigmoid"
+
+    T().check_grad({"X": xg}, ["X"])
+
+    class T2(OpTest):
+        op_type = "tanh_shrink"
+
+    T2().check_grad({"X": xg}, ["X"])
+
+
+def test_rank_table_max_len_shrink_memory_chain():
+    # the RNN memory-shrink trio: rank table sorts sequences desc by
+    # length, max_sequence_len reads the head, shrink_memory keeps the
+    # still-active prefix at timestep I
+    from op_test import run_kernel
+    import numpy as np
+
+    lengths = np.asarray([2, 5, 3, 1], np.int64)
+    table = run_kernel("lod_rank_table", {"X": lengths})["Out"]
+    np.testing.assert_array_equal(table[:, 1], [5, 3, 2, 1])
+    np.testing.assert_array_equal(table[:, 0], [1, 2, 0, 3])
+
+    mx = run_kernel("max_sequence_len", {"RankTable": table})["Out"]
+    assert int(mx) == 5
+
+    x = np.arange(4 * 3, dtype=np.float32).reshape(4, 3)
+    out = run_kernel("shrink_memory",
+                     {"X": x, "I": np.asarray(2), "RankTable": table})["Out"]
+    # lengths-in-rank-order [5,3,2,1]: active (> 2) = first 2 rows
+    np.testing.assert_array_equal(out, x[:2])
+
+
+def test_dgc_op_rampup_and_topk_mask():
+    from op_test import run_kernel
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    g = rng.standard_normal(64).astype(np.float32)
+    u = np.zeros_like(g)
+    v = np.zeros_like(g)
+
+    # before rampup_begin_step: pass-through, state untouched
+    out = run_kernel("dgc", {"U": u, "V": v, "Grad": g,
+                             "current_step": np.asarray(0.0)},
+                     {"m": 0.9, "rampup_begin_step": 10.0,
+                      "rampup_step": 10.0, "sparsity": [0.75]})
+    np.testing.assert_allclose(out["GradOut"], g)
+    np.testing.assert_allclose(out["UOut"], u)
+    np.testing.assert_allclose(out["VOut"], v)
+
+    # after rampup: exactly top-25% of |v+g| ships, error feedback keeps
+    # the rest, and shipped+kept reconstructs v_n
+    out = run_kernel("dgc", {"U": u, "V": v, "Grad": g,
+                             "current_step": np.asarray(100.0)},
+                     {"m": 0.9, "rampup_begin_step": 10.0,
+                      "rampup_step": 10.0, "sparsity": [0.75]})
+    shipped = np.asarray(out["GradOut"])
+    kept = np.asarray(out["VOut"])
+    n_ship = int((shipped != 0).sum())
+    assert n_ship == 16, n_ship                    # 25% of 64
+    np.testing.assert_allclose(shipped + kept, g, rtol=1e-5, atol=1e-6)
+    # shipped entries are the largest-magnitude ones
+    assert np.abs(shipped[shipped != 0]).min() >= np.abs(
+        kept[kept != 0]).max() - 1e-6
