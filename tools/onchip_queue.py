@@ -249,6 +249,24 @@ flags.set_flags({"FLAGS_use_pallas_layer_norm": True})
 r = _bench_gpt_mfu(cfg, 16, 512, 60, "bert_pallas_ln", peak)
 print("RESULT " + json.dumps(r), flush=True)
 """,
+    "bert_b48_pallas_ln": """
+# r5: the b16 A/B measured Pallas LN +0.7% (0.4841 vs 0.4808, r4
+# 10:45); rerun at the NEW default batch 48 — a win here flips the
+# headline default
+from bench import _bench_gpt_mfu, _peak_flops
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu import flags
+import jax, json
+peak = _peak_flops(jax.devices()[0])
+cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                num_heads=12, max_seq_len=512, dtype="bfloat16")
+for use in (False, True):
+    flags.set_flags({"FLAGS_use_pallas_layer_norm": use})
+    r = _bench_gpt_mfu(cfg, 48, 512, 40,
+                       "bert_b48_ln_%s" % ("pallas" if use else "xla"),
+                       peak)
+    print("RESULT " + json.dumps(r), flush=True)
+""",
     "transformer_batch_sweep": """
 from bench import _bench_gpt_mfu, _peak_flops
 from paddle_tpu.models.gpt import GPTConfig
