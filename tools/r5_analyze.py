@@ -1,0 +1,63 @@
+"""Turn R5_RESNET_PROFILE.json into a traffic-budget decision table.
+
+Reads the probe output (tools/r5_resnet_probe.py) and prints, per
+variant, the XLA-reported bytes/step and the delta vs base — i.e. how
+much of the 46.7GB the BN-stats passes, the maxpool fwd/bwd, and the
+optimizer update each carry — plus the bandwidth-implied MFU ceiling
+(bytes / 819GB/s as the step floor) so the fix with the largest payoff
+is arithmetic, not guesswork.
+"""
+import json
+import sys
+
+HBM_GBPS = 819.0        # v5e
+PEAK = 197e12           # bf16
+FWD_FLOPS = 4.089e9     # per image
+
+
+def main(path="R5_RESNET_PROFILE.json"):
+    doc = json.load(open(path))
+    rows = {r["variant"]: r for r in doc["rows"] if "variant" in r}
+    base = rows.get("base_b128")
+    if not base:
+        print("no base_b128 row"); return 1
+
+    def gb(r):
+        return r.get("bytes_accessed_per_step_gb")
+
+    print(f"{'variant':<16}{'GB/step':>9}{'d vs base':>11}{'step_ms':>9}"
+          f"{'bw_ms':>7}{'mfu':>8}{'mfu@bw':>8}")
+    for name, r in rows.items():
+        b = gb(r)
+        batch = r.get("batch", 128)
+        if b is None:
+            print(f"{name:<16}  (no cost data: {r.get('error','?')})")
+            continue
+        bw_ms = b / HBM_GBPS * 1e3
+        # what MFU would this variant hit if it ran exactly at the HBM
+        # roofline (its bytes at full bandwidth)?
+        mfu_at_bw = (3.0 * FWD_FLOPS * batch) / (b / HBM_GBPS) / PEAK \
+            if name != "fwd_b128" else \
+            (FWD_FLOPS * batch) / (b / HBM_GBPS) / PEAK
+        delta = "" if name == "base_b128" or gb(base) is None else \
+            f"{b - gb(base):+.2f}"
+        print(f"{name:<16}{b:>9.2f}{delta:>11}{r.get('step_ms', 0):>9.2f}"
+              f"{bw_ms:>7.1f}{r.get('mfu', r.get('mfu_fwd_basis', 0)):>8.4f}"
+              f"{mfu_at_bw:>8.4f}")
+
+    prof = doc.get("profile", {})
+    cats = prof.get("per_step_ms_by_category", {})
+    if cats:
+        print("\nbase per-op categories (ms/step):")
+        for k, v in cats.items():
+            print(f"  {k:<28}{v:>8.2f}")
+    tops = prof.get("top_ops_ms", {})
+    if tops:
+        print("\ntop ops (ms/step):")
+        for k, v in list(tops.items())[:15]:
+            print(f"  {v:>7.2f}  {k}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:]))
