@@ -299,3 +299,57 @@ def test_image_classification_vgg_style():
     out = exe.run(test_prog, feed={"img": feeds()["img"]},
                   fetch_list=[pred])
     assert np.asarray(out[0]).shape == (32, 10)
+
+
+def test_rnn_encoder_decoder_bilstm():
+    """book/test_rnn_encoder_decoder.py — the 9th book model: bi-LSTM
+    encoder (forward + is_reverse dynamic_lstm, last/first step concat)
+    conditioning an LSTM decoder, trained end-to-end through the STATIC
+    graph path on a shifted-copy toy task; loss must drop and stay
+    finite (reference contract: avg_loss threshold + NaN abort)."""
+    v, d, b, t = 12, 8, 8, 5
+    rng = np.random.default_rng(9)
+    src = rng.integers(2, v, (b, t)).astype(np.int64)
+    tgt = np.roll(src, -1, axis=1).reshape(b, t, 1).astype(np.int64)
+    lens = np.full((b,), t, np.int64)
+
+    with fluid.scope_guard(fluid.Scope()), fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            s = fluid.data("src", [b, t], dtype="int64")
+            y = fluid.data("tgt", [b, t, 1], dtype="int64")
+            ln = fluid.data("lens", [b], dtype="int64")
+            emb = fluid.layers.embedding(s, size=[v, d])
+            # bi-LSTM encoder: two projections + fwd/rev lstm
+            fproj = fluid.layers.fc(emb, 4 * d, num_flatten_dims=2)
+            fwd, _ = fluid.layers.dynamic_lstm(fproj, 4 * d, lengths=ln)
+            bproj = fluid.layers.fc(emb, 4 * d, num_flatten_dims=2)
+            rev, _ = fluid.layers.dynamic_lstm(bproj, 4 * d, lengths=ln,
+                                               is_reverse=True)
+            enc_last = fluid.layers.sequence_last_step(fwd, ln)
+            enc_first = fluid.layers.sequence_first_step(rev, ln)
+            enc = fluid.layers.reshape(
+                fluid.layers.concat([enc_last, enc_first], axis=1),
+                [b, 2 * d])
+            h0 = fluid.layers.fc(enc, d, act="tanh")
+            c0 = fluid.layers.fill_constant([b, d], "float32", 0.0)
+            # decoder LSTM over (teacher-forced) source embedding,
+            # initialised from the encoder state
+            dproj = fluid.layers.fc(emb, 4 * d, num_flatten_dims=2)
+            dec, _ = fluid.layers.dynamic_lstm(dproj, 4 * d, h_0=h0,
+                                               c_0=c0, lengths=ln)
+            dec = fluid.layers.reshape(dec, [b, t, d])
+            logits = fluid.layers.fc(dec, v, num_flatten_dims=2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.Adam(0.02).minimize(loss)
+
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(40):
+            out = exe.run(main, feed={"src": src, "tgt": tgt, "lens": lens},
+                          fetch_list=[loss])
+            losses.append(float(out[0]))
+            assert np.isfinite(losses[-1]), losses  # NaN abort parity
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
