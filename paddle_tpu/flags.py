@@ -112,3 +112,12 @@ declare_flag("use_pallas_dgc_topk", False,
              "Route DGC top-k gradient selection through the streaming "
              "Pallas histogram-threshold kernel instead of lax.top_k "
              "(approximate: keeps >= k elements).")
+
+declare_flag("maxpool_mask_bwd", False,
+             "Give max-pool a recompute-mask custom VJP (window passes "
+             "+ shifted compares, all XLA-fusable) instead of the "
+             "default select_and_scatter backward — same first-match "
+             "tie semantics; a TPU bandwidth experiment knob. "
+             "Restriction: custom_vjp has no JVP rule, so forward-mode "
+             "AD (jax.jvp/linearize) through max-pool fails with the "
+             "flag on; reverse-mode training is unaffected.")

@@ -350,6 +350,74 @@ def conv2d_transpose(ins, attrs):
     return {"Output": out}
 
 
+def _maxpool_first_match(x, window, wstrides, pads, spatial):
+    """Max-pool with a recompute-mask backward (FLAGS_maxpool_mask_bwd).
+
+    The default backward of lax.reduce_window(max) is
+    select_and_scatter_add — historically a slow lowering on TPU.  This
+    custom VJP reproduces its exact semantics (the FIRST max in
+    row-major window order receives the gradient) from recompute:
+    per-offset strided slices of the padded input are compared to the
+    saved output to find each window's first-match offset, and the
+    cotangent flows through a sum of mask-weighted slices whose
+    transpose is plain pad+add — window passes and shifted elementwise
+    ops only, all XLA-fusable.  A bandwidth experiment knob for the
+    ResNet stem (the largest tensor in the net feeds its maxpool)."""
+    kh, kw = window[spatial[0]], window[spatial[1]]
+    sh, sw = wstrides[spatial[0]], wstrides[spatial[1]]
+    pad_cfg = [(0, 0)] * x.ndim
+    pad_cfg[spatial[0]] = tuple(pads[spatial[0]])
+    pad_cfg[spatial[1]] = tuple(pads[spatial[1]])
+
+    def pool(xx):
+        return lax.reduce_window(xx, -jnp.inf, lax.max, window, wstrides,
+                                 [tuple(p) for p in pads])
+
+    def sl(xp, out_shape, o_h, o_w):
+        starts = [0] * x.ndim
+        strides = [1] * x.ndim
+        limits = list(xp.shape)
+        starts[spatial[0]], starts[spatial[1]] = o_h, o_w
+        strides[spatial[0]], strides[spatial[1]] = sh, sw
+        limits[spatial[0]] = o_h + (out_shape[spatial[0]] - 1) * sh + 1
+        limits[spatial[1]] = o_w + (out_shape[spatial[1]] - 1) * sw + 1
+        return lax.slice(xp, starts, limits, strides)
+
+    @jax.custom_vjp
+    def f(xx):
+        return pool(xx)
+
+    def fwd(xx):
+        y = pool(xx)
+        return y, (xx, y)
+
+    def bwd(res, dy):
+        xx, y = res
+        xp = jnp.pad(xx, pad_cfg, constant_values=-jnp.inf)
+        # first-match offset per window: iterate offsets in REVERSE
+        # row-major order so the earliest matching offset's assignment
+        # lands last (pad -inf never equals y, so pads never match)
+        first = jnp.full(y.shape, kh * kw, jnp.int32)
+        for oi in reversed(range(kh * kw)):
+            o_h, o_w = divmod(oi, kw)
+            first = jnp.where(sl(xp, y.shape, o_h, o_w) == y, oi, first)
+
+        def g(xin):
+            xq = jnp.pad(xin, pad_cfg)
+            acc = jnp.zeros(y.shape, xin.dtype)
+            for oi in range(kh * kw):
+                o_h, o_w = divmod(oi, kw)
+                acc = acc + sl(xq, y.shape, o_h, o_w) * \
+                    (first == oi).astype(xin.dtype)
+            return acc
+
+        _, vjp = jax.vjp(g, xx)
+        return (vjp(dy)[0],)
+
+    f.defvjp(fwd, bwd)
+    return f(x).astype(x.dtype)
+
+
 @register_op("pool2d")
 def pool2d(ins, attrs):
     x = ins["X"]
@@ -389,8 +457,16 @@ def pool2d(ins, attrs):
         padding[spatial[0]], padding[spatial[1]] = pads
 
     if ptype == "max":
-        out = lax.reduce_window(x, -jnp.inf, lax.max, window, wstrides, padding)
-        out = out.astype(x.dtype)
+        from .. import flags as _flags
+
+        if (_flags.flag("maxpool_mask_bwd") and padding != "SAME"
+                and jnp.issubdtype(x.dtype, jnp.floating)):
+            out = _maxpool_first_match(x, window, wstrides, padding,
+                                       spatial)
+        else:
+            out = lax.reduce_window(x, -jnp.inf, lax.max, window,
+                                    wstrides, padding)
+            out = out.astype(x.dtype)
     else:
         summed = lax.reduce_window(x, 0.0, lax.add, window, wstrides, padding)
         has_pad = padding == "SAME" or any(

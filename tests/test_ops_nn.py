@@ -325,3 +325,58 @@ def test_resnet_bn_stats_sample_wiring():
     bns = [l for l in m.sublayers(include_self=True)
            if isinstance(l, nn.BatchNorm)]
     assert bns and all(l._stats_sample == 8 for l in bns)
+
+
+def test_maxpool_mask_bwd_matches_select_and_scatter():
+    # FLAGS_maxpool_mask_bwd: the recompute-mask custom VJP must equal
+    # the default select_and_scatter backward bit-for-tie — quantized
+    # inputs force duplicate maxima inside overlapping 3x3/s2 windows
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu import flags
+    from paddle_tpu.ops import nn_ops
+
+    rng = np.random.default_rng(0)
+    # heavy quantization -> many exact ties (incl. across window overlap)
+    x = (rng.integers(-3, 4, (2, 9, 9, 5)) * 0.5).astype(np.float32)
+    attrs = {"ksize": [3, 3], "strides": [2, 2], "paddings": [1, 1],
+             "pooling_type": "max", "data_format": "NHWC"}
+
+    def run(flag):
+        flags.set_flags({"FLAGS_maxpool_mask_bwd": flag})
+        try:
+            def loss(xx):
+                out = nn_ops.pool2d({"X": xx}, attrs)["Out"]
+                # weighted sum so each window's grad routing is visible
+                w = jnp.arange(out.size, dtype=jnp.float32).reshape(out.shape)
+                return jnp.sum(out * w)
+            y = nn_ops.pool2d({"X": jnp.asarray(x)}, attrs)["Out"]
+            g = jax.grad(loss)(jnp.asarray(x))
+            return np.asarray(y), np.asarray(g)
+        finally:
+            flags.set_flags({"FLAGS_maxpool_mask_bwd": False})
+
+    y_ref, g_ref = run(False)
+    y_new, g_new = run(True)
+    np.testing.assert_array_equal(y_new, y_ref)
+    np.testing.assert_allclose(g_new, g_ref, rtol=0, atol=0)
+
+    # NCHW layout too
+    attrs_nchw = {"ksize": [3, 3], "strides": [2, 2], "paddings": [1, 1],
+                  "pooling_type": "max", "data_format": "NCHW"}
+    xn = np.transpose(x, (0, 3, 1, 2)).copy()
+
+    def run_nchw(flag):
+        flags.set_flags({"FLAGS_maxpool_mask_bwd": flag})
+        try:
+            def loss(xx):
+                out = nn_ops.pool2d({"X": xx}, attrs_nchw)["Out"]
+                return jnp.sum(out * (out + 1.0))
+            return np.asarray(jax.grad(loss)(jnp.asarray(xn)))
+        finally:
+            flags.set_flags({"FLAGS_maxpool_mask_bwd": False})
+
+    np.testing.assert_allclose(run_nchw(True), run_nchw(False),
+                               rtol=0, atol=0)

@@ -249,6 +249,21 @@ flags.set_flags({"FLAGS_use_pallas_layer_norm": True})
 r = _bench_gpt_mfu(cfg, 16, 512, 60, "bert_pallas_ln", peak)
 print("RESULT " + json.dumps(r), flush=True)
 """,
+    "resnet_maxpool_bwd_ab": """
+# r5: select_and_scatter (default maxpool bwd) vs the recompute-mask
+# custom VJP (FLAGS_maxpool_mask_bwd) on the headline resnet config —
+# the stem maxpool consumes the largest tensor in the net
+from bench import resnet50_time_config, _peak_flops
+from paddle_tpu import flags
+import jax, json
+peak = _peak_flops(jax.devices()[0])
+for use in (False, True):
+    flags.set_flags({"FLAGS_maxpool_mask_bwd": use})
+    r = resnet50_time_config(peak, batch=128, iters=40, bn_stats_sample=16)
+    r["maxpool_mask_bwd"] = use
+    print("PART " + json.dumps(r), flush=True)
+print("RESULT " + json.dumps({"ab": "done"}), flush=True)
+""",
     "bert_b48_pallas_ln": """
 # r5: the b16 A/B measured Pallas LN +0.7% (0.4841 vs 0.4808, r4
 # 10:45); rerun at the NEW default batch 48 — a win here flips the

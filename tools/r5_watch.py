@@ -28,6 +28,9 @@ def main():
             log({"r5_watch": "probe done — fused subset A/B"})
             run_experiment("resnet_fused_subset_ab",
                            EXPERIMENTS["resnet_fused_subset_ab"], 2400)
+            log({"r5_watch": "maxpool bwd A/B"})
+            run_experiment("resnet_maxpool_bwd_ab",
+                           EXPERIMENTS["resnet_maxpool_bwd_ab"], 2400)
             log({"r5_watch": "bert b48 pallas-LN A/B"})
             run_experiment("bert_b48_pallas_ln",
                            EXPERIMENTS["bert_b48_pallas_ln"], 1500)
