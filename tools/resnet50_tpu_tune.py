@@ -80,7 +80,8 @@ def main():
     # fused-bottleneck path (r4) against the per-conv XLA path
     for batch, remat, ss, fused in (
             (128, False, 16, False), (128, False, 32, False),
-            (192, False, 16, False), (256, False, 32, False),
+            (128, False, 8, False), (192, False, 16, False),
+            (256, False, 32, False),
             (128, False, 16, True), (128, True, 16, False)):
         try:
             r = time_config(batch, remat, stats_sample=ss, fused=fused)
