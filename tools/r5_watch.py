@@ -1,9 +1,21 @@
-"""Round-5 first-window orchestrator: probe > bench priority.
+"""Round-5 single-window orchestrator.
 
-Waits for the tunnel, runs the r5 ResNet traffic probe as the FIRST
-thing in the chip window (its results decide the round's perf work),
-then re-arms the tpu_capture daemon for the round's ongoing captures.
-One-shot: exits after the probe so the operator is notified.
+If the tunnel yields only ONE usable window this round, the order of
+operations decides how much of the round's mandate gets evidence:
+
+  1. full bench capture at HEAD  (VERDICT r4 #2 — the guaranteed win:
+     every BENCH_TPU row fresh, incl. the 4 never-captured configs)
+  2. resnet tuning sweep         (clean remat rows + adoption data for
+     the NEXT capture's headline config)
+  3. fused-subset / maxpool-bwd / pallas-LN A/Bs (the round's perf
+     experiments — each can flip a default)
+  4. the traffic probe           (diagnosis for further work)
+  5. re-arm tools/tpu_capture.py (sha-aware re-captures for the rest
+     of the round, picking up anything the A/Bs changed)
+
+One orchestrator, strictly ordered, every step under the chip lock —
+no probe/daemon lock races.  One-shot: exits after the chain so the
+operator is notified.
 """
 import os
 import subprocess
@@ -15,29 +27,32 @@ sys.path.insert(0, REPO)
 
 from tools.onchip_queue import (  # noqa: E402
     EXPERIMENTS, log, probe, run_experiment)
+from tools.tpu_capture import run_locked  # noqa: E402
 
 
 def main():
     deadline = time.time() + 11 * 3600
-    log({"r5_watch": "up"})
+    log({"r5_watch": "up (capture-first ordering)"})
     while time.time() < deadline:
         if probe():
-            log({"r5_watch": "tunnel up — running resnet probe"})
-            code = open(os.path.join(REPO, "tools/r5_resnet_probe.py")).read()
-            run_experiment("r5_resnet_probe", code, 3600)
-            log({"r5_watch": "probe done — fused subset A/B"})
+            log({"r5_watch": "tunnel up — 1/5 full bench capture"})
+            rc = run_locked("bench.py", 5400)
+            log({"r5_watch": "bench rc=%s — 2/5 tuning sweep" % rc})
+            rc2 = run_locked("tools/resnet50_tpu_tune.py", 5400)
+            log({"r5_watch": "sweep rc=%s — 3/5 A/Bs" % rc2})
             run_experiment("resnet_fused_subset_ab",
                            EXPERIMENTS["resnet_fused_subset_ab"], 2400)
-            log({"r5_watch": "maxpool bwd A/B"})
             run_experiment("resnet_maxpool_bwd_ab",
                            EXPERIMENTS["resnet_maxpool_bwd_ab"], 2400)
-            log({"r5_watch": "bert b48 pallas-LN A/B"})
             run_experiment("bert_b48_pallas_ln",
                            EXPERIMENTS["bert_b48_pallas_ln"], 1500)
-            log({"r5_watch": "re-arming capture daemon"})
+            log({"r5_watch": "4/5 traffic probe"})
+            code = open(os.path.join(REPO, "tools/r5_resnet_probe.py")).read()
+            run_experiment("r5_resnet_probe", code, 3600)
+            log({"r5_watch": "5/5 re-arming capture daemon"})
             subprocess.Popen(
                 [sys.executable, os.path.join(REPO, "tools/tpu_capture.py"),
-                 "--max-hours", "11", "--probe-timeout", "120",
+                 "--max-hours", "10", "--probe-timeout", "120",
                  "--bench-timeout", "5400", "--down-sleep", "300",
                  "--captured-sleep", "5400"],
                 cwd=REPO, start_new_session=True,
