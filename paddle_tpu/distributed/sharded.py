@@ -122,9 +122,37 @@ def shard_batch(mesh, *arrays, spec=None):
     return tuple(out) if len(out) > 1 else out[0]
 
 
-def shard_train_state(state, mesh, rules):
+def _zero1_spec(spec, shape, mesh):
+    """Add dp-sharding of dim 0 to an optimizer-moment spec (ZeRO-1).
+
+    The param itself stays replicated over dp (plain data parallelism);
+    only the OPTIMIZER STATE shards, cutting its memory by the dp
+    degree — the ZeRO-1 trade (arXiv:1910.02054 §5.1) expressed the
+    pjit way: annotate the moment arrays and let XLA partition the
+    update computation over dp and all-gather the new params.  A dim-0
+    axis of SIZE 1 (e.g. "tp" on a pure-DP mesh — which gpt_rules puts
+    on the vocab embedding, the largest param) counts as free, or the
+    headline memory saving would silently not materialize exactly
+    where it matters; indivisible dims are left for _named to clamp."""
+    dp = mesh.shape.get("dp", 1)
+    if dp <= 1 or not shape:
+        return spec
+
+    def axsize(a):
+        names = (a,) if isinstance(a, str) else (a or ())
+        return int(np.prod([mesh.shape[n] for n in names]))
+
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    if parts and axsize(parts[0]) == 1 and shape[0] % dp == 0:
+        parts[0] = "dp"
+        return P(*parts)
+    return spec
+
+
+def shard_train_state(state, mesh, rules, zero1=False):
     """Shard a models.train.TrainState: params + matching opt moments per
-    rules, buffers/step/rng replicated."""
+    rules, buffers/step/rng replicated.  zero1=True additionally shards
+    the optimizer moments' dim 0 over dp (see _zero1_spec)."""
     from ..models.train import TrainState
 
     params = shard_params(state.params, mesh, rules)
@@ -134,7 +162,10 @@ def shard_train_state(state, mesh, rules):
         for n, p in params.items():
             if ("/" + n + "/" in leaf_path or leaf_path.endswith("/" + n)) \
                     and np.shape(leaf) == np.shape(p):
-                return jax.device_put(leaf, _named(mesh, rules.spec(n), leaf))
+                spec = rules.spec(n)
+                if zero1:
+                    spec = _zero1_spec(spec, np.shape(leaf), mesh)
+                return jax.device_put(leaf, _named(mesh, spec, leaf))
         return jax.device_put(leaf, NamedSharding(mesh, P()))
 
     opt_state = _tree_map_with_path(shard_opt, state.opt_state)
@@ -160,18 +191,38 @@ def _tree_map_with_path(fn, tree, path=""):
 
 
 def make_sharded_train_step(model, optimizer, mesh, rules=None,
-                            loss_fn=None, rng_seed=0):
+                            loss_fn=None, rng_seed=0, zero1=False):
     """Build (step, sharded_state). step(state, *batch) -> (state, loss).
 
     The step function is models.train.make_train_step's jitted step —
     sharding is carried entirely by the arrays; XLA compiles the TP/DP/SP
     collectives from the NamedShardings. Batch arrays should be placed
     with shard_batch (dp×sp).
+
+    zero1=True shards the optimizer moments over dp (ZeRO-1): params
+    stay replicated, state memory divides by the dp degree, and XLA
+    partitions the update + all-gathers the fresh params — the
+    stage-1 memory optimisation the reference's DP never had.  The
+    output state's shardings are pinned to the input's: without the
+    constraint XLA's sharding inference returns dp-SHARDED params
+    after step 1, breaking the replicated-params contract and forcing
+    a recompile of the donated-state step on call 2.
     """
     from ..models.train import init_train_state, make_train_step
 
     rules = rules or gpt_rules()
     state = init_train_state(model, optimizer, rng_seed=rng_seed)
-    state = shard_train_state(state, mesh, rules)
-    step = make_train_step(model, optimizer, loss_fn=loss_fn, jit=True)
-    return step, state
+    state = shard_train_state(state, mesh, rules, zero1=zero1)
+    if not zero1:
+        step = make_train_step(model, optimizer, loss_fn=loss_fn, jit=True)
+        return step, state
+
+    inner = make_train_step(model, optimizer, loss_fn=loss_fn, jit=False)
+    state_sh = jax.tree.map(lambda a: a.sharding, state)
+
+    def step(st, *batch):
+        st2, loss = inner(st, *batch)
+        st2 = jax.tree.map(jax.lax.with_sharding_constraint, st2, state_sh)
+        return st2, loss
+
+    return jax.jit(step, donate_argnums=(0,)), state
