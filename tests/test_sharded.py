@@ -171,3 +171,45 @@ def test_zero1_shards_opt_state_and_matches_replicated():
             break
     assert m_leaf.sharding.shard_shape(m_leaf.shape) == (4, 32), \
         m_leaf.sharding
+
+
+def test_zero1_checkpoint_round_trip(tmp_path):
+    # sharded moments survive save/restore with their NamedShardings
+    # (orbax restores onto the template's shardings) and training resumes
+    import jax
+    import numpy as np
+
+    from paddle_tpu.checkpoint import load_checkpoint, save_checkpoint
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.sharded import (
+        make_sharded_train_step, mlp_rules, shard_batch)
+    from paddle_tpu import nn
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer.functional import Adam
+
+    nn.seed(5)
+    model = nn.Sequential(nn.Linear(16, 32, act="relu"), nn.Linear(32, 4))
+    mesh = build_mesh(dp=4, devices=jax.devices()[:4])
+    step, state = make_sharded_train_step(
+        model, Adam(0.01), mesh, rules=mlp_rules(),
+        loss_fn=lambda m, x, y: F.cross_entropy(m(x), y).mean(),
+        zero1=True)
+    rng = np.random.default_rng(0)
+    x, y = shard_batch(mesh,
+                       rng.standard_normal((8, 16)).astype(np.float32),
+                       rng.integers(0, 4, (8,)).astype(np.int32))
+    state, _ = step(state, x, y)
+    save_checkpoint(str(tmp_path), state, step=1)
+    restored, at = load_checkpoint(str(tmp_path), state, step=1)
+    assert at == 1
+    found = False
+    for path_leaf in jax.tree_util.tree_leaves_with_path(
+            restored.opt_state):
+        leaf = path_leaf[1]
+        if np.shape(leaf) == (16, 32):
+            assert leaf.sharding.shard_shape(leaf.shape) == (4, 32)
+            found = True
+            break
+    assert found
+    _, resumed_loss = step(restored, x, y)
+    assert np.isfinite(float(resumed_loss))
