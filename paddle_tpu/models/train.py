@@ -73,12 +73,18 @@ def _loss_with_buffers(model, params, buffers, rng, loss_fn, batch):
 
 
 def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
-                    grad_psum_axis=None, remat=False):
+                    grad_psum_axis=None, remat=False, accum_steps=1):
     """Build `step(state, *batch) -> (state, loss)`.
 
     loss_fn(model, *batch) -> scalar; defaults to model.loss.
     grad_psum_axis: mesh axis name(s) to pmean grads over (for use inside
     shard_map); plain pjit DP needs no explicit psum — XLA inserts it.
+    accum_steps=k > 1 splits the batch's leading dim into k microbatches
+    and lax.scans grad accumulation over them inside the ONE compiled
+    step (mean of microbatch grads, one optimizer update) — the
+    activation-memory lever for batch sizes whose activations don't fit,
+    with buffers (BN running stats) threaded through the scan exactly as
+    k sequential small steps would update them.
     remat: True rematerializes the whole forward in the backward pass
     (activations are not stored; ~1/3 more FLOPs for O(layer-io) memory).
     remat="conv_outs" saves ONLY conv outputs (the checkpoint_name tags
@@ -100,30 +106,66 @@ def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
     if isinstance(remat, str) and remat != "conv_outs":
         raise ValueError(
             f"unknown remat mode {remat!r}; use True or 'conv_outs'")
+    if int(accum_steps) < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if loss_fn is None:
         loss_fn = lambda m, *b: m.loss(*b)
     model.train()
 
-    def step(state, *batch):
-        rng, new_rng = jax.random.split(state.rng)
-
-        def loss_of(params):
-            return _loss_with_buffers(model, params, state.buffers, rng,
-                                      loss_fn, batch)
-
+    def _wrap_remat(loss_of):
+        # remat was validated at build time above
         if remat == "conv_outs":
-            loss_of = jax.checkpoint(
+            return jax.checkpoint(
                 loss_of,
                 policy=jax.checkpoint_policies.save_only_these_names(
                     "conv_out"))
-        elif isinstance(remat, str):
-            raise ValueError(
-                f"unknown remat mode {remat!r}; use True or 'conv_outs'")
-        elif remat:
-            loss_of = jax.checkpoint(loss_of)
+        if remat:
+            return jax.checkpoint(loss_of)
+        return loss_of
 
-        (loss, new_buffers), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(state.params)
+    def step(state, *batch):
+        rng, new_rng = jax.random.split(state.rng)
+
+        if accum_steps > 1:
+            k = accum_steps
+            for b in batch:
+                if b.shape[0] % k != 0:
+                    raise ValueError(
+                        f"batch leading dim {b.shape[0]} not divisible "
+                        f"into accum_steps={k} microbatches")
+            micro = tuple(
+                b.reshape(k, b.shape[0] // k, *b.shape[1:])
+                for b in batch)
+
+            def body(carry, xs):
+                gsum, bufs, lsum, i = carry
+
+                def loss_of(params):
+                    return _loss_with_buffers(
+                        model, params, bufs, jax.random.fold_in(rng, i),
+                        loss_fn, xs)
+
+                (l, newb), g = jax.value_and_grad(
+                    _wrap_remat(loss_of), has_aux=True)(state.params)
+                gsum = jax.tree.map(jnp.add, gsum, g)
+                return (gsum, newb, lsum + l.astype(jnp.float32),
+                        i + 1), None
+
+            gzero = jax.tree.map(jnp.zeros_like, state.params)
+            (gsum, new_buffers, lsum, _), _ = jax.lax.scan(
+                body,
+                (gzero, state.buffers, jnp.zeros((), jnp.float32),
+                 jnp.zeros((), jnp.int32)),
+                micro)
+            grads = jax.tree.map(lambda g: g / k, gsum)
+            loss = lsum / k
+        else:
+            def loss_of(params):
+                return _loss_with_buffers(model, params, state.buffers,
+                                          rng, loss_fn, batch)
+
+            (loss, new_buffers), grads = jax.value_and_grad(
+                _wrap_remat(loss_of), has_aux=True)(state.params)
         if grad_psum_axis:
             grads = jax.lax.pmean(grads, grad_psum_axis)
             loss = jax.lax.pmean(loss, grad_psum_axis)
