@@ -264,6 +264,60 @@ for use in (False, True):
     print("PART " + json.dumps(r), flush=True)
 print("RESULT " + json.dumps({"ab": "done"}), flush=True)
 """,
+
+    "bert_b48_profile": """
+# r5: per-op xplane profile of the b48 BERT headline step — where do
+# the ms go at the new default batch (attention / matmul / LN / CE)?
+import jax, jax.numpy as jnp, numpy as np, functools, glob, json, collections
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.models.train import init_train_state, make_train_step
+from paddle_tpu.optimizer.functional import AdamW
+cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                num_heads=12, max_seq_len=512, dtype="bfloat16")
+model = GPT(cfg)
+opt = AdamW(1e-4)
+state = init_train_state(model, opt)
+step = make_train_step(model, opt, jit=False)
+@functools.partial(jax.jit, donate_argnums=(0,))
+def run(state, x, y):
+    def body(st, _):
+        st, loss = step(st, x, y)
+        return st, loss
+    return jax.lax.scan(body, state, None, length=10)
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.integers(0, 32768, (48, 512)), jnp.int32)
+y = jnp.asarray(rng.integers(0, 32768, (48, 512)), jnp.int32)
+st, losses = run(state, x, y); float(losses[-1])
+with jax.profiler.trace("/root/repo/.prof_bert48"):
+    st, losses = run(st, x, y); float(losses[-1])
+import sys; sys.argv = ["x"]
+from tools.parse_xplane import load, device_plane
+f = glob.glob("/root/repo/.prof_bert48/**/*.xplane.pb", recursive=True)[-1]
+plane = device_plane(load(f))
+md = {m.id: m for m in plane.event_metadata.values()}
+smd = {m.id: m.name for m in plane.stat_metadata.values()}
+cats = collections.defaultdict(float)
+tops = collections.defaultdict(float)
+for line in plane.lines:
+    if line.name != "XLA Ops":
+        continue
+    for ev in line.events:
+        m = md.get(ev.metadata_id)
+        if m is None or m.name.startswith("%while"):
+            continue
+        cat = ""
+        for stt in m.stats:
+            if smd.get(stt.metadata_id) == "hlo_category":
+                cat = stt.str_value
+        cats[cat] += ev.duration_ps / 1e9 / 10
+        tops[m.name[:70]] += ev.duration_ps / 1e9 / 10
+top = sorted(tops.items(), key=lambda kv: -kv[1])[:12]
+print("RESULT " + json.dumps({
+    "per_step_ms_by_category": {k: round(v, 2) for k, v in
+                                sorted(cats.items(), key=lambda kv: -kv[1])
+                                if v > 0.05},
+    "top_ops_ms": {k: round(v, 2) for k, v in top}}), flush=True)
+""",
     "bert_b48_pallas_ln": """
 # r5: the b16 A/B measured Pallas LN +0.7% (0.4841 vs 0.4808, r4
 # 10:45); rerun at the NEW default batch 48 — a win here flips the

@@ -46,6 +46,8 @@ def main():
                            EXPERIMENTS["resnet_maxpool_bwd_ab"], 2400)
             run_experiment("bert_b48_pallas_ln",
                            EXPERIMENTS["bert_b48_pallas_ln"], 1500)
+            run_experiment("bert_b48_profile",
+                           EXPERIMENTS["bert_b48_profile"], 1200)
             log({"r5_watch": "4/5 traffic probe"})
             code = open(os.path.join(REPO, "tools/r5_resnet_probe.py")).read()
             run_experiment("r5_resnet_probe", code, 3600)
