@@ -18,6 +18,17 @@ Optimizer moments inherit param shardings for free: FunctionalOptimizer
 .init builds them with zeros_like(param), which preserves sharding — so
 Adam/LAMB state is automatically sharded like the weights (ZeRO-style for
 the tp-sharded slices).
+
+ZeRO staging under XLA (make_sharded_train_step(zero1=True) is stage 1):
+stage 2 (sharded GRADIENTS) has no separate array to annotate here —
+within the one compiled step XLA materializes each grad only between
+its producer and the update that consumes it and frees it immediately,
+so grad residency is already transient; the partitioner turns the
+dp-psum feeding a dp-sharded update into reduce-scatter where
+profitable.  Stage 3 (sharded PARAMS) is spelled differently in this
+framework: shard the params themselves via PartitionRules (fsdp-style
+specs) and XLA inserts the all-gathers per layer — no separate "zero3"
+flag is needed, the rules ARE the mechanism.
 """
 
 import re
