@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "PartitionRules", "gpt_rules", "bert_rules", "mlp_rules",
-    "shard_params", "shard_train_state", "shard_batch",
+    "fsdp_rules", "shard_params", "shard_train_state", "shard_batch",
     "make_sharded_train_step",
 ]
 
@@ -92,6 +92,20 @@ def mlp_rules():
     return PartitionRules([
         (r"\.weight$", P(None, "tp")),
         (r".*", P()),
+    ])
+
+
+def fsdp_rules():
+    """ZeRO-3/FSDP-style rules: every parameter's dim 0 shards over dp
+    (params, grads, AND moments all divide by the dp degree; XLA
+    all-gathers each layer's weights where the forward/backward needs
+    them and reduce-scatters grads into the sharded update).  Biases
+    and other small dims that don't divide are clamped to replicated by
+    _named.  Compose with gpt_rules via `fsdp_rules() + gpt_rules()`
+    ordering games only if you want tp+fsdp on DIFFERENT params —
+    for tp+fsdp on the SAME param use explicit per-name rules."""
+    return PartitionRules([
+        (r".*", P("dp")),
     ])
 
 

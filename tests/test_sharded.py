@@ -213,3 +213,63 @@ def test_zero1_checkpoint_round_trip(tmp_path):
     assert found
     _, resumed_loss = step(restored, x, y)
     assert np.isfinite(float(resumed_loss))
+
+
+def test_fsdp_rules_shard_params_and_match_replicated():
+    # ZeRO-3/FSDP spelled as partition rules: params themselves shard
+    # over dp; training matches the replicated run exactly (batch
+    # replicated per shard is NOT needed — params sharding is about
+    # memory layout, the math is identical)
+    import jax
+    import numpy as np
+
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.sharded import (
+        fsdp_rules, make_sharded_train_step, shard_batch)
+    from paddle_tpu.models.train import init_train_state, make_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu import nn
+    from paddle_tpu.optimizer.functional import Adam
+
+    def build():
+        nn.seed(31)
+        return nn.Sequential(nn.Linear(16, 32, act="relu"),
+                             nn.Linear(32, 4))
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y).mean()
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    y = rng.integers(0, 4, (8,)).astype(np.int32)
+
+    model = build()
+    ref_state = init_train_state(model, Adam(0.01))
+    ref_step = make_train_step(model, Adam(0.01), loss_fn=loss_fn)
+    ref = []
+    for _ in range(3):
+        ref_state, l = ref_step(ref_state, x, y)
+        ref.append(float(l))
+
+    mesh = build_mesh(dp=4, devices=jax.devices()[:4])
+    model2 = build()
+    step, state = make_sharded_train_step(model2, Adam(0.01), mesh,
+                                          rules=fsdp_rules(),
+                                          loss_fn=loss_fn)
+    # params themselves are dp-sharded: dim0 divides by 4
+    p = state.params["0.weight"]
+    assert p.sharding.shard_shape(p.shape) == (4, 32), p.sharding
+    # moments inherit the sharding for free
+    found = False
+    for pl in jax.tree_util.tree_leaves_with_path(state.opt_state):
+        if np.shape(pl[1]) == (16, 32):
+            assert pl[1].sharding.shard_shape(pl[1].shape) == (4, 32)
+            found = True
+            break
+    assert found, "no (16, 32) moment leaf found to check"
+    xb, yb = shard_batch(mesh, x, y)
+    got = []
+    for _ in range(3):
+        state, l = step(state, xb, yb)
+        got.append(float(l))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
