@@ -216,7 +216,8 @@ def _tree_map_with_path(fn, tree, path=""):
 
 
 def make_sharded_train_step(model, optimizer, mesh, rules=None,
-                            loss_fn=None, rng_seed=0, zero1=False):
+                            loss_fn=None, rng_seed=0, zero1=False,
+                            accum_steps=1):
     """Build (step, sharded_state). step(state, *batch) -> (state, loss).
 
     The step function is models.train.make_train_step's jitted step —
@@ -224,6 +225,9 @@ def make_sharded_train_step(model, optimizer, mesh, rules=None,
     collectives from the NamedShardings. Batch arrays should be placed
     with shard_batch (dp×sp).
 
+    accum_steps=k > 1 scans grad accumulation over k microbatches
+    inside the step (see models.train.make_train_step — batch leading
+    dims must divide by k); composes with zero1 and the rules.
     zero1=True shards the optimizer moments over dp (ZeRO-1): params
     stay replicated, state memory divides by the dp degree, and XLA
     partitions the update + all-gathers the fresh params — the
@@ -239,10 +243,12 @@ def make_sharded_train_step(model, optimizer, mesh, rules=None,
     state = init_train_state(model, optimizer, rng_seed=rng_seed)
     state = shard_train_state(state, mesh, rules, zero1=zero1)
     if not zero1:
-        step = make_train_step(model, optimizer, loss_fn=loss_fn, jit=True)
+        step = make_train_step(model, optimizer, loss_fn=loss_fn, jit=True,
+                               accum_steps=accum_steps)
         return step, state
 
-    inner = make_train_step(model, optimizer, loss_fn=loss_fn, jit=False)
+    inner = make_train_step(model, optimizer, loss_fn=loss_fn, jit=False,
+                            accum_steps=accum_steps)
     state_sh = jax.tree.map(lambda a: a.sharding, state)
 
     def step(st, *batch):

@@ -273,3 +273,49 @@ def test_fsdp_rules_shard_params_and_match_replicated():
         state, l = step(state, xb, yb)
         got.append(float(l))
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_zero1_with_grad_accumulation():
+    # accum_steps composes with zero1: microbatch scan inside the
+    # sharded step, same losses as the replicated full-batch run
+    import jax
+    import numpy as np
+
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.sharded import (
+        make_sharded_train_step, mlp_rules, shard_batch)
+    from paddle_tpu.models.train import init_train_state, make_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu import nn
+    from paddle_tpu.optimizer.functional import SGD
+
+    def build():
+        nn.seed(41)
+        return nn.Sequential(nn.Linear(16, 32, act="relu"),
+                             nn.Linear(32, 4))
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y).mean()
+
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((16, 16)).astype(np.float32)
+    y = rng.integers(0, 4, (16,)).astype(np.int32)
+
+    model = build()
+    ref_state = init_train_state(model, SGD(0.05))
+    ref_step = make_train_step(model, SGD(0.05), loss_fn=loss_fn)
+    ref = []
+    for _ in range(3):
+        ref_state, l = ref_step(ref_state, x, y)
+        ref.append(float(l))
+
+    mesh = build_mesh(dp=4, devices=jax.devices()[:4])
+    step, state = make_sharded_train_step(
+        build(), SGD(0.05), mesh, rules=mlp_rules(), loss_fn=loss_fn,
+        zero1=True, accum_steps=2)
+    xb, yb = shard_batch(mesh, x, y)
+    got = []
+    for _ in range(3):
+        state, l = step(state, xb, yb)
+        got.append(float(l))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
