@@ -1,12 +1,13 @@
-"""One-shot on-chip experiment queue: wait for the tunnel, run, exit.
+"""On-chip experiment registry + locked runner.
 
-Round-4 items queued behind the next tunnel window:
-  1. fused-bottleneck ResNet-50 timing (first Mosaic compile of the
-     fused kernels on real hardware — generous timeout, compile of the
-     8 stage-variant kernels is minutes)
-  2. transformer_flash batch sweep (8/12/16) hunting the 0.45 MFU
-     target
-Results append to ONCHIP_QUEUE.log as JSON lines; safe to re-run.
+EXPERIMENTS maps name -> self-contained code string; run_experiment
+acquires the chip flock IN-PROCESS (so the timeout clock measures chip
+time, not lock wait), runs the code in its own session, killpg's the
+whole tree on timeout, and logs PART/RESULT lines to ONCHIP_QUEUE.log.
+Round-5 additions: resnet_fused_subset_ab (id vs id_early vs unfused),
+resnet_maxpool_bwd_ab (FLAGS_maxpool_mask_bwd A/B), bert_b48_pallas_ln,
+bert_b48_profile.  tools/r5_watch.py sequences the round's chain
+(capture-first); main() below remains the standalone r4-style queue.
 """
 import json
 import os
