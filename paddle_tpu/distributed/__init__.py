@@ -17,16 +17,17 @@ from .mesh import build_mesh, default_mesh, get_global_mesh, set_global_mesh
 from .env import ParallelEnv, init_parallel_env, get_rank, get_world_size
 from .data_parallel import DataParallel, DataParallelTrainStep, scale_loss
 from .sharded import (
-    PartitionRules, gpt_rules, bert_rules, mlp_rules, shard_params,
-    shard_batch, shard_train_state, make_sharded_train_step,
+    PartitionRules, gpt_rules, bert_rules, mlp_rules, fsdp_rules,
+    shard_params, shard_batch, shard_train_state,
+    make_sharded_train_step,
 )
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import (gpipe, build_gpt_pipeline,
                        build_gpt_pipeline_3d)
 from .federated import FLClient, FLServer, run_fl_round
 from .moe import (
-    init_moe_params, moe_ffn, shard_moe_params, sharded_moe_ffn,
-    top_k_gating,
+    init_moe_params, moe_ffn, moe_ffn_shardmap, shard_moe_params,
+    sharded_moe_ffn, top_k_gating,
 )
 from .ps import (
     SparseEmbedding, Communicator, PSServer, PSClient, HeartBeatMonitor,
@@ -41,12 +42,13 @@ __all__ = [
     "DataParallel", "DataParallelTrainStep", "scale_loss",
     "PartitionRules", "gpt_rules", "bert_rules", "mlp_rules",
     "shard_params", "shard_batch", "shard_train_state",
-    "make_sharded_train_step",
+    "make_sharded_train_step", "fsdp_rules",
     "ring_attention", "ring_attention_sharded",
     "gpipe", "build_gpt_pipeline", "build_gpt_pipeline_3d",
     "SparseEmbedding", "Communicator", "PSServer", "PSClient",
     "HeartBeatMonitor",
     "FLServer", "FLClient", "run_fl_round",
-    "init_moe_params", "moe_ffn", "sharded_moe_ffn", "shard_moe_params",
+    "init_moe_params", "moe_ffn", "moe_ffn_shardmap", "sharded_moe_ffn",
+    "shard_moe_params",
     "top_k_gating",
 ]
