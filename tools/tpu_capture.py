@@ -20,6 +20,11 @@ Chip exclusivity: everything that touches the TPU takes a blocking
 flock on LOCK_PATH; interactive experiments should do the same
 (`flock /tmp/paddle_tpu_chip.lock -c "python ..."`).
 
+Outage diagnosis (r5): a responsive local relay (127.0.0.1:48271
+answers HTTP) while `jax.devices()` hangs means the upstream pod
+claim/grant is failing — external, unfixable from the container; keep
+probing out-of-process with a timeout and wait.
+
 Measurement-infrastructure parity with the reference's
 paddle/fluid/platform/profiler.h:206 and tools/timeline.py:137 roles.
 """
