@@ -319,3 +319,36 @@ def test_zero1_with_grad_accumulation():
         state, l = step(state, xb, yb)
         got.append(float(l))
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_named_sharding_clamps_and_pads_specs():
+    # _named is load-bearing for every sharding decision: specs clamp
+    # to rank, indivisible dims fall back to replicated, trailing Nones
+    # drop, and multi-axis entries multiply
+    import jax
+    import numpy as np
+
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.sharded import _named
+
+    mesh = build_mesh(dp=2, tp=2, devices=jax.devices()[:4])
+
+    # spec longer than rank: extra entries drop
+    s = _named(mesh, P("dp", "tp", None), np.zeros((4, 4)))
+    assert s.spec == P("dp", "tp"), s.spec
+    # indivisible dim un-shards (5 % 2 != 0)
+    s = _named(mesh, P("dp", "tp"), np.zeros((5, 4)))
+    assert s.spec == P(None, "tp"), s.spec
+    # fully indivisible -> replicated
+    s = _named(mesh, P("dp"), np.zeros((3,)))
+    assert s.spec == P(), s.spec
+    # multi-axis entry: ("dp","tp") needs dim % 4 == 0
+    s = _named(mesh, P(("dp", "tp")), np.zeros((8, 2)))
+    assert s.spec == P(("dp", "tp")), s.spec
+    s = _named(mesh, P(("dp", "tp")), np.zeros((6, 2)))
+    assert s.spec == P(), s.spec
+    # scalar: any spec collapses to replicated
+    s = _named(mesh, P("dp"), np.zeros(()))
+    assert s.spec == P(), s.spec
