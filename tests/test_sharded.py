@@ -352,3 +352,31 @@ def test_named_sharding_clamps_and_pads_specs():
     # scalar: any spec collapses to replicated
     s = _named(mesh, P("dp"), np.zeros(()))
     assert s.spec == P(), s.spec
+
+
+def test_zero1_spec_edge_cases():
+    # size-1 axis on dim 0 counts as free (pure-DP meshes must shard
+    # the vocab embedding's moments); indivisible dims stay unchanged;
+    # dp=1 meshes are a no-op
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.sharded import _zero1_spec
+
+    mesh = build_mesh(dp=4, devices=jax.devices()[:4])   # tp size 1
+    assert _zero1_spec(P("tp", None), (512, 64), mesh) == P("dp", None)
+    # trailing None is fine — _named strips it downstream
+    assert _zero1_spec(P(), (512, 64), mesh) == P("dp", None)
+    assert _zero1_spec(P("tp", None), (510, 64), mesh) == P("tp", None)
+    assert _zero1_spec(P(), (), mesh) == P()
+
+    mesh2 = build_mesh(dp=2, tp=2, devices=jax.devices()[:4])
+    # real tp axis on dim 0 -> untouched
+    assert _zero1_spec(P("tp", None), (512, 64), mesh2) == P("tp", None)
+    # free dim 0 -> dp added, tp preserved on dim 1
+    assert _zero1_spec(P(None, "tp"), (64, 64), mesh2) == P("dp", "tp")
+
+    mesh1 = build_mesh(dp=1, devices=jax.devices()[:1])
+    assert _zero1_spec(P(), (512, 64), mesh1) == P()
