@@ -1,0 +1,18 @@
+"""The driver-facing entry points must stay importable and jittable.
+
+dryrun_multichip(8) is exercised out-of-band (it takes minutes on the
+CPU mesh and the driver runs it directly); entry() is cheap enough to
+pin in the suite so an API drift can't brick the driver's single-chip
+compile check.
+"""
+
+import jax
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (4, 256, 8192)
+    assert str(out.dtype) == "float32"
