@@ -236,6 +236,243 @@ def resnet50_time_config(peak, batch=128, remat=False, iters=40,
     return r
 
 
+RESNET18_FWD_FLOPS_32 = 2 * 0.037e9     # per image at 32x32 (CPU grid)
+
+# the four independently-measurable ResNet-50 step-time levers (ISSUE 1);
+# each gets one isolated A/B row against the all-off base
+SWEEP_LEVERS = ("layout", "remat", "prefetch", "precision")
+
+
+def _time_feed_steps(step, state, batches_fn, prefetch, reps=3):
+    """Per-step seconds of a FEED-LOOP harness: every step's batch
+    starts on the HOST and enters via device_put — the input-pipeline
+    path `Executor.train_from_dataset` drives — either synchronously
+    per step (prefetch=False) or through reader.device_prefetch's
+    double buffer (prefetch=True), which has batch N+1's transfer in
+    flight while step N runs.  Unlike _time_steps' resident-batch scan,
+    input-pipeline time is part of the measurement — deliberately: it
+    is the only harness in which the prefetch lever is expressible, so
+    the WHOLE lever grid uses it to keep per-lever deltas comparable.
+    CONSUMES `state` (donated into the jitted step).
+
+    batches_fn: zero-arg callable returning a fresh iterable of host
+    batch tuples each rep (host arrays — the transfer is the point)."""
+    import jax
+
+    from paddle_tpu.reader import device_prefetch
+
+    jstep = jax.jit(step, donate_argnums=(0,))
+
+    def put(b):
+        return jax.tree_util.tree_map(jax.device_put, b)
+
+    # compile + first transfer outside the timed region
+    state, loss = jstep(state, *put(next(iter(batches_fn()))))
+    assert np.isfinite(float(loss.astype(np.float32))), \
+        "non-finite loss in warmup"
+    best = float("inf")
+    for _ in range(reps):
+        src = iter(batches_fn())
+        it = device_prefetch(src, size=2) if prefetch else map(put, src)
+        n = 0
+        t0 = time.perf_counter()
+        for b in it:
+            state, loss = jstep(state, *b)
+            n += 1
+        float(loss.astype(np.float32))          # device sync
+        best = min(best, (time.perf_counter() - t0) / max(n, 1))
+    return best, state
+
+
+def _sweep_payload(results):
+    """rows["resnet50_sweep"] payload from grid rows: per-lever isolated
+    deltas vs the all-off base, the best measured composition, and the
+    errored-config count (acceptance: zero)."""
+    timed = {r["config"]: r for r in results if "mfu" in r}
+    base = timed.get("base")
+    levers = {}
+    for lever in SWEEP_LEVERS:
+        row = timed.get(lever)
+        if base and row:
+            levers[lever] = {
+                "off_mfu": base["mfu"], "on_mfu": row["mfu"],
+                "delta_mfu": round(row["mfu"] - base["mfu"], 4),
+                "delta_pct": round(
+                    (row["mfu"] / base["mfu"] - 1) * 100, 1)}
+    best = (max(timed.values(), key=lambda r: r["mfu"])
+            if timed else None)
+    return {"metric": "resnet50_sweep", "harness": "feed_loop",
+            "levers": levers, "best": best, "configs": results,
+            "errors": sum(1 for r in results if "error" in r)}
+
+
+def _persist_sweep(results, device):
+    """Merge a (possibly partial) grid into BENCH_TPU.json — called
+    after EVERY timed config so a tunnel death mid-sweep keeps the rows
+    that measured; an all-error grid never clobbers a prior good one."""
+    if not any("mfu" in r for r in results):
+        return None
+    payload = _sweep_payload(results)
+    payload["device"] = device
+    payload["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
+    payload["git_sha"] = _git_sha()
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc["rows"]["resnet50_sweep"] = payload
+    _save_bench_tpu(doc)
+    return payload["best"]
+
+
+def resnet50_lever_grid(peak, on_tpu, iters=None, reps=None,
+                        on_result=None, extra_batches=(), batch=None):
+    """The per-lever ResNet-50 A/B grid (resnet50_sweep): one all-off
+    base row, one isolated row per lever, and two compositions —
+    `compose_fast` (layout+prefetch+precision; remat stays off because
+    recompute trades step time for memory) and `compose_all` — so the
+    on-chip evidence attributes the step-time delta to each lever
+    instead of blending them into one number.
+
+    Levers (off -> on):
+      layout:    NCHW -> NHWC model internals (channels-last convs, the
+                 TPU-native layout; the feed stays NCHW, models/resnet
+                 transposes once at entry)
+      remat:     jax.checkpoint around the pure loss (memory lever —
+                 expected NEGATIVE time delta; its row proving it RUNS
+                 is the point after BENCH_r05's UnexpectedTracerError)
+      prefetch:  reader.device_prefetch double buffer vs per-step
+                 synchronous device_put
+      precision: conv/matmul precision "highest" (fp32-accumulating
+                 MXU passes) -> "bfloat16" (single-pass bf16), the
+                 make_train_step(precision=) / FLAGS_conv_matmul_
+                 precision knob.  ~no-op on CPU, large on TPU.
+
+    All rows use the feed-loop harness (_time_feed_steps), so grid MFU
+    includes input-pipeline time and reads ~lower than the headline's
+    resident-batch scan MFU — compare rows within the grid, not against
+    the headline.  CPU scale: resnet18 @ 32x32 (grid logic + remat
+    regression); TPU scale: resnet50 bf16 @ 224x224.
+
+    on_result(results_so_far) fires after every config (incremental
+    persistence on chip); extra_batches adds compose_fast rows at other
+    batch sizes (the batch-knee role of the old tune sweep)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.train import init_train_state, make_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer.functional import Momentum
+
+    if on_tpu:
+        from paddle_tpu.models.resnet import resnet50 as build
+        dflt = dict(batch=128, size=224, classes=1000, dtype="bfloat16",
+                    ss=16, iters=20, reps=2,
+                    fwd_flops=RESNET50_FWD_FLOPS_224)
+    else:
+        from paddle_tpu.models.resnet import resnet18 as build
+        dflt = dict(batch=8, size=32, classes=10, dtype="float32",
+                    ss=0, iters=3, reps=2,
+                    fwd_flops=RESNET18_FWD_FLOPS_32)
+    # image size is fixed per scale: the per-image fwd_flops constant
+    # the MFU accounting uses is only valid at that size
+    size = dflt["size"]
+    iters = iters or dflt["iters"]
+    reps = reps or dflt["reps"]
+    classes, dtype, ss = dflt["classes"], dflt["dtype"], dflt["ss"]
+    jdt = jnp.bfloat16 if dtype == "bfloat16" else np.float32
+
+    def run_one(name, layout=False, remat=False, prefetch=False,
+                precision=False, batch=batch or dflt["batch"]):
+        model = build(num_classes=classes, dtype=dtype,
+                      data_format="NHWC" if layout else "NCHW",
+                      bn_stats_sample=ss)
+        opt = Momentum(0.1, 0.9)
+        state = init_train_state(model, opt)
+
+        def loss_fn(m, x, y):
+            return F.cross_entropy(m(x), y).mean()
+
+        step = make_train_step(
+            model, opt, loss_fn=loss_fn, jit=False, remat=remat,
+            precision="bfloat16" if precision else "highest")
+        rng = np.random.default_rng(0)
+        # a few distinct HOST batches, cycled: device_put per step is
+        # what the harness times, data variety just keeps XLA honest
+        host = [(rng.standard_normal((batch, 3, size, size))
+                 .astype(jdt),
+                 rng.integers(0, classes, (batch,)).astype(np.int32))
+                for _ in range(min(4, iters))]
+
+        def batches():
+            return (host[i % len(host)] for i in range(iters))
+
+        dt, _ = _time_feed_steps(step, state, batches, prefetch,
+                                 reps=reps)
+        mfu = 3.0 * dflt["fwd_flops"] * batch / dt / peak
+        row = {"config": name, "batch": batch,
+               "data_format": "NHWC" if layout else "NCHW",
+               "remat": bool(remat), "prefetch": bool(prefetch),
+               "precision": "bfloat16" if precision else "highest",
+               "step_ms": round(dt * 1e3, 2),
+               "samples_per_sec": round(batch / dt, 1),
+               "mfu": round(mfu, 4)}
+        if ss:
+            row["bn_stats_sample"] = ss
+        return row
+
+    grid = [("base", {}),
+            ("layout", {"layout": True}),
+            ("remat", {"remat": True}),
+            ("prefetch", {"prefetch": True}),
+            ("precision", {"precision": True}),
+            ("compose_fast", {"layout": True, "prefetch": True,
+                              "precision": True}),
+            ("compose_all", {"layout": True, "remat": True,
+                             "prefetch": True, "precision": True})]
+    for b in extra_batches:
+        grid.append(("compose_fast_b%d" % b,
+                     {"layout": True, "prefetch": True,
+                      "precision": True, "batch": b}))
+
+    results = []
+    for name, kw in grid:
+        try:
+            r = run_one(name, **kw)
+        except Exception as e:  # an errored row is a grid finding (the
+            # acceptance gate counts them), not a sweep killer
+            r = dict(config=name,
+                     error=f"{type(e).__name__}: {e}"[:160], **kw)
+        results.append(r)
+        if on_result is not None:
+            on_result(results)
+    return _sweep_payload(results)
+
+
+def main_resnet50_sweep():
+    """`python bench.py resnet50_sweep` — run the lever grid standalone
+    on whatever backend answers (CPU-scaled when the chip is absent);
+    one JSON line per config, the full payload LAST.  On chip, each
+    timed config is merged into BENCH_TPU.json as it lands."""
+    import jax
+
+    degraded = (os.environ.get("PADDLE_TPU_BENCH_NO_PROBE", "")
+                .lower() in ("1", "true", "yes") or not _probe_backend())
+    if degraded:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    peak = _peak_flops(dev)
+    device = str(getattr(dev, "device_kind", dev.platform))
+
+    def on_result(results):
+        print(json.dumps(results[-1]), flush=True)
+        if on_tpu:
+            _persist_sweep(results, device)
+
+    payload = resnet50_lever_grid(peak, on_tpu, on_result=on_result)
+    payload["device"] = device
+    print(json.dumps(payload), flush=True)
+    return 0 if not payload["errors"] else 1
+
+
 def bench_resnet50(on_tpu, peak):
     """BASELINE config 2: ResNet-50 train step, data-parallel path (one
     chip here; the DP program is the same jitted step the sharded test
@@ -292,9 +529,12 @@ def bench_resnet50(on_tpu, peak):
         best, fused_note = r, None
         prior = (doc.get("rows", {}).get("resnet_fused") or {})
         if fmt == "NHWC" and ss and prior.get("value"):
+            # same subset default as bench_resnet50_fused (full fused
+            # dies in the remote AOT helper), but scoped to THIS call:
+            # the default must not leak into the rest of the suite as
+            # process-global state
+            unset = "PADDLE_TPU_FUSED_SUBSET" not in os.environ
             try:
-                # same subset default as bench_resnet50_fused: full
-                # fused dies in the remote AOT helper
                 os.environ.setdefault("PADDLE_TPU_FUSED_SUBSET", "id")
                 rf = resnet50_time_config(peak, batch=128,
                                           data_format=fmt,
@@ -303,6 +543,9 @@ def bench_resnet50(on_tpu, peak):
                     best, fused_note = rf, round(r["mfu"], 4)
             except Exception as e:  # noqa: BLE001
                 fused_note = f"fused attempt failed: {e}"[:120]
+            finally:
+                if unset:
+                    os.environ.pop("PADDLE_TPU_FUSED_SUBSET", None)
         mfu = best["mfu"]
         out = {"metric": "resnet50_train_mfu", "value": mfu,
                "unit": "mfu_frac",
@@ -320,7 +563,7 @@ def bench_resnet50(on_tpu, peak):
         return out
 
     model = resnet18(num_classes=10, dtype="float32")
-    batch, size, iters, fwd_flops = 8, 32, 2, 2 * 0.037e9
+    batch, size, iters, fwd_flops = 8, 32, 2, RESNET18_FWD_FLOPS_32
     opt = Momentum(0.1, 0.9)
     state = init_train_state(model, opt)
 
@@ -876,4 +1119,8 @@ def main():
 
 
 if __name__ == "__main__":
+    import sys
+
+    if "resnet50_sweep" in sys.argv[1:]:
+        sys.exit(main_resnet50_sweep())
     main()

@@ -14,6 +14,7 @@ Top-level namespace mirrors the reference's `paddle.fluid` surface:
     exe.run(fluid.default_startup_program())
 """
 
+from . import _jax_compat  # noqa: F401  — must run before any jax use
 from . import flags
 from .flags import set_flags, get_flags
 

@@ -1,0 +1,81 @@
+"""Compatibility shims for the range of jax releases this repo meets.
+
+The package is developed against the TPU host's jax (where `jax.shard_map`
+is public API and takes `check_vma=`), but CI containers pin older
+releases where shard_map still lives in `jax.experimental.shard_map` and
+the kwarg is spelled `check_rep`.  Importing this module (the first thing
+`paddle_tpu/__init__.py` does) installs a forwarding `jax.shard_map` when
+the attribute is missing, so every call site — package modules, tests,
+tools — can uniformly say `jax.shard_map(...)` / `from jax import
+shard_map` with `check_vma=` and run on both.  Same treatment for the
+other new-jax spellings the package uses: `jax.lax.axis_size`,
+`jax.enable_x64`, and Pallas' `CompilerParams`.
+
+Nothing is patched when the attribute already exists.
+"""
+
+import inspect
+
+import jax
+
+
+def _install_shard_map():
+    if hasattr(jax, "shard_map"):
+        _esm = jax.shard_map
+        # mid-window releases have PUBLIC jax.shard_map but still the
+        # old `check_rep` kwarg — those need the translation below just
+        # as much as the experimental-module ones
+        if "check_vma" in inspect.signature(_esm).parameters:
+            return
+    else:
+        from jax.experimental.shard_map import shard_map as _esm
+
+    _params = set(inspect.signature(_esm).parameters)
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kw):
+        # new-jax spelling -> old-jax spelling (same meaning: replication
+        # / varying-manual-axes checking of the per-device body)
+        if "check_vma" in kw and "check_vma" not in _params:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    **kw)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size():
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        # psum of a Python constant is special-cased to a STATIC int
+        # (size * 1), so reshapes over the result stay shape-legal
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size
+
+
+def _install_enable_x64():
+    if hasattr(jax, "enable_x64"):
+        return
+    from jax.experimental import enable_x64
+
+    jax.enable_x64 = enable_x64
+
+
+def _install_pallas_compiler_params():
+    # new jax renamed pltpu.TPUCompilerParams -> CompilerParams; the
+    # kernels say the new name.  Pallas may legitimately be absent.
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+    except Exception:
+        return
+    if not hasattr(pltpu, "CompilerParams") \
+            and hasattr(pltpu, "TPUCompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+_install_shard_map()
+_install_axis_size()
+_install_enable_x64()
+_install_pallas_compiler_params()

@@ -158,6 +158,13 @@ def moe_ffn_shardmap(params, x, axis="ep", k=2, capacity_factor=1.25,
     d = x.shape[-1]
     toks = x.reshape(-1, d)
     e_loc = params["w1"].shape[0]
+    assert params["wg"].shape[-1] == ep * e_loc, (
+        f"moe_ffn_shardmap: router wg routes over "
+        f"{params['wg'].shape[-1]} experts but w1 holds {e_loc} local "
+        f"experts x {ep} '{axis}' shards = {ep * e_loc}.  Expert-major "
+        f"leaves (w1/w2) must be the LOCAL [E/ep, ...] slices of the "
+        f"global expert dim — pass params already sharded over '{axis}' "
+        f"(e.g. via moe_rules), not the replicated full-expert arrays.")
     dispatch, combine, aux = top_k_gating(
         toks, params["wg"], k=k, capacity_factor=capacity_factor)
     cap = dispatch.shape[-1]

@@ -68,7 +68,12 @@ class PartitionRules:
 
 
 def gpt_rules():
-    """Megatron TP sharding for models/gpt.py / models/bert.py naming."""
+    """Megatron TP sharding for models/gpt.py / models/bert.py naming.
+
+    No trailing `.*` catch-all: unmatched names already fall through to
+    PartitionRules' replicated default, and keeping the table specific
+    is what lets `gpt_rules() + fsdp_rules()` compose (a catch-all here
+    would shadow fsdp's `.*` -> P("dp") under first-match-wins)."""
     col = P(None, "tp")   # [in, out] -> out sharded
     row = P("tp", None)   # [in, out] -> in sharded
     return PartitionRules([
@@ -77,10 +82,11 @@ def gpt_rules():
         (r"(out_proj|fc2|linear2)\.weight$", row),
         (r"(wte|wpe|word_emb|pos_emb|embedding)\.weight$", P("tp", None)),
         # MoE expert-major weights shard over the expert-parallel axis;
-        # the router stays replicated
+        # the router stays replicated (it must match BEFORE any
+        # composed catch-all, hence an explicit rule despite equalling
+        # the default)
         (r"moe\.(w1|w2)$", P("ep", None, None)),
         (r"moe\.wg$", P()),
-        (r".*", P()),
     ])
 
 
@@ -89,9 +95,9 @@ def bert_rules():
 
 
 def mlp_rules():
+    # no `.*` catch-all for the same composability reason as gpt_rules
     return PartitionRules([
         (r"\.weight$", P(None, "tp")),
-        (r".*", P()),
     ])
 
 
@@ -101,9 +107,12 @@ def fsdp_rules():
     all-gathers each layer's weights where the forward/backward needs
     them and reduce-scatters grads into the sharded update).  Biases
     and other small dims that don't divide are clamped to replicated by
-    _named.  Compose with gpt_rules via `fsdp_rules() + gpt_rules()`
-    ordering games only if you want tp+fsdp on DIFFERENT params —
-    for tp+fsdp on the SAME param use explicit per-name rules."""
+    _named.  Compose with gpt_rules as `gpt_rules() + fsdp_rules()` —
+    specific rules FIRST, this catch-all LAST, since
+    PartitionRules.spec returns the FIRST matching rule (the reverse
+    order would have the `.*` -> P("dp") rule shadow every gpt rule).
+    That composition gives tp+fsdp on DIFFERENT params; for tp+fsdp on
+    the SAME param use explicit per-name rules."""
     return PartitionRules([
         (r".*", P("dp")),
     ])

@@ -113,6 +113,16 @@ declare_flag("use_pallas_dgc_topk", False,
              "Pallas histogram-threshold kernel instead of lax.top_k "
              "(approximate: keeps >= k elements).")
 
+# Default jax matmul/conv precision for compiled train/eval steps
+# ("" = jax's own default).  "bfloat16" pins conv+matmul inputs to the
+# bf16 MXU path (the explicit precision lever of the ResNet-50 A/B
+# grid); "highest"/"float32" forces full-precision accumulating passes
+# for numerics-sensitive runs.  Read by models/train.make_train_step
+# (precision=None) and framework/compiler.apply_precision_policy.
+declare_flag("conv_matmul_precision", "",
+             "Default matmul/conv precision for compiled steps "
+             "('', 'bfloat16', 'tensorfloat32', 'float32', 'highest').")
+
 declare_flag("maxpool_mask_bwd", False,
              "Give max-pool a recompute-mask custom VJP (window passes "
              "+ shifted compares, all XLA-fusable) instead of the "

@@ -18,7 +18,44 @@ devices is returned as shape [8] — average it like reference users do.
 
 import numpy as np
 
-__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+           "resolve_precision", "apply_precision_policy"]
+
+
+# ---------------------------------------------------------------------------
+# Precision policy — the explicit bf16 conv/matmul knob on compiled steps
+# ---------------------------------------------------------------------------
+
+def resolve_precision(program=None):
+    """Precision for a compiled step: the program's own override
+    (CompiledProgram.with_precision) wins, else FLAGS_conv_matmul_precision,
+    else None (jax's default).  Values: "bfloat16" (pin every dot/conv to
+    the bf16 MXU path — the precision lever of the ResNet-50 A/B grid),
+    "tensorfloat32", "float32"/"highest" (full-precision passes)."""
+    p = getattr(program, "_precision", None) if program is not None else None
+    if p is None:
+        from .. import flags
+
+        p = flags.flag("conv_matmul_precision") or None
+    return p
+
+
+def apply_precision_policy(fn, precision):
+    """Wrap a step callable so `jax.default_matmul_precision(precision)`
+    is active while jit TRACES it — every dot_general / conv the step
+    stages inherits the policy.  No-op for a falsy precision."""
+    if not precision:
+        return fn
+    import functools
+
+    import jax
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.default_matmul_precision(precision):
+            return fn(*args, **kwargs)
+
+    return wrapped
 
 
 class BuildStrategy:
@@ -71,6 +108,14 @@ class CompiledProgram:
         self._is_data_parallel = False
         self._dp_places = None
         self._loss_name = None
+        self._precision = None
+
+    def with_precision(self, precision):
+        """Pin the matmul/conv precision this program compiles with
+        ("bfloat16" | "tensorfloat32" | "float32" | "highest"); overrides
+        FLAGS_conv_matmul_precision for this program only."""
+        self._precision = precision
+        return self
 
     # -- reference API ---------------------------------------------------
     def with_data_parallel(self, loss_name=None, build_strategy=None,

@@ -558,7 +558,10 @@ class Executor:
     ):
         program = program if program is not None else default_main_program()
         # CompiledProgram / parallel wrapper support
+        from .compiler import resolve_precision
+
         dp_mesh = None
+        precision = resolve_precision(program)
         if hasattr(program, "_get_executable_program"):
             if getattr(program, "_is_data_parallel", False):
                 dp_mesh = program._dp_mesh()
@@ -575,14 +578,28 @@ class Executor:
         for name, value in feed.items():
             v = program.global_block()._find_var_recursive(name)
             dtype = to_jax_dtype(v.dtype) if v is not None and v.dtype else None
-            arr = jnp.asarray(np.asarray(value), dtype=dtype)
+            if isinstance(value, jax.Array):
+                # already on device (reader.device_prefetch path): any
+                # dtype cast stays device-side — a numpy round-trip here
+                # would forfeit the prefetched transfer
+                arr = value if dtype is None or value.dtype == dtype \
+                    else value.astype(dtype)
+            else:
+                arr = jnp.asarray(np.asarray(value), dtype=dtype)
             feed_arrays[name] = arr
 
         self._root_key, run_key = jax.random.split(self._root_key)
 
         if flags.flag("eager_executor") or flags.flag("check_nan_inf"):
-            return self._run_eager(program, feed_arrays, fetch_names, scope,
-                                   run_key, return_numpy)
+            # the debug path must execute at the SAME precision as the
+            # compiled step it stands in for, or the numerics being
+            # hunted (e.g. a NaN under check_nan_inf) need not reproduce
+            from .compiler import apply_precision_policy
+
+            return apply_precision_policy(
+                lambda: self._run_eager(program, feed_arrays, fetch_names,
+                                        scope, run_key, return_numpy),
+                precision)()
 
         persist_names = sorted(
             v.name for v in program.list_vars() if v.persistable
@@ -625,13 +642,14 @@ class Executor:
                         f"{a.shape}")
 
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               state_names, None if dp_mesh is None else dp_mesh.shape_tuple)
+               state_names, None if dp_mesh is None else dp_mesh.shape_tuple,
+               precision)
         # cache value holds the program so id() can't be recycled by a new
         # Program allocated at the same address after GC
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None or entry[1] is not program:
             compiled = self._build(program, fetch_names, tuple(persist_names),
-                                   dp_mesh=dp_mesh)
+                                   dp_mesh=dp_mesh, precision=precision)
             if use_program_cache:
                 self._cache[key] = (compiled, program)
         else:
@@ -770,7 +788,7 @@ class Executor:
             t = _threading.Thread(target=produce, daemon=True)
             t.start()
 
-            def prepared_batches():
+            def _host_batches():
                 try:
                     while True:
                         item = q.get()
@@ -781,6 +799,27 @@ class Executor:
                         yield item
                 finally:
                     stop.set()        # unblock + retire the producer
+
+            def prepared_batches():
+                gen = _host_batches()
+                if not entries and \
+                        not getattr(program, "_is_data_parallel", False):
+                    # dense single-device path: double-buffered DEVICE
+                    # prefetch on top of the host producer thread — feed
+                    # arrays are device_put while the previous step runs
+                    # (buffered_reader.cc's device double buffer).  The
+                    # sparse path keeps host batches: ids must stay host
+                    # arrays for the gradient push, and its overlap win
+                    # (the TCP pull) already lives on the producer
+                    # thread.  The data-parallel path also keeps host
+                    # batches: device_put would land the FULL batch on
+                    # device 0 for jit to reshard (an extra d2d hop +
+                    # device-0 memory spike), whereas the numpy feed
+                    # lets jit place each dp shard directly.
+                    from ..reader import device_prefetch
+
+                    gen = device_prefetch(gen, size=2)
+                return gen
         else:
             def prepared_batches():
                 for b in dataset:
@@ -843,14 +882,17 @@ class Executor:
                 needed |= set(ops[i].input_names())
         return [op for i, op in enumerate(ops) if keep[i]]
 
-    def _build(self, program, fetch_names, persist_names, dp_mesh=None):
+    def _build(self, program, fetch_names, persist_names, dp_mesh=None,
+               precision=None):
         ops = self._live_ops(program, fetch_names)
         sections = [] if program._is_test else list(program.backward_sections)
         return self._build_step(ops, sections, fetch_names, persist_names,
-                                dp_mesh)
+                                dp_mesh, precision=precision)
 
     def _build_step(self, ops, sections, fetch_names, persist_names,
-                    dp_mesh):
+                    dp_mesh, precision=None):
+        from .compiler import apply_precision_policy
+
         dp = dp_mesh is not None
 
         def make_step(dp):
@@ -859,7 +901,8 @@ class Executor:
         step = make_step(dp)
 
         if not dp:
-            return jax.jit(step, donate_argnums=(0,))
+            return jax.jit(apply_precision_policy(step, precision),
+                           donate_argnums=(0,))
 
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
@@ -902,11 +945,11 @@ class Executor:
 
                 out_fetch_specs = [
                     P("dp") if r >= 1 else P() for r in fetch_ranks]
-                fn = jax.jit(shard_map(
+                fn = jax.jit(apply_precision_policy(shard_map(
                     dp_step_shaped, mesh=dp_mesh,
                     in_specs=(P(), P("dp"), P()),
                     out_specs=(P(), out_fetch_specs),
-                    check_vma=False), donate_argnums=(0,))
+                    check_vma=False), precision), donate_argnums=(0,))
                 memo[sig] = fn
             return fn(state, feeds, key)
 

@@ -202,13 +202,20 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     w = helper.create_parameter(param_attr, shape=w_shape,
                                 dtype=helper.input_dtype(input))
     out_shape = None
-    if input.shape is not None and data_format == "NCHW":
-        n, _, h, wd = input.shape
+    if input.shape is not None:
+        # spatial dims sit at (2, 3) for NCHW, (1, 2) for NHWC — the
+        # channels-last (TPU-native) layout is a first-class path, so
+        # shape inference must not silently drop to unknown for it
+        if data_format == "NCHW":
+            n, _, h, wd = input.shape
+        else:
+            n, h, wd, _ = input.shape
         oh = ((int(h) + 2 * padding[0] - dilation[0] * (filter_size[0] - 1)
                - 1) // stride[0] + 1) if h is not None and h != -1 else None
         ow = ((int(wd) + 2 * padding[1] - dilation[1] * (filter_size[1] - 1)
                - 1) // stride[1] + 1) if wd is not None and wd != -1 else None
-        out_shape = (n, num_filters, oh, ow)
+        out_shape = ((n, num_filters, oh, ow) if data_format == "NCHW"
+                     else (n, oh, ow, num_filters))
     out = helper.create_variable_for_type_inference(input.dtype,
                                                     shape=out_shape)
     helper.append_op(
@@ -222,14 +229,20 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                                     is_bias=True)
         tmp = helper.create_variable_for_type_inference(out.dtype,
                                                         shape=out.shape)
+        # bias broadcasts over the CHANNEL dim: 1 for NCHW, trailing
+        # for NHWC (axis=-1 aligns y's dims to x's trailing dims)
         helper.append_op("elementwise_add", inputs={"X": out, "Y": b},
-                         outputs={"Out": tmp}, attrs={"axis": 1})
+                         outputs={"Out": tmp},
+                         attrs={"axis": 1 if data_format == "NCHW"
+                                else -1})
         out = tmp
     return helper.append_activation(out, act)
 
 
 def depthwise_conv2d(input, num_filters, filter_size, **kwargs):
-    kwargs["groups"] = int(input.shape[1])
+    kwargs["groups"] = int(
+        input.shape[1] if kwargs.get("data_format", "NCHW") == "NCHW"
+        else input.shape[-1])
     return conv2d(input, num_filters, filter_size, **kwargs)
 
 
@@ -272,14 +285,19 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
     st = [pool_stride, pool_stride] if isinstance(pool_stride, int) else list(pool_stride)
     pd = [pool_padding, pool_padding] if isinstance(pool_padding, int) else list(pool_padding)
     out_shape = None
-    if input.shape is not None and data_format == "NCHW":
-        n, c, h, wd = input.shape
+    if input.shape is not None:
+        if data_format == "NCHW":
+            n, c, h, wd = input.shape
+        else:
+            n, h, wd, c = input.shape
         if global_pooling:
-            out_shape = (n, c, 1, 1)
+            out_shape = ((n, c, 1, 1) if data_format == "NCHW"
+                         else (n, 1, 1, c))
         elif h is not None and h != -1 and wd is not None and wd != -1:
             oh = (int(h) + 2 * pd[0] - ps[0]) // st[0] + 1
             ow = (int(wd) + 2 * pd[1] - ps[1]) // st[1] + 1
-            out_shape = (n, c, oh, ow)
+            out_shape = ((n, c, oh, ow) if data_format == "NCHW"
+                         else (n, oh, ow, c))
     out = helper.create_variable_for_type_inference(input.dtype,
                                                     shape=out_shape)
     helper.append_op(

@@ -73,7 +73,8 @@ def _loss_with_buffers(model, params, buffers, rng, loss_fn, batch):
 
 
 def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
-                    grad_psum_axis=None, remat=False, accum_steps=1):
+                    grad_psum_axis=None, remat=False, accum_steps=1,
+                    precision=None):
     """Build `step(state, *batch) -> (state, loss)`.
 
     loss_fn(model, *batch) -> scalar; defaults to model.loss.
@@ -101,7 +102,17 @@ def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
     activations don't fit, not to go faster.
     jax.checkpoint must wrap the PURE params->loss function — wrapping a
     stateful `model(...)` call would leak buffer-update tracers across
-    the re-trace and die with UnexpectedTracerError.
+    the re-trace and die with UnexpectedTracerError.  Belt-and-braces
+    for the same failure class: the checkpointed function here takes
+    EVERY traced value (params, buffers, rng, batch) as an explicit
+    argument rather than a closure capture, so the recompute trace can
+    never hold a reference into the outer trace no matter how strict
+    the jax release is about closed-over tracers.
+    precision: jax matmul/conv precision for the whole compiled step
+    ("bfloat16" | "tensorfloat32" | "float32" | "highest" | None).
+    None defers to FLAGS_conv_matmul_precision ("" = jax default) —
+    the explicit bf16-MXU knob for perf A/Bs; numerics-sensitive runs
+    pass "highest".
     """
     if isinstance(remat, str) and remat != "conv_outs":
         raise ValueError(
@@ -111,17 +122,27 @@ def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
     if loss_fn is None:
         loss_fn = lambda m, *b: m.loss(*b)
     model.train()
+    if precision is None:
+        from ..framework.compiler import resolve_precision
 
-    def _wrap_remat(loss_of):
-        # remat was validated at build time above
-        if remat == "conv_outs":
-            return jax.checkpoint(
-                loss_of,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "conv_out"))
-        if remat:
-            return jax.checkpoint(loss_of)
-        return loss_of
+        precision = resolve_precision()
+
+    # The checkpointed callable: pure in its ARGUMENTS — params, buffers,
+    # rng, and the batch all enter as explicit inputs (saved residuals),
+    # never as closure-captured tracers, so the backward-pass recompute
+    # trace owns every value it touches.
+    def _loss_args(params, bufs, rng_key, *xs):
+        return _loss_with_buffers(model, params, bufs, rng_key, loss_fn,
+                                  xs)
+
+    if remat == "conv_outs":
+        _loss_args = jax.checkpoint(
+            _loss_args,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "conv_out"))
+    elif remat:
+        _loss_args = jax.checkpoint(_loss_args)
+    _grad = jax.value_and_grad(_loss_args, has_aux=True)
 
     def step(state, *batch):
         rng, new_rng = jax.random.split(state.rng)
@@ -139,14 +160,8 @@ def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
 
             def body(carry, xs):
                 gsum, bufs, lsum, i = carry
-
-                def loss_of(params):
-                    return _loss_with_buffers(
-                        model, params, bufs, jax.random.fold_in(rng, i),
-                        loss_fn, xs)
-
-                (l, newb), g = jax.value_and_grad(
-                    _wrap_remat(loss_of), has_aux=True)(state.params)
+                (l, newb), g = _grad(state.params, bufs,
+                                     jax.random.fold_in(rng, i), *xs)
                 gsum = jax.tree.map(jnp.add, gsum, g)
                 return (gsum, newb, lsum + l.astype(jnp.float32),
                         i + 1), None
@@ -160,12 +175,8 @@ def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
             grads = jax.tree.map(lambda g: g / k, gsum)
             loss = lsum / k
         else:
-            def loss_of(params):
-                return _loss_with_buffers(model, params, state.buffers,
-                                          rng, loss_fn, batch)
-
-            (loss, new_buffers), grads = jax.value_and_grad(
-                _wrap_remat(loss_of), has_aux=True)(state.params)
+            (loss, new_buffers), grads = _grad(state.params,
+                                               state.buffers, rng, *batch)
         if grad_psum_axis:
             grads = jax.lax.pmean(grads, grad_psum_axis)
             loss = jax.lax.pmean(loss, grad_psum_axis)
@@ -175,6 +186,13 @@ def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
                                buffers=new_buffers, step=state.step + 1,
                                rng=new_rng)
         return new_state, loss
+
+    if precision:
+        # active during tracing, so every dot/conv the step stages
+        # inherits the policy (jit traces under this context)
+        from ..framework.compiler import apply_precision_policy
+
+        step = apply_precision_policy(step, precision)
 
     if jit:
         step = jax.jit(step, donate_argnums=(0,) if donate else ())

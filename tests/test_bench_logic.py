@@ -57,3 +57,105 @@ def test_error_rows_carry_real_metric_names():
             ("bert_chunked_ce", "bert_chunked_ce_mfu"),
             ("transformer_h128", "transformer_h128_train_mfu")):
         assert f'("{key}", "{metric}"' in src, (key, metric)
+
+# ---------------------------------------------------------------------------
+# resnet50_sweep lever grid (ISSUE 1 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def _row(name, mfu, **kw):
+    r = {"config": name, "batch": 8, "data_format": "NCHW",
+         "remat": False, "prefetch": False, "precision": "highest",
+         "step_ms": 1.0, "samples_per_sec": 1.0, "mfu": mfu}
+    r.update(kw)
+    return r
+
+
+def test_sweep_payload_lever_deltas_and_best():
+    rows = [_row("base", 0.10),
+            _row("layout", 0.12, data_format="NHWC"),
+            _row("remat", 0.08, remat=True),
+            _row("prefetch", 0.11, prefetch=True),
+            _row("precision", 0.13, precision="bfloat16"),
+            _row("compose_fast", 0.15, data_format="NHWC",
+                 prefetch=True, precision="bfloat16")]
+    p = bench._sweep_payload(rows)
+    assert p["metric"] == "resnet50_sweep"
+    assert p["errors"] == 0
+    assert set(p["levers"]) == set(bench.SWEEP_LEVERS)
+    # isolated deltas vs the all-off base, sign preserved (remat is a
+    # memory lever — negative time delta is a finding, not an error)
+    assert p["levers"]["layout"]["delta_mfu"] == 0.02
+    assert p["levers"]["remat"]["delta_mfu"] == -0.02
+    assert p["levers"]["remat"]["delta_pct"] == -20.0
+    # best composition is the max measured row, whatever its levers
+    assert p["best"]["config"] == "compose_fast"
+
+
+def test_sweep_payload_counts_errors_and_survives_missing_base():
+    rows = [{"config": "base", "error": "Boom"},
+            _row("layout", 0.12, data_format="NHWC")]
+    p = bench._sweep_payload(rows)
+    assert p["errors"] == 1
+    assert p["levers"] == {}          # no base -> no deltas, no crash
+    assert p["best"]["config"] == "layout"
+
+
+def test_persist_sweep_partial_and_no_clobber(monkeypatch, tmp_path):
+    path = tmp_path / "BENCH_TPU.json"
+    monkeypatch.setattr(bench, "BENCH_TPU_PATH", str(path))
+    monkeypatch.setattr(bench, "_git_sha", lambda: "abc123")
+    # an all-error partial grid must not write anything
+    assert bench._persist_sweep([{"config": "base", "error": "x"}],
+                                "v5e") is None
+    assert not path.exists()
+    # a timed partial grid persists incrementally
+    rows = [_row("base", 0.10)]
+    bench._persist_sweep(rows, "v5e")
+    rows.append(_row("layout", 0.12, data_format="NHWC"))
+    best = bench._persist_sweep(rows, "v5e")
+    assert best["config"] == "layout"
+    doc = bench._load_bench_tpu()
+    saved = doc["rows"]["resnet50_sweep"]
+    assert saved["device"] == "v5e" and saved["git_sha"] == "abc123"
+    assert len(saved["configs"]) == 2
+    assert saved["levers"]["layout"]["delta_pct"] == 20.0
+
+
+def test_lever_grid_structure(monkeypatch):
+    """The grid wires every lever through a REAL model/step build (only
+    the timing is stubbed): 7 rows, each lever isolated exactly once,
+    compositions at the end, remat rows present and non-erroring."""
+    speeds = {"base": 1.0, "layout": 0.9, "remat": 1.3, "prefetch": 0.95,
+              "precision": 0.85, "compose_fast": 0.7, "compose_all": 1.1}
+    seen_prefetch = {}
+
+    def fake_time(step, state, batches_fn, prefetch, reps=3):
+        # the step must be a callable the real harness could jit; pull
+        # the config name back out via the call order below
+        name = order[len(seen_prefetch)]
+        seen_prefetch[name] = prefetch
+        return 0.1 * speeds[name], state
+
+    order = ["base", "layout", "remat", "prefetch", "precision",
+             "compose_fast", "compose_all"]
+    monkeypatch.setattr(bench, "_time_feed_steps", fake_time)
+    progressive = []
+    p = bench.resnet50_lever_grid(
+        1e11, False, on_result=lambda rs: progressive.append(len(rs)))
+    assert [r["config"] for r in p["configs"]] == order
+    assert p["errors"] == 0
+    assert progressive == list(range(1, 8))   # on_result after each row
+    # prefetch flag reaches the harness for exactly the prefetch rows
+    assert [n for n, pf in seen_prefetch.items() if pf] == \
+        ["prefetch", "compose_fast", "compose_all"]
+    # isolated rows flip exactly one lever vs base
+    base = p["configs"][0]
+    flips = {"layout": "data_format", "remat": "remat",
+             "prefetch": "prefetch", "precision": "precision"}
+    for name, field in flips.items():
+        row = next(r for r in p["configs"] if r["config"] == name)
+        diff = [k for k in ("data_format", "remat", "prefetch",
+                            "precision") if row[k] != base[k]]
+        assert diff == [field], (name, diff)
+    assert p["best"]["config"] == "compose_fast"

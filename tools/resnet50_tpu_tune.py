@@ -1,36 +1,27 @@
-"""ResNet-50 on-chip tuning sweep (VERDICT r3 #2 support).
+"""ResNet-50 on-chip lever sweep (VERDICT r3 #2 / ISSUE 1 tentpole).
 
-Runs small timed sweeps of the resnet50 bf16 NHWC train step on the
-real TPU chip — batch size x remat — and merges the results into
-BENCH_TPU.json under rows["resnet50_sweep"], so the first tunnel window
-yields not just the headline MFU but the data to pick the right batch
-and fix what the first-ever conv-stack measurement surfaces.
+Runs bench.py's per-lever A/B grid (`resnet50_lever_grid`) on the real
+TPU chip — base, one isolated row per lever (NHWC layout, remat,
+device prefetch, bf16 conv/matmul precision), the best composition,
+plus compose rows at larger batches (the batch-knee role of the old
+batch x remat sweep) — and merges the results into BENCH_TPU.json
+under rows["resnet50_sweep"], so the next tunnel window yields
+ATTRIBUTABLE per-lever deltas instead of a single blended number.
 
 Run only when the chip is up (the capture daemon invokes it after a
 successful bench capture); safe to run standalone:
   flock /tmp/paddle_tpu_chip.lock -c "python tools/resnet50_tpu_tune.py"
+CPU-scaled grid for checking the sweep itself: `python bench.py
+resnet50_sweep` with PADDLE_TPU_BENCH_NO_PROBE=1.
 """
 
 import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
-
-
-
-def time_config(batch, remat, iters=40, stats_sample=0, fused=False):
-    import jax
-
-    from bench import _peak_flops, resnet50_time_config
-
-    peak = _peak_flops(jax.devices()[0])
-    return resnet50_time_config(peak, batch=batch, remat=remat,
-                                iters=iters, bn_stats_sample=stats_sample,
-                                fused=fused)
 
 
 def main():
@@ -48,52 +39,25 @@ def main():
     if dev.platform != "tpu":
         print(json.dumps({"skipped": f"not on TPU ({dev.platform})"}))
         return 1
-    from bench import _git_sha, _load_bench_tpu, _save_bench_tpu
+    from bench import _peak_flops, _persist_sweep, resnet50_lever_grid
 
-    def persist(results):
-        # save after EVERY timed config (the tunnel can die mid-sweep
-        # and a timeout kill must not discard measured rows), and never
-        # clobber a previous good sweep with an all-error one
-        timed = [r for r in results if "mfu" in r]
-        if not timed:
-            return None
-        best = max(timed, key=lambda r: r["mfu"])
-        row = {"metric": "resnet50_sweep", "configs": results,
-               "best": best,
-               "device": str(getattr(dev, "device_kind", dev.platform)),
-               "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                            time.gmtime()),
-               "git_sha": _git_sha()}
-        doc = _load_bench_tpu() or {"rows": {}}
-        doc["rows"]["resnet50_sweep"] = row
-        _save_bench_tpu(doc)
-        return best
+    peak = _peak_flops(dev)
+    device = str(getattr(dev, "device_kind", dev.platform))
 
-    # fused rows ride the id-subset by default: the full-fused program
-    # exceeds the remote AOT helper's custom-call ceiling and dies
-    # server-side (TPU_WORKER_HOSTNAMES, r4) — an unset env must
-    # measure, not crash
-    os.environ.setdefault("PADDLE_TPU_FUSED_SUBSET", "id")
+    def on_result(results):
+        # print + persist after EVERY config: the tunnel can die
+        # mid-sweep and a timeout kill must not discard measured rows
+        # (_persist_sweep never clobbers a good sweep with an all-error
+        # one)
+        print(json.dumps(results[-1]), flush=True)
+        _persist_sweep(results, device)
 
-    results, best = [], None
-    # (batch, remat, stats_sample, fused); fused rows time the Pallas
-    # fused-bottleneck path (r4) against the per-conv XLA path
-    for batch, remat, ss, fused in (
-            (128, False, 16, False), (128, False, 32, False),
-            (128, False, 8, False), (192, False, 16, False),
-            (256, False, 32, False),
-            (128, False, 16, True), (128, True, 16, False)):
-        try:
-            r = time_config(batch, remat, stats_sample=ss, fused=fused)
-        except Exception as e:
-            r = {"batch": batch, "remat": remat, "stats_sample": ss,
-                 "fused": fused,
-                 "error": f"{type(e).__name__}: {e}"[:160]}
-        results.append(r)
-        print(json.dumps(r), flush=True)
-        best = persist(results) or best
-    print(json.dumps({"sweep_best": best}), flush=True)
-    return 0
+    payload = resnet50_lever_grid(peak, True, on_result=on_result,
+                                  extra_batches=(192, 256))
+    print(json.dumps({"sweep_best": payload["best"],
+                      "levers": payload["levers"],
+                      "errors": payload["errors"]}), flush=True)
+    return 0 if not payload["errors"] else 1
 
 
 if __name__ == "__main__":

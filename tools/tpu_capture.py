@@ -38,6 +38,10 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# at module scope, once: head_sha() runs every daemon-loop iteration,
+# and an insert there would grow sys.path unboundedly
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 LOCK_PATH = "/tmp/paddle_tpu_chip.lock"
 LOG_PATH = os.path.join(REPO, "tpu_capture.log")
 
@@ -107,7 +111,6 @@ def stale_row_keys(head, ignore=()):
 
 
 def head_sha():
-    sys.path.insert(0, REPO)
     from bench import _git_sha
     return _git_sha() or ""
 
