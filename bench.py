@@ -446,11 +446,12 @@ def resnet50_lever_grid(peak, on_tpu, iters=None, reps=None,
     return _sweep_payload(results)
 
 
-def main_resnet50_sweep():
-    """`python bench.py resnet50_sweep` — run the lever grid standalone
-    on whatever backend answers (CPU-scaled when the chip is absent);
-    one JSON line per config, the full payload LAST.  On chip, each
-    timed config is merged into BENCH_TPU.json as it lands."""
+def _resolve_backend():
+    """Shared standalone-entry-point preamble: probe the tunnel out of
+    process (PADDLE_TPU_BENCH_NO_PROBE=1 skips the probe and goes
+    straight to CPU — for fast local checks, never set by the driver),
+    fall back to the CPU backend when the chip is absent, and resolve
+    the device identity.  Returns (degraded, on_tpu, peak, device)."""
     import jax
 
     degraded = (os.environ.get("PADDLE_TPU_BENCH_NO_PROBE", "")
@@ -459,8 +460,16 @@ def main_resnet50_sweep():
         jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    peak = _peak_flops(dev)
-    device = str(getattr(dev, "device_kind", dev.platform))
+    return degraded, on_tpu, _peak_flops(dev), \
+        str(getattr(dev, "device_kind", dev.platform))
+
+
+def main_resnet50_sweep():
+    """`python bench.py resnet50_sweep` — run the lever grid standalone
+    on whatever backend answers (CPU-scaled when the chip is absent);
+    one JSON line per config, the full payload LAST.  On chip, each
+    timed config is merged into BENCH_TPU.json as it lands."""
+    _, on_tpu, peak, device = _resolve_backend()
 
     def on_result(results):
         print(json.dumps(results[-1]), flush=True)
@@ -888,6 +897,121 @@ def bench_flash_tiles(on_tpu, peak):
     return out
 
 
+def bench_dispatch_overhead(on_tpu, peak, steps=None):
+    """Host-overhead scoreboard for the Executor dispatch path (ISSUE 2
+    tentpole evidence): with PR 1 shrinking device step time, the host
+    term bounds LeNet-class small-step workloads, so it gets its own
+    persisted row.  Reported host μs/step, all on ONE small fc train
+    program through the PUBLIC Executor.run:
+
+      first_trace_ms : first run — program trace + XLA compile
+      cached_hit_us  : compiled-step cache hot, but the run-plan
+                       rebuilt every call (the pre-run-plan-cache
+                       steady state, forced by dropping
+                       program._run_plan_cache between calls)
+      fast_path_us   : both caches hot, return_numpy=False — pure host
+                       dispatch cost, no sync anywhere in the loop
+      blocking_us    : per-step host materialization (return_numpy=
+                       True), the old every-step sync for reference
+      steps_ahead    : dispatches the host completed before step 1's
+                       fetch came device-ready — measured async
+                       pipelining depth (0 means lockstep)
+    """
+    import jax
+
+    import paddle_tpu as fluid
+
+    steps = steps or (300 if on_tpu else 50)
+    with fluid.unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [None, 64])
+            y = fluid.data("y", [None, 1])
+            h = fluid.layers.fc(x, 64, act="relu")
+            pred = fluid.layers.fc(h, 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.01).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.default_rng(0)
+    feed = {
+        "x": jax.device_put(
+            rng.standard_normal((256, 64)).astype(np.float32)),
+        "y": jax.device_put(
+            rng.standard_normal((256, 1)).astype(np.float32)),
+    }
+
+    def run_once(return_numpy=False):
+        return exe.run(main, feed=feed, fetch_list=[loss], scope=scope,
+                       return_numpy=return_numpy)
+
+    t0 = time.perf_counter()
+    f = run_once()
+    np.asarray(f[0])                               # compile + sync
+    first_trace_ms = (time.perf_counter() - t0) * 1e3
+
+    def time_loop(prep=None, return_numpy=False):
+        """Avg host seconds/call over `steps` calls; the loop itself
+        never syncs (unless return_numpy does) — one final sync after
+        the clock stops drains the device queue for the next loop."""
+        run_once()                                  # warm
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            if prep is not None:
+                prep()
+            out = run_once(return_numpy=return_numpy)
+        dt = (time.perf_counter() - t0) / steps
+        np.asarray(out[0])                          # drain
+        return dt
+
+    def drop_plan():
+        main._run_plan_cache = None
+
+    cached_hit = time_loop(prep=drop_plan)
+    fast_path = time_loop()
+    blocking = time_loop(return_numpy=True)
+
+    # steps-ahead: dispatch until step 1's fetch reports device-ready
+    f0 = run_once()[0]
+    steps_ahead = None
+    if hasattr(f0, "is_ready"):
+        steps_ahead = 0
+        while not f0.is_ready() and steps_ahead < steps:
+            run_once()
+            steps_ahead += 1
+        np.asarray(f0)
+    return {"metric": "dispatch_overhead", "unit": "us_per_step",
+            "first_trace_ms": round(first_trace_ms, 1),
+            "cached_hit_us": round(cached_hit * 1e6, 1),
+            "fast_path_us": round(fast_path * 1e6, 1),
+            "blocking_us": round(blocking * 1e6, 1),
+            "steps_ahead": steps_ahead, "steps": steps,
+            "vs_baseline": None}
+
+
+def main_dispatch_overhead():
+    """`python bench.py dispatch_overhead` — run the host-overhead
+    scoreboard standalone on whatever backend answers (CPU fallback
+    when the chip is absent); prints the row as JSON and, on chip,
+    persists it under rows["dispatch_overhead"] in BENCH_TPU.json so
+    the host term is tracked over time alongside the device rows."""
+    _, on_tpu, peak, device = _resolve_backend()
+    r = bench_dispatch_overhead(on_tpu, peak)
+    r["device"] = device
+    if on_tpu:
+        row = dict(r)
+        row["git_sha"] = _git_sha()
+        row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime())
+        doc = _load_bench_tpu() or {"rows": {}}
+        doc.setdefault("rows", {})["dispatch_overhead"] = row
+        _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0
+
+
 def _git_sha():
     try:
         return subprocess.run(
@@ -944,20 +1068,7 @@ def _probe_backend(timeouts=(180, 240, 300), pause=20):
 
 
 def main():
-    import jax
-
-    # PADDLE_TPU_BENCH_NO_PROBE=1 skips the (up to 12-minute) tunnel
-    # probe and goes straight to CPU fallback — for fast local checks of
-    # the bench itself, never set by the driver or the capture daemon.
-    degraded = (os.environ.get("PADDLE_TPU_BENCH_NO_PROBE", "")
-                .lower() in ("1", "true", "yes")
-                or not _probe_backend())
-    if degraded:
-        jax.config.update("jax_platforms", "cpu")
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    peak = _peak_flops(dev)
-    device = str(getattr(dev, "device_kind", dev.platform))
+    degraded, on_tpu, peak, device = _resolve_backend()
     note = ("accelerator tunnel unavailable after 3 probe attempts; "
             "CPU fallback — tiny-shape numbers, not the TPU "
             "measurement") if degraded else None
@@ -1061,6 +1172,7 @@ def main():
          bench_transformer_h128),
         ("flash_tile_ab", "flash_tile_ab", bench_flash_tiles),
         ("bert_chunked_ce", "bert_chunked_ce_mfu", bench_bert_chunked_ce),
+        ("dispatch_overhead", "dispatch_overhead", bench_dispatch_overhead),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
 
     # SIGALRM only interrupts Python bytecode: a compile/RPC wedged
@@ -1123,4 +1235,6 @@ if __name__ == "__main__":
 
     if "resnet50_sweep" in sys.argv[1:]:
         sys.exit(main_resnet50_sweep())
+    if "dispatch_overhead" in sys.argv[1:]:
+        sys.exit(main_dispatch_overhead())
     main()

@@ -46,6 +46,9 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     program.backward_sections.append(
         BackwardSection(pos, loss.name, params, no_grad, ckpt_names)
     )
+    # a backward section changes the compiled step even when every @GRAD
+    # var already exists, so the run-plan/compiled caches must see it
+    program._bump()
 
     result = []
     for pname in params:
@@ -115,6 +118,7 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     program.backward_sections.append(
         BackwardSection(pos, loss.name, names, no_grad_set)
     )
+    program._bump()
     grads = []
     for n in names:
         v = block.var(n)

@@ -109,6 +109,7 @@ class CompiledProgram:
         self._dp_places = None
         self._loss_name = None
         self._precision = None
+        self._dp_mesh_cache = None   # (ndev, Mesh) — see _dp_mesh
 
     def with_precision(self, precision):
         """Pin the matmul/conv precision this program compiles with
@@ -146,9 +147,19 @@ class CompiledProgram:
         return len(places)
 
     def _dp_mesh(self):
+        """Mesh over the dp devices, memoized per device count: the
+        executor asks for it on EVERY run, and rebuilding a Mesh per
+        step is host dispatch overhead (plus a fresh object identity
+        for jit to hash).  Invalidates itself if with_data_parallel
+        re-targets a different number of places."""
         import jax
         from jax.sharding import Mesh
 
         n = self._dp_device_count()
+        cached = self._dp_mesh_cache
+        if cached is not None and cached[0] == n:
+            return cached[1]
         devs = np.array(jax.devices()[:n])
-        return Mesh(devs, ("dp",))
+        mesh = Mesh(devs, ("dp",))
+        self._dp_mesh_cache = (n, mesh)
+        return mesh

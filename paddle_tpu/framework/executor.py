@@ -18,6 +18,8 @@ Scope maps variable names to device arrays (parity: framework/scope.h:46,
 minus the parent-chain — programs here resolve names at trace time).
 """
 
+import contextlib
+
 import numpy as np
 
 import jax
@@ -27,7 +29,33 @@ from .. import flags
 from ..core.dtype import to_jax_dtype
 from ..core.place import default_place
 from ..ops.registry import get_op
+from .compiler import apply_precision_policy, resolve_precision
 from .program import Variable, default_main_program
+
+_profiler = None
+
+
+def _dispatch_span(name):
+    """profiler.RecordEvent span when a profiling session is active,
+    else a no-op context — the steady-state dispatch path must not grow
+    the profiler's event list on every step of a long training run."""
+    global _profiler
+    if _profiler is None:
+        from .. import profiler
+
+        _profiler = profiler
+    if _profiler.is_profiling():
+        return _profiler.RecordEvent(name)
+    return contextlib.nullcontext()
+
+
+def _materialize(fetches):
+    """Block on device fetches and copy them to host numpy arrays — the
+    ONE sync point of the dispatch path.  Every host materialization the
+    executor performs goes through here so the no-sync steady-state
+    contract of train_from_dataset is testable (a counting wrapper over
+    this function observes every sync)."""
+    return [np.asarray(f) for f in fetches]
 
 
 class Scope:
@@ -534,6 +562,52 @@ def _checkpoint_chunks(seg, checkpoint_names):
     return chunks
 
 
+class _RunPlan:
+    """Steady-state dispatch analysis for one (program, version).
+
+    The Fluid reference keeps its hot loop fast by doing program
+    analysis once (feed/fetch-targeted pruning, executor.py:236/274);
+    the per-call analogue here — the persist-name list, the
+    produced/read op-name sets, and the feed-name -> dtype map — is
+    computed ONCE per program mutation so a cached-hit Executor.run is
+    a dict lookup plus one compiled call, with no list_vars() scan.
+
+    The plan is stored on the Program itself (program._run_plan_cache),
+    so a recycled id() of a garbage-collected program can never alias
+    another program's plan; `version` pins it to the _version counter
+    every graph mutation bumps (Block.append_op / create_var), and
+    `program` guards against a foreign plan object being rebound onto
+    a different Program instance."""
+
+    __slots__ = ("program", "version", "persist_names", "produced",
+                 "read_names", "_feed_dtypes")
+
+    def __init__(self, program):
+        self.program = program
+        self.version = program._version
+        self.persist_names = tuple(sorted(
+            v.name for v in program.list_vars() if v.persistable))
+        produced, read = set(), set()
+        for op in program.global_block().ops:
+            produced.update(op.output_names())
+            read.update(op.input_names())
+        self.produced = produced
+        self.read_names = read
+        self._feed_dtypes = {}
+
+    def feed_dtype(self, name):
+        """Declared jax dtype of a feed var (None when undeclared) —
+        resolved through the block chain once per name, then served
+        from the plan."""
+        try:
+            return self._feed_dtypes[name]
+        except KeyError:
+            v = self.program.global_block()._find_var_recursive(name)
+            dt = to_jax_dtype(v.dtype) if v is not None and v.dtype else None
+            self._feed_dtypes[name] = dt
+            return dt
+
+
 class Executor:
     """Parity: fluid.Executor (executor.py:437)."""
 
@@ -545,6 +619,23 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+    @staticmethod
+    def _get_plan(program, use_program_cache=True):
+        """The program's run-plan: served from program._run_plan_cache
+        on a (same program, same _version) hit, rebuilt otherwise.
+        use_program_cache=False bypasses the cache entirely — neither
+        reads nor stores it (the same contract as the compiled-fn
+        cache)."""
+        if use_program_cache:
+            plan = getattr(program, "_run_plan_cache", None)
+            if plan is not None and plan.program is program \
+                    and plan.version == program._version:
+                return plan
+        plan = _RunPlan(program)
+        if use_program_cache:
+            program._run_plan_cache = plan
+        return plan
 
     # ------------------------------------------------------------------
     def run(
@@ -558,8 +649,6 @@ class Executor:
     ):
         program = program if program is not None else default_main_program()
         # CompiledProgram / parallel wrapper support
-        from .compiler import resolve_precision
-
         dp_mesh = None
         precision = resolve_precision(program)
         if hasattr(program, "_get_executable_program"):
@@ -574,93 +663,111 @@ class Executor:
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
 
-        feed_arrays = {}
-        for name, value in feed.items():
-            v = program.global_block()._find_var_recursive(name)
-            dtype = to_jax_dtype(v.dtype) if v is not None and v.dtype else None
-            if isinstance(value, jax.Array):
-                # already on device (reader.device_prefetch path): any
-                # dtype cast stays device-side — a numpy round-trip here
-                # would forfeit the prefetched transfer
-                arr = value if dtype is None or value.dtype == dtype \
-                    else value.astype(dtype)
-            else:
-                arr = jnp.asarray(np.asarray(value), dtype=dtype)
-            feed_arrays[name] = arr
+        with _dispatch_span("executor.run.prepare"):
+            plan = self._get_plan(program, use_program_cache)
 
-        self._root_key, run_key = jax.random.split(self._root_key)
+            feed_arrays = {}
+            feed_casts = {}
+            for name, value in feed.items():
+                dtype = plan.feed_dtype(name)
+                if isinstance(value, jax.Array):
+                    # already on device (reader.device_prefetch path): a
+                    # mismatched dtype is cast INSIDE the compiled step
+                    # (feed_casts), so the prefetched buffer costs the
+                    # dispatch path neither a host round-trip nor a
+                    # separate per-call cast dispatch
+                    if dtype is not None and value.dtype != dtype:
+                        feed_casts[name] = dtype
+                    feed_arrays[name] = value
+                else:
+                    feed_arrays[name] = jnp.asarray(np.asarray(value),
+                                                    dtype=dtype)
+
+            self._root_key, run_key = jax.random.split(self._root_key)
 
         if flags.flag("eager_executor") or flags.flag("check_nan_inf"):
             # the debug path must execute at the SAME precision as the
             # compiled step it stands in for, or the numerics being
-            # hunted (e.g. a NaN under check_nan_inf) need not reproduce
-            from .compiler import apply_precision_policy
-
+            # hunted (e.g. a NaN under check_nan_inf) need not reproduce.
+            # It interprets op-by-op, so feed casts happen up front.
+            if feed_casts:
+                feed_arrays = {
+                    n: (a.astype(feed_casts[n]) if n in feed_casts else a)
+                    for n, a in feed_arrays.items()}
             return apply_precision_policy(
                 lambda: self._run_eager(program, feed_arrays, fetch_names,
                                         scope, run_key, return_numpy),
                 precision)()
 
-        persist_names = sorted(
-            v.name for v in program.list_vars() if v.persistable
-        )
-        state = {}
-        missing = []
-        for n in persist_names:
-            val = scope.find_var(n)
-            if val is None:
-                missing.append(n)
-            else:
-                state[n] = val
-        # Vars never written before and not produced by this program are an
-        # error only if some op reads them; let interpretation raise lazily.
-        produced = set()
-        for op in program.global_block().ops:
-            produced.update(op.output_names())
-        state_names = tuple(sorted(state))
-        for n in missing:
-            if n in produced:
-                continue
-            read = any(n in op.input_names() for op in program.global_block().ops)
-            if read:
-                raise RuntimeError(
-                    f"persistable variable '{n}' is uninitialized; run the "
-                    f"startup program first"
-                )
+        with _dispatch_span("executor.run.state"):
+            state = {}
+            missing = []
+            for n in plan.persist_names:
+                val = scope.find_var(n)
+                if val is None:
+                    missing.append(n)
+                else:
+                    state[n] = val
+            # Vars never written before and not produced by this program
+            # are an error only if some op reads them; let interpretation
+            # raise lazily.
+            state_names = tuple(sorted(state))
+            for n in missing:
+                if n not in plan.produced and n in plan.read_names:
+                    raise RuntimeError(
+                        f"persistable variable '{n}' is uninitialized; run "
+                        f"the startup program first"
+                    )
 
-        feed_sig = tuple(
-            (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
-            for n in sorted(feed_arrays)
-        )
-        if dp_mesh is not None:
-            ndev = dp_mesh.devices.size
-            for n, a in feed_arrays.items():
-                if a.ndim == 0 or a.shape[0] % ndev != 0:
-                    raise ValueError(
-                        f"data-parallel feed '{n}' needs a leading batch "
-                        f"dim divisible by {ndev} devices, got "
-                        f"{a.shape}")
+            feed_sig = tuple(
+                (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
+                for n in sorted(feed_arrays)
+            )
+            if dp_mesh is not None:
+                ndev = dp_mesh.devices.size
+                for n, a in feed_arrays.items():
+                    if a.ndim == 0 or a.shape[0] % ndev != 0:
+                        raise ValueError(
+                            f"data-parallel feed '{n}' needs a leading "
+                            f"batch dim divisible by {ndev} devices, got "
+                            f"{a.shape}")
 
-        key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               state_names, None if dp_mesh is None else dp_mesh.shape_tuple,
-               precision)
-        # cache value holds the program so id() can't be recycled by a new
-        # Program allocated at the same address after GC
-        entry = self._cache.get(key) if use_program_cache else None
+            key = (id(program), plan.version, feed_sig, tuple(fetch_names),
+                   state_names,
+                   None if dp_mesh is None else dp_mesh.shape_tuple,
+                   precision)
+            # cache value holds the program so id() can't be recycled by a
+            # new Program allocated at the same address after GC
+            entry = self._cache.get(key) if use_program_cache else None
         if entry is None or entry[1] is not program:
-            compiled = self._build(program, fetch_names, tuple(persist_names),
-                                   dp_mesh=dp_mesh, precision=precision)
+            with _dispatch_span("executor.run.trace"):
+                compiled = self._build(program, fetch_names,
+                                       plan.persist_names, dp_mesh=dp_mesh,
+                                       precision=precision,
+                                       feed_casts=feed_casts)
             if use_program_cache:
                 self._cache[key] = (compiled, program)
         else:
             compiled = entry[0]
 
-        new_state, fetches = compiled(state, feed_arrays, run_key)
-        for n, v in new_state.items():
-            scope.set_var(n, v)
+        with _dispatch_span("executor.run.dispatch"):
+            # async dispatch: this returns device futures without a sync,
+            # and the donated `state` buffers are rebound to the NEW
+            # device arrays — never via a host copy, which would both
+            # block and resurrect freed donated buffers as host memory
+            new_state, fetches = compiled(state, feed_arrays, run_key)
+            for n, v in new_state.items():
+                scope.set_var(n, v)
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+            with _dispatch_span("executor.run.fetch"):
+                return _materialize(fetches)
+        # a fetch naming a persistable var ALIASES the buffer just bound
+        # into the scope; the NEXT run donates that buffer, which would
+        # invalidate a still-held device fetch.  A device-side copy (no
+        # sync) decouples it — donation stays sound across the no-sync
+        # steady state.
+        return [jnp.copy(f) if n in new_state else f
+                for n, f in zip(fetch_names, fetches)]
 
     # ------------------------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
@@ -825,24 +932,35 @@ class Executor:
                 for b in dataset:
                     yield prepare(b)
 
+        # Steady-state no-sync contract: fetches come back as DEVICE
+        # arrays (return_numpy=False) and are only materialized on host
+        # at print_period boundaries and for the final batch, so jax's
+        # async dispatch pipelines the host several steps ahead of the
+        # device (composing with the producer thread + device_prefetch
+        # double buffer above).  The sparse push is the one per-step
+        # exception: the gradient rows must reach the host to be pushed.
         last = None
         step_i = 0
         for feed, fl, batch_ids in prepared_batches():
-            out = self.run(program, feed=feed, fetch_list=fl, scope=scope)
+            out = self.run(program, feed=feed, fetch_list=fl, scope=scope,
+                           return_numpy=False)
             if entries and _sparse_push:
                 n = len(entries)
-                for e, g in zip(entries, out[-n:]):
-                    e["table"].push(batch_ids[e["emb_var"]], np.asarray(g))
+                grads = _materialize(out[-n:])
+                for e, g in zip(entries, grads):
+                    e["table"].push(batch_ids[e["emb_var"]], g)
                 out = out[:-n]
             last = out
             step_i += 1
             if (debug or fetch_info) and fetch_names \
                     and step_i % print_period == 0:
                 msg = ", ".join(
-                    f"{info}={np.asarray(v).mean():.6f}"
-                    for info, v in zip(fetch_info, out))
+                    f"{info}={v.mean():.6f}"
+                    for info, v in zip(fetch_info, _materialize(out)))
                 print(f"[train_from_dataset] step {step_i}: {msg}")
-        return last if fetch_names else None
+        if not fetch_names:
+            return None
+        return _materialize(last) if last is not None else None
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -883,21 +1001,21 @@ class Executor:
         return [op for i, op in enumerate(ops) if keep[i]]
 
     def _build(self, program, fetch_names, persist_names, dp_mesh=None,
-               precision=None):
+               precision=None, feed_casts=None):
         ops = self._live_ops(program, fetch_names)
         sections = [] if program._is_test else list(program.backward_sections)
         return self._build_step(ops, sections, fetch_names, persist_names,
-                                dp_mesh, precision=precision)
+                                dp_mesh, precision=precision,
+                                feed_casts=feed_casts)
 
     def _build_step(self, ops, sections, fetch_names, persist_names,
-                    dp_mesh, precision=None):
-        from .compiler import apply_precision_policy
-
+                    dp_mesh, precision=None, feed_casts=None):
         dp = dp_mesh is not None
 
         def make_step(dp):
             return self._make_step_fn(ops, sections, fetch_names,
-                                      persist_names, dp)
+                                      persist_names, dp,
+                                      feed_casts=feed_casts)
         step = make_step(dp)
 
         if not dp:
@@ -955,17 +1073,24 @@ class Executor:
 
         return compiled
 
-    def _make_step_fn(self, ops, sections, fetch_names, persist_names, dp):
+    def _make_step_fn(self, ops, sections, fetch_names, persist_names, dp,
+                      feed_casts=None):
         # optimizer-updated params: identical across dp replicas by
         # construction, so exempt from the SyncBN-style stats averaging
         param_names = set()
         for bs in sections:
             param_names.update(bs.param_names)
+        feed_casts = feed_casts or {}
 
         def step(state, feeds, key):
             env = {}
             env.update(state)
-            env.update(feeds)
+            # device-resident feeds whose dtype mismatches the declared
+            # var dtype are cast HERE, inside the compiled step — the
+            # cast fuses into the step instead of costing the dispatch
+            # path a separate per-call device computation
+            for n, v in feeds.items():
+                env[n] = v.astype(feed_casts[n]) if n in feed_casts else v
             const_env = {}
             rng_box = _RngBox(key)
             pos = 0

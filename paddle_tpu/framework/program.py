@@ -68,6 +68,22 @@ class Variable:
         self.lod_level = lod_level
 
     @property
+    def persistable(self):
+        return self._persistable
+
+    @persistable.setter
+    def persistable(self, value):
+        # layers toggle persistability on existing vars (plain attribute
+        # write); the flip changes the executor's persist-name analysis,
+        # so it must bump the program version like any other mutation or
+        # a cached run-plan would keep serving the stale persist set
+        value = bool(value)
+        old = getattr(self, "_persistable", None)
+        self._persistable = value
+        if old is not None and old != value:
+            self.block.program._bump()
+
+    @property
     def is_parameter(self):
         return isinstance(self, Parameter)
 
@@ -306,6 +322,11 @@ class Program:
         self._is_test = False
         # amp state set by amp.decorate; consulted by the executor
         self.amp_enabled = False
+        # executor run-plan (executor._RunPlan): the steady-state
+        # dispatch analysis cached per (program, _version).  Lives on
+        # the Program — not in an id()-keyed executor dict — so a
+        # recycled address after GC can never serve a stale plan.
+        self._run_plan_cache = None
 
     # -- structure ----------------------------------------------------------
 
@@ -326,6 +347,8 @@ class Program:
         self.current_block_idx = self.blocks[self.current_block_idx].parent_idx
 
     def _bump(self):
+        # every graph mutation lands here; the version compare is what
+        # invalidates the executor's run-plan + compiled-step caches
         self._version += 1
 
     def all_parameters(self):

@@ -21,17 +21,51 @@ import jax
 from . import flags
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "RecordEvent",
-           "cuda_profiler", "reset_profiler",
+           "cuda_profiler", "reset_profiler", "is_profiling",
            "export_chrome_tracing"]
 
+# Span storage: the nesting STACK is per-thread (spans nest within one
+# thread), but the recorded events are aggregated across threads —
+# train_from_dataset's producer thread records spans too, and events
+# landing in an unreachable threading.local would silently vanish from
+# stop_profiler's table and export_chrome_tracing (the thread-local
+# event-loss bug).  Every per-thread event list is registered in
+# _thread_events at first use; readers merge them, tagged with the tid.
 _state = threading.local()
+_registry_lock = threading.Lock()
+# append-only list of every thread's event list.  NOT keyed by tid:
+# thread idents are recycled after a thread exits, and a tid-keyed dict
+# would overwrite (and lose) a dead producer thread's events when a new
+# thread draws the same ident.  Each registered list stays reachable
+# from its thread's threading.local, so entries are cleared in place,
+# never removed (a retired thread costs one empty list).
+_event_lists = []
 
 
 def _events():
-    if not hasattr(_state, "events"):
-        _state.events = []
+    ev = getattr(_state, "events", None)
+    if ev is None:
+        ev = _state.events = []
         _state.stack = []
-    return _state.events
+        with _registry_lock:
+            _event_lists.append(ev)
+    return ev
+
+
+def _all_events():
+    """Every recorded event, across ALL threads, in timestamp order."""
+    with _registry_lock:
+        lists = list(_event_lists)
+    out = [e for evs in lists for e in evs]
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def _clear_events():
+    with _registry_lock:
+        lists = list(_event_lists)
+    for evs in lists:
+        del evs[:]    # in place: each thread keeps its registered list
 
 
 class RecordEvent:
@@ -55,6 +89,7 @@ class RecordEvent:
             "ts": self.start / 1000.0,
             "dur": (end - self.start) / 1000.0,
             "depth": len(_state.stack),
+            "tid": threading.get_ident(),
         })
         return False
 
@@ -62,8 +97,16 @@ class RecordEvent:
 _active = {"on": False, "jax_trace": False, "dir": None}
 
 
+def is_profiling():
+    """True while a start_profiler/profiler() session is active — the
+    executor's dispatch path checks this before opening RecordEvent
+    spans so steady-state training never accumulates events."""
+    return _active["on"]
+
+
 def start_profiler(state="All", tracer_option="Default"):
-    _events().clear()
+    _events()            # register this thread before clearing
+    _clear_events()
     _active["on"] = True
     if state in ("All", "GPU", "TPU"):
         trace_dir = flags.flag("profiler_dir")
@@ -83,7 +126,7 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
             jax.profiler.stop_trace()
         finally:
             _active["jax_trace"] = False
-    events = list(_events())
+    events = _all_events()
     if not events:
         return {}
     # aggregate table like the reference's per-op profiling report
@@ -110,12 +153,16 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 
 def export_chrome_tracing(path, events=None):
-    """chrome://tracing JSON (tools/timeline.py:137 parity)."""
-    events = events if events is not None else _events()
+    """chrome://tracing JSON (tools/timeline.py:137 parity).  Events
+    from every recording thread are included; each trace row carries
+    the real thread id so producer-thread spans (train_from_dataset
+    prefetch) land on their own timeline track."""
+    events = events if events is not None else _all_events()
     trace = {
         "traceEvents": [
             {"name": e["name"], "ph": "X", "ts": e["ts"], "dur": e["dur"],
-             "pid": 0, "tid": e.get("depth", 0), "cat": "host"}
+             "pid": 0, "tid": e.get("tid", e.get("depth", 0)),
+             "cat": "host", "args": {"depth": e.get("depth", 0)}}
             for e in events
         ]
     }
@@ -136,9 +183,10 @@ def profiler(state="All", sorted_key="total", profile_path="/tmp/profile",
 
 
 def reset_profiler():
-    """Clear all recorded events (reference profiler.py reset_profiler
-    parity) without stopping an active profiling session."""
-    _events().clear()
+    """Clear all recorded events — on every thread — (reference
+    profiler.py reset_profiler parity) without stopping an active
+    profiling session."""
+    _clear_events()
 
 
 @contextlib.contextmanager

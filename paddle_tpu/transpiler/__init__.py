@@ -240,6 +240,7 @@ class DistributeTranspiler:
         for sec in getattr(program, "backward_sections", []):
             if sec.pos > idx:
                 sec.pos -= 1
+        program._bump()   # invalidate the executor's run-plan cache
 
     @staticmethod
     def _slot_name(op, slot, outputs=False):

@@ -159,3 +159,31 @@ def test_lever_grid_structure(monkeypatch):
                             "precision") if row[k] != base[k]]
         assert diff == [field], (name, diff)
     assert p["best"]["config"] == "compose_fast"
+
+
+# ---------------------------------------------------------------------------
+# dispatch_overhead host scoreboard (ISSUE 2 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_overhead_row_shape():
+    """The scoreboard runs end-to-end on CPU and its row carries every
+    field BENCH_TPU consumers read.  No timing comparisons here: wall
+    numbers under suite load are noise (the cached-hit vs fast-path
+    ordering is asserted structurally by
+    test_dispatch_fastpath.test_cached_hit_skips_listvars_and_repruning,
+    which proves the work the fast path skips)."""
+    r = bench.bench_dispatch_overhead(False, 1e11, steps=15)
+    assert r["metric"] == "dispatch_overhead"
+    for k in ("first_trace_ms", "cached_hit_us", "fast_path_us",
+              "blocking_us", "steps_ahead", "steps"):
+        assert k in r, k
+    assert r["first_trace_ms"] > 0
+    assert r["fast_path_us"] > 0 and r["cached_hit_us"] > 0
+    assert r["steps_ahead"] is None or r["steps_ahead"] >= 0
+
+
+def test_dispatch_overhead_in_suite_and_standalone():
+    src = open(bench.__file__).read()
+    assert '("dispatch_overhead", "dispatch_overhead"' in src
+    assert '"dispatch_overhead" in sys.argv[1:]' in src

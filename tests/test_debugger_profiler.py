@@ -53,3 +53,48 @@ def test_profiler_chrome_trace(tmp_path):
     events = data["traceEvents"] if isinstance(data, dict) else data
     names = {e.get("name") for e in events}
     assert "train_step" in names
+
+
+def test_profiler_aggregates_events_across_threads(tmp_path):
+    """Spans recorded on a worker thread (train_from_dataset's producer)
+    must not vanish into an unreachable threading.local: stop_profiler's
+    table and export_chrome_tracing aggregate every thread's events,
+    tagged with the recording thread's tid."""
+    import threading
+
+    profiler.reset_profiler()
+    with profiler.profiler(state="CPU",
+                           profile_path=str(tmp_path / "prof")):
+        with profiler.RecordEvent("main_span"):
+            pass
+
+        def work():
+            with profiler.RecordEvent("producer_span"):
+                pass
+
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+    trace_path = str(tmp_path / "trace.json")
+    profiler.export_chrome_tracing(trace_path)
+    events = json.load(open(trace_path))["traceEvents"]
+    spans = {e["name"]: e for e in events}
+    assert {"main_span", "producer_span"} <= set(spans)
+    assert spans["main_span"]["tid"] != spans["producer_span"]["tid"]
+
+
+def test_profiler_table_counts_worker_spans():
+    """stop_profiler's aggregate table includes worker-thread spans."""
+    import threading
+
+    profiler.reset_profiler()
+    profiler.start_profiler(state="CPU")
+    threads = [threading.Thread(
+        target=lambda: profiler.RecordEvent("worker").__enter__().__exit__())
+        for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    table = profiler.stop_profiler(profile_path=None)
+    assert table["worker"]["calls"] == 3

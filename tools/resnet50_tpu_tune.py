@@ -23,6 +23,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
+# persistent compilation cache, BEFORE any jax import below: Mosaic
+# compiles must be paid once per git state, not once per process
+import jax_cache_env  # noqa: E402
+
+jax_cache_env.set_cache_env()
+
 
 def main():
     # the tunnel HANGS jax.devices() when down — probe out-of-process

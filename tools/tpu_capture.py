@@ -42,6 +42,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # and an insert there would grow sys.path unboundedly
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
+
+# Persistent compilation cache into THIS process's environ before any
+# jax init (the probe subprocesses and the benched scripts inherit it),
+# so every compile-capable entry point shares ONE cache and Mosaic
+# compiles are paid once per git state (jax_cache_env docstring).
+import jax_cache_env  # noqa: E402
+
+jax_cache_env.set_cache_env()
+
 LOCK_PATH = "/tmp/paddle_tpu_chip.lock"
 LOG_PATH = os.path.join(REPO, "tpu_capture.log")
 
