@@ -55,22 +55,19 @@ import jax_cache_env
 jax_cache_env.set_cache_env()
 
 
-PEAK_FLOPS = {
-    "v2": 22.5e12, "v3": 61.0e12, "v4": 137.5e12,
-    "v5e": 197e12, "v5p": 459e12, "v6e": 918e12, "v6": 918e12,
-}
 MFU_TARGET = 0.45
 RESNET50_FWD_FLOPS_224 = 4.089e9     # per image, published conv+fc count
 
 
 def _peak_flops(device):
-    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    for k in sorted(PEAK_FLOPS, key=len, reverse=True):
-        if k in kind:
-            return PEAK_FLOPS[k]
-    if device.platform == "cpu":
-        return 1e11
-    return 197e12
+    """Per-device peak FLOPs — the ONE table lives in
+    paddle_tpu.monitor (compile_ledger.PEAK_FLOPS), so the
+    hand-accounted bench MFU and the telemetry-ledger MFU can never
+    diverge on the peak.  Imported lazily: bench must not initialize
+    anything jax-adjacent before jax_cache_env is set."""
+    from paddle_tpu.monitor import peak_flops
+
+    return peak_flops(device)
 
 
 def _time_steps(step, state, batch, iters, reps=3):
@@ -83,8 +80,18 @@ def _time_steps(step, state, batch, iters, reps=3):
     iters also sets the dispatch-floor dilution: one tunnel round-trip
     costs tens of ms (r4: resnet step 53.1ms wall at iters=10 vs 45.8ms
     device-profiled, i.e. ~73ms floor / iters), so TPU configs use
-    iters large enough that floor/iters is ~1ms."""
+    iters large enough that floor/iters is ~1ms.
+
+    While telemetry is on (main()'s run_config enables it per config),
+    the scan's compile goes through monitor.instrument_jit — the
+    compile wall time, HLO cost-analysis FLOPs and memory_analysis
+    bytes land in the per-config ledger — and each timed rep is
+    recorded as `iters` observed steps, so every suite row can attach
+    a telemetry snapshot with an XLA-derived MFU next to the
+    hand-accounted one."""
     import jax
+
+    from paddle_tpu import monitor
 
     # donating the carried state lets XLA reuse the parameter buffers
     # across scan invocations instead of copying them
@@ -94,6 +101,10 @@ def _time_steps(step, state, batch, iters, reps=3):
             st, loss = step(st, *batch)
             return st, loss
         return jax.lax.scan(body, state, None, length=iters)
+
+    run = monitor.instrument_jit(run, key="bench_scan")
+    examples_per_scan = iters * int(np.shape(batch[0])[0]) \
+        if batch and np.ndim(batch[0]) else 0
 
     st, losses = run(state, *batch)
     # Donation invalidates `state` on TPU but is silently ignored on CPU;
@@ -107,7 +118,14 @@ def _time_steps(step, state, batch, iters, reps=3):
         t0 = time.perf_counter()
         st, losses = run(st, *batch)
         float(losses[-1])
-        best = min(best, (time.perf_counter() - t0) / iters)
+        rep_s = time.perf_counter() - t0
+        # ONE observed record per scan dispatch: the ledger's
+        # cost-analysis FLOPs cover the whole iters-step scan, so the
+        # matching "step" for MFU purposes is the scan invocation
+        # (flops and time both scale by iters; the ratio is per-step)
+        monitor.observe_steps(1, rep_s, examples=examples_per_scan,
+                              label=f"scan_x{iters}")
+        best = min(best, rep_s / iters)
     return best
 
 
@@ -259,9 +277,11 @@ def _time_feed_steps(step, state, batches_fn, prefetch, reps=3):
     batch tuples each rep (host arrays — the transfer is the point)."""
     import jax
 
+    from paddle_tpu import monitor
     from paddle_tpu.reader import device_prefetch
 
-    jstep = jax.jit(step, donate_argnums=(0,))
+    jstep = monitor.instrument_jit(jax.jit(step, donate_argnums=(0,)),
+                                   key="bench_feed_step")
 
     def put(b):
         return jax.tree_util.tree_map(jax.device_put, b)
@@ -280,7 +300,9 @@ def _time_feed_steps(step, state, batches_fn, prefetch, reps=3):
             state, loss = jstep(state, *b)
             n += 1
         float(loss.astype(np.float32))          # device sync
-        best = min(best, (time.perf_counter() - t0) / max(n, 1))
+        rep_s = time.perf_counter() - t0
+        monitor.observe_steps(n, rep_s, label="bench_feed_loop")
+        best = min(best, rep_s / max(n, 1))
     return best, state
 
 
@@ -1012,6 +1034,162 @@ def main_dispatch_overhead():
     return 0
 
 
+def _telemetry_brief(snap):
+    """Condense a monitor.snapshot() for embedding in a bench row:
+    keep the headline aggregates + compile accounting, drop the
+    per-program ledger and raw gauges (the full detail stays in the
+    in-process snapshot / JSONL).
+
+    The brief's MFU pairs the MOST RECENT compile event's FLOPs with
+    the LAST steady step time (not the mean): rows that time several
+    variants sequentially (unfused-then-fused resnet, tile A/Bs) would
+    otherwise divide one variant's FLOPs by a cross-variant mean —
+    a number that is no variant's MFU."""
+    if not snap or not (snap.get("steps") or snap.get(
+            "compile", {}).get("count")):
+        return None
+    out = {k: snap[k] for k in
+           ("steps", "step_time_s", "host_dispatch_us", "examples",
+            "examples_per_sec", "feed_bytes", "fetch_bytes", "counters")
+           if snap.get(k) is not None}
+    from paddle_tpu import monitor as _m
+
+    last_t = (snap.get("step_time_s") or {}).get("last")
+    mfu = _m.mfu(step_time_s=last_t) if last_t else None
+    if mfu is not None:
+        out["mfu"] = mfu
+    comp = snap.get("compile") or {}
+    out["compile"] = {k: comp[k] for k in
+                      ("count", "total_compile_ms", "flops",
+                       "bytes_accessed", "memory") if comp.get(k)
+                      is not None}
+    return out
+
+
+def bench_telemetry_smoke(on_tpu, peak):
+    """Telemetry smoke row (ISSUE 3 CI satellite): run a tiny fc train
+    loop through the PUBLIC Executor.run with telemetry on — on the CPU
+    mesh when >1 host device is visible, single-device otherwise — and
+    assert the snapshot is well-formed: non-zero steps, monotone
+    step-record timestamps, compile count+time, memory_analysis bytes,
+    cache hit AND miss counts, and an MFU derived from XLA cost
+    analysis (no hand-coded FLOP formula anywhere in this row).
+
+    Side effect: the PROCESS-GLOBAL monitor is reset (twice) — any
+    surrounding telemetry session loses its accumulated records, and a
+    caller-attached JSONL writer is detached (only the enabled/disabled
+    state is restored).  In the suite this is moot (run_config resets
+    per config); standalone callers should snapshot first."""
+    import tempfile
+
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+
+    steps = 8
+    batch = 64
+    was_enabled = monitor.is_enabled()
+    monitor.reset()
+    jsonl = os.path.join(tempfile.mkdtemp(prefix="paddle_tpu_tel_"),
+                         "telemetry.jsonl")
+    monitor.enable(jsonl_path=jsonl)
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [None, 64])
+                y = fluid.data("y", [None, 1])
+                h = fluid.layers.fc(x, 64, act="relu")
+                pred = fluid.layers.fc(h, 1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(0.01).minimize(loss)
+        ndev = len(jax.devices())
+        mesh_devices = ndev if ndev > 1 and batch % ndev == 0 else 1
+        prog = main
+        if mesh_devices > 1:
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name,
+                places=mesh_devices).with_telemetry("telemetry_smoke")
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        rng = np.random.default_rng(0)
+        feed = {"x": rng.standard_normal((batch, 64)).astype(np.float32),
+                "y": rng.standard_normal((batch, 1)).astype(np.float32)}
+        for _ in range(steps):
+            exe.run(prog, feed=feed, fetch_list=[loss], scope=scope,
+                    return_numpy=False)
+
+        snap = monitor.snapshot()
+        records = monitor.step_records()
+        counters = snap.get("counters", {})
+        checks = {
+            # startup run + train steps all recorded
+            "steps_recorded": snap.get("steps", 0) >= steps,
+            "timestamps_monotone": all(
+                a["ts_us"] < b["ts_us"]
+                for a, b in zip(records, records[1:])),
+            "step_time_present": bool(
+                (snap.get("step_time_s") or {}).get("mean")),
+            "host_dispatch_present": bool(
+                (snap.get("host_dispatch_us") or {}).get("mean")),
+            "cache_hits": counters.get("run_plan.hit", 0) > 0
+            and counters.get("compiled_step.hit", 0) > 0,
+            "cache_misses": counters.get("run_plan.miss", 0) > 0
+            and counters.get("compiled_step.miss", 0) > 0,
+            "compile_counted": snap["compile"].get("count", 0) >= 1
+            and snap["compile"].get("total_compile_ms", 0) > 0,
+            "memory_bytes": (snap["compile"].get("memory") or {})
+            .get("temp_bytes") is not None,
+            "mfu_from_cost_analysis": isinstance(
+                snap.get("mfu"), float) and snap["mfu"] > 0,
+            "jsonl_round_trip": len(monitor.read_jsonl(jsonl))
+            == len(records),
+        }
+        row = {"metric": "telemetry_smoke",
+               "value": int(all(checks.values())), "unit": "ok",
+               "vs_baseline": None, "steps": snap.get("steps"),
+               "mesh_devices": mesh_devices, "checks": checks,
+               "telemetry": _telemetry_brief(snap)}
+        if not all(checks.values()):
+            row["error"] = "failed checks: " + ", ".join(
+                k for k, v in checks.items() if not v)
+        return row
+    finally:
+        monitor.disable()
+        monitor.reset()
+        if was_enabled:
+            monitor.enable()
+
+
+def main_telemetry_smoke():
+    """`python bench.py telemetry_smoke` — CI/tooling entry: the smoke
+    row standalone on a 2-device virtual CPU mesh (the env var must
+    land before jax initialises), persisted to BENCH_TPU.json under
+    rows["telemetry_smoke"] like the other rows.  Exit 0 only when
+    every well-formedness check passes."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=2")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_telemetry_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["telemetry_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def _git_sha():
     try:
         return subprocess.run(
@@ -1120,6 +1298,19 @@ def main():
         old = None
         r = None
         completed = False
+        # per-config telemetry: each suite row runs with the monitor on
+        # over a freshly-reset registry/ledger, and attaches the brief
+        # snapshot (steps, compile count/time, XLA FLOPs + memory
+        # bytes, ledger MFU) so every row carries machine-readable
+        # counters alongside its hand-accounted numbers.  EXCEPT
+        # dispatch_overhead: that row measures the bare host-dispatch
+        # floor, and per-step telemetry recording would be measured
+        # INTO it (observer effect) — it runs with the monitor off.
+        from paddle_tpu import monitor as _monitor
+
+        _monitor.reset()
+        if key != "dispatch_overhead":
+            _monitor.enable()
         try:
             if budget:
                 old = signal.signal(signal.SIGALRM, _alarm)
@@ -1134,6 +1325,10 @@ def main():
             except _ConfigTimeout:
                 if not completed:
                     raise
+            if isinstance(r, dict) and "telemetry" not in r:
+                brief = _telemetry_brief(_monitor.snapshot())
+                if brief is not None:
+                    r["telemetry"] = brief
             return record(key, r)
         except _ConfigTimeout:
             if completed:
@@ -1152,6 +1347,7 @@ def main():
             return {"metric": metric, "error": f"{type(e).__name__}: {e}"[:200],
                     "device": device}
         finally:
+            _monitor.disable()
             if budget and old is not None:
                 signal.signal(signal.SIGALRM, old)
 
@@ -1173,6 +1369,7 @@ def main():
         ("flash_tile_ab", "flash_tile_ab", bench_flash_tiles),
         ("bert_chunked_ce", "bert_chunked_ce_mfu", bench_bert_chunked_ce),
         ("dispatch_overhead", "dispatch_overhead", bench_dispatch_overhead),
+        ("telemetry_smoke", "telemetry_smoke", bench_telemetry_smoke),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
 
     # SIGALRM only interrupts Python bytecode: a compile/RPC wedged
@@ -1237,4 +1434,6 @@ if __name__ == "__main__":
         sys.exit(main_resnet50_sweep())
     if "dispatch_overhead" in sys.argv[1:]:
         sys.exit(main_dispatch_overhead())
+    if "telemetry_smoke" in sys.argv[1:]:
+        sys.exit(main_telemetry_smoke())
     main()

@@ -92,6 +92,7 @@ from . import reader
 from . import dataset
 from . import metrics
 from . import profiler
+from . import monitor
 from . import nn
 from . import dygraph
 from . import distributed
@@ -117,7 +118,8 @@ __all__ = [
     "scope_guard", "append_backward", "gradients", "ParamAttr",
     "initializer", "unique_name", "backward", "layers", "optimizer",
     "regularizer", "clip", "io", "reader", "dataset", "metrics",
-    "profiler", "nn", "dygraph", "distributed", "amp", "jit", "models",
+    "profiler", "monitor", "nn", "dygraph", "distributed", "amp", "jit",
+    "models",
     "contrib",
     "DataLoader",
 ]

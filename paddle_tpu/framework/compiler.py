@@ -109,6 +109,7 @@ class CompiledProgram:
         self._dp_places = None
         self._loss_name = None
         self._precision = None
+        self._telemetry_label = None
         self._dp_mesh_cache = None   # (ndev, Mesh) — see _dp_mesh
 
     def with_precision(self, precision):
@@ -116,6 +117,15 @@ class CompiledProgram:
         ("bfloat16" | "tensorfloat32" | "float32" | "highest"); overrides
         FLAGS_conv_matmul_precision for this program only."""
         self._precision = precision
+        return self
+
+    def with_telemetry(self, label):
+        """Name this program in the telemetry compile ledger: while
+        `monitor.is_enabled()`, its compile events, cost-analysis FLOPs
+        and memory bytes are keyed by `label` (default: an opaque
+        program-identity key), so `monitor.mfu(step_time, key=label)`
+        and the per-program ledger read naturally."""
+        self._telemetry_label = label
         return self
 
     # -- reference API ---------------------------------------------------
@@ -162,4 +172,8 @@ class CompiledProgram:
         devs = np.array(jax.devices()[:n])
         mesh = Mesh(devs, ("dp",))
         self._dp_mesh_cache = (n, mesh)
+        from .. import monitor
+
+        if monitor.is_enabled():
+            monitor.gauge("dp_devices").set(n)
         return mesh

@@ -19,6 +19,7 @@ minus the parent-chain — programs here resolve names at trace time).
 """
 
 import contextlib
+import time
 
 import numpy as np
 
@@ -33,6 +34,7 @@ from .compiler import apply_precision_policy, resolve_precision
 from .program import Variable, default_main_program
 
 _profiler = None
+_monitor = None
 
 
 def _dispatch_span(name):
@@ -47,6 +49,18 @@ def _dispatch_span(name):
     if _profiler.is_profiling():
         return _profiler.RecordEvent(name)
     return contextlib.nullcontext()
+
+
+def _mon():
+    """Lazy paddle_tpu.monitor handle (same import-cycle discipline as
+    _profiler): the telemetry subsystem Executor.run feeds per-step
+    metrics and compile events into while monitor.is_enabled()."""
+    global _monitor
+    if _monitor is None:
+        from .. import monitor
+
+        _monitor = monitor
+    return _monitor
 
 
 def _materialize(fetches):
@@ -627,11 +641,16 @@ class Executor:
         use_program_cache=False bypasses the cache entirely — neither
         reads nor stores it (the same contract as the compiled-fn
         cache)."""
+        mon = _mon()
         if use_program_cache:
             plan = getattr(program, "_run_plan_cache", None)
             if plan is not None and plan.program is program \
                     and plan.version == program._version:
+                if mon.is_enabled():
+                    mon.counter("run_plan.hit").add(1)
                 return plan
+        if mon.is_enabled():
+            mon.counter("run_plan.miss").add(1)
         plan = _RunPlan(program)
         if use_program_cache:
             program._run_plan_cache = plan
@@ -648,13 +667,19 @@ class Executor:
         use_program_cache=True,
     ):
         program = program if program is not None else default_main_program()
+        mon = _mon()
+        mon_on = mon.is_enabled()
+        t0 = time.perf_counter_ns() if mon_on else 0
         # CompiledProgram / parallel wrapper support
         dp_mesh = None
         precision = resolve_precision(program)
+        telemetry_key = getattr(program, "_telemetry_label", None)
         if hasattr(program, "_get_executable_program"):
             if getattr(program, "_is_data_parallel", False):
                 dp_mesh = program._dp_mesh()
             program = program._get_executable_program()
+        if telemetry_key is None:
+            telemetry_key = getattr(program, "_telemetry_label", None)
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope if scope is not None else _global_scope
@@ -694,10 +719,17 @@ class Executor:
                 feed_arrays = {
                     n: (a.astype(feed_casts[n]) if n in feed_casts else a)
                     for n, a in feed_arrays.items()}
-            return apply_precision_policy(
+            out = apply_precision_policy(
                 lambda: self._run_eager(program, feed_arrays, fetch_names,
                                         scope, run_key, return_numpy),
                 precision)()
+            if mon_on:
+                # the debug interpreter EXECUTES inline — elapsed time
+                # here is execution, not dispatch, so no
+                # host_dispatch_us is recorded (it would contaminate
+                # the dispatch aggregates ~1000x)
+                self._record_step_metrics(mon, None, feed_arrays, out)
+            return out
 
         with _dispatch_span("executor.run.state"):
             state = {}
@@ -739,15 +771,21 @@ class Executor:
             # cache value holds the program so id() can't be recycled by a
             # new Program allocated at the same address after GC
             entry = self._cache.get(key) if use_program_cache else None
-        if entry is None or entry[1] is not program:
+        fresh_compile = entry is None or entry[1] is not program
+        if fresh_compile:
+            if mon_on:
+                mon.counter("compiled_step.miss").add(1)
             with _dispatch_span("executor.run.trace"):
                 compiled = self._build(program, fetch_names,
                                        plan.persist_names, dp_mesh=dp_mesh,
                                        precision=precision,
-                                       feed_casts=feed_casts)
+                                       feed_casts=feed_casts,
+                                       telemetry_key=telemetry_key)
             if use_program_cache:
                 self._cache[key] = (compiled, program)
         else:
+            if mon_on:
+                mon.counter("compiled_step.hit").add(1)
             compiled = entry[0]
 
         with _dispatch_span("executor.run.dispatch"):
@@ -758,6 +796,14 @@ class Executor:
             new_state, fetches = compiled(state, feed_arrays, run_key)
             for n, v in new_state.items():
                 scope.set_var(n, v)
+        if mon_on:
+            # recorded BEFORE any materialization so host_dispatch_us is
+            # the pure dispatch cost; fetch bytes read from the device
+            # array metadata (no sync).  A step that paid trace+compile
+            # is tagged warmup so it can't skew the steady-state
+            # aggregates (mean step time / dispatch μs / MFU).
+            self._record_step_metrics(mon, t0, feed_arrays, fetches,
+                                      warmup=fresh_compile)
         if return_numpy:
             with _dispatch_span("executor.run.fetch"):
                 return _materialize(fetches)
@@ -768,6 +814,30 @@ class Executor:
         # steady state.
         return [jnp.copy(f) if n in new_state else f
                 for n, f in zip(fetch_names, fetches)]
+
+    @staticmethod
+    def _record_step_metrics(mon, t0, feed_arrays, fetches,
+                             warmup=False):
+        """One telemetry step record per Executor.run: host-dispatch μs
+        (entry to here; t0=None skips it — the eager debug path has no
+        dispatch phase), examples (leading feed dim), feed/fetch bytes.
+        Wall step time is derived by the session from the gap between
+        consecutive records; warmup=True marks a run that paid
+        trace+compile (excluded from steady-state means)."""
+        examples = 0
+        feed_bytes = 0
+        for a in feed_arrays.values():
+            feed_bytes += int(getattr(a, "nbytes", 0) or 0)
+            shape = getattr(a, "shape", ())
+            if shape:
+                examples = max(examples, int(shape[0]))
+        fetch_bytes = sum(int(getattr(f, "nbytes", 0) or 0)
+                          for f in fetches)
+        mon.record_step(
+            host_dispatch_us=(None if t0 is None
+                              else (time.perf_counter_ns() - t0) / 1e3),
+            examples=examples or None, feed_bytes=feed_bytes,
+            fetch_bytes=fetch_bytes, warmup=warmup)
 
     # ------------------------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
@@ -1001,15 +1071,22 @@ class Executor:
         return [op for i, op in enumerate(ops) if keep[i]]
 
     def _build(self, program, fetch_names, persist_names, dp_mesh=None,
-               precision=None, feed_casts=None):
+               precision=None, feed_casts=None, telemetry_key=None):
         ops = self._live_ops(program, fetch_names)
         sections = [] if program._is_test else list(program.backward_sections)
+        if telemetry_key is None:
+            # stable, readable ledger key: program identity + mutation
+            # version + what it fetches (CompiledProgram.with_telemetry
+            # overrides with a human-chosen label)
+            telemetry_key = "prog%x:v%d" % (id(program), program._version)
         return self._build_step(ops, sections, fetch_names, persist_names,
                                 dp_mesh, precision=precision,
-                                feed_casts=feed_casts)
+                                feed_casts=feed_casts,
+                                telemetry_key=telemetry_key)
 
     def _build_step(self, ops, sections, fetch_names, persist_names,
-                    dp_mesh, precision=None, feed_casts=None):
+                    dp_mesh, precision=None, feed_casts=None,
+                    telemetry_key="program"):
         dp = dp_mesh is not None
 
         def make_step(dp):
@@ -1019,8 +1096,13 @@ class Executor:
         step = make_step(dp)
 
         if not dp:
-            return jax.jit(apply_precision_policy(step, precision),
-                           donate_argnums=(0,))
+            # instrument_jit routes each new input signature's compile
+            # through the monitor's AOT path (timed, cost/memory
+            # analyzed) while telemetry is on; a pass-through implicit
+            # jit call otherwise
+            return _mon().instrument_jit(
+                jax.jit(apply_precision_policy(step, precision),
+                        donate_argnums=(0,)), key=telemetry_key)
 
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
@@ -1063,11 +1145,14 @@ class Executor:
 
                 out_fetch_specs = [
                     P("dp") if r >= 1 else P() for r in fetch_ranks]
-                fn = jax.jit(apply_precision_policy(shard_map(
-                    dp_step_shaped, mesh=dp_mesh,
-                    in_specs=(P(), P("dp"), P()),
-                    out_specs=(P(), out_fetch_specs),
-                    check_vma=False), precision), donate_argnums=(0,))
+                fn = _mon().instrument_jit(
+                    jax.jit(apply_precision_policy(shard_map(
+                        dp_step_shaped, mesh=dp_mesh,
+                        in_specs=(P(), P("dp"), P()),
+                        out_specs=(P(), out_fetch_specs),
+                        check_vma=False), precision),
+                        donate_argnums=(0,)),
+                    key=telemetry_key + ":dp")
                 memo[sig] = fn
             return fn(state, feeds, key)
 

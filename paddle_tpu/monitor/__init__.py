@@ -1,0 +1,173 @@
+"""paddle_tpu.monitor — runtime telemetry subsystem.
+
+Three pillars (ISSUE 3 tentpole):
+
+1. **Step metrics** — `Executor.run` / `train_from_dataset` /
+   `CompiledProgram` (and the bench harnesses) feed a `MetricsSession`
+   automatically while telemetry is enabled: wall step time,
+   host-dispatch μs, run-plan/compiled-step cache hits and misses,
+   feed/fetch bytes, examples/s — all landing in a counters/gauges
+   registry with optional JSONL emission and the in-process
+   `snapshot()` API.
+2. **Compile & memory accounting** — every jit compile is a ledger
+   event (count, wall time, program key) carrying XLA's OWN
+   `cost_analysis()` FLOPs and `memory_analysis()` bytes, so
+   `monitor.mfu(step_time)` needs no hand-coded per-model FLOP formula.
+3. **Unified trace** — `profiler.export_chrome_tracing` merges host
+   RecordEvent spans with step-boundary spans and chrome-trace counter
+   tracks (examples/s, cache, live bytes) built here (`trace.py`).
+
+Usage::
+
+    from paddle_tpu import monitor
+    monitor.enable(jsonl_path="/tmp/telemetry.jsonl")
+    ... train ...
+    snap = monitor.snapshot()        # machine-readable, json.dump-safe
+    print(snap["mfu"], snap["compile"]["count"])
+    monitor.disable()
+
+Telemetry off (the default) costs the dispatch path one boolean check.
+"""
+
+from .compile_ledger import (CompileLedger, PEAK_FLOPS, peak_flops,
+                             parse_cost_analysis, parse_memory_analysis)
+from .jsonl_writer import JsonlWriter, read_jsonl
+from .registry import Counter, Gauge, MetricsRegistry
+from .session import MetricsSession
+
+__all__ = [
+    "enable", "disable", "is_enabled", "snapshot", "reset",
+    "counter", "gauge", "record_step", "observe_steps", "record_compile",
+    "aot_compile", "instrument_jit", "mfu", "step_records",
+    "compile_events", "jsonl_path", "merged_trace_events",
+    "MetricsRegistry", "MetricsSession", "CompileLedger", "JsonlWriter",
+    "read_jsonl", "Counter", "Gauge", "PEAK_FLOPS", "peak_flops",
+    "parse_cost_analysis", "parse_memory_analysis",
+]
+
+# process-global instances: one registry, one compile ledger, one step
+# session — every layer reports into the same place, which is the point
+_registry = MetricsRegistry()
+_ledger = CompileLedger(_registry)
+_session = MetricsSession(_registry, _ledger)
+_enabled = False
+
+
+def enable(jsonl_path=None):
+    """Turn telemetry on.  With `jsonl_path`, every step record is also
+    appended there as one JSON line (`read_jsonl` parses it back)."""
+    global _enabled
+    if jsonl_path is not None:
+        _session.attach_writer(JsonlWriter(jsonl_path))
+    _enabled = True
+
+
+def disable():
+    """Stop recording (recorded data stays readable until `reset`).
+    Also detaches the JSONL writer: a later `enable()` without a
+    `jsonl_path` records in-process only instead of silently appending
+    to the previous path."""
+    global _enabled
+    _enabled = False
+    _session.attach_writer(None)
+
+
+def is_enabled():
+    return _enabled
+
+
+def reset():
+    """Drop all recorded telemetry: step records, compile events, and
+    every counter/gauge (in place — held handles stay valid)."""
+    _session.clear()
+    _ledger.clear()
+    _registry.reset()
+
+
+# -- recording entry points (no-ops while disabled) ---------------------
+
+def counter(name):
+    return _registry.counter(name)
+
+
+def gauge(name):
+    return _registry.gauge(name)
+
+
+def record_step(**kwargs):
+    if not _enabled:
+        return None
+    return _session.record_step(**kwargs)
+
+
+def observe_steps(n, seconds, examples=0, label=None):
+    if not _enabled:
+        return None
+    return _session.observe_steps(n, seconds, examples=examples,
+                                  label=label)
+
+
+def record_compile(key, compile_s, flops=None, bytes_accessed=None,
+                   memory=None, trace_s=None, source="manual"):
+    if not _enabled:
+        return None
+    return _ledger.record(key, compile_s, flops=flops,
+                          bytes_accessed=bytes_accessed, memory=memory,
+                          trace_s=trace_s, source=source)
+
+
+def aot_compile(jitfn, *args, key="jit"):
+    """Timed lower+compile with cost/memory analysis recorded; returns
+    the compiled executable (None if AOT is unavailable)."""
+    return _ledger.aot_compile(jitfn, *args, key=key)
+
+
+def instrument_jit(jitfn, key="jit"):
+    """Wrap a jitted callable so its compiles land in the ledger while
+    telemetry is enabled; a plain pass-through call otherwise."""
+    return _ledger.instrument_jit(jitfn, key=key, is_enabled=is_enabled)
+
+
+# -- reading ------------------------------------------------------------
+
+def step_records():
+    return _session.records()
+
+
+def compile_events():
+    return _ledger.events()
+
+
+def jsonl_path():
+    w = _session.writer()
+    return w.path if w is not None else None
+
+
+def mfu(step_time_s=None, key=None, peak=None):
+    """MFU from the compile ledger's cost analysis.  step_time_s
+    defaults to the session's mean recorded step time."""
+    if step_time_s is None:
+        step_time_s = _session.mean_step_time()
+    return _ledger.mfu(step_time_s, key=key, peak=peak)
+
+
+def snapshot():
+    """Point-in-time telemetry snapshot — scalars only, json.dump-safe:
+    session aggregates (steps, step_time_s, host_dispatch_us,
+    examples/s, byte totals), the full counter/gauge registry, the
+    compile ledger summary (count, time, FLOPs, memory bytes), and the
+    derived MFU."""
+    out = _session.snapshot()
+    out.update(_registry.snapshot())
+    out["compile"] = _ledger.summary()
+    out["mfu"] = mfu()
+    return out
+
+
+def merged_trace_events(host_events):
+    """Build the unified trace event list from the profiler's host
+    spans plus this session's step records and compile events."""
+    from .trace import merged_trace_events as _merge
+
+    return _merge(host_events, step_records=_session.records(),
+                  compile_events=_ledger.events())

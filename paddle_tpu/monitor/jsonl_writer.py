@@ -1,0 +1,77 @@
+"""JSONL emission for telemetry records.
+
+One JSON object per line, append-only — the format every monitoring
+pipeline ingests without a schema negotiation.  The writer is the sink
+MetricsSession emits step records into; `read_jsonl` is the matching
+parser (used by tools/telemetry_report.py and the round-trip test).
+"""
+
+import json
+import threading
+
+__all__ = ["JsonlWriter", "read_jsonl"]
+
+
+class JsonlWriter:
+    """Append dict records to a .jsonl file, one flushed line each.
+
+    Opened lazily on first emit (so enabling telemetry without steps
+    never creates an empty file) and safe to emit from the producer
+    thread and the main thread concurrently."""
+
+    def __init__(self, path):
+        self.path = path
+        self._fh = None
+        self._closed = False
+        self._lock = threading.Lock()
+
+    def emit(self, record):
+        line = json.dumps(record, sort_keys=True, default=_json_default)
+        with self._lock:
+            if self._closed:
+                # a producer thread racing monitor.disable() must not
+                # reopen the just-closed file (leaked handle + a write
+                # after detach); the boundary record is dropped instead
+                return
+            if self._fh is None:
+                self._fh = open(self.path, "a")
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self):
+        """Close and RETIRE the writer: later emits are dropped, never
+        reopened — close is the end of this writer's life."""
+        with self._lock:
+            self._closed = True
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def _json_default(o):
+    # numpy scalars (step counters fed from device fetches) serialize as
+    # their python value; anything else degrades to repr rather than
+    # killing the training loop that emitted it
+    try:
+        return o.item()
+    except AttributeError:
+        return repr(o)
+
+
+def read_jsonl(path):
+    """Parse a telemetry JSONL file back into a list of dicts, skipping
+    blank lines.  A malformed line raises ValueError naming the line
+    number — a truncated tail from a killed run should be loud, not a
+    silently shorter list."""
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{i}: malformed JSONL record: {e}") from e
+    return out

@@ -1,0 +1,210 @@
+"""MetricsSession — per-step telemetry records.
+
+Executor.run / train_from_dataset / the bench harnesses feed this
+automatically (no hand-instrumentation): each step lands one record with
+wall step time, host-dispatch μs, feed/fetch bytes, examples/s, and a
+sample of the cache counters at that instant.  Records are kept
+in-process (for `snapshot()` and the merged chrome trace) and — when a
+JSONL writer is attached — emitted one line per step.
+
+Clocks: `ts_us` is `time.perf_counter_ns()/1000`, the SAME clock the
+profiler's RecordEvent spans use, so step spans and host spans land on
+one merged timeline without skew; `wall_time` (epoch seconds) rides
+along for humans reading the JSONL.
+"""
+
+import threading
+import time
+
+__all__ = ["MetricsSession"]
+
+# counters sampled into every step record — the chrome-trace counter
+# tracks are built from these samples
+_SAMPLED_COUNTERS = ("run_plan.hit", "run_plan.miss",
+                     "compiled_step.hit", "compiled_step.miss",
+                     "compile.count")
+
+
+class MetricsSession:
+    """Step-record accumulator over a registry + compile ledger."""
+
+    def __init__(self, registry, ledger):
+        self._registry = registry
+        self._ledger = ledger
+        self._lock = threading.Lock()
+        self._records = []
+        self._writer = None
+        self._last_end_ns = None
+
+    def attach_writer(self, writer):
+        """Attach (or, with None, detach) the JSONL sink; a replaced
+        writer is closed so re-enabling telemetry can never keep
+        appending to an earlier path's orphaned file handle."""
+        old = self._writer
+        if old is not None and old is not writer:
+            old.close()
+        self._writer = writer
+
+    def writer(self):
+        return self._writer
+
+    # -- recording ------------------------------------------------------
+    def record_step(self, host_dispatch_us=None, examples=None,
+                    feed_bytes=None, fetch_bytes=None, label=None,
+                    warmup=False):
+        """One training/eval step completed.  Wall step time is the gap
+        since the previous record (the device-throttled cadence the user
+        experiences under async dispatch); the first step falls back to
+        the host-dispatch time — there is nothing earlier to measure
+        from.  warmup=True tags a step that paid trace/compile cost:
+        it stays in the record stream (and the trace) but is excluded
+        from the snapshot's steady-state means and the MFU step time,
+        which would otherwise be skewed by orders of magnitude in
+        short runs."""
+        record = {
+            "kind": "step",
+            "wall_time": time.time(),
+        }
+        if warmup:
+            record["warmup"] = True
+        if label is not None:
+            record["label"] = label
+        if host_dispatch_us is not None:
+            record["host_dispatch_us"] = round(host_dispatch_us, 1)
+        if feed_bytes is not None:
+            record["feed_bytes"] = int(feed_bytes)
+        if fetch_bytes is not None:
+            record["fetch_bytes"] = int(fetch_bytes)
+        snap = self._registry.snapshot()["counters"]
+        record["counters"] = {k: snap[k] for k in _SAMPLED_COUNTERS
+                              if k in snap}
+        # step index, step time, and the append happen under ONE lock
+        # acquisition: concurrent recorders (producer thread + main)
+        # must neither duplicate step numbers nor append out of
+        # timestamp order
+        with self._lock:
+            now_ns = time.perf_counter_ns()
+            if self._last_end_ns is not None:
+                step_time_s = (now_ns - self._last_end_ns) / 1e9
+            elif host_dispatch_us is not None:
+                step_time_s = host_dispatch_us / 1e6
+            else:
+                step_time_s = 0.0
+            self._last_end_ns = now_ns
+            record["step"] = len(self._records) + 1
+            record["ts_us"] = now_ns / 1000.0
+            record["step_time_s"] = step_time_s
+            if examples:
+                record["examples"] = int(examples)
+                if step_time_s > 0:
+                    record["examples_per_sec"] = round(
+                        examples / step_time_s, 1)
+            self._records.append(record)
+        self._finish(record, examples_per_sec=record.get(
+            "examples_per_sec"))
+        return record
+
+    def observe_steps(self, n, seconds, examples=0, label=None):
+        """Bulk entry for scan-style harnesses (bench's `_time_steps`
+        times `n` steps in one device dispatch): records ONE entry with
+        the averaged per-step time covering `n` steps."""
+        if n <= 0:
+            return None
+        step_time_s = seconds / n
+        record = {
+            "kind": "step",
+            "steps": int(n),
+            "wall_time": time.time(),
+            "step_time_s": step_time_s,
+        }
+        if label is not None:
+            record["label"] = label
+        if examples:
+            record["examples"] = int(examples)
+            if step_time_s > 0:
+                record["examples_per_sec"] = round(
+                    examples / step_time_s, 1)
+        with self._lock:
+            now_ns = time.perf_counter_ns()
+            self._last_end_ns = now_ns
+            record["step"] = len(self._records) + 1
+            record["ts_us"] = now_ns / 1000.0
+            self._records.append(record)
+        self._finish(record, n=n,
+                     examples_per_sec=record.get("examples_per_sec"))
+        return record
+
+    def _finish(self, record, n=1, examples_per_sec=None):
+        """Registry updates + JSONL emission for an already-appended
+        record (outside the records lock: the writer does file I/O)."""
+        self._registry.counter("steps").add(n)
+        self._registry.gauge("step_time_s").set(record["step_time_s"])
+        if examples_per_sec is not None:
+            self._registry.gauge("examples_per_sec").set(examples_per_sec)
+        w = self._writer
+        if w is not None:
+            w.emit(record)
+
+    # -- reading --------------------------------------------------------
+    def records(self):
+        with self._lock:
+            return list(self._records)
+
+    def snapshot(self):
+        """Aggregate step view: count, last/mean step time, examples/s,
+        byte totals — scalars only (the full per-step series stays in
+        `records()` / the JSONL).  Means cover STEADY-STATE records
+        only: warmup-tagged steps (trace/compile paid inline) would
+        otherwise dominate the mean in short runs; they still count
+        toward `steps` and `warmup_steps` reports how many were
+        excluded."""
+        with self._lock:
+            records = list(self._records)
+        if not records:
+            return {"steps": 0}
+        steady = [r for r in records if not r.get("warmup")] or records
+        times = [r["step_time_s"] for r in steady if r["step_time_s"] > 0]
+        n_steps = sum(r.get("steps", 1) for r in records)
+        out = {
+            "steps": n_steps,
+            "first_ts_us": records[0]["ts_us"],
+            "last_ts_us": records[-1]["ts_us"],
+            "step_time_s": {
+                "last": steady[-1]["step_time_s"],
+                "mean": (sum(times) / len(times)) if times else None,
+            },
+        }
+        n_warm = sum(1 for r in records if r.get("warmup"))
+        if n_warm:
+            out["warmup_steps"] = n_warm
+        dispatch = [r["host_dispatch_us"] for r in steady
+                    if "host_dispatch_us" in r]
+        if dispatch:
+            out["host_dispatch_us"] = {
+                "last": dispatch[-1],
+                "mean": round(sum(dispatch) / len(dispatch), 1),
+            }
+        examples = sum(r.get("examples", 0) for r in records)
+        if examples:
+            out["examples"] = examples
+            span_s = (records[-1]["ts_us"] - records[0]["ts_us"]) / 1e6
+            if span_s > 0:
+                out["examples_per_sec"] = round(examples / span_s, 1)
+        for field in ("feed_bytes", "fetch_bytes"):
+            total = sum(r.get(field, 0) for r in records)
+            if total:
+                out[field] = total
+        return out
+
+    def mean_step_time(self):
+        """Mean STEADY-STATE step time (warmup records excluded) — the
+        denominator monitor.mfu() defaults to."""
+        with self._lock:
+            times = [r["step_time_s"] for r in self._records
+                     if r["step_time_s"] > 0 and not r.get("warmup")]
+        return (sum(times) / len(times)) if times else None
+
+    def clear(self):
+        with self._lock:
+            del self._records[:]
+            self._last_end_ns = None

@@ -1,0 +1,117 @@
+"""Unified chrome-trace builder — host spans, step spans, counters.
+
+One Perfetto/chrome://tracing load shows, on a shared timeline:
+
+  pid 0 ("host")       RecordEvent spans, one track per recording thread
+  pid 1 ("train steps") step-boundary spans + compile spans
+  pid 1 counter tracks  examples/s, cache hit/miss, live bytes
+
+All timestamps are the profiler's span clock (perf_counter μs), so the
+tracks align without cross-clock skew.  `profiler.export_chrome_tracing`
+calls `merged_trace_events`; this module only builds the event list.
+"""
+
+__all__ = ["merged_trace_events", "host_span_events"]
+
+_HOST_PID = 0
+_STEP_PID = 1
+_STEP_TID = 0
+_COMPILE_TID = 1
+
+
+def host_span_events(events):
+    """RecordEvent spans -> trace rows (tools/timeline.py:137 parity).
+    Each row carries the real recording-thread id so producer-thread
+    spans (train_from_dataset prefetch) get their own track."""
+    return [
+        {"name": e["name"], "ph": "X", "ts": e["ts"], "dur": e["dur"],
+         "pid": _HOST_PID, "tid": e.get("tid", e.get("depth", 0)),
+         "cat": "host", "args": {"depth": e.get("depth", 0)}}
+        for e in events
+    ]
+
+
+def _metadata_events(host_events):
+    out = [
+        {"name": "process_name", "ph": "M", "pid": _HOST_PID,
+         "args": {"name": "host"}},
+        {"name": "process_name", "ph": "M", "pid": _STEP_PID,
+         "args": {"name": "train steps"}},
+        {"name": "thread_name", "ph": "M", "pid": _STEP_PID,
+         "tid": _STEP_TID, "args": {"name": "steps"}},
+        {"name": "thread_name", "ph": "M", "pid": _STEP_PID,
+         "tid": _COMPILE_TID, "args": {"name": "compiles"}},
+    ]
+    for tid in sorted({e.get("tid", 0) for e in host_events}):
+        out.append({"name": "thread_name", "ph": "M", "pid": _HOST_PID,
+                    "tid": tid, "args": {"name": f"thread-{tid}"}})
+    return out
+
+
+def _step_events(records):
+    """Step records -> one X span per step + counter samples at each
+    step boundary."""
+    out = []
+    for r in records:
+        dur_us = r["step_time_s"] * 1e6 * r.get("steps", 1)
+        start = r["ts_us"] - dur_us
+        args = {"step": r.get("step")}
+        for k in ("examples", "host_dispatch_us", "feed_bytes",
+                  "fetch_bytes", "steps", "label"):
+            if r.get(k) is not None:
+                args[k] = r[k]
+        out.append({"name": "step", "ph": "X", "ts": start,
+                    "dur": dur_us, "pid": _STEP_PID, "tid": _STEP_TID,
+                    "cat": "step", "args": args})
+        # counter tracks: one sample per step end
+        if r.get("examples_per_sec") is not None:
+            out.append({"name": "examples/s", "ph": "C", "ts": r["ts_us"],
+                        "pid": _STEP_PID,
+                        "args": {"examples/s": r["examples_per_sec"]}})
+        counters = r.get("counters") or {}
+        cache = {}
+        hits = counters.get("run_plan.hit", 0) \
+            + counters.get("compiled_step.hit", 0)
+        misses = counters.get("run_plan.miss", 0) \
+            + counters.get("compiled_step.miss", 0)
+        if hits or misses:
+            cache = {"hit": hits, "miss": misses}
+            out.append({"name": "cache", "ph": "C", "ts": r["ts_us"],
+                        "pid": _STEP_PID, "args": cache})
+    return out
+
+
+def _compile_events(events):
+    from .compile_ledger import live_bytes
+
+    out = []
+    for e in events:
+        dur_us = e["compile_ms"] * 1e3
+        args = {"key": e["key"]}
+        for k in ("flops", "bytes_accessed", "trace_ms", "source"):
+            if e.get(k) is not None:
+                args[k] = e[k]
+        if e.get("memory"):
+            args.update(e["memory"])
+        out.append({"name": "xla_compile", "ph": "X",
+                    "ts": e["ts_us"] - dur_us, "dur": dur_us,
+                    "pid": _STEP_PID, "tid": _COMPILE_TID,
+                    "cat": "compile", "args": args})
+        live = live_bytes(e.get("memory"))
+        if live is not None:
+            out.append({"name": "live_bytes", "ph": "C", "ts": e["ts_us"],
+                        "pid": _STEP_PID, "args": {"bytes": live}})
+    return out
+
+
+def merged_trace_events(host_events, step_records=None,
+                        compile_events=None):
+    """The full merged event list: metadata + host spans + step spans +
+    compile spans + counter tracks."""
+    step_records = step_records or []
+    compile_events = compile_events or []
+    out = _metadata_events(host_events)
+    out.extend(host_span_events(host_events))
+    out.extend(_step_events(step_records))
+    out.extend(_compile_events(compile_events))
+    return out

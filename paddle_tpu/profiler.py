@@ -69,21 +69,41 @@ def _clear_events():
 
 
 class RecordEvent:
-    """RAII host-side span (platform/profiler.h:124 parity)."""
+    """RAII host-side span (platform/profiler.h:124 parity).
+
+    Zero-cost while no profiling session is active: `__enter__` checks
+    `is_profiling()` ITSELF (not just the executor call sites), so a
+    RecordEvent sprinkled through user code costs steady-state training
+    one boolean check and records nothing.  A span that straddles
+    `reset_profiler` (entered before, exited after) is dropped rather
+    than resurrected: its start predates the reset, so appending it
+    would re-populate the just-cleared table with a stale event — the
+    session `epoch` stamp catches exactly that."""
 
     def __init__(self, name):
         self.name = name
         self.start = None
+        self._epoch = None
 
     def __enter__(self):
+        if not _active["on"]:
+            self.start = None      # armed-off: __exit__ is a no-op
+            return self
         _events()
+        self._epoch = _active["epoch"]
         self.start = time.perf_counter_ns()
         _state.stack.append(self.name)
         return self
 
     def __exit__(self, *exc):
+        if self.start is None:
+            return False
         end = time.perf_counter_ns()
         _state.stack.pop()
+        if self._epoch != _active["epoch"]:
+            # reset_profiler (or a new start_profiler) cleared the event
+            # store while this span was open: discard, don't resurrect
+            return False
         _events().append({
             "name": self.name,
             "ts": self.start / 1000.0,
@@ -94,7 +114,9 @@ class RecordEvent:
         return False
 
 
-_active = {"on": False, "jax_trace": False, "dir": None}
+# `epoch` counts event-store clears (reset_profiler / start_profiler);
+# an in-flight RecordEvent compares its entry epoch before appending.
+_active = {"on": False, "jax_trace": False, "dir": None, "epoch": 0}
 
 
 def is_profiling():
@@ -107,6 +129,7 @@ def is_profiling():
 def start_profiler(state="All", tracer_option="Default"):
     _events()            # register this thread before clearing
     _clear_events()
+    _active["epoch"] += 1
     _active["on"] = True
     if state in ("All", "GPU", "TPU"):
         trace_dir = flags.flag("profiler_dir")
@@ -148,24 +171,34 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     report = "\n".join(lines)
     print(report)
     if profile_path:
-        export_chrome_tracing(profile_path + ".json", events)
+        # default (merged) export: the session's trace should carry the
+        # monitor's step/counter tracks alongside the host spans
+        export_chrome_tracing(profile_path + ".json")
     return table
 
 
 def export_chrome_tracing(path, events=None):
-    """chrome://tracing JSON (tools/timeline.py:137 parity).  Events
-    from every recording thread are included; each trace row carries
-    the real thread id so producer-thread spans (train_from_dataset
-    prefetch) land on their own timeline track."""
-    events = events if events is not None else _all_events()
-    trace = {
-        "traceEvents": [
-            {"name": e["name"], "ph": "X", "ts": e["ts"], "dur": e["dur"],
-             "pid": 0, "tid": e.get("tid", e.get("depth", 0)),
-             "cat": "host", "args": {"depth": e.get("depth", 0)}}
-            for e in events
-        ]
-    }
+    """Unified chrome://tracing JSON (tools/timeline.py:137 parity,
+    extended per ISSUE 3): host RecordEvent spans — every recording
+    thread, tagged with its real tid — MERGED with the monitor's
+    step-boundary spans, xla-compile spans, and counter tracks
+    (examples/s, cache hit/miss, live bytes), all on the shared
+    perf_counter timeline.  One Perfetto load shows host dispatch,
+    steps, and counters together; tools/parse_xplane.py accepts the
+    same file.
+
+    Passing an explicit `events` list exports EXACTLY those host spans
+    (the parameter is a filter — a per-phase subset must not be
+    contaminated by the process-global monitor state); the default
+    exports everything recorded plus the monitor's merged tracks."""
+    from . import monitor
+    from .monitor.trace import host_span_events
+
+    if events is None:
+        trace_events = monitor.merged_trace_events(_all_events())
+    else:
+        trace_events = host_span_events(events)
+    trace = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
     with open(path, "w") as f:
         json.dump(trace, f)
     return path
@@ -185,7 +218,14 @@ def profiler(state="All", sorted_key="total", profile_path="/tmp/profile",
 def reset_profiler():
     """Clear all recorded events — on every thread — (reference
     profiler.py reset_profiler parity) without stopping an active
-    profiling session."""
+    profiling session.
+
+    Safe with respect to in-flight spans: a `RecordEvent` that is OPEN
+    when reset runs will, on exit, see the epoch has advanced and drop
+    itself instead of appending a stale event whose start predates the
+    clear (or crashing on missing state).  Spans ENTERED after the
+    reset record normally."""
+    _active["epoch"] += 1
     _clear_events()
 
 

@@ -98,3 +98,70 @@ def test_profiler_table_counts_worker_spans():
         t.join()
     table = profiler.stop_profiler(profile_path=None)
     assert table["worker"]["calls"] == 3
+
+
+def test_record_event_zero_cost_when_profiling_off():
+    """ISSUE 3 satellite: the gate lives inside RecordEvent itself —
+    spans opened while no session is active record NOTHING, anywhere
+    (not only at the executor call sites)."""
+    assert not profiler.is_profiling()
+    profiler.reset_profiler()
+    for _ in range(5):
+        with profiler.RecordEvent("stopped_span"):
+            pass
+    assert profiler._all_events() == []
+    # and a session started afterwards sees only ITS spans
+    profiler.start_profiler(state="CPU")
+    with profiler.RecordEvent("live_span"):
+        pass
+    table = profiler.stop_profiler(profile_path=None)
+    assert "stopped_span" not in table
+    assert table["live_span"]["calls"] == 1
+
+
+def test_record_event_straddling_session_stop_is_dropped():
+    """A span entered while profiling is OFF but exited while ON must
+    not record (its start time is meaningless for the session)."""
+    profiler.reset_profiler()
+    ev = profiler.RecordEvent("straddler")
+    ev.__enter__()
+    profiler.start_profiler(state="CPU")
+    ev.__exit__(None, None, None)
+    table = profiler.stop_profiler(profile_path=None)
+    assert "straddler" not in table
+
+
+def test_reset_profiler_during_open_span_is_safe():
+    """ISSUE 3 satellite: an in-flight RecordEvent exiting after
+    reset_profiler neither crashes nor resurrects its stale event —
+    and spans opened after the reset record normally."""
+    profiler.reset_profiler()
+    profiler.start_profiler(state="CPU")
+    ev = profiler.RecordEvent("stale_span")
+    ev.__enter__()
+    profiler.reset_profiler()          # clears while the span is open
+    ev.__exit__(None, None, None)      # must not re-populate the store
+    with profiler.RecordEvent("fresh_span"):
+        pass
+    table = profiler.stop_profiler(profile_path=None)
+    assert "stale_span" not in table
+    assert table["fresh_span"]["calls"] == 1
+
+
+def test_nested_spans_survive_reset_without_stack_corruption():
+    """reset mid-nest: both spans exit cleanly (no pop-from-empty), the
+    outer one is dropped, and the NEXT session still nests correctly."""
+    profiler.reset_profiler()
+    profiler.start_profiler(state="CPU")
+    with profiler.RecordEvent("outer"):
+        profiler.reset_profiler()
+        with profiler.RecordEvent("inner"):
+            pass
+    profiler.stop_profiler(profile_path=None)
+    # depth bookkeeping intact for a fresh session
+    profiler.start_profiler(state="CPU")
+    with profiler.RecordEvent("a"):
+        with profiler.RecordEvent("b"):
+            pass
+    table = profiler.stop_profiler(profile_path=None)
+    assert table["a"]["calls"] == 1 and table["b"]["calls"] == 1

@@ -1,23 +1,39 @@
-"""Parse a jax.profiler xplane.pb into a per-op time table.
+"""Parse a profiler capture into a per-op / per-track time table.
 
-The tensorboard_plugin_profile converter in this image is broken against
-the installed TF (missing xspace_to_tools_data symbol), so this walks the
-XSpace proto directly: TPU device plane -> XLA-op lines -> aggregate
-duration by HLO op name / category.
+Accepts BOTH trace formats this repo produces, so the two paths cannot
+silently diverge:
 
-Usage: python tools/parse_xplane.py <xplane.pb> [top_n]
+- a jax.profiler ``xplane.pb`` (device-side XSpace proto): TPU device
+  plane -> XLA-op lines -> aggregate duration by HLO op name / category.
+  (The tensorboard_plugin_profile converter in this image is broken
+  against the installed TF — missing xspace_to_tools_data symbol — so
+  this walks the XSpace proto directly.)
+- the merged chrome-trace JSON that ``profiler.export_chrome_tracing``
+  writes (host RecordEvent spans + monitor step spans + counter
+  tracks): aggregate span duration per (process, track) and list the
+  counter tracks' last samples.
+
+Anything else exits with an error naming the two expected formats.
+
+Usage: python tools/parse_xplane.py <xplane.pb | trace.json> [top_n]
 """
 import collections
+import json
 import sys
 
-from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
+def load_xspace(path):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
-def load(path):
     xs = xplane_pb2.XSpace()
     with open(path, "rb") as f:
         xs.ParseFromString(f.read())
     return xs
+
+
+# importer-compat alias: tools/r5_resnet_probe.py and tools/onchip_queue.py
+# do `from tools.parse_xplane import load`
+load = load_xspace
 
 
 def device_plane(xs):
@@ -49,10 +65,8 @@ def agg(plane):
     return out
 
 
-def main():
-    path = sys.argv[1]
-    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 40
-    xs = load(path)
+def main_xplane(path, top_n):
+    xs = load_xspace(path)
     plane = device_plane(xs)
     tables = agg(plane)
     for lname, table in tables.items():
@@ -64,6 +78,87 @@ def main():
         rows = sorted(table.items(), key=lambda kv: -kv[1][0])[:top_n]
         for name, (ps, n, cat) in rows:
             print(f"  {ps/1e9:9.3f} ms  x{n:<5d} {cat:12s} {name[:110]}")
+
+
+def main_chrome_trace(path, top_n):
+    """The merged host+steps+counters trace from export_chrome_tracing:
+    per-track span aggregates + counter-track summary."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        raise SystemExit(
+            f"{path}: JSON but not a chrome trace (no traceEvents list)")
+    pid_names, tid_names = {}, {}
+    spans = collections.defaultdict(
+        lambda: collections.defaultdict(lambda: [0.0, 0]))
+    counters = collections.defaultdict(list)
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        ph = e.get("ph")
+        if ph == "M":
+            # foreign traces may carry metadata without args — skip,
+            # don't crash (the track then shows its numeric id)
+            name = (e.get("args") or {}).get("name")
+            if name is None:
+                continue
+            if e.get("name") == "process_name":
+                pid_names[e.get("pid")] = name
+            elif e.get("name") == "thread_name":
+                tid_names[(e.get("pid"), e.get("tid"))] = name
+        elif ph == "X":
+            key = (e.get("pid", 0), e.get("tid", 0))
+            row = spans[key][e.get("name", "?")]
+            row[0] += float(e.get("dur", 0.0))
+            row[1] += 1
+        elif ph == "C":
+            counters[e.get("name", "?")].append(
+                (float(e.get("ts", 0.0)), e.get("args", {})))
+    for (pid, tid), table in sorted(spans.items()):
+        track = (f"{pid_names.get(pid, pid)}/"
+                 f"{tid_names.get((pid, tid), tid)}")
+        total = sum(v[0] for v in table.values())
+        print(f"== track {track}: total {total/1e3:.3f} ms over "
+              f"{sum(v[1] for v in table.values())} spans")
+        rows = sorted(table.items(), key=lambda kv: -kv[1][0])[:top_n]
+        for name, (us, n) in rows:
+            print(f"  {us/1e3:9.3f} ms  x{n:<5d} {name[:110]}")
+    for name, samples in sorted(counters.items()):
+        samples.sort(key=lambda s: s[0])   # args dicts don't compare
+        print(f"== counter {name!r}: {len(samples)} samples, "
+              f"last {samples[-1][1]}")
+
+
+def _format_error(path, e):
+    return SystemExit(
+        f"{path}: not a parseable capture ({type(e).__name__}: {e}).\n"
+        "Expected one of:\n"
+        "  - jax.profiler xplane.pb (XSpace protobuf, device trace)\n"
+        "  - merged chrome-trace JSON from "
+        "profiler.export_chrome_tracing (traceEvents list)")
+
+
+def main():
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    path = sys.argv[1]
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    with open(path, "rb") as f:
+        head = f.read(64).lstrip()
+    if head.startswith(b"{") or head.startswith(b"["):
+        try:
+            return main_chrome_trace(path, top_n)
+        except (SystemExit, BrokenPipeError):
+            raise
+        except Exception as e:
+            raise _format_error(path, e)
+    try:
+        return main_xplane(path, top_n)
+    except (SystemExit, BrokenPipeError):
+        raise
+    except Exception as e:
+        raise _format_error(path, e)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,75 @@
+"""Summarize a telemetry JSONL stream (monitor.enable(jsonl_path=...)).
+
+Reads the per-step records the MetricsSession emitted and prints the
+aggregate view a run review needs: step count, step-time distribution
+(mean / p50 / p95 / max), host-dispatch μs, examples/s, byte totals,
+and the final cache-counter sample — without importing jax or touching
+the process that produced the file.
+
+Usage: python tools/telemetry_report.py <telemetry.jsonl>
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.monitor.jsonl_writer import read_jsonl  # noqa: E402
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def summarize(records):
+    steps = [r for r in records if r.get("kind") == "step"]
+    out = {"records": len(records), "steps": sum(
+        r.get("steps", 1) for r in steps)}
+    times = sorted(r["step_time_s"] for r in steps
+                   if r.get("step_time_s", 0) > 0)
+    if times:
+        out["step_time_ms"] = {
+            "mean": round(sum(times) / len(times) * 1e3, 3),
+            "p50": round(_pct(times, 0.50) * 1e3, 3),
+            "p95": round(_pct(times, 0.95) * 1e3, 3),
+            "max": round(times[-1] * 1e3, 3),
+        }
+    dispatch = sorted(r["host_dispatch_us"] for r in steps
+                      if "host_dispatch_us" in r)
+    if dispatch:
+        out["host_dispatch_us"] = {
+            "mean": round(sum(dispatch) / len(dispatch), 1),
+            "p95": round(_pct(dispatch, 0.95), 1),
+        }
+    examples = sum(r.get("examples", 0) for r in steps)
+    if examples and len(steps) > 1:
+        span_s = (steps[-1]["ts_us"] - steps[0]["ts_us"]) / 1e6
+        out["examples"] = examples
+        if span_s > 0:
+            out["examples_per_sec"] = round(examples / span_s, 1)
+    for field in ("feed_bytes", "fetch_bytes"):
+        total = sum(r.get(field, 0) for r in steps)
+        if total:
+            out[field] = total
+    for r in reversed(steps):
+        if r.get("counters"):
+            out["final_counters"] = r["counters"]
+            break
+    return out
+
+
+def main():
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    records = read_jsonl(sys.argv[1])
+    summary = summarize(records)
+    width = max(len(k) for k in summary)
+    for k, v in summary.items():
+        print(f"{k:<{width}}  {v}")
+
+
+if __name__ == "__main__":
+    main()
