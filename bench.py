@@ -1190,6 +1190,180 @@ def main_telemetry_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_fault_tolerance_smoke(on_tpu, peak):
+    """Fault-tolerance chaos row (ISSUE 4 CI satellite): a tiny fc
+    train loop through the PUBLIC train_from_dataset on the CPU mesh
+    (data-parallel when >1 host device is visible) with the full
+    injection menu armed — a NaN step under the rollback policy, a
+    transient device error under retry/backoff, and a preemption with
+    auto-resume — asserting every recovery counter fired AND that the
+    recovered run's final params are BITWISE-identical to an
+    uninterrupted run of the same batches (the only honest definition
+    of "recovered").
+
+    Side effect: like telemetry_smoke, the PROCESS-GLOBAL monitor and
+    resilience state are reset; standalone callers should snapshot
+    first."""
+    import tempfile
+
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor, resilience
+    from paddle_tpu.checkpoint import CheckpointManager, latest_step
+
+    steps = 10
+    batch = 16
+    nan_at, transient_at, preempt_at = 4, 6, 8
+    was_enabled = monitor.is_enabled()
+    monitor.reset()
+    monitor.enable()
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [None, 16])
+                y = fluid.data("y", [None, 1])
+                h = fluid.layers.fc(x, 16, act="relu")
+                pred = fluid.layers.fc(h, 1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(0.05).minimize(loss)
+        ndev = len(jax.devices())
+        mesh_devices = ndev if ndev > 1 and batch % ndev == 0 else 1
+        prog = main
+        if mesh_devices > 1:
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, places=mesh_devices)
+
+        rng = np.random.default_rng(0)
+        batches = [
+            {"x": rng.standard_normal((batch, 16)).astype(np.float32),
+             "y": rng.standard_normal((batch, 1)).astype(np.float32)}
+            for _ in range(steps)]
+
+        # ---- uninterrupted reference ------------------------------
+        exe = fluid.Executor()
+        ref = fluid.Scope()
+        exe.run(startup, scope=ref)
+        for b in batches:
+            exe.run(prog, feed=b, fetch_list=[loss], scope=ref,
+                    return_numpy=False)
+        ref_w = np.asarray(ref.find_var("fc_0.w_0"))
+
+        # ---- chaos run: NaN->rollback, transient->retry, preempt --
+        ckdir = tempfile.mkdtemp(prefix="paddle_tpu_ft_")
+        mgr = CheckpointManager(ckdir, save_interval_steps=2)
+        exe2 = fluid.Executor()
+        sc = fluid.Scope()
+        exe2.run(startup, scope=sc)
+        resilience.enable_anomaly_guard(policy="rollback", manager=mgr)
+        resilience.enable_retry(resilience.RetryPolicy(
+            max_retries=3, base_delay=0.001, sleep=lambda d: None,
+            seed=0))
+
+        def preempting():
+            for i, b in enumerate(batches):
+                if i == preempt_at:
+                    resilience.request_preemption()
+                yield b
+
+        with resilience.plan_scope(nan_at_steps=[nan_at],
+                                   transient_at_step=transient_at,
+                                   transient_times=1):
+            exe2.train_from_dataset(
+                prog, preempting(), scope=sc, fetch_list=[loss],
+                checkpoint=mgr, print_period=10 ** 6, prefetch=False)
+            fired = dict(resilience.faultinject.active_plan().fired)
+        resilience.disable_anomaly_guard()
+        resilience.disable_retry()
+        resilience.clear_preemption()
+
+        # ---- resumed run: same command, fresh process analogue ----
+        exe3 = fluid.Executor()
+        sc2 = fluid.Scope()
+        exe3.run(startup, scope=sc2)
+        out = exe3.train_from_dataset(
+            prog, batches, scope=sc2, fetch_list=[loss],
+            checkpoint=mgr, auto_resume=True, print_period=10 ** 6,
+            prefetch=False)
+        final_w = np.asarray(sc2.find_var("fc_0.w_0"))
+
+        snap = monitor.snapshot()
+        counters = snap.get("counters", {})
+        checks = {
+            "nan_injected": fired.get("nan") == 1,
+            "transient_injected": fired.get("transient") == 1,
+            "rollback_recovered":
+                counters.get("resilience.rollbacks", 0) == 1
+                and counters.get("resilience.checkpoint_restores", 0) >= 1,
+            "retry_recovered": counters.get("resilience.retries", 0) >= 1
+                and counters.get("resilience.retry_giveup", 0) == 0,
+            "preempt_checkpointed":
+                counters.get("resilience.preempt_checkpoint", 0) == 1
+                and latest_step(ckdir) is not None,
+            "auto_resumed": counters.get("resilience.auto_resume", 0) == 1
+                and counters.get("resilience.batches_skipped", 0)
+                == preempt_at,
+            "resumed_run_well_formed": out is not None
+                and np.isfinite(np.asarray(out[0])).all(),
+            "params_bitwise_identical": np.array_equal(final_w, ref_w),
+            "save_time_recorded": (snap.get("gauges", {})
+                                   .get("resilience.last_save_s")
+                                   is not None),
+            "counters_in_snapshot": any(
+                k.startswith("resilience.") for k in counters),
+        }
+        checks = {k: bool(v) for k, v in checks.items()}  # np.bool_ -> json
+        row = {"metric": "fault_tolerance_smoke",
+               "value": int(all(checks.values())), "unit": "ok",
+               "vs_baseline": None, "steps": steps,
+               "mesh_devices": mesh_devices,
+               "injected": fired, "checks": checks,
+               "recovery_counters": {
+                   k: v for k, v in counters.items()
+                   if k.startswith("resilience.")},
+               "telemetry": _telemetry_brief(snap)}
+        if not all(checks.values()):
+            row["error"] = "failed checks: " + ", ".join(
+                k for k, v in checks.items() if not v)
+        return row
+    finally:
+        resilience.disable_anomaly_guard()
+        resilience.disable_retry()
+        resilience.clear_preemption()
+        resilience.faultinject.disarm()
+        monitor.disable()
+        monitor.reset()
+        if was_enabled:
+            monitor.enable()
+
+
+def main_fault_tolerance_smoke():
+    """`python bench.py fault_tolerance_smoke` — CI/tooling entry: the
+    chaos row standalone on a 2-device virtual CPU mesh, persisted to
+    BENCH_TPU.json under rows["fault_tolerance_smoke"].  Exit 0 only
+    when every recovery check passes."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=2")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_fault_tolerance_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["fault_tolerance_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def _git_sha():
     try:
         return subprocess.run(
@@ -1370,6 +1544,8 @@ def main():
         ("bert_chunked_ce", "bert_chunked_ce_mfu", bench_bert_chunked_ce),
         ("dispatch_overhead", "dispatch_overhead", bench_dispatch_overhead),
         ("telemetry_smoke", "telemetry_smoke", bench_telemetry_smoke),
+        ("fault_tolerance_smoke", "fault_tolerance_smoke",
+         bench_fault_tolerance_smoke),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
 
     # SIGALRM only interrupts Python bytecode: a compile/RPC wedged
@@ -1436,4 +1612,6 @@ if __name__ == "__main__":
         sys.exit(main_dispatch_overhead())
     if "telemetry_smoke" in sys.argv[1:]:
         sys.exit(main_telemetry_smoke())
+    if "fault_tolerance_smoke" in sys.argv[1:]:
+        sys.exit(main_fault_tolerance_smoke())
     main()

@@ -93,6 +93,7 @@ from . import dataset
 from . import metrics
 from . import profiler
 from . import monitor
+from . import resilience
 from . import nn
 from . import dygraph
 from . import distributed

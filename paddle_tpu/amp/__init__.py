@@ -304,6 +304,15 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0**15,
 import jax as _jax
 import jax.numpy as _jnp
 
+# ONE finiteness reduction shared with the anomaly guard
+# (resilience.guard fuses the same check into compiled executor steps;
+# sharing the implementation keeps "finite" meaning the same thing in
+# both subsystems)
+from ..resilience.guard import all_finite
+
+__all__ += ["all_finite", "scaler_init", "scale_loss", "unscale_grads",
+            "scaler_update", "make_amp_train_step"]
+
 
 def scaler_init(init_scale=2.0 ** 15, incr_every_n_steps=1000,
                 decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5):
@@ -322,9 +331,10 @@ def scale_loss(scaler, loss):
     return loss * scaler["scale"].astype(loss.dtype)
 
 
-def _all_finite(tree):
-    leaves = [_jnp.all(_jnp.isfinite(x)) for x in _jax.tree.leaves(tree)]
-    return _jnp.stack(leaves).all() if leaves else _jnp.asarray(True)
+# back-compat alias (the resilience.guard implementation also skips
+# non-float leaves, so int counters/rng keys in a grads pytree no
+# longer break the check)
+_all_finite = all_finite
 
 
 def unscale_grads(scaler, grads):
@@ -367,6 +377,12 @@ def make_amp_train_step(model, optimizer, loss_fn=None, jit=True,
     step(state, *batch) -> (state, loss, grads_finite). Overflowing
     steps leave params/opt-state untouched and shrink the scale —
     OptimizerWithMixedPrecision semantics for jitted eager training.
+
+    Fault tolerance: the returned `grads_finite` flag is exactly what
+    `resilience.guarded_step` consumes — wrap the step to get policy
+    handling (raise / skip_step / rollback-from-checkpoint) plus
+    `resilience.*` recovery counters on top of the scaler's native
+    skip-on-overflow.
     """
     from ..models.train import TrainState, init_train_state
     from ..models.train import _loss_with_buffers

@@ -12,12 +12,32 @@ payload the way checkpoint_notify snapshots pserver lookup tables.
 
 Crash safety: a step directory counts as a checkpoint only once its
 _COMPLETE marker exists (written last), so a SIGKILL mid-save leaves the
-previous complete checkpoint as the resume point.
+previous complete checkpoint as the resume point.  Two hardenings on
+top of the marker protocol (ISSUE 4):
+
+- a per-file checksum MANIFEST (size + crc32 of every payload file,
+  written after the arrays, before the marker): a checkpoint whose
+  marker exists but whose bytes were truncated/corrupted after the
+  marker write (partial disk, torn copy) is DETECTED and skipped by
+  `latest_step`, falling back to the previous complete step instead of
+  feeding garbage into restore;
+- `CheckpointManager._gc` also removes incomplete/corrupt `step_*`
+  dirs older than the newest complete checkpoint, so crashed save
+  attempts can no longer leak disk forever (an incomplete dir NEWER
+  than the best complete step is kept — it may be a save in flight).
+
+Fault injection: `save_checkpoint` visits the
+`checkpoint.before_marker` crash point between the array write and the
+marker, so the kill-during-save recovery path is testable on purpose
+(resilience.faultinject).
 """
 
+import json
 import os
 import re
 import shutil
+import time
+import zlib
 
 import jax
 import numpy as np
@@ -30,12 +50,109 @@ except Exception:  # pragma: no cover
     _HAS_ORBAX = False
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_step",
-           "CheckpointManager"]
+           "load_extras", "CheckpointManager"]
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 _MARKER = "_COMPLETE"
+_MANIFEST = "_MANIFEST.json"
 
 _checkpointer = None
+
+# verification memo: abs step path -> (manifest mtime_ns, ok).  A
+# training loop calls latest_step via _gc on every save; re-crc'ing
+# every complete checkpoint each time would double the save's IO.
+_verify_memo = {}
+
+
+def _mon():
+    from . import monitor
+
+    return monitor
+
+
+def _crash_point(name):
+    from .resilience import faultinject
+
+    faultinject.crash_point(name)
+
+
+def _iter_payload_files(path):
+    """Every file under the step dir except the marker/manifest
+    themselves, as (relpath, abspath) in sorted order."""
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for f in sorted(files):
+            if root == path and f in (_MARKER, _MANIFEST):
+                continue
+            ap = os.path.join(root, f)
+            yield os.path.relpath(ap, path), ap
+
+
+def _file_crc32(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc
+            crc = zlib.crc32(b, crc)
+
+
+def _write_manifest(path):
+    entries = {}
+    for rel, ap in _iter_payload_files(path):
+        entries[rel] = {"size": os.path.getsize(ap),
+                        "crc32": _file_crc32(ap)}
+    tmp = os.path.join(path, _MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump({"version": 1, "files": entries}, f)
+    os.replace(tmp, os.path.join(path, _MANIFEST))
+
+
+def _payload_stat_sig(path):
+    """Cheap (stat-only, no reads) fingerprint of the payload files:
+    any truncation/rewrite changes a size or mtime and forces the crc
+    pass to re-run, while an untouched checkpoint re-verifies for the
+    cost of a directory walk."""
+    sig = []
+    for rel, ap in _iter_payload_files(path):
+        try:
+            st = os.stat(ap)
+        except OSError:
+            sig.append((rel, -1, -1))
+            continue
+        sig.append((rel, st.st_size, st.st_mtime_ns))
+    return tuple(sig)
+
+
+def _verify_manifest(path):
+    """True when every manifested file exists with matching size and
+    crc32.  A step dir WITHOUT a manifest (pre-manifest checkpoints)
+    passes — the marker protocol is its only guarantee."""
+    mpath = os.path.join(path, _MANIFEST)
+    try:
+        mstat = os.stat(mpath)
+    except OSError:
+        return True        # legacy checkpoint: marker-only protocol
+    sig = (mstat.st_mtime_ns, _payload_stat_sig(path))
+    memo = _verify_memo.get(path)
+    if memo is not None and memo[0] == sig:
+        return memo[1]
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        ok = True
+        for rel, want in manifest.get("files", {}).items():
+            ap = os.path.join(path, rel)
+            if not os.path.isfile(ap) \
+                    or os.path.getsize(ap) != want["size"] \
+                    or _file_crc32(ap) != want["crc32"]:
+                ok = False
+                break
+    except (OSError, ValueError, KeyError):
+        ok = False
+    _verify_memo[path] = (sig, ok)
+    return ok
 
 
 def _ckptr():
@@ -51,37 +168,74 @@ def _step_path(directory, step):
     return os.path.join(os.path.abspath(directory), f"step_{step}")
 
 
-def _list_steps(directory, complete_only=True):
+def _scan_steps(directory, verify=True):
+    """One directory pass: sorted [(step, complete)].  With verify,
+    `complete` demands the _COMPLETE marker AND a verified manifest —
+    a markered-but-truncated checkpoint is not a checkpoint (memo-
+    served for unchanged dirs: a stat walk, no payload reads).
+    verify=False trusts the marker alone — the retention/GC criterion,
+    which must not cold-CRC-read gigabytes of retained checkpoints
+    from inside the training loop; corruption is caught where it
+    matters, at restore-target selection (latest_step)."""
     if not os.path.isdir(directory):
         return []
-    steps = []
+    out = []
     for d in os.listdir(directory):
         m = _STEP_DIR.match(d)
         if not m:
             continue
-        if complete_only and not os.path.exists(
-                os.path.join(directory, d, _MARKER)):
-            continue
-        steps.append(int(m.group(1)))
-    return sorted(steps)
+        path = os.path.join(directory, d)
+        complete = os.path.exists(os.path.join(path, _MARKER)) \
+            and (not verify or _verify_manifest(path))
+        out.append((int(m.group(1)), complete))
+    return sorted(out)
+
+
+def _list_steps(directory, complete_only=True):
+    return [s for s, complete in _scan_steps(directory)
+            if complete or not complete_only]
 
 
 def latest_step(directory):
-    """Highest COMPLETE checkpointed step in `directory`, or None."""
-    steps = _list_steps(directory)
-    return steps[-1] if steps else None
+    """Highest COMPLETE (markered + checksum-verified) checkpointed
+    step in `directory`, or None.
+
+    Lazy: verifies newest-first and stops at the first good dir, so a
+    cold-process resume reads ~one checkpoint's bytes, not every
+    retained step's (older dirs get verified when _gc next looks)."""
+    if not os.path.isdir(directory):
+        return None
+    marked = []
+    for d in os.listdir(directory):
+        m = _STEP_DIR.match(d)
+        if m and os.path.exists(os.path.join(directory, d, _MARKER)):
+            marked.append(int(m.group(1)))
+    for s in sorted(marked, reverse=True):
+        if _verify_manifest(_step_path(directory, s)):
+            return s
+    return None
 
 
-def save_checkpoint(directory, state, step, sparse_tables=None):
+def save_checkpoint(directory, state, step, sparse_tables=None,
+                    extras=None):
     """Write `state` (any pytree of jax/np arrays) at `step`.
 
     sparse_tables: optional {name: SparseEmbedding} — exported host-side
     with optimizer accumulators and restored into whatever sharding
     layout the loader uses.
+
+    extras: optional {name: ndarray} sidecar riding OUTSIDE the
+    template-matched state tree (read back with `load_extras`), so
+    loaders with a different template still restore — the executor
+    checkpoints its PRNG root key here, which is what makes a rollback
+    replay of a stochastic (dropout) program bitwise-identical to the
+    uninterrupted run.
     """
+    t0 = time.perf_counter()
     path = _step_path(directory, step)
     if os.path.isdir(path):  # overwrite an old/incomplete attempt
         shutil.rmtree(path)
+        _verify_memo.pop(path, None)
     if _HAS_ORBAX:
         ckptr = _ckptr()
         ckptr.save(os.path.join(path, "state"), state, force=True)
@@ -100,9 +254,29 @@ def save_checkpoint(directory, state, step, sparse_tables=None):
             payload[f"{name}.ids"] = st["ids"]
             payload[f"{name}.values"] = st["values"]
         np.savez(os.path.join(path, "sparse_tables.npz"), **payload)
+    if extras:
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "extras.npz"),
+                 **{k: np.asarray(v) for k, v in extras.items()})
+    # the crash window under test: arrays are on disk, the marker is
+    # not — a kill here must leave the PREVIOUS checkpoint as the
+    # resume point (resilience.faultinject fires InjectedCrash here
+    # when armed)
+    _crash_point("checkpoint.before_marker")
+    _write_manifest(path)
     # marker last: only now does this step count as a checkpoint
     with open(os.path.join(path, _MARKER), "w") as f:
         f.write("ok\n")
+    # seed the verification memo: the writer just computed these CRCs,
+    # so the next _list_steps (the manager's own _gc, one line from
+    # now) must not re-read the whole checkpoint to re-derive them
+    _verify_memo[path] = ((os.stat(os.path.join(path, _MANIFEST))
+                           .st_mtime_ns, _payload_stat_sig(path)), True)
+    mon = _mon()
+    if mon.is_enabled():
+        mon.counter("resilience.checkpoint_saves").add(1)
+        mon.gauge("resilience.last_save_s").set(
+            round(time.perf_counter() - t0, 4))
     return path
 
 
@@ -111,6 +285,7 @@ def load_checkpoint(directory, template_state, step=None,
     """Restore a checkpoint into the structure/shardings of
     `template_state` (e.g. a freshly-initialised TrainState — sharded
     leaves come back with their NamedShardings). Returns (state, step)."""
+    t0 = time.perf_counter()
     if step is None:
         step = latest_step(directory)
         if step is None:
@@ -133,7 +308,26 @@ def load_checkpoint(directory, template_state, step=None,
         for name, table in sparse_tables.items():
             table.load_state_dict({"ids": npz[f"{name}.ids"],
                                    "values": npz[f"{name}.values"]})
+    mon = _mon()
+    if mon.is_enabled():
+        mon.counter("resilience.checkpoint_restores").add(1)
+        mon.gauge("resilience.last_restore_s").set(
+            round(time.perf_counter() - t0, 4))
     return state, step
+
+
+def load_extras(directory, step=None):
+    """The extras sidecar of checkpoint `step` (default: latest
+    complete) as {name: np.ndarray}; {} when the checkpoint has none."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    p = os.path.join(_step_path(directory, step), "extras.npz")
+    if not os.path.isfile(p):
+        return {}
+    with np.load(p) as npz:
+        return {k: npz[k] for k in npz.files}
 
 
 class CheckpointManager:
@@ -148,20 +342,56 @@ class CheckpointManager:
     def should_save(self, step):
         return step % self.save_interval_steps == 0
 
-    def save(self, state, step, sparse_tables=None, force=False):
+    def save(self, state, step, sparse_tables=None, force=False,
+             extras=None):
         """Checkpoint if `step` is on the save interval (or force=True).
         Returns the path, or None when gated off."""
         if not force and not self.should_save(step):
             return None
-        path = save_checkpoint(self.directory, state, step, sparse_tables)
+        path = save_checkpoint(self.directory, state, step, sparse_tables,
+                               extras=extras)
         self._gc()
         return path
+
+    def load_extras(self, step=None):
+        return load_extras(self.directory, step)
+
+    def latest_step(self):
+        return latest_step(self.directory)
 
     def restore_latest(self, template_state, sparse_tables=None):
         return load_checkpoint(self.directory, template_state,
                                sparse_tables=sparse_tables)
 
     def _gc(self):
-        for s in _list_steps(self.directory)[:-self.max_to_keep]:
-            shutil.rmtree(_step_path(self.directory, s),
-                          ignore_errors=True)
+        """Rolling retention PLUS orphan cleanup: crashed save
+        attempts (no marker) older than the newest markered checkpoint
+        are dead weight — without this they leak disk forever.  An
+        incomplete dir NEWER than the best markered step is left
+        alone: it may be a save currently in flight.  Retention
+        trusts the MARKER only (verify=False): a markered-but-corrupt
+        dir occupies a keep slot until rotation, and `latest_step`'s
+        lazy checksum pass skips it at restore time — the alternative
+        is cold-CRC-reading every retained checkpoint on the first
+        save of a resumed process."""
+        scan = _scan_steps(self.directory, verify=False)  # ONE stat pass
+        complete = [s for s, ok in scan if ok]
+        doomed = complete[:-self.max_to_keep]
+        if doomed:
+            # rotation must never delete the last verified-GOOD
+            # checkpoint: on a store whose newer markered dirs were
+            # corrupted post-marker, the oldest (good) one is all that
+            # stands between a rollback and total run loss.  Normal
+            # path stays cheap: latest_step stops at the newest dir,
+            # whose verification the save that triggered this _gc just
+            # memo-seeded.
+            newest_good = latest_step(self.directory)
+            doomed = [s for s in doomed
+                      if newest_good is not None and s < newest_good]
+        if complete:
+            newest = complete[-1]
+            doomed += [s for s, ok in scan if not ok and s < newest]
+        for s in doomed:
+            path = _step_path(self.directory, s)
+            shutil.rmtree(path, ignore_errors=True)
+            _verify_memo.pop(path, None)

@@ -35,6 +35,7 @@ from .program import Variable, default_main_program
 
 _profiler = None
 _monitor = None
+_resilience = None
 
 
 def _dispatch_span(name):
@@ -61,6 +62,19 @@ def _mon():
 
         _monitor = monitor
     return _monitor
+
+
+def _res():
+    """Lazy paddle_tpu.resilience handle: anomaly guard, retry policy,
+    preemption flag, and the fault-injection harness the dispatch path
+    consults.  When nothing is enabled the whole fault-tolerance layer
+    costs the steady state three None checks per run."""
+    global _resilience
+    if _resilience is None:
+        from .. import resilience
+
+        _resilience = resilience
+    return _resilience
 
 
 def _materialize(fetches):
@@ -630,6 +644,12 @@ class Executor:
         self._cache = {}
         seed = flags.flag("global_seed")
         self._root_key = jax.random.PRNGKey(seed)
+        # True while scope state may hold arrays committed to devices
+        # a dp mesh doesn't cover (fresh executor over a user-restored
+        # scope; re-armed by checkpoint restore paths).  Gates the dp
+        # re-placement scan so the steady-state dispatch path never
+        # pays per-var sharding checks.
+        self._check_state_placement = True
 
     def close(self):
         self._cache.clear()
@@ -688,6 +708,13 @@ class Executor:
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
 
+        res = _res()
+        guard = res.active_guard()
+        # the fused finite check only exists where loss/grads exist:
+        # train programs with backward sections on the compiled path
+        guard_on = (guard is not None and not program._is_test
+                    and bool(program.backward_sections))
+
         with _dispatch_span("executor.run.prepare"):
             plan = self._get_plan(program, use_program_cache)
 
@@ -707,6 +734,12 @@ class Executor:
                 else:
                     feed_arrays[name] = jnp.asarray(np.asarray(value),
                                                     dtype=dtype)
+            if res.faultinject.is_armed():
+                # fault-injection harness: counts this dispatch and may
+                # hand back a NaN-tainted COPY of the feed dict (the
+                # caller's arrays are never touched, so a rollback
+                # replay of the same batch sees clean data)
+                feed_arrays = res.faultinject.on_step_feed(feed_arrays)
 
             self._root_key, run_key = jax.random.split(self._root_key)
 
@@ -751,6 +784,28 @@ class Executor:
                         f"the startup program first"
                     )
 
+            if dp_mesh is not None and self._check_state_placement:
+                # a checkpoint restore (auto_resume / guard rollback
+                # into a cold scope) hands back arrays COMMITTED to the
+                # template's devices; shard_map refuses committed
+                # arrays that don't cover the mesh, so re-place them
+                # replicated.  The scan runs only while the placement
+                # flag is armed (executor construction + restore
+                # paths): steady-state dispatch pays nothing for it.
+                from jax.sharding import (NamedSharding,
+                                          PartitionSpec as _P)
+
+                mesh_devs = set(dp_mesh.devices.flat)
+                rep = None
+                for n, v in state.items():
+                    devs = getattr(getattr(v, "sharding", None),
+                                   "device_set", None)
+                    if devs is not None and devs != mesh_devs:
+                        if rep is None:
+                            rep = NamedSharding(dp_mesh, _P())
+                        state[n] = jax.device_put(v, rep)
+                self._check_state_placement = False
+
             feed_sig = tuple(
                 (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
                 for n in sorted(feed_arrays)
@@ -767,7 +822,7 @@ class Executor:
             key = (id(program), plan.version, feed_sig, tuple(fetch_names),
                    state_names,
                    None if dp_mesh is None else dp_mesh.shape_tuple,
-                   precision)
+                   precision, guard_on)
             # cache value holds the program so id() can't be recycled by a
             # new Program allocated at the same address after GC
             entry = self._cache.get(key) if use_program_cache else None
@@ -780,7 +835,8 @@ class Executor:
                                        plan.persist_names, dp_mesh=dp_mesh,
                                        precision=precision,
                                        feed_casts=feed_casts,
-                                       telemetry_key=telemetry_key)
+                                       telemetry_key=telemetry_key,
+                                       guard_on=guard_on)
             if use_program_cache:
                 self._cache[key] = (compiled, program)
         else:
@@ -789,13 +845,44 @@ class Executor:
             compiled = entry[0]
 
         with _dispatch_span("executor.run.dispatch"):
-            # async dispatch: this returns device futures without a sync,
-            # and the donated `state` buffers are rebound to the NEW
-            # device arrays — never via a host copy, which would both
-            # block and resurrect freed donated buffers as host memory
-            new_state, fetches = compiled(state, feed_arrays, run_key)
+            retry_policy = res.active_retry()
+
+            def _dispatch():
+                # an injected transient error fires here, INSIDE the
+                # retried region, so backoff + re-dispatch is the real
+                # recovery path under test
+                if res.faultinject.is_armed():
+                    res.faultinject.check_transient()
+                out = compiled(state, feed_arrays, run_key)
+                if retry_policy is not None:
+                    # async dispatch defers real XLA/PJRT failures to
+                    # the next sync point — which would sit OUTSIDE
+                    # this retried region.  With retry on, block here
+                    # so a transient execution error surfaces where
+                    # backoff can catch it: fault tolerance trades the
+                    # steps-ahead pipeline for retryability.
+                    jax.block_until_ready(out)
+                return out
+
+            # async dispatch (retry off): this returns device futures
+            # without a sync, and the donated `state` buffers are
+            # rebound to the NEW device arrays — never via a host copy,
+            # which would both block and resurrect freed donated
+            # buffers as host memory
+            if retry_policy is not None:
+                new_state, fetches = res.call_with_retry(_dispatch,
+                                                         retry_policy)
+            else:
+                new_state, fetches = _dispatch()
             for n, v in new_state.items():
                 scope.set_var(n, v)
+        guard_flag = None
+        if guard_on:
+            # the fused all-finite flag rides back as the LAST fetch;
+            # popped before metrics so fetch-byte accounting and the
+            # caller's fetch list never see it
+            guard_flag = fetches[-1]
+            fetches = fetches[:-1]
         if mon_on:
             # recorded BEFORE any materialization so host_dispatch_us is
             # the pure dispatch cost; fetch bytes read from the device
@@ -804,6 +891,12 @@ class Executor:
             # aggregates (mean step time / dispatch μs / MFU).
             self._record_step_metrics(mon, t0, feed_arrays, fetches,
                                       warmup=fresh_compile)
+        if guard_flag is not None:
+            # ONE host sync per guarded step (the policy decision needs
+            # the scalar): the price of the guard, paid only when it is
+            # enabled.  State selection already happened on device — an
+            # anomalous step committed nothing.
+            self._apply_guard_policy(res, guard, guard_flag, plan, scope)
         if return_numpy:
             with _dispatch_span("executor.run.fetch"):
                 return _materialize(fetches)
@@ -839,12 +932,77 @@ class Executor:
             examples=examples or None, feed_bytes=feed_bytes,
             fetch_bytes=fetch_bytes, warmup=warmup)
 
+    def _apply_guard_policy(self, res, guard, guard_flag, plan, scope):
+        """Host side of the anomaly guard: read the fused finite flag
+        (a float — 1.0 when every section's loss/grads were finite on
+        every dp shard) and apply the active policy.
+
+        skip_step needs no state action (the compiled step selected the
+        old state on device); rollback restores the newest complete
+        checkpoint into the scope and raises RollbackPerformed so the
+        training loop rewinds its data cursor."""
+        ok = float(np.asarray(guard_flag)) >= 1.0
+        if ok:
+            guard.note_ok()
+            return
+        mon = _mon()
+        if mon.is_enabled():
+            mon.counter("resilience.anomaly_steps").add(1)
+        guard.note_anomaly()         # escalates past max_consecutive
+        guard.last_skipped = False
+        if guard.policy == "raise":
+            raise res.AnomalyError(
+                "anomaly guard: non-finite loss/gradients in guarded "
+                "step (policy=raise)")
+        if guard.policy == "skip_step":
+            guard.last_skipped = True
+            if mon.is_enabled():
+                mon.counter("resilience.skipped_steps").add(1)
+            return
+        # rollback: restore newest complete checkpoint into the scope
+        guard.note_rollback()        # escalates past max_rollbacks
+        template = {}
+        for n in plan.persist_names:
+            v = scope.find_var(n)
+            if v is not None:
+                template[n] = v
+        with _dispatch_span("resilience.rollback_restore"):
+            try:
+                state, ck_step = guard.manager.restore_latest(template)
+            except FileNotFoundError as e:
+                # no complete checkpoint yet: the on-device select
+                # already kept the params clean, but there is nothing
+                # to roll back TO — escalate with the real story
+                # instead of a bare IO error
+                raise res.AnomalyError(
+                    "rollback policy hit an anomaly before any complete "
+                    "checkpoint existed; save one up front (train_from_"
+                    "dataset does this automatically) or use "
+                    "policy='skip_step'") from e
+        for n, v in state.items():
+            scope.set_var(n, v)
+        # restored arrays may be committed off-mesh: the next dp
+        # dispatch re-places them
+        self._check_state_placement = True
+        # checkpoints written by train_from_dataset carry the executor
+        # PRNG root key: restoring it rewinds the rng STREAM along with
+        # the params, so a replay of a stochastic (dropout) program is
+        # bitwise-identical to the uninterrupted run
+        loader = getattr(guard.manager, "load_extras", None)
+        extras = loader(ck_step) if loader is not None else {}
+        if "executor_rng_key" in extras:
+            self._root_key = jnp.asarray(extras["executor_rng_key"])
+        if mon.is_enabled():
+            mon.counter("resilience.rollbacks").add(1)
+        raise res.RollbackPerformed(ck_step)
+
     # ------------------------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
                            sparse_config=None, _sparse_push=True,
-                           prefetch=None):
+                           prefetch=None, checkpoint=None,
+                           auto_resume=False):
         """Dataset-driven training loop — the industrial CTR path.
 
         Parity: /root/reference/python/paddle/fluid/executor.py:1187
@@ -873,6 +1031,20 @@ class Executor:
         pulls are already the semantics; plain sync tables keep the
         strict pull->step->push order.
 
+        checkpoint: fault-tolerance cadence (fleet_util save-model
+        parity) — a checkpoint.CheckpointManager, a directory path, or
+        a kwargs dict for CheckpointManager.  The loop saves the
+        program's persistable vars every save_interval_steps, force-
+        saves at the next step boundary when a preemption was requested
+        (resilience.PreemptionHandler / request_preemption) and exits
+        cleanly, and — when the active anomaly guard's policy is
+        ``rollback`` — keeps the prepared batches since the last save
+        so a rollback can replay the data cursor in place.
+
+        auto_resume: restore the newest complete checkpoint before
+        training and skip the already-consumed batches, so a re-launch
+        of the SAME command continues the run (trainer-restart parity).
+
         Returns the list of final-batch fetch values (or None, like the
         reference, when fetch_list is empty).
         """
@@ -888,6 +1060,90 @@ class Executor:
         fetch_info = list(fetch_info or fetch_names)
         blk = real_prog.global_block()
 
+        # -- fault-tolerance plumbing ----------------------------------
+        res = _res()
+        mon = _mon()
+        mgr = checkpoint
+        if mgr is not None and not hasattr(mgr, "restore_latest"):
+            from ..checkpoint import CheckpointManager
+
+            if isinstance(mgr, str):
+                mgr = CheckpointManager(mgr)
+            elif isinstance(mgr, dict):
+                mgr = CheckpointManager(**mgr)
+            else:
+                raise TypeError(
+                    f"checkpoint= wants a CheckpointManager, path, or "
+                    f"kwargs dict, got {type(checkpoint).__name__}")
+        ckpt_scope = scope if scope is not None else _global_scope
+        persist_names = sorted(v.name for v in real_prog.list_vars()
+                               if v.persistable)
+
+        def _ckpt_state():
+            return {n: ckpt_scope.find_var(n) for n in persist_names
+                    if ckpt_scope.find_var(n) is not None}
+
+        def _ckpt_extras():
+            return {"executor_rng_key": np.asarray(self._root_key)}
+
+        guard = res.active_guard()
+        # rollback/replay only exists for TRAIN programs: an eval drain
+        # (infer_from_dataset, clone(for_test=True)) is never guarded
+        # (no backward sections), and adopting the guard's manager for
+        # it would interval-save EVAL vars into the TRAINING store —
+        # _gc would then rotate out real restore points
+        is_train_prog = (not real_prog._is_test
+                         and bool(real_prog.backward_sections))
+        keep_replay = (guard is not None and guard.policy == "rollback"
+                       and is_train_prog)
+        if keep_replay:
+            # the guard restores through ITS manager; the loop's saves
+            # and replay numbering must point at the same store or a
+            # RollbackPerformed.step means nothing here
+            if mgr is None:
+                mgr = guard.manager
+            elif mgr is not guard.manager:
+                raise ValueError(
+                    "checkpoint= and the rollback guard's manager are "
+                    "different CheckpointManagers; pass the same one so "
+                    "rollback steps line up with the loop's saves")
+
+        if auto_resume and mgr is None:
+            raise ValueError(
+                "auto_resume=True needs a checkpoint store (pass "
+                "checkpoint=...); silently retraining from step 0 "
+                "would re-consume data")
+        start_step = 0
+        if mgr is not None and auto_resume:
+            template = _ckpt_state()
+            if template:
+                try:
+                    restored, start_step = mgr.restore_latest(template)
+                except FileNotFoundError:
+                    start_step = 0      # cold start: nothing to resume
+                else:
+                    for n, v in restored.items():
+                        ckpt_scope.set_var(n, v)
+                    self._check_state_placement = True
+                    extras = mgr.load_extras(start_step)
+                    if "executor_rng_key" in extras:
+                        # resume the rng STREAM, not just the params —
+                        # dropout continues exactly where the
+                        # interrupted run left off
+                        self._root_key = jnp.asarray(
+                            extras["executor_rng_key"])
+                    if mon.is_enabled():
+                        mon.counter("resilience.auto_resume").add(1)
+                        mon.counter("resilience.batches_skipped").add(
+                            start_step)
+        if start_step:
+            import itertools
+
+            # skip already-consumed RAW batches (before prepare(): no
+            # wasted sparse pulls), preserving the data cursor of the
+            # interrupted run
+            dataset = itertools.islice(iter(dataset), start_step, None)
+
         # sparse_config: one entry dict, a list of them, or (when None)
         # whatever the DistributeTranspiler attached to the program
         sp = sparse_config
@@ -898,6 +1154,12 @@ class Executor:
             else ([sp] if sp else [])
         # tolerate partial/dense configs: no table -> dense path
         entries = [e for e in entries if e and e.get("table") is not None]
+        if keep_replay and entries and _sparse_push:
+            raise ValueError(
+                "anomaly-guard rollback cannot be combined with sparse "
+                "gradient push: pushed rows can't be unwound by a "
+                "checkpoint restore (use policy='skip_step' or drop the "
+                "sparse tables)")
         for e in entries:
             # Communicator wraps a table: pull reads through, push goes
             # via the communicator's mode (sync/async/half_async/geo)
@@ -979,7 +1241,7 @@ class Executor:
 
             def prepared_batches():
                 gen = _host_batches()
-                if not entries and \
+                if not entries and not keep_replay and \
                         not getattr(program, "_is_data_parallel", False):
                     # dense single-device path: double-buffered DEVICE
                     # prefetch on top of the host producer thread — feed
@@ -992,7 +1254,12 @@ class Executor:
                     # batches: device_put would land the FULL batch on
                     # device 0 for jit to reshard (an extra d2d hop +
                     # device-0 memory spike), whereas the numpy feed
-                    # lets jit place each dp shard directly.
+                    # lets jit place each dp shard directly.  The
+                    # rollback-replay path also keeps host batches: the
+                    # replay buffer retains every feed since the last
+                    # save, and pinning those as DEVICE arrays would
+                    # burn HBM proportional to the save interval (host
+                    # RAM is the right place for a recovery window).
                     from ..reader import device_prefetch
 
                     gen = device_prefetch(gen, size=2)
@@ -1009,19 +1276,111 @@ class Executor:
         # device (composing with the producer thread + device_prefetch
         # double buffer above).  The sparse push is the one per-step
         # exception: the gradient rows must reach the host to be pushed.
+        if keep_replay and mgr.latest_step() is None:
+            # rollback needs a restore point covering the WHOLE loop:
+            # without this, an anomaly before the first interval save
+            # has nowhere to roll back to.  (After the sparse-config
+            # validation — a config error must win over a save.)
+            initial = _ckpt_state()
+            if initial:
+                mgr.save(initial, start_step, force=True,
+                         extras=_ckpt_extras())
         last = None
-        step_i = 0
+        step_i = start_step
+        replay = []          # [(step_no, feed, fl)] since the last save
         for feed, fl, batch_ids in prepared_batches():
-            out = self.run(program, feed=feed, fetch_list=fl, scope=scope,
-                           return_numpy=False)
+            if res.preemption_requested():
+                # preemption-safe exit: force-checkpoint at this STEP
+                # BOUNDARY (never mid-step) and leave the loop cleanly;
+                # a re-launch with auto_resume=True continues here.
+                # (Counted HERE, not in the signal handler — the
+                # handler must stay async-signal-safe.)
+                if mon.is_enabled():
+                    mon.counter("resilience.preempt_requested").add(1)
+                if mgr is None:
+                    # stopping is still right, but a checkpoint-less
+                    # loop can't consume the flag (an enclosing
+                    # checkpointed loop might) — without this warning a
+                    # process with NO such loop silently turns every
+                    # later train_from_dataset into a 0-step no-op
+                    import warnings
+
+                    warnings.warn(
+                        "preemption requested but this train_from_"
+                        "dataset has no checkpoint= store; stopping "
+                        "WITHOUT saving.  The flag stays set for an "
+                        "enclosing checkpointed loop — call "
+                        "resilience.clear_preemption() if none exists.",
+                        RuntimeWarning, stacklevel=2)
+                if mgr is not None:
+                    if mgr.latest_step() != step_i:
+                        # already durable at this exact boundary?  Then
+                        # do NOT rewrite it: save_checkpoint rmtree's
+                        # the existing dir first, and a SIGKILL during
+                        # the rewrite — the grace window running out,
+                        # the very scenario this path serves — would
+                        # lose the only fresh restore point
+                        mgr.save(_ckpt_state(), step_i, force=True,
+                                 extras=_ckpt_extras())
+                    if mon.is_enabled():
+                        mon.counter("resilience.preempt_checkpoint").add(1)
+                    # HANDLED (durable checkpoint taken): leaving the
+                    # flag up would make every later train_from_dataset
+                    # in this process train zero steps (notebook
+                    # re-runs, per-epoch loops).  A checkpoint-LESS
+                    # drain (eval pass, ad-hoc loop) must NOT clear it:
+                    # the enclosing training loop still has to see the
+                    # request and take the real force-checkpoint.
+                    res.clear_preemption()
+                break
+            if keep_replay:
+                # run with data-cursor replay: a RollbackPerformed from
+                # the guard restored checkpoint step S into the scope;
+                # re-run the buffered batches S+1..current in order
+                # (the failing batch included — injected faults are
+                # one-shot; a persistent anomaly escalates via the
+                # guard's max_rollbacks)
+                pending = [(step_i + 1, feed, fl)]
+                while pending:
+                    sno, f, flx = pending.pop(0)
+                    try:
+                        out = self.run(program, feed=f, fetch_list=flx,
+                                       scope=scope, return_numpy=False)
+                    except res.RollbackPerformed as rb:
+                        redo = [it for it in replay if it[0] > rb.step]
+                        replay = [it for it in replay
+                                  if it[0] <= rb.step]
+                        pending = redo + [(sno, f, flx)] + pending
+                        continue
+                    replay.append((sno, f, flx))
+            else:
+                out = self.run(program, feed=feed, fetch_list=fl,
+                               scope=scope, return_numpy=False)
             if entries and _sparse_push:
                 n = len(entries)
-                grads = _materialize(out[-n:])
-                for e, g in zip(entries, grads):
-                    e["table"].push(batch_ids[e["emb_var"]], g)
-                out = out[:-n]
+                if guard is not None and guard.last_skipped:
+                    # a skipped step commits NOTHING — that contract
+                    # covers the sparse half too: these gradient rows
+                    # are the NaNs the guard just refused to apply
+                    out = out[:-n]
+                else:
+                    grads = _materialize(out[-n:])
+                    for e, g in zip(entries, grads):
+                        e["table"].push(batch_ids[e["emb_var"]], g)
+                    out = out[:-n]
             last = out
             step_i += 1
+            if mgr is not None and mgr.should_save(step_i):
+                # interval-gated BEFORE building the state dict: the
+                # 999 gated-off steps of a 1000-step interval must not
+                # pay per-var scope lookups or the rng-key host copy
+                # (the loop's no-sync contract)
+                saved = mgr.save(_ckpt_state(), step_i,
+                                 extras=_ckpt_extras())
+                if saved is not None:
+                    # everything up to step_i is durable: the replay
+                    # window restarts here
+                    replay = [it for it in replay if it[0] > step_i]
             if (debug or fetch_info) and fetch_names \
                     and step_i % print_period == 0:
                 msg = ", ".join(
@@ -1071,7 +1430,8 @@ class Executor:
         return [op for i, op in enumerate(ops) if keep[i]]
 
     def _build(self, program, fetch_names, persist_names, dp_mesh=None,
-               precision=None, feed_casts=None, telemetry_key=None):
+               precision=None, feed_casts=None, telemetry_key=None,
+               guard_on=False):
         ops = self._live_ops(program, fetch_names)
         sections = [] if program._is_test else list(program.backward_sections)
         if telemetry_key is None:
@@ -1082,17 +1442,19 @@ class Executor:
         return self._build_step(ops, sections, fetch_names, persist_names,
                                 dp_mesh, precision=precision,
                                 feed_casts=feed_casts,
-                                telemetry_key=telemetry_key)
+                                telemetry_key=telemetry_key,
+                                guard_on=guard_on)
 
     def _build_step(self, ops, sections, fetch_names, persist_names,
                     dp_mesh, precision=None, feed_casts=None,
-                    telemetry_key="program"):
+                    telemetry_key="program", guard_on=False):
         dp = dp_mesh is not None
 
         def make_step(dp):
             return self._make_step_fn(ops, sections, fetch_names,
                                       persist_names, dp,
-                                      feed_casts=feed_casts)
+                                      feed_casts=feed_casts,
+                                      guard_on=guard_on)
         step = make_step(dp)
 
         if not dp:
@@ -1159,17 +1521,20 @@ class Executor:
         return compiled
 
     def _make_step_fn(self, ops, sections, fetch_names, persist_names, dp,
-                      feed_casts=None):
+                      feed_casts=None, guard_on=False):
         # optimizer-updated params: identical across dp replicas by
         # construction, so exempt from the SyncBN-style stats averaging
         param_names = set()
         for bs in sections:
             param_names.update(bs.param_names)
         feed_casts = feed_casts or {}
+        if guard_on:
+            from ..resilience.guard import all_finite as _all_finite_tree
 
         def step(state, feeds, key):
             env = {}
             env.update(state)
+            finite = jnp.asarray(True) if guard_on else None
             # device-resident feeds whose dtype mismatches the declared
             # var dtype are cast HERE, inside the compiled step — the
             # cast fuses into the step instead of costing the dispatch
@@ -1213,6 +1578,14 @@ class Executor:
                     fwd, has_aux=True
                 )(train_params)
                 rng_box = _RngBox(new_key)
+                if guard_on:
+                    # anomaly guard: ONE fused reduction per section over
+                    # the loss and the raw (pre-sync, still scaled under
+                    # AMP — exactly where update_loss_scaling samples)
+                    # gradients; folded into the compiled step so the
+                    # check costs no extra dispatch
+                    finite = finite & jnp.isfinite(loss_val) \
+                        & _all_finite_tree(grads)
                 for n, g in grads.items():
                     # DP gradient sync — the one collective the reference
                     # inserts as allreduce op-handles
@@ -1235,6 +1608,22 @@ class Executor:
                             jnp.asarray(v).dtype, jnp.floating)
                         else v)
                     for n, v in new_state.items()}
+            if guard_on:
+                # the flag travels as float32 so the dp fetch pmean
+                # averages it: ANY shard's anomaly pulls it below 1.0
+                flag = finite.astype(jnp.float32)
+                if dp:
+                    flag = jax.lax.pmean(flag, "dp")
+                ok = flag >= 1.0
+                # an anomalous step commits NOTHING: select the old
+                # state on device (same contract as the AMP scaler's
+                # skip-on-overflow).  XLA copies where donation would
+                # alias — correctness first, the guard is opt-in.
+                new_state = {
+                    n: (jnp.where(ok, jnp.asarray(v), jnp.asarray(state[n]))
+                        if n in state else v)
+                    for n, v in new_state.items()}
+                fetches = fetches + [flag]
             return new_state, fetches
 
         return step

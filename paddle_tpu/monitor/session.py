@@ -19,10 +19,16 @@ import time
 __all__ = ["MetricsSession"]
 
 # counters sampled into every step record — the chrome-trace counter
-# tracks are built from these samples
+# tracks are built from these samples (the resilience.* rows make
+# recovery events — retries, skipped steps, rollbacks, checkpoint
+# save/restore — visible on the merged trace timeline)
 _SAMPLED_COUNTERS = ("run_plan.hit", "run_plan.miss",
                      "compiled_step.hit", "compiled_step.miss",
-                     "compile.count")
+                     "compile.count",
+                     "resilience.retries", "resilience.anomaly_steps",
+                     "resilience.skipped_steps", "resilience.rollbacks",
+                     "resilience.checkpoint_saves",
+                     "resilience.checkpoint_restores")
 
 
 class MetricsSession:

@@ -78,6 +78,13 @@ def _step_events(records):
             cache = {"hit": hits, "miss": misses}
             out.append({"name": "cache", "ph": "C", "ts": r["ts_us"],
                         "pid": _STEP_PID, "args": cache})
+        # recovery-event track: only emitted once any resilience
+        # counter has fired, so fault-free runs keep a clean trace
+        resil = {k.split(".", 1)[1]: v for k, v in counters.items()
+                 if k.startswith("resilience.")}
+        if any(resil.values()):
+            out.append({"name": "resilience", "ph": "C", "ts": r["ts_us"],
+                        "pid": _STEP_PID, "args": resil})
     return out
 
 

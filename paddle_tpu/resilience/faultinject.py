@@ -1,0 +1,206 @@
+"""Deterministic fault-injection harness.
+
+The fault-tolerance layer is only trustworthy if every recovery path is
+exercised on purpose: this module injects the three failure shapes the
+runtime claims to survive, at exact, reproducible points —
+
+- **NaN at step N**: the first floating-point feed of the Nth guarded
+  Executor.run is replaced (a tainted COPY — the caller's batch array
+  is untouched, so a rollback replay of the same batch sees clean
+  data) with NaN, which propagates to loss and gradients and trips the
+  anomaly guard.
+- **transient error at step N**: a synthetic InjectedTransientError is
+  raised from inside the retried dispatch region, `times` times in a
+  row, exercising classification + backoff + eventual success.
+- **crash at a named point**: code that must be crash-safe calls
+  `crash_point("name")` at its vulnerable spots (checkpoint.py calls
+  `checkpoint.before_marker` between the array write and the
+  _COMPLETE marker); an armed plan raises InjectedCrash there —
+  a BaseException, so no cleanup handler downstream can complete the
+  interrupted operation, exactly like a SIGKILL.
+
+All injections are ONE-SHOT by default (they disarm after firing) and
+counted both in the plan (`fired`) and as `resilience.injected_*`
+monitor counters, so a test can assert the fault actually happened —
+a chaos test that silently injects nothing is worse than no test.
+"""
+
+import threading
+
+from .taxonomy import InjectedCrash, InjectedTransientError
+
+__all__ = ["FaultPlan", "arm", "disarm", "active_plan", "is_armed",
+           "plan_scope", "on_step_feed", "check_transient", "crash_point",
+           "InjectedTransientError", "InjectedCrash"]
+
+_lock = threading.Lock()
+_plan = None
+
+
+class FaultPlan:
+    """One armed injection schedule.  Step indices are 0-based counts
+    of EVERY Executor.run dispatch SINCE ARMING — guarded or not, eval
+    programs included (the harness keeps its own counter; arm right
+    before the loop under test, and account for any interleaved eval
+    runs when picking indices).  Injecting into an UNguarded run is a
+    legitimate chaos scenario: it shows what the failure looks like
+    with recovery off.
+
+    nan_at_steps:   iterable of step indices whose feeds get tainted
+    nan_feed:       feed var name to taint (default: first float feed,
+                    in sorted-name order for determinism)
+    transient_at_step: step index that raises InjectedTransientError
+    transient_times:   how many consecutive raises before succeeding
+    crash_points:   {point_name: nth_hit_to_fire} (0-based hit count)
+    """
+
+    def __init__(self, nan_at_steps=(), nan_feed=None,
+                 transient_at_step=None, transient_times=1,
+                 crash_points=None):
+        self.nan_at_steps = set(int(s) for s in (
+            nan_at_steps if not isinstance(nan_at_steps, int)
+            else (nan_at_steps,)))
+        self.nan_feed = nan_feed
+        self.transient_at_step = transient_at_step
+        self.transient_remaining = int(transient_times)
+        self.crash_points = dict(crash_points or {})
+        self._crash_hits = {}
+        self.step = 0
+        self.fired = {"nan": 0, "transient": 0, "crash": 0}
+
+    def describe(self):
+        return {"step": self.step, "fired": dict(self.fired)}
+
+
+def arm(plan=None, **kw):
+    """Install a FaultPlan (or build one from kwargs) process-wide.
+    Returns the armed plan."""
+    global _plan
+    p = plan if plan is not None else FaultPlan(**kw)
+    with _lock:
+        _plan = p
+    return p
+
+
+def disarm():
+    global _plan
+    with _lock:
+        _plan = None
+
+
+def active_plan():
+    return _plan
+
+
+def is_armed():
+    return _plan is not None
+
+
+class plan_scope:
+    """Context manager: arm on enter, ALWAYS disarm on exit — a
+    raising test must not leak its faults into the next one."""
+
+    def __init__(self, plan=None, **kw):
+        self._plan = plan if plan is not None else FaultPlan(**kw)
+
+    def __enter__(self):
+        return arm(self._plan)
+
+    def __exit__(self, *exc):
+        disarm()
+        return False
+
+
+def _mon():
+    from .. import monitor
+
+    return monitor
+
+
+# -- hooks called by the runtime ---------------------------------------
+
+def on_step_feed(feed_arrays):
+    """Executor.run calls this once per guarded dispatch with the
+    prepared feed dict; returns the (possibly tainted) dict and
+    advances the plan's step counter.  The input dict/arrays are never
+    mutated — a tainted feed is a fresh NaN-filled array under the
+    same name."""
+    p = _plan
+    if p is None:
+        return feed_arrays
+    with _lock:
+        step = p.step
+        p.step += 1
+        fire_nan = step in p.nan_at_steps
+        if fire_nan:
+            p.nan_at_steps.discard(step)       # one-shot
+    if not fire_nan:
+        return feed_arrays
+    import jax.numpy as jnp
+
+    name = p.nan_feed
+    if name is None:
+        for n in sorted(feed_arrays):
+            a = feed_arrays[n]
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype,
+                                                      jnp.floating):
+                name = n
+                break
+    if name is None or name not in feed_arrays:
+        raise ValueError(
+            f"fault plan has no float feed to taint (nan_feed="
+            f"{p.nan_feed!r}, feeds={sorted(feed_arrays)})")
+    tainted = dict(feed_arrays)
+    tainted[name] = jnp.full_like(jnp.asarray(tainted[name]), jnp.nan)
+    p.fired["nan"] += 1
+    mon = _mon()
+    if mon.is_enabled():
+        mon.counter("resilience.injected_nan").add(1)
+    return tainted
+
+
+def check_transient():
+    """Called from inside the retried dispatch region: raises the
+    scheduled InjectedTransientError while any raises remain for the
+    current step.  The step index was fixed by on_step_feed (which
+    runs first), so every retry of the SAME step re-enters here."""
+    p = _plan
+    if p is None or p.transient_at_step is None:
+        return
+    # on_step_feed already advanced p.step past the current dispatch
+    current = p.step - 1
+    if current != p.transient_at_step:
+        return
+    with _lock:
+        if p.transient_remaining <= 0:
+            return
+        p.transient_remaining -= 1
+        p.fired["transient"] += 1
+    mon = _mon()
+    if mon.is_enabled():
+        mon.counter("resilience.injected_transient").add(1)
+    raise InjectedTransientError(
+        "injected: RESOURCE_EXHAUSTED: synthetic device allocation "
+        "failure (fault-injection harness)")
+
+
+def crash_point(name):
+    """Instrumented code calls this at its crash-vulnerable points;
+    a no-op unless an armed plan schedules `name`.  Fires InjectedCrash
+    on the scheduled visit (0-based), then disarms that point."""
+    p = _plan
+    if p is None or name not in p.crash_points:
+        return
+    with _lock:
+        if name not in p.crash_points:       # re-check under lock
+            return
+        hit = p._crash_hits.get(name, 0)
+        p._crash_hits[name] = hit + 1
+        if hit != p.crash_points[name]:
+            return
+        del p.crash_points[name]             # one-shot
+        p.fired["crash"] += 1
+    mon = _mon()
+    if mon.is_enabled():
+        mon.counter("resilience.injected_crash").add(1)
+    raise InjectedCrash(f"injected crash at point {name!r}")

@@ -1,0 +1,170 @@
+"""Anomaly guard — on-device all-finite check with a recovery policy.
+
+Parity role: the reference's FLAGS_check_nan_inf checks every op
+output on the host (operator.cc:1032) — useful for debugging, ruinous
+for throughput.  The guard instead fuses ONE cheap reduction into the
+compiled train step (isfinite over each section's loss and gradients,
+AND-ed to a single scalar riding back with the fetches) and lets a
+policy decide what an anomalous step means:
+
+- ``raise``     — stop the run with AnomalyError (CI / debugging).
+- ``skip_step`` — commit nothing: the compiled step selects the OLD
+  state when the flag is down (the select is on-device, so a skipped
+  step costs no extra sync beyond the flag read), counts it, and
+  training continues with the next batch.  This is exactly the
+  dynamic-loss-scaling skip of the AMP path, generalized to any
+  program.
+- ``rollback``  — restore the newest complete checkpoint through a
+  CheckpointManager and signal the training loop (RollbackPerformed)
+  to rewind its data cursor and replay the consumed batches.
+
+AMP integration: the static-graph AMP decorator scales the loss before
+backward, so the guard's gradient check sees SCALED grads — overflow
+detection at the same point update_loss_scaling samples; with bf16
+(no scaling) the check degenerates to a plain finiteness test.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["AnomalyGuard", "AnomalyError", "RollbackPerformed",
+           "enable_anomaly_guard", "disable_anomaly_guard",
+           "anomaly_guard", "active_guard", "all_finite"]
+
+POLICIES = ("raise", "skip_step", "rollback")
+
+
+class AnomalyError(FloatingPointError):
+    """A guarded step produced non-finite loss/gradients under the
+    `raise` policy (or a policy escalated after repeated anomalies)."""
+
+
+class RollbackPerformed(RuntimeError):
+    """The guard restored checkpoint `step` into the scope; the
+    training loop must rewind its data cursor to that step and replay.
+    Executor.train_from_dataset handles this itself; bare Executor.run
+    loops catch it and reset their batch index to `step`."""
+
+    def __init__(self, step):
+        super().__init__(
+            f"anomaly guard rolled state back to checkpoint step {step}; "
+            f"replay data from there")
+        self.step = step
+
+
+def all_finite(tree):
+    """Single-scalar finiteness over a pytree of float leaves (the
+    same reduction amp's loss-scaler uses).  Non-float leaves — int
+    counters, rng keys — are finite by construction and skipped, but
+    dtype-LESS Python floats (an eagerly accumulated loss) are
+    promoted and checked: float('nan') must not slip through."""
+    checks = []
+    for x in jax.tree.leaves(tree):
+        a = x if hasattr(x, "dtype") else jnp.asarray(x)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            checks.append(jnp.all(jnp.isfinite(a)))
+    if not checks:
+        return jnp.asarray(True)
+    return jnp.stack(checks).all()
+
+
+class AnomalyGuard:
+    """Active guard configuration.
+
+    policy:          one of POLICIES.
+    manager:         CheckpointManager (required for ``rollback``).
+    max_consecutive: escalate to AnomalyError after this many
+                     anomalous steps IN A ROW — a persistent numeric
+                     bug must not skip/rollback forever (the
+                     reference's loss scaler has the same escape:
+                     scale bottoms out at 1.0 and the run dies).
+    max_rollbacks:   total rollbacks before escalating.
+    """
+
+    def __init__(self, policy="raise", manager=None, max_consecutive=10,
+                 max_rollbacks=3):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown anomaly policy {policy!r}; pick from {POLICIES}")
+        if policy == "rollback" and manager is None:
+            raise ValueError(
+                "rollback policy needs a CheckpointManager (manager=...)")
+        self.policy = policy
+        self.manager = manager
+        self.max_consecutive = max_consecutive
+        self.max_rollbacks = max_rollbacks
+        self._lock = threading.Lock()
+        self.consecutive = 0
+        self.rollbacks = 0
+        # True exactly when the most recent guarded step was skipped —
+        # the signal train_from_dataset's sparse-push path reads so a
+        # skipped step's NaN gradient rows never reach the tables
+        self.last_skipped = False
+
+    # -- bookkeeping called by the executor ---------------------------
+    def note_ok(self):
+        with self._lock:
+            self.consecutive = 0
+            self.last_skipped = False
+
+    def note_anomaly(self):
+        """Count one anomalous step; returns True when the policy
+        should still apply, raises AnomalyError when escalation is
+        due."""
+        with self._lock:
+            self.consecutive += 1
+            if self.consecutive > self.max_consecutive:
+                raise AnomalyError(
+                    f"{self.consecutive} consecutive anomalous steps "
+                    f"exceed max_consecutive={self.max_consecutive}; "
+                    f"escalating past policy {self.policy!r}")
+        return True
+
+    def note_rollback(self):
+        with self._lock:
+            self.rollbacks += 1
+            if self.rollbacks > self.max_rollbacks:
+                raise AnomalyError(
+                    f"{self.rollbacks} rollbacks exceed max_rollbacks="
+                    f"{self.max_rollbacks}; the anomaly is not transient")
+
+
+_active = None
+
+
+def enable_anomaly_guard(policy="raise", manager=None, **kw):
+    """Install a process-wide guard; compiled train steps built while
+    a guard is active carry the fused finite check (the executor's
+    compiled-fn cache keys on this, so toggling is safe)."""
+    global _active
+    _active = AnomalyGuard(policy=policy, manager=manager, **kw)
+    return _active
+
+
+def disable_anomaly_guard():
+    global _active
+    _active = None
+
+
+def active_guard():
+    return _active
+
+
+class anomaly_guard:
+    """Context-manager form, restoring the previous guard on exit."""
+
+    def __init__(self, policy="raise", manager=None, **kw):
+        self._guard = AnomalyGuard(policy=policy, manager=manager, **kw)
+
+    def __enter__(self):
+        global _active
+        self._prev = _active
+        _active = self._guard
+        return self._guard
+
+    def __exit__(self, *exc):
+        global _active
+        _active = self._prev
+        return False

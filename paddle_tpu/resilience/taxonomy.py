@@ -1,0 +1,112 @@
+"""Error taxonomy — transient vs fatal classification for retry.
+
+Parity role: the reference's trainer restart semantics distinguish
+worker deaths the fleet recovers from (pserver timeout, barrier lost,
+preempted trainer — fleet re-launches the worker) from programming
+errors that must fail the job (shape mismatch, missing var).  Here the
+same split drives the retry/backoff layer: only errors classified
+TRANSIENT are retried; everything else fails fast with the original
+traceback.
+
+Classification is TABLE-driven (not a type check buried in a retry
+loop) so new failure shapes are one row, and the table itself is
+inspectable/testable.  Two axes:
+
+- exception TYPE: connection/timeout OS errors are transient;
+  Python programming errors (TypeError, KeyError, ...) are fatal no
+  matter what their message says.
+- MESSAGE pattern: jaxlib surfaces XLA/PJRT status codes as
+  `XlaRuntimeError` with the gRPC code name in the message
+  (RESOURCE_EXHAUSTED, UNAVAILABLE, ...), so the code word — not the
+  exception type — carries the taxonomy.
+"""
+
+import re
+
+__all__ = ["TRANSIENT", "FATAL", "classify", "is_transient",
+           "InjectedTransientError", "InjectedCrash", "TAXONOMY"]
+
+TRANSIENT = "transient"
+FATAL = "fatal"
+
+
+class InjectedTransientError(RuntimeError):
+    """Synthetic device/runtime error raised by the fault-injection
+    harness; classified transient by TYPE so retry tests exercise the
+    real classification path."""
+
+
+class InjectedCrash(BaseException):
+    """Synthetic SIGKILL stand-in raised at a crash point.  Derives
+    from BaseException so no `except Exception` cleanup handler can
+    swallow it — like the real signal, nothing downstream of the crash
+    point runs (the _COMPLETE marker is never written)."""
+
+
+# message patterns for XLA/PJRT/distributed-runtime status codes and
+# preemption-shaped infrastructure failures.  Order matters: first
+# match wins, and fatal codes are listed before the broad transient
+# net so e.g. "INVALID_ARGUMENT: ... was ABORTED" stays fatal.
+_MESSAGE_RULES = (
+    # -- fatal status codes: the program itself is wrong --------------
+    (re.compile(r"\bINVALID_ARGUMENT\b"), FATAL),
+    (re.compile(r"\bFAILED_PRECONDITION\b"), FATAL),
+    (re.compile(r"\bUNIMPLEMENTED\b"), FATAL),
+    (re.compile(r"\bOUT_OF_RANGE\b"), FATAL),
+    (re.compile(r"\bPERMISSION_DENIED\b"), FATAL),
+    (re.compile(r"\bUNAUTHENTICATED\b"), FATAL),
+    # -- transient status codes: infrastructure, not the program ------
+    (re.compile(r"\bRESOURCE_EXHAUSTED\b"), TRANSIENT),
+    (re.compile(r"\bUNAVAILABLE\b"), TRANSIENT),
+    (re.compile(r"\bDEADLINE_EXCEEDED\b"), TRANSIENT),
+    (re.compile(r"\bABORTED\b"), TRANSIENT),
+    (re.compile(r"\bCANCELLED\b"), TRANSIENT),
+    # -- preemption-shaped: the platform took the device back ---------
+    (re.compile(r"preempt", re.IGNORECASE), TRANSIENT),
+    (re.compile(r"slice.*restart|restart.*slice", re.IGNORECASE), TRANSIENT),
+    (re.compile(r"socket closed|connection reset|broken pipe",
+                re.IGNORECASE), TRANSIENT),
+    (re.compile(r"coordination service.*(unavailable|error)",
+                re.IGNORECASE), TRANSIENT),
+    (re.compile(r"device.*(lost|halted|reset)", re.IGNORECASE), TRANSIENT),
+)
+
+# exception TYPES classified without looking at the message.  Python
+# programming errors fail fast even if their text happens to contain a
+# transient-looking word (an error note quoting a log line, say).
+_FATAL_TYPES = (
+    TypeError, KeyError, AttributeError, IndexError, NotImplementedError,
+    AssertionError, NameError, ImportError, SyntaxError,
+)
+_TRANSIENT_TYPES = (
+    InjectedTransientError, ConnectionError, TimeoutError, BrokenPipeError,
+)
+
+# the full inspectable table (used by the README and tests)
+TAXONOMY = {
+    "fatal_types": tuple(t.__name__ for t in _FATAL_TYPES),
+    "transient_types": tuple(t.__name__ for t in _TRANSIENT_TYPES),
+    "message_rules": tuple((p.pattern, cls) for p, cls in _MESSAGE_RULES),
+}
+
+
+def classify(exc):
+    """TRANSIENT or FATAL for one exception instance.
+
+    Precedence: transient types > fatal types > message rules > FATAL.
+    (An InjectedTransientError is a RuntimeError subclass; the type
+    check must see it before any message rule fires.)
+    """
+    if isinstance(exc, _TRANSIENT_TYPES):
+        return TRANSIENT
+    if isinstance(exc, _FATAL_TYPES):
+        return FATAL
+    msg = str(exc)
+    for pattern, cls in _MESSAGE_RULES:
+        if pattern.search(msg):
+            return cls
+    return FATAL
+
+
+def is_transient(exc):
+    return classify(exc) == TRANSIENT

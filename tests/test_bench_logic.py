@@ -187,3 +187,19 @@ def test_dispatch_overhead_in_suite_and_standalone():
     src = open(bench.__file__).read()
     assert '("dispatch_overhead", "dispatch_overhead"' in src
     assert '"dispatch_overhead" in sys.argv[1:]' in src
+
+
+# ---------------------------------------------------------------------------
+# fault_tolerance_smoke chaos row (ISSUE 4 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_tolerance_smoke_in_suite_and_standalone():
+    """The chaos row is wired into the suite AND the standalone argv
+    entry (the recovery behaviors themselves are covered end-to-end by
+    tests/test_resilience.py; re-running the whole row here would pay
+    its compiles twice per CI run for no new signal)."""
+    src = open(bench.__file__).read()
+    assert '("fault_tolerance_smoke", "fault_tolerance_smoke"' in src
+    assert '"fault_tolerance_smoke" in sys.argv[1:]' in src
+    assert "main_fault_tolerance_smoke" in src
