@@ -1145,8 +1145,11 @@ def bench_telemetry_smoke(on_tpu, peak):
             .get("temp_bytes") is not None,
             "mfu_from_cost_analysis": isinstance(
                 snap.get("mfu"), float) and snap["mfu"] > 0,
-            "jsonl_round_trip": len(monitor.read_jsonl(jsonl))
-            == len(records),
+            # step-kind lines match the in-process records (op_profile
+            # records from the compile ledger ride the same stream)
+            "jsonl_round_trip": len(
+                [r for r in monitor.read_jsonl(jsonl)
+                 if r.get("kind") == "step"]) == len(records),
         }
         row = {"metric": "telemetry_smoke",
                "value": int(all(checks.values())), "unit": "ok",
@@ -1185,6 +1188,136 @@ def main_telemetry_smoke():
                                        time.gmtime())
     doc = _load_bench_tpu() or {"rows": {}}
     doc.setdefault("rows", {})["telemetry_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
+def bench_op_profile_smoke(on_tpu, peak):
+    """Per-op attribution smoke row (ISSUE 5 CI satellite): a tiny fc
+    train loop through the PUBLIC Executor.run on the CPU mesh
+    (data-parallel when >1 host device is visible) with telemetry on,
+    asserting the attribution invariants end-to-end:
+
+    - scope-attributed FLOPs (+ the unattributed residual) sum EXACTLY
+      to the whole-program cost_analysis total, and likewise bytes;
+    - every ProgramDesc op of the compiled section appears under its
+      own scope name (executor.op_scope_names is the ground truth);
+    - the unattributed FLOPs residual is <= 1%;
+    - snapshot()["op_profile"] exposes the same rows, json-serializable.
+
+    Side effect: like telemetry_smoke, the PROCESS-GLOBAL monitor is
+    reset; standalone callers should snapshot first."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.framework.executor import op_scope_names
+
+    steps = 6
+    batch = 64
+    was_enabled = monitor.is_enabled()
+    monitor.reset()
+    monitor.enable()
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [None, 64])
+                y = fluid.data("y", [None, 1])
+                h = fluid.layers.fc(x, 64, act="relu")
+                pred = fluid.layers.fc(h, 1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(0.01).minimize(loss)
+        ndev = len(jax.devices())
+        mesh_devices = ndev if ndev > 1 and batch % ndev == 0 else 1
+        prog = main
+        if mesh_devices > 1:
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name,
+                places=mesh_devices).with_telemetry("op_profile_smoke")
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        rng = np.random.default_rng(0)
+        feed = {"x": rng.standard_normal((batch, 64)).astype(np.float32),
+                "y": rng.standard_normal((batch, 1)).astype(np.float32)}
+        for _ in range(steps):
+            exe.run(prog, feed=feed, fetch_list=[loss], scope=scope,
+                    return_numpy=False)
+
+        split = monitor.op_profile_split()
+        snap = monitor.snapshot()
+        expected = {s for s, _ in op_scope_names(prog, [loss.name])}
+        checks = {"split_present": split is not None}
+        if split is not None:
+            scopes = split["scopes"]
+            flops_sum = sum(d["flops"] for d in scopes.values()) \
+                + split["unattributed"]["flops"]
+            bytes_sum = sum(d["bytes_accessed"]
+                            for d in scopes.values()) \
+                + split["unattributed"]["bytes_accessed"]
+            checks.update({
+                # exact: split_by_scope assigns the float remainder, so
+                # == (not approx) is the contract under test
+                "flops_sum_exact": flops_sum == split["totals"]["flops"]
+                and split["totals"]["flops"] > 0,
+                "bytes_sum_exact": bytes_sum
+                == split["totals"]["bytes_accessed"],
+                "all_ops_scoped": expected <= set(scopes),
+                "residual_under_1pct":
+                    split["unattributed"]["flops_pct"] <= 1.0,
+                "snapshot_rows": bool(snap.get("op_profile"))
+                and json.dumps(snap["op_profile"]) is not None,
+            })
+            if not checks["all_ops_scoped"]:
+                checks["missing_scopes"] = sorted(expected
+                                                  - set(scopes))[:8]
+        ok = all(v for k, v in checks.items()
+                 if isinstance(v, bool))
+        row = {"metric": "op_profile_smoke", "value": int(ok),
+               "unit": "ok", "vs_baseline": None,
+               "mesh_devices": mesh_devices,
+               "program_ops": len(expected),
+               "attributed_scopes": len(split["scopes"]) if split else 0,
+               "unattributed_flops_pct": round(
+                   split["unattributed"]["flops_pct"], 4) if split
+               else None,
+               "checks": checks,
+               "telemetry": _telemetry_brief(snap)}
+        if not ok:
+            row["error"] = "failed checks: " + ", ".join(
+                k for k, v in checks.items()
+                if isinstance(v, bool) and not v)
+        return row
+    finally:
+        monitor.disable()
+        monitor.reset()
+        if was_enabled:
+            monitor.enable()
+
+
+def main_op_profile_smoke():
+    """`python bench.py op_profile_smoke` — CI/tooling entry: the
+    attribution smoke row standalone on a 2-device virtual CPU mesh,
+    persisted to BENCH_TPU.json under rows["op_profile_smoke"].  Exit 0
+    only when every attribution invariant holds."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=2")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_op_profile_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["op_profile_smoke"] = row
     _save_bench_tpu(doc)
     print(json.dumps(r), flush=True)
     return 0 if r.get("value") == 1 else 1
@@ -1544,6 +1677,7 @@ def main():
         ("bert_chunked_ce", "bert_chunked_ce_mfu", bench_bert_chunked_ce),
         ("dispatch_overhead", "dispatch_overhead", bench_dispatch_overhead),
         ("telemetry_smoke", "telemetry_smoke", bench_telemetry_smoke),
+        ("op_profile_smoke", "op_profile_smoke", bench_op_profile_smoke),
         ("fault_tolerance_smoke", "fault_tolerance_smoke",
          bench_fault_tolerance_smoke),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
@@ -1612,6 +1746,8 @@ if __name__ == "__main__":
         sys.exit(main_dispatch_overhead())
     if "telemetry_smoke" in sys.argv[1:]:
         sys.exit(main_telemetry_smoke())
+    if "op_profile_smoke" in sys.argv[1:]:
+        sys.exit(main_op_profile_smoke())
     if "fault_tolerance_smoke" in sys.argv[1:]:
         sys.exit(main_fault_tolerance_smoke())
     main()

@@ -123,6 +123,19 @@ declare_flag("conv_matmul_precision", "",
              "Default matmul/conv precision for compiled steps "
              "('', 'bfloat16', 'tensorfloat32', 'float32', 'highest').")
 
+# Always-on flight recorder (monitor/flight_recorder.py): a bounded
+# ring of recent step records, compile events and recovery events that
+# costs one deque append per step while healthy and writes a
+# post-mortem JSONL + chrome trace on crash / unhandled exception /
+# anomaly-guard escalation.  FLAGS_flight_recorder=0 disables all of
+# it (recording AND dumps).
+declare_flag("flight_recorder", True,
+             "Keep the always-on post-mortem ring buffer recording.")
+declare_flag("flight_recorder_steps", 256,
+             "How many recent step records the flight recorder keeps.")
+declare_flag("flight_recorder_dir", "/tmp/paddle_tpu_flight",
+             "Directory flight-recorder post-mortem dumps land in.")
+
 declare_flag("maxpool_mask_bwd", False,
              "Give max-pool a recompute-mask custom VJP (window passes "
              "+ shifted compares, all XLA-fusable) instead of the "

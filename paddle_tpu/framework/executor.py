@@ -36,6 +36,8 @@ from .program import Variable, default_main_program
 _profiler = None
 _monitor = None
 _resilience = None
+_op_sampler_slot = None
+_flight = None
 
 
 def _dispatch_span(name):
@@ -75,6 +77,30 @@ def _res():
 
         _resilience = resilience
     return _resilience
+
+
+def _sampler():
+    """Active per-op sampler (monitor.op_profile.sampling scope) or
+    None — resolved through the module's single-slot list so the
+    interpreter loop pays one list load per op while sampling is off."""
+    global _op_sampler_slot
+    if _op_sampler_slot is None:
+        from ..monitor import op_profile
+
+        _op_sampler_slot = op_profile._ACTIVE
+    return _op_sampler_slot[0]
+
+
+def _fr():
+    """The always-on flight recorder (monitor.flight_recorder): a
+    bounded ring of step/compile/recovery records that costs one deque
+    append per step while healthy and dumps a post-mortem on crash."""
+    global _flight
+    if _flight is None:
+        from ..monitor import flight_recorder
+
+        _flight = flight_recorder.get()
+    return _flight
 
 
 def _materialize(fetches):
@@ -474,8 +500,22 @@ _CONTROL_FLOW_OPS = {
 }
 
 
-def run_op(op, env, rng_box, const_env=None):
-    """Execute one recorded op against env (used at trace time)."""
+def run_op(op, env, rng_box, const_env=None, scope=None):
+    """Execute one recorded op against env (used at trace time).
+
+    With `scope` ("{section}/{op_type}_{idx}", see op_scopes), the
+    whole emission — control-flow sub-traces included — runs inside
+    jax.named_scope(scope), so every HLO instruction this op stages
+    carries its ProgramDesc identity in metadata.op_name (the
+    provenance monitor.op_profile attributes device cost by).  Pure
+    trace-time cost: compiled steps never re-enter here."""
+    if scope is not None:
+        with jax.named_scope(scope):
+            return _run_op(op, env, rng_box, const_env)
+    return _run_op(op, env, rng_box, const_env)
+
+
+def _run_op(op, env, rng_box, const_env=None):
     if op.type in _CONTROL_FLOW_OPS:
         _CONTROL_FLOW_OPS[op.type](op, env, rng_box, const_env)
         return
@@ -560,9 +600,76 @@ def run_op(op, env, rng_box, const_env=None):
             pass
 
 
-def interpret(ops, env, rng_box, const_env=None):
+def interpret(ops, env, rng_box, const_env=None, scopes=None,
+              allow_sampling=True):
+    """Run `ops` in order.  `scopes` maps id(op) -> scope name (built
+    once per program by op_scopes); while a monitor.op_profile sampler
+    is active (the eager/dygraph sampling mode), each op is wall-timed
+    with block_until_ready on its outputs and recorded under its scope
+    — plus a profiler span when a profiling session is on, so the
+    chrome trace grows per-op rows.  allow_sampling=False marks a
+    jit-STAGING caller (_make_step_fn): its per-op durations would be
+    pure trace time masquerading as measurements, so the sampler is
+    bypassed there even when active."""
+    sampler = _sampler() if allow_sampling else None
+    if sampler is None:
+        for op in ops:
+            run_op(op, env, rng_box, const_env,
+                   scopes.get(id(op)) if scopes else None)
+        return
+    global _profiler
+    if _profiler is None:
+        from .. import profiler
+
+        _profiler = profiler
     for op in ops:
-        run_op(op, env, rng_box, const_env)
+        scope = (scopes.get(id(op)) if scopes else None) \
+            or f"main/{op.type}"
+        t0 = time.perf_counter_ns()
+        run_op(op, env, rng_box, const_env, scope)
+        outs = [env[n] for n in op.output_names() if n in env]
+        try:
+            # concrete arrays block until device-done (the honest per-op
+            # time); tracers under an autodiff/jit trace have nothing to
+            # block on and record host dispatch time instead
+            jax.block_until_ready(outs)
+        except Exception:
+            pass
+        t1 = time.perf_counter_ns()
+        sampler.note(scope, (t1 - t0) / 1e3)
+        _profiler.add_span(scope, t0, t1)
+
+
+def op_scopes(ops, sections):
+    """Deterministic per-op scope names for one live-op list:
+    "{section}/{op_type}_{idx}" with idx the op's position in the list
+    and section fwd<k> for ops feeding backward section k, update for
+    ops after the last section (optimizer/stats), main when the
+    program has no backward sections.  Derived from program structure
+    alone, so names are STABLE across recompiles of the same program
+    (the property the attribution tests pin)."""
+    section_ends = [(bs.pos, f"fwd{k}") for k, bs in enumerate(sections)]
+    tail = "update" if sections else "main"
+    names = []
+    for i, op in enumerate(ops):
+        prefix = tail
+        for pos, name in section_ends:
+            if i < pos:
+                prefix = name
+                break
+        names.append(f"{prefix}/{op.type}_{i}")
+    return names
+
+
+def op_scope_names(program, fetch_names=()):
+    """Public provenance map for one program: [(scope, op)] in
+    execution order, exactly the scopes the compiled step will emit —
+    what monitor.op_profile checks attribution coverage against."""
+    if hasattr(program, "_get_executable_program"):
+        program = program._get_executable_program()
+    ops = Executor._live_ops(program, list(fetch_names))
+    sections = [] if program._is_test else list(program.backward_sections)
+    return list(zip(op_scopes(ops, sections), ops))
 
 
 def _checkpoint_chunks(seg, checkpoint_names):
@@ -689,7 +796,10 @@ class Executor:
         program = program if program is not None else default_main_program()
         mon = _mon()
         mon_on = mon.is_enabled()
-        t0 = time.perf_counter_ns() if mon_on else 0
+        # t0 is read unconditionally: the always-on flight recorder's
+        # minimal step record wants the dispatch time too (one clock
+        # read — far under the <2% fast-path budget)
+        t0 = time.perf_counter_ns()
         # CompiledProgram / parallel wrapper support
         dp_mesh = None
         precision = resolve_precision(program)
@@ -756,12 +866,17 @@ class Executor:
                 lambda: self._run_eager(program, feed_arrays, fetch_names,
                                         scope, run_key, return_numpy),
                 precision)()
+            step_rec = None
             if mon_on:
                 # the debug interpreter EXECUTES inline — elapsed time
                 # here is execution, not dispatch, so no
                 # host_dispatch_us is recorded (it would contaminate
                 # the dispatch aggregates ~1000x)
-                self._record_step_metrics(mon, None, feed_arrays, out)
+                step_rec = self._record_step_metrics(mon, None,
+                                                     feed_arrays, out)
+            fr = _fr()
+            if fr.enabled:
+                fr.note_step(step_rec)
             return out
 
         with _dispatch_span("executor.run.state"):
@@ -830,6 +945,14 @@ class Executor:
         if fresh_compile:
             if mon_on:
                 mon.counter("compiled_step.miss").add(1)
+            else:
+                # with telemetry on, the compile ledger mirrors its
+                # (fully analyzed) event into the recorder; off, this
+                # marker still timestamps the recompile in a post-mortem
+                fr = _fr()
+                if fr.enabled:
+                    fr.note_compile_marker(
+                        telemetry_key or "prog%x" % id(program))
             with _dispatch_span("executor.run.trace"):
                 compiled = self._build(program, fetch_names,
                                        plan.persist_names, dp_mesh=dp_mesh,
@@ -883,14 +1006,25 @@ class Executor:
             # caller's fetch list never see it
             guard_flag = fetches[-1]
             fetches = fetches[:-1]
+        step_rec = None
         if mon_on:
             # recorded BEFORE any materialization so host_dispatch_us is
             # the pure dispatch cost; fetch bytes read from the device
             # array metadata (no sync).  A step that paid trace+compile
             # is tagged warmup so it can't skew the steady-state
             # aggregates (mean step time / dispatch μs / MFU).
-            self._record_step_metrics(mon, t0, feed_arrays, fetches,
-                                      warmup=fresh_compile)
+            step_rec = self._record_step_metrics(mon, t0, feed_arrays,
+                                                 fetches,
+                                                 warmup=fresh_compile)
+        fr = _fr()
+        if fr.enabled:
+            # always-on: with telemetry enabled the ring shares the
+            # session's record; without it, a minimal record (one dict
+            # + deque append) keeps the post-mortem window alive
+            fr.note_step(step_rec,
+                         host_dispatch_us=(time.perf_counter_ns() - t0)
+                         / 1e3,
+                         warmup=fresh_compile)
         if guard_flag is not None:
             # ONE host sync per guarded step (the policy decision needs
             # the scalar): the price of the guard, paid only when it is
@@ -916,7 +1050,9 @@ class Executor:
         dispatch phase), examples (leading feed dim), feed/fetch bytes.
         Wall step time is derived by the session from the gap between
         consecutive records; warmup=True marks a run that paid
-        trace+compile (excluded from steady-state means)."""
+        trace+compile (excluded from steady-state means).  Returns the
+        session record so the flight recorder can share it (one dict
+        in both rings, no duplicate bookkeeping)."""
         examples = 0
         feed_bytes = 0
         for a in feed_arrays.values():
@@ -926,7 +1062,7 @@ class Executor:
                 examples = max(examples, int(shape[0]))
         fetch_bytes = sum(int(getattr(f, "nbytes", 0) or 0)
                           for f in fetches)
-        mon.record_step(
+        return mon.record_step(
             host_dispatch_us=(None if t0 is None
                               else (time.perf_counter_ns() - t0) / 1e3),
             examples=examples or None, feed_bytes=feed_bytes,
@@ -948,6 +1084,9 @@ class Executor:
         mon = _mon()
         if mon.is_enabled():
             mon.counter("resilience.anomaly_steps").add(1)
+        fr = _fr()
+        if fr.enabled:
+            fr.note_event("anomaly", policy=guard.policy)
         guard.note_anomaly()         # escalates past max_consecutive
         guard.last_skipped = False
         if guard.policy == "raise":
@@ -994,6 +1133,8 @@ class Executor:
             self._root_key = jnp.asarray(extras["executor_rng_key"])
         if mon.is_enabled():
             mon.counter("resilience.rollbacks").add(1)
+        if fr.enabled:
+            fr.note_event("rollback", checkpoint_step=ck_step)
         raise res.RollbackPerformed(ck_step)
 
     # ------------------------------------------------------------------
@@ -1297,6 +1438,10 @@ class Executor:
                 # handler must stay async-signal-safe.)
                 if mon.is_enabled():
                     mon.counter("resilience.preempt_requested").add(1)
+                fr = _fr()
+                if fr.enabled:
+                    fr.note_event("preemption", step=step_i,
+                                  checkpointed=mgr is not None)
                 if mgr is None:
                     # stopping is still right, but a checkpoint-less
                     # loop can't consume the flag (an enclosing
@@ -1501,8 +1646,10 @@ class Executor:
 
                 def dp_step_shaped(state, feeds, key):
                     new_state, fetches = dp_step(state, feeds, key)
-                    fetches = [f if r >= 1 else jax.lax.pmean(f, "dp")
-                               for f, r in zip(fetches, fetch_ranks)]
+                    with jax.named_scope("update/dp_fetch_sync_0"):
+                        fetches = [f if r >= 1
+                                   else jax.lax.pmean(f, "dp")
+                                   for f, r in zip(fetches, fetch_ranks)]
                     return new_state, fetches
 
                 out_fetch_specs = [
@@ -1528,6 +1675,11 @@ class Executor:
         for bs in sections:
             param_names.update(bs.param_names)
         feed_casts = feed_casts or {}
+        # ProgramDesc provenance: every op's kernel emission is wrapped
+        # in jax.named_scope at trace time (see run_op), so the lowered
+        # HLO carries per-op attribution metadata at zero runtime cost
+        scopes = {id(op): name
+                  for op, name in zip(ops, op_scopes(ops, sections))}
         if guard_on:
             from ..resilience.guard import all_finite as _all_finite_tree
 
@@ -1544,7 +1696,7 @@ class Executor:
             const_env = {}
             rng_box = _RngBox(key)
             pos = 0
-            for bs in sections:
+            for sec_i, bs in enumerate(sections):
                 seg = ops[pos:bs.pos]
                 train_params = {
                     n: env[n] for n in bs.param_names if n in env
@@ -1563,13 +1715,15 @@ class Executor:
                             def run_chunk(e_in, k, _c=chunk):
                                 e2 = dict(e_in)
                                 b = _RngBox(k)
-                                interpret(_c, e2, b, const_env)
+                                interpret(_c, e2, b, const_env, scopes,
+                                          allow_sampling=False)
                                 return e2, b.key
 
                             e, box_key = jax.checkpoint(run_chunk)(e, box_key)
                         else:
                             b = _RngBox(box_key)
-                            interpret(chunk, e, b, const_env)
+                            interpret(chunk, e, b, const_env, scopes,
+                                      allow_sampling=False)
                             box_key = b.key
                     loss = e[_loss]
                     return jnp.sum(loss), (e, box_key)
@@ -1586,13 +1740,20 @@ class Executor:
                     # check costs no extra dispatch
                     finite = finite & jnp.isfinite(loss_val) \
                         & _all_finite_tree(grads)
-                for n, g in grads.items():
-                    # DP gradient sync — the one collective the reference
-                    # inserts as allreduce op-handles
-                    # (multi_devices_graph_pass.cc:446)
-                    env[n + "@GRAD"] = jax.lax.pmean(g, "dp") if dp else g
+                # DP gradient sync — the one collective the reference
+                # inserts as allreduce op-handles
+                # (multi_devices_graph_pass.cc:446).  Framework-inserted
+                # (no ProgramDesc op to blame), so it gets its OWN
+                # attribution scope: on a dp mesh the allreduce is real
+                # device time and must not land in the unattributed
+                # residual.
+                with jax.named_scope(f"fwd{sec_i}/dp_grad_sync_{sec_i}"):
+                    for n, g in grads.items():
+                        env[n + "@GRAD"] = jax.lax.pmean(g, "dp") \
+                            if dp else g
                 pos = bs.pos
-            interpret(ops[pos:], env, rng_box, const_env)
+            interpret(ops[pos:], env, rng_box, const_env, scopes,
+                      allow_sampling=False)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in persist_names if n in env}
             if dp:
@@ -1601,28 +1762,32 @@ class Executor:
                 # (batch-norm running stats) diverge with the local shard
                 # -> average, SyncBN-style. Integer state (counters) is
                 # identical across devices and must NOT go through pmean
-                # (true division would float-ify it).
-                new_state = {
-                    n: (jax.lax.pmean(v, "dp")
-                        if n not in param_names and jnp.issubdtype(
-                            jnp.asarray(v).dtype, jnp.floating)
-                        else v)
-                    for n, v in new_state.items()}
+                # (true division would float-ify it).  Scoped like the
+                # grad sync: framework collective, own attribution row.
+                with jax.named_scope("update/dp_state_sync_0"):
+                    new_state = {
+                        n: (jax.lax.pmean(v, "dp")
+                            if n not in param_names and jnp.issubdtype(
+                                jnp.asarray(v).dtype, jnp.floating)
+                            else v)
+                        for n, v in new_state.items()}
             if guard_on:
-                # the flag travels as float32 so the dp fetch pmean
-                # averages it: ANY shard's anomaly pulls it below 1.0
-                flag = finite.astype(jnp.float32)
-                if dp:
-                    flag = jax.lax.pmean(flag, "dp")
-                ok = flag >= 1.0
-                # an anomalous step commits NOTHING: select the old
-                # state on device (same contract as the AMP scaler's
-                # skip-on-overflow).  XLA copies where donation would
-                # alias — correctness first, the guard is opt-in.
-                new_state = {
-                    n: (jnp.where(ok, jnp.asarray(v), jnp.asarray(state[n]))
-                        if n in state else v)
-                    for n, v in new_state.items()}
+                with jax.named_scope("update/guard_check_0"):
+                    # the flag travels as float32 so the dp fetch pmean
+                    # averages it: ANY shard's anomaly pulls it below 1.0
+                    flag = finite.astype(jnp.float32)
+                    if dp:
+                        flag = jax.lax.pmean(flag, "dp")
+                    ok = flag >= 1.0
+                    # an anomalous step commits NOTHING: select the old
+                    # state on device (same contract as the AMP scaler's
+                    # skip-on-overflow).  XLA copies where donation would
+                    # alias — correctness first, the guard is opt-in.
+                    new_state = {
+                        n: (jnp.where(ok, jnp.asarray(v),
+                                      jnp.asarray(state[n]))
+                           if n in state else v)
+                        for n, v in new_state.items()}
                 fetches = fetches + [flag]
             return new_state, fetches
 
@@ -1643,24 +1808,29 @@ class Executor:
         rng_box = _RngBox(key)
         ops = self._live_ops(program, fetch_names)
         sections = [] if program._is_test else list(program.backward_sections)
+        scopes = {id(op): name
+                  for op, name in zip(ops, op_scopes(ops, sections))}
         pos = 0
         persist = {v.name for v in program.list_vars() if v.persistable}
 
         def run_seg(seg):
+            if not check:
+                # the sampling-aware loop: per-op timing when a
+                # monitor.op_profile sampler is active
+                interpret(seg, env, rng_box, None, scopes)
+                return
             for op in seg:
-                before = set(env)
-                run_op(op, env, rng_box)
-                if check:
-                    for slot, names in op.outputs.items():
-                        for n in names:
-                            if n in env and jnp.issubdtype(
-                                jnp.asarray(env[n]).dtype, jnp.floating
-                            ):
-                                if not bool(jnp.all(jnp.isfinite(env[n]))):
-                                    raise FloatingPointError(
-                                        f"op '{op.type}' output '{n}' "
-                                        f"contains NaN/Inf"
-                                    )
+                run_op(op, env, rng_box, None, scopes.get(id(op)))
+                for slot, names in op.outputs.items():
+                    for n in names:
+                        if n in env and jnp.issubdtype(
+                            jnp.asarray(env[n]).dtype, jnp.floating
+                        ):
+                            if not bool(jnp.all(jnp.isfinite(env[n]))):
+                                raise FloatingPointError(
+                                    f"op '{op.type}' output '{n}' "
+                                    f"contains NaN/Inf"
+                                )
 
         for bs in sections:
             seg = ops[pos:bs.pos]
@@ -1670,7 +1840,7 @@ class Executor:
                 e = dict(_env)
                 e.update(ps)
                 box = _RngBox(_key)
-                interpret(_seg, e, box)
+                interpret(_seg, e, box, None, scopes)
                 return jnp.sum(e[bs.loss_name]), (e, box.key)
 
             (loss_val, (env, new_key)), grads = jax.value_and_grad(

@@ -34,12 +34,16 @@ from .compile_ledger import (CompileLedger, PEAK_FLOPS, peak_flops,
 from .jsonl_writer import JsonlWriter, read_jsonl
 from .registry import Counter, Gauge, MetricsRegistry
 from .session import MetricsSession
+from . import op_profile                                  # noqa: F401
+from . import flight_recorder  # noqa: F401  — installs crash hooks
 
 __all__ = [
     "enable", "disable", "is_enabled", "snapshot", "reset",
     "counter", "gauge", "record_step", "observe_steps", "record_compile",
     "aot_compile", "instrument_jit", "mfu", "step_records",
     "compile_events", "jsonl_path", "merged_trace_events",
+    "op_table", "op_profile_split", "op_profile", "flight_recorder",
+    "flight_dump",
     "MetricsRegistry", "MetricsSession", "CompileLedger", "JsonlWriter",
     "read_jsonl", "Counter", "Gauge", "PEAK_FLOPS", "peak_flops",
     "parse_cost_analysis", "parse_memory_analysis",
@@ -50,6 +54,9 @@ __all__ = [
 _registry = MetricsRegistry()
 _ledger = CompileLedger(_registry)
 _session = MetricsSession(_registry, _ledger)
+# op-profile splits computed at compile time ride the telemetry JSONL
+# stream as kind="op_profile" records (step numbering stays step-only)
+_ledger.set_aux_sink(_session.emit_record)
 _enabled = False
 
 
@@ -77,11 +84,15 @@ def is_enabled():
 
 
 def reset():
-    """Drop all recorded telemetry: step records, compile events, and
-    every counter/gauge (in place — held handles stay valid)."""
+    """Drop all recorded telemetry: step records, compile events,
+    per-op samples, and every counter/gauge (in place — held handles
+    stay valid).  The flight recorder's ring is NOT cleared: it is an
+    independent always-on post-mortem window (clear it explicitly with
+    flight_recorder.get().clear())."""
     _session.clear()
     _ledger.clear()
     _registry.reset()
+    op_profile.clear_samples()
 
 
 # -- recording entry points (no-ops while disabled) ---------------------
@@ -151,23 +162,56 @@ def mfu(step_time_s=None, key=None, peak=None):
     return _ledger.mfu(step_time_s, key=key, peak=peak)
 
 
+def op_profile_split(key=None):
+    """The newest per-op static attribution (monitor/op_profile.py
+    split structure: totals, per-scope FLOPs/bytes, unattributed
+    residual), optionally restricted to compile-ledger key `key`.
+    None until a compile has been analyzed."""
+    for e in reversed(_ledger.events()):
+        if key is not None and e.get("key") != key:
+            continue
+        if e.get("op_profile"):
+            return e["op_profile"]
+    return None
+
+
+def op_table(key=None):
+    """Fluid-parity per-op rows: the static FLOPs/bytes split merged
+    with any sampled per-op timings — what stop_profiler prints and
+    snapshot() embeds."""
+    return op_profile.op_table(static=op_profile_split(key),
+                               sampled=op_profile.sampled_rows(),
+                               step_time_s=_session.mean_step_time())
+
+
+def flight_dump(reason="manual"):
+    """Force a flight-recorder post-mortem dump now; returns the JSONL
+    path (None when the recorder is disabled)."""
+    return flight_recorder.dump(reason)
+
+
 def snapshot():
-    """Point-in-time telemetry snapshot — scalars only, json.dump-safe:
-    session aggregates (steps, step_time_s, host_dispatch_us,
-    examples/s, byte totals), the full counter/gauge registry, the
-    compile ledger summary (count, time, FLOPs, memory bytes), and the
-    derived MFU."""
+    """Point-in-time telemetry snapshot — json.dump-safe: session
+    aggregates (steps, step_time_s, host_dispatch_us, examples/s, byte
+    totals), the full counter/gauge registry, the compile ledger
+    summary (count, time, FLOPs, memory bytes), the derived MFU, and —
+    once a compile has been attributed — the per-op profile rows."""
     out = _session.snapshot()
     out.update(_registry.snapshot())
     out["compile"] = _ledger.summary()
     out["mfu"] = mfu()
+    rows = op_table()
+    if rows:
+        out["op_profile"] = rows
     return out
 
 
 def merged_trace_events(host_events):
     """Build the unified trace event list from the profiler's host
-    spans plus this session's step records and compile events."""
+    spans plus this session's step records, compile events, and gauge
+    time-series tracks."""
     from .trace import merged_trace_events as _merge
 
     return _merge(host_events, step_records=_session.records(),
-                  compile_events=_ledger.events())
+                  compile_events=_ledger.events(),
+                  gauge_series=_registry.gauge_series())

@@ -109,10 +109,17 @@ class CompileLedger:
         self._registry = registry
         self._lock = threading.Lock()
         self._events = []
+        # optional JSONL sink for auxiliary (non-step) records: the
+        # monitor wires this to MetricsSession.emit_record so per-op
+        # attribution splits land in the same telemetry stream
+        self._aux_sink = None
+
+    def set_aux_sink(self, sink):
+        self._aux_sink = sink
 
     # -- recording ------------------------------------------------------
     def record(self, key, compile_s, flops=None, bytes_accessed=None,
-               memory=None, trace_s=None, source="aot"):
+               memory=None, trace_s=None, source="aot", op_profile=None):
         event = {
             "kind": "compile",
             "key": key,
@@ -129,6 +136,8 @@ class CompileLedger:
             event["bytes_accessed"] = bytes_accessed
         if memory is not None:
             event["memory"] = memory
+        if op_profile is not None:
+            event["op_profile"] = op_profile
         with self._lock:
             self._events.append(event)
         self._registry.counter("compile.count").add(1)
@@ -137,6 +146,22 @@ class CompileLedger:
         live = live_bytes(memory)
         if live is not None:
             self._registry.gauge("compile.live_bytes").set(live)
+        try:
+            from . import flight_recorder
+
+            # mirror into the always-on post-mortem ring (full analysis
+            # attached); the recorder also keeps the newest attribution
+            # split as its "what was the step made of" section
+            flight_recorder.get().note_compile(event)
+            if op_profile is not None:
+                flight_recorder.get().note_op_table(op_profile)
+        except Exception:
+            pass
+        if op_profile is not None and self._aux_sink is not None:
+            self._aux_sink({"kind": "op_profile", "key": key,
+                            "ts_us": event["ts_us"],
+                            "wall_time": event["wall_time"],
+                            **op_profile})
         return event
 
     def events(self):
@@ -172,9 +197,20 @@ class CompileLedger:
             memory = parse_memory_analysis(compiled.memory_analysis())
         except Exception:
             memory = None
+        try:
+            # per-op attribution: parse the optimized HLO's named-scope
+            # metadata and split the cost-analysis totals per ProgramDesc
+            # op (monitor/op_profile.py).  A one-time cost per compile —
+            # milliseconds of text parsing next to seconds of XLA.
+            from .op_profile import static_split
+
+            op_profile = static_split(compiled)
+        except Exception:
+            op_profile = None
         self.record(key, compile_s=t2 - t1, trace_s=t1 - t0,
                     flops=cost["flops"],
-                    bytes_accessed=cost["bytes_accessed"], memory=memory)
+                    bytes_accessed=cost["bytes_accessed"], memory=memory,
+                    op_profile=op_profile)
         return compiled
 
     def instrument_jit(self, jitfn, key="jit", is_enabled=None):

@@ -1,0 +1,321 @@
+"""Always-on flight recorder (ISSUE 5, second pillar).
+
+PR 4's resilience layer *recovers* from faults; this module *explains*
+them.  While a process is healthy the recorder costs almost nothing —
+a fixed-size ring of the last K step records, recent compile events,
+and recovery events (each one dict append, no I/O, no syncs) — and the
+moment a run dies it writes a post-mortem:
+
+- ``flight_<pid>.jsonl``      — meta (reason/time), the full
+  counter/gauge registry snapshot plus the recorder's own (telemetry-
+  gate-free) event counters, the last op-attribution table, compile
+  events, recovery events, and the last K step records.
+- ``flight_<pid>.trace.json`` — the same window as a chrome trace
+  (monitor/trace.py builder), so the final seconds open in Perfetto.
+
+Dump triggers, wired through the resilience taxonomy paths:
+
+- **unhandled exception** — a ``sys.excepthook`` wrapper (chains to the
+  previous hook; SystemExit excluded).
+- **anomaly-guard escalation** — ``guard.note_anomaly``/``note_rollback``
+  dump before raising AnomalyError, and ``RetriesExhausted`` dumps in
+  retry.py: these are usually caught by driver code, so waiting for the
+  excepthook would lose the window.
+- **injected crash** — ``faultinject.crash_point`` dumps before raising
+  InjectedCrash (the SIGKILL stand-in; a real SIGKILL can't dump, the
+  simulation records what the kill interrupted).
+- **atexit backstop** — if a severe event was recorded but nothing
+  dumped since (error swallowed, then sys.exit), the exit handler
+  writes the dump; clean exits write nothing.
+
+FLAGS_flight_recorder=0 turns the whole machinery off;
+FLAGS_flight_recorder_steps sizes the ring;
+FLAGS_flight_recorder_dir places the dumps.
+"""
+
+import atexit
+import collections
+import json
+import os
+import sys
+import threading
+import time
+
+from .. import flags
+
+__all__ = ["FlightRecorder", "get", "dump", "note_event",
+           "install_hooks"]
+
+
+class FlightRecorder:
+    """Bounded post-mortem ring: steps + compiles + recovery events."""
+
+    def __init__(self, capacity=None):
+        # None -> follow FLAGS_flight_recorder live (fluid.set_flags at
+        # runtime works); a bool set via the property pins it
+        self._enabled_override = None
+        cap = int(capacity or flags.flag("flight_recorder_steps"))
+        self._lock = threading.Lock()
+        self._steps = collections.deque(maxlen=cap)
+        self._compiles = collections.deque(maxlen=64)
+        self._events = collections.deque(maxlen=128)
+        # telemetry-gate-free counters: resilience counters in the
+        # monitor registry only move while monitor.is_enabled(); a
+        # post-mortem must count recovery events even with telemetry off
+        self._counters = {}
+        self._last_op_table = None
+        self._step_seq = 0
+        self._last_step_ns = None
+        self._dirty = None        # severe-event reason awaiting a dump
+        self._last_dump = None
+
+    @property
+    def enabled(self):
+        """Live view of FLAGS_flight_recorder (so a runtime
+        fluid.set_flags({"FLAGS_flight_recorder": 0}) really disables
+        recording AND dumps), unless explicitly pinned by assignment."""
+        if self._enabled_override is not None:
+            return self._enabled_override
+        return bool(flags.flag("flight_recorder"))
+
+    @enabled.setter
+    def enabled(self, value):
+        self._enabled_override = bool(value)
+
+    # -- recording (hot path: keep allocation-only) ---------------------
+    def note_step(self, record=None, host_dispatch_us=None, warmup=False):
+        """One executor step.  With telemetry on, `record` is the
+        MetricsSession's own dict (shared, not copied); otherwise a
+        minimal record is built here — the only steady-state cost the
+        recorder adds to a telemetry-off run."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._step_seq += 1
+            now_ns = time.perf_counter_ns()
+            if record is None:
+                record = {"kind": "step", "step": self._step_seq,
+                          "ts_us": now_ns / 1e3}
+                if self._last_step_ns is not None:
+                    record["step_time_s"] = (now_ns - self._last_step_ns) \
+                        / 1e9
+                if host_dispatch_us is not None:
+                    record["host_dispatch_us"] = round(host_dispatch_us, 1)
+                if warmup:
+                    record["warmup"] = True
+            self._last_step_ns = now_ns
+            self._steps.append(record)
+
+    def note_compile(self, event):
+        """Mirror one compile-ledger event (full cost/memory analysis
+        attached) into the ring."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._compiles.append(event)
+
+    def note_compile_marker(self, key):
+        """Timestamp-only recompile marker for telemetry-off runs."""
+        if not self.enabled:
+            return
+        self.note_compile({"kind": "compile", "key": key,
+                           "ts_us": time.perf_counter_ns() / 1e3,
+                           "wall_time": time.time(),
+                           "compile_ms": 0.0, "source": "marker"})
+
+    def note_event(self, kind, severe=False, **fields):
+        """One recovery/diagnostic event (anomaly, retry, rollback,
+        injection, preemption).  severe=True arms the atexit backstop:
+        the process should not exit without a dump after this."""
+        if not self.enabled:
+            return
+        ev = {"kind": "event", "event": kind,
+              "ts_us": time.perf_counter_ns() / 1e3,
+              "wall_time": time.time()}
+        ev.update(fields)
+        with self._lock:
+            self._events.append(ev)
+            self._counters[kind] = self._counters.get(kind, 0) + 1
+            if severe:
+                self._dirty = kind
+
+    def note_op_table(self, split):
+        """Latest per-op attribution (the op_profile.static_split
+        structure: totals/scopes/unattributed) — the 'what was the
+        step made of' section of a post-mortem."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._last_op_table = split
+
+    # -- reading --------------------------------------------------------
+    def snapshot(self):
+        with self._lock:
+            return {
+                "steps": list(self._steps),
+                "compiles": list(self._compiles),
+                "events": list(self._events),
+                "counters": dict(self._counters),
+                "op_table": self._last_op_table,
+                "step_seq": self._step_seq,
+            }
+
+    def clear(self):
+        with self._lock:
+            self._steps.clear()
+            self._compiles.clear()
+            self._events.clear()
+            self._counters.clear()
+            self._last_op_table = None
+            self._step_seq = 0
+            self._last_step_ns = None
+            self._dirty = None
+
+    # -- the post-mortem ------------------------------------------------
+    def dump(self, reason, directory=None):
+        """Write the JSONL + chrome-trace pair; returns the JSONL path
+        (None when disabled).  Never raises: a post-mortem writer that
+        can kill the process it is explaining is worse than none."""
+        if not self.enabled:
+            return None
+        try:
+            return self._dump(reason, directory)
+        except Exception as e:  # noqa: BLE001
+            try:
+                print(f"[paddle_tpu.flight_recorder] dump failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+            except Exception:
+                pass
+            return None
+
+    def _dump(self, reason, directory=None):
+        directory = directory or flags.flag("flight_recorder_dir")
+        os.makedirs(directory, exist_ok=True)
+        snap = self.snapshot()
+        from .jsonl_writer import _json_default
+
+        # stable per-process paths: successive dumps overwrite with the
+        # newer (larger) window — "a single post-mortem", not a spray
+        base = os.path.join(directory, f"flight_{os.getpid()}")
+        jsonl_path = base + ".jsonl"
+        trace_path = base + ".trace.json"
+        registry = {}
+        try:
+            from .. import monitor
+
+            registry = monitor._registry.snapshot()
+        except Exception:
+            pass
+        lines = [{"kind": "meta", "reason": reason,
+                  "wall_time": time.time(), "pid": os.getpid(),
+                  "argv": list(sys.argv), "step_seq": snap["step_seq"]},
+                 {"kind": "counters", "registry": registry,
+                  "recorder": snap["counters"]}]
+        if snap["op_table"]:
+            # SAME record shape as the telemetry JSONL's op_profile
+            # lines (top-level totals/scopes/unattributed), so
+            # tools/telemetry_report.py's per-op section reads a dump
+            # exactly like a live stream
+            lines.append({"kind": "op_profile", **snap["op_table"]})
+        lines.extend(snap["events"])
+        lines.extend(snap["compiles"])
+        lines.extend(snap["steps"])
+        tmp = jsonl_path + ".tmp"
+        with open(tmp, "w") as f:
+            for rec in lines:
+                f.write(json.dumps(rec, sort_keys=True,
+                                   default=_json_default) + "\n")
+        os.replace(tmp, jsonl_path)
+        try:
+            self._write_trace(trace_path, snap)
+        except Exception:
+            trace_path = None
+        with self._lock:
+            self._dirty = None
+            self._last_dump = jsonl_path
+        print(f"[paddle_tpu.flight_recorder] {reason}: post-mortem at "
+              f"{jsonl_path}" + (f" + {trace_path}" if trace_path else ""),
+              file=sys.stderr)
+        return jsonl_path
+
+    def _write_trace(self, path, snap):
+        from .trace import merged_trace_events
+
+        host_events = []
+        prof = sys.modules.get("paddle_tpu.profiler")
+        if prof is not None:
+            # an active profiling session's host spans join the trace;
+            # no import if the profiler was never loaded
+            host_events = prof._all_events()
+        gauge_series = {}
+        try:
+            from .. import monitor
+
+            # the gauge histories (live-bytes watermark, checkpoint
+            # wall-time, backoff) are exactly the pre-crash signal a
+            # post-mortem wants — same tracks as the live export
+            gauge_series = monitor._registry.gauge_series()
+        except Exception:
+            pass
+        events = merged_trace_events(host_events,
+                                     step_records=snap["steps"],
+                                     compile_events=snap["compiles"],
+                                     gauge_series=gauge_series)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"},
+                      f)
+
+    @property
+    def last_dump(self):
+        return self._last_dump
+
+
+_RECORDER = FlightRecorder()
+
+
+def get():
+    return _RECORDER
+
+
+def dump(reason, directory=None):
+    return _RECORDER.dump(reason, directory)
+
+
+def note_event(kind, severe=False, **fields):
+    _RECORDER.note_event(kind, severe=severe, **fields)
+
+
+# -- process hooks ------------------------------------------------------
+
+_hooks_installed = False
+_prev_excepthook = None
+
+
+def _excepthook(exc_type, exc, tb):
+    if exc_type is not SystemExit:
+        _RECORDER.dump(f"unhandled:{exc_type.__name__}")
+    (_prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+
+def _atexit_dump():
+    with _RECORDER._lock:
+        dirty = _RECORDER._dirty
+    if dirty:
+        _RECORDER.dump(f"atexit:{dirty}")
+
+
+def install_hooks():
+    """Install the excepthook wrapper + atexit backstop (idempotent).
+    Installed even when FLAGS_flight_recorder=0 at import: the hooks
+    re-check `enabled` when they fire, so a runtime re-enable still
+    gets its post-mortem."""
+    global _hooks_installed, _prev_excepthook
+    if _hooks_installed:
+        return
+    _prev_excepthook = sys.excepthook
+    sys.excepthook = _excepthook
+    atexit.register(_atexit_dump)
+    _hooks_installed = True
+
+
+install_hooks()

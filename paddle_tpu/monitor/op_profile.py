@@ -1,0 +1,676 @@
+"""Per-op device-time attribution (ISSUE 5 tentpole).
+
+Once a Program is jit-compiled, XLA reports cost for the WHOLE step —
+the Fluid profiler's per-op table (platform/profiler parity: calls,
+total/max time per op, sorted by cost) has nothing to hang numbers on.
+This module restores op granularity without giving up whole-program
+compilation:
+
+1. **Provenance** — the executor wraps every op's kernel emission in
+   ``jax.named_scope("{section}/{op_type}_{idx}")`` while tracing, so
+   every HLO instruction (forward, and the transposed backward, which
+   appears as ``transpose(jvp(<scope>))``) carries its ProgramDesc op
+   in ``metadata.op_name``.
+2. **Static split** — ``static_split(compiled)`` walks the optimized
+   HLO text with a small analytical cost model (dot/conv/reduce/
+   elementwise), groups per-instruction FLOPs/bytes by scope, and
+   scales the groups so they sum EXACTLY to the executable's own
+   ``cost_analysis()`` totals.  The model only has to get the
+   *proportions* roughly right; XLA's numbers stay authoritative.
+   Instructions without a scope (donation copies, layout ops) land in
+   an explicit ``unattributed`` bucket instead of silently vanishing.
+3. **Trace grouping** — ``group_spans_by_scope`` aggregates captured
+   trace spans (host RecordEvent spans from the sampling mode, or
+   device-plane events from an XPlane capture — see
+   tools/parse_xplane.py) per scope, giving measured time next to the
+   static FLOPs.
+4. **Sampling mode** — ``sampling()`` times each op of the EAGER
+   executor path (and dygraph Layer calls) on the host with
+   ``block_until_ready``, the per-op fallback when a program cannot
+   run jitted or a trace capture is unavailable.
+
+``op_table()`` merges all sources into the Fluid-parity rows that
+``stop_profiler`` prints and ``monitor.snapshot()["op_profile"]``
+exposes.
+
+This module imports neither jax nor numpy at module level so
+tools/parse_xplane.py can reuse the grouping without an accelerator
+runtime.
+"""
+
+import contextlib
+import re
+import threading
+import time
+
+__all__ = [
+    "UNATTRIBUTED", "scope_of", "parse_hlo_instruction_costs",
+    "split_by_scope", "static_split", "group_spans_by_scope",
+    "OpSampler", "sampling", "active_sampler", "is_sampling",
+    "sampled_rows", "clear_samples", "op_table",
+]
+
+# the bucket for instructions carrying no recognizable scope metadata
+# (donation copies, layout assignment, parameter plumbing)
+UNATTRIBUTED = "(unattributed)"
+
+# A scope as the executor emits it: "{section}/{op_type}_{idx}" where
+# section is fwd<k> (ops feeding backward section k), update (ops after
+# the last backward section: optimizer, stats), or main (programs with
+# no backward section).  XLA embeds it in op_name paths like
+#   jit(step)/jit(main)/fwd0/conv2d_3/conv_general_dilated
+#   jit(step)/jit(main)/transpose(jvp(fwd0/conv2d_3))/...
+# so the match must fire inside parens as well as between slashes.
+_SCOPE_RE = re.compile(
+    r"(?:^|[/(])((?:fwd\d+|update|main)/[A-Za-z0-9_.\-]*_\d+)(?=[/)]|$)")
+
+
+def scope_of(op_name, known_scopes=None):
+    """Extract the executor scope from an HLO/trace op_name path, or
+    None.  With `known_scopes`, only exact members match (guards
+    against a user named_scope that happens to look like ours)."""
+    if not op_name:
+        return None
+    for m in _SCOPE_RE.finditer(op_name):
+        s = m.group(1)
+        if known_scopes is None or s in known_scopes:
+            return s
+    return None
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing + per-instruction cost model
+# ---------------------------------------------------------------------------
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_COMP_HEADER_RE = re.compile(
+    r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->\s*.+\{\s*$")
+# computations applied per-element by their caller (reduce/scatter/sort
+# comparators): the call site's cost rule already covers them, so their
+# instructions must not be double counted.  ONLY these opcodes' to_apply
+# targets are excluded — a plain `call` (XLA:CPU's parallel-fusion
+# representation) runs its body once at full shapes and must be costed.
+_REGION_REF_RE = re.compile(
+    r"=\s+\S+\s+(?:reduce|reduce-window|scatter|select-and-scatter|sort"
+    r"|all-reduce|reduce-scatter|map)\([^\n]*?to_apply=%?([\w.\-]+)")
+
+# scope-inheritance family preference: a metadata-less instruction of
+# these opcodes votes for operand scopes whose Fluid op type looks like
+# the same kind of compute (see parse_hlo_instruction_costs)
+_OPCODE_FAMILY = {
+    "convolution": ("conv",),
+    "dot": ("mul", "matmul", "fc", "linear"),
+}
+
+# pure data movement / bookkeeping: zero flops in XLA's model too
+_ZERO_FLOP = frozenset((
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "bitcast-convert", "copy", "copy-start", "copy-done", "broadcast",
+    "reshape", "transpose", "iota", "slice", "dynamic-slice",
+    "dynamic-update-slice", "concatenate", "reverse", "pad", "gather",
+    "convert", "rng", "rng-bit-generator", "rng-get-and-update-state",
+    "after-all", "partition-id", "replica-id", "infeed", "outfeed",
+    "fusion", "call", "while", "conditional", "custom-call",
+    "all-gather", "all-to-all", "collective-permute", "optimization-barrier",
+    "send", "send-done", "recv", "recv-done", "domain", "add-dependency",
+))
+
+
+def _shape_elems_bytes(type_str):
+    """(element count, byte size) of an HLO type string; tuple types
+    sum their leaves.  `f32[]` is a scalar (1 element)."""
+    elems = 0
+    nbytes = 0
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        if dt in ("token", "opaque"):
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        elems += n
+        nbytes += n * _DTYPE_BYTES.get(dt, 4)
+    return elems, nbytes
+
+
+def _split_instruction(line):
+    """'%name = TYPE opcode(OPERANDS), attrs' -> (type_str, opcode,
+    operand_str, attr_str) or None for non-instruction lines."""
+    if " = " not in line:
+        return None
+    _, rhs = line.split(" = ", 1)
+    rhs = rhs.strip()
+    if rhs.startswith("("):                    # tuple-typed result
+        depth = 0
+        for i, ch in enumerate(rhs):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+        type_str, rest = rhs[:i + 1], rhs[i + 1:].lstrip()
+    else:
+        parts = rhs.split(" ", 1)
+        if len(parts) != 2:
+            return None
+        type_str, rest = parts
+    par = rest.find("(")
+    if par <= 0:
+        return None
+    opcode = rest[:par].strip()
+    if not re.fullmatch(r"[a-zA-Z][\w\-]*", opcode):
+        return None
+    depth = 0
+    for i in range(par, len(rest)):
+        if rest[i] == "(":
+            depth += 1
+        elif rest[i] == ")":
+            depth -= 1
+            if depth == 0:
+                break
+    else:
+        return None
+    return type_str, opcode, rest[par + 1:i], rest[i + 1:]
+
+
+def _instruction_flops(opcode, out_elems, operand_shapes, attr_str):
+    """Analytical FLOP estimate for one optimized-HLO instruction.
+    Proportions are what matter (split_by_scope rescales to the
+    executable's cost_analysis total); the rules mirror XLA's
+    HloCostAnalysis shapes: 2*M*N*K dots, 2*out*K convs, one op per
+    input element for reductions, one per output element elementwise."""
+    if opcode in _ZERO_FLOP:
+        return 0.0
+    if opcode == "dot":
+        k = 1
+        m = re.search(r"lhs_contracting_dims=\{([0-9,]*)\}", attr_str)
+        if m and operand_shapes:
+            lhs_dims = operand_shapes[0][1]
+            for idx in filter(None, m.group(1).split(",")):
+                i = int(idx)
+                if i < len(lhs_dims):
+                    k *= lhs_dims[i]
+        return 2.0 * out_elems * k
+    if opcode == "convolution":
+        # multiply-adds per output element = kernel spatial taps x input
+        # features = prod(rhs) / output_features
+        if len(operand_shapes) < 2:
+            return 2.0 * out_elems
+        rhs_dims = operand_shapes[1][1]
+        rhs_elems = 1
+        for d in rhs_dims:
+            rhs_elems *= d
+        o_size = 1
+        m = re.search(r"dim_labels=[^ ,]*_([0-9a-z]+)->", attr_str)
+        if m:
+            kernel_labels = m.group(1)
+            o_pos = kernel_labels.find("o")
+            if 0 <= o_pos < len(rhs_dims):
+                o_size = rhs_dims[o_pos]
+        return 2.0 * out_elems * (rhs_elems / max(o_size, 1))
+    if opcode in ("reduce", "reduce-window", "select-and-scatter",
+                  "all-reduce", "reduce-scatter"):
+        in_elems = operand_shapes[0][0] if operand_shapes else out_elems
+        return float(max(in_elems, out_elems))
+    if opcode == "scatter":
+        # one update op per scattered element
+        return float(operand_shapes[-1][0]) if operand_shapes else 0.0
+    if opcode in ("sort", "topk"):
+        in_elems = operand_shapes[0][0] if operand_shapes else out_elems
+        return float(in_elems)
+    # everything else: elementwise arithmetic/comparison/transcendental
+    return float(out_elems)
+
+
+def parse_hlo_instruction_costs(hlo_text, known_scopes=None):
+    """Walk an optimized HLO module's text form into per-instruction
+    cost rows: ``{"scope", "opcode", "flops", "bytes_accessed"}``.
+
+    Counting rules (mirroring how XLA attributes cost):
+
+    - FLOPs are counted in the entry computation and in fusion/call/
+      while bodies (their instructions run at their stated shapes), but
+      NOT in ``to_apply`` regions — reduce/scatter comparators are
+      applied per element and the call site's rule covers them.  A
+      while body is counted once (trip counts are not in the text).
+    - bytes_accessed is counted for ENTRY instructions only (operand +
+      result sizes): fused instructions read registers, not HBM.
+    - an instruction XLA emitted WITHOUT op_name metadata (this jax
+      drops it on e.g. transposed convolutions — the conv backward,
+      easily a third of a conv net's FLOPs) inherits the majority
+      scope of its scoped operands: dataflow-neighbor attribution,
+      marked ``"inherited": True`` so the split can report how much of
+      the table leaned on it.  Only instructions with no scoped
+      operand at all stay unattributed.
+    """
+    region_names = set(_REGION_REF_RE.findall(hlo_text))
+    rows = []
+    name_scope = {}
+    operand_map = {}
+    pending = []     # (row index, result name, operand names)
+    current = None
+    is_entry = False
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith("//"):
+            continue
+        header = _COMP_HEADER_RE.match(line)
+        if header and not line.startswith(" "):
+            current = header.group(2)
+            is_entry = bool(header.group(1))
+            continue
+        if line.startswith("}") or current is None:
+            continue
+        if current in region_names:
+            continue
+        parsed = _split_instruction(stripped[5:].strip()
+                                    if stripped.startswith("ROOT ")
+                                    else stripped)
+        if parsed is None:
+            continue
+        type_str, opcode, operand_str, attr_str = parsed
+        if opcode in ("parameter", "constant"):
+            continue
+        out_elems, out_bytes = _shape_elems_bytes(type_str)
+        operand_strs = []
+        operand_shapes = []
+        for m in re.finditer(
+                r"((?:[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?))\s+%",
+                operand_str):
+            t = m.group(1)
+            operand_strs.append(t)
+            dt, dims = _SHAPE_RE.findall(t)[0]
+            dim_list = tuple(int(d) for d in dims.split(",")) if dims \
+                else ()
+            n = 1
+            for d in dim_list:
+                n *= d
+            operand_shapes.append((n, dim_list))
+        flops = _instruction_flops(opcode, out_elems, operand_shapes,
+                                   attr_str)
+        nbytes = 0.0
+        if is_entry:
+            nbytes = float(out_bytes)
+            for t in operand_strs:
+                nbytes += _shape_elems_bytes(t)[1]
+        m = _OPNAME_RE.search(line)
+        scope = scope_of(m.group(1) if m else None, known_scopes)
+        rows.append({
+            "scope": scope,
+            "opcode": opcode,
+            "flops": float(flops),
+            "bytes_accessed": nbytes,
+        })
+        rm = re.match(r"(?:ROOT\s+)?%([\w.\-]+)\s*=", stripped)
+        res_name = rm.group(1) if rm else None
+        if res_name is not None:
+            operands = re.findall(r"%([\w.\-]+)", operand_str)
+            operand_map[res_name] = operands
+            if scope is not None:
+                name_scope[res_name] = scope
+            else:
+                pending.append((len(rows) - 1, res_name, operands))
+    # dataflow-neighbor inheritance for metadata-less instructions:
+    # resolve iteratively so a chain of bare instructions converges
+    for _ in range(4):
+        changed = False
+        for idx, res_name, operands in pending:
+            if rows[idx]["scope"] is not None:
+                continue
+            votes = [name_scope[o] for o in operands if o in name_scope]
+            fam = _OPCODE_FAMILY.get(rows[idx]["opcode"])
+            if fam:
+                # a bare convolution's direct operands are typically
+                # the upstream cotangent (somebody ELSE's scope) and a
+                # layout fusion of a parameter: prefer a same-family
+                # scope, searching a few dataflow hops when the direct
+                # operands offer none — the weight-grad conv must land
+                # on ITS conv, not on the batch-norm that produced the
+                # cotangent
+                preferred = [v for v in votes
+                             if _family_match(v, fam)]
+                if not preferred:
+                    hit = _family_bfs(operands, fam, name_scope,
+                                      operand_map)
+                    if hit is not None:
+                        preferred = [hit]
+                if preferred:
+                    votes = preferred
+            if not votes:
+                continue
+            best = max(sorted(set(votes)), key=votes.count)
+            rows[idx]["scope"] = best
+            rows[idx]["inherited"] = True
+            name_scope[res_name] = best
+            changed = True
+        if not changed:
+            break
+    return rows
+
+
+def _family_match(scope, fam):
+    return any(t in scope.split("/", 1)[-1] for t in fam)
+
+
+def _family_bfs(operands, fam, name_scope, operand_map, depth=3):
+    """Nearest same-family scope within `depth` dataflow hops of the
+    operand set (breadth-first, cycle-safe); None when there is none."""
+    seen = set()
+    frontier = list(operands)
+    for _ in range(depth):
+        nxt = []
+        for o in frontier:
+            if o in seen:
+                continue
+            seen.add(o)
+            s = name_scope.get(o)
+            if s is not None and _family_match(s, fam):
+                return s
+            nxt.extend(operand_map.get(o, ()))
+        frontier = nxt
+        if not frontier:
+            break
+    return None
+
+
+def split_by_scope(rows, totals):
+    """Group per-instruction cost rows by scope and scale each field so
+    the groups sum EXACTLY to `totals` (the executable's own
+    cost_analysis numbers) — the model provides proportions, XLA the
+    magnitude.  Rows without a scope become the ``unattributed``
+    bucket; its share is the attribution residual the acceptance bound
+    (<= 1% on real models) is measured on.
+
+    totals: {"flops": float|None, "bytes_accessed": float|None}
+    returns {"totals": ..., "scopes": {scope: {flops, bytes_accessed,
+    flops_pct, instructions}}, "unattributed": {...}}
+    """
+    per = {}
+    for r in rows:
+        key = r.get("scope") or UNATTRIBUTED
+        d = per.setdefault(key, {"flops": 0.0, "bytes_accessed": 0.0,
+                                 "instructions": 0})
+        d["flops"] += float(r.get("flops") or 0.0)
+        d["bytes_accessed"] += float(r.get("bytes_accessed") or 0.0)
+        d["instructions"] += 1
+        if r.get("inherited"):
+            d["inherited_instructions"] = \
+                d.get("inherited_instructions", 0) + 1
+    for field in ("flops", "bytes_accessed"):
+        total = totals.get(field) if totals else None
+        if total is None:
+            continue
+        model_sum = sum(d[field] for d in per.values())
+        if model_sum > 0:
+            # scale to the total EXACTLY (the acceptance invariant):
+            # scaled values are rounded to whole units (FLOPs/bytes are
+            # integral) with the remainder assigned to the LARGEST
+            # group — integer-valued floats sum exactly in ANY order,
+            # and a big group can absorb the up-to-N/2-unit rounding
+            # drift without ever going negative the way a near-zero
+            # last-inserted group could
+            k_rem = max(per, key=lambda k: per[k][field])
+            acc = 0.0
+            for k in per:
+                if k == k_rem:
+                    continue
+                v = float(round(per[k][field] / model_sum * total))
+                per[k][field] = v
+                acc += v
+            per[k_rem][field] = total - acc
+        elif total:
+            # the model saw nothing costable but XLA reports cost:
+            # everything is residual, loudly
+            d = per.setdefault(UNATTRIBUTED,
+                               {"flops": 0.0, "bytes_accessed": 0.0,
+                                "instructions": 0})
+            d[field] += total
+    flops_total = sum(d["flops"] for d in per.values())
+    for d in per.values():
+        d["flops_pct"] = (d["flops"] / flops_total * 100.0) \
+            if flops_total > 0 else 0.0
+    unattributed = per.pop(UNATTRIBUTED, {"flops": 0.0,
+                                          "bytes_accessed": 0.0,
+                                          "instructions": 0,
+                                          "flops_pct": 0.0})
+    return {
+        "totals": {"flops": totals.get("flops") if totals else None,
+                   "bytes_accessed": (totals.get("bytes_accessed")
+                                      if totals else None)},
+        "scopes": per,
+        "unattributed": unattributed,
+    }
+
+
+def static_split(compiled, known_scopes=None):
+    """Per-scope FLOPs/bytes attribution of one compiled executable:
+    parse its optimized HLO text, cost each instruction, group by the
+    executor's named scopes, scale to its cost_analysis totals.
+    Returns the split_by_scope structure, or None when the executable
+    exposes neither text nor cost analysis."""
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    if not text:
+        return None
+    from .compile_ledger import parse_cost_analysis
+
+    try:
+        totals = parse_cost_analysis(compiled.cost_analysis())
+    except Exception:
+        totals = {"flops": None, "bytes_accessed": None}
+    rows = parse_hlo_instruction_costs(text, known_scopes)
+    if not rows:
+        return None
+    return split_by_scope(rows, totals)
+
+
+# ---------------------------------------------------------------------------
+# trace grouping (shared by tools/parse_xplane.py for both formats)
+# ---------------------------------------------------------------------------
+
+def group_spans_by_scope(spans, known_scopes=None):
+    """Aggregate (name, duration_us) span pairs per scope:
+    {scope: {"calls", "total_us", "max_us"}}.  Spans whose name carries
+    no scope are skipped — callers print their ordinary per-track
+    tables for those."""
+    out = {}
+    for name, dur_us in spans:
+        s = scope_of(name, known_scopes)
+        if s is None:
+            continue
+        row = out.setdefault(s, {"calls": 0, "total_us": 0.0,
+                                 "max_us": 0.0})
+        row["calls"] += 1
+        row["total_us"] += float(dur_us)
+        row["max_us"] = max(row["max_us"], float(dur_us))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sampling mode — eager/dygraph per-op host timing
+# ---------------------------------------------------------------------------
+
+class OpSampler:
+    """Per-op wall-time accumulator for the eager paths.  The executor's
+    interpreter (FLAGS_eager_executor) and dygraph Layer.__call__ feed
+    it while a ``sampling()`` scope is active: each op/layer call is
+    timed host-side with ``jax.block_until_ready`` on its outputs (ops
+    running under an autodiff trace can't block; their host dispatch
+    time is recorded instead, which is still ranking-useful)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+
+    def note(self, scope, dur_us):
+        with self._lock:
+            row = self._table.get(scope)
+            if row is None:
+                row = self._table[scope] = [0, 0.0, 0.0, float("inf")]
+            row[0] += 1
+            row[1] += dur_us
+            row[2] = max(row[2], dur_us)
+            row[3] = min(row[3], dur_us)
+
+    def rows(self):
+        with self._lock:
+            return {
+                scope: {"calls": c, "total_us": tot, "max_us": mx,
+                        "min_us": (0.0 if mn == float("inf") else mn),
+                        "ave_us": (tot / c) if c else 0.0}
+                for scope, (c, tot, mx, mn) in self._table.items()
+            }
+
+    def timed(self, scope):
+        """Time one call: ``with sampler.timed("main/fc_0"): ...`` —
+        used by call sites that have no output handle to block on."""
+        return _Timed(self, scope)
+
+
+class _Timed:
+    __slots__ = ("_sampler", "_scope", "_t0")
+
+    def __init__(self, sampler, scope):
+        self._sampler = sampler
+        self._scope = scope
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self._sampler.note(self._scope,
+                           (time.perf_counter_ns() - self._t0) / 1e3)
+        return False
+
+
+# active sampler in a single-slot list: call sites on hot-ish paths
+# (eager interpret loop, dygraph Layer.__call__) check `_ACTIVE[0] is
+# None` — one load, no function call — before paying anything
+_ACTIVE = [None]
+_last_sampler = None
+
+
+def active_sampler():
+    return _ACTIVE[0]
+
+
+def is_sampling():
+    return _ACTIVE[0] is not None
+
+
+def sampled_rows():
+    """Rows of the active sampler, else of the most recently finished
+    one — what op_table() merges as measured per-op time."""
+    s = _ACTIVE[0] if _ACTIVE[0] is not None else _last_sampler
+    return s.rows() if s is not None else {}
+
+
+def clear_samples():
+    global _last_sampler
+    _last_sampler = None
+    _ACTIVE[0] = None
+
+
+@contextlib.contextmanager
+def sampling(force_eager=True):
+    """Enable per-op sampling.  force_eager switches the executor to
+    the op-by-op interpreter for the duration (the jitted path has no
+    per-op boundaries to time — use the static split / trace grouping
+    there), restoring the flag on exit."""
+    global _last_sampler
+    from .. import flags
+
+    sampler = OpSampler()
+    prev = _ACTIVE[0]
+    _ACTIVE[0] = sampler
+    old_flag = flags.flag("eager_executor")
+    if force_eager:
+        flags.set_flags({"eager_executor": True})
+    try:
+        yield sampler
+    finally:
+        _ACTIVE[0] = prev
+        _last_sampler = sampler
+        if force_eager:
+            flags.set_flags({"eager_executor": old_flag})
+
+
+# ---------------------------------------------------------------------------
+# the merged Fluid-parity table
+# ---------------------------------------------------------------------------
+
+def op_table(static=None, sampled=None, step_time_s=None):
+    """Merge the static cost split and the sampled timings into ordered
+    per-op rows (Fluid profiler-table parity): scope, calls, measured
+    device/host time (total/max/min/ave μs), FLOPs, bytes, and
+    %-of-step — time share when measured time exists, FLOPs share
+    otherwise.  `step_time_s` adds an estimated per-step device time
+    per scope (flops share x step time) when nothing was measured."""
+    if static is None or sampled is None:
+        from .. import monitor  # late: avoid cycle at module import
+
+        if static is None:
+            for e in reversed(monitor.compile_events()):
+                if e.get("op_profile"):
+                    static = e["op_profile"]
+                    break
+        if sampled is None:
+            sampled = sampled_rows()
+    sampled = sampled or {}
+    scopes = dict((static or {}).get("scopes") or {})
+    rows = []
+    seen = set()
+    for scope, d in scopes.items():
+        row = {"scope": scope,
+               "flops": d.get("flops"),
+               "bytes_accessed": d.get("bytes_accessed"),
+               "flops_pct": round(d.get("flops_pct", 0.0), 3)}
+        t = sampled.get(scope)
+        if t:
+            row.update(calls=t["calls"],
+                       total_us=round(t["total_us"], 1),
+                       max_us=round(t["max_us"], 1),
+                       min_us=round(t["min_us"], 1),
+                       ave_us=round(t["ave_us"], 1))
+        elif step_time_s and d.get("flops_pct") is not None:
+            row["est_us"] = round(step_time_s * 1e6
+                                  * d["flops_pct"] / 100.0, 1)
+        rows.append(row)
+        seen.add(scope)
+    for scope, t in sampled.items():
+        if scope in seen:
+            continue
+        rows.append({"scope": scope, "calls": t["calls"],
+                     "total_us": round(t["total_us"], 1),
+                     "max_us": round(t["max_us"], 1),
+                     "min_us": round(t["min_us"], 1),
+                     "ave_us": round(t["ave_us"], 1)})
+    measured_total = sum(r.get("total_us", 0.0) for r in rows)
+    if measured_total > 0:
+        for r in rows:
+            if "total_us" in r:
+                r["time_pct"] = round(
+                    r["total_us"] / measured_total * 100.0, 3)
+    if static and static.get("unattributed", {}).get("instructions"):
+        u = static["unattributed"]
+        rows.append({"scope": UNATTRIBUTED, "flops": u.get("flops"),
+                     "bytes_accessed": u.get("bytes_accessed"),
+                     "flops_pct": round(u.get("flops_pct", 0.0), 3)})
+    rows.sort(key=lambda r: -(r.get("total_us")
+                              or r.get("est_us")
+                              or r.get("flops") or 0.0))
+    return rows

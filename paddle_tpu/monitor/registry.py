@@ -13,9 +13,15 @@ sections (a dict lookup + float add — contention is not a concern at
 per-step granularity).
 """
 
+import collections
 import threading
+import time
 
 __all__ = ["Counter", "Gauge", "MetricsRegistry"]
+
+# per-gauge history depth: enough to draw a counter track over the
+# recent past without ever growing with run length
+_GAUGE_SAMPLES = 512
 
 
 class Counter:
@@ -38,22 +44,34 @@ class Counter:
 
 
 class Gauge:
-    """Last-write-wins sample (examples/s, live bytes, dp width)."""
+    """Last-write-wins sample (examples/s, live bytes, dp width).
 
-    __slots__ = ("name", "_value", "_lock")
+    Every `set` also lands in a bounded (ts_us, value) history — the
+    time-series the merged chrome trace renders as a counter track
+    (checkpoint wall-time, live-bytes watermarks...), timestamped on
+    the profiler's perf_counter clock so the samples align with the
+    host spans."""
+
+    __slots__ = ("name", "_value", "_lock", "_samples")
 
     def __init__(self, name, lock):
         self.name = name
         self._value = None
         self._lock = lock
+        self._samples = collections.deque(maxlen=_GAUGE_SAMPLES)
 
     def set(self, v):
         with self._lock:
             self._value = v
+            self._samples.append((time.perf_counter_ns() / 1e3, v))
 
     @property
     def value(self):
         return self._value
+
+    def samples(self):
+        with self._lock:
+            return list(self._samples)
 
 
 class MetricsRegistry:
@@ -88,6 +106,18 @@ class MetricsRegistry:
                            if g._value is not None},
             }
 
+    def gauge_series(self):
+        """{name: [(ts_us, value), ...]} for every gauge with history —
+        the input of the merged trace's gauge counter tracks."""
+        with self._lock:
+            gauges = list(self._gauges.values())
+        out = {}
+        for g in gauges:
+            samples = g.samples()
+            if samples:
+                out[g.name] = samples
+        return out
+
     def reset(self):
         """Zero every counter and clear every gauge IN PLACE — handles
         held by call sites (executor module-level counter refs) stay
@@ -97,3 +127,4 @@ class MetricsRegistry:
                 c._value = 0
             for g in self._gauges.values():
                 g._value = None
+                g._samples.clear()

@@ -151,6 +151,15 @@ class MetricsSession:
         if w is not None:
             w.emit(record)
 
+    def emit_record(self, record):
+        """Write one auxiliary (non-step) record to the attached JSONL
+        sink — compile-ledger op-profile splits ride the same stream
+        the step records use.  No session bookkeeping: step numbering
+        and aggregates stay step-only."""
+        w = self._writer
+        if w is not None:
+            w.emit(record)
+
     # -- reading --------------------------------------------------------
     def records(self):
         with self._lock:

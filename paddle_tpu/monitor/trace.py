@@ -48,12 +48,28 @@ def _metadata_events(host_events):
     return out
 
 
+def _gauge_events(gauge_series):
+    """Gauge histories -> one chrome counter track per gauge
+    (checkpoint wall-time, live-bytes watermarks, backoff delays...),
+    alongside the sampled-counter tracks.  Non-numeric gauge values
+    are skipped — Perfetto counters are numbers."""
+    out = []
+    for name, samples in sorted(gauge_series.items()):
+        arg = name.rsplit(".", 1)[-1]
+        for ts, v in samples:
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            out.append({"name": name, "ph": "C", "ts": ts,
+                        "pid": _STEP_PID, "args": {arg: v}})
+    return out
+
+
 def _step_events(records):
     """Step records -> one X span per step + counter samples at each
     step boundary."""
     out = []
     for r in records:
-        dur_us = r["step_time_s"] * 1e6 * r.get("steps", 1)
+        dur_us = r.get("step_time_s", 0.0) * 1e6 * r.get("steps", 1)
         start = r["ts_us"] - dur_us
         args = {"step": r.get("step")}
         for k in ("examples", "host_dispatch_us", "feed_bytes",
@@ -112,13 +128,16 @@ def _compile_events(events):
 
 
 def merged_trace_events(host_events, step_records=None,
-                        compile_events=None):
+                        compile_events=None, gauge_series=None):
     """The full merged event list: metadata + host spans + step spans +
-    compile spans + counter tracks."""
+    compile spans + counter tracks (sampled counters AND gauge
+    time-series)."""
     step_records = step_records or []
     compile_events = compile_events or []
     out = _metadata_events(host_events)
     out.extend(host_span_events(host_events))
     out.extend(_step_events(step_records))
     out.extend(_compile_events(compile_events))
+    if gauge_series:
+        out.extend(_gauge_events(gauge_series))
     return out

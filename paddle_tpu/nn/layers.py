@@ -16,6 +16,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import unique_name
+# the op-profile sampler's single-slot handle (op_profile imports only
+# stdlib, so this is cycle- and jax-free): Layer.__call__ checks
+# `_op_sampler[0] is not None` — one list load — while sampling is off
+from ..monitor.op_profile import _ACTIVE as _op_sampler
 from .parameter import EagerParameter, default_rng
 
 
@@ -167,10 +171,36 @@ class Layer:
     def __call__(self, *args, **kwargs):
         from ..tape import current_tape
 
+        if _op_sampler[0] is not None:
+            # per-op sampling mode (monitor.op_profile.sampling): time
+            # this layer call host-side with block_until_ready — the
+            # dygraph twin of the eager executor's per-op sampling
+            return self._sampled_call(_op_sampler[0], args, kwargs)
         tape = current_tape()
         if tape is None:
             return self.forward(*args, **kwargs)
         return self._record_call(tape, args, kwargs)
+
+    def _sampled_call(self, sampler, args, kwargs):
+        import time as _time
+
+        import jax as _jax
+
+        from ..tape import current_tape
+
+        t0 = _time.perf_counter_ns()
+        tape = current_tape()
+        if tape is None:
+            out = self.forward(*args, **kwargs)
+        else:
+            out = self._record_call(tape, args, kwargs)
+        try:
+            _jax.block_until_ready(out)
+        except Exception:
+            pass   # tracers under an outer trace can't block
+        sampler.note(f"dygraph/{self._full_name}",
+                     (_time.perf_counter_ns() - t0) / 1e3)
+        return out
 
     def _record_call(self, tape, args, kwargs):
         """Record this call on the dygraph tape: the forward runs as a

@@ -22,7 +22,7 @@ from . import flags
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "RecordEvent",
            "cuda_profiler", "reset_profiler", "is_profiling",
-           "export_chrome_tracing"]
+           "export_chrome_tracing", "add_span"]
 
 # Span storage: the nesting STACK is per-thread (spans nest within one
 # thread), but the recorded events are aggregated across threads —
@@ -119,6 +119,22 @@ class RecordEvent:
 _active = {"on": False, "jax_trace": False, "dir": None, "epoch": 0}
 
 
+def add_span(name, start_ns, end_ns, depth=0):
+    """Record one already-measured span (perf_counter_ns endpoints) —
+    the entry point the op-profile sampling mode uses so its per-op
+    timings appear in stop_profiler's table and the chrome trace.
+    No-op outside a profiling session, same contract as RecordEvent."""
+    if not _active["on"]:
+        return
+    _events().append({
+        "name": name,
+        "ts": start_ns / 1000.0,
+        "dur": (end_ns - start_ns) / 1000.0,
+        "depth": depth,
+        "tid": threading.get_ident(),
+    })
+
+
 def is_profiling():
     """True while a start_profiler/profiler() session is active — the
     executor's dispatch path checks this before opening RecordEvent
@@ -142,7 +158,20 @@ def start_profiler(state="All", tracer_option="Default"):
             _active["jax_trace"] = False
 
 
+# Fluid-parity sort keys (profiler.py:196): each maps to the table
+# column it ranks by, descending — the reference prints the costliest
+# first whatever the key
+_SORT_FIELDS = {"total": "total_us", "max": "max_us", "min": "min_us",
+                "ave": "ave_us", "calls": "calls"}
+
+
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """End the profiling session and print the aggregate span table
+    (calls / total / max / min / ave μs, sorted by `sorted_key` —
+    "total" | "max" | "min" | "ave" | "calls", reference parity), plus
+    — when the monitor has per-op attribution data (a compiled step's
+    static split and/or a sampling run) — the Fluid per-op table with
+    device-time, FLOPs, bytes, and %-of-step columns."""
     _active["on"] = False
     if _active["jax_trace"]:
         try:
@@ -150,31 +179,73 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         finally:
             _active["jax_trace"] = False
     events = _all_events()
-    if not events:
-        return {}
-    # aggregate table like the reference's per-op profiling report
     table = {}
     for e in events:
         row = table.setdefault(e["name"], {"calls": 0, "total_us": 0.0,
-                                           "max_us": 0.0})
+                                           "max_us": 0.0,
+                                           "min_us": float("inf")})
         row["calls"] += 1
         row["total_us"] += e["dur"]
         row["max_us"] = max(row["max_us"], e["dur"])
-    if sorted_key in ("total", None):
-        items = sorted(table.items(), key=lambda kv: -kv[1]["total_us"])
-    else:
-        items = list(table.items())
-    lines = [f"{'Event':<40}{'Calls':>8}{'Total(us)':>14}{'Max(us)':>12}"]
-    for name, row in items:
-        lines.append(f"{name:<40}{row['calls']:>8}{row['total_us']:>14.1f}"
-                     f"{row['max_us']:>12.1f}")
-    report = "\n".join(lines)
-    print(report)
+        row["min_us"] = min(row["min_us"], e["dur"])
+    for row in table.values():
+        row["ave_us"] = row["total_us"] / row["calls"]
+        if row["min_us"] == float("inf"):
+            row["min_us"] = 0.0
+    if sorted_key is not None and sorted_key not in _SORT_FIELDS:
+        raise ValueError(
+            f"sorted_key must be one of {sorted(_SORT_FIELDS)} or None, "
+            f"got {sorted_key!r}")
+    field = _SORT_FIELDS[sorted_key or "total"]
+    items = sorted(table.items(), key=lambda kv: -kv[1][field])
+    if table:
+        lines = [f"{'Event':<40}{'Calls':>8}{'Total(us)':>14}"
+                 f"{'Max(us)':>12}{'Min(us)':>12}{'Ave(us)':>12}"]
+        for name, row in items:
+            lines.append(
+                f"{name:<40}{row['calls']:>8}{row['total_us']:>14.1f}"
+                f"{row['max_us']:>12.1f}{row['min_us']:>12.1f}"
+                f"{row['ave_us']:>12.1f}")
+        print("\n".join(lines))
+    _print_op_table()
+    if not events:
+        return {}
     if profile_path:
         # default (merged) export: the session's trace should carry the
         # monitor's step/counter tracks alongside the host spans
         export_chrome_tracing(profile_path + ".json")
     return table
+
+
+def _print_op_table():
+    """The per-op attribution section (ISSUE 5 tentpole surface):
+    scope, calls, measured μs, XLA-cost FLOPs/bytes, %-of-step.  Quiet
+    when no attribution data exists — a plain host-span session prints
+    exactly what it used to."""
+    try:
+        from . import monitor
+
+        rows = monitor.op_table()
+    except Exception:
+        return
+    if not rows:
+        return
+    lines = ["", "Per-op attribution (device cost by ProgramDesc op):",
+             f"{'Op (section/type_idx)':<36}{'Calls':>7}{'Time(us)':>12}"
+             f"{'GFLOPs':>10}{'MBytes':>10}{'%':>8}"]
+    for r in rows:
+        t = r.get("total_us", r.get("est_us"))
+        pct = r.get("time_pct", r.get("flops_pct"))
+        lines.append(
+            f"{r['scope']:<36}"
+            f"{r.get('calls', '-'):>7}"
+            + (f"{t:>12.1f}" if t is not None else f"{'-':>12}")
+            + (f"{r['flops'] / 1e9:>10.4f}" if r.get("flops") is not None
+               else f"{'-':>10}")
+            + (f"{r['bytes_accessed'] / 1e6:>10.3f}"
+               if r.get("bytes_accessed") is not None else f"{'-':>10}")
+            + (f"{pct:>8.2f}" if pct is not None else f"{'-':>8}"))
+    print("\n".join(lines))
 
 
 def export_chrome_tracing(path, events=None):

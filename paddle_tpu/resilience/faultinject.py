@@ -117,6 +117,12 @@ def _mon():
     return monitor
 
 
+def _fr():
+    from ..monitor import flight_recorder
+
+    return flight_recorder
+
+
 # -- hooks called by the runtime ---------------------------------------
 
 def on_step_feed(feed_arrays):
@@ -156,6 +162,7 @@ def on_step_feed(feed_arrays):
     mon = _mon()
     if mon.is_enabled():
         mon.counter("resilience.injected_nan").add(1)
+    _fr().note_event("injected_nan", step=step, feed=name)
     return tainted
 
 
@@ -179,6 +186,7 @@ def check_transient():
     mon = _mon()
     if mon.is_enabled():
         mon.counter("resilience.injected_transient").add(1)
+    _fr().note_event("injected_transient", step=current)
     raise InjectedTransientError(
         "injected: RESOURCE_EXHAUSTED: synthetic device allocation "
         "failure (fault-injection harness)")
@@ -203,4 +211,11 @@ def crash_point(name):
     mon = _mon()
     if mon.is_enabled():
         mon.counter("resilience.injected_crash").add(1)
+    # post-mortem BEFORE the raise: InjectedCrash models a SIGKILL, so
+    # nothing downstream may run — including any dump hook.  (A real
+    # SIGKILL can't dump either; the simulation records what the kill
+    # interrupted, which is exactly what the chaos test asserts.)
+    fr = _fr()
+    fr.note_event("injected_crash", severe=True, point=name)
+    fr.dump(f"injected_crash:{name}")
     raise InjectedCrash(f"injected crash at point {name!r}")

@@ -115,20 +115,44 @@ class AnomalyGuard:
         due."""
         with self._lock:
             self.consecutive += 1
-            if self.consecutive > self.max_consecutive:
-                raise AnomalyError(
-                    f"{self.consecutive} consecutive anomalous steps "
-                    f"exceed max_consecutive={self.max_consecutive}; "
-                    f"escalating past policy {self.policy!r}")
+            escalate = self.consecutive > self.max_consecutive
+        if escalate:
+            # dump OUTSIDE the guard lock: the recorder snapshots the
+            # monitor registry, and holding two subsystem locks across
+            # each other is how deadlocks are born
+            _flight_dump(
+                f"anomaly_guard:max_consecutive={self.max_consecutive}")
+            raise AnomalyError(
+                f"{self.consecutive} consecutive anomalous steps "
+                f"exceed max_consecutive={self.max_consecutive}; "
+                f"escalating past policy {self.policy!r}")
         return True
 
     def note_rollback(self):
         with self._lock:
             self.rollbacks += 1
-            if self.rollbacks > self.max_rollbacks:
-                raise AnomalyError(
-                    f"{self.rollbacks} rollbacks exceed max_rollbacks="
-                    f"{self.max_rollbacks}; the anomaly is not transient")
+            escalate = self.rollbacks > self.max_rollbacks
+        if escalate:
+            _flight_dump(
+                f"anomaly_guard:max_rollbacks={self.max_rollbacks}")
+            raise AnomalyError(
+                f"{self.rollbacks} rollbacks exceed max_rollbacks="
+                f"{self.max_rollbacks}; the anomaly is not transient")
+
+
+def _flight_dump(reason):
+    """Escalations are normally CAUGHT by driver code (CI harnesses,
+    retry loops), so the excepthook may never see them: write the
+    post-mortem at the escalation point.  Never raises — diagnostics
+    must not mask the AnomalyError being thrown."""
+    try:
+        from ..monitor import flight_recorder
+
+        flight_recorder.note_event("anomaly_escalation", severe=True,
+                                   reason=reason)
+        flight_recorder.dump(reason)
+    except Exception:
+        pass
 
 
 _active = None

@@ -72,6 +72,12 @@ def _mon():
     return monitor
 
 
+def _fr():
+    from ..monitor import flight_recorder
+
+    return flight_recorder
+
+
 def call_with_retry(fn, policy=None, classify_fn=classify,
                     on_retry=None):
     """Run `fn()`; on a TRANSIENT throw, back off and retry up to
@@ -94,11 +100,22 @@ def call_with_retry(fn, policy=None, classify_fn=classify,
             if attempt >= policy.max_retries:
                 if mon.is_enabled():
                     mon.counter("resilience.retry_giveup").add(1)
+                fr = _fr()
+                fr.note_event("retry_giveup", severe=True,
+                              attempts=attempt + 1,
+                              error=f"{type(e).__name__}: {e}"[:200])
+                # the caller usually catches RetriesExhausted and shuts
+                # down cleanly — this taxonomy path dumps NOW so the
+                # post-mortem records what the device was doing
+                fr.dump("retries_exhausted")
                 raise RetriesExhausted(attempt + 1, e) from e
             d = policy.delay(attempt)
             if mon.is_enabled():
                 mon.counter("resilience.retries").add(1)
                 mon.gauge("resilience.last_backoff_s").set(d)
+            _fr().note_event("retry", attempt=attempt,
+                             backoff_s=round(d, 4),
+                             error=f"{type(e).__name__}: {e}"[:200])
             if on_retry is not None:
                 on_retry(attempt, d, e)
             policy.sleep(d)

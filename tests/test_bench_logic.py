@@ -194,6 +194,29 @@ def test_dispatch_overhead_in_suite_and_standalone():
 # ---------------------------------------------------------------------------
 
 
+def test_op_profile_smoke_in_suite_and_standalone():
+    """The attribution smoke row is wired into the suite AND the
+    standalone argv entry (the invariants themselves run end-to-end in
+    tests/test_op_profile.py on the test mesh; the row re-asserts them
+    on the 2-device standalone mesh in CI)."""
+    src = open(bench.__file__).read()
+    assert '("op_profile_smoke", "op_profile_smoke"' in src
+    assert '"op_profile_smoke" in sys.argv[1:]' in src
+    assert "main_op_profile_smoke" in src
+
+
+def test_bench_op_profile_smoke_row_passes():
+    """The CI row end-to-end on the test mesh: FLOPs sum exactly to the
+    whole-program cost_analysis total, every op scoped, residual
+    bounded."""
+    row = bench.bench_op_profile_smoke(False, 1e11)
+    assert row["value"] == 1, row.get("checks")
+    # >= : framework-inserted dp-sync collectives carry their own
+    # scopes on top of the ProgramDesc ops
+    assert row["attributed_scopes"] >= row["program_ops"]
+    assert row["unattributed_flops_pct"] <= 1.0
+
+
 def test_fault_tolerance_smoke_in_suite_and_standalone():
     """The chaos row is wired into the suite AND the standalone argv
     entry (the recovery behaviors themselves are covered end-to-end by

@@ -15,11 +15,54 @@ silently diverge:
 
 Anything else exits with an error naming the two expected formats.
 
+Both formats additionally get a **per-op attribution** section (ISSUE
+5): spans/events whose names or op_name stats carry an executor scope
+("{section}/{op_type}_{idx}" — see paddle_tpu/monitor/op_profile.py)
+are grouped per ProgramDesc op, so a capture answers "which conv in my
+program is eating the step" directly.
+
 Usage: python tools/parse_xplane.py <xplane.pb | trace.json> [top_n]
 """
 import collections
 import json
+import os
 import sys
+
+
+def _op_profile_mod():
+    """Load monitor/op_profile.py by FILE PATH: the scope regex and
+    grouping live there (one definition for the whole repo), but
+    importing the paddle_tpu package would pull in jax — this tool
+    stays runnable on a bare host next to a capture file."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "paddle_tpu", "monitor",
+                        "op_profile.py")
+    spec = importlib.util.spec_from_file_location("_pt_op_profile", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def print_scope_table(spans, top_n, unit_div=1e3, unit="ms"):
+    """Group (name, duration_us) spans by executor scope and print the
+    per-op table; quiet when nothing carries a scope (a capture from
+    outside the executor)."""
+    try:
+        grouped = _op_profile_mod().group_spans_by_scope(spans)
+    except Exception:
+        return
+    if not grouped:
+        return
+    total = sum(v["total_us"] for v in grouped.values())
+    print(f"== per-op attribution: {total/unit_div:.3f} {unit} over "
+          f"{len(grouped)} program ops")
+    rows = sorted(grouped.items(), key=lambda kv: -kv[1]["total_us"])
+    for scope, v in rows[:top_n]:
+        pct = v["total_us"] / total * 100.0 if total else 0.0
+        print(f"  {v['total_us']/unit_div:9.3f} {unit}  "
+              f"x{v['calls']:<5d} {pct:5.1f}%  {scope}")
 
 
 def load_xspace(path):
@@ -44,11 +87,16 @@ def device_plane(xs):
 
 
 def agg(plane):
-    """Return {line_name: {event_name: (total_ps, count)}} plus the
-    event-metadata stat 'hlo_category' when present."""
+    """Return ({line_name: {event_name: (total_ps, count, category)}},
+    spans) where spans is a per-event (attribution_name, duration_us)
+    list — attribution_name prefers the 'tf_op'/'op_name' metadata stat
+    (the named-scope path XLA threads through to the device plane) over
+    the bare HLO instruction name, so the per-op grouping can see the
+    executor's ProgramDesc scopes."""
     md = {m.id: m for m in plane.event_metadata.values()}
     smd = {m.id: m.name for m in plane.stat_metadata.values()}
     out = {}
+    spans = []
     for line in plane.lines:
         table = collections.defaultdict(lambda: [0, 0, ""])
         for ev in line.events:
@@ -57,18 +105,23 @@ def agg(plane):
             row = table[name]
             row[0] += ev.duration_ps
             row[1] += 1
-            if not row[2] and m:
+            op_name = None
+            if m:
                 for st in m.stats:
-                    if smd.get(st.metadata_id) == "hlo_category":
+                    sname = smd.get(st.metadata_id)
+                    if sname == "hlo_category" and not row[2]:
                         row[2] = st.str_value
+                    elif sname in ("tf_op", "op_name") and not op_name:
+                        op_name = st.str_value
+            spans.append((op_name or name, ev.duration_ps / 1e6))
         out[line.name] = table
-    return out
+    return out, spans
 
 
 def main_xplane(path, top_n):
     xs = load_xspace(path)
     plane = device_plane(xs)
-    tables = agg(plane)
+    tables, spans = agg(plane)
     for lname, table in tables.items():
         total = sum(v[0] for v in table.values())
         if total == 0:
@@ -78,6 +131,7 @@ def main_xplane(path, top_n):
         rows = sorted(table.items(), key=lambda kv: -kv[1][0])[:top_n]
         for name, (ps, n, cat) in rows:
             print(f"  {ps/1e9:9.3f} ms  x{n:<5d} {cat:12s} {name[:110]}")
+    print_scope_table(spans, top_n)
 
 
 def main_chrome_trace(path, top_n):
@@ -93,6 +147,7 @@ def main_chrome_trace(path, top_n):
     spans = collections.defaultdict(
         lambda: collections.defaultdict(lambda: [0.0, 0]))
     counters = collections.defaultdict(list)
+    flat_spans = []
     for e in events:
         if not isinstance(e, dict):
             continue
@@ -112,6 +167,8 @@ def main_chrome_trace(path, top_n):
             row = spans[key][e.get("name", "?")]
             row[0] += float(e.get("dur", 0.0))
             row[1] += 1
+            flat_spans.append((e.get("name", "?"),
+                               float(e.get("dur", 0.0))))
         elif ph == "C":
             counters[e.get("name", "?")].append(
                 (float(e.get("ts", 0.0)), e.get("args", {})))
@@ -128,6 +185,10 @@ def main_chrome_trace(path, top_n):
         samples.sort(key=lambda s: s[0])   # args dicts don't compare
         print(f"== counter {name!r}: {len(samples)} samples, "
               f"last {samples[-1][1]}")
+    # per-op grouping: the sampling mode records per-op spans named by
+    # scope, so a merged trace from an eager profiling session gets the
+    # same attribution table an XPlane capture does
+    print_scope_table(flat_spans, top_n)
 
 
 def _format_error(path, e):

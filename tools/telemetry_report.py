@@ -3,8 +3,12 @@
 Reads the per-step records the MetricsSession emitted and prints the
 aggregate view a run review needs: step count, step-time distribution
 (mean / p50 / p95 / max), host-dispatch μs, examples/s, byte totals,
-and the final cache-counter sample — without importing jax or touching
-the process that produced the file.
+the final cache-counter sample, a per-op cost section (from the
+kind="op_profile" records the compile ledger emits — which ProgramDesc
+ops own the FLOPs/bytes, plus the unattributed residual), and a
+resilience-event summary (retries, skipped steps, rollbacks,
+checkpoint saves/restores over the run, from the sampled counters) —
+without touching the process that produced the file.
 
 Usage: python tools/telemetry_report.py <telemetry.jsonl>
 """
@@ -58,7 +62,55 @@ def summarize(records):
         if r.get("counters"):
             out["final_counters"] = r["counters"]
             break
+    op = _op_profile_section(records)
+    if op:
+        out["op_profile"] = op
+    resil = _resilience_section(steps)
+    if resil:
+        out["resilience"] = resil
     return out
+
+
+def _op_profile_section(records, top=8):
+    """Per-op cost from the newest kind="op_profile" record: the top
+    scopes by FLOPs with their share, plus the attribution residual."""
+    latest = None
+    for r in reversed(records):
+        if r.get("kind") == "op_profile" and r.get("scopes"):
+            latest = r
+            break
+    if latest is None:
+        return None
+    scopes = latest["scopes"]
+    rows = sorted(scopes.items(),
+                  key=lambda kv: -(kv[1].get("flops") or 0.0))
+    out = {
+        "key": latest.get("key"),
+        "ops": len(scopes),
+        "top": [
+            {"scope": s,
+             "flops": round(d.get("flops") or 0.0, 1),
+             "flops_pct": round(d.get("flops_pct") or 0.0, 2),
+             "bytes": round(d.get("bytes_accessed") or 0.0, 1)}
+            for s, d in rows[:top]
+        ],
+    }
+    un = latest.get("unattributed") or {}
+    if un.get("instructions"):
+        out["unattributed_flops_pct"] = round(un.get("flops_pct", 0.0), 3)
+    return out
+
+
+def _resilience_section(steps):
+    """Recovery events over the run: the final sampled values of the
+    resilience.* counters (cumulative since monitor enable — the last
+    sample IS the run total), nonzero only."""
+    sampled = [r["counters"] for r in steps if r.get("counters")]
+    if not sampled:
+        return None
+    out = {k.split(".", 1)[1]: v for k, v in sampled[-1].items()
+           if k.startswith("resilience.") and v}
+    return out or None
 
 
 def main():
