@@ -1323,6 +1323,141 @@ def main_op_profile_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_mem_profile_smoke(on_tpu, peak):
+    """HBM-attribution smoke row (ISSUE 6 CI satellite): a tiny fc
+    train loop through the PUBLIC Executor.run on the CPU mesh
+    (data-parallel when >1 host device is visible) with telemetry on,
+    asserting the peak-memory invariants end-to-end:
+
+    - per-scope peak bytes (+ the unattributed residual) sum EXACTLY
+      to the executable's memory_analysis() temp+output bytes;
+    - the unattributed residual is <= 1% of the peak attribution;
+    - the live-bytes timeline has strictly increasing program
+      positions and covers the peak;
+    - the peak snapshot table is non-empty and the class split names
+      the parameters;
+    - snapshot()["mem_profile"] exposes the same data, json-safe.
+
+    Side effect: like telemetry_smoke, the PROCESS-GLOBAL monitor is
+    reset; standalone callers should snapshot first."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+
+    steps = 6
+    was_enabled = monitor.is_enabled()
+    monitor.reset()
+    monitor.enable()
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [None, 64])
+                y = fluid.data("y", [None, 1])
+                h = fluid.layers.fc(x, 64, act="relu")
+                pred = fluid.layers.fc(h, 1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(0.01).minimize(loss)
+        mesh_devices = len(jax.devices())
+        # 128 examples PER DEVICE, whatever the mesh: the <=1% residual
+        # bound is an attribution-coverage assertion on real working
+        # buffers — a shrinking per-device batch would turn XLA's
+        # constant-size parameter-plumbing copies (the honest residual)
+        # into bound-breaking noise
+        batch = 128 * max(2, mesh_devices)
+        prog = main
+        if mesh_devices > 1:
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name,
+                places=mesh_devices).with_telemetry("mem_profile_smoke")
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        rng = np.random.default_rng(0)
+        feed = {"x": rng.standard_normal((batch, 64)).astype(np.float32),
+                "y": rng.standard_normal((batch, 1)).astype(np.float32)}
+        for _ in range(steps):
+            exe.run(prog, feed=feed, fetch_list=[loss], scope=scope,
+                    return_numpy=False)
+
+        prof = monitor.mem_profile_split()
+        snap = monitor.snapshot()
+        checks = {"profile_present": prof is not None}
+        if prof is not None:
+            scopes = prof["scopes"]
+            peak_sum = sum(d["peak_bytes"] for d in scopes.values()) \
+                + prof["unattributed"]["peak_bytes"]
+            tl = prof["timeline"]
+            checks.update({
+                # exact: scale_groups_exact assigns the float
+                # remainder, so == (not approx) is the contract
+                "peak_sum_exact": peak_sum
+                == prof["totals"]["attributed_bytes"]
+                and (prof["totals"]["attributed_bytes"] or 0) > 0,
+                "residual_under_1pct":
+                    prof["unattributed"]["peak_pct"] <= 1.0,
+                "timeline_monotone": len(tl) >= 2 and all(
+                    tl[i][0] < tl[i + 1][0] for i in range(len(tl) - 1)),
+                "timeline_covers_peak": any(
+                    p == prof["peak"]["pos"] for p, _ in tl),
+                "peak_table_nonempty": bool(prof["top_buffers"]),
+                "classes_name_params":
+                    "parameter" in (prof.get("classes") or {}),
+                "snapshot_rows": bool(snap.get("mem_profile"))
+                and json.dumps(snap["mem_profile"]) is not None,
+            })
+        ok = all(v for v in checks.values() if isinstance(v, bool))
+        row = {"metric": "mem_profile_smoke", "value": int(ok),
+               "unit": "ok", "vs_baseline": None,
+               "mesh_devices": mesh_devices,
+               "peak_hbm_bytes": (prof["peak"].get("hbm_bytes")
+                                  or prof["peak"]["model_bytes"])
+               if prof else None,
+               "attributed_scopes": len(prof["scopes"]) if prof else 0,
+               "unattributed_peak_pct": round(
+                   prof["unattributed"]["peak_pct"], 4) if prof
+               else None,
+               "checks": checks,
+               "telemetry": _telemetry_brief(snap)}
+        if not ok:
+            row["error"] = "failed checks: " + ", ".join(
+                k for k, v in checks.items()
+                if isinstance(v, bool) and not v)
+        return row
+    finally:
+        monitor.disable()
+        monitor.reset()
+        if was_enabled:
+            monitor.enable()
+
+
+def main_mem_profile_smoke():
+    """`python bench.py mem_profile_smoke` — CI/tooling entry: the
+    HBM-attribution smoke row standalone on a 2-device virtual CPU
+    mesh, persisted to BENCH_TPU.json under rows["mem_profile_smoke"].
+    Exit 0 only when every peak-memory invariant holds."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=2")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_mem_profile_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["mem_profile_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def bench_fault_tolerance_smoke(on_tpu, peak):
     """Fault-tolerance chaos row (ISSUE 4 CI satellite): a tiny fc
     train loop through the PUBLIC train_from_dataset on the CPU mesh
@@ -1678,6 +1813,8 @@ def main():
         ("dispatch_overhead", "dispatch_overhead", bench_dispatch_overhead),
         ("telemetry_smoke", "telemetry_smoke", bench_telemetry_smoke),
         ("op_profile_smoke", "op_profile_smoke", bench_op_profile_smoke),
+        ("mem_profile_smoke", "mem_profile_smoke",
+         bench_mem_profile_smoke),
         ("fault_tolerance_smoke", "fault_tolerance_smoke",
          bench_fault_tolerance_smoke),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
@@ -1748,6 +1885,8 @@ if __name__ == "__main__":
         sys.exit(main_telemetry_smoke())
     if "op_profile_smoke" in sys.argv[1:]:
         sys.exit(main_op_profile_smoke())
+    if "mem_profile_smoke" in sys.argv[1:]:
+        sys.exit(main_mem_profile_smoke())
     if "fault_tolerance_smoke" in sys.argv[1:]:
         sys.exit(main_fault_tolerance_smoke())
     main()

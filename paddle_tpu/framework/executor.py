@@ -953,13 +953,20 @@ class Executor:
                 if fr.enabled:
                     fr.note_compile_marker(
                         telemetry_key or "prog%x" % id(program))
-            with _dispatch_span("executor.run.trace"):
-                compiled = self._build(program, fetch_names,
-                                       plan.persist_names, dp_mesh=dp_mesh,
-                                       precision=precision,
-                                       feed_casts=feed_casts,
-                                       telemetry_key=telemetry_key,
-                                       guard_on=guard_on)
+            try:
+                with _dispatch_span("executor.run.trace"):
+                    compiled = self._build(program, fetch_names,
+                                           plan.persist_names,
+                                           dp_mesh=dp_mesh,
+                                           precision=precision,
+                                           feed_casts=feed_casts,
+                                           telemetry_key=telemetry_key,
+                                           guard_on=guard_on)
+            except Exception as e:
+                # a program too big to even COMPILE dies with the same
+                # RESOURCE_EXHAUSTED shape an execution OOM does
+                self._oom_postmortem(e, mon_on)
+                raise
             if use_program_cache:
                 self._cache[key] = (compiled, program)
         else:
@@ -967,38 +974,50 @@ class Executor:
                 mon.counter("compiled_step.hit").add(1)
             compiled = entry[0]
 
-        with _dispatch_span("executor.run.dispatch"):
-            retry_policy = res.active_retry()
+        try:
+            with _dispatch_span("executor.run.dispatch"):
+                retry_policy = res.active_retry()
 
-            def _dispatch():
-                # an injected transient error fires here, INSIDE the
-                # retried region, so backoff + re-dispatch is the real
-                # recovery path under test
-                if res.faultinject.is_armed():
-                    res.faultinject.check_transient()
-                out = compiled(state, feed_arrays, run_key)
+                def _dispatch():
+                    # an injected transient error fires here, INSIDE
+                    # the retried region, so backoff + re-dispatch is
+                    # the real recovery path under test
+                    if res.faultinject.is_armed():
+                        res.faultinject.check_transient()
+                    out = compiled(state, feed_arrays, run_key)
+                    if retry_policy is not None:
+                        # async dispatch defers real XLA/PJRT failures
+                        # to the next sync point — which would sit
+                        # OUTSIDE this retried region.  With retry on,
+                        # block here so a transient execution error
+                        # surfaces where backoff can catch it: fault
+                        # tolerance trades the steps-ahead pipeline
+                        # for retryability.
+                        jax.block_until_ready(out)
+                    return out
+
+                # async dispatch (retry off): this returns device
+                # futures without a sync, and the donated `state`
+                # buffers are rebound to the NEW device arrays — never
+                # via a host copy, which would both block and
+                # resurrect freed donated buffers as host memory
                 if retry_policy is not None:
-                    # async dispatch defers real XLA/PJRT failures to
-                    # the next sync point — which would sit OUTSIDE
-                    # this retried region.  With retry on, block here
-                    # so a transient execution error surfaces where
-                    # backoff can catch it: fault tolerance trades the
-                    # steps-ahead pipeline for retryability.
-                    jax.block_until_ready(out)
-                return out
-
-            # async dispatch (retry off): this returns device futures
-            # without a sync, and the donated `state` buffers are
-            # rebound to the NEW device arrays — never via a host copy,
-            # which would both block and resurrect freed donated
-            # buffers as host memory
-            if retry_policy is not None:
-                new_state, fetches = res.call_with_retry(_dispatch,
-                                                         retry_policy)
-            else:
-                new_state, fetches = _dispatch()
-            for n, v in new_state.items():
-                scope.set_var(n, v)
+                    new_state, fetches = res.call_with_retry(
+                        _dispatch, retry_policy)
+                else:
+                    new_state, fetches = _dispatch()
+                for n, v in new_state.items():
+                    scope.set_var(n, v)
+        except Exception as e:
+            # RESOURCE_EXHAUSTED is a taxonomy dump trigger: write the
+            # peak-HBM post-mortem (peak table, live-bytes timeline,
+            # requested-vs-device bytes, last-K steps) BEFORE the
+            # error propagates — a run that died of OOM must explain
+            # what was resident.  (With retry enabled an OOM is
+            # retried first; only the error that finally escapes —
+            # RetriesExhausted chains it — lands here.)
+            self._oom_postmortem(e, mon_on)
+            raise
         guard_flag = None
         if guard_on:
             # the fused all-finite flag rides back as the LAST fetch;
@@ -1033,7 +1052,14 @@ class Executor:
             self._apply_guard_policy(res, guard, guard_flag, plan, scope)
         if return_numpy:
             with _dispatch_span("executor.run.fetch"):
-                return _materialize(fetches)
+                try:
+                    return _materialize(fetches)
+                except Exception as e:
+                    # async dispatch (retry off) defers execution
+                    # failures to this sync point — an OOM surfacing
+                    # here still gets its post-mortem
+                    self._oom_postmortem(e, mon_on)
+                    raise
         # a fetch naming a persistable var ALIASES the buffer just bound
         # into the scope; the NEXT run donates that buffer, which would
         # invalidate a still-held device fetch.  A device-side copy (no
@@ -1041,6 +1067,23 @@ class Executor:
         # steady state.
         return [jnp.copy(f) if n in new_state else f
                 for n, f in zip(fetch_names, fetches)]
+
+    @staticmethod
+    def _oom_postmortem(exc, mon_on):
+        """OOM dump trigger (resilience.taxonomy.is_oom): count the
+        event and have the flight recorder write the peak-HBM
+        post-mortem before the caller re-raises.  Never raises itself
+        — forensics must not mask the real error."""
+        try:
+            if not _res().is_oom(exc):
+                return
+            if mon_on:
+                _mon().counter("resilience.oom_events").add(1)
+            fr = _fr()
+            if fr.enabled:
+                fr.dump_oom(exc)
+        except Exception:
+            pass
 
     @staticmethod
     def _record_step_metrics(mon, t0, feed_arrays, fetches,
@@ -1594,6 +1637,14 @@ class Executor:
                     dp_mesh, precision=None, feed_casts=None,
                     telemetry_key="program", guard_on=False):
         dp = dp_mesh is not None
+        # var maps for the mem-profile's variable-class attribution:
+        # which entry arguments are optimizer-updated parameters vs
+        # other persistable state (stats buffers, optimizer moments)
+        var_info = {
+            "params": frozenset(n for bs in sections
+                                for n in bs.param_names),
+            "persist": frozenset(persist_names),
+        }
 
         def make_step(dp):
             return self._make_step_fn(ops, sections, fetch_names,
@@ -1609,7 +1660,8 @@ class Executor:
             # jit call otherwise
             return _mon().instrument_jit(
                 jax.jit(apply_precision_policy(step, precision),
-                        donate_argnums=(0,)), key=telemetry_key)
+                        donate_argnums=(0,)), key=telemetry_key,
+                var_info=var_info)
 
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
@@ -1661,7 +1713,7 @@ class Executor:
                         out_specs=(P(), out_fetch_specs),
                         check_vma=False), precision),
                         donate_argnums=(0,)),
-                    key=telemetry_key + ":dp")
+                    key=telemetry_key + ":dp", var_info=var_info)
                 memo[sig] = fn
             return fn(state, feeds, key)
 

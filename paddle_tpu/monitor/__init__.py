@@ -35,6 +35,7 @@ from .jsonl_writer import JsonlWriter, read_jsonl
 from .registry import Counter, Gauge, MetricsRegistry
 from .session import MetricsSession
 from . import op_profile                                  # noqa: F401
+from . import mem_profile                                 # noqa: F401
 from . import flight_recorder  # noqa: F401  — installs crash hooks
 
 __all__ = [
@@ -44,6 +45,7 @@ __all__ = [
     "compile_events", "jsonl_path", "merged_trace_events",
     "op_table", "op_profile_split", "op_profile", "flight_recorder",
     "flight_dump",
+    "mem_profile", "mem_profile_split", "mem_table", "peak_breakdown",
     "MetricsRegistry", "MetricsSession", "CompileLedger", "JsonlWriter",
     "read_jsonl", "Counter", "Gauge", "PEAK_FLOPS", "peak_flops",
     "parse_cost_analysis", "parse_memory_analysis",
@@ -133,10 +135,13 @@ def aot_compile(jitfn, *args, key="jit"):
     return _ledger.aot_compile(jitfn, *args, key=key)
 
 
-def instrument_jit(jitfn, key="jit"):
+def instrument_jit(jitfn, key="jit", var_info=None):
     """Wrap a jitted callable so its compiles land in the ledger while
-    telemetry is enabled; a plain pass-through call otherwise."""
-    return _ledger.instrument_jit(jitfn, key=key, is_enabled=is_enabled)
+    telemetry is enabled; a plain pass-through call otherwise.
+    `var_info` (the executor's param/persist var maps) classes the
+    mem-profile's entry-argument buffers."""
+    return _ledger.instrument_jit(jitfn, key=key, is_enabled=is_enabled,
+                                  var_info=var_info)
 
 
 # -- reading ------------------------------------------------------------
@@ -184,6 +189,45 @@ def op_table(key=None):
                                step_time_s=_session.mean_step_time())
 
 
+def mem_profile_split(key=None):
+    """The newest peak-memory attribution (monitor/mem_profile.py
+    structure: peak, timeline, per-scope peak bytes, classes, top
+    buffers, unattributed residual), optionally restricted to
+    compile-ledger key `key`.  None until a compile has been
+    analyzed."""
+    for e in reversed(_ledger.events()):
+        if key is not None and e.get("key") != key:
+            continue
+        if e.get("mem_profile"):
+            return e["mem_profile"]
+    return None
+
+
+def mem_table(key=None):
+    """Ordered per-scope peak-HBM rows of the newest memory profile —
+    what stop_profiler's "Peak HBM" section prints."""
+    return mem_profile.mem_table(mem_profile_split(key))
+
+
+def peak_breakdown(key=None):
+    """Compact peak-HBM view of the newest memory profile: headline
+    peak bytes, per-variable-class split, the top peak scopes, the
+    peak snapshot table, and the unattributed residual — json-safe
+    (what snapshot()["mem_profile"] embeds)."""
+    prof = mem_profile_split(key)
+    if not prof:
+        return None
+    return {
+        "peak": prof.get("peak"),
+        "totals": prof.get("totals"),
+        "classes": prof.get("classes"),
+        "scopes": mem_profile.mem_table(prof),
+        "top_buffers": prof.get("top_buffers"),
+        "unattributed": prof.get("unattributed"),
+        "donated": prof.get("donated"),
+    }
+
+
 def flight_dump(reason="manual"):
     """Force a flight-recorder post-mortem dump now; returns the JSONL
     path (None when the recorder is disabled)."""
@@ -203,6 +247,9 @@ def snapshot():
     rows = op_table()
     if rows:
         out["op_profile"] = rows
+    mem = peak_breakdown()
+    if mem:
+        out["mem_profile"] = mem
     return out
 
 

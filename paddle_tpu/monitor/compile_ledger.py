@@ -119,7 +119,8 @@ class CompileLedger:
 
     # -- recording ------------------------------------------------------
     def record(self, key, compile_s, flops=None, bytes_accessed=None,
-               memory=None, trace_s=None, source="aot", op_profile=None):
+               memory=None, trace_s=None, source="aot", op_profile=None,
+               mem_profile=None):
         event = {
             "kind": "compile",
             "key": key,
@@ -138,6 +139,8 @@ class CompileLedger:
             event["memory"] = memory
         if op_profile is not None:
             event["op_profile"] = op_profile
+        if mem_profile is not None:
+            event["mem_profile"] = mem_profile
         with self._lock:
             self._events.append(event)
         self._registry.counter("compile.count").add(1)
@@ -151,17 +154,30 @@ class CompileLedger:
 
             # mirror into the always-on post-mortem ring (full analysis
             # attached); the recorder also keeps the newest attribution
-            # split as its "what was the step made of" section
+            # split as its "what was the step made of" section and the
+            # newest memory profile as the peak-HBM section an OOM
+            # post-mortem writes
             flight_recorder.get().note_compile(event)
             if op_profile is not None:
                 flight_recorder.get().note_op_table(op_profile)
+            if mem_profile is not None:
+                # keyed like the aux-sink record, so a dump's
+                # kind="mem_profile" line names its program too
+                flight_recorder.get().note_mem_profile(
+                    {"key": key, **mem_profile})
         except Exception:
             pass
-        if op_profile is not None and self._aux_sink is not None:
-            self._aux_sink({"kind": "op_profile", "key": key,
-                            "ts_us": event["ts_us"],
-                            "wall_time": event["wall_time"],
-                            **op_profile})
+        if self._aux_sink is not None:
+            if op_profile is not None:
+                self._aux_sink({"kind": "op_profile", "key": key,
+                                "ts_us": event["ts_us"],
+                                "wall_time": event["wall_time"],
+                                **op_profile})
+            if mem_profile is not None:
+                self._aux_sink({"kind": "mem_profile", "key": key,
+                                "ts_us": event["ts_us"],
+                                "wall_time": event["wall_time"],
+                                **mem_profile})
         return event
 
     def events(self):
@@ -173,11 +189,16 @@ class CompileLedger:
             del self._events[:]
 
     # -- AOT compile + instrumentation ---------------------------------
-    def aot_compile(self, jitfn, *args, key="jit"):
+    def aot_compile(self, jitfn, *args, key="jit", var_info=None):
         """lower+compile `jitfn` at `args`, recording one compile event
         (wall-clocked compile, cost_analysis, memory_analysis).  Returns
         the compiled executable, or None when the callable does not
-        support AOT (caller falls back to the implicit-jit path)."""
+        support AOT (caller falls back to the implicit-jit path).
+
+        `var_info` ({"params": ..., "persist": ...} — the executor's
+        param/persist var maps) feeds the mem-profile's variable-class
+        attribution; the analysis runs without it, with entry arguments
+        classed by their state/feeds container only."""
         lower = getattr(jitfn, "lower", None)
         if lower is None:
             return None
@@ -197,6 +218,13 @@ class CompileLedger:
             memory = parse_memory_analysis(compiled.memory_analysis())
         except Exception:
             memory = None
+        # the optimized-HLO pretty-print is the expensive shared input
+        # of both attribution passes (multi-MB for real models): fetch
+        # it ONCE and hand it to each
+        try:
+            hlo_text = compiled.as_text()
+        except Exception:
+            hlo_text = None
         try:
             # per-op attribution: parse the optimized HLO's named-scope
             # metadata and split the cost-analysis totals per ProgramDesc
@@ -204,16 +232,27 @@ class CompileLedger:
             # milliseconds of text parsing next to seconds of XLA.
             from .op_profile import static_split
 
-            op_profile = static_split(compiled)
+            op_profile = static_split(compiled, text=hlo_text)
         except Exception:
             op_profile = None
+        try:
+            # peak-memory attribution from the same HLO text: buffer
+            # liveness + peak snapshot + live-bytes timeline
+            # (monitor/mem_profile.py), scaled to memory_analysis
+            from .mem_profile import static_mem_profile
+
+            mem_profile = static_mem_profile(compiled, var_info=var_info,
+                                             text=hlo_text)
+        except Exception:
+            mem_profile = None
         self.record(key, compile_s=t2 - t1, trace_s=t1 - t0,
                     flops=cost["flops"],
                     bytes_accessed=cost["bytes_accessed"], memory=memory,
-                    op_profile=op_profile)
+                    op_profile=op_profile, mem_profile=mem_profile)
         return compiled
 
-    def instrument_jit(self, jitfn, key="jit", is_enabled=None):
+    def instrument_jit(self, jitfn, key="jit", is_enabled=None,
+                       var_info=None):
         """Wrap a jitted callable so its compile goes through
         `aot_compile` (timed + analyzed) while telemetry is on.  Off
         before any compile happened, or when AOT fails, the call goes
@@ -251,7 +290,8 @@ class CompileLedger:
             sig = _abstract_sig(args)
             fn = memo.get(sig)
             if fn is None:
-                fn = self.aot_compile(jitfn, *args, key=key)
+                fn = self.aot_compile(jitfn, *args, key=key,
+                                      var_info=var_info)
                 if fn is None:
                     # no AOT for this callable: time the first (implicit
                     # compile) call so the ledger still counts it
@@ -327,4 +367,10 @@ class CompileLedger:
                 if e.get(field) is not None:
                     out[field] = e[field]
                     break
+        for e in reversed(events):
+            if e.get("mem_profile"):
+                pk = e["mem_profile"].get("peak") or {}
+                out["peak_hbm_bytes"] = (pk.get("hbm_bytes")
+                                         or pk.get("model_bytes"))
+                break
         return out

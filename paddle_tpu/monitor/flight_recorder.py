@@ -24,6 +24,14 @@ Dump triggers, wired through the resilience taxonomy paths:
 - **injected crash** — ``faultinject.crash_point`` dumps before raising
   InjectedCrash (the SIGKILL stand-in; a real SIGKILL can't dump, the
   simulation records what the kill interrupted).
+- **OOM** (ISSUE 6) — RESOURCE_EXHAUSTED is a dump trigger in the
+  resilience taxonomy: the executor calls ``dump_oom(exc)`` before
+  re-raising, so the post-mortem carries the peak-HBM attribution
+  table + live-bytes timeline (the newest mem_profile), a
+  ``kind="oom"`` record with the requested bytes parsed from the
+  error and the device's own memory stats, and — when the backend
+  supports it — a ``jax.profiler.device_memory_profile()`` capture
+  written alongside as ``flight_<pid>.memprof.pb.gz``.
 - **atexit backstop** — if a severe event was recorded but nothing
   dumped since (error swallowed, then sys.exit), the exit handler
   writes the dump; clean exits write nothing.
@@ -37,14 +45,63 @@ import atexit
 import collections
 import json
 import os
+import re
 import sys
 import threading
 import time
 
 from .. import flags
 
-__all__ = ["FlightRecorder", "get", "dump", "note_event",
+__all__ = ["FlightRecorder", "get", "dump", "dump_oom", "note_event",
            "install_hooks"]
+
+
+# requested-bytes extraction from XLA/PJRT OOM messages — the two
+# shapes the runtime actually prints: "... to allocate 123456 bytes"
+# and "Attempting to allocate 1.91G[iB]"
+_OOM_BYTES_RES = (
+    re.compile(r"allocat\w*[^\d]{0,40}?([\d][\d,]*)\s*bytes",
+               re.IGNORECASE),
+    re.compile(r"allocat\w*[^\d]{0,40}?([\d][\d,]*(?:\.\d+)?)\s*"
+               r"([KMGT])i?B?\b", re.IGNORECASE),
+)
+_UNIT = {"K": 2 ** 10, "M": 2 ** 20, "G": 2 ** 30, "T": 2 ** 40}
+
+
+def _parse_requested_bytes(msg):
+    """Bytes the failed allocation asked for, parsed from the error
+    text; None when the message carries no recognizable size."""
+    if not msg:
+        return None
+    for pat in _OOM_BYTES_RES:
+        m = pat.search(msg)
+        if m:
+            n = float(m.group(1).replace(",", ""))
+            if m.lastindex and m.lastindex >= 2:
+                n *= _UNIT[m.group(2).upper()]
+            return int(n)
+    return None
+
+
+def _device_memory_stats():
+    """Per-device allocator stats (bytes_in_use / bytes_limit / peaks)
+    from the backend, {} when the platform exposes none (CPU)."""
+    out = {}
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", None)
+            s = stats() if stats is not None else None
+            if not s:
+                continue
+            out[str(d.id)] = {
+                k: int(v) for k, v in s.items()
+                if isinstance(v, (int, float))
+                and not isinstance(v, bool)}
+    except Exception:
+        return {}
+    return out
 
 
 class FlightRecorder:
@@ -64,6 +121,9 @@ class FlightRecorder:
         # post-mortem must count recovery events even with telemetry off
         self._counters = {}
         self._last_op_table = None
+        self._last_mem_profile = None
+        self._last_oom = None
+        self._oom_memprof = None   # device_memory_profile() capture
         self._step_seq = 0
         self._last_step_ns = None
         self._dirty = None        # severe-event reason awaiting a dump
@@ -148,6 +208,57 @@ class FlightRecorder:
         with self._lock:
             self._last_op_table = split
 
+    def note_mem_profile(self, profile):
+        """Latest peak-memory attribution (the mem_profile structure:
+        peak/timeline/scopes/classes/top_buffers) — the 'what was
+        resident at the peak' section an OOM post-mortem writes."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._last_mem_profile = profile
+
+    def note_oom(self, exc):
+        """Record one memory-exhaustion event: the error text, the
+        requested bytes parsed from it, the device allocator's own
+        stats (requested-vs-device), and — when the backend supports
+        it — a device_memory_profile() capture written alongside the
+        next dump.  Arms the atexit backstop (severe)."""
+        if not self.enabled:
+            return
+        self.note_event("oom", severe=True,
+                        error=f"{type(exc).__name__}: {exc}"[:200])
+        rec = {"kind": "oom",
+               "error": f"{type(exc).__name__}: {exc}"[:2000],
+               "ts_us": time.perf_counter_ns() / 1e3,
+               "wall_time": time.time()}
+        req = _parse_requested_bytes(str(exc))
+        if req is not None:
+            rec["requested_bytes"] = req
+        device = _device_memory_stats()
+        if device:
+            rec["device_memory"] = device
+        memprof = None
+        try:
+            import jax
+
+            memprof = jax.profiler.device_memory_profile()
+        except Exception:
+            pass
+        with self._lock:
+            self._last_oom = rec
+            if memprof:
+                self._oom_memprof = memprof
+
+    def dump_oom(self, exc, directory=None):
+        """OOM post-mortem: capture the memory forensics (note_oom)
+        and dump — the executor calls this BEFORE re-raising a
+        RESOURCE_EXHAUSTED so the run's last act is explaining its own
+        death.  Returns the dump path (None when disabled)."""
+        if not self.enabled:
+            return None
+        self.note_oom(exc)
+        return self.dump(f"oom:{type(exc).__name__}", directory)
+
     # -- reading --------------------------------------------------------
     def snapshot(self):
         with self._lock:
@@ -157,6 +268,8 @@ class FlightRecorder:
                 "events": list(self._events),
                 "counters": dict(self._counters),
                 "op_table": self._last_op_table,
+                "mem_profile": self._last_mem_profile,
+                "oom": self._last_oom,
                 "step_seq": self._step_seq,
             }
 
@@ -167,9 +280,13 @@ class FlightRecorder:
             self._events.clear()
             self._counters.clear()
             self._last_op_table = None
+            self._last_mem_profile = None
+            self._last_oom = None
+            self._oom_memprof = None
             self._step_seq = 0
             self._last_step_ns = None
             self._dirty = None
+            self._last_dump = None
 
     # -- the post-mortem ------------------------------------------------
     def dump(self, reason, directory=None):
@@ -217,6 +334,12 @@ class FlightRecorder:
             # tools/telemetry_report.py's per-op section reads a dump
             # exactly like a live stream
             lines.append({"kind": "op_profile", **snap["op_table"]})
+        if snap["mem_profile"]:
+            # likewise one kind="mem_profile" line: peak table +
+            # live-bytes timeline, identical to the telemetry stream's
+            lines.append({"kind": "mem_profile", **snap["mem_profile"]})
+        if snap["oom"]:
+            lines.append(snap["oom"])
         lines.extend(snap["events"])
         lines.extend(snap["compiles"])
         lines.extend(snap["steps"])
@@ -230,6 +353,16 @@ class FlightRecorder:
             self._write_trace(trace_path, snap)
         except Exception:
             trace_path = None
+        with self._lock:
+            memprof = self._oom_memprof
+        if memprof:
+            # the jax allocator's own pprof capture rides alongside
+            # (pprof -http=: flight_<pid>.memprof.pb.gz)
+            try:
+                with open(base + ".memprof.pb.gz", "wb") as f:
+                    f.write(memprof)
+            except Exception:
+                pass
         with self._lock:
             self._dirty = None
             self._last_dump = jsonl_path
@@ -279,6 +412,10 @@ def get():
 
 def dump(reason, directory=None):
     return _RECORDER.dump(reason, directory)
+
+
+def dump_oom(exc, directory=None):
+    return _RECORDER.dump_oom(exc, directory)
 
 
 def note_event(kind, severe=False, **fields):

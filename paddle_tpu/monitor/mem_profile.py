@@ -1,0 +1,537 @@
+"""Per-buffer HBM liveness + peak-memory attribution (ISSUE 6 tentpole).
+
+`memory_analysis()` says HOW MUCH a compiled program needs
+(argument/output/temp bytes); nothing so far says WHAT is resident at
+the peak or WHICH ProgramDesc op/variable put it there — and peak
+memory, not FLOPs, is what bounds batch size and remat choices.  This
+module rebuilds that lens from the same source op_profile already
+parses, the compiled executable's optimized-HLO text:
+
+1. **Liveness** — the optimized module is scheduled
+   (``is_scheduled=true``), so ENTRY instruction order IS execution
+   order.  Each instruction's output buffer is sized from its shape
+   and lives from its definition to its last use (root outputs to the
+   end of the program); alias-producing opcodes (tuple,
+   get-tuple-element, bitcast) allocate nothing but extend their
+   underlying buffers' lives, and ``input_output_alias`` entries —
+   jit donation — mark outputs that REUSE a donated argument's storage
+   (zero new allocation, class ``donated_reuse``).
+2. **Attribution** — every buffer lands on (a) the PR-5 executor scope
+   (``{section}/{op_type}_{idx}`` from ``metadata.op_name``; metadata-
+   less instructions inherit the majority scope of their dataflow
+   neighbors, same discipline as op_profile) and (b) a variable class:
+   ``parameter`` / ``optimizer_state`` (entry arguments resolved
+   through the executor's param/persist var maps via the
+   ``state['w']`` / ``feeds['x']`` arg-name metadata jax stamps on
+   parameters), ``activation`` (feeds + forward-section outputs),
+   ``gradient`` (``transpose(jvp(...))`` backward values), ``temp``,
+   ``donated_reuse``.
+3. **Products** — a live-bytes-over-program **timeline** (program-
+   position curve, emitted as a chrome counter track in the merged
+   trace), a **peak snapshot table** (top-K buffers live at the
+   argmax, with scope/class/shape/bytes/%-of-peak), and per-scope
+   **peak contributions scaled so they sum EXACTLY** to
+   ``memory_analysis()`` temp+output bytes — op_profile's integer
+   remainder-assignment scheme, unattributed residual in an explicit
+   bucket the acceptance bound (<= 1%) is measured on.
+
+The model only has to get buffer *proportions* right; XLA's
+memory_analysis stays authoritative for magnitude.  Like op_profile,
+this module imports neither jax nor numpy at module level.
+"""
+
+import re
+
+from .op_profile import (UNATTRIBUTED, _OPNAME_RE, _shape_elems_bytes,
+                         _split_instruction, _COMP_HEADER_RE,
+                         scale_groups_exact, scope_of)
+
+__all__ = [
+    "CLASSES", "parse_hlo_liveness", "build_mem_profile",
+    "static_mem_profile", "mem_table",
+]
+
+# the variable classes every buffer is binned into
+CLASS_PARAMETER = "parameter"
+CLASS_OPT_STATE = "optimizer_state"
+CLASS_ACTIVATION = "activation"
+CLASS_GRADIENT = "gradient"
+CLASS_TEMP = "temp"
+CLASS_DONATED = "donated_reuse"
+CLASSES = (CLASS_PARAMETER, CLASS_OPT_STATE, CLASS_ACTIVATION,
+           CLASS_GRADIENT, CLASS_TEMP, CLASS_DONATED)
+
+# opcodes whose result is a VIEW of (or bookkeeping over) existing
+# buffers — zero new allocation, but they extend their operands' lives
+# to wherever the view is consumed.  `while` mutates its carry tuple in
+# place (the buffers were allocated at the tuple construction).
+_ALIAS_OPCODES = frozenset((
+    "tuple", "get-tuple-element", "bitcast", "bitcast-convert",
+    "optimization-barrier", "add-dependency", "while", "domain",
+    "after-all",
+))
+
+# jax stamps entry parameters with the flattened arg path as op_name:
+#   state['w']   feeds['x']   key
+_ARG_PATH_RE = re.compile(r"^(\w+)\[\\?['\"](.*?)\\?['\"]\]")
+
+
+def _arg_class(arg_name, var_info):
+    """Variable class of one entry argument from its arg-path metadata
+    + the executor's var maps.  var_info: {"params": set of optimizer-
+    updated parameter names, "persist": set of persistable var names}
+    (both optional — without them the container name decides)."""
+    if not arg_name:
+        return CLASS_TEMP
+    m = _ARG_PATH_RE.match(arg_name)
+    if m is None:
+        return CLASS_TEMP                       # the rng key, etc.
+    container, var = m.group(1), m.group(2)
+    if container == "feeds":
+        return CLASS_ACTIVATION
+    if container != "state":
+        return CLASS_TEMP
+    params = (var_info or {}).get("params") or ()
+    persist = (var_info or {}).get("persist") or ()
+    if var in params:
+        return CLASS_PARAMETER
+    if var in persist:
+        return CLASS_OPT_STATE
+    # a state entry with no var map at all: parameter is the honest
+    # default (state IS the persistable set on the executor path)
+    return CLASS_PARAMETER if not persist else CLASS_OPT_STATE
+
+
+def _buffer_class(raw_op_name, scope):
+    """Variable class of a computed (non-argument) buffer."""
+    if raw_op_name and "transpose(jvp(" in raw_op_name:
+        return CLASS_GRADIENT
+    if scope and scope.split("/", 1)[0].startswith("fwd"):
+        return CLASS_ACTIVATION
+    return CLASS_TEMP
+
+
+def _parse_output_aliases(hlo_text):
+    """``input_output_alias={ {0}: (0, {}, may-alias), ... }`` from the
+    module header -> {output_tuple_index: parameter_number}.  An empty
+    output path ({}) means the whole (single) output, index 0."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return {}
+    i = start + len("input_output_alias={") - 1
+    depth = 0
+    for j in range(i, min(len(hlo_text), i + 100000)):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    else:
+        return {}
+    body = hlo_text[i + 1:j]
+    out = {}
+    for m in re.finditer(r"\{\s*([0-9]*)[0-9,\s]*\}\s*:\s*\(\s*(\d+)",
+                         body):
+        out_idx = int(m.group(1)) if m.group(1) else 0
+        out[out_idx] = int(m.group(2))
+    return out
+
+
+def parse_hlo_liveness(hlo_text, known_scopes=None, var_info=None):
+    """Walk an optimized (scheduled) HLO module's text form into
+    per-buffer liveness rows.
+
+    Returns ``{"buffers": [...], "positions": N}`` where each buffer is
+    ``{"name", "opcode", "scope", "class", "shape", "bytes",
+    "alloc_bytes", "def", "end", "arg", "donated"}``:
+
+    - ``bytes`` is the buffer's full size; ``alloc_bytes`` is what the
+      program itself allocates for it — 0 for entry arguments (caller-
+      owned, the argument_bytes baseline), view opcodes, and outputs
+      aliased onto donated inputs.
+    - ``def``/``end`` are program positions (entry instruction index);
+      arguments are live from 0, root outputs and donated buffers to
+      the end.
+    - metadata-less instructions inherit the majority scope of their
+      scoped operands (``"inherited": True``), mirroring op_profile's
+      dataflow-neighbor attribution so the backward's bare
+      instructions don't flood the residual bucket.
+    """
+    aliases = _parse_output_aliases(hlo_text)
+    buffers = []
+    by_name = {}
+    last_use = {}
+    name_scope = {}
+    operand_map = {}
+    pending = []           # (buffer index, result name, operands)
+    root_name = None
+    root_operands = []
+    current = None
+    is_entry = False
+    pos = 0
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith("//"):
+            continue
+        header = _COMP_HEADER_RE.match(line)
+        if header and not line.startswith(" "):
+            current = header.group(2)
+            is_entry = bool(header.group(1))
+            continue
+        if not is_entry or line.startswith("}") or current is None:
+            continue
+        is_root = stripped.startswith("ROOT ")
+        parsed = _split_instruction(stripped[5:].strip() if is_root
+                                    else stripped)
+        if parsed is None:
+            continue
+        type_str, opcode, operand_str, attr_str = parsed
+        if opcode == "constant":
+            continue           # folded into the executable, not HBM temp
+        rm = re.match(r"(?:ROOT\s+)?%([\w.\-]+)\s*=", stripped)
+        res_name = rm.group(1) if rm else None
+        if res_name is None:
+            continue
+        _, out_bytes = _shape_elems_bytes(type_str)
+        operands = re.findall(r"%([\w.\-]+)", operand_str)
+        for o in operands:
+            last_use[o] = pos
+        m = _OPNAME_RE.search(line)
+        raw_op_name = m.group(1) if m else None
+        is_arg = opcode == "parameter"
+        if is_arg:
+            arg_name = (raw_op_name or "").replace("\\'", "'")
+            scope = None
+            cls = _arg_class(arg_name, var_info)
+        else:
+            arg_name = None
+            scope = scope_of(raw_op_name, known_scopes)
+            cls = _buffer_class(raw_op_name, scope)
+        buf = {
+            "name": res_name,
+            "opcode": opcode,
+            "scope": scope,
+            "class": cls,
+            "shape": type_str,
+            "bytes": int(out_bytes),
+            # arguments are caller-owned (the argument_bytes baseline);
+            # view opcodes allocate nothing
+            "alloc_bytes": (0 if is_arg or opcode in _ALIAS_OPCODES
+                            else int(out_bytes)),
+            "def": 0 if is_arg else pos,
+            "end": pos,
+            "arg": is_arg,
+            "donated": False,
+        }
+        if arg_name:
+            buf["arg_name"] = arg_name
+        buffers.append(buf)
+        by_name[res_name] = buf
+        operand_map[res_name] = operands
+        if scope is not None:
+            name_scope[res_name] = scope
+        elif not is_arg:
+            pending.append((len(buffers) - 1, res_name, operands))
+        if is_root:
+            root_name = res_name
+            root_operands = operands
+        pos += 1
+
+    n = pos
+    # liveness: defs already set; fold uses in, then extend through
+    # view chains (a tuple element is alive while any view of the
+    # tuple is) — a few reversed passes converge on the DAG
+    for name, p in last_use.items():
+        b = by_name.get(name)
+        if b is not None:
+            b["end"] = max(b["end"], p)
+    if root_name is not None:
+        by_name[root_name]["end"] = max(n - 1, 0)
+        for o in root_operands:
+            if o in by_name:
+                by_name[o]["end"] = max(by_name[o]["end"], n - 1)
+    for _ in range(4):
+        changed = False
+        for b in buffers:
+            if b["opcode"] not in _ALIAS_OPCODES:
+                continue
+            for o in operand_map.get(b["name"], ()):
+                ob = by_name.get(o)
+                if ob is not None and ob["end"] < b["end"]:
+                    ob["end"] = b["end"]
+                    changed = True
+        if not changed:
+            break
+    # arguments stay resident for the whole program: the caller holds
+    # them, and donated ones become outputs
+    for b in buffers:
+        if b["arg"]:
+            b["end"] = max(n - 1, 0)
+
+    # donation: an output tuple element aliased onto a parameter reuses
+    # the donated argument's storage — no new allocation
+    if aliases:
+        if root_name is not None and by_name.get(root_name, {}) \
+                .get("opcode") == "tuple":
+            outs = root_operands
+        else:
+            outs = [root_name] if root_name is not None else []
+        for out_idx in aliases:
+            if 0 <= out_idx < len(outs):
+                b = by_name.get(outs[out_idx])
+                if b is not None:
+                    b["donated"] = True
+                    b["end"] = max(n - 1, 0)
+                    if not b["arg"]:
+                        b["alloc_bytes"] = 0
+                        b["class"] = CLASS_DONATED
+
+    # parameter plumbing: a bare copy of an entry argument (XLA's
+    # donation/update realization) is the new value of that variable,
+    # not scratch — it keeps the argument's variable class.  Its scope
+    # stays None (no ProgramDesc op owns it): the residual bucket is
+    # the honest home for plumbing bytes.
+    for b in buffers:
+        if b["opcode"] == "copy" and b["scope"] is None and not b["arg"]:
+            ops_ = operand_map.get(b["name"], ())
+            if len(ops_) == 1:
+                ob = by_name.get(ops_[0])
+                if ob is not None and ob["arg"]:
+                    b["class"] = ob["class"]
+
+    # dataflow-neighbor scope inheritance for metadata-less
+    # instructions (op_profile's scheme): iterate so chains converge
+    for _ in range(4):
+        changed = False
+        for idx, res_name, operands in pending:
+            if buffers[idx]["scope"] is not None:
+                continue
+            votes = [name_scope[o] for o in operands if o in name_scope]
+            if not votes:
+                continue
+            best = max(sorted(set(votes)), key=votes.count)
+            buffers[idx]["scope"] = best
+            buffers[idx]["inherited"] = True
+            name_scope[res_name] = best
+            changed = True
+        if not changed:
+            break
+    return {"buffers": buffers, "positions": n}
+
+
+def _timeline(buffers, n, peak_pos, max_points=240):
+    """Model live bytes (argument baseline + live allocations) over
+    program position, downsampled to <= max_points strictly-increasing
+    positions, the peak position always kept exact."""
+    if n <= 0:
+        return []
+    delta = [0] * (n + 1)
+    base = 0
+    for b in buffers:
+        if b["arg"]:
+            base += b["bytes"]
+        elif b["alloc_bytes"]:
+            delta[b["def"]] += b["alloc_bytes"]
+            delta[min(b["end"], n - 1) + 1] -= b["alloc_bytes"]
+    curve = []
+    acc = base
+    for p in range(n):
+        acc += delta[p]
+        curve.append(acc)
+    stride = max(1, n // max_points)
+    keep = sorted(set(range(0, n, stride)) | {peak_pos, n - 1})
+    return [[p, int(curve[p])] for p in keep]
+
+
+def _peak_position(buffers, n):
+    """(argmax position, model live bytes there) of the program's own
+    allocations (arguments excluded — they are a constant baseline)."""
+    if n <= 0:
+        return 0, 0
+    delta = [0] * (n + 1)
+    for b in buffers:
+        if not b["arg"] and b["alloc_bytes"]:
+            delta[b["def"]] += b["alloc_bytes"]
+            delta[min(b["end"], n - 1) + 1] -= b["alloc_bytes"]
+    best_pos, best, acc = 0, 0, 0
+    for p in range(n):
+        acc += delta[p]
+        if acc > best:
+            best, best_pos = acc, p
+    return best_pos, best
+
+
+def build_mem_profile(parsed, memory=None, top_k=12):
+    """The json-safe mem-profile structure from parse_hlo_liveness
+    output + a parse_memory_analysis dict (None tolerated):
+
+    - ``peak``: argmax position, model bytes (args baseline + live
+      allocations), and ``hbm_bytes`` — the allocation high-water
+      bound ``argument + temp + output`` from memory_analysis.
+    - ``timeline``: [[position, model live bytes], ...], monotone
+      positions, peak kept exact — the chrome counter track's data.
+    - ``scopes`` / ``unattributed``: per-scope bytes of the program's
+      own buffers live at the peak, scaled so they sum EXACTLY to
+      memory_analysis temp+output bytes (model bytes kept alongside);
+      the residual share is ``unattributed["peak_pct"]``.
+    - ``classes``: model bytes at the peak per variable class,
+      arguments included (the parameter/optimizer/activation/gradient
+      split that actually bounds batch size).
+    - ``top_buffers``: top-K buffers live at the peak by resident
+      bytes, with scope/class/shape/%-of-peak.
+    """
+    buffers = parsed["buffers"]
+    n = parsed["positions"]
+    if not buffers or n <= 0:
+        return None
+    peak_pos, peak_alloc = _peak_position(buffers, n)
+    args_bytes = sum(b["bytes"] for b in buffers if b["arg"])
+    model_peak = args_bytes + peak_alloc
+
+    # donated buffers stay in the live set with zero resident bytes:
+    # the classes/top-buffers tables must SHOW donation reuse, not
+    # silently drop it
+    live = [b for b in buffers
+            if b["def"] <= peak_pos <= b["end"]
+            and (b["arg"] or b["donated"] or b["alloc_bytes"] > 0)]
+
+    # per-scope peak contributions over the program's OWN allocations
+    # (what temp+output measures), scaled exactly
+    per = {}
+    for b in live:
+        if b["arg"] or b["donated"]:
+            continue
+        key = b["scope"] or UNATTRIBUTED
+        d = per.setdefault(key, {"peak_bytes": 0.0, "model_bytes": 0,
+                                 "buffers": 0})
+        d["peak_bytes"] += float(b["alloc_bytes"])
+        d["model_bytes"] += b["alloc_bytes"]
+        d["buffers"] += 1
+        if b.get("inherited"):
+            d["inherited_buffers"] = d.get("inherited_buffers", 0) + 1
+    attributed_total = None
+    if memory and memory.get("temp_bytes") is not None:
+        attributed_total = float(memory["temp_bytes"]
+                                 + memory.get("output_bytes", 0))
+        if not scale_groups_exact(per, "peak_bytes", attributed_total) \
+                and attributed_total:
+            # the model saw nothing live at the peak but XLA reports
+            # temp+output bytes: everything is residual, loudly
+            d = per.setdefault(UNATTRIBUTED,
+                               {"peak_bytes": 0.0, "model_bytes": 0,
+                                "buffers": 0})
+            d["peak_bytes"] += attributed_total
+    scaled_total = sum(d["peak_bytes"] for d in per.values())
+    for d in per.values():
+        d["peak_pct"] = (d["peak_bytes"] / scaled_total * 100.0) \
+            if scaled_total > 0 else 0.0
+    unattributed = per.pop(UNATTRIBUTED, {"peak_bytes": 0.0,
+                                          "model_bytes": 0,
+                                          "buffers": 0, "peak_pct": 0.0})
+
+    # variable-class split at the peak: everything resident, arguments
+    # included — resident = arg bytes, computed = its allocation
+    classes = {}
+    for b in live:
+        resident = b["bytes"] if b["arg"] else b["alloc_bytes"]
+        if resident <= 0 and not b["donated"]:
+            continue
+        d = classes.setdefault(b["class"], {"peak_bytes": 0,
+                                            "buffers": 0})
+        d["peak_bytes"] += resident
+        d["buffers"] += 1
+
+    ranked = sorted(live, key=lambda b: -(b["bytes"] if b["arg"]
+                                          else b["alloc_bytes"]))
+    top_buffers = []
+    for b in ranked[:top_k]:
+        resident = b["bytes"] if b["arg"] else b["alloc_bytes"]
+        row = {"name": b["name"], "scope": b["scope"],
+               "class": b["class"], "shape": b["shape"],
+               "bytes": int(resident),
+               "pct_of_peak": round(resident / model_peak * 100.0, 3)
+               if model_peak > 0 else 0.0}
+        if b.get("arg_name"):
+            row["var"] = b["arg_name"]
+        if b["donated"]:
+            row["donated"] = True
+        top_buffers.append(row)
+
+    totals = {"attributed_bytes": (int(attributed_total)
+                                   if attributed_total is not None
+                                   else None),
+              "model_args_bytes": int(args_bytes)}
+    hbm_bytes = None
+    if memory:
+        for field in ("argument_bytes", "output_bytes", "temp_bytes",
+                      "alias_bytes"):
+            if memory.get(field) is not None:
+                totals[field] = int(memory[field])
+        if memory.get("temp_bytes") is not None:
+            hbm_bytes = (memory.get("argument_bytes", 0)
+                         + memory.get("output_bytes", 0)
+                         + memory["temp_bytes"])
+    donated = [b.get("arg_name") or b["name"] for b in buffers
+               if b["donated"]]
+    return {
+        "totals": totals,
+        "peak": {"pos": int(peak_pos), "model_bytes": int(model_peak),
+                 "model_alloc_bytes": int(peak_alloc),
+                 "hbm_bytes": (int(hbm_bytes) if hbm_bytes is not None
+                               else None)},
+        "timeline": _timeline(buffers, n, peak_pos),
+        "scopes": per,
+        "unattributed": unattributed,
+        "classes": classes,
+        "top_buffers": top_buffers,
+        "donated": donated,
+        "positions": int(n),
+    }
+
+
+def static_mem_profile(compiled, var_info=None, known_scopes=None,
+                       text=None):
+    """Peak-memory attribution of one compiled executable: parse its
+    optimized HLO text into buffer liveness, bin by executor scope and
+    variable class, scale the peak to its memory_analysis totals.
+    Returns the build_mem_profile structure, or None when the
+    executable exposes no text.  `text` shares one as_text() between
+    analyzers (same contract as op_profile.static_split)."""
+    if text is None:
+        try:
+            text = compiled.as_text()
+        except Exception:
+            return None
+    if not text:
+        return None
+    from .compile_ledger import parse_memory_analysis
+
+    try:
+        memory = parse_memory_analysis(compiled.memory_analysis())
+    except Exception:
+        memory = None
+    parsed = parse_hlo_liveness(text, known_scopes, var_info)
+    if not parsed["buffers"]:
+        return None
+    return build_mem_profile(parsed, memory)
+
+
+def mem_table(profile):
+    """Ordered per-scope peak rows (what stop_profiler's "Peak HBM"
+    section prints): scope, peak bytes (scaled), %-of-peak, buffer
+    count — unattributed residual last when present."""
+    if not profile:
+        return []
+    rows = [{"scope": s, "peak_bytes": int(d["peak_bytes"]),
+             "peak_pct": round(d.get("peak_pct", 0.0), 3),
+             "buffers": d.get("buffers", 0)}
+            for s, d in (profile.get("scopes") or {}).items()]
+    rows.sort(key=lambda r: -r["peak_bytes"])
+    un = profile.get("unattributed") or {}
+    if un.get("buffers") or un.get("peak_bytes"):
+        rows.append({"scope": UNATTRIBUTED,
+                     "peak_bytes": int(un.get("peak_bytes", 0)),
+                     "peak_pct": round(un.get("peak_pct", 0.0), 3),
+                     "buffers": un.get("buffers", 0)})
+    return rows

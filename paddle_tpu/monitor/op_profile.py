@@ -45,7 +45,8 @@ import time
 
 __all__ = [
     "UNATTRIBUTED", "scope_of", "parse_hlo_instruction_costs",
-    "split_by_scope", "static_split", "group_spans_by_scope",
+    "split_by_scope", "scale_groups_exact", "static_split",
+    "group_spans_by_scope",
     "OpSampler", "sampling", "active_sampler", "is_sampling",
     "sampled_rows", "clear_samples", "op_table",
 ]
@@ -384,6 +385,36 @@ def _family_bfs(operands, fam, name_scope, operand_map, depth=3):
     return None
 
 
+def scale_groups_exact(per, field, total):
+    """Scale ``per[k][field]`` in place so the groups sum EXACTLY to
+    `total` — the integer remainder-assignment scheme both the FLOPs
+    split and the peak-memory split (monitor/mem_profile.py) rely on:
+    scaled values are rounded to whole units (FLOPs/bytes are integral)
+    with the remainder assigned to the LARGEST group — integer-valued
+    floats sum exactly in ANY re-summation order, and a big group can
+    absorb the up-to-N/2-unit rounding drift without ever going
+    negative the way a near-zero last-inserted group could.
+
+    Returns False (groups untouched) when the model sum is not
+    positive or `total` is None — the caller decides how to report a
+    modelless total."""
+    if total is None:
+        return False
+    model_sum = sum(d[field] for d in per.values())
+    if model_sum <= 0:
+        return False
+    k_rem = max(per, key=lambda k: per[k][field])
+    acc = 0.0
+    for k in per:
+        if k == k_rem:
+            continue
+        v = float(round(per[k][field] / model_sum * total))
+        per[k][field] = v
+        acc += v
+    per[k_rem][field] = total - acc
+    return True
+
+
 def split_by_scope(rows, totals):
     """Group per-instruction cost rows by scope and scale each field so
     the groups sum EXACTLY to `totals` (the executable's own
@@ -411,25 +442,9 @@ def split_by_scope(rows, totals):
         total = totals.get(field) if totals else None
         if total is None:
             continue
-        model_sum = sum(d[field] for d in per.values())
-        if model_sum > 0:
-            # scale to the total EXACTLY (the acceptance invariant):
-            # scaled values are rounded to whole units (FLOPs/bytes are
-            # integral) with the remainder assigned to the LARGEST
-            # group — integer-valued floats sum exactly in ANY order,
-            # and a big group can absorb the up-to-N/2-unit rounding
-            # drift without ever going negative the way a near-zero
-            # last-inserted group could
-            k_rem = max(per, key=lambda k: per[k][field])
-            acc = 0.0
-            for k in per:
-                if k == k_rem:
-                    continue
-                v = float(round(per[k][field] / model_sum * total))
-                per[k][field] = v
-                acc += v
-            per[k_rem][field] = total - acc
-        elif total:
+        # scale to the total EXACTLY (the acceptance invariant) via the
+        # shared remainder-assignment scheme
+        if not scale_groups_exact(per, field, total) and total:
             # the model saw nothing costable but XLA reports cost:
             # everything is residual, loudly
             d = per.setdefault(UNATTRIBUTED,
@@ -453,16 +468,19 @@ def split_by_scope(rows, totals):
     }
 
 
-def static_split(compiled, known_scopes=None):
+def static_split(compiled, known_scopes=None, text=None):
     """Per-scope FLOPs/bytes attribution of one compiled executable:
     parse its optimized HLO text, cost each instruction, group by the
     executor's named scopes, scale to its cost_analysis totals.
     Returns the split_by_scope structure, or None when the executable
-    exposes neither text nor cost analysis."""
-    try:
-        text = compiled.as_text()
-    except Exception:
-        return None
+    exposes neither text nor cost analysis.  `text` lets the caller
+    share one as_text() pretty-print between analyzers — multi-MB for
+    real models, so the ledger fetches it once per compile."""
+    if text is None:
+        try:
+            text = compiled.as_text()
+        except Exception:
+            return None
     if not text:
         return None
     from .compile_ledger import parse_cost_analysis

@@ -28,7 +28,8 @@ _SAMPLED_COUNTERS = ("run_plan.hit", "run_plan.miss",
                      "resilience.retries", "resilience.anomaly_steps",
                      "resilience.skipped_steps", "resilience.rollbacks",
                      "resilience.checkpoint_saves",
-                     "resilience.checkpoint_restores")
+                     "resilience.checkpoint_restores",
+                     "resilience.oom_events")
 
 
 class MetricsSession:

@@ -105,8 +105,6 @@ def _step_events(records):
 
 
 def _compile_events(events):
-    from .compile_ledger import live_bytes
-
     out = []
     for e in events:
         dur_us = e["compile_ms"] * 1e3
@@ -120,10 +118,21 @@ def _compile_events(events):
                     "ts": e["ts_us"] - dur_us, "dur": dur_us,
                     "pid": _STEP_PID, "tid": _COMPILE_TID,
                     "cat": "compile", "args": args})
-        live = live_bytes(e.get("memory"))
-        if live is not None:
-            out.append({"name": "live_bytes", "ph": "C", "ts": e["ts_us"],
-                        "pid": _STEP_PID, "args": {"bytes": live}})
+        # live-bytes watermark: NOT rebuilt here from e["memory"] — the
+        # compile ledger already feeds compile_ledger.live_bytes() into
+        # the "compile.live_bytes" gauge at record time, and that
+        # gauge's history IS the counter track (_gauge_events).  One
+        # definition, one sample stream: the chrome track and the
+        # gauge cannot drift.
+        mem_prof = e.get("mem_profile")
+        if mem_prof and mem_prof.get("timeline"):
+            # live-bytes-over-PROGRAM timeline (mem_profile): the x
+            # axis is program position, mapped 1 μs per point from the
+            # compile's end so the curve sits next to its compile span
+            for i, (_pos, b) in enumerate(mem_prof["timeline"]):
+                out.append({"name": "hbm_live_bytes", "ph": "C",
+                            "ts": e["ts_us"] + i, "pid": _STEP_PID,
+                            "args": {"bytes": b}})
     return out
 
 

@@ -208,6 +208,7 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
                 f"{row['ave_us']:>12.1f}")
         print("\n".join(lines))
     _print_op_table()
+    _print_mem_table()
     if not events:
         return {}
     if profile_path:
@@ -245,6 +246,53 @@ def _print_op_table():
             + (f"{r['bytes_accessed'] / 1e6:>10.3f}"
                if r.get("bytes_accessed") is not None else f"{'-':>10}")
             + (f"{pct:>8.2f}" if pct is not None else f"{'-':>8}"))
+    print("\n".join(lines))
+
+
+def _fmt_bytes(b):
+    if b is None:
+        return "-"
+    if b >= 2 ** 30:
+        return f"{b / 2 ** 30:.2f} GiB"
+    if b >= 2 ** 20:
+        return f"{b / 2 ** 20:.2f} MiB"
+    return f"{b / 2 ** 10:.1f} KiB"
+
+
+def _print_mem_table():
+    """The "Peak HBM" section (ISSUE 6 surface): headline peak bytes,
+    the variable-class split (parameter / optimizer state / activation
+    / gradient / temp / donated-reuse), and the top peak scopes.
+    Quiet when no compile has been memory-attributed."""
+    try:
+        from . import monitor
+
+        prof = monitor.mem_profile_split()
+        rows = monitor.mem_table()
+    except Exception:
+        return
+    if not prof:
+        return
+    peak = prof.get("peak") or {}
+    hbm = peak.get("hbm_bytes")
+    lines = ["", "Peak HBM (live-buffer attribution at the program "
+                 "peak):",
+             f"  peak {_fmt_bytes(hbm if hbm is not None else peak.get('model_bytes'))}"
+             f" at program position {peak.get('pos')}"
+             + (f" (model {_fmt_bytes(peak.get('model_bytes'))})"
+                if hbm is not None else "")]
+    classes = prof.get("classes") or {}
+    if classes:
+        parts = [f"{c}={_fmt_bytes(d['peak_bytes'])}"
+                 for c, d in sorted(classes.items(),
+                                    key=lambda kv: -kv[1]["peak_bytes"])]
+        lines.append("  classes: " + "  ".join(parts))
+    if rows:
+        lines.append(f"{'Scope':<36}{'Peak':>12}{'%':>8}{'Buffers':>9}")
+        for r in rows[:12]:
+            lines.append(f"{r['scope']:<36}"
+                         f"{_fmt_bytes(r['peak_bytes']):>12}"
+                         f"{r['peak_pct']:>8.2f}{r['buffers']:>9}")
     print("\n".join(lines))
 
 

@@ -46,7 +46,8 @@ from .guard import (AnomalyError, AnomalyGuard,              # noqa: F401
 from .preempt import (PreemptionHandler, clear_preemption,   # noqa: F401
                       preemption_requested, request_preemption)
 from .retry import RetriesExhausted, RetryPolicy, call_with_retry
-from .taxonomy import FATAL, TRANSIENT, TAXONOMY, classify, is_transient
+from .taxonomy import (FATAL, TRANSIENT, TAXONOMY, classify, is_oom,
+                       is_transient)
 
 __all__ = [
     # guard
@@ -57,7 +58,8 @@ __all__ = [
     "RetryPolicy", "RetriesExhausted", "call_with_retry",
     "enable_retry", "disable_retry", "active_retry",
     # taxonomy
-    "classify", "is_transient", "TRANSIENT", "FATAL", "TAXONOMY",
+    "classify", "is_transient", "is_oom", "TRANSIENT", "FATAL",
+    "TAXONOMY",
     # preemption
     "PreemptionHandler", "preemption_requested", "request_preemption",
     "clear_preemption",

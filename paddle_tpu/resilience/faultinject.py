@@ -189,7 +189,8 @@ def check_transient():
     _fr().note_event("injected_transient", step=current)
     raise InjectedTransientError(
         "injected: RESOURCE_EXHAUSTED: synthetic device allocation "
-        "failure (fault-injection harness)")
+        "failure while trying to allocate 1073741824 bytes "
+        "(fault-injection harness)")
 
 
 def crash_point(name):

@@ -23,7 +23,7 @@ inspectable/testable.  Two axes:
 
 import re
 
-__all__ = ["TRANSIENT", "FATAL", "classify", "is_transient",
+__all__ = ["TRANSIENT", "FATAL", "classify", "is_transient", "is_oom",
            "InjectedTransientError", "InjectedCrash", "TAXONOMY"]
 
 TRANSIENT = "transient"
@@ -82,11 +82,21 @@ _TRANSIENT_TYPES = (
     InjectedTransientError, ConnectionError, TimeoutError, BrokenPipeError,
 )
 
+# -- dump triggers (ISSUE 6): failure shapes that warrant a flight-
+# recorder post-mortem BEFORE the error propagates.  Orthogonal to the
+# transient/fatal axis — a RESOURCE_EXHAUSTED is *retried* (transient)
+# AND *explained* (the executor writes the peak-HBM table + live-bytes
+# timeline via flight_recorder.dump_oom when one finally surfaces).
+_OOM_PATTERN = re.compile(
+    r"\bRESOURCE_EXHAUSTED\b|\bout of memory\b|\ballocation fail",
+    re.IGNORECASE)
+
 # the full inspectable table (used by the README and tests)
 TAXONOMY = {
     "fatal_types": tuple(t.__name__ for t in _FATAL_TYPES),
     "transient_types": tuple(t.__name__ for t in _TRANSIENT_TYPES),
     "message_rules": tuple((p.pattern, cls) for p, cls in _MESSAGE_RULES),
+    "dump_triggers": {"oom": _OOM_PATTERN.pattern},
 }
 
 
@@ -110,3 +120,21 @@ def classify(exc):
 
 def is_transient(exc):
     return classify(exc) == TRANSIENT
+
+
+def is_oom(exc):
+    """True when `exc` is a memory-exhaustion failure — a MemoryError,
+    or an XLA/PJRT RESOURCE_EXHAUSTED / out-of-memory message anywhere
+    in the exception or its cause/context chain (a RetriesExhausted
+    wrapping an OOM still reads as one).  The executor treats OOM as a
+    DUMP TRIGGER: the flight recorder writes the peak-HBM post-mortem
+    before the error propagates."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if isinstance(exc, MemoryError):
+            return True
+        if _OOM_PATTERN.search(str(exc)):
+            return True
+        exc = exc.__cause__ or exc.__context__
+    return False

@@ -217,6 +217,27 @@ def test_bench_op_profile_smoke_row_passes():
     assert row["unattributed_flops_pct"] <= 1.0
 
 
+def test_mem_profile_smoke_in_suite_and_standalone():
+    """The HBM-attribution smoke row is wired into the suite AND the
+    standalone argv entry (the invariants run end-to-end in
+    tests/test_mem_profile.py on the test mesh; the row re-asserts
+    them on the 2-device standalone mesh in CI)."""
+    src = open(bench.__file__).read()
+    assert '("mem_profile_smoke", "mem_profile_smoke"' in src
+    assert '"mem_profile_smoke" in sys.argv[1:]' in src
+    assert "main_mem_profile_smoke" in src
+
+
+def test_bench_mem_profile_smoke_row_passes():
+    """The CI row end-to-end on the test mesh: per-scope peak bytes
+    sum exactly to memory_analysis temp+output, residual <= 1%,
+    timeline monotone, peak table non-empty."""
+    row = bench.bench_mem_profile_smoke(False, 1e11)
+    assert row["value"] == 1, row.get("checks")
+    assert row["peak_hbm_bytes"] > 0
+    assert row["unattributed_peak_pct"] <= 1.0
+
+
 def test_fault_tolerance_smoke_in_suite_and_standalone():
     """The chaos row is wired into the suite AND the standalone argv
     entry (the recovery behaviors themselves are covered end-to-end by

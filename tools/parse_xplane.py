@@ -11,7 +11,10 @@ silently diverge:
 - the merged chrome-trace JSON that ``profiler.export_chrome_tracing``
   writes (host RecordEvent spans + monitor step spans + counter
   tracks): aggregate span duration per (process, track) and list the
-  counter tracks' last samples.
+  counter tracks' last samples.  Memory counter tracks (the
+  mem-profile's ``hbm_live_bytes`` program timeline and the
+  ``compile.live_bytes`` gauge watermark) additionally get a per-track
+  peak/mean table.
 
 Anything else exits with an error naming the two expected formats.
 
@@ -185,10 +188,35 @@ def main_chrome_trace(path, top_n):
         samples.sort(key=lambda s: s[0])   # args dicts don't compare
         print(f"== counter {name!r}: {len(samples)} samples, "
               f"last {samples[-1][1]}")
+    print_memory_tracks(counters)
     # per-op grouping: the sampling mode records per-op spans named by
     # scope, so a merged trace from an eager profiling session gets the
     # same attribution table an XPlane capture does
     print_scope_table(flat_spans, top_n)
+
+
+def print_memory_tracks(counters):
+    """Per-track peak/mean table for the memory counter tracks the
+    merged trace carries (`hbm_live_bytes` — the mem-profile's
+    live-bytes-over-program timeline — and the `*live_bytes`/`*bytes`
+    gauge tracks); quiet when the trace has none."""
+    rows = []
+    for name, samples in sorted(counters.items()):
+        if "bytes" not in name:
+            continue
+        vals = [float(v) for _, args in samples
+                for v in (args or {}).values()
+                if isinstance(v, (int, float))
+                and not isinstance(v, bool)]
+        if vals:
+            rows.append((name, max(vals), sum(vals) / len(vals),
+                         len(vals)))
+    if not rows:
+        return
+    print(f"== memory counter tracks ({len(rows)})")
+    for name, peak, mean, n in rows:
+        print(f"  {name:<24} peak {peak / 2**20:10.3f} MiB  "
+              f"mean {mean / 2**20:10.3f} MiB  x{n}")
 
 
 def _format_error(path, e):

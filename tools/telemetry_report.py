@@ -5,10 +5,15 @@ aggregate view a run review needs: step count, step-time distribution
 (mean / p50 / p95 / max), host-dispatch μs, examples/s, byte totals,
 the final cache-counter sample, a per-op cost section (from the
 kind="op_profile" records the compile ledger emits — which ProgramDesc
-ops own the FLOPs/bytes, plus the unattributed residual), and a
-resilience-event summary (retries, skipped steps, rollbacks,
-checkpoint saves/restores over the run, from the sampled counters) —
-without touching the process that produced the file.
+ops own the FLOPs/bytes, plus the unattributed residual), a memory
+section (from the kind="mem_profile" records: peak HBM bytes per
+program key, the top peak scopes with their share, the residual, and
+any kind="oom" post-mortem records — flight-recorder dumps use the
+same record shapes, so this tool reads a dump exactly like a live
+stream), and a resilience-event summary (retries, skipped steps,
+rollbacks, OOM events, checkpoint saves/restores over the run, from
+the sampled counters) — without touching the process that produced
+the file.
 
 Usage: python tools/telemetry_report.py <telemetry.jsonl>
 """
@@ -65,6 +70,9 @@ def summarize(records):
     op = _op_profile_section(records)
     if op:
         out["op_profile"] = op
+    mem = _memory_section(records)
+    if mem:
+        out["memory"] = mem
     resil = _resilience_section(steps)
     if resil:
         out["resilience"] = resil
@@ -98,6 +106,46 @@ def _op_profile_section(records, top=8):
     un = latest.get("unattributed") or {}
     if un.get("instructions"):
         out["unattributed_flops_pct"] = round(un.get("flops_pct", 0.0), 3)
+    return out
+
+
+def _memory_section(records, top=5):
+    """Peak HBM from the kind="mem_profile" records: peak bytes per
+    program key (newest record per key wins — a recompile's numbers
+    supersede), the newest profile's top peak scopes with their share,
+    the unattributed residual, and any kind="oom" post-mortems."""
+    per_key = {}
+    latest = None
+    for r in records:
+        if r.get("kind") == "mem_profile":
+            per_key[r.get("key")] = r
+            latest = r
+    ooms = [r for r in records if r.get("kind") == "oom"]
+    if not per_key and not ooms:
+        return None
+    out = {}
+    if per_key:
+        out["peak_bytes"] = {
+            k: ((r.get("peak") or {}).get("hbm_bytes")
+                or (r.get("peak") or {}).get("model_bytes"))
+            for k, r in per_key.items()}
+    if latest is not None and latest.get("scopes"):
+        rows = sorted(latest["scopes"].items(),
+                      key=lambda kv: -(kv[1].get("peak_bytes") or 0))
+        out["top_peak_scopes"] = [
+            {"scope": s,
+             "bytes": round(d.get("peak_bytes") or 0.0, 1),
+             "pct": round(d.get("peak_pct") or 0.0, 2)}
+            for s, d in rows[:top]]
+        un = latest.get("unattributed") or {}
+        if un.get("buffers") or un.get("peak_bytes"):
+            out["unattributed_pct"] = round(un.get("peak_pct", 0.0), 3)
+    if ooms:
+        out["oom_events"] = [
+            {k: (o[k][:160] if k == "error" else o[k])
+             for k in ("error", "requested_bytes", "device_memory")
+             if o.get(k) is not None}
+            for o in ooms]
     return out
 
 
