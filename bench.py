@@ -1458,6 +1458,149 @@ def main_mem_profile_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_program_lint_smoke(on_tpu, peak):
+    """Static-verifier smoke row (ISSUE 7 CI satellite): device-free —
+    lints every bundled static-zoo model program (main + startup) and
+    asserts 0 errors across the zoo; then seeds known-bad programs
+    (shape mismatch, use-before-def, unregistered op, dead op, bad dp
+    divisibility, non-aliasing stateful update) and asserts each
+    yields EXACTLY its expected PT code.  Records total lint wall-time
+    over the zoo so a verifier perf regression shows up as a number,
+    not a feeling."""
+    import paddle_tpu as fluid
+    from paddle_tpu import analysis
+    from paddle_tpu.models import static_zoo
+
+    t0 = time.perf_counter()
+    zoo_errors = {}
+    zoo_warnings = {}
+    ops_linted = 0
+    for name, model in sorted(static_zoo.build_all().items()):
+        r_main = analysis.check_program(model.main,
+                                        fetch_names=model.fetches,
+                                        program_key=f"{name}/main")
+        r_start = analysis.check_program(model.startup, fetch_names=[],
+                                         program_key=f"{name}/startup")
+        zoo_errors[name] = len(r_main.errors) + len(r_start.errors)
+        zoo_warnings[name] = (len(r_main.warnings)
+                              + len(r_start.warnings))
+        ops_linted += sum(len(b.ops) for b in model.main.blocks)
+        ops_linted += sum(len(b.ops) for b in model.startup.blocks)
+    lint_wall_ms = (time.perf_counter() - t0) * 1e3
+
+    def _expect(codes, build):
+        """Build a seeded-bug program and return whether the expected
+        codes came out AND no unexpected PT1xx error appeared — a
+        verifier regression spraying bogus errors over the seeded
+        programs must fail this row, not hide behind the seeded code."""
+        with fluid.unique_name.guard():
+            main = fluid.Program()
+            with fluid.program_guard(main, fluid.Program()):
+                fetches, dp = build(main)
+        r = analysis.check_program(main, fetch_names=fetches,
+                                   dp_ndev=dp)
+        got = set(r.by_code())
+        expected = set(codes)
+        unexpected_errors = {c for c in got
+                             if c.startswith("PT1") and c not in expected}
+        return expected <= got and not unexpected_errors
+
+    def _shape_mismatch(main):
+        a = fluid.data("a", [2, 3])
+        b = fluid.data("b", [5, 4])
+        out = main.global_block().create_var(name="o")
+        main.global_block().append_op("mul", inputs={"X": a, "Y": b},
+                                      outputs={"Out": out})
+        return ["o"], None
+
+    def _use_before_def(main):
+        out = main.global_block().create_var(name="o")
+        main.global_block().append_op("relu", inputs={"X": "ghost"},
+                                      outputs={"Out": out})
+        return ["o"], None
+
+    def _unregistered(main):
+        a = fluid.data("a", [2, 2])
+        main.global_block().append_op("no_such_op",
+                                      inputs={"X": a},
+                                      outputs={"Out": "o"})
+        return ["o"], None
+
+    def _dead_op(main):
+        a = fluid.data("a", [2, 2])
+        from paddle_tpu import layers as L
+
+        kept = L.relu(a)
+        L.sigmoid(a)                      # never fetched/read
+        return [kept.name], None
+
+    def _bad_dp(main):
+        a = fluid.data("a", [3, 4])       # batch 3 on a 2-dev mesh
+        from paddle_tpu import layers as L
+
+        out = L.relu(a)
+        return [out.name], 2
+
+    def _bad_alias(main):
+        p = main.global_block().create_parameter(name="w", shape=[4],
+                                                 dtype="float32")
+        g = fluid.data("g", [4])
+        lr = fluid.data("lr", [1])
+        other = main.global_block().create_var(name="not_w", shape=[4])
+        main.global_block().append_op(
+            "sgd", inputs={"Param": p, "Grad": g, "LearningRate": lr},
+            outputs={"ParamOut": other})
+        return ["not_w"], None
+
+    seeded = {
+        "shape_mismatch_PT101": _expect(["PT101"], _shape_mismatch),
+        "use_before_def_PT103": _expect(["PT103"], _use_before_def),
+        "unregistered_PT105": _expect(["PT105"], _unregistered),
+        "dead_op_PT201": _expect(["PT201"], _dead_op),
+        "dp_divisibility_PT107": _expect(["PT107"], _bad_dp),
+        "stateful_alias_PT106": _expect(["PT106"], _bad_alias),
+    }
+    checks = dict(seeded)
+    checks["zoo_zero_errors"] = all(v == 0 for v in zoo_errors.values())
+    checks["zoo_covered"] = len(zoo_errors) == len(static_zoo.BUILDERS)
+    row = {"metric": "program_lint_smoke",
+           "value": int(all(checks.values())), "unit": "ok",
+           "vs_baseline": None,
+           "models": len(zoo_errors),
+           "ops_linted": ops_linted,
+           "lint_wall_ms": round(lint_wall_ms, 1),
+           "zoo_errors": zoo_errors,
+           "zoo_warnings": zoo_warnings,
+           "checks": checks}
+    if not all(checks.values()):
+        row["error"] = "failed checks: " + ", ".join(
+            k for k, v in checks.items() if not v)
+    return row
+
+
+def main_program_lint_smoke():
+    """`python bench.py program_lint_smoke` — CI/tooling entry: the
+    device-free lint row, persisted to BENCH_TPU.json under
+    rows["program_lint_smoke"].  Exit 0 only when the zoo lints with
+    zero errors AND every seeded bug yields its expected PT code."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_program_lint_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["program_lint_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def bench_fault_tolerance_smoke(on_tpu, peak):
     """Fault-tolerance chaos row (ISSUE 4 CI satellite): a tiny fc
     train loop through the PUBLIC train_from_dataset on the CPU mesh
@@ -1817,6 +1960,8 @@ def main():
          bench_mem_profile_smoke),
         ("fault_tolerance_smoke", "fault_tolerance_smoke",
          bench_fault_tolerance_smoke),
+        ("program_lint_smoke", "program_lint_smoke",
+         bench_program_lint_smoke),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
 
     # SIGALRM only interrupts Python bytecode: a compile/RPC wedged
@@ -1889,4 +2034,6 @@ if __name__ == "__main__":
         sys.exit(main_mem_profile_smoke())
     if "fault_tolerance_smoke" in sys.argv[1:]:
         sys.exit(main_fault_tolerance_smoke())
+    if "program_lint_smoke" in sys.argv[1:]:
+        sys.exit(main_program_lint_smoke())
     main()

@@ -64,6 +64,7 @@ from . import initializer
 from . import unique_name
 from . import backward
 
+from . import analysis  # static Program verifier (FLAGS_static_check)
 from . import layers
 from . import nets
 from . import debugger

@@ -1,0 +1,53 @@
+"""paddle_tpu.analysis — static Program verification (ISSUE 7 tentpole).
+
+The reference validated every ProgramDesc at build time through per-op
+``InferShape``/``InferVarType`` passes; this package is the TPU-native
+analogue: abstract interpretation over a recorded Program, catching
+shape/dtype mismatches, use-before-def, dead code, donation/aliasing
+hazards, and distributed misconfigurations BEFORE ``jax.jit`` tracing —
+with the op's ProgramDesc identity and creation callsite attached to
+every finding, instead of an opaque tracer error.
+
+Usage::
+
+    from paddle_tpu import analysis
+    result = analysis.check_program(main, fetch_names=[loss.name])
+    print(result.render())        # PT1xx errors / PT2xx warnings
+
+Executor integration: ``FLAGS_static_check=off|warn|error`` runs the
+verifier (cached per program version) before every trace; ``error``
+raises :class:`ProgramLintError` pre-trace, ``warn`` emits a
+:class:`ProgramLintWarning` once per program version, ``off`` (the
+default) costs the dispatch path one flag read.
+
+Standalone CLI: ``python tools/program_lint.py`` lints serialized
+programs or the bundled static model zoo.
+"""
+
+import warnings as _warnings
+
+from .diagnostics import (CODES, Diagnostic, LintResult,
+                          ProgramLintError)
+from .shape_rules import (OPAQUE, ShapeError, VarSpec, has_shape_rule,
+                          is_opaque, register_opaque, shape_rule)
+from .verifier import cached_check, check_program
+
+__all__ = [
+    "check_program", "cached_check", "CODES",
+    "Diagnostic", "LintResult", "ProgramLintError",
+    "ProgramLintWarning",
+    "VarSpec", "OPAQUE", "ShapeError", "shape_rule", "register_opaque",
+    "has_shape_rule", "is_opaque",
+]
+
+
+class ProgramLintWarning(UserWarning):
+    """Category of FLAGS_static_check=warn reports (filterable with the
+    stdlib warnings machinery)."""
+
+
+def warn_result(result, stacklevel=2):
+    """Emit one ProgramLintWarning for a non-clean LintResult."""
+    if result.diagnostics:
+        _warnings.warn(result.render(), ProgramLintWarning,
+                       stacklevel=stacklevel)

@@ -1,0 +1,470 @@
+"""Static Program verifier — abstract interpretation over ProgramDesc.
+
+The build-time validation layer the reference ran as per-op
+``InferShape``/``InferVarType`` (framework/operator.h OperatorWithKernel)
+plus the graph sanity checks of executor prepare: here ONE pass walks a
+recorded :class:`~paddle_tpu.framework.program.Program` WITHOUT tracing
+or compiling and reports everything that would otherwise die deep
+inside ``jax.jit`` as an opaque tracer error with no ProgramDesc
+provenance.
+
+Passes (see diagnostics.py for the full code table):
+
+1. **shape/dtype inference** — per-op-family rules (shape_rules.py)
+   propagate a (shape, dtype) lattice; unknown ops degrade to OPAQUE
+   with a warning, never a false error.
+2. **def-use / liveness** — use-before-def, dead ops/vars, WAW,
+   missing fetch targets, unregistered op types.
+3. **donation/aliasing hazards** — stateful ops whose ``*Out`` slot
+   doesn't alias its input; fetches of donated persistable vars.
+4. **distributed lints** — dp batch-dim divisibility, collectives
+   outside a dp mesh, backward-section consistency.
+
+`check_program` is pure analysis; `cached_check` memoizes per
+(program, ``_version``) — ``_bump()`` invalidates — so the executor's
+steady-state dispatch fast path pays one dict probe.
+"""
+
+import time
+
+from ..ops.registry import _OPS
+from . import shape_rules as sr
+from .diagnostics import Diagnostic, LintResult
+
+# op types executed by the interpreter's control-flow table, not the
+# kernel registry.  The executor's _CONTROL_FLOW_OPS dict is the
+# single source of truth; it is resolved lazily (framework.executor
+# imports jax at module load — this module must stay importable
+# without it) with a static fallback for import-less contexts.
+_CONTROL_FLOW_FALLBACK = frozenset((
+    "cond", "switch", "while_loop", "while_block", "static_rnn",
+    "create_array", "array_write", "array_read", "array_length",
+    "lod_tensor_to_array", "array_to_lod_tensor",
+))
+_control_flow_types = None
+
+
+def _control_flow():
+    global _control_flow_types
+    if _control_flow_types is None:
+        try:
+            from ..framework.executor import _CONTROL_FLOW_OPS
+
+            _control_flow_types = (frozenset(_CONTROL_FLOW_OPS)
+                                   | _CONTROL_FLOW_FALLBACK)
+        except Exception:
+            _control_flow_types = _CONTROL_FLOW_FALLBACK
+    return _control_flow_types
+
+_COLLECTIVE_TYPES = frozenset((
+    "allreduce", "broadcast", "c_allgather", "c_allreduce_max",
+    "c_allreduce_min", "c_allreduce_prod", "c_allreduce_sum",
+    "c_broadcast", "c_reducescatter",
+))
+
+_SIDE_EFFECT_TYPES = frozenset(("print",))
+
+# how many analyses actually ran (cache misses) — pinned by the
+# caching tests; monotone over the process lifetime
+analysis_runs = 0
+
+
+def _grad_name(name):
+    return name + "@GRAD"
+
+
+def _var_spec(var):
+    if var is None:
+        return sr.OPAQUE
+    return sr.VarSpec(var.shape, var.dtype)
+
+
+def _diag(diags, code, message, op=None, op_index=None, var=None):
+    diags.append(Diagnostic(
+        code, message,
+        op_type=None if op is None else op.type,
+        op_index=op_index,
+        callsite=None if op is None else getattr(op, "callsite", None),
+        var=var))
+
+
+def check_program(program, fetch_names=None, feed_names=(),
+                  dp_ndev=None, program_key=None):
+    """Lint one Program.  `fetch_names=None` means "fetches unknown":
+    the fetch-dependent lints (PT104/PT201/PT202/PT208) are skipped so
+    a standalone lint of an inference program doesn't flag its leaf
+    outputs as dead.  Returns a :class:`LintResult`."""
+    global analysis_runs
+    analysis_runs += 1
+    t0 = time.perf_counter()
+    diags = []
+    blk = program.global_block()
+    ops = list(blk.ops)
+    sections = ([] if program._is_test
+                else list(program.backward_sections))
+    feed_names = set(feed_names or ())
+
+    control_flow = _control_flow()
+    declared = {}
+    for b in program.blocks:
+        for n, v in b.vars.items():
+            declared.setdefault(n, v)
+    persist = {n for n, v in declared.items() if v.persistable}
+    data_vars = {n for n, v in declared.items() if v.is_data}
+
+    # ---- pass 0: unregistered op types (all blocks) -------------------
+    for b in program.blocks:
+        for i, op in enumerate(b.ops):
+            if op.type not in _OPS and op.type not in control_flow:
+                _diag(diags, "PT105",
+                      f"op type '{op.type}' has no registered TPU "
+                      f"kernel (would raise NotImplementedError "
+                      f"mid-trace)", op=op,
+                      op_index=i if b is blk else None)
+
+    # ---- pass 1: def-use over the global block ------------------------
+    defined = set(persist) | data_vars | set(feed_names)
+    produced_at = {}                  # name -> first producing op index
+    for i, op in enumerate(ops):
+        for n in op.output_names():
+            produced_at.setdefault(n, i)
+    section_at = {}
+    for bs in sections:
+        section_at.setdefault(bs.pos, []).append(bs)
+
+    last_write = {}                   # name -> (op_index, read_since)
+    use_before_def = set()            # report once per var name
+
+    def _note_reads(names):
+        for n in names:
+            if n in last_write:
+                last_write[n] = (last_write[n][0], True)
+
+    for i, op in enumerate(ops):
+        for bs in section_at.get(i, ()):
+            if bs.loss_name not in defined:
+                _diag(diags, "PT108",
+                      f"backward section at op #{i} differentiates "
+                      f"loss '{bs.loss_name}' which is undefined at "
+                      f"that position", var=bs.loss_name)
+            for p in bs.param_names:
+                defined.add(_grad_name(p))
+                last_write.pop(_grad_name(p), None)
+            _note_reads([bs.loss_name] + list(bs.param_names))
+        reads = op.input_names()
+        for n in reads:
+            if n in defined or n in use_before_def:
+                continue
+            use_before_def.add(n)
+            if n in produced_at and produced_at[n] > i:
+                msg = (f"variable '{n}' is read before the op that "
+                       f"produces it (op #{produced_at[n]})")
+            elif n in declared:
+                msg = (f"non-persistable variable '{n}' is read but "
+                       f"never produced, fed, or initialized")
+            else:
+                msg = f"variable '{n}' is not declared in any block"
+            _diag(diags, "PT103", msg, op=op, op_index=i, var=n)
+        _note_reads(reads)
+        for n in op.output_names():
+            prev = last_write.get(n)
+            if prev is not None and not prev[1]:
+                _diag(diags, "PT203",
+                      f"variable '{n}' written at op #{i} overwrites "
+                      f"the value written at op #{prev[0]} that was "
+                      f"never read", op=op, op_index=i, var=n)
+            last_write[n] = (i, False)
+            defined.add(n)
+
+    # trailing sections (pos == len(ops)) never hit the walk above:
+    # run their loss check here so an undefined loss is still caught
+    for bs in sections:
+        if bs.pos >= len(ops) and bs.loss_name not in defined:
+            _diag(diags, "PT108",
+                  f"backward section at op #{bs.pos} differentiates "
+                  f"loss '{bs.loss_name}' which is undefined at that "
+                  f"position", var=bs.loss_name)
+
+    # grad names a section will materialize count as defined for the
+    # end-of-program view even when no op at that pos exists yet
+    section_grads = {_grad_name(p) for bs in sections
+                     for p in bs.param_names}
+
+    # ---- pass 2: fetch-dependent lints --------------------------------
+    if fetch_names is not None:
+        produced = set(produced_at)
+        for f in fetch_names:
+            if f in defined or f in section_grads:
+                if f in persist and f in produced:
+                    _diag(diags, "PT208",
+                          f"fetch '{f}' names a persistable variable "
+                          f"the compiled step updates and donates; "
+                          f"the executor must device-copy it to keep "
+                          f"the fetched buffer valid", var=f)
+                continue
+            _diag(diags, "PT104",
+                  f"fetch target '{f}' is never produced by this "
+                  f"program" + (" (did you mean a declared var? it is "
+                                "neither fed nor persistable)"
+                                if f in declared else ""), var=f)
+
+        # dead ops: backward sweep from fetches + loss/grads +
+        # persistable updates + side effects (mirrors _live_ops, but as
+        # a LINT: train programs run unpruned, dead work still burns
+        # device time)
+        needed = set(fetch_names) | section_grads
+        needed.update(bs.loss_name for bs in sections)
+        keep = [False] * len(ops)
+        for i in range(len(ops) - 1, -1, -1):
+            outs = set(ops[i].output_names())
+            if (outs & needed or outs & persist
+                    or ops[i].type in _SIDE_EFFECT_TYPES
+                    or ops[i].type in control_flow):
+                keep[i] = True
+                needed |= set(ops[i].input_names())
+        for i, op in enumerate(ops):
+            if not keep[i]:
+                _diag(diags, "PT201",
+                      f"dead op: outputs {op.output_names()} are never "
+                      f"read, fetched, or persisted", op=op, op_index=i)
+
+        # dead vars: declared in the global block, touched by nothing
+        touched = set(produced_at) | set(feed_names) | set(fetch_names) \
+            | section_grads
+        for op in ops:
+            touched.update(op.input_names())
+        for bs in sections:
+            touched.add(bs.loss_name)
+            touched.update(bs.param_names)
+        for n, v in blk.vars.items():
+            if n in touched or v.persistable or v.is_data:
+                continue
+            if n.endswith("@GRAD"):
+                # framework-made grad slots survive clone(for_test=True)
+                # with their backward sections stripped — clone
+                # artifacts, not user mistakes
+                continue
+            _diag(diags, "PT202",
+                  f"variable '{n}' is declared but never produced, "
+                  f"read, or fetched", var=n)
+
+    # ---- pass 3: shape/dtype inference --------------------------------
+    specs = {}
+    for n in persist | data_vars | set(feed_names):
+        specs[n] = _var_spec(declared.get(n))
+    warned_opaque = set()
+    for i, op in enumerate(ops):
+        for bs in section_at.get(i, ()):
+            for p in bs.param_names:
+                specs[_grad_name(p)] = specs.get(p, sr.OPAQUE)
+        if op.type in control_flow or sr.is_opaque(op.type):
+            _bind_outputs(specs, op, None)
+            continue
+        rule = sr.get_rule(op.type)
+        if rule is None:
+            if op.type in _OPS and op.type not in warned_opaque:
+                warned_opaque.add(op.type)
+                _diag(diags, "PT204",
+                      f"no shape-inference rule for op type "
+                      f"'{op.type}'; its outputs are treated as "
+                      f"opaque", op=op, op_index=i)
+            _bind_outputs(specs, op, None)
+            continue
+        ins = {}
+        for slot, names in op.inputs.items():
+            ins[slot] = [specs.get(n) or _var_spec(declared.get(n))
+                         for n in names]
+        try:
+            outs = rule(op, ins, op.attrs)
+        except sr.ShapeError as e:
+            code = "PT102" if e.kind == "dtype" else "PT101"
+            _diag(diags, code, str(e), op=op, op_index=i)
+            outs = None
+        except Exception as e:   # degrade, never false-error
+            _diag(diags, "PT209",
+                  f"shape rule for '{op.type}' crashed "
+                  f"({type(e).__name__}: {e}); outputs treated as "
+                  f"opaque", op=op, op_index=i)
+            outs = None
+        _bind_outputs(specs, op, outs)
+    # trailing sections (pos == len(ops))
+    for bs in sections:
+        if bs.pos >= len(ops):
+            for p in bs.param_names:
+                specs[_grad_name(p)] = specs.get(p, sr.OPAQUE)
+
+    # ---- pass 3b: shape/dtype inside sub-blocks (control-flow bodies)
+    # REDUCED pass: rule-based inference only.  Def-use/liveness/WAW
+    # are unsound across the interpreter's runtime binding of loop
+    # carries (cond_inner/body_inner names bind at trace time), so a
+    # sub-block reports only genuine PT101/PT102 inconsistencies;
+    # anything uncertain stays silent rather than false-positive.
+    for b in program.blocks:
+        if b is blk:
+            continue
+        local = {}
+        for i, op in enumerate(b.ops):
+            if op.type in control_flow or sr.is_opaque(op.type) \
+                    or op.type not in _OPS:
+                _bind_outputs(local, op, None)
+                continue
+            rule = sr.get_rule(op.type)
+            if rule is None:
+                _bind_outputs(local, op, None)
+                continue
+            ins = {}
+            for slot, names in op.inputs.items():
+                ins[slot] = [local.get(n) or specs.get(n)
+                             or _var_spec(b._find_var_recursive(n))
+                             for n in names]
+            try:
+                outs = rule(op, ins, op.attrs)
+            except sr.ShapeError as e:
+                code = "PT102" if e.kind == "dtype" else "PT101"
+                _diag(diags, code, f"block {b.idx}: {e}", op=op,
+                      op_index=i)
+                outs = None
+            except Exception:
+                outs = None     # weaker context: degrade quietly
+            _bind_outputs(local, op, outs)
+
+    # ---- pass 4: donation / aliasing hazards --------------------------
+    for i, op in enumerate(ops):
+        opdef = _OPS.get(op.type)
+        if opdef is None or not opdef.stateful:
+            continue
+        for oslot, onames in op.outputs.items():
+            if not oslot.endswith("Out"):
+                continue
+            islot = oslot[:-3]
+            inames = op.inputs.get(islot)
+            if not inames:
+                continue
+            for oname, iname in zip(onames, inames):
+                if oname != iname:
+                    _diag(diags, "PT106",
+                          f"stateful op writes {oslot}='{oname}' "
+                          f"which does not alias {islot}='{iname}': "
+                          f"the in-place update would land in a "
+                          f"different variable and '{iname}' would "
+                          f"never advance", op=op, op_index=i,
+                          var=iname)
+
+    # ---- pass 5: distributed / backward-section lints -----------------
+    if dp_ndev is not None and dp_ndev > 1:
+        read_names = {n for op in ops for n in op.input_names()}
+        for n in sorted(data_vars & read_names):
+            spec = specs.get(n) or _var_spec(declared.get(n))
+            if spec.shape and spec.shape[0] is not None \
+                    and spec.shape[0] % dp_ndev != 0:
+                _diag(diags, "PT107",
+                      f"data-parallel feed '{n}' has a static batch "
+                      f"dim {spec.shape[0]} not divisible by the "
+                      f"{dp_ndev}-device mesh", var=n)
+    if not dp_ndev or dp_ndev <= 1:
+        for i, op in enumerate(ops):
+            if op.type in _COLLECTIVE_TYPES:
+                _diag(diags, "PT207",
+                      f"collective op '{op.type}' in a program run "
+                      f"without a data-parallel mesh (needs "
+                      f"with_data_parallel or a multi-process group)",
+                      op=op, op_index=i)
+
+    producers = {}
+    for i, op in enumerate(ops):
+        for n in op.output_names():
+            producers.setdefault(n, []).append(i)
+    for bs in sections:
+        loss_spec = specs.get(bs.loss_name)
+        if loss_spec is not None and loss_spec.numel() not in (None, 1):
+            _diag(diags, "PT205",
+                  f"backward-section loss '{bs.loss_name}' has shape "
+                  f"{loss_spec.shape} (executor sums it; reduce to a "
+                  f"scalar first if that is not intended)",
+                  var=bs.loss_name)
+        # reachability: walk the dataflow backwards from the loss
+        # through ops before the section position
+        reachable = {bs.loss_name}
+        frontier = [bs.loss_name]
+        while frontier:
+            name = frontier.pop()
+            for pi in producers.get(name, ()):
+                if pi >= bs.pos:
+                    continue
+                for n in ops[pi].input_names():
+                    if n not in reachable:
+                        reachable.add(n)
+                        frontier.append(n)
+        for p in bs.param_names:
+            if p not in reachable:
+                _diag(diags, "PT206",
+                      f"parameter '{p}' is not reachable from loss "
+                      f"'{bs.loss_name}': its gradient is identically "
+                      f"zero", var=p)
+
+    order = {"error": 0, "warning": 1}
+    diags.sort(key=lambda d: (order[d.severity],
+                              -1 if d.op_index is None else d.op_index,
+                              d.code))
+    return LintResult(diags, program_key=program_key,
+                      wall_ms=(time.perf_counter() - t0) * 1e3)
+
+
+def _bind_outputs(specs, op, outs):
+    """Bind a rule's output specs (or OPAQUE when outs is None) to the
+    op's output variable names."""
+    for slot, names in op.outputs.items():
+        if not names:
+            continue
+        vals = None if outs is None else outs.get(slot)
+        if vals is None:
+            for n in names:
+                specs[n] = sr.OPAQUE
+        elif isinstance(vals, (list, tuple)):
+            for n, v in zip(names, vals):
+                specs[n] = v
+            for n in names[len(vals):]:
+                specs[n] = sr.OPAQUE
+        else:
+            specs[names[0]] = vals
+            for n in names[1:]:
+                specs[n] = sr.OPAQUE
+
+
+# ---------------------------------------------------------------------------
+# cached entry point (the executor's hook)
+# ---------------------------------------------------------------------------
+
+_CACHE_CAP = 8
+
+
+def cached_check(program, fetch_names=None, feed_names=(), dp_ndev=None,
+                 program_key=None):
+    """`check_program` memoized on the program per
+    (``_version``, fetches, feeds, dp) — the same invalidation contract
+    as the executor's run-plan cache: any graph mutation bumps
+    ``_version`` and the next check re-analyzes.  Returns
+    (result, fresh): `fresh` is False on a cache hit so the caller can
+    avoid double-reporting."""
+    key = (program._version,
+           None if fetch_names is None else tuple(fetch_names),
+           frozenset(feed_names or ()),
+           dp_ndev)
+    cache = getattr(program, "_lint_cache", None)
+    if cache is not None:
+        hit = cache.get(key)
+        if hit is not None:
+            return hit, False
+    result = check_program(program, fetch_names=fetch_names,
+                           feed_names=feed_names, dp_ndev=dp_ndev,
+                           program_key=program_key)
+    if cache is None:
+        cache = {}
+        program._lint_cache = cache
+    elif len(cache) >= _CACHE_CAP:
+        # drop stale versions first, then oldest insertion
+        stale = [k for k in cache if k[0] != program._version]
+        for k in stale or [next(iter(cache))]:
+            cache.pop(k, None)
+    cache[key] = result
+    return result, True

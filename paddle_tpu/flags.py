@@ -136,6 +136,19 @@ declare_flag("flight_recorder_steps", 256,
 declare_flag("flight_recorder_dir", "/tmp/paddle_tpu_flight",
              "Directory flight-recorder post-mortem dumps land in.")
 
+# Static Program verifier (paddle_tpu.analysis): lint every program
+# BEFORE tracing/compiling — shape/dtype inference, use-before-def,
+# dead code, donation hazards, distributed misconfigurations — with
+# results cached per (program, _version) so the steady-state dispatch
+# fast path pays one flag read.  "off" (default) skips the verifier
+# entirely; "warn" emits a ProgramLintWarning once per program
+# version; "error" raises ProgramLintError pre-trace when any PT1xx
+# error is found (the strongest fail-fast of the resilience taxonomy:
+# INVALID_ARGUMENT-class failures never reach the compiler).
+declare_flag("static_check", "off",
+             "Static program verification before tracing: "
+             "off | warn | error.")
+
 declare_flag("maxpool_mask_bwd", False,
              "Give max-pool a recompute-mask custom VJP (window passes "
              "+ shifted compares, all XLA-fusable) instead of the "

@@ -818,6 +818,17 @@ class Executor:
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
 
+        # Static program verification (FLAGS_static_check=off|warn|error):
+        # the pre-trace InferShape/def-use/donation/dp lint pass of
+        # paddle_tpu.analysis.  Results are cached per (program,
+        # _version, fetches, feeds, dp) — _bump() invalidates — so the
+        # steady-state dispatch path pays one flag read + one dict
+        # probe; "off" (the default) costs the flag read alone.
+        check_mode = flags.flag("static_check")
+        if check_mode and check_mode != "off":
+            self._static_check(program, fetch_names, feed, dp_mesh,
+                               check_mode, telemetry_key, mon, mon_on)
+
         res = _res()
         guard = res.active_guard()
         # the fused finite check only exists where loss/grads exist:
@@ -1067,6 +1078,42 @@ class Executor:
         # steady state.
         return [jnp.copy(f) if n in new_state else f
                 for n, f in zip(fetch_names, fetches)]
+
+    @staticmethod
+    def _static_check(program, fetch_names, feed, dp_mesh, mode,
+                      telemetry_key, mon, mon_on):
+        """Run the static verifier before tracing (the reference's
+        build-time InferShape parity point).  A fresh analysis emits
+        ONE ProgramLintWarning (warn mode), a kind="lint" telemetry
+        record, and a flight-recorder event; a cache hit re-raises in
+        error mode but never re-reports — a long training loop lints
+        each program version exactly once."""
+        from .. import analysis
+
+        key = telemetry_key or "prog%x:v%d" % (id(program),
+                                               program._version)
+        result, fresh = analysis.cached_check(
+            program, fetch_names=fetch_names,
+            feed_names=list(feed or ()),
+            dp_ndev=(None if dp_mesh is None
+                     else int(dp_mesh.devices.size)),
+            program_key=key)
+        if fresh:
+            if mon_on:
+                mon.record_lint(result.to_record())
+            fr = _fr()
+            if fr.enabled and result.diagnostics:
+                # the full kind="lint" record for post-mortem dumps
+                # plus a recovery-style event marking WHEN it happened
+                fr.note_lint(result.to_record())
+                fr.note_event("lint", key=key,
+                              errors=len(result.errors),
+                              warnings=len(result.warnings),
+                              codes=result.by_code())
+            if result.diagnostics and (mode != "error" or result.ok):
+                analysis.warn_result(result, stacklevel=4)
+        if mode == "error" and not result.ok:
+            raise analysis.ProgramLintError(result)
 
     @staticmethod
     def _oom_postmortem(exc, mon_on):

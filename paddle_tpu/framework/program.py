@@ -251,8 +251,31 @@ class Block:
     def var(self, name):
         v = self._find_var_recursive(name)
         if v is None:
-            raise ValueError(f"variable '{name}' not found in block {self.idx}")
+            raise ValueError(
+                f"variable '{name}' not found in block {self.idx}"
+                + self._did_you_mean(name))
         return v
+
+    def _did_you_mean(self, name):
+        """Close-match suggestions over this block + its ancestors —
+        a typo'd fetch/feed name gets candidates instead of a bare
+        name error (op_call_stack-style ergonomics for the graph
+        API)."""
+        import difflib
+
+        candidates = set()
+        b = self
+        while True:
+            candidates.update(b.vars)
+            if b.parent_idx < 0:
+                break
+            b = self.program.blocks[b.parent_idx]
+        close = difflib.get_close_matches(name, candidates, n=3,
+                                          cutoff=0.6)
+        if not close:
+            return ""
+        return " — did you mean " + " or ".join(
+            f"'{c}'" for c in close) + "?"
 
     def has_var(self, name):
         return self._find_var_recursive(name) is not None

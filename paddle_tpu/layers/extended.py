@@ -615,7 +615,13 @@ def center_loss(input, label, alpha, num_classes, param_attr=None,
         param_attr, shape=[num_classes, int(input.shape[-1])],
         dtype=input.dtype)
     outs = {s: helper.create_variable_for_type_inference(input.dtype)
-            for s in ("Loss", "SampleCenterDiff", "CentersOut")}
+            for s in ("Loss", "SampleCenterDiff")}
+    # CentersOut aliases Centers (center_loss_op.cc updates the
+    # centers buffer in place): binding the output back onto the
+    # parameter is what makes the running-center SGD update actually
+    # persist across steps — a fresh output var would silently drop
+    # it (exactly the PT106 donation-hazard lint)
+    outs["CentersOut"] = centers
     from .tensor import fill_constant
 
     alpha_v = alpha if isinstance(alpha, Variable) else \

@@ -41,6 +41,7 @@ from . import flight_recorder  # noqa: F401  — installs crash hooks
 __all__ = [
     "enable", "disable", "is_enabled", "snapshot", "reset",
     "counter", "gauge", "record_step", "observe_steps", "record_compile",
+    "record_lint", "lint_records",
     "aot_compile", "instrument_jit", "mfu", "step_records",
     "compile_events", "jsonl_path", "merged_trace_events",
     "op_table", "op_profile_split", "op_profile", "flight_recorder",
@@ -60,6 +61,9 @@ _session = MetricsSession(_registry, _ledger)
 # stream as kind="op_profile" records (step numbering stays step-only)
 _ledger.set_aux_sink(_session.emit_record)
 _enabled = False
+# kind="lint" records from the static verifier (ISSUE 7): kept here so
+# snapshot consumers can read them without re-parsing the JSONL
+_lint_records = []
 
 
 def enable(jsonl_path=None):
@@ -95,6 +99,7 @@ def reset():
     _ledger.clear()
     _registry.reset()
     op_profile.clear_samples()
+    del _lint_records[:]
 
 
 # -- recording entry points (no-ops while disabled) ---------------------
@@ -118,6 +123,24 @@ def observe_steps(n, seconds, examples=0, label=None):
         return None
     return _session.observe_steps(n, seconds, examples=examples,
                                   label=label)
+
+
+def record_lint(record):
+    """Write one kind="lint" record (a LintResult.to_record() dict from
+    the static verifier) onto the telemetry JSONL stream and keep it
+    addressable in-process (lint_records()).  No step bookkeeping —
+    like op_profile records, lint rides the same stream without
+    touching step numbering."""
+    if not _enabled or not record:
+        return None
+    _lint_records.append(dict(record))
+    _session.emit_record(record)
+    return record
+
+
+def lint_records():
+    """kind="lint" records seen since enable()/reset(), newest last."""
+    return list(_lint_records)
 
 
 def record_compile(key, compile_s, flops=None, bytes_accessed=None,

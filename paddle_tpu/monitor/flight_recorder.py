@@ -122,6 +122,7 @@ class FlightRecorder:
         self._counters = {}
         self._last_op_table = None
         self._last_mem_profile = None
+        self._last_lints = {}
         self._last_oom = None
         self._oom_memprof = None   # device_memory_profile() capture
         self._step_seq = 0
@@ -217,6 +218,16 @@ class FlightRecorder:
         with self._lock:
             self._last_mem_profile = profile
 
+    def note_lint(self, record):
+        """Latest static-verifier result per program key (the
+        kind="lint" record shape of LintResult.to_record()) — a
+        post-mortem of a program that failed validation should say
+        WHAT the verifier saw, not just that it ran."""
+        if not self.enabled or not record:
+            return
+        with self._lock:
+            self._last_lints[record.get("key")] = dict(record)
+
     def note_oom(self, exc):
         """Record one memory-exhaustion event: the error text, the
         requested bytes parsed from it, the device allocator's own
@@ -269,6 +280,7 @@ class FlightRecorder:
                 "counters": dict(self._counters),
                 "op_table": self._last_op_table,
                 "mem_profile": self._last_mem_profile,
+                "lints": list(self._last_lints.values()),
                 "oom": self._last_oom,
                 "step_seq": self._step_seq,
             }
@@ -281,6 +293,7 @@ class FlightRecorder:
             self._counters.clear()
             self._last_op_table = None
             self._last_mem_profile = None
+            self._last_lints.clear()
             self._last_oom = None
             self._oom_memprof = None
             self._step_seq = 0
@@ -338,6 +351,11 @@ class FlightRecorder:
             # likewise one kind="mem_profile" line: peak table +
             # live-bytes timeline, identical to the telemetry stream's
             lines.append({"kind": "mem_profile", **snap["mem_profile"]})
+        for lint in snap.get("lints") or ():
+            # one kind="lint" line per program key, identical to the
+            # telemetry stream's — telemetry_report's lint section
+            # reads a dump exactly like a live stream
+            lines.append(lint)
         if snap["oom"]:
             lines.append(snap["oom"])
         lines.extend(snap["events"])

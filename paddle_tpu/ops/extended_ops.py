@@ -258,7 +258,7 @@ def lookup_table_dequant(ins, attrs):
 
 # -- sync batch norm --------------------------------------------------------
 
-@register_op("sync_batch_norm")
+@register_op("sync_batch_norm", stateful=True)
 def sync_batch_norm(ins, attrs):
     """operators/sync_batch_norm_op.cu — batch norm whose batch statistics
     are reduced across the data-parallel group.  TPU-native form: when run

@@ -93,7 +93,7 @@ def teacher_student_sigmoid_loss(ins, attrs):
     return {"Y": y.reshape(jnp.asarray(ins["X"]).shape)}
 
 
-@register_op("center_loss")
+@register_op("center_loss", stateful=True)
 def center_loss(ins, attrs):
     """operators/center_loss_op.cc — 0.5*||x - center_y||^2 plus the
     running-center SGD update CentersOut = Centers - alpha * dCenter."""

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from .registry import register_op
 
 
-@register_op("auc")
+@register_op("auc", stateful=True)
 def auc(ins, attrs):
     """metrics/auc_op.h:30-122 — histogram-bucketed ROC AUC. StatPos/StatNeg
     carry [num_thresholds+1] bucket counts (slide_steps=0 layout); outputs

@@ -27,8 +27,13 @@ class OpDef:
         # (dropout, random init ops). The executor threads keys through.
         self.needs_rng = needs_rng
         # stateful: output aliases an input buffer logically (e.g. optimizer
-        # update ops writing ParamOut=Param). Purely informational; the
-        # functional interpreter always produces new values.
+        # update ops writing ParamOut=Param, batch_norm's running stats).
+        # The functional interpreter always produces new values, but the
+        # static verifier's donation-hazard pass (paddle_tpu.analysis)
+        # relies on this tag being TRUTHFUL: a stateful op whose "<X>Out"
+        # slot doesn't name the same variable as its "<X>" input is a
+        # dropped in-place update (PT106).  tests/test_analysis.py scans
+        # every kernel for *Out-aliasing slots and asserts the tag.
         self.stateful = stateful
 
 

@@ -1,0 +1,112 @@
+"""Standalone static Program linter (paddle_tpu.analysis CLI).
+
+Lints serialized programs (Program.to_json files) or the bundled
+static model zoo WITHOUT tracing, compiling, or touching a device —
+the ProgramDesc-level pre-flight the reference ran as per-op
+InferShape at build time.  Diagnostics carry stable PT codes (PT1xx
+errors / PT2xx warnings), the op type/index, and the op's creation
+callsite; see paddle_tpu/analysis/diagnostics.py for the table.
+
+Usage:
+  python tools/program_lint.py <program.json> [--fetch a,b] [--dp N]
+  python tools/program_lint.py --model lenet [--dp N]
+  python tools/program_lint.py --all-models
+
+Exit status: 0 clean (no PT1xx errors anywhere), 1 errors found,
+2 usage error.  `--fetch` enables the fetch-dependent lints (missing
+fetch targets, dead ops/vars, donated-then-fetched); `--dp N` enables
+the data-parallel lints against an N-device mesh.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _lint_one(label, program, fetch_names, dp_ndev, verbose=True):
+    from paddle_tpu import analysis
+
+    result = analysis.check_program(program, fetch_names=fetch_names,
+                                    dp_ndev=dp_ndev, program_key=label)
+    if verbose:
+        ops = sum(len(b.ops) for b in program.blocks)
+        print(f"{label}: {ops} ops, {len(result.errors)} error(s), "
+              f"{len(result.warnings)} warning(s)"
+              f"  [{result.wall_ms:.1f} ms]")
+        for d in result.diagnostics:
+            print("  " + d.render())
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="program_lint.py",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("program", nargs="?",
+                    help="Program.to_json file to lint")
+    ap.add_argument("--model", help="lint one bundled static model "
+                    "(mlp|lenet|resnet|bert|gpt|seq2seq|wide_deep|"
+                    "word2vec)")
+    ap.add_argument("--all-models", action="store_true",
+                    help="lint every bundled static model (main + "
+                    "startup programs)")
+    ap.add_argument("--fetch", default=None,
+                    help="comma-separated fetch names (enables the "
+                    "fetch-dependent lints)")
+    ap.add_argument("--dp", type=int, default=None,
+                    help="data-parallel mesh size for the distributed "
+                    "lints")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON records instead "
+                    "of text")
+    args = ap.parse_args(argv)
+
+    targets = []
+    if args.all_models or args.model:
+        from paddle_tpu.models import static_zoo
+
+        names = (sorted(static_zoo.BUILDERS) if args.all_models
+                 else [args.model])
+        for name in names:
+            try:
+                m = static_zoo.build(name)
+            except KeyError as e:
+                print(e, file=sys.stderr)
+                return 2
+            targets.append((f"{name}/main", m.main, m.fetches))
+            targets.append((f"{name}/startup", m.startup, []))
+    elif args.program:
+        from paddle_tpu.framework.program import Program
+
+        try:
+            with open(args.program) as f:
+                prog = Program.from_json(f.read())
+        except Exception as e:
+            print(f"cannot load {args.program}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+        fetches = (args.fetch.split(",") if args.fetch else None)
+        targets.append((os.path.basename(args.program), prog, fetches))
+    else:
+        ap.print_help()
+        return 2
+
+    any_errors = False
+    records = []
+    for label, prog, fetches in targets:
+        result = _lint_one(label, prog, fetches, args.dp,
+                           verbose=not args.json)
+        records.append(result.to_record())
+        any_errors = any_errors or not result.ok
+    if args.json:
+        print(json.dumps(records, indent=1))
+    return 1 if any_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

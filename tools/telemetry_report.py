@@ -10,7 +10,9 @@ section (from the kind="mem_profile" records: peak HBM bytes per
 program key, the top peak scopes with their share, the residual, and
 any kind="oom" post-mortem records — flight-recorder dumps use the
 same record shapes, so this tool reads a dump exactly like a live
-stream), and a resilience-event summary (retries, skipped steps,
+stream), a static-analysis section (from the kind="lint" records the
+verifier emits once per program version: error/warning counts by PT
+code per program key), and a resilience-event summary (retries, skipped steps,
 rollbacks, OOM events, checkpoint saves/restores over the run, from
 the sampled counters) — without touching the process that produced
 the file.
@@ -70,6 +72,9 @@ def summarize(records):
     op = _op_profile_section(records)
     if op:
         out["op_profile"] = op
+    lint = _lint_section(records)
+    if lint:
+        out["lint"] = lint
     mem = _memory_section(records)
     if mem:
         out["memory"] = mem
@@ -106,6 +111,38 @@ def _op_profile_section(records, top=8):
     un = latest.get("unattributed") or {}
     if un.get("instructions"):
         out["unattributed_flops_pct"] = round(un.get("flops_pct", 0.0), 3)
+    return out
+
+
+def _lint_section(records):
+    """Static-verifier findings from the kind="lint" records the
+    executor emits once per (program, version): per program key the
+    newest error/warning counts and the count-by-PT-code breakdown
+    (newest record per key wins — a re-lint after _bump supersedes)."""
+    per_key = {}
+    for r in records:
+        if r.get("kind") == "lint":
+            per_key[r.get("key")] = r
+    if not per_key:
+        return None
+    out = {"programs": len(per_key)}
+    progs = {}
+    total = {}
+    for k, r in per_key.items():
+        entry = {"errors": r.get("errors", 0),
+                 "warnings": r.get("warnings", 0)}
+        if r.get("codes"):
+            entry["codes"] = r["codes"]
+            for code, n in r["codes"].items():
+                total[code] = total.get(code, 0) + n
+        if r.get("first_error"):
+            entry["first_error"] = r["first_error"][:160]
+        progs[k] = entry
+    out["by_program"] = progs
+    if total:
+        out["codes_total"] = dict(sorted(total.items()))
+    out["errors_total"] = sum(p["errors"] for p in progs.values())
+    out["warnings_total"] = sum(p["warnings"] for p in progs.values())
     return out
 
 
