@@ -1775,6 +1775,321 @@ def main_fault_tolerance_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_serving_smoke(on_tpu, peak):
+    """Serving chaos row (ISSUE 8 CI satellite): a tiny saved model
+    served through the hardened ServingRuntime on the CPU mesh with
+    the full menu armed — injected transients under retry, forced
+    consecutive failures to walk the circuit breaker through
+    open -> half_open -> closed, an injected HANG the watchdog must
+    dump-and-cancel-retry, and a synthetic overload burst against a
+    bounded queue with tiny deadlines — asserting every submitted
+    request either completes (bitwise-equal to an unbatched
+    Predictor.run) or fails with a CLASSIFIED error, that zero
+    requests are silently lost, that the watchdog post-mortem exists,
+    and that the reported p99 is EXACTLY the nearest-rank percentile
+    of the recorded samples.
+
+    Side effect: like the other smoke rows, the PROCESS-GLOBAL monitor
+    and fault-injection state are reset."""
+    import tempfile
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor, resilience
+    from paddle_tpu.inference import Predictor
+    from paddle_tpu.resilience import RetryPolicy, faultinject, taxonomy
+    from paddle_tpu.serving import (DeadlineExceeded, QueueFullError,
+                                    ServingRuntime)
+    from paddle_tpu.serving.stats import exact_percentile
+
+    was_enabled = monitor.is_enabled()
+    monitor.reset()
+    monitor.enable()
+    flight_dir = tempfile.mkdtemp(prefix="paddle_tpu_serving_flight_")
+    old_flight = fluid.get_flags("FLAGS_flight_recorder_dir")
+    fluid.set_flags({"FLAGS_flight_recorder_dir": flight_dir})
+    monitor.flight_recorder.get().clear()
+    rt = None
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [None, 16])
+                h = fluid.layers.fc(x, 16, act="relu")
+                out = fluid.layers.fc(h, 4, act="softmax")
+        exe = fluid.Executor()
+        exe.run(startup)
+        model_dir = tempfile.mkdtemp(prefix="paddle_tpu_serving_")
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                      main_program=main)
+        pred = Predictor(model_dir)
+        rng = np.random.default_rng(0)
+
+        def batch(rows):
+            return {"x": rng.standard_normal((rows, 16))
+                    .astype(np.float32)}
+
+        rt = ServingRuntime(
+            pred, max_batch_size=4, max_queue_depth=8,
+            batch_window_s=0.002, default_deadline_s=30.0,
+            retry_policy=RetryPolicy(max_retries=2, base_delay=0.001,
+                                     max_delay=0.01,
+                                     sleep=lambda d: None, seed=0),
+            breaker_threshold=2, breaker_cooldown_s=0.75,
+            watchdog_stall_s=0.1, watchdog_poll_s=0.02,
+            watchdog_policy="cancel_retry", degraded_mode="eager",
+            label="serving_smoke")
+        prewarmed = rt.prewarmed
+        compiles_after_prewarm = len(monitor.compile_events())
+
+        ledger = []                 # (feed, future) for every submit
+
+        def submit(rows, deadline_s=None):
+            feed = batch(rows)
+            try:
+                fut = rt.submit(feed, deadline_s=deadline_s)
+            except Exception as e:  # expected: QueueFullError under
+                ledger.append((feed, e))   # the overload burst
+                return e
+            ledger.append((feed, fut))
+            return fut
+
+        # -- phase A: healthy concurrent traffic --------------------
+        threads = [threading.Thread(
+            target=lambda: [submit(r) for r in (1, 2, 3)])
+            for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        phase_a = list(ledger)
+        for _, f in phase_a:
+            # a burst unlucky enough to fill the queue is REJECTED
+            # (classified) — legitimate under admission control; the
+            # final ledger accounting covers it
+            if not isinstance(f, Exception):
+                f.exception(timeout=30)
+
+        # -- phase B: transient under retry -------------------------
+        faultinject.arm(transient_at_step=0, transient_times=1)
+        submit(2).result(timeout=30)
+        retried = monitor.snapshot()["counters"].get(
+            "resilience.retries", 0)
+        faultinject.disarm()
+
+        # -- phase C: consecutive failures walk the breaker ---------
+        # 6 raises / 3 attempts per dispatch = two exhausted dispatches
+        # in a row -> breaker (threshold 2) opens; the two sacrificed
+        # requests fail with classified RetriesExhausted
+        faultinject.arm(transient_at_step=[0, 1], transient_times=6)
+        # submit the second sacrifice only after the first resolved:
+        # coalesced into ONE batch they would count ONE breaker failure
+        sac1 = submit(1)
+        err1 = sac1.exception(timeout=30)
+        sac2 = submit(1)
+        err2 = sac2.exception(timeout=30)
+        open_seen = rt.breaker.state == "open"
+        # breaker open -> degraded eager path still serves
+        degraded_fut = submit(2)
+        degraded_fut.result(timeout=30)
+        degraded_served = rt.stats.degraded >= 1
+        time.sleep(0.9)             # past the 0.75s cooldown
+        submit(1).result(timeout=30)    # half-open probe -> closes
+        faultinject.disarm()
+        closed_again = rt.breaker.state == "closed"
+        transitions = [(t["from"], t["to"])
+                       for t in rt.breaker.summary()["transitions"]]
+
+        # -- phase D: injected hang + overload burst ----------------
+        hang = threading.Event()
+        faultinject.arm(stall_points={"serving.dispatch": hang})
+        victim = submit(2)          # wedges in dispatch
+        deadline = time.time() + 10
+        while rt.stats.in_flight == 0 and time.time() < deadline:
+            time.sleep(0.005)       # wait for the dispatch to wedge
+        burst = []
+        for i in range(14):         # queue depth 8: tail must bounce
+            burst.append(submit(1, deadline_s=(0.03 if i < 4
+                                               else 30.0)))
+        victim.result(timeout=30)   # cancel-retry re-dispatch serves it
+        hang.set()                  # release the abandoned thread
+        faultinject.disarm()
+
+        # -- settle + invariants ------------------------------------
+        # bitwise equality is the contract of the BATCHED compiled
+        # path (phase A ran entirely on it): a request's rows must be
+        # BITWISE what Predictor.run computes at the same padded
+        # bucket shape.  Concurrency decides which bucket a request
+        # coalesced into, so match against each candidate bucket
+        # (within a bucket, row offset and batch companions provably
+        # don't change bits — XLA's gemm is row-independent; only the
+        # bucket SHAPE selects the kernel).  Requests served by the
+        # degraded eager interpreter are only allclose — a different
+        # (unfused) computation is the point of the fallback.
+        def bucket_refs(feed):
+            rows = len(feed["x"])
+            for b in rt.dispatcher.buckets:
+                if b < rows:
+                    continue
+                padded = {"x": np.concatenate(
+                    [feed["x"],
+                     np.zeros((b - rows,) + feed["x"].shape[1:],
+                              feed["x"].dtype)])}
+                yield [o[:rows] for o in pred.run(padded)]
+
+        phase_a_items = set(id(f) for _, f in phase_a)
+        outcomes_of = []
+        completed_ok = []
+        batched_bitwise = []
+        classified = []
+        for feed, item in ledger:
+            if isinstance(item, Exception):        # rejected at submit
+                outcomes_of.append("rejected_submit")
+                classified.append(isinstance(item, QueueFullError))
+                continue
+            err = item.exception(timeout=30)
+            if err is None:
+                res = item.result()
+                ref = pred.run(feed)
+                completed_ok.append(
+                    all(np.allclose(a, b, atol=1e-5)
+                        for a, b in zip(res, ref)))
+                if id(item) in phase_a_items:
+                    batched_bitwise.append(any(
+                        all(np.array_equal(a, b)
+                            for a, b in zip(res, bref))
+                        for bref in bucket_refs(feed)))
+                outcomes_of.append("completed")
+            else:
+                outcomes_of.append(type(err).__name__)
+                # classified means one of the EXPECTED failure shapes:
+                # a deadline-category error (shed/expired/stalled), a
+                # backpressure rejection, or a transient the taxonomy
+                # recognizes (the breaker sacrifices wrap injected
+                # RESOURCE_EXHAUSTED).  An unclassified bug escaping
+                # the batcher (KeyError -> FATAL) must FAIL this check.
+                classified.append(
+                    isinstance(err, (DeadlineExceeded, QueueFullError))
+                    or resilience.classify(err) == taxonomy.TRANSIENT)
+        summary = rt.summary()
+        samples = sorted(rt.stats.samples())
+        p99_exact = round(exact_percentile(samples, 0.99) * 1e3, 3) \
+            if samples else None
+        counters = monitor.snapshot().get("counters", {})
+        flight = monitor.flight_recorder.get().last_dump
+        dump_has_serving = False
+        dump_has_stall = False
+        if flight and os.path.exists(flight):
+            with open(flight) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("kind") == "serving":
+                        dump_has_serving = True
+                    if rec.get("kind") == "event" and \
+                            rec.get("event") == "serving_stall":
+                        dump_has_stall = True
+        rt.emit_telemetry()
+        serving_rec = monitor.serving_records()
+
+        checks = {
+            "prewarm_compiled_all_buckets": prewarmed == 3,
+            "no_recompile_after_prewarm":
+                len(monitor.compile_events()) == compiles_after_prewarm,
+            "all_requests_resolved": all(
+                isinstance(i, Exception) or i.done()
+                for _, i in ledger),
+            "zero_silently_lost":
+                summary["requests"] == summary["resolved"]
+                and summary["pending"] == 0
+                and summary["requests"] == len(ledger),
+            "completions_numerically_correct":
+                completed_ok and all(completed_ok),
+            "batched_results_bitwise_equal":
+                batched_bitwise and all(batched_bitwise),
+            "failures_all_classified": classified and all(classified),
+            "retry_recovered": retried >= 1
+                and counters.get("resilience.retries", 0) >= 1,
+            "breaker_sacrifices_classified":
+                err1 is not None and err2 is not None
+                and resilience.classify(err1) == taxonomy.TRANSIENT
+                and resilience.classify(err2) == taxonomy.TRANSIENT,
+            "breaker_opened": open_seen
+                and ("closed", "open") in transitions,
+            "breaker_half_open_probe":
+                ("open", "half_open") in transitions,
+            "breaker_closed_again": closed_again
+                and ("half_open", "closed") in transitions,
+            "degraded_mode_served": degraded_served,
+            "watchdog_stall_detected":
+                rt.stats.watchdog_stalls >= 1
+                and counters.get("resilience.watchdog_stalls", 0) >= 1,
+            "watchdog_cancel_retry_served": rt.stats.cancel_retries >= 1
+                and victim.exception() is None,
+            "watchdog_dump_written": bool(
+                flight and os.path.exists(flight) and dump_has_stall),
+            "dump_carries_serving_record": dump_has_serving,
+            "overload_backpressure": summary["outcomes"]["rejected"] >= 1,
+            "deadline_shed": (summary["outcomes"]["shed"]
+                              + summary["outcomes"]["expired"]) >= 1,
+            "p99_math_exact": p99_exact is not None
+                and summary["latency"]["p99_ms"] == p99_exact,
+            "serving_record_on_stream": any(
+                r.get("kind") == "serving" for r in serving_rec),
+        }
+        checks = {k: bool(v) for k, v in checks.items()}
+        row = {"metric": "serving_smoke",
+               "value": int(all(checks.values())), "unit": "ok",
+               "vs_baseline": None,
+               "requests": summary["requests"],
+               "outcomes": summary["outcomes"],
+               "latency_ms": summary.get("latency"),
+               "breaker_transitions": [f"{a}->{b}"
+                                       for a, b in transitions],
+               "checks": checks,
+               "telemetry": _telemetry_brief(monitor.snapshot())}
+        if not all(checks.values()):
+            row["error"] = "failed checks: " + ", ".join(
+                k for k, v in checks.items() if not v)
+        return row
+    finally:
+        faultinject.disarm()
+        if rt is not None:
+            try:
+                rt.close(timeout=5.0)
+            except Exception:
+                pass
+        fluid.set_flags(old_flight)
+        monitor.disable()
+        monitor.reset()
+        if was_enabled:
+            monitor.enable()
+
+
+def main_serving_smoke():
+    """`python bench.py serving_smoke` — CI/tooling entry: the serving
+    chaos row standalone on a 2-device virtual CPU mesh, persisted to
+    BENCH_TPU.json under rows["serving_smoke"].  Exit 0 only when
+    every robustness check passes."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=2")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_serving_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["serving_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def _git_sha():
     try:
         return subprocess.run(
@@ -1960,6 +2275,7 @@ def main():
          bench_mem_profile_smoke),
         ("fault_tolerance_smoke", "fault_tolerance_smoke",
          bench_fault_tolerance_smoke),
+        ("serving_smoke", "serving_smoke", bench_serving_smoke),
         ("program_lint_smoke", "program_lint_smoke",
          bench_program_lint_smoke),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
@@ -2034,6 +2350,8 @@ if __name__ == "__main__":
         sys.exit(main_mem_profile_smoke())
     if "fault_tolerance_smoke" in sys.argv[1:]:
         sys.exit(main_fault_tolerance_smoke())
+    if "serving_smoke" in sys.argv[1:]:
+        sys.exit(main_serving_smoke())
     if "program_lint_smoke" in sys.argv[1:]:
         sys.exit(main_program_lint_smoke())
     main()

@@ -149,6 +149,20 @@ declare_flag("static_check", "off",
              "Static program verification before tracing: "
              "off | warn | error.")
 
+# Hardened inference serving runtime (paddle_tpu.serving, ISSUE 8):
+# defaults for ServingConfig — overridable per-runtime, but a fleet
+# rollout wants one env knob, not a code change.
+declare_flag("serving_queue_depth", 64,
+             "Serving admission control: max queued requests before "
+             "enqueue rejects with backpressure (QueueFullError).")
+declare_flag("serving_deadline_s", 0.0,
+             "Default per-request deadline budget in seconds "
+             "(0 = no deadline unless the request carries one).")
+declare_flag("serving_watchdog_stall_s", 30.0,
+             "Hang watchdog: a serving dispatch in flight longer than "
+             "this triggers a flight-recorder dump and escalates per "
+             "watchdog_policy.")
+
 declare_flag("maxpool_mask_bwd", False,
              "Give max-pool a recompute-mask custom VJP (window passes "
              "+ shifted compares, all XLA-fusable) instead of the "

@@ -72,8 +72,12 @@ class Predictor:
                    if v.persistable}
         self._params = {n: jnp.asarray(data[n]) for n in data.files
                         if n in persist}
-        self._fn = jax.jit(_make_pure_fn(self._program, self._fetch_names,
-                                         self._params))
+        # the un-jitted pure fn is kept addressable: the serving
+        # runtime's degraded mode (run_eager) interprets through it
+        # when the compiled path is circuit-broken
+        self._pure_fn = _make_pure_fn(self._program, self._fetch_names,
+                                      self._params)
+        self._fn = jax.jit(self._pure_fn)
 
     def get_input_names(self):
         return list(self._feed_names)
@@ -81,8 +85,12 @@ class Predictor:
     def get_output_names(self):
         return list(self._fetch_names)
 
-    def run(self, feed):
-        """feed: dict name -> ndarray. Returns [np.ndarray] per fetch."""
+    def prepare_feed(self, feed):
+        """Validate + device-cast one feed dict to the program's feed
+        dtypes: {name: jnp array}.  Shared by run(), run_eager() and
+        the serving runtime's micro-batcher (which pads PREPARED feeds,
+        so a padded batch is bitwise the same arrays a direct run
+        sees)."""
         feeds = {}
         for name in self._feed_names:
             if name not in feed:
@@ -91,7 +99,38 @@ class Predictor:
             dtype = to_jax_dtype(v.dtype) if v is not None and v.dtype \
                 else None
             feeds[name] = jnp.asarray(np.asarray(feed[name]), dtype=dtype)
-        outs = self._fn(feeds)
+        return feeds
+
+    def feed_specs(self):
+        """{feed name: (feature_shape, jax dtype)} — the per-example
+        trailing dims (leading batch dim stripped; None entries for
+        dynamic trailing dims) the serving bucketer shapes its padded
+        buckets from."""
+        specs = {}
+        for name in self._feed_names:
+            v = self._program.global_block()._find_var_recursive(name)
+            shape = tuple(v.shape) if v is not None and v.shape \
+                else None
+            dtype = to_jax_dtype(v.dtype) if v is not None and v.dtype \
+                else jnp.float32
+            feat = None
+            if shape:
+                feat = tuple(None if d in (None, -1) else int(d)
+                             for d in shape[1:])
+            specs[name] = (feat, dtype)
+        return specs
+
+    def run(self, feed):
+        """feed: dict name -> ndarray. Returns [np.ndarray] per fetch."""
+        outs = self._fn(self.prepare_feed(feed))
+        return [np.asarray(o) for o in outs]
+
+    def run_eager(self, feed):
+        """Interpret the pruned program op-by-op WITHOUT jit — no
+        tracing, no compile cache, works at any batch shape.  Slow, but
+        immune to compiled-path failures: the serving runtime's
+        degraded mode routes here while its circuit breaker is open."""
+        outs = self._pure_fn(self.prepare_feed(feed))
         return [np.asarray(o) for o in outs]
 
     # -- AOT --------------------------------------------------------------

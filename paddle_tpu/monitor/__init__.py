@@ -47,6 +47,7 @@ __all__ = [
     "op_table", "op_profile_split", "op_profile", "flight_recorder",
     "flight_dump",
     "mem_profile", "mem_profile_split", "mem_table", "peak_breakdown",
+    "serving_table", "record_serving", "serving_records",
     "MetricsRegistry", "MetricsSession", "CompileLedger", "JsonlWriter",
     "read_jsonl", "Counter", "Gauge", "PEAK_FLOPS", "peak_flops",
     "parse_cost_analysis", "parse_memory_analysis",
@@ -64,6 +65,8 @@ _enabled = False
 # kind="lint" records from the static verifier (ISSUE 7): kept here so
 # snapshot consumers can read them without re-parsing the JSONL
 _lint_records = []
+# kind="serving" records from the serving runtime (ISSUE 8), same idea
+_serving_records = []
 
 
 def enable(jsonl_path=None):
@@ -100,6 +103,7 @@ def reset():
     _registry.reset()
     op_profile.clear_samples()
     del _lint_records[:]
+    del _serving_records[:]
 
 
 # -- recording entry points (no-ops while disabled) ---------------------
@@ -141,6 +145,37 @@ def record_lint(record):
 def lint_records():
     """kind="lint" records seen since enable()/reset(), newest last."""
     return list(_lint_records)
+
+
+def record_serving(record):
+    """Write one kind="serving" record (a ServingStats.to_record()
+    dict from the serving runtime) onto the telemetry JSONL stream and
+    keep it addressable in-process (serving_records()).  Like lint and
+    op_profile records, it rides the stream without touching step
+    numbering."""
+    if not _enabled or not record:
+        return None
+    _serving_records.append(dict(record))
+    _session.emit_record(record)
+    return record
+
+
+def serving_records():
+    """kind="serving" records seen since enable()/reset(), newest
+    last."""
+    return list(_serving_records)
+
+
+def serving_table():
+    """One summary row per live ServingRuntime — request outcomes
+    (completed / shed / expired / rejected / failed / stalled /
+    cancelled), exact p50/p99 latency, bucket mix, queue/in-flight
+    gauges, breaker state + transitions, watchdog stalls.  Empty list
+    when no runtime is alive.  Works with telemetry off: the serving
+    stats ledger is gate-free like the flight recorder's counters."""
+    from ..serving import stats as _serving_stats
+
+    return _serving_stats.serving_table()
 
 
 def record_compile(key, compile_s, flops=None, bytes_accessed=None,
@@ -273,6 +308,9 @@ def snapshot():
     mem = peak_breakdown()
     if mem:
         out["mem_profile"] = mem
+    serving = serving_table()
+    if serving:
+        out["serving"] = serving
     return out
 
 

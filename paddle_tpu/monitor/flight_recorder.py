@@ -123,6 +123,7 @@ class FlightRecorder:
         self._last_op_table = None
         self._last_mem_profile = None
         self._last_lints = {}
+        self._last_serving = {}
         self._last_oom = None
         self._oom_memprof = None   # device_memory_profile() capture
         self._step_seq = 0
@@ -228,6 +229,18 @@ class FlightRecorder:
         with self._lock:
             self._last_lints[record.get("key")] = dict(record)
 
+    def note_serving(self, record):
+        """Latest serving-runtime summary per label (the
+        kind="serving" record shape of ServingStats.to_record()) — the
+        'what was the serving path doing' section of a post-mortem.
+        The serving watchdog refreshes it right before a stall dump so
+        the dump carries the current outcome ledger, exact latency
+        percentiles and breaker state."""
+        if not self.enabled or not record:
+            return
+        with self._lock:
+            self._last_serving[record.get("key")] = dict(record)
+
     def note_oom(self, exc):
         """Record one memory-exhaustion event: the error text, the
         requested bytes parsed from it, the device allocator's own
@@ -281,6 +294,7 @@ class FlightRecorder:
                 "op_table": self._last_op_table,
                 "mem_profile": self._last_mem_profile,
                 "lints": list(self._last_lints.values()),
+                "serving": list(self._last_serving.values()),
                 "oom": self._last_oom,
                 "step_seq": self._step_seq,
             }
@@ -294,6 +308,7 @@ class FlightRecorder:
             self._last_op_table = None
             self._last_mem_profile = None
             self._last_lints.clear()
+            self._last_serving.clear()
             self._last_oom = None
             self._oom_memprof = None
             self._step_seq = 0
@@ -356,6 +371,10 @@ class FlightRecorder:
             # telemetry stream's — telemetry_report's lint section
             # reads a dump exactly like a live stream
             lines.append(lint)
+        for serving in snap.get("serving") or ():
+            # likewise one kind="serving" line per runtime label —
+            # outcome ledger, exact latency percentiles, breaker state
+            lines.append(serving)
         if snap["oom"]:
             lines.append(snap["oom"])
         lines.extend(snap["events"])

@@ -31,10 +31,20 @@ from multiprocessing import shared_memory
 
 import numpy as np
 
-__all__ = ["ShmBatchLoader"]
+__all__ = ["ShmBatchLoader", "ProducerDeadError"]
 
 _END = "__end__"
 _ERR = "__err__"
+
+
+class ProducerDeadError(ConnectionError):
+    """A shm worker PROCESS died without reporting (OOM killer,
+    segfault, SIGKILL) while the consumer was blocked on its queue.
+    Subclasses ConnectionError so the resilience taxonomy classifies
+    it TRANSIENT by type — a re-launched loader epoch is the recovery,
+    exactly like the reference fleet re-launching a dead worker —
+    instead of the consumer hanging forever on a queue nobody will
+    ever feed again."""
 
 # segment names handed to the parent but not yet unlinked; one process-
 # wide registry + atexit hook (per-instance hooks would pin loaders)
@@ -88,6 +98,14 @@ def _worker_main(batch_reader, worker_id, num_workers, sharded, q,
                  capacity_sem):
     signal.signal(signal.SIGTERM, lambda *a: exit(0))
     try:
+        # fault-injection hook (inherited by fork): an armed
+        # crash_point("shm.worker") kills THIS process without a
+        # sentinel — the SIGKILL/OOM-killer shape the consumer's
+        # producer-death guard must detect (see except InjectedCrash)
+        from ..resilience import faultinject as _fi
+    except Exception:
+        _fi = None
+    try:
         if sharded:
             # shard-aware reader: each worker generates ONLY its batches
             it = batch_reader(worker_id, num_workers)
@@ -99,6 +117,8 @@ def _worker_main(batch_reader, worker_id, num_workers, sharded, q,
             it = itertools.islice(batch_reader(), worker_id, None,
                                   num_workers)
         for batch in it:
+            if _fi is not None:
+                _fi.crash_point("shm.worker")
             arrays = _normalize(batch)
             total = sum(a.nbytes for _, a in arrays)
             capacity_sem.acquire()      # bound in-flight shared memory
@@ -123,7 +143,16 @@ def _worker_main(batch_reader, worker_id, num_workers, sharded, q,
             except Exception:
                 pass
         q.put((_END, worker_id))
-    except BaseException:
+    except BaseException as e:
+        if _fi is not None and isinstance(e, _fi.InjectedCrash):
+            # model a SIGKILL faithfully: no sentinel, no cleanup —
+            # the process just stops existing.  (q.put'ing _ERR here
+            # would be a dying process politely reporting its own
+            # murder, which is exactly what the producer-death guard
+            # exists to NOT rely on.)
+            import os
+
+            os._exit(1)
         q.put((_ERR, traceback.format_exc()))
 
 
@@ -153,12 +182,15 @@ class ShmBatchLoader:
     """
 
     def __init__(self, batch_reader, num_workers=2, capacity=4,
-                 mp_context=None):
+                 mp_context=None, death_poll_s=1.0):
         assert num_workers >= 1
         self._reader = batch_reader
         self._sharded = is_shard_aware(batch_reader)
         self._num_workers = num_workers
         self._capacity = capacity
+        # producer-death guard poll: how long one blocking queue read
+        # waits before re-checking the worker process is still alive
+        self._death_poll_s = death_poll_s
         # fork: generators/closures pass to children for free (the
         # reference's loader forks too); children only touch numpy
         self._ctx = mp.get_context(mp_context or "fork")
@@ -195,17 +227,32 @@ class ShmBatchLoader:
                 i = active[pos % len(active)]
                 while True:
                     try:
-                        item = queues[i].get(timeout=5.0)
+                        item = queues[i].get(timeout=self._death_poll_s)
                         break
                     except Exception:
-                        # worker killed without a sentinel (OOM killer,
-                        # segfault): surface it instead of hanging
+                        # producer-death guard: a worker killed without
+                        # a sentinel (OOM killer, segfault, SIGKILL)
+                        # would leave this get() blocked FOREVER —
+                        # poll-check liveness and raise a CLASSIFIED
+                        # error instead (ProducerDeadError is transient
+                        # in the resilience taxonomy: re-running the
+                        # loader is the recovery)
                         p = procs[i]
                         if not p.is_alive():
-                            raise RuntimeError(
+                            try:
+                                # the dying worker's queue feeder may
+                                # have flushed a final batch: drain it
+                                # before declaring starvation
+                                item = queues[i].get_nowait()
+                                break
+                            except Exception:
+                                pass
+                            raise ProducerDeadError(
                                 f"multiprocess DataLoader worker {i} "
                                 f"died (exitcode {p.exitcode}) without "
-                                f"reporting — likely killed (OOM?)")
+                                f"reporting — likely killed (OOM?); "
+                                f"consumer unblocked instead of "
+                                f"hanging")
                 if item[0] == _END:
                     active.remove(i)
                     continue

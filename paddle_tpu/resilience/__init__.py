@@ -36,6 +36,7 @@ Usage::
                                auto_resume=True)
 """
 
+from .breaker import (CircuitBreaker, CircuitOpenError)      # noqa: F401
 from .faultinject import (FaultPlan, InjectedCrash,          # noqa: F401
                           InjectedTransientError, plan_scope)
 from . import faultinject                                    # noqa: F401
@@ -46,7 +47,8 @@ from .guard import (AnomalyError, AnomalyGuard,              # noqa: F401
 from .preempt import (PreemptionHandler, clear_preemption,   # noqa: F401
                       preemption_requested, request_preemption)
 from .retry import RetriesExhausted, RetryPolicy, call_with_retry
-from .taxonomy import (FATAL, TRANSIENT, TAXONOMY, classify, is_oom,
+from .taxonomy import (DEADLINE, FATAL, TRANSIENT, TAXONOMY,
+                       DeadlineExceeded, classify, is_deadline, is_oom,
                        is_transient)
 
 __all__ = [
@@ -57,8 +59,11 @@ __all__ = [
     # retry
     "RetryPolicy", "RetriesExhausted", "call_with_retry",
     "enable_retry", "disable_retry", "active_retry",
+    # breaker
+    "CircuitBreaker", "CircuitOpenError",
     # taxonomy
-    "classify", "is_transient", "is_oom", "TRANSIENT", "FATAL",
+    "classify", "is_transient", "is_oom", "is_deadline",
+    "DeadlineExceeded", "TRANSIENT", "FATAL", "DEADLINE",
     "TAXONOMY",
     # preemption
     "PreemptionHandler", "preemption_requested", "request_preemption",

@@ -31,7 +31,7 @@ from .taxonomy import InjectedCrash, InjectedTransientError
 
 __all__ = ["FaultPlan", "arm", "disarm", "active_plan", "is_armed",
            "plan_scope", "on_step_feed", "check_transient", "crash_point",
-           "InjectedTransientError", "InjectedCrash"]
+           "stall_point", "InjectedTransientError", "InjectedCrash"]
 
 _lock = threading.Lock()
 _plan = None
@@ -49,24 +49,52 @@ class FaultPlan:
     nan_at_steps:   iterable of step indices whose feeds get tainted
     nan_feed:       feed var name to taint (default: first float feed,
                     in sorted-name order for determinism)
-    transient_at_step: step index that raises InjectedTransientError
-    transient_times:   how many consecutive raises before succeeding
+    transient_at_step: step index (or iterable of indices — the
+                    serving breaker tests need CONSECUTIVE dispatch
+                    failures) that raises InjectedTransientError
+    transient_times:   how many raises total before succeeding (shared
+                    budget across the scheduled steps)
     crash_points:   {point_name: nth_hit_to_fire} (0-based hit count)
+    stall_points:   {point_name: spec} latency/hang injection (ISSUE 8):
+                    spec is a float (deterministic sleep of that many
+                    seconds) or a threading.Event (block until the test
+                    sets it — a REAL hang with no wall-clock guess, so
+                    watchdog tests are not timing-flaky).  One-shot per
+                    point; a (nth_hit, spec) tuple targets a later
+                    visit.
     """
 
     def __init__(self, nan_at_steps=(), nan_feed=None,
                  transient_at_step=None, transient_times=1,
-                 crash_points=None):
+                 crash_points=None, stall_points=None):
         self.nan_at_steps = set(int(s) for s in (
             nan_at_steps if not isinstance(nan_at_steps, int)
             else (nan_at_steps,)))
         self.nan_feed = nan_feed
-        self.transient_at_step = transient_at_step
+        if transient_at_step is None:
+            self.transient_at_steps = set()
+        elif isinstance(transient_at_step, int):
+            self.transient_at_steps = {transient_at_step}
+        else:
+            self.transient_at_steps = set(
+                int(s) for s in transient_at_step)
         self.transient_remaining = int(transient_times)
         self.crash_points = dict(crash_points or {})
         self._crash_hits = {}
+        self.stall_points = {
+            name: (spec if isinstance(spec, tuple) else (0, spec))
+            for name, spec in (stall_points or {}).items()}
+        self._stall_hits = {}
         self.step = 0
-        self.fired = {"nan": 0, "transient": 0, "crash": 0}
+        self.fired = {"nan": 0, "transient": 0, "crash": 0, "stall": 0}
+
+    @property
+    def transient_at_step(self):
+        """Back-compat single-step view (None unless exactly one
+        step is scheduled — multi-step plans have no single index)."""
+        if len(self.transient_at_steps) == 1:
+            return next(iter(self.transient_at_steps))
+        return None
 
     def describe(self):
         return {"step": self.step, "fired": dict(self.fired)}
@@ -172,11 +200,11 @@ def check_transient():
     current step.  The step index was fixed by on_step_feed (which
     runs first), so every retry of the SAME step re-enters here."""
     p = _plan
-    if p is None or p.transient_at_step is None:
+    if p is None or not p.transient_at_steps:
         return
     # on_step_feed already advanced p.step past the current dispatch
     current = p.step - 1
-    if current != p.transient_at_step:
+    if current not in p.transient_at_steps:
         return
     with _lock:
         if p.transient_remaining <= 0:
@@ -220,3 +248,40 @@ def crash_point(name):
     fr.note_event("injected_crash", severe=True, point=name)
     fr.dump(f"injected_crash:{name}")
     raise InjectedCrash(f"injected crash at point {name!r}")
+
+
+def stall_point(name):
+    """Instrumented code calls this at its hang-vulnerable points (the
+    serving dispatch, the shm consumer loop); a no-op unless an armed
+    plan schedules `name`.  Fires the scheduled stall on the scheduled
+    visit (0-based hit count), then disarms that point.
+
+    A float spec sleeps that many seconds (deterministic latency); a
+    threading.Event spec BLOCKS until the test sets it — the honest
+    hang the watchdog must detect, with no wall-clock race.  A stuck
+    test can't deadlock CI: event waits are capped at 120s."""
+    p = _plan
+    if p is None or name not in p.stall_points:
+        return
+    with _lock:
+        if name not in p.stall_points:       # re-check under lock
+            return
+        hit = p._stall_hits.get(name, 0)
+        p._stall_hits[name] = hit + 1
+        target_hit, spec = p.stall_points[name]
+        if hit != target_hit:
+            return
+        del p.stall_points[name]             # one-shot
+        p.fired["stall"] += 1
+    mon = _mon()
+    if mon.is_enabled():
+        mon.counter("resilience.injected_stall").add(1)
+    _fr().note_event("injected_stall", point=name,
+                     spec=("event" if isinstance(spec, threading.Event)
+                           else float(spec)))
+    if isinstance(spec, threading.Event):
+        spec.wait(timeout=120.0)
+    else:
+        import time
+
+        time.sleep(float(spec))

@@ -23,11 +23,30 @@ inspectable/testable.  Two axes:
 
 import re
 
-__all__ = ["TRANSIENT", "FATAL", "classify", "is_transient", "is_oom",
+__all__ = ["TRANSIENT", "FATAL", "DEADLINE", "classify", "is_transient",
+           "is_oom", "is_deadline", "DeadlineExceeded",
            "InjectedTransientError", "InjectedCrash", "TAXONOMY"]
 
 TRANSIENT = "transient"
 FATAL = "fatal"
+# a request/dispatch ran out of TIME BUDGET (shed in a serving queue,
+# stalled past the hang watchdog's threshold).  Distinct from TRANSIENT
+# on purpose: retrying is exactly wrong — the budget is already spent,
+# so the only honest outcome is a fast classified failure the caller
+# can act on (shed load, re-issue with a fresh budget).
+DEADLINE = "deadline"
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request exceeded its time budget — shed from the serving
+    queue before dispatch, or expired while a dispatch was in flight.
+    Classified DEADLINE by TYPE (never retried: the budget is gone);
+    `elapsed_s`/`budget_s` carry the forensics when known."""
+
+    def __init__(self, msg, elapsed_s=None, budget_s=None):
+        super().__init__(msg)
+        self.elapsed_s = elapsed_s
+        self.budget_s = budget_s
 
 
 class InjectedTransientError(RuntimeError):
@@ -91,22 +110,48 @@ _OOM_PATTERN = re.compile(
     r"\bRESOURCE_EXHAUSTED\b|\bout of memory\b|\ballocation fail",
     re.IGNORECASE)
 
+# deadline/timeout-shaped failure text (ISSUE 8): a shed or stalled
+# request must classify distinctly from generic transients — is_deadline
+# walks the cause/context chain like is_oom, so a RetriesExhausted (or a
+# serving-layer wrapper) around a watchdog stall still reads as one.
+# Like OOM, deadline-shaped death is a flight-recorder dump trigger:
+# the serving watchdog dumps the in-flight batch's metadata before
+# escalating.
+_DEADLINE_PATTERN = re.compile(
+    r"\bDEADLINE_EXCEEDED\b|deadline exceeded|timed out\b"
+    r"|watchdog stall", re.IGNORECASE)
+
+# deadline-shaped exception TYPES for classify(): checked FIRST — a
+# DeadlineExceeded whose message quotes a transient-looking log line
+# must still fail fast.  (TimeoutError stays in _TRANSIENT_TYPES for
+# classify — a bare socket timeout is retry-worthy — but is_deadline
+# still recognizes it on the orthogonal axis.)
+_DEADLINE_TYPES = (DeadlineExceeded,)
+
 # the full inspectable table (used by the README and tests)
 TAXONOMY = {
     "fatal_types": tuple(t.__name__ for t in _FATAL_TYPES),
     "transient_types": tuple(t.__name__ for t in _TRANSIENT_TYPES),
+    "deadline_types": tuple(t.__name__ for t in _DEADLINE_TYPES),
     "message_rules": tuple((p.pattern, cls) for p, cls in _MESSAGE_RULES),
-    "dump_triggers": {"oom": _OOM_PATTERN.pattern},
+    "dump_triggers": {"oom": _OOM_PATTERN.pattern,
+                      "deadline": _DEADLINE_PATTERN.pattern},
 }
 
 
 def classify(exc):
-    """TRANSIENT or FATAL for one exception instance.
+    """TRANSIENT, FATAL or DEADLINE for one exception instance.
 
-    Precedence: transient types > fatal types > message rules > FATAL.
-    (An InjectedTransientError is a RuntimeError subclass; the type
-    check must see it before any message rule fires.)
+    Precedence: deadline types > transient types > fatal types >
+    message rules > FATAL.  (An InjectedTransientError is a
+    RuntimeError subclass; the type check must see it before any
+    message rule fires.  A raw XLA "DEADLINE_EXCEEDED" status message
+    on a non-DeadlineExceeded type stays TRANSIENT — a collective
+    rendezvous timeout is infrastructure and retry-worthy; only the
+    runtime's own budget-expiry type means the budget is spent.)
     """
+    if isinstance(exc, _DEADLINE_TYPES):
+        return DEADLINE
     if isinstance(exc, _TRANSIENT_TYPES):
         return TRANSIENT
     if isinstance(exc, _FATAL_TYPES):
@@ -135,6 +180,26 @@ def is_oom(exc):
         if isinstance(exc, MemoryError):
             return True
         if _OOM_PATTERN.search(str(exc)):
+            return True
+        exc = exc.__cause__ or exc.__context__
+    return False
+
+
+def is_deadline(exc):
+    """True when `exc` is a deadline/timeout-shaped failure — a
+    DeadlineExceeded or TimeoutError, or a DEADLINE_EXCEEDED /
+    "deadline exceeded" / watchdog-stall message anywhere in the
+    exception or its cause/context chain (a RetriesExhausted wrapping a
+    stalled dispatch still reads as one).  Orthogonal to classify():
+    the serving layer uses it to count shed/stalled requests distinctly
+    from generic transients and to trigger the watchdog's
+    flight-recorder dump."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if isinstance(exc, _DEADLINE_TYPES + (TimeoutError,)):
+            return True
+        if _DEADLINE_PATTERN.search(str(exc)):
             return True
         exc = exc.__cause__ or exc.__context__
     return False

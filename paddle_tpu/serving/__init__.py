@@ -1,0 +1,39 @@
+"""paddle_tpu.serving — hardened inference serving runtime (ISSUE 8).
+
+Layers a resilient request path over the inference engines
+(`Predictor` / `CompiledPredictor`):
+
+- **Dynamic micro-batching** into pre-warmed padded bucket shapes
+  (`bucketing.py`) — no recompile storm, bitwise-equal results.
+- **Admission control** — bounded queue + per-request deadlines;
+  overload degrades to bounded latency (classified sheds and
+  backpressure rejections), never unbounded queueing.
+- **Circuit breaker + jittered retry** around the batched dispatch,
+  reusing `resilience/retry.py` and the error taxonomy; while open,
+  a degraded-mode fallback (smallest bucket or the eager interpreter)
+  keeps serving.
+- **Hang watchdog** — a stalled dispatch triggers a flight-recorder
+  post-mortem with the in-flight batch's metadata, then escalates
+  (classified failure or cancel-and-retry).
+
+Observability: exact p50/p99 latency, queue-depth/in-flight gauges,
+`resilience.*` shed/retry/breaker/watchdog counters, per-request spans
+in the merged Chrome trace, `monitor.serving_table()`, and
+kind="serving" records on the telemetry JSONL stream and in flight
+dumps (tools/telemetry_report.py renders both).
+"""
+
+from .bucketing import (BucketDispatcher, default_buckets,  # noqa: F401
+                        pick_bucket)
+from .runtime import (DeadlineExceeded, QueueFullError,     # noqa: F401
+                      ServingClosedError, ServingConfig,
+                      ServingFuture, ServingRuntime)
+from .stats import ServingStats, serving_table              # noqa: F401
+from .watchdog import HangWatchdog, WatchdogStall           # noqa: F401
+
+__all__ = [
+    "ServingRuntime", "ServingConfig", "ServingFuture",
+    "QueueFullError", "ServingClosedError", "DeadlineExceeded",
+    "WatchdogStall", "HangWatchdog", "ServingStats", "serving_table",
+    "BucketDispatcher", "default_buckets", "pick_bucket",
+]

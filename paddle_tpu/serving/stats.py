@@ -1,0 +1,235 @@
+"""Serving observability: exact latency percentiles + outcome ledger.
+
+Every request that enters a ServingRuntime ends in EXACTLY one of the
+outcome buckets below — completed, shed (deadline expired in queue),
+expired (deadline passed in flight), rejected (backpressure at
+enqueue), failed (classified dispatch error), stalled (watchdog
+escalation), cancelled (runtime closed) — so `requests ==
+sum(outcomes)` is an invariant the chaos smoke asserts: a serving
+runtime that silently loses a request has failed at its one job.
+
+Latency percentiles are EXACT nearest-rank over the recorded samples
+(bounded ring, default 8192): `p(q) = sorted[ceil(q*n)-1]`.  No
+histogram buckets, no interpolation — the smoke row recomputes p99
+from the raw samples and asserts equality with the table's number.
+
+Counters are double-booked like the flight recorder's: gate-free local
+fields (the serving table must work with telemetry off) plus
+`resilience.*`/`serving.*` monitor counters while telemetry is on.
+"""
+
+import collections
+import math
+import threading
+import weakref
+
+__all__ = ["ServingStats", "exact_percentile", "serving_table",
+           "all_stats"]
+
+_SAMPLE_CAP = 8192
+
+# live runtimes' stats, keyed by label — what monitor.serving_table()
+# reads.  Weak values: a dropped runtime leaves the table (its final
+# numbers persist in the telemetry JSONL / flight dump it emitted).
+_REGISTRY = weakref.WeakValueDictionary()
+_registry_lock = threading.Lock()
+
+
+def exact_percentile(sorted_samples, q):
+    """Nearest-rank percentile: the smallest recorded sample >= q of
+    the distribution — an ACTUAL sample, never an interpolation, so
+    re-deriving it from the raw samples is equality, not allclose."""
+    n = len(sorted_samples)
+    if not n:
+        return None
+    rank = max(1, math.ceil(q * n))
+    return sorted_samples[min(n, rank) - 1]
+
+
+def _mon():
+    from .. import monitor
+
+    return monitor
+
+
+OUTCOMES = ("completed", "shed", "expired", "rejected", "failed",
+            "stalled", "cancelled")
+
+
+class ServingStats:
+    """One runtime's gate-free outcome ledger + latency samples."""
+
+    def __init__(self, label="serving", register=True):
+        self.label = label
+        self._lock = threading.Lock()
+        self._outcomes = {k: 0 for k in OUTCOMES}
+        self.requests = 0
+        self.batches = 0
+        self.padded_rows = 0
+        self.dispatched_rows = 0
+        self.degraded = 0
+        self.retries = 0
+        self.watchdog_stalls = 0
+        self.cancel_retries = 0
+        self._samples = collections.deque(maxlen=_SAMPLE_CAP)
+        self._buckets = {}            # bucket size -> dispatch count
+        self._breaker = None          # CircuitBreaker, set by runtime
+        self.queue_depth = 0
+        self.in_flight = 0
+        if register:
+            with _registry_lock:
+                _REGISTRY[label] = self
+
+    def attach_breaker(self, breaker):
+        self._breaker = breaker
+
+    # -- recording ------------------------------------------------------
+    def note_admitted(self, depth):
+        with self._lock:
+            self.requests += 1
+            self.queue_depth = depth
+        mon = _mon()
+        if mon.is_enabled():
+            mon.counter("serving.requests").add(1)
+            mon.gauge("serving.queue_depth").set(depth)
+
+    def note_queue_depth(self, depth):
+        with self._lock:
+            self.queue_depth = depth
+        mon = _mon()
+        if mon.is_enabled():
+            mon.gauge("serving.queue_depth").set(depth)
+
+    def note_in_flight(self, n):
+        with self._lock:
+            self.in_flight = n
+        mon = _mon()
+        if mon.is_enabled():
+            mon.gauge("serving.in_flight").set(n)
+
+    def note_outcome(self, outcome, latency_s=None):
+        """Terminal state of one request.  `rejected` requests never
+        counted as admitted, so they increment `requests` here — the
+        invariant stays sum(outcomes) == requests."""
+        with self._lock:
+            self._outcomes[outcome] += 1
+            if outcome == "rejected":
+                self.requests += 1
+            if latency_s is not None:
+                self._samples.append(float(latency_s))
+        mon = _mon()
+        if mon.is_enabled():
+            name = {"completed": "serving.completed",
+                    "shed": "resilience.serving_shed",
+                    "expired": "resilience.serving_expired",
+                    "rejected": "resilience.serving_rejected",
+                    "failed": "resilience.serving_failed",
+                    "stalled": "resilience.serving_stalled",
+                    "cancelled": "resilience.serving_cancelled"}[outcome]
+            mon.counter(name).add(1)
+
+    def note_batch(self, bucket, rows, degraded=False):
+        """One dispatched batch.  bucket=None means the dispatch went
+        through a NON-bucketed path (the degraded eager interpreter):
+        it counts as a batch but must not invent a bucket key in the
+        bucket-mix observability."""
+        with self._lock:
+            self.batches += 1
+            self.dispatched_rows += rows
+            if bucket is not None:
+                self.padded_rows += max(0, bucket - rows)
+                self._buckets[bucket] = self._buckets.get(bucket, 0) + 1
+            if degraded:
+                self.degraded += 1
+        mon = _mon()
+        if mon.is_enabled():
+            mon.counter("serving.batches").add(1)
+            if bucket is not None:
+                mon.counter(f"serving.bucket_{bucket}").add(1)
+            if degraded:
+                mon.counter("resilience.serving_degraded").add(1)
+
+    def note_retry(self):
+        with self._lock:
+            self.retries += 1
+
+    def note_watchdog_stall(self):
+        with self._lock:
+            self.watchdog_stalls += 1
+        mon = _mon()
+        if mon.is_enabled():
+            mon.counter("resilience.watchdog_stalls").add(1)
+
+    def note_cancel_retry(self):
+        with self._lock:
+            self.cancel_retries += 1
+        mon = _mon()
+        if mon.is_enabled():
+            mon.counter("resilience.watchdog_cancel_retry").add(1)
+
+    # -- reading --------------------------------------------------------
+    def samples(self):
+        with self._lock:
+            return list(self._samples)
+
+    def latency(self):
+        """Exact latency stats over the recorded end-to-end samples."""
+        s = sorted(self.samples())
+        if not s:
+            return None
+        return {
+            "count": len(s),
+            "mean_ms": round(sum(s) / len(s) * 1e3, 3),
+            "p50_ms": round(exact_percentile(s, 0.50) * 1e3, 3),
+            "p99_ms": round(exact_percentile(s, 0.99) * 1e3, 3),
+            "max_ms": round(s[-1] * 1e3, 3),
+        }
+
+    def summary(self):
+        """json-safe serving-table row: outcomes, invariant check,
+        latency percentiles, bucket mix, breaker + watchdog state."""
+        with self._lock:
+            outcomes = dict(self._outcomes)
+            out = {
+                "key": self.label,
+                "requests": self.requests,
+                "outcomes": outcomes,
+                "resolved": sum(outcomes.values()),
+                "pending": self.requests - sum(outcomes.values()),
+                "batches": self.batches,
+                "dispatched_rows": self.dispatched_rows,
+                "padded_rows": self.padded_rows,
+                "buckets": {str(k): v
+                            for k, v in sorted(self._buckets.items())},
+                "degraded_batches": self.degraded,
+                "dispatch_retries": self.retries,
+                "watchdog_stalls": self.watchdog_stalls,
+                "cancel_retries": self.cancel_retries,
+                "queue_depth": self.queue_depth,
+                "in_flight": self.in_flight,
+            }
+        lat = self.latency()
+        if lat:
+            out["latency"] = lat
+        if self._breaker is not None:
+            out["breaker"] = self._breaker.summary()
+        return out
+
+    def to_record(self):
+        """The kind="serving" telemetry record — one line on the JSONL
+        stream / flight dump, same shape the report tool parses."""
+        rec = {"kind": "serving"}
+        rec.update(self.summary())
+        return rec
+
+
+def all_stats():
+    with _registry_lock:
+        return dict(_REGISTRY)
+
+
+def serving_table():
+    """One summary row per live ServingRuntime (newest state, exact
+    percentiles) — what monitor.serving_table() returns and
+    snapshot()["serving"] embeds."""
+    return [s.summary() for s in all_stats().values()]

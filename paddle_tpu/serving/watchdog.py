@@ -1,0 +1,147 @@
+"""Hang watchdog — a wedged dispatch must be detected, explained, and
+escalated, never waited on forever.
+
+PR 4's retry layer handles dispatches that FAIL; this thread handles
+dispatches that do NOTHING — a collective stuck at rendezvous, a
+runtime bug, an injected hang.  The batcher registers every in-flight
+dispatch (`track`) with its batch metadata; the watchdog polls the
+registry, and any entry older than `stall_s`:
+
+1. gets a flight-recorder post-mortem dump NOW (reason
+   "serving_stall", carrying the in-flight batch's metadata — bucket,
+   rows, request ids, elapsed — plus the usual last-K window), because
+   a process wedged hard enough may never reach another dump point;
+2. bumps `resilience.watchdog_stalls`;
+3. has its `stalled` event set — the dispatch's WAITER escalates per
+   policy (fail the batch with a classified WatchdogStall, or abandon
+   the wedged call and retry degraded); the watchdog itself never
+   kills anything (you cannot cancel an XLA dispatch, only stop
+   waiting for it).
+
+The clock is injectable and the poll interval adapts to the stall
+threshold, so tests run with millisecond thresholds and zero flakes.
+"""
+
+import threading
+import time
+
+from ..resilience.taxonomy import DeadlineExceeded
+
+__all__ = ["HangWatchdog", "WatchdogStall"]
+
+
+class WatchdogStall(DeadlineExceeded):
+    """A dispatch exceeded the watchdog's stall threshold and the
+    escalation policy chose to fail it.  Subclasses DeadlineExceeded:
+    classified DEADLINE (never blind-retried), `is_deadline`-true, and
+    distinct from generic transients in every counter."""
+
+
+def _fr():
+    from ..monitor import flight_recorder
+
+    return flight_recorder
+
+
+class HangWatchdog:
+    """Monitor in-flight serving dispatches for stalls."""
+
+    def __init__(self, stall_s, poll_s=None, clock=time.monotonic,
+                 stats=None, label="serving", pre_dump=None,
+                 on_poll=None):
+        # pre_dump: zero-arg callback run before the stall dump — the
+        # runtime uses it to push its freshest kind="serving" record
+        # into the flight recorder so the dump carries the serving
+        # table, not a stale one
+        self.pre_dump = pre_dump
+        # on_poll: zero-arg callback run every poll tick — the runtime
+        # hangs its queue deadline sweep here, so budget expiry is
+        # enforced even while the batcher thread is wedged inside the
+        # very stall this watchdog exists to catch
+        self.on_poll = on_poll
+        self.stall_s = float(stall_s)
+        # poll fast enough to detect within ~12% of the threshold, but
+        # never busy-spin; the cap keeps an idle runtime cheap
+        self.poll_s = poll_s if poll_s is not None else \
+            min(max(self.stall_s / 8.0, 0.005), 1.0)
+        self.clock = clock
+        self.stats = stats
+        self.label = label
+        self._lock = threading.Lock()
+        self._inflight = {}          # token -> entry
+        self._next_token = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{self.label}-watchdog",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    # -- registry -------------------------------------------------------
+    def track(self, meta):
+        """Register one in-flight dispatch; returns (token, stalled
+        threading.Event).  The waiter waits on `done OR stalled`."""
+        entry = {"start": self.clock(), "meta": dict(meta or {}),
+                 "stalled": threading.Event(), "flagged": False}
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            self._inflight[token] = entry
+        if self.stats is not None:
+            self.stats.note_in_flight(len(self._inflight))
+        return token, entry["stalled"]
+
+    def untrack(self, token):
+        with self._lock:
+            self._inflight.pop(token, None)
+        if self.stats is not None:
+            self.stats.note_in_flight(len(self._inflight))
+
+    def check_now(self):
+        """One scan pass (the loop body, callable directly by tests)."""
+        now = self.clock()
+        with self._lock:
+            entries = list(self._inflight.items())
+        for token, e in entries:
+            elapsed = now - e["start"]
+            if elapsed < self.stall_s or e["flagged"]:
+                continue
+            e["flagged"] = True
+            if self.stats is not None:
+                self.stats.note_watchdog_stall()
+            fr = _fr()
+            fr.note_event(
+                "serving_stall", severe=True, label=self.label,
+                elapsed_s=round(elapsed, 4),
+                stall_threshold_s=self.stall_s, **e["meta"])
+            # dump BEFORE escalation: if the waiter's policy raises and
+            # the caller exits, the post-mortem already exists — and it
+            # records what the wedged dispatch was doing
+            if self.pre_dump is not None:
+                try:
+                    self.pre_dump()
+                except Exception:
+                    pass
+            fr.dump(f"serving_stall:{self.label}")
+            e["stalled"].set()
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_s):
+            if self.on_poll is not None:
+                try:
+                    self.on_poll()
+                except Exception:
+                    pass
+            self.check_now()

@@ -247,3 +247,19 @@ def test_fault_tolerance_smoke_in_suite_and_standalone():
     assert '("fault_tolerance_smoke", "fault_tolerance_smoke"' in src
     assert '"fault_tolerance_smoke" in sys.argv[1:]' in src
     assert "main_fault_tolerance_smoke" in src
+
+
+# ---------------------------------------------------------------------------
+# serving_smoke chaos row (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_serving_smoke_in_suite_and_standalone():
+    """The serving chaos row is wired into the suite AND the standalone
+    argv entry (the robustness behaviors themselves are covered
+    end-to-end by tests/test_serving.py; re-running the whole row here
+    would pay its compiles twice per CI run for no new signal)."""
+    src = open(bench.__file__).read()
+    assert '("serving_smoke", "serving_smoke"' in src
+    assert '"serving_smoke" in sys.argv[1:]' in src
+    assert "main_serving_smoke" in src

@@ -189,3 +189,44 @@ def test_feeds_static_training():
         losses = [float(exe.run(main, feed=b, fetch_list=[loss])[0])
                   for b in loader]
         assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------
+# producer-death guard (ISSUE 8 satellite)
+# ---------------------------------------------------------------------
+
+def test_producer_death_raises_classified_instead_of_hanging():
+    """A worker PROCESS killed without a sentinel (the OOM-killer /
+    SIGKILL shape, injected via the fault harness: crash_point in a
+    forked child exits hard with no cleanup) must unblock the consumer
+    with a CLASSIFIED transient error — not hang it forever on a queue
+    nobody will ever feed again."""
+    from paddle_tpu.reader.shm import ProducerDeadError
+    from paddle_tpu.resilience import faultinject, taxonomy
+
+    with faultinject.plan_scope(crash_points={"shm.worker": 2}):
+        loader = ShmBatchLoader(_batches(n=8), num_workers=1,
+                                death_poll_s=0.2)
+        got = []
+        t0 = time.time()
+        with pytest.raises(ProducerDeadError) as ei:
+            for b in loader:
+                got.append(int(b["i"][0]))
+        # batches before the injected kill arrived in order...
+        assert got == [0, 1]
+        # ...the guard detected the death promptly (no 300s hang)
+        assert time.time() - t0 < 30
+        assert "died" in str(ei.value)
+    # the error is transient in the taxonomy: re-running the loader is
+    # the recovery, like the reference fleet re-launching a worker
+    assert taxonomy.classify(ei.value) == taxonomy.TRANSIENT
+    assert isinstance(ei.value, ConnectionError)
+
+
+def test_producer_death_guard_does_not_fire_on_healthy_worker():
+    """The liveness poll must be invisible to a healthy run: same
+    batches, same order, no spurious ProducerDeadError."""
+    loader = ShmBatchLoader(_batches(n=6), num_workers=1,
+                            death_poll_s=0.1)
+    got = [int(b["i"][0]) for b in loader]
+    assert got == list(range(6))

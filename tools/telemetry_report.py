@@ -14,8 +14,11 @@ stream), a static-analysis section (from the kind="lint" records the
 verifier emits once per program version: error/warning counts by PT
 code per program key), and a resilience-event summary (retries, skipped steps,
 rollbacks, OOM events, checkpoint saves/restores over the run, from
-the sampled counters) — without touching the process that produced
-the file.
+the sampled counters), and a serving section (from the kind="serving"
+records the serving runtime emits: request outcome ledger with the
+zero-silent-loss invariant, exact latency percentiles, shed/breaker/
+watchdog event counts per runtime label) — without touching the
+process that produced the file.
 
 Usage: python tools/telemetry_report.py <telemetry.jsonl>
 """
@@ -78,6 +81,9 @@ def summarize(records):
     mem = _memory_section(records)
     if mem:
         out["memory"] = mem
+    serving = _serving_section(records)
+    if serving:
+        out["serving"] = serving
     resil = _resilience_section(steps)
     if resil:
         out["resilience"] = resil
@@ -183,6 +189,64 @@ def _memory_section(records, top=5):
              for k in ("error", "requested_bytes", "device_memory")
              if o.get(k) is not None}
             for o in ooms]
+    return out
+
+
+def _serving_section(records):
+    """Serving-runtime summary from the kind="serving" records the
+    runtime emits (on close / emit_telemetry, and in flight dumps via
+    the watchdog's pre-dump refresh — both carry the same shape, so a
+    dump reads exactly like a live stream).  Newest record per runtime
+    label wins; per program key: latency percentiles (exact, as the
+    runtime computed them over its recorded samples), the outcome
+    ledger with the zero-silent-loss invariant, and the
+    shed/breaker/watchdog event counts."""
+    per_key = {}
+    for r in records:
+        if r.get("kind") == "serving":
+            per_key[r.get("key")] = r
+    if not per_key:
+        return None
+    out = {"runtimes": len(per_key)}
+    progs = {}
+    for k, r in per_key.items():
+        outcomes = r.get("outcomes") or {}
+        entry = {"requests": r.get("requests", 0),
+                 "completed": outcomes.get("completed", 0)}
+        # the silent-loss detector: a request the runtime admitted but
+        # had not resolved when this record was emitted.  Nonzero in a
+        # CLOSE-time or post-mortem record means a request was lost —
+        # mid-flight records (a watchdog stall dump) legitimately show
+        # the wedged batch here
+        if r.get("pending"):
+            entry["UNRESOLVED"] = r["pending"]
+        events = {
+            "shed": outcomes.get("shed", 0),
+            "expired": outcomes.get("expired", 0),
+            "rejected": outcomes.get("rejected", 0),
+            "failed": outcomes.get("failed", 0),
+            "stalled": outcomes.get("stalled", 0),
+            "watchdog_stalls": r.get("watchdog_stalls", 0),
+            "degraded_batches": r.get("degraded_batches", 0),
+            "dispatch_retries": r.get("dispatch_retries", 0),
+        }
+        entry["events"] = {k2: v for k2, v in events.items() if v}
+        lat = r.get("latency")
+        if lat:
+            entry["latency_ms"] = {
+                q: lat[q] for q in ("p50_ms", "p99_ms", "mean_ms",
+                                    "max_ms") if q in lat}
+        br = r.get("breaker") or {}
+        if br.get("transitions") or br.get("state") not in (None,
+                                                            "closed"):
+            entry["breaker"] = {
+                "state": br.get("state"),
+                "transitions": [f"{t['from']}->{t['to']}"
+                                for t in br.get("transitions", [])]}
+        if r.get("buckets"):
+            entry["buckets"] = r["buckets"]
+        progs[k] = entry
+    out["by_runtime"] = progs
     return out
 
 
