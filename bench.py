@@ -1601,6 +1601,238 @@ def main_program_lint_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_graph_opt_sweep(on_tpu, peak):
+    """Graph-optimizer sweep row (ISSUE 9): two acceptance pillars.
+
+    (a) Bucketed dp gradient sync on a 2-device mesh: train the same
+    mlp program unbucketed (FLAGS_dp_bucket_bytes=0 — one psum per
+    gradient), with one big bucket, and with tiny buckets; assert the
+    collective count drops from N grads to exactly
+    ceil(total_grad_bytes / bucket_bytes) dtype-segregated buckets and
+    that the trained params are BITWISE-identical across all three
+    (psum is elementwise — bucketing must not change a single bit).
+
+    (b) Pass-pipeline op reduction: every static-zoo model's inference
+    clone runs the full pipeline (with real startup-initialized
+    parameter values, so conv+BN folding is live) plus one
+    isolated-pass run per pass; assert >= 10% op-count reduction on at
+    least 3 models, allclose outputs vs the unoptimized program on ALL
+    of them, optimized programs lint clean, and the pipeline is
+    idempotent.  Host-dispatch µs and step time are measured
+    unoptimized vs optimized on the biggest-reduction model so the
+    sweep carries a wall-clock delta, not just op counts."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import analysis, passes
+    from paddle_tpu.framework.executor import Scope
+    from paddle_tpu.models import static_zoo
+    from paddle_tpu.transpiler import collective
+
+    checks = {}
+    import jax
+    import jax.numpy as jnp
+
+    ndev = min(2, len(jax.devices()))
+
+    # ---- (a) bucketed dp gradient sync --------------------------------
+    from paddle_tpu import flags as _flags
+
+    bucket_flag_entry = _flags.flag("dp_bucket_bytes")
+
+    def _dp_train(bucket_bytes, steps=5):
+        fluid.set_flags({"FLAGS_dp_bucket_bytes": bucket_bytes})
+        try:
+            with fluid.unique_name.guard():
+                m = static_zoo.build("mlp")
+            exe = fluid.Executor()
+            scope = Scope()
+            exe.run(m.startup, scope=scope)
+            prog = fluid.CompiledProgram(m.main).with_data_parallel(
+                loss_name=m.loss_name, places=ndev)
+            rng = np.random.default_rng(7)
+            for _ in range(steps):
+                feed = {"x": rng.standard_normal((8, 13)).astype(
+                            np.float32),
+                        "y": rng.standard_normal((8, 1)).astype(
+                            np.float32)}
+                exe.run(prog, feed=feed, fetch_list=[m.loss_name],
+                        scope=scope)
+            params = {n: np.asarray(v) for n, v in scope.vars.items()}
+            return params, collective.last_sync_stats()
+        finally:
+            fluid.set_flags({"FLAGS_dp_bucket_bytes": bucket_flag_entry})
+
+    tiny_bucket = 256
+    p_per_grad, s_per_grad = _dp_train(0)
+    p_one, s_one = _dp_train(4 << 20)
+    p_tiny, s_tiny = _dp_train(tiny_bucket)
+    total_bytes = s_per_grad["total_bytes"]
+    bound = -(-total_bytes // tiny_bucket)        # ceil
+    checks["unbucketed_one_psum_per_grad"] = (
+        s_per_grad["psums"] == s_per_grad["grads"])
+    checks["one_bucket_coalesces_all"] = s_one["psums"] == 1
+    checks["tiny_buckets_at_ceil_bound"] = (
+        0 < s_tiny["psums"] <= bound)
+    checks["bucketed_params_bitwise"] = (
+        set(p_per_grad) == set(p_one) == set(p_tiny)
+        and all(np.array_equal(p_per_grad[n], p_one[n])
+                and np.array_equal(p_per_grad[n], p_tiny[n])
+                for n in p_per_grad))
+    checks["no_bucket_fallbacks"] = (s_one["fallbacks"] == 0
+                                     and s_tiny["fallbacks"] == 0)
+    bucketing = {
+        "grads": s_per_grad["grads"],
+        "grad_bytes": total_bytes,
+        "psums_per_grad": s_per_grad["psums"],
+        "psums_one_bucket": s_one["psums"],
+        "psums_tiny_bucket": s_tiny["psums"],
+        "tiny_bucket_bytes": tiny_bucket,
+        "ceil_bound": bound,
+    }
+
+    # ---- (b) pass pipeline over the zoo -------------------------------
+    models = {}
+    reduced_10pct = 0
+    all_allclose = True
+    lint_clean = True
+    for name in sorted(static_zoo.BUILDERS):
+        with fluid.unique_name.guard():
+            m = static_zoo.build(name)
+        exe = fluid.Executor()
+        scope = Scope()
+        exe.run(m.startup, scope=scope)
+        test = m.main.clone(for_test=True)
+        fetches = [m.loss_name]
+        params = {n: np.asarray(v) for n, v in scope.vars.items()
+                  if v is not None}
+        opt, opt_params, rep = passes.fold_inference(
+            test, params, fetch_names=fetches,
+            program_key=f"graph_opt_sweep/{name}", record=False)
+        feed = m.smoke_feed(batch=8)
+        ref = exe.run(test, feed=feed, fetch_list=fetches, scope=scope)
+        opt_scope = Scope()
+        for n, v in opt_params.items():
+            opt_scope.set_var(n, jnp.asarray(v))
+        out = exe.run(opt, feed=feed, fetch_list=fetches,
+                      scope=opt_scope)
+        close = all(np.allclose(a, b, rtol=1e-4, atol=1e-5)
+                    for a, b in zip(ref, out))
+        all_allclose = all_allclose and close
+        lint = analysis.check_program(opt, fetch_names=fetches)
+        lint_clean = lint_clean and not lint.errors
+        before, after = rep["before_ops"], rep["after_ops"]
+        pct = 100.0 * (before - after) / before if before else 0.0
+        if pct >= 10.0:
+            reduced_10pct += 1
+        per_pass = {}
+        for pname in passes.DEFAULT_PIPELINE:
+            _, solo = passes.optimize_program(
+                test, fetch_names=fetches,
+                params={n: np.asarray(v) for n, v in params.items()},
+                passes=[pname], record=False)
+            per_pass[pname] = solo["ops_removed"]
+        models[name] = {
+            "before_ops": before, "after_ops": after,
+            "reduction_pct": round(pct, 1), "allclose": close,
+            "lint_errors": len(lint.errors),
+            "per_pass_removed": per_pass,
+            "pipeline_wall_ms": rep["total_wall_ms"],
+        }
+    checks["opcount_10pct_on_3_models"] = reduced_10pct >= 3
+    checks["all_models_allclose"] = all_allclose
+    checks["optimized_lint_clean"] = lint_clean
+
+    # idempotence on the biggest-reduction model
+    best = max(models, key=lambda n: models[n]["reduction_pct"])
+    with fluid.unique_name.guard():
+        m = static_zoo.build(best)
+    opt1, _ = passes.optimize_program(m.main.clone(for_test=True),
+                                      fetch_names=[m.loss_name],
+                                      record=False)
+    _, rep2 = passes.optimize_program(opt1, fetch_names=[m.loss_name],
+                                      record=False)
+    checks["pipeline_idempotent"] = rep2["ops_removed"] == 0
+
+    # wall-clock delta: unoptimized vs optimized inference step on the
+    # biggest-reduction model (host dispatch µs + steady step time)
+    def _time_steps(program, scope, feed, fetches, steps=20):
+        exe = fluid.Executor()
+        exe.run(program, feed=feed, fetch_list=fetches, scope=scope)
+        t0 = time.perf_counter()
+        host_us = []
+        for _ in range(steps):
+            h0 = time.perf_counter()
+            out = exe.run(program, feed=feed, fetch_list=fetches,
+                          scope=scope, return_numpy=False)
+            host_us.append((time.perf_counter() - h0) * 1e6)
+            _ = [np.asarray(o) for o in out]
+        wall = (time.perf_counter() - t0) / steps
+        host_us.sort()
+        return round(host_us[len(host_us) // 2], 1), round(wall * 1e6, 1)
+
+    with fluid.unique_name.guard():
+        m = static_zoo.build(best)
+    exe = fluid.Executor()
+    scope = Scope()
+    exe.run(m.startup, scope=scope)
+    test = m.main.clone(for_test=True)
+    params = {n: np.asarray(v) for n, v in scope.vars.items()
+              if v is not None}
+    opt, opt_params, _rep = passes.fold_inference(
+        test, params, fetch_names=[m.loss_name], record=False)
+    opt_scope = Scope()
+    for n, v in opt_params.items():
+        opt_scope.set_var(n, jnp.asarray(v))
+    feed = m.smoke_feed(batch=8)
+    base_us, base_step = _time_steps(test, scope, feed, [m.loss_name])
+    opt_us, opt_step = _time_steps(opt, opt_scope, feed, [m.loss_name])
+    timing = {"model": best,
+              "base_host_dispatch_us": base_us,
+              "opt_host_dispatch_us": opt_us,
+              "base_step_us": base_step, "opt_step_us": opt_step}
+
+    row = {"metric": "graph_opt_sweep",
+           "value": int(all(checks.values())), "unit": "ok",
+           "vs_baseline": None,
+           "bucketing": bucketing,
+           "models": models,
+           "models_reduced_10pct": reduced_10pct,
+           "timing": timing,
+           "checks": checks}
+    if not all(checks.values()):
+        row["error"] = "failed checks: " + ", ".join(
+            k for k, v in checks.items() if not v)
+    return row
+
+
+def main_graph_opt_sweep():
+    """`python bench.py graph_opt_sweep` — CI/tooling entry: the
+    graph-optimizer row standalone on a 2-device virtual CPU mesh,
+    persisted to BENCH_TPU.json under rows["graph_opt_sweep"].  Exit 0
+    only when the bucketed sync is bitwise-identical at the ceil bucket
+    bound AND >= 3 zoo models shed >= 10% of their ops with allclose
+    outputs."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=2")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_graph_opt_sweep(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["graph_opt_sweep"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def bench_fault_tolerance_smoke(on_tpu, peak):
     """Fault-tolerance chaos row (ISSUE 4 CI satellite): a tiny fc
     train loop through the PUBLIC train_from_dataset on the CPU mesh
@@ -2278,6 +2510,7 @@ def main():
         ("serving_smoke", "serving_smoke", bench_serving_smoke),
         ("program_lint_smoke", "program_lint_smoke",
          bench_program_lint_smoke),
+        ("graph_opt_sweep", "graph_opt_sweep", bench_graph_opt_sweep),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
 
     # SIGALRM only interrupts Python bytecode: a compile/RPC wedged
@@ -2354,4 +2587,6 @@ if __name__ == "__main__":
         sys.exit(main_serving_smoke())
     if "program_lint_smoke" in sys.argv[1:]:
         sys.exit(main_program_lint_smoke())
+    if "graph_opt_sweep" in sys.argv[1:]:
+        sys.exit(main_graph_opt_sweep())
     main()

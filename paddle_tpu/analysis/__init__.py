@@ -26,8 +26,10 @@ programs or the bundled static model zoo.
 
 import warnings as _warnings
 
+from . import facts
 from .diagnostics import (CODES, Diagnostic, LintResult,
                           ProgramLintError)
+from .facts import infer_specs, live_op_mask, protected_names
 from .shape_rules import (OPAQUE, ShapeError, VarSpec, has_shape_rule,
                           is_opaque, register_opaque, shape_rule)
 from .verifier import cached_check, check_program
@@ -38,6 +40,7 @@ __all__ = [
     "ProgramLintWarning",
     "VarSpec", "OPAQUE", "ShapeError", "shape_rule", "register_opaque",
     "has_shape_rule", "is_opaque",
+    "facts", "live_op_mask", "infer_specs", "protected_names",
 ]
 
 

@@ -28,33 +28,14 @@ steady-state dispatch fast path pays one dict probe.
 import time
 
 from ..ops.registry import _OPS
+from . import facts
 from . import shape_rules as sr
 from .diagnostics import Diagnostic, LintResult
 
 # op types executed by the interpreter's control-flow table, not the
-# kernel registry.  The executor's _CONTROL_FLOW_OPS dict is the
-# single source of truth; it is resolved lazily (framework.executor
-# imports jax at module load — this module must stay importable
-# without it) with a static fallback for import-less contexts.
-_CONTROL_FLOW_FALLBACK = frozenset((
-    "cond", "switch", "while_loop", "while_block", "static_rnn",
-    "create_array", "array_write", "array_read", "array_length",
-    "lod_tensor_to_array", "array_to_lod_tensor",
-))
-_control_flow_types = None
-
-
-def _control_flow():
-    global _control_flow_types
-    if _control_flow_types is None:
-        try:
-            from ..framework.executor import _CONTROL_FLOW_OPS
-
-            _control_flow_types = (frozenset(_CONTROL_FLOW_OPS)
-                                   | _CONTROL_FLOW_FALLBACK)
-        except Exception:
-            _control_flow_types = _CONTROL_FLOW_FALLBACK
-    return _control_flow_types
+# kernel registry — single-sourced in analysis/facts.py (shared with
+# the graph optimizer's passes).
+_control_flow = facts.control_flow_types
 
 _COLLECTIVE_TYPES = frozenset((
     "allreduce", "broadcast", "c_allgather", "c_allreduce_max",
@@ -62,21 +43,19 @@ _COLLECTIVE_TYPES = frozenset((
     "c_broadcast", "c_reducescatter",
 ))
 
-_SIDE_EFFECT_TYPES = frozenset(("print",))
+_SIDE_EFFECT_TYPES = facts.SIDE_EFFECT_TYPES
 
 # how many analyses actually ran (cache misses) — pinned by the
 # caching tests; monotone over the process lifetime
 analysis_runs = 0
 
 
-def _grad_name(name):
-    return name + "@GRAD"
-
-
-def _var_spec(var):
-    if var is None:
-        return sr.OPAQUE
-    return sr.VarSpec(var.shape, var.dtype)
+# shared analysis facts (facts.py is the single source: grad naming,
+# spec construction, output binding — lint and optimizer legality must
+# apply identical rules)
+_grad_name = facts.grad_name
+_var_spec = facts.var_spec
+_bind_outputs = facts.bind_outputs
 
 
 def _diag(diags, code, message, op=None, op_index=None, var=None):
@@ -209,19 +188,14 @@ def check_program(program, fetch_names=None, feed_names=(),
                                 if f in declared else ""), var=f)
 
         # dead ops: backward sweep from fetches + loss/grads +
-        # persistable updates + side effects (mirrors _live_ops, but as
-        # a LINT: train programs run unpruned, dead work still burns
-        # device time)
-        needed = set(fetch_names) | section_grads
-        needed.update(bs.loss_name for bs in sections)
-        keep = [False] * len(ops)
-        for i in range(len(ops) - 1, -1, -1):
-            outs = set(ops[i].output_names())
-            if (outs & needed or outs & persist
-                    or ops[i].type in _SIDE_EFFECT_TYPES
-                    or ops[i].type in control_flow):
-                keep[i] = True
-                needed |= set(ops[i].input_names())
+        # persistable updates + side effects (the SAME liveness fact
+        # the DCE pass of paddle_tpu.passes consumes — facts.py is the
+        # single definition, so "lint says dead" and "DCE deletes"
+        # can never disagree).  Train programs run unpruned; dead work
+        # still burns device time, hence the lint.
+        keep = facts.live_op_mask(ops, sections, fetch_names, persist,
+                                  control_flow_types=control_flow,
+                                  side_effect_types=_SIDE_EFFECT_TYPES)
         for i, op in enumerate(ops):
             if not keep[i]:
                 _diag(diags, "PT201",
@@ -249,49 +223,31 @@ def check_program(program, fetch_names=None, feed_names=(),
                   f"read, or fetched", var=n)
 
     # ---- pass 3: shape/dtype inference --------------------------------
-    specs = {}
-    for n in persist | data_vars | set(feed_names):
-        specs[n] = _var_spec(declared.get(n))
+    # THE rule walk lives in facts.infer_specs (shared with the graph
+    # optimizer's rewrite-legality checks, so "what the lint infers"
+    # and "what a pass believes" cannot diverge); the verifier layers
+    # its diagnostics on top through the event callback.
     warned_opaque = set()
-    for i, op in enumerate(ops):
-        for bs in section_at.get(i, ()):
-            for p in bs.param_names:
-                specs[_grad_name(p)] = specs.get(p, sr.OPAQUE)
-        if op.type in control_flow or sr.is_opaque(op.type):
-            _bind_outputs(specs, op, None)
-            continue
-        rule = sr.get_rule(op.type)
-        if rule is None:
-            if op.type in _OPS and op.type not in warned_opaque:
+
+    def _spec_event(kind, op, i, err):
+        if kind == "no_rule":
+            if op.type not in warned_opaque:
                 warned_opaque.add(op.type)
                 _diag(diags, "PT204",
                       f"no shape-inference rule for op type "
                       f"'{op.type}'; its outputs are treated as "
                       f"opaque", op=op, op_index=i)
-            _bind_outputs(specs, op, None)
-            continue
-        ins = {}
-        for slot, names in op.inputs.items():
-            ins[slot] = [specs.get(n) or _var_spec(declared.get(n))
-                         for n in names]
-        try:
-            outs = rule(op, ins, op.attrs)
-        except sr.ShapeError as e:
-            code = "PT102" if e.kind == "dtype" else "PT101"
-            _diag(diags, code, str(e), op=op, op_index=i)
-            outs = None
-        except Exception as e:   # degrade, never false-error
+        elif kind == "shape_error":
+            code = "PT102" if err.kind == "dtype" else "PT101"
+            _diag(diags, code, str(err), op=op, op_index=i)
+        else:            # rule_crash: degrade, never false-error
             _diag(diags, "PT209",
                   f"shape rule for '{op.type}' crashed "
-                  f"({type(e).__name__}: {e}); outputs treated as "
+                  f"({type(err).__name__}: {err}); outputs treated as "
                   f"opaque", op=op, op_index=i)
-            outs = None
-        _bind_outputs(specs, op, outs)
-    # trailing sections (pos == len(ops))
-    for bs in sections:
-        if bs.pos >= len(ops):
-            for p in bs.param_names:
-                specs[_grad_name(p)] = specs.get(p, sr.OPAQUE)
+
+    specs = facts.infer_specs(program, feed_names=feed_names,
+                              on_event=_spec_event)
 
     # ---- pass 3b: shape/dtype inside sub-blocks (control-flow bodies)
     # REDUCED pass: rule-based inference only.  Def-use/liveness/WAW
@@ -408,27 +364,6 @@ def check_program(program, fetch_names=None, feed_names=(),
                               d.code))
     return LintResult(diags, program_key=program_key,
                       wall_ms=(time.perf_counter() - t0) * 1e3)
-
-
-def _bind_outputs(specs, op, outs):
-    """Bind a rule's output specs (or OPAQUE when outs is None) to the
-    op's output variable names."""
-    for slot, names in op.outputs.items():
-        if not names:
-            continue
-        vals = None if outs is None else outs.get(slot)
-        if vals is None:
-            for n in names:
-                specs[n] = sr.OPAQUE
-        elif isinstance(vals, (list, tuple)):
-            for n, v in zip(names, vals):
-                specs[n] = v
-            for n in names[len(vals):]:
-                specs[n] = sr.OPAQUE
-        else:
-            specs[names[0]] = vals
-            for n in names[1:]:
-                specs[n] = sr.OPAQUE
 
 
 # ---------------------------------------------------------------------------

@@ -163,6 +163,36 @@ declare_flag("serving_watchdog_stall_s", 30.0,
              "this triggers a flight-recorder dump and escalates per "
              "watchdog_policy.")
 
+# Program-level graph optimizer (paddle_tpu.passes, ISSUE 9): the
+# framework/ir pass-pipeline analogue.  "on" substitutes an optimized
+# program (CSE / const fold / identity+scale collapse / DCE) before
+# tracing, cached per (program version, fetch set, pass config) so the
+# steady-state dispatch path pays one flag read + one dict probe.
+declare_flag("graph_opt", "off",
+             "Run the graph-optimizer pass pipeline before tracing: "
+             "off | on.")
+declare_flag("graph_opt_disable", "",
+             "Comma-separated pass names to skip when FLAGS_graph_opt "
+             "is on (e.g. 'cse,dce'); see passes.DEFAULT_PIPELINE.")
+
+# Bucketed data-parallel gradient synchronization (transpiler.
+# collective.sync_gradients): flatten gradients per dtype and psum
+# fixed-capacity buckets instead of one collective per gradient — the
+# fuse_all_reduce_op_pass / PyTorch-DDP gradient-bucketing design.
+# Bitwise-identical to the per-gradient sync (psum is elementwise);
+# 0 disables bucketing and emits one psum per gradient.
+declare_flag("dp_bucket_bytes", 4 << 20,
+             "Capacity in bytes of one flattened dp gradient-sync "
+             "bucket (0 = one psum per gradient).")
+
+# Inference-mode folding (passes.fold_inference): Predictor folds
+# test-mode batch_norms into conv/fc weights and collapses
+# scale/identity chains at load time.  Outputs are allclose — not
+# bitwise — to the unfolded program (documented in README).
+declare_flag("inference_fold", True,
+             "Fold conv/fc+batch_norm and scale chains when loading "
+             "inference models (Predictor/serving).")
+
 declare_flag("maxpool_mask_bwd", False,
              "Give max-pool a recompute-mask custom VJP (window passes "
              "+ shifted compares, all XLA-fusable) instead of the "

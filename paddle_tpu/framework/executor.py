@@ -483,7 +483,11 @@ def _run_while_block(op, env, rng_box, const_env=None):
         env[n] = v
 
 
-_SIDE_EFFECT_OPS = {"print"}
+# the single definition shared with the PT201 lint and the DCE pass
+# (analysis/facts.py): an op type added there must survive _live_ops
+# pruning too, or its side effect is silently dropped on fetch-pruned
+# runs while the lint still calls it live
+from ..analysis.facts import SIDE_EFFECT_TYPES as _SIDE_EFFECT_OPS
 
 _CONTROL_FLOW_OPS = {
     "cond": _run_cond,
@@ -664,9 +668,18 @@ def op_scopes(ops, sections):
 def op_scope_names(program, fetch_names=()):
     """Public provenance map for one program: [(scope, op)] in
     execution order, exactly the scopes the compiled step will emit —
-    what monitor.op_profile checks attribution coverage against."""
+    what monitor.op_profile checks attribution coverage against.
+
+    With FLAGS_graph_opt=on the executor traces the OPTIMIZED
+    substitute, so the map resolves through it: fused/folded ops appear
+    under their own (emitted) scopes and carry ``op.folded_from`` — the
+    source ops' scope names — so attribution tools can map device time
+    on a rewritten op back to what the user built instead of landing it
+    in ``(unattributed)``."""
     if hasattr(program, "_get_executable_program"):
         program = program._get_executable_program()
+    if flags.flag("graph_opt") == "on":
+        program = Executor._resolve_optimized(program, list(fetch_names))
     ops = Executor._live_ops(program, list(fetch_names))
     sections = [] if program._is_test else list(program.backward_sections)
     return list(zip(op_scopes(ops, sections), ops))
@@ -818,6 +831,45 @@ class Executor:
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
 
+        # Graph-optimizer substitution (FLAGS_graph_opt=on): trace the
+        # OPTIMIZED twin of the program — CSE/const-fold/identity/DCE
+        # applied by paddle_tpu.passes — cached per (version, fetches,
+        # pass config) on the program, so a flag flip or a pass-config
+        # change re-optimizes while the steady state pays one flag
+        # read + one dict probe.  The substitute is a different object
+        # with its own _version, so the run-plan and compiled-step
+        # caches key on the pass config for free.
+        if flags.flag("graph_opt") == "on":
+            program = self._resolve_optimized(program, fetch_names)
+
+        # Optimize-time-folded constants become initialized
+        # persistables; their values live on the program — seed them
+        # into the scope so both the compiled and eager paths resolve
+        # them like any other persistable state.  Stamped per
+        # (program, version): a re-optimized program OVERWRITES its
+        # stale constants instead of first-write-wins serving them,
+        # and the steady state pays one getattr compare.
+        fc = getattr(program, "_folded_constants", None)
+        if fc:
+            # per-(program, version) seed memo on the scope, so an
+            # alternating train/eval pair doesn't re-device-put its
+            # constants every step.  Entries hold the PROGRAM, not its
+            # id(): a recycled address after GC must not make a new
+            # program's constants look already-seeded (same defense as
+            # the compiled-step cache storing the program in its
+            # value).
+            stamps = getattr(scope, "_folded_seed_stamps", None)
+            if stamps is None:
+                stamps = scope._folded_seed_stamps = {}
+            ent = stamps.get(id(program))
+            if ent is None or ent[0] is not program \
+                    or ent[1] != program._version:
+                for n, v in fc.items():
+                    scope.set_var(n, jnp.asarray(v))
+                if len(stamps) >= 8:
+                    stamps.clear()
+                stamps[id(program)] = (program, program._version)
+
         # Static program verification (FLAGS_static_check=off|warn|error):
         # the pre-trace InferShape/def-use/donation/dp lint pass of
         # paddle_tpu.analysis.  Results are cached per (program,
@@ -948,7 +1000,13 @@ class Executor:
             key = (id(program), plan.version, feed_sig, tuple(fetch_names),
                    state_names,
                    None if dp_mesh is None else dp_mesh.shape_tuple,
-                   precision, guard_on)
+                   precision, guard_on,
+                   # the grad-sync bucket capacity is read at TRACE
+                   # time (transpiler.collective.sync_gradients), so a
+                   # flag change must retrace dp steps — key on it for
+                   # dp programs only (non-dp traces never read it)
+                   None if dp_mesh is None
+                   else int(flags.flag("dp_bucket_bytes")))
             # cache value holds the program so id() can't be recycled by a
             # new Program allocated at the same address after GC
             entry = self._cache.get(key) if use_program_cache else None
@@ -1078,6 +1136,42 @@ class Executor:
         # steady state.
         return [jnp.copy(f) if n in new_state else f
                 for n, f in zip(fetch_names, fetches)]
+
+    @staticmethod
+    def _resolve_optimized(program, fetch_names):
+        """The optimized substitute for `program` under the current
+        pass config — built once per (program version, fetch set, pass
+        config) and cached on the program (``_opt_cache``; ``_bump()``
+        clears it, so a mutation can never serve a stale substitute).
+        Value-based folds are NOT applied here: executor-run programs
+        own mutable parameters, so only the structural passes are
+        legal."""
+        from .. import passes as _passes
+
+        try:
+            names = _passes.enabled_passes()
+        except KeyError as e:
+            raise ValueError(
+                f"FLAGS_graph_opt_disable names an unknown pass: {e}"
+            ) from e
+        key = (program._version, tuple(fetch_names), names)
+        cache = getattr(program, "_opt_cache", None)
+        if cache:
+            hit = cache.get(key)
+            if hit is not None:
+                return hit
+        label = getattr(program, "_telemetry_label", None)
+        opt, _report = _passes.optimize_program(
+            program, fetch_names=fetch_names, passes=names,
+            program_key=label or "prog%x:v%d" % (id(program),
+                                                 program._version))
+        opt._telemetry_label = label
+        if cache is None:
+            cache = program._opt_cache = {}
+        elif len(cache) >= 4:
+            cache.clear()
+        cache[key] = opt
+        return opt
 
     @staticmethod
     def _static_check(program, fetch_names, feed, dp_mesh, mode,
@@ -1697,7 +1791,8 @@ class Executor:
             return self._make_step_fn(ops, sections, fetch_names,
                                       persist_names, dp,
                                       feed_casts=feed_casts,
-                                      guard_on=guard_on)
+                                      guard_on=guard_on,
+                                      telemetry_key=telemetry_key)
         step = make_step(dp)
 
         if not dp:
@@ -1767,7 +1862,8 @@ class Executor:
         return compiled
 
     def _make_step_fn(self, ops, sections, fetch_names, persist_names, dp,
-                      feed_casts=None, guard_on=False):
+                      feed_casts=None, guard_on=False,
+                      telemetry_key=None):
         # optimizer-updated params: identical across dp replicas by
         # construction, so exempt from the SyncBN-style stats averaging
         param_names = set()
@@ -1841,15 +1937,28 @@ class Executor:
                         & _all_finite_tree(grads)
                 # DP gradient sync — the one collective the reference
                 # inserts as allreduce op-handles
-                # (multi_devices_graph_pass.cc:446).  Framework-inserted
-                # (no ProgramDesc op to blame), so it gets its OWN
+                # (multi_devices_graph_pass.cc:446), coalesced here by
+                # transpiler.collective.sync_gradients into flattened
+                # fixed-capacity buckets (FLAGS_dp_bucket_bytes; the
+                # fuse_all_reduce_op_pass analogue — bitwise-identical
+                # to per-gradient psums).  Framework-inserted (no
+                # ProgramDesc op to blame), so it keeps its OWN
                 # attribution scope: on a dp mesh the allreduce is real
                 # device time and must not land in the unattributed
                 # residual.
                 with jax.named_scope(f"fwd{sec_i}/dp_grad_sync_{sec_i}"):
-                    for n, g in grads.items():
-                        env[n + "@GRAD"] = jax.lax.pmean(g, "dp") \
-                            if dp else g
+                    if dp:
+                        from ..transpiler import collective as _coll
+
+                        # keyed per program so the pass ledger keeps
+                        # one bucketing record PER dp program instead
+                        # of newest-wins under one shared key
+                        synced = _coll.sync_gradients(
+                            grads, "dp", key=telemetry_key)
+                    else:
+                        synced = grads
+                    for n, g in synced.items():
+                        env[n + "@GRAD"] = g
                 pos = bs.pos
             interpret(ops[pos:], env, rng_box, const_env, scopes,
                       allow_sampling=False)

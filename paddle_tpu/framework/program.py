@@ -180,6 +180,11 @@ class Operator:
         # creation site for error decoration (op_call_stack.cc parity):
         # first caller frame outside paddle_tpu
         self.callsite = _user_callsite()
+        # provenance for graph-optimizer rewrites: scope names of the
+        # source ops this op absorbed (paddle_tpu.passes sets it), so
+        # per-op attribution of an optimized program maps fused/folded
+        # ops back to what the user built
+        self.folded_from = ()
 
     def input_names(self):
         return [n for vs in self.inputs.values() for n in vs]
@@ -350,6 +355,12 @@ class Program:
         # the Program — not in an id()-keyed executor dict — so a
         # recycled address after GC can never serve a stale plan.
         self._run_plan_cache = None
+        # graph-optimizer state: optimize-time-evaluated constants
+        # ({name: ndarray} — the executor seeds scopes from it) and the
+        # cache of optimized substitute programs keyed by
+        # (version, fetches, pass config)
+        self._folded_constants = None
+        self._opt_cache = None
 
     # -- structure ----------------------------------------------------------
 
@@ -370,9 +381,17 @@ class Program:
         self.current_block_idx = self.blocks[self.current_block_idx].parent_idx
 
     def _bump(self):
-        # every graph mutation lands here; the version compare is what
-        # invalidates the executor's run-plan + compiled-step caches
+        # every graph mutation lands here.  The version bump re-keys
+        # the compiled-step cache; the derived caches living ON the
+        # program (run-plan, lint results, optimized substitutes) are
+        # dropped in the same call so no consumer can observe a window
+        # where the version moved but a stale artifact still answers.
         self._version += 1
+        self._run_plan_cache = None
+        cache = getattr(self, "_lint_cache", None)
+        if cache:
+            cache.clear()
+        self._opt_cache = None
 
     def all_parameters(self):
         return [p for b in self.blocks for p in b.all_parameters()]
@@ -405,6 +424,7 @@ class Program:
                 no.inputs = {k: list(v) for k, v in op.inputs.items()}
                 no.outputs = {k: list(v) for k, v in op.outputs.items()}
                 no.attrs = dict(op.attrs)
+                no.folded_from = getattr(op, "folded_from", ())
                 if for_test and "is_test" in _TEST_MODE_OPS.get(op.type, ()):
                     no.attrs["is_test"] = True
                 nb.ops.append(no)
@@ -413,6 +433,8 @@ class Program:
         p.random_seed = self.random_seed
         p._is_test = for_test
         p.amp_enabled = self.amp_enabled
+        if self._folded_constants:
+            p._folded_constants = dict(self._folded_constants)
         if for_test:
             # prune backward + optimize ops (parity: Program.clone's test
             # mode, framework.py:3806 — everything appended after the first
@@ -443,7 +465,7 @@ class Program:
     # -- serialization ------------------------------------------------------
 
     def to_json(self):
-        return json.dumps({
+        doc = {
             "version": 1,
             "blocks": [b.to_dict() for b in self.blocks],
             "backward_sections": [
@@ -452,7 +474,14 @@ class Program:
                 for s in self.backward_sections
             ],
             "is_test": self._is_test,
-        })
+        }
+        if self._folded_constants:
+            doc["folded_constants"] = {
+                n: {"__ndarray__": np.asarray(v).tolist(),
+                    "dtype": str(np.asarray(v).dtype)}
+                for n, v in self._folded_constants.items()
+            }
+        return json.dumps(doc)
 
     @staticmethod
     def from_json(text):
@@ -484,6 +513,12 @@ class Program:
                 BackwardSection(sd["pos"], sd["loss"], sd["params"],
                                 checkpoint_names=sd.get("checkpoints")))
         p._is_test = data.get("is_test", False)
+        fc = data.get("folded_constants")
+        if fc:
+            p._folded_constants = {
+                n: np.array(v["__ndarray__"], dtype=v["dtype"])
+                for n, v in fc.items()
+            }
         return p
 
     def to_string(self, throw_on_error=False):

@@ -29,6 +29,7 @@ try:
 except ImportError:  # pragma: no cover
     _jax_export = None
 
+from . import flags
 from .core.dtype import to_jax_dtype
 from .framework.executor import _RngBox, interpret
 from .framework.program import Program
@@ -72,6 +73,24 @@ class Predictor:
                    if v.persistable}
         self._params = {n: jnp.asarray(data[n]) for n in data.files
                         if n in persist}
+        # Graph-optimizer folding path (FLAGS_inference_fold): fold
+        # test-mode batch_norms into conv/fc weights, collapse
+        # scale/identity chains, and DCE from the fetch set — the
+        # reference's inference analysis passes, applied once at load
+        # time so BOTH the compiled and the degraded (run_eager) paths
+        # serve the same folded program.  Outputs are allclose, not
+        # bitwise, vs the unfolded program.
+        self._fold_report = None
+        if flags.flag("inference_fold"):
+            from . import passes as _passes
+
+            self._program, params, self._fold_report = \
+                _passes.fold_inference(
+                    self._program, self._params,
+                    fetch_names=self._fetch_names,
+                    program_key="predictor:%s" % os.path.basename(
+                        os.path.abspath(dirname)))
+            self._params = {n: jnp.asarray(v) for n, v in params.items()}
         # the un-jitted pure fn is kept addressable: the serving
         # runtime's degraded mode (run_eager) interprets through it
         # when the compiled path is circuit-broken

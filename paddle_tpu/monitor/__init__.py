@@ -42,6 +42,7 @@ __all__ = [
     "enable", "disable", "is_enabled", "snapshot", "reset",
     "counter", "gauge", "record_step", "observe_steps", "record_compile",
     "record_lint", "lint_records",
+    "record_pass_pipeline", "pass_pipeline_records",
     "aot_compile", "instrument_jit", "mfu", "step_records",
     "compile_events", "jsonl_path", "merged_trace_events",
     "op_table", "op_profile_split", "op_profile", "flight_recorder",
@@ -67,6 +68,9 @@ _enabled = False
 _lint_records = []
 # kind="serving" records from the serving runtime (ISSUE 8), same idea
 _serving_records = []
+# kind="pass_pipeline" records from the graph optimizer (ISSUE 9):
+# per-pass op counts + wall time, and the trace-time dp grad-bucketing
+_pass_records = []
 
 
 def enable(jsonl_path=None):
@@ -104,6 +108,7 @@ def reset():
     op_profile.clear_samples()
     del _lint_records[:]
     del _serving_records[:]
+    del _pass_records[:]
 
 
 # -- recording entry points (no-ops while disabled) ---------------------
@@ -164,6 +169,32 @@ def serving_records():
     """kind="serving" records seen since enable()/reset(), newest
     last."""
     return list(_serving_records)
+
+
+def record_pass_pipeline(record):
+    """Write one kind="pass_pipeline" record (a pass-pipeline report
+    from paddle_tpu.passes, or the trace-time dp grad-bucketing note
+    from transpiler.collective) onto the telemetry JSONL stream and
+    keep it addressable in-process (pass_pipeline_records()).  Like
+    lint/op_profile records, it rides the stream without touching step
+    numbering."""
+    if not _enabled or not record:
+        return None
+    record = dict(record)
+    record.setdefault("kind", "pass_pipeline")
+    import time as _time
+
+    record.setdefault("ts_us", _time.perf_counter_ns() / 1000.0)
+    record.setdefault("wall_time", _time.time())
+    _pass_records.append(record)
+    _session.emit_record(record)
+    return record
+
+
+def pass_pipeline_records():
+    """kind="pass_pipeline" records seen since enable()/reset(),
+    newest last."""
+    return list(_pass_records)
 
 
 def serving_table():

@@ -1,0 +1,143 @@
+"""paddle_tpu.passes — the program-level graph optimizer (ISSUE 9).
+
+The reference ran every ProgramDesc through ``framework/ir`` rewrite
+passes before execution (constant folding, fuse passes,
+``fuse_all_reduce_op_pass``); this package is the TPU-native analogue:
+an ordered pipeline that REWRITES a recorded Program — producing a new
+``_version`` so every executor cache re-keys — with per-pass op-count
+and wall-time recorded to the telemetry stream as
+``kind="pass_pipeline"`` records.
+
+Passes (each individually disableable via ``FLAGS_graph_opt_disable``):
+
+- ``const_fold``     — optimize-time evaluation of constant subgraphs;
+                       folded results become initialized persistables
+                       (``program._folded_constants`` seeds scopes).
+- ``cse``            — common-subexpression elimination within each
+                       backward segment.
+- ``identity_elim``  — no-op reshapes/transposes/casts, scale(1,+0),
+                       test-mode upscale dropout, zero pads, assigns.
+- ``fold_scale_chain`` — scale(scale(x)) chain collapse.
+- ``fold_batch_norm``  — conv/fc + test-mode batch_norm fold (needs
+                       parameter values; Predictor / bench supply them).
+- ``dce``            — dead-op/dead-var elimination seeded from the
+                       fetch set, sharing the PT201/PT202 liveness fact
+                       with the static verifier.
+
+Entry points::
+
+    opt, report = passes.optimize_program(main, fetch_names=[loss.name])
+    opt, params, report = passes.fold_inference(program, params, fetches)
+
+Executor integration: ``FLAGS_graph_opt=on`` substitutes the optimized
+program pre-trace (cached per (version, fetches, pass config));
+``Predictor`` applies the inference folds at load time
+(``FLAGS_inference_fold``).  The bucketed dp gradient sync rides the
+same ledger but lives in ``transpiler.collective`` — it rewrites the
+COLLECTIVE emission at trace time, not the op list.
+"""
+
+import time
+
+from .. import flags
+from .common import const_fold, cse, dce, identity_elim
+from .fold import fold_batch_norm, fold_scale_chain
+from .rewriter import ProgramRewriter
+
+__all__ = ["PASSES", "DEFAULT_PIPELINE", "optimize_program",
+           "fold_inference", "enabled_passes", "ProgramRewriter"]
+
+PASSES = {
+    "const_fold": const_fold,
+    "cse": cse,
+    "identity_elim": identity_elim,
+    "fold_scale_chain": fold_scale_chain,
+    "fold_batch_norm": fold_batch_norm,
+    "dce": dce,
+}
+
+# order matters: folding creates constants/identities the later passes
+# clean up, and dce runs last to sweep every orphaned producer
+DEFAULT_PIPELINE = ("const_fold", "cse", "identity_elim",
+                    "fold_scale_chain", "fold_batch_norm", "dce")
+
+
+def enabled_passes(disable=None):
+    """The default pipeline minus ``disable`` (an iterable of names, or
+    None to read ``FLAGS_graph_opt_disable`` — comma-separated)."""
+    if disable is None:
+        disable = flags.flag("graph_opt_disable")
+    if isinstance(disable, str):
+        disable = [p.strip() for p in disable.split(",") if p.strip()]
+    disable = set(disable)
+    unknown = disable - set(PASSES)
+    if unknown:
+        raise KeyError(
+            f"unknown graph-opt pass(es) {sorted(unknown)}; known: "
+            f"{list(DEFAULT_PIPELINE)}")
+    return tuple(p for p in DEFAULT_PIPELINE if p not in disable)
+
+
+def optimize_program(program, fetch_names=(), feed_names=(),
+                     params=None, passes=None, disable=None,
+                     program_key=None, record=True):
+    """Run the pass pipeline over a CLONE of `program` and return
+    ``(optimized_program, report)``.  The input program is never
+    mutated.
+
+    params: optional {name: ndarray} of concrete parameter values —
+    enables the value-based folds, which update the dict IN PLACE
+    (pass a copy if the originals must survive).
+
+    The report is the ``kind="pass_pipeline"`` record: per-pass
+    before/after op counts and wall time, plus totals; with `record`
+    and telemetry enabled it is also appended to the JSONL stream
+    (monitor.record_pass_pipeline).
+    """
+    names = tuple(passes) if passes is not None \
+        else enabled_passes(disable)
+    unknown = set(names) - set(PASSES)
+    if unknown:
+        raise KeyError(f"unknown graph-opt pass(es) {sorted(unknown)}")
+    t0 = time.perf_counter()
+    # clone() carries _folded_constants; passes may add more
+    opt = program.clone(for_test=program._is_test)
+    rw = ProgramRewriter(opt, fetch_names=fetch_names,
+                         feed_names=feed_names, params=params)
+    before = len(rw.ops)
+    rows = []
+    for name in names:
+        stats = rw.timed(PASSES[name])
+        stats["name"] = name
+        rows.append(stats)
+    report = {
+        "kind": "pass_pipeline",
+        "key": program_key or "prog%x:v%d" % (id(program),
+                                              program._version),
+        "before_ops": before,
+        "after_ops": len(rw.ops),
+        "ops_removed": before - len(rw.ops),
+        "passes": rows,
+        "total_wall_ms": round((time.perf_counter() - t0) * 1e3, 3),
+    }
+    if record:
+        from .. import monitor
+
+        monitor.record_pass_pipeline(report)
+    return opt, report
+
+
+def fold_inference(program, params, fetch_names=(), program_key=None,
+                   record=True, disable=None):
+    """The Predictor's load-time path: full pipeline including the
+    value-based folds over an inference program + its loaded parameter
+    values.  Returns ``(program, params, report)`` — `params` is a new
+    dict with folded weight values (originals untouched)."""
+    params = dict(params)
+    opt, report = optimize_program(
+        program, fetch_names=fetch_names, params=params,
+        program_key=program_key, record=record, disable=disable)
+    # folded constants double as parameters on the interpret path
+    for n, v in (getattr(opt, "_folded_constants", None) or {}).items():
+        params.setdefault(n, v)
+    return opt, params, report

@@ -226,6 +226,13 @@ class ServingRuntime:
         # pending past shutdown even with the batcher wedged
         self._live = set()
         self.prewarmed = self.dispatcher.prewarm() if cfg.prewarm else 0
+        # the predictor's load-time graph-optimizer report (conv+BN
+        # folds, identity collapses — FLAGS_inference_fold), surfaced
+        # on the runtime.  NOT re-recorded into the pass ledger: the
+        # Predictor already emitted the kind="pass_pipeline" record at
+        # load time, and a second key would double-count the same fold
+        # work in telemetry_report's Passes section.
+        self.fold_report = getattr(predictor, "_fold_report", None)
         if auto_start:
             self.start()
 

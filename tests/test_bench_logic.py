@@ -263,3 +263,30 @@ def test_serving_smoke_in_suite_and_standalone():
     assert '("serving_smoke", "serving_smoke"' in src
     assert '"serving_smoke" in sys.argv[1:]' in src
     assert "main_serving_smoke" in src
+
+
+# ---------------------------------------------------------------------------
+# graph_opt_sweep row (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_graph_opt_sweep_in_suite_and_standalone():
+    """The graph-optimizer row is wired into the suite AND the
+    standalone argv entry (the pass/bucketing behaviors themselves are
+    covered end-to-end by tests/test_passes.py; re-running the whole
+    row here would pay its compiles twice per CI run for no new
+    signal)."""
+    src = open(bench.__file__).read()
+    assert '("graph_opt_sweep", "graph_opt_sweep"' in src
+    assert '"graph_opt_sweep" in sys.argv[1:]' in src
+    assert "main_graph_opt_sweep" in src
+
+
+def test_graph_opt_sweep_row_shape():
+    """The sweep row's check list carries both acceptance pillars: the
+    bitwise bucketed sync and the >=10%-on-3-models op reduction."""
+    src = open(bench.__file__).read()
+    for check in ("bucketed_params_bitwise", "tiny_buckets_at_ceil_bound",
+                  "opcount_10pct_on_3_models", "all_models_allclose",
+                  "optimized_lint_clean", "pipeline_idempotent"):
+        assert check in src, check

@@ -1,0 +1,146 @@
+"""Before/after op-diff of the graph-optimizer pass pipeline.
+
+Runs ``paddle_tpu.passes`` over a serialized Program (the JSON written
+by ``Program.to_json`` / ``io.save_inference_model``) or over the
+bundled static model zoo, and prints what each pass did: per-pass op
+counts, wall time, the op-type diff, and any folded constants.
+
+Usage:
+    python tools/program_opt.py <program.json|model_dir> [fetch ...]
+    python tools/program_opt.py --all-models
+    python tools/program_opt.py --all-models --test-mode --json
+    python tools/program_opt.py --disable cse,dce <program.json>
+
+``--test-mode`` optimizes the inference clone (``clone(for_test=True)``)
+— where DCE from the fetch set and the identity/scale collapses do
+most of their work; without values only the structural passes run
+(conv+BN folding needs parameter values — the Predictor path).
+Exit 0 always (a report, not a gate).
+"""
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _op_types(program):
+    return Counter(op.type for op in program.global_block().ops)
+
+
+def _diff(before, after):
+    removed = before - after
+    added = after - before
+    out = {}
+    if removed:
+        out["removed"] = dict(sorted(removed.items()))
+    if added:
+        out["added"] = dict(sorted(added.items()))
+    return out
+
+
+def _optimize_one(name, program, fetches, disable, as_json):
+    from paddle_tpu import passes
+
+    before_types = _op_types(program)
+    opt, report = passes.optimize_program(
+        program, fetch_names=fetches, disable=disable,
+        program_key=name, record=False)
+    after_types = _op_types(opt)
+    row = {
+        "program": name,
+        "fetches": list(fetches),
+        "before_ops": report["before_ops"],
+        "after_ops": report["after_ops"],
+        "ops_removed": report["ops_removed"],
+        "passes": [
+            {"name": p["name"],
+             "removed": p["before_ops"] - p["after_ops"],
+             "wall_ms": p["wall_ms"]}
+            for p in report["passes"]],
+        "op_diff": _diff(before_types, after_types),
+    }
+    fc = getattr(opt, "_folded_constants", None)
+    if fc:
+        row["folded_constants"] = sorted(fc)
+    if as_json:
+        print(json.dumps(row))
+        return
+    pct = (100.0 * row["ops_removed"] / row["before_ops"]
+           if row["before_ops"] else 0.0)
+    print(f"{name}: {row['before_ops']} -> {row['after_ops']} ops "
+          f"(-{row['ops_removed']}, {pct:.1f}%)")
+    for p in row["passes"]:
+        mark = f"-{p['removed']}" if p["removed"] else " 0"
+        print(f"  {p['name']:<18} {mark:>5} ops  {p['wall_ms']:8.2f} ms")
+    if row["op_diff"]:
+        print(f"  op diff: {row['op_diff']}")
+    if fc:
+        print(f"  folded constants: {sorted(fc)}")
+
+
+def _load_program(path):
+    from paddle_tpu.framework.program import Program
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "__model__.json")
+    with open(path) as f:
+        doc = json.load(f)
+    # save_inference_model wraps the program in a model manifest
+    if isinstance(doc, dict) and "program" in doc:
+        prog = Program.from_json(json.dumps(doc["program"]))
+        fetches = list(doc.get("fetch_names", ()))
+    else:
+        prog = Program.from_json(json.dumps(doc))
+        fetches = []
+    return prog, fetches
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="graph-optimizer before/after op-diff")
+    ap.add_argument("target", nargs="?",
+                    help="serialized program JSON or inference-model dir")
+    ap.add_argument("fetches", nargs="*",
+                    help="fetch names seeding DCE (default: the "
+                         "model's own fetch list, if serialized)")
+    ap.add_argument("--all-models", action="store_true",
+                    help="optimize every bundled static-zoo model")
+    ap.add_argument("--test-mode", action="store_true",
+                    help="optimize the clone(for_test=True) inference "
+                         "program instead of the train program")
+    ap.add_argument("--disable", default="",
+                    help="comma-separated pass names to skip")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one JSON row per program instead of text")
+    args = ap.parse_args(argv)
+    disable = [p for p in args.disable.split(",") if p.strip()]
+
+    if args.all_models:
+        from paddle_tpu.models import static_zoo
+
+        for name in sorted(static_zoo.BUILDERS):
+            model = static_zoo.build(name)
+            prog = (model.main.clone(for_test=True) if args.test_mode
+                    else model.main)
+            fetches = ([model.loss_name] if args.test_mode
+                       else list(model.fetches))
+            _optimize_one(name, prog, fetches, disable, args.as_json)
+        return 0
+    if not args.target:
+        ap.error("need a program path or --all-models")
+    prog, saved_fetches = _load_program(args.target)
+    if args.test_mode:
+        prog = prog.clone(for_test=True)
+    fetches = args.fetches or saved_fetches
+    _optimize_one(os.path.basename(args.target.rstrip("/")), prog,
+                  fetches, disable, args.as_json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

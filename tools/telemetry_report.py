@@ -17,7 +17,10 @@ rollbacks, OOM events, checkpoint saves/restores over the run, from
 the sampled counters), and a serving section (from the kind="serving"
 records the serving runtime emits: request outcome ledger with the
 zero-silent-loss invariant, exact latency percentiles, shed/breaker/
-watchdog event counts per runtime label) — without touching the
+watchdog event counts per runtime label), and a graph-optimizer
+section (from the kind="pass_pipeline" records: ops removed and
+per-pass wall time per program key, plus the dp gradient-bucketing
+notes — buckets formed, sparse fallbacks) — without touching the
 process that produced the file.
 
 Usage: python tools/telemetry_report.py <telemetry.jsonl>
@@ -84,6 +87,9 @@ def summarize(records):
     serving = _serving_section(records)
     if serving:
         out["serving"] = serving
+    pass_rows = _passes_section(records)
+    if pass_rows:
+        out["passes"] = pass_rows
     resil = _resilience_section(steps)
     if resil:
         out["resilience"] = resil
@@ -247,6 +253,72 @@ def _serving_section(records):
             entry["buckets"] = r["buckets"]
         progs[k] = entry
     out["by_runtime"] = progs
+    return out
+
+
+def _passes_section(records):
+    """Graph-optimizer summary from the kind="pass_pipeline" records
+    (paddle_tpu.passes reports + the trace-time dp grad-bucketing
+    notes).  Newest record per program key wins; per key: ops removed,
+    per-pass removal/wall-time breakdown, buckets formed / fallbacks
+    for the gradient-sync emissions."""
+    per_key = {}
+    for r in records:
+        if r.get("kind") == "pass_pipeline":
+            per_key[r.get("key")] = r
+    if not per_key:
+        return None
+    out = {"programs": len(per_key)}
+    progs = {}
+    total_removed = 0
+    total_buckets = 0
+    total_fallbacks = 0
+    total_coalesced = 0
+    for k, r in per_key.items():
+        entry = {"before_ops": r.get("before_ops"),
+                 "after_ops": r.get("after_ops"),
+                 "ops_removed": r.get("ops_removed", 0)}
+        pass_names = {p.get("name") for p in r.get("passes", ())}
+        if pass_names == {"dp_grad_bucket"}:
+            # grad-sync coalescing removes COLLECTIVES, not Program
+            # ops — folding it into ops_removed_total would claim op
+            # deletions that never happened
+            entry["collectives_coalesced"] = entry.pop("ops_removed")
+            total_coalesced += entry["collectives_coalesced"] or 0
+        else:
+            total_removed += entry["ops_removed"] or 0
+        rows = {}
+        for p in r.get("passes", ()):
+            name = p.get("name", "?")
+            row = {}
+            removed = ((p.get("before_ops") or 0)
+                       - (p.get("after_ops") or 0))
+            if removed:
+                row["removed"] = removed
+            if p.get("wall_ms") is not None:
+                row["wall_ms"] = p["wall_ms"]
+            if name == "dp_grad_bucket":
+                row["grads"] = p.get("grads")
+                row["psums"] = p.get("psums")
+                row["buckets"] = p.get("buckets", 0)
+                row["fallbacks"] = p.get("fallbacks", 0)
+                total_buckets += row["buckets"] or 0
+                total_fallbacks += row["fallbacks"] or 0
+            if row:
+                rows[name] = row
+        if rows:
+            entry["passes"] = rows
+        if r.get("total_wall_ms") is not None:
+            entry["total_wall_ms"] = r["total_wall_ms"]
+        progs[k] = entry
+    out["by_program"] = progs
+    out["ops_removed_total"] = total_removed
+    if total_coalesced:
+        out["collectives_coalesced_total"] = total_coalesced
+    if total_buckets:
+        out["buckets_formed"] = total_buckets
+    if total_fallbacks:
+        out["bucket_fallbacks"] = total_fallbacks
     return out
 
 
