@@ -2297,6 +2297,254 @@ def bench_serving_smoke(on_tpu, peak):
             monitor.enable()
 
 
+def bench_fleet_obs_smoke(on_tpu, peak):
+    """Fleet-observability smoke row (ISSUE 10 CI satellite): a REAL
+    2-process CPU-mesh dp train through the public Executor path
+    (tests/dist_worker_fleet.py) with rank 1 slowed on EVERY step via
+    ``faultinject.stall_point("executor.step")``, asserting:
+
+    - the straggler is NAMED: ``monitor.fleet_skew()`` on both ranks
+      attributes the slowdown to dp shard 1 / process_index 1, with
+      ``behind_us_mean`` within ±20% of the injected stall;
+    - the wait-fraction math RECOMPUTES EXACTLY from the raw per-step
+      wait vectors the worker dumps (no trust in the rolling table);
+    - a live ``/metrics`` scrape parses and exposes the same counters
+      and gauges as ``monitor.snapshot()`` (spot-checked per name),
+      and ``/healthz`` answers 200/ok;
+    - the rank-tagged telemetry streams merge
+      (tools/telemetry_report.py fleet mode) with records attributed
+      to the right rank and the skew table riding the stream;
+    - a single-process dispatch microbench shows the exporter adds no
+      steady-state cost (off vs running, generous 1.5x guard — the
+      hot path is gate-free either way).
+    """
+    import tempfile
+
+    from paddle_tpu.distributed.launch import _wait, start_procs
+
+    stall_s = 0.08
+    steps = 12
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "dist_worker_fleet.py")
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_fleet_obs_")
+    out = os.path.join(tmp, "out.json")
+    procs, logs = start_procs(
+        node_ips=["127.0.0.1"], node_ip="127.0.0.1", nproc_per_node=2,
+        training_script=worker,
+        script_args=(out, str(stall_s), str(steps)),
+        log_dir=os.path.join(tmp, "logs"),
+        env_extra={"PYTHONPATH": repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""),
+                   "PADDLE_RENDEZVOUS_TIMEOUT": "60"})
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        if all(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.5)
+    else:
+        for p in procs:
+            p.kill()
+    rc = _wait(procs, logs)
+    if rc != 0:
+        logtail = ""
+        try:
+            ldir = os.path.join(tmp, "logs")
+            logtail = "; ".join(
+                p + ": " + open(os.path.join(ldir, p)).read()[-400:]
+                for p in sorted(os.listdir(ldir)))
+        except OSError:
+            pass
+        return {"metric": "fleet_obs_smoke", "value": 0, "unit": "ok",
+                "vs_baseline": None,
+                "error": f"fleet worker rc={rc}: {logtail[:1500]}"}
+
+    results = {}
+    for r in (0, 1):
+        with open(f"{out}.r{r}") as f:
+            results[r] = json.load(f)
+    r0 = results[0]
+    window = r0["window"]
+    checks = {}
+
+    # (1) the straggler is named, on BOTH ranks' own tables
+    for r in (0, 1):
+        st = (results[r]["table"] or {}).get("straggler") or {}
+        checks[f"straggler_named_r{r}"] = (
+            st.get("dp_index") == 1 and st.get("process_index") == 1)
+    behind = ((r0["table"] or {}).get("straggler")
+              or {}).get("behind_us_mean") or 0.0
+    checks["behind_within_20pct"] = (
+        abs(behind - stall_s * 1e6) <= 0.20 * stall_s * 1e6)
+
+    # (2) wait-fraction math recomputes EXACTLY from the raw rows,
+    # with the same formulas/rounding monitor.fleet uses
+    def recompute(rows, window):
+        rows = rows[-window:]
+        ndev = max(len(r["waits_us"]) for r in rows)
+        waits = [[] for _ in range(ndev)]
+        behind = [[] for _ in range(ndev)]
+        times = [r["step_time_s"] for r in rows
+                 if (r.get("step_time_s") or 0) > 0]
+        for r in rows:
+            w = r["waits_us"]
+            if len(w) != ndev:
+                continue
+            wmax = max(w)
+            for i in range(ndev):
+                waits[i].append(w[i])
+                behind[i].append(wmax - w[i])
+        mean_step_us = (sum(times) / len(times) * 1e6) if times else None
+        out = []
+        for i in range(ndev):
+            if not waits[i]:
+                continue
+            mean_wait = sum(waits[i]) / len(waits[i])
+            mean_behind = sum(behind[i]) / len(behind[i])
+            row = {"wait_us_mean": round(mean_wait, 1),
+                   "behind_us_mean": round(mean_behind, 1)}
+            if mean_step_us:
+                row["wait_frac"] = round(mean_wait / mean_step_us, 4)
+                row["straggler_score"] = round(
+                    mean_behind / mean_step_us, 4)
+            out.append(row)
+        return out
+
+    rows0 = r0.get("rows") or []
+    tbl_ranks = (r0.get("table") or {}).get("ranks") or []
+    recomputed = recompute(rows0, window) if rows0 else []
+    checks["rows_complete"] = len(rows0) == steps
+    checks["wait_frac_recomputed_exactly"] = (
+        bool(recomputed) and len(tbl_ranks) == len(recomputed) and all(
+            all(trow.get(k) == rrow[k] for k in rrow)
+            for trow, rrow in zip(tbl_ranks, recomputed)))
+
+    # (3) live /metrics == snapshot(), /healthz ok
+    metrics = r0.get("metrics") or {}
+    parsed = metrics.get("parsed") or {}
+    checks["metrics_scrape_parses"] = len(parsed) > 0
+    snap_counters = r0.get("snapshot_counters") or {}
+    snap_gauges = r0.get("snapshot_gauges") or {}
+
+    from paddle_tpu.monitor import exporter
+
+    def _prom(name, kind=None):
+        return exporter.metric_key(exporter.exported_name(name, kind))
+
+    checks["scrape_matches_snapshot"] = bool(snap_counters) and all(
+        parsed.get(_prom(n, "counter")) == float(v)
+        for n, v in snap_counters.items()) and all(
+        parsed.get(_prom(n)) == float(v)
+        for n, v in snap_gauges.items())
+    health = metrics.get("health") or {}
+    checks["healthz_ok"] = (health.get("ok") is True
+                            and health.get("status") == 200)
+
+    # (4) the rank-tagged streams merge with correct attribution
+    import sys
+
+    sys.path.insert(0, repo)
+    from tools.telemetry_report import fleet_merge, summarize_fleet
+
+    tdir = os.path.join(tmp, "telemetry")
+    streams = sorted(os.path.join(tdir, p) for p in os.listdir(tdir)
+                     if p.endswith(".jsonl"))
+    by_rank, merged = fleet_merge(streams)
+    fsum = summarize_fleet(by_rank, merged)
+    checks["fleet_merge_two_ranks"] = fsum.get("ranks") == 2
+    skew = fsum.get("fleet_skew") or {}
+    checks["fleet_merge_names_straggler"] = (
+        (skew.get("straggler") or {}).get("process_index") == 1)
+
+    # (5) exporter off adds nothing to the dispatch path (it is not
+    # even imported per step); generous 1.5x guard so CPU noise can't
+    # flake CI while a real per-step cost still fails
+    import paddle_tpu as fluid
+    from paddle_tpu.monitor import exporter as _exp
+
+    with fluid.unique_name.guard():
+        mp, sp = fluid.Program(), fluid.Program()
+        with fluid.program_guard(mp, sp):
+            xv = fluid.data("x", [None, 16])
+            hv = fluid.layers.fc(xv, 16)
+            mv = fluid.layers.mean(hv)
+    exe = fluid.Executor()
+    sc = fluid.Scope()
+    exe.run(sp, scope=sc)
+    xb = np.ones((8, 16), np.float32)
+
+    def dispatch_us(chunks=8, per_chunk=5):
+        # best-of-chunks MIN: a single 40-call mean swings 3x between
+        # runs on a contended CI box (one scheduler stall poisons it);
+        # the per-config floor is the steady-state dispatch cost the
+        # guard actually compares
+        for _ in range(5):
+            exe.run(mp, feed={"x": xb}, fetch_list=[mv], scope=sc)
+        best = None
+        for _ in range(chunks):
+            t0 = time.perf_counter()
+            for _ in range(per_chunk):
+                exe.run(mp, feed={"x": xb}, fetch_list=[mv], scope=sc)
+            dt = (time.perf_counter() - t0) / per_chunk * 1e6
+            best = dt if best is None else min(best, dt)
+        return best
+
+    _exp.stop()
+    off_us = dispatch_us()
+    _exp.start(0, host="127.0.0.1")
+    on_us = dispatch_us()
+    _exp.stop()
+    # one-sided on purpose: the guard exists to catch the exporter-ON
+    # path regressing dispatch; "off slower than on" is CPU noise (the
+    # first window eating a contention spike), not a defect.  The key
+    # reads "no regression vs the exporter-off baseline".
+    checks["exporter_off_no_regression"] = on_us <= off_us * 1.5 + 50.0
+
+    checks = {k: bool(v) for k, v in checks.items()}
+    row = {"metric": "fleet_obs_smoke",
+           "value": int(all(checks.values())), "unit": "ok",
+           "vs_baseline": None, "steps": steps, "stall_s": stall_s,
+           "checks": checks,
+           "straggler": (r0["table"] or {}).get("straggler"),
+           "behind_us_mean": behind,
+           "injected_us": stall_s * 1e6,
+           "wait_frac_r0": (r0["table"]["ranks"][0].get("wait_frac")
+                            if r0.get("table") else None),
+           "mean_step_time_s": (r0["table"] or {}).get(
+               "mean_step_time_s"),
+           "dispatch_us_exporter_off": round(off_us, 1),
+           "dispatch_us_exporter_on": round(on_us, 1),
+           "metrics_series": len(parsed),
+           "fleet_merge": {k: fsum.get(k) for k in
+                           ("ranks", "step_time_straggler")}}
+    if not all(checks.values()):
+        row["error"] = "failed checks: " + ", ".join(
+            k for k, v in checks.items() if not v)
+    return row
+
+
+def main_fleet_obs_smoke():
+    """`python bench.py fleet_obs_smoke` — CI/tooling entry: the
+    2-process straggler smoke standalone, persisted to BENCH_TPU.json
+    under rows["fleet_obs_smoke"].  Exit 0 only when every check
+    passes."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_fleet_obs_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["fleet_obs_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def main_serving_smoke():
     """`python bench.py serving_smoke` — CI/tooling entry: the serving
     chaos row standalone on a 2-device virtual CPU mesh, persisted to
@@ -2511,6 +2759,7 @@ def main():
         ("program_lint_smoke", "program_lint_smoke",
          bench_program_lint_smoke),
         ("graph_opt_sweep", "graph_opt_sweep", bench_graph_opt_sweep),
+        ("fleet_obs_smoke", "fleet_obs_smoke", bench_fleet_obs_smoke),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
 
     # SIGALRM only interrupts Python bytecode: a compile/RPC wedged
@@ -2589,4 +2838,6 @@ if __name__ == "__main__":
         sys.exit(main_program_lint_smoke())
     if "graph_opt_sweep" in sys.argv[1:]:
         sys.exit(main_graph_opt_sweep())
+    if "fleet_obs_smoke" in sys.argv[1:]:
+        sys.exit(main_fleet_obs_smoke())
     main()

@@ -193,6 +193,39 @@ declare_flag("inference_fold", True,
              "Fold conv/fc+batch_norm and scale chains when loading "
              "inference models (Predictor/serving).")
 
+# Fleet-wide observability (paddle_tpu.monitor.fleet / exporter,
+# ISSUE 10).  The skew probe rides the dp step as two extra int32
+# scalars per device (host pre-sync timestamp) plus one pmax+all_gather
+# pair per step — each rank derives its own compute-vs-barrier-wait
+# split with no host round trip.  Non-dp programs never read the flag.
+declare_flag("fleet_skew", True,
+             "Emit the per-step straggler/skew probe alongside the dp "
+             "gradient sync (dp programs only).")
+
+# Live Prometheus exporter: a stdlib http.server daemon thread serving
+# /metrics (text format: every counter/gauge, serving p50/p99, breaker
+# state, peak HBM, fleet skew) and /healthz (rc reflects breaker /
+# watchdog / anomaly-guard state).  0 (default) = off: the hot path
+# carries no exporter code at all, gate-free like the flight recorder.
+declare_flag("metrics_port", 0,
+             "Serve /metrics and /healthz on this port (0 = off).")
+declare_flag("metrics_host", "127.0.0.1",
+             "Bind address for the metrics exporter.  Loopback by "
+             "default — the scrape body names hosts and serving "
+             "labels; set 0.0.0.0 deliberately to let a fleet-level "
+             "Prometheus reach it.")
+
+# Telemetry JSONL rotation: a week-long always-on run must not fill a
+# disk.  When the active segment passes the cap it is rotated to
+# <path>.1 (older segments shift up) and the oldest beyond the keep
+# count is deleted; read_jsonl reads rotated segments transparently.
+declare_flag("telemetry_max_mb", 512,
+             "Rotate the telemetry JSONL when the active segment "
+             "passes this many MiB (0 = never rotate).")
+declare_flag("telemetry_keep", 3,
+             "How many rotated telemetry JSONL segments to keep "
+             "(beyond the active one).")
+
 declare_flag("maxpool_mask_bwd", False,
              "Give max-pool a recompute-mask custom VJP (window passes "
              "+ shifted compares, all XLA-fusable) instead of the "

@@ -38,6 +38,7 @@ _monitor = None
 _resilience = None
 _op_sampler_slot = None
 _flight = None
+_fleet_mod = None
 
 
 def _dispatch_span(name):
@@ -101,6 +102,18 @@ def _fr():
 
         _flight = flight_recorder.get()
     return _flight
+
+
+def _fleet():
+    """Lazy paddle_tpu.monitor.fleet handle (ISSUE 10): rank identity,
+    the dp timestamp feeds, and the skew ring the straggler probe's
+    gathered wait vectors land in."""
+    global _fleet_mod
+    if _fleet_mod is None:
+        from ..monitor import fleet
+
+        _fleet_mod = fleet
+    return _fleet_mod
 
 
 def _materialize(fetches):
@@ -913,6 +926,11 @@ class Executor:
                 # caller's arrays are never touched, so a rollback
                 # replay of the same batch sees clean data)
                 feed_arrays = res.faultinject.on_step_feed(feed_arrays)
+                # latency/hang injection (fleet straggler smoke): the
+                # stall happens BEFORE the skew probe's timestamp is
+                # taken, so an injected slow rank looks exactly like a
+                # real one to the barrier-wait attribution
+                res.faultinject.stall_point("executor.step")
 
             self._root_key, run_key = jax.random.split(self._root_key)
 
@@ -984,10 +1002,6 @@ class Executor:
                         state[n] = jax.device_put(v, rep)
                 self._check_state_placement = False
 
-            feed_sig = tuple(
-                (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
-                for n in sorted(feed_arrays)
-            )
             if dp_mesh is not None:
                 ndev = dp_mesh.devices.size
                 for n, a in feed_arrays.items():
@@ -996,6 +1010,24 @@ class Executor:
                             f"data-parallel feed '{n}' needs a leading "
                             f"batch dim divisible by {ndev} devices, got "
                             f"{a.shape}")
+
+            # Fleet skew probe (ISSUE 10): dp programs carry this
+            # rank's host pre-sync timestamp on device as two reserved
+            # int32 feeds; the compiled step turns them into a
+            # replicated per-shard barrier-wait vector returned as one
+            # extra (popped) fetch.  Constant shape/dtype, so the
+            # compiled-step cache key and memoized shard_map signature
+            # stay stable across steps.
+            fleet_on = (dp_mesh is not None
+                        and flags.flag("fleet_skew"))
+            if fleet_on:
+                feed_arrays = _fleet().add_timestamp_feeds(feed_arrays,
+                                                           dp_mesh)
+
+            feed_sig = tuple(
+                (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
+                for n in sorted(feed_arrays)
+            )
 
             key = (id(program), plan.version, feed_sig, tuple(fetch_names),
                    state_names,
@@ -1087,6 +1119,14 @@ class Executor:
             # RetriesExhausted chains it — lands here.)
             self._oom_postmortem(e, mon_on)
             raise
+        skew_fetch = None
+        if fleet_on:
+            # the skew probe's replicated wait vector rides back as the
+            # very last fetch (after the guard flag); popped here,
+            # handed to the fleet ring WITHOUT materializing — the
+            # async dispatch pipeline never syncs on a diagnostic
+            skew_fetch = fetches[-1]
+            fetches = fetches[:-1]
         guard_flag = None
         if guard_on:
             # the fused all-finite flag rides back as the LAST fetch;
@@ -1113,6 +1153,9 @@ class Executor:
                          host_dispatch_us=(time.perf_counter_ns() - t0)
                          / 1e3,
                          warmup=fresh_compile)
+        if skew_fetch is not None:
+            _fleet().note_sync(skew_fetch, step_record=step_rec,
+                               mesh=dp_mesh, key=telemetry_key)
         if guard_flag is not None:
             # ONE host sync per guarded step (the policy decision needs
             # the scalar): the price of the guard, paid only when it is
@@ -1239,7 +1282,11 @@ class Executor:
         in both rings, no duplicate bookkeeping)."""
         examples = 0
         feed_bytes = 0
-        for a in feed_arrays.values():
+        for n, a in feed_arrays.items():
+            if n.startswith("__fleet_"):
+                # the skew probe's timestamp feeds are diagnostics, not
+                # workload — byte/example accounting must not see them
+                continue
             feed_bytes += int(getattr(a, "nbytes", 0) or 0)
             shape = getattr(a, "shape", ())
             if shape:
@@ -1388,6 +1435,11 @@ class Executor:
         # -- fault-tolerance plumbing ----------------------------------
         res = _res()
         mon = _mon()
+        # live /metrics exporter (ISSUE 10): session-entry hook, never
+        # per step — a no-op unless FLAGS_metrics_port says otherwise
+        from ..monitor import exporter as _exporter
+
+        _exporter.ensure_started()
         mgr = checkpoint
         if mgr is not None and not hasattr(mgr, "restore_latest"):
             from ..checkpoint import CheckpointManager
@@ -1716,6 +1768,13 @@ class Executor:
                     f"{info}={v.mean():.6f}"
                     for info, v in zip(fetch_info, _materialize(out)))
                 print(f"[train_from_dataset] step {step_i}: {msg}")
+        if mon.is_enabled():
+            # loop-end fleet record (ISSUE 10): the rolling skew table
+            # rides the telemetry stream once per loop, so a JSONL
+            # report (or a post-mortem) names the straggler without
+            # asking the live process
+            mon.record_fleet_skew(
+                key=getattr(program, "_telemetry_label", None))
         if not fetch_names:
             return None
         return _materialize(last) if last is not None else None
@@ -1824,11 +1883,19 @@ class Executor:
                 (n, a.shape, str(a.dtype)) for n, a in feeds.items()))
             fn = memo.get(sig)
             if fn is None:
+                from ..monitor import fleet as _fleet_names
+
+                # the skew probe's reserved feeds never enter the
+                # program; its wait vector rides as one extra fetch
+                # BEYOND the shape-evaluated ones (replicated by the
+                # all_gather, so out-spec P() with no fetch-sync pmean)
+                has_fleet = _fleet_names.FLEET_TS_SEC in feeds
                 ndev = dp_mesh.devices.size
                 local_feeds = {
                     n: jax.ShapeDtypeStruct(
                         (a.shape[0] // ndev,) + a.shape[1:], a.dtype)
                     for n, a in feeds.items()
+                    if not n.startswith("__fleet_")
                 }
                 avals = jax.eval_shape(
                     plain_step,
@@ -1840,14 +1907,22 @@ class Executor:
 
                 def dp_step_shaped(state, feeds, key):
                     new_state, fetches = dp_step(state, feeds, key)
+                    skew = None
+                    if has_fleet:
+                        skew = fetches[-1]
+                        fetches = fetches[:-1]
                     with jax.named_scope("update/dp_fetch_sync_0"):
                         fetches = [f if r >= 1
                                    else jax.lax.pmean(f, "dp")
                                    for f, r in zip(fetches, fetch_ranks)]
+                    if skew is not None:
+                        fetches = fetches + [skew]
                     return new_state, fetches
 
                 out_fetch_specs = [
                     P("dp") if r >= 1 else P() for r in fetch_ranks]
+                if has_fleet:
+                    out_fetch_specs = out_fetch_specs + [P()]
                 fn = _mon().instrument_jit(
                     jax.jit(apply_precision_policy(shard_map(
                         dp_step_shaped, mesh=dp_mesh,
@@ -1882,11 +1957,21 @@ class Executor:
             env = {}
             env.update(state)
             finite = jnp.asarray(True) if guard_on else None
+            # fleet skew probe (ISSUE 10): the reserved timestamp feeds
+            # never enter the program env — they feed the barrier-wait
+            # collective emitted in the dp_grad_sync scope below
+            fleet_ts = None
+            if dp and "__fleet_ts_sec__" in feeds:
+                fleet_ts = (feeds["__fleet_ts_sec__"],
+                            feeds["__fleet_ts_usec__"])
+            skew = None
             # device-resident feeds whose dtype mismatches the declared
             # var dtype are cast HERE, inside the compiled step — the
             # cast fuses into the step instead of costing the dispatch
             # path a separate per-call device computation
             for n, v in feeds.items():
+                if n.startswith("__fleet_"):
+                    continue
                 env[n] = v.astype(feed_casts[n]) if n in feed_casts else v
             const_env = {}
             rng_box = _RngBox(key)
@@ -1955,6 +2040,13 @@ class Executor:
                         # of newest-wins under one shared key
                         synced = _coll.sync_gradients(
                             grads, "dp", key=telemetry_key)
+                        if fleet_ts is not None and skew is None:
+                            # the straggler probe rides the SAME scope
+                            # as the bucketed grad collectives: one
+                            # extra scalar pair per step, attributed to
+                            # dp_grad_sync like the psums it measures
+                            skew = _coll.emit_skew_probe(
+                                fleet_ts[0], fleet_ts[1], "dp")
                     else:
                         synced = grads
                     for n, g in synced.items():
@@ -1997,6 +2089,19 @@ class Executor:
                            if n in state else v)
                         for n, v in new_state.items()}
                 fetches = fetches + [flag]
+            if fleet_ts is not None:
+                if skew is None:
+                    # no backward section carried the probe (eval / dp
+                    # inference program): emit it with the state-sync
+                    # framework collectives instead
+                    from ..transpiler import collective as _coll
+
+                    with jax.named_scope("update/dp_grad_sync_fleet"):
+                        skew = _coll.emit_skew_probe(
+                            fleet_ts[0], fleet_ts[1], "dp")
+                # the wait vector is the VERY last fetch — the executor
+                # pops it before the guard flag's own pop
+                fetches = fetches + [skew]
             return new_state, fetches
 
         return step

@@ -37,6 +37,9 @@ from .session import MetricsSession
 from . import op_profile                                  # noqa: F401
 from . import mem_profile                                 # noqa: F401
 from . import flight_recorder  # noqa: F401  — installs crash hooks
+from . import fleet                                       # noqa: F401
+from . import exporter                                    # noqa: F401
+from .fleet import fleet_skew, rank_info, rank_tag        # noqa: F401
 
 __all__ = [
     "enable", "disable", "is_enabled", "snapshot", "reset",
@@ -49,6 +52,8 @@ __all__ = [
     "flight_dump",
     "mem_profile", "mem_profile_split", "mem_table", "peak_breakdown",
     "serving_table", "record_serving", "serving_records",
+    "fleet", "exporter", "fleet_skew", "rank_info", "rank_tag",
+    "record_fleet_skew", "fleet_skew_records",
     "MetricsRegistry", "MetricsSession", "CompileLedger", "JsonlWriter",
     "read_jsonl", "Counter", "Gauge", "PEAK_FLOPS", "peak_flops",
     "parse_cost_analysis", "parse_memory_analysis",
@@ -71,15 +76,22 @@ _serving_records = []
 # kind="pass_pipeline" records from the graph optimizer (ISSUE 9):
 # per-pass op counts + wall time, and the trace-time dp grad-bucketing
 _pass_records = []
+# kind="fleet_skew" records from the straggler probe (ISSUE 10): the
+# rolling per-rank skew table, emitted at loop end / flight dump
+_fleet_records = []
 
 
 def enable(jsonl_path=None):
     """Turn telemetry on.  With `jsonl_path`, every step record is also
-    appended there as one JSON line (`read_jsonl` parses it back)."""
+    appended there as one JSON line (`read_jsonl` parses it back —
+    rank-stamped and size-cap-rotated per the FLAGS_telemetry_* policy).
+    Session entry also starts the live /metrics exporter iff
+    FLAGS_metrics_port says so (never per step, never raising)."""
     global _enabled
     if jsonl_path is not None:
         _session.attach_writer(JsonlWriter(jsonl_path))
     _enabled = True
+    exporter.ensure_started()
 
 
 def disable():
@@ -106,9 +118,11 @@ def reset():
     _ledger.clear()
     _registry.reset()
     op_profile.clear_samples()
+    fleet.clear()
     del _lint_records[:]
     del _serving_records[:]
     del _pass_records[:]
+    del _fleet_records[:]
 
 
 # -- recording entry points (no-ops while disabled) ---------------------
@@ -195,6 +209,38 @@ def pass_pipeline_records():
     """kind="pass_pipeline" records seen since enable()/reset(),
     newest last."""
     return list(_pass_records)
+
+
+def record_fleet_skew(table=None, key=None):
+    """Write one kind="fleet_skew" record — the current rolling skew
+    table (fleet.fleet_skew()) unless an explicit table is passed —
+    onto the telemetry JSONL stream and keep it addressable in-process
+    (fleet_skew_records()).  Called at train-loop end and by the flight
+    recorder before a dump; like lint/serving records it rides the
+    stream without touching step numbering.  None (and no record) when
+    no dp step has carried the probe yet."""
+    if not _enabled:
+        return None
+    if table is None:
+        table = fleet.fleet_skew()
+    if not table:
+        return None
+    record = {"kind": "fleet_skew", **table}
+    if key is not None:
+        record["key"] = key
+    import time as _time
+
+    record.setdefault("ts_us", _time.perf_counter_ns() / 1000.0)
+    record.setdefault("wall_time", _time.time())
+    _fleet_records.append(record)
+    _session.emit_record(record)
+    return record
+
+
+def fleet_skew_records():
+    """kind="fleet_skew" records seen since enable()/reset(), newest
+    last."""
+    return list(_fleet_records)
 
 
 def serving_table():
@@ -329,6 +375,11 @@ def snapshot():
     totals), the full counter/gauge registry, the compile ledger
     summary (count, time, FLOPs, memory bytes), the derived MFU, and —
     once a compile has been attributed — the per-op profile rows."""
+    # drain the fleet skew ring FIRST: materializing pending probe
+    # vectors bumps fleet.* counters/gauges, and the registry snapshot
+    # below must already include them — same ordering the /metrics
+    # exporter uses, so scrape and snapshot agree
+    skew = fleet.fleet_skew()
     out = _session.snapshot()
     out.update(_registry.snapshot())
     out["compile"] = _ledger.summary()
@@ -342,6 +393,8 @@ def snapshot():
     serving = serving_table()
     if serving:
         out["serving"] = serving
+    if skew:
+        out["fleet"] = {"rank": fleet.rank_tag(), "skew": skew}
     return out
 
 

@@ -1,0 +1,426 @@
+"""Fleet-wide observability — rank identity + straggler/skew attribution
+(ISSUE 10 tentpole, parts 1 and 2).
+
+Every observability pillar before this PR (telemetry JSONL, per-op and
+HBM attribution, flight recorder) was blind to which host/rank produced
+a record.  This module is the per-process side of the fleet layer:
+
+**Rank identity** (:func:`rank_tag` / :func:`rank_info`) — one small
+dict ``{host, process_index, local_device_ids}`` stamped on every JSONL
+record, every flight-recorder dump (filename + header), and the merged
+chrome trace's process metadata, so N rank streams written into one
+shared ``FLAGS_telemetry_dir``-style directory are mergeable after the
+fact (``tools/telemetry_report.py --fleet`` / ``tools/parse_xplane.py
+--fleet``).  Identity is sourced from the launcher's ``PADDLE_*`` env
+contract and enriched from jax (``process_index``/``local_devices``)
+ONLY once the backend is already initialized — reading it must never
+itself initialize the backend, or a later ``jax.distributed.initialize``
+in the same process would fail.
+
+**Straggler/skew attribution** (:class:`FleetSkew`) — the executor's dp
+step carries each rank's host pre-sync timestamp on device (two int32
+scalars per device, ``transpiler.collective.emit_skew_probe``), where a
+``pmax`` + ``all_gather`` pair inside the ``dp_grad_sync`` scope turns
+it into a replicated per-shard barrier-wait vector with **no host round
+trip**: ``wait_us[r] = t_latest - t_r`` — the slowest rank arrives last
+and waits ~0 while everyone else's wait IS the straggler's lag.  The
+executor hands the (still-on-device) vector to :func:`note_sync`; the
+ring materializes lazily so the async-dispatch pipeline is never forced
+to sync on a diagnostic.  :func:`fleet_skew` reports per-rank step-time
+deltas, wait fraction, and a rolling straggler score; the flight
+recorder appends the same table (``kind="fleet_skew"``) to every
+post-mortem so an anomaly/OOM dump says *who* was slow.
+
+Timestamps are epoch-based (NTP-shared across hosts), encoded as
+``(seconds mod 2**20, microseconds)`` so they survive int32 without
+losing μs resolution; a wrap straddling one step (~ once per 12 days)
+yields one nonsense sample, bounded by the ring.
+"""
+
+import collections
+import os
+import socket
+import threading
+import time
+
+from .. import flags
+
+__all__ = ["FLEET_TS_SEC", "FLEET_TS_USEC", "rank_info", "rank_tag",
+           "host_timestamp", "add_timestamp_feeds", "note_sync",
+           "fleet_skew", "clear", "FleetSkew"]
+
+# reserved feed names the executor injects for dp programs (stripped
+# before the program env is built — never visible to user ops)
+FLEET_TS_SEC = "__fleet_ts_sec__"
+FLEET_TS_USEC = "__fleet_ts_usec__"
+
+# seconds wrap for the int32 encoding (~12 days); within one step every
+# rank is on the same side of the wrap except at the boundary itself
+EPOCH_MOD = 1 << 20
+
+# a wait beyond this (~6 days) can only be the wrap boundary landing
+# between two ranks' timestamps in one step — the sample is discarded
+# at drain time so it cannot poison the rolling window
+_WRAP_CLAMP_US = (EPOCH_MOD // 2) * 1e6
+
+_SKEW_WINDOW = 64          # rolling straggler-score window (steps)
+_RING = 256                # pending + materialized row bound
+
+
+def _jax_enrichment():
+    """process_index/count + local device ids from jax — but ONLY if
+    the backend is already initialized (checked via xla_bridge, no side
+    effects).  None otherwise; callers retry on a later read."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        from jax._src import xla_bridge as xb
+
+        if not xb.backends_are_initialized():
+            return None
+        return {
+            "process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count()),
+            "local_device_ids": [int(d.id) for d in jax.local_devices()],
+        }
+    except Exception:
+        return None
+
+
+_rank_lock = threading.Lock()
+_rank_info = None           # cached; "complete" once jax enriched it
+_tag_cache = None           # frozen rank_tag() once the info is complete
+
+
+def rank_info(refresh=False):
+    """This process's fleet identity: ``{host, pid, process_index,
+    process_count, local_device_ids}``.  Launcher env vars
+    (``PADDLE_TRAINER_ID``/``PADDLE_TRAINERS_NUM``) are the base truth;
+    jax's own process_index/local_devices supersede them once the
+    backend is up (re-checked on each call until then)."""
+    global _rank_info, _tag_cache
+    with _rank_lock:
+        info = _rank_info
+        if info is None or refresh:
+            _tag_cache = None
+            # the env/host base is built ONCE — emit stamps every JSONL
+            # line, so only the (cheap, side-effect-free) jax probe may
+            # repeat until the backend is up
+            info = {
+                "host": socket.gethostname(),
+                "pid": os.getpid(),
+                "process_index": int(os.environ.get("PADDLE_TRAINER_ID",
+                                                    "0")),
+                "process_count": int(os.environ.get(
+                    "PADDLE_TRAINERS_NUM", "1")),
+                "local_device_ids": None,
+                "_complete": False,
+            }
+            _rank_info = info
+        if not info["_complete"]:
+            enriched = _jax_enrichment()
+            if enriched is not None:
+                info.update(enriched)
+                info["_complete"] = True
+        out = dict(info)
+        del out["_complete"]    # cache bookkeeping, not public contract
+        return out
+
+
+def rank_tag():
+    """The compact stamp every JSONL record / dump header carries:
+    ``{host, process_index}`` plus ``local_device_ids`` once known.
+    Frozen after the jax enrichment lands — the stamp runs once per
+    emitted JSONL line, so the steady state is one dict copy."""
+    global _tag_cache
+    tag = _tag_cache
+    if tag is None:
+        info = rank_info()
+        tag = {"host": info["host"],
+               "process_index": info["process_index"]}
+        if info.get("local_device_ids") is not None:
+            tag["local_device_ids"] = info["local_device_ids"]
+        with _rank_lock:
+            if _rank_info is not None and _rank_info.get("_complete"):
+                _tag_cache = tag
+    return dict(tag)
+
+
+# -- the on-device probe's host side ------------------------------------
+
+def host_timestamp():
+    """Now, encoded for the int32 probe: (epoch seconds mod 2**20,
+    microseconds within the second)."""
+    t = time.time()
+    return int(t) % EPOCH_MOD, int((t % 1.0) * 1e6)
+
+
+_mesh_cache = {}   # id(mesh) -> (mesh, local_rows, shard_procs, sharding)
+
+
+def _mesh_layout(mesh):
+    """(local device rows this process contributes, per-dp-shard
+    process_index list, dp NamedSharding or None) — cached per mesh so
+    the per-step feed injection rebuilds nothing; keyed
+    id-recycle-proof."""
+    ent = _mesh_cache.get(id(mesh))
+    if ent is not None and ent[0] is mesh:
+        return ent[1], ent[2], ent[3]
+    devs = list(mesh.devices.flat)
+    try:
+        import jax
+
+        me = jax.process_index()
+    except Exception:
+        me = 0
+    shard_procs = [int(getattr(d, "process_index", 0)) for d in devs]
+    local_rows = sum(1 for p in shard_procs if p == me) or len(devs)
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(mesh, PartitionSpec("dp"))
+    except Exception:
+        sharding = None
+    if len(_mesh_cache) >= 8:
+        _mesh_cache.clear()
+    _mesh_cache[id(mesh)] = (mesh, local_rows, shard_procs, sharding)
+    return local_rows, shard_procs, sharding
+
+
+def add_timestamp_feeds(feed_arrays, mesh):
+    """Inject this rank's pre-sync timestamp as the two reserved dp
+    feeds (one int32 scalar per local device row).  Returns a NEW dict;
+    the caller's feed dict is never mutated."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    local_rows, _, sharding = _mesh_layout(mesh)
+    sec, usec = host_timestamp()
+    if sharding is None:   # non-dp mesh: fail with the native error
+        sharding = NamedSharding(mesh, P("dp"))
+    out = dict(feed_arrays)
+    out[FLEET_TS_SEC] = jax.make_array_from_process_local_data(
+        sharding, np.full((local_rows,), sec, np.int32))
+    out[FLEET_TS_USEC] = jax.make_array_from_process_local_data(
+        sharding, np.full((local_rows,), usec, np.int32))
+    return out
+
+
+# -- skew accounting ----------------------------------------------------
+
+class FleetSkew:
+    """Rolling per-rank barrier-wait attribution.
+
+    ``note_sync`` appends the step's (still-on-device) replicated wait
+    vector without materializing it — the diagnostic must not force the
+    async dispatch pipeline to sync.  Reads (:meth:`table`,
+    the exporter, a flight dump) drain pending entries first."""
+
+    def __init__(self, window=_SKEW_WINDOW):
+        self._lock = threading.Lock()
+        self._pending = collections.deque(maxlen=_RING)
+        self._rows = collections.deque(maxlen=_RING)
+        self._shard_procs = None
+        self._window = window
+
+    def note_sync(self, waits, step_record=None, mesh=None, key=None):
+        """One dp step's gathered wait vector (replicated [ndev]
+        float32, device array or anything np.asarray-able)."""
+        meta = {"key": key}
+        if step_record is not None:
+            meta["step"] = step_record.get("step")
+            meta["step_time_s"] = step_record.get("step_time_s")
+        shard_procs = None
+        if mesh is not None:
+            _, shard_procs, _ = _mesh_layout(mesh)
+        with self._lock:
+            if shard_procs is not None:
+                self._shard_procs = shard_procs
+            self._pending.append((waits, meta))
+
+    def drain(self):
+        """Materialize pending device vectors into host rows (the only
+        point the probe touches the host)."""
+        import numpy as np
+
+        with self._lock:
+            pending = list(self._pending)
+            self._pending.clear()
+        if not pending:
+            return
+        rows = []
+        for waits, meta in pending:
+            try:
+                arr = waits
+                if hasattr(arr, "addressable_data"):
+                    arr = arr.addressable_data(0)
+                vec = np.asarray(arr, dtype=np.float64).reshape(-1)
+            except Exception:
+                continue
+            if vec.size and float(vec.max()) > _WRAP_CLAMP_US:
+                # EPOCH_MOD wrap straddled this step: one bogus
+                # ~EPOCH_MOD-second wait would corrupt straggler
+                # election and max_skew_us for the whole window
+                try:
+                    from .. import monitor
+
+                    monitor.counter("fleet.wrap_discards").add(1)
+                except Exception:
+                    pass
+                continue
+            row = dict(meta)
+            row["waits_us"] = [float(v) for v in vec]
+            rows.append(row)
+        if not rows:
+            return
+        with self._lock:
+            self._rows.extend(rows)
+        self._note_counters(rows)
+
+    def _note_counters(self, rows):
+        """Gate-free fleet counters + the ``fleet.skew_us`` gauge whose
+        history becomes the chrome counter track."""
+        try:
+            from .. import monitor
+
+            monitor.counter("fleet.sync_probes").add(len(rows))
+            me = rank_info()["process_index"]
+            shard_procs = self._shard_procs
+            straggled = 0
+            for row in rows:
+                w = row["waits_us"]
+                if len(w) < 2:
+                    continue
+                wmax, wmin = max(w), min(w)
+                monitor.gauge("fleet.skew_us").set(round(wmax - wmin, 1))
+                if wmax <= wmin:
+                    # no skew this step: a tie (all-zero waits on a
+                    # healthy run) must not elect shard 0 a straggler
+                    continue
+                # the straggler arrived last: its wait is the minimum
+                slow = min(range(len(w)), key=w.__getitem__)
+                if shard_procs and shard_procs[slow] == me:
+                    straggled += 1
+            if straggled:
+                monitor.counter("fleet.straggler_steps").add(straggled)
+        except Exception:
+            pass
+
+    def rows(self):
+        self.drain()
+        with self._lock:
+            return [dict(r) for r in self._rows]
+
+    def table(self, window=None):
+        """The skew table: per dp-shard wait stats over the rolling
+        window, plus the named straggler.
+
+        Per shard ``r``: ``wait_us_*`` — time r spent at the barrier
+        waiting for the slowest rank; ``behind_us_*`` — how far r's
+        arrival trailed the earliest rank (the straggler has the max);
+        ``wait_frac`` — mean wait / mean step time; ``straggler_score``
+        — mean behind_us normalized by the window's mean step time (a
+        rolling "fraction of every step this rank costs the fleet")."""
+        self.drain()
+        window = window or self._window
+        with self._lock:
+            rows = list(self._rows)[-window:]
+            shard_procs = self._shard_procs
+        if not rows:
+            return None
+        ndev = max(len(r["waits_us"]) for r in rows)
+        waits = [[] for _ in range(ndev)]
+        behind = [[] for _ in range(ndev)]
+        slowest_counts = [0] * ndev
+        times = [r["step_time_s"] for r in rows
+                 if (r.get("step_time_s") or 0) > 0]
+        for r in rows:
+            w = r["waits_us"]
+            if len(w) != ndev:
+                continue
+            wmax = max(w)
+            if wmax > min(w):
+                # ties (zero skew) name no slowest shard
+                slow = min(range(ndev), key=w.__getitem__)
+                slowest_counts[slow] += 1
+            for i in range(ndev):
+                waits[i].append(w[i])
+                behind[i].append(wmax - w[i])
+        mean_step_us = (sum(times) / len(times) * 1e6) if times else None
+        ranks = []
+        for i in range(ndev):
+            if not waits[i]:
+                continue
+            mean_wait = sum(waits[i]) / len(waits[i])
+            mean_behind = sum(behind[i]) / len(behind[i])
+            row = {
+                "dp_index": i,
+                "process_index": (shard_procs[i] if shard_procs
+                                  and i < len(shard_procs) else None),
+                "wait_us_mean": round(mean_wait, 1),
+                "wait_us_last": round(waits[i][-1], 1),
+                "behind_us_mean": round(mean_behind, 1),
+                "behind_us_max": round(max(behind[i]), 1),
+                "slowest_steps": slowest_counts[i],
+            }
+            if mean_step_us:
+                row["wait_frac"] = round(mean_wait / mean_step_us, 4)
+                row["straggler_score"] = round(
+                    mean_behind / mean_step_us, 4)
+            ranks.append(row)
+        if not ranks:
+            return None
+        max_skew = round(
+            max(max(b) for b in behind if b) if any(behind) else 0.0, 1)
+        straggler = max(ranks, key=lambda r: r["behind_us_mean"])
+        out = {
+            "steps": len(rows),
+            "window": window,
+            "mean_step_time_s": (round(mean_step_us / 1e6, 6)
+                                 if mean_step_us else None),
+            "max_skew_us": max_skew,
+            "ranks": ranks,
+            # a zero-skew window names NO straggler: electing shard 0
+            # off an all-zero tie would hand dashboards a false signal
+            "straggler": ({
+                "dp_index": straggler["dp_index"],
+                "process_index": straggler["process_index"],
+                "behind_us_mean": straggler["behind_us_mean"],
+                "straggler_score": straggler.get("straggler_score"),
+            } if straggler["behind_us_mean"] > 0 else None),
+        }
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._pending.clear()
+            self._rows.clear()
+            self._shard_procs = None
+
+
+_SKEW = FleetSkew()
+
+
+def note_sync(waits, step_record=None, mesh=None, key=None):
+    _SKEW.note_sync(waits, step_record=step_record, mesh=mesh, key=key)
+
+
+def fleet_skew(window=None):
+    """The current skew table (None until a dp step carried the probe).
+    json.dump-safe; what ``snapshot()["fleet"]`` embeds, the exporter
+    labels per rank, and a flight dump appends as ``kind="fleet_skew"``."""
+    return _SKEW.table(window=window)
+
+
+def skew_rows():
+    """Per-step materialized probe rows (waits_us per dp shard), oldest
+    first — the raw series the smoke row recomputes the table from."""
+    return _SKEW.rows()
+
+
+def clear():
+    _SKEW.clear()

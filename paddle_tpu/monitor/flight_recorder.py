@@ -6,12 +6,15 @@ a fixed-size ring of the last K step records, recent compile events,
 and recovery events (each one dict append, no I/O, no syncs) — and the
 moment a run dies it writes a post-mortem:
 
-- ``flight_<pid>.jsonl``      — meta (reason/time), the full
-  counter/gauge registry snapshot plus the recorder's own (telemetry-
-  gate-free) event counters, the last op-attribution table, compile
+- ``flight_<host>_p<rank>_<pid>.jsonl``      — meta (reason/time, the
+  fleet rank tag — N ranks dumping into one shared directory never
+  collide, ISSUE 10), the full counter/gauge registry snapshot plus the
+  recorder's own (telemetry-gate-free) event counters, the last
+  op-attribution table, the fleet skew table (who was slow), compile
   events, recovery events, and the last K step records.
-- ``flight_<pid>.trace.json`` — the same window as a chrome trace
-  (monitor/trace.py builder), so the final seconds open in Perfetto.
+- ``flight_<host>_p<rank>_<pid>.trace.json`` — the same window as a
+  chrome trace (monitor/trace.py builder), so the final seconds open in
+  Perfetto.
 
 Dump triggers, wired through the resilience taxonomy paths:
 
@@ -31,7 +34,8 @@ Dump triggers, wired through the resilience taxonomy paths:
   ``kind="oom"`` record with the requested bytes parsed from the
   error and the device's own memory stats, and — when the backend
   supports it — a ``jax.profiler.device_memory_profile()`` capture
-  written alongside as ``flight_<pid>.memprof.pb.gz``.
+  written alongside as
+  ``flight_<host>_p<rank>_<pid>.memprof.pb.gz``.
 - **atexit backstop** — if a severe event was recorded but nothing
   dumped since (error swallowed, then sys.exit), the exit handler
   writes the dump; clean exits write nothing.
@@ -340,8 +344,21 @@ class FlightRecorder:
         from .jsonl_writer import _json_default
 
         # stable per-process paths: successive dumps overwrite with the
-        # newer (larger) window — "a single post-mortem", not a spray
-        base = os.path.join(directory, f"flight_{os.getpid()}")
+        # newer (larger) window — "a single post-mortem", not a spray.
+        # The fleet identity is IN the filename (ISSUE 10): N ranks
+        # dumping into one shared directory never interleave ambiguously
+        # (pids alone can collide across hosts).
+        rank = {}
+        try:
+            from . import fleet
+
+            rank = fleet.rank_tag()
+        except Exception:
+            pass
+        base = os.path.join(
+            directory,
+            f"flight_{rank.get('host', 'localhost')}"
+            f"_p{rank.get('process_index', 0)}_{os.getpid()}")
         jsonl_path = base + ".jsonl"
         trace_path = base + ".trace.json"
         registry = {}
@@ -353,7 +370,8 @@ class FlightRecorder:
             pass
         lines = [{"kind": "meta", "reason": reason,
                   "wall_time": time.time(), "pid": os.getpid(),
-                  "argv": list(sys.argv), "step_seq": snap["step_seq"]},
+                  "argv": list(sys.argv), "step_seq": snap["step_seq"],
+                  **rank},
                  {"kind": "counters", "registry": registry,
                   "recorder": snap["counters"]}]
         if snap["op_table"]:
@@ -377,6 +395,18 @@ class FlightRecorder:
             lines.append(serving)
         if snap["oom"]:
             lines.append(snap["oom"])
+        try:
+            # the fleet skew table (ISSUE 10): an anomaly/OOM
+            # post-mortem from a dp run says WHO was slow, not just
+            # that someone was
+            from . import fleet
+
+            skew = fleet.fleet_skew()
+            if skew:
+                lines.append({"kind": "fleet_skew",
+                              "wall_time": time.time(), **skew})
+        except Exception:
+            pass
         lines.extend(snap["events"])
         lines.extend(snap["compiles"])
         lines.extend(snap["steps"])
@@ -394,7 +424,7 @@ class FlightRecorder:
             memprof = self._oom_memprof
         if memprof:
             # the jax allocator's own pprof capture rides alongside
-            # (pprof -http=: flight_<pid>.memprof.pb.gz)
+            # (pprof -http=: flight_<host>_p<rank>_<pid>.memprof.pb.gz)
             try:
                 with open(base + ".memprof.pb.gz", "wb") as f:
                     f.write(memprof)

@@ -32,11 +32,28 @@ def host_span_events(events):
 
 
 def _metadata_events(host_events):
+    # fleet identity on every process block (ISSUE 10): rank streams
+    # written into a shared dir stay attributable, and a multi-process
+    # merge (tools/parse_xplane.py --fleet) can remap pids per rank.
+    # Single-process process NAMES are unchanged; the rank rides in
+    # the metadata args (plus a "rankN:" prefix once there IS a fleet).
+    rank = {}
+    prefix = ""
+    try:
+        from . import fleet
+
+        info = fleet.rank_info()
+        rank = {"host": info["host"],
+                "process_index": info["process_index"]}
+        if info.get("process_count", 1) > 1:
+            prefix = f"rank{info['process_index']}:"
+    except Exception:
+        pass
     out = [
         {"name": "process_name", "ph": "M", "pid": _HOST_PID,
-         "args": {"name": "host"}},
+         "args": {"name": prefix + "host", **rank}},
         {"name": "process_name", "ph": "M", "pid": _STEP_PID,
-         "args": {"name": "train steps"}},
+         "args": {"name": prefix + "train steps", **rank}},
         {"name": "thread_name", "ph": "M", "pid": _STEP_PID,
          "tid": _STEP_TID, "args": {"name": "steps"}},
         {"name": "thread_name", "ph": "M", "pid": _STEP_PID,

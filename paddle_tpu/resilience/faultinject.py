@@ -61,7 +61,9 @@ class FaultPlan:
                     sets it — a REAL hang with no wall-clock guess, so
                     watchdog tests are not timing-flaky).  One-shot per
                     point; a (nth_hit, spec) tuple targets a later
-                    visit.
+                    visit, and ("every", spec) fires on EVERY visit
+                    without disarming — the fleet straggler smoke slows
+                    one rank on each step (ISSUE 10).
     """
 
     def __init__(self, nan_at_steps=(), nan_feed=None,
@@ -269,9 +271,12 @@ def stall_point(name):
         hit = p._stall_hits.get(name, 0)
         p._stall_hits[name] = hit + 1
         target_hit, spec = p.stall_points[name]
-        if hit != target_hit:
+        if target_hit == "every":
+            pass                             # repeating: never disarm
+        elif hit != target_hit:
             return
-        del p.stall_points[name]             # one-shot
+        else:
+            del p.stall_points[name]         # one-shot
         p.fired["stall"] += 1
     mon = _mon()
     if mon.is_enabled():

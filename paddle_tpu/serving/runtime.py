@@ -238,6 +238,12 @@ class ServingRuntime:
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
+        # live /metrics exporter (ISSUE 10): serving shares the same
+        # session-entry hook training uses — a no-op unless
+        # FLAGS_metrics_port says otherwise, never on the hot path
+        from ..monitor import exporter
+
+        exporter.ensure_started()
         self.watchdog.start()
         if self._batcher is None:
             self._batcher = threading.Thread(

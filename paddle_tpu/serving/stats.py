@@ -74,6 +74,7 @@ class ServingStats:
         self._samples = collections.deque(maxlen=_SAMPLE_CAP)
         self._buckets = {}            # bucket size -> dispatch count
         self._breaker = None          # CircuitBreaker, set by runtime
+        self._watchdog = None         # HangWatchdog, set by watchdog
         self.queue_depth = 0
         self.in_flight = 0
         if register:
@@ -82,6 +83,12 @@ class ServingStats:
 
     def attach_breaker(self, breaker):
         self._breaker = breaker
+
+    def attach_watchdog(self, watchdog):
+        """Back-link set by HangWatchdog so the summary (and /healthz)
+        can see a CURRENTLY-wedged dispatch, not just the stall count
+        it left behind."""
+        self._watchdog = weakref.ref(watchdog)
 
     # -- recording ------------------------------------------------------
     def note_admitted(self, depth):
@@ -213,6 +220,9 @@ class ServingStats:
             out["latency"] = lat
         if self._breaker is not None:
             out["breaker"] = self._breaker.summary()
+        wd = self._watchdog() if self._watchdog is not None else None
+        if wd is not None:
+            out["stalled_in_flight"] = wd.stalled_now()
         return out
 
     def to_record(self):

@@ -72,6 +72,16 @@ class HangWatchdog:
         self._next_token = 0
         self._stop = threading.Event()
         self._thread = None
+        if stats is not None:
+            # back-link: the serving summary / the exporter's /healthz
+            # ask "is a flagged dispatch STILL wedged right now"
+            stats.attach_watchdog(self)
+
+    def stalled_now(self):
+        """How many flagged dispatches are still in flight — nonzero
+        exactly while a detected stall remains unresolved."""
+        with self._lock:
+            return sum(1 for e in self._inflight.values() if e["flagged"])
 
     # -- lifecycle ------------------------------------------------------
     def start(self):

@@ -233,6 +233,36 @@ def _note_sync(stats, key):
         pass
 
 
+def emit_skew_probe(ts_sec, ts_usec, axis_name="dp"):
+    """Trace-time straggler probe (ISSUE 10), emitted inside the same
+    ``dp_grad_sync`` scope the bucketed gradient collectives live in:
+    one extra scalar pair per step instead of per gradient.
+
+    ``ts_sec``/``ts_usec`` are per-device int32 rows carrying each
+    rank's HOST pre-sync timestamp (epoch seconds mod 2**20 +
+    microseconds — the int32-safe split encoding from
+    ``monitor.fleet.host_timestamp``).  On device: a lexicographic
+    pmax finds the latest arrival, each rank's barrier wait is
+    ``t_latest - t_self`` at exact μs resolution, and one all_gather
+    replicates the per-shard wait vector so EVERY rank knows the whole
+    fleet's split without a host round trip.  Returns the replicated
+    float32 ``[ndev]`` wait vector (μs)."""
+    import jax
+    import jax.numpy as jnp
+
+    sec = ts_sec[0]
+    usec = ts_usec[0]
+    max_sec = jax.lax.pmax(sec, axis_name)
+    # lexicographic max: only ranks holding the max second compete on
+    # the microsecond component (others masked to -1, below any real
+    # usec), so the combined difference below is exact
+    tie_usec = jnp.where(sec == max_sec, usec, jnp.int32(-1))
+    max_usec = jax.lax.pmax(tie_usec, axis_name)
+    wait_us = ((max_sec - sec).astype(jnp.float32) * 1e6
+               + (max_usec - usec).astype(jnp.float32))
+    return jax.lax.all_gather(wait_us, axis_name)
+
+
 class Collective:
     def __init__(self, nrings=1):
         self.nrings = nrings
@@ -266,4 +296,5 @@ class LocalSGD(Collective):
 
 
 __all__ = ["GradAllReduce", "LocalSGD", "Collective",
-           "sync_gradients", "plan_buckets", "last_sync_stats"]
+           "sync_gradients", "plan_buckets", "last_sync_stats",
+           "emit_skew_probe"]

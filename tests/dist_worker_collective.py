@@ -133,6 +133,30 @@ def main():
         # state_dict carries UNwrapped names
         assert set(dp.state_dict()) == set(model.state_dict())
 
+    # --- rank-tagged telemetry streams (ISSUE 10) ----------------------
+    # every rank writes its own JSONL into ONE shared directory; the
+    # parent merges them with tools/telemetry_report.py's fleet mode
+    # and asserts each record lands on the rank that wrote it.  The
+    # jax backend is up, so the stamp carries the REAL process_index.
+    from paddle_tpu import monitor
+
+    tag = monitor.rank_tag()
+    assert tag["process_index"] == rank, (tag, rank)
+    assert monitor.rank_info()["process_count"] == world
+    tdir = os.path.join(os.path.dirname(os.path.abspath(out_path)),
+                        "telemetry")
+    os.makedirs(tdir, exist_ok=True)
+    monitor.reset()
+    monitor.enable(jsonl_path=os.path.join(tdir,
+                                           f"telemetry_r{rank}.jsonl"))
+    # rank-distinct payloads so the parent can prove attribution, not
+    # just that SOME stamp exists
+    monitor.record_step(host_dispatch_us=100.0 + rank,
+                        examples=8 * (rank + 1))
+    monitor.record_step(host_dispatch_us=100.0 + rank,
+                        examples=8 * (rank + 1))
+    monitor.disable()
+
     if rank == 0:
         with open(out_path, "w") as f:
             json.dump({"losses": losses, "world": world}, f)

@@ -773,6 +773,11 @@ def test_kind_lint_record_rides_telemetry_stream(tmp_path,
         assert len(recs) == 1                 # once per program version
         assert recs[0]["warnings"] == 1
         assert recs[0]["codes"] == {"PT201": 1}
+        for r in recs:
+            # serialized lines are rank-stamped (ISSUE 10); the
+            # in-process records stay clean
+            for k in monitor.rank_tag():
+                r.pop(k, None)
         assert monitor.lint_records() == recs
     finally:
         monitor.disable()

@@ -290,3 +290,36 @@ def test_graph_opt_sweep_row_shape():
                   "opcount_10pct_on_3_models", "all_models_allclose",
                   "optimized_lint_clean", "pipeline_idempotent"):
         assert check in src, check
+
+
+# ---------------------------------------------------------------------------
+# fleet_obs_smoke row (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_obs_smoke_in_suite_and_standalone():
+    """The fleet-observability row is wired into the suite AND the
+    standalone argv entry (the straggler/exporter behaviors themselves
+    are covered by tests/test_fleet.py and the 2-process row runs
+    end-to-end under `python bench.py fleet_obs_smoke`; re-running the
+    cluster spawn here would pay the rendezvous twice per CI run for
+    no new signal)."""
+    src = open(bench.__file__).read()
+    assert '("fleet_obs_smoke", "fleet_obs_smoke"' in src
+    assert '"fleet_obs_smoke" in sys.argv[1:]' in src
+    assert "main_fleet_obs_smoke" in src
+
+
+def test_fleet_obs_smoke_row_shape():
+    """The smoke row's check list carries every acceptance pillar:
+    named straggler on both ranks, the ±20% injected-delay bound, the
+    exact wait-fraction recomputation, the scrape==snapshot spot
+    check, the rank-attributed fleet merge, and the exporter-off
+    dispatch guard."""
+    src = open(bench.__file__).read()
+    for check in ("straggler_named_r",      # per-rank, f-string keyed
+                  "behind_within_20pct", "wait_frac_recomputed_exactly",
+                  "scrape_matches_snapshot", "healthz_ok",
+                  "fleet_merge_names_straggler",
+                  "exporter_off_no_regression"):
+        assert check in src, check

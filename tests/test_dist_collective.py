@@ -94,6 +94,33 @@ def test_cluster_collectives_and_dist_vs_local(nproc, tmp_path):
     for i, (d, l) in enumerate(zip(dist_losses, local_losses)):
         assert abs(d - l) <= 1e-3, (i, d, l)
 
+    # --- rank-tagged telemetry merge (ISSUE 10 satellite) --------------
+    # each worker wrote its own JSONL stream into the shared dir with
+    # rank-distinct payloads; the fleet merge must attribute every
+    # record to the rank that wrote it (REAL multi-process stamps, not
+    # the single-process default of 0)
+    sys.path.insert(0, repo)
+    from tools.telemetry_report import fleet_merge, summarize_fleet
+
+    tdir = tmp_path / "telemetry"
+    streams = sorted(os.path.join(tdir, p) for p in os.listdir(tdir))
+    assert len(streams) == nproc, streams
+    by_rank, merged = fleet_merge(streams)
+    assert len(by_rank) == nproc, list(by_rank)
+    for label, records in by_rank.items():
+        steps = [r for r in records if r.get("kind") == "step"]
+        assert steps, label
+        ranks = {r["process_index"] for r in steps}
+        assert len(ranks) == 1, (label, ranks)
+        r = ranks.pop()
+        assert label.endswith(f":p{r}")
+        # the payload the worker wrote for THIS rank, on every record
+        assert all(s["host_dispatch_us"] == 100.0 + r for s in steps)
+        assert all(s["examples"] == 8 * (r + 1) for s in steps)
+    summary = summarize_fleet(by_rank, merged)
+    assert summary["ranks"] == nproc
+    assert set(summary["by_rank"]) == set(by_rank)
+
 
 def test_bad_rank_wiring_fails(tmp_path):
     """Anti-green-on-broken check: a cluster whose PADDLE_TRAINERS_NUM

@@ -215,6 +215,14 @@ def test_jsonl_round_trip_same_snapshot(tmp_path):
     session.record_step(host_dispatch_us=50.0, examples=32,
                         feed_bytes=1024, fetch_bytes=8)
     parsed = read_jsonl(path)
+    # every serialized line is rank-stamped (ISSUE 10) — the stamp is
+    # a superset of the in-process record, never a mutation of it
+    from paddle_tpu.monitor import fleet
+
+    tag = fleet.rank_tag()
+    for r in parsed:
+        for k, v in tag.items():
+            assert r.pop(k) == v
     assert parsed == json.loads(json.dumps(session.records()))
     assert [r["step"] for r in parsed] == [1, 2]
     assert all(r["kind"] == "step" for r in parsed)

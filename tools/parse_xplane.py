@@ -24,9 +24,19 @@ Both formats additionally get a **per-op attribution** section (ISSUE
 are grouped per ProgramDesc op, so a capture answers "which conv in my
 program is eating the step" directly.
 
+Fleet mode (ISSUE 10): ``--fleet <dir>`` merges every per-rank chrome
+trace in a shared directory onto ONE timeline — pids remapped
+rank-major, process rows prefixed ``rank{r}@{host}`` from the
+rank-stamped trace metadata, each trace aligned to its own window
+start (span clocks are per-process perf_counter) — writes
+``<dir>/fleet_merged.trace.json`` (Perfetto-loadable) and prints the
+per-track summary over the merged events.
+
 Usage: python tools/parse_xplane.py <xplane.pb | trace.json> [top_n]
+       python tools/parse_xplane.py --fleet <trace-dir> [top_n]
 """
 import collections
+import glob
 import json
 import os
 import sys
@@ -137,15 +147,23 @@ def main_xplane(path, top_n):
     print_scope_table(spans, top_n)
 
 
-def main_chrome_trace(path, top_n):
-    """The merged host+steps+counters trace from export_chrome_tracing:
-    per-track span aggregates + counter-track summary."""
+def _load_chrome_events(path):
     with open(path) as f:
         doc = json.load(f)
     events = doc.get("traceEvents", doc) if isinstance(doc, dict) else doc
     if not isinstance(events, list):
         raise SystemExit(
             f"{path}: JSON but not a chrome trace (no traceEvents list)")
+    return events
+
+
+def main_chrome_trace(path, top_n):
+    """The merged host+steps+counters trace from export_chrome_tracing:
+    per-track span aggregates + counter-track summary."""
+    summarize_chrome_events(_load_chrome_events(path), top_n)
+
+
+def summarize_chrome_events(events, top_n):
     pid_names, tid_names = {}, {}
     spans = collections.defaultdict(
         lambda: collections.defaultdict(lambda: [0.0, 0]))
@@ -219,6 +237,101 @@ def print_memory_tracks(counters):
               f"mean {mean / 2**20:10.3f} MiB  x{n}")
 
 
+def _trace_rank(events, fallback):
+    """The fleet rank a trace was recorded by, read from the rank-
+    stamped process_name metadata (monitor/trace.py puts {host,
+    process_index} in the args); (fallback, None) for untagged
+    traces so pre-fleet captures still merge."""
+    for e in events:
+        if not isinstance(e, dict) or e.get("ph") != "M" \
+                or e.get("name") != "process_name":
+            continue
+        args = e.get("args") or {}
+        if "process_index" in args:
+            return int(args["process_index"]), args.get("host")
+    return fallback, None
+
+
+# rank-major pid remap stride: above Linux's largest pid_max (2**22)
+# so a foreign trace carrying a real OS pid can never collide with
+# another rank's remapped rows
+_PID_STRIDE = 1 << 23
+
+
+def merge_fleet_traces(paths, events_by_path=None):
+    """Merge N rank-tagged chrome traces onto one timeline with
+    per-rank process rows.  Each trace's span clock is that process's
+    perf_counter — monotonic but not shared — so every trace is
+    aligned to its own earliest event (the common window start); pids
+    are remapped rank-major (rank*_PID_STRIDE + pid) and process names get a
+    "rank{r}@{host}" prefix, so Perfetto shows one process group per
+    rank.  ``events_by_path`` lets a caller that already parsed a
+    trace (the --fleet validity probe) avoid re-reading it."""
+    merged = []
+    ranks = []
+    for i, path in enumerate(sorted(paths)):
+        events = (events_by_path or {}).get(path)
+        if events is None:
+            events = _load_chrome_events(path)
+        rank, host = _trace_rank(events, i)
+        ranks.append(rank)
+        t0 = min((float(e["ts"]) for e in events
+                  if isinstance(e, dict) and "ts" in e), default=0.0)
+        label = f"rank{rank}" + (f"@{host}" if host else "")
+        for e in events:
+            if not isinstance(e, dict):
+                continue
+            e = dict(e)
+            if "pid" in e:
+                # stride must clear any REAL os pid a foreign trace in
+                # the shared dir may carry (pid_max is <= 2**22), not
+                # just paddle's own constant pids 0/1 — a collision
+                # silently overlaps two ranks on one Perfetto row
+                e["pid"] = rank * _PID_STRIDE + int(e["pid"])
+            if "ts" in e:
+                e["ts"] = float(e["ts"]) - t0
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                args = dict(e.get("args") or {})
+                name = args.get("name", "")
+                if not name.startswith("rank"):
+                    args["name"] = f"{label}:{name}"
+                e["args"] = args
+            elif e.get("ph") == "C":
+                # counter tracks are keyed by name within a pid; the
+                # rank prefix keeps per-rank series separable when a
+                # viewer flattens them
+                e = {**e, "name": f"{label}:{e.get('name', '?')}"}
+            merged.append(e)
+    if len(set(ranks)) != len(ranks):
+        print(f"warning: duplicate rank tags across traces {ranks} — "
+              f"rows may overlap", file=sys.stderr)
+    return merged
+
+
+def main_fleet(directory, top_n):
+    """--fleet <dir>: merge every chrome trace in the directory (the
+    per-rank flight dumps / export_chrome_tracing outputs a shared
+    telemetry dir accumulates), write <dir>/fleet_merged.trace.json,
+    and print the per-track summary over the merged timeline."""
+    paths = sorted(
+        p for p in glob.glob(os.path.join(directory, "*.json"))
+        if not p.endswith("fleet_merged.trace.json"))
+    loaded = {}
+    for p in paths:
+        try:
+            loaded[p] = _load_chrome_events(p)
+        except (SystemExit, ValueError, json.JSONDecodeError):
+            continue
+    if not loaded:
+        raise SystemExit(f"no chrome traces (*.json) in {directory}")
+    merged = merge_fleet_traces(sorted(loaded), events_by_path=loaded)
+    out_path = os.path.join(directory, "fleet_merged.trace.json")
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+    print(f"== fleet merge: {len(loaded)} rank traces -> {out_path}")
+    summarize_chrome_events(merged, top_n)
+
+
 def _format_error(path, e):
     return SystemExit(
         f"{path}: not a parseable capture ({type(e).__name__}: {e}).\n"
@@ -231,6 +344,12 @@ def _format_error(path, e):
 def main():
     if len(sys.argv) < 2:
         raise SystemExit(__doc__)
+    if sys.argv[1] == "--fleet":
+        if len(sys.argv) < 3 or not os.path.isdir(sys.argv[2]):
+            raise SystemExit("--fleet wants a directory of per-rank "
+                             "chrome traces")
+        top_n = int(sys.argv[3]) if len(sys.argv) > 3 else 40
+        return main_fleet(sys.argv[2], top_n)
     path = sys.argv[1]
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 40
     with open(path, "rb") as f:

@@ -23,9 +23,23 @@ per-pass wall time per program key, plus the dp gradient-bucketing
 notes — buckets formed, sparse fallbacks) — without touching the
 process that produced the file.
 
+Fleet mode (ISSUE 10): every line a rank writes is stamped with
+``{host, process_index}`` (monitor.fleet.rank_tag), so N per-rank
+streams written into one shared directory stay attributable after the
+fact.  ``--fleet <dir>`` reads every ``*.jsonl`` stream in the
+directory (rotated segments transparently), groups records by their
+rank stamp, and prints per-rank rows (steps, step-time, dispatch)
+next to the merged totals, the newest ``kind="fleet_skew"`` table
+(who was slow, wait fraction, straggler score) and a step-time-delta
+straggler call of its own — so multi-host diagnosis is one command,
+not N log-scrapes.
+
 Usage: python tools/telemetry_report.py <telemetry.jsonl>
+       python tools/telemetry_report.py --fleet <telemetry-dir>
 """
+import glob
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -93,6 +107,9 @@ def summarize(records):
     resil = _resilience_section(steps)
     if resil:
         out["resilience"] = resil
+    skew = _fleet_skew_section(records)
+    if skew:
+        out["fleet_skew"] = skew
     return out
 
 
@@ -322,6 +339,109 @@ def _passes_section(records):
     return out
 
 
+def _fleet_skew_section(records):
+    """Straggler attribution from the newest kind="fleet_skew" record
+    (the rolling table the dp probe builds: per-rank barrier wait /
+    behind-time / wait fraction, and the named straggler)."""
+    # newest by wall_time, not by stream position: a fleet merge
+    # concatenates rank streams, and a crashed rank's stale table must
+    # not shadow the survivors' current one (ties/missing wall_time
+    # keep later-in-stream wins, matching the single-stream reading)
+    latest = None
+    for r in records:
+        if r.get("kind") == "fleet_skew" and r.get("ranks"):
+            if latest is None or ((r.get("wall_time") or 0)
+                                  >= (latest.get("wall_time") or 0)):
+                latest = r
+    if latest is None:
+        return None
+    out = {"steps": latest.get("steps"),
+           "max_skew_us": latest.get("max_skew_us"),
+           "mean_step_time_s": latest.get("mean_step_time_s"),
+           "straggler": latest.get("straggler"),
+           "ranks": [
+               {k: row.get(k) for k in (
+                   "dp_index", "process_index", "wait_us_mean",
+                   "behind_us_mean", "wait_frac", "straggler_score",
+                   "slowest_steps") if row.get(k) is not None}
+               for row in latest["ranks"]]}
+    return out
+
+
+def _rank_label(record):
+    """One stable "host:pN" label per rank stamp; "(untagged)" for
+    pre-fleet streams so old captures still report."""
+    host = record.get("host")
+    pi = record.get("process_index")
+    if host is None and pi is None:
+        return "(untagged)"
+    return f"{host or '?'}:p{pi if pi is not None else '?'}"
+
+
+def fleet_merge(paths):
+    """Read N rank streams (rotated segments transparently) and group
+    their records by rank stamp.  Returns ({label: records}, merged
+    records ordered stream-by-stream)."""
+    by_rank = {}
+    merged = []
+    for path in sorted(paths):
+        for r in read_jsonl(path):
+            by_rank.setdefault(_rank_label(r), []).append(r)
+            merged.append(r)
+    return by_rank, merged
+
+
+def summarize_fleet(by_rank, merged):
+    """The fleet view: per-rank rows + merged totals + the newest skew
+    table + a steady-state step-time-delta straggler call recomputed
+    HERE from the per-rank streams.  The wall-clock call is a weak
+    signal in a barrier-synchronized dp fleet (every rank's step time
+    converges to max-over-ranks), so it drops warmup steps and stays
+    silent unless the spread is significant — the probe's fleet_skew
+    table is the authoritative attribution."""
+    out = {"ranks": len(by_rank)}
+    rows = {}
+    for label, records in sorted(by_rank.items()):
+        s = summarize(records)
+        row = {"records": s["records"], "steps": s["steps"]}
+        if s.get("step_time_ms"):
+            row["step_time_ms"] = s["step_time_ms"]
+        if s.get("host_dispatch_us"):
+            row["host_dispatch_us"] = s["host_dispatch_us"]
+        if s.get("examples_per_sec"):
+            row["examples_per_sec"] = s["examples_per_sec"]
+        rows[label] = row
+    out["by_rank"] = rows
+    # steady-state means: drop each rank's first two steps (compile/
+    # warmup dominates them and lands asymmetrically across ranks),
+    # and only call a straggler when the spread clears noise
+    steady = {}
+    for label, records in sorted(by_rank.items()):
+        times = [r["step_time_s"] for r in records
+                 if r.get("kind") == "step"
+                 and r.get("step_time_s", 0) > 0][2:]
+        if times:
+            steady[label] = round(sum(times) / len(times) * 1e3, 3)
+    if len(steady) >= 2:
+        slow = max(steady, key=steady.get)
+        fast = min(steady, key=steady.get)
+        delta = round(steady[slow] - steady[fast], 3)
+        if delta > 0.2 * steady[fast]:
+            out["step_time_straggler"] = {
+                "rank": slow,
+                "mean_ms": steady[slow],
+                "delta_ms": delta}
+    skew = _fleet_skew_section(merged)
+    if skew:
+        out["fleet_skew"] = skew
+    ooms = [{"rank": _rank_label(r),
+             "error": (r.get("error") or "")[:120]}
+            for r in merged if r.get("kind") == "oom"]
+    if ooms:
+        out["oom_events"] = ooms
+    return out
+
+
 def _resilience_section(steps):
     """Recovery events over the run: the final sampled values of the
     resilience.* counters (cumulative since monitor enable — the last
@@ -335,10 +455,28 @@ def _resilience_section(steps):
 
 
 def main():
-    if len(sys.argv) < 2:
+    args = sys.argv[1:]
+    if not args:
         raise SystemExit(__doc__)
-    records = read_jsonl(sys.argv[1])
-    summary = summarize(records)
+    if args[0] == "--fleet":
+        if len(args) < 2 or not os.path.isdir(args[1]):
+            raise SystemExit("--fleet wants a directory of per-rank "
+                             "*.jsonl streams")
+        # rotated segments (<stream>.jsonl.K) count as their base
+        # stream: a rank whose active segment was just rotated away
+        # must not vanish from the merge (read_jsonl reads segments
+        # transparently from the base path even when it is absent)
+        paths = sorted(
+            {re.sub(r"\.\d+$", "", p) for p in
+             glob.glob(os.path.join(args[1], "*.jsonl")) +
+             glob.glob(os.path.join(args[1], "*.jsonl.[0-9]*"))})
+        if not paths:
+            raise SystemExit(f"no *.jsonl streams in {args[1]}")
+        by_rank, merged = fleet_merge(paths)
+        summary = summarize_fleet(by_rank, merged)
+    else:
+        records = read_jsonl(args[0])
+        summary = summarize(records)
     width = max(len(k) for k in summary)
     for k, v in summary.items():
         print(f"{k:<{width}}  {v}")
