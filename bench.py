@@ -2545,6 +2545,224 @@ def main_fleet_obs_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_elastic_fleet_smoke(on_tpu, peak):
+    """Elastic-fleet chaos row (ISSUE 11 CI satellite): a REAL
+    2-process CPU-mesh dp train (tests/dist_worker_elastic.py) where
+    rank 1 is KILLED mid-run at a deterministic step boundary
+    (InjectedCrash at ``elastic.step_boundary`` — a SIGKILL between
+    steps), asserting the full recovery arc:
+
+    - the survivor's bounded boundary sync declares the rank dead,
+      force-saves, reshards 2→1 IN PROCESS (restore_resharded onto its
+      local mesh + retarget_dp) and keeps training on the full global
+      batch;
+    - its /healthz answers 503 with reason=elastic_transition while
+      the transition is in flight, 200 after commit;
+    - at a scheduled boundary a join intent for a fresh rank surfaces:
+      the fleet grows 1→2 via force-save + committed topology +
+      relaunch, and the relaunched pair resumes from the rendezvous
+      checkpoint — the re-admit path;
+    - final params are BITWISE-identical to an uninterrupted reference
+      run with the SAME topology schedule (2 procs → 1 proc → 2 procs
+      at the same boundaries, no kill, no elastic machinery): the
+      recovery introduced zero numeric drift and advanced the data
+      cursor exactly (dp math is shard-count-dependent, so an
+      uninterrupted run must change worlds at the same steps for
+      bitwise to be meaningful — the KILL and its recovery are the
+      only difference under test);
+    - every ``resilience.elastic_*`` counter fired, and the merged
+      rank-tagged telemetry's topology history names both transitions
+      (telemetry_report --fleet).
+    """
+    import tempfile
+
+    from paddle_tpu.distributed.launch import start_procs
+    from paddle_tpu.resilience.elastic import request_join
+
+    total, kill_at, grow_at, batch = 12, 4, 8, 8
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "tests", "dist_worker_elastic.py")
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_elastic_")
+    env_extra = {"PYTHONPATH": repo + os.pathsep
+                 + os.environ.get("PYTHONPATH", ""),
+                 "PADDLE_RENDEZVOUS_TIMEOUT": "60"}
+
+    def run_phase(run, phase, nproc, start, end, elastic,
+                  expect_rc=None, timeout=180):
+        out_dir = os.path.join(tmp, run)
+        cfg = {"phase": phase, "ckpt_dir": os.path.join(tmp, f"ck_{run}"),
+               "out_dir": out_dir, "total_steps": total,
+               "kill_at": kill_at, "grow_at": grow_at, "batch": batch,
+               "start_step": start, "end_step": end, "elastic": elastic,
+               "peer_timeout_s": 8.0,
+               "report": os.path.join(out_dir, "report")}
+        os.makedirs(out_dir, exist_ok=True)
+        cpath = os.path.join(out_dir, f"cfg_{phase}.json")
+        with open(cpath, "w") as f:
+            json.dump(cfg, f)
+        procs, logs = start_procs(
+            node_ips=["127.0.0.1"], node_ip="127.0.0.1",
+            nproc_per_node=nproc, training_script=worker,
+            script_args=(cpath,),
+            log_dir=os.path.join(out_dir, f"logs_{phase}"),
+            env_extra=env_extra)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.3)
+        else:
+            for p in procs:
+                p.kill()
+        for f in logs:
+            f.close()
+        rcs = [p.poll() for p in procs]
+        want = expect_rc if expect_rc is not None else [0] * nproc
+        ok = all(r is not None and ((r == 0) == (w == 0))
+                 for r, w in zip(rcs, want))
+        reports = {}
+        for r in range(nproc):
+            rp = f"{cfg['report']}.{phase}.r{r}"
+            if os.path.isfile(rp):
+                with open(rp) as f:
+                    reports[r] = json.load(f)
+        return ok, rcs, reports, cfg
+
+    checks = {}
+
+    # ---- chaos run: kill at kill_at, rejoin at grow_at -------------
+    request_join(os.path.join(tmp, "ck_chaos"), 1, after_step=grow_at)
+    ok_a, rcs_a, rep_a, _ = run_phase("chaos", "chaos_a", 2, 0, total,
+                                      True, expect_rc=[0, 1])
+    r0a = rep_a.get(0) or {}
+    checks["chaos_a_procs"] = ok_a and 0 in rep_a
+    checks["kill_fired"] = (rcs_a[1] not in (0, None)
+                            and 1 not in rep_a)
+    evs = r0a.get("events") or []
+    death = next((e for e in evs if e["kind"] == "rank_death"), None)
+    checks["rank_death_named"] = (death is not None
+                                  and death["ranks"] == [1]
+                                  and death["step"] == kill_at)
+    checks["shrunk_at_kill"] = r0a.get("shrunk_at") == kill_at
+    h = r0a.get("health") or {}
+    checks["healthz_503_during_transition"] = (
+        (h.get("during") or {}).get("status") == 503
+        and (h.get("during") or {}).get("reason") == "elastic_transition")
+    checks["healthz_ok_after_commit"] = (
+        (h.get("after") or {}).get("status") == 200
+        and (h.get("after") or {}).get("ok") is True)
+    checks["grow_relaunch"] = (r0a.get("exit_action") == "relaunch"
+                               and r0a.get("steps_done") == grow_at
+                               and r0a.get("ckpt_latest") == grow_at)
+    ca = r0a.get("counters") or {}
+    checks["elastic_counters"] = (
+        ca.get("resilience.elastic_transitions") == 2
+        and ca.get("resilience.elastic_shrinks") == 1
+        and ca.get("resilience.elastic_grows") == 1
+        and ca.get("resilience.elastic_rank_deaths", 0) >= 1
+        and ca.get("resilience.elastic_reshards") == 1
+        and ca.get("resilience.elastic_rank_joins") == 1)
+    checks["process_count_gauge"] = (
+        (r0a.get("gauges") or {}).get("fleet.process_count") == 2)
+
+    ok_b, _, rep_b, _ = run_phase("chaos", "chaos_b", 2, grow_at, total,
+                                  True)
+    r0b = rep_b.get(0) or {}
+    checks["chaos_b_procs"] = ok_b and 0 in rep_b
+    checks["rejoin_resumed"] = (
+        r0b.get("restored_step") == grow_at
+        and (r0b.get("counters") or {})
+        .get("resilience.elastic_resumes") == 1
+        and r0b.get("steps_done") == total)
+    topo = r0b.get("restored_topology") or {}
+    checks["topology_provenance"] = topo.get("world") == 1
+
+    # ---- clean reference: same topology schedule, no kill ----------
+    ok_c1, _, rep_c1, _ = run_phase("clean", "clean_a", 2, 0, kill_at,
+                                    False)
+    ok_c2, _, rep_c2, _ = run_phase("clean", "clean_b", 1, kill_at,
+                                    grow_at, False)
+    ok_c3, _, rep_c3, _ = run_phase("clean", "clean_c", 2, grow_at,
+                                    total, False)
+    checks["clean_reference_ran"] = ok_c1 and ok_c2 and ok_c3
+    final_chaos = r0b.get("final_params")
+    final_clean = (rep_c3.get(0) or {}).get("final_params")
+    checks["params_bitwise_identical"] = (
+        final_chaos is not None and final_clean is not None
+        and set(final_chaos) == set(final_clean)
+        and all(np.array_equal(np.asarray(final_chaos[n]),
+                               np.asarray(final_clean[n]))
+                for n in final_chaos))
+    # the loss streams must line up leg by leg too (same batches, same
+    # worlds): chaos legs A(0..kill)+shrunken(kill..grow)+B(grow..end)
+    # vs clean legs a+b+c
+    chaos_losses = (r0a.get("losses") or []) + (r0b.get("losses") or [])
+    clean_losses = ((rep_c1.get(0) or {}).get("losses") or []) + \
+        ((rep_c2.get(0) or {}).get("losses") or []) + \
+        ((rep_c3.get(0) or {}).get("losses") or [])
+    checks["loss_stream_identical"] = (
+        len(chaos_losses) == total == len(clean_losses)
+        and chaos_losses == clean_losses)
+
+    # ---- topology history in the merged fleet report ---------------
+    import sys
+
+    sys.path.insert(0, repo)
+    from tools.telemetry_report import fleet_merge, summarize_fleet
+
+    tdir = os.path.join(tmp, "chaos", "telemetry")
+    streams = sorted(os.path.join(tdir, p) for p in os.listdir(tdir)
+                     if p.endswith(".jsonl"))
+    by_rank, merged = fleet_merge(streams)
+    fsum = summarize_fleet(by_rank, merged)
+    hist = (fsum.get("elastic_topology") or {})
+    trans = hist.get("transitions") or []
+    checks["topology_history_reported"] = (
+        len(trans) == 2
+        and trans[0].get("transition") == "shrink"
+        and trans[0].get("to_world") == 1
+        and trans[1].get("transition") == "grow"
+        and trans[1].get("to_world") == 2)
+
+    checks = {k: bool(v) for k, v in checks.items()}
+    details = {"events": evs, "counters": ca,
+               "transitions": trans,
+               "chaos_losses": chaos_losses[:4]}
+    row = {"metric": "elastic_fleet_smoke",
+           "value": int(all(checks.values())), "unit": "ok",
+           "vs_baseline": None, "total_steps": total,
+           "kill_at": kill_at, "grow_at": grow_at,
+           "checks": checks, "topology_history": trans,
+           "details": details}
+    if not all(checks.values()):
+        row["error"] = "failed checks: " + ", ".join(
+            k for k, v in checks.items() if not v)
+    return row
+
+
+def main_elastic_fleet_smoke():
+    """`python bench.py elastic_fleet_smoke` — CI/tooling entry: the
+    kill/reshard/rejoin chaos row standalone, persisted to
+    BENCH_TPU.json under rows["elastic_fleet_smoke"].  Exit 0 only
+    when every recovery check passes."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_elastic_fleet_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["elastic_fleet_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def main_serving_smoke():
     """`python bench.py serving_smoke` — CI/tooling entry: the serving
     chaos row standalone on a 2-device virtual CPU mesh, persisted to
@@ -2760,6 +2978,8 @@ def main():
          bench_program_lint_smoke),
         ("graph_opt_sweep", "graph_opt_sweep", bench_graph_opt_sweep),
         ("fleet_obs_smoke", "fleet_obs_smoke", bench_fleet_obs_smoke),
+        ("elastic_fleet_smoke", "elastic_fleet_smoke",
+         bench_elastic_fleet_smoke),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
 
     # SIGALRM only interrupts Python bytecode: a compile/RPC wedged
@@ -2840,4 +3060,6 @@ if __name__ == "__main__":
         sys.exit(main_graph_opt_sweep())
     if "fleet_obs_smoke" in sys.argv[1:]:
         sys.exit(main_fleet_obs_smoke())
+    if "elastic_fleet_smoke" in sys.argv[1:]:
+        sys.exit(main_elastic_fleet_smoke())
     main()

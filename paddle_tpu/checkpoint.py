@@ -50,11 +50,13 @@ except Exception:  # pragma: no cover
     _HAS_ORBAX = False
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_step",
-           "load_extras", "CheckpointManager"]
+           "load_extras", "load_topology", "restore_resharded",
+           "resharded_cursor", "CheckpointManager"]
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 _MARKER = "_COMPLETE"
 _MANIFEST = "_MANIFEST.json"
+_TOPOLOGY = "_TOPOLOGY.json"
 
 _checkpointer = None
 
@@ -216,8 +218,35 @@ def latest_step(directory):
     return None
 
 
+def _current_topology():
+    """Best-effort fleet shape at save time: the launcher env contract
+    plus jax's own process/device counts once the backend is up (read
+    through monitor.fleet.rank_info, which never initializes it).  This
+    is the provenance restore_resharded and the elastic runtime read
+    back — a checkpoint knows what world wrote it."""
+    try:
+        from .monitor import fleet
+
+        info = fleet.rank_info()
+        topo = {"process_count": info.get("process_count"),
+                "process_index": info.get("process_index"),
+                "host": info.get("host")}
+        if info.get("local_device_ids") is not None:
+            topo["local_device_count"] = len(info["local_device_ids"])
+        try:
+            from jax._src import xla_bridge as xb
+
+            if xb.backends_are_initialized():
+                topo["device_count"] = int(jax.device_count())
+        except Exception:
+            pass
+        return topo
+    except Exception:
+        return {}
+
+
 def save_checkpoint(directory, state, step, sparse_tables=None,
-                    extras=None):
+                    extras=None, topology=None, writer=None):
     """Write `state` (any pytree of jax/np arrays) at `step`.
 
     sparse_tables: optional {name: SparseEmbedding} — exported host-side
@@ -230,22 +259,69 @@ def save_checkpoint(directory, state, step, sparse_tables=None,
     checkpoints its PRNG root key here, which is what makes a rollback
     replay of a stochastic (dropout) program bitwise-identical to the
     uninterrupted run.
+
+    topology: optional dict merged over the auto-captured fleet shape
+    (process/device counts) written as a `_TOPOLOGY.json` sidecar — the
+    provenance `restore_resharded` and the elastic coordinator read
+    back (`load_topology`).  Covered by the checksum manifest like any
+    payload file.
+
+    writer: "orbax" (default when available) or "npz".  The npz writer
+    is COLLECTIVE-FREE: orbax's save runs a cross-process sync barrier
+    in a multi-process jax world, which (a) desynchronizes single-
+    writer saves against peers' training collectives and (b) can never
+    complete once a peer is dead — exactly the moment the elastic
+    runtime force-saves.  Elastic stores therefore use writer="npz"
+    with host-replicated snapshots; the loaders auto-detect the format
+    per checkpoint, so the two writers can share one directory.
     """
     t0 = time.perf_counter()
     path = _step_path(directory, step)
     if os.path.isdir(path):  # overwrite an old/incomplete attempt
         shutil.rmtree(path)
         _verify_memo.pop(path, None)
-    if _HAS_ORBAX:
+    if writer is None:
+        writer = "orbax" if _HAS_ORBAX else "npz"
+    if writer == "orbax":
         ckptr = _ckptr()
         ckptr.save(os.path.join(path, "state"), state, force=True)
         ckptr.wait_until_finished()
-    else:  # pragma: no cover
+    elif writer == "npz":
         os.makedirs(os.path.join(path, "state"), exist_ok=True)
-        flat, _ = jax.tree.flatten_with_path(state)
-        np.savez(os.path.join(path, "state", "arrays.npz"),
-                 **{jax.tree_util.keystr(k): np.asarray(v)
-                    for k, v in flat})
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        arrays = {}
+        for k, v in flat:
+            if hasattr(v, "addressable_data"):
+                if getattr(v, "is_fully_replicated", True):
+                    # a replicated global array's shard 0 IS the value
+                    # — np.asarray on a non-fully-addressable array
+                    # would raise
+                    v = v.addressable_data(0)
+                elif getattr(v, "is_fully_addressable", False):
+                    # sharded but local (single-process mesh):
+                    # np.asarray gathers the shards on host
+                    pass
+                else:
+                    # shard 0 of a cross-process SHARDED array is NOT
+                    # the array; silently writing it would produce a
+                    # checkpoint whose corruption only surfaces at
+                    # restore time — after the other shards' owners
+                    # may be dead.  The collective-free writer cannot
+                    # gather them; refuse loudly at save time.
+                    raise ValueError(
+                        f"npz checkpoint writer: leaf "
+                        f"{jax.tree_util.keystr(k)} is sharded across "
+                        f"processes ({v.sharding}); the collective-"
+                        f"free writer only handles replicated or "
+                        f"locally-addressable arrays — pass a host "
+                        f"snapshot or use the orbax writer")
+            arrays[jax.tree_util.keystr(k)] = np.asarray(v)
+        tmp = os.path.join(path, "state", "arrays.npz.tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, os.path.join(path, "state", "arrays.npz"))
+    else:
+        raise ValueError(f"unknown checkpoint writer {writer!r}")
     if sparse_tables:
         os.makedirs(path, exist_ok=True)
         payload = {}
@@ -258,6 +334,15 @@ def save_checkpoint(directory, state, step, sparse_tables=None,
         os.makedirs(path, exist_ok=True)
         np.savez(os.path.join(path, "extras.npz"),
                  **{k: np.asarray(v) for k, v in extras.items()})
+    # topology provenance: what fleet shape wrote this checkpoint.
+    # Written BEFORE the manifest so its bytes are checksum-covered.
+    topo = _current_topology()
+    topo.update(topology or {})
+    topo["step"] = step
+    topo["wall_time"] = time.time()
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, _TOPOLOGY), "w") as f:
+        json.dump(topo, f)
     # the crash window under test: arrays are on disk, the marker is
     # not — a kill here must leave the PREVIOUS checkpoint as the
     # resume point (resilience.faultinject fires InjectedCrash here
@@ -291,13 +376,14 @@ def load_checkpoint(directory, template_state, step=None,
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     path = _step_path(directory, step)
-    if _HAS_ORBAX:
+    npz = os.path.join(path, "state", "arrays.npz")
+    if _HAS_ORBAX and not os.path.isfile(npz):
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
                                 template_state)
         state = _ckptr().restore(os.path.join(path, "state"), abstract)
-    else:  # pragma: no cover
-        data = np.load(os.path.join(path, "state", "arrays.npz"))
-        flat, treedef = jax.tree.flatten_with_path(template_state)
+    else:
+        data = np.load(npz)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template_state)
         leaves = [data[jax.tree_util.keystr(k)] for k, _ in flat]
         state = jax.tree.unflatten(treedef, leaves)
         state = jax.tree.map(
@@ -330,31 +416,161 @@ def load_extras(directory, step=None):
         return {k: npz[k] for k in npz.files}
 
 
+def load_topology(directory, step=None):
+    """The `_TOPOLOGY.json` provenance of checkpoint `step` (default:
+    latest complete): what fleet shape (process/device counts) wrote
+    it.  None for pre-topology checkpoints."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    p = os.path.join(_step_path(directory, step), _TOPOLOGY)
+    if not os.path.isfile(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def resharded_cursor(step, old_world=None, new_world=None,
+                     preserve_global_batch=True):
+    """The data cursor (consumed GLOBAL batches) after restoring
+    checkpoint `step` onto a different world size.
+
+    The checkpoint counts steps in global batches.  When the global
+    batch is PRESERVED across the reshard (each survivor feeds a larger
+    slice — the bitwise-identical-math mode), one step still consumes
+    one global batch and the cursor is unchanged.  When the PER-RANK
+    batch is preserved instead (the global batch scales with the
+    world), each old step consumed `old_world` rank-batches, so the
+    resumed loop's cursor in NEW global batches is
+    ``step * old_world // new_world`` (floor: a partial new-batch is
+    re-consumed rather than skipped — never silently drop data)."""
+    step = int(step)
+    if preserve_global_batch:
+        return step
+    if not old_world or not new_world:
+        raise ValueError("per-rank-batch cursor scaling needs old_world "
+                         "and new_world")
+    return (step * int(old_world)) // int(new_world)
+
+
+def restore_resharded(directory, template_state, mesh=None, step=None,
+                      sparse_tables=None):
+    """Restore checkpoint `step` (default: newest COMPLETE — a
+    truncated/corrupt newest dir is skipped by latest_step's checksum
+    pass, falling back to the previous complete step) onto a DIFFERENT
+    topology than the one that saved it.
+
+    Unlike load_checkpoint, the template is used for STRUCTURE ONLY
+    (shape/dtype — its leaves are never materialized, so a template
+    holding arrays committed to a dead mesh is safe); arrays are
+    restored to host and re-placed REPLICATED on `mesh` (or returned as
+    host arrays when mesh is None, for callers doing their own
+    placement).  Replication is what makes the reshard bitwise-exact:
+    every device of the new mesh sees the identical bytes the old
+    world saved, whatever either world's shape.
+
+    Returns (state, step).  Counted as `resilience.elastic_reshards`
+    next to the ordinary restore counters."""
+    t0 = time.perf_counter()
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = _step_path(directory, step)
+
+    def _abstract(v):
+        # metadata-only: np.shape/.dtype never touch device buffers, so
+        # a template leaf living on an unreachable mesh cannot hang us
+        dt = getattr(v, "dtype", None)
+        if dt is None:
+            dt = np.asarray(v).dtype
+        return np.empty(np.shape(v), dt)
+
+    npz = os.path.join(path, "state", "arrays.npz")
+    if _HAS_ORBAX and not os.path.isfile(npz):
+        # numpy-template restore: orbax reads the bytes WITHOUT
+        # consulting the saved sharding file, which references the
+        # WRITER's (possibly no longer constructible) mesh
+        abstract = jax.tree.map(_abstract, template_state)
+        state = _ckptr().restore(os.path.join(path, "state"), abstract)
+    else:
+        data = np.load(npz)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template_state)
+        state = jax.tree.unflatten(
+            treedef, [data[jax.tree_util.keystr(k)] for k, _ in flat])
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        multiproc = len({getattr(d, "process_index", 0)
+                         for d in mesh.devices.flat}) > 1
+        if multiproc:
+            # every process restored identical bytes from the shared
+            # store; each contributes its full copy of the replica
+            state = jax.tree.map(
+                lambda v: jax.make_array_from_process_local_data(
+                    rep, np.asarray(v)), state)
+        else:
+            state = jax.tree.map(lambda v: jax.device_put(v, rep), state)
+    if sparse_tables:
+        npz = np.load(os.path.join(path, "sparse_tables.npz"))
+        for name, table in sparse_tables.items():
+            table.load_state_dict({"ids": npz[f"{name}.ids"],
+                                   "values": npz[f"{name}.values"]})
+    mon = _mon()
+    mon.counter("resilience.elastic_reshards").add(1)
+    if mon.is_enabled():
+        mon.counter("resilience.checkpoint_restores").add(1)
+        mon.gauge("resilience.last_restore_s").set(
+            round(time.perf_counter() - t0, 4))
+    try:
+        from .monitor import flight_recorder
+
+        flight_recorder.note_event(
+            "elastic_reshard", step=step,
+            mesh_shape=(None if mesh is None
+                        else list(np.shape(mesh.devices))))
+    except Exception:
+        pass
+    return state, step
+
+
 class CheckpointManager:
     """Keep-last-N rolling checkpoints with save_interval gating
     (fleet_util save-model cadence parity, minus HDFS)."""
 
-    def __init__(self, directory, max_to_keep=3, save_interval_steps=1):
+    def __init__(self, directory, max_to_keep=3, save_interval_steps=1,
+                 writer=None):
+        """writer: None (orbax when available) or "npz" — the
+        collective-free writer elastic fleet stores need (a survivor
+        force-saving after a peer died cannot join orbax's cross-
+        process sync barrier).  Loaders auto-detect per checkpoint."""
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         self.save_interval_steps = save_interval_steps
+        self.writer = writer
 
     def should_save(self, step):
         return step % self.save_interval_steps == 0
 
     def save(self, state, step, sparse_tables=None, force=False,
-             extras=None):
+             extras=None, topology=None):
         """Checkpoint if `step` is on the save interval (or force=True).
         Returns the path, or None when gated off."""
         if not force and not self.should_save(step):
             return None
         path = save_checkpoint(self.directory, state, step, sparse_tables,
-                               extras=extras)
+                               extras=extras, topology=topology,
+                               writer=self.writer)
         self._gc()
         return path
 
     def load_extras(self, step=None):
         return load_extras(self.directory, step)
+
+    def load_topology(self, step=None):
+        return load_topology(self.directory, step)
 
     def latest_step(self):
         return latest_step(self.directory)
@@ -362,6 +578,16 @@ class CheckpointManager:
     def restore_latest(self, template_state, sparse_tables=None):
         return load_checkpoint(self.directory, template_state,
                                sparse_tables=sparse_tables)
+
+    def restore_resharded(self, template_state, mesh=None, step=None,
+                          sparse_tables=None):
+        """Topology-change restore (ISSUE 11): bring the newest
+        complete checkpoint — whatever world size saved it — up
+        REPLICATED on `mesh` (or as host arrays when mesh is None).
+        See module-level restore_resharded."""
+        return restore_resharded(self.directory, template_state,
+                                 mesh=mesh, step=step,
+                                 sparse_tables=sparse_tables)
 
     def _gc(self):
         """Rolling retention PLUS orphan cleanup: crashed save

@@ -111,6 +111,7 @@ class CompiledProgram:
         self._precision = None
         self._telemetry_label = None
         self._dp_mesh_cache = None   # (ndev, Mesh) — see _dp_mesh
+        self._dp_key_cache = None    # (Mesh, key) — see _dp_mesh_key
 
     def with_precision(self, precision):
         """Pin the matmul/conv precision this program compiles with
@@ -133,13 +134,29 @@ class CompiledProgram:
                            exec_strategy=None, share_vars_from=None,
                            places=None):
         """compiler.py:296 parity. places defaults to every local device;
-        pass an int to cap the dp width (or a list of Places)."""
+        pass an int to cap the dp width, a list of Places, or a list of
+        jax Devices (the elastic runtime retargets a survivor onto
+        exactly its local devices this way)."""
         self._is_data_parallel = True
         self._loss_name = loss_name
         if build_strategy is not None:
             self._build_strategy = build_strategy
         self._exec_strategy = exec_strategy
         self._dp_places = places
+        self._dp_mesh_cache = None
+        return self
+
+    def retarget_dp(self, places):
+        """Elastic hook (ISSUE 11): re-point the dp mesh at a new
+        device set after a topology change — same contract as the
+        places= of with_data_parallel, but callable mid-run.  The mesh
+        memo is invalidated here; the executor's compiled-step cache
+        keys on the mesh's device identity, so the next run retraces
+        on the new world instead of serving the stale executable."""
+        if not self._is_data_parallel:
+            raise ValueError("retarget_dp needs with_data_parallel first")
+        self._dp_places = places
+        self._dp_mesh_cache = None
         return self
 
     # -- executor integration -------------------------------------------
@@ -160,20 +177,50 @@ class CompiledProgram:
         """Mesh over the dp devices, memoized per device count: the
         executor asks for it on EVERY run, and rebuilding a Mesh per
         step is host dispatch overhead (plus a fresh object identity
-        for jit to hash).  Invalidates itself if with_data_parallel
-        re-targets a different number of places."""
+        for jit to hash).  Invalidates itself if with_data_parallel /
+        retarget_dp re-targets a different place set.
+
+        places as a list of jax Devices pins the mesh to EXACTLY those
+        devices (the elastic shrink path: a survivor's local devices
+        only, so no collective can touch a dead peer's channel);
+        otherwise the first `n` global devices as before."""
         import jax
         from jax.sharding import Mesh
 
-        n = self._dp_device_count()
+        places = self._dp_places
+        explicit = (isinstance(places, (list, tuple)) and places
+                    and all(hasattr(p, "id") and hasattr(p, "platform")
+                            for p in places))
+        if explicit:
+            devs = list(places)
+            n = len(devs)
+        else:
+            n = self._dp_device_count()
+            devs = None
         cached = self._dp_mesh_cache
         if cached is not None and cached[0] == n:
             return cached[1]
-        devs = np.array(jax.devices()[:n])
-        mesh = Mesh(devs, ("dp",))
+        if devs is None:
+            devs = jax.devices()[:n]
+        mesh = Mesh(np.array(devs), ("dp",))
         self._dp_mesh_cache = (n, mesh)
         from .. import monitor
 
         if monitor.is_enabled():
             monitor.gauge("dp_devices").set(n)
         return mesh
+
+    def _dp_mesh_key(self):
+        """Device-identity cache key of the current dp mesh: (shape,
+        sorted device ids).  Memoized with the mesh itself, so the
+        executor's per-dispatch key build stays O(1) — and a
+        retarget_dp onto a SAME-SIZED different device set still
+        retraces instead of serving the old world's executable."""
+        mesh = self._dp_mesh()
+        cached = self._dp_key_cache
+        if cached is not None and cached[0] is mesh:
+            return cached[1]
+        key = (mesh.shape_tuple,
+               tuple(sorted(int(d.id) for d in mesh.devices.flat)))
+        self._dp_key_cache = (mesh, key)
+        return key

@@ -828,11 +828,17 @@ class Executor:
         t0 = time.perf_counter_ns()
         # CompiledProgram / parallel wrapper support
         dp_mesh = None
+        dp_key = None
         precision = resolve_precision(program)
         telemetry_key = getattr(program, "_telemetry_label", None)
         if hasattr(program, "_get_executable_program"):
             if getattr(program, "_is_data_parallel", False):
                 dp_mesh = program._dp_mesh()
+                # device-IDENTITY key (memoized with the mesh): an
+                # elastic retarget_dp onto a same-sized different
+                # device set must retrace, not reuse the dead world's
+                # executable
+                dp_key = program._dp_mesh_key()
             program = program._get_executable_program()
         if telemetry_key is None:
             telemetry_key = getattr(program, "_telemetry_label", None)
@@ -1031,7 +1037,8 @@ class Executor:
 
             key = (id(program), plan.version, feed_sig, tuple(fetch_names),
                    state_names,
-                   None if dp_mesh is None else dp_mesh.shape_tuple,
+                   (dp_key or dp_mesh.shape_tuple)
+                   if dp_mesh is not None else None,
                    precision, guard_on,
                    # the grad-sync bucket capacity is read at TRACE
                    # time (transpiler.collective.sync_gradients), so a
@@ -1374,7 +1381,7 @@ class Executor:
                            fetch_info=None, print_period=100,
                            sparse_config=None, _sparse_push=True,
                            prefetch=None, checkpoint=None,
-                           auto_resume=False):
+                           auto_resume=False, elastic=None):
         """Dataset-driven training loop — the industrial CTR path.
 
         Parity: /root/reference/python/paddle/fluid/executor.py:1187
@@ -1417,6 +1424,18 @@ class Executor:
         training and skip the already-consumed batches, so a re-launch
         of the SAME command continues the run (trainer-restart parity).
 
+        elastic: an resilience.ElasticCoordinator (ISSUE 11) — its
+        step_boundary hook runs before every dispatch: heartbeat +
+        bounded peer sync + leave/join intents + the skew policy.  A
+        topology event force-saves at THIS boundary and raises
+        TopologyChanged (action "reshard_local"/"relaunch") so the
+        caller rebuilds on the new world and resumes from the shared
+        checkpoint; a drain (SIGUSR1) or preemption under the
+        coordinator additionally posts a leave intent so survivors
+        shrink without waiting out the dead-peer timeout.  The
+        coordinator's manager doubles as checkpoint= when none is
+        passed.
+
         Returns the list of final-batch fetch values (or None, like the
         reference, when fetch_list is empty).
         """
@@ -1452,6 +1471,19 @@ class Executor:
                 raise TypeError(
                     f"checkpoint= wants a CheckpointManager, path, or "
                     f"kwargs dict, got {type(checkpoint).__name__}")
+        if elastic is not None:
+            # the coordinator's manager IS the fleet's shared store:
+            # the force-saves its transitions take and the loop's
+            # interval saves must land in one place, or the shrink
+            # path resumes from the wrong history
+            if mgr is None:
+                mgr = elastic.manager
+            elif mgr is not elastic.manager:
+                raise ValueError(
+                    "checkpoint= and the elastic coordinator's manager "
+                    "are different CheckpointManagers; pass the same "
+                    "one so topology transitions and interval saves "
+                    "share a store")
         ckpt_scope = scope if scope is not None else _global_scope
         persist_names = sorted(v.name for v in real_prog.list_vars()
                                if v.persistable)
@@ -1665,7 +1697,68 @@ class Executor:
         last = None
         step_i = start_step
         replay = []          # [(step_no, feed, fl)] since the last save
+
+        def _elastic_rethrow(e):
+            # a preemption-shaped dispatch failure (dead peer, lost
+            # heartbeat, reset transport) under the coordinator is a
+            # TOPOLOGY event, not a retryable blip: the state of this
+            # step may be consumed (donated buffers), so the catcher
+            # reshards from the newest complete checkpoint and replays
+            # its cursor — no force-save here
+            if elastic is None:
+                return
+            ev = elastic.on_dispatch_error(e, step=step_i)
+            if ev is None:
+                return
+            survivors = [m for m in elastic.members
+                         if m not in ev["ranks"]]
+            action = ("reshard_local"
+                      if survivors == [elastic.rank] else "relaunch")
+            from ..resilience.elastic import TopologyChanged
+
+            raise TopologyChanged(step_i, ev, action) from e
+
         for feed, fl, batch_ids in prepared_batches():
+            if elastic is not None:
+                ev = elastic.step_boundary(step_i)
+                if ev is not None:
+                    kind = ev["kind"]
+                    if kind == "self_leave" and ev.get("reason") == \
+                            "drain":
+                        # SIGUSR1 drain-and-leave: durable boundary
+                        # state, leave intent already posted, exit
+                        # cleanly and stay re-admittable
+                        elastic.force_save(_ckpt_state(), step_i,
+                                           extras=_ckpt_extras())
+                        if mon.is_enabled():
+                            mon.counter(
+                                "resilience.elastic_drain_exits").add(1)
+                        break
+                    if kind == "rank_join":
+                        # grow force-saves the rendezvous checkpoint,
+                        # commits the enlarged topology, and raises
+                        # TopologyChanged(action="relaunch")
+                        elastic.grow(step_i, ev["ranks"],
+                                     save_state=_ckpt_state(),
+                                     extras=_ckpt_extras())
+                    if kind in ("rank_leave", "rank_death", "evict"):
+                        # survivors force-save at THIS boundary; the
+                        # caller drives the shrink (reshard in process
+                        # or orchestrator relaunch) from the durable
+                        # state — the loop's compiled world is stale
+                        elastic.force_save(_ckpt_state(), step_i,
+                                           extras=_ckpt_extras())
+                        survivors = [m for m in elastic.members
+                                     if m not in ev["ranks"]]
+                        action = ("reshard_local"
+                                  if survivors == [elastic.rank]
+                                  else "relaunch")
+                        from ..resilience.elastic import TopologyChanged
+
+                        raise TopologyChanged(step_i, ev, action)
+                    # kind == "self_leave"/"preempt": fall through to
+                    # the preemption block below, which force-saves,
+                    # clears the flag, and exits
             if res.preemption_requested():
                 # preemption-safe exit: force-checkpoint at this STEP
                 # BOUNDARY (never mid-step) and leave the loop cleanly;
@@ -1689,8 +1782,14 @@ class Executor:
                     warnings.warn(
                         "preemption requested but this train_from_"
                         "dataset has no checkpoint= store; stopping "
-                        "WITHOUT saving.  The flag stays set for an "
-                        "enclosing checkpointed loop — call "
+                        "WITHOUT saving.  Pass checkpoint=<dir|"
+                        "CheckpointManager> (with auto_resume=True to "
+                        "continue on relaunch) to make this exit "
+                        "durable; for a fleet leave that peers should "
+                        "shrink around, install PreemptionHandler("
+                        "drain_signal=signal.SIGUSR1) under an "
+                        "ElasticCoordinator instead.  The flag stays "
+                        "set for an enclosing checkpointed loop — call "
                         "resilience.clear_preemption() if none exists.",
                         RuntimeWarning, stacklevel=2)
                 if mgr is not None:
@@ -1702,7 +1801,10 @@ class Executor:
                         # the very scenario this path serves — would
                         # lose the only fresh restore point
                         mgr.save(_ckpt_state(), step_i, force=True,
-                                 extras=_ckpt_extras())
+                                 extras=_ckpt_extras(),
+                                 topology=(elastic.topology()
+                                           if elastic is not None
+                                           else None))
                     if mon.is_enabled():
                         mon.counter("resilience.preempt_checkpoint").add(1)
                     # HANDLED (durable checkpoint taken): leaving the
@@ -1733,10 +1835,17 @@ class Executor:
                                   if it[0] <= rb.step]
                         pending = redo + [(sno, f, flx)] + pending
                         continue
+                    except Exception as e:
+                        _elastic_rethrow(e)
+                        raise
                     replay.append((sno, f, flx))
             else:
-                out = self.run(program, feed=feed, fetch_list=fl,
-                               scope=scope, return_numpy=False)
+                try:
+                    out = self.run(program, feed=feed, fetch_list=fl,
+                                   scope=scope, return_numpy=False)
+                except Exception as e:
+                    _elastic_rethrow(e)
+                    raise
             if entries and _sparse_push:
                 n = len(entries)
                 if guard is not None and guard.last_skipped:
@@ -1755,9 +1864,16 @@ class Executor:
                 # interval-gated BEFORE building the state dict: the
                 # 999 gated-off steps of a 1000-step interval must not
                 # pay per-var scope lookups or the rng-key host copy
-                # (the loop's no-sync contract)
+                # (the loop's no-sync contract).  Under a coordinator,
+                # every save carries the committed topology stamp —
+                # restore_resharded's provenance must name the world
+                # that WROTE the checkpoint, whichever save path won
+                # the boundary.
                 saved = mgr.save(_ckpt_state(), step_i,
-                                 extras=_ckpt_extras())
+                                 extras=_ckpt_extras(),
+                                 topology=(elastic.topology()
+                                           if elastic is not None
+                                           else None))
                 if saved is not None:
                     # everything up to step_i is durable: the replay
                     # window restarts here

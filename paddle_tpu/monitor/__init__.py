@@ -54,6 +54,7 @@ __all__ = [
     "serving_table", "record_serving", "serving_records",
     "fleet", "exporter", "fleet_skew", "rank_info", "rank_tag",
     "record_fleet_skew", "fleet_skew_records",
+    "record_elastic", "elastic_records",
     "MetricsRegistry", "MetricsSession", "CompileLedger", "JsonlWriter",
     "read_jsonl", "Counter", "Gauge", "PEAK_FLOPS", "peak_flops",
     "parse_cost_analysis", "parse_memory_analysis",
@@ -79,6 +80,10 @@ _pass_records = []
 # kind="fleet_skew" records from the straggler probe (ISSUE 10): the
 # rolling per-rank skew table, emitted at loop end / flight dump
 _fleet_records = []
+# kind="elastic" records from the elastic fleet runtime (ISSUE 11):
+# topology transitions, rank join/leave/death, policy decisions — the
+# topology history telemetry_report renders
+_elastic_records = []
 
 
 def enable(jsonl_path=None):
@@ -123,6 +128,7 @@ def reset():
     del _serving_records[:]
     del _pass_records[:]
     del _fleet_records[:]
+    del _elastic_records[:]
 
 
 # -- recording entry points (no-ops while disabled) ---------------------
@@ -241,6 +247,33 @@ def fleet_skew_records():
     """kind="fleet_skew" records seen since enable()/reset(), newest
     last."""
     return list(_fleet_records)
+
+
+def record_elastic(record):
+    """Write one kind="elastic" record (a topology-transition /
+    rank-membership / policy event from resilience.elastic) onto the
+    telemetry JSONL stream and keep it addressable in-process
+    (elastic_records()).  Like lint/serving/fleet records it rides the
+    stream without touching step numbering; a no-op while telemetry is
+    off — the gate-free `resilience.elastic_*` counters still record
+    that the transition happened."""
+    if not _enabled or not record:
+        return None
+    record = dict(record)
+    record.setdefault("kind", "elastic")
+    import time as _time
+
+    record.setdefault("ts_us", _time.perf_counter_ns() / 1000.0)
+    record.setdefault("wall_time", _time.time())
+    _elastic_records.append(record)
+    _session.emit_record(record)
+    return record
+
+
+def elastic_records():
+    """kind="elastic" records seen since enable()/reset(), newest
+    last."""
+    return list(_elastic_records)
 
 
 def serving_table():

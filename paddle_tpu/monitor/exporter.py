@@ -91,9 +91,12 @@ def prometheus_text():
     # these registry names sanitize to the SAME families the serving-
     # ledger block below owns with {runtime=...} labels — emitting both
     # would split the family (promtool/OpenMetrics reject that) and
-    # show two diverging series for one concept
+    # show two diverging series for one concept.  fleet.process_count
+    # is owned by the dedicated elastic block below for the same
+    # reason: it must exist on every scrape (not only once a
+    # coordinator set the gauge), so the gauge copy is skipped here.
     ledger_owned = {"serving.requests", "serving.queue_depth",
-                    "serving.in_flight"}
+                    "serving.in_flight", "fleet.process_count"}
     for name, value in sorted(reg["counters"].items()):
         if name in ledger_owned:
             continue
@@ -166,6 +169,25 @@ def prometheus_text():
         hbm = peak.get("hbm_bytes") or peak.get("model_bytes")
         if hbm is not None:
             _line(out, "peak_hbm_bytes", hbm, kind="gauge")
+    except Exception:
+        pass
+    # elastic fleet (ISSUE 11): the current world size and the
+    # process-lifetime transition count, present on EVERY scrape so a
+    # dashboard can alert on topology churn without special-casing
+    # "no coordinator yet" (world falls back to the launch identity)
+    try:
+        from ..resilience import elastic
+
+        world = elastic.current_world()
+        if world is None:
+            world = fleet.rank_info().get("process_count") or 1
+        _line(out, "fleet_process_count", int(world), kind="gauge",
+              help_="current fleet world size (elastic topology)")
+        _line(out, "elastic_transitions_total",
+              elastic.transitions_total(), kind="counter",
+              help_="topology transitions since process start")
+        _line(out, "elastic_transition_in_flight",
+              1 if elastic.transition_in_flight() else 0, kind="gauge")
     except Exception:
         pass
     # fleet skew: one labeled gauge row per dp shard + the straggler
@@ -248,9 +270,21 @@ def parse_prometheus(text):
 def health():
     """(ok, checks) — the /healthz verdict.  Unhealthy when any live
     serving breaker is OPEN, a watchdog-flagged dispatch is still
-    wedged in flight, or the anomaly guard is mid-anomaly-streak."""
+    wedged in flight, the anomaly guard is mid-anomaly-streak, or an
+    elastic topology change is IN FLIGHT (the fleet is between
+    begin_transition and commit_transition — serving/load-balancers
+    must drain around the window)."""
     checks = {"breaker_open": False, "watchdog_wedged": False,
-              "anomaly_streak": 0}
+              "anomaly_streak": 0, "elastic_transition": False}
+    try:
+        from ..resilience import elastic
+
+        t = elastic.transition_in_flight()
+        if t:
+            checks["elastic_transition"] = True
+            checks["elastic_transition_kind"] = t.get("kind")
+    except Exception:
+        pass
     try:
         from ..serving import stats as serving_stats
 
@@ -272,8 +306,24 @@ def health():
     except Exception:
         pass
     ok = not (checks["breaker_open"] or checks["watchdog_wedged"]
-              or checks["anomaly_streak"] > 0)
+              or checks["anomaly_streak"] > 0
+              or checks["elastic_transition"])
     return ok, checks
+
+
+def _health_reason(checks):
+    """The first failing check's name — the machine-actionable
+    `reason` field of a 503 body (a load balancer draining around an
+    elastic transition keys on reason == "elastic_transition")."""
+    if checks.get("elastic_transition"):
+        return "elastic_transition"
+    if checks.get("breaker_open"):
+        return "breaker_open"
+    if checks.get("watchdog_wedged"):
+        return "watchdog_wedged"
+    if checks.get("anomaly_streak"):
+        return "anomaly_streak"
+    return None
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
@@ -290,8 +340,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                         "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
             ok, checks = health()
-            body = json.dumps({"ok": ok, "checks": checks},
-                              sort_keys=True).encode()
+            doc = {"ok": ok, "checks": checks}
+            if not ok:
+                doc["reason"] = _health_reason(checks)
+            body = json.dumps(doc, sort_keys=True).encode()
             self._reply(200 if ok else 503, body, "application/json")
         else:
             self._reply(404, b"not found\n", "text/plain")

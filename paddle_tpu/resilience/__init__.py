@@ -19,6 +19,15 @@ Four pillars (ISSUE 4 tentpole):
    marker during checkpoint saves; drives tests and the
    `bench.py fault_tolerance_smoke` CI chaos row.
 
+Plus the fleet-level pillar (ISSUE 11): the **elastic runtime**
+(`elastic.py`) — topology-change resharding
+(`CheckpointManager.restore_resharded`), rank join/leave through an
+`ElasticCoordinator` (heartbeat liveness, bounded-timeout boundary
+sync, leave/join intents, shrink/grow transitions gated into
+/healthz), and skew-driven policies (`ElasticPolicy`:
+warn | rebalance | evict off `monitor.fleet_skew()`), exercised by the
+`bench.py elastic_fleet_smoke` kill/reshard/rejoin chaos row.
+
 All recovery events land as `resilience.*` monitor counters/gauges
 (visible in `monitor.snapshot()` and the merged Chrome trace), and
 checkpoint save/restore wall time is recorded by checkpoint.py.
@@ -37,6 +46,9 @@ Usage::
 """
 
 from .breaker import (CircuitBreaker, CircuitOpenError)      # noqa: F401
+from . import elastic                                        # noqa: F401
+from .elastic import (ElasticCoordinator, ElasticPolicy,     # noqa: F401
+                      TopologyChanged, active_coordinator)
 from .faultinject import (FaultPlan, InjectedCrash,          # noqa: F401
                           InjectedTransientError, plan_scope)
 from . import faultinject                                    # noqa: F401
@@ -44,12 +56,14 @@ from .guard import (AnomalyError, AnomalyGuard,              # noqa: F401
                     RollbackPerformed, active_guard, all_finite,
                     anomaly_guard, disable_anomaly_guard,
                     enable_anomaly_guard)
-from .preempt import (PreemptionHandler, clear_preemption,   # noqa: F401
-                      preemption_requested, request_preemption)
+from .preempt import (PreemptionHandler, clear_drain,        # noqa: F401
+                      clear_preemption, drain_requested,
+                      preemption_requested, request_drain,
+                      request_preemption)
 from .retry import RetriesExhausted, RetryPolicy, call_with_retry
-from .taxonomy import (DEADLINE, FATAL, TRANSIENT, TAXONOMY,
+from .taxonomy import (DEADLINE, FATAL, PREEMPTION, TRANSIENT, TAXONOMY,
                        DeadlineExceeded, classify, is_deadline, is_oom,
-                       is_transient)
+                       is_preemption, is_transient)
 
 __all__ = [
     # guard
@@ -61,13 +75,16 @@ __all__ = [
     "enable_retry", "disable_retry", "active_retry",
     # breaker
     "CircuitBreaker", "CircuitOpenError",
+    # elastic fleet (ISSUE 11)
+    "elastic", "ElasticCoordinator", "ElasticPolicy", "TopologyChanged",
+    "active_coordinator",
     # taxonomy
-    "classify", "is_transient", "is_oom", "is_deadline",
-    "DeadlineExceeded", "TRANSIENT", "FATAL", "DEADLINE",
+    "classify", "is_transient", "is_oom", "is_deadline", "is_preemption",
+    "DeadlineExceeded", "TRANSIENT", "FATAL", "DEADLINE", "PREEMPTION",
     "TAXONOMY",
-    # preemption
+    # preemption / drain
     "PreemptionHandler", "preemption_requested", "request_preemption",
-    "clear_preemption",
+    "clear_preemption", "drain_requested", "request_drain", "clear_drain",
     # fault injection
     "faultinject", "FaultPlan", "plan_scope", "InjectedTransientError",
     "InjectedCrash",

@@ -1,0 +1,855 @@
+"""Elastic fleet runtime — topology change as a recoverable variable
+(ISSUE 11 tentpole).
+
+Parity role: the reference's distributed Fluid runtime assumes trainers
+die and come back — the fleet re-launches a lost worker and the pserver
+path tolerates it (PAPER.md layer 6).  Our dp runtime could *name* a
+straggler (PR 10 ``monitor.fleet_skew``) and survive in-process faults
+(PR 4 guard/retry/preemption), but a lost rank killed the whole run.
+This module closes the detection→recovery loop:
+
+**Control plane** — a shared directory (``<ckpt>/_elastic``) next to
+the checkpoint store carries the fleet's collective memory: per-rank
+heartbeats (step + wall time, rewritten atomically at every step
+boundary), *leave intents* (a SIGTERM'd/drained rank announces its
+exit so survivors don't wait out the dead-peer timeout), *join
+intents* (a fresh rank — or the orchestrator on its behalf — asks to
+be admitted), and ``topology.json`` (the current generation: world
+size + member ranks).  Files, not RPCs, on purpose: the checkpoint
+store is already the one shared, durable medium every rank can reach,
+and a recovery protocol must not depend on the very collectives whose
+failure it handles.
+
+**Bounded-timeout boundary sync** — :meth:`ElasticCoordinator.
+step_boundary` is the per-step hook: write our heartbeat, then wait
+(bounded by ``peer_timeout_s``) until every member has either posted
+this boundary or posted a leave intent.  A member that does neither is
+declared dead.  The sync is also where the SIGTERM/SIGUSR1 flags from
+:class:`~.preempt.PreemptionHandler` become *leave intents*, where
+join intents surface as grow events, and where the skew policy reads
+``monitor.fleet_skew()``.  The return value is an event dict (or None
+in the steady state); ``Executor.train_from_dataset(elastic=...)``
+turns events into a force-save plus :class:`TopologyChanged`.
+
+**Transitions** — shrink (survivors < world) restores the force-saved
+checkpoint onto a new mesh via ``CheckpointManager.restore_resharded``
+— IN PROCESS when the survivor set is exactly this rank's local
+devices (the jax world needs no cross-process collectives any more),
+via orchestrator relaunch otherwise (``action="relaunch"``: jax pins
+``num_processes`` at initialize time, so a *changed multi-process
+world* must re-rendezvous — the reference's trainer-restart contract).
+Grow always relaunches: the joining process cannot enter an existing
+gloo/PJRT world.  Every transition is bracketed by ``begin_transition``
+/ ``commit_transition`` — between them ``transition_in_flight()`` is
+truthy, the /healthz exporter answers 503 ``reason=elastic_transition``
+(serving keeps its health gate during the window), and the new
+``topology.json`` generation is only written at commit.
+
+**Skew policy** (:class:`ElasticPolicy`) — the ``on_straggler`` table:
+``warn`` (record + counter), ``rebalance`` (shift per-rank batch
+shares away from the straggler, ``plan_feed`` quantizes them to
+integer rows for the host-side feed assembly; under strict SPMD the
+device shards stay equal, so shares steer the host input pipeline and
+the policy escalates once shares bottom out), ``evict`` (a shrink
+event targeting the straggler).  Decisions need ``patience``
+consecutive over-threshold windows — one noisy step must not evict a
+healthy rank.
+
+Observability: every transition is a ``resilience.elastic_*`` counter
+(gate-free, scrape-visible with telemetry off), a ``kind="elastic"``
+JSONL record, and a flight-recorder event; ``fleet.process_count`` /
+``fleet.topology_gen`` gauges track the current world, and
+``tools/telemetry_report.py`` (``--fleet``) renders the topology
+history.
+"""
+
+import atexit
+import json
+import os
+import threading
+import time
+
+from . import preempt
+from .faultinject import crash_point
+from .taxonomy import is_preemption
+
+__all__ = ["ElasticCoordinator", "ElasticPolicy", "TopologyChanged",
+           "active_coordinator", "transition_in_flight", "current_world",
+           "transitions_total", "request_join", "local_mesh"]
+
+_CONTROL_DIR = "_elastic"
+
+
+class TopologyChanged(RuntimeError):
+    """The fleet's topology changed at step boundary `step`; the
+    current compiled world is stale.  `event` is the coordinator event
+    that triggered it and `action` what the catcher should do:
+
+    - ``"reshard_local"`` — this process alone survives its shrink:
+      rebuild on ``coordinator.local_mesh()`` via ``restore_resharded``
+      and continue in process.
+    - ``"relaunch"`` — the new world spans a different multi-process
+      set: the force-saved checkpoint + committed topology.json are the
+      rendezvous; exit so the orchestrator relaunches at the new size.
+    - ``"exit"`` — this rank itself left (drain/preemption under the
+      coordinator); state is durable, exit cleanly.
+    """
+
+    def __init__(self, step, event, action):
+        super().__init__(
+            f"fleet topology changed at step {step}: "
+            f"{event.get('kind')} -> {action}")
+        self.step = step
+        self.event = dict(event)
+        self.action = action
+
+
+def _mon():
+    from .. import monitor
+
+    return monitor
+
+
+def _fr():
+    from ..monitor import flight_recorder
+
+    return flight_recorder
+
+
+def _atomic_json(path, payload):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def local_mesh(axis_name="dp"):
+    """Mesh over THIS process's local devices — the shrink target when
+    a survivor continues in process (no cross-process collectives
+    remain, so the dead peers' gloo channels are never touched)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.local_devices()), (axis_name,))
+
+
+def request_join(directory, rank, after_step=None):
+    """Write a join intent for `rank` into the control dir of the
+    elastic store rooted at checkpoint `directory` — what a freshly
+    launched rank (or the orchestrator on its behalf) posts to be
+    admitted at the next step boundary.  `after_step` defers admission
+    until the fleet reaches that boundary (a scheduled grow)."""
+    cdir = os.path.join(os.path.abspath(directory), _CONTROL_DIR)
+    os.makedirs(cdir, exist_ok=True)
+    _atomic_json(os.path.join(cdir, f"join_r{int(rank)}.json"),
+                 {"rank": int(rank), "after_step": after_step,
+                  "wall_time": time.time()})
+    _mon().counter("resilience.elastic_join_requests").add(1)
+
+
+# ----------------------------------------------------------------------
+# skew-driven policy
+# ----------------------------------------------------------------------
+
+class ElasticPolicy:
+    """The ``on_straggler`` policy table driven by the rolling
+    straggler score of ``monitor.fleet_skew()``.
+
+    on_straggler:     "warn" | "rebalance" | "evict" — the action once
+                      a straggler holds the score above
+                      `score_threshold` for `patience` consecutive
+                      observations (hysteresis: one slow step is not a
+                      policy event).
+    rebalance_step:   share fraction moved off the straggler per
+                      rebalance decision (its share floor is
+                      `min_share`; the freed share spreads equally
+                      over the other ranks).
+    evict_after_rebalances: with on_straggler="rebalance", how many
+                      rebalances against the SAME rank before the
+                      policy escalates to eviction — the shrink path
+                      is the final actuator when shares bottom out.
+    """
+
+    ACTIONS = ("warn", "rebalance", "evict")
+
+    def __init__(self, on_straggler="warn", score_threshold=0.25,
+                 patience=3, rebalance_step=0.25, min_share=0.5,
+                 evict_after_rebalances=2):
+        if on_straggler not in self.ACTIONS:
+            raise ValueError(
+                f"on_straggler must be one of {self.ACTIONS}, "
+                f"got {on_straggler!r}")
+        self.on_straggler = on_straggler
+        self.score_threshold = float(score_threshold)
+        self.patience = int(patience)
+        self.rebalance_step = float(rebalance_step)
+        self.min_share = float(min_share)
+        self.evict_after_rebalances = int(evict_after_rebalances)
+        self._streak = 0
+        self._streak_rank = None
+        self._rebalances = {}     # dp_index -> count
+        self.shares = None        # {dp_index: share}, sum == nranks
+
+    def note_table(self, table):
+        """Feed one skew table (monitor.fleet_skew()); returns a
+        decision dict {"action", "straggler", ...} when the policy
+        fires, else None."""
+        straggler = (table or {}).get("straggler")
+        score = (straggler or {}).get("straggler_score")
+        if straggler is None or score is None \
+                or score < self.score_threshold:
+            self._streak = 0
+            self._streak_rank = None
+            return None
+        idx = straggler["dp_index"]
+        if idx != self._streak_rank:
+            self._streak = 0
+            self._streak_rank = idx
+        self._streak += 1
+        if self._streak < self.patience:
+            return None
+        self._streak = 0
+        base = {"straggler": dict(straggler), "score": score,
+                "threshold": self.score_threshold}
+        if self.on_straggler == "warn":
+            return {"action": "warn", **base}
+        if self.on_straggler == "evict":
+            return {"action": "evict", **base}
+        # rebalance, escalating to evict once the share bottoms out or
+        # the same rank keeps straggling through the allowed attempts
+        nranks = len((table or {}).get("ranks") or []) or (idx + 1)
+        if self.shares is None:
+            self.shares = {i: 1.0 for i in range(nranks)}
+        share = self.shares.get(idx, 1.0)
+        done = self._rebalances.get(idx, 0)
+        if share <= self.min_share or done >= self.evict_after_rebalances:
+            return {"action": "evict", "escalated_from": "rebalance",
+                    "rebalances": done, **base}
+        moved = min(self.rebalance_step, share - self.min_share)
+        others = [i for i in self.shares if i != idx]
+        self.shares[idx] = round(share - moved, 6)
+        for i in others:
+            self.shares[i] = round(self.shares[i] + moved / len(others), 6)
+        self._rebalances[idx] = done + 1
+        return {"action": "rebalance", "moved": moved,
+                "shares": dict(self.shares), **base}
+
+    def plan_feed(self, global_rows):
+        """Quantize the current shares to integer per-rank row counts
+        summing exactly to `global_rows` (largest-remainder rounding)
+        — the host-side feed assembly plan.  Equal split when no
+        rebalance has fired."""
+        if not self.shares:
+            return None
+        n = len(self.shares)
+        total = sum(self.shares.values())
+        exact = {i: global_rows * s / total for i, s in self.shares.items()}
+        rows = {i: int(exact[i]) for i in exact}
+        short = global_rows - sum(rows.values())
+        for i in sorted(exact, key=lambda i: exact[i] - rows[i],
+                        reverse=True)[:short]:
+            rows[i] += 1
+        assert sum(rows.values()) == global_rows
+        return rows
+
+
+# ----------------------------------------------------------------------
+# the coordinator
+# ----------------------------------------------------------------------
+
+_ACTIVE = None
+_lock = threading.Lock()
+# the exporter's view: None, or the begin_transition payload while a
+# topology change is in flight (module-level so /healthz needs no
+# coordinator handle — and so a scrape can never race a dying one)
+_transition = None
+_transitions_total = 0
+_current_world = None
+
+
+def active_coordinator():
+    """The installed ElasticCoordinator, or None — what retry.py
+    consults before blind-retrying a PREEMPTION-shaped failure."""
+    return _ACTIVE
+
+
+def transition_in_flight():
+    """The in-flight transition payload (dict) or None — drives the
+    /healthz 503 reason=elastic_transition window."""
+    return _transition
+
+
+def transitions_total():
+    """Process-lifetime topology transitions (begin events) — the
+    exporter's ``elastic_transitions_total``."""
+    return _transitions_total
+
+
+def current_world():
+    """World size of the newest committed topology this process knows
+    (None before any coordinator activity)."""
+    return _current_world
+
+
+class ElasticCoordinator:
+    """Per-rank agent of the elastic protocol.
+
+    manager:         the fleet's shared CheckpointManager — both the
+                     durable state AND the control-plane root.
+    rank / world:    this rank and the launch world size (default: the
+                     fleet rank identity / committed topology.json).
+    peer_timeout_s:  bounded-timeout boundary sync — a member that
+                     neither reaches the boundary nor posts a leave
+                     intent within this window is declared dead.
+    sync_interval:   full peer sync every N boundaries (1 = every
+                     step); intents/policy are polled at every
+                     boundary regardless (non-blocking).
+    policy:          ElasticPolicy (None = no skew-driven actions).
+    drain_signal:    opt-in drain signal forwarded to the wrapped
+                     PreemptionHandler (e.g. signal.SIGUSR1).
+
+    Use as a context manager (installs signal handlers + registers as
+    the active coordinator for retry.py), or call install()/
+    uninstall() explicitly.
+    """
+
+    def __init__(self, manager, rank=None, world=None,
+                 peer_timeout_s=10.0, poll_interval_s=0.02,
+                 sync_interval=1, heartbeat_interval_s=1.0,
+                 progress_timeout_s=600.0, policy=None,
+                 drain_signal=None, install_signals=True,
+                 on_transition=None):
+        if not hasattr(manager, "restore_resharded"):
+            from ..checkpoint import CheckpointManager
+
+            manager = CheckpointManager(manager) \
+                if isinstance(manager, str) else manager
+        self.manager = manager
+        self.control_dir = os.path.join(manager.directory, _CONTROL_DIR)
+        os.makedirs(self.control_dir, exist_ok=True)
+        info = self._rank_info()
+        self.rank = int(info["process_index"] if rank is None else rank)
+        topo = _read_json(os.path.join(self.control_dir, "topology.json"))
+        if world is not None:
+            self.world = int(world)
+            self.members = sorted(set(topo["members"]) if topo and
+                                  topo.get("world") == self.world
+                                  else range(self.world))
+        elif topo:
+            self.world = int(topo["world"])
+            self.members = sorted(topo["members"])
+        else:
+            self.world = int(info["process_count"])
+            self.members = list(range(self.world))
+        self.gen = int(topo["gen"]) if topo else 1
+        self.peer_timeout_s = float(peer_timeout_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.sync_interval = max(1, int(sync_interval))
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.progress_timeout_s = float(progress_timeout_s)
+        self.policy = policy
+        self.on_transition = on_transition
+        self._handler = None
+        self._install_signals = install_signals
+        self._drain_signal = drain_signal
+        self._boundaries = 0
+        self._left = False
+        self._last_step = -1
+        self._hb_stop = None
+        self._hb_thread = None
+        self._note_world()
+
+    @staticmethod
+    def _rank_info():
+        from ..monitor import fleet
+
+        return fleet.rank_info()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def install(self):
+        global _ACTIVE
+        with _lock:
+            _ACTIVE = self
+        if self._install_signals:
+            self._handler = preempt.PreemptionHandler(
+                drain_signal=self._drain_signal).install()
+        # liveness is decoupled from step PROGRESS on purpose: a peer
+        # wedged in a 30s first-step compile writes no boundary, but
+        # its heart keeps beating — only a dead PROCESS goes silent.
+        # The daemon thread re-stamps this rank's heartbeat (latest
+        # boundary + fresh wall time) every heartbeat_interval_s.
+        self._hb_stop = threading.Event()
+        self._write_heartbeat(self._last_step)
+
+        def _beat():
+            while not self._hb_stop.wait(self.heartbeat_interval_s):
+                try:
+                    self._write_heartbeat(self._last_step)
+                except OSError:
+                    pass
+
+        self._hb_thread = threading.Thread(
+            target=_beat, name="paddle_tpu-elastic-hb", daemon=True)
+        self._hb_thread.start()
+        # the heart must STOP when this process starts dying: a daemon
+        # thread outlives the main thread's unhandled-exception unwind
+        # and keeps beating while atexit hooks (jax.distributed's
+        # shutdown barrier, wedged on the very peers waiting for us)
+        # run — survivors would see a fresh heartbeat from a corpse
+        # forever.  Registered AFTER jax's shutdown hook, so it runs
+        # FIRST (atexit is LIFO), exactly like a real SIGKILL taking
+        # the whole process.
+        atexit.register(self._stop_heartbeat)
+        self._record("install", world=self.world, gen=self.gen,
+                     members=self.members)
+        return self
+
+    def _stop_heartbeat(self):
+        stop, thread = self._hb_stop, self._hb_thread
+        if stop is not None:
+            stop.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=2.0)
+        self._hb_stop = self._hb_thread = None
+
+    def uninstall(self):
+        global _ACTIVE
+        self._stop_heartbeat()
+        try:
+            atexit.unregister(self._stop_heartbeat)
+        except Exception:
+            pass
+        if self._handler is not None:
+            self._handler.uninstall()
+            self._handler = None
+        with _lock:
+            if _ACTIVE is self:
+                _ACTIVE = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- control-plane file helpers ------------------------------------
+
+    def _path(self, name):
+        return os.path.join(self.control_dir, name)
+
+    def _write_heartbeat(self, step):
+        self._last_step = max(self._last_step, int(step))
+        _atomic_json(self._path(f"hb_r{self.rank}.json"),
+                     {"rank": self.rank, "step": self._last_step,
+                      "gen": self.gen, "pid": os.getpid(),
+                      "wall_time": time.time()})
+
+    def _heartbeats(self):
+        out = {}
+        for m in self.members:
+            hb = _read_json(self._path(f"hb_r{m}.json"))
+            if hb is not None:
+                out[m] = hb
+        return out
+
+    def _leave_intents(self):
+        out = {}
+        try:
+            names = os.listdir(self.control_dir)
+        except OSError:
+            return out
+        for n in names:
+            if n.startswith("leave_r") and n.endswith(".json"):
+                rec = _read_json(self._path(n))
+                if rec is not None:
+                    out[int(rec["rank"])] = rec
+        return out
+
+    def _join_intents(self, step):
+        out = {}
+        try:
+            names = os.listdir(self.control_dir)
+        except OSError:
+            return out
+        for n in names:
+            if n.startswith("join_r") and n.endswith(".json"):
+                rec = _read_json(self._path(n))
+                if rec is None or int(rec["rank"]) in self.members:
+                    continue
+                after = rec.get("after_step")
+                if after is None or int(step) >= int(after):
+                    out[int(rec["rank"])] = rec
+        return out
+
+    def leave_intent(self, step, reason):
+        """Announce this rank's departure so survivors shrink around
+        it instead of waiting out the dead-peer timeout."""
+        _atomic_json(self._path(f"leave_r{self.rank}.json"),
+                     {"rank": self.rank, "step": int(step),
+                      "reason": reason, "wall_time": time.time()})
+        self._left = True
+        _mon().counter("resilience.elastic_rank_leaves").add(1)
+        self._record("leave_intent", step=int(step), reason=reason)
+
+    def request_join(self, rank, after_step=None):
+        request_join(self.manager.directory, rank, after_step=after_step)
+
+    # -- the per-boundary hook -----------------------------------------
+
+    def step_boundary(self, step, skew_table=None):
+        """The elastic hook at step boundary `step` (= batches
+        consumed).  Returns an event dict when the topology must
+        change, else None:
+
+        - ``{"kind": "self_leave", "reason": ...}`` — THIS rank was
+          preempted (SIGTERM) or drained (SIGUSR1): its leave intent is
+          already posted; the loop force-saves and exits.
+        - ``{"kind": "rank_leave"|"rank_death", "ranks": [...]}`` —
+          peers left/died: the loop force-saves and shrinks.
+        - ``{"kind": "rank_join", "ranks": [...]}`` — admitted join
+          intents: the loop force-saves and grows (relaunch).
+        - ``{"kind": "evict", "ranks": [...]}`` — the skew policy
+          escalated to eviction of a persistent straggler.
+        """
+        # deterministic chaos hook: the bench kills a rank exactly here
+        # — after completing step-1, before any heartbeat for `step` —
+        # modeling a SIGKILL landing between two steps
+        crash_point("elastic.step_boundary")
+        step = int(step)
+        self._boundaries += 1
+        if preempt.drain_requested():
+            preempt.clear_drain()
+            _mon().counter("resilience.elastic_drains").add(1)
+            self.leave_intent(step, "drain")
+            return {"kind": "self_leave", "reason": "drain", "step": step}
+        if preempt.preemption_requested():
+            # the loop's own preemption path force-saves + clears the
+            # flag; the coordinator's job is the leave intent
+            self.leave_intent(step, "preempt")
+            return {"kind": "self_leave", "reason": "preempt",
+                    "step": step}
+        self._write_heartbeat(step)
+        # non-blocking sweeps first: an announced departure beats the
+        # timeout, and a scheduled join is visible immediately
+        leaves = {r: rec for r, rec in self._leave_intents().items()
+                  if r in self.members and r != self.rank}
+        if leaves:
+            return {"kind": "rank_leave", "ranks": sorted(leaves),
+                    "step": step,
+                    "reasons": {r: rec.get("reason")
+                                for r, rec in leaves.items()}}
+        joins = self._join_intents(step)
+        if joins:
+            return {"kind": "rank_join", "ranks": sorted(joins),
+                    "step": step}
+        if len(self.members) > 1 and \
+                self._boundaries % self.sync_interval == 0:
+            ev = self._sync_peers(step)
+            if ev is not None:
+                return ev
+        if self.policy is not None:
+            table = skew_table
+            if table is None:
+                table = _mon().fleet_skew()
+            decision = self.policy.note_table(table)
+            if decision is not None:
+                return self._apply_policy(decision, step)
+        return None
+
+    def _sync_peers(self, step):
+        """Bounded-timeout barrier on the control plane: every member
+        must reach boundary `step` (heartbeat step), announce departure
+        (leave intent), or keep its LIVENESS stamp fresh.  Death is
+        silence — a peer whose background heartbeat goes stale for
+        peer_timeout_s — never mere slowness: a rank wedged in a long
+        compile still beats, so it is waited for (up to the
+        progress_timeout_s backstop, after which a live-but-wedged
+        peer is treated as dead too: the fleet must not hang forever
+        on a zombie)."""
+        hard_deadline = time.monotonic() + self.progress_timeout_s
+        # a peer with NO heartbeat file yet (still initializing, or a
+        # shared-fs lag) ages from the start of THIS wait, not from
+        # epoch — a slow-to-boot rank must not read as long-dead
+        t0_wall = time.time()
+        while True:
+            hbs = self._heartbeats()
+            now = time.time()
+            waiting = [m for m in self.members
+                       if m != self.rank
+                       and int(hbs.get(m, {}).get("step", -1)) < step]
+            if not waiting:
+                return None
+            leaves = self._leave_intents()
+            gone = sorted(m for m in waiting if m in leaves)
+            if gone:
+                return {"kind": "rank_leave", "ranks": gone,
+                        "step": step,
+                        "reasons": {m: leaves[m].get("reason")
+                                    for m in gone}}
+            stale = sorted(
+                m for m in waiting
+                if now - hbs.get(m, {}).get("wall_time", t0_wall)
+                > self.peer_timeout_s)
+            if stale or time.monotonic() >= hard_deadline:
+                dead = stale or sorted(waiting)
+                _mon().counter("resilience.elastic_rank_deaths") \
+                    .add(len(dead))
+                self._record("rank_death", step=step, ranks=dead,
+                             timeout_s=self.peer_timeout_s,
+                             wedged=not stale,
+                             last_seen={m: hbs.get(m, {}).get("step")
+                                        for m in dead})
+                return {"kind": "rank_death", "ranks": dead,
+                        "step": step, "timeout_s": self.peer_timeout_s}
+            time.sleep(self.poll_interval_s)
+
+    def on_dispatch_error(self, exc, step=None):
+        """Classify a dispatch failure: preemption-shaped (dead peer,
+        lost heartbeat, reset transport — taxonomy.is_preemption) means
+        a rank MAY have died mid-step.  Returns a rank_death event
+        naming the members whose heartbeats went stale within the
+        probe window, or None — both for failures that are not the
+        elastic layer's to handle AND for preemption-shaped blips
+        where every peer's heart still beats (those go back to the
+        caller's retry/propagation path)."""
+        if not is_preemption(exc):
+            return None
+        # probe: give a just-died peer's heartbeat up to peer_timeout_s
+        # (plus slack) to go stale before blaming anyone.  The probe
+        # window exceeds the staleness threshold, so a peer that truly
+        # died mid-step WILL read stale here; if every heart is still
+        # fresh after the full window, the failure was a transport
+        # blip between LIVE peers — hand it back (retry/propagate)
+        # rather than shrink around the whole fleet and split-brain
+        # against peers that keep training.
+        deadline = time.monotonic() + self.peer_timeout_s + 1.0
+        t0_wall = time.time()
+        stale = []
+        while not stale and time.monotonic() < deadline:
+            hbs = self._heartbeats()
+            now = time.time()
+            stale = [m for m in self.members if m != self.rank
+                     and now - hbs.get(m, {}).get("wall_time", t0_wall)
+                     > self.peer_timeout_s]
+            if not stale:
+                time.sleep(self.poll_interval_s * 5)
+        if not stale:
+            _mon().counter("resilience.elastic_blips_ignored").add(1)
+            self._record("dispatch_blip", step=step,
+                         error=f"{type(exc).__name__}: {exc}"[:200])
+            return None
+        _mon().counter("resilience.elastic_rank_deaths").add(len(stale))
+        self._record("rank_death", step=step, ranks=stale,
+                     source="dispatch_error",
+                     error=f"{type(exc).__name__}: {exc}"[:200])
+        return {"kind": "rank_death", "ranks": sorted(stale),
+                "step": step, "source": "dispatch_error"}
+
+    def _apply_policy(self, decision, step):
+        """Turn a policy decision into counters/records, and into an
+        evict event when the ladder ends at the shrink path."""
+        action = decision["action"]
+        _mon().counter(f"resilience.elastic_policy_{action}").add(1)
+        self._record("policy", step=step, **decision)
+        if action == "evict":
+            target = decision["straggler"].get("process_index")
+            if target is None:
+                target = decision["straggler"]["dp_index"]
+            return {"kind": "evict", "ranks": [int(target)],
+                    "step": step, "decision": decision}
+        return None       # warn/rebalance act in place, training goes on
+
+    def topology(self):
+        """The current committed topology stamp ({world, gen, members})
+        — what every elastic save records as checkpoint provenance."""
+        return {"world": self.world, "gen": self.gen,
+                "members": list(self.members)}
+
+    def batch_shares(self):
+        """The policy's current per-rank batch shares (None before any
+        rebalance) — what an elastic input pipeline consults when
+        assembling the global batch."""
+        return None if self.policy is None else self.policy.shares
+
+    # -- transitions ---------------------------------------------------
+
+    def begin_transition(self, kind, step, to_world, reason=None,
+                         ranks=()):
+        """Open the transition window: /healthz flips to 503
+        reason=elastic_transition until commit_transition."""
+        global _transition, _transitions_total
+        payload = {"kind": kind, "step": int(step), "gen": self.gen,
+                   "from_world": self.world, "to_world": int(to_world),
+                   "reason": reason, "ranks": sorted(ranks),
+                   "wall_time": time.time()}
+        with _lock:
+            _transition = payload
+            _transitions_total += 1
+        _mon().counter("resilience.elastic_transitions").add(1)
+        _mon().counter(f"resilience.elastic_{kind}s").add(1)
+        self._record("transition_begin", **payload)
+        fr = _fr()
+        # "transition" not "kind": the recorder's own event kind is the
+        # first positional of note_event
+        fr.note_event("elastic_transition", phase="begin",
+                      transition=kind, step=int(step),
+                      from_world=self.world, to_world=int(to_world))
+        if self.on_transition is not None:
+            self.on_transition(dict(payload))
+        return payload
+
+    def commit_transition(self, members, step):
+        """Seal the new topology: write topology.json gen+1, sweep the
+        control files of departed members and consumed join intents,
+        close the /healthz window."""
+        global _transition
+        members = sorted(int(m) for m in members)
+        self.gen += 1
+        old_members = self.members
+        self.members = members
+        self.world = len(members)
+        _atomic_json(self._path("topology.json"),
+                     {"gen": self.gen, "world": self.world,
+                      "members": members, "step": int(step),
+                      "wall_time": time.time()})
+        for m in old_members:
+            if m not in members:
+                for prefix in ("hb_r", "leave_r"):
+                    try:
+                        os.remove(self._path(f"{prefix}{m}.json"))
+                    except OSError:
+                        pass
+        joined = []
+        for m in members:
+            if m not in old_members:
+                joined.append(m)
+                try:
+                    os.remove(self._path(f"join_r{m}.json"))
+                except OSError:
+                    pass
+        if joined:
+            _mon().counter("resilience.elastic_rank_joins") \
+                .add(len(joined))
+        with _lock:
+            _transition = None
+        self._note_world()
+        self._record("transition_commit", step=int(step), gen=self.gen,
+                     world=self.world, members=members, joined=joined)
+        _fr().note_event("elastic_transition", phase="commit",
+                         gen=self.gen, world=self.world, step=int(step))
+
+    def shrink(self, template_state, step, dead, save_state=None,
+               extras=None):
+        """The shrink recipe: force-save (when the survivor still holds
+        a consistent boundary state), drop `dead` from the membership,
+        and either reshard IN PROCESS (survivor set == {this rank}:
+        restore the shared checkpoint replicated onto the local mesh
+        and return (state, ck_step, mesh)) or commit + raise
+        TopologyChanged(action="relaunch") for multi-survivor worlds.
+        """
+        dead = set(int(d) for d in dead)
+        survivors = [m for m in self.members if m not in dead]
+        if self.rank not in survivors:
+            raise ValueError(f"rank {self.rank} cannot drive a shrink "
+                             f"it does not survive ({survivors})")
+        self.begin_transition("shrink", step, len(survivors),
+                              reason="rank_loss", ranks=dead)
+        if save_state is not None:
+            self.force_save(save_state, step, extras=extras)
+        if survivors != [self.rank]:
+            self.commit_transition(survivors, step)
+            raise TopologyChanged(step, {"kind": "shrink",
+                                         "ranks": sorted(dead)},
+                                  "relaunch")
+        mesh = local_mesh()
+        state, ck_step = self.manager.restore_resharded(
+            template_state, mesh=mesh, step=None)
+        self.commit_transition(survivors, step)
+        return state, ck_step, mesh
+
+    def grow(self, step, joiners, save_state=None, extras=None):
+        """The grow recipe: force-save the rendezvous checkpoint,
+        commit the enlarged membership, and raise TopologyChanged
+        (action="relaunch") — a process cannot join an existing
+        initialized jax world, so admission happens through the
+        checkpoint + topology.json at the next launch."""
+        joiners = sorted(int(j) for j in joiners)
+        members = sorted(set(self.members) | set(joiners))
+        self.begin_transition("grow", step, len(members),
+                              reason="rank_join", ranks=joiners)
+        if save_state is not None:
+            self.force_save(save_state, step, extras=extras)
+        self.commit_transition(members, step)
+        raise TopologyChanged(step, {"kind": "rank_join",
+                                     "ranks": joiners}, "relaunch")
+
+    def force_save(self, state, step, extras=None):
+        """Durable boundary state for the NEXT topology, stamped with
+        the CURRENT one (the provenance restore_resharded reads)."""
+        if self.manager.latest_step() != int(step):
+            self.manager.save(state, int(step), force=True, extras=extras,
+                              topology=self.topology())
+            _mon().counter("resilience.elastic_force_saves").add(1)
+
+    def resume(self, step=None):
+        """Called by every member of a freshly-launched (grown or
+        relaunched) fleet: adopt the committed topology, clear this
+        rank's own stale leave intent, and record the resume."""
+        topo = _read_json(self._path("topology.json"))
+        if topo is not None:
+            self.gen = int(topo["gen"])
+            self.members = sorted(topo["members"])
+            self.world = int(topo["world"])
+        try:
+            os.remove(self._path(f"leave_r{self.rank}.json"))
+        except OSError:
+            pass
+        self._left = False
+        self._note_world()
+        _mon().counter("resilience.elastic_resumes").add(1)
+        self._record("resume", step=step, gen=self.gen,
+                     world=self.world, members=self.members)
+        return topo
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def _note_world(self):
+        global _current_world
+        with _lock:
+            _current_world = self.world
+        mon = _mon()
+        mon.gauge("fleet.process_count").set(self.world)
+        mon.gauge("fleet.topology_gen").set(self.gen)
+
+    def _record(self, event, **fields):
+        mon = _mon()
+        if "kind" in fields:
+            # a transition payload's own "kind" (shrink/grow) must not
+            # shadow the JSONL record kind ("elastic")
+            fields["transition"] = fields.pop("kind")
+        try:
+            mon.record_elastic({"kind": "elastic", "event": event,
+                                "rank": self.rank, "gen": self.gen,
+                                "world": self.world, **fields})
+        except Exception:
+            pass
+        if event not in ("transition_begin", "transition_commit"):
+            try:
+                _fr().note_event(f"elastic_{event}", rank=self.rank,
+                                 **{k: v for k, v in fields.items()
+                                    if isinstance(v, (int, float, str,
+                                                      list, tuple))})
+            except Exception:
+                pass

@@ -11,15 +11,25 @@ and exits cleanly, which `auto_resume=True` then picks up.
 
 A second SIGINT escalates to the default KeyboardInterrupt — a user
 hammering Ctrl-C must still be able to kill a wedged run.
+
+ISSUE 11: an opt-in DRAIN signal (conventionally SIGUSR1, via
+``PreemptionHandler(drain_signal=signal.SIGUSR1)``) raises a SEPARATE
+flag meaning "finish the step, leave the fleet, stay re-admittable" —
+distinct from SIGTERM's "save and exit".  A drained rank under an
+ElasticCoordinator writes a leave intent so the survivors shrink
+around it without waiting out the dead-peer timeout; the process
+itself exits cleanly and can later rejoin via a join intent.
 """
 
 import signal
 import threading
 
 __all__ = ["PreemptionHandler", "preemption_requested",
-           "request_preemption", "clear_preemption"]
+           "request_preemption", "clear_preemption",
+           "drain_requested", "request_drain", "clear_drain"]
 
 _event = threading.Event()
+_drain_event = threading.Event()
 
 
 def preemption_requested():
@@ -43,6 +53,26 @@ def clear_preemption():
     _event.clear()
 
 
+def drain_requested():
+    """True when a drain-and-leave was requested (SIGUSR1 under an
+    opted-in PreemptionHandler, or request_drain) — "finish the step,
+    leave the fleet, stay re-admittable", distinct from the preemption
+    flag's "save and exit"."""
+    return _drain_event.is_set()
+
+
+def request_drain():
+    """Programmatic drain request (what the opt-in drain signal's
+    handler calls).  Async-signal-safe for the same reason
+    request_preemption is: ONLY the event is set — counting happens in
+    the loop that observes the flag."""
+    _drain_event.set()
+
+
+def clear_drain():
+    _drain_event.clear()
+
+
 class PreemptionHandler:
     """Install SIGTERM/SIGINT -> request_preemption while active.
 
@@ -52,10 +82,19 @@ class PreemptionHandler:
     Previous handlers are restored on exit.  Only the main thread may
     install signal handlers (CPython rule); constructing elsewhere
     raises, so a producer thread can't half-install.
+
+    drain_signal (opt-in, conventionally signal.SIGUSR1): raises the
+    DRAIN flag instead of the preemption flag — "leave the fleet at
+    the next step boundary, stay re-admittable".  An elastic training
+    loop turns it into a leave intent + clean exit; a plain loop that
+    never polls drain_requested() simply ignores it, which is why the
+    signal is not installed by default.
     """
 
-    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 drain_signal=None):
         self.signals = tuple(signals)
+        self.drain_signal = drain_signal
         self._prev = {}
         self._sigints = 0
 
@@ -71,12 +110,18 @@ class PreemptionHandler:
                 raise KeyboardInterrupt
         request_preemption()
 
+    def _on_drain(self, signum, frame):
+        request_drain()
+
     def install(self):
         if threading.current_thread() is not threading.main_thread():
             raise RuntimeError(
                 "PreemptionHandler must be installed from the main thread")
         for s in self.signals:
             self._prev[s] = signal.signal(s, self._on_signal)
+        if self.drain_signal is not None:
+            self._prev[self.drain_signal] = signal.signal(
+                self.drain_signal, self._on_drain)
         return self
 
     def uninstall(self):

@@ -15,9 +15,14 @@ exact delay sequence without wall-clock waits.
 import random
 import time
 
-from .taxonomy import classify, TRANSIENT
+from .taxonomy import classify, PREEMPTION, TRANSIENT
 
 __all__ = ["RetryPolicy", "call_with_retry", "RetriesExhausted"]
+
+# retryable categories: a single throw cannot tell a network blip from
+# a dead peer, so preemption-shaped failures keep their historical
+# retry behavior — UNLESS an elastic coordinator is active (below)
+_RETRYABLE = (TRANSIENT, PREEMPTION)
 
 
 class RetriesExhausted(RuntimeError):
@@ -78,12 +83,28 @@ def _fr():
     return flight_recorder
 
 
+def _elastic_active():
+    """Lazy, cycle-free probe for an installed ElasticCoordinator —
+    the signal that rank-death recovery belongs to the topology-change
+    path, not the backoff loop."""
+    from . import elastic
+
+    return elastic.active_coordinator() is not None
+
+
 def call_with_retry(fn, policy=None, classify_fn=classify,
                     on_retry=None):
-    """Run `fn()`; on a TRANSIENT throw, back off and retry up to
-    policy.max_retries times.  Fatal errors propagate immediately with
-    their original traceback.  Exhausted retries raise
-    RetriesExhausted chaining the last error.
+    """Run `fn()`; on a TRANSIENT (or preemption-shaped) throw, back
+    off and retry up to policy.max_retries times.  Fatal errors
+    propagate immediately with their original traceback.  Exhausted
+    retries raise RetriesExhausted chaining the last error.
+
+    PREEMPTION-category failures (dead peer, lost heartbeat, barrier
+    timeout — taxonomy.is_preemption) are retried like transients
+    ONLY while no elastic coordinator is active: with one installed,
+    the throw propagates immediately so the coordinator can turn the
+    rank death into a topology change instead of the retry loop
+    blind-redialing a dead peer through the whole backoff schedule.
 
     Recovery telemetry: each retry bumps `resilience.retries` and sets
     the `resilience.last_backoff_s` gauge; a give-up bumps
@@ -95,7 +116,13 @@ def call_with_retry(fn, policy=None, classify_fn=classify,
         try:
             return fn()
         except Exception as e:
-            if classify_fn(e) != TRANSIENT:
+            cat = classify_fn(e)
+            if cat not in _RETRYABLE:
+                raise
+            if cat == PREEMPTION and _elastic_active():
+                if mon.is_enabled():
+                    mon.counter("resilience.retry_deferred_to_elastic") \
+                        .add(1)
                 raise
             if attempt >= policy.max_retries:
                 if mon.is_enabled():

@@ -23,9 +23,10 @@ inspectable/testable.  Two axes:
 
 import re
 
-__all__ = ["TRANSIENT", "FATAL", "DEADLINE", "classify", "is_transient",
-           "is_oom", "is_deadline", "DeadlineExceeded",
-           "InjectedTransientError", "InjectedCrash", "TAXONOMY"]
+__all__ = ["TRANSIENT", "FATAL", "DEADLINE", "PREEMPTION", "classify",
+           "is_transient", "is_oom", "is_deadline", "is_preemption",
+           "DeadlineExceeded", "InjectedTransientError", "InjectedCrash",
+           "TAXONOMY"]
 
 TRANSIENT = "transient"
 FATAL = "fatal"
@@ -35,6 +36,16 @@ FATAL = "fatal"
 # so the only honest outcome is a fast classified failure the caller
 # can act on (shed load, re-issue with a fresh budget).
 DEADLINE = "deadline"
+# a PEER (or this rank's own slice) went away: the platform preempted a
+# worker, the jax.distributed coordination service lost a heartbeat, a
+# collective's transport hit a dead socket.  Retry-worthy BY DEFAULT
+# (is_transient covers it — a blip and a death look identical from one
+# throw), but a distinct category so the elastic coordinator and the
+# retry path agree on what "a rank died" looks like: while an
+# ElasticCoordinator is active, retry fails fast on PREEMPTION and
+# hands recovery to the topology-change path instead of blind-redialing
+# a dead peer through the whole backoff schedule (ISSUE 11).
+PREEMPTION = "preemption"
 
 
 class DeadlineExceeded(RuntimeError):
@@ -67,6 +78,22 @@ class InjectedCrash(BaseException):
 # match wins, and fatal codes are listed before the broad transient
 # net so e.g. "INVALID_ARGUMENT: ... was ABORTED" stays fatal.
 _MESSAGE_RULES = (
+    # -- preemption-shaped, TIGHTLY-ANCHORED dead-peer transport/
+    # control-plane shapes (ISSUE 11): these precede even the fatal
+    # status codes because a dead peer's gloo collective surfaces as
+    # "FAILED_PRECONDITION: ... Gloo all-reduce failed: ... Connection
+    # reset by peer" (observed on the CPU backend) and the specific
+    # shape must win over the generic code.  ONLY phrases that cannot
+    # plausibly appear in a programming error's text belong up here —
+    # a bare word like "heartbeat" does not (an "INVALID_ARGUMENT:
+    # heartbeat_interval must be positive" must stay fatal), so the
+    # broader shapes rank BELOW the fatal codes.
+    (re.compile(r"socket closed|connection reset|broken pipe",
+                re.IGNORECASE), PREEMPTION),
+    (re.compile(r"coordination service", re.IGNORECASE), PREEMPTION),
+    (re.compile(r"barrier.{0,40}(time.?out|timed.?out)|"
+                r"(time.?out|timed.?out).{0,40}barrier",
+                re.IGNORECASE), PREEMPTION),
     # -- fatal status codes: the program itself is wrong --------------
     (re.compile(r"\bINVALID_ARGUMENT\b"), FATAL),
     (re.compile(r"\bFAILED_PRECONDITION\b"), FATAL),
@@ -74,20 +101,30 @@ _MESSAGE_RULES = (
     (re.compile(r"\bOUT_OF_RANGE\b"), FATAL),
     (re.compile(r"\bPERMISSION_DENIED\b"), FATAL),
     (re.compile(r"\bUNAUTHENTICATED\b"), FATAL),
+    # -- preemption-shaped, broader: the platform took a worker/device
+    # back, or the control plane says a peer is gone.  One category
+    # (PREEMPTION) for every "a rank died" shape so the retry path and
+    # the elastic coordinator classify them identically instead of
+    # falling through to a blind TRANSIENT retry — but AFTER the fatal
+    # codes, so a status-coded programming error whose text merely
+    # mentions one of these words stays fatal.  Still BEFORE the
+    # transient codes: "UNAVAILABLE: ... missing heartbeats" is a rank
+    # death, not a generic blip.
+    (re.compile(r"preempt", re.IGNORECASE), PREEMPTION),
+    (re.compile(r"slice.*restart|restart.*slice", re.IGNORECASE),
+     PREEMPTION),
+    (re.compile(r"heartbeat", re.IGNORECASE), PREEMPTION),
+    (re.compile(r"(peer|worker|task|process)"
+                r".{0,40}(disconnect|unreachable|shut ?down|terminated|"
+                r"exited|closed)", re.IGNORECASE), PREEMPTION),
+    (re.compile(r"device.*(lost|halted|reset)", re.IGNORECASE),
+     PREEMPTION),
     # -- transient status codes: infrastructure, not the program ------
     (re.compile(r"\bRESOURCE_EXHAUSTED\b"), TRANSIENT),
     (re.compile(r"\bUNAVAILABLE\b"), TRANSIENT),
     (re.compile(r"\bDEADLINE_EXCEEDED\b"), TRANSIENT),
     (re.compile(r"\bABORTED\b"), TRANSIENT),
     (re.compile(r"\bCANCELLED\b"), TRANSIENT),
-    # -- preemption-shaped: the platform took the device back ---------
-    (re.compile(r"preempt", re.IGNORECASE), TRANSIENT),
-    (re.compile(r"slice.*restart|restart.*slice", re.IGNORECASE), TRANSIENT),
-    (re.compile(r"socket closed|connection reset|broken pipe",
-                re.IGNORECASE), TRANSIENT),
-    (re.compile(r"coordination service.*(unavailable|error)",
-                re.IGNORECASE), TRANSIENT),
-    (re.compile(r"device.*(lost|halted|reset)", re.IGNORECASE), TRANSIENT),
 )
 
 # exception TYPES classified without looking at the message.  Python
@@ -98,8 +135,14 @@ _FATAL_TYPES = (
     AssertionError, NameError, ImportError, SyntaxError,
 )
 _TRANSIENT_TYPES = (
-    InjectedTransientError, ConnectionError, TimeoutError, BrokenPipeError,
+    InjectedTransientError, TimeoutError,
 )
+# connection-level OS errors are how a dead peer manifests locally
+# (gloo/PJRT surface SIGKILL'd ranks as resets and broken pipes), so
+# they classify PREEMPTION by TYPE — is_transient still covers them,
+# but the elastic coordinator sees them as a rank death.  A bare
+# TimeoutError stays TRANSIENT: a slow socket is not a dead one.
+_PREEMPTION_TYPES = (ConnectionError, BrokenPipeError)
 
 # -- dump triggers (ISSUE 6): failure shapes that warrant a flight-
 # recorder post-mortem BEFORE the error propagates.  Orthogonal to the
@@ -132,6 +175,7 @@ _DEADLINE_TYPES = (DeadlineExceeded,)
 TAXONOMY = {
     "fatal_types": tuple(t.__name__ for t in _FATAL_TYPES),
     "transient_types": tuple(t.__name__ for t in _TRANSIENT_TYPES),
+    "preemption_types": tuple(t.__name__ for t in _PREEMPTION_TYPES),
     "deadline_types": tuple(t.__name__ for t in _DEADLINE_TYPES),
     "message_rules": tuple((p.pattern, cls) for p, cls in _MESSAGE_RULES),
     "dump_triggers": {"oom": _OOM_PATTERN.pattern,
@@ -140,11 +184,12 @@ TAXONOMY = {
 
 
 def classify(exc):
-    """TRANSIENT, FATAL or DEADLINE for one exception instance.
+    """TRANSIENT, FATAL, DEADLINE or PREEMPTION for one exception
+    instance.
 
-    Precedence: deadline types > transient types > fatal types >
-    message rules > FATAL.  (An InjectedTransientError is a
-    RuntimeError subclass; the type check must see it before any
+    Precedence: deadline types > preemption types > transient types >
+    fatal types > message rules > FATAL.  (An InjectedTransientError is
+    a RuntimeError subclass; the type check must see it before any
     message rule fires.  A raw XLA "DEADLINE_EXCEEDED" status message
     on a non-DeadlineExceeded type stays TRANSIENT — a collective
     rendezvous timeout is infrastructure and retry-worthy; only the
@@ -152,6 +197,8 @@ def classify(exc):
     """
     if isinstance(exc, _DEADLINE_TYPES):
         return DEADLINE
+    if isinstance(exc, _PREEMPTION_TYPES):
+        return PREEMPTION
     if isinstance(exc, _TRANSIENT_TYPES):
         return TRANSIENT
     if isinstance(exc, _FATAL_TYPES):
@@ -164,7 +211,12 @@ def classify(exc):
 
 
 def is_transient(exc):
-    return classify(exc) == TRANSIENT
+    """Retry-worthy: TRANSIENT or PREEMPTION.  A single throw cannot
+    distinguish a network blip from a dead peer, so without an elastic
+    coordinator the preemption shapes keep their historical
+    retry-and-pray behavior; retry.py itself fails fast on PREEMPTION
+    while a coordinator is active (it owns the recovery)."""
+    return classify(exc) in (TRANSIENT, PREEMPTION)
 
 
 def is_oom(exc):
@@ -180,6 +232,23 @@ def is_oom(exc):
         if isinstance(exc, MemoryError):
             return True
         if _OOM_PATTERN.search(str(exc)):
+            return True
+        exc = exc.__cause__ or exc.__context__
+    return False
+
+
+def is_preemption(exc):
+    """True when `exc` is a rank-death / preemption-shaped failure —
+    classified PREEMPTION anywhere in its cause/context chain (a
+    RetriesExhausted wrapping a dead-peer connection reset still reads
+    as one, like is_oom/is_deadline).  This is the single definition of
+    "a rank died" the retry path and the elastic coordinator share:
+    what retry refuses to blind-redial while a coordinator is active is
+    exactly what the coordinator turns into a topology change."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if classify(exc) == PREEMPTION:
             return True
         exc = exc.__cause__ or exc.__context__
     return False

@@ -323,3 +323,38 @@ def test_fleet_obs_smoke_row_shape():
                   "fleet_merge_names_straggler",
                   "exporter_off_no_regression"):
         assert check in src, check
+
+
+# ---------------------------------------------------------------------------
+# elastic_fleet_smoke row (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_fleet_smoke_in_suite_and_standalone():
+    """The elastic chaos row is wired into the suite AND the
+    standalone argv entry (the shrink/grow/policy behaviors themselves
+    are covered by tests/test_elastic.py; the 5-launch kill/reshard/
+    rejoin arc runs end-to-end under `python bench.py
+    elastic_fleet_smoke` — re-running the cluster spawns here would
+    pay five rendezvous per CI run for no new signal)."""
+    src = open(bench.__file__).read()
+    assert '("elastic_fleet_smoke", "elastic_fleet_smoke"' in src
+    assert '"elastic_fleet_smoke" in sys.argv[1:]' in src
+    assert "main_elastic_fleet_smoke" in src
+
+
+def test_elastic_fleet_smoke_row_shape():
+    """The chaos row's check list carries every acceptance pillar of
+    ISSUE 11: the deterministic kill, the named rank death, the
+    in-process 2→1 reshard, the healthz transition window with its
+    reason body, the grow/relaunch rejoin, bitwise params + identical
+    loss stream vs the clean-scheduled reference, the full elastic
+    counter set, and the merged topology history."""
+    src = open(bench.__file__).read()
+    for check in ("kill_fired", "rank_death_named", "shrunk_at_kill",
+                  "healthz_503_during_transition",
+                  "healthz_ok_after_commit", "grow_relaunch",
+                  "elastic_counters", "rejoin_resumed",
+                  "topology_provenance", "params_bitwise_identical",
+                  "loss_stream_identical", "topology_history_reported"):
+        assert check in src, check

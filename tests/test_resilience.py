@@ -49,11 +49,28 @@ def _counters():
 
 def test_taxonomy_transient_status_codes():
     for msg in ("RESOURCE_EXHAUSTED: out of memory allocating",
-                "UNAVAILABLE: coordination service error",
                 "DEADLINE_EXCEEDED: slept too long",
-                "worker was preempted by the scheduler",
-                "Socket closed before handshake"):
+                "ABORTED: cross-replica op cancelled"):
         assert taxonomy.classify(RuntimeError(msg)) == taxonomy.TRANSIENT, msg
+
+
+def test_taxonomy_preemption_category():
+    """ISSUE 11: rank-death shapes (coordination service, barrier
+    timeout, lost heartbeat, dead-peer transports, preempted workers)
+    classify PREEMPTION — still retry-worthy (is_transient), but the
+    elastic coordinator and the retry path agree on what "a rank died"
+    looks like instead of these falling through to a blind TRANSIENT."""
+    for msg in ("UNAVAILABLE: coordination service error",
+                "worker was preempted by the scheduler",
+                "Socket closed before handshake",
+                "barrier timed out waiting for 1 of 2 tasks",
+                "coordinator detected missing heartbeats from task 1",
+                "connection reset by peer",
+                "peer process terminated unexpectedly"):
+        exc = RuntimeError(msg)
+        assert taxonomy.classify(exc) == taxonomy.PREEMPTION, msg
+        assert taxonomy.is_transient(exc), msg       # still retryable
+        assert taxonomy.is_preemption(exc), msg
 
 
 def test_taxonomy_fatal_status_codes_and_types():

@@ -217,9 +217,12 @@ def test_producer_death_raises_classified_instead_of_hanging():
         # ...the guard detected the death promptly (no 300s hang)
         assert time.time() - t0 < 30
         assert "died" in str(ei.value)
-    # the error is transient in the taxonomy: re-running the loader is
-    # the recovery, like the reference fleet re-launching a worker
-    assert taxonomy.classify(ei.value) == taxonomy.TRANSIENT
+    # a dead producer is a dead-peer shape: PREEMPTION in the taxonomy
+    # (ConnectionError by type, ISSUE 11) but still retry-worthy —
+    # re-running the loader is the recovery, like the reference fleet
+    # re-launching a worker
+    assert taxonomy.classify(ei.value) == taxonomy.PREEMPTION
+    assert taxonomy.is_transient(ei.value)
     assert isinstance(ei.value, ConnectionError)
 
 

@@ -110,6 +110,9 @@ def summarize(records):
     skew = _fleet_skew_section(records)
     if skew:
         out["fleet_skew"] = skew
+    topo = _elastic_section(records)
+    if topo:
+        out["elastic_topology"] = topo
     return out
 
 
@@ -368,6 +371,52 @@ def _fleet_skew_section(records):
     return out
 
 
+def _elastic_section(records):
+    """Topology history from the kind="elastic" records the elastic
+    coordinator emits (ISSUE 11): every transition (shrink/grow, from→
+    to world, boundary step, reason) in wall-clock order, plus rank
+    death/leave/join/resume and policy-decision tallies and the newest
+    committed topology.  In a fleet merge the rank streams interleave;
+    transitions are keyed by (gen, transition, step) so the one rank
+    that drove a transition reports it once."""
+    evs = [r for r in records if r.get("kind") == "elastic"]
+    if not evs:
+        return None
+    seen = set()
+    transitions = []
+    tallies = {}
+    current = None
+    for r in sorted(evs, key=lambda r: r.get("wall_time") or 0):
+        event = r.get("event")
+        tallies[event] = tallies.get(event, 0) + 1
+        if event == "transition_begin":
+            key = (r.get("gen"), r.get("transition"), r.get("step"))
+            if key in seen:
+                continue
+            seen.add(key)
+            transitions.append({k: r.get(k) for k in (
+                "transition", "step", "from_world", "to_world",
+                "reason", "rank", "wall_time") if r.get(k) is not None})
+        elif event == "transition_commit":
+            current = {"gen": r.get("gen"), "world": r.get("world"),
+                       "members": r.get("members"),
+                       "step": r.get("step")}
+        elif event == "policy":
+            action = r.get("action")
+            tallies[f"policy_{action}"] = \
+                tallies.get(f"policy_{action}", 0) + 1
+    out = {"events": len(evs), "transitions": transitions}
+    if current:
+        out["current"] = current
+    for k in ("rank_death", "leave_intent", "resume"):
+        if tallies.get(k):
+            out[f"{k}s"] = tallies[k]
+    for k, v in tallies.items():
+        if k.startswith("policy_"):
+            out[k] = v
+    return out
+
+
 def _rank_label(record):
     """One stable "host:pN" label per rank stamp; "(untagged)" for
     pre-fleet streams so old captures still report."""
@@ -434,6 +483,9 @@ def summarize_fleet(by_rank, merged):
     skew = _fleet_skew_section(merged)
     if skew:
         out["fleet_skew"] = skew
+    topo = _elastic_section(merged)
+    if topo:
+        out["elastic_topology"] = topo
     ooms = [{"rank": _rank_label(r),
              "error": (r.get("error") or "")[:120]}
             for r in merged if r.get("kind") == "oom"]
