@@ -1601,6 +1601,258 @@ def main_program_lint_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_sharding_lint_smoke(on_tpu, peak):
+    """Static sharding-analyzer smoke row (ISSUE 12): four pillars.
+
+    (a) Zoo lint: every bundled static model is PT3xx-CLEAN under its
+    shipped default rule set (bert/gpt carry the Megatron TP layout on
+    a {dp, mp} mesh; the rest a dp catch-all), with the analyzer
+    wall-time recorded so a perf regression is a number.
+
+    (b) Seeded bugs: one dedicated program per new PT code (PT301
+    rule-miss, PT302 replicated giant, PT303 hot-edge reshard, PT304
+    divisibility, PT305 conflicting join, PT306 unresolved psum)
+    yields EXACTLY its code.
+
+    (c) Collective conformance on a 2-dev CPU mesh: for bert and gpt,
+    the analyzer's implied dp grad-sync plan (count AND bytes) matches
+    the executed program's emission (transpiler.collective
+    last_sync_stats) exactly, and the PR-5 op-profile attribution sees
+    the dp_grad_sync scope the plan predicted.
+
+    (d) Memory conformance: the static per-shard peak-memory estimate
+    lands within 25% of PR-6's measured mem_profile peak on the same
+    two models.
+
+    Side effect: the PROCESS-GLOBAL monitor is reset (the conformance
+    step needs a clean ledger)."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.analysis import sharding as sh_mod
+    from paddle_tpu.framework.executor import Scope
+    from paddle_tpu.models import static_zoo
+    from paddle_tpu.transpiler import collective as coll
+
+    checks = {}
+
+    # ---- (a) zoo lint under default rule sets -------------------------
+    t0 = time.perf_counter()
+    zoo = {}
+    for name in sorted(static_zoo.BUILDERS):
+        with fluid.unique_name.guard():
+            m = static_zoo.build(name)
+        a = sh_mod.analyze(m.main, m.partition_rules(),
+                           fetch_names=m.fetches,
+                           feed_shapes=m.smoke_feed_shapes())
+        zoo[name] = {
+            "diagnostics": len(a.diagnostics),
+            "unmatched_rules": len(a.report["unmatched_rules"]),
+            "collectives": {f"{k[0]}@{'x'.join(k[1])}": dict(v)
+                            for k, v in a.collective_table().items()},
+            "static_peak_bytes": a.memory["peak_bytes"],
+        }
+    analyzer_wall_ms = (time.perf_counter() - t0) * 1e3
+    checks["zoo_pt3xx_clean"] = all(
+        z["diagnostics"] == 0 and z["unmatched_rules"] == 0
+        for z in zoo.values())
+    checks["zoo_covered"] = len(zoo) == len(static_zoo.BUILDERS)
+
+    # ---- (b) one seeded bug per PT3xx code ----------------------------
+    from paddle_tpu import layers as L
+
+    def _expect(code, build):
+        with fluid.unique_name.guard():
+            main = fluid.Program()
+            with fluid.program_guard(main, fluid.Program()):
+                fetches, rules_list, mesh = build(main)
+        rules = sh_mod.PartitionRules(rules_list, mesh)
+        a = sh_mod.analyze(main, rules, fetch_names=fetches)
+        got = {d.code for d in a.diagnostics}
+        bad = {c for c in got
+               if c.startswith("PT3") and c != code
+               and sh_mod.Diagnostic(c, "").severity == "error"}
+        return code in got and not bad
+
+    def _pt301(main):
+        main.global_block().create_parameter(name="w_miss", shape=[4])
+        return None, [(r"other", [])], {"mp": 2}
+
+    def _pt302(main):
+        main.global_block().create_parameter(name="giant",
+                                             shape=[1 << 20])
+        return None, [(r".*", [])], {"dp": 2}
+
+    def _pt303(main):
+        x = fluid.data("x", [8, 8])
+        label = fluid.data("label", [8, 1], dtype="int64")
+        logits = L.fc(x, 10)
+        loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        return [loss.name], [(r"fc_0\.w_0$", [None, "mp"]),
+                             (r".*", [])], {"dp": 2, "mp": 2}
+
+    def _pt304(main):
+        w = main.global_block().create_parameter(name="w13",
+                                                 shape=[13, 4])
+        out = L.relu(w)
+        return [out.name], [(r"^w13$", ["mp", None]), (r".*", [])], \
+            {"mp": 2}
+
+    def _pt305(main):
+        pa = main.global_block().create_parameter(name="pa",
+                                                  shape=[8, 4])
+        pb = main.global_block().create_parameter(name="pb",
+                                                  shape=[8, 4])
+        out = L.elementwise_add(pa, pb)
+        return [out.name], [(r"^pa$", ["row", None]),
+                            (r"^pb$", ["col", None]), (r".*", [])], \
+            {"row": 2, "col": 2}
+
+    def _pt306(main):
+        x = fluid.data("x", [4, 8])
+        w = main.global_block().create_parameter(name="w", shape=[8, 6])
+        h = L.matmul(x, w)
+        return [h.name], [(r"^w$", ["mp", None]), (r".*", [])], \
+            {"mp": 2}
+
+    flag_before = fluid.get_flags("replicated_param_bytes")
+    fluid.set_flags({"FLAGS_replicated_param_bytes": 1 << 20})
+    try:
+        seeded = {
+            "rule_miss_PT301": _expect("PT301", _pt301),
+            "replicated_giant_PT302": _expect("PT302", _pt302),
+            "hot_edge_reshard_PT303": _expect("PT303", _pt303),
+            "divisibility_PT304": _expect("PT304", _pt304),
+            "conflicting_join_PT305": _expect("PT305", _pt305),
+            "missing_psum_PT306": _expect("PT306", _pt306),
+        }
+    finally:
+        fluid.set_flags(flag_before)
+    checks.update(seeded)
+
+    # ---- (c)+(d) conformance: predicted vs executed -------------------
+    ndev = min(2, len(jax.devices()))
+    conformance = {}
+    if ndev >= 2:
+        was_enabled = monitor.is_enabled()
+        monitor.reset()
+        monitor.enable()
+        try:
+            dp_rules = sh_mod.PartitionRules([(r".*", [])],
+                                             {"dp": ndev})
+            for name in ("bert", "gpt"):
+                with fluid.unique_name.guard():
+                    m = static_zoo.build(name)
+                feed = m.smoke_feed(batch=4 * ndev)
+                feed_shapes = {n: tuple(v.shape)
+                               for n, v in feed.items()}
+                a = sh_mod.analyze(m.main, dp_rules,
+                                   fetch_names=[m.loss_name],
+                                   feed_shapes=feed_shapes)
+                plan = a.dp_sync_plan()
+                key = f"sharding_conf_{name}"
+                exe = fluid.Executor()
+                scope = Scope()
+                exe.run(m.startup, scope=scope)
+                prog = fluid.CompiledProgram(m.main) \
+                    .with_data_parallel(loss_name=m.loss_name,
+                                        places=ndev) \
+                    .with_telemetry(key)
+                for _ in range(3):
+                    exe.run(prog, feed=feed, fetch_list=[m.loss_name],
+                            scope=scope)
+                stats = coll.last_sync_stats()
+                scopes = (monitor.op_profile_split(key=f"{key}:dp")
+                          or {}).get("scopes", {})
+                pred_scopes = {r["scope"] for r in plan["records"]}
+                prof = monitor.mem_profile_split(key=f"{key}:dp")
+                measured = (prof or {}).get("peak", {}).get(
+                    "model_bytes") or 0
+                static_peak = a.memory["peak_bytes"]
+                mem_err = (abs(static_peak - measured) / measured
+                           if measured else None)
+                conformance[name] = {
+                    "predicted_psums": plan["count"],
+                    "predicted_bytes": plan["bytes"],
+                    "executed_psums": stats.get("psums"),
+                    "executed_bytes": stats.get("total_bytes"),
+                    "attributed_scopes_seen": sorted(
+                        s for s in scopes if "dp_grad_sync" in s),
+                    "static_peak_bytes": static_peak,
+                    "measured_peak_bytes": measured,
+                    "mem_rel_err": (round(mem_err, 4)
+                                    if mem_err is not None else None),
+                }
+                # the executor's shard_map contract IS the analyzer's
+                # spec set: feeds P("dp") on the batch dim, state
+                # replicated — the "specs taken from the analyzer"
+                # half of the conformance
+                from jax.sharding import PartitionSpec as P
+
+                checks[f"{name}_feed_specs_match_executor"] = all(
+                    a.specs[n].to_jax() == P("dp")
+                    for n in feed) and all(
+                    a.specs[p].to_jax() == P()
+                    for bs in m.main.backward_sections
+                    for p in bs.param_names)
+                checks[f"{name}_collectives_exact"] = (
+                    plan["count"] == stats.get("psums")
+                    and plan["bytes"] == stats.get("total_bytes"))
+                checks[f"{name}_scope_attributed"] = all(
+                    any(s.endswith(p.split("/")[-1]) or s == p
+                        for s in scopes) for p in pred_scopes) \
+                    and any("dp_grad_sync" in s for s in scopes)
+                checks[f"{name}_mem_within_25pct"] = (
+                    mem_err is not None and mem_err <= 0.25)
+        finally:
+            monitor.disable()
+            monitor.reset()
+            if was_enabled:
+                monitor.enable()
+
+    row = {"metric": "sharding_lint_smoke",
+           "value": int(all(checks.values())), "unit": "ok",
+           "vs_baseline": None,
+           "models": len(zoo),
+           "analyzer_wall_ms": round(analyzer_wall_ms, 1),
+           "zoo": zoo,
+           "conformance": conformance,
+           "conformance_devices": ndev,
+           "checks": checks}
+    if not all(checks.values()):
+        row["error"] = "failed checks: " + ", ".join(
+            k for k, v in checks.items() if not v)
+    return row
+
+
+def main_sharding_lint_smoke():
+    """`python bench.py sharding_lint_smoke` — CI/tooling entry: the
+    sharding-analyzer row standalone on a 2-device virtual CPU mesh,
+    persisted to BENCH_TPU.json under rows["sharding_lint_smoke"].
+    Exit 0 only when the zoo is PT3xx-clean, every seeded bug yields
+    its exact code, and the conformance invariants hold."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=2")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_sharding_lint_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["sharding_lint_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def bench_graph_opt_sweep(on_tpu, peak):
     """Graph-optimizer sweep row (ISSUE 9): two acceptance pillars.
 
@@ -2976,6 +3228,8 @@ def main():
         ("serving_smoke", "serving_smoke", bench_serving_smoke),
         ("program_lint_smoke", "program_lint_smoke",
          bench_program_lint_smoke),
+        ("sharding_lint_smoke", "sharding_lint_smoke",
+         bench_sharding_lint_smoke),
         ("graph_opt_sweep", "graph_opt_sweep", bench_graph_opt_sweep),
         ("fleet_obs_smoke", "fleet_obs_smoke", bench_fleet_obs_smoke),
         ("elastic_fleet_smoke", "elastic_fleet_smoke",
@@ -3056,6 +3310,8 @@ if __name__ == "__main__":
         sys.exit(main_serving_smoke())
     if "program_lint_smoke" in sys.argv[1:]:
         sys.exit(main_program_lint_smoke())
+    if "sharding_lint_smoke" in sys.argv[1:]:
+        sys.exit(main_sharding_lint_smoke())
     if "graph_opt_sweep" in sys.argv[1:]:
         sys.exit(main_graph_opt_sweep())
     if "fleet_obs_smoke" in sys.argv[1:]:

@@ -27,11 +27,14 @@ programs or the bundled static model zoo.
 import warnings as _warnings
 
 from . import facts
+from . import sharding
 from .diagnostics import (CODES, Diagnostic, LintResult,
                           ProgramLintError)
 from .facts import infer_specs, live_op_mask, protected_names
 from .shape_rules import (OPAQUE, ShapeError, VarSpec, has_shape_rule,
                           is_opaque, register_opaque, shape_rule)
+from .sharding import (REPLICATED, MeshSpec, PartitionRules, ShardSpec,
+                       ShardingAnalysis)
 from .verifier import cached_check, check_program
 
 __all__ = [
@@ -41,6 +44,8 @@ __all__ = [
     "VarSpec", "OPAQUE", "ShapeError", "shape_rule", "register_opaque",
     "has_shape_rule", "is_opaque",
     "facts", "live_op_mask", "infer_specs", "protected_names",
+    "sharding", "MeshSpec", "ShardSpec", "REPLICATED",
+    "PartitionRules", "ShardingAnalysis",
 ]
 
 

@@ -19,6 +19,15 @@ PT106    error      stateful op's *Out slot doesn't alias its input
                     (ParamOut != Param: the update would be dropped)
 PT107    error      data-parallel feed batch dim not divisible by mesh
 PT108    error      backward-section loss undefined at section position
+PT301    error      partition rule-miss on a trainable parameter
+PT302    warning    replicated parameter above
+                    FLAGS_replicated_param_bytes (shard the embedding)
+PT303    warning    resharding on a forward (hot) edge — the implied
+                    collective runs in fwd AND its mirrored backward
+PT304    error      sharded dim not divisible by its mesh-axis size
+PT305    error      conflicting sharding specs join at one op
+PT306    error      sharded reduction's pending psum never lands
+                    (a fetch would observe one shard's partial sum)
 PT201    warning    dead op (outputs never read, fetched, or persisted)
 PT202    warning    dead var (declared but never produced or read)
 PT203    warning    write-after-write (value overwritten, never read)
@@ -48,6 +57,12 @@ CODES = {
     "PT106": (ERROR, "stateful op output does not alias its input"),
     "PT107": (ERROR, "dp batch dim not divisible by mesh size"),
     "PT108": (ERROR, "backward-section loss undefined at section"),
+    "PT301": (ERROR, "partition rule-miss on a trainable parameter"),
+    "PT302": (WARNING, "replicated parameter above the byte threshold"),
+    "PT303": (WARNING, "resharding on a forward (hot) edge"),
+    "PT304": (ERROR, "sharded dim not divisible by mesh-axis size"),
+    "PT305": (ERROR, "conflicting sharding specs join"),
+    "PT306": (ERROR, "pending partial sum never resolved"),
     "PT201": (WARNING, "dead op"),
     "PT202": (WARNING, "dead variable"),
     "PT203": (WARNING, "write-after-write without a read"),
@@ -116,6 +131,9 @@ class LintResult:
         self.diagnostics = list(diagnostics)
         self.program_key = program_key
         self.wall_ms = wall_ms
+        # the full ShardingAnalysis when partition rules were in play
+        # (verifier pass 6); None otherwise
+        self.sharding = None
 
     @property
     def errors(self):

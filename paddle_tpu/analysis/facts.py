@@ -123,12 +123,18 @@ def bind_outputs(specs, op, outs):
                 specs[n] = sr.OPAQUE
 
 
-def infer_specs(program, feed_names=(), on_event=None):
+def infer_specs(program, feed_names=(), on_event=None, overrides=None):
     """THE (shape, dtype) rule walk over the global block — shared by
     the verifier's pass 3 (which layers PT101/102/204/209 diagnostics
-    on top via `on_event`) and the graph optimizer's rewrite-legality
-    checks (which run it quietly): one walk, so "what the lint infers"
-    and "what a pass believes" can never diverge.
+    on top via `on_event`), the graph optimizer's rewrite-legality
+    checks, and the sharding analyzer's propagation (which both run it
+    quietly): one walk, so "what the lint infers" and "what a pass
+    believes" can never diverge.
+
+    `overrides` maps var names to concrete shapes that replace the
+    declared ones at the walk's start — the sharding analyzer's
+    memory/cost models pin the symbolic batch dim to a real feed batch
+    this way without mutating the program.
 
     `on_event(kind, op, op_index, error)` is called for each failure
     mode before the op's outputs degrade to OPAQUE:
@@ -150,6 +156,10 @@ def infer_specs(program, feed_names=(), on_event=None):
     for n, v in declared.items():
         if v.persistable or v.is_data or n in feed_names:
             specs[n] = _var_spec(v)
+    for n, shape in (overrides or {}).items():
+        base = declared.get(n)
+        specs[n] = sr.VarSpec(shape,
+                              base.dtype if base is not None else None)
     section_at = {}
     for bs in sections:
         section_at.setdefault(bs.pos, []).append(bs)

@@ -89,6 +89,16 @@ def register_opaque(*names):
     _OPAQUE_OPS.update(names)
 
 
+def stale_opaque_entries():
+    """Audit: register_opaque entries that now have a REAL shape rule.
+    An op family in both tables means someone wrote the rule but
+    forgot to retire the opaque marker — the rule wins at lookup time
+    (``infer_specs`` checks ``is_opaque`` first, so the new rule would
+    silently never run).  The registry-drift test fails on any entry
+    here, not just on missing coverage."""
+    return sorted(_OPAQUE_OPS & set(_RULES))
+
+
 def has_shape_rule(op_type):
     return op_type in _RULES
 

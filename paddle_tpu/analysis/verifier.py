@@ -68,11 +68,18 @@ def _diag(diags, code, message, op=None, op_index=None, var=None):
 
 
 def check_program(program, fetch_names=None, feed_names=(),
-                  dp_ndev=None, program_key=None):
+                  dp_ndev=None, program_key=None, sharding=None,
+                  feed_shapes=None):
     """Lint one Program.  `fetch_names=None` means "fetches unknown":
     the fetch-dependent lints (PT104/PT201/PT202/PT208) are skipped so
     a standalone lint of an inference program doesn't flag its leaf
-    outputs as dead.  Returns a :class:`LintResult`."""
+    outputs as dead.  `sharding` is a
+    :class:`~paddle_tpu.analysis.sharding.PartitionRules` (default: the
+    set attached to the program via ``sharding.attach`` /
+    ``CompiledProgram.with_sharding_rules``, if any) — when present,
+    the static sharding analyzer runs and its PT3xx diagnostics merge
+    into the result; the full :class:`ShardingAnalysis` rides on
+    ``result.sharding``.  Returns a :class:`LintResult`."""
     global analysis_runs
     analysis_runs += 1
     t0 = time.perf_counter()
@@ -358,12 +365,35 @@ def check_program(program, fetch_names=None, feed_names=(),
                       f"'{bs.loss_name}': its gradient is identically "
                       f"zero", var=p)
 
+    # ---- pass 6: static sharding analysis (PT3xx) ---------------------
+    # only when a rule set is in play — a program without partition
+    # rules has nothing to lint here, and the pass costs nothing
+    sharding_analysis = None
+    if sharding is None:
+        from . import sharding as _sh
+
+        sharding = _sh.attached(program)
+    if sharding is not None:
+        from . import sharding as _sh
+
+        # feed_shapes pin the symbolic batch dim: divisibility checks
+        # become decidable and the cost/memory models byte-exact —
+        # and the resulting diagnostics flow into THIS result, so the
+        # CLI's exit-code contract sees them
+        sharding_analysis = _sh.analyze(
+            program, sharding, fetch_names=fetch_names,
+            feed_names=feed_names, feed_shapes=feed_shapes,
+            program_key=program_key)
+        diags.extend(sharding_analysis.diagnostics)
+
     order = {"error": 0, "warning": 1}
     diags.sort(key=lambda d: (order[d.severity],
                               -1 if d.op_index is None else d.op_index,
                               d.code))
-    return LintResult(diags, program_key=program_key,
-                      wall_ms=(time.perf_counter() - t0) * 1e3)
+    result = LintResult(diags, program_key=program_key,
+                        wall_ms=(time.perf_counter() - t0) * 1e3)
+    result.sharding = sharding_analysis
+    return result
 
 
 # ---------------------------------------------------------------------------
@@ -381,10 +411,14 @@ def cached_check(program, fetch_names=None, feed_names=(), dp_ndev=None,
     ``_version`` and the next check re-analyzes.  Returns
     (result, fresh): `fresh` is False on a cache hit so the caller can
     avoid double-reporting."""
+    from . import sharding as _sh
+
+    rules = _sh.attached(program)
     key = (program._version,
            None if fetch_names is None else tuple(fetch_names),
            frozenset(feed_names or ()),
-           dp_ndev)
+           dp_ndev,
+           None if rules is None else rules.fingerprint())
     cache = getattr(program, "_lint_cache", None)
     if cache is not None:
         hit = cache.get(key)

@@ -149,6 +149,14 @@ declare_flag("static_check", "off",
              "Static program verification before tracing: "
              "off | warn | error.")
 
+# Static sharding analyzer (paddle_tpu.analysis.sharding, ISSUE 12):
+# a parameter left replicated by the partition rules above this many
+# bytes lints as PT302 — the "forgot to shard the embedding" OOM,
+# caught before any trace.  0 disables the check.
+declare_flag("replicated_param_bytes", 64 << 20,
+             "PT302 threshold: lint a replicated parameter larger "
+             "than this many bytes (0 = off).")
+
 # Hardened inference serving runtime (paddle_tpu.serving, ISSUE 8):
 # defaults for ServingConfig — overridable per-runtime, but a fleet
 # rollout wants one env knob, not a code change.

@@ -129,6 +129,32 @@ class CompiledProgram:
         self._telemetry_label = label
         return self
 
+    def with_sharding_rules(self, rules, mesh=None, data_axis="dp"):
+        """Attach a partition-rule set for the static sharding
+        analyzer (ISSUE 12): under ``FLAGS_static_check`` the verifier
+        lints the program against these rules (PT301-PT306 — rule
+        misses, replicated giants, hot-edge reshards, divisibility,
+        conflicting joins, unresolved psums) before any trace.
+
+        ``rules`` is an ``analysis.sharding.PartitionRules``, a
+        ``{"mesh": ..., "rules": ...}`` dict (the rule-file format), or
+        a plain ``[(regex, dims), ...]`` list with ``mesh`` given
+        separately.  Attachment is analysis metadata, not a graph
+        mutation: the program version does not bump, and the lint
+        cache keys on the rule fingerprint."""
+        from ..analysis import sharding as _sh
+
+        if isinstance(rules, dict):
+            rules = _sh.PartitionRules.from_dict(rules)
+        elif not isinstance(rules, _sh.PartitionRules):
+            if mesh is None:
+                raise ValueError(
+                    "with_sharding_rules(list_of_rules) needs mesh=")
+            rules = _sh.PartitionRules(rules, mesh,
+                                       data_axis=data_axis)
+        _sh.attach(self._program, rules)
+        return self
+
     # -- reference API ---------------------------------------------------
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,

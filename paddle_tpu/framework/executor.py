@@ -859,7 +859,17 @@ class Executor:
         # with its own _version, so the run-plan and compiled-step
         # caches key on the pass config for free.
         if flags.flag("graph_opt") == "on":
-            program = self._resolve_optimized(program, fetch_names)
+            opt = self._resolve_optimized(program, fetch_names)
+            if opt is not program:
+                # the substitute is a clone — mirror the CURRENT
+                # sharding-rule attachment (analysis metadata, not
+                # graph state) so the PT3xx lints neither vanish under
+                # graph_opt=on nor keep linting a cached clone against
+                # rules the user has since replaced or removed
+                rules = getattr(program, "_sharding_rules", None)
+                if getattr(opt, "_sharding_rules", None) is not rules:
+                    opt._sharding_rules = rules
+            program = opt
 
         # Optimize-time-folded constants become initialized
         # persistables; their values live on the program — seed them

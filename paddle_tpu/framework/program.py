@@ -44,6 +44,22 @@ def _user_callsite():
     return None
 
 
+def did_you_mean(name, candidates, n=3, cutoff=0.6):
+    """Difflib close-match suggestion text (" — did you mean ...?") or
+    "" when nothing is close.  The ONE fuzzy-suggestion rule: Block.var
+    uses it for typo'd var names and the sharding rule engine for rule
+    regexes that match zero vars — a typo'd rule gets the same
+    treatment a typo'd fetch does."""
+    import difflib
+
+    close = difflib.get_close_matches(name, list(candidates), n=n,
+                                      cutoff=cutoff)
+    if not close:
+        return ""
+    return " — did you mean " + " or ".join(
+        f"'{c}'" for c in close) + "?"
+
+
 class Variable:
     """A named slot in a Block. Parity: framework.py:806."""
 
@@ -249,6 +265,10 @@ class Block:
         p = Parameter(self, name=name, shape=shape, dtype=dtype,
                       trainable=trainable, regularizer=regularizer,
                       initializer=initializer)
+        # creation provenance, like ops: the sharding lints (PT301/302)
+        # blame a parameter, not an op — the callsite names where the
+        # layer that made it was called
+        p.callsite = _user_callsite()
         self.vars[p.name] = p
         self.program._bump()
         return p
@@ -265,9 +285,8 @@ class Block:
         """Close-match suggestions over this block + its ancestors —
         a typo'd fetch/feed name gets candidates instead of a bare
         name error (op_call_stack-style ergonomics for the graph
-        API)."""
-        import difflib
-
+        API).  Shares the module-level did_you_mean rule with the
+        sharding rule engine's zero-match reporting."""
         candidates = set()
         b = self
         while True:
@@ -275,12 +294,7 @@ class Block:
             if b.parent_idx < 0:
                 break
             b = self.program.blocks[b.parent_idx]
-        close = difflib.get_close_matches(name, candidates, n=3,
-                                          cutoff=0.6)
-        if not close:
-            return ""
-        return " — did you mean " + " or ".join(
-            f"'{c}'" for c in close) + "?"
+        return did_you_mean(name, candidates)
 
     def has_var(self, name):
         return self._find_var_recursive(name) is not None
@@ -435,6 +449,11 @@ class Program:
         p.amp_enabled = self.amp_enabled
         if self._folded_constants:
             p._folded_constants = dict(self._folded_constants)
+        # sharding-rule attachment (analysis metadata) rides along:
+        # the for_test eval twin must lint PT3xx like its parent
+        rules = getattr(self, "_sharding_rules", None)
+        if rules is not None:
+            p._sharding_rules = rules
         if for_test:
             # prune backward + optimize ops (parity: Program.clone's test
             # mode, framework.py:3806 — everything appended after the first

@@ -30,6 +30,29 @@ class StaticModel:
         self.fetches = list(fetches)      # fetch var names
         self.loss_name = loss_name
 
+    @property
+    def sharding_rules(self):
+        """This family's default partition-rule document (the
+        ``--sharding-rules`` file format): ``{"mesh", "rules",
+        "data_axis"}``.  Every default set is PT3xx-clean on its own
+        mesh — the property ``bench.py sharding_lint_smoke`` and the
+        zoo sweep tests pin."""
+        return DEFAULT_SHARDING_RULES.get(
+            self.name, DEFAULT_SHARDING_RULES["_default"])
+
+    def partition_rules(self):
+        """The default rules as a live analyzer object."""
+        from ..analysis.sharding import PartitionRules
+
+        return PartitionRules.from_dict(self.sharding_rules)
+
+    def smoke_feed_shapes(self, batch=8):
+        """Concrete feed shapes for one smoke batch — what the
+        sharding analyzer's byte-exact cost/memory models pin the
+        symbolic batch dim with."""
+        return {name: tuple(batch if d is None else d for d in shape)
+                for name, shape, _ in self.feeds}
+
     def op_types(self):
         """Every op type the model's programs emit (main + startup,
         all blocks) — what the registry-drift test checks coverage
@@ -280,6 +303,55 @@ def build_word2vec(window=4, vocab=120, d=16):
                        [("context", (None, window), "int64"),
                         ("center", (None, 1), "int64")],
                        [loss.name], loss_name=loss.name)
+
+
+# ---------------------------------------------------------------------------
+# default partition-rule sets (ISSUE 12): one document per family, in
+# the rule-file format tools/program_lint.py --sharding-rules reads.
+# Ordered (regex, dims) pairs, first-match-wins, final '.*' catch-all
+# makes replication EXPLICIT (no PT301).  The transformer families
+# carry the Megatron tensor-parallel layout over a 2D {dp, mp} mesh:
+# qkv/ffn-up column-sharded, attn-out/ffn-down row-sharded (the row
+# shard's pending psum resolves at the residual add — one all-reduce
+# per block, which the analyzer's collective table prices), embedding
+# vocab-sharded (masked-lookup psum).  Every set lints PT3xx-clean on
+# its own mesh.
+# ---------------------------------------------------------------------------
+
+_TRANSFORMER_TP_RULES = [
+    # attention q/k/v projections: column parallel
+    [r"fc_0\.w_0$", [None, "mp"]],
+    [r"fc_1\.w_0$", [None, "mp"]],
+    [r"fc_2\.w_0$", [None, "mp"]],
+    # attention output projection: row parallel (psum at residual)
+    [r"fc_3\.w_0$", ["mp", None]],
+    # ffn up: column parallel; ffn down: row parallel
+    [r"fc_4\.w_0$", [None, "mp"]],
+    [r"fc_5\.w_0$", ["mp", None]],
+    # token embedding: vocab-sharded (masked-lookup psum)
+    [r"embedding_0\.w_0$", ["mp", None]],
+    # everything else (biases, norms, heads, optimizer scalars):
+    # replicated, explicitly
+    [r".*", []],
+]
+
+DEFAULT_SHARDING_RULES = {
+    "_default": {
+        "mesh": {"dp": 2},
+        "data_axis": "dp",
+        "rules": [[r".*", []]],
+    },
+    "bert": {
+        "mesh": {"dp": 2, "mp": 2},
+        "data_axis": "dp",
+        "rules": list(_TRANSFORMER_TP_RULES),
+    },
+    "gpt": {
+        "mesh": {"dp": 2, "mp": 2},
+        "data_axis": "dp",
+        "rules": list(_TRANSFORMER_TP_RULES),
+    },
+}
 
 
 BUILDERS = {

@@ -86,6 +86,39 @@ def plan_buckets(entries, bucket_bytes):
     return buckets
 
 
+def implied_collective_plan(entries, axes=("dp",), bucket_bytes=None):
+    """STATIC twin of :func:`sync_gradients`'s emission, shared with
+    the sharding analyzer (``analysis.sharding``): the same
+    ``plan_buckets`` math over ``(name, numel, itemsize, dtype)``
+    entries in firing order, returned as implied-collective records
+    instead of traced psums.  Because the plan and the emission run
+    the SAME planner with the SAME flag default, the analyzer's
+    predicted collective count/bytes and the executed
+    ``last_sync_stats`` agree exactly — the conformance property
+    ``bench.py sharding_lint_smoke`` pins.
+
+    ``bucket_bytes=None`` reads ``FLAGS_dp_bucket_bytes``; 0 plans the
+    legacy one-all-reduce-per-gradient sync."""
+    if bucket_bytes is None:
+        bucket_bytes = int(flags.flag("dp_bucket_bytes"))
+    axes = list(axes)
+    out = []
+    entries = list(entries)
+    if bucket_bytes > 0 and entries:
+        for b in plan_buckets(entries, bucket_bytes):
+            out.append({"kind": "all_reduce", "axes": axes,
+                        "var": "+".join(b["names"]),
+                        "bytes": int(b["bytes"]),
+                        "dtype": b["dtype"]})
+    else:
+        for name, numel, itemsize, dtype in entries:
+            out.append({"kind": "all_reduce", "axes": axes,
+                        "var": name,
+                        "bytes": int(numel) * int(itemsize),
+                        "dtype": dtype})
+    return out
+
+
 def _is_dense(g):
     """A plain dense array jnp can flatten/concatenate: has shape and
     dtype, and is not a SelectedRows-style wrapper."""
@@ -297,4 +330,4 @@ class LocalSGD(Collective):
 
 __all__ = ["GradAllReduce", "LocalSGD", "Collective",
            "sync_gradients", "plan_buckets", "last_sync_stats",
-           "emit_skew_probe"]
+           "implied_collective_plan", "emit_skew_probe"]

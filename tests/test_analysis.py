@@ -559,6 +559,34 @@ def test_registry_drift_every_zoo_op_has_kernel_and_rule():
     assert not missing_rule, missing_rule
 
 
+def test_registry_drift_no_stale_opaque_entries():
+    """The drift test fails on STALE opaque entries too (ISSUE 12
+    satellite): an op family marked register_opaque that now has a
+    real shape rule means the rule silently never runs (infer_specs
+    checks is_opaque first) — retire the opaque marker when the rule
+    lands."""
+    from paddle_tpu.analysis import shape_rules
+
+    stale = shape_rules.stale_opaque_entries()
+    assert not stale, (
+        f"register_opaque entries shadowing real shape rules "
+        f"(remove them from the opaque list): {stale}")
+
+
+def test_stale_opaque_audit_detects_seeded_overlap():
+    """The audit itself works: seed one overlap, see it reported,
+    clean up."""
+    from paddle_tpu.analysis import shape_rules
+
+    assert "relu" in shape_rules._RULES
+    shape_rules._OPAQUE_OPS.add("relu")
+    try:
+        assert shape_rules.stale_opaque_entries() == ["relu"]
+    finally:
+        shape_rules._OPAQUE_OPS.discard("relu")
+    assert not shape_rules.stale_opaque_entries()
+
+
 def test_stateful_audit_every_out_aliasing_kernel_is_tagged():
     """Registry audit (ISSUE 7 satellite): any kernel whose source
     returns a '<X>Out' slot while reading ins['<X>'] performs a

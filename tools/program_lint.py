@@ -3,19 +3,40 @@
 Lints serialized programs (Program.to_json files) or the bundled
 static model zoo WITHOUT tracing, compiling, or touching a device —
 the ProgramDesc-level pre-flight the reference ran as per-op
-InferShape at build time.  Diagnostics carry stable PT codes (PT1xx
-errors / PT2xx warnings), the op type/index, and the op's creation
-callsite; see paddle_tpu/analysis/diagnostics.py for the table.
+InferShape at build time.  Diagnostics carry stable PT codes, the op
+type/index, and the op's creation callsite; see
+paddle_tpu/analysis/diagnostics.py for the table:
+
+- PT1xx  errors   (shape/dtype, def-use, aliasing, distributed)
+- PT2xx  warnings (dead code, opaque rules, donation fetches)
+- PT3xx  sharding lints (with --sharding-rules): PT301 rule-miss,
+  PT302 replicated giant param, PT303 hot-edge reshard, PT304
+  divisibility, PT305 conflicting join, PT306 unresolved pending psum
+  — plus the implied-collective cost table and the static per-shard
+  peak-memory estimate in the --json records.
 
 Usage:
   python tools/program_lint.py <program.json> [--fetch a,b] [--dp N]
-  python tools/program_lint.py --model lenet [--dp N]
-  python tools/program_lint.py --all-models
+      [--sharding-rules rules.json]
+  python tools/program_lint.py --model lenet [--sharding-rules default]
+  python tools/program_lint.py --all-models [--sharding-rules default]
+  python tools/program_lint.py --all-models --json
 
-Exit status: 0 clean (no PT1xx errors anywhere), 1 errors found,
-2 usage error.  `--fetch` enables the fetch-dependent lints (missing
-fetch targets, dead ops/vars, donated-then-fetched); `--dp N` enables
-the data-parallel lints against an N-device mesh.
+`--sharding-rules FILE` loads a partition-rule document ({"mesh":
+{axis: size}, "rules": [[regex, [axis|null, ...]], ...], "data_axis":
+"dp"}); the special value `default` uses each bundled model's own
+default rule set (only with --model/--all-models).
+
+Exit-code contract (CI gates on it):
+  0  clean — no PT1xx and no PT3xx ERRORS anywhere (warnings allowed)
+  1  at least one error-severity diagnostic
+  2  usage / unreadable input
+
+`--json` emits one machine-readable record per linted program (the
+same shape tools/program_opt.py --json uses: a JSON array on stdout),
+each carrying counts by code, every diagnostic's full detail, and —
+when sharding rules are in play — the rule-match report, the implied
+collective table, and the static memory estimate.
 """
 import argparse
 import json
@@ -28,11 +49,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def _lint_one(label, program, fetch_names, dp_ndev, verbose=True):
+def _lint_one(label, program, fetch_names, dp_ndev, rules,
+              feed_shapes=None, verbose=True):
     from paddle_tpu import analysis
 
+    # feed_shapes (zoo smoke batches) flow INTO the one verifier run:
+    # shape-dependent PT3xx findings count toward the exit code and
+    # the cost/memory records are byte-exact — no second analysis
     result = analysis.check_program(program, fetch_names=fetch_names,
-                                    dp_ndev=dp_ndev, program_key=label)
+                                    dp_ndev=dp_ndev, program_key=label,
+                                    sharding=rules,
+                                    feed_shapes=feed_shapes)
     if verbose:
         ops = sum(len(b.ops) for b in program.blocks)
         print(f"{label}: {ops} ops, {len(result.errors)} error(s), "
@@ -40,13 +67,30 @@ def _lint_one(label, program, fetch_names, dp_ndev, verbose=True):
               f"  [{result.wall_ms:.1f} ms]")
         for d in result.diagnostics:
             print("  " + d.render())
+        if result.sharding is not None:
+            for line in result.sharding.render().splitlines():
+                print("  " + line)
+            for um in result.sharding.report["unmatched_rules"]:
+                print(f"  rule {um['pattern']!r} matched no vars"
+                      f"{um['suggestion']}")
     return result
+
+
+def _record(result):
+    rec = result.to_record()
+    rec["diagnostics"] = [d.to_dict() for d in result.diagnostics]
+    if result.sharding is not None:
+        rec["sharding"] = result.sharding.to_record()
+        rec["memory"] = result.sharding.memory
+    return rec
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="program_lint.py",
-        description=__doc__.splitlines()[0])
+        description=__doc__.splitlines()[0],
+        epilog="exit status: 0 = no PT1xx/PT3xx errors, 1 = errors "
+               "found, 2 = usage error")
     ap.add_argument("program", nargs="?",
                     help="Program.to_json file to lint")
     ap.add_argument("--model", help="lint one bundled static model "
@@ -61,10 +105,25 @@ def main(argv=None):
     ap.add_argument("--dp", type=int, default=None,
                     help="data-parallel mesh size for the distributed "
                     "lints")
+    ap.add_argument("--sharding-rules", default=None, metavar="FILE",
+                    help="partition-rule JSON file enabling the PT3xx "
+                    "sharding lints; 'default' uses each bundled "
+                    "model's own default rule set")
     ap.add_argument("--json", action="store_true",
                     help="emit machine-readable JSON records instead "
-                    "of text")
+                    "of text (parity with tools/program_opt.py)")
     args = ap.parse_args(argv)
+
+    file_rules = None
+    if args.sharding_rules and args.sharding_rules != "default":
+        from paddle_tpu.analysis import sharding as _sh
+
+        try:
+            file_rules = _sh.load_rules_file(args.sharding_rules)
+        except Exception as e:
+            print(f"cannot load rules {args.sharding_rules}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
 
     targets = []
     if args.all_models or args.model:
@@ -78,9 +137,22 @@ def main(argv=None):
             except KeyError as e:
                 print(e, file=sys.stderr)
                 return 2
-            targets.append((f"{name}/main", m.main, m.fetches))
-            targets.append((f"{name}/startup", m.startup, []))
+            rules = file_rules
+            feed_shapes = None
+            if args.sharding_rules == "default":
+                rules = m.partition_rules()
+            if rules is not None:
+                feed_shapes = m.smoke_feed_shapes()
+            targets.append((f"{name}/main", m.main, m.fetches, rules,
+                            feed_shapes))
+            targets.append((f"{name}/startup", m.startup, [], None,
+                            None))
     elif args.program:
+        if args.sharding_rules == "default":
+            print("--sharding-rules default needs --model/--all-models"
+                  " (serialized programs carry no bundled rule set)",
+                  file=sys.stderr)
+            return 2
         from paddle_tpu.framework.program import Program
 
         try:
@@ -91,17 +163,19 @@ def main(argv=None):
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             return 2
         fetches = (args.fetch.split(",") if args.fetch else None)
-        targets.append((os.path.basename(args.program), prog, fetches))
+        targets.append((os.path.basename(args.program), prog, fetches,
+                        file_rules, None))
     else:
         ap.print_help()
         return 2
 
     any_errors = False
     records = []
-    for label, prog, fetches in targets:
-        result = _lint_one(label, prog, fetches, args.dp,
+    for label, prog, fetches, rules, feed_shapes in targets:
+        result = _lint_one(label, prog, fetches, args.dp, rules,
+                           feed_shapes=feed_shapes,
                            verbose=not args.json)
-        records.append(result.to_record())
+        records.append(_record(result))
         any_errors = any_errors or not result.ok
     if args.json:
         print(json.dumps(records, indent=1))
