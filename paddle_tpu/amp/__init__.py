@@ -177,40 +177,78 @@ def cast_for_op(op_type, *xs):
 
 # -- static-graph rewrite (fp16_utils.py:51 rewrite_program parity) ----------
 
-def rewrite_program(program, amp_lists=None, dest_dtype=None):
-    """Insert cast ops so white-list ops compute in the AMP dtype and
-    black-list ops stay fp32 — the reference's rewrite_program
-    (fp16_utils.py:51/156) on this Program IR.  Parameters feeding
-    white ops are cast at use (fp32 master weights stay in scope).
-    Apply BEFORE minimize()/append_backward, like the quantization
-    pass; autodiff then differentiates through the casts."""
-    from ..framework.program import Operator
+def _fusion_tier_applied(program):
+    """True when the graph-optimizer's FUSION tier already ran over
+    this program (marker set by passes.fuse_program, or fusion-tier op
+    types present — a clone keeps the ops but not necessarily the
+    marker)."""
+    from ..passes.fuse import FUSED_TIER_TYPES
 
-    lists = amp_lists or AutoMixedPrecisionLists()
-    dest = dest_dtype or ("bfloat16" if flags.flag("amp_dtype") ==
-                          "bfloat16" else "float16")
-    if program.backward_sections:
+    if getattr(program, "_fusion_applied", False):
+        return True
+    return any(op.type in FUSED_TIER_TYPES
+               for b in program.blocks for op in b.ops)
+
+
+def _check_canonical_order(program):
+    """The canonical optimization order is AMP rewrite → fusion tier →
+    structural passes: the fusion matcher is taught to see THROUGH
+    AMP's casts, but AMP's list-driven rewrite knows nothing about
+    fused op types — casting around them would split patterns the
+    kernels already own and silently un-fuse the bf16 path."""
+    if _fusion_tier_applied(program):
         raise ValueError(
-            "apply amp.rewrite_program before minimize()/append_backward")
+            "canonical order violated: this program already carries "
+            "fusion-tier ops (FLAGS_graph_opt_fuse), but AMP must be "
+            "rewritten FIRST (AMP rewrite -> fusion -> structural "
+            "passes).  Leave FLAGS_amp=train/on so the executor "
+            "applies both in order, or call amp.rewrite_program / "
+            "amp.rewrite_train_program before passes.fuse_program.")
+
+
+def _insert_casts(program, lists, dest):
+    """Shared cast-insertion core: rewire white/black-list ops' float
+    inputs through cast ops, keeping the ORIGINAL op objects (their
+    callsite/folded_from provenance must survive — the fusion matcher
+    and PR-5 attribution both read it).  Handles programs WITH backward
+    sections by remapping each section's `pos` past the inserted casts
+    and resetting the cast memo at every section boundary (each
+    segment traces into its own value_and_grad closure, so a cast
+    produced in one segment must not be referenced from another)."""
+    from ..framework.program import Block, Operator
+
     block = program.global_block()
+    ops = block.ops
+    boundaries = {bs.pos for bs in program.backward_sections}
     new_ops = []
+    pos_map = {}
     casted = {}       # (var, dtype) -> cast-output name
     n = [0]
 
     def cast_in(name, to):
+        # NO declared-dtype short-circuit: intermediate vars are
+        # declared float32 while their RUNTIME arrays may be bf16
+        # (white-op outputs flow through gray ops untouched), so the
+        # only sound pin is an explicit cast op — XLA elides the ones
+        # that turn out to be identities
         key = (name, to)
         if key not in casted:
             n[0] += 1
             out = f"{name}.cast_{to}_{n[0]}"
             block.create_var(name=out, dtype=to)
-            new_ops.append(Operator(
-                block, "cast", {"X": [name]}, {"Out": [out]},
-                {"in_dtype": None, "out_dtype": to}))
+            cast_op = Operator(block, "cast", {"X": [name]},
+                               {"Out": [out]},
+                               {"in_dtype": None, "out_dtype": to})
+            new_ops.append(cast_op)
             casted[key] = out
         return casted[key]
 
-    for op in block.ops:
-        if op.type in lists.unsupported_list:
+    for i, op in enumerate(ops):
+        pos_map[i] = len(new_ops)
+        if i in boundaries:
+            casted.clear()
+        if op.type in lists.unsupported_list or any(
+                isinstance(v, Block) for v in op.attrs.values()):
             new_ops.append(op)         # never cast these
             continue
         if op.type in lists.white_list:
@@ -233,16 +271,64 @@ def rewrite_program(program, amp_lists=None, dest_dtype=None):
                 else:
                     out_names.append(vn)
             ins[slot] = out_names
-        new_ops.append(Operator(block, op.type, None, None, op.attrs))
-        new_ops[-1].inputs = ins
-        new_ops[-1].outputs = op.outputs
+        op.inputs = ins
+        new_ops.append(op)
         # downstream consumers see the op's declared output dtype; the
         # interpreter propagates actual array dtypes, so no output cast
         # is needed until a black op pins fp32 again
-    block.ops[:] = new_ops
+    pos_map[len(ops)] = len(new_ops)
+    block.ops = new_ops
+    for bs in program.backward_sections:
+        bs.pos = pos_map[min(bs.pos, len(ops))]
     program.amp_enabled = True
     program._bump()
     return program
+
+
+def rewrite_program(program, amp_lists=None, dest_dtype=None):
+    """Insert cast ops so white-list ops compute in the AMP dtype and
+    black-list ops stay fp32 — the reference's rewrite_program
+    (fp16_utils.py:51/156) on this Program IR.  Parameters feeding
+    white ops are cast at use (fp32 master weights stay in scope).
+    Apply BEFORE minimize()/append_backward, like the quantization
+    pass; autodiff then differentiates through the casts.  For an
+    already-minimized program use :func:`rewrite_train_program` (the
+    executor's FLAGS_amp default-train path).  Idempotent: a program
+    whose ``amp_enabled`` flag is already set passes through."""
+    if program.amp_enabled:
+        return program
+    _check_canonical_order(program)
+    if program.backward_sections:
+        raise ValueError(
+            "apply amp.rewrite_program before minimize()/"
+            "append_backward (or use amp.rewrite_train_program — the "
+            "FLAGS_amp executor path — which remaps the backward "
+            "sections past the inserted casts)")
+    lists = amp_lists or AutoMixedPrecisionLists()
+    dest = dest_dtype or ("bfloat16" if flags.flag("amp_dtype") ==
+                          "bfloat16" else "float16")
+    return _insert_casts(program, lists, dest)
+
+
+def rewrite_train_program(program, amp_lists=None, dest_dtype=None):
+    """AMP-rewrite a program that ALREADY has backward sections (built
+    through minimize()/append_backward) — the executor's
+    FLAGS_amp=train default path for ``train_from_dataset``.
+
+    The casts are inserted exactly like :func:`rewrite_program`; each
+    BackwardSection's position is remapped past them, so the executor's
+    value_and_grad still splits the op list at the same logical
+    boundary and autodiff differentiates through the casts (fp32
+    master params, low-precision compute — grads come back fp32).
+    Idempotent, and refuses fused programs like the public rewrite
+    (canonical order: AMP → fusion → structural)."""
+    if program.amp_enabled:
+        return program
+    _check_canonical_order(program)
+    lists = amp_lists or AutoMixedPrecisionLists()
+    dest = dest_dtype or ("bfloat16" if flags.flag("amp_dtype") ==
+                          "bfloat16" else "float16")
+    return _insert_casts(program, lists, dest)
 
 
 # -- static-graph decorate ---------------------------------------------------

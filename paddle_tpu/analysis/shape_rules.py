@@ -816,6 +816,87 @@ def _layer_norm_rule(op, ins, attrs):
     }
 
 
+# ---------------------------------------------------------------------------
+# fusion-tier ops (ISSUE 14): real shape rules, not opaque entries —
+# the fused program must lint exactly as strictly as its source
+# subgraph did
+# ---------------------------------------------------------------------------
+
+@shape_rule("fused_attention")
+def _fused_attention_rule(op, ins, attrs):
+    # NO same-dtype requirement: a shared (multi-consumer) AMP cast
+    # may legitimately leave one of Q/K/V bf16 while the others'
+    # sole-consumed casts were absorbed — the kernel unifies on the
+    # promoted dtype, so mixed declared widths are not a lint error
+    q, v = one(ins, "Q"), one(ins, "V")
+    if not _known(q.shape) or not _known(v.shape) \
+            or len(q.shape) < 2 or len(v.shape) < 2:
+        return {"Out": VarSpec(None, q.dtype or v.dtype)}
+    heads = int(attrs.get("head_number", 0) or 0)
+    if heads and q.shape[-1] is not None and q.shape[-1] % heads != 0:
+        raise ShapeError(
+            f"fused_attention: feature dim {q.shape[-1]} not divisible "
+            f"by head_number {heads}")
+    # both layouts: Out keeps Q's leading dims and takes V's last dim
+    return {"Out": VarSpec(tuple(q.shape[:-1]) + (v.shape[-1],),
+                           q.dtype or v.dtype)}
+
+
+@shape_rule("fused_bias_act")
+def _fused_bias_act_rule(op, ins, attrs):
+    x, b = one(ins, "X"), one(ins, "Bias")
+    if _known(x.shape) and _known(b.shape) and b.shape \
+            and x.shape and x.shape[-1] is not None \
+            and len(b.shape) == 1 and b.shape[0] is not None:
+        axis = attrs.get("axis", -1)
+        at = (len(x.shape) - 1) if axis in (-1, None) else int(axis)
+        if 0 <= at < len(x.shape) and x.shape[at] is not None \
+                and x.shape[at] != b.shape[0]:
+            raise ShapeError(
+                f"fused_bias_act: bias length {b.shape[0]} does not "
+                f"match X dim {x.shape[at]} at axis {at}")
+    return {"Out": VarSpec(x.shape, x.dtype)}
+
+
+@shape_rule("fused_layer_norm")
+def _fused_layer_norm_rule(op, ins, attrs):
+    x = one(ins, "X")
+    res = ins.get("Residual")
+    if res is not None:
+        r = res[0] if isinstance(res, list) else res
+        if _known(x.shape) and _known(r.shape) \
+                and len(x.shape) == len(r.shape) \
+                and any(a is not None and b is not None and a != b
+                        for a, b in zip(x.shape, r.shape)):
+            raise ShapeError(
+                f"fused_layer_norm: residual shape {tuple(r.shape)} "
+                f"does not match X {tuple(x.shape)}")
+    axis = attrs.get("begin_norm_axis", 1)
+    lead = x.shape[:axis] if _known(x.shape) else None
+    return {
+        "Y": VarSpec(x.shape, x.dtype),
+        "Mean": VarSpec(lead, x.dtype),
+        "Variance": VarSpec(lead, x.dtype),
+    }
+
+
+@shape_rule("fused_bottleneck")
+def _fused_bottleneck_rule(op, ins, attrs):
+    # the conv half prices exactly like conv2d (same slots, the
+    # absorbed conv op's attrs ride under conv_attrs); the bn half
+    # mirrors batch_norm's stat outputs
+    conv_out = _conv2d_rule(op, ins, dict(attrs.get("conv_attrs")
+                                          or {}))["Output"]
+    mean, var = one(ins, "Mean"), one(ins, "Variance")
+    return {
+        "Y": conv_out,
+        "MeanOut": VarSpec(mean.shape, mean.dtype),
+        "VarianceOut": VarSpec(var.shape, var.dtype),
+        "SavedMean": VarSpec(mean.shape, mean.dtype),
+        "SavedVariance": VarSpec(var.shape, var.dtype),
+    }
+
+
 @shape_rule("lookup_table", "lookup_table_v2")
 def _lookup_rule(op, ins, attrs):
     ids, w = one(ins, "Ids"), one(ins, "W")

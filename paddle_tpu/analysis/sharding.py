@@ -1006,6 +1006,94 @@ def _propagate_op(ctx, op, i):
         _h_optimizer(ctx, op, i)
         return
 
+    if t == "fused_attention":
+        # per-head attention is independent: the output carries the
+        # joined Q/K/V layout (for the zoo's Megatron TP rules the
+        # head/feature dim rides the mp axis, batch rides dp; the
+        # contraction axes — head_dim and seq — are unsharded there)
+        qn = _first(op, "Q")
+        out_name = (op.outputs.get("Out") or [None])[0]
+        r = _rank(ctx, out_name)
+        acc = _broadcast_dims(ctx, qn, r)
+        ctx.resolve_partial(qn, op, i)
+        for slot in ("K", "V", "Mask"):
+            n = _first(op, slot)
+            if not n:
+                continue
+            ctx.resolve_partial(n, op, i)
+            merged, conflict = _merge_dims_pair(
+                acc, _broadcast_dims(ctx, n, r))
+            if conflict is not None:
+                d, a, b = conflict
+                ctx.diag(
+                    "PT305",
+                    f"conflicting sharding join at 'fused_attention': "
+                    f"'{qn}' and '{n}' disagree on dim {d} "
+                    f"(axes {a!r} vs {b!r}); '{n}' is "
+                    f"implied-resharded to "
+                    f"{ShardSpec(merged).render()}",
+                    op=op, op_index=i, var=n)
+                ctx.reshard(n, ctx.env.get(n, REPLICATED),
+                            ShardSpec(merged), op, i,
+                            why="conflicting-join resolution")
+            acc = merged
+        _bind_specs(ctx, op, {"Out": ShardSpec(acc)
+                              if acc is not None else REPLICATED})
+        return
+
+    if t == "fused_bias_act":
+        xn, bn = _first(op, "X"), _first(op, "Bias")
+        out_name = (op.outputs.get("Out") or [None])[0]
+        out = _join_elementwise(ctx, op, i, xn, bn,
+                                _rank(ctx, out_name))
+        _bind_specs(ctx, op, {"Out": out})
+        return
+
+    if t == "fused_layer_norm":
+        # residual join first (elementwise semantics), then the
+        # layer_norm trailing-dim reshard
+        xn, rn = _first(op, "X"), _first(op, "Residual")
+        r = _rank(ctx, xn)
+        if rn:
+            spec = _join_elementwise(ctx, op, i, xn, rn, r)
+        else:
+            spec = ctx.resolve_partial(xn, op, i)
+        ax = attrs.get("begin_norm_axis", 1)
+        if r:
+            dims = list((_aligned(spec, r).dims or [None] * r))
+            if any(dims[d] is not None for d in range(ax, r)):
+                dst = ShardSpec(dims[:ax] + [None] * (r - ax))
+                spec = ctx.reshard(xn, spec, dst, op, i,
+                                   why="fused_layer_norm normalizes "
+                                       "sharded trailing dims")
+        lead = ShardSpec((spec.dims or ())[:ax]) if spec.dims else \
+            REPLICATED
+        _bind_specs(ctx, op, {"Y": spec, "Mean": lead,
+                              "Variance": lead})
+        return
+
+    if t == "fused_bottleneck":
+        # conv half priced through the SAME conv2d rule (out-channel
+        # filter shards propagate, in-channel contraction pends a psum
+        # — the fused program lints exactly as strictly as its source
+        # subgraph); the bn half resolves that partial immediately
+        # (batch stats need the true sums, like the unfused bn
+        # consuming the conv output) and passes the running stats
+        # through like batch_norm
+        _h_conv(ctx, op, i, attrs=attrs.get("conv_attrs") or {},
+                out_slot="Y")
+        yn = (op.outputs.get("Y") or [None])[0]
+        if yn:
+            ctx.resolve_partial(yn, op, i)
+        out = {}
+        for oslot, islot in (("MeanOut", "Mean"),
+                             ("VarianceOut", "Variance")):
+            n = _first(op, islot)
+            if n:
+                out[oslot] = ctx.env.get(n, REPLICATED)
+        _bind_specs(ctx, op, out)
+        return
+
     # unknown family: degrade to replicated with a note, never a
     # false error (the PT204-for-sharding contract)
     sharded_ins = [n for n in op.input_names()
@@ -1146,14 +1234,18 @@ def _h_fc(ctx, op, i):
     _bind_specs(ctx, op, {"Out": ShardSpec(out_dims, partial)})
 
 
-def _h_conv(ctx, op, i):
+def _h_conv(ctx, op, i, attrs=None, out_slot="Output"):
     """conv2d: batch sharding passes through; filter out-channel
     sharding shards the output channel dim; in-channel (contraction)
     sharding pends a psum; sharded spatial dims gather (halo exchange
-    is not modeled)."""
+    is not modeled).  `attrs`/`out_slot` let fused_bottleneck price its
+    conv half through the SAME rule (its conv attrs ride nested, its
+    conv output slot is Y)."""
     xn, wn = _first(op, "Input"), _first(op, "Filter")
     xs = ctx.resolve_partial(xn, op, i)
-    nchw = op.attrs.get("data_format", "NCHW") in ("NCHW", "AnyLayout")
+    if attrs is None:
+        attrs = op.attrs
+    nchw = attrs.get("data_format", "NCHW") in ("NCHW", "AnyLayout")
     rx = _rank(ctx, xn)
     if rx != 4:
         _bind_specs(ctx, op, {})
@@ -1180,7 +1272,7 @@ def _h_conv(ctx, op, i):
     out_dims = [None] * 4
     out_dims[b_dim] = xd[b_dim]
     out_dims[c_dim] = co_axis
-    _bind_specs(ctx, op, {"Output": ShardSpec(
+    _bind_specs(ctx, op, {out_slot: ShardSpec(
         _dedupe_axes(out_dims, partial), partial)})
 
 

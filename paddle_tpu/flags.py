@@ -193,6 +193,35 @@ declare_flag("dp_bucket_bytes", 4 << 20,
              "Capacity in bytes of one flattened dp gradient-sync "
              "bucket (0 = one psum per gradient).")
 
+# Fusion pass tier (paddle_tpu.passes.fuse, ISSUE 14): pattern-match
+# attention / conv+bn / bias+act / layer_norm+residual subgraphs into
+# the fused ops whose kernels dispatch to paddle_tpu/kernels/ (flash
+# attention, Pallas layer_norm).  "train" (the default) fuses programs
+# going through the dataset train loop (train_from_dataset — the zoo
+# train path); "on" extends it to every executor-run train program and
+# joins the fusion tier into the FLAGS_graph_opt inference pipeline;
+# "off" never fuses.  With "off" (and FLAGS_amp=off) the executor is
+# byte-for-byte the PR-13 dispatch path.
+declare_flag("graph_opt_fuse", "train",
+             "Fusion pass tier: off | train (dataset train loop only) "
+             "| on (every train program + the graph_opt inference "
+             "pipeline).")
+declare_flag("graph_opt_fuse_disable", "",
+             "Comma-separated fusion pass names to skip (e.g. "
+             "'fuse_attention'); see passes.FUSION_PIPELINE.")
+
+# AMP-by-default train path (ISSUE 14): bf16 automatic mixed precision
+# via amp.rewrite_train_program on the executor's cloned substitute —
+# fp32 master params in scope, white-list ops (matmul/conv/fc) compute
+# in FLAGS_amp_dtype, black-list reductions pinned fp32, the PR-4
+# all-finite anomaly guard as the safety net.  Same trinary as the
+# fusion flag; canonical order is AMP rewrite -> fusion -> structural
+# passes (enforced with a loud error when violated).
+declare_flag("amp", "train",
+             "Automatic mixed precision for compiled train steps: "
+             "off | train (dataset train loop only) | on (every "
+             "executor-run train program).")
+
 # Inference-mode folding (passes.fold_inference): Predictor folds
 # test-mode batch_norms into conv/fc weights and collapses
 # scale/identity chains at load time.  Outputs are allclose — not

@@ -678,7 +678,7 @@ def op_scopes(ops, sections):
     return names
 
 
-def op_scope_names(program, fetch_names=()):
+def op_scope_names(program, fetch_names=(), train_loop=False):
     """Public provenance map for one program: [(scope, op)] in
     execution order, exactly the scopes the compiled step will emit —
     what monitor.op_profile checks attribution coverage against.
@@ -688,9 +688,16 @@ def op_scope_names(program, fetch_names=()):
     under their own (emitted) scopes and carry ``op.folded_from`` — the
     source ops' scope names — so attribution tools can map device time
     on a rewritten op back to what the user built instead of landing it
-    in ``(unattributed)``."""
+    in ``(unattributed)``.  ``train_loop=True`` additionally resolves
+    the FLAGS_amp / FLAGS_graph_opt_fuse train tier exactly as a
+    ``train_from_dataset`` dispatch would (their "train" default only
+    fires on that path)."""
     if hasattr(program, "_get_executable_program"):
         program = program._get_executable_program()
+    do_amp, do_fuse = Executor._train_tier_modes(program, train_loop)
+    if do_amp or do_fuse:
+        program = Executor._resolve_train_optimized(
+            program, list(fetch_names), do_amp, do_fuse)
     if flags.flag("graph_opt") == "on":
         program = Executor._resolve_optimized(program, list(fetch_names))
     ops = Executor._live_ops(program, list(fetch_names))
@@ -818,6 +825,7 @@ class Executor:
         scope=None,
         return_numpy=True,
         use_program_cache=True,
+        _train_loop=False,
     ):
         program = program if program is not None else default_main_program()
         mon = _mon()
@@ -849,6 +857,30 @@ class Executor:
         fetch_names = [
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
+
+        # Performance tier (ISSUE 14): bf16 AMP rewrite + fused-kernel
+        # pattern matching on a cloned substitute, in the canonical
+        # order AMP rewrite -> fusion -> structural passes (the
+        # graph_opt substitution below composes third).  FLAGS_amp /
+        # FLAGS_graph_opt_fuse default "train": they fire for programs
+        # dispatched by train_from_dataset (the zoo train path) and
+        # stay out of bare Executor.run unless set to "on" — with both
+        # "off", this costs two flag reads and the dispatch path is
+        # byte-for-byte the pre-fusion executor.
+        do_amp, do_fuse = self._train_tier_modes(program, _train_loop)
+        if do_amp or do_fuse:
+            tier_opt = self._resolve_train_optimized(
+                program, fetch_names, do_amp, do_fuse)
+            if tier_opt is not program:
+                # mirror the CURRENT sharding-rule attachment (same
+                # contract as the graph_opt substitution below): a
+                # re-attached or removed rule set must not keep linting
+                # a cached substitute against stale rules
+                rules = getattr(program, "_sharding_rules", None)
+                if getattr(tier_opt, "_sharding_rules", None) is not \
+                        rules:
+                    tier_opt._sharding_rules = rules
+            program = tier_opt
 
         # Graph-optimizer substitution (FLAGS_graph_opt=on): trace the
         # OPTIMIZED twin of the program — CSE/const-fold/identity/DCE
@@ -1198,6 +1230,68 @@ class Executor:
                 for n, f in zip(fetch_names, fetches)]
 
     @staticmethod
+    def _train_tier_modes(program, train_loop):
+        """(do_amp, do_fuse) for one dispatch: the ISSUE-14 performance
+        tier applies only to TRAIN programs (backward sections, not a
+        test clone); "train" mode further requires the dataset train
+        loop (train_from_dataset), "on" covers every Executor.run.
+        AMP is additionally skipped for programs the user already
+        rewrote (amp_enabled)."""
+        if program._is_test or not program.backward_sections:
+            return False, False
+        amp_mode = flags.flag("amp")
+        fuse_mode = flags.flag("graph_opt_fuse")
+        do_amp = (amp_mode == "on"
+                  or (amp_mode == "train" and train_loop)) \
+            and not program.amp_enabled
+        do_fuse = (fuse_mode == "on"
+                   or (fuse_mode == "train" and train_loop))
+        return do_amp, do_fuse
+
+    @staticmethod
+    def _resolve_train_optimized(program, fetch_names, do_amp, do_fuse):
+        """The AMP+fusion substitute for a train program — built once
+        per (version, fetch set, amp dtype, fusion config) and cached
+        in the same on-program ``_opt_cache`` the structural substitute
+        uses (``_bump()`` clears it), so the steady-state dispatch path
+        pays two flag reads and a dict probe.  Canonical order inside:
+        AMP rewrite first, fusion second; the FLAGS_graph_opt
+        structural tier (if on) then composes on the RESULT."""
+        from .. import passes as _passes
+
+        try:
+            fuse_names = (_passes.enabled_fusion_passes()
+                          if do_fuse else ())
+        except KeyError as e:
+            raise ValueError(
+                f"FLAGS_graph_opt_fuse_disable names an unknown "
+                f"fusion pass: {e}") from e
+        key = ("train_tier", program._version, tuple(fetch_names),
+               flags.flag("amp_dtype") if do_amp else None, fuse_names)
+        cache = getattr(program, "_opt_cache", None)
+        if cache:
+            hit = cache.get(key)
+            if hit is not None:
+                return hit
+        label = getattr(program, "_telemetry_label", None)
+        pkey = label or "prog%x:v%d" % (id(program), program._version)
+        opt = program.clone()
+        if do_amp:
+            from .. import amp as _amp
+
+            _amp.rewrite_train_program(opt)
+        if do_fuse:
+            _passes.fuse_program(opt, fetch_names=fetch_names,
+                                 clone=False, program_key=pkey)
+        opt._telemetry_label = label
+        if cache is None:
+            cache = program._opt_cache = {}
+        elif len(cache) >= 8:
+            cache.clear()
+        cache[key] = opt
+        return opt
+
+    @staticmethod
     def _resolve_optimized(program, fetch_names):
         """The optimized substitute for `program` under the current
         pass config — built once per (program version, fetch set, pass
@@ -1210,21 +1304,43 @@ class Executor:
 
         try:
             names = _passes.enabled_passes()
+            # the fusion tier composes with this pipeline when
+            # explicitly global — fusion FIRST (canonical order),
+            # structural cleanup after.  Programs the train tier
+            # already fused skip it (idempotent, but a re-scan per
+            # substitute build is pure waste and its report would be
+            # all-zero noise).
+            fuse_names = (
+                _passes.enabled_fusion_passes()
+                if flags.flag("graph_opt_fuse") == "on"
+                and not getattr(program, "_fusion_applied", False)
+                else ())
         except KeyError as e:
             raise ValueError(
-                f"FLAGS_graph_opt_disable names an unknown pass: {e}"
-            ) from e
-        key = (program._version, tuple(fetch_names), names)
+                f"FLAGS_graph_opt_disable / "
+                f"FLAGS_graph_opt_fuse_disable names an unknown pass: "
+                f"{e}") from e
+        key = (program._version, tuple(fetch_names), names, fuse_names)
         cache = getattr(program, "_opt_cache", None)
         if cache:
             hit = cache.get(key)
             if hit is not None:
                 return hit
         label = getattr(program, "_telemetry_label", None)
+        pkey = label or "prog%x:v%d" % (id(program), program._version)
+        src = program
+        if fuse_names:
+            # a separate, tier-tagged fuse_program run (not fuse_*
+            # names folded into optimize_program): the telemetry
+            # Fusion section keys on tier="fusion", and the structural
+            # section must not absorb pattern rows
+            src, _freport = _passes.fuse_program(
+                program, fetch_names=fetch_names, program_key=pkey)
         opt, _report = _passes.optimize_program(
-            program, fetch_names=fetch_names, passes=names,
-            program_key=label or "prog%x:v%d" % (id(program),
-                                                 program._version))
+            src, fetch_names=fetch_names, passes=names,
+            program_key=pkey,
+            # fuse_program already cloned; don't deep-copy twice
+            clone=src is program)
         opt._telemetry_label = label
         if cache is None:
             cache = program._opt_cache = {}
@@ -1838,7 +1954,8 @@ class Executor:
                     sno, f, flx = pending.pop(0)
                     try:
                         out = self.run(program, feed=f, fetch_list=flx,
-                                       scope=scope, return_numpy=False)
+                                       scope=scope, return_numpy=False,
+                                       _train_loop=True)
                     except res.RollbackPerformed as rb:
                         redo = [it for it in replay if it[0] > rb.step]
                         replay = [it for it in replay
@@ -1852,7 +1969,8 @@ class Executor:
             else:
                 try:
                     out = self.run(program, feed=feed, fetch_list=fl,
-                                   scope=scope, return_numpy=False)
+                                   scope=scope, return_numpy=False,
+                                   _train_loop=True)
                 except Exception as e:
                     _elastic_rethrow(e)
                     raise

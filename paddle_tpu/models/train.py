@@ -74,7 +74,7 @@ def _loss_with_buffers(model, params, buffers, rng, loss_fn, batch):
 
 def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
                     grad_psum_axis=None, remat=False, accum_steps=1,
-                    precision=None):
+                    precision=None, amp=None):
     """Build `step(state, *batch) -> (state, loss)`.
 
     loss_fn(model, *batch) -> scalar; defaults to model.loss.
@@ -113,6 +113,14 @@ def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
     None defers to FLAGS_conv_matmul_precision ("" = jax default) —
     the explicit bf16-MXU knob for perf A/Bs; numerics-sensitive runs
     pass "highest".
+    amp: True routes the loss computation through amp.auto_cast —
+    white-list ops (matmul/conv/fc functional kernels) compute in
+    FLAGS_amp_dtype (bf16 on TPU) against fp32 master params, black
+    ops pinned fp32.  None (the default) reads FLAGS_amp: "on" enables
+    it globally; the "train" default keeps the dygraph step fp32 (the
+    dataset train loop is the AMP-by-default path — see
+    amp.rewrite_train_program); False forces it off.  Compose with
+    make_amp_train_step for fp16 dynamic loss scaling.
     """
     if isinstance(remat, str) and remat != "conv_outs":
         raise ValueError(
@@ -131,7 +139,22 @@ def make_train_step(model, optimizer, loss_fn=None, jit=True, donate=True,
     # rng, and the batch all enter as explicit inputs (saved residuals),
     # never as closure-captured tracers, so the backward-pass recompute
     # trace owns every value it touches.
+    if amp is None:
+        from .. import flags as _flags
+
+        amp = _flags.flag("amp") == "on"
+
     def _loss_args(params, bufs, rng_key, *xs):
+        if amp:
+            # eager autocast around the whole forward: the functional
+            # kernels consult the list-driven dispatch per op, so the
+            # step traces with bf16 white ops and fp32 black ops while
+            # params (the grad targets) stay fp32 masters
+            from .. import amp as _amp
+
+            with _amp.auto_cast(enable=True):
+                return _loss_with_buffers(model, params, bufs, rng_key,
+                                          loss_fn, xs)
         return _loss_with_buffers(model, params, bufs, rng_key, loss_fn,
                                   xs)
 

@@ -336,6 +336,157 @@ def conv2d_inception_fusion(ins, attrs):
     return {"Output": jnp.concatenate(outs, axis=1)}
 
 
+# ---------------------------------------------------------------------------
+# Fusion-tier ops (ISSUE 14): the op types paddle_tpu.passes.fuse emits
+# when it pattern-matches a recorded Program.  Unlike the parity ops
+# above (which exist so saved ProgramDescs load), these four are never
+# written by a user: the fusion pass rewrites matched subgraphs into
+# them, and each kernel dispatches to the repo's fused/Pallas
+# implementations (kernels/attention.py flash path, kernels/layer_norm.py
+# Pallas LN) where shapes allow, composing the exact unfused primitives
+# otherwise so the fused program stays allclose to its source subgraph.
+# ---------------------------------------------------------------------------
+
+def _compute_cast(x, compute_dtype):
+    if not compute_dtype or x is None:
+        return x
+    import numpy as np
+
+    return x.astype(jnp.dtype(compute_dtype)) \
+        if hasattr(x, "astype") else np.asarray(x).astype(compute_dtype)
+
+
+@register_op("fused_attention")
+def fused_attention_op(ins, attrs):
+    """The attention subgraph — matmul(Q,K^T)·scale[·+mask]·softmax·
+    matmul(·,V), optionally with the zoo's split-heads reshape/transpose
+    ring absorbed — as ONE op.
+
+    attrs:
+      scale          — the logit scale (matmul alpha × the scale op).
+      head_number    — > 0 means Q/K/V are the PRE-split [B, T, H*D]
+                       projections (the full-ring match); the kernel
+                       splits heads itself and merges them back.  0
+                       means Q/K/V arrive already head-split (rank-4
+                       [B, H, S, D] takes the dot_product_attention /
+                       flash path, other ranks the generic matmul
+                       composition).
+      compute_dtype  — "" = inputs' own dtype; "bfloat16" when the
+                       fusion matcher absorbed AMP's white-list casts
+                       (the fused op re-applies the cast it swallowed).
+      softmax_axis   — must be the last axis (the matcher only fuses
+                       that form); kept for provenance.
+    The softmax always reduces in f32 (flash-attention convention) —
+    identical to the unfused graph at fp32, and strictly more accurate
+    than a bf16 softmax under AMP.
+    """
+    from ..kernels.attention import dot_product_attention
+
+    compute = attrs.get("compute_dtype", "")
+    q = _compute_cast(jnp.asarray(ins["Q"]), compute)
+    k = _compute_cast(jnp.asarray(ins["K"]), compute)
+    v = _compute_cast(jnp.asarray(ins["V"]), compute)
+    # a shared (multi-consumer) AMP cast may have fed only SOME inputs
+    # pre-cast: unify on the promoted dtype so the dots never mix
+    ct = jnp.result_type(q, k, v)
+    q, k, v = q.astype(ct), k.astype(ct), v.astype(ct)
+    mask = ins.get("Mask")
+    if mask is not None:
+        mask = jnp.asarray(mask)
+    scale = float(attrs.get("scale", 1.0))
+    heads = int(attrs.get("head_number", 0))
+    if heads > 0:
+        b, t, d = q.shape
+        hd = d // heads
+
+        def split(z):
+            return jnp.transpose(z.reshape(b, t, heads, hd),
+                                 (0, 2, 1, 3))
+
+        out = dot_product_attention(split(q), split(k), split(v),
+                                    mask=mask, scale=scale,
+                                    training=False)
+        return {"Out": jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, d)}
+    if q.ndim == 4:
+        return {"Out": dot_product_attention(q, k, v, mask=mask,
+                                             scale=scale,
+                                             training=False)}
+    logits = jnp.matmul(q, jnp.swapaxes(k, -1, -2)) * scale
+    if mask is not None:
+        logits = (jnp.where(mask, logits, -1e9)
+                  if mask.dtype == jnp.bool_ else logits + mask)
+    probs = jax.nn.softmax(logits.astype(jnp.float32),
+                           axis=-1).astype(q.dtype)
+    return {"Out": jnp.matmul(probs, v)}
+
+
+@register_op("fused_bias_act")
+def fused_bias_act_op(ins, attrs):
+    """bias-add + activation chain (fc/conv epilogue) as one op.  The
+    kernel delegates to the exact unfused primitives (elementwise_add's
+    reference axis broadcast + the registered activation kernel), so the
+    fused program is bitwise the unfused subgraph — the fusion win is
+    one op for XLA to schedule instead of two, and one attribution scope
+    instead of two."""
+    add = get_op("elementwise_add")
+    h = add.fn({"X": ins["X"], "Y": ins["Bias"]},
+               {"axis": attrs.get("axis", -1)})["Out"]
+    act = attrs.get("act", "relu")
+    return {"Out": get_op(act).fn({"X": h},
+                                  dict(attrs.get("act_attrs")
+                                       or {}))["Out"]}
+
+
+@register_op("fused_layer_norm")
+def fused_layer_norm_op(ins, attrs):
+    """residual-add + layer_norm as one op (the transformer block's
+    `layer_norm(x + sublayer(x))`).  Delegates to the registered
+    layer_norm kernel, which routes last-axis norms through the Pallas
+    fused kernel on TPU under FLAGS_use_pallas_layer_norm."""
+    x = jnp.asarray(ins["X"])
+    res = ins.get("Residual")
+    if res is not None:
+        x = x + jnp.asarray(res)
+    ln_ins = {"X": x}
+    for slot in ("Scale", "Bias"):
+        if ins.get(slot) is not None:
+            ln_ins[slot] = ins[slot]
+    return get_op("layer_norm").fn(ln_ins, attrs)
+
+
+@register_op("fused_bottleneck", stateful=True)
+def fused_bottleneck_op(ins, attrs):
+    """conv2d + batch_norm (+ activation) as one op — the cuDNN
+    conv+BN+relu bottleneck of the reference's fused tier, TPU-native.
+    Training-capable: the batch-norm half keeps its running-stat
+    updates (MeanOut/VarianceOut alias Mean/Variance — stateful, like
+    batch_norm itself).  attrs carry the source ops' attr dicts
+    verbatim under conv_attrs / bn_attrs plus the absorbed activation
+    name under act ("" = none) and the AMP compute_dtype the matcher
+    swallowed (casts Input/Filter like the white-list casts it
+    replaced)."""
+    compute = attrs.get("compute_dtype", "")
+    conv = get_op("conv2d")
+    x = _compute_cast(jnp.asarray(ins["Input"]), compute)
+    w = _compute_cast(jnp.asarray(ins["Filter"]), compute)
+    if w.dtype != x.dtype:
+        # a shared AMP cast may have fed only one side pre-cast;
+        # lax.conv requires matching dtypes — follow the input
+        w = w.astype(x.dtype)
+    y = conv.fn({"Input": x, "Filter": w},
+                dict(attrs.get("conv_attrs") or {}))["Output"]
+    bn = get_op("batch_norm")
+    out = bn.fn({"X": y, "Scale": ins["Scale"], "Bias": ins["Bias"],
+                 "Mean": ins["Mean"], "Variance": ins["Variance"]},
+                dict(attrs.get("bn_attrs") or {}))
+    act = attrs.get("act", "")
+    if act:
+        out["Y"] = get_op(act).fn({"X": out["Y"]},
+                                  dict(attrs.get("act_attrs")
+                                       or {}))["Out"]
+    return out
+
+
 @register_op("fused_embedding_fc_lstm")
 def fused_embedding_fc_lstm(ins, attrs):
     """fused/fused_embedding_fc_lstm_op.cc — embedding lookup folded into

@@ -42,10 +42,14 @@ import time
 from .. import flags
 from .common import const_fold, cse, dce, identity_elim
 from .fold import fold_batch_norm, fold_scale_chain
+from .fuse import (FUSED_TIER_TYPES, fuse_attention, fuse_bias_act,
+                   fuse_bottleneck, fuse_layer_norm)
 from .rewriter import ProgramRewriter
 
-__all__ = ["PASSES", "DEFAULT_PIPELINE", "optimize_program",
-           "fold_inference", "enabled_passes", "ProgramRewriter"]
+__all__ = ["PASSES", "DEFAULT_PIPELINE", "FUSION_PIPELINE",
+           "FUSED_TIER_TYPES", "optimize_program", "fuse_program",
+           "fold_inference", "enabled_passes", "enabled_fusion_passes",
+           "ProgramRewriter"]
 
 PASSES = {
     "const_fold": const_fold,
@@ -54,12 +58,27 @@ PASSES = {
     "fold_scale_chain": fold_scale_chain,
     "fold_batch_norm": fold_batch_norm,
     "dce": dce,
+    # fusion tier (ISSUE 14): pattern -> fused-kernel ops.  NOT in
+    # DEFAULT_PIPELINE — the structural tier stays byte-identical to
+    # PR 9; the fusion tier rides FLAGS_graph_opt_fuse (train path) or
+    # joins the FLAGS_graph_opt pipeline when that flag is "on".
+    "fuse_attention": fuse_attention,
+    "fuse_bottleneck": fuse_bottleneck,
+    "fuse_bias_act": fuse_bias_act,
+    "fuse_layer_norm": fuse_layer_norm,
 }
 
 # order matters: folding creates constants/identities the later passes
 # clean up, and dce runs last to sweep every orphaned producer
 DEFAULT_PIPELINE = ("const_fold", "cse", "identity_elim",
                     "fold_scale_chain", "fold_batch_norm", "dce")
+
+# fusion tier order: attention first (the biggest subgraph — bias_act
+# firing first would not overlap it, but keeping the large pattern
+# greedy is the cheap way to never have a small fuse shadow a big one),
+# then the conv+bn bottleneck, then the epilogue/residual pairs
+FUSION_PIPELINE = ("fuse_attention", "fuse_bottleneck",
+                   "fuse_bias_act", "fuse_layer_norm")
 
 
 def enabled_passes(disable=None):
@@ -70,20 +89,27 @@ def enabled_passes(disable=None):
     if isinstance(disable, str):
         disable = [p.strip() for p in disable.split(",") if p.strip()]
     disable = set(disable)
-    unknown = disable - set(PASSES)
+    # validate against the STRUCTURAL pipeline, not the full PASSES
+    # table: a fusion pass name here would silently do nothing (the
+    # fusion tier has its own FLAGS_graph_opt_fuse_disable knob), and
+    # a knob that does nothing must say so loudly
+    unknown = disable - set(DEFAULT_PIPELINE)
     if unknown:
         raise KeyError(
             f"unknown graph-opt pass(es) {sorted(unknown)}; known: "
-            f"{list(DEFAULT_PIPELINE)}")
+            f"{list(DEFAULT_PIPELINE)} (fusion passes are disabled via "
+            f"FLAGS_graph_opt_fuse_disable)")
     return tuple(p for p in DEFAULT_PIPELINE if p not in disable)
 
 
 def optimize_program(program, fetch_names=(), feed_names=(),
                      params=None, passes=None, disable=None,
-                     program_key=None, record=True):
+                     program_key=None, record=True, clone=True):
     """Run the pass pipeline over a CLONE of `program` and return
     ``(optimized_program, report)``.  The input program is never
-    mutated.
+    mutated (clone=False rewrites `program` itself — for callers that
+    already cloned, e.g. the executor composing fuse_program's output
+    into this pipeline without paying a second deep copy).
 
     params: optional {name: ndarray} of concrete parameter values —
     enables the value-based folds, which update the dict IN PLACE
@@ -101,7 +127,7 @@ def optimize_program(program, fetch_names=(), feed_names=(),
         raise KeyError(f"unknown graph-opt pass(es) {sorted(unknown)}")
     t0 = time.perf_counter()
     # clone() carries _folded_constants; passes may add more
-    opt = program.clone(for_test=program._is_test)
+    opt = program.clone(for_test=program._is_test) if clone else program
     rw = ProgramRewriter(opt, fetch_names=fetch_names,
                          feed_names=feed_names, params=params)
     before = len(rw.ops)
@@ -117,6 +143,68 @@ def optimize_program(program, fetch_names=(), feed_names=(),
         "before_ops": before,
         "after_ops": len(rw.ops),
         "ops_removed": before - len(rw.ops),
+        "passes": rows,
+        "total_wall_ms": round((time.perf_counter() - t0) * 1e3, 3),
+    }
+    if record:
+        from .. import monitor
+
+        monitor.record_pass_pipeline(report)
+    return opt, report
+
+
+def enabled_fusion_passes(disable=None):
+    """The fusion pipeline minus ``disable`` (an iterable of names, or
+    None to read ``FLAGS_graph_opt_fuse_disable`` — comma-separated)."""
+    if disable is None:
+        disable = flags.flag("graph_opt_fuse_disable")
+    if isinstance(disable, str):
+        disable = [p.strip() for p in disable.split(",") if p.strip()]
+    disable = set(disable)
+    unknown = disable - set(FUSION_PIPELINE)
+    if unknown:
+        raise KeyError(
+            f"unknown fusion pass(es) {sorted(unknown)}; known: "
+            f"{list(FUSION_PIPELINE)}")
+    return tuple(p for p in FUSION_PIPELINE if p not in disable)
+
+
+def fuse_program(program, fetch_names=(), feed_names=(), clone=True,
+                 disable=None, program_key=None, record=True):
+    """Run the FUSION tier (ISSUE 14) over `program` and return
+    ``(fused_program, report)``.
+
+    clone=True (the default) rewrites a clone like
+    :func:`optimize_program`; clone=False rewrites `program` itself —
+    the executor's train-tier path, which has already cloned (AMP
+    rewrite → fusion run on the same private substitute, preserving
+    the canonical order).
+
+    The report is a ``kind="pass_pipeline"`` record tagged
+    ``tier="fusion"`` whose per-pass rows carry the pattern match
+    counts (``matched``) — what ``tools/program_opt.py --fuse`` and the
+    telemetry report's Fusion section read."""
+    names = enabled_fusion_passes(disable)
+    t0 = time.perf_counter()
+    opt = program.clone(for_test=program._is_test) if clone else program
+    rw = ProgramRewriter(opt, fetch_names=fetch_names,
+                         feed_names=feed_names)
+    before = len(rw.ops)
+    rows = []
+    for name in names:
+        stats = rw.timed(PASSES[name])
+        stats["name"] = name
+        rows.append(stats)
+    opt._fusion_applied = True
+    report = {
+        "kind": "pass_pipeline",
+        "tier": "fusion",
+        "key": program_key or "prog%x:v%d" % (id(program),
+                                              program._version),
+        "before_ops": before,
+        "after_ops": len(rw.ops),
+        "ops_removed": before - len(rw.ops),
+        "patterns_matched": sum(r.get("matched", 0) for r in rows),
         "passes": rows,
         "total_wall_ms": round((time.perf_counter() - t0) * 1e3, 3),
     }

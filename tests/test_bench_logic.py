@@ -293,6 +293,39 @@ def test_graph_opt_sweep_row_shape():
 
 
 # ---------------------------------------------------------------------------
+# fused_amp_sweep row (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_amp_sweep_in_suite_and_standalone():
+    """The fusion+AMP sweep row is wired into the suite AND the
+    standalone argv entry (the matcher/AMP behaviors themselves are
+    covered end-to-end by tests/test_fuse.py; re-running the 20-config
+    grid here would pay its compiles twice per CI run for no new
+    signal)."""
+    src = open(bench.__file__).read()
+    assert '("fused_amp_sweep", "fused_amp_sweep"' in src
+    assert '"fused_amp_sweep" in sys.argv[1:]' in src
+    assert "main_fused_amp_sweep" in src
+
+
+def test_fused_amp_sweep_row_shape():
+    """The sweep row's check list carries the acceptance pillars:
+    per-lever isolation, all-fused-configs allclose, pattern coverage,
+    AMP casts in the compiled graph, cost_analysis MFU, the <=1%
+    fused attribution residual, and the TPU-armed step-time gates."""
+    src = open(bench.__file__).read()
+    for check in ("all_fused_configs_allclose",
+                  "per_lever_deltas_isolated",
+                  "fusion_step_reduction_2_models",
+                  "fused_amp_step_reduction_2_models",
+                  "patterns_fired_all_fusable_models",
+                  "amp_casts_in_graph", "mfu_reported",
+                  "fused_unattributed_residual_le_1pct"):
+        assert check in src, check
+
+
+# ---------------------------------------------------------------------------
 # fleet_obs_smoke row (ISSUE 10 satellite)
 # ---------------------------------------------------------------------------
 

@@ -280,7 +280,20 @@ def test_train_from_dataset_syncs_at_print_period_boundaries(
 def test_train_from_dataset_deferred_fetches_match_blocking_loop():
     """Acceptance: deferred fetches are numerically identical to the
     pre-change blocking path (same program, same init, same batches,
-    one exe.run per step in both)."""
+    one exe.run per step in both).  The ISSUE-14 AMP/fusion train tier
+    is pinned off: it applies to the dataset loop but not to a bare
+    exe.run loop, and this test's contract is the fetch-deferral
+    machinery, not the train tier's (documented) numerics change."""
+    entry = fluid.get_flags(["FLAGS_amp", "FLAGS_graph_opt_fuse"])
+    fluid.set_flags({"FLAGS_amp": "off",
+                     "FLAGS_graph_opt_fuse": "off"})
+    try:
+        _deferred_matches_blocking()
+    finally:
+        fluid.set_flags(entry)
+
+
+def _deferred_matches_blocking():
     batches = _batches(5)
 
     main, startup, loss = _train_program()

@@ -258,12 +258,24 @@ def _batches(n, seed=0):
             for _ in range(n)]
 
 
-def _reference_weights(main, startup, loss, batches):
+def _reference_weights(main, startup, loss, batches, train_loop=False):
+    """Uninterrupted reference for the recovery tests.  train_loop=True
+    routes it through train_from_dataset itself, so a test whose body
+    trains through the dataset loop compares against the SAME dispatch
+    path (including the ISSUE-14 AMP/fusion train tier that loop
+    applies by default) and its bitwise assertion pins the recovery
+    machinery, not a path difference; tests driving bare exe.run loops
+    keep the bare-loop reference."""
     exe = fluid.Executor()
     sc = fluid.Scope()
     exe.run(startup, scope=sc)
-    for b in batches:
-        exe.run(main, feed=b, fetch_list=[loss], scope=sc)
+    if train_loop:
+        exe.train_from_dataset(main, list(batches), scope=sc,
+                               fetch_list=[loss], print_period=100,
+                               prefetch=False)
+    else:
+        for b in batches:
+            exe.run(main, feed=b, fetch_list=[loss], scope=sc)
     return np.asarray(sc.find_var("fc_0.w_0"))
 
 
@@ -418,7 +430,7 @@ def test_preempt_then_auto_resume_bitwise_identical(mon, tmp_path):
     finishes bitwise-identical to an uninterrupted run."""
     main, startup, loss = _build_program()
     batches = _batches(8)
-    ref_w = _reference_weights(main, startup, loss, batches)
+    ref_w = _reference_weights(main, startup, loss, batches, train_loop=True)
 
     exe = fluid.Executor()
     sc = fluid.Scope()
@@ -471,7 +483,7 @@ def test_train_from_dataset_rollback_replays_cursor(mon, tmp_path):
     one uninterrupted-equivalent run."""
     main, startup, loss = _build_program()
     batches = _batches(7)
-    ref_w = _reference_weights(main, startup, loss, batches)
+    ref_w = _reference_weights(main, startup, loss, batches, train_loop=True)
 
     mgr = CheckpointManager(tmp_path, save_interval_steps=2)
     exe = fluid.Executor()
@@ -499,7 +511,7 @@ def test_train_from_dataset_rollback_without_checkpoint_kwarg(mon,
     has a restore point), never letting RollbackPerformed escape."""
     main, startup, loss = _build_program()
     batches = _batches(5)
-    ref_w = _reference_weights(main, startup, loss, batches)
+    ref_w = _reference_weights(main, startup, loss, batches, train_loop=True)
     mgr = CheckpointManager(tmp_path, save_interval_steps=2)
     exe = fluid.Executor()
     sc = fluid.Scope()

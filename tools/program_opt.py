@@ -43,13 +43,23 @@ def _diff(before, after):
     return out
 
 
-def _optimize_one(name, program, fetches, disable, as_json):
+def _optimize_one(name, program, fetches, disable, as_json,
+                  fuse=False):
     from paddle_tpu import passes
 
     before_types = _op_types(program)
-    opt, report = passes.optimize_program(
-        program, fetch_names=fetches, disable=disable,
-        program_key=name, record=False)
+    if fuse:
+        # canonical order: the fusion tier runs FIRST, the structural
+        # pipeline cleans up after it
+        names = passes.enabled_fusion_passes() + tuple(
+            p for p in passes.enabled_passes(disable=disable))
+        opt, report = passes.optimize_program(
+            program, fetch_names=fetches, passes=names,
+            program_key=name, record=False)
+    else:
+        opt, report = passes.optimize_program(
+            program, fetch_names=fetches, disable=disable,
+            program_key=name, record=False)
     after_types = _op_types(opt)
     row = {
         "program": name,
@@ -60,10 +70,16 @@ def _optimize_one(name, program, fetches, disable, as_json):
         "passes": [
             {"name": p["name"],
              "removed": p["before_ops"] - p["after_ops"],
-             "wall_ms": p["wall_ms"]}
+             "wall_ms": p["wall_ms"],
+             **({"matched": p["matched"]} if p.get("matched") is not
+                None and p["name"].startswith("fuse_") else {})}
             for p in report["passes"]],
         "op_diff": _diff(before_types, after_types),
     }
+    if fuse:
+        row["patterns_matched"] = {
+            p["name"]: p.get("matched", 0)
+            for p in report["passes"] if p["name"].startswith("fuse_")}
     fc = getattr(opt, "_folded_constants", None)
     if fc:
         row["folded_constants"] = sorted(fc)
@@ -76,7 +92,10 @@ def _optimize_one(name, program, fetches, disable, as_json):
           f"(-{row['ops_removed']}, {pct:.1f}%)")
     for p in row["passes"]:
         mark = f"-{p['removed']}" if p["removed"] else " 0"
-        print(f"  {p['name']:<18} {mark:>5} ops  {p['wall_ms']:8.2f} ms")
+        matched = (f"  {p['matched']} matched"
+                   if p.get("matched") else "")
+        print(f"  {p['name']:<18} {mark:>5} ops  "
+              f"{p['wall_ms']:8.2f} ms{matched}")
     if row["op_diff"]:
         print(f"  op diff: {row['op_diff']}")
     if fc:
@@ -115,6 +134,11 @@ def main(argv=None):
                          "program instead of the train program")
     ap.add_argument("--disable", default="",
                     help="comma-separated pass names to skip")
+    ap.add_argument("--fuse", action="store_true",
+                    help="run the ISSUE-14 fusion tier first "
+                         "(attention / conv+bn / bias+act / "
+                         "layer_norm+residual pattern matching) and "
+                         "print per-pattern match counts")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="one JSON row per program instead of text")
     args = ap.parse_args(argv)
@@ -129,7 +153,8 @@ def main(argv=None):
                     else model.main)
             fetches = ([model.loss_name] if args.test_mode
                        else list(model.fetches))
-            _optimize_one(name, prog, fetches, disable, args.as_json)
+            _optimize_one(name, prog, fetches, disable, args.as_json,
+                          fuse=args.fuse)
         return 0
     if not args.target:
         ap.error("need a program path or --all-models")
@@ -138,7 +163,7 @@ def main(argv=None):
         prog = prog.clone(for_test=True)
     fetches = args.fetches or saved_fetches
     _optimize_one(os.path.basename(args.target.rstrip("/")), prog,
-                  fetches, disable, args.as_json)
+                  fetches, disable, args.as_json, fuse=args.fuse)
     return 0
 
 

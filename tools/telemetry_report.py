@@ -104,6 +104,9 @@ def summarize(records):
     pass_rows = _passes_section(records)
     if pass_rows:
         out["passes"] = pass_rows
+    fusion = _fusion_section(records)
+    if fusion:
+        out["fusion"] = fusion
     resil = _resilience_section(steps)
     if resil:
         out["resilience"] = resil
@@ -284,7 +287,10 @@ def _passes_section(records):
     for the gradient-sync emissions."""
     per_key = {}
     for r in records:
-        if r.get("kind") == "pass_pipeline":
+        if r.get("kind") == "pass_pipeline" \
+                and r.get("tier") != "fusion":
+            # fusion-tier records have their own section — counting
+            # their removals here too would double-book them
             per_key[r.get("key")] = r
     if not per_key:
         return None
@@ -339,6 +345,53 @@ def _passes_section(records):
         out["buckets_formed"] = total_buckets
     if total_fallbacks:
         out["bucket_fallbacks"] = total_fallbacks
+    return out
+
+
+def _fusion_section(records):
+    """Fusion-tier summary (ISSUE 14) from the kind="pass_pipeline"
+    records tagged tier="fusion" (passes.fuse_program): per program
+    key (newest wins) the patterns that fired with their match counts,
+    ops removed, and per-pattern wall time."""
+    per_key = {}
+    for r in records:
+        if r.get("kind") == "pass_pipeline" and r.get("tier") == \
+                "fusion":
+            per_key[r.get("key")] = r
+    if not per_key:
+        return None
+    out = {"programs": len(per_key)}
+    progs = {}
+    total_matched = 0
+    total_removed = 0
+    for k, r in per_key.items():
+        patterns = {}
+        for p in r.get("passes", ()):
+            row = {}
+            if p.get("matched"):
+                row["matched"] = p["matched"]
+            removed = ((p.get("before_ops") or 0)
+                       - (p.get("after_ops") or 0))
+            if removed:
+                row["ops_removed"] = removed
+            if p.get("wall_ms") is not None and row:
+                row["wall_ms"] = p["wall_ms"]
+            if row:
+                patterns[p.get("name", "?")] = row
+        entry = {
+            "patterns_matched": r.get("patterns_matched", 0),
+            "ops_removed": r.get("ops_removed", 0),
+        }
+        if patterns:
+            entry["patterns"] = patterns
+        if r.get("total_wall_ms") is not None:
+            entry["total_wall_ms"] = r["total_wall_ms"]
+        progs[k] = entry
+        total_matched += entry["patterns_matched"] or 0
+        total_removed += entry["ops_removed"] or 0
+    out["by_program"] = progs
+    out["patterns_matched_total"] = total_matched
+    out["ops_removed_total"] = total_removed
     return out
 
 
