@@ -1853,6 +1853,259 @@ def main_sharding_lint_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_numerics_lint_smoke(on_tpu, peak):
+    """Numerics-analyzer smoke row (ISSUE 15): four pillars.
+
+    (a) Zoo lint: every bundled static model's TRAIN substitute — the
+    AMP+fused program the executor actually dispatches under the
+    default FLAGS_amp=train + FLAGS_graph_opt_fuse=train — is
+    PT4xx-CLEAN (no numerics finding of ANY severity), with the
+    analyzer wall time recorded so a perf regression is a number.
+
+    (b) Seeded codes: one known-bad program per PT4xx code
+    (PT401..PT407) asserting EXACTLY its expected code comes out, with
+    no unexpected PT4xx error alongside.
+
+    (c) Runtime-divergence conformance: the seeded PT401 program (log
+    in bf16 of values near 1.0 — bf16's 2^-8 spacing at 1.0 rounds the
+    offset away) actually diverges past the fused_amp_sweep bf16
+    tolerance (rtol 7e-2) at runtime, while its lint-clean fp32 twin
+    matches the numpy reference — the lint provably predicts a real
+    numerics failure, not a style preference.
+
+    (d) Churn conformance: the PT403 removable-churn count on a seeded
+    cast-churn program equals EXACTLY the number of cast ops the
+    structural pass pipeline (cse + identity_elim) then deletes — the
+    lint and the optimizer share one definition of "redundant cast".
+    """
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import analysis, layers as L, passes
+    from paddle_tpu.framework.executor import Executor, Scope
+    from paddle_tpu.models import static_zoo
+
+    checks = {}
+
+    # ---- (a) zoo substitutes PT4xx-clean ------------------------------
+    t0 = time.perf_counter()
+    zoo_pt4 = {}
+    zoo_errors = {}
+    ops_linted = 0
+    for name, model in sorted(static_zoo.build_all().items()):
+        sub = Executor._resolve_train_optimized(
+            model.main, model.fetches, True, True)
+        r = analysis.check_program(sub, fetch_names=model.fetches,
+                                   program_key=f"{name}/train_tier")
+        zoo_pt4[name] = sum(n for c, n in r.by_code().items()
+                            if c.startswith("PT4"))
+        zoo_errors[name] = len(r.errors)
+        ops_linted += len(sub.global_block().ops)
+    lint_wall_ms = (time.perf_counter() - t0) * 1e3
+    checks["zoo_pt4xx_clean"] = all(v == 0 for v in zoo_pt4.values())
+    checks["zoo_zero_errors"] = all(v == 0 for v in zoo_errors.values())
+    checks["zoo_covered"] = len(zoo_pt4) == len(static_zoo.BUILDERS)
+
+    # ---- (b) one seeded-bug program per PT4xx code --------------------
+    def _expect(code, build, **kw):
+        """Build a seeded program, lint, and require the expected code
+        WITHOUT any unexpected PT4xx error riding along (an analyzer
+        regression spraying bogus errors must fail this row)."""
+        with fluid.unique_name.guard():
+            main = fluid.Program()
+            with fluid.program_guard(main, fluid.Program()):
+                fetches, feeds = build(main)
+        r = analysis.check_program(main, fetch_names=fetches,
+                                   feed_names=feeds, **kw)
+        got = r.by_code()
+        bad = {c for c in got if c.startswith("PT4")
+               and c != code and analysis.CODES[c][0] == "error"}
+        return code in got and not bad
+
+    def _pt401(main):
+        x = fluid.data("x", [None, 8])
+        return [L.log(L.cast(x, "bfloat16")).name], ["x"]
+
+    def _pt402(main):
+        p = main.global_block().create_parameter(
+            name="w", shape=[4], dtype="bfloat16")
+        g = fluid.data("g", [4])
+        lr = fluid.data("lr", [1])
+        main.global_block().append_op(
+            "sgd", inputs={"Param": p, "Grad": g, "LearningRate": lr},
+            outputs={"ParamOut": p})
+        return None, ["g", "lr"]
+
+    def _pt403(main):
+        x = fluid.data("x", [None, 8])
+        a = L.cast(x, "bfloat16")
+        b = L.cast(x, "bfloat16")           # duplicate (cse removes)
+        c = L.cast(a, "bfloat16")           # identity (identity_elim)
+        out = L.elementwise_add(L.relu(a), L.relu(b))
+        return [out.name, L.relu(c).name], ["x"]
+
+    def _pt404(main):
+        x = fluid.data("x", [4, 100000])
+        return [L.reduce_sum(L.cast(x, "bfloat16"), dim=[1]).name], \
+            ["x"]
+
+    def _pt405(main):
+        from paddle_tpu import amp
+
+        x = fluid.data("x", [None, 8])
+        y = fluid.data("y", [None, 1])
+        loss = L.mean(L.square_error_cost(L.fc(x, 1), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        amp.rewrite_train_program(main, dest_dtype="float16")
+        return [loss.name], ["x", "y"]
+
+    def _pt407(main):
+        x = fluid.data("x", [None, 8])
+        o = main.global_block().create_var(name="drift", shape=[None, 8],
+                                           dtype="float32")
+        main.global_block().append_op(
+            "relu", inputs={"X": L.cast(x, "bfloat16")},
+            outputs={"Out": o})
+        return ["drift"], ["x"]
+
+    seeded = {
+        "fragile_bf16_PT401": _expect("PT401", _pt401),
+        "lost_master_PT402": _expect("PT402", _pt402),
+        "cast_churn_PT403": _expect("PT403", _pt403),
+        "bf16_accumulation_PT404": _expect("PT404", _pt404),
+        "fp16_no_scaling_PT405": _expect("PT405", _pt405),
+        "fetch_drift_PT407": _expect("PT407", _pt407),
+    }
+
+    # PT406 seeds through the fusion tier: an attention pattern whose
+    # softmax probs leak to a second consumer — the matcher must name
+    # the multi_consumer guard
+    def _attn(leak):
+        main = fluid.Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, fluid.Program()):
+                q = fluid.data("q", [2, 4, 8, 16])
+                k = fluid.data("k", [2, 4, 8, 16])
+                v = fluid.data("v", [2, 4, 8, 16])
+                p = L.softmax(L.scale(L.matmul(q, k, transpose_y=True),
+                                      scale=0.25))
+                o = L.matmul(p, v)
+                extra = L.relu(p) if leak else None
+        fetches = [o.name] + ([extra.name] if leak else [])
+        return main, fetches
+
+    near_prog, near_fetches = _attn(True)
+    fused, _rep = passes.fuse_program(near_prog,
+                                      fetch_names=near_fetches)
+    near_lint = analysis.check_program(fused, fetch_names=near_fetches)
+    near = getattr(fused, "_fusion_near_misses", [])
+    seeded["fusion_near_miss_PT406"] = (
+        "PT406" in near_lint.by_code()
+        and any(nm.get("guard") == "multi_consumer" for nm in near))
+    # guard flip: remove the leaking consumer and the SAME pattern
+    # matches — proof the named guard was the real blocker
+    ok_prog, ok_fetches = _attn(False)
+    refused, _rep2 = passes.fuse_program(ok_prog,
+                                         fetch_names=ok_fetches)
+    seeded["near_miss_guard_flip_fuses"] = (
+        any(op.type == "fused_attention"
+            for op in refused.global_block().ops)
+        and not getattr(refused, "_fusion_near_misses", []))
+    checks.update(seeded)
+
+    # ---- (c) seeded PT401 diverges at runtime -------------------------
+    # log(1.001) in bf16: 1.001 rounds to 1.0 (spacing 2^-8), log -> 0
+    # instead of ~1e-3 — relative error ~1.0, far past the
+    # fused_amp_sweep bf16 tolerance (rtol 7e-2); the fp32 twin is
+    # byte-exact against numpy
+    def _log_prog(low):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.unique_name.guard():
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [None, 64])
+                h = L.cast(x, "bfloat16") if low else x
+                out = L.mean(L.log(h))
+        return main, out.name
+
+    xb = np.full((4, 64), 1.001, np.float32)
+    ref = float(np.mean(np.log(xb.astype(np.float64))))
+    exe = fluid.Executor()
+    vals = {}
+    for tag, low in (("bf16", True), ("fp32", False)):
+        main, out_name = _log_prog(low)
+        vals[tag] = float(np.asarray(exe.run(
+            main, feed={"x": xb}, fetch_list=[out_name],
+            scope=Scope())[0]))
+    rel_bf16 = abs(vals["bf16"] - ref) / max(abs(ref), 1e-12)
+    rel_fp32 = abs(vals["fp32"] - ref) / max(abs(ref), 1e-12)
+    checks["seeded_pt401_diverges_past_tolerance"] = rel_bf16 > 7e-2
+    checks["lint_clean_twin_within_tolerance"] = rel_fp32 <= 7e-2
+
+    # ---- (d) PT403 churn count == structurally removed casts ----------
+    with fluid.unique_name.guard():
+        churn_main = fluid.Program()
+        with fluid.program_guard(churn_main, fluid.Program()):
+            churn_fetches, churn_feeds = _pt403(churn_main)
+    churn_lint = analysis.check_program(churn_main,
+                                        fetch_names=churn_fetches,
+                                        feed_names=churn_feeds)
+    removable = churn_lint.numerics.churn_removable
+    before_casts = sum(1 for op in churn_main.global_block().ops
+                       if op.type == "cast")
+    opt, _ = passes.optimize_program(churn_main,
+                                     fetch_names=churn_fetches,
+                                     record=False)
+    after_casts = sum(1 for op in opt.global_block().ops
+                      if op.type == "cast")
+    checks["churn_count_equals_structural_removal"] = (
+        removable == before_casts - after_casts and removable > 0)
+
+    row = {"metric": "numerics_lint_smoke",
+           "value": int(all(checks.values())), "unit": "ok",
+           "vs_baseline": None,
+           "models": len(zoo_pt4),
+           "ops_linted": ops_linted,
+           "lint_wall_ms": round(lint_wall_ms, 1),
+           "zoo_pt4xx": zoo_pt4,
+           "divergence": {"ref": ref, "bf16": vals["bf16"],
+                          "fp32": vals["fp32"],
+                          "rel_bf16": round(rel_bf16, 4),
+                          "rel_fp32": round(rel_fp32, 6)},
+           "churn": {"removable": removable,
+                     "casts_removed": before_casts - after_casts},
+           "checks": checks}
+    if not all(checks.values()):
+        row["error"] = "failed checks: " + ", ".join(
+            k for k, v in checks.items() if not v)
+    return row
+
+
+def main_numerics_lint_smoke():
+    """`python bench.py numerics_lint_smoke` — CI/tooling entry: the
+    numerics-analyzer row standalone, persisted to BENCH_TPU.json
+    under rows["numerics_lint_smoke"].  Exit 0 only when the zoo's
+    train-tier substitutes are PT4xx-clean, every seeded bug yields
+    its exact code, the PT406 guard flip re-fuses, the seeded PT401
+    measurably diverges at runtime, and the PT403 churn count matches
+    the structural pipeline's cast removals."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_numerics_lint_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["numerics_lint_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def bench_graph_opt_sweep(on_tpu, peak):
     """Graph-optimizer sweep row (ISSUE 9): two acceptance pillars.
 
@@ -3438,6 +3691,8 @@ def main():
          bench_program_lint_smoke),
         ("sharding_lint_smoke", "sharding_lint_smoke",
          bench_sharding_lint_smoke),
+        ("numerics_lint_smoke", "numerics_lint_smoke",
+         bench_numerics_lint_smoke),
         ("graph_opt_sweep", "graph_opt_sweep", bench_graph_opt_sweep),
         ("fused_amp_sweep", "fused_amp_sweep", bench_fused_amp_sweep),
         ("fleet_obs_smoke", "fleet_obs_smoke", bench_fleet_obs_smoke),
@@ -3521,6 +3776,8 @@ if __name__ == "__main__":
         sys.exit(main_program_lint_smoke())
     if "sharding_lint_smoke" in sys.argv[1:]:
         sys.exit(main_sharding_lint_smoke())
+    if "numerics_lint_smoke" in sys.argv[1:]:
+        sys.exit(main_numerics_lint_smoke())
     if "graph_opt_sweep" in sys.argv[1:]:
         sys.exit(main_graph_opt_sweep())
     if "fused_amp_sweep" in sys.argv[1:]:

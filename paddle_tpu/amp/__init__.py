@@ -236,9 +236,17 @@ def _insert_casts(program, lists, dest):
             n[0] += 1
             out = f"{name}.cast_{to}_{n[0]}"
             block.create_var(name=out, dtype=to)
+            # _amp_inserted marks this as a REQUIRED static pin, not
+            # churn: the rewrite cannot know the runtime dtype (a
+            # white-op output flowing through gray ops is bf16 under a
+            # float32 declaration), so the numerics analyzer (PT403)
+            # must not flag the pins that turn out to be identities —
+            # XLA elides them for free.  Underscore attrs stay out of
+            # CSE's canonical form and the kernel ignores them.
             cast_op = Operator(block, "cast", {"X": [name]},
                                {"Out": [out]},
-                               {"in_dtype": None, "out_dtype": to})
+                               {"in_dtype": None, "out_dtype": to,
+                                "_amp_inserted": True})
             new_ops.append(cast_op)
             casted[key] = out
         return casted[key]
@@ -281,6 +289,9 @@ def _insert_casts(program, lists, dest):
     for bs in program.backward_sections:
         bs.pos = pos_map[min(bs.pos, len(ops))]
     program.amp_enabled = True
+    # provenance the static numerics analyzer (PT4xx) and the lint
+    # cache key read: WHICH low-precision dtype this rewrite targeted
+    program._amp_dest = dest
     program._bump()
     return program
 

@@ -27,10 +27,12 @@ programs or the bundled static model zoo.
 import warnings as _warnings
 
 from . import facts
+from . import numerics
 from . import sharding
 from .diagnostics import (CODES, Diagnostic, LintResult,
                           ProgramLintError)
 from .facts import infer_specs, live_op_mask, protected_names
+from .numerics import NumericsAnalysis, numerics_class
 from .shape_rules import (OPAQUE, ShapeError, VarSpec, has_shape_rule,
                           is_opaque, register_opaque, shape_rule)
 from .sharding import (REPLICATED, MeshSpec, PartitionRules, ShardSpec,
@@ -46,6 +48,7 @@ __all__ = [
     "facts", "live_op_mask", "infer_specs", "protected_names",
     "sharding", "MeshSpec", "ShardSpec", "REPLICATED",
     "PartitionRules", "ShardingAnalysis",
+    "numerics", "NumericsAnalysis", "numerics_class",
 ]
 
 

@@ -41,6 +41,20 @@ PT208    warning    fetch of a persistable var the compiled step
                     donates (executor device-copies to stay sound)
 PT209    warning    shape rule crashed (internal; outputs degraded to
                     OPAQUE — never a false error)
+PT401    error      numerically fragile op (AMP black-list family:
+                    softmax/exp/log/loss) computing in bf16/fp16
+PT402    error      optimizer update whose param or accumulator chain
+                    lost its fp32 master copy
+PT403    warning    cast churn: redundant up/down cast pairs or a
+                    value re-cast to the same dtype (with byte cost)
+PT404    warning    overflow-prone accumulation: low-precision
+                    reduction over FLAGS_numerics_reduce_elems elements
+PT405    warning    fp16 training without loss scaling reaching the
+                    anomaly guard's sample point
+PT406    warning    fusion near-miss: a fuse pattern almost matched
+                    but a named guard blocked it
+PT407    warning    feed/fetch dtype drift vs the declared VarDesc
+                    (forces an implicit hot-path cast)
 =======  =========  ====================================================
 """
 
@@ -72,6 +86,13 @@ CODES = {
     "PT207": (WARNING, "collective op outside a dp mesh"),
     "PT208": (WARNING, "fetch of a donated persistable variable"),
     "PT209": (WARNING, "shape rule crashed (degraded to opaque)"),
+    "PT401": (ERROR, "numerically fragile op in low-precision compute"),
+    "PT402": (ERROR, "optimizer update lost its fp32 master copy"),
+    "PT403": (WARNING, "cast churn (redundant up/down cast pairs)"),
+    "PT404": (WARNING, "overflow-prone low-precision accumulation"),
+    "PT405": (WARNING, "fp16 training without loss scaling"),
+    "PT406": (WARNING, "fusion near-miss (blocked by a named guard)"),
+    "PT407": (WARNING, "feed/fetch dtype drift vs declared VarDesc"),
 }
 
 
@@ -134,6 +155,9 @@ class LintResult:
         # the full ShardingAnalysis when partition rules were in play
         # (verifier pass 6); None otherwise
         self.sharding = None
+        # the full NumericsAnalysis from verifier pass 7 (PT4xx);
+        # None when the numerics pass did not run
+        self.numerics = None
 
     @property
     def errors(self):
@@ -175,6 +199,15 @@ class LintResult:
             rec["wall_ms"] = round(self.wall_ms, 3)
         if self.errors:
             rec["first_error"] = self.errors[0].render()
+        # PT4xx provenance rides the SAME record (telemetry_report's
+        # lint section breaks these out; a forked record kind would
+        # make "newest per key wins" ambiguous between the two)
+        if self.numerics is not None:
+            guards = self.numerics.near_miss_guards()
+            if guards:
+                rec["near_miss_guards"] = guards
+            if self.numerics.churn_bytes:
+                rec["cast_churn_bytes"] = self.numerics.churn_bytes
         return rec
 
     def __repr__(self):

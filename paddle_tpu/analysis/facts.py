@@ -23,7 +23,8 @@ from . import shape_rules as sr
 
 __all__ = ["live_op_mask", "infer_specs", "protected_names",
            "grad_name", "SIDE_EFFECT_TYPES", "control_flow_types",
-           "var_spec", "bind_outputs"]
+           "var_spec", "bind_outputs", "backward_segments",
+           "multi_written_names"]
 
 # ops whose output IS the side effect: liveness keeps them
 # unconditionally.  The single definition the verifier's PT201 sweep,
@@ -62,6 +63,24 @@ def grad_name(name):
     return name + "@GRAD"
 
 
+def backward_segments(num_ops, sections):
+    """``seg_of[i]``: which backward segment op *i* belongs to —
+    segment k covers the ops before the k-th BackwardSection position
+    (sorted), the tail after the last.  Ops in different segments
+    trace into different ``value_and_grad`` closures, so this is the
+    ONE boundary definition the CSE pass's dedup scope, the fusion
+    matchers' ``same_seg`` guard, and the numerics analyzer's
+    cast-churn memo all share."""
+    positions = sorted(bs.pos for bs in sections)
+    seg_of = []
+    k = 0
+    for i in range(num_ops):
+        while k < len(positions) and positions[k] <= i:
+            k += 1
+        seg_of.append(k)
+    return seg_of
+
+
 def live_op_mask(ops, sections, fetch_names, persist,
                  control_flow_types=(), side_effect_types=(),
                  extra_roots=()):
@@ -87,6 +106,24 @@ def live_op_mask(ops, sections, fetch_names, persist,
             keep[i] = True
             needed |= set(ops[i].input_names())
     return keep
+
+
+def multi_written_names(ops, pre_defined):
+    """Names with more than one DEFINITION over `ops` — WAW barriers.
+    `pre_defined` holds names that carry a value BEFORE the program
+    runs (feeds, persistables, data vars): their FIRST in-program
+    write is already the second definition.  The ONE definition the
+    graph passes' legality checks (ProgramRewriter.multi_written) and
+    the numerics analyzer's churn guards share — a cast the lint
+    calls removable must be one the passes may actually remove."""
+    seen = set(pre_defined)
+    multi = set()
+    for op in ops:
+        for n in op.output_names():
+            if n in seen:
+                multi.add(n)
+            seen.add(n)
+    return multi
 
 
 def var_spec(var):

@@ -386,6 +386,19 @@ def check_program(program, fetch_names=None, feed_names=(),
             program_key=program_key)
         diags.extend(sharding_analysis.diagnostics)
 
+    # ---- pass 7: numerics / AMP-safety analysis (PT4xx) ---------------
+    # dtype-flow over the SAME specs pass 3 computed: fragile ops in
+    # low precision, broken fp32 master chains, cast churn, fusion
+    # near-misses.  On the executor path this program IS the AMP+fused
+    # substitute (_resolve_train_optimized runs before _static_check),
+    # so the analysis sees the casts the dispatch actually traces.
+    from . import numerics as _nu
+
+    numerics_analysis = _nu.analyze(
+        program, fetch_names=fetch_names, feed_names=feed_names,
+        specs=specs, program_key=program_key)
+    diags.extend(numerics_analysis.diagnostics)
+
     order = {"error": 0, "warning": 1}
     diags.sort(key=lambda d: (order[d.severity],
                               -1 if d.op_index is None else d.op_index,
@@ -393,6 +406,7 @@ def check_program(program, fetch_names=None, feed_names=(),
     result = LintResult(diags, program_key=program_key,
                         wall_ms=(time.perf_counter() - t0) * 1e3)
     result.sharding = sharding_analysis
+    result.numerics = numerics_analysis
     return result
 
 
@@ -406,11 +420,15 @@ _CACHE_CAP = 8
 def cached_check(program, fetch_names=None, feed_names=(), dp_ndev=None,
                  program_key=None):
     """`check_program` memoized on the program per
-    (``_version``, fetches, feeds, dp) — the same invalidation contract
-    as the executor's run-plan cache: any graph mutation bumps
-    ``_version`` and the next check re-analyzes.  Returns
+    (``_version``, fetches, feeds, dp, amp dtype, fusion config) — the
+    same invalidation contract as the executor's run-plan cache: any
+    graph mutation bumps ``_version`` and the next check re-analyzes,
+    and a flag flip changing the AMP dtype or the enabled fusion
+    passes re-keys (the PT4xx numerics pass reads both, and the
+    executor builds a DIFFERENT substitute under them).  Returns
     (result, fresh): `fresh` is False on a cache hit so the caller can
     avoid double-reporting."""
+    from .. import flags
     from . import sharding as _sh
 
     rules = _sh.attached(program)
@@ -418,7 +436,11 @@ def cached_check(program, fetch_names=None, feed_names=(), dp_ndev=None,
            None if fetch_names is None else tuple(fetch_names),
            frozenset(feed_names or ()),
            dp_ndev,
-           None if rules is None else rules.fingerprint())
+           None if rules is None else rules.fingerprint(),
+           flags.flag("amp_dtype"),
+           (flags.flag("graph_opt_fuse"),
+            flags.flag("graph_opt_fuse_disable")),
+           flags.flag("numerics_reduce_elems"))
     cache = getattr(program, "_lint_cache", None)
     if cache is not None:
         hit = cache.get(key)

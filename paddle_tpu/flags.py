@@ -157,6 +157,15 @@ declare_flag("replicated_param_bytes", 64 << 20,
              "PT302 threshold: lint a replicated parameter larger "
              "than this many bytes (0 = off).")
 
+# Static numerics analyzer (paddle_tpu.analysis.numerics, ISSUE 15):
+# an accumulating reduction (sum/mean/cumsum family) running in
+# bf16/fp16 over at least this many elements per output lints as
+# PT404 — past ~2^mantissa same-magnitude additions the low-precision
+# sum stagnates.  0 disables the check.
+declare_flag("numerics_reduce_elems", 65536,
+             "PT404 threshold: lint a low-precision accumulating "
+             "reduction over this many elements per output (0 = off).")
+
 # Hardened inference serving runtime (paddle_tpu.serving, ISSUE 8):
 # defaults for ServingConfig — overridable per-runtime, but a fleet
 # rollout wants one env knob, not a code change.

@@ -1284,6 +1284,14 @@ class Executor:
             _passes.fuse_program(opt, fetch_names=fetch_names,
                                  clone=False, program_key=pkey)
         opt._telemetry_label = label
+        # provenance for the PT4xx numerics lint and post-mortems:
+        # WHICH train-tier config produced this substitute (the lint
+        # runs against it — _static_check fires after this
+        # substitution — and a cached substitute outlives the flag
+        # state that built it)
+        opt._train_tier = {
+            "amp": flags.flag("amp_dtype") if do_amp else None,
+            "fuse": list(fuse_names)}
         if cache is None:
             cache = program._opt_cache = {}
         elif len(cache) >= 8:

@@ -231,7 +231,11 @@ def _as_list(x):
 def _jsonable_attrs(attrs):
     out = {}
     for k, v in attrs.items():
-        if k.startswith("_"):
+        if k.startswith("_") and k != "_amp_inserted":
+            # underscore attrs are runtime-only (rng keys etc.) —
+            # except the AMP pin tag, a plain bool the numerics
+            # analyzer must still see on a reloaded program (an
+            # untagged identity pin would lint as PT403 churn)
             continue
         if isinstance(v, np.ndarray):
             out[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
@@ -493,6 +497,11 @@ class Program:
                 for s in self.backward_sections
             ],
             "is_test": self._is_test,
+            # an AMP-rewritten program must round-trip as rewritten:
+            # a reloaded substitute fed back through rewrite_train_
+            # program (e.g. tools/program_lint.py --amp) would
+            # otherwise be double-cast
+            "amp_enabled": self.amp_enabled,
         }
         if self._folded_constants:
             doc["folded_constants"] = {
@@ -532,6 +541,12 @@ class Program:
                 BackwardSection(sd["pos"], sd["loss"], sd["params"],
                                 checkpoint_names=sd.get("checkpoints")))
         p._is_test = data.get("is_test", False)
+        p.amp_enabled = data.get(
+            "amp_enabled",
+            # pre-amp_enabled serializations: the AMP rewrite's tagged
+            # cast pins are the durable evidence it already ran
+            any(op.get("attrs", {}).get("_amp_inserted")
+                for bd in data["blocks"] for op in bd["ops"]))
         fc = data.get("folded_constants")
         if fc:
             p._folded_constants = {

@@ -191,11 +191,33 @@ def fuse_program(program, fetch_names=(), feed_names=(), clone=True,
                          feed_names=feed_names)
     before = len(rw.ops)
     rows = []
+    raw_misses = []
     for name in names:
         stats = rw.timed(PASSES[name])
         stats["name"] = name
+        # near-miss records carry live op refs (indices shift as the
+        # passes rewrite) — pull them out of the telemetry row and
+        # resolve below, once every pass has run
+        raw_misses.extend(stats.pop("near_misses", ()))
         rows.append(stats)
     opt._fusion_applied = True
+    # resolve near-misses against the FINAL op list: an anchor a later
+    # pattern absorbed or repurposed is moot; the rest get the op
+    # index PT406 (analysis.numerics) reports
+    final_pos = {id(op): k for k, op in
+                 enumerate(opt.global_block().ops)}
+    near_misses = []
+    for nm in raw_misses:
+        a_op = nm.pop("_anchor_op", None)
+        g_op = nm.pop("_guard_op", None)
+        ai = final_pos.get(id(a_op))
+        if ai is None or a_op.type != nm.get("anchor_type"):
+            continue
+        nm["anchor_index"] = ai
+        gi = final_pos.get(id(g_op)) if g_op is not None else None
+        nm["guard_op_index"] = ai if gi is None else gi
+        near_misses.append(nm)
+    opt._fusion_near_misses = near_misses
     report = {
         "kind": "pass_pipeline",
         "tier": "fusion",
@@ -208,6 +230,13 @@ def fuse_program(program, fetch_names=(), feed_names=(), clone=True,
         "passes": rows,
         "total_wall_ms": round((time.perf_counter() - t0) * 1e3, 3),
     }
+    if near_misses:
+        guards = {}
+        for nm in near_misses:
+            g = nm.get("guard") or "?"
+            guards[g] = guards.get(g, 0) + 1
+        report["near_misses"] = len(near_misses)
+        report["near_miss_guards"] = dict(sorted(guards.items()))
     if record:
         from .. import monitor
 

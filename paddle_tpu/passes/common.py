@@ -127,14 +127,7 @@ def cse(rw):
     Segment-scoped because ops on opposite sides of a BackwardSection
     position trace into different jax.value_and_grad closures."""
     ops = rw.ops
-    sections = rw.sections()
-    positions = sorted(bs.pos for bs in sections)
-    seg_of = []
-    k = 0
-    for i in range(len(ops)):
-        while k < len(positions) and positions[k] <= i:
-            k += 1
-        seg_of.append(k)
+    seg_of = facts.backward_segments(len(ops), rw.sections())
     persist = rw.persist_names()
     multi = rw.multi_written()
     rename = {}

@@ -35,6 +35,8 @@ scopes.  Patterns never straddle a BackwardSection boundary (ops on
 opposite sides trace into different value_and_grad closures).
 """
 
+from ..analysis import facts as _facts
+
 __all__ = ["fuse_attention", "fuse_bias_act", "fuse_bottleneck",
            "fuse_layer_norm", "FUSED_TIER_TYPES"]
 
@@ -52,11 +54,22 @@ _FUSABLE_ACTS = ("relu", "gelu", "tanh", "sigmoid")
 class _Match:
     """Shared bookkeeping for one fusion pass run: consumer/producer
     maps, segment assignment, the used-index set keeping patterns
-    disjoint, the PRE-rewrite scope names for provenance, and the
-    cast-transparent edge walkers."""
+    disjoint, the PRE-rewrite scope names for provenance, the
+    cast-transparent edge walkers, and the shared EXPLAIN mode: every
+    guard that can refuse an otherwise-structurally-matched pattern is
+    NAMED, records which op/var it fired on into ``last_guard``, and a
+    matcher that bails on a guard calls :meth:`miss` so the near-miss
+    (pattern, anchor, guard, detail) lands in ``near_misses`` — what
+    the PT406 lint renders and ``passes.fuse_program`` aggregates onto
+    ``program._fusion_near_misses``."""
 
-    def __init__(self, rw):
+    def __init__(self, rw, pattern=None):
         self.rw = rw
+        self.pattern = pattern
+        self.near_misses = []
+        # (guard name, op index or None, detail) of the most recent
+        # guard refusal; cleared at each anchor and consumed by miss()
+        self.last_guard = None
         self.ops = rw.ops
         self.cons = rw.consumers()
         self.prod = rw.producers()
@@ -66,25 +79,54 @@ class _Match:
         # scope names BEFORE any anchor mutation: what folded_from must
         # record (the anchor's own scope changes with its new type)
         self.scopes0 = rw.all_scope_names()
-        positions = sorted(bs.pos for bs in rw.sections())
-        self.seg_of = []
-        k = 0
-        for i in range(len(self.ops)):
-            while k < len(positions) and positions[k] <= i:
-                k += 1
-            self.seg_of.append(k)
+        self.seg_of = _facts.backward_segments(len(self.ops),
+                                               rw.sections())
         self.used = set()
         self.remove = set()
         self.matched = 0
 
-    # -- guards -------------------------------------------------------
+    # -- guards (each refusal is NAMED for the PT406 explain mode) ----
+    def fail(self, guard, detail, at=None, var=None):
+        """Record one named guard refusal and return False — the one
+        bail-out path every guard shares, so 'which guard fired on
+        which op' (and which VARIABLE, when one is to blame) is a fact
+        the matcher records, not a reconstruction."""
+        self.last_guard = (guard, at, detail, var)
+        return False
+
     def internal_ok(self, name, inside):
         """`name` may vanish inside a fused region: every consumer is
         in `inside`, and nothing outside the rewrite can see it."""
-        if name in self.rw.protected or name in self.persist \
-                or name in self.multi or name in self.rw.feed_names:
-            return False
-        return all(c in inside for c in self.cons.get(name, ()))
+        at = self.prod.get(name)
+        if name in self.rw.protected:
+            return self.fail(
+                "protected_var",
+                f"intermediate '{name}' is protected (fetched or "
+                f"referenced from a control-flow body)", at, var=name)
+        if name in self.persist:
+            return self.fail(
+                "persistable_intermediate",
+                f"intermediate '{name}' is persistable state", at,
+                var=name)
+        if name in self.multi:
+            return self.fail(
+                "multi_write",
+                f"intermediate '{name}' is written more than once "
+                f"(WAW barrier)", at, var=name)
+        if name in self.rw.feed_names:
+            return self.fail("fed_intermediate",
+                             f"intermediate '{name}' is a feed", at,
+                             var=name)
+        outside = [c for c in self.cons.get(name, ())
+                   if c not in inside]
+        if outside:
+            return self.fail(
+                "multi_consumer",
+                f"intermediate '{name}' has {len(outside)} "
+                f"consumer(s) outside the pattern (first: op "
+                f"#{outside[0]} '{self.ops[outside[0]].type}')", at,
+                var=name)
+        return True
 
     def side_outs_dead(self, i, keep_slots=("Out", "Y")):
         """Secondary outputs (XShape markers) of an op being absorbed
@@ -96,15 +138,55 @@ class _Match:
             for n in names:
                 if self.cons.get(n) or n in self.rw.protected \
                         or n in self.persist:
-                    return False
+                    return self.fail(
+                        "live_side_output",
+                        f"op #{i} '{op.type}' side output '{n}' "
+                        f"({slot}) is consumed or protected", i,
+                        var=n)
         return True
 
     def absorbable(self, i):
-        return i is not None and i not in self.used \
-            and i not in self.remove
+        if i is None:
+            return False
+        if i in self.used or i in self.remove:
+            return self.fail(
+                "already_fused",
+                f"op #{i} '{self.ops[i].type}' was already absorbed "
+                f"by an earlier pattern", i)
+        return True
 
     def same_seg(self, idxs):
-        return len({self.seg_of[i] for i in idxs}) == 1
+        if len({self.seg_of[i] for i in idxs}) == 1:
+            return True
+        lo = min(idxs)
+        return self.fail(
+            "section_boundary",
+            f"pattern ops {sorted(idxs)} straddle a backward-section "
+            f"boundary (opposite sides trace into different "
+            f"value_and_grad closures)", lo)
+
+    def miss(self, anchor):
+        """The structural pattern anchored at `anchor` matched, but
+        the most recent named guard refused it: record the near-miss
+        (a no-op when the bail was structural — no guard fired)."""
+        if self.last_guard is None:
+            return
+        guard, at, detail, var = self.last_guard
+        self.last_guard = None
+        op = self.ops[anchor]
+        self.near_misses.append({
+            "pattern": self.pattern,
+            "anchor_type": op.type,
+            "callsite": getattr(op, "callsite", None),
+            "guard": guard,
+            "detail": detail,
+            "var": var,
+            # op OBJECTS, not indices: later patterns/passes shift the
+            # op list, and fuse_program resolves final indices by
+            # identity once every pass has run
+            "_anchor_op": op,
+            "_guard_op": None if at is None else self.ops[at],
+        })
 
     # -- cast-transparent edges ---------------------------------------
     def up(self, name, casts):
@@ -116,7 +198,12 @@ class _Match:
         imm = self._dtype(name)
         while True:
             j = self.prod.get(name)
+            saved = self.last_guard
             if not self.absorbable(j):
+                # probing, not a refusal: the edge stays matchable on
+                # this name — an `already_fused` probe here must not
+                # masquerade as the guard a LATER structural bail hit
+                self.last_guard = saved
                 return name, imm
             op = self.ops[j]
             if op.type != "cast":
@@ -137,6 +224,12 @@ class _Match:
         op's type."""
         while True:
             cs = [c for c in self.cons.get(name, ())]
+            if len(cs) > 1:
+                return self.fail(
+                    "multi_consumer",
+                    f"'{name}' has {len(cs)} consumers; the pattern "
+                    f"needs it sole-consumed to absorb the edge",
+                    cs[0], var=name) or None
             if len(cs) != 1 or not self.absorbable(cs[0]):
                 return None
             op = self.ops[cs[0]]
@@ -144,7 +237,12 @@ class _Match:
                 out = op.outputs["Out"][0]
                 if out in self.rw.protected or out in self.persist \
                         or out in self.multi:
-                    return None
+                    return self.fail(
+                        "shared_cast",
+                        f"cast output '{out}' (op #{cs[0]}) is "
+                        f"protected, persistable, or rewritten — the "
+                        f"cast cannot be absorbed into the pattern",
+                        cs[0], var=out) or None
                 casts.append(cs[0])
                 name = out
                 continue
@@ -183,7 +281,13 @@ class _Match:
     def finish(self):
         removed = self.rw.apply(remove=self.remove)
         self.rw.sweep_dead_vars()
-        return {"matched": self.matched, "absorbed_ops": removed}
+        stats = {"matched": self.matched, "absorbed_ops": removed}
+        if self.near_misses:
+            # carries live op refs — fuse_program pops this key,
+            # resolves final indices, and keeps the telemetry row
+            # JSON-clean
+            stats["near_misses"] = self.near_misses
+        return stats
 
 
 # ---------------------------------------------------------------------------
@@ -224,8 +328,9 @@ def _match_split_ring(m, name, edge_consumers):
 
 def fuse_attention(rw):
     """matmul·scale·[mask]·softmax·matmul → ``fused_attention``."""
-    m = _Match(rw)
+    m = _Match(rw, "fuse_attention")
     for i, op in enumerate(m.ops):
+        m.last_guard = None
         if op.type != "softmax" or not m.absorbable(i):
             continue
         spec = m.specs.get(op.inputs["X"][0])
@@ -238,6 +343,7 @@ def fuse_attention(rw):
         sm_in, _ = m.up(op.inputs["X"][0], casts_up)
         j = m.prod.get(sm_in)
         if not m.absorbable(j):
+            m.miss(i)
             continue
         # optional additive mask between scale and softmax
         mask_name = None
@@ -254,6 +360,7 @@ def fuse_attention(rw):
             nxt, _ = m.up(cand.inputs["X"][0], casts_up)
             j = m.prod.get(nxt)
             if not m.absorbable(j):
+                m.miss(i)
                 continue
             cand = m.ops[j]
         if cand.type != "scale" \
@@ -264,6 +371,7 @@ def fuse_attention(rw):
         mm1_in, _ = m.up(cand.inputs["X"][0], casts_up)
         j = m.prod.get(mm1_in)
         if not m.absorbable(j):
+            m.miss(i)
             continue
         mm1 = m.ops[j]
         if mm1.type != "matmul" \
@@ -277,6 +385,7 @@ def fuse_attention(rw):
         mm2_idx = m.sole_consumer(op.outputs["Out"][0], casts_down,
                                   ("matmul",))
         if mm2_idx is None:
+            m.miss(i)
             continue
         mm2 = m.ops[mm2_idx]
         if mm2.attrs.get("transpose_X", False) \
@@ -292,6 +401,7 @@ def fuse_attention(rw):
         if mask_idx is not None:
             core.add(mask_idx)
         if not m.same_seg(core):
+            m.miss(i)
             continue
         inside = core | set(casts_up) | set(casts_down)
         mids = [mm1.outputs["Out"][0],
@@ -302,6 +412,7 @@ def fuse_attention(rw):
         mids.extend(m.ops[c].outputs["Out"][0]
                     for c in casts_up + casts_down)
         if not all(m.internal_ok(n, inside) for n in mids):
+            m.miss(i)
             continue
         # Q/K/V edges (through AMP casts); the immediate dtype the
         # anchor matmul computed in is the fused op's compute dtype
@@ -350,6 +461,7 @@ def fuse_attention(rw):
             anchor = merge[1]
             out_name = m.ops[anchor].outputs["Out"][0]
             if not m.same_seg(core | set(ring) | {anchor}):
+                m.miss(i)
                 continue
         absorbed = (core | set(casts_up) | set(casts_down)
                     | set(q_casts) | set(k_casts) | set(v_casts)
@@ -372,15 +484,17 @@ def fuse_attention(rw):
 
 def fuse_bias_act(rw):
     """elementwise_add(X, bias-parameter) → act ⇒ ``fused_bias_act``."""
-    m = _Match(rw)
+    m = _Match(rw, "fuse_bias_act")
     params = {v.name for v in rw.program.list_vars() if v.is_parameter}
     for i, op in enumerate(m.ops):
+        m.last_guard = None
         if op.type not in _FUSABLE_ACTS or not m.absorbable(i):
             continue
         casts = []
         x_in, _ = m.up(op.inputs["X"][0], casts)
         j = m.prod.get(x_in)
         if not m.absorbable(j):
+            m.miss(i)
             continue
         add = m.ops[j]
         if add.type != "elementwise_add":
@@ -391,11 +505,13 @@ def fuse_bias_act(rw):
                 or bspec.shape is None or len(bspec.shape) != 1:
             continue
         if not m.same_seg({i, j}):
+            m.miss(i)
             continue
         inside = {i, j} | set(casts)
         mids = [add.outputs["Out"][0]] \
             + [m.ops[c].outputs["Out"][0] for c in casts]
         if not all(m.internal_ok(n, inside) for n in mids):
+            m.miss(i)
             continue
         a_op = m.ops[i]
         m.commit(i, {j} | set(casts))
@@ -415,14 +531,16 @@ def fuse_bias_act(rw):
 
 def fuse_layer_norm(rw):
     """elementwise_add(x, residual) → layer_norm ⇒ ``fused_layer_norm``."""
-    m = _Match(rw)
+    m = _Match(rw, "fuse_layer_norm")
     for i, op in enumerate(m.ops):
+        m.last_guard = None
         if op.type != "layer_norm" or not m.absorbable(i):
             continue
         casts = []
         x_in, _ = m.up(op.inputs["X"][0], casts)
         j = m.prod.get(x_in)
         if not m.absorbable(j):
+            m.miss(i)
             continue
         add = m.ops[j]
         if add.type != "elementwise_add" \
@@ -434,11 +552,13 @@ def fuse_layer_norm(rw):
                 or ys.shape is None or len(xs.shape) != len(ys.shape):
             continue          # only the same-rank residual form
         if not m.same_seg({i, j}):
+            m.miss(i)
             continue
         inside = {i, j} | set(casts)
         mids = [add.outputs["Out"][0]] \
             + [m.ops[c].outputs["Out"][0] for c in casts]
         if not all(m.internal_ok(n, inside) for n in mids):
+            m.miss(i)
             continue
         a_op = m.ops[i]
         m.commit(i, {j} | set(casts))
@@ -460,14 +580,16 @@ def fuse_bottleneck(rw):
     """conv2d → batch_norm [→ act] ⇒ ``fused_bottleneck`` (stateful:
     the running-stat writes ride along — the fused op keeps the bn op's
     MeanOut/VarianceOut aliasing, so the PT106 donation lint holds)."""
-    m = _Match(rw)
+    m = _Match(rw, "fuse_bottleneck")
     for i, op in enumerate(m.ops):
+        m.last_guard = None
         if op.type != "batch_norm" or not m.absorbable(i):
             continue
         casts = []
         x_in, _ = m.up(op.inputs["X"][0], casts)
         j = m.prod.get(x_in)
         if not m.absorbable(j):
+            m.miss(i)
             continue
         conv = m.ops[j]
         if conv.type != "conv2d":
@@ -487,6 +609,7 @@ def fuse_bottleneck(rw):
             mids = [conv_out, op.outputs["Y"][0]]
         else:
             if not m.same_seg({i, j}):
+                m.miss(i)
                 continue
             anchor = i
             act = ""
@@ -497,6 +620,7 @@ def fuse_bottleneck(rw):
         inside = absorbed | {anchor}
         mids.extend(m.ops[c].outputs["Out"][0] for c in casts)
         if not all(m.internal_ok(n, inside) for n in mids):
+            m.miss(anchor)
             continue
         if anchor != i:
             # the bn op's stat outputs move to the anchor, which sits
@@ -510,7 +634,15 @@ def fuse_bottleneck(rw):
                     if any(c <= anchor and c != i and c not in inside
                            for c in m.cons.get(n, ())):
                         ok = False
+                        m.fail(
+                            "stat_consumer_order",
+                            f"batch_norm stat output '{n}' is read "
+                            f"between the bn (op #{i}) and the fused "
+                            f"anchor (op #{anchor}); moving the stat "
+                            f"write to the anchor would reorder that "
+                            f"read", i, var=n)
             if not ok:
+                m.miss(anchor)
                 continue
         in_casts, f_casts = [], []
         in_name, _ = m.up(conv.inputs["Input"][0], in_casts)

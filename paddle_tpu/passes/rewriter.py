@@ -146,18 +146,14 @@ class ProgramRewriter:
         FIRST in-program write — an optimizer update, a moving-stat
         refresh — is already the second definition, and a pre-update
         read must not be rewired across it.  Every pass treats these
-        names as untouchable."""
-        seen = set(self.feed_names)
+        names as untouchable.  (facts.multi_written_names is the
+        single definition; the numerics analyzer's churn guards share
+        it.)"""
+        pre = set(self.feed_names)
         for v in self.program.list_vars():
             if v.persistable or v.is_data:
-                seen.add(v.name)
-        multi = set()
-        for op in self.ops:
-            for n in op.output_names():
-                if n in seen:
-                    multi.add(n)
-                seen.add(n)
-        return multi
+                pre.add(v.name)
+        return facts.multi_written_names(self.ops, pre)
 
     def source_scopes(self, op):
         return self._source_scope.get(id(op), ())

@@ -266,6 +266,40 @@ def test_serving_smoke_in_suite_and_standalone():
 
 
 # ---------------------------------------------------------------------------
+# numerics_lint_smoke row (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_numerics_lint_smoke_in_suite_and_standalone():
+    """The numerics-analyzer row is wired into the suite AND the
+    standalone argv entry (the PT4xx behaviors themselves are covered
+    end-to-end by tests/test_numerics.py, which also runs the row
+    once; re-running the full zoo sweep here would pay the builds
+    twice per CI run for no new signal)."""
+    src = open(bench.__file__).read()
+    assert '("numerics_lint_smoke", "numerics_lint_smoke"' in src
+    assert '"numerics_lint_smoke" in sys.argv[1:]' in src
+    assert "main_numerics_lint_smoke" in src
+
+
+def test_numerics_lint_smoke_row_shape():
+    """The smoke row's check list carries every acceptance pillar of
+    ISSUE 15: the PT4xx-clean zoo substitutes, one seeded program per
+    code, the PT406 guard flip, the seeded-PT401 runtime divergence
+    conformance, and the PT403 churn-vs-structural-removal equality."""
+    src = open(bench.__file__).read()
+    for check in ("zoo_pt4xx_clean", "fragile_bf16_PT401",
+                  "lost_master_PT402", "cast_churn_PT403",
+                  "bf16_accumulation_PT404", "fp16_no_scaling_PT405",
+                  "fusion_near_miss_PT406", "fetch_drift_PT407",
+                  "near_miss_guard_flip_fuses",
+                  "seeded_pt401_diverges_past_tolerance",
+                  "lint_clean_twin_within_tolerance",
+                  "churn_count_equals_structural_removal"):
+        assert check in src, check
+
+
+# ---------------------------------------------------------------------------
 # graph_opt_sweep row (ISSUE 9 satellite)
 # ---------------------------------------------------------------------------
 

@@ -14,21 +14,35 @@ paddle_tpu/analysis/diagnostics.py for the table:
   divisibility, PT305 conflicting join, PT306 unresolved pending psum
   — plus the implied-collective cost table and the static per-shard
   peak-memory estimate in the --json records.
+- PT4xx  numerics lints (always on; --amp/--fuse make them bite):
+  PT401 fragile op in low precision, PT402 broken fp32 master chain,
+  PT403 cast churn, PT404 overflow-prone low-precision accumulation,
+  PT405 fp16 without loss scaling, PT406 fusion near-miss with the
+  blocking guard named, PT407 feed/fetch dtype drift.
 
 Usage:
   python tools/program_lint.py <program.json> [--fetch a,b] [--dp N]
-      [--sharding-rules rules.json]
+      [--sharding-rules rules.json] [--amp] [--fuse]
   python tools/program_lint.py --model lenet [--sharding-rules default]
   python tools/program_lint.py --all-models [--sharding-rules default]
-  python tools/program_lint.py --all-models --json
+  python tools/program_lint.py --all-models --amp --fuse --json
 
 `--sharding-rules FILE` loads a partition-rule document ({"mesh":
 {axis: size}, "rules": [[regex, [axis|null, ...]], ...], "data_axis":
 "dp"}); the special value `default` uses each bundled model's own
 default rule set (only with --model/--all-models).
 
+`--amp` / `--fuse` lint the SAME substitute the executor dispatches
+under FLAGS_amp / FLAGS_graph_opt_fuse: the AMP rewrite and/or the
+fusion tier are applied (canonical order: AMP -> fusion) to each TRAIN
+program before linting, so the PT4xx findings describe the casts and
+fused kernels the compiled step actually traces — the pristine source
+has no casts to analyze.  Startup/inference programs pass through
+untouched, exactly as the executor's train-tier gate does.
+
 Exit-code contract (CI gates on it):
-  0  clean — no PT1xx and no PT3xx ERRORS anywhere (warnings allowed)
+  0  clean — no PT1xx, no PT3xx and no PT4xx ERRORS anywhere
+     (warnings allowed)
   1  at least one error-severity diagnostic
   2  usage / unreadable input
 
@@ -76,6 +90,22 @@ def _lint_one(label, program, fetch_names, dp_ndev, rules,
     return result
 
 
+def _train_substitute(program, fetch_names, do_amp, do_fuse):
+    """The executor's train-tier substitute for `program` — the SAME
+    resolver Executor.run dispatches through (_resolve_train_optimized,
+    canonical order AMP rewrite -> fusion), behind the same gate: only
+    TRAIN programs (backward sections, not a test clone) are rewritten;
+    startup/inference programs lint as-is."""
+    if not (do_amp or do_fuse) or program._is_test \
+            or not program.backward_sections:
+        return program
+    from paddle_tpu.framework.executor import Executor
+
+    return Executor._resolve_train_optimized(
+        program, list(fetch_names or ()),
+        do_amp and not program.amp_enabled, do_fuse)
+
+
 def _record(result):
     rec = result.to_record()
     rec["diagnostics"] = [d.to_dict() for d in result.diagnostics]
@@ -89,8 +119,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="program_lint.py",
         description=__doc__.splitlines()[0],
-        epilog="exit status: 0 = no PT1xx/PT3xx errors, 1 = errors "
-               "found, 2 = usage error")
+        epilog="exit status: 0 = no PT1xx/PT3xx/PT4xx errors, 1 = "
+               "errors found, 2 = usage error")
     ap.add_argument("program", nargs="?",
                     help="Program.to_json file to lint")
     ap.add_argument("--model", help="lint one bundled static model "
@@ -109,6 +139,14 @@ def main(argv=None):
                     help="partition-rule JSON file enabling the PT3xx "
                     "sharding lints; 'default' uses each bundled "
                     "model's own default rule set")
+    ap.add_argument("--amp", action="store_true",
+                    help="AMP-rewrite each train program (FLAGS_amp "
+                    "parity) before linting, so the PT4xx numerics "
+                    "lints see the casts the executor traces")
+    ap.add_argument("--fuse", action="store_true",
+                    help="run the fusion tier (FLAGS_graph_opt_fuse "
+                    "parity) before linting — PT406 then explains "
+                    "near-miss patterns with the blocking guard")
     ap.add_argument("--json", action="store_true",
                     help="emit machine-readable JSON records instead "
                     "of text (parity with tools/program_opt.py)")
@@ -172,10 +210,15 @@ def main(argv=None):
     any_errors = False
     records = []
     for label, prog, fetches, rules, feed_shapes in targets:
-        result = _lint_one(label, prog, fetches, args.dp, rules,
+        sub = _train_substitute(prog, fetches, args.amp, args.fuse)
+        result = _lint_one(label, sub, fetches, args.dp, rules,
                            feed_shapes=feed_shapes,
                            verbose=not args.json)
-        records.append(_record(result))
+        rec = _record(result)
+        if sub is not prog:
+            rec["train_tier"] = {"amp": bool(args.amp),
+                                 "fuse": bool(args.fuse)}
+        records.append(rec)
         any_errors = any_errors or not result.ok
     if args.json:
         print(json.dumps(records, indent=1))

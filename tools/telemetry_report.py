@@ -152,8 +152,12 @@ def _op_profile_section(records, top=8):
 def _lint_section(records):
     """Static-verifier findings from the kind="lint" records the
     executor emits once per (program, version): per program key the
-    newest error/warning counts and the count-by-PT-code breakdown
-    (newest record per key wins — a re-lint after _bump supersedes)."""
+    newest error/warning counts, the count-by-PT-code breakdown, a
+    PT4xx numerics breakout (the ISSUE-15 dtype-flow/AMP-safety
+    family), and the top fusion near-miss guards the PT406
+    explanations named (the records carry "near_miss_guards" — same
+    kind, extended, never forked; newest record per key wins — a
+    re-lint after _bump supersedes)."""
     per_key = {}
     for r in records:
         if r.get("kind") == "lint":
@@ -163,6 +167,7 @@ def _lint_section(records):
     out = {"programs": len(per_key)}
     progs = {}
     total = {}
+    guards_total = {}
     for k, r in per_key.items():
         entry = {"errors": r.get("errors", 0),
                  "warnings": r.get("warnings", 0)}
@@ -170,12 +175,31 @@ def _lint_section(records):
             entry["codes"] = r["codes"]
             for code, n in r["codes"].items():
                 total[code] = total.get(code, 0) + n
+            pt4 = {c: n for c, n in r["codes"].items()
+                   if c.startswith("PT4")}
+            if pt4:
+                entry["numerics"] = pt4
+        if r.get("near_miss_guards"):
+            entry["near_miss_guards"] = r["near_miss_guards"]
+            for g, n in r["near_miss_guards"].items():
+                guards_total[g] = guards_total.get(g, 0) + n
+        if r.get("cast_churn_bytes"):
+            entry["cast_churn_bytes"] = r["cast_churn_bytes"]
         if r.get("first_error"):
             entry["first_error"] = r["first_error"][:160]
         progs[k] = entry
     out["by_program"] = progs
     if total:
         out["codes_total"] = dict(sorted(total.items()))
+        pt4_total = {c: n for c, n in total.items()
+                     if c.startswith("PT4")}
+        if pt4_total:
+            out["numerics_total"] = dict(sorted(pt4_total.items()))
+    if guards_total:
+        # top blocking guards across every program: the "why didn't
+        # my model fuse" answer in one line
+        out["near_miss_guards_top"] = dict(sorted(
+            guards_total.items(), key=lambda kv: (-kv[1], kv[0]))[:8])
     out["errors_total"] = sum(p["errors"] for p in progs.values())
     out["warnings_total"] = sum(p["warnings"] for p in progs.values())
     return out
