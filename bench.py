@@ -1853,6 +1853,211 @@ def main_sharding_lint_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_tp_runtime_smoke(on_tpu, peak):
+    """GSPMD runtime-tier row (ISSUE 16): bert trained on a REAL 4-dev
+    {dp=2, mp=2} mesh under its default Megatron TP rule set via
+    ``with_sharding_rules(..., execute=True)``, against a pure-dp
+    {dp=2} reference from the SAME init and feed.  Five pillars:
+
+    (a) numerics — the TP loss trajectory is allclose to the dp
+    reference (3 steps, same global batch);
+    (b) collective conformance — the lowering plan's predicted mp
+    all-reduce count AND bytes equal the executed program's
+    note_model_sync records (last_sync_stats["model"]) EXACTLY;
+    (c) placement — param, bias and optimizer-moment leaves named by
+    the plan are VERIFIABLY sharded (per-shard bytes strictly below
+    the replicated size);
+    (d) memory — the measured per-shard mem_profile peak lands within
+    25% of the plan's static per-shard estimate and strictly below the
+    dp-only run's peak (the ~1/mp HBM claim as a number);
+    (e) elasticity — the TP checkpoint ({dp=2,mp=2} sharded leaves,
+    npz writer) restores BITWISE onto a {dp=4} mesh via
+    restore_resharded, mesh-axes provenance carried in _TOPOLOGY.json.
+
+    Side effect: the PROCESS-GLOBAL monitor is reset (the conformance
+    step needs a clean ledger)."""
+    import shutil as _shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import checkpoint as ckpt
+    from paddle_tpu import monitor
+    from paddle_tpu.analysis import sharding as sh_mod
+    from paddle_tpu.distributed.mesh import build_rule_mesh
+    from paddle_tpu.framework.executor import Scope
+    from paddle_tpu.models import static_zoo
+    from paddle_tpu.transpiler import collective as coll
+
+    if len(jax.devices()) < 4:
+        return {"metric": "tp_runtime_smoke",
+                "skipped": "needs a 4-device mesh for {dp=2, mp=2} "
+                           "(run standalone: python bench.py "
+                           "tp_runtime_smoke)"}
+
+    checks = {}
+    with fluid.unique_name.guard():
+        m = static_zoo.build("bert")
+    rules = m.partition_rules()
+    feed = m.smoke_feed(batch=8, seed=11)
+    feed_shapes = {n: tuple(v.shape) for n, v in feed.items()}
+    plan = sh_mod.lower(m.main, rules, fetch_names=[m.loss_name],
+                        feed_names=sorted(feed_shapes),
+                        feed_shapes=feed_shapes)
+    plan_rec = plan.to_record()
+    pred_model = {"count": 0, "bytes": 0}
+    for (kind, axes), v in plan.collective_table().items():
+        if "mp" in axes:
+            pred_model["count"] += v["count"]
+            pred_model["bytes"] += v["bytes"]
+
+    exe = fluid.Executor()
+    init_scope = Scope()
+    exe.run(m.startup, scope=init_scope)
+    init_state = {n: np.asarray(v) for n, v in init_scope.vars.items()
+                  if v is not None}
+
+    was_enabled = monitor.is_enabled()
+    monitor.reset()
+    monitor.enable()
+    row = {"metric": "tp_runtime_smoke"}
+    tmpdir = tempfile.mkdtemp(prefix="tp_runtime_smoke_")
+    try:
+        # ---- pure-dp reference: {dp=2}, same local batch as the TP
+        # run so the memory delta isolates the mp sharding ------------
+        dp_rules = sh_mod.PartitionRules([(r".*", [])], {"dp": 2})
+        dp_scope = Scope()
+        for n, v in init_state.items():
+            dp_scope.set_var(n, v)
+        prog_dp = fluid.CompiledProgram(m.main) \
+            .with_sharding_rules(dp_rules, execute=True) \
+            .with_telemetry("tp_rt_dp")
+        dp_losses = [float(np.mean(exe.run(
+            prog_dp, feed=feed, fetch_list=[m.loss_name],
+            scope=dp_scope)[0])) for _ in range(3)]
+        dp_prof = monitor.mem_profile_split(key="tp_rt_dp:dp") or {}
+        dp_peak = (dp_prof.get("peak", {}) or {}).get("model_bytes") or 0
+
+        # ---- TP run: {dp=2, mp=2} with the zoo's Megatron rules -----
+        tp_scope = Scope()
+        for n, v in init_state.items():
+            tp_scope.set_var(n, v)
+        prog_tp = fluid.CompiledProgram(m.main) \
+            .with_sharding_rules(rules, execute=True) \
+            .with_telemetry("tp_rt_tp")
+        tp_losses = [float(np.mean(exe.run(
+            prog_tp, feed=feed, fetch_list=[m.loss_name],
+            scope=tp_scope)[0])) for _ in range(3)]
+        stats = coll.last_sync_stats()
+        model = stats.get("model") or {}
+        tp_prof = monitor.mem_profile_split(key="tp_rt_tp:dp") or {}
+        tp_peak = (tp_prof.get("peak", {}) or {}).get("model_bytes") or 0
+
+        # (a) numerics: same math, different layout
+        checks["loss_allclose_vs_dp"] = bool(np.allclose(
+            dp_losses, tp_losses, rtol=2e-3, atol=2e-5))
+        # (b) predicted mp collective table == executed, exactly
+        checks["model_collectives_exact"] = (
+            model.get("psums") == pred_model["count"]
+            and model.get("total_bytes") == pred_model["bytes"]
+            and pred_model["count"] > 0)
+        # (c) sharded placement, per plan-named leaf
+        leaf_bytes = {}
+        sharded_ok = []
+        for name in ("fc_0.w_0", "fc_0.b_0", "fc_0.w_0_adam_0_moment1",
+                     "embedding_0.w_0"):
+            v = tp_scope.vars.get(name)
+            shard = (v.addressable_shards[0].data.nbytes
+                     if hasattr(v, "addressable_shards") else None)
+            leaf_bytes[name] = {"shard": shard, "full": int(v.nbytes)}
+            sharded_ok.append(shard is not None and shard < v.nbytes)
+        checks["param_and_moment_leaves_sharded"] = all(sharded_ok)
+        # (d) memory: static estimate within 25%, TP strictly below dp
+        static_peak = (plan_rec["static_peak_bytes"]
+                       + plan_rec["static_state_bytes"])
+        mem_err = (abs(static_peak - tp_peak) / tp_peak
+                   if tp_peak else None)
+        checks["mem_within_25pct"] = (mem_err is not None
+                                      and mem_err <= 0.25)
+        checks["tp_peak_below_dp_peak"] = bool(
+            tp_peak and dp_peak and tp_peak < dp_peak)
+
+        # (e) TP checkpoint -> {dp=4} bitwise reshard (npz writer: the
+        # collective-free one an elastic survivor would use)
+        tp_state = {n: v for n, v in tp_scope.vars.items()
+                    if v is not None}
+        ckpt.save_checkpoint(tmpdir, tp_state, 3, writer="npz")
+        topo = ckpt.load_topology(tmpdir) or {}
+        checks["topology_mesh_axes"] = (
+            topo.get("mesh_axes") == {"dp": 2, "mp": 2})
+        mesh_dp4 = build_rule_mesh({"dp": 4})
+        tmpl = {n: np.empty(np.shape(v),
+                            np.asarray(v).dtype if not hasattr(
+                                v, "dtype") else v.dtype)
+                for n, v in tp_state.items()}
+        restored, _ = ckpt.restore_resharded(tmpdir, tmpl, mesh=mesh_dp4)
+        checks["ckpt_reshard_bitwise"] = all(
+            np.array_equal(np.asarray(restored[n]), np.asarray(v))
+            for n, v in tp_state.items())
+
+        row.update({
+            "value": int(all(checks.values())), "unit": "ok",
+            "vs_baseline": None,
+            "dp_losses": dp_losses, "tp_losses": tp_losses,
+            "predicted_model_collectives": pred_model,
+            "executed_model_collectives": {
+                "psums": model.get("psums"),
+                "total_bytes": model.get("total_bytes")},
+            "leaf_bytes": leaf_bytes,
+            "static_peak_bytes": static_peak,
+            "measured_tp_peak_bytes": tp_peak,
+            "measured_dp_peak_bytes": dp_peak,
+            "mem_rel_err": (round(mem_err, 4) if mem_err is not None
+                            else None),
+            "checks": checks,
+        })
+        if not all(checks.values()):
+            row["error"] = "failed checks: " + ", ".join(
+                k for k, v in checks.items() if not v)
+    finally:
+        _shutil.rmtree(tmpdir, ignore_errors=True)
+        monitor.disable()
+        monitor.reset()
+        if was_enabled:
+            monitor.enable()
+    return row
+
+
+def main_tp_runtime_smoke():
+    """`python bench.py tp_runtime_smoke` — CI/tooling entry: the
+    GSPMD runtime-tier row standalone on a 4-device virtual CPU mesh,
+    persisted to BENCH_TPU.json under rows["tp_runtime_smoke"].  Exit
+    0 only when the TP run matches the dp reference, the predicted
+    collective table matches execution exactly, the leaves are
+    verifiably sharded, the memory claims hold, and the checkpoint
+    reshards bitwise."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_tp_runtime_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["tp_runtime_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def bench_numerics_lint_smoke(on_tpu, peak):
     """Numerics-analyzer smoke row (ISSUE 15): four pillars.
 
@@ -3691,6 +3896,8 @@ def main():
          bench_program_lint_smoke),
         ("sharding_lint_smoke", "sharding_lint_smoke",
          bench_sharding_lint_smoke),
+        ("tp_runtime_smoke", "tp_runtime_smoke",
+         bench_tp_runtime_smoke),
         ("numerics_lint_smoke", "numerics_lint_smoke",
          bench_numerics_lint_smoke),
         ("graph_opt_sweep", "graph_opt_sweep", bench_graph_opt_sweep),
@@ -3776,6 +3983,8 @@ if __name__ == "__main__":
         sys.exit(main_program_lint_smoke())
     if "sharding_lint_smoke" in sys.argv[1:]:
         sys.exit(main_sharding_lint_smoke())
+    if "tp_runtime_smoke" in sys.argv[1:]:
+        sys.exit(main_tp_runtime_smoke())
     if "numerics_lint_smoke" in sys.argv[1:]:
         sys.exit(main_numerics_lint_smoke())
     if "graph_opt_sweep" in sys.argv[1:]:

@@ -45,6 +45,7 @@ __all__ = [
     "MeshSpec", "ShardSpec", "REPLICATED", "PartitionRules",
     "match_report", "propagate", "analyze", "ShardingAnalysis",
     "attach", "attached", "load_rules_file",
+    "ShardingPlan", "lower",
 ]
 
 _DTYPE_BYTES = {
@@ -1869,3 +1870,235 @@ def analyze(program, rules, fetch_names=None, feed_names=(),
     memory = estimate_memory(program, ctx, fetch_names=fetch_names)
     return ShardingAnalysis(program, rules, report, ctx, memory,
                             program_key=program_key)
+
+
+# ---------------------------------------------------------------------------
+# spec -> NamedSharding lowering (the GSPMD runtime tier's static half)
+# ---------------------------------------------------------------------------
+
+def _inherit_optimizer_specs(specs, classes, shapes):
+    """Optimizer accumulators mirror their parameter's layout (the
+    ``shard_train_state`` contract): an accumulator named
+    ``{param}_{opt}_{slot}`` with the PARAMETER's shape inherits the
+    parameter's (partial-cleared) spec; shape-mismatched slots
+    (beta-pow scalars) stay replicated.  A rule that explicitly shards
+    an accumulator wins — only replicated accumulators inherit.
+    Returns the {name: spec} overrides only."""
+    params = sorted(
+        (n for n, c in classes.items() if c in ("param", "persist")),
+        key=len, reverse=True)
+    out = {}
+    for name, cls in classes.items():
+        if cls != "optimizer":
+            continue
+        if not specs.get(name, REPLICATED).is_replicated:
+            continue
+        vs = shapes.get(name)
+        for p in params:
+            if not (name.startswith(p) and len(name) > len(p)
+                    and name[len(p)] in "._"):
+                continue
+            pspec = specs.get(p, REPLICATED)
+            if not pspec.is_replicated:
+                pvs = shapes.get(p)
+                if vs is not None and pvs is not None \
+                        and vs.shape is not None \
+                        and tuple(vs.shape) == tuple(pvs.shape):
+                    out[name] = pspec.clear_partial()
+            break
+    return out
+
+
+class ShardingPlan:
+    """The concrete lowering of one rule set over one program: what the
+    executor's SPMD tier places, pins, and prices.
+
+    - ``state_specs`` — per-persistable ShardSpec for device placement
+      (donated optimizer state inherits its parameter's layout);
+    - ``constraints`` — ``[(op_index, var, ShardSpec)]`` activation
+      pins at the edges the propagator marked (attention qkv/out, ffn
+      up/down, the vocab-sharded embedding): the executor inserts
+      ``with_sharding_constraint`` exactly there;
+    - ``model_collectives`` — the implied-collective records over
+      NON-data mesh axes (the mp psums): the table the executed
+      ``last_sync_stats`` must reproduce;
+    - ``memory`` — the static per-shard estimate re-run WITH the
+      optimizer-state inheritance, so the number the runtime
+      mem-profile is compared against prices the layout that actually
+      executes.
+
+    Jax-free like the rest of this module; ``ShardSpec.to_jax()`` is
+    the executor-side bridge."""
+
+    def __init__(self, program, rules, report, ctx, state_specs,
+                 constraints, model_collectives, memory):
+        self.program = program
+        self.rules = rules
+        self.report = report
+        self.specs = dict(ctx.env)
+        self.classes = dict(ctx.classes or {})
+        self.shapes = ctx.shapes
+        self.mesh_axes = dict(rules.mesh.axes)
+        self.data_axis = rules.data_axis
+        self.state_specs = state_specs
+        self.constraints = constraints
+        self.model_collectives = model_collectives
+        self.memory = memory
+        self._mesh = rules.mesh
+
+    def fingerprint(self):
+        """Cache identity: rule set + mesh + data axis (the executor's
+        compiled-step cache key includes this, so re-attaching a
+        different rule set retraces instead of serving a stale
+        layout)."""
+        return self.rules.fingerprint()
+
+    def body_spec(self, spec):
+        """`spec` with the data axis STRIPPED: inside the executor's
+        shard_map body the data axis is manual (arrays are per-dp-shard
+        locals), so constraints there may only name model axes."""
+        if spec.dims is None:
+            return ShardSpec(None)
+        return ShardSpec(
+            tuple(None if d == self.data_axis else d
+                  for d in spec.dims))
+
+    def model_sync_records(self):
+        """The predicted model-parallel collective records (kind,
+        axes, var, bytes, op_index, scope) — what the executor notes
+        into ``last_sync_stats`` at trace time, making predicted ==
+        executed true by construction (the dp bucket-planner
+        philosophy extended to mp)."""
+        return [dict(r) for r in self.model_collectives]
+
+    def collective_table(self):
+        """{(kind, axes): {count, bytes}} over the model collectives."""
+        out = {}
+        for rec in self.model_collectives:
+            key = (rec["kind"], tuple(rec["axes"]))
+            d = out.setdefault(key, {"count": 0, "bytes": 0})
+            d["count"] += 1
+            d["bytes"] += rec["bytes"]
+        return out
+
+    def per_var_table(self):
+        """[{var, class, spec, full_bytes, shard_bytes}] over every
+        persistable (and data) var — the ``--lower`` CLI's plan print
+        and the per-leaf placement assertion's expected set."""
+        rows = []
+        for name in sorted(self.state_specs):
+            spec = self.state_specs[name]
+            vs = self.shapes.get(name)
+            rows.append({
+                "var": name,
+                "class": self.classes.get(name, "persist"),
+                "spec": spec.render(),
+                "partition_spec": list(spec.dims or []),
+                "full_bytes": full_bytes(vs, default_dim=1),
+                "shard_bytes": shard_bytes(vs, spec, self._mesh,
+                                           default_dim=1),
+            })
+        return rows
+
+    def to_record(self):
+        table = {f"{kind}@{'x'.join(axes)}": dict(v)
+                 for (kind, axes), v in self.collective_table().items()}
+        sharded = [r for r in self.per_var_table()
+                   if r["partition_spec"]
+                   and any(d for d in r["partition_spec"])]
+        return {
+            "kind": "sharding_plan",
+            "mesh": dict(self.mesh_axes),
+            "data_axis": self.data_axis,
+            "state_vars": len(self.state_specs),
+            "sharded_state_vars": len(sharded),
+            "constraints": len(self.constraints),
+            "model_collectives": table,
+            "static_peak_bytes": (self.memory or {}).get("peak_bytes"),
+            "static_state_bytes": (self.memory or {}).get("state_bytes"),
+        }
+
+    def render(self):
+        mesh = ", ".join(f"{k}={v}" for k, v in self.mesh_axes.items())
+        lines = [f"sharding plan on mesh {{{mesh}}} "
+                 f"(data axis {self.data_axis!r}):"]
+        for r in self.per_var_table():
+            sb = r["shard_bytes"]
+            fb = r["full_bytes"]
+            lines.append(
+                f"  {r['var']:<40s} {r['spec']:<16s} "
+                f"{'' if sb is None else sb} / "
+                f"{'' if fb is None else fb} bytes/shard"
+                f" [{r['class']}]")
+        lines.append(f"  {len(self.constraints)} activation constraint"
+                     f"{'s' if len(self.constraints) != 1 else ''}")
+        for (kind, axes), v in sorted(self.collective_table().items()):
+            lines.append(f"  implied {kind} over {'x'.join(axes)}: "
+                         f"{v['count']} x, {v['bytes']} bytes")
+        if self.memory:
+            lines.append(f"  static per-shard peak: "
+                         f"{self.memory['peak_bytes']} bytes (+ state "
+                         f"{self.memory['state_bytes']})")
+        return "\n".join(lines)
+
+
+def lower(program, rules, fetch_names=None, feed_names=(),
+          feed_shapes=None):
+    """Lower a rule set over a program into a :class:`ShardingPlan`:
+    run the PR-12 propagation, inherit optimizer-state layouts from
+    their parameters, collect the activation-edge constraint set and
+    the model-axis collective records, and re-price the static
+    per-shard memory for the layout that will actually execute.  Pure
+    analysis — no jax, no trace; the executor (and the ``--lower``
+    CLI) consume the result."""
+    report, ctx = propagate(program, rules, fetch_names=fetch_names,
+                            feed_names=feed_names,
+                            feed_shapes=feed_shapes)
+    classes = ctx.classes or _var_classes(program)
+    persist = {n for n, c in classes.items()
+               if c in ("param", "persist", "optimizer")}
+    model_axes = set(rules.mesh.axes) - {rules.data_axis}
+
+    # state placement: the var's final propagated spec (partial
+    # markers cleared — placement is a layout, not a pending psum),
+    # optimizer slots inheriting their parameter's layout
+    state_specs = {}
+    for n in sorted(persist):
+        state_specs[n] = ctx.env.get(n, REPLICATED).clear_partial()
+    inherited = _inherit_optimizer_specs(state_specs, classes,
+                                         ctx.shapes)
+    state_specs.update(inherited)
+
+    # activation pins: every forward-op output whose propagated spec
+    # names a model axis — exactly the edges the propagator marked
+    # (qkv/ffn column outputs and their reshapes/transposes, the
+    # vocab-sharded embedding's sharded head).  Partial-only specs are
+    # NOT pinned: the owed psum is GSPMD's to place at the dot.
+    constraints = []
+    blk = program.global_block()
+    ops = list(blk.ops)
+    for i, op in enumerate(ops):
+        if not ctx.hot(i) and ctx.fwd_limit:
+            break
+        for o in op.output_names():
+            if o in persist:
+                continue
+            spec = ctx.env.get(o)
+            if spec is None or spec.dims is None:
+                continue
+            if not (set(spec.sharded_axes()) & model_axes):
+                continue
+            constraints.append((i, o, spec.clear_partial()))
+
+    model_collectives = [
+        r for r in ctx.collectives
+        if set(r["axes"]) & model_axes]
+
+    # re-price the static memory with the INHERITED optimizer layout:
+    # this is the estimate the runtime conformance compares against,
+    # so it must price the state the executor actually places
+    for n, spec in inherited.items():
+        ctx.env[n] = spec
+    memory = estimate_memory(program, ctx, fetch_names=fetch_names)
+    return ShardingPlan(program, rules, report, ctx, state_specs,
+                        constraints, model_collectives, memory)

@@ -245,6 +245,36 @@ def _current_topology():
         return {}
 
 
+def _state_mesh_axes(state):
+    """{axis: size} of the mesh the state's device arrays live on
+    (the first NamedSharding-carrying leaf — a train state lives on
+    ONE mesh), or None for host/numpy states.  Written into
+    `_TOPOLOGY.json` so `restore_resharded` callers can see the
+    WRITER's {dp,mp} shape without reconstructing its mesh."""
+    for v in jax.tree_util.tree_leaves(state):
+        sh = getattr(v, "sharding", None)
+        m = getattr(sh, "mesh", None)
+        if m is not None and getattr(m, "axis_names", None):
+            try:
+                return {str(a): int(m.shape[a]) for a in m.axis_names}
+            except Exception:
+                return None
+    return None
+
+
+def _leaf_name(kpath):
+    """Last component of a tree_flatten_with_path key path as the
+    plain var name state_specs are keyed by ('fc_0.w_0' etc.)."""
+    if not kpath:
+        return None
+    last = kpath[-1]
+    for attr in ("key", "name", "idx"):
+        v = getattr(last, attr, None)
+        if v is not None:
+            return str(v)
+    return str(last)
+
+
 def save_checkpoint(directory, state, step, sparse_tables=None,
                     extras=None, topology=None, writer=None):
     """Write `state` (any pytree of jax/np arrays) at `step`.
@@ -337,6 +367,9 @@ def save_checkpoint(directory, state, step, sparse_tables=None,
     # topology provenance: what fleet shape wrote this checkpoint.
     # Written BEFORE the manifest so its bytes are checksum-covered.
     topo = _current_topology()
+    mesh_axes = _state_mesh_axes(state)
+    if mesh_axes is not None:
+        topo["mesh_axes"] = mesh_axes
     topo.update(topology or {})
     topo["step"] = step
     topo["wall_time"] = time.time()
@@ -455,7 +488,7 @@ def resharded_cursor(step, old_world=None, new_world=None,
 
 
 def restore_resharded(directory, template_state, mesh=None, step=None,
-                      sparse_tables=None):
+                      sparse_tables=None, state_specs=None):
     """Restore checkpoint `step` (default: newest COMPLETE — a
     truncated/corrupt newest dir is skipped by latest_step's checksum
     pass, falling back to the previous complete step) onto a DIFFERENT
@@ -469,6 +502,15 @@ def restore_resharded(directory, template_state, mesh=None, step=None,
     placement).  Replication is what makes the reshard bitwise-exact:
     every device of the new mesh sees the identical bytes the old
     world saved, whatever either world's shape.
+
+    state_specs (ISSUE 16): optional {leaf_name: ShardSpec-or-
+    PartitionSpec} — leaves named in it are placed SHARDED on `mesh`
+    instead of replicated (a ShardingPlan.state_specs lowers a TP
+    checkpoint straight onto another {dp,mp} shape: the host bytes are
+    identical either way, placement only decides which slice each
+    device holds, so the reshard stays bitwise).  Leaves without a
+    spec, and every leaf when state_specs is None, replicate as
+    before.
 
     Returns (state, step).  Counted as `resilience.elastic_reshards`
     next to the ordinary restore counters."""
@@ -505,14 +547,30 @@ def restore_resharded(directory, template_state, mesh=None, step=None,
         rep = NamedSharding(mesh, PartitionSpec())
         multiproc = len({getattr(d, "process_index", 0)
                          for d in mesh.devices.flat}) > 1
-        if multiproc:
-            # every process restored identical bytes from the shared
-            # store; each contributes its full copy of the replica
-            state = jax.tree.map(
-                lambda v: jax.make_array_from_process_local_data(
-                    rep, np.asarray(v)), state)
-        else:
-            state = jax.tree.map(lambda v: jax.device_put(v, rep), state)
+
+        def _target(kpath):
+            if not state_specs:
+                return rep
+            spec = state_specs.get(_leaf_name(kpath))
+            if spec is None:
+                return rep
+            if hasattr(spec, "to_jax"):     # analyzer ShardSpec
+                spec = spec.to_jax()
+            return NamedSharding(mesh, spec)
+
+        def _place(kpath, v):
+            sh = _target(kpath)
+            arr = np.asarray(v)
+            if multiproc:
+                # every process restored identical full bytes from the
+                # shared store; each serves the shards it addresses by
+                # slicing its own copy (replicated target: the full
+                # index — same path as before)
+                return jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, a=arr: a[idx])
+            return jax.device_put(v, sh)
+
+        state = jax.tree_util.tree_map_with_path(_place, state)
     if sparse_tables:
         npz = np.load(os.path.join(path, "sparse_tables.npz"))
         for name, table in sparse_tables.items():
@@ -580,14 +638,16 @@ class CheckpointManager:
                                sparse_tables=sparse_tables)
 
     def restore_resharded(self, template_state, mesh=None, step=None,
-                          sparse_tables=None):
+                          sparse_tables=None, state_specs=None):
         """Topology-change restore (ISSUE 11): bring the newest
         complete checkpoint — whatever world size saved it — up
-        REPLICATED on `mesh` (or as host arrays when mesh is None).
+        REPLICATED on `mesh` (or as host arrays when mesh is None);
+        `state_specs` places named leaves SHARDED instead (ISSUE 16).
         See module-level restore_resharded."""
         return restore_resharded(self.directory, template_state,
                                  mesh=mesh, step=step,
-                                 sparse_tables=sparse_tables)
+                                 sparse_tables=sparse_tables,
+                                 state_specs=state_specs)
 
     def _gc(self):
         """Rolling retention PLUS orphan cleanup: crashed save

@@ -13,7 +13,9 @@ from .collective import (
     all_reduce, all_gather, reduce_scatter, broadcast, ppermute, all_to_all,
     psum, pmean, pmax, pmin,
 )
-from .mesh import build_mesh, default_mesh, get_global_mesh, set_global_mesh
+from .mesh import (build_mesh, build_rule_mesh, default_mesh,
+                   get_global_mesh, mesh_key, mesh_layout,
+                   set_global_mesh)
 from .env import ParallelEnv, init_parallel_env, get_rank, get_world_size
 from .data_parallel import DataParallel, DataParallelTrainStep, scale_loss
 from .sharded import (
@@ -37,7 +39,8 @@ __all__ = [
     "collective", "mesh", "fleet",
     "all_reduce", "all_gather", "reduce_scatter", "broadcast", "ppermute",
     "all_to_all", "psum", "pmean", "pmax", "pmin",
-    "build_mesh", "default_mesh", "get_global_mesh", "set_global_mesh",
+    "build_mesh", "build_rule_mesh", "default_mesh", "get_global_mesh",
+    "mesh_key", "mesh_layout", "set_global_mesh",
     "ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
     "DataParallel", "DataParallelTrainStep", "scale_loss",
     "PartitionRules", "gpt_rules", "bert_rules", "mlp_rules",

@@ -7,6 +7,8 @@ jax.sharding.Mesh — "dp"/"tp"/"pp"/"sp"/"ep" axes replace ring ids, and
 XLA compiles the collectives onto ICI links; no comm-init ops exist.
 """
 
+import math
+
 import numpy as np
 
 import jax
@@ -35,6 +37,127 @@ def build_mesh(dp=1, tp=1, pp=1, sp=1, ep=1, devices=None):
             f"mesh needs {need} devices, only {len(devices)} available")
     devs = np.array(devices[:need]).reshape(pp, dp, sp, tp, ep)
     return Mesh(devs, AXES)
+
+
+def build_rule_mesh(axes, devices=None):
+    """Mesh whose axis names/order follow a partition-rule
+    ``MeshSpec``-style ``{axis: size}`` dict (e.g. ``{"dp": 2,
+    "mp": 2}``) — the analyzer's axis names become jax mesh axes
+    VERBATIM, so a rule spec ``[None, "mp"]`` lowers to
+    ``PartitionSpec(None, "mp")`` on this mesh with no renaming
+    table.  Size-1 axes are kept (they cost nothing and preserve the
+    rule set's axis vocabulary).  ``devices`` pins an explicit device
+    list (the elastic contract of ``with_data_parallel(places=...)``);
+    otherwise the first ``prod(sizes)`` global devices are taken."""
+    axes = {str(k): int(v) for k, v in dict(
+        axes.axes if hasattr(axes, "axes") else axes).items()}
+    if not axes:
+        axes = {"dp": 1}
+    devices = devices if devices is not None else jax.devices()
+    need = math.prod(axes.values())
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {axes} needs {need} devices, only "
+            f"{len(devices)} available")
+    devs = np.array(devices[:need]).reshape(tuple(axes.values()))
+    return Mesh(devs, tuple(axes))
+
+
+def mesh_key(mesh):
+    """Device-IDENTITY cache key of a mesh: (axis names, shape, sorted
+    device ids).  Two meshes with the same key compile to the same
+    executable; an elastic retarget onto a same-sized DIFFERENT device
+    set changes the key and forces a retrace."""
+    return (tuple(mesh.axis_names), mesh.shape_tuple,
+            tuple(sorted(int(d.id) for d in mesh.devices.flat)))
+
+
+class MeshLayout:
+    """One mesh's derived placement facts, computed once and shared by
+    every feed path (ISSUE 16): the executor's compiled-step cache key,
+    the fleet timestamp-feed sharding, and the skew probe's per-shard
+    process map all read the same object instead of memoizing
+    separately.
+
+    Fields:
+      mesh         — the jax Mesh
+      key          — :func:`mesh_key` device-identity tuple
+      data_axis    — the batch-sharding axis name (None if absent)
+      data_sharding— NamedSharding splitting dim 0 over data_axis
+      local_rows   — device rows this process contributes
+      shard_procs  — process_index per mesh device, flat order
+      data_rows    — data-axis rows this process contributes: on a 1-D
+                     dp mesh identical to local_rows, on a {dp,mp} mesh
+                     the number of DISTINCT dp coordinates among the
+                     local devices (the fleet timestamp feed is one row
+                     per dp SHARD, not per device)
+      data_procs   — process_index per data-axis shard (first device of
+                     each dp slice), the skew table's rank->host map
+      fingerprint  — the rule-set fingerprint this layout was keyed
+                     with (None for plain dp layouts)
+    """
+
+    __slots__ = ("mesh", "key", "data_axis", "data_sharding",
+                 "local_rows", "shard_procs", "data_rows", "data_procs",
+                 "fingerprint")
+
+    def __init__(self, mesh, data_axis="dp", fingerprint=None):
+        self.mesh = mesh
+        self.key = mesh_key(mesh)
+        self.data_axis = (data_axis if data_axis in mesh.axis_names
+                          else None)
+        self.fingerprint = fingerprint
+        devs = list(mesh.devices.flat)
+        try:
+            me = jax.process_index()
+        except Exception:
+            me = 0
+        self.shard_procs = [int(getattr(d, "process_index", 0))
+                            for d in devs]
+        self.local_rows = (sum(1 for p in self.shard_procs if p == me)
+                           or len(devs))
+        if self.data_axis is not None:
+            ax = list(mesh.axis_names).index(self.data_axis)
+            ndata = int(mesh.shape[self.data_axis])
+            procs = [None] * ndata
+            mine = set()
+            for idx, d in np.ndenumerate(mesh.devices):
+                i = idx[ax]
+                if procs[i] is None:
+                    procs[i] = int(getattr(d, "process_index", 0))
+                if int(getattr(d, "process_index", 0)) == me:
+                    mine.add(i)
+            self.data_procs = [p if p is not None else 0 for p in procs]
+            self.data_rows = len(mine) or ndata
+        else:
+            self.data_procs = list(self.shard_procs)
+            self.data_rows = self.local_rows
+        try:
+            self.data_sharding = NamedSharding(
+                mesh, P(self.data_axis) if self.data_axis else P())
+        except Exception:
+            self.data_sharding = None
+
+
+_LAYOUT_CACHE = {}   # (id(mesh), data_axis, fingerprint) -> MeshLayout
+
+
+def mesh_layout(mesh, data_axis="dp", fingerprint=None):
+    """The shared mesh-layout cache (ISSUE 16 satellite): one
+    :class:`MeshLayout` per (mesh device identity, rule fingerprint),
+    id-recycle-proof (the entry holds the mesh; a recycled id() with a
+    different mesh object misses).  Bounded like the fleet's old
+    private cache: 8 entries, cleared wholesale."""
+    k = (id(mesh), data_axis, fingerprint)
+    ent = _LAYOUT_CACHE.get(k)
+    if ent is not None and ent.mesh is mesh:
+        return ent
+    layout = MeshLayout(mesh, data_axis=data_axis,
+                        fingerprint=fingerprint)
+    if len(_LAYOUT_CACHE) >= 8:
+        _LAYOUT_CACHE.clear()
+    _LAYOUT_CACHE[k] = layout
+    return layout
 
 
 def set_global_mesh(mesh):

@@ -112,6 +112,10 @@ class CompiledProgram:
         self._telemetry_label = None
         self._dp_mesh_cache = None   # (ndev, Mesh) — see _dp_mesh
         self._dp_key_cache = None    # (Mesh, key) — see _dp_mesh_key
+        self._is_spmd = False        # with_sharding_rules(execute=True)
+        self._spmd_rules = None      # PartitionRules driving execution
+        self._spmd_places = None     # explicit device list (elastic)
+        self._spmd_mesh_cache = None  # (fingerprint, Mesh)
 
     def with_precision(self, precision):
         """Pin the matmul/conv precision this program compiles with
@@ -129,7 +133,8 @@ class CompiledProgram:
         self._telemetry_label = label
         return self
 
-    def with_sharding_rules(self, rules, mesh=None, data_axis="dp"):
+    def with_sharding_rules(self, rules, mesh=None, data_axis="dp",
+                            execute=False, places=None):
         """Attach a partition-rule set for the static sharding
         analyzer (ISSUE 12): under ``FLAGS_static_check`` the verifier
         lints the program against these rules (PT301-PT306 — rule
@@ -141,7 +146,16 @@ class CompiledProgram:
         a plain ``[(regex, dims), ...]`` list with ``mesh`` given
         separately.  Attachment is analysis metadata, not a graph
         mutation: the program version does not bump, and the lint
-        cache keys on the rule fingerprint."""
+        cache keys on the rule fingerprint.
+
+        ``execute=True`` is the GSPMD runtime tier (ISSUE 16): the
+        executor LOWERS these rules — params and donated optimizer
+        state placed per-leaf on the rule mesh, activation edges pinned
+        with ``with_sharding_constraint``, feeds batch-sharded over the
+        data axis, model axes handed to XLA as GSPMD auto axes.
+        ``places`` pins an explicit device list (elastic contract);
+        re-attaching a different rule set retraces (the compiled-step
+        cache keys on the rule fingerprint + mesh device identity)."""
         from ..analysis import sharding as _sh
 
         if isinstance(rules, dict):
@@ -153,6 +167,12 @@ class CompiledProgram:
             rules = _sh.PartitionRules(rules, mesh,
                                        data_axis=data_axis)
         _sh.attach(self._program, rules)
+        if execute:
+            self._is_spmd = True
+            self._spmd_rules = rules
+            if places is not None:
+                self._spmd_places = places
+            self._spmd_mesh_cache = None
         return self
 
     # -- reference API ---------------------------------------------------
@@ -237,16 +257,65 @@ class CompiledProgram:
         return mesh
 
     def _dp_mesh_key(self):
-        """Device-identity cache key of the current dp mesh: (shape,
-        sorted device ids).  Memoized with the mesh itself, so the
-        executor's per-dispatch key build stays O(1) — and a
+        """Device-identity cache key of the current dp mesh, via the
+        shared :func:`distributed.mesh.mesh_layout` cache (ISSUE 16
+        satellite — the same layout object serves the fleet timestamp
+        feeds and the skew probe).  Memoized with the mesh itself, so
+        the executor's per-dispatch key build stays O(1) — and a
         retarget_dp onto a SAME-SIZED different device set still
         retraces instead of serving the old world's executable."""
         mesh = self._dp_mesh()
         cached = self._dp_key_cache
         if cached is not None and cached[0] is mesh:
             return cached[1]
-        key = (mesh.shape_tuple,
-               tuple(sorted(int(d.id) for d in mesh.devices.flat)))
+        from ..distributed.mesh import mesh_layout
+
+        key = mesh_layout(mesh, "dp").key
         self._dp_key_cache = (mesh, key)
         return key
+
+    # -- GSPMD runtime tier (ISSUE 16) ----------------------------------
+    def _spmd_mesh(self):
+        """Mesh for the attached rule set's ``{axis: size}`` spec
+        (``build_rule_mesh`` — analyzer axis names become jax mesh axes
+        verbatim), memoized per rule fingerprint.  ``places`` given to
+        ``with_sharding_rules(execute=True)`` pins the device list."""
+        rules = self._spmd_rules
+        if rules is None:
+            raise ValueError(
+                "no executable rules: with_sharding_rules(..., "
+                "execute=True) first")
+        from ..distributed.mesh import build_rule_mesh
+
+        fp = rules.fingerprint()
+        cached = self._spmd_mesh_cache
+        if cached is not None and cached[0] == fp:
+            return cached[1]
+        places = self._spmd_places
+        devices = None
+        if isinstance(places, (list, tuple)) and places:
+            devices = list(places)
+        mesh = build_rule_mesh(rules.mesh, devices=devices)
+        self._spmd_mesh_cache = (fp, mesh)
+        from .. import monitor
+
+        if monitor.is_enabled():
+            monitor.gauge("spmd_devices").set(int(mesh.devices.size))
+        return mesh
+
+    def _spmd_layout(self):
+        """Shared MeshLayout for the spmd mesh, keyed on (device
+        identity, rule fingerprint) in the distributed.mesh cache."""
+        rules = self._spmd_rules
+        mesh = self._spmd_mesh()
+        from ..distributed.mesh import mesh_layout
+
+        return mesh_layout(mesh, data_axis=rules.data_axis,
+                           fingerprint=rules.fingerprint())
+
+    def _spmd_key(self):
+        """Compiled-step cache key of the spmd tier: rule fingerprint +
+        mesh device identity — re-attaching rules OR retargeting the
+        mesh retraces instead of serving a stale layout."""
+        layout = self._spmd_layout()
+        return (layout.key, layout.fingerprint)

@@ -618,7 +618,7 @@ def _run_op(op, env, rng_box, const_env=None):
 
 
 def interpret(ops, env, rng_box, const_env=None, scopes=None,
-              allow_sampling=True):
+              allow_sampling=True, pins=None):
     """Run `ops` in order.  `scopes` maps id(op) -> scope name (built
     once per program by op_scopes); while a monitor.op_profile sampler
     is active (the eager/dygraph sampling mode), each op is wall-timed
@@ -627,12 +627,18 @@ def interpret(ops, env, rng_box, const_env=None, scopes=None,
     chrome trace grows per-op rows.  allow_sampling=False marks a
     jit-STAGING caller (_make_step_fn): its per-op durations would be
     pure trace time masquerading as measurements, so the sampler is
-    bypassed there even when active."""
+    bypassed there even when active.
+
+    `pins` ({var_name: NamedSharding}, GSPMD tier) constrains each
+    listed var right after the op producing it — the activation-edge
+    with_sharding_constraint insertion of the lowered ShardingPlan."""
     sampler = _sampler() if allow_sampling else None
     if sampler is None:
         for op in ops:
             run_op(op, env, rng_box, const_env,
                    scopes.get(id(op)) if scopes else None)
+            if pins:
+                _apply_pins(op, env, pins)
         return
     global _profiler
     if _profiler is None:
@@ -644,6 +650,8 @@ def interpret(ops, env, rng_box, const_env=None, scopes=None,
             or f"main/{op.type}"
         t0 = time.perf_counter_ns()
         run_op(op, env, rng_box, const_env, scope)
+        if pins:
+            _apply_pins(op, env, pins)
         outs = [env[n] for n in op.output_names() if n in env]
         try:
             # concrete arrays block until device-done (the honest per-op
@@ -655,6 +663,17 @@ def interpret(ops, env, rng_box, const_env=None, scopes=None,
         t1 = time.perf_counter_ns()
         sampler.note(scope, (t1 - t0) / 1e3)
         _profiler.add_span(scope, t0, t1)
+
+
+def _apply_pins(op, env, pins):
+    """Constrain `op`'s just-produced outputs listed in `pins` — the
+    trace-time with_sharding_constraint emission of the GSPMD tier.
+    Scoped under the op's own named_scope caller, so the pin's HLO
+    carries the same provenance as the op it anchors."""
+    for n in op.output_names():
+        s = pins.get(n)
+        if s is not None and n in env:
+            env[n] = jax.lax.with_sharding_constraint(env[n], s)
 
 
 def op_scopes(ops, sections):
@@ -790,6 +809,13 @@ class Executor:
         # re-placement scan so the steady-state dispatch path never
         # pays per-var sharding checks.
         self._check_state_placement = True
+        # GSPMD runtime tier (ISSUE 16): memoized ShardingPlan per
+        # (program, version, rule fingerprint, feed shapes), and a
+        # placement stamp per program so the per-leaf sharded
+        # device_put scan runs once per (program, mesh, rules) — the
+        # steady-state dispatch pays one dict probe.
+        self._spmd_plans = {}
+        self._spmd_place_stamps = {}
 
     def close(self):
         self._cache.clear()
@@ -816,6 +842,30 @@ class Executor:
             program._run_plan_cache = plan
         return plan
 
+    def _get_spmd_plan(self, program, rules, fetch_names, feed_arrays):
+        """Memoized ShardingPlan for the GSPMD tier: one
+        ``analysis.sharding.lower`` per (program identity, version,
+        rule fingerprint, feed shapes) — a rule re-attachment or a
+        feed-shape change re-lowers, the steady state pays a dict
+        probe.  Entries hold the program so a recycled id() after GC
+        can't serve a stale plan."""
+        shapes = {n: tuple(np.shape(a)) for n, a in feed_arrays.items()
+                  if not n.startswith("__fleet_")}
+        key = (id(program), program._version, rules.fingerprint(),
+               tuple(sorted(shapes.items())), tuple(fetch_names))
+        ent = self._spmd_plans.get(key)
+        if ent is not None and ent[0] is program:
+            return ent[1]
+        from ..analysis import sharding as _sh
+
+        plan = _sh.lower(program, rules, fetch_names=fetch_names,
+                         feed_names=sorted(shapes),
+                         feed_shapes=shapes)
+        if len(self._spmd_plans) >= 8:
+            self._spmd_plans.clear()
+        self._spmd_plans[key] = (program, plan)
+        return plan
+
     # ------------------------------------------------------------------
     def run(
         self,
@@ -839,8 +889,28 @@ class Executor:
         dp_key = None
         precision = resolve_precision(program)
         telemetry_key = getattr(program, "_telemetry_label", None)
+        spmd_rules = None
+        spmd_plan = None
         if hasattr(program, "_get_executable_program"):
-            if getattr(program, "_is_data_parallel", False):
+            if getattr(program, "_is_spmd", False):
+                # GSPMD runtime tier (ISSUE 16): the attached partition
+                # rules EXECUTE — state placed per-leaf on the rule
+                # mesh, model axes handed to XLA as auto axes, the dp
+                # axis staying the manual grad-sync axis below.
+                spmd_rules = program._spmd_rules
+                dp_mesh = program._spmd_mesh()
+                if "dp" not in dp_mesh.axis_names \
+                        or spmd_rules.data_axis != "dp":
+                    raise ValueError(
+                        "executable sharding rules need a 'dp' data "
+                        "axis on the mesh (size 1 is fine); got axes "
+                        f"{dp_mesh.axis_names} with data axis "
+                        f"{spmd_rules.data_axis!r}")
+                # rule fingerprint + mesh device identity: re-attaching
+                # rules or retargeting the mesh retraces instead of
+                # serving a stale layout
+                dp_key = program._spmd_key()
+            elif getattr(program, "_is_data_parallel", False):
                 dp_mesh = program._dp_mesh()
                 # device-IDENTITY key (memoized with the mesh): an
                 # elastic retarget_dp onto a same-sized different
@@ -940,7 +1010,10 @@ class Executor:
         check_mode = flags.flag("static_check")
         if check_mode and check_mode != "off":
             self._static_check(program, fetch_names, feed, dp_mesh,
-                               check_mode, telemetry_key, mon, mon_on)
+                               check_mode, telemetry_key, mon, mon_on,
+                               dp_ndev=(int(dp_mesh.shape["dp"])
+                                        if spmd_rules is not None
+                                        else None))
 
         res = _res()
         guard = res.active_guard()
@@ -968,6 +1041,14 @@ class Executor:
                 else:
                     feed_arrays[name] = jnp.asarray(np.asarray(value),
                                                     dtype=dtype)
+            if spmd_rules is not None:
+                # lower the rules into the executable ShardingPlan
+                # (state placement, activation pins, model-collective
+                # records) — memoized per (program, version, rule
+                # fingerprint, feed shapes), so the steady state pays
+                # one dict probe
+                spmd_plan = self._get_spmd_plan(
+                    program, spmd_rules, fetch_names, feed_arrays)
             if res.faultinject.is_armed():
                 # fault-injection harness: counts this dispatch and may
                 # hand back a NaN-tainted COPY of the feed dict (the
@@ -1028,7 +1109,34 @@ class Executor:
                         f"the startup program first"
                     )
 
-            if dp_mesh is not None and self._check_state_placement:
+            if spmd_plan is not None:
+                # per-leaf SHARDED placement (the tentpole's HBM win):
+                # params and the donated optimizer state go onto the
+                # rule mesh under their lowered NamedSharding — an
+                # mp-sharded leaf's per-shard bytes shrink by ~1/mp.
+                # Scanned once per (program, mesh identity, rule
+                # fingerprint) via the placement stamp, re-armed by the
+                # restore paths through _check_state_placement.
+                stamp = self._spmd_place_stamps.get(id(program))
+                if (self._check_state_placement or stamp is None
+                        or stamp[0] is not program
+                        or stamp[1] != dp_key):
+                    from jax.sharding import (NamedSharding,
+                                              PartitionSpec as _P)
+
+                    for n, v in state.items():
+                        spec = spmd_plan.state_specs.get(n)
+                        sh = NamedSharding(
+                            dp_mesh,
+                            spec.to_jax() if spec is not None else _P())
+                        if getattr(v, "sharding", None) != sh:
+                            state[n] = jax.device_put(v, sh)
+                    if len(self._spmd_place_stamps) >= 8:
+                        self._spmd_place_stamps.clear()
+                    self._spmd_place_stamps[id(program)] = (program,
+                                                            dp_key)
+                    self._check_state_placement = False
+            elif dp_mesh is not None and self._check_state_placement:
                 # a checkpoint restore (auto_resume / guard rollback
                 # into a cold scope) hands back arrays COMMITTED to the
                 # template's devices; shard_map refuses committed
@@ -1051,7 +1159,12 @@ class Executor:
                 self._check_state_placement = False
 
             if dp_mesh is not None:
-                ndev = dp_mesh.devices.size
+                # feeds split over the DATA axis only: the full device
+                # count for pure dp, the dp-axis extent on a {dp,mp}
+                # rule mesh (mp shards see the whole local batch)
+                ndev = (int(dp_mesh.shape["dp"])
+                        if spmd_rules is not None
+                        else dp_mesh.devices.size)
                 for n, a in feed_arrays.items():
                     if a.ndim == 0 or a.shape[0] % ndev != 0:
                         raise ValueError(
@@ -1071,6 +1184,18 @@ class Executor:
             if fleet_on:
                 feed_arrays = _fleet().add_timestamp_feeds(feed_arrays,
                                                            dp_mesh)
+
+            if spmd_plan is not None:
+                # jax.lax.axis_index on a manual axis lowers to a
+                # PartitionId op, which XLA's SPMD partitioner rejects
+                # in partial-manual (auto mp) modules — so the per-dp-
+                # shard rng fold happens HERE on the host, and the
+                # [dp, 2] key stack ships sharded over dp instead of
+                # being folded inside the body
+                run_key = jax.vmap(
+                    lambda i, k=run_key: jax.random.fold_in(k, i))(
+                    jnp.arange(int(dp_mesh.shape["dp"]),
+                               dtype=jnp.uint32))
 
             feed_sig = tuple(
                 (n, feed_arrays[n].shape, str(feed_arrays[n].dtype))
@@ -1111,7 +1236,8 @@ class Executor:
                                            precision=precision,
                                            feed_casts=feed_casts,
                                            telemetry_key=telemetry_key,
-                                           guard_on=guard_on)
+                                           guard_on=guard_on,
+                                           spmd_plan=spmd_plan)
             except Exception as e:
                 # a program too big to even COMPILE dies with the same
                 # RESOURCE_EXHAUSTED shape an execution OOM does
@@ -1168,6 +1294,15 @@ class Executor:
             # RetriesExhausted chains it — lands here.)
             self._oom_postmortem(e, mon_on)
             raise
+        if spmd_plan is not None:
+            # record the model-axis collectives XLA inserted from the
+            # auto-axis constraints: the plan's OWN implied records, so
+            # last_sync_stats()["model"] equals the analyzer's table by
+            # construction (the mp half of the conformance loop)
+            from ..transpiler import collective as _coll
+
+            _coll.note_model_sync(spmd_plan.model_sync_records(),
+                                  key=telemetry_key)
         skew_fetch = None
         if fleet_on:
             # the skew probe's replicated wait vector rides back as the
@@ -1359,7 +1494,7 @@ class Executor:
 
     @staticmethod
     def _static_check(program, fetch_names, feed, dp_mesh, mode,
-                      telemetry_key, mon, mon_on):
+                      telemetry_key, mon, mon_on, dp_ndev=None):
         """Run the static verifier before tracing (the reference's
         build-time InferShape parity point).  A fresh analysis emits
         ONE ProgramLintWarning (warn mode), a kind="lint" telemetry
@@ -1373,7 +1508,8 @@ class Executor:
         result, fresh = analysis.cached_check(
             program, fetch_names=fetch_names,
             feed_names=list(feed or ()),
-            dp_ndev=(None if dp_mesh is None
+            dp_ndev=(dp_ndev if dp_ndev is not None
+                     else None if dp_mesh is None
                      else int(dp_mesh.devices.size)),
             program_key=key)
         if fresh:
@@ -2071,7 +2207,7 @@ class Executor:
 
     def _build(self, program, fetch_names, persist_names, dp_mesh=None,
                precision=None, feed_casts=None, telemetry_key=None,
-               guard_on=False):
+               guard_on=False, spmd_plan=None):
         ops = self._live_ops(program, fetch_names)
         sections = [] if program._is_test else list(program.backward_sections)
         if telemetry_key is None:
@@ -2083,12 +2219,14 @@ class Executor:
                                 dp_mesh, precision=precision,
                                 feed_casts=feed_casts,
                                 telemetry_key=telemetry_key,
-                                guard_on=guard_on)
+                                guard_on=guard_on, spmd_plan=spmd_plan)
 
     def _build_step(self, ops, sections, fetch_names, persist_names,
                     dp_mesh, precision=None, feed_casts=None,
-                    telemetry_key="program", guard_on=False):
+                    telemetry_key="program", guard_on=False,
+                    spmd_plan=None):
         dp = dp_mesh is not None
+        spmd = spmd_plan is not None
         # var maps for the mem-profile's variable-class attribution:
         # which entry arguments are optimizer-updated parameters vs
         # other persistable state (stats buffers, optimizer moments)
@@ -2098,12 +2236,44 @@ class Executor:
             "persist": frozenset(persist_names),
         }
 
-        def make_step(dp):
+        pins = None
+        state_pins = None
+        model_axes = frozenset()
+        if spmd:
+            from jax.sharding import NamedSharding
+
+            # inside the shard_map body the dp axis is manual, so the
+            # lowered constraints name only the GSPMD auto (model)
+            # axes — body_spec strips the data axis
+            model_axes = frozenset(a for a in dp_mesh.axis_names
+                                   if a != "dp")
+
+            def _ns(spec):
+                return NamedSharding(
+                    dp_mesh, spmd_plan.body_spec(spec).to_jax())
+
+            # activation pins at the propagator-marked edges, keyed by
+            # var name (the producing op pins its output right after
+            # emission — see interpret)
+            pins = {name: _ns(spec)
+                    for _i, name, spec in spmd_plan.constraints}
+            # output-state pins: the donated state's layout is pinned
+            # to its input placement, or XLA's own inference would
+            # re-layout the donated buffers and retrace every step
+            # (the distributed.sharded make_sharded_train_step lesson)
+            state_pins = {n: _ns(s)
+                          for n, s in spmd_plan.state_specs.items()}
+
+        def make_step(dp, with_pins=True):
             return self._make_step_fn(ops, sections, fetch_names,
                                       persist_names, dp,
                                       feed_casts=feed_casts,
                                       guard_on=guard_on,
-                                      telemetry_key=telemetry_key)
+                                      telemetry_key=telemetry_key,
+                                      pins=pins if with_pins else None,
+                                      state_pins=(state_pins
+                                                  if with_pins else None),
+                                      spmd=spmd)
         step = make_step(dp)
 
         if not dp:
@@ -2120,11 +2290,19 @@ class Executor:
         from jax.sharding import PartitionSpec as P
 
         def dp_step(state, feeds, key):
-            # per-device rng diversity (dropout) while state stays in sync
-            key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+            # per-device rng diversity (dropout) while state stays in
+            # sync.  GSPMD tier: the fold already happened on host (a
+            # manual-axis axis_index would lower to the PartitionId op
+            # partial-manual modules reject) — the [dp, 2] key stack
+            # arrives sharded over dp, each shard takes its row.
+            if spmd:
+                key = key[0]
+            else:
+                key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
             return step(state, feeds, key)
 
-        plain_step = make_step(False)   # for shape-only evaluation
+        # for shape-only evaluation: no pins (they don't change shapes)
+        plain_step = make_step(False, with_pins=False)
         memo = {}
 
         def compiled(state, feeds, key):
@@ -2142,7 +2320,10 @@ class Executor:
                 # BEYOND the shape-evaluated ones (replicated by the
                 # all_gather, so out-spec P() with no fetch-sync pmean)
                 has_fleet = _fleet_names.FLEET_TS_SEC in feeds
-                ndev = dp_mesh.devices.size
+                # feeds split over the data axis only: on a {dp,mp}
+                # rule mesh each mp shard sees the whole dp-local batch
+                ndev = (int(dp_mesh.shape["dp"]) if spmd
+                        else dp_mesh.devices.size)
                 local_feeds = {
                     n: jax.ShapeDtypeStruct(
                         (a.shape[0] // ndev,) + a.shape[1:], a.dtype)
@@ -2174,13 +2355,24 @@ class Executor:
                 out_fetch_specs = [
                     P("dp") if r >= 1 else P() for r in fetch_ranks]
                 if has_fleet:
-                    out_fetch_specs = out_fetch_specs + [P()]
+                    # GSPMD tier: the probe returns its LOCAL wait row
+                    # (no in-body AllGather — XLA's propagation drops
+                    # it in partial-manual modules) and the out-spec
+                    # boundary concatenates the [dp] vector instead
+                    out_fetch_specs = out_fetch_specs + [
+                        P("dp") if spmd else P()]
+                # GSPMD tier: the model axes are AUTO — XLA propagates
+                # the state placements + body pins and inserts the mp
+                # collectives itself; the dp axis stays manual so the
+                # bucketed grad sync / skew probe machinery runs as-is
+                sm_kw = {"auto": model_axes} if spmd else {}
                 fn = _mon().instrument_jit(
                     jax.jit(apply_precision_policy(shard_map(
                         dp_step_shaped, mesh=dp_mesh,
-                        in_specs=(P(), P("dp"), P()),
+                        in_specs=(P(), P("dp"),
+                                  P("dp") if spmd else P()),
                         out_specs=(P(), out_fetch_specs),
-                        check_vma=False), precision),
+                        check_vma=False, **sm_kw), precision),
                         donate_argnums=(0,)),
                     key=telemetry_key + ":dp", var_info=var_info)
                 memo[sig] = fn
@@ -2190,7 +2382,8 @@ class Executor:
 
     def _make_step_fn(self, ops, sections, fetch_names, persist_names, dp,
                       feed_casts=None, guard_on=False,
-                      telemetry_key=None):
+                      telemetry_key=None, pins=None, state_pins=None,
+                      spmd=False):
         # optimizer-updated params: identical across dp replicas by
         # construction, so exempt from the SyncBN-style stats averaging
         param_names = set()
@@ -2248,14 +2441,15 @@ class Executor:
                                 e2 = dict(e_in)
                                 b = _RngBox(k)
                                 interpret(_c, e2, b, const_env, scopes,
-                                          allow_sampling=False)
+                                          allow_sampling=False,
+                                          pins=pins)
                                 return e2, b.key
 
                             e, box_key = jax.checkpoint(run_chunk)(e, box_key)
                         else:
                             b = _RngBox(box_key)
                             interpret(chunk, e, b, const_env, scopes,
-                                      allow_sampling=False)
+                                      allow_sampling=False, pins=pins)
                             box_key = b.key
                     loss = e[_loss]
                     return jnp.sum(loss), (e, box_key)
@@ -2298,14 +2492,15 @@ class Executor:
                             # extra scalar pair per step, attributed to
                             # dp_grad_sync like the psums it measures
                             skew = _coll.emit_skew_probe(
-                                fleet_ts[0], fleet_ts[1], "dp")
+                                fleet_ts[0], fleet_ts[1], "dp",
+                                gather=not spmd)
                     else:
                         synced = grads
                     for n, g in synced.items():
                         env[n + "@GRAD"] = g
                 pos = bs.pos
             interpret(ops[pos:], env, rng_box, const_env, scopes,
-                      allow_sampling=False)
+                      allow_sampling=False, pins=pins)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in persist_names if n in env}
             if dp:
@@ -2341,6 +2536,18 @@ class Executor:
                            if n in state else v)
                         for n, v in new_state.items()}
                 fetches = fetches + [flag]
+            if state_pins:
+                # pin each donated state output to its INPUT layout:
+                # without this XLA is free to infer a different output
+                # sharding for the updated state, which both breaks
+                # donation aliasing and retraces the step next call
+                # with the drifted placement
+                with jax.named_scope("update/spmd_state_pin_0"):
+                    new_state = {
+                        n: (jax.lax.with_sharding_constraint(
+                                v, state_pins[n])
+                            if n in state_pins else v)
+                        for n, v in new_state.items()}
             if fleet_ts is not None:
                 if skew is None:
                     # no backward section carried the probe (eval / dp
@@ -2350,7 +2557,8 @@ class Executor:
 
                     with jax.named_scope("update/dp_grad_sync_fleet"):
                         skew = _coll.emit_skew_probe(
-                            fleet_ts[0], fleet_ts[1], "dp")
+                            fleet_ts[0], fleet_ts[1], "dp",
+                            gather=not spmd)
                 # the wait vector is the VERY last fetch — the executor
                 # pops it before the guard flag's own pop
                 fetches = fetches + [skew]

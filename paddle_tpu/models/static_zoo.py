@@ -330,8 +330,16 @@ _TRANSFORMER_TP_RULES = [
     [r"fc_5\.w_0$", ["mp", None]],
     # token embedding: vocab-sharded (masked-lookup psum)
     [r"embedding_0\.w_0$", ["mp", None]],
-    # everything else (biases, norms, heads, optimizer scalars):
-    # replicated, explicitly
+    # column-parallel biases shard WITH their weight's output dim
+    # (Megatron: the bias adds onto the still-sharded activation, so a
+    # replicated bias would force a premature gather); row-parallel
+    # biases (fc_3/fc_5) stay replicated — they add AFTER the psum
+    [r"fc_0\.b_0$", ["mp"]],
+    [r"fc_1\.b_0$", ["mp"]],
+    [r"fc_2\.b_0$", ["mp"]],
+    [r"fc_4\.b_0$", ["mp"]],
+    # everything else (row-parallel biases, norms, heads, optimizer
+    # scalars): replicated, explicitly
     [r".*", []],
 ]
 

@@ -158,36 +158,20 @@ def host_timestamp():
     return int(t) % EPOCH_MOD, int((t % 1.0) * 1e6)
 
 
-_mesh_cache = {}   # id(mesh) -> (mesh, local_rows, shard_procs, sharding)
-
-
 def _mesh_layout(mesh):
-    """(local device rows this process contributes, per-dp-shard
-    process_index list, dp NamedSharding or None) — cached per mesh so
-    the per-step feed injection rebuilds nothing; keyed
-    id-recycle-proof."""
-    ent = _mesh_cache.get(id(mesh))
-    if ent is not None and ent[0] is mesh:
-        return ent[1], ent[2], ent[3]
-    devs = list(mesh.devices.flat)
-    try:
-        import jax
+    """(data-axis rows this process contributes, per-dp-shard
+    process_index list, dp NamedSharding or None) — served by the
+    SHARED :func:`distributed.mesh.mesh_layout` cache (ISSUE 16
+    satellite), so the executor's cache key, the timestamp feeds and
+    the skew table all read one layout object.  On a {dp,mp} rule mesh
+    the rows/procs are per dp SHARD, not per device: the probe's wait
+    vector has one slot per data-parallel rank."""
+    from ..distributed.mesh import mesh_layout
 
-        me = jax.process_index()
-    except Exception:
-        me = 0
-    shard_procs = [int(getattr(d, "process_index", 0)) for d in devs]
-    local_rows = sum(1 for p in shard_procs if p == me) or len(devs)
-    try:
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        sharding = NamedSharding(mesh, PartitionSpec("dp"))
-    except Exception:
-        sharding = None
-    if len(_mesh_cache) >= 8:
-        _mesh_cache.clear()
-    _mesh_cache[id(mesh)] = (mesh, local_rows, shard_procs, sharding)
-    return local_rows, shard_procs, sharding
+    lay = mesh_layout(mesh)
+    if lay.data_axis != "dp":
+        return lay.data_rows, lay.data_procs, None
+    return lay.data_rows, lay.data_procs, lay.data_sharding
 
 
 def add_timestamp_feeds(feed_arrays, mesh):

@@ -266,7 +266,49 @@ def _note_sync(stats, key):
         pass
 
 
-def emit_skew_probe(ts_sec, ts_usec, axis_name="dp"):
+def note_model_sync(records, key=None):
+    """Record the model-parallel (GSPMD auto-axis) collectives of the
+    most recent spmd step into ``last_sync_stats()["model"]``.
+
+    Under the hybrid runtime the dp gradient psums are emitted manually
+    (:func:`sync_gradients` above, stats set at trace time) while the
+    mp collectives are inserted by XLA from the sharding constraints —
+    there is no trace-time hook to count them.  The executor therefore
+    notes the ``ShardingPlan``'s own implied-collective records here
+    after dispatch: the records ARE the analyzer's, so the predicted
+    table and the executed stats agree exactly by construction (the
+    conformance property ``bench.py tp_runtime_smoke`` pins)."""
+    records = [dict(r) for r in records]
+    axes = sorted({a for r in records for a in r.get("axes", ())})
+    _LAST_SYNC["model"] = {
+        "psums": len(records),
+        "total_bytes": int(sum(int(r.get("bytes", 0))
+                               for r in records)),
+        "axes": axes,
+        "records": records,
+    }
+    try:
+        from .. import monitor
+
+        if monitor.is_enabled() and records:
+            monitor.record_pass_pipeline({
+                "kind": "pass_pipeline",
+                "key": key or "mp_model_sync",
+                "passes": [{"name": "mp_auto_collectives",
+                            "psums": len(records),
+                            "total_bytes":
+                                _LAST_SYNC["model"]["total_bytes"],
+                            "axes": axes}],
+                "before_ops": len(records),
+                "after_ops": len(records),
+                "ops_removed": 0,
+            })
+    except Exception:
+        pass
+    return dict(_LAST_SYNC["model"])
+
+
+def emit_skew_probe(ts_sec, ts_usec, axis_name="dp", gather=True):
     """Trace-time straggler probe (ISSUE 10), emitted inside the same
     ``dp_grad_sync`` scope the bucketed gradient collectives live in:
     one extra scalar pair per step instead of per gradient.
@@ -279,7 +321,14 @@ def emit_skew_probe(ts_sec, ts_usec, axis_name="dp"):
     ``t_latest - t_self`` at exact μs resolution, and one all_gather
     replicates the per-shard wait vector so EVERY rank knows the whole
     fleet's split without a host round trip.  Returns the replicated
-    float32 ``[ndev]`` wait vector (μs)."""
+    float32 ``[ndev]`` wait vector (μs).
+
+    ``gather=False`` (the GSPMD runtime tier) returns the LOCAL wait as
+    a ``[1]`` row instead — inside a partial-manual shard_map (mp as a
+    GSPMD auto axis) an HLO AllGather carries no sharding through XLA's
+    propagation pass and the partitioner aborts on the manual-subgroup
+    mismatch, so the gather happens at the shard_map out-spec boundary
+    (``P("dp")``) rather than in the body."""
     import jax
     import jax.numpy as jnp
 
@@ -293,6 +342,8 @@ def emit_skew_probe(ts_sec, ts_usec, axis_name="dp"):
     max_usec = jax.lax.pmax(tie_usec, axis_name)
     wait_us = ((max_sec - sec).astype(jnp.float32) * 1e6
                + (max_usec - usec).astype(jnp.float32))
+    if not gather:
+        return wait_us[None]
     return jax.lax.all_gather(wait_us, axis_name)
 
 
@@ -330,4 +381,5 @@ class LocalSGD(Collective):
 
 __all__ = ["GradAllReduce", "LocalSGD", "Collective",
            "sync_gradients", "plan_buckets", "last_sync_stats",
-           "implied_collective_plan", "emit_skew_probe"]
+           "implied_collective_plan", "emit_skew_probe",
+           "note_model_sync"]

@@ -186,6 +186,58 @@ def test_npz_writer_roundtrip_and_autodetect(tmp_path):
         ck.save_checkpoint(str(tmp_path), state, 4, writer="bogus")
 
 
+@pytest.mark.parametrize("writer", ["orbax", "npz"])
+def test_restore_resharded_across_mesh_shapes(tmp_path, writer):
+    """ISSUE 16 satellite: the reshard arc beyond pure-dp — {dp=2} →
+    {dp=1,mp=2} → {dp=2,mp=2} → {dp=2}, bitwise at every hop with BOTH
+    writers, `_TOPOLOGY.json` carrying the writing mesh's axes."""
+    from paddle_tpu.distributed.mesh import build_rule_mesh
+
+    shapes = [{"dp": 2}, {"dp": 1, "mp": 2}, {"dp": 2, "mp": 2},
+              {"dp": 2}]
+    rng = np.random.default_rng(7)
+    host = {"w": rng.standard_normal((4, 4)).astype(np.float32),
+            "m": rng.standard_normal((4,)).astype(np.float32)}
+    mesh = build_rule_mesh(shapes[0])
+    state = {n: jax.device_put(v, NamedSharding(mesh, P()))
+             for n, v in host.items()}
+    for step, axes in enumerate(shapes[1:], start=1):
+        d = str(tmp_path / f"hop{step}")
+        ck.save_checkpoint(d, state, step, writer=writer)
+        topo = ck.load_topology(d)
+        assert topo["mesh_axes"] == {k: int(v) for k, v in
+                                     mesh.shape.items()}
+        mesh = build_rule_mesh(axes)
+        state, got_step = ck.restore_resharded(d, state, mesh=mesh)
+        assert got_step == step
+        for n, want in host.items():
+            assert np.array_equal(np.asarray(state[n]), want)
+            assert (set(state[n].sharding.device_set)
+                    == set(mesh.devices.flat))
+
+
+def test_restore_resharded_state_specs_places_sharded(tmp_path):
+    """state_specs= lowers a TP plan's layout at restore: named leaves
+    land SHARDED on the target mesh (per-shard bytes below full),
+    unnamed leaves replicate as before — values bitwise either way."""
+    from paddle_tpu.analysis.sharding import ShardSpec
+    from paddle_tpu.distributed.mesh import build_rule_mesh
+
+    state = {"w": np.arange(16, dtype=np.float32).reshape(4, 4),
+             "m": np.arange(4, dtype=np.float32)}
+    ck.save_checkpoint(str(tmp_path), state, 1, writer="npz")
+    mesh = build_rule_mesh({"dp": 2, "mp": 2})
+    restored, _ = ck.restore_resharded(
+        str(tmp_path), state, mesh=mesh,
+        state_specs={"w": ShardSpec((None, "mp"))})
+    w = restored["w"]
+    assert tuple(w.sharding.spec) == (None, "mp")
+    assert w.addressable_shards[0].data.nbytes * 2 == w.nbytes
+    assert np.array_equal(np.asarray(w), state["w"])
+    assert restored["m"].sharding.spec == P()
+    assert np.array_equal(np.asarray(restored["m"]), state["m"])
+
+
 # ---------------------------------------------------------------------
 # coordinator control plane
 # ---------------------------------------------------------------------

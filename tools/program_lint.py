@@ -139,6 +139,12 @@ def main(argv=None):
                     help="partition-rule JSON file enabling the PT3xx "
                     "sharding lints; 'default' uses each bundled "
                     "model's own default rule set")
+    ap.add_argument("--lower", action="store_true",
+                    help="with --sharding-rules: print the concrete "
+                    "NamedSharding lowering plan (per-var placement, "
+                    "activation pins, model collective table, static "
+                    "per-shard memory) the GSPMD runtime tier would "
+                    "execute — still fully static, no tracing")
     ap.add_argument("--amp", action="store_true",
                     help="AMP-rewrite each train program (FLAGS_amp "
                     "parity) before linting, so the PT4xx numerics "
@@ -207,6 +213,11 @@ def main(argv=None):
         ap.print_help()
         return 2
 
+    if args.lower and not (file_rules or args.sharding_rules):
+        print("--lower needs --sharding-rules (the lowering plan IS "
+              "the rule set's placement)", file=sys.stderr)
+        return 2
+
     any_errors = False
     records = []
     for label, prog, fetches, rules, feed_shapes in targets:
@@ -218,6 +229,19 @@ def main(argv=None):
         if sub is not prog:
             rec["train_tier"] = {"amp": bool(args.amp),
                                  "fuse": bool(args.fuse)}
+        if args.lower and rules is not None:
+            from paddle_tpu.analysis import sharding as _sh
+
+            plan = _sh.lower(
+                sub, rules, fetch_names=fetches,
+                feed_names=sorted(feed_shapes or ()),
+                feed_shapes=feed_shapes)
+            if args.json:
+                rec["lower"] = plan.to_record()
+            else:
+                print(f"{label}: lowering plan")
+                for line in plan.render().splitlines():
+                    print("  " + line)
         records.append(rec)
         any_errors = any_errors or not result.ok
     if args.json:
