@@ -3215,6 +3215,216 @@ def bench_serving_smoke(on_tpu, peak):
             monitor.enable()
 
 
+def bench_decode_serving_smoke(on_tpu, peak):
+    """Continuous-batching decode chaos row (ISSUE 17 CI satellite):
+    a tiny GPT served through the slot-based DecodeEngine on the CPU
+    mesh, twice over the SAME heterogeneous workload — continuous
+    (slots refill the moment one frees) vs the pad-to-bucket static
+    baseline (the same engine with continuous=False: admit a cohort,
+    wait for its straggler) — plus a deterministic chaos pass with an
+    injected slow decode step and per-token budget expiries.  Asserts:
+
+    - zero silent losses: requests == sum(outcomes), pending == 0,
+      with the chaos expiries landing CLASSIFIED (expired/shed);
+    - zero recompiles after warmup: the compile ledger holds exactly
+      one decode-step program and one prefill program per bucket for
+      each engine, unchanged by joins/leaves/chaos;
+    - decoded tokens are TOKEN-EXACT vs models.generate() per request
+      (greedy), including requests that joined mid-decode into a
+      previously-released slot;
+    - continuous tokens/s beats the static baseline on the straggler
+      workload;
+    - the kind="serving" record carries the decode block and /metrics
+      exposes the decode_tokens_total / decode_slot_occupancy families.
+
+    Side effect: like the other smoke rows, the PROCESS-GLOBAL monitor
+    and fault-injection state are reset."""
+    import tempfile
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.models import generate as G
+    from paddle_tpu.models.gpt import GPT, GPTConfig
+    from paddle_tpu.monitor import exporter
+    from paddle_tpu.resilience import RetryPolicy, faultinject
+    from paddle_tpu.serving import DeadlineExceeded
+    from paddle_tpu.serving.decode import DecodeConfig, DecodeEngine
+
+    was_enabled = monitor.is_enabled()
+    monitor.reset()
+    monitor.enable()
+    flight_dir = tempfile.mkdtemp(prefix="paddle_tpu_decode_flight_")
+    old_flight = fluid.get_flags("FLAGS_flight_recorder_dir")
+    fluid.set_flags({"FLAGS_flight_recorder_dir": flight_dir})
+    monitor.flight_recorder.get().clear()
+    engines = []
+    try:
+        np.random.seed(7)
+        cfg = GPTConfig(vocab_size=97, hidden_size=48, num_layers=3,
+                        num_heads=4, max_seq_len=64, dropout=0.0)
+        model = GPT(cfg)
+        params = G.build_decode_params(model)
+        retry = RetryPolicy(max_retries=2, base_delay=0.001,
+                            max_delay=0.01, sleep=lambda d: None,
+                            seed=0)
+
+        def make_engine(label, continuous, auto_start):
+            e = DecodeEngine(params, config=DecodeConfig(
+                slots=3, max_len=48, buckets=(8, 16),
+                retry_policy=retry, watchdog_stall_s=5.0,
+                watchdog_poll_s=0.02, continuous=continuous,
+                label=label), auto_start=auto_start)
+            engines.append(e)
+            return e
+
+        # heterogeneous straggler workload: every cohort of 3 carries
+        # one long request, so the static baseline's slots idle while
+        # continuous refills them the moment the short ones leave
+        rng = np.random.default_rng(0)
+        work = []
+        for wave in range(4):
+            for max_new in (16, 4, 4):
+                work.append((rng.integers(0, 97, size=int(
+                    rng.integers(3, 9))), max_new))
+        refs = {i: np.asarray(G.generate(
+            model, p[None, :], max_new_tokens=n))[0]
+            for i, (p, n) in enumerate(work)}
+
+        def run_workload(engine):
+            futs = [None] * len(work)
+
+            def feeder(offset):
+                for i in range(offset, len(work), 3):
+                    p, n = work[i]
+                    futs[i] = engine.submit(p, n)
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=feeder, args=(o,))
+                       for o in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            toks = [f.result(timeout=60) for f in futs]
+            elapsed = time.perf_counter() - t0
+            total = sum(len(t) for t in toks)
+            return toks, total / elapsed
+
+        cont = make_engine("decode_smoke_cont", True, True)
+        cont_prewarm = cont.prewarmed
+        cont_keys = ("decode_smoke_cont.decode_step",
+                     "decode_smoke_cont.prefill_b8",
+                     "decode_smoke_cont.prefill_b16")
+        cont_tokens, cont_tps = run_workload(cont)
+        token_exact = all(np.array_equal(cont_tokens[i], refs[i])
+                          for i in range(len(work)))
+
+        # -- chaos pass on the SAME continuous engine ---------------
+        # occupy every slot with budget-less long requests, then queue
+        # a tight-budget request behind them: FIFO admission keeps it
+        # queued for many decode steps, so its first-token budget must
+        # SHED it.  Then admit a second tight-budget victim into a
+        # freed slot and slow the next (shared) decode step past its
+        # budget: the victim must EXPIRE mid-flight while the
+        # budget-less neighbours ride the same slow step to completion
+        chaos_long = [cont.submit(w[0], 16) for w in work[:3]]
+        shed_fut = cont.submit(work[3][0], 8, token_budget_s=0.001)
+        shed_err = shed_fut.exception(timeout=30)
+        chaos_long[0].exception(timeout=60)   # a slot is now free
+        pre_prefills = cont.stats.prefill_steps
+        exp_fut = cont.submit(work[4][0], 16, token_budget_s=0.12)
+        deadline = time.time() + 10
+        while cont.stats.prefill_steps == pre_prefills \
+                and not exp_fut.done() and time.time() < deadline:
+            time.sleep(0.002)     # victim slot-resident before arming
+        faultinject.arm(stall_points={"decode.step": (0, 0.25)})
+        exp_err = exp_fut.exception(timeout=30)
+        faultinject.disarm()
+        for f in chaos_long:
+            f.exception(timeout=60)
+        cont.emit_telemetry()
+        cont_events = [e for e in monitor.compile_events()
+                       if e.get("key") in cont_keys]
+        cont_summary = cont.summary()
+        scrape = exporter.prometheus_text()
+        serving_rec = monitor.serving_records()
+        cont.close()
+
+        # -- static pad-to-bucket baseline --------------------------
+        static = make_engine("decode_smoke_static", False, True)
+        static_tokens, static_tps = run_workload(static)
+        static_exact = all(np.array_equal(static_tokens[i], refs[i])
+                           for i in range(len(work)))
+        static_events = [e for e in monitor.compile_events()
+                         if str(e.get("key", "")).startswith(
+                             "decode_smoke_static.")]
+        static.close()
+
+        dec = cont_summary["decode"]
+        checks = {
+            "prewarm_compiled_all_programs":
+                cont_prewarm == 3 and static.prewarmed == 3,
+            "no_recompile_after_warmup":
+                len(cont_events) == 3 and len(static_events) == 3,
+            "tokens_exact_vs_generate": token_exact and static_exact,
+            "zero_silently_lost":
+                cont_summary["requests"]
+                == sum(cont_summary["outcomes"].values())
+                and cont_summary["pending"] == 0,
+            "budget_shed_classified":
+                isinstance(shed_err, DeadlineExceeded)
+                and cont_summary["outcomes"]["shed"] >= 1,
+            "budget_expired_classified":
+                isinstance(exp_err, DeadlineExceeded)
+                and cont_summary["outcomes"]["expired"] >= 1,
+            "no_unclassified_failures":
+                cont_summary["outcomes"]["failed"] == 0
+                and cont_summary["outcomes"]["stalled"] == 0,
+            "slow_step_survived":
+                cont_summary["outcomes"]["completed"]
+                == len(work) + len(chaos_long),
+            "continuous_beats_static": cont_tps > static_tps,
+            "occupancy_tracked":
+                dec.get("slot_occupancy_mean") is not None
+                and 0.0 < dec["slot_occupancy_mean"] <= 1.0,
+            "serving_record_has_decode_block": any(
+                r.get("kind") == "serving" and r.get("decode")
+                for r in serving_rec),
+            "metrics_export_decode_families":
+                "decode_tokens_total{" in scrape
+                and "decode_slot_occupancy{" in scrape,
+        }
+        checks = {k: bool(v) for k, v in checks.items()}
+        row = {"metric": "decode_serving_smoke",
+               "value": int(all(checks.values())), "unit": "ok",
+               "vs_baseline": round(cont_tps / static_tps, 3)
+               if static_tps else None,
+               "continuous_tokens_per_s": round(cont_tps, 2),
+               "static_tokens_per_s": round(static_tps, 2),
+               "requests": cont_summary["requests"],
+               "outcomes": cont_summary["outcomes"],
+               "decode": dec,
+               "checks": checks,
+               "telemetry": _telemetry_brief(monitor.snapshot())}
+        if not all(checks.values()):
+            row["error"] = "failed checks: " + ", ".join(
+                k for k, v in checks.items() if not v)
+        return row
+    finally:
+        faultinject.disarm()
+        for e in engines:
+            try:
+                e.close()
+            except Exception:
+                pass
+        fluid.set_flags(old_flight)
+        monitor.disable()
+        monitor.reset()
+        if was_enabled:
+            monitor.enable()
+
+
 def bench_fleet_obs_smoke(on_tpu, peak):
     """Fleet-observability smoke row (ISSUE 10 CI satellite): a REAL
     2-process CPU-mesh dp train through the public Executor path
@@ -3706,6 +3916,32 @@ def main_serving_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def main_decode_serving_smoke():
+    """`python bench.py decode_serving_smoke` — CI/tooling entry: the
+    continuous-batching decode chaos row standalone on a 2-device
+    virtual CPU mesh, persisted to BENCH_TPU.json under
+    rows["decode_serving_smoke"].  Exit 0 only when every check
+    passes."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=2")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_decode_serving_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["decode_serving_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def _git_sha():
     try:
         return subprocess.run(
@@ -3892,6 +4128,8 @@ def main():
         ("fault_tolerance_smoke", "fault_tolerance_smoke",
          bench_fault_tolerance_smoke),
         ("serving_smoke", "serving_smoke", bench_serving_smoke),
+        ("decode_serving_smoke", "decode_serving_smoke",
+         bench_decode_serving_smoke),
         ("program_lint_smoke", "program_lint_smoke",
          bench_program_lint_smoke),
         ("sharding_lint_smoke", "sharding_lint_smoke",
@@ -3977,6 +4215,8 @@ if __name__ == "__main__":
         sys.exit(main_mem_profile_smoke())
     if "fault_tolerance_smoke" in sys.argv[1:]:
         sys.exit(main_fault_tolerance_smoke())
+    if "decode_serving_smoke" in sys.argv[1:]:
+        sys.exit(main_decode_serving_smoke())
     if "serving_smoke" in sys.argv[1:]:
         sys.exit(main_serving_smoke())
     if "program_lint_smoke" in sys.argv[1:]:

@@ -179,6 +179,22 @@ declare_flag("serving_watchdog_stall_s", 30.0,
              "Hang watchdog: a serving dispatch in flight longer than "
              "this triggers a flight-recorder dump and escalates per "
              "watchdog_policy.")
+declare_flag("decode_slots", 8,
+             "Continuous-batching decode engine (serving/decode.py): "
+             "number of concurrent sequence slots one compiled decode "
+             "step carries.  Every step runs the full slot width; more "
+             "slots = more throughput until the step goes "
+             "compute-bound.")
+declare_flag("decode_max_len", 2048,
+             "Decode engine ring-buffer KV-cache depth per slot "
+             "(prompt + generated tokens must fit).  Fixed at engine "
+             "build — it is the compiled decode step's cache shape.")
+declare_flag("decode_token_budget_s", 0.0,
+             "Default per-TOKEN deadline budget for decode requests: "
+             "each token (including the first, i.e. TTFT) must arrive "
+             "within this many seconds of the previous one or the "
+             "request is shed/expired into the outcome ledger "
+             "(0 = no budget unless the request carries one).")
 
 # Program-level graph optimizer (paddle_tpu.passes, ISSUE 9): the
 # framework/ir pass-pipeline analogue.  "on" substitutes an optimized

@@ -14,6 +14,11 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e9
+# the decode path masks with -1e30 (flash_attention.py's NEG_INF), NOT
+# this module's -1e9: models/generate.py's inline decode math always
+# used -1e30, and the serving engine's token-exactness contract is that
+# decode_attention reproduces it bitwise
+DECODE_NEG_INF = -1e30
 
 
 def _xla_attention(q, k, v, mask, scale, is_causal, dropout_p, training,
@@ -38,6 +43,70 @@ def _xla_attention(q, k, v, mask, scale, is_causal, dropout_p, training,
         keep = jax.random.bernoulli(rng_key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def decode_attention(q, k, v, pos=None, mask=None, scale=None,
+                     use_flash=None):
+    """Single-query decode attention: q [B, H, 1, D] against a KV-cache
+    prefix k/v [B, H, T, D] -> [B, H, 1, D].
+
+    `pos` is the CURRENT token's cache position — scalar (whole batch at
+    one position, models/generate.py's cohort decode) or [B] (per-slot
+    ragged positions, the serving engine); cache columns > pos are
+    masked.  Alternatively pass an explicit `mask` (bool keeps-where-
+    true, else additive) when the live set is not a prefix (the fused-op
+    path).  With neither, the full cache is attended (pos = T-1).
+
+    Numerics contract: the XLA path is bitwise the inline decode math
+    models/generate.py shipped with (f32 scores, -1e30 masked columns,
+    f32 softmax, cast back to q.dtype) — masked columns underflow to
+    exactly 0.0 in f32, so padded cache depth never perturbs the live
+    sums and cached decode stays token-exact vs a full forward.  The
+    flash path (TPU, deep caches) is the online-softmax Pallas kernel in
+    flash_attention.py: same math re-associated, allclose not bitwise,
+    so the serving engine pins one path per process."""
+    import os
+
+    head_dim = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(head_dim)
+    t = k.shape[-2]
+    if pos is not None and mask is not None:
+        raise ValueError("pass pos or mask, not both")
+    if pos is None and mask is None:
+        pos = t - 1
+
+    can_flash = (mask is None and q.shape[-2] == 1 and t % 128 == 0
+                 and head_dim in (64, 128, 256))
+    if use_flash is None:
+        from .backend import is_tpu_backend
+
+        env = os.environ.get("PADDLE_TPU_FORCE_FLASH_DECODE", "")
+        if env:
+            use_flash = env.lower() in ("1", "true", "yes")
+        else:
+            use_flash = is_tpu_backend() and t >= 1024
+    if use_flash and can_flash:
+        from .flash_attention import flash_decode
+
+        return flash_decode(q, k, v, jnp.asarray(pos, jnp.int32) + 1,
+                            sm_scale=scale)
+
+    if mask is None:
+        pos_arr = jnp.asarray(pos, jnp.int32)
+        idx = jnp.arange(t, dtype=jnp.int32)
+        if pos_arr.ndim == 0:
+            live = (idx <= pos_arr)[None, None, None, :]
+        else:                                   # [B] per-row positions
+            live = (idx[None, :] <= pos_arr[:, None])[:, None, None, :]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k.astype(q.dtype)) * scale
+    if mask is None:
+        s = jnp.where(live, s.astype(jnp.float32), DECODE_NEG_INF)
+    elif mask.dtype == jnp.bool_:
+        s = jnp.where(mask, s.astype(jnp.float32), DECODE_NEG_INF)
+    else:
+        s = s.astype(jnp.float32) + mask
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(q.dtype))
 
 
 def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, is_causal=False,

@@ -360,6 +360,125 @@ def _bwd_core(sm_scale, causal, block_q, block_k, q, k, v, do, lse,
 
 
 # --------------------------------------------------------------------------
+# single-query decode forward (ISSUE 17)
+# --------------------------------------------------------------------------
+
+def _decode_compiler_params():
+    if pltpu is None:  # pragma: no cover
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary"))
+
+
+def _smem_spec(*args):
+    if pltpu is None:  # pragma: no cover
+        return pl.BlockSpec(*args)
+    return pl.BlockSpec(*args, memory_space=pltpu.SMEM)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref,
+                   l_ref, *, sm_scale, block_k):
+    ki = pl.program_id(1)
+    num_k = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[0, 0]
+
+    # k blocks entirely past the live prefix contribute nothing; skip
+    # their DMA'd compute outright (the ragged-length win: a slot at
+    # pos 40 in a 2048-deep cache touches 1 block, not 16)
+    @pl.when(ki * block_k < length)
+    def _tile():
+        q = q_ref[0]                                      # [1, d]
+        k_blk = k_ref[0]                                  # [bk, d]
+        v_blk = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [1, bk] f32
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos < length, s, NEG_INF)
+        m_prev = m_ref[0, 0]
+        l_prev = l_ref[0, 0]
+        m_cur = jnp.maximum(m_prev, s.max())
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_ref[:] = jnp.broadcast_to(l_prev * alpha + p.sum(), l_ref.shape)
+        acc_ref[0:1] = acc_ref[0:1] * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = l_ref[0, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[0:1] / l_safe).astype(o_ref.dtype)
+
+
+def flash_decode(q, k, v, lengths, sm_scale=None, block_k=None):
+    """Single-query flash attention for the decode phase.
+
+    q: [B, H, 1, D] (one new token per row), k/v: [B, H, T, D] (the KV
+    cache), lengths: int32 [B] or scalar — live prefix length per row
+    (pos + 1); cache positions >= length are masked out.  The grid is
+    (B*H, T//block_k) with the k axis "arbitrary" so the running
+    (m, l, acc) online-softmax state persists across k blocks, and
+    blocks past the live prefix are pruned with pl.when — cost scales
+    with the ragged lengths, not the cache depth.  T must be divisible
+    by block_k (auto-shrunk power of two <= 512)."""
+    b, h, q_len, d = q.shape
+    if q_len != 1:
+        raise ValueError(f"flash_decode needs q_len == 1, got {q_len}")
+    t = k.shape[-2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if block_k is None:
+        cand = 512
+        while cand > 64 and (cand > t or t % cand):
+            cand //= 2
+        block_k = cand if (cand <= t and t % cand == 0) else t
+    if t % block_k:
+        raise ValueError(
+            f"cache depth {t} must be divisible by block_k {block_k}")
+    lengths = jnp.broadcast_to(
+        jnp.asarray(lengths, jnp.int32).reshape(-1), (b,))
+    len2 = jnp.repeat(lengths, h).reshape(b * h, 1)
+    q3 = q.reshape(b * h, 1, d)
+    k3 = k.reshape(b * h, t, d)
+    v3 = v.reshape(b * h, t, d)
+    grid = (b * h, t // block_k)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=float(sm_scale),
+                          block_k=block_k),
+        grid=grid,
+        in_specs=[
+            _smem_spec((1, 1), lambda bh, ki: (bh, 0)),
+            _vmem_spec((1, 1, d), lambda bh, ki: (bh, 0, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_specs=_vmem_spec((1, 1, d), lambda bh, ki: (bh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        scratch_shapes=[
+            # 8-row scratch (f32 sublane tile) though only row 0 is
+            # used: sub-tile scratch shapes are not portable on TPU
+            _scratch((8, d)),
+            _scratch((8, _LANES)),
+            _scratch((8, _LANES)),
+        ],
+        compiler_params=_decode_compiler_params(),
+        interpret=_interpret(),
+    )(len2, q3, k3, v3)
+    return out.reshape(b, h, 1, d)
+
+
+# --------------------------------------------------------------------------
 # public API
 # --------------------------------------------------------------------------
 

@@ -175,12 +175,12 @@ def prefill(params: DecodeParams, input_ids, cache, cfg=None):
 def decode_step(params: DecodeParams, token, cache, pos, cfg=None):
     """One incremental step: token [B] at position pos (scalar) ->
     (logits [B, V], updated cache)."""
+    from ..kernels.attention import decode_attention
+
     cfg = cfg or params.cfg
     scale = 1.0 / (cfg.hidden_size // cfg.num_heads) ** 0.5
     x = jnp.take(params.emb["wte.weight"], token[:, None], axis=0) \
         + params.emb["wpe.weight"][pos][None, None, :]
-    max_len = cache["k"].shape[3]
-    live = (jnp.arange(max_len) <= pos)[None, None, None, :]
 
     def layer(x, xs):
         bp, k_cache, v_cache = xs
@@ -191,11 +191,11 @@ def decode_step(params: DecodeParams, token, cache, pos, cfg=None):
             k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
-        s = jnp.einsum("bhqd,bhkd->bhqk", q,
-                       k_cache.astype(q.dtype)) * scale
-        s = jnp.where(live, s.astype(jnp.float32), -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, v_cache.astype(x.dtype))
+        # the shared single-query kernel (kernels/attention.py): same
+        # inline math this function used to carry — serving/decode.py
+        # calls the identical code path, which is what makes the
+        # engine's token-exactness vs generate() structural
+        o = decode_attention(q, k_cache, v_cache, pos=pos, scale=scale)
         return _block_tail(x, _merge_heads(o), bp, cfg,
                            decode=True), (k_cache, v_cache)
 

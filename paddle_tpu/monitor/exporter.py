@@ -160,6 +160,25 @@ def prometheus_text():
                 _line(out, "serving_stalled_in_flight",
                       row["stalled_in_flight"],
                       labels={"runtime": row["key"]}, kind="gauge")
+        # decode-engine families (ISSUE 17): new names, NOT extra
+        # labels on the families above — a decode row is a superset of
+        # a serving row, and adding decode-only samples to an existing
+        # family would split it across scrapes with mixed runtimes
+        for row in rows:
+            dec = row.get("decode")
+            if dec:
+                _line(out, "decode_tokens_total", dec["tokens_total"],
+                      labels={"runtime": row["key"]}, kind="counter",
+                      help_="tokens emitted by decode steps (excludes "
+                            "prefill first-tokens)")
+        for row in rows:
+            dec = row.get("decode") or {}
+            if dec.get("slot_occupancy_mean") is not None:
+                _line(out, "decode_slot_occupancy",
+                      dec["slot_occupancy_mean"],
+                      labels={"runtime": row["key"]}, kind="gauge",
+                      help_="mean fraction of KV-cache slots live per "
+                            "decode step")
     except Exception:
         pass
     # compile ledger: peak HBM of the newest attributed compile

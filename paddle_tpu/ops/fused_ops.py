@@ -380,7 +380,7 @@ def fused_attention_op(ins, attrs):
     identical to the unfused graph at fp32, and strictly more accurate
     than a bf16 softmax under AMP.
     """
-    from ..kernels.attention import dot_product_attention
+    from ..kernels.attention import decode_attention, dot_product_attention
 
     compute = attrs.get("compute_dtype", "")
     q = _compute_cast(jnp.asarray(ins["Q"]), compute)
@@ -395,22 +395,31 @@ def fused_attention_op(ins, attrs):
         mask = jnp.asarray(mask)
     scale = float(attrs.get("scale", 1.0))
     heads = int(attrs.get("head_number", 0))
+
+    def _attend(q4, k4, v4):
+        # decode-shaped dispatch (the matcher tags these attrs["decode"]
+        # at fuse time): a single query attending a longer K/V prefix
+        # goes to the single-query kernel — XLA composition on CPU /
+        # short caches, the Pallas flash_decode path on deep TPU caches
+        if q4.shape[-2] == 1 and k4.shape[-2] > 1:
+            return decode_attention(q4, k4, v4, mask=mask, scale=scale)
+        return dot_product_attention(q4, k4, v4, mask=mask, scale=scale,
+                                     training=False)
+
     if heads > 0:
         b, t, d = q.shape
         hd = d // heads
 
         def split(z):
-            return jnp.transpose(z.reshape(b, t, heads, hd),
+            # z's OWN seq length: decode-shaped matches have q at
+            # seq 1 with K/V at the full cache depth
+            return jnp.transpose(z.reshape(b, z.shape[1], heads, hd),
                                  (0, 2, 1, 3))
 
-        out = dot_product_attention(split(q), split(k), split(v),
-                                    mask=mask, scale=scale,
-                                    training=False)
+        out = _attend(split(q), split(k), split(v))
         return {"Out": jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, d)}
     if q.ndim == 4:
-        return {"Out": dot_product_attention(q, k, v, mask=mask,
-                                             scale=scale,
-                                             training=False)}
+        return {"Out": _attend(q, k, v)}
     logits = jnp.matmul(q, jnp.swapaxes(k, -1, -2)) * scale
     if mask is not None:
         logits = (jnp.where(mask, logits, -1e9)

@@ -475,6 +475,21 @@ def fuse_attention(rw):
         a_op.outputs = {"Out": [out_name]}
         a_op.attrs = {"scale": scale_val, "head_number": heads,
                       "compute_dtype": compute, "softmax_axis": -1}
+        # decode-shaped match (q_len == 1 against a longer K/V prefix):
+        # tag it so the kernel's single-query dispatch (fused_ops ->
+        # kernels.attention.decode_attention / flash_decode) is visible
+        # statically — in the pass report and the numerics analyzer —
+        # not just a runtime shape branch.  Both the pre-split
+        # [B, T, H*D] ring form and the head-split [B, H, S, D] form
+        # carry the sequence length at axis -2.
+        q_spec = m.specs.get(q_name)
+        k_spec = m.specs.get(k_name)
+        if (q_spec is not None and q_spec.shape is not None
+                and k_spec is not None and k_spec.shape is not None
+                and len(q_spec.shape) >= 2 and len(k_spec.shape) >= 2
+                and q_spec.shape[-2] == 1 and k_spec.shape[-2]
+                and k_spec.shape[-2] > 1):
+            a_op.attrs["decode"] = True
     return m.finish()
 
 

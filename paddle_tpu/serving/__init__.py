@@ -16,6 +16,12 @@ Layers a resilient request path over the inference engines
   post-mortem with the in-flight batch's metadata, then escalates
   (classified failure or cancel-and-retry).
 
+ISSUE 17 adds `decode.py`: a slot-based continuous-batching DECODE
+engine on the same hardening stack — one donated-state compiled decode
+step over a fixed slot×max_len KV ring buffer, per-bucket prefill
+refills without retracing, per-TOKEN deadline budgets, and
+tokens/s / TTFT / occupancy observability (`DecodeStats`).
+
 Observability: exact p50/p99 latency, queue-depth/in-flight gauges,
 `resilience.*` shed/retry/breaker/watchdog counters, per-request spans
 in the merged Chrome trace, `monitor.serving_table()`, and
@@ -25,14 +31,18 @@ dumps (tools/telemetry_report.py renders both).
 
 from .bucketing import (BucketDispatcher, default_buckets,  # noqa: F401
                         pick_bucket)
+from .decode import (DecodeConfig, DecodeEngine,            # noqa: F401
+                     EngineBrokenError, default_prompt_buckets)
 from .runtime import (DeadlineExceeded, QueueFullError,     # noqa: F401
                       ServingClosedError, ServingConfig,
                       ServingFuture, ServingRuntime)
-from .stats import ServingStats, serving_table              # noqa: F401
+from .stats import DecodeStats, ServingStats, serving_table  # noqa: F401
 from .watchdog import HangWatchdog, WatchdogStall           # noqa: F401
 
 __all__ = [
     "ServingRuntime", "ServingConfig", "ServingFuture",
+    "DecodeEngine", "DecodeConfig", "DecodeStats",
+    "EngineBrokenError", "default_prompt_buckets",
     "QueueFullError", "ServingClosedError", "DeadlineExceeded",
     "WatchdogStall", "HangWatchdog", "ServingStats", "serving_table",
     "BucketDispatcher", "default_buckets", "pick_bucket",

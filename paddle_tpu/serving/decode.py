@@ -1,0 +1,854 @@
+"""Continuous-batching decode serving — slot-based KV-cache engine
+(ISSUE 17 tentpole).
+
+The PR-8 runtime batches single-shot predictors; this engine serves
+`models/generate.py`'s GPT family autoregressively, with the
+iteration-level scheduling of Orca (OSDI '22) and the slot-resident
+KV cache of vLLM (SOSP '23):
+
+- ONE compiled decode step owns the whole serving state: a fixed
+  `[layers, slots, heads, max_len, head_dim]` ring-buffer KV cache plus
+  per-slot `pos/active/token/stop/eos/temp/key` vectors, passed as
+  **donated** executor state (the PR-16 donation idiom — the cache
+  never copies, the step updates it in place on device).
+- Requests **join and leave mid-decode**: a finished slot is released
+  and refilled by the next queued request's prefill WITHOUT retracing —
+  prefill runs at the PR-8 bucket shapes (prompt padded to a
+  power-of-two bucket, causally masked so padding is exactly inert) and
+  writes K/V straight into the slot's cache region; slot index, true
+  prompt length and stop position are traced scalars.  Steady state
+  therefore compiles exactly (1 decode step + 1 prefill per bucket),
+  asserted through the compile ledger by the decode_serving_smoke row.
+- Every decode step runs the full slot width; inactive slots compute
+  harmlessly masked garbage (their writes land clamped inside their own
+  slot's region and are overwritten by the next tenant's prefill or by
+  the step that first attends the position — see _decode_step_impl).
+
+Token-exactness: decode attention is the SAME code generate() uses
+(kernels/attention.py decode_attention), prefill is the same layer math
+at bucket shape with MoE routed drop-free (cap = cohort size), and
+padded/causally-dead columns underflow to exact f32 zeros — so a
+request decoded through slots, including one that joins mid-stream
+into a previously-released slot, emits token-for-token what
+generate() emits (greedy; asserted dense + MoE in
+tests/test_decode_serving.py).
+
+Hardening is the PR-8 stack rewired for token granularity: per-TOKEN
+deadline budgets (TTFT included) feeding the outcome ledger
+(requests == sum(outcomes) stays the invariant), the circuit breaker
+around both dispatch kinds, the hang watchdog tracking each in-flight
+step (a wedged decode step gets a flight-recorder post-mortem and its
+requests fail classified — the donated state is inside the wedged
+call, so the engine marks itself broken rather than pretend the cache
+survived), and DecodeStats publishing tokens/s, TTFT and inter-token
+percentiles (exact nearest-rank), slot occupancy and the
+prefill/decode split to /metrics and the telemetry stream.
+
+`continuous=False` turns the SAME engine into the pad-to-bucket
+baseline (admit a cohort, decode until every member finishes, only
+then admit again) — the bench's control arm, isolating iteration-level
+scheduling as the measured lever.
+"""
+
+import functools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .. import flags
+from ..resilience import faultinject
+from ..resilience.breaker import CircuitBreaker
+from ..resilience.retry import RetryPolicy, call_with_retry
+from ..resilience.taxonomy import DeadlineExceeded
+from .runtime import QueueFullError, ServingClosedError, ServingFuture
+from .stats import DecodeStats
+from .watchdog import HangWatchdog, WatchdogStall
+
+__all__ = ["DecodeEngine", "DecodeConfig", "EngineBrokenError",
+           "default_prompt_buckets", "QueueFullError",
+           "ServingClosedError", "WatchdogStall", "DeadlineExceeded"]
+
+_DEFAULT_RETRY = object()
+
+
+def _fr():
+    from ..monitor import flight_recorder
+
+    return flight_recorder
+
+
+def _mon():
+    from .. import monitor
+
+    return monitor
+
+
+def default_prompt_buckets(max_len):
+    """Power-of-two prompt buckets 16..max_len (PR-8 bucketing shape):
+    one prefill program per bucket, compiled once."""
+    out = []
+    b = 16
+    while b <= max_len:
+        out.append(b)
+        b *= 2
+    return tuple(out) or (int(max_len),)
+
+
+class DecodeConfig:
+    """Knobs for one decode engine; flag-backed like ServingConfig."""
+
+    def __init__(self, slots=None, max_len=None, buckets=None,
+                 max_queue_depth=None, default_token_budget_s=None,
+                 retry_policy=_DEFAULT_RETRY, breaker_threshold=5,
+                 breaker_cooldown_s=5.0, watchdog_stall_s=None,
+                 watchdog_poll_s=None, continuous=True, prewarm=True,
+                 label="decode", clock=time.monotonic):
+        self.slots = int(slots if slots is not None
+                         else flags.flag("decode_slots"))
+        self.max_len = int(max_len if max_len is not None
+                           else flags.flag("decode_max_len"))
+        if self.slots < 1 or self.max_len < 2:
+            raise ValueError("need slots >= 1 and max_len >= 2")
+        self.buckets = tuple(sorted(set(
+            int(b) for b in (buckets
+                             or default_prompt_buckets(self.max_len)))))
+        if any(b < 1 or b > self.max_len for b in self.buckets):
+            raise ValueError(
+                f"buckets {self.buckets} must lie in [1, max_len="
+                f"{self.max_len}]")
+        self.max_queue_depth = int(
+            max_queue_depth if max_queue_depth is not None
+            else flags.flag("serving_queue_depth"))
+        if default_token_budget_s is None:
+            default_token_budget_s = \
+                flags.flag("decode_token_budget_s") or None
+        self.default_token_budget_s = default_token_budget_s
+        if retry_policy is _DEFAULT_RETRY:
+            retry_policy = RetryPolicy(max_retries=2, base_delay=0.02,
+                                       max_delay=0.5, seed=0)
+        self.retry_policy = retry_policy          # None disables retry
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.watchdog_stall_s = float(
+            watchdog_stall_s if watchdog_stall_s is not None
+            else flags.flag("serving_watchdog_stall_s"))
+        self.watchdog_poll_s = watchdog_poll_s
+        self.continuous = bool(continuous)
+        self.prewarm = bool(prewarm)
+        self.label = label
+        self.clock = clock
+
+
+class _DecodeRequest:
+    __slots__ = ("prompt", "max_new", "eos_id", "temperature",
+                 "token_budget_s", "rid", "future", "tokens",
+                 "enqueue_t", "last_token_t", "first_token_t", "slot",
+                 "bucket", "kill", "key")
+
+    def __init__(self, prompt, max_new, eos_id, temperature,
+                 token_budget_s, rid, bucket, key):
+        self.prompt = prompt              # np.int32 [len]
+        self.max_new = max_new
+        self.eos_id = eos_id              # int or None
+        self.temperature = temperature
+        self.token_budget_s = token_budget_s
+        self.rid = rid
+        self.bucket = bucket
+        self.key = key                    # np.uint32 [2]
+        self.future = ServingFuture()
+        self.tokens = []
+        self.enqueue_t = None
+        self.last_token_t = None          # engine clock of newest token
+        self.first_token_t = None
+        self.slot = None
+        self.kill = False                 # expired while slot-resident
+
+    def next_deadline(self):
+        """Per-token budget: the NEXT token (the first included — TTFT
+        counts queue wait) must land within budget of the previous."""
+        if self.token_budget_s is None:
+            return None
+        anchor = self.last_token_t if self.last_token_t is not None \
+            else self.enqueue_t
+        return anchor + self.token_budget_s
+
+    def expired(self, now):
+        d = self.next_deadline()
+        return d is not None and now >= d
+
+
+class EngineBrokenError(RuntimeError):
+    """The engine lost its donated device state (a wedged or failed
+    decode step) and cannot continue; submit() fails fast."""
+
+
+# ---------------------------------------------------------------------------
+# device programs (module-level so each engine jits exactly two shapes)
+# ---------------------------------------------------------------------------
+
+def _decode_step_impl(state, trees, kill, cfg):
+    """One full-width decode step over every slot.
+
+    Inactive (or host-killed) slots still flow through the math — their
+    writes land at their stale position CLAMPED inside their own slot's
+    cache region, which is safe: a position is only ever attended on or
+    after the step that first writes it (the live mask is `col <= pos`
+    and the write at `pos` happens before the attend), and a refilling
+    prefill overwrites the prompt region wholesale."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.attention import decode_attention
+    from ..models import generate as G
+    from ..nn import functional as F
+
+    params = G.DecodeParams(*trees, cfg)
+    n_slots = state["pos"].shape[0]
+    max_len = state["k"].shape[3]
+    scale = 1.0 / (cfg.hidden_size // cfg.num_heads) ** 0.5
+    active = jnp.logical_and(state["active"], jnp.logical_not(kill))
+    pos = state["pos"]
+    tok = state["token"]
+    x = jnp.take(params.emb["wte.weight"], tok[:, None], axis=0) \
+        + jnp.take(params.emb["wpe.weight"], pos, axis=0)[:, None, :]
+    posw = jnp.minimum(pos, max_len - 1)
+    sl = jnp.arange(n_slots)
+
+    def layer(x, xs):
+        bp, k_cache, v_cache = xs          # caches [S, H, T, D]
+        hn = F.layer_norm(x, [cfg.hidden_size], bp["norm1.weight"],
+                          bp["norm1.bias"])
+        q, k, v = G._qkv(hn, bp, cfg.num_heads)      # [S, H, 1, D]
+        k_cache = k_cache.at[sl, :, posw, :].set(
+            k[:, :, 0, :].astype(k_cache.dtype))
+        v_cache = v_cache.at[sl, :, posw, :].set(
+            v[:, :, 0, :].astype(v_cache.dtype))
+        # per-slot ragged positions through the SAME single-query
+        # kernel generate() decodes with — the token-exactness hinge
+        o = decode_attention(q, k_cache, v_cache, pos=pos, scale=scale)
+        return G._block_tail(x, G._merge_heads(o), bp, cfg,
+                             decode=True), (k_cache, v_cache)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer, x, (params.blocks, state["k"], state["v"]))
+    x = F.layer_norm(x, [cfg.hidden_size], params.head["norm_f.weight"],
+                     params.head["norm_f.bias"])
+    logits = jnp.einsum("bh,vh->bv", x[:, -1],
+                        params.emb["wte.weight"])
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = state["temp"]
+    scaled = logits.astype(jnp.float32) \
+        / jnp.maximum(temp, 1e-6)[:, None]
+    sampled = jax.vmap(
+        lambda kk, p, lg: jax.random.categorical(
+            jax.random.fold_in(kk, p), lg))(
+        state["key"], pos, scaled).astype(jnp.int32)
+    nxt = jnp.where(temp > 0.0, sampled, greedy)
+    new_pos = pos + 1
+    done = jnp.logical_or(
+        jnp.logical_and(state["eos"] >= 0, nxt == state["eos"]),
+        new_pos >= state["stop"])
+    still = jnp.logical_and(active, jnp.logical_not(done))
+    out = dict(state)
+    out.update(
+        k=ks, v=vs,
+        pos=jnp.where(active, new_pos, pos),
+        token=jnp.where(active, nxt, tok),
+        active=still)
+    return out, nxt, active, still
+
+
+def _prefill_impl(state, trees, prompt, true_len, slot, stop, eos,
+                  temp, key, cfg):
+    """Prefill one request into one slot at a static bucket shape.
+
+    `prompt` is [1, bucket] zero-padded; causal masking makes the pad
+    columns exactly inert for the real positions (masked scores
+    underflow to f32 zero), and MoE routes DROP-FREE (cap = cohort
+    size) so pad tokens cannot displace real ones — the first emitted
+    token is bitwise what generate()'s unpadded prefill emits.
+    true_len/slot/stop are traced scalars: refilling any slot with any
+    prompt length inside the bucket reuses this one program."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import generate as G
+    from ..nn import functional as F
+
+    params = G.DecodeParams(*trees, cfg)
+    bucket = prompt.shape[1]
+    pos = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+    x = jnp.take(params.emb["wte.weight"], prompt, axis=0) \
+        + jnp.take(params.emb["wpe.weight"], pos, axis=0)
+
+    def layer(x, bp):
+        hn = F.layer_norm(x, [cfg.hidden_size], bp["norm1.weight"],
+                          bp["norm1.bias"])
+        q, k, v = G._qkv(hn, bp, cfg.num_heads)
+        o = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                           training=False)
+        return G._block_tail(x, G._merge_heads(o), bp, cfg,
+                             decode=True), (k, v)
+
+    x, (ks, vs) = jax.lax.scan(layer, x, params.blocks)
+    # ks: [L, 1, H, bucket, D] -> this slot's cache region [:, slot]
+    k_cache = jax.lax.dynamic_update_slice(
+        state["k"], ks.astype(state["k"].dtype), (0, slot, 0, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        state["v"], vs.astype(state["v"].dtype), (0, slot, 0, 0, 0))
+    x = F.layer_norm(x, [cfg.hidden_size], params.head["norm_f.weight"],
+                     params.head["norm_f.bias"])
+    # logits at the TRUE last prompt position (LN is per-position, so
+    # slicing before the head matches generate()'s slice-after bitwise)
+    h = jax.lax.dynamic_slice(
+        x, (0, true_len - 1, 0), (1, 1, cfg.hidden_size))[:, 0]
+    logits = jnp.einsum("bh,vh->bv", h, params.emb["wte.weight"])
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6)
+    sampled = jax.random.categorical(key, scaled,
+                                     axis=-1).astype(jnp.int32)
+    first = jnp.where(temp > 0.0, sampled, greedy)[0]
+    active = jnp.logical_and(
+        true_len < stop,
+        jnp.logical_not(jnp.logical_and(eos >= 0, first == eos)))
+    out = dict(state)
+    out.update(
+        k=k_cache, v=v_cache,
+        pos=state["pos"].at[slot].set(true_len),
+        token=state["token"].at[slot].set(first),
+        active=state["active"].at[slot].set(active),
+        stop=state["stop"].at[slot].set(stop),
+        eos=state["eos"].at[slot].set(eos),
+        temp=state["temp"].at[slot].set(temp),
+        key=state["key"].at[slot].set(key))
+    return out, first, active
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class DecodeEngine:
+    """See module docstring.  `auto_start=False` keeps the loop thread
+    off so tests drive scheduling deterministically via `step()`."""
+
+    def __init__(self, model_or_params, config=None, auto_start=True,
+                 **kw):
+        from ..models import generate as G
+
+        self.config = cfg = config or DecodeConfig(**kw)
+        if config is not None and kw:
+            raise TypeError("pass either config= or keyword knobs, "
+                            "not both")
+        params = (model_or_params
+                  if isinstance(model_or_params, G.DecodeParams)
+                  else G.build_decode_params(model_or_params))
+        self.params = params
+        if cfg.max_len > params.cfg.max_seq_len:
+            raise ValueError(
+                f"max_len {cfg.max_len} exceeds the model's "
+                f"max_seq_len {params.cfg.max_seq_len}")
+        self._trees = (params.emb, params.blocks, params.head)
+        self.stats = DecodeStats(cfg.label, slots=cfg.slots)
+        self.breaker = CircuitBreaker(
+            failure_threshold=cfg.breaker_threshold,
+            cooldown_s=cfg.breaker_cooldown_s, clock=cfg.clock,
+            name=cfg.label)
+        self.stats.attach_breaker(self.breaker)
+        self.watchdog = HangWatchdog(
+            cfg.watchdog_stall_s, poll_s=cfg.watchdog_poll_s,
+            clock=cfg.clock, stats=self.stats, label=cfg.label,
+            pre_dump=self.emit_telemetry, on_poll=self.sweep_expired)
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._queue = deque()
+        self._slot_req = [None] * cfg.slots
+        self._live = set()
+        self._rid = 0
+        self._closed = False
+        self._broken = False
+        self._loop_thread = None
+        self._build_programs()
+        self._state = self._fresh_state()
+        self.prewarmed = self._prewarm() if cfg.prewarm else 0
+        if auto_start:
+            self.start()
+
+    # -- compiled programs ---------------------------------------------
+    def _build_programs(self):
+        import jax
+
+        mon = _mon()
+        cfg = self.config
+        dec_cfg = self.params.cfg
+        step = jax.jit(functools.partial(_decode_step_impl, cfg=dec_cfg),
+                       donate_argnums=(0,))
+        self._step_fn = mon.instrument_jit(
+            step, key=f"{cfg.label}.decode_step")
+        self._prefill_fns = {}
+        pre = jax.jit(functools.partial(_prefill_impl, cfg=dec_cfg),
+                      donate_argnums=(0,))
+        for b in cfg.buckets:
+            # one instrumented wrapper per bucket: the ledger wrappers
+            # are signature-pinned, and per-bucket keys make the
+            # "1 prefill compile per bucket" assertion a ledger query
+            self._prefill_fns[b] = mon.instrument_jit(
+                pre, key=f"{cfg.label}.prefill_b{b}")
+
+    def _fresh_state(self):
+        import jax.numpy as jnp
+
+        cfg = self.config
+        dec = self.params.cfg
+        head_dim = dec.hidden_size // dec.num_heads
+        kv = (dec.num_layers, cfg.slots, dec.num_heads, cfg.max_len,
+              head_dim)
+        return {
+            "k": jnp.zeros(kv, dec.dtype),
+            "v": jnp.zeros(kv, dec.dtype),
+            "pos": jnp.zeros(cfg.slots, jnp.int32),
+            "active": jnp.zeros(cfg.slots, bool),
+            "token": jnp.zeros(cfg.slots, jnp.int32),
+            "stop": jnp.zeros(cfg.slots, jnp.int32),
+            "eos": jnp.full((cfg.slots,), -1, jnp.int32),
+            "temp": jnp.zeros(cfg.slots, jnp.float32),
+            "key": jnp.zeros((cfg.slots, 2), jnp.uint32),
+        }
+
+    def _prewarm(self):
+        """Compile every program this engine will ever run (1 decode
+        step + 1 prefill per bucket) against throwaway state, then
+        rebuild the state zeros — donation consumed the warm buffers,
+        and serving must start from an empty cache anyway."""
+        cfg = self.config
+        n = 0
+        for b in cfg.buckets:
+            self._state, _, _ = self._prefill_fns[b](
+                self._state, self._trees,
+                np.zeros((1, b), np.int32), np.int32(1), np.int32(0),
+                np.int32(1), np.int32(-1), np.float32(0.0),
+                np.zeros(2, np.uint32))
+            n += 1
+        self._state, _, _, _ = self._step_fn(
+            self._state, self._trees, np.zeros(cfg.slots, bool))
+        self._state = self._fresh_state()
+        return n + 1
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._loop_thread is not None or self._closed:
+                return
+            self._loop_thread = threading.Thread(
+                target=self._loop, name=f"{self.config.label}-engine",
+                daemon=True)
+            self._loop_thread.start()
+        self.watchdog.start()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            t = self._loop_thread
+        if t is not None:
+            t.join(timeout=10.0)
+        err = ServingClosedError("decode engine closed")
+        with self._lock:
+            leftovers = list(self._live)
+            self._queue.clear()
+            self._slot_req = [None] * self.config.slots
+        for req in leftovers:
+            self._resolve_error(req, err, "cancelled")
+        self.watchdog.stop()
+        self.emit_telemetry()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- submission -----------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens, eos_id=None,
+               temperature=0.0, token_budget_s=None, seed=None):
+        """Enqueue one generation request; returns a ServingFuture that
+        resolves to the np.int32 token array (length max_new_tokens,
+        or shorter if eos_id fires)."""
+        cfg = self.config
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new > cfg.max_len:
+            raise ValueError(
+                f"prompt+new = {prompt.size + max_new} exceeds the "
+                f"engine's max_len {cfg.max_len}")
+        bucket = next((b for b in cfg.buckets if b >= prompt.size),
+                      None)
+        if bucket is None:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the largest "
+                f"prefill bucket {cfg.buckets[-1]}")
+        if token_budget_s is None:
+            token_budget_s = cfg.default_token_budget_s
+        with self._lock:
+            if self._closed:
+                raise ServingClosedError("decode engine is closed")
+            if self._broken:
+                raise EngineBrokenError(
+                    "decode engine lost its device state (stalled or "
+                    "failed step); build a fresh engine")
+            if len(self._queue) >= cfg.max_queue_depth:
+                self.stats.note_outcome("rejected")
+                raise QueueFullError(
+                    f"decode queue at depth {cfg.max_queue_depth}")
+            self._rid += 1
+            rid = self._rid
+            key = np.asarray(
+                np.random.RandomState(
+                    seed if seed is not None else rid).randint(
+                    0, 2 ** 31, size=2), np.uint32)
+            req = _DecodeRequest(prompt, max_new, eos_id,
+                                 float(temperature),
+                                 token_budget_s, rid, bucket, key)
+            req.enqueue_t = cfg.clock()
+            self._queue.append(req)
+            self._live.add(req)
+            self.stats.note_admitted(len(self._queue))
+            self._cond.notify_all()
+        return req.future
+
+    # -- budget sweep (watchdog poll + loop tick) ----------------------
+    def sweep_expired(self):
+        """Shed queued requests and expire slot-resident ones whose
+        per-token budget has passed — runs on the watchdog thread too,
+        so budget expiry keeps resolving even while the engine thread
+        is wedged inside a stalled step."""
+        now = self.config.clock()
+        shed, expired = [], []
+        with self._lock:
+            keep = deque()
+            for req in self._queue:
+                (shed.append if req.expired(now)
+                 else keep.append)(req)
+            self._queue = keep
+            # slot-resident: mark for the next step's kill mask
+            for req in self._slot_req:
+                if req is not None and not req.kill \
+                        and not req.future.done() and req.expired(now):
+                    req.kill = True
+                    expired.append(req)
+            depth = len(self._queue)
+        for req in shed:
+            self._resolve_error(
+                req, DeadlineExceeded(
+                    f"first token budget "
+                    f"({req.token_budget_s * 1e3:.1f}ms/token) expired "
+                    f"in queue", budget_s=req.token_budget_s),
+                "shed")
+        for req in expired:
+            self._resolve_error(
+                req, DeadlineExceeded(
+                    f"per-token budget "
+                    f"({req.token_budget_s * 1e3:.1f}ms/token) expired "
+                    f"after {len(req.tokens)} tokens",
+                    budget_s=req.token_budget_s),
+                "expired")
+        if shed or expired:
+            self.stats.note_queue_depth(depth)
+        return len(shed) + len(expired)
+
+    # -- resolution -----------------------------------------------------
+    def _resolve_ok(self, req, now):
+        if req.future._set_result(np.asarray(req.tokens, np.int32)):
+            self.stats.note_outcome("completed",
+                                    latency_s=now - req.enqueue_t)
+        with self._lock:
+            self._live.discard(req)
+
+    def _resolve_error(self, req, exc, outcome):
+        if req.future._set_exception(exc):
+            self.stats.note_outcome(outcome)
+        with self._lock:
+            self._live.discard(req)
+
+    def _mark_broken(self, why):
+        with self._lock:
+            self._broken = True
+            queued = list(self._queue)
+            self._queue.clear()
+        err = EngineBrokenError(f"decode engine broken: {why}")
+        for req in queued:
+            self._resolve_error(req, err, "cancelled")
+        _fr().note_event("decode_engine_broken", severe=True,
+                         label=self.config.label, reason=why)
+
+    # -- guarded dispatch ----------------------------------------------
+    def _dispatch(self, call, meta, requests):
+        """Run one device call (prefill or decode step) on a worker
+        thread under watchdog + retry + breaker, enforcing per-token
+        budgets of the carried requests while it is in flight.
+        Returns the call's value, or None when the dispatch stalled or
+        failed (requests resolved, engine marked broken — the donated
+        state rode the doomed call)."""
+        cfg = self.config
+        token, stalled = self.watchdog.track(meta)
+        done = threading.Event()
+        box = {}
+
+        def runner():
+            try:
+                def _call():
+                    if faultinject.is_armed():
+                        faultinject.check_transient()
+                        faultinject.stall_point("decode.step")
+                    return call()
+
+                if cfg.retry_policy is not None:
+                    box["out"] = call_with_retry(
+                        _call, cfg.retry_policy,
+                        on_retry=lambda *a: self.stats.note_retry())
+                else:
+                    box["out"] = _call()
+            except BaseException as e:  # noqa: BLE001
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=runner, daemon=True,
+                             name=f"{cfg.label}-dispatch")
+        t.start()
+        try:
+            while not done.wait(timeout=0.002):
+                self.sweep_expired()
+                # requests riding THIS dispatch may not be queue- or
+                # slot-resident yet (a prefill's request is in limbo
+                # between the two) — enforce their budgets directly
+                now = cfg.clock()
+                for req in requests:
+                    if not req.future.done() and req.expired(now):
+                        req.kill = True
+                        self._resolve_error(
+                            req, DeadlineExceeded(
+                                "per-token budget expired in flight",
+                                budget_s=req.token_budget_s),
+                            "expired")
+                if stalled.is_set():
+                    stall = WatchdogStall(
+                        f"decode {meta.get('op')} step in flight > "
+                        f"{cfg.watchdog_stall_s}s")
+                    self.breaker.note_failure(stall)
+                    for req in requests:
+                        self._resolve_error(req, stall, "stalled")
+                    self._mark_broken("watchdog_stall")
+                    return None
+        finally:
+            self.watchdog.untrack(token)
+        if "error" in box:
+            e = box["error"]
+            self.breaker.note_failure(e)
+            _fr().note_event(
+                "decode_dispatch_failed", label=cfg.label,
+                error=f"{type(e).__name__}: {e}"[:200],
+                **{k: v for k, v in meta.items() if k != "request_ids"})
+            for req in requests:
+                self._resolve_error(req, e, "failed")
+            self._mark_broken("dispatch_failed")
+            self.emit_telemetry()
+            return None
+        self.breaker.note_success()
+        return box["out"]
+
+    # -- scheduling -----------------------------------------------------
+    def _free_slots_locked(self):
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _admit_locked(self):
+        """Pick (slot, request) pairs to prefill this iteration.
+        Continuous mode refills any free slot the moment the queue has
+        work; static (baseline) mode only admits a fresh cohort once
+        EVERY slot is free — the pad-to-bucket re-prefill scheduling
+        the bench row compares against."""
+        free = self._free_slots_locked()
+        if not free or not self._queue:
+            return []
+        if not self.config.continuous \
+                and len(free) != self.config.slots:
+            return []
+        picks = []
+        while free and self._queue:
+            req = self._queue.popleft()
+            if req.future.done():          # shed while queued
+                continue
+            picks.append((free.pop(0), req))
+        self.stats.note_queue_depth(len(self._queue))
+        return picks
+
+    def step(self):
+        """One engine iteration: sweep budgets, refill free slots via
+        prefill, then run one full-width decode step.  Returns the
+        number of device dispatches made (0 = idle)."""
+        cfg = self.config
+        self.sweep_expired()
+        with self._lock:
+            if self._broken:
+                return 0
+            picks = self._admit_locked()
+        dispatched = 0
+        for idx, (slot, req) in enumerate(picks):
+            if not self.breaker.allow():
+                # breaker open: requeue the whole remainder and let
+                # budgets shed; the cooldown probe reopens admission
+                with self._lock:
+                    for _, r in reversed(picks[idx:]):
+                        self._queue.appendleft(r)
+                picks = picks[:idx]
+                break
+            if not self._prefill(slot, req):
+                err = EngineBrokenError(
+                    "decode engine broke mid-admission")
+                for _, r in picks[idx + 1:]:
+                    self._resolve_error(r, err, "cancelled")
+                return dispatched + 1      # engine broken
+            dispatched += 1
+        with self._lock:
+            slot_reqs = list(self._slot_req)
+        want_step = any(
+            r is not None and (r.kill or not r.future.done())
+            for r in slot_reqs)
+        if want_step and self.breaker.allow():
+            self._decode_once(slot_reqs)
+            dispatched += 1
+        return dispatched
+
+    def _prefill(self, slot, req):
+        cfg = self.config
+        bucket = req.bucket
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, :req.prompt.size] = req.prompt
+        true_len = req.prompt.size
+        stop = true_len + req.max_new - 1   # position of the last token
+        meta = {"op": "prefill", "bucket": bucket, "slot": slot,
+                "rid": req.rid}
+        fn = self._prefill_fns[bucket]
+        state = self._state
+
+        def call():
+            return fn(state, self._trees, prompt, np.int32(true_len),
+                      np.int32(slot), np.int32(stop),
+                      np.int32(-1 if req.eos_id is None else req.eos_id),
+                      np.float32(req.temperature), req.key)
+
+        out = self._dispatch(call, meta, [req])
+        if out is None:
+            return False
+        self._state, first, active = out
+        now = cfg.clock()
+        first = int(first)
+        active = bool(active)
+        req.first_token_t = req.last_token_t = now
+        if req.future.done():              # expired mid-prefill
+            self.stats.note_prefill(ttft_s=None, now=now)
+            req.kill = True
+            with self._lock:
+                self._slot_req[slot] = req if active else None
+            return True
+        self.stats.note_prefill(ttft_s=now - req.enqueue_t, now=now)
+        req.tokens.append(first)
+        req.slot = slot
+        if not active:                     # max_new == 1 or instant eos
+            self._resolve_ok(req, now)
+            with self._lock:
+                self._slot_req[slot] = None
+        else:
+            with self._lock:
+                self._slot_req[slot] = req
+        return True
+
+    def _decode_once(self, slot_reqs):
+        cfg = self.config
+        kill = np.array([r is not None and r.kill for r in slot_reqs],
+                        bool)
+        rids = [r.rid for r in slot_reqs if r is not None]
+        meta = {"op": "decode", "active": int(sum(
+            r is not None and not r.kill for r in slot_reqs)),
+            "request_ids": rids}
+        state = self._state
+
+        def call():
+            return self._step_fn(state, self._trees, kill)
+
+        waiting = [r for r in slot_reqs
+                   if r is not None and not r.future.done()]
+        out = self._dispatch(call, meta, waiting)
+        if out is None:
+            return False
+        self._state, tokens, was_active, still = out
+        now = cfg.clock()
+        tokens = np.asarray(tokens)
+        was_active = np.asarray(was_active)
+        still = np.asarray(still)
+        emitted = 0
+        for i, req in enumerate(slot_reqs):
+            if req is None:
+                continue
+            if not was_active[i]:
+                # killed (budget-expired) or raced to done: release
+                with self._lock:
+                    if self._slot_req[i] is req:
+                        self._slot_req[i] = None
+                continue
+            if not req.future.done():
+                req.tokens.append(int(tokens[i]))
+                if req.last_token_t is not None:
+                    self.stats.note_token_latency(
+                        now - req.last_token_t)
+                req.last_token_t = now
+                emitted += 1
+                if not still[i]:
+                    self._resolve_ok(req, now)
+            if not still[i]:
+                with self._lock:
+                    if self._slot_req[i] is req:
+                        self._slot_req[i] = None
+        self.stats.note_decode_step(int(was_active.sum()), emitted,
+                                    now=now)
+        if self.stats.decode_steps % 64 == 0:
+            self.emit_telemetry()
+        return True
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._closed and not self._queue \
+                        and not any(r is not None
+                                    for r in self._slot_req):
+                    self._cond.wait(0.02)
+                if self._closed or self._broken:
+                    return
+            try:
+                did = self.step()
+            except Exception as e:  # noqa: BLE001
+                _fr().note_event(
+                    "decode_engine_error", severe=True,
+                    label=self.config.label,
+                    error=f"{type(e).__name__}: {e}"[:200])
+                self._mark_broken("engine_loop_error")
+                return
+            if self._broken:
+                return
+            if not did:
+                time.sleep(0.001)
+
+    # -- observability --------------------------------------------------
+    def emit_telemetry(self):
+        """Push the freshest kind="serving" decode record onto the
+        telemetry JSONL stream (no-op while telemetry is off)."""
+        return _mon().record_serving(self.stats.to_record())
+
+    def summary(self):
+        return self.stats.summary()

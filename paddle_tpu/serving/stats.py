@@ -23,8 +23,8 @@ import math
 import threading
 import weakref
 
-__all__ = ["ServingStats", "exact_percentile", "serving_table",
-           "all_stats"]
+__all__ = ["ServingStats", "DecodeStats", "exact_percentile",
+           "serving_table", "all_stats"]
 
 _SAMPLE_CAP = 8192
 
@@ -231,6 +231,124 @@ class ServingStats:
         rec = {"kind": "serving"}
         rec.update(self.summary())
         return rec
+
+
+class DecodeStats(ServingStats):
+    """The decode engine's ledger: everything ServingStats keeps (the
+    outcome invariant, end-to-end latency samples, breaker/watchdog
+    links) plus the token-level series continuous batching is judged
+    by — tokens/s, time-to-first-token, inter-token latency, slot
+    occupancy, prefill-vs-decode step split.
+
+    TTFT and per-token latencies ride the SAME exact nearest-rank
+    percentile machinery as request latency (bounded sample rings,
+    `exact_percentile`) — no new estimator, so the smoke row can
+    recompute any published percentile from the raw samples and assert
+    equality."""
+
+    def __init__(self, label="decode", slots=0, register=True):
+        super().__init__(label, register=register)
+        self.slots = int(slots)
+        self.tokens_total = 0
+        self.prefill_steps = 0
+        self.decode_steps = 0
+        self._occupancy_sum = 0.0      # sum of active/slots per step
+        self._ttft = collections.deque(maxlen=_SAMPLE_CAP)
+        self._tok_lat = collections.deque(maxlen=_SAMPLE_CAP)
+        self._first_t = None           # first/last token wall-clock
+        self._last_t = None            # (engine clock) for tokens/s
+
+    # -- recording ------------------------------------------------------
+    def note_prefill(self, ttft_s=None, now=None):
+        """One prefill dispatch; ttft_s is the submitting request's
+        enqueue->first-token latency."""
+        with self._lock:
+            self.prefill_steps += 1
+            if ttft_s is not None:
+                self._ttft.append(float(ttft_s))
+            if now is not None:
+                if self._first_t is None:
+                    self._first_t = now
+                self._last_t = now
+        mon = _mon()
+        if mon.is_enabled():
+            mon.counter("serving.decode_prefills").add(1)
+
+    def note_decode_step(self, active, emitted, now=None):
+        """One decode-step dispatch: `active` slots were live going in,
+        `emitted` tokens landed on live requests coming out."""
+        with self._lock:
+            self.decode_steps += 1
+            self.tokens_total += int(emitted)
+            if self.slots:
+                self._occupancy_sum += active / self.slots
+            if now is not None:
+                if self._first_t is None:
+                    self._first_t = now
+                self._last_t = now
+        mon = _mon()
+        if mon.is_enabled():
+            mon.counter("serving.decode_steps").add(1)
+            mon.counter("serving.decode_tokens").add(int(emitted))
+            if self.slots:
+                mon.gauge("serving.decode_active_slots").set(active)
+
+    def note_token_latency(self, latency_s):
+        with self._lock:
+            self._tok_lat.append(float(latency_s))
+
+    # -- reading --------------------------------------------------------
+    def _percentiles(self, ring):
+        s = sorted(ring)
+        if not s:
+            return None
+        return {
+            "count": len(s),
+            "mean_ms": round(sum(s) / len(s) * 1e3, 3),
+            "p50_ms": round(exact_percentile(s, 0.50) * 1e3, 3),
+            "p99_ms": round(exact_percentile(s, 0.99) * 1e3, 3),
+            "max_ms": round(s[-1] * 1e3, 3),
+        }
+
+    def ttft_samples(self):
+        with self._lock:
+            return list(self._ttft)
+
+    def token_latency_samples(self):
+        with self._lock:
+            return list(self._tok_lat)
+
+    def decode_summary(self):
+        with self._lock:
+            steps = self.decode_steps
+            out = {
+                "slots": self.slots,
+                "tokens_total": self.tokens_total,
+                "prefill_steps": self.prefill_steps,
+                "decode_steps": steps,
+                "slot_occupancy_mean": (
+                    round(self._occupancy_sum / steps, 4) if steps
+                    and self.slots else None),
+            }
+            span = (self._last_t - self._first_t
+                    if self._first_t is not None
+                    and self._last_t is not None else None)
+            ttft_ring = list(self._ttft)
+            tok_ring = list(self._tok_lat)
+        if span and span > 0:
+            out["tokens_per_s"] = round(out["tokens_total"] / span, 2)
+        ttft = self._percentiles(ttft_ring)
+        if ttft:
+            out["ttft"] = ttft
+        tok = self._percentiles(tok_ring)
+        if tok:
+            out["token_latency"] = tok
+        return out
+
+    def summary(self):
+        out = super().summary()
+        out["decode"] = self.decode_summary()
+        return out
 
 
 def all_stats():

@@ -266,6 +266,23 @@ def test_serving_smoke_in_suite_and_standalone():
 
 
 # ---------------------------------------------------------------------------
+# decode_serving_smoke chaos row (ISSUE 17 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_decode_serving_smoke_in_suite_and_standalone():
+    """The continuous-batching decode chaos row is wired into the
+    suite AND the standalone argv entry (the engine behaviors
+    themselves are covered end-to-end by tests/test_decode_serving.py;
+    re-running the whole row here would pay its compiles twice per CI
+    run for no new signal)."""
+    src = open(bench.__file__).read()
+    assert '("decode_serving_smoke", "decode_serving_smoke"' in src
+    assert '"decode_serving_smoke" in sys.argv[1:]' in src
+    assert "main_decode_serving_smoke" in src
+
+
+# ---------------------------------------------------------------------------
 # numerics_lint_smoke row (ISSUE 15 satellite)
 # ---------------------------------------------------------------------------
 

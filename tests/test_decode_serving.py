@@ -1,0 +1,489 @@
+"""Continuous-batching decode engine tests (ISSUE 17): token-exact
+parity vs models.generate() (dense + MoE, including a request that
+joins mid-decode into a previously-released slot), the two-compile
+steady state through the compile ledger, per-token budget shedding /
+expiry with an injectable clock, watchdog escalation of a wedged
+decode step (engine broken, ledger balanced), the single-query flash
+decode kernel, the fuse pass's decode-shape dispatch, and the
+DecodeStats / exporter / report observability surface.
+
+Determinism strategy: scheduling tests drive the engine synchronously
+(auto_start=False + step()) so slot composition is exact; budget tests
+use a fake clock; the hang test blocks on a threading.Event the test
+releases (no wall-clock guesses)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.models import generate as G
+from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.resilience import RetryPolicy, faultinject
+from paddle_tpu.serving import (DeadlineExceeded, QueueFullError,
+                                ServingClosedError, WatchdogStall)
+from paddle_tpu.serving.decode import (DecodeConfig, DecodeEngine,
+                                       EngineBrokenError,
+                                       default_prompt_buckets)
+from paddle_tpu.serving.stats import DecodeStats, exact_percentile
+
+
+# ---------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faultinject.disarm()
+    monitor.disable()
+    monitor.reset()
+    yield
+    faultinject.disarm()
+    monitor.disable()
+    monitor.reset()
+
+
+@pytest.fixture(scope="module")
+def dense_model():
+    np.random.seed(11)
+    cfg = GPTConfig(vocab_size=97, hidden_size=48, num_layers=3,
+                    num_heads=4, max_seq_len=32, dropout=0.0)
+    return GPT(cfg)
+
+
+@pytest.fixture(scope="module")
+def moe_model():
+    np.random.seed(12)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=24, num_experts=4,
+                    moe_top_k=2, moe_capacity_factor=8.0)
+    m = GPT(cfg)
+    # sharpen the router so expert choice is decisive (capacity 8.0
+    # never binds -> generate()'s own prefill is drop-free and the
+    # engine's drop-free decode routing matches it exactly)
+    for blk in m.blocks:
+        blk.moe.wg.set_value(np.asarray(blk.moe.wg.value) * 10.0)
+    return m
+
+
+def _engine(model, clock=time.monotonic, **kw):
+    kw.setdefault("slots", 3)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("buckets", (8, 16))
+    kw.setdefault("watchdog_stall_s", 30.0)
+    kw.setdefault("label", f"dec_test_{id(model) % 10000}_{time.time_ns() % 100000}")
+    auto = kw.pop("auto_start", False)
+    return DecodeEngine(model, config=DecodeConfig(clock=clock, **kw),
+                        auto_start=auto)
+
+
+def _drain(eng, futs, max_steps=200):
+    for _ in range(max_steps):
+        if all(f.done() for f in futs):
+            return
+        eng.step()
+    raise AssertionError("engine did not drain")
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------
+# token-exact parity
+# ---------------------------------------------------------------------
+
+def test_dense_parity_and_midstream_slot_refill(dense_model):
+    """Slot-decoded tokens are TOKEN-EXACT vs generate() (greedy),
+    with heterogeneous prompt lengths and max_new across slots; a
+    request submitted after a short one finishes joins mid-decode into
+    the RELEASED slot and is exact too (the prefill overwrote the
+    previous tenant's cache region)."""
+    eng = _engine(dense_model)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 97, size=n) for n in (5, 7, 3)]
+    futs = [eng.submit(p, n) for p, n in zip(prompts, (9, 3, 6))]
+    # run until the short request frees its slot but others are live
+    for _ in range(200):
+        eng.step()
+        if futs[1].done():
+            break
+    assert futs[1].done() and not futs[0].done()
+    # join mid-decode: must land in a previously-used slot (all three
+    # slots have been written by earlier tenants)
+    late = rng.integers(0, 97, size=12)
+    f_late = eng.submit(late, 7)
+    _drain(eng, futs + [f_late])
+    for p, n, f in zip(prompts + [late], (9, 3, 6, 7),
+                       futs + [f_late]):
+        ref = np.asarray(G.generate(dense_model, p[None, :],
+                                    max_new_tokens=n))[0]
+        assert np.array_equal(f.result(timeout=0), ref)
+    s = eng.summary()
+    assert s["outcomes"]["completed"] == 4
+    assert s["requests"] == sum(s["outcomes"].values())
+    eng.close()
+
+
+def test_moe_parity_threaded(moe_model):
+    """MoE configs decode token-exact through the engine too (drop-free
+    routing: per-token expert choice is independent of slot cohort),
+    with the loop thread scheduling."""
+    eng = _engine(moe_model, slots=2, max_len=24, buckets=(8,),
+                  auto_start=True)
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, 64, size=n) for n in (4, 6, 5)]
+    futs = [eng.submit(p, 5) for p in prompts]
+    for p, f in zip(prompts, futs):
+        ref = np.asarray(G.generate(moe_model, p[None, :],
+                                    max_new_tokens=5))[0]
+        assert np.array_equal(f.result(timeout=60), ref)
+    eng.close()
+    s = eng.summary()
+    assert s["outcomes"]["completed"] == 3
+    assert s["requests"] == sum(s["outcomes"].values())
+
+
+def test_eos_early_stop(dense_model):
+    """An eos_id request stops the slot at the eos token (inclusive)
+    and matches generate()'s output up to that point."""
+    rng = np.random.default_rng(5)
+    p = rng.integers(0, 97, size=6)
+    full = np.asarray(G.generate(dense_model, p[None, :],
+                                 max_new_tokens=10))[0]
+    eos = int(full[3])        # force a stop after 4 tokens
+    eng = _engine(dense_model, buckets=(8,))
+    f = eng.submit(p, 10, eos_id=eos)
+    _drain(eng, [f])
+    got = f.result(timeout=0)
+    stop = int(np.argmax(full == eos)) + 1
+    assert np.array_equal(got, full[:stop])
+    eng.close()
+
+
+# ---------------------------------------------------------------------
+# compile discipline
+# ---------------------------------------------------------------------
+
+def test_two_compile_steady_state(dense_model):
+    """Steady state compiles exactly once per program: 1 decode step +
+    1 prefill per bucket, all at prewarm; joins/leaves/refills after
+    that add ZERO compile-ledger events."""
+    monitor.reset()
+    monitor.enable()
+    eng = _engine(dense_model, label="dec_compile_t")
+    assert eng.prewarmed == 3      # 2 buckets + 1 decode step
+    warm = len(monitor.compile_events())
+    keys = {e.get("key") for e in monitor.compile_events()}
+    assert {"dec_compile_t.decode_step", "dec_compile_t.prefill_b8",
+            "dec_compile_t.prefill_b16"} <= keys
+    rng = np.random.default_rng(6)
+    futs = [eng.submit(rng.integers(0, 97, size=int(n)), 4)
+            for n in rng.integers(2, 15, size=7)]
+    _drain(eng, futs)
+    assert len(monitor.compile_events()) == warm
+    eng.close()
+
+
+def test_default_prompt_buckets():
+    assert default_prompt_buckets(64) == (16, 32, 64)
+    assert default_prompt_buckets(100) == (16, 32, 64)
+
+
+# ---------------------------------------------------------------------
+# per-token budgets
+# ---------------------------------------------------------------------
+
+def test_budget_shed_in_queue(dense_model):
+    """A queued request whose first-token budget passes before a slot
+    frees is SHED with DeadlineExceeded — the sweep runs host-side, no
+    device step needed."""
+    clk = FakeClock()
+    eng = _engine(dense_model, clock=clk, slots=1, buckets=(8,))
+    rng = np.random.default_rng(7)
+    f_long = eng.submit(rng.integers(0, 97, size=4), 8)
+    eng.step()                     # occupies the only slot
+    f_tight = eng.submit(rng.integers(0, 97, size=4), 4,
+                         token_budget_s=0.5)
+    clk.advance(1.0)
+    assert eng.sweep_expired() == 1
+    assert isinstance(f_tight.exception(timeout=0), DeadlineExceeded)
+    _drain(eng, [f_long])
+    s = eng.summary()
+    assert s["outcomes"]["shed"] == 1
+    assert s["outcomes"]["completed"] == 1
+    assert s["requests"] == sum(s["outcomes"].values())
+    eng.close()
+
+
+def test_budget_expired_midstream_releases_slot(dense_model):
+    """A slot-resident request whose inter-token budget passes is
+    resolved 'expired', its slot is killed on the next step, and the
+    freed slot is REFILLED by the next queued request (which still
+    decodes token-exact)."""
+    clk = FakeClock()
+    eng = _engine(dense_model, clock=clk, slots=1, buckets=(8,))
+    rng = np.random.default_rng(8)
+    p1, p2 = rng.integers(0, 97, size=5), rng.integers(0, 97, size=6)
+    f1 = eng.submit(p1, 8, token_budget_s=0.5)
+    eng.step()                     # prefill: first token lands
+    eng.step()                     # one decode token
+    assert not f1.done()
+    clk.advance(1.0)               # inter-token gap > budget
+    assert eng.sweep_expired() == 1
+    assert isinstance(f1.exception(timeout=0), DeadlineExceeded)
+    f2 = eng.submit(p2, 4)         # queued behind the dead tenant
+    _drain(eng, [f2])
+    ref = np.asarray(G.generate(dense_model, p2[None, :],
+                                max_new_tokens=4))[0]
+    assert np.array_equal(f2.result(timeout=0), ref)
+    s = eng.summary()
+    assert s["outcomes"]["expired"] == 1
+    assert s["outcomes"]["completed"] == 1
+    assert s["requests"] == sum(s["outcomes"].values())
+    eng.close()
+
+
+def test_queue_full_rejected(dense_model):
+    eng = _engine(dense_model, slots=1, max_queue_depth=2,
+                  buckets=(8,))
+    rng = np.random.default_rng(9)
+    subs = [eng.submit(rng.integers(0, 97, size=4), 4)
+            for _ in range(2)]
+    with pytest.raises(QueueFullError):
+        eng.submit(rng.integers(0, 97, size=4), 4)
+    assert eng.summary()["outcomes"]["rejected"] == 1
+    _drain(eng, subs)
+    eng.close()
+    s = eng.summary()
+    assert s["requests"] == sum(s["outcomes"].values())
+
+
+def test_submit_validation(dense_model):
+    # validation never reaches a program: skip the prewarm compiles
+    eng = _engine(dense_model, prewarm=False)
+    with pytest.raises(ValueError):
+        eng.submit([], 4)                     # empty prompt
+    with pytest.raises(ValueError):
+        eng.submit([1, 2], 0)                 # no tokens requested
+    with pytest.raises(ValueError):
+        eng.submit(list(range(20)), 4)        # beyond largest bucket
+    with pytest.raises(ValueError):
+        eng.submit([1, 2, 3], 40)             # prompt+new > max_len
+    eng.close()
+    with pytest.raises(ServingClosedError):
+        eng.submit([1, 2], 2)
+
+
+# ---------------------------------------------------------------------
+# watchdog + broken-engine semantics
+# ---------------------------------------------------------------------
+
+def test_watchdog_stall_breaks_engine(dense_model, tmp_path):
+    """A wedged decode step escalates: the watchdog flags it, riding
+    requests resolve 'stalled' (classified), queued requests cancel,
+    and the engine refuses new work — the donated KV state is inside
+    the wedged call, so pretending to continue would serve garbage."""
+    old = fluid.get_flags("FLAGS_flight_recorder_dir")
+    fluid.set_flags({"FLAGS_flight_recorder_dir": str(tmp_path)})
+    hang = threading.Event()
+    try:
+        eng = _engine(dense_model, auto_start=True, buckets=(8,),
+                      watchdog_stall_s=0.08, watchdog_poll_s=0.02,
+                      retry_policy=None)
+        rng = np.random.default_rng(10)
+        f1 = eng.submit(rng.integers(0, 97, size=4), 8)
+        # wedge the NEXT dispatch (prefill or decode — both run under
+        # the same guard)
+        faultinject.arm(stall_points={"decode.step": ("every", hang)})
+        f2 = eng.submit(rng.integers(0, 97, size=4), 8)
+        err = f1.exception(timeout=30) or f2.exception(timeout=30)
+        assert isinstance(err, WatchdogStall)
+        with pytest.raises(EngineBrokenError):
+            eng.submit(rng.integers(0, 97, size=4), 2)
+        s = eng.summary()
+        assert s["outcomes"]["stalled"] >= 1
+        assert s["watchdog_stalls"] >= 1
+        assert s["requests"] == sum(s["outcomes"].values())
+        assert s["pending"] == 0
+    finally:
+        hang.set()
+        faultinject.disarm()
+        fluid.set_flags(old)
+    eng.close()
+
+
+def test_close_cancels_queued(dense_model):
+    # never steps: everything cancels in the queue, no compiles needed
+    eng = _engine(dense_model, slots=1, prewarm=False)
+    rng = np.random.default_rng(13)
+    futs = [eng.submit(rng.integers(0, 97, size=4), 6)
+            for _ in range(3)]
+    eng.close()
+    s = eng.summary()
+    assert s["outcomes"]["cancelled"] >= 2    # the queued ones
+    assert s["requests"] == sum(s["outcomes"].values())
+    assert all(f.done() for f in futs)
+
+
+# ---------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------
+
+def test_decode_stats_percentiles_exact():
+    """TTFT and inter-token percentiles ride the nearest-rank
+    machinery: the published p99 is EXACTLY recomputable from the raw
+    samples — no estimator drift."""
+    st = DecodeStats("dec_pct_t", slots=4, register=False)
+    rng = np.random.default_rng(14)
+    for v in rng.uniform(0.001, 0.2, size=257):
+        st.note_token_latency(float(v))
+        st.note_prefill(ttft_s=float(v) * 2)
+    d = st.decode_summary()
+    toks = sorted(st.token_latency_samples())
+    assert d["token_latency"]["p99_ms"] == round(
+        exact_percentile(toks, 0.99) * 1e3, 3)
+    ttfts = sorted(st.ttft_samples())
+    assert d["ttft"]["p50_ms"] == round(
+        exact_percentile(ttfts, 0.50) * 1e3, 3)
+
+
+def test_metrics_and_record_surface(dense_model):
+    """/metrics exposes decode_tokens_total + decode_slot_occupancy
+    (parseable, family-contiguous) and the kind='serving' record
+    carries the decode block the report tool renders."""
+    from paddle_tpu.monitor import exporter
+
+    monitor.reset()
+    monitor.enable()
+    eng = _engine(dense_model, label="dec_metrics_t", buckets=(8,))
+    rng = np.random.default_rng(15)
+    futs = [eng.submit(rng.integers(0, 97, size=5), 4)
+            for _ in range(3)]
+    _drain(eng, futs)
+    eng.emit_telemetry()
+    text = exporter.prometheus_text()
+    parsed = exporter.parse_prometheus(text)
+    lab = (("runtime", "dec_metrics_t"),)
+    assert parsed[("paddle_tpu_decode_tokens_total", lab)] \
+        == eng.stats.tokens_total
+    occ = parsed[("paddle_tpu_decode_slot_occupancy", lab)]
+    assert 0.0 < occ <= 1.0
+    recs = [r for r in monitor.serving_records()
+            if r.get("kind") == "serving" and r.get("decode")]
+    assert recs
+    dec = recs[-1]["decode"]
+    assert dec["tokens_total"] == eng.stats.tokens_total
+    assert dec["prefill_steps"] == 3
+
+    from tools.telemetry_report import _serving_section
+
+    sec = _serving_section(recs)
+    block = sec["by_runtime"]["dec_metrics_t"]["decode"]
+    assert block["tokens_total"] == eng.stats.tokens_total
+    assert block["steps"]["prefill"] == 3
+    assert 0.0 < block["prefill_step_frac"] < 1.0
+    assert "p99_ms" in block.get("ttft_ms", {})
+    eng.close()
+
+
+# ---------------------------------------------------------------------
+# kernels + fuse dispatch
+# ---------------------------------------------------------------------
+
+def test_flash_decode_matches_xla_path():
+    """The Pallas single-query decode kernel (interpret mode on CPU)
+    matches the exact XLA decode_attention math with ragged per-row
+    lengths."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.attention import decode_attention
+    from paddle_tpu.kernels.flash_attention import flash_decode
+
+    rng = np.random.default_rng(16)
+    b, h, t, d = 3, 4, 256, 64
+    q = jnp.asarray(rng.standard_normal((b, h, 1, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    pos = jnp.asarray([5, 200, 255], jnp.int32)
+    ref = decode_attention(q, k, v, pos=pos, use_flash=False)
+    out = flash_decode(q, k, v, pos + 1)
+    assert np.allclose(np.asarray(out), np.asarray(ref),
+                       rtol=1e-5, atol=1e-5)
+
+
+def test_fuse_tags_decode_shape_and_matches():
+    """A decode-shaped attention pattern (q_len==1 against a longer
+    K/V prefix) fuses with attrs['decode']=True and the fused program
+    still matches the unfused one numerically."""
+    from paddle_tpu import layers as L
+    from paddle_tpu import passes
+    from paddle_tpu.framework.executor import Scope
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            q = fluid.data("q", [None, 4, 1, 8])
+            k = fluid.data("k", [None, 4, 16, 8])
+            v = fluid.data("v", [None, 4, 16, 8])
+            mask = fluid.data("mask", [None, 4, 1, 16])
+            scores = L.scale(L.matmul(q, k, transpose_y=True),
+                             scale=8 ** -0.5)
+            probs = L.softmax(L.elementwise_add(scores, mask))
+            ctx = L.matmul(probs, v)
+            loss = L.mean(ctx)
+    fused, _ = passes.fuse_program(main, fetch_names=[loss.name],
+                                   record=False)
+    fa = next(op for op in fused.global_block().ops
+              if op.type == "fused_attention")
+    assert fa.attrs.get("decode") is True
+    exe = fluid.Executor()
+    rng = np.random.default_rng(17)
+    feed = {"q": rng.standard_normal((2, 4, 1, 8)).astype(np.float32),
+            "k": rng.standard_normal((2, 4, 16, 8)).astype(np.float32),
+            "v": rng.standard_normal((2, 4, 16, 8)).astype(np.float32),
+            "mask": np.where(
+                np.arange(16)[None, None, None, :] <= 9, 0.0,
+                -1e9).astype(np.float32)
+            * np.ones((2, 4, 1, 16), np.float32)}
+    ref = exe.run(main, feed=feed, fetch_list=[loss.name],
+                  scope=Scope())
+    out = exe.run(fused, feed=feed, fetch_list=[loss.name],
+                  scope=Scope())
+    assert np.allclose(np.asarray(ref[0]), np.asarray(out[0]),
+                       rtol=1e-5, atol=1e-6)
+
+
+def test_static_baseline_mode_waits_for_cohort(dense_model):
+    """continuous=False is the pad-to-bucket baseline: no admission
+    while ANY slot is occupied — the straggler holds the whole cohort."""
+    eng = _engine(dense_model, slots=2, continuous=False,
+                  buckets=(8,))
+    rng = np.random.default_rng(18)
+    f_long = eng.submit(rng.integers(0, 97, size=4), 8)
+    f_short = eng.submit(rng.integers(0, 97, size=4), 2)
+    eng.step()                    # admits BOTH (all slots free)
+    _drain(eng, [f_short])
+    f_next = eng.submit(rng.integers(0, 97, size=4), 2)
+    eng.step()
+    assert not f_next.done() or f_long.done()
+    with eng._lock:
+        occupied = [r is not None for r in eng._slot_req]
+    if not f_long.done():
+        # the freed slot must NOT have been refilled while the
+        # straggler decodes
+        assert sum(occupied) == 1
+    _drain(eng, [f_long, f_next])
+    for f, n in ((f_long, 8), (f_short, 2), (f_next, 2)):
+        assert len(f.result(timeout=0)) == n
+    eng.close()

@@ -346,6 +346,13 @@ def test_scrape_matches_snapshot_over_http():
         parsed = exporter.parse_prometheus(text)
         snap = monitor.snapshot()
         for name, v in snap["counters"].items():
+            if name in {"serving.requests", "serving.queue_depth",
+                        "serving.in_flight", "fleet.process_count"}:
+                # ledger-owned: the exporter skips the bare registry
+                # copy and exports the {runtime=...}-labeled family
+                # instead (registry names survive monitor.reset() with
+                # value 0, so any earlier serving test leaves them)
+                continue
             key = ("paddle_tpu_"
                    + exporter._sanitize(name) + "_total", ())
             assert parsed[key] == float(v), name

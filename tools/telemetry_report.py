@@ -298,6 +298,37 @@ def _serving_section(records):
                                 for t in br.get("transitions", [])]}
         if r.get("buckets"):
             entry["buckets"] = r["buckets"]
+        dec = r.get("decode")
+        if dec:
+            # decode-engine block (ISSUE 17): token-level series for
+            # the continuous-batching engine — tokens/s, TTFT and
+            # inter-token percentiles (exact nearest-rank, as the
+            # engine computed them), slot occupancy, and how the step
+            # mix split between prefill refills and decode steps
+            dblock = {
+                "tokens_total": dec.get("tokens_total", 0),
+                "slots": dec.get("slots"),
+            }
+            if dec.get("tokens_per_s") is not None:
+                dblock["tokens_per_s"] = dec["tokens_per_s"]
+            if dec.get("slot_occupancy_mean") is not None:
+                dblock["slot_occupancy_mean"] = \
+                    dec["slot_occupancy_mean"]
+            ttft = dec.get("ttft")
+            if ttft:
+                dblock["ttft_ms"] = {
+                    q: ttft[q] for q in ("p50_ms", "p99_ms") if q in ttft}
+            tok = dec.get("token_latency")
+            if tok:
+                dblock["token_latency_ms"] = {
+                    q: tok[q] for q in ("p50_ms", "p99_ms") if q in tok}
+            pre = dec.get("prefill_steps", 0)
+            steps = dec.get("decode_steps", 0)
+            dblock["steps"] = {"prefill": pre, "decode": steps}
+            if pre + steps:
+                dblock["prefill_step_frac"] = round(
+                    pre / (pre + steps), 4)
+            entry["decode"] = dblock
         progs[k] = entry
     out["by_runtime"] = progs
     return out
