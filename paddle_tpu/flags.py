@@ -196,6 +196,30 @@ declare_flag("decode_token_budget_s", 0.0,
              "request is shed/expired into the outcome ledger "
              "(0 = no budget unless the request carries one).")
 
+# Request-scoped distributed tracing (paddle_tpu.monitor.tracing,
+# ISSUE 18): per-request span trees through the serving tier with
+# exact tail-latency attribution.  Off by default and gate-free when
+# off — the dispatch fast path pays nothing (same contract as the
+# flight recorder).
+declare_flag("request_tracing", False,
+             "Record a span tree (queue / dispatch / retry / stall / "
+             "prefill / decode) for every serving request; attribution "
+             "tables and SLO accounting derive exactly from the spans.")
+declare_flag("trace_sample", 1.0,
+             "Head-sampling rate for retaining FULL span trees of "
+             "non-violating requests (0.0..1.0).  SLO violators are "
+             "always retained regardless; per-request attribution "
+             "component rows are always recorded.")
+declare_flag("serving_slo_ms", 0.0,
+             "End-to-end latency SLO per request in milliseconds: a "
+             "completed request slower than this counts as an SLO "
+             "violation (slo_violations counter + burn-rate gauge on "
+             "/metrics, violator trees always retained).  0 = no SLO.")
+declare_flag("trace_buffer", 512,
+             "Capacity of the retained full-span-tree ring per serving "
+             "label (violators + head-sampled); oldest trees fall out "
+             "and are counted in trees_dropped.")
+
 # Program-level graph optimizer (paddle_tpu.passes, ISSUE 9): the
 # framework/ir pass-pipeline analogue.  "on" substitutes an optimized
 # program (CSE / const fold / identity+scale collapse / DCE) before

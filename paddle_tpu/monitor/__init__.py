@@ -39,6 +39,7 @@ from . import mem_profile                                 # noqa: F401
 from . import flight_recorder  # noqa: F401  — installs crash hooks
 from . import fleet                                       # noqa: F401
 from . import exporter                                    # noqa: F401
+from . import tracing                                     # noqa: F401
 from .fleet import fleet_skew, rank_info, rank_tag        # noqa: F401
 
 __all__ = [
@@ -52,6 +53,7 @@ __all__ = [
     "flight_dump",
     "mem_profile", "mem_profile_split", "mem_table", "peak_breakdown",
     "serving_table", "record_serving", "serving_records",
+    "tracing", "record_trace", "trace_records",
     "fleet", "exporter", "fleet_skew", "rank_info", "rank_tag",
     "record_fleet_skew", "fleet_skew_records",
     "record_elastic", "elastic_records",
@@ -84,6 +86,9 @@ _fleet_records = []
 # topology transitions, rank join/leave/death, policy decisions — the
 # topology history telemetry_report renders
 _elastic_records = []
+# kind="trace" records from request tracing (ISSUE 18): each retained
+# span tree (SLO violators + head-sampled), emitted at trace finish
+_trace_records = []
 
 
 def enable(jsonl_path=None):
@@ -129,6 +134,8 @@ def reset():
     del _pass_records[:]
     del _fleet_records[:]
     del _elastic_records[:]
+    del _trace_records[:]
+    tracing.get().reset()
 
 
 # -- recording entry points (no-ops while disabled) ---------------------
@@ -189,6 +196,26 @@ def serving_records():
     """kind="serving" records seen since enable()/reset(), newest
     last."""
     return list(_serving_records)
+
+
+def record_trace(record):
+    """Write one kind="trace" record (a retained request span tree
+    from monitor/tracing.py) onto the telemetry JSONL stream and keep
+    it addressable in-process (trace_records()).  Like lint/serving
+    records it rides the stream without touching step numbering.  The
+    TraceStore itself is gate-free like the serving stats ledger —
+    this is only the JSONL/export mirror."""
+    if not _enabled or not record:
+        return None
+    _trace_records.append(dict(record))
+    _session.emit_record(record)
+    return record
+
+
+def trace_records():
+    """kind="trace" records (retained span trees) seen since
+    enable()/reset(), newest last."""
+    return list(_trace_records)
 
 
 def record_pass_pipeline(record):
@@ -426,6 +453,11 @@ def snapshot():
     serving = serving_table()
     if serving:
         out["serving"] = serving
+    store = tracing.get()
+    tr = [s for s in (store.summary(lb) for lb in store.labels())
+          if s is not None]
+    if tr:
+        out["tracing"] = tr
     if skew:
         out["fleet"] = {"rank": fleet.rank_tag(), "skew": skew}
     return out
@@ -433,10 +465,11 @@ def snapshot():
 
 def merged_trace_events(host_events):
     """Build the unified trace event list from the profiler's host
-    spans plus this session's step records, compile events, and gauge
-    time-series tracks."""
+    spans plus this session's step records, compile events, gauge
+    time-series tracks, and retained request-trace trees."""
     from .trace import merged_trace_events as _merge
 
     return _merge(host_events, step_records=_session.records(),
                   compile_events=_ledger.events(),
-                  gauge_series=_registry.gauge_series())
+                  gauge_series=_registry.gauge_series(),
+                  trace_trees=tracing.get().retained_trees())

@@ -181,6 +181,28 @@ def prometheus_text():
                             "decode step")
     except Exception:
         pass
+    # request-tracing SLO families (ISSUE 18): cumulative violation
+    # counter + window burn-rate gauge per traced serving label, driven
+    # by FLAGS_serving_slo_ms.  Family-outer like the ledger block.
+    try:
+        from . import tracing
+
+        store = tracing.get()
+        slos = [s for s in (store.slo_table(lb) for lb in store.labels())
+                if s is not None and s["slo_ms"] > 0.0]
+        for s in slos:
+            _line(out, "serving_slo_violations_total",
+                  s["violations_total"],
+                  labels={"runtime": s["label"]}, kind="counter",
+                  help_="completed requests slower than "
+                        "FLAGS_serving_slo_ms")
+        for s in slos:
+            _line(out, "serving_slo_burn_rate", s["burn_rate"],
+                  labels={"runtime": s["label"]}, kind="gauge",
+                  help_="violating fraction of the completed-request "
+                        "window")
+    except Exception:
+        pass
     # compile ledger: peak HBM of the newest attributed compile
     try:
         prof = monitor.mem_profile_split()

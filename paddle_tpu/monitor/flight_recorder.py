@@ -393,6 +393,26 @@ class FlightRecorder:
             # likewise one kind="serving" line per runtime label —
             # outcome ledger, exact latency percentiles, breaker state
             lines.append(serving)
+        try:
+            # request tracing (ISSUE 18): the retained span trees as
+            # kind="trace" lines — identical to the telemetry stream's,
+            # so telemetry_report's tracing section reads a dump like a
+            # live stream.  A stall dump therefore NAMES the wedged
+            # requests' traces: the stall event's meta carries their
+            # trace_ids, and the trees/active listing here carries the
+            # spans recorded up to the wedge.
+            from . import tracing
+
+            store = tracing.get()
+            for tree in store.retained_trees():
+                lines.append(tree)
+            active = store.active_traces()
+            if active:
+                lines.append({"kind": "trace_active",
+                              "wall_time": time.time(),
+                              "active": active})
+        except Exception:
+            pass
         if snap["oom"]:
             lines.append(snap["oom"])
         try:
@@ -457,10 +477,20 @@ class FlightRecorder:
             gauge_series = monitor._registry.gauge_series()
         except Exception:
             pass
+        trace_trees = []
+        try:
+            from . import tracing
+
+            # retained request span trees ride the post-mortem chrome
+            # trace as pid-2 tracks, same clock as the host spans
+            trace_trees = tracing.get().retained_trees()
+        except Exception:
+            pass
         events = merged_trace_events(host_events,
                                      step_records=snap["steps"],
                                      compile_events=snap["compiles"],
-                                     gauge_series=gauge_series)
+                                     gauge_series=gauge_series,
+                                     trace_trees=trace_trees)
         with open(path, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"},
                       f)

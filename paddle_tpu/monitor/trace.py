@@ -5,16 +5,23 @@ One Perfetto/chrome://tracing load shows, on a shared timeline:
   pid 0 ("host")       RecordEvent spans, one track per recording thread
   pid 1 ("train steps") step-boundary spans + compile spans
   pid 1 counter tracks  examples/s, cache hit/miss, live bytes
+  pid 2 ("requests")    per-request serving span trees (ISSUE 18):
+                        one track per retained trace, span nesting =
+                        the trace's parent/child structure, per-token
+                        progress as instant events
 
 All timestamps are the profiler's span clock (perf_counter μs), so the
-tracks align without cross-clock skew.  `profiler.export_chrome_tracing`
+tracks align without cross-clock skew — request tracing stamps spans
+with the same perf_counter_ns clock.  `profiler.export_chrome_tracing`
 calls `merged_trace_events`; this module only builds the event list.
 """
 
-__all__ = ["merged_trace_events", "host_span_events"]
+__all__ = ["merged_trace_events", "host_span_events",
+           "request_trace_events"]
 
 _HOST_PID = 0
 _STEP_PID = 1
+_REQUEST_PID = 2
 _STEP_TID = 0
 _COMPILE_TID = 1
 
@@ -153,11 +160,54 @@ def _compile_events(events):
     return out
 
 
+def request_trace_events(trace_trees):
+    """Retained request span trees (monitor/tracing.py tree dicts) ->
+    pid-2 tracks: one tid per trace, each span an X event at its tree
+    depth's natural nesting, each annotation an instant event.  Span
+    timestamps are already perf_counter ns, converted to the trace
+    clock's μs here."""
+    out = []
+    for tid, tree in enumerate(trace_trees):
+        name = "%s %s%s" % (
+            tree.get("outcome", "?"), tree.get("trace_id", "")[:8],
+            " VIOLATION" if tree.get("violation") else "")
+        out.append({"name": "thread_name", "ph": "M",
+                    "pid": _REQUEST_PID, "tid": tid,
+                    "args": {"name": name}})
+        for s in tree.get("spans", ()):
+            if s.get("start_ns") is None or s.get("end_ns") is None:
+                continue
+            args = {"trace_id": tree.get("trace_id"),
+                    "rid": tree.get("rid"),
+                    "depth": s.get("depth", 0)}
+            if s.get("category"):
+                args["category"] = s["category"]
+            if s.get("outcome"):
+                args["outcome"] = s["outcome"]
+            args.update(s.get("attrs") or {})
+            out.append({"name": s["name"], "ph": "X",
+                        "ts": s["start_ns"] / 1e3,
+                        "dur": (s["end_ns"] - s["start_ns"]) / 1e3,
+                        "pid": _REQUEST_PID, "tid": tid,
+                        "cat": "request", "args": args})
+            for ts_ns, text in (s.get("annotations") or ()):
+                out.append({"name": text, "ph": "i", "ts": ts_ns / 1e3,
+                            "pid": _REQUEST_PID, "tid": tid, "s": "t",
+                            "cat": "request",
+                            "args": {"span": s["name"]}})
+    if out:
+        out.insert(0, {"name": "process_name", "ph": "M",
+                       "pid": _REQUEST_PID,
+                       "args": {"name": "requests"}})
+    return out
+
+
 def merged_trace_events(host_events, step_records=None,
-                        compile_events=None, gauge_series=None):
+                        compile_events=None, gauge_series=None,
+                        trace_trees=None):
     """The full merged event list: metadata + host spans + step spans +
     compile spans + counter tracks (sampled counters AND gauge
-    time-series)."""
+    time-series) + per-request serving trace tracks."""
     step_records = step_records or []
     compile_events = compile_events or []
     out = _metadata_events(host_events)
@@ -166,4 +216,6 @@ def merged_trace_events(host_events, step_records=None,
     out.extend(_compile_events(compile_events))
     if gauge_series:
         out.extend(_gauge_events(gauge_series))
+    if trace_trees:
+        out.extend(request_trace_events(trace_trees))
     return out

@@ -85,6 +85,12 @@ def _mon():
     return monitor
 
 
+def _tracing():
+    from ..monitor import tracing
+
+    return tracing
+
+
 def default_prompt_buckets(max_len):
     """Power-of-two prompt buckets 16..max_len (PR-8 bucketing shape):
     one prefill program per bucket, compiled once."""
@@ -145,7 +151,7 @@ class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "temperature",
                  "token_budget_s", "rid", "future", "tokens",
                  "enqueue_t", "last_token_t", "first_token_t", "slot",
-                 "bucket", "kill", "key")
+                 "bucket", "kill", "key", "trace", "qspan", "dspan")
 
     def __init__(self, prompt, max_new, eos_id, temperature,
                  token_budget_s, rid, bucket, key):
@@ -164,6 +170,11 @@ class _DecodeRequest:
         self.first_token_t = None
         self.slot = None
         self.kill = False                 # expired while slot-resident
+        # request-scoped trace context (monitor/tracing.py); None when
+        # FLAGS_request_tracing is off
+        self.trace = None
+        self.qspan = None                 # queue-wait span
+        self.dspan = None                 # slot-resident decode span
 
     def next_deadline(self):
         """Per-token budget: the NEXT token (the first included — TTFT
@@ -472,10 +483,12 @@ class DecodeEngine:
 
     # -- submission -----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens, eos_id=None,
-               temperature=0.0, token_budget_s=None, seed=None):
+               temperature=0.0, token_budget_s=None, seed=None,
+               traceparent=None):
         """Enqueue one generation request; returns a ServingFuture that
         resolves to the np.int32 token array (length max_new_tokens,
-        or shorter if eos_id fires)."""
+        or shorter if eos_id fires).  `traceparent` optionally joins an
+        external W3C trace when FLAGS_request_tracing is on."""
         cfg = self.config
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size < 1:
@@ -502,8 +515,21 @@ class DecodeEngine:
                 raise EngineBrokenError(
                     "decode engine lost its device state (stalled or "
                     "failed step); build a fresh engine")
+            # started after the closed/broken gates (those raise
+            # without a ledger outcome, so no tree must exist for
+            # them) but before the queue-full gate (rejected IS a
+            # ledger outcome and its tree must close as "rejected")
+            trace = _tracing().get().start_request(
+                f"decode.request/{cfg.label}", label=cfg.label,
+                traceparent=traceparent,
+                attrs={"prompt_len": int(prompt.size),
+                       "max_new": max_new})
             if len(self._queue) >= cfg.max_queue_depth:
                 self.stats.note_outcome("rejected")
+                if trace is not None:
+                    trace.annotate(trace.root, "rejected: queue full",
+                                   depth=len(self._queue))
+                    trace.finish("rejected")
                 raise QueueFullError(
                     f"decode queue at depth {cfg.max_queue_depth}")
             self._rid += 1
@@ -516,6 +542,10 @@ class DecodeEngine:
                                  float(temperature),
                                  token_budget_s, rid, bucket, key)
             req.enqueue_t = cfg.clock()
+            if trace is not None:
+                trace.rid = rid
+                req.trace = trace
+                req.qspan = trace.child("queue", "queue")
             self._queue.append(req)
             self._live.add(req)
             self.stats.note_admitted(len(self._queue))
@@ -567,22 +597,35 @@ class DecodeEngine:
         if req.future._set_result(np.asarray(req.tokens, np.int32)):
             self.stats.note_outcome("completed",
                                     latency_s=now - req.enqueue_t)
+            if req.trace is not None:
+                req.trace.finish("completed")
         with self._lock:
             self._live.discard(req)
 
     def _resolve_error(self, req, exc, outcome):
         if req.future._set_exception(exc):
             self.stats.note_outcome(outcome)
+            if req.trace is not None:
+                req.trace.finish(outcome)
         with self._lock:
             self._live.discard(req)
 
     def _mark_broken(self, why):
+        """The donated device state rode a doomed call: drain EVERY
+        unresolved request — queued AND slot-resident — as cancelled,
+        so no future (and no trace) stays open behind a dead engine.
+        Requests the failing dispatch already resolved (stalled/
+        failed) are skipped by the idempotent resolve."""
         with self._lock:
             self._broken = True
             queued = list(self._queue)
             self._queue.clear()
+            resident = [r for r in self._slot_req if r is not None]
+            self._slot_req = [None] * self.config.slots
         err = EngineBrokenError(f"decode engine broken: {why}")
         for req in queued:
+            self._resolve_error(req, err, "cancelled")
+        for req in resident:
             self._resolve_error(req, err, "cancelled")
         _fr().note_event("decode_engine_broken", severe=True,
                          label=self.config.label, reason=why)
@@ -654,7 +697,8 @@ class DecodeEngine:
             _fr().note_event(
                 "decode_dispatch_failed", label=cfg.label,
                 error=f"{type(e).__name__}: {e}"[:200],
-                **{k: v for k, v in meta.items() if k != "request_ids"})
+                **{k: v for k, v in meta.items()
+                   if k not in ("request_ids", "trace_ids")})
             for req in requests:
                 self._resolve_error(req, e, "failed")
             self._mark_broken("dispatch_failed")
@@ -702,9 +746,15 @@ class DecodeEngine:
         for idx, (slot, req) in enumerate(picks):
             if not self.breaker.allow():
                 # breaker open: requeue the whole remainder and let
-                # budgets shed; the cooldown probe reopens admission
+                # budgets shed; the cooldown probe reopens admission.
+                # A requeued request keeps its SAME trace (its queue
+                # span never ended — requeued wait keeps accruing);
+                # the detour is a point annotation, not a new tree.
                 with self._lock:
                     for _, r in reversed(picks[idx:]):
+                        if r.trace is not None:
+                            r.trace.annotate(r.trace.root,
+                                             "breaker_requeue")
                         self._queue.appendleft(r)
                 picks = picks[:idx]
                 break
@@ -734,6 +784,13 @@ class DecodeEngine:
         stop = true_len + req.max_new - 1   # position of the last token
         meta = {"op": "prefill", "bucket": bucket, "slot": slot,
                 "rid": req.rid}
+        pspan = None
+        if req.trace is not None:
+            meta["trace_id"] = req.trace.trace_id
+            req.trace.end(req.qspan)
+            pspan = req.trace.child(f"prefill/b{bucket}", "prefill",
+                                    attrs={"bucket": bucket,
+                                           "slot": slot})
         fn = self._prefill_fns[bucket]
         state = self._state
 
@@ -751,6 +808,9 @@ class DecodeEngine:
         first = int(first)
         active = bool(active)
         req.first_token_t = req.last_token_t = now
+        if req.trace is not None:
+            req.trace.annotate(pspan, "first_token")
+            req.trace.end(pspan)
         if req.future.done():              # expired mid-prefill
             self.stats.note_prefill(ttft_s=None, now=now)
             req.kill = True
@@ -765,6 +825,11 @@ class DecodeEngine:
             with self._lock:
                 self._slot_req[slot] = None
         else:
+            if req.trace is not None:
+                # slot-resident decode: one span from slot entry to
+                # the last token, per-token progress as annotations
+                req.dspan = req.trace.child("decode", "decode",
+                                            attrs={"slot": slot})
             with self._lock:
                 self._slot_req[slot] = req
         return True
@@ -777,6 +842,12 @@ class DecodeEngine:
         meta = {"op": "decode", "active": int(sum(
             r is not None and not r.kill for r in slot_reqs)),
             "request_ids": rids}
+        tids = [r.trace.trace_id for r in slot_reqs
+                if r is not None and r.trace is not None]
+        if tids:
+            # a wedged decode step's stall dump names every resident
+            # request's trace
+            meta["trace_ids"] = tids
         state = self._state
 
         def call():
@@ -809,7 +880,12 @@ class DecodeEngine:
                         now - req.last_token_t)
                 req.last_token_t = now
                 emitted += 1
+                if req.trace is not None:
+                    req.trace.annotate(req.dspan, "token",
+                                       n=len(req.tokens))
                 if not still[i]:
+                    if req.trace is not None:
+                        req.trace.end(req.dspan)
                     self._resolve_ok(req, now)
             if not still[i]:
                 with self._lock:
@@ -847,8 +923,16 @@ class DecodeEngine:
     # -- observability --------------------------------------------------
     def emit_telemetry(self):
         """Push the freshest kind="serving" decode record onto the
-        telemetry JSONL stream (no-op while telemetry is off)."""
-        return _mon().record_serving(self.stats.to_record())
+        telemetry JSONL stream (no-op while telemetry is off).  With
+        request tracing on, the record carries the label's
+        attribution/SLO summary."""
+        rec = self.stats.to_record()
+        store = _tracing().get()
+        if store.enabled:
+            s = store.summary(self.config.label)
+            if s is not None:
+                rec["tracing"] = s
+        return _mon().record_serving(rec)
 
     def summary(self):
         return self.stats.summary()

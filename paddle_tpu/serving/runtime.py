@@ -158,10 +158,11 @@ class ServingFuture:
 
 class _Request:
     __slots__ = ("prepared", "rows", "enqueue_t", "enqueue_pc_ns",
-                 "deadline", "budget_s", "future", "rid")
+                 "deadline", "budget_s", "future", "rid", "trace",
+                 "qspan")
 
     def __init__(self, prepared, rows, enqueue_t, deadline, budget_s,
-                 rid):
+                 rid, trace=None):
         self.prepared = prepared
         self.rows = rows
         self.enqueue_t = enqueue_t
@@ -170,6 +171,11 @@ class _Request:
         self.budget_s = budget_s
         self.future = ServingFuture()
         self.rid = rid
+        # request-scoped trace context (monitor/tracing.py); None when
+        # FLAGS_request_tracing is off — every touch downstream guards
+        # on that None, so the off path is one attribute read
+        self.trace = trace
+        self.qspan = None
 
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
@@ -179,6 +185,12 @@ def _fr():
     from ..monitor import flight_recorder
 
     return flight_recorder
+
+
+def _tracing():
+    from ..monitor import tracing
+
+    return tracing
 
 
 def _mon():
@@ -287,23 +299,39 @@ class ServingRuntime:
         return False
 
     # -- admission ------------------------------------------------------
-    def submit(self, feed, deadline_s=None):
+    def submit(self, feed, deadline_s=None, traceparent=None):
         """Enqueue one request; returns a ServingFuture.  Raises
         synchronously on validation errors (bad feed), backpressure
         (QueueFullError) and a closed runtime — admission failures are
         the CALLER's bug or the CALLER's signal to back off, so they
-        never consume queue budget."""
+        never consume queue budget.
+
+        `traceparent` is an optional W3C trace-context header from the
+        external caller; with FLAGS_request_tracing on, the request's
+        span tree joins that trace instead of starting a fresh one."""
         if self._closed:
             raise ServingClosedError("serving runtime closed")
         prepared, rows = self.dispatcher.prepare(feed)
         budget = deadline_s if deadline_s is not None \
             else self.config.default_deadline_s
         now = self.config.clock()
+        # None when tracing is off: start_request is the only flag
+        # probe on the submit path, and the dispatch path never probes
+        trace = _tracing().get().start_request(
+            f"serving.request/{self.config.label}",
+            label=self.config.label, traceparent=traceparent,
+            attrs={"rows": rows})
         with self._cond:
             if self._closed:
+                if trace is not None:
+                    trace.finish("cancelled")
                 raise ServingClosedError("serving runtime closed")
             if len(self._queue) >= self.config.max_queue_depth:
                 self.stats.note_outcome("rejected")
+                if trace is not None:
+                    trace.annotate(trace.root, "rejected: queue full",
+                                   depth=len(self._queue))
+                    trace.finish("rejected")
                 _fr().note_event("serving_rejected",
                                  label=self.config.label,
                                  depth=len(self._queue))
@@ -314,7 +342,10 @@ class ServingRuntime:
             self._rid += 1
             req = _Request(prepared, rows, now,
                            now + budget if budget else None, budget,
-                           self._rid)
+                           self._rid, trace=trace)
+            if trace is not None:
+                trace.rid = req.rid
+                req.qspan = trace.child("queue", "queue")
             self._queue.append(req)
             self._live.add(req)
             # counted INSIDE the lock: a dispatch resolving this
@@ -324,9 +355,11 @@ class ServingRuntime:
             self._cond.notify()
         return req.future
 
-    def run(self, feed, deadline_s=None, timeout=None):
+    def run(self, feed, deadline_s=None, timeout=None,
+            traceparent=None):
         """Blocking convenience: submit + result."""
-        return self.submit(feed, deadline_s=deadline_s).result(
+        return self.submit(feed, deadline_s=deadline_s,
+                           traceparent=traceparent).result(
             timeout=timeout)
 
     # -- batching -------------------------------------------------------
@@ -460,6 +493,7 @@ class ServingRuntime:
         self.stats.note_outcome("completed",
                                 latency_s=now - req.enqueue_t)
         self._request_span(req, "ok")
+        self._finish_trace(req, "completed")
         return True
 
     def _resolve_error(self, req, exc, outcome):
@@ -468,7 +502,16 @@ class ServingRuntime:
         self._live.discard(req)
         self.stats.note_outcome(outcome)
         self._request_span(req, outcome)
+        self._finish_trace(req, outcome)
         return True
+
+    def _finish_trace(self, req, outcome):
+        """Close the request's span tree with its ledger outcome.
+        Called ONLY from the two _resolve_* terminal points (which are
+        idempotent), so the trace-outcome multiset reconciles with the
+        outcome ledger by construction."""
+        if req.trace is not None:
+            req.trace.finish(outcome)
 
     def _note_serving(self):
         fr = _fr()
@@ -477,12 +520,26 @@ class ServingRuntime:
 
     def emit_telemetry(self):
         """Write the current kind="serving" record onto the telemetry
-        JSONL stream (no-op while telemetry is off)."""
-        return _mon().record_serving(self.stats.to_record())
+        JSONL stream (no-op while telemetry is off).  With request
+        tracing on, the record carries the label's attribution/SLO
+        summary."""
+        rec = self.stats.to_record()
+        store = _tracing().get()
+        if store.enabled:
+            s = store.summary(self.config.label)
+            if s is not None:
+                rec["tracing"] = s
+        return _mon().record_serving(rec)
 
     # -- dispatch -------------------------------------------------------
     def _dispatch_batch(self, batch, rows):
         bucket = pick_bucket(self.dispatcher.buckets, rows)
+        for r in batch:
+            if r.trace is not None:
+                r.trace.end(r.qspan)
+                r.trace.annotate(r.trace.root, "batch_join",
+                                 bucket=bucket, rows=rows,
+                                 requests=len(batch))
         if not self.breaker.allow():
             self._degraded_serve(batch)
             return
@@ -491,6 +548,11 @@ class ServingRuntime:
         meta = {"bucket": bucket, "rows": rows,
                 "requests": len(batch),
                 "request_ids": [r.rid for r in batch]}
+        tids = [r.trace.trace_id for r in batch if r.trace is not None]
+        if tids:
+            # carried in the watchdog meta: a stall escalation's
+            # flight dump names the wedged requests' traces
+            meta["trace_ids"] = tids
         outcome = self._dispatch_guarded(merged, bucket, batch, slices,
                                          meta, final_attempt=False)
         if outcome == "cancel_retry":
@@ -525,6 +587,39 @@ class ServingRuntime:
         token, stalled = self.watchdog.track(meta)
         done = threading.Event()
         box = {}
+        # per-request dispatch-attempt spans (None-trace requests pay
+        # one attribute read and are skipped — the gate-free contract)
+        attempt = 2 if final_attempt else 1
+        tspans = [(r, r.trace.child(f"dispatch/b{bucket}", "dispatch",
+                                    attrs={"bucket": bucket,
+                                           "attempt": attempt}))
+                  for r in batch if r.trace is not None]
+        rspans = {}
+
+        def _close_attempt(outcome, category=None):
+            for r, ds in tspans:
+                sp = rspans.pop(r, None)
+                if sp is not None:
+                    r.trace.end(sp)
+                if ds is not None:
+                    if category is not None:
+                        r.trace.recategorize(ds, category)
+                    r.trace.end(ds, outcome=outcome)
+
+        def _note_retry(*_a):
+            self.stats.note_retry()
+            # the remainder of this attempt (backoff + re-dispatch) is
+            # retry-caused latency: charge it to "retry", one level
+            # under the dispatch span
+            for r, ds in tspans:
+                if ds is None:
+                    continue
+                prev = rspans.pop(r, None)
+                if prev is not None:
+                    r.trace.end(prev)
+                sp = r.trace.child("retry", "retry", parent=ds)
+                if sp is not None:
+                    rspans[r] = sp
 
         def call():
             prof = _profiler()
@@ -546,7 +641,7 @@ class ServingRuntime:
                 if cfg.retry_policy is not None:
                     box["outs"] = call_with_retry(
                         _dispatch, cfg.retry_policy,
-                        on_retry=lambda *a: self.stats.note_retry())
+                        on_retry=_note_retry)
                 else:
                     box["outs"] = _dispatch()
             except BaseException as e:  # noqa: BLE001
@@ -576,16 +671,22 @@ class ServingRuntime:
                             "expired")
                 if all(r.future.done() for r in batch):
                     # nobody is waiting for this result anymore
+                    _close_attempt("abandoned")
                     return "abandoned"
                 if stalled.is_set():
                     if cfg.watchdog_policy == "cancel_retry" \
                             and not final_attempt:
+                        # the wedged attempt's wall time is STALL, not
+                        # dispatch — the fresh attempt gets its own
+                        # span on the SAME trace
+                        _close_attempt("cancel_retry", category="stall")
                         return "cancel_retry"
                     stall = WatchdogStall(
                         f"serving dispatch watchdog stall: batch "
                         f"(bucket {bucket}, {meta['rows']} rows) in "
                         f"flight > {cfg.watchdog_stall_s}s")
                     self.breaker.note_failure(stall)
+                    _close_attempt("stalled", category="stall")
                     for r in batch:
                         self._resolve_error(r, stall, "stalled")
                     return "stalled"
@@ -598,12 +699,15 @@ class ServingRuntime:
             _fr().note_event(
                 "serving_dispatch_failed", label=cfg.label,
                 error=f"{type(e).__name__}: {e}"[:200], **{
-                    k: v for k, v in meta.items() if k != "request_ids"})
+                    k: v for k, v in meta.items()
+                    if k not in ("request_ids", "trace_ids")})
+            _close_attempt("failed")
             for r in batch:
                 self._resolve_error(r, e, "failed")
             return "failed"
         self.breaker.note_success()
         self.stats.note_batch(bucket, meta["rows"])
+        _close_attempt("ok")
         for r, outs in zip(batch, self.dispatcher.split(box["outs"],
                                                         slices)):
             self._resolve_ok(r, outs)
@@ -622,6 +726,9 @@ class ServingRuntime:
         for req in batch:
             if req.future.done():
                 continue
+            if req.trace is not None:
+                req.trace.annotate(req.trace.root, "breaker_open",
+                                   mode=mode)
             now = cfg.clock()
             if req.expired(now):
                 elapsed = now - req.enqueue_t
@@ -641,6 +748,8 @@ class ServingRuntime:
                         f"fast"),
                     "failed")
                 continue
+            dspan = req.trace.child(f"degraded/{mode}", "degraded") \
+                if req.trace is not None else None
             try:
                 if mode == "eager":
                     outs = self.dispatcher.dispatch_eager(req.prepared)
@@ -656,8 +765,12 @@ class ServingRuntime:
                         slices)[0]
                     self.stats.note_batch(bucket, req.rows,
                                           degraded=True)
+                if dspan is not None:
+                    req.trace.end(dspan, outcome="ok")
                 self._resolve_ok(req, outs)
             except Exception as e:  # noqa: BLE001
+                if dspan is not None:
+                    req.trace.end(dspan, outcome="failed")
                 self._resolve_error(req, e, "failed")
 
     # -- reading --------------------------------------------------------

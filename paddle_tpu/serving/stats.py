@@ -13,6 +13,14 @@ Latency percentiles are EXACT nearest-rank over the recorded samples
 histogram buckets, no interpolation — the smoke row recomputes p99
 from the raw samples and asserts equality with the table's number.
 
+WINDOW SEMANTICS: the sample rings are bounded (`deque(maxlen=8192)`),
+so under long traffic the oldest samples fall out — percentiles are
+exact over the NEWEST <= 8192 samples, a sliding window, not the full
+run.  Evictions are counted (`samples_dropped` in the latency tables
+and the serving record), so a reader can tell a complete distribution
+from a windowed one instead of being silently lied to.  The outcome
+LEDGER is never windowed — counts are cumulative forever.
+
 Counters are double-booked like the flight recorder's: gate-free local
 fields (the serving table must work with telemetry off) plus
 `resilience.*`/`serving.*` monitor counters while telemetry is on.
@@ -72,6 +80,7 @@ class ServingStats:
         self.watchdog_stalls = 0
         self.cancel_retries = 0
         self._samples = collections.deque(maxlen=_SAMPLE_CAP)
+        self.samples_dropped = 0      # ring evictions (window honesty)
         self._buckets = {}            # bucket size -> dispatch count
         self._breaker = None          # CircuitBreaker, set by runtime
         self._watchdog = None         # HangWatchdog, set by watchdog
@@ -123,6 +132,8 @@ class ServingStats:
             if outcome == "rejected":
                 self.requests += 1
             if latency_s is not None:
+                if len(self._samples) == self._samples.maxlen:
+                    self.samples_dropped += 1
                 self._samples.append(float(latency_s))
         mon = _mon()
         if mon.is_enabled():
@@ -180,17 +191,24 @@ class ServingStats:
             return list(self._samples)
 
     def latency(self):
-        """Exact latency stats over the recorded end-to-end samples."""
-        s = sorted(self.samples())
+        """Exact latency stats over the recorded end-to-end samples —
+        the newest <= maxlen window (see module docstring); the
+        `samples_dropped` field counts what the window evicted."""
+        with self._lock:
+            dropped = self.samples_dropped
+            s = sorted(self._samples)
         if not s:
             return None
-        return {
+        out = {
             "count": len(s),
             "mean_ms": round(sum(s) / len(s) * 1e3, 3),
             "p50_ms": round(exact_percentile(s, 0.50) * 1e3, 3),
             "p99_ms": round(exact_percentile(s, 0.99) * 1e3, 3),
             "max_ms": round(s[-1] * 1e3, 3),
         }
+        if dropped:
+            out["samples_dropped"] = dropped
+        return out
 
     def summary(self):
         """json-safe serving-table row: outcomes, invariant check,
@@ -254,7 +272,9 @@ class DecodeStats(ServingStats):
         self.decode_steps = 0
         self._occupancy_sum = 0.0      # sum of active/slots per step
         self._ttft = collections.deque(maxlen=_SAMPLE_CAP)
+        self.ttft_dropped = 0
         self._tok_lat = collections.deque(maxlen=_SAMPLE_CAP)
+        self.tok_lat_dropped = 0
         self._first_t = None           # first/last token wall-clock
         self._last_t = None            # (engine clock) for tokens/s
 
@@ -265,6 +285,8 @@ class DecodeStats(ServingStats):
         with self._lock:
             self.prefill_steps += 1
             if ttft_s is not None:
+                if len(self._ttft) == self._ttft.maxlen:
+                    self.ttft_dropped += 1
                 self._ttft.append(float(ttft_s))
             if now is not None:
                 if self._first_t is None:
@@ -295,20 +317,25 @@ class DecodeStats(ServingStats):
 
     def note_token_latency(self, latency_s):
         with self._lock:
+            if len(self._tok_lat) == self._tok_lat.maxlen:
+                self.tok_lat_dropped += 1
             self._tok_lat.append(float(latency_s))
 
     # -- reading --------------------------------------------------------
-    def _percentiles(self, ring):
+    def _percentiles(self, ring, dropped=0):
         s = sorted(ring)
         if not s:
             return None
-        return {
+        out = {
             "count": len(s),
             "mean_ms": round(sum(s) / len(s) * 1e3, 3),
             "p50_ms": round(exact_percentile(s, 0.50) * 1e3, 3),
             "p99_ms": round(exact_percentile(s, 0.99) * 1e3, 3),
             "max_ms": round(s[-1] * 1e3, 3),
         }
+        if dropped:
+            out["samples_dropped"] = dropped
+        return out
 
     def ttft_samples(self):
         with self._lock:
@@ -334,13 +361,15 @@ class DecodeStats(ServingStats):
                     if self._first_t is not None
                     and self._last_t is not None else None)
             ttft_ring = list(self._ttft)
+            ttft_dropped = self.ttft_dropped
             tok_ring = list(self._tok_lat)
+            tok_dropped = self.tok_lat_dropped
         if span and span > 0:
             out["tokens_per_s"] = round(out["tokens_total"] / span, 2)
-        ttft = self._percentiles(ttft_ring)
+        ttft = self._percentiles(ttft_ring, dropped=ttft_dropped)
         if ttft:
             out["ttft"] = ttft
-        tok = self._percentiles(tok_ring)
+        tok = self._percentiles(tok_ring, dropped=tok_dropped)
         if tok:
             out["token_latency"] = tok
         return out

@@ -9,8 +9,12 @@ registry, and any entry older than `stall_s`:
 
 1. gets a flight-recorder post-mortem dump NOW (reason
    "serving_stall", carrying the in-flight batch's metadata — bucket,
-   rows, request ids, elapsed — plus the usual last-K window), because
-   a process wedged hard enough may never reach another dump point;
+   rows, request ids, and, with request tracing on, the wedged
+   requests' trace_ids, elapsed — plus the usual last-K window),
+   because a process wedged hard enough may never reach another dump
+   point.  The trace_ids in the stall event join against the dump's
+   kind="trace" / "trace_active" lines, so the post-mortem names the
+   wedged requests' span trees, not just their count;
 2. bumps `resilience.watchdog_stalls`;
 3. has its `stalled` event set — the dispatch's WAITER escalates per
    policy (fail the batch with a classified WatchdogStall, or abandon

@@ -283,6 +283,45 @@ def test_decode_serving_smoke_in_suite_and_standalone():
 
 
 # ---------------------------------------------------------------------------
+# request_tracing_smoke chaos row (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_request_tracing_smoke_in_suite_and_standalone():
+    """The request-tracing chaos row is wired into the suite AND the
+    standalone argv entry (the tracing behaviors themselves are
+    covered end-to-end by tests/test_request_tracing.py; re-running
+    the whole row here would pay its compiles twice per CI run for no
+    new signal)."""
+    src = open(bench.__file__).read()
+    assert '("request_tracing_smoke", "request_tracing_smoke"' in src
+    assert '"request_tracing_smoke" in sys.argv[1:]' in src
+    assert "main_request_tracing_smoke" in src
+
+
+def test_request_tracing_smoke_row_shape():
+    """The smoke row's check list carries every acceptance pillar of
+    ISSUE 18: orphan-free span trees, exact integer-ns attribution
+    (trees AND table rows), ledger reconciliation, external
+    traceparent join, the injected stall landing in the stall
+    component, violator exemplar retention under zero sampling, the
+    SLO Prometheus families, and the tracing-off gate-free dispatch
+    guard."""
+    src = open(bench.__file__).read()
+    for check in ("zero_silently_lost", "all_completed",
+                  "trees_orphan_free", "attribution_exact_trees",
+                  "attribution_exact_rows", "ledger_reconciles",
+                  "external_trace_joined", "stall_attributed",
+                  "violator_exemplar_retained", "slo_families_exported",
+                  "trace_records_on_stream",
+                  "serving_record_carries_tracing",
+                  "chrome_trace_request_tracks",
+                  "report_renders_tracing_section",
+                  "tracing_off_gate_free"):
+        assert f'"{check}"' in src, check
+
+
+# ---------------------------------------------------------------------------
 # numerics_lint_smoke row (ISSUE 15 satellite)
 # ---------------------------------------------------------------------------
 

@@ -20,8 +20,14 @@ zero-silent-loss invariant, exact latency percentiles, shed/breaker/
 watchdog event counts per runtime label), and a graph-optimizer
 section (from the kind="pass_pipeline" records: ops removed and
 per-pass wall time per program key, plus the dp gradient-bucketing
-notes — buckets formed, sparse fallbacks) — without touching the
-process that produced the file.
+notes — buckets formed, sparse fallbacks), and a tracing section
+(ISSUE 18: from the kind="trace" span trees the request tracer
+retains and the "tracing" rollup embedded in kind="serving" records —
+per-label SLO attainment and burn rate, the p99 request's exact
+tail-latency attribution, and the top slowest traces with their
+dominant component; flight dumps carry the same record shapes, so a
+post-mortem reads identically) — without touching the process that
+produced the file.
 
 Fleet mode (ISSUE 10): every line a rank writes is stamped with
 ``{host, process_index}`` (monitor.fleet.rank_tag), so N per-rank
@@ -101,6 +107,9 @@ def summarize(records):
     serving = _serving_section(records)
     if serving:
         out["serving"] = serving
+    tracing = _tracing_section(records)
+    if tracing:
+        out["tracing"] = tracing
     pass_rows = _passes_section(records)
     if pass_rows:
         out["passes"] = pass_rows
@@ -331,6 +340,100 @@ def _serving_section(records):
             entry["decode"] = dblock
         progs[k] = entry
     out["by_runtime"] = progs
+    return out
+
+
+def _dominant_component(components_ns):
+    """The component that owns the largest share of a trace's wall
+    time — ties break alphabetically so reports are deterministic."""
+    if not components_ns:
+        return None
+    return max(components_ns.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
+
+def _tracing_section(records, top=5):
+    """Request-tracing summary (ISSUE 18) from the two shapes the
+    tracer emits: the per-label "tracing" rollup embedded in
+    kind="serving" records (SLO attainment + the p50/p99 requests'
+    exact attribution, as the store computed them) and the
+    kind="trace" span trees themselves (one per retained request —
+    live streams and flight dumps carry the same shape, so this reads
+    a post-mortem exactly like a live capture).  Newest rollup per
+    label wins; trees dedupe by trace_id (a flight dump re-emits the
+    retained window, and a fleet merge may carry one trace's record
+    from several rank streams — last wins, the shapes agree)."""
+    rollups = {}
+    for r in records:
+        if r.get("kind") == "serving" and r.get("tracing"):
+            t = r["tracing"]
+            rollups[t.get("label", r.get("key"))] = t
+    trees = {}
+    for r in records:
+        if r.get("kind") == "trace" and r.get("trace_id"):
+            trees[r["trace_id"]] = r
+    if not rollups and not trees:
+        return None
+    out = {}
+    labels = {}
+    for lb, t in sorted(rollups.items()):
+        entry = {"finished": t.get("finished", 0)}
+        if t.get("active"):
+            # nonzero in a close-time record means an unresolved
+            # request; mid-flight records (a stall dump) legitimately
+            # show the wedged batch here — same reading as UNRESOLVED
+            # in the serving section
+            entry["active"] = t["active"]
+        for k in ("rows_dropped", "trees_dropped"):
+            if t.get(k):
+                entry[k] = t[k]
+        slo = t.get("slo")
+        if slo and slo.get("slo_ms", 0) > 0:
+            entry["slo"] = {
+                "slo_ms": slo["slo_ms"],
+                "violations": slo.get("violations_total", 0),
+                "eligible": slo.get("eligible", 0),
+                "attainment": round(slo.get("attainment", 1.0), 4),
+                "burn_rate": round(slo.get("burn_rate", 0.0), 4),
+            }
+        attr = t.get("attribution")
+        if attr and attr.get("p99"):
+            # the p99 row is ONE actual request's decomposition — the
+            # ms values re-derive from that trace's raw spans with
+            # integer-ns equality, not from averaged buckets
+            p99 = attr["p99"]
+            entry["p99_ms"] = round(p99["total_ns"] / 1e6, 3)
+            entry["p99_breakdown_ms"] = {
+                c: round(ns / 1e6, 3)
+                for c, ns in sorted(
+                    p99.get("components_ns", {}).items(),
+                    key=lambda kv: (-kv[1], kv[0])) if ns}
+            dom = _dominant_component(p99.get("components_ns"))
+            if dom:
+                entry["p99_dominant"] = dom
+        labels[lb] = entry
+    if labels:
+        out["by_label"] = labels
+    if trees:
+        out["trees"] = len(trees)
+        rows = sorted(trees.values(),
+                      key=lambda t: -(t.get("total_ns") or 0))[:top]
+        slowest = []
+        for t in rows:
+            row = {
+                "trace": t["trace_id"][:8],
+                "label": t.get("label"),
+                "outcome": t.get("outcome"),
+                "total_ms": round((t.get("total_ns") or 0) / 1e6, 3),
+            }
+            dom = _dominant_component(t.get("components_ns"))
+            if dom:
+                row["dominant"] = dom
+                row["dominant_ms"] = round(
+                    t["components_ns"][dom] / 1e6, 3)
+            if t.get("violation"):
+                row["violation"] = True
+            slowest.append(row)
+        out["slowest"] = slowest
     return out
 
 
@@ -594,6 +697,41 @@ def summarize_fleet(by_rank, merged):
     topo = _elastic_section(merged)
     if topo:
         out["elastic_topology"] = topo
+    tracing = _tracing_section(merged)
+    if tracing:
+        # join spans by trace id across the rank streams (ISSUE 18): a
+        # request that hopped processes — traceparent propagated from a
+        # client rank into a serving rank — appears as fragments
+        # sharing one trace_id; merge their span lists into one tree
+        # per trace so the fleet view shows the request end to end, not
+        # N disjoint pieces
+        frags = {}
+        for label, records in by_rank.items():
+            for r in records:
+                if r.get("kind") == "trace" and r.get("trace_id"):
+                    frags.setdefault(r["trace_id"], {})[label] = r
+        cross = []
+        for tid, by in sorted(frags.items()):
+            if len(by) < 2:
+                continue
+            spans = []
+            seen = set()
+            for label in sorted(by):
+                for s in by[label].get("spans", ()):
+                    if s.get("span_id") in seen:
+                        continue
+                    seen.add(s.get("span_id"))
+                    spans.append(dict(s, rank=label))
+            spans.sort(key=lambda s: (s.get("start_ns") or 0))
+            cross.append({
+                "trace": tid[:8],
+                "ranks": sorted(by),
+                "spans": len(spans),
+                "span_names": [s.get("name") for s in spans[:8]],
+            })
+        if cross:
+            tracing["cross_rank_traces"] = cross
+        out["tracing"] = tracing
     ooms = [{"rank": _rank_label(r),
              "error": (r.get("error") or "")[:120]}
             for r in merged if r.get("kind") == "oom"]
