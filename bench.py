@@ -3891,6 +3891,286 @@ def main_elastic_fleet_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_fleet_serving_smoke(on_tpu, peak):
+    """Fleet-serving chaos row (ISSUE 19 CI satellite): a REAL fleet —
+    a versioned registry, N=2 replica serving SUBPROCESSES
+    (``python -m paddle_tpu.serving.replica``), and a health-gated
+    FleetRouter in this process — driven through the full robustness
+    arc:
+
+    - one replica is armed to DIE mid-request (``os._exit(1)`` at the
+      ``replica.infer`` kill point): the router classifies the reset
+      socket as failover-class and the request COMPLETES on the
+      survivor — the caller never sees the death, and the kill
+      verifiably fired (the worker exits 1);
+    - the model version rolls v1 -> v2 -> v1 DURING traffic (zero-drop
+      hot-swap: warm-then-flip-then-drain), and the rolled-back fleet
+      predicts bitwise-identically to its pre-roll self;
+    - zero silent losses, asserted via the merged outcome ledger —
+      requests == sum(outcomes) across router + live replicas, and
+      every route attempt the router ever STARTED is resolved (which
+      covers the replica that died holding its ledger);
+    - the AOT cold-start cache works end to end: the registry's
+      artifacts are seeded once in-process, and every subprocess
+      replica reaches first byte — across BOTH versions of the roll —
+      with ZERO serving compile-ledger events (``aot_imported`` > 0);
+    - router-hop spans JOIN replica spans by trace id: the router's
+      retained trees and the survivor's ``/trace`` trees share ids
+      (the traceparent the router forwards is honored end to end).
+    """
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.monitor import tracing
+    from paddle_tpu.serving import FleetRouter, ModelHost, ModelRegistry
+
+    monitor.reset()
+    monitor.enable()
+    old_tracing = fluid.get_flags("FLAGS_request_tracing")
+    fluid.set_flags({"FLAGS_request_tracing": True})
+    tracing.get().reset()
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_fleet_srv_")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    checks = {}
+    procs = []
+    router = None
+    try:
+        # ---- registry with two published versions ------------------
+        def build(hidden, d):
+            with fluid.unique_name.guard():
+                main, startup = fluid.Program(), fluid.Program()
+                with fluid.program_guard(main, startup):
+                    x = fluid.data("x", [None, 8])
+                    h = fluid.layers.fc(x, hidden, act="relu")
+                    out = fluid.layers.fc(h, 4, act="softmax")
+            exe = fluid.Executor()
+            exe.run(startup)
+            fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                          main_program=main)
+            return d
+
+        reg = ModelRegistry(os.path.join(tmp, "registry"))
+        v1 = reg.publish(build(16, os.path.join(tmp, "model_a")))
+        v2 = reg.publish(build(8, os.path.join(tmp, "model_b")))
+        reg.set_current(v1)
+        host_kw = {"max_batch_size": 4, "batch_window_s": 0.0}
+
+        # ---- seed the AOT cache for BOTH versions ------------------
+        # (one in-process warm each publishes the per-bucket artifacts
+        # every subprocess replica then cold-starts from)
+        seeded = 0
+        for v in (v1, v2):
+            seed_host = ModelHost(reg, name=f"seed_v{v}",
+                                  config_kw=dict(host_kw))
+            seed_host.start(v)
+            seeded += seed_host.aot_exported
+            seed_host.close()
+        aot_available = seeded > 0       # jax.export may be absent
+
+        # ---- launch the replica fleet (r0 armed to die) ------------
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   FLAGS_request_tracing="1",
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        endpoints = []
+        for i, kill in ((0, "replica.infer:2"), (1, None)):
+            ep = os.path.join(tmp, f"ep{i}.json")
+            cmd = [sys.executable, "-m", "paddle_tpu.serving.replica",
+                   "--registry", reg.root, "--name", f"r{i}",
+                   "--endpoint-file", ep, "--max-batch", "4",
+                   "--telemetry",
+                   os.path.join(tmp, f"telemetry_r{i}.jsonl")]
+            if kill:
+                cmd += ["--kill-point", kill]
+            log = open(os.path.join(tmp, f"r{i}.log"), "w")
+            procs.append((subprocess.Popen(cmd, env=env, stdout=log,
+                                           stderr=log), log))
+            endpoints.append(ep)
+        deadline = time.time() + 180
+        eps = []
+        for ep in endpoints:
+            while not os.path.isfile(ep) and time.time() < deadline:
+                time.sleep(0.2)
+            if os.path.isfile(ep):
+                with open(ep) as f:
+                    eps.append(json.load(f))
+        checks["replicas_started"] = (
+            len(eps) == 2 and all(e.get("version") == v1 for e in eps))
+
+        router = FleetRouter(
+            [(e["name"], e["host"], e["port"]) for e in eps],
+            label="fleet_smoke", health_poll_s=0.2,
+            request_timeout_s=30.0)
+        rng = np.random.default_rng(0)
+        fixed = {"x": rng.standard_normal((2, 8)).astype(np.float32)}
+
+        def feed(i):
+            return {"x": np.random.default_rng(i)
+                    .standard_normal((1, 8)).astype(np.float32)}
+
+        # ---- phase 1: traffic until the armed kill fires -----------
+        # r0 dies on its 3rd /infer (0-based hit 2); round-robin gets
+        # it there within a handful of requests.  EVERY request must
+        # complete — the failover absorbs the death.
+        sent = 0
+        errors = []
+        while router.failovers == 0 and sent < 30:
+            try:
+                router.run(feed(sent))
+            except Exception as e:  # noqa: BLE001 — chaos verdict
+                errors.append(repr(e))
+            sent += 1
+        checks["failover_absorbed"] = (
+            router.failovers >= 1 and not errors
+            and router.stats.summary()["outcomes"]["completed"] == sent)
+        kill_rc = procs[0][0].wait(timeout=60)
+        checks["kill_fired"] = kill_rc == 1
+        for _ in range(4):               # declare r0 dead, not stale
+            router.poll_once()
+        checks["dead_replica_gated"] = any(
+            r.dead for r in router.replicas if r.name == "r0")
+
+        # ---- phase 2: roll v1 -> v2 -> v1 under traffic ------------
+        before = [np.asarray(o) for o in router.run(fixed)]
+        stop = threading.Event()
+        bg = {"completed": 0, "errors": []}
+
+        def traffic():
+            i = 1000
+            while not stop.is_set():
+                try:
+                    router.run(feed(i))
+                    bg["completed"] += 1
+                except Exception as e:  # noqa: BLE001 — chaos verdict
+                    bg["errors"].append(repr(e))
+                i += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        try:
+            roll_fwd = router.roll(v2)
+            reg.set_current(v2)
+            on_v2 = [np.asarray(o) for o in router.run(fixed)]
+            roll_back = router.roll(v1)
+            reg.set_current(v1)
+            after = [np.asarray(o) for o in router.run(fixed)]
+        finally:
+            stop.set()
+            t.join(timeout=60)
+        live = [e["name"] for e in eps if e["name"] != "r0"]
+        checks["roll_applied_to_live_fleet"] = (
+            all(roll_fwd[n].get("version") == v2 for n in live)
+            and all(roll_back[n].get("version") == v1 for n in live))
+        checks["roll_forward_back_bitwise"] = (
+            any(not np.array_equal(a, b)
+                for a, b in zip(before, on_v2))
+            and all(np.array_equal(a, b)
+                    for a, b in zip(before, after)))
+        checks["zero_drop_during_roll"] = (
+            bg["completed"] > 0 and not bg["errors"])
+
+        # ---- zero silent losses: the merged ledger identity --------
+        router.poll_once()
+        ledger = router.fleet_ledger()
+        merged = ledger["merged"]
+        checks["ledger_identity"] = (
+            merged["requests"] == merged["resolved"]
+            and merged["unaccounted"] == 0)
+        checks["attempts_all_resolved"] = (
+            ledger["attempts"]["started"] > 0
+            and ledger["attempts"]["unaccounted"] == 0)
+
+        # ---- AOT cold start: zero compiles across BOTH versions ----
+        survivor = [r for r in router.replicas if r.name != "r0"][0]
+        stats = survivor.last_stats or {}
+        checks["aot_cold_start_zero_compiles"] = (not aot_available) or (
+            stats.get("aot_imported", 0) > 0
+            and stats.get("serving_compile_events", -1) == 0
+            and stats.get("swaps", 0) == 2)
+
+        # ---- trace join: router-hop + replica spans, one trace id --
+        router_trees = tracing.get().retained_trees(label="fleet_smoke")
+        router_ids = {tr["trace_id"] for tr in router_trees}
+        import http.client as _hc
+
+        conn = _hc.HTTPConnection(survivor.host, survivor.port,
+                                  timeout=10)
+        try:
+            conn.request("GET", "/trace")
+            replica_trees = json.loads(
+                conn.getresponse().read())["trees"]
+        finally:
+            conn.close()
+        replica_ids = {tr["trace_id"] for tr in replica_trees}
+        joined = router_ids & replica_ids
+        checks["trace_joined_across_hop"] = (
+            len(joined) > 0
+            and any("route:" in (s.get("name") or "")
+                    for tr in router_trees
+                    if tr["trace_id"] in joined
+                    for s in tr["spans"]))
+
+        router.emit_telemetry()
+        checks = {k: bool(v) for k, v in checks.items()}
+        details = {"sent_phase1": sent, "failovers": router.failovers,
+                   "bg_completed": bg["completed"],
+                   "merged": merged, "attempts": ledger["attempts"],
+                   "aot_seeded": seeded,
+                   "joined_traces": len(joined),
+                   "survivor_stats": {k: stats.get(k) for k in
+                                      ("aot_imported", "aot_exported",
+                                       "serving_compile_events",
+                                       "swaps", "version")}}
+        row = {"metric": "fleet_serving_smoke",
+               "value": int(all(checks.values())), "unit": "ok",
+               "vs_baseline": None, "replicas": 2,
+               "checks": checks, "details": details,
+               "telemetry": _telemetry_brief(monitor.snapshot())}
+        if not all(checks.values()):
+            row["error"] = "failed checks: " + ", ".join(
+                k for k, v in checks.items() if not v)
+        return row
+    finally:
+        if router is not None:
+            router.close(emit=False)
+        for p, log in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+            log.close()
+        fluid.set_flags({"FLAGS_request_tracing":
+                         old_tracing["FLAGS_request_tracing"]})
+        monitor.disable()
+        monitor.reset()
+
+
+def main_fleet_serving_smoke():
+    """`python bench.py fleet_serving_smoke` — CI/tooling entry: the
+    replica-kill/hot-swap/AOT fleet chaos row standalone, persisted to
+    BENCH_TPU.json under rows["fleet_serving_smoke"].  Exit 0 only
+    when every robustness check passes."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_fleet_serving_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["fleet_serving_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def main_serving_smoke():
     """`python bench.py serving_smoke` — CI/tooling entry: the serving
     chaos row standalone on a 2-device virtual CPU mesh, persisted to
@@ -4432,6 +4712,8 @@ def main():
         ("fleet_obs_smoke", "fleet_obs_smoke", bench_fleet_obs_smoke),
         ("elastic_fleet_smoke", "elastic_fleet_smoke",
          bench_elastic_fleet_smoke),
+        ("fleet_serving_smoke", "fleet_serving_smoke",
+         bench_fleet_serving_smoke),
         ("resnet_fused", "resnet50_fused_mfu", bench_resnet50_fused)]
 
     # SIGALRM only interrupts Python bytecode: a compile/RPC wedged
@@ -4526,4 +4808,6 @@ if __name__ == "__main__":
         sys.exit(main_fleet_obs_smoke())
     if "elastic_fleet_smoke" in sys.argv[1:]:
         sys.exit(main_elastic_fleet_smoke())
+    if "fleet_serving_smoke" in sys.argv[1:]:
+        sys.exit(main_fleet_serving_smoke())
     main()

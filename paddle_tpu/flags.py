@@ -312,6 +312,20 @@ declare_flag("telemetry_keep", 3,
              "How many rotated telemetry JSONL segments to keep "
              "(beyond the active one).")
 
+# Fleet serving tier (router + replicas).  Poll/failover knobs live in
+# flags so a deployment can retune them without code: a LAN fleet wants
+# sub-second health gating; a cross-zone one wants fewer, patient polls.
+declare_flag("fleet_health_poll_s", 0.5,
+             "FleetRouter health-poll interval in seconds (0 = no "
+             "background polling; call poll_once() manually).")
+declare_flag("fleet_failover_attempts", 2,
+             "How many ADDITIONAL replicas a request may fail over to "
+             "after its first attempt fails with a transient/"
+             "preemption-classified error.  Deadline and fatal "
+             "failures never fail over.")
+declare_flag("fleet_request_timeout_s", 30.0,
+             "Socket timeout for one router->replica request hop.")
+
 declare_flag("maxpool_mask_bwd", False,
              "Give max-pool a recompute-mask custom VJP (window passes "
              "+ shifted compares, all XLA-fusable) instead of the "

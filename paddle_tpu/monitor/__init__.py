@@ -57,6 +57,7 @@ __all__ = [
     "fleet", "exporter", "fleet_skew", "rank_info", "rank_tag",
     "record_fleet_skew", "fleet_skew_records",
     "record_elastic", "elastic_records",
+    "record_fleet_serving", "fleet_serving_records",
     "MetricsRegistry", "MetricsSession", "CompileLedger", "JsonlWriter",
     "read_jsonl", "Counter", "Gauge", "PEAK_FLOPS", "peak_flops",
     "parse_cost_analysis", "parse_memory_analysis",
@@ -86,6 +87,10 @@ _fleet_records = []
 # topology transitions, rank join/leave/death, policy decisions — the
 # topology history telemetry_report renders
 _elastic_records = []
+# kind="fleet_serving" records from the fleet router (ISSUE 19): the
+# merged router+replica outcome ledger, failover counts, per-replica
+# health/version — emitted at router close / on demand
+_fleet_serving_records = []
 # kind="trace" records from request tracing (ISSUE 18): each retained
 # span tree (SLO violators + head-sampled), emitted at trace finish
 _trace_records = []
@@ -134,6 +139,7 @@ def reset():
     del _pass_records[:]
     del _fleet_records[:]
     del _elastic_records[:]
+    del _fleet_serving_records[:]
     del _trace_records[:]
     tracing.get().reset()
 
@@ -301,6 +307,31 @@ def elastic_records():
     """kind="elastic" records seen since enable()/reset(), newest
     last."""
     return list(_elastic_records)
+
+
+def record_fleet_serving(record):
+    """Write one kind="fleet_serving" record (the FleetRouter's merged
+    outcome ledger + per-replica health/version/breaker view) onto the
+    telemetry JSONL stream and keep it addressable in-process
+    (fleet_serving_records()).  A no-op while telemetry is off — the
+    router's registered ServingStats still carries the live ledger."""
+    if not _enabled or not record:
+        return None
+    record = dict(record)
+    record.setdefault("kind", "fleet_serving")
+    import time as _time
+
+    record.setdefault("ts_us", _time.perf_counter_ns() / 1000.0)
+    record.setdefault("wall_time", _time.time())
+    _fleet_serving_records.append(record)
+    _session.emit_record(record)
+    return record
+
+
+def fleet_serving_records():
+    """kind="fleet_serving" records seen since enable()/reset(),
+    newest last."""
+    return list(_fleet_serving_records)
 
 
 def serving_table():

@@ -260,6 +260,45 @@ def prometheus_text():
                   kind="gauge")
     except Exception:
         pass
+    # fleet serving tier (ISSUE 19): per-router failover/unaccounted
+    # counters plus one labeled row per replica (health, version,
+    # breaker).  Reads ONLY the router's cached state — a scrape must
+    # never block on replica sockets.  Family-outer like every block.
+    try:
+        from ..serving import fleet as serving_fleet
+
+        routers = serving_fleet.router_table()
+        for r in routers:
+            _line(out, "fleet_failovers_total", r["failovers"],
+                  labels={"router": r["label"]}, kind="counter",
+                  help_="requests retried on a different replica after "
+                        "a transient/preemption-classified failure")
+        for r in routers:
+            _line(out, "fleet_attempts_unaccounted",
+                  r["attempts_unaccounted"],
+                  labels={"router": r["label"]}, kind="gauge",
+                  help_="route attempts started but never resolved — "
+                        "nonzero at quiesce means silent loss")
+        for r in routers:
+            for rep in r["replicas"]:
+                _line(out, "fleet_replica_healthy",
+                      0 if rep["dead"] else (1 if rep["healthy"] else 0),
+                      labels={"router": r["label"],
+                              "replica": rep["name"]}, kind="gauge")
+        for r in routers:
+            for rep in r["replicas"]:
+                if rep.get("version") is not None:
+                    _line(out, "fleet_replica_version", rep["version"],
+                          labels={"router": r["label"],
+                                  "replica": rep["name"]}, kind="gauge")
+        for r in routers:
+            for rep in r["replicas"]:
+                _line(out, "fleet_replica_breaker_open",
+                      1 if rep["breaker_open"] else 0,
+                      labels={"router": r["label"],
+                              "replica": rep["name"]}, kind="gauge")
+    except Exception:
+        pass
     return "\n".join(out["lines"]) + "\n"
 
 

@@ -25,13 +25,15 @@ monitor counters, so a test can assert the fault actually happened —
 a chaos test that silently injects nothing is worse than no test.
 """
 
+import os
 import threading
 
 from .taxonomy import InjectedCrash, InjectedTransientError
 
 __all__ = ["FaultPlan", "arm", "disarm", "active_plan", "is_armed",
            "plan_scope", "on_step_feed", "check_transient", "crash_point",
-           "stall_point", "InjectedTransientError", "InjectedCrash"]
+           "kill_point", "stall_point",
+           "InjectedTransientError", "InjectedCrash"]
 
 _lock = threading.Lock()
 _plan = None
@@ -55,6 +57,12 @@ class FaultPlan:
     transient_times:   how many raises total before succeeding (shared
                     budget across the scheduled steps)
     crash_points:   {point_name: nth_hit_to_fire} (0-based hit count)
+    kill_points:    {point_name: nth_hit_to_fire} like crash_points,
+                    but the PROCESS dies via os._exit(1) — a real
+                    SIGKILL-equivalent for multi-process chaos (the
+                    fleet replica kill, ISSUE 19): no exception, no
+                    handler, no atexit; the peer sees a dead socket.
+                    InjectedCrash stays the single-process simulation.
     stall_points:   {point_name: spec} latency/hang injection (ISSUE 8):
                     spec is a float (deterministic sleep of that many
                     seconds) or a threading.Event (block until the test
@@ -68,7 +76,7 @@ class FaultPlan:
 
     def __init__(self, nan_at_steps=(), nan_feed=None,
                  transient_at_step=None, transient_times=1,
-                 crash_points=None, stall_points=None):
+                 crash_points=None, kill_points=None, stall_points=None):
         self.nan_at_steps = set(int(s) for s in (
             nan_at_steps if not isinstance(nan_at_steps, int)
             else (nan_at_steps,)))
@@ -83,12 +91,15 @@ class FaultPlan:
         self.transient_remaining = int(transient_times)
         self.crash_points = dict(crash_points or {})
         self._crash_hits = {}
+        self.kill_points = dict(kill_points or {})
+        self._kill_hits = {}
         self.stall_points = {
             name: (spec if isinstance(spec, tuple) else (0, spec))
             for name, spec in (stall_points or {}).items()}
         self._stall_hits = {}
         self.step = 0
-        self.fired = {"nan": 0, "transient": 0, "crash": 0, "stall": 0}
+        self.fired = {"nan": 0, "transient": 0, "crash": 0, "kill": 0,
+                      "stall": 0}
 
     @property
     def transient_at_step(self):
@@ -250,6 +261,38 @@ def crash_point(name):
     fr.note_event("injected_crash", severe=True, point=name)
     fr.dump(f"injected_crash:{name}")
     raise InjectedCrash(f"injected crash at point {name!r}")
+
+
+def kill_point(name):
+    """Instrumented code calls this at its kill-vulnerable points (the
+    fleet replica worker's request path); a no-op unless an armed plan
+    schedules `name`.  On the scheduled visit (0-based hit count) the
+    PROCESS dies via ``os._exit(1)`` — the SIGKILL model the shm worker
+    established: no exception, no cleanup handler, no atexit hooks, no
+    flushed buffers; peers observe a reset socket, which is exactly the
+    failure shape the router's failover path must classify (ISSUE 19).
+    The counter/flight-recorder notes land BEFORE the exit (a real
+    SIGKILL can't note anything; the simulation records what it
+    interrupted — the same contract as crash_point's pre-raise dump)."""
+    p = _plan
+    if p is None or name not in p.kill_points:
+        return
+    with _lock:
+        if name not in p.kill_points:        # re-check under lock
+            return
+        hit = p._kill_hits.get(name, 0)
+        p._kill_hits[name] = hit + 1
+        if hit != p.kill_points[name]:
+            return
+        del p.kill_points[name]              # one-shot
+        p.fired["kill"] += 1
+    mon = _mon()
+    if mon.is_enabled():
+        mon.counter("resilience.injected_kill").add(1)
+    fr = _fr()
+    fr.note_event("injected_kill", severe=True, point=name)
+    fr.dump(f"injected_kill:{name}")
+    os._exit(1)
 
 
 def stall_point(name):

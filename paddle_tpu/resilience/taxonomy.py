@@ -25,6 +25,7 @@ import re
 
 __all__ = ["TRANSIENT", "FATAL", "DEADLINE", "PREEMPTION", "classify",
            "is_transient", "is_oom", "is_deadline", "is_preemption",
+           "is_failover",
            "DeadlineExceeded", "InjectedTransientError", "InjectedCrash",
            "TAXONOMY"]
 
@@ -180,6 +181,9 @@ TAXONOMY = {
     "message_rules": tuple((p.pattern, cls) for p, cls in _MESSAGE_RULES),
     "dump_triggers": {"oom": _OOM_PATTERN.pattern,
                       "deadline": _DEADLINE_PATTERN.pattern},
+    # the fleet router's failover rule (ISSUE 19): which classes route
+    # a per-replica failure onto a DIFFERENT replica
+    "failover_classes": (TRANSIENT, PREEMPTION),
 }
 
 
@@ -249,6 +253,36 @@ def is_preemption(exc):
     while exc is not None and id(exc) not in seen:
         seen.add(id(exc))
         if classify(exc) == PREEMPTION:
+            return True
+        exc = exc.__cause__ or exc.__context__
+    return False
+
+
+def is_failover(exc):
+    """True when a per-REPLICA failure should be retried on a
+    DIFFERENT replica (the fleet router's failover rule, ISSUE 19) —
+    distinct from plain retry: the same-replica budget is irrelevant
+    because the router moves the request sideways instead of waiting
+    out a backoff schedule against a dead socket.
+
+    Failover-worthy: the transient and preemption shapes — a replica
+    connection reset / RemoteDisconnected (its process was SIGKILL'd
+    mid-request), an overload 503, a generic infrastructure blip.
+    NOT failover-worthy: deadline shapes (the budget is spent — moving
+    replicas cannot un-spend it) and fatal shapes (a bad request fails
+    identically everywhere; re-running it N more times only multiplies
+    the damage).  Walks the cause/context chain like is_oom/is_deadline
+    so a router-side wrapper around the transport error still routes
+    correctly — with deadline links checked first at every hop, since
+    an expired budget must win over whatever transient noise the
+    expiry surfaced alongside."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        cls = classify(exc)
+        if cls == DEADLINE:
+            return False
+        if cls in (TRANSIENT, PREEMPTION):
             return True
         exc = exc.__cause__ or exc.__context__
     return False

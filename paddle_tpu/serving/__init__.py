@@ -22,6 +22,14 @@ step over a fixed slot×max_len KV ring buffer, per-bucket prefill
 refills without retracing, per-TOKEN deadline budgets, and
 tokens/s / TTFT / occupancy observability (`DecodeStats`).
 
+ISSUE 19 adds the FLEET tier: `registry.py` (versioned model registry
+with atomic `_COMPLETE`-markered publishes + per-version AOT artifact
+cache), `replica.py` (a replica worker hosting one runtime per model
+version with zero-drop hot-swap, behind an HTTP surface), and
+`fleet.py` (a health-gated router with per-replica breakers,
+classified failover, and a merged requests==sum(outcomes) fleet
+ledger).
+
 Observability: exact p50/p99 latency, queue-depth/in-flight gauges,
 `resilience.*` shed/retry/breaker/watchdog counters, per-request spans
 in the merged Chrome trace, `monitor.serving_table()`, and
@@ -33,6 +41,11 @@ from .bucketing import (BucketDispatcher, default_buckets,  # noqa: F401
                         pick_bucket)
 from .decode import (DecodeConfig, DecodeEngine,            # noqa: F401
                      EngineBrokenError, default_prompt_buckets)
+from .fleet import (FleetRouter, NoReplicaAvailable,        # noqa: F401
+                    ReplicaHandle, ReplicaRequestError,
+                    ReplicaUnavailable, router_table)
+from .registry import ModelRegistry, RegistryError          # noqa: F401
+from .replica import ModelHost, ReplicaServer               # noqa: F401
 from .runtime import (DeadlineExceeded, QueueFullError,     # noqa: F401
                       ServingClosedError, ServingConfig,
                       ServingFuture, ServingRuntime)
@@ -46,4 +59,7 @@ __all__ = [
     "QueueFullError", "ServingClosedError", "DeadlineExceeded",
     "WatchdogStall", "HangWatchdog", "ServingStats", "serving_table",
     "BucketDispatcher", "default_buckets", "pick_bucket",
+    "FleetRouter", "ReplicaHandle", "NoReplicaAvailable",
+    "ReplicaUnavailable", "ReplicaRequestError", "router_table",
+    "ModelRegistry", "RegistryError", "ModelHost", "ReplicaServer",
 ]

@@ -23,10 +23,17 @@ independently, the non-padding rows are BITWISE identical to an
 unbatched `Predictor.run` (asserted by tests/test_serving.py).
 """
 
+import os
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+try:
+    from jax import export as _jax_export
+except ImportError:  # pragma: no cover
+    _jax_export = None
 
 __all__ = ["default_buckets", "pick_bucket", "BucketDispatcher"]
 
@@ -240,8 +247,66 @@ class BucketDispatcher:
             example = self._zero_example(bucket)
             if example is None:
                 return n
+            if self._key(bucket, self._feat_sig(example)) in self._cache:
+                continue           # already imported from the AOT cache
             self._compile(bucket, example, self._feat_sig(example))
             n += 1
+        return n
+
+    # -- AOT artifact cache (ISSUE 19) ----------------------------------
+    def export_aot(self, dirname, platforms=None):
+        """Serialize one ``jax.export`` artifact per bucket
+        (``b<bucket>.jaxexport``) into `dirname` — the cold-start cache
+        payload a later replica imports instead of recompiling.  Rides
+        the same serialization path as Predictor.export_compiled.
+        Returns the number of artifacts written (0 for a
+        CompiledPredictor — it already IS the artifact — or when shapes
+        are dynamic / jax.export is unavailable)."""
+        if hasattr(self.predictor, "_exported") or _jax_export is None:
+            return 0
+        os.makedirs(dirname, exist_ok=True)
+        n = 0
+        for bucket in self.buckets:
+            example = self._zero_example(bucket)
+            if example is None:
+                return n
+            exported = _jax_export.export(
+                self.predictor._fn, platforms=platforms)(example)
+            path = os.path.join(dirname, f"b{bucket}.jaxexport")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(exported.serialize())
+            os.replace(tmp, path)
+            n += 1
+        return n
+
+    def import_aot(self, dirname):
+        """Load per-bucket serialized executables into the compiled-fn
+        cache WITHOUT tracing or compiling — zero compile-ledger
+        events, which is the whole point: a cold replica reaches first
+        byte on cache hits alone.  Each artifact lands under the same
+        cache key `_compile` would have used, so a version/shape
+        mismatch simply misses and falls through to a (ledgered)
+        compile instead of serving a stale executable.  Returns the
+        number of buckets imported."""
+        if hasattr(self.predictor, "_exported") or _jax_export is None:
+            return 0
+        n = 0
+        for bucket in self.buckets:
+            path = os.path.join(dirname, f"b{bucket}.jaxexport")
+            if not os.path.isfile(path):
+                continue
+            example = self._zero_example(bucket)
+            if example is None:
+                return n
+            with open(path, "rb") as f:
+                exported = _jax_export.deserialize(f.read())
+            key = self._key(bucket, self._feat_sig(example))
+            self._cache[key] = exported.call
+            n += 1
+        mon = _mon()
+        if n and mon.is_enabled():
+            mon.counter("serving.aot_import").add(n)
         return n
 
     def dispatch(self, batched, bucket):

@@ -484,6 +484,43 @@ def test_elastic_fleet_smoke_row_shape():
 
 
 # ---------------------------------------------------------------------------
+# fleet_serving_smoke row (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_serving_smoke_in_suite_and_standalone():
+    """The fleet-serving chaos row is wired into the suite AND the
+    standalone argv entry (registry/failover/hot-swap behaviors
+    themselves are covered by tests/test_fleet_serving.py; the real
+    2-subprocess kill/roll arc runs end-to-end under `python bench.py
+    fleet_serving_smoke` — respawning the replica fleet here would pay
+    two cold jax starts per CI run for no new signal)."""
+    src = open(bench.__file__).read()
+    assert '("fleet_serving_smoke", "fleet_serving_smoke"' in src
+    assert '"fleet_serving_smoke" in sys.argv[1:]' in src
+    assert "main_fleet_serving_smoke" in src
+
+
+def test_fleet_serving_smoke_row_shape():
+    """The row's check list carries every acceptance pillar of ISSUE
+    19: the mid-request replica kill verifiably fired and the failover
+    absorbed it, the dead replica is health-gated out, the version
+    rolled forward and back bitwise under zero-drop traffic, the
+    merged requests==sum(outcomes) identity plus per-attempt
+    accounting, the AOT cold start with zero serving compiles, and the
+    router-hop/replica trace join."""
+    src = open(bench.__file__).read()
+    for check in ("replicas_started", "failover_absorbed",
+                  "kill_fired", "dead_replica_gated",
+                  "roll_applied_to_live_fleet",
+                  "roll_forward_back_bitwise", "zero_drop_during_roll",
+                  "ledger_identity", "attempts_all_resolved",
+                  "aot_cold_start_zero_compiles",
+                  "trace_joined_across_hop"):
+        assert check in src, check
+
+
+# ---------------------------------------------------------------------------
 # tp_runtime_smoke row (ISSUE 16)
 # ---------------------------------------------------------------------------
 

@@ -125,6 +125,9 @@ def summarize(records):
     topo = _elastic_section(records)
     if topo:
         out["elastic_topology"] = topo
+    fleet_srv = _fleet_serving_section(records)
+    if fleet_srv:
+        out["fleet_serving"] = fleet_srv
     return out
 
 
@@ -340,6 +343,63 @@ def _serving_section(records):
             entry["decode"] = dblock
         progs[k] = entry
     out["by_runtime"] = progs
+    return out
+
+
+def _fleet_serving_section(records):
+    """Fleet-router summary from the kind="fleet_serving" records the
+    FleetRouter emits (on close / emit_telemetry).  Newest record per
+    router label wins; per router: the router's own outcome ledger,
+    failover count, the MERGED router+replica ledger with its
+    requests == sum(outcomes) identity — UNACCOUNTED (uppercase, like
+    the serving section's UNRESOLVED) flags the silent losses the
+    identity failed to cover — the per-attempt started/resolved row
+    (which covers even replicas that died holding their ledgers), and
+    one health/version/breaker row per replica."""
+    per_router = {}
+    for r in records:
+        if r.get("kind") == "fleet_serving":
+            per_router[r.get("label")] = r
+    if not per_router:
+        return None
+    out = {"routers": len(per_router)}
+    rows = {}
+    for label, r in sorted(per_router.items()):
+        router = r.get("router") or {}
+        merged = r.get("merged") or {}
+        attempts = r.get("attempts") or {}
+        entry = {
+            "requests": router.get("requests", 0),
+            "outcomes": {k: v for k, v in
+                         (router.get("outcomes") or {}).items() if v},
+            "failovers": r.get("failovers", 0),
+            "merged_requests": merged.get("requests", 0),
+            "merged_resolved": merged.get("resolved", 0),
+        }
+        if merged.get("unaccounted"):
+            entry["UNACCOUNTED"] = merged["unaccounted"]
+        if attempts.get("unaccounted"):
+            entry["attempts_unaccounted"] = attempts["unaccounted"]
+        if attempts:
+            entry["attempts"] = {
+                "started": attempts.get("started", 0),
+                "resolved": attempts.get("resolved", 0)}
+        reps = {}
+        for rep in r.get("replicas") or ():
+            row = {"healthy": rep.get("healthy"),
+                   "version": rep.get("version")}
+            if rep.get("dead"):
+                row["dead"] = True
+            if rep.get("draining"):
+                row["draining"] = True
+            br = rep.get("breaker") or {}
+            if br.get("state") not in (None, "closed"):
+                row["breaker"] = br.get("state")
+            reps[rep.get("name")] = row
+        if reps:
+            entry["replicas"] = reps
+        rows[label] = entry
+    out["by_router"] = rows
     return out
 
 
@@ -697,6 +757,9 @@ def summarize_fleet(by_rank, merged):
     topo = _elastic_section(merged)
     if topo:
         out["elastic_topology"] = topo
+    fleet_srv = _fleet_serving_section(merged)
+    if fleet_srv:
+        out["fleet_serving"] = fleet_srv
     tracing = _tracing_section(merged)
     if tracing:
         # join spans by trace id across the rank streams (ISSUE 18): a
