@@ -2925,6 +2925,217 @@ def main_fault_tolerance_smoke():
     return 0 if r.get("value") == 1 else 1
 
 
+def bench_goodput_smoke(on_tpu, peak):
+    """Goodput-ledger chaos row (ISSUE 20 CI satellite): a tiny fc
+    train loop through the PUBLIC train_from_dataset on the CPU mesh
+    with FLAGS_goodput on and known-duration badput injected — a data
+    stall at reader.prepare (prefetch=False, so it lands inline on the
+    consumer thread instead of hiding behind pipelining), one
+    transient under a jitter-free fixed-backoff retry, a checkpoint
+    save with an injected stall, plus the unavoidable first compile —
+    asserting (a) the ledger's categories sum EXACTLY (integer ns, ==)
+    to the measured wall clock with the unattributed residual <= 1%,
+    (b) each injected delay lands in ITS OWN category within +/-20% of
+    the injected duration, (c) the stored goodput_fraction re-derives
+    == from the raw buckets via goodput.compute_fractions, and (d) the
+    flag-off dispatch fast path pays nothing: plain Executor.run
+    medians with the ledger off stay at or below the ledger-on medians
+    (generous noise bound) and the off loop creates no ledger.
+
+    Side effect: like the other smoke rows, the PROCESS-GLOBAL monitor
+    and fault-injection state are reset."""
+    import statistics
+    import tempfile
+
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor, resilience
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.monitor import goodput
+
+    steps = 8
+    batch = 16
+    # each injection must DOMINATE the genuine work sharing its bucket
+    # (the +/-20% band is around the injected duration): batch prep is
+    # ~free, a backoff sleep is pure, but the in-run saves cost real
+    # tens of ms even with the writer primed — so the checkpoint stall
+    # is the largest
+    stall_s, backoff_s, ck_stall_s = 0.12, 0.08, 0.30
+    was_enabled = monitor.is_enabled()
+    monitor.reset()
+    monitor.enable()
+    old_flag = fluid.get_flags("FLAGS_goodput")
+    fluid.set_flags({"FLAGS_goodput": True})
+    try:
+        with fluid.unique_name.guard():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [None, 16])
+                y = fluid.data("y", [None, 1])
+                h = fluid.layers.fc(x, 16, act="relu")
+                pred = fluid.layers.fc(h, 1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(0.05).minimize(loss)
+        ndev = len(jax.devices())
+        mesh_devices = ndev if ndev > 1 and batch % ndev == 0 else 1
+        prog = main
+        if mesh_devices > 1:
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, places=mesh_devices)
+
+        rng = np.random.default_rng(0)
+        batches = [
+            {"x": rng.standard_normal((batch, 16)).astype(np.float32),
+             "y": rng.standard_normal((batch, 1)).astype(np.float32)}
+            for _ in range(steps)]
+
+        ckdir = tempfile.mkdtemp(prefix="paddle_tpu_goodput_")
+        mgr = CheckpointManager(ckdir, save_interval_steps=6)
+        exe = fluid.Executor()
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        # prime the checkpoint writer OUTSIDE the ledgered run (writer
+        # imports, fs warmup): the in-run save's genuine cost must not
+        # swamp the +/-20% band around the injected stall
+        from paddle_tpu.checkpoint import save_checkpoint
+        save_checkpoint(
+            tempfile.mkdtemp(prefix="paddle_tpu_goodput_prime_"),
+            {"w": np.zeros((4,), np.float32)}, step=0)
+        resilience.enable_retry(resilience.RetryPolicy(
+            max_retries=3, base_delay=backoff_s, jitter=0.0, seed=0))
+        with resilience.plan_scope(
+                transient_at_step=5, transient_times=1,
+                stall_points={"reader.prepare": (3, stall_s),
+                              "checkpoint.save": ck_stall_s}):
+            exe.train_from_dataset(
+                prog, batches, scope=sc, fetch_list=[loss],
+                checkpoint=mgr, print_period=10 ** 6, prefetch=False)
+            fired = dict(resilience.faultinject.active_plan().fired)
+        resilience.disable_retry()
+
+        recs = monitor.goodput_records()
+        rec = recs[-1] if recs else {}
+        wall = int(rec.get("wall_ns") or 0)
+        cats = {k: int(v) for k, v in
+                (rec.get("categories") or {}).items()}
+
+        def within(cat, injected_s):
+            # the bucket holds the injected delay plus the genuine
+            # work at that site (a real save, real batch prep, real
+            # backoff bookkeeping) — +/-20% of the injected duration
+            # is the acceptance bound ISSUE 20 names
+            return abs(cats.get(cat, 0) - injected_s * 1e9) \
+                <= 0.20 * injected_s * 1e9
+
+        # ---- (d) flag-off fast path on the now-warm program -------
+        feed = batches[0]
+        for _ in range(20):
+            exe.run(prog, feed=feed, fetch_list=[loss], scope=sc,
+                    return_numpy=False)
+        n_recs = len(monitor.goodput_records())
+        gled = goodput.start_run(key="fastpath_on")
+        on_us = []
+        for _ in range(200):
+            t0 = time.perf_counter()
+            exe.run(prog, feed=feed, fetch_list=[loss], scope=sc,
+                    return_numpy=False)
+            on_us.append((time.perf_counter() - t0) * 1e6)
+        goodput.abandon(gled)
+        fluid.set_flags({"FLAGS_goodput": False})
+        off_us = []
+        for _ in range(200):
+            t0 = time.perf_counter()
+            exe.run(prog, feed=feed, fetch_list=[loss], scope=sc,
+                    return_numpy=False)
+            off_us.append((time.perf_counter() - t0) * 1e6)
+        on_med = statistics.median(on_us)
+        off_med = statistics.median(off_us)
+
+        frac = goodput.compute_fractions(rec)
+        checks = {
+            "record_emitted": bool(rec)
+                and rec.get("kind") == "goodput",
+            "injections_fired": fired.get("transient") == 1
+                and fired.get("stall") == 2,
+            "sum_exact": wall > 0 and sum(cats.values()) == wall,
+            "unattributed_le_1pct": wall > 0
+                and cats.get("unattributed", 0) <= 0.01 * wall,
+            "data_stall_attributed": within("data_wait", stall_s),
+            "retry_backoff_attributed": within("recovery", backoff_s),
+            "checkpoint_attributed": within("checkpoint_save",
+                                            ck_stall_s),
+            "compile_attributed": cats.get("compile", 0) > 0,
+            "steps_counted": rec.get("steps") == steps,
+            "fraction_rederives":
+                frac["goodput_fraction"] == rec.get("goodput_fraction")
+                and frac["badput_fraction"]
+                == rec.get("badput_fraction"),
+            "fastpath_off_no_ledger":
+                len(monitor.goodput_records()) == n_recs
+                and goodput.active() is None,
+            "fastpath_off_no_overhead":
+                off_med <= on_med * 1.5 + 100.0,
+        }
+        checks = {k: bool(v) for k, v in checks.items()}
+        row = {"metric": "goodput_smoke",
+               "value": int(all(checks.values())), "unit": "ok",
+               "vs_baseline": None, "steps": steps,
+               "mesh_devices": mesh_devices,
+               "wall_s": round(wall / 1e9, 4),
+               "goodput_fraction": rec.get("goodput_fraction"),
+               "categories_ms": {c: round(ns / 1e6, 3)
+                                 for c, ns in sorted(cats.items())
+                                 if ns},
+               "injected_ms": {"data_wait": stall_s * 1e3,
+                               "recovery": backoff_s * 1e3,
+                               "checkpoint_save": ck_stall_s * 1e3},
+               "dispatch_us": {"ledger_on_p50": round(on_med, 1),
+                               "ledger_off_p50": round(off_med, 1)},
+               "checks": checks}
+        if not all(checks.values()):
+            row["error"] = "failed checks: " + ", ".join(
+                k for k, v in checks.items() if not v)
+        return row
+    finally:
+        resilience.disable_retry()
+        resilience.faultinject.disarm()
+        gl = goodput.active()
+        if gl is not None:
+            goodput.abandon(gl)
+        fluid.set_flags(old_flag)
+        monitor.disable()
+        monitor.reset()
+        if was_enabled:
+            monitor.enable()
+
+
+def main_goodput_smoke():
+    """`python bench.py goodput_smoke` — CI/tooling entry: the goodput
+    attribution chaos row standalone on a 2-device virtual CPU mesh,
+    persisted to BENCH_TPU.json under rows["goodput_smoke"].  Exit 0
+    only when every attribution check passes."""
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=2")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    device = str(getattr(dev, "device_kind", dev.platform))
+    r = bench_goodput_smoke(False, _peak_flops(dev))
+    r["device"] = device
+    row = dict(r)
+    row["git_sha"] = _git_sha()
+    row["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())
+    doc = _load_bench_tpu() or {"rows": {}}
+    doc.setdefault("rows", {})["goodput_smoke"] = row
+    _save_bench_tpu(doc)
+    print(json.dumps(r), flush=True)
+    return 0 if r.get("value") == 1 else 1
+
+
 def bench_serving_smoke(on_tpu, peak):
     """Serving chaos row (ISSUE 8 CI satellite): a tiny saved model
     served through the hardened ServingRuntime on the CPU mesh with
@@ -4694,6 +4905,7 @@ def main():
          bench_mem_profile_smoke),
         ("fault_tolerance_smoke", "fault_tolerance_smoke",
          bench_fault_tolerance_smoke),
+        ("goodput_smoke", "goodput_smoke", bench_goodput_smoke),
         ("serving_smoke", "serving_smoke", bench_serving_smoke),
         ("decode_serving_smoke", "decode_serving_smoke",
          bench_decode_serving_smoke),
@@ -4786,6 +4998,8 @@ if __name__ == "__main__":
         sys.exit(main_mem_profile_smoke())
     if "fault_tolerance_smoke" in sys.argv[1:]:
         sys.exit(main_fault_tolerance_smoke())
+    if "goodput_smoke" in sys.argv[1:]:
+        sys.exit(main_goodput_smoke())
     if "decode_serving_smoke" in sys.argv[1:]:
         sys.exit(main_decode_serving_smoke())
     if "request_tracing_smoke" in sys.argv[1:]:

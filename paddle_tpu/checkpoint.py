@@ -78,6 +78,13 @@ def _crash_point(name):
     faultinject.crash_point(name)
 
 
+def _stall_point(name):
+    from .resilience import faultinject
+
+    if faultinject.is_armed():
+        faultinject.stall_point(name)
+
+
 def _iter_payload_files(path):
     """Every file under the step dir except the marker/manifest
     themselves, as (relpath, abspath) in sorted order."""
@@ -306,6 +313,27 @@ def save_checkpoint(directory, state, step, sparse_tables=None,
     per checkpoint, so the two writers can share one directory.
     """
     t0 = time.perf_counter()
+    # the whole synchronous write is badput the goodput ledger charges
+    # to checkpoint_save (a no-op when no ledger is active); the stall
+    # point lets the chaos bench inject a known-duration slow save
+    gled = _mon().goodput.active()
+    gpushed = gled is not None and gled.push("checkpoint_save")
+    try:
+        _stall_point("checkpoint.save")
+        return _save_checkpoint_body(directory, state, step,
+                                     sparse_tables=sparse_tables,
+                                     extras=extras, topology=topology,
+                                     writer=writer, t0=t0)
+    finally:
+        if gpushed:
+            gled.pop()
+
+
+def _save_checkpoint_body(directory, state, step, sparse_tables=None,
+                          extras=None, topology=None, writer=None,
+                          t0=None):
+    if t0 is None:
+        t0 = time.perf_counter()
     path = _step_path(directory, step)
     if os.path.isdir(path):  # overwrite an old/incomplete attempt
         shutil.rmtree(path)

@@ -326,6 +326,20 @@ declare_flag("fleet_failover_attempts", 2,
 declare_flag("fleet_request_timeout_s", 30.0,
              "Socket timeout for one router->replica request hop.")
 
+# Goodput ledger (paddle_tpu.monitor.goodput, ISSUE 20): partition the
+# entire wall time of a train_from_dataset run / long Executor.run
+# session into an exhaustive set of integer-ns categories (productive
+# step, compile, data wait, host dispatch, checkpoint save, recovery,
+# elastic transition, dp sync wait, unattributed residual) that sum
+# EXACTLY to the measured wall time.  Off (default) = gate-free: the
+# dispatch path pays one module-global read; on = one clock read per
+# category transition.
+declare_flag("goodput", False,
+             "Keep the wall-clock goodput/badput attribution ledger "
+             "during training runs (kind=\"goodput\" record, /metrics "
+             "goodput gauges + per-category badput counters, chrome "
+             "badput tracks).")
+
 declare_flag("maxpool_mask_bwd", False,
              "Give max-pool a recompute-mask custom VJP (window passes "
              "+ shifted compares, all XLA-fusable) instead of the "

@@ -39,6 +39,7 @@ _resilience = None
 _op_sampler_slot = None
 _flight = None
 _fleet_mod = None
+_goodput_mod = None
 
 
 def _dispatch_span(name):
@@ -114,6 +115,52 @@ def _fleet():
 
         _fleet_mod = fleet
     return _fleet_mod
+
+
+def _gp():
+    """Lazy paddle_tpu.monitor.goodput handle (ISSUE 20): the run
+    ledger the dispatch path charges wall time into.  goodput.active()
+    is None unless FLAGS_goodput armed one — the whole off path is one
+    module-global read."""
+    global _goodput_mod
+    if _goodput_mod is None:
+        from ..monitor import goodput
+
+        _goodput_mod = goodput
+    return _goodput_mod
+
+
+# reusable (contextlib.nullcontext is reentrant) — the off path must
+# not allocate a context object per span site
+_NULL_CTX = contextlib.nullcontext()
+
+
+def _gspan(category):
+    """Goodput span context for `category`: a real ledger span while a
+    run ledger is active, the shared nullcontext otherwise."""
+    gled = _gp().active()
+    if gled is None:
+        return _NULL_CTX
+    return gled.span(category)
+
+
+def _goodput_batches(gen):
+    """Iterate `gen` charging the wait for each next prepared batch to
+    the active ledger's data_wait bucket (reader / prefetch / sparse-
+    pull starvation as seen by the consuming thread); a plain
+    passthrough when no ledger is active."""
+    gen = iter(gen)
+    end = object()
+    while True:
+        gled = _gp().active()
+        if gled is None:
+            item = next(gen, end)
+        else:
+            with gled.span("data_wait"):
+                item = next(gen, end)
+        if item is end:
+            return
+        yield item
 
 
 def _materialize(fetches):
@@ -877,6 +924,36 @@ class Executor:
         use_program_cache=True,
         _train_loop=False,
     ):
+        # Goodput accounting (ISSUE 20): while a run ledger is active,
+        # the whole dispatch body is a host_dispatch span — re-labeled
+        # compile on a fresh trace, with the device-sync points inside
+        # charging productive_step (innermost span wins).  With no
+        # ledger (FLAGS_goodput off) this is one global read and a
+        # direct call into the unchanged dispatch path.
+        gled = _gp().active()
+        if gled is None:
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy, use_program_cache,
+                                  _train_loop)
+        pushed = gled.push("host_dispatch")
+        try:
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy, use_program_cache,
+                                  _train_loop)
+        finally:
+            if pushed:
+                gled.pop()
+
+    def _run_impl(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        scope=None,
+        return_numpy=True,
+        use_program_cache=True,
+        _train_loop=False,
+    ):
         program = program if program is not None else default_main_program()
         mon = _mon()
         mon_on = mon.is_enabled()
@@ -1217,6 +1294,14 @@ class Executor:
             # new Program allocated at the same address after GC
             entry = self._cache.get(key) if use_program_cache else None
         fresh_compile = entry is None or entry[1] is not program
+        gled = _gp().active()
+        if gled is not None and fresh_compile:
+            # jit compiles on FIRST INVOCATION, so trace + XLA compile
+            # both happen between here and the end of the dispatch
+            # block: re-label the enclosing host_dispatch span until
+            # then (time already charged stays host_dispatch — the
+            # plan/feed prep above really was dispatch work)
+            gled.retag("compile")
         if fresh_compile:
             if mon_on:
                 mon.counter("compiled_step.miss").add(1)
@@ -1268,8 +1353,10 @@ class Executor:
                         # block here so a transient execution error
                         # surfaces where backoff can catch it: fault
                         # tolerance trades the steps-ahead pipeline
-                        # for retryability.
-                        jax.block_until_ready(out)
+                        # for retryability.  The wait IS the step's
+                        # device execution — goodput's productive time.
+                        with _gspan("productive_step"):
+                            jax.block_until_ready(out)
                     return out
 
                 # async dispatch (retry off): this returns device
@@ -1294,6 +1381,10 @@ class Executor:
             # RetriesExhausted chains it — lands here.)
             self._oom_postmortem(e, mon_on)
             raise
+        if gled is not None and fresh_compile:
+            # compile is done (first invocation returned): the rest of
+            # this run is ordinary dispatch bookkeeping again
+            gled.retag("host_dispatch")
         if spmd_plan is not None:
             # record the model-axis collectives XLA inserted from the
             # auto-axis constraints: the plan's OWN implied records, so
@@ -1349,7 +1440,10 @@ class Executor:
         if return_numpy:
             with _dispatch_span("executor.run.fetch"):
                 try:
-                    return _materialize(fetches)
+                    # the one sync point of the synchronous path: the
+                    # block covers the step's device execution
+                    with _gspan("productive_step"):
+                        return _materialize(fetches)
                 except Exception as e:
                     # async dispatch (retry off) defers execution
                     # failures to this sync point — an OOM surfacing
@@ -1585,7 +1679,11 @@ class Executor:
         old state on device); rollback restores the newest complete
         checkpoint into the scope and raises RollbackPerformed so the
         training loop rewinds its data cursor."""
-        ok = float(np.asarray(guard_flag)) >= 1.0
+        # the flag materialization is where the guarded step's device
+        # execution is awaited: productive time — unless the policy
+        # decides below that the step was wasted
+        with _gspan("productive_step") as gs:
+            ok = float(np.asarray(guard_flag)) >= 1.0
         if ok:
             guard.note_ok()
             return
@@ -1605,6 +1703,13 @@ class Executor:
             guard.last_skipped = True
             if mon.is_enabled():
                 mon.counter("resilience.skipped_steps").add(1)
+            gled = _gp().active()
+            if gled is not None:
+                # the step committed nothing: the execution wait just
+                # charged as productive was really recovery (sum-
+                # preserving move of exactly the span's own ns)
+                gled.reclassify("productive_step", "recovery",
+                                getattr(gs, "ns", 0))
             return
         # rollback: restore newest complete checkpoint into the scope
         guard.note_rollback()        # escalates past max_rollbacks
@@ -1613,7 +1718,8 @@ class Executor:
             v = scope.find_var(n)
             if v is not None:
                 template[n] = v
-        with _dispatch_span("resilience.rollback_restore"):
+        with _dispatch_span("resilience.rollback_restore"), \
+                _gspan("recovery"):
             try:
                 state, ck_step = guard.manager.restore_latest(template)
             except FileNotFoundError as e:
@@ -1709,6 +1815,48 @@ class Executor:
         Returns the list of final-batch fetch values (or None, like the
         reference, when fetch_list is empty).
         """
+        # Goodput ledger lifecycle (ISSUE 20): one ledger per run while
+        # FLAGS_goodput is on (start_run returns None otherwise, and
+        # also when an enclosing run already owns the wall clock).  The
+        # kind="goodput" record is emitted on EVERY exit — a run that
+        # died still reports where its wall time went.
+        gp = _gp()
+        gled = gp.start_run(
+            key=getattr(program, "_telemetry_label", None)
+            or "train_from_dataset")
+        if gled is None:
+            return self._train_from_dataset_impl(
+                program=program, dataset=dataset, scope=scope,
+                thread=thread, debug=debug, fetch_list=fetch_list,
+                fetch_info=fetch_info, print_period=print_period,
+                sparse_config=sparse_config, _sparse_push=_sparse_push,
+                prefetch=prefetch, checkpoint=checkpoint,
+                auto_resume=auto_resume, elastic=elastic)
+        outcome = "error"
+        try:
+            out = self._train_from_dataset_impl(
+                program=program, dataset=dataset, scope=scope,
+                thread=thread, debug=debug, fetch_list=fetch_list,
+                fetch_info=fetch_info, print_period=print_period,
+                sparse_config=sparse_config, _sparse_push=_sparse_push,
+                prefetch=prefetch, checkpoint=checkpoint,
+                auto_resume=auto_resume, elastic=elastic)
+            outcome = "ok"
+            return out
+        finally:
+            # the dp barrier wait the skew probe measured hid inside
+            # the productive sync points: move it to its own bucket
+            # (sum-preserving) before the record is built
+            gled.fold_dp_sync(_fleet().fleet_skew())
+            gp.finish_run(gled, extra={"outcome": outcome})
+
+    def _train_from_dataset_impl(self, program=None, dataset=None,
+                                 scope=None, thread=0, debug=False,
+                                 fetch_list=None, fetch_info=None,
+                                 print_period=100, sparse_config=None,
+                                 _sparse_push=True, prefetch=None,
+                                 checkpoint=None, auto_resume=False,
+                                 elastic=None):
         program = program if program is not None else default_main_program()
         real_prog = program
         if hasattr(real_prog, "_get_executable_program"):
@@ -1861,6 +2009,11 @@ class Executor:
                         or all(_is_async(e) for e in entries))
 
         def prepare(batch):
+            # latency injection for the input pipeline (the goodput
+            # chaos bench stalls batch preparation here): armed-gated,
+            # so the unarmed path pays one None check
+            if res.faultinject.is_armed():
+                res.faultinject.stall_point("reader.prepare")
             feed = {k: v for k, v in batch.items()
                     if blk._find_var_recursive(k) is not None}
             fl = list(fetch_names)
@@ -1988,9 +2141,10 @@ class Executor:
 
             raise TopologyChanged(step_i, ev, action) from e
 
-        for feed, fl, batch_ids in prepared_batches():
+        for feed, fl, batch_ids in _goodput_batches(prepared_batches()):
             if elastic is not None:
-                ev = elastic.step_boundary(step_i)
+                with _gspan("elastic_transition"):
+                    ev = elastic.step_boundary(step_i)
                 if ev is not None:
                     kind = ev["kind"]
                     if kind == "self_leave" and ev.get("reason") == \
@@ -1998,8 +2152,9 @@ class Executor:
                         # SIGUSR1 drain-and-leave: durable boundary
                         # state, leave intent already posted, exit
                         # cleanly and stay re-admittable
-                        elastic.force_save(_ckpt_state(), step_i,
-                                           extras=_ckpt_extras())
+                        with _gspan("elastic_transition"):
+                            elastic.force_save(_ckpt_state(), step_i,
+                                               extras=_ckpt_extras())
                         if mon.is_enabled():
                             mon.counter(
                                 "resilience.elastic_drain_exits").add(1)
@@ -2008,16 +2163,18 @@ class Executor:
                         # grow force-saves the rendezvous checkpoint,
                         # commits the enlarged topology, and raises
                         # TopologyChanged(action="relaunch")
-                        elastic.grow(step_i, ev["ranks"],
-                                     save_state=_ckpt_state(),
-                                     extras=_ckpt_extras())
+                        with _gspan("elastic_transition"):
+                            elastic.grow(step_i, ev["ranks"],
+                                         save_state=_ckpt_state(),
+                                         extras=_ckpt_extras())
                     if kind in ("rank_leave", "rank_death", "evict"):
                         # survivors force-save at THIS boundary; the
                         # caller drives the shrink (reshard in process
                         # or orchestrator relaunch) from the durable
                         # state — the loop's compiled world is stale
-                        elastic.force_save(_ckpt_state(), step_i,
-                                           extras=_ckpt_extras())
+                        with _gspan("elastic_transition"):
+                            elastic.force_save(_ckpt_state(), step_i,
+                                               extras=_ckpt_extras())
                         survivors = [m for m in elastic.members
                                      if m not in ev["ranks"]]
                         action = ("reshard_local"
@@ -2126,12 +2283,18 @@ class Executor:
                     # are the NaNs the guard just refused to apply
                     out = out[:-n]
                 else:
-                    grads = _materialize(out[-n:])
+                    # per-step sparse sync point: awaiting the gradient
+                    # rows is awaiting the step's device execution
+                    with _gspan("productive_step"):
+                        grads = _materialize(out[-n:])
                     for e, g in zip(entries, grads):
                         e["table"].push(batch_ids[e["emb_var"]], g)
                     out = out[:-n]
             last = out
             step_i += 1
+            gled = _gp().active()
+            if gled is not None:
+                gled.note_step()
             if mgr is not None and mgr.should_save(step_i):
                 # interval-gated BEFORE building the state dict: the
                 # 999 gated-off steps of a 1000-step interval must not
@@ -2152,9 +2315,13 @@ class Executor:
                     replay = [it for it in replay if it[0] > step_i]
             if (debug or fetch_info) and fetch_names \
                     and step_i % print_period == 0:
+                # print-period sync: draining the async pipeline here
+                # waits on the steps it had in flight
+                with _gspan("productive_step"):
+                    vals = _materialize(out)
                 msg = ", ".join(
                     f"{info}={v.mean():.6f}"
-                    for info, v in zip(fetch_info, _materialize(out)))
+                    for info, v in zip(fetch_info, vals))
                 print(f"[train_from_dataset] step {step_i}: {msg}")
         if mon.is_enabled():
             # loop-end fleet record (ISSUE 10): the rolling skew table
@@ -2165,7 +2332,12 @@ class Executor:
                 key=getattr(program, "_telemetry_label", None))
         if not fetch_names:
             return None
-        return _materialize(last) if last is not None else None
+        if last is None:
+            return None
+        # final sync: the async pipeline's remaining in-flight steps
+        # complete here
+        with _gspan("productive_step"):
+            return _materialize(last)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

@@ -40,6 +40,7 @@ from . import flight_recorder  # noqa: F401  — installs crash hooks
 from . import fleet                                       # noqa: F401
 from . import exporter                                    # noqa: F401
 from . import tracing                                     # noqa: F401
+from . import goodput                                     # noqa: F401
 from .fleet import fleet_skew, rank_info, rank_tag        # noqa: F401
 
 __all__ = [
@@ -58,6 +59,7 @@ __all__ = [
     "record_fleet_skew", "fleet_skew_records",
     "record_elastic", "elastic_records",
     "record_fleet_serving", "fleet_serving_records",
+    "goodput", "record_goodput", "goodput_records",
     "MetricsRegistry", "MetricsSession", "CompileLedger", "JsonlWriter",
     "read_jsonl", "Counter", "Gauge", "PEAK_FLOPS", "peak_flops",
     "parse_cost_analysis", "parse_memory_analysis",
@@ -94,6 +96,10 @@ _fleet_serving_records = []
 # kind="trace" records from request tracing (ISSUE 18): each retained
 # span tree (SLO violators + head-sampled), emitted at trace finish
 _trace_records = []
+# kind="goodput" records from the wall-clock attribution ledger
+# (ISSUE 20): one per finished run — integer-ns category buckets that
+# sum exactly to the run's wall time, goodput fraction, effective MFU
+_goodput_records = []
 
 
 def enable(jsonl_path=None):
@@ -141,6 +147,7 @@ def reset():
     del _elastic_records[:]
     del _fleet_serving_records[:]
     del _trace_records[:]
+    del _goodput_records[:]
     tracing.get().reset()
 
 
@@ -334,6 +341,36 @@ def fleet_serving_records():
     return list(_fleet_serving_records)
 
 
+def record_goodput(record):
+    """Write one kind="goodput" record (a finished GoodputLedger's
+    wall-clock attribution: integer-ns category buckets summing exactly
+    to wall_ns, goodput_fraction, effective_mfu) onto the telemetry
+    JSONL stream and keep it addressable in-process
+    (goodput_records()).  Like lint/serving/fleet records it rides the
+    stream without touching step numbering; the record is kept even
+    while telemetry is off — the ledger only exists when FLAGS_goodput
+    armed it, and dropping its one record because enable() wasn't
+    called would silently lose the whole run's attribution."""
+    if not record:
+        return None
+    record = dict(record)
+    record.setdefault("kind", "goodput")
+    import time as _time
+
+    record.setdefault("ts_us", _time.perf_counter_ns() / 1000.0)
+    record.setdefault("wall_time", _time.time())
+    _goodput_records.append(record)
+    if _enabled:
+        _session.emit_record(record)
+    return record
+
+
+def goodput_records():
+    """kind="goodput" records seen since enable()/reset(), newest
+    last."""
+    return list(_goodput_records)
+
+
 def serving_table():
     """One summary row per live ServingRuntime — request outcomes
     (completed / shed / expired / rejected / failed / stalled /
@@ -491,6 +528,13 @@ def snapshot():
         out["tracing"] = tr
     if skew:
         out["fleet"] = {"rank": fleet.rank_tag(), "skew": skew}
+    # the ACTIVE run's in-flight breakdown wins over a past finished
+    # record — a snapshot is the now-state; history stays addressable
+    # via goodput_records()
+    if goodput.active() is not None:
+        out["goodput"] = goodput.active().flight_record()
+    elif _goodput_records:
+        out["goodput"] = dict(_goodput_records[-1])
     return out
 
 

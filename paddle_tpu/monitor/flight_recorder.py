@@ -427,6 +427,20 @@ class FlightRecorder:
                               "wall_time": time.time(), **skew})
         except Exception:
             pass
+        try:
+            # goodput ledger (ISSUE 20): finished runs' kind="goodput"
+            # records plus the ACTIVE ledger's in-flight breakdown —
+            # an OOM/crash dump carries the run's time attribution so
+            # a post-mortem answers "was it slow before it died"
+            from .. import monitor
+            from . import goodput
+
+            for rec in monitor.goodput_records():
+                lines.append(rec)
+            for rec in goodput.flight_records():
+                lines.append({"wall_time": time.time(), **rec})
+        except Exception:
+            pass
         lines.extend(snap["events"])
         lines.extend(snap["compiles"])
         lines.extend(snap["steps"])

@@ -54,12 +54,21 @@ def shuffle(reader, buf_size, seed=None):
 
 
 def buffered(reader, size):
-    """Background-thread prefetch (decorator.py buffered)."""
+    """Background-thread prefetch (decorator.py buffered).  The
+    consumer side is instrumented: buffer occupancy lands on the
+    `reader.prefetch_depth` gauge at every get (starvation shows as a
+    flatline at 0 on /metrics and the chrome counter track), and the
+    blocking get itself is charged to the goodput ledger's data_wait
+    bucket while one is active."""
 
     class _End:
         pass
 
     def buffered_reader():
+        from .. import monitor
+        from ..monitor import goodput
+
+        depth = monitor.gauge("reader.prefetch_depth")
         q = queue.Queue(maxsize=size)
 
         def worker():
@@ -72,7 +81,13 @@ def buffered(reader, size):
         t = threading.Thread(target=worker, daemon=True)
         t.start()
         while True:
-            item = q.get()
+            depth.set(q.qsize())
+            gled = goodput.active()
+            if gled is None:
+                item = q.get()
+            else:
+                with gled.span("data_wait"):
+                    item = q.get()
             if item is _End:
                 break
             yield item
@@ -188,6 +203,9 @@ def device_prefetch(batches, size=2, device=None):
     def put(item):
         return jax.tree_util.tree_map(put_leaf, item)
 
+    from .. import monitor
+
+    depth = monitor.gauge("reader.prefetch_depth")
     it = iter(batches)
     queue = collections.deque()
 
@@ -197,6 +215,10 @@ def device_prefetch(batches, size=2, device=None):
 
     fill(size)
     while queue:
+        # buffer occupancy AT each get: a healthy double buffer reads
+        # `size`, a starved one flatlines at 1 (this batch only) — the
+        # input-starvation signal on /metrics and the chrome track
+        depth.set(len(queue))
         out = queue.popleft()
         # issue batch N+1's transfer BEFORE handing batch N to the
         # consumer: the copy overlaps the consumer's step

@@ -145,5 +145,15 @@ def call_with_retry(fn, policy=None, classify_fn=classify,
                              error=f"{type(e).__name__}: {e}"[:200])
             if on_retry is not None:
                 on_retry(attempt, d, e)
-            policy.sleep(d)
+            # the backoff is pure badput: charge it to the goodput
+            # ledger's recovery bucket (innermost-span-wins, so a
+            # backoff during a compile retry still reads as recovery)
+            gled = _mon().goodput.active()
+            if gled is not None and gled.push("recovery"):
+                try:
+                    policy.sleep(d)
+                finally:
+                    gled.pop()
+            else:
+                policy.sleep(d)
             attempt += 1

@@ -139,8 +139,13 @@ class FleetRouter:
                  health_poll_s=None, failover_attempts=None,
                  request_timeout_s=None, breaker_threshold=3,
                  breaker_cooldown_s=2.0, clock=time.monotonic,
-                 auto_poll=True):
+                 auto_poll=True, policy="round_robin"):
+        if policy not in ("round_robin", "least_loaded"):
+            raise ValueError(
+                f"unknown routing policy {policy!r}: want 'round_robin' "
+                f"or 'least_loaded'")
         self.label = label
+        self.policy = policy
         self.clock = clock
         self.health_poll_s = float(
             health_poll_s if health_poll_s is not None
@@ -253,11 +258,31 @@ class FleetRouter:
                 if r.healthy and not r.draining and not r.dead
                 and r.name not in tried]
 
+    @staticmethod
+    def _load(rep):
+        """Scraped load of one replica: queued + in-flight requests
+        from its newest /stats doc (the runtime's own admission
+        gauges).  None when no poll has landed a stats doc yet — the
+        least-loaded policy treats that as unknown, not as idle."""
+        active = (rep.last_stats or {}).get("active") or {}
+        depth = active.get("queue_depth")
+        in_flight = active.get("in_flight")
+        if depth is None and in_flight is None:
+            return None
+        return int(depth or 0) + int(in_flight or 0)
+
     def _pick(self, tried):
-        """Round-robin over routable replicas, taking the first whose
-        breaker admits traffic.  allow() is only asked in candidate
-        order (it hands out half-open probe tokens — polling every
-        breaker would burn probes on replicas we don't pick)."""
+        """Pick a routable replica whose breaker admits traffic.
+
+        round_robin (default): rotate over the candidates.
+        least_loaded: order candidates by their scraped queue-depth +
+        in-flight load (ISSUE 20 satellite — the first consumer of the
+        metrics the observability tier exports); replicas with no
+        scraped gauges sort last, and ties keep the round-robin
+        rotation order, so a fleet with no stats yet degrades to exact
+        round-robin.  allow() is only asked in candidate order (it
+        hands out half-open probe tokens — polling every breaker would
+        burn probes on replicas we don't pick)."""
         with self._lock:
             candidates = self._routable(tried)
             if not candidates:
@@ -265,8 +290,15 @@ class FleetRouter:
             start = self._rr
             self._rr += 1
         n = len(candidates)
-        for i in range(n):
-            rep = candidates[(start + i) % n]
+        ordered = [candidates[(start + i) % n] for i in range(n)]
+        if self.policy == "least_loaded":
+            loads = [self._load(rep) for rep in ordered]
+            if any(ld is not None for ld in loads):
+                order = sorted(range(n),
+                               key=lambda i: (loads[i] is None,
+                                              loads[i] or 0))
+                ordered = [ordered[i] for i in order]
+        for rep in ordered:
             if rep.breaker.allow():
                 return rep
         return None
@@ -458,7 +490,8 @@ class FleetRouter:
         }
 
     def fleet_record(self):
-        rec = {"kind": "fleet_serving", "label": self.label}
+        rec = {"kind": "fleet_serving", "label": self.label,
+               "policy": self.policy}
         rec.update(self.fleet_ledger())
         return rec
 

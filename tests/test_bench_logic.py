@@ -250,6 +250,22 @@ def test_fault_tolerance_smoke_in_suite_and_standalone():
 
 
 # ---------------------------------------------------------------------------
+# goodput_smoke chaos row (ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_goodput_smoke_in_suite_and_standalone():
+    """The goodput attribution row is wired into the suite AND the
+    standalone argv entry (the ledger behaviors themselves are covered
+    end-to-end by tests/test_goodput.py; re-running the whole row here
+    would pay its compiles twice per CI run for no new signal)."""
+    src = open(bench.__file__).read()
+    assert '("goodput_smoke", "goodput_smoke"' in src
+    assert '"goodput_smoke" in sys.argv[1:]' in src
+    assert "main_goodput_smoke" in src
+
+
+# ---------------------------------------------------------------------------
 # serving_smoke chaos row (ISSUE 8 satellite)
 # ---------------------------------------------------------------------------
 

@@ -607,6 +607,91 @@ def test_router_roll_swaps_fleet_and_back_bitwise(registry):
 
 
 # ---------------------------------------------------------------------
+# least-loaded routing (ISSUE 20 satellite)
+# ---------------------------------------------------------------------
+
+def _mk_offline_router(n=3, **kw):
+    """A router over unreachable endpoints — _pick ordering is pure
+    cached-state logic, so no sockets are needed to test it."""
+    return FleetRouter(
+        [(f"r{i}", "127.0.0.1", 1 + i) for i in range(n)],
+        label=_label("ll"), auto_poll=False, **kw)
+
+
+def _set_load(rep, depth=None, in_flight=None):
+    active = {}
+    if depth is not None:
+        active["queue_depth"] = depth
+    if in_flight is not None:
+        active["in_flight"] = in_flight
+    rep.last_stats = {"active": active} if active else {"active": {}}
+
+
+def test_least_loaded_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        _mk_offline_router(policy="fastest_guess")
+
+
+def test_least_loaded_picks_smallest_scraped_load():
+    router = _mk_offline_router(policy="least_loaded")
+    try:
+        _set_load(router.replicas[0], depth=4, in_flight=1)
+        _set_load(router.replicas[1], depth=0, in_flight=1)
+        _set_load(router.replicas[2], depth=2, in_flight=2)
+        # load is queue_depth + in_flight: r1 (1) < r2 (4) < r0 (5);
+        # the pick ignores the rr rotation while loads differ
+        for _ in range(4):
+            assert router._pick(set()).name == "r1"
+        # a failover that already tried the least-loaded replica moves
+        # to the next-least-loaded, not back to rr order
+        assert router._pick({"r1"}).name == "r2"
+    finally:
+        router.close(emit=False)
+
+
+def test_least_loaded_missing_stats_sort_last():
+    router = _mk_offline_router(policy="least_loaded")
+    try:
+        _set_load(router.replicas[0], depth=2)
+        _set_load(router.replicas[1], in_flight=2)
+        # r2 never produced a stats doc: unknown, NOT idle — while any
+        # replica has a scraped load, the unknown one is picked last
+        picks = [router._pick(set()).name for _ in range(4)]
+        assert set(picks) == {"r0", "r1"}
+        # ...and the r0/r1 TIE keeps rotating round-robin
+        assert picks[0] != picks[1]
+        assert router._pick({"r0", "r1"}).name == "r2"
+    finally:
+        router.close(emit=False)
+
+
+def test_least_loaded_without_any_stats_is_round_robin():
+    router = _mk_offline_router(policy="least_loaded")
+    try:
+        picks = [router._pick(set()).name for _ in range(6)]
+        assert picks == ["r0", "r1", "r2", "r0", "r1", "r2"]
+    finally:
+        router.close(emit=False)
+
+
+def test_least_loaded_end_to_end_and_record_carries_policy(registry):
+    router, reps = _mk_fleet(registry, policy="least_loaded")
+    try:
+        router.poll_once()            # land real /stats docs
+        for i in range(4):
+            outs = router.run(_feed(1, seed=i))
+            assert np.asarray(outs[0]).shape == (1, 3)
+        s = router.stats.summary()
+        assert s["outcomes"]["completed"] == 4
+        rec = router.fleet_record()
+        assert rec["policy"] == "least_loaded"
+    finally:
+        router.close(emit=False)
+        for s in reps:
+            s.close()
+
+
+# ---------------------------------------------------------------------
 # observability: exporter families + report section + telemetry record
 # ---------------------------------------------------------------------
 

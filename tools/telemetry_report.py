@@ -26,8 +26,13 @@ retains and the "tracing" rollup embedded in kind="serving" records —
 per-label SLO attainment and burn rate, the p99 request's exact
 tail-latency attribution, and the top slowest traces with their
 dominant component; flight dumps carry the same record shapes, so a
-post-mortem reads identically) — without touching the process that
-produced the file.
+post-mortem reads identically), and a goodput section (ISSUE 20: from
+the kind="goodput" records the wall-clock attribution ledger emits at
+the end of each train_from_dataset run — the per-category badput table
+with each category's share of measured wall, the dominant badput
+category, and the exact-sum / fraction-re-derivation invariants
+surfaced in uppercase when violated) — without touching the process
+that produced the file.
 
 Fleet mode (ISSUE 10): every line a rank writes is stamped with
 ``{host, process_index}`` (monitor.fleet.rank_tag), so N per-rank
@@ -128,6 +133,9 @@ def summarize(records):
     fleet_srv = _fleet_serving_section(records)
     if fleet_srv:
         out["fleet_serving"] = fleet_srv
+    gp = _goodput_section(records)
+    if gp:
+        out["goodput"] = gp
     return out
 
 
@@ -642,6 +650,67 @@ def _fleet_skew_section(records):
     return out
 
 
+def _goodput_section(records):
+    """Wall-clock attribution from the kind="goodput" records the
+    goodput ledger emits at the end of a train_from_dataset run (ISSUE
+    20).  Newest record per run key wins (by wall_time like the skew
+    table — a fleet merge concatenates rank streams; flight dumps stamp
+    wall_time on the lines they re-emit, and an in-flight crash
+    snapshot carries ``in_flight: true``).  Per run: the per-category
+    table with each category's share of measured wall, the dominant
+    badput category, and the two invariants the ledger promises —
+    categories sum EXACTLY (integer ns) to wall, and the stored
+    goodput_fraction re-derives from the raw buckets — surfaced in
+    uppercase when violated, like UNRESOLVED in the serving section."""
+    per_key = {}
+    for r in records:
+        if r.get("kind") == "goodput" and r.get("categories"):
+            prev = per_key.get(r.get("key"))
+            if prev is None or ((r.get("wall_time") or 0)
+                                >= (prev.get("wall_time") or 0)):
+                per_key[r.get("key")] = r
+    if not per_key:
+        return None
+    out = {"runs": len(per_key)}
+    runs = {}
+    for k, r in sorted(per_key.items(), key=lambda kv: str(kv[0])):
+        wall = int(r.get("wall_ns") or 0)
+        cats = {c: int(ns) for c, ns in (r.get("categories") or
+                                         {}).items()}
+        entry = {
+            "wall_s": round(wall / 1e9, 3),
+            "steps": r.get("steps", 0),
+            "goodput_pct": round(
+                (r.get("goodput_fraction") or 0.0) * 100, 2),
+        }
+        if r.get("in_flight"):
+            # a crash/watchdog dump snapshotted the ledger mid-run —
+            # the exact-sum invariant only binds finished records
+            entry["in_flight"] = True
+        if r.get("effective_mfu") is not None:
+            entry["effective_mfu"] = r["effective_mfu"]
+        entry["categories"] = {
+            c: {"s": round(ns / 1e9, 3),
+                "pct": round(ns / wall * 100, 2) if wall else 0.0}
+            for c, ns in sorted(cats.items(),
+                                key=lambda kv: (-kv[1], kv[0])) if ns}
+        bad = {c: ns for c, ns in cats.items()
+               if c != "productive_step" and ns}
+        if bad:
+            entry["top_badput"] = max(
+                bad.items(), key=lambda kv: (kv[1], kv[0]))[0]
+        if not r.get("in_flight"):
+            if sum(cats.values()) != wall:
+                entry["SUM_MISMATCH_NS"] = sum(cats.values()) - wall
+            if wall > 0 and r.get("goodput_fraction") is not None \
+                    and cats.get("productive_step", 0) / wall \
+                    != r["goodput_fraction"]:
+                entry["FRACTION_MISMATCH"] = True
+        runs[k] = entry
+    out["by_run"] = runs
+    return out
+
+
 def _elastic_section(records):
     """Topology history from the kind="elastic" records the elastic
     coordinator emits (ISSUE 11): every transition (shrink/grow, from→
@@ -730,6 +799,17 @@ def summarize_fleet(by_rank, merged):
             row["host_dispatch_us"] = s["host_dispatch_us"]
         if s.get("examples_per_sec"):
             row["examples_per_sec"] = s["examples_per_sec"]
+        gp = s.get("goodput")
+        if gp and gp.get("by_run"):
+            # one goodput line per rank: its newest run's wall,
+            # goodput %, and dominant badput category — the detail
+            # table stays in the single-stream view
+            run = list(gp["by_run"].values())[-1]
+            grow = {"wall_s": run["wall_s"],
+                    "goodput_pct": run["goodput_pct"]}
+            if run.get("top_badput"):
+                grow["top_badput"] = run["top_badput"]
+            row["goodput"] = grow
         rows[label] = row
     out["by_rank"] = rows
     # steady-state means: drop each rank's first two steps (compile/
@@ -754,6 +834,26 @@ def summarize_fleet(by_rank, merged):
     skew = _fleet_skew_section(merged)
     if skew:
         out["fleet_skew"] = skew
+    # fleet goodput: productive over wall summed across every rank's
+    # newest finished ledger (raw integer ns, not the rounded per-rank
+    # rows) — one number for "what fraction of the fleet's paid
+    # wall-clock trained the model"
+    gp_wall = gp_prod = 0
+    for label, records in sorted(by_rank.items()):
+        per_key = {}
+        for r in records:
+            if r.get("kind") == "goodput" and r.get("categories") \
+                    and not r.get("in_flight"):
+                prev = per_key.get(r.get("key"))
+                if prev is None or ((r.get("wall_time") or 0)
+                                    >= (prev.get("wall_time") or 0)):
+                    per_key[r.get("key")] = r
+        for r in per_key.values():
+            gp_wall += int(r.get("wall_ns") or 0)
+            gp_prod += int((r.get("categories") or {})
+                           .get("productive_step") or 0)
+    if gp_wall:
+        out["fleet_goodput_pct"] = round(gp_prod / gp_wall * 100, 2)
     topo = _elastic_section(merged)
     if topo:
         out["elastic_topology"] = topo
